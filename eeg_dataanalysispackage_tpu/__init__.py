@@ -1,0 +1,30 @@
+"""TPU-native EEG data-analysis framework.
+
+A ground-up JAX/XLA re-design of the capabilities of
+``NEUROINFORMATICS-GROUP-FAV-KIV-ZCU/EEG_DataAnalysisPackage`` (the
+"Spark_EEG_Analysis" P300 guess-the-number BCI pipeline): BrainVision
+ingest -> stimulus-locked epoching -> Daubechies-8 DWT features ->
+target/non-target classification, rebuilt TPU-first.
+
+Layer map (mirrors SURVEY.md section 7):
+
+- ``io``        BrainVision vhdr/vmrk/eeg parsing, info.txt sources,
+                host staging (native C++ demux when built).
+- ``epochs``    marker -> window gather, baseline correction, the
+                order-dependent target/non-target balance scan.
+- ``ops``       numeric kernels: db8 DWT (host-parity and batched XLA
+                variants), baseline, normalization, FFT band-pass.
+- ``features``  the ``fe=`` plugin registry (dwt-8, dwt-8-tpu).
+- ``models``    the ``train_clf=`` plugin registry (logreg, svm, dt,
+                rf, nn) + classification statistics.
+- ``parallel``  jax.sharding Mesh construction, data-parallel batch
+                sharding, collective-based SGD.
+- ``pipeline``  query-string DSL front end (parity with the reference
+                run-time configuration surface) + CLI.
+- ``utils``     Java interop shims (java.util.Random / shuffle for
+                split parity), config handling.
+- ``checkpoint`` model/optimizer persistence.
+- ``obs``       profiling hooks, stage timers, metrics.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,260 @@
+"""BrainVision (.vhdr/.vmrk/.eeg) reader.
+
+Parses the Brain Vision Data Exchange format (INI-style header + marker
+files, multiplexed int16 binary data) the way the reference's closed
+``eegloader-hdfs`` jar does, as observed through
+``/root/reference/src/main/java/cz/zcu/kiv/DataTransformation/OffLineDataProvider.java:167-196``
+and the fixture headers (``test-data/DoD/DoD2015_01.vhdr``).
+
+Scaling: each int16 sample is multiplied by the per-channel resolution
+(e.g. 0.1 uV) in float64, matching ``readBinaryData(...) -> double[]``.
+
+The hot demux (int16 -> scaled float) is vectorized numpy here; the
+optional native C++ path lives in ``io/native.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class ChannelInfo:
+    """One ``Ch<n>=<Name>,<Ref>,<Resolution>,<Unit>`` entry."""
+
+    number: int  # 1-based channel number, as in the header
+    name: str
+    reference: str
+    resolution: float
+    units: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Marker:
+    """One ``Mk<n>=<Type>,<Description>,<Position>,...`` entry.
+
+    ``stimulus`` carries the description text (e.g. ``"S  2"``);
+    ``position`` is the raw position-in-data-points field, used directly
+    as the sample index the way the reference uses
+    ``marker.getPosition()`` (OffLineDataProvider.java:220-225).
+    """
+
+    name: str
+    kind: str
+    stimulus: str
+    position: int
+
+    def stimulus_index(self) -> int:
+        """Digits of the stimulus text minus one; -1 when no digits.
+
+        Mirrors ``replaceAll("[\\D]", "")`` + parse - 1
+        (OffLineDataProvider.java:207-214).
+        """
+        digits = re.sub(r"\D", "", self.stimulus)
+        if digits:
+            return int(digits) - 1
+        return -1
+
+
+@dataclasses.dataclass(frozen=True)
+class Header:
+    data_file: str
+    marker_file: str
+    data_format: str  # BINARY
+    orientation: str  # MULTIPLEXED | VECTORIZED
+    num_channels: int
+    sampling_interval_us: float
+    binary_format: str  # INT_16 | IEEE_FLOAT_32
+    channels: List[ChannelInfo]
+
+    @property
+    def sampling_rate_hz(self) -> float:
+        return 1e6 / self.sampling_interval_us
+
+    def channel_index(self, name: str) -> Optional[int]:
+        """0-based index of a channel by case-insensitive name."""
+        lname = name.lower()
+        for i, ch in enumerate(self.channels):
+            if ch.name.lower() == lname:
+                return i
+        return None
+
+
+_SECTION_RE = re.compile(r"^\[(?P<name>.+)\]\s*$")
+_KV_RE = re.compile(r"^(?P<key>[^=;]+)=(?P<value>.*)$")
+
+
+def _parse_ini(text: str) -> Dict[str, Dict[str, str]]:
+    """Minimal INI parse: sections, key=value, ';' comments skipped.
+
+    The [Comment] section of real vhdr files contains free text with
+    '=' signs; values are kept verbatim, later sections win on dup keys.
+    """
+    sections: Dict[str, Dict[str, str]] = {}
+    current: Optional[Dict[str, str]] = None
+    for raw in text.splitlines():
+        line = raw.strip("\r\n")
+        if not line or line.lstrip().startswith(";"):
+            continue
+        m = _SECTION_RE.match(line.strip())
+        if m:
+            current = sections.setdefault(m.group("name"), {})
+            continue
+        if current is None:
+            continue
+        kv = _KV_RE.match(line)
+        if kv:
+            current[kv.group("key").strip()] = kv.group("value")
+    return sections
+
+
+def _unescape_name(name: str) -> str:
+    # Commas in channel names are coded as "\1" per the format spec.
+    return name.replace("\\1", ",")
+
+
+def parse_vhdr(text: str) -> Header:
+    sections = _parse_ini(text)
+    common = sections.get("Common Infos", {})
+    binary = sections.get("Binary Infos", {})
+    chan_section = sections.get("Channel Infos", {})
+
+    channels: List[ChannelInfo] = []
+    chan_keys = [k for k in chan_section if re.fullmatch(r"Ch\d+", k)]
+    for key in sorted(chan_keys, key=lambda k: int(k[2:])):
+        parts = chan_section[key].split(",")
+        # <Name>,<Reference>,<Resolution>,<Unit>, future extensions
+        name = _unescape_name(parts[0]) if parts else ""
+        ref = parts[1] if len(parts) > 1 else ""
+        res = float(parts[2]) if len(parts) > 2 and parts[2] else 1.0
+        units = parts[3] if len(parts) > 3 else "uV"
+        channels.append(
+            ChannelInfo(
+                number=int(key[2:]),
+                name=name,
+                reference=ref,
+                resolution=res,
+                units=units,
+            )
+        )
+
+    return Header(
+        data_file=common.get("DataFile", ""),
+        marker_file=common.get("MarkerFile", ""),
+        data_format=common.get("DataFormat", "BINARY"),
+        orientation=common.get("DataOrientation", "MULTIPLEXED"),
+        num_channels=int(common.get("NumberOfChannels", len(channels) or 1)),
+        sampling_interval_us=float(common.get("SamplingInterval", 1000)),
+        binary_format=binary.get("BinaryFormat", "INT_16"),
+        channels=channels,
+    )
+
+
+_MARKER_KEY_RE = re.compile(r"^Mk\d+$")
+
+
+def parse_vmrk(text: str) -> List[Marker]:
+    sections = _parse_ini(text)
+    infos = sections.get("Marker Infos", {})
+    markers: List[Marker] = []
+    # preserve numeric Mk order
+    for key in sorted(infos, key=lambda k: int(k[2:]) if k[2:].isdigit() else 0):
+        if not _MARKER_KEY_RE.match(key):
+            continue
+        parts = infos[key].split(",")
+        kind = parts[0] if parts else ""
+        stimulus = _unescape_name(parts[1]) if len(parts) > 1 else ""
+        try:
+            position = int(parts[2]) if len(parts) > 2 else 0
+        except ValueError:
+            position = 0
+        markers.append(Marker(name=key, kind=kind, stimulus=stimulus, position=position))
+    return markers
+
+
+_BINARY_DTYPES = {
+    "INT_16": np.dtype("<i2"),
+    "INT_32": np.dtype("<i4"),
+    "IEEE_FLOAT_32": np.dtype("<f4"),
+}
+
+
+class Recording:
+    """A parsed BrainVision triplet with lazy channel access."""
+
+    def __init__(self, header: Header, markers: List[Marker], raw: np.ndarray):
+        self.header = header
+        self.markers = markers
+        # raw: (num_samples, num_channels) unscaled samples
+        self._raw = raw
+
+    @property
+    def num_samples(self) -> int:
+        return self._raw.shape[0]
+
+    def read_channel(self, index: int) -> np.ndarray:
+        """Full channel as float64 scaled by its resolution (0-based index).
+
+        Matches ``DataTransformer.readBinaryData`` returning double[]
+        (OffLineDataProvider.java:186-188). The closed eegloader jar
+        performs the sample*resolution scaling in *float32* before
+        widening to double — pinned empirically by bit-comparing the
+        fixture epochs against the reference's Epochs.csv artifact
+        (diffs of exactly 2^-12 at |x|~2285 otherwise).
+        """
+        res = np.float32(self.header.channels[index].resolution)
+        return (self._raw[:, index].astype(np.float32) * res).astype(np.float64)
+
+    def read_channels(self, indices: Sequence[int]) -> np.ndarray:
+        """(len(indices), num_samples) float64 scaled channel matrix."""
+        res = np.array(
+            [self.header.channels[i].resolution for i in indices], dtype=np.float32
+        )
+        scaled32 = self._raw[:, list(indices)].T.astype(np.float32) * res[:, None]
+        return scaled32.astype(np.float64)
+
+
+def load_recording(
+    eeg_path: str,
+    vhdr_path: Optional[str] = None,
+    vmrk_path: Optional[str] = None,
+    filesystem=None,
+) -> Recording:
+    """Load a BrainVision triplet.
+
+    Sibling .vhdr/.vmrk default to .eeg with the suffix substituted, as
+    ``setFileNames`` does (OffLineDataProvider.java:327-365).
+    ``filesystem`` is an ``io.sources`` FileSystem; defaults to local.
+    """
+    from . import sources
+
+    fs = filesystem or sources.LocalFileSystem()
+    base, _ = os.path.splitext(eeg_path)
+    vhdr_path = vhdr_path or base + ".vhdr"
+    vmrk_path = vmrk_path or base + ".vmrk"
+
+    for p in (vhdr_path, vmrk_path, eeg_path):
+        if not fs.exists(p):
+            raise FileNotFoundError(f"No related file found: {p}")
+
+    header = parse_vhdr(fs.read_text(vhdr_path))
+    markers = parse_vmrk(fs.read_text(vmrk_path))
+    blob = fs.read_bytes(eeg_path)
+
+    dtype = _BINARY_DTYPES.get(header.binary_format)
+    if dtype is None:
+        raise ValueError(f"Unsupported BinaryFormat: {header.binary_format}")
+    flat = np.frombuffer(blob, dtype=dtype)
+    nch = header.num_channels
+    nsamp = flat.size // nch
+    flat = flat[: nsamp * nch]
+    if header.orientation.upper() == "MULTIPLEXED":
+        raw = flat.reshape(nsamp, nch)
+    else:  # VECTORIZED: ch1 all samples, ch2 all samples, ...
+        raw = flat.reshape(nch, nsamp).T
+    return Recording(header, markers, raw)

@@ -1,0 +1,149 @@
+"""Offline data provider: info.txt / .eeg inputs -> balanced epoch batch.
+
+TPU-first re-design of ``DataTransformation/OffLineDataProvider.java``:
+instead of a stateful loader mutating epoch lists per marker, files are
+parsed on the host into dense ``(n, 3, 750)`` arrays ready for device
+staging. Input-contract parity:
+
+- args ``[<info.txt path>]`` or ``[<.eeg path>, <guessed number>]``
+  (OffLineDataProvider.java:111-141);
+- info.txt entries are resolved against the info.txt's directory
+  (``filePrefix`` — :129);
+- duplicate info.txt entries collapse, first-seen order, last guess
+  wins (LinkedHashMap semantics — :53, :308);
+- files whose .vhdr/.vmrk/.eeg sibling is missing are skipped with a
+  log, not fatal (:154-161);
+- channels named fz/cz/pz (case-insensitive) are selected (:172-183);
+- the balance counters span all files of a run (:58-59).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from . import brainvision, sources
+from ..epochs import extractor
+from ..utils import constants
+
+logger = logging.getLogger(__name__)
+
+
+class OfflineDataProvider:
+    """Loads BrainVision recordings and extracts balanced P300 epochs."""
+
+    def __init__(
+        self,
+        args: Sequence[str],
+        filesystem: Optional[sources.FileSystem] = None,
+        channel_names: Sequence[str] = constants.CHANNEL_NAMES,
+        pre: int = constants.PRESTIMULUS_SAMPLES,
+        post: int = constants.POSTSTIMULUS_SAMPLES,
+    ):
+        args = [a for a in args if a is not None]
+        if len(args) == 0 or len(args) > 6:
+            raise ValueError(
+                "Please enter the input in one of these formats: "
+                "1. <location of info.txt file> "
+                "2. <location of a .eeg file> <guessed number> *<optional values>"
+            )
+        self._args = list(args)
+        self._fs = filesystem or sources.LocalFileSystem()
+        self._channel_names = [c.lower() for c in channel_names]
+        self._pre = pre
+        self._post = post
+        self._batch: Optional[extractor.EpochBatch] = None
+        # Resolved channel indices persist across files of a run: the
+        # reference's FZIndex/CZIndex/PZIndex are instance fields, so a
+        # file missing a channel silently reuses the index resolved for
+        # the previous file (OffLineDataProvider.java:49-51,172-183);
+        # the int-field default 0 applies only before the first hit.
+        self._last_indices: Dict[str, int] = {c: 0 for c in self._channel_names}
+
+    # -- input handling -------------------------------------------------
+
+    def _resolve_files(self) -> tuple[str, Dict[str, int]]:
+        """Returns (prefix, ordered {path: guessed number})."""
+        loc = self._args[0]
+        if loc.endswith(constants.EEG_EXTENSION):
+            if len(self._args) < 2:
+                raise ValueError(
+                    "A .eeg input requires a guessed number: "
+                    "<location of a .eeg file> <guessed number>"
+                )
+            return "", {loc: int(self._args[1])}
+        if loc.endswith(".txt"):
+            prefix = loc[: loc.rfind("/")] + "/" if "/" in loc else ""
+            return prefix, sources.parse_info_txt(self._fs.read_text(loc))
+        raise ValueError(
+            "Please enter the input in one of these formats: "
+            "1. <location of info.txt file> "
+            "2. <location of a .eeg file> <guessed number> *<optional values>"
+        )
+
+    # -- loading --------------------------------------------------------
+
+    def load(self) -> extractor.EpochBatch:
+        """Parse inputs and extract epochs from every resolvable file."""
+        prefix, files = self._resolve_files()
+        balance = extractor.BalanceState()
+        batches: List[extractor.EpochBatch] = []
+        for rel_path, guessed in files.items():
+            eeg_path = prefix + rel_path
+            try:
+                rec = brainvision.load_recording(eeg_path, filesystem=self._fs)
+            except FileNotFoundError as e:
+                logger.warning("Did not load %s: %s", rel_path, e)
+                continue
+            batches.append(self._process_recording(rec, guessed, balance))
+        self._batch = extractor.EpochBatch.concatenate(batches)
+        return self._batch
+
+    # Reference-compatible alias (OffLineDataProvider.loadData).
+    load_data = load
+
+    def _process_recording(
+        self,
+        rec: brainvision.Recording,
+        guessed: int,
+        balance: extractor.BalanceState,
+    ) -> extractor.EpochBatch:
+        indices = []
+        for name in self._channel_names:
+            idx = rec.header.channel_index(name)
+            if idx is None:
+                idx = self._last_indices[name]
+                logger.warning(
+                    "Channel %s not found; reusing stale index %d", name, idx
+                )
+            self._last_indices[name] = idx
+            indices.append(idx)
+        channels = rec.read_channels(indices)
+        return extractor.extract_epochs(
+            channels,
+            rec.markers,
+            guessed,
+            pre=self._pre,
+            post=self._post,
+            balance=balance,
+        )
+
+    # -- reference-parity accessors ------------------------------------
+
+    @property
+    def batch(self) -> extractor.EpochBatch:
+        if self._batch is None:
+            self.load()
+        assert self._batch is not None
+        return self._batch
+
+    def get_data(self) -> List[np.ndarray]:
+        """List of (3, 750) float64 epochs (reference ``getData``)."""
+        return [e for e in self.batch.epochs]
+
+    def get_data_labels(self) -> List[float]:
+        """List of 0.0/1.0 labels (reference ``getDataLabels``)."""
+        return [float(t) for t in self.batch.targets]

@@ -1,0 +1,30 @@
+"""Test configuration.
+
+Tests run hermetically on CPU with a virtual 8-device mesh so
+multi-chip sharding is exercised without TPU hardware (the reference
+never tested multi-node at all — SURVEY.md section 4). Must run before
+jax initializes its backends, hence the env mutation at import time.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+REFERENCE_TEST_DATA = "/root/reference/test-data"
+
+
+@pytest.fixture(scope="session")
+def fixture_dir():
+    if not os.path.isdir(REFERENCE_TEST_DATA):
+        pytest.skip("reference fixture data not available")
+    return REFERENCE_TEST_DATA

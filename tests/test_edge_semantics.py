@@ -1,0 +1,124 @@
+"""Edge-case parity semantics not covered by the reference fixtures.
+
+Pin the Java behaviors found during review: Arrays.copyOfRange
+zero-padding past the end of a recording, trailing-space info.txt
+lines, and stale channel-index reuse across files of a run.
+"""
+
+import numpy as np
+import pytest
+
+from eeg_dataanalysispackage_tpu.epochs import extractor
+from eeg_dataanalysispackage_tpu.io import provider, sources
+
+
+def make_vhdr(channels=("Fz", "Cz", "Pz"), resolution="0.1"):
+    lines = [
+        "Brain Vision Data Exchange Header File Version 1.0",
+        "",
+        "[Common Infos]",
+        "DataFile=x.eeg",
+        "MarkerFile=x.vmrk",
+        "DataFormat=BINARY",
+        "DataOrientation=MULTIPLEXED",
+        f"NumberOfChannels={len(channels)}",
+        "SamplingInterval=1000",
+        "",
+        "[Binary Infos]",
+        "BinaryFormat=INT_16",
+        "",
+        "[Channel Infos]",
+    ]
+    for i, ch in enumerate(channels):
+        lines.append(f"Ch{i+1}={ch},,{resolution},µV")
+    return "\n".join(lines).encode()
+
+
+def make_vmrk(positions_stimuli):
+    lines = ["[Common Infos]", "DataFile=x.eeg", "", "[Marker Infos]"]
+    for i, (pos, stim) in enumerate(positions_stimuli):
+        lines.append(f"Mk{i+1}=Stimulus,S{stim:>3},{pos},1,0")
+    return "\n".join(lines).encode()
+
+
+def make_recording_fs(path_base, n_samples, positions_stimuli, channels=("Fz", "Cz", "Pz")):
+    rng = np.random.RandomState(0)
+    data = rng.randint(-1000, 1000, size=(n_samples, len(channels))).astype("<i2")
+    fs = sources.InMemoryFileSystem()
+    fs.write_bytes(path_base + ".vhdr", make_vhdr(channels))
+    fs.write_bytes(path_base + ".vmrk", make_vmrk(positions_stimuli))
+    fs.write_bytes(path_base + ".eeg", data.tobytes())
+    return fs, data
+
+
+def test_end_of_recording_window_zero_padded():
+    """A marker whose window runs past the end is kept zero-padded,
+    exactly as Arrays.copyOfRange does (from <= length, to beyond)."""
+    n = 1000
+    fs, data = make_recording_fs("rec", n, [(600, 1)])  # window [500, 1350)
+    odp = provider.OfflineDataProvider(["rec.eeg", "1"], filesystem=fs)
+    batch = odp.load()
+    assert batch.epochs.shape == (1, 3, 750)
+    # samples past the recording end are exactly zero minus baseline
+    pad_region = batch.epochs[0, :, n - 600 :]  # beyond original length
+    base_region = batch.epochs[0, :, : n - 600]
+    assert np.all(pad_region == pad_region[:, :1])  # constant = -baseline
+    assert not np.all(base_region == base_region[:, :1])
+
+
+def test_window_starting_past_end_dropped():
+    n = 1000
+    fs, _ = make_recording_fs("rec", n, [(1200, 1)])  # from=1100 > length
+    odp = provider.OfflineDataProvider(["rec.eeg", "1"], filesystem=fs)
+    assert len(odp.load()) == 0
+
+
+def test_window_from_equals_length_kept_all_zero():
+    n = 1000
+    fs, _ = make_recording_fs("rec", n, [(1100, 1)])  # from=1000 == length
+    odp = provider.OfflineDataProvider(["rec.eeg", "1"], filesystem=fs)
+    batch = odp.load()
+    assert batch.epochs.shape == (1, 3, 750)
+    assert np.all(batch.epochs == 0.0)
+
+
+def test_info_txt_trailing_space_line_skipped():
+    files = sources.parse_info_txt("A/a.eeg \nB/b.eeg 5\n \n")
+    assert files == {"B/b.eeg": 5}
+
+
+def test_info_txt_double_space_raises():
+    # 'A/a.eeg  3' -> parts[1] == '' -> NumberFormatException in Java
+    with pytest.raises(ValueError):
+        sources.parse_info_txt("A/a.eeg  3\n")
+
+
+def test_stale_channel_index_reused_across_files():
+    """File 2 lacks 'fz'; the reference reuses the index resolved for
+    file 1 (instance-field FZIndex), not channel 0."""
+    fs1, d1 = make_recording_fs("a", 2000, [(500, 1)], channels=("EOG", "Fz", "Cz", "Pz"))
+    fs2, d2 = make_recording_fs("b", 2000, [(500, 2)], channels=("X0", "X1", "Cz", "Pz"))
+    fs = sources.InMemoryFileSystem({**fs1.files, **fs2.files})
+    fs.write_bytes("info.txt", b"a.eeg 1\nb.eeg 1\n")
+    odp = provider.OfflineDataProvider(["info.txt"], filesystem=fs)
+    batch = odp.load()
+    assert len(batch) == 2
+    # second epoch's first channel must come from column 1 (stale fz
+    # index from file 1), not column 0
+    win = d2[400:1250, 1].astype(np.float32) * np.float32(0.1)
+    expected = extractor.baseline_correct_f32(win.astype(np.float64)[None, None], 100)
+    np.testing.assert_array_equal(
+        batch.epochs[1, 0], expected[0, 0, 100:].astype(np.float64)
+    )
+
+
+def test_balance_state_spans_files():
+    """Balance counters are global across an info.txt run."""
+    fs1, _ = make_recording_fs("a", 3000, [(500, 1), (700, 2)])
+    fs2, _ = make_recording_fs("b", 3000, [(500, 2), (700, 1)])
+    fs = sources.InMemoryFileSystem({**fs1.files, **fs2.files})
+    fs.write_bytes("info.txt", b"a.eeg 1\nb.eeg 1\n")
+    batch = provider.OfflineDataProvider(["info.txt"], filesystem=fs).load()
+    # file a: target kept (T1), non-target kept (N1);
+    # file b: non-target kept (T1>=N1 -> N2), target kept (T<=N)
+    assert batch.targets.tolist() == [1.0, 0.0, 0.0, 1.0]

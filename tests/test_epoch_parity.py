@@ -1,0 +1,119 @@
+"""Bit-parity tests for BrainVision ingest + epoching.
+
+Golden values come from the reference's integration tests
+(OfflineDataProviderTest.java:65-129), converted here into hermetic
+local-filesystem tests (no HDFS daemon). The summation replays Java's
+exact left-to-right double folds, so equality is checked bitwise.
+"""
+
+import numpy as np
+import pytest
+
+from eeg_dataanalysispackage_tpu.io import brainvision, provider, sources
+
+
+def java_epoch_sum(epochs: np.ndarray) -> float:
+    """Sequential rowSum-then-total fold (OfflineDataProviderTest.java:70-80)."""
+    row_sums = np.cumsum(epochs, axis=-1)[..., -1]  # sequential per row
+    return float(np.cumsum(row_sums.reshape(-1))[-1])
+
+
+def test_info_txt_fixture(fixture_dir):
+    odp = provider.OfflineDataProvider([fixture_dir + "/infoTrain.txt"])
+    batch = odp.load()
+    assert batch.epochs.shape == (11, 3, 750)
+    assert java_epoch_sum(batch.epochs) == -253772.18676757812
+    assert int(batch.targets.sum()) == 5
+
+
+def test_single_eeg_with_guess(fixture_dir):
+    odp = provider.OfflineDataProvider(
+        [fixture_dir + "/DoD/DoD_2015_02.eeg", "4"]
+    )
+    batch = odp.load()
+    assert batch.epochs.shape == (27, 3, 750)
+    assert int(batch.targets.sum()) == 13
+
+
+def test_pz_rows_match_reference_epochs_csv(fixture_dir):
+    """The reference repo root carries an Epochs.csv dump of channel Pz
+    of every fixture epoch (DataProviderUtils.writeEpochsToCSV,
+    DataProviderUtils.java:30-47). Our Pz rows must match exactly."""
+    import os
+
+    csv_path = "/root/reference/Epochs.csv"
+    if not os.path.exists(csv_path):
+        pytest.skip("Epochs.csv artifact not present")
+    with open(csv_path) as f:
+        ref = np.array(
+            [[float(x) for x in line.strip().rstrip(",").split(",")] for line in f]
+        )
+    odp = provider.OfflineDataProvider([fixture_dir + "/infoTrain.txt"])
+    batch = odp.load()
+    assert ref.shape == (11, 750)
+    np.testing.assert_array_equal(batch.epochs[:, 2, :], ref)
+
+
+def test_duplicate_info_lines_collapse(fixture_dir):
+    """infoTrain.txt lists the same file twice; LinkedHashMap semantics
+    collapse it to one load (OffLineDataProvider.java:53)."""
+    text = sources.LocalFileSystem().read_text(fixture_dir + "/infoTrain.txt")
+    files = sources.parse_info_txt(text)
+    assert len(files) == 1
+
+
+def test_info_txt_comments_and_blanks():
+    files = sources.parse_info_txt(
+        "# comment\n\nA/a.eeg 3 1\n\nB/b.eeg 5\nA/a.eeg 7\nsolo_field_line\n"
+    )
+    assert list(files.items()) == [("A/a.eeg", 7), ("B/b.eeg", 5)]
+
+
+def test_info_txt_bad_number_raises():
+    with pytest.raises(ValueError):
+        sources.parse_info_txt("A/a.eeg x\n")
+
+
+def test_missing_sibling_files_are_skipped(fixture_dir, tmp_path):
+    """DoD/info.txt lists 9 recordings of which only DoD_2015_02.eeg has
+    its full triplet present; the others must be skipped non-fatally
+    (OffLineDataProvider.java:154-161)."""
+    odp = provider.OfflineDataProvider([fixture_dir + "/DoD/info.txt"])
+    batch = odp.load()
+    assert len(batch) > 0
+
+
+def test_out_of_range_markers_skipped(fixture_dir):
+    """Mk1 'New Segment' sits at position 1; its window [−99, 751) is
+    out of range and must be dropped (OffLineDataProvider.java:262-264)."""
+    rec = brainvision.load_recording(fixture_dir + "/DoD/DoD2015_01.vhdr"[:-5] + ".eeg")
+    positions = [m.position for m in rec.markers]
+    assert positions[0] == 1
+    from eeg_dataanalysispackage_tpu.epochs import extractor
+
+    channels = rec.read_channels([0, 1, 2])
+    _, valid = extractor.gather_windows(channels, np.array(positions))
+    assert not valid[0]
+    assert valid[1:].all()
+
+
+def test_vhdr_parse(fixture_dir):
+    hdr = brainvision.parse_vhdr(
+        sources.LocalFileSystem().read_text(fixture_dir + "/DoD/DoD2015_01.vhdr")
+    )
+    assert hdr.num_channels == 3
+    assert hdr.binary_format == "INT_16"
+    assert [c.name for c in hdr.channels] == ["Fz", "Cz", "Pz"]
+    assert hdr.channels[0].resolution == 0.1
+    assert hdr.sampling_rate_hz == 1000.0
+
+
+def test_vmrk_parse(fixture_dir):
+    markers = brainvision.parse_vmrk(
+        sources.LocalFileSystem().read_text(fixture_dir + "/DoD/DoD2015_01.vmrk")
+    )
+    assert markers[0].kind == "New Segment"
+    assert markers[1].stimulus == "S  2"
+    assert markers[1].position == 12016
+    assert markers[1].stimulus_index() == 1
+    assert markers[0].stimulus_index() == -1
