@@ -1,0 +1,34 @@
+"""Feature-extraction plugin boundary.
+
+The TPU-native equivalent of the reference's ``IFeatureExtraction``
+seam (IFeatureExtraction.java:33-34): a feature extractor maps a batch
+of epochs to a batch of fixed-size feature vectors. Unlike the
+reference — which maps a per-epoch ``double[][] -> double[]`` closure
+over RDD elements — the contract here is *batched*: extractors take
+``(n, channels, samples)`` and return ``(n, feature_dim)`` so the
+whole batch lowers to one XLA program instead of n kernel launches.
+A per-epoch adapter is provided for reference-style call sites.
+"""
+
+from __future__ import annotations
+
+import abc
+
+import numpy as np
+
+
+class FeatureExtraction(abc.ABC):
+    """Batched feature extractor."""
+
+    @abc.abstractmethod
+    def extract_batch(self, epochs: np.ndarray) -> np.ndarray:
+        """(n, channels, samples) -> (n, feature_dim)."""
+
+    @property
+    @abc.abstractmethod
+    def feature_dimension(self) -> int:
+        """Length of one feature vector (``getFeatureDimension``)."""
+
+    def extract_features(self, epoch: np.ndarray) -> np.ndarray:
+        """Single-epoch adapter matching the reference signature."""
+        return np.asarray(self.extract_batch(np.asarray(epoch)[None]))[0]

@@ -1,0 +1,40 @@
+"""``fe=`` plugin registry.
+
+Parity with the reference's hard-coded switch
+(PipelineBuilder.java:128-139): ``dwt-8`` builds
+``WaveletTransform(8, 512, 175, 16)``. The TPU build adds
+``dwt-8-tpu`` (same math, batched XLA backend) per BASELINE.json's
+north star, plus a generic ``dwt-<n>`` family for the other registry
+indices. Unknown names raise the reference's error message.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, Dict
+
+from . import base, wavelet
+
+_REGISTRY: Dict[str, Callable[[], base.FeatureExtraction]] = {}
+
+
+def register(name: str, factory: Callable[[], base.FeatureExtraction]) -> None:
+    _REGISTRY[name] = factory
+
+
+def create(name: str) -> base.FeatureExtraction:
+    if name in _REGISTRY:
+        return _REGISTRY[name]()
+    m = re.fullmatch(r"dwt-(\d+)(-tpu)?", name)
+    if m:
+        return wavelet.WaveletTransform(
+            name=int(m.group(1)),
+            backend="xla" if m.group(2) else "host",
+        )
+    raise ValueError("Unsupported feature extraction argument")
+
+
+register("dwt-8", lambda: wavelet.WaveletTransform(8, 512, 175, 16, backend="host"))
+register(
+    "dwt-8-tpu", lambda: wavelet.WaveletTransform(8, 512, 175, 16, backend="xla")
+)

@@ -1,0 +1,59 @@
+"""Host (numpy, float64) DWT with bit-exact reference accumulation.
+
+This is the *parity* implementation of the eegdsp fast wavelet
+transform (see ``eegdsp_compat`` for the identified algorithm): every
+inner product is a sequential left-to-right float64 fold, reproduced
+vectorially with ``np.cumsum`` (cumsum's prefix chain is exactly the
+Java accumulation order). The batched XLA implementation for TPUs
+lives in ``ops/dwt.py``; this one is the ground truth it is tested
+against, and is what ``fe=dwt-8`` (the reference-parity feature mode)
+uses.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import eegdsp_compat
+
+
+def _seq_dot(block: np.ndarray, f: np.ndarray) -> np.ndarray:
+    """Sequential left-fold of sum(block * f) over the last axis."""
+    return np.cumsum(block * f, axis=-1)[..., -1]
+
+
+def fwt_periodic(signal: np.ndarray, h: np.ndarray, g: np.ndarray) -> np.ndarray:
+    """Full in-place-layout FWT over the last axis.
+
+    signal: (..., n) float64, n a power of two >= len(h).
+    Returns (..., n): [a_K | d_K | d_{K-1} | ... | d_1] where K is the
+    number of levels run (decompose while current length >= len(h)).
+    """
+    out = np.array(signal, dtype=np.float64, copy=True)
+    n = out.shape[-1]
+    L = len(h)
+    while n >= L:
+        half = n // 2
+        idx = (2 * np.arange(half)[:, None] + np.arange(L)[None, :]) % n
+        block = out[..., :n][..., idx]  # (..., half, L)
+        out[..., :half] = _seq_dot(block, h)
+        out[..., half:n] = _seq_dot(block, g)
+        n = half
+    return out
+
+
+def dwt_coefficients(
+    signal: np.ndarray, wavelet_index: int = 8, count: int = 16
+) -> np.ndarray:
+    """First ``count`` entries of the eegdsp coefficient layout —
+    the reference's ``getDwtCoefficients()[0:FEATURE_SIZE]``."""
+    h, g = eegdsp_compat.filter_pair(wavelet_index)
+    return fwt_periodic(signal, h, g)[..., :count]
+
+
+def l2_normalize_seq(features: np.ndarray) -> np.ndarray:
+    """L2-normalize over the last axis with the reference's exact
+    arithmetic: sequential sum of squares, sqrt, elementwise divide
+    (SignalProcessing.java:38-52)."""
+    sumsq = np.cumsum(features * features, axis=-1)[..., -1]
+    return features / np.sqrt(sumsq)[..., None]

@@ -9,7 +9,10 @@ jax initializes its backends, hence the env mutation at import time.
 import os
 import sys
 
+# JAX_PLATFORMS (plural) is ignored when the axon TPU plugin is
+# present; JAX_PLATFORM_NAME is honored. Set both.
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,125 @@
+"""Golden-value parity for the reverse-engineered eegdsp DWT.
+
+The contract is the reference's FeatureExtractionTest
+(FeatureExtractionTest.java:63-106): 11 x 48 features from the fixture
+with sum == -24.861844096031625, checked *bitwise* for the host
+backend and to float32 tolerance for the XLA backend.
+"""
+
+import numpy as np
+import pytest
+
+from eeg_dataanalysispackage_tpu.features import registry, wavelet
+from eeg_dataanalysispackage_tpu.io import provider
+from eeg_dataanalysispackage_tpu.ops import daubechies, dwt_host, eegdsp_compat
+
+
+def java_feature_sum(features: np.ndarray) -> float:
+    """Sequential per-epoch then total fold (FeatureExtractionTest.java:94-103)."""
+    per_epoch = np.cumsum(features, axis=1)[:, -1]
+    return float(np.cumsum(per_epoch)[-1])
+
+
+@pytest.fixture(scope="module")
+def fixture_epochs(fixture_dir):
+    return provider.OfflineDataProvider([fixture_dir + "/infoTrain.txt"]).load()
+
+
+def test_golden_feature_sum_bitwise(fixture_epochs):
+    fe = registry.create("dwt-8")
+    feats = fe.extract_batch(fixture_epochs.epochs)
+    assert feats.shape == (11, 48)
+    assert java_feature_sum(feats) == -24.861844096031625
+
+
+def test_xla_backend_matches_host(fixture_epochs):
+    host = registry.create("dwt-8").extract_batch(fixture_epochs.epochs)
+    xla = registry.create("dwt-8-tpu").extract_batch(fixture_epochs.epochs)
+    assert xla.shape == (11, 48)
+    np.testing.assert_allclose(xla, host, rtol=0, atol=5e-6)
+
+
+def test_single_epoch_adapter(fixture_epochs):
+    fe = registry.create("dwt-8")
+    one = fe.extract_features(fixture_epochs.epochs[0])
+    batch = fe.extract_batch(fixture_epochs.epochs)
+    np.testing.assert_array_equal(one, batch[0])
+    assert fe.feature_dimension == 48
+
+
+def test_feature_vectors_unit_norm(fixture_epochs):
+    feats = registry.create("dwt-8").extract_batch(fixture_epochs.epochs)
+    np.testing.assert_allclose((feats**2).sum(axis=1), 1.0, atol=1e-12)
+
+
+def test_wavelet_registry_indices():
+    # index 8 is the golden-pinned 10-tap table
+    h8 = eegdsp_compat.scaling_filter(8)
+    np.testing.assert_array_equal(h8, eegdsp_compat.DAUB10_H)
+    # even indices exist, odd tap counts don't
+    eegdsp_compat.scaling_filter(2)  # Daubechies4
+    with pytest.raises(ValueError):
+        eegdsp_compat.scaling_filter(1)  # Daubechies3: no such filter
+    with pytest.raises(ValueError):
+        eegdsp_compat.scaling_filter(18)
+
+
+def test_daubechies_generator_matches_textbook_db2():
+    h = daubechies.daubechies_scaling(2)
+    ref = np.array(
+        [-0.12940952255092145, 0.22414386804185735, 0.8365163037378079, 0.48296291314469025]
+    )
+    np.testing.assert_allclose(h, ref, atol=1e-15)
+
+
+def test_daub10_table_is_truncated_spectral_factorization():
+    """The 12-digit table must equal the computed filter rounded to 12
+    decimals — guards against typos in the golden constants."""
+    computed = np.round(daubechies.daubechies_scaling(5)[::-1], 12)
+    np.testing.assert_array_equal(computed, eegdsp_compat.DAUB10_H)
+
+
+def test_setter_validation_ranges():
+    fe = wavelet.WaveletTransform()
+    with pytest.raises(ValueError):
+        fe.set_wavelet_name(18)
+    with pytest.raises(ValueError):
+        fe.set_epoch_size(751)
+    with pytest.raises(ValueError):
+        fe.set_skip_samples(0)
+    with pytest.raises(ValueError):
+        fe.set_feature_size(1025)
+    fe2 = wavelet.WaveletTransform(8, 512, 175, 16)
+    assert fe2 == wavelet.WaveletTransform(8, 512, 175, 16)
+    assert fe2 != wavelet.WaveletTransform(8, 512, 175, 32)
+
+
+def test_unknown_fe_name_raises():
+    with pytest.raises(ValueError, match="Unsupported feature extraction"):
+        registry.create("pca")
+
+
+def test_dwt_layout_structure(fixture_epochs):
+    """512 samples with a 10-tap filter run 6 levels; the first 16
+    coefficients are a6(8) ++ d6(8), NOT 'level-5 approximation' as the
+    reference's comments claim."""
+    sig = fixture_epochs.epochs[0, 0, 175:687]
+    full = dwt_host.fwt_periodic(sig, *eegdsp_compat.filter_pair(8))
+    assert full.shape == (512,)
+    coeffs = dwt_host.dwt_coefficients(sig, 8, 16)
+    np.testing.assert_array_equal(coeffs, full[:16])
+
+
+def test_setters_invalidate_xla_cache(fixture_epochs):
+    fe = registry.create("dwt-8-tpu")
+    out1 = fe.extract_batch(fixture_epochs.epochs)
+    assert out1.shape == (11, 48)
+    fe.set_feature_size(8)
+    out2 = fe.extract_batch(fixture_epochs.epochs)
+    assert out2.shape == (11, 24)
+
+
+def test_window_exceeding_epoch_raises(fixture_epochs):
+    fe = wavelet.WaveletTransform(8, 750, 750, 16)
+    with pytest.raises(ValueError, match="exceeds the epoch length"):
+        fe.extract_batch(fixture_epochs.epochs)
