@@ -1,0 +1,91 @@
+"""Classifier plugin boundary (reference: Classification/IClassifier.java).
+
+Same public seam as the reference — ``set_feature_extraction``,
+``train``, ``test``, ``save``, ``load``, ``set_config`` with opaque
+``config_*`` string maps (IClassifier.java:43-85) — but stateless-by-
+construction: model parameters are explicit pytrees threaded through
+pure jitted functions, never mutable static fields (the reference's
+classifiers share state through ``static fe``/``model`` fields, e.g.
+LogisticRegressionClassifier.java:50-51, making one instance per JVM
+the only safe configuration; SURVEY.md section 5 'race detection').
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from . import stats
+from ..features import base as features_base
+
+
+class Classifier(abc.ABC):
+    """Batched classifier over extracted features."""
+
+    # True for classifiers whose reference counterpart builds stats
+    # from MulticlassMetrics' confusion matrix only (MLlib paths),
+    # leaving MSE/class sums at 0; False for the incremental NN path.
+    confusion_only_stats: bool = True
+
+    def __init__(self) -> None:
+        self.fe: Optional[features_base.FeatureExtraction] = None
+        self.config: Dict[str, str] = {}
+
+    # -- reference surface --------------------------------------------
+
+    def set_feature_extraction(self, fe: features_base.FeatureExtraction) -> None:
+        self.fe = fe
+
+    def set_config(self, config: Dict[str, str]) -> None:
+        self.config = dict(config)
+
+    def train(
+        self,
+        epochs: Sequence[np.ndarray] | np.ndarray,
+        targets: Sequence[float] | np.ndarray,
+        fe: features_base.FeatureExtraction,
+    ) -> None:
+        self.fe = fe
+        features = self._extract(epochs)
+        labels = np.asarray(targets, dtype=np.float64)
+        self.fit(features, labels)
+
+    def test(
+        self,
+        epochs: Sequence[np.ndarray] | np.ndarray,
+        targets: Sequence[float] | np.ndarray,
+    ) -> stats.ClassificationStatistics:
+        features = self._extract(epochs)
+        labels = np.asarray(targets, dtype=np.float64)
+        predictions = self.predict(features)
+        return stats.ClassificationStatistics.from_arrays(
+            predictions, labels, confusion_only=self.confusion_only_stats
+        )
+
+    # -- batched core (the TPU-native surface) -------------------------
+
+    @abc.abstractmethod
+    def fit(self, features: np.ndarray, labels: np.ndarray) -> None:
+        """(n, d) features + (n,) {0,1} labels -> trained state."""
+
+    @abc.abstractmethod
+    def predict(self, features: np.ndarray) -> np.ndarray:
+        """(n, d) -> (n,) real-valued outputs (rounded by stats)."""
+
+    @abc.abstractmethod
+    def save(self, path: str) -> None: ...
+
+    @abc.abstractmethod
+    def load(self, path: str) -> None: ...
+
+    # ------------------------------------------------------------------
+
+    def _extract(self, epochs) -> np.ndarray:
+        if self.fe is None:
+            raise ValueError("feature extraction not set")
+        arr = np.asarray(epochs, dtype=np.float64)
+        if arr.ndim == 2:  # single epoch
+            arr = arr[None]
+        return np.asarray(self.fe.extract_batch(arr))

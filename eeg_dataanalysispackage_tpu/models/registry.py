@@ -1,0 +1,45 @@
+"""``train_clf=`` / ``load_clf=`` plugin registry.
+
+Parity with the reference's classifier switch
+(PipelineBuilder.java:156-169): svm, logreg, dt, rf, nn. Unknown names
+raise the reference's error message.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+from . import base
+
+_REGISTRY: Dict[str, Callable[[], base.Classifier]] = {}
+
+
+def register(name: str, factory: Callable[[], base.Classifier]) -> None:
+    _REGISTRY[name] = factory
+
+
+def create(name: str) -> base.Classifier:
+    if name not in _REGISTRY:
+        raise ValueError("Unsupported classifier argument")
+    return _REGISTRY[name]()
+
+
+def names() -> list:
+    return sorted(_REGISTRY)
+
+
+def _register_builtins() -> None:
+    from . import linear
+
+    register("logreg", linear.LogisticRegressionClassifier)
+    register("svm", linear.SVMClassifier)
+    from . import trees
+
+    register("dt", trees.DecisionTreeClassifier)
+    register("rf", trees.RandomForestClassifier)
+    from . import nn
+
+    register("nn", nn.NeuralNetworkClassifier)
+
+
+_register_builtins()

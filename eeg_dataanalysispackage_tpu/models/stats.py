@@ -1,0 +1,122 @@
+"""Classification statistics (reference: Utils/ClassificationStatistics.java).
+
+Confusion-matrix accumulator with the same fields, accuracy/MSE math,
+rounding rule (Math.round: half-up), and report text as the reference
+(ClassificationStatistics.java:50-96). A vectorized ``from_arrays``
+builds it from whole prediction batches (the XLA-friendly path:
+confusion matrix = 4-way bincount).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+
+def _java_round(x: float) -> int:
+    # Java Math.round = floor(x + 0.5); Python round() half-to-even differs.
+    return math.floor(x + 0.5)
+
+
+class ClassificationStatistics:
+    def __init__(self, tp: int = 0, tn: int = 0, fp: int = 0, fn: int = 0):
+        self.true_positives = tp
+        self.true_negatives = tn
+        self.false_positives = fp
+        self.false_negatives = fn
+        self.mse = 0.0
+        self.class1_sum = 0.0  # sum of real outputs on expected-0 patterns
+        self.class2_sum = 0.0  # sum of real outputs on expected-1 patterns
+
+    def add(self, real_output: float, expected_output: float) -> None:
+        """Incremental accumulation (ClassificationStatistics.java:68-83)."""
+        self.mse += (expected_output - real_output) ** 2
+        e = _java_round(expected_output)
+        r = _java_round(real_output)
+        if e == 0 and e == r:
+            self.true_negatives += 1
+            self.class1_sum += real_output
+        elif e == 0 and e != r:
+            self.false_positives += 1
+            self.class1_sum += real_output
+        elif e == 1 and e == r:
+            self.true_positives += 1
+            self.class2_sum += real_output
+        elif e == 1 and e != r:
+            self.false_negatives += 1
+            self.class2_sum += real_output
+
+    @classmethod
+    def from_arrays(
+        cls,
+        real_outputs: np.ndarray,
+        expected_outputs: np.ndarray,
+        confusion_only: bool = False,
+    ) -> "ClassificationStatistics":
+        """Batched construction.
+
+        ``confusion_only=True`` reproduces the reference's MLlib path,
+        which builds statistics from the confusion matrix alone and
+        leaves MSE/class sums at 0
+        (LogisticRegressionClassifier.java:133-138); the incremental
+        path (NN — NeuralNetworkClassifier.java:164) fills them.
+
+        Bug-as-behavior: the reference indexes Spark's *column-major*
+        ``confusionMatrix().toArray()`` — actually [tn, fn, fp, tp] —
+        as ``[tn, fp, fn, tp]`` (LogisticRegressionClassifier.java:
+        133-137), so every MLlib-path report prints false positives
+        and false negatives swapped. ``confusion_only=True`` preserves
+        that swap for report parity; accuracy is unaffected. The
+        incremental path labels them correctly, as the reference NN
+        does.
+        """
+        real = np.asarray(real_outputs, dtype=np.float64)
+        exp = np.asarray(expected_outputs, dtype=np.float64)
+        e = np.floor(exp + 0.5).astype(np.int64)
+        r = np.floor(real + 0.5).astype(np.int64)
+        true_fp = int(((e == 0) & (r != 0)).sum())
+        true_fn = int(((e == 1) & (r != 1)).sum())
+        if confusion_only:
+            true_fp, true_fn = true_fn, true_fp
+        stats = cls(
+            tp=int(((e == 1) & (r == 1)).sum()),
+            tn=int(((e == 0) & (r == 0)).sum()),
+            fp=true_fp,
+            fn=true_fn,
+        )
+        if not confusion_only:
+            stats.mse = float(((exp - real) ** 2).sum())
+            stats.class1_sum = float(real[e == 0].sum())
+            stats.class2_sum = float(real[e == 1].sum())
+        return stats
+
+    @property
+    def num_patterns(self) -> int:
+        return (
+            self.true_positives
+            + self.true_negatives
+            + self.false_positives
+            + self.false_negatives
+        )
+
+    def calc_accuracy(self) -> float:
+        # Java's int/int-widened-to-double 0/0 yields NaN, not a crash.
+        if self.num_patterns == 0:
+            return math.nan
+        return (self.true_positives + self.true_negatives) / self.num_patterns
+
+    def __str__(self) -> str:
+        # Field order and wording match ClassificationStatistics.java:86-96.
+        mse = math.nan if self.num_patterns == 0 else self.mse / self.num_patterns
+        return (
+            f"Number of patterns: {self.num_patterns}\n"
+            f"True positives: {self.true_positives}\n"
+            f"True negatives: {self.true_negatives}\n"
+            f"False positives: {self.false_positives}\n"
+            f"False negatives: {self.false_negatives}\n"
+            f"Accuracy: {self.calc_accuracy() * 100}%\n"
+            f"MSE: {mse}\n"
+            f"Non-targets: {self.class1_sum}\n"
+            f"Targets: {self.class2_sum}\n"
+        )

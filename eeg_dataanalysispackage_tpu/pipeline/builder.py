@@ -1,0 +1,120 @@
+"""Query-string pipeline front end (reference: Pipeline/PipelineBuilder.java).
+
+The reference's whole run-time configuration surface is one
+``k=v&k=v`` string (README "Run-time configuration";
+PipelineBuilder.java:94-295). This builder preserves that surface —
+same reserved keys, same required/optional semantics, same error
+messages, same seed-1 shuffle + 70/30 split, same ``config_*``
+pass-through and ``result_path`` report file — over the TPU-native
+data path: epochs load once into a dense batch, features are extracted
+by one jitted program, classifiers consume whole batches.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..features import registry as fe_registry
+from ..io import provider, sources
+from ..models import registry as clf_registry
+from ..models import stats
+from ..utils import java_compat
+
+logger = logging.getLogger(__name__)
+
+
+def get_query_map(query: str) -> Dict[str, str]:
+    """k=v&k=v parse; empty values tolerated (PipelineBuilder.java:49-68)."""
+    out: Dict[str, str] = {}
+    for param in query.split("&"):
+        parts = param.split("=")
+        name = parts[0]
+        value = parts[1] if len(parts) > 1 else ""
+        out[name] = value
+    return out
+
+
+class PipelineBuilder:
+    def __init__(
+        self,
+        query: str,
+        filesystem: Optional[sources.FileSystem] = None,
+    ):
+        self.query = query
+        self._fs = filesystem or sources.LocalFileSystem()
+        self.statistics: Optional[stats.ClassificationStatistics] = None
+
+    def execute(self) -> stats.ClassificationStatistics:
+        query_map = get_query_map(self.query)
+        logger.info("query: %s", query_map)
+
+        # 1. input (PipelineBuilder.java:104-113)
+        if "info_file" in query_map:
+            files = [query_map["info_file"]]
+        elif "eeg_file" in query_map and "guessed_num" in query_map:
+            files = [query_map["eeg_file"], query_map["guessed_num"]]
+        else:
+            raise ValueError("Missing the input file argument")
+
+        odp = provider.OfflineDataProvider(files, filesystem=self._fs)
+        batch = odp.load()
+
+        # 2. feature extraction (PipelineBuilder.java:128-139)
+        if "fe" not in query_map:
+            raise ValueError("Missing the feature extraction argument")
+        fe = fe_registry.create(query_map["fe"])
+
+        # 3. classifier (PipelineBuilder.java:151-284)
+        n = len(batch)
+        if "train_clf" in query_map:
+            classifier = clf_registry.create(query_map["train_clf"])
+
+            train_idx, test_idx = java_compat.train_test_split_indices(n, seed=1)
+            config = {
+                k: v for k, v in query_map.items() if k.startswith("config_")
+            }
+            classifier.set_config(config)
+            classifier.train(
+                batch.epochs[train_idx], batch.targets[train_idx], fe
+            )
+            logger.info("trained %s", query_map["train_clf"])
+
+            if query_map.get("save_clf") == "true":
+                if "save_name" not in query_map:
+                    raise ValueError(
+                        "Please provide a location to save a classifier "
+                        "within the save_name query parameter"
+                    )
+                classifier.save(query_map["save_name"])
+
+            statistics = classifier.test(
+                batch.epochs[test_idx], batch.targets[test_idx]
+            )
+
+        elif "load_clf" in query_map:
+            classifier = clf_registry.create(query_map["load_clf"])
+            if "load_name" not in query_map:
+                raise ValueError("Classifier location not provided")
+
+            # load mode tests on ALL shuffled data — no split
+            # (PipelineBuilder.java:261-278)
+            perm = java_compat.java_shuffle_indices(n, seed=1)
+            classifier.set_feature_extraction(fe)
+            classifier.load(query_map["load_name"])
+            statistics = classifier.test(batch.epochs[perm], batch.targets[perm])
+
+        else:
+            raise ValueError("Missing classifier argument")
+
+        logger.info("statistics:\n%s", statistics)
+
+        if "result_path" in query_map:
+            with open(query_map["result_path"], "w") as f:
+                # PrintWriter.println appends a newline to toString()
+                f.write(str(statistics) + "\n")
+
+        self.statistics = statistics
+        return statistics

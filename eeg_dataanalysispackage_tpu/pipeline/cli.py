@@ -1,0 +1,44 @@
+"""CLI entry point (reference: Main.java).
+
+``python -m eeg_dataanalysispackage_tpu.pipeline.cli '<query string>'``
+mirrors ``spark-submit --class cz.zcu.kiv.Main <jar> '<query string>'``
+(Main.java:38-51, README "Deployment"): args[0] is the query string;
+failures print a stack trace and exit non-zero (the reference swallows
+them — we at least fail loudly).
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+from . import builder
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s.%(msecs)03d %(levelname)s %(name)s - %(message)s",
+        datefmt="%H:%M:%S",
+    )
+    log = logging.getLogger("eeg_dataanalysispackage_tpu")
+    log.info("Hello from the TPU-native EEG analysis pipeline")
+    log.info("Application started with arguments %s", argv)
+    if not argv:
+        log.error("usage: cli.py '<query string>' (e.g. "
+                  "'info_file=...&fe=dwt-8&train_clf=logreg')")
+        return 2
+    try:
+        statistics = builder.PipelineBuilder(argv[0]).execute()
+    except Exception:
+        import traceback
+
+        traceback.print_exc()
+        return 1
+    print(statistics, end="")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

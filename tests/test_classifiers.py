@@ -1,0 +1,163 @@
+"""Classifier, stats, and Java-compat shuffle tests."""
+
+import numpy as np
+import pytest
+
+from eeg_dataanalysispackage_tpu.models import linear, registry, sgd, stats
+from eeg_dataanalysispackage_tpu.utils import java_compat
+
+
+# -- java.util.Random parity ------------------------------------------
+
+
+def test_java_random_golden_values():
+    # Famous java.util.Random outputs: these pin the 48-bit LCG.
+    assert java_compat.JavaRandom(1).next_int32() == -1155869325
+    assert java_compat.JavaRandom(0).next_int32() == -1155484576
+
+
+def test_java_shuffle_is_permutation_and_deterministic():
+    a = java_compat.java_shuffle_indices(11, seed=1)
+    b = java_compat.java_shuffle_indices(11, seed=1)
+    assert a == b
+    assert sorted(a) == list(range(11))
+    assert a != list(range(11))
+
+
+def test_split_matches_reference_shape():
+    train, test = java_compat.train_test_split_indices(11, seed=1)
+    assert len(train) == 7  # (int)(11*0.7)
+    assert len(test) == 4
+    assert sorted(train + test) == list(range(11))
+
+
+# -- ClassificationStatistics -----------------------------------------
+
+
+def test_stats_report_format():
+    s = stats.ClassificationStatistics(tp=3, tn=4, fp=2, fn=1)
+    text = str(s)
+    assert "Number of patterns: 10" in text
+    assert "True positives: 3" in text
+    assert "Accuracy: 70.0%" in text
+    assert text.endswith("Targets: 0.0\n")
+
+
+def test_stats_incremental_matches_batched():
+    rng = np.random.RandomState(0)
+    real = rng.rand(50)
+    exp = (rng.rand(50) > 0.5).astype(float)
+    s1 = stats.ClassificationStatistics()
+    for r, e in zip(real, exp):
+        s1.add(r, e)
+    s2 = stats.ClassificationStatistics.from_arrays(real, exp)
+    assert (
+        s1.true_positives,
+        s1.true_negatives,
+        s1.false_positives,
+        s1.false_negatives,
+    ) == (
+        s2.true_positives,
+        s2.true_negatives,
+        s2.false_positives,
+        s2.false_negatives,
+    )
+    assert s1.mse == pytest.approx(s2.mse)
+    assert s1.class1_sum == pytest.approx(s2.class1_sum)
+
+
+def test_stats_java_round_half_up():
+    s = stats.ClassificationStatistics.from_arrays(
+        np.array([0.5]), np.array([1.0])
+    )  # Math.round(0.5) == 1 (half-up; Python's round() would give 0)
+    assert s.true_positives == 1
+
+
+# -- linear classifiers ------------------------------------------------
+
+
+def make_separable(n=200, d=8, seed=0):
+    rng = np.random.RandomState(seed)
+    w_true = rng.randn(d)
+    x = rng.randn(n, d)
+    y = (x @ w_true > 0).astype(np.float64)
+    return x, y
+
+
+def test_logreg_learns_separable():
+    x, y = make_separable()
+    clf = linear.LogisticRegressionClassifier()
+    clf.set_config({})
+    clf.fit(x, y)
+    acc = (clf.predict(x) == y).mean()
+    assert acc > 0.95
+
+
+def test_svm_learns_separable():
+    x, y = make_separable(seed=3)
+    clf = linear.SVMClassifier()
+    clf.set_config(
+        {
+            "config_num_iterations": "100",
+            "config_step_size": "1.0",
+            "config_reg_param": "0.01",
+            "config_mini_batch_fraction": "1.0",
+        }
+    )
+    clf.fit(x, y)
+    assert (clf.predict(x) == y).mean() > 0.95
+
+
+def test_minibatch_sampling_path():
+    x, y = make_separable(seed=5)
+    cfg = sgd.SGDConfig(num_iterations=50, mini_batch_fraction=0.5)
+    w = sgd.train_linear(x, y, cfg)
+    acc = ((x @ w >= 0) == y).mean()
+    assert acc > 0.9
+
+
+def test_sgd_deterministic():
+    x, y = make_separable(seed=7)
+    cfg = sgd.SGDConfig(num_iterations=20, mini_batch_fraction=0.3)
+    np.testing.assert_array_equal(
+        sgd.train_linear(x, y, cfg), sgd.train_linear(x, y, cfg)
+    )
+
+
+def test_save_load_roundtrip(tmp_path):
+    x, y = make_separable()
+    clf = linear.LogisticRegressionClassifier()
+    clf.set_config({})
+    clf.fit(x, y)
+    path = str(tmp_path / "model")
+    clf.save(path)
+    clf2 = linear.LogisticRegressionClassifier()
+    clf2.load(path)
+    np.testing.assert_array_equal(clf.weights, clf2.weights)
+
+
+def test_registry_unknown_raises():
+    with pytest.raises(ValueError, match="Unsupported classifier"):
+        registry.create("xgboost")
+
+
+def test_predict_before_fit_raises():
+    with pytest.raises(ValueError, match="not trained"):
+        linear.SVMClassifier().predict(np.zeros((1, 4)))
+
+
+def test_confusion_only_swaps_fp_fn():
+    """Reference bug-as-behavior: MLlib-path reports read Spark's
+    column-major confusion matrix as [tn,fp,fn,tp] when it is actually
+    [tn,fn,fp,tp], swapping FP/FN in every report."""
+    real = np.array([0.0, 0.0, 0.0])  # all predicted negative
+    exp = np.array([1.0, 1.0, 0.0])  # two actual positives
+    s = stats.ClassificationStatistics.from_arrays(real, exp, confusion_only=True)
+    assert (s.false_positives, s.false_negatives) == (2, 0)  # swapped
+    s2 = stats.ClassificationStatistics.from_arrays(real, exp)
+    assert (s2.false_positives, s2.false_negatives) == (0, 2)  # true labels
+
+
+def test_empty_stats_prints_nan():
+    s = stats.ClassificationStatistics.from_arrays(np.zeros(0), np.zeros(0))
+    assert "Accuracy: nan%" in str(s)

@@ -1,0 +1,162 @@
+"""DT/RF and NN classifier tests."""
+
+import numpy as np
+import pytest
+
+from eeg_dataanalysispackage_tpu.models import nn, registry, trees
+
+
+def make_data(n=300, d=10, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, d)
+    # axis-aligned rule so trees can nail it
+    y = ((x[:, 0] > 0.2) & (x[:, 3] < 0.5)).astype(np.float64)
+    return x, y
+
+
+NN_BASE_CONFIG = {
+    "config_seed": "7",
+    "config_num_iterations": "300",
+    "config_learning_rate": "0.1",
+    "config_momentum": "0.9",
+    "config_weight_init": "xavier",
+    "config_updater": "nesterovs",
+    "config_optimization_algo": "stochastic_gradient_descent",
+    "config_pretrain": "false",
+    "config_backprop": "true",
+    "config_loss_function": "xent",
+    "config_layer1_layer_type": "dense",
+    "config_layer1_n_out": "16",
+    "config_layer1_drop_out": "0.0",
+    "config_layer1_activation_function": "relu",
+    "config_layer2_layer_type": "output",
+    "config_layer2_n_out": "2",
+    "config_layer2_drop_out": "0.0",
+    "config_layer2_activation_function": "softmax",
+}
+
+
+def test_decision_tree_learns_rule():
+    x, y = make_data()
+    clf = trees.DecisionTreeClassifier()
+    clf.set_config(
+        {
+            "config_max_bins": "32",
+            "config_impurity": "gini",
+            "config_max_depth": "5",
+            "config_min_instances_per_node": "1",
+        }
+    )
+    clf.fit(x, y)
+    assert (clf.predict(x) == y).mean() > 0.95
+
+
+def test_decision_tree_default_config():
+    x, y = make_data(seed=2)
+    clf = trees.DecisionTreeClassifier()
+    clf.set_config({})
+    clf.fit(x, y)
+    assert (clf.predict(x) == y).mean() > 0.9
+
+
+def test_random_forest_learns_rule():
+    x, y = make_data(seed=3)
+    clf = trees.RandomForestClassifier()
+    clf.set_config(
+        {
+            "config_max_bins": "32",
+            "config_impurity": "entropy",
+            "config_max_depth": "6",
+            "config_min_instances_per_node": "1",
+            "config_num_trees": "20",
+            "config_feature_subset": "auto",
+        }
+    )
+    clf.fit(x, y)
+    assert (clf.predict(x) == y).mean() > 0.93
+
+
+def test_rf_deterministic_seed():
+    x, y = make_data(seed=4)
+
+    def train():
+        clf = trees.RandomForestClassifier()
+        clf.set_config(
+            {
+                "config_max_bins": "16",
+                "config_impurity": "gini",
+                "config_max_depth": "4",
+                "config_min_instances_per_node": "1",
+                "config_num_trees": "5",
+                "config_feature_subset": "sqrt",
+            }
+        )
+        clf.fit(x, y)
+        return clf.predict(x)
+
+    np.testing.assert_array_equal(train(), train())
+
+
+def test_tree_save_load_roundtrip(tmp_path):
+    x, y = make_data(seed=5)
+    clf = trees.RandomForestClassifier()
+    clf.set_config(
+        {
+            "config_max_bins": "16",
+            "config_impurity": "gini",
+            "config_max_depth": "4",
+            "config_min_instances_per_node": "1",
+            "config_num_trees": "3",
+            "config_feature_subset": "auto",
+        }
+    )
+    clf.fit(x, y)
+    # file:// prefix tolerated like the reference DT/RF save paths
+    clf.save("file://" + str(tmp_path / "rf_model"))
+    clf2 = trees.RandomForestClassifier()
+    clf2.load("file://" + str(tmp_path / "rf_model"))
+    np.testing.assert_array_equal(clf.predict(x), clf2.predict(x))
+
+
+def test_nn_learns(capfd):
+    x, y = make_data(n=200, d=8, seed=6)
+    clf = nn.NeuralNetworkClassifier()
+    clf.set_config(dict(NN_BASE_CONFIG))
+    clf.fit(x, y)
+    acc = ((clf.predict(x) > 0.5).astype(float) == y).mean()
+    assert acc > 0.85
+
+
+def test_nn_missing_config_raises():
+    clf = nn.NeuralNetworkClassifier()
+    clf.set_config({})
+    with pytest.raises(ValueError, match="config_seed"):
+        clf.fit(np.zeros((4, 8)), np.zeros(4))
+
+
+def test_nn_save_load_roundtrip(tmp_path):
+    x, y = make_data(n=100, d=6, seed=8)
+    clf = nn.NeuralNetworkClassifier()
+    clf.set_config(dict(NN_BASE_CONFIG, config_num_iterations="50"))
+    clf.fit(x, y)
+    path = str(tmp_path / "nn_model")
+    clf.save(path)
+    clf2 = nn.NeuralNetworkClassifier()
+    clf2.load(path)
+    np.testing.assert_allclose(clf.predict(x), clf2.predict(x), atol=1e-6)
+
+
+def test_nn_dropout_path():
+    x, y = make_data(n=100, d=6, seed=9)
+    cfg = dict(NN_BASE_CONFIG, config_num_iterations="30")
+    cfg["config_layer1_drop_out"] = "0.3"
+    clf = nn.NeuralNetworkClassifier()
+    clf.set_config(cfg)
+    clf.fit(x, y)  # must not crash; dropout only active in training
+    p1 = clf.predict(x)
+    p2 = clf.predict(x)
+    np.testing.assert_array_equal(p1, p2)  # deterministic at test time
+
+
+def test_all_five_classifiers_registered():
+    assert registry.names() == ["dt", "logreg", "nn", "rf", "svm"]
