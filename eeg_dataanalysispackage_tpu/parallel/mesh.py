@@ -1,0 +1,102 @@
+"""Device mesh construction + batch sharding helpers.
+
+The TPU-native replacement for the reference's Spark runtime layer
+(``Utils/SparkInitializer.java`` — lazy singleton SparkContext over
+``local[*]`` threads, akka control plane + netty data plane per
+SURVEY.md section 2.3): parallel resources are a
+``jax.sharding.Mesh``; data parallelism is a ``NamedSharding`` over
+the batch axis; collectives ride ICI within a slice and DCN across
+hosts, inserted by XLA from sharding annotations rather than by
+explicit RPC.
+
+Axes:
+- ``data``  — epoch-batch data parallelism (the reference's only
+  strategy: RDD partitions of epochs);
+- ``time``  — sequence/context parallelism for continuous-EEG
+  streaming (see ``parallel/streaming.py``), net-new vs the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+TIME_AXIS = "time"
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    axes: Tuple[str, ...] = (DATA_AXIS,),
+    shape: Optional[Sequence[int]] = None,
+) -> Mesh:
+    """Build a mesh over the first ``n_devices`` available devices.
+
+    1-D data mesh by default; pass ``axes``/``shape`` for 2-D layouts
+    (e.g. ``axes=('data','time'), shape=(2,4)``).
+    """
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    if n > len(devices):
+        raise ValueError(f"requested {n} devices, only {len(devices)} present")
+    devs = np.array(devices[:n])
+    if shape is None:
+        shape = (n,) if len(axes) == 1 else None
+    if shape is None:
+        raise ValueError("shape required for multi-axis meshes")
+    return Mesh(devs.reshape(shape), axes)
+
+
+def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
+    """Shard the leading (batch) dimension over ``axis``."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def pad_to_multiple(array: np.ndarray, multiple: int, axis: int = 0):
+    """Pad ``axis`` up to a multiple (XLA needs evenly divisible shards).
+
+    Returns (padded, original_length). Padding rows are zeros; callers
+    mask them out of reductions via the returned length.
+    """
+    n = array.shape[axis]
+    rem = (-n) % multiple
+    if rem == 0:
+        return array, n
+    widths = [(0, 0)] * array.ndim
+    widths[axis] = (0, rem)
+    return np.pad(array, widths), n
+
+
+def shard_batch(array: np.ndarray, mesh: Mesh, axis_name: str = DATA_AXIS):
+    """Pad + device_put a host batch across the mesh's data axis.
+
+    The host->device staging boundary (replaces the reference's
+    ``sc.parallelize`` driver->executor serialization,
+    LogisticRegressionClassifier.java:87-88).
+    """
+    padded, n = pad_to_multiple(np.asarray(array), mesh.shape[axis_name])
+    return jax.device_put(padded, batch_sharding(mesh, axis_name)), n
+
+
+def shard_batch_with_mask(mesh: Mesh, *arrays, axis_name: str = DATA_AXIS):
+    """Pad + shard float32 batch arrays, plus a 1/0 validity mask over
+    the padded rows. Single source of truth for the padding/masking
+    convention used by distributed SGD and the flagship train step."""
+    out = []
+    n = None
+    padded_len = None
+    for a in arrays:
+        sharded, n = shard_batch(np.asarray(a, np.float32), mesh, axis_name)
+        padded_len = sharded.shape[0]
+        out.append(sharded)
+    mask_np = np.zeros(padded_len, dtype=np.float32)
+    mask_np[:n] = 1.0
+    out.append(jax.device_put(mask_np, batch_sharding(mesh, axis_name)))
+    return tuple(out)

@@ -1,0 +1,107 @@
+"""End-to-end data-parallel training step for the flagship P300 model.
+
+The flagship program fuses the whole reference pipeline into one XLA
+computation per step: raw epochs -> eegdsp DWT filter-bank cascade ->
+48-dim normalized features -> MLP -> loss -> backward -> optimizer
+update. Parallelism is the workload's natural pair of axes
+(SURVEY.md section 2.3: the reference's only strategy is data
+parallelism over epochs; the time axis is this build's net-new
+sequence-parallel dimension, exercised in ``parallel/streaming.py``):
+
+- batch (epochs) sharded over the mesh's ``data`` axis;
+- parameters replicated; XLA inserts the psum all-reduce for the
+  gradient contraction over the sharded batch dimension — the ICI
+  equivalent of MLlib's treeAggregate (minus the driver round trip).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..ops import dwt as dwt_xla
+from . import mesh as pmesh
+
+
+def init_mlp_params(
+    key, sizes=(48, 64, 2), dtype=jnp.float32
+) -> Dict[str, jnp.ndarray]:
+    params = {}
+    for i, (n_in, n_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+        key, sub = jax.random.split(key)
+        params[f"w{i}"] = (
+            jax.random.normal(sub, (n_in, n_out), dtype) * jnp.sqrt(2.0 / n_in)
+        )
+        params[f"b{i}"] = jnp.zeros((n_out,), dtype)
+    return params
+
+
+def forward(params: Dict[str, jnp.ndarray], features: jnp.ndarray) -> jnp.ndarray:
+    """(B, 48) features -> (B, 2) class probabilities."""
+    x = features
+    n_layers = len(params) // 2
+    for i in range(n_layers):
+        x = x @ params[f"w{i}"] + params[f"b{i}"]
+        if i < n_layers - 1:
+            x = jax.nn.relu(x)
+    return jax.nn.softmax(x, axis=-1)
+
+
+def extract_features(epochs: jnp.ndarray) -> jnp.ndarray:
+    """(B, C, T) raw epochs -> (B, C*16) normalized DWT features
+    (the shared composed-cascade einsum — ops/dwt.epoch_features)."""
+    return dwt_xla.epoch_features(epochs)
+
+
+def forward_step(params: Dict[str, jnp.ndarray], epochs: jnp.ndarray) -> jnp.ndarray:
+    """The flagship jittable forward: raw epochs -> P(target)."""
+    return forward(params, extract_features(epochs))[:, 0]
+
+
+def make_train_step(mesh=None, learning_rate: float = 0.05, momentum: float = 0.9):
+    """Build (init_state, train_step) for the full pipeline.
+
+    ``train_step(state, epochs, labels, mask) -> (state, loss)`` is one
+    jitted program; with a mesh, ``epochs``/``labels``/``mask`` are
+    expected sharded over the data axis and params replicated.
+    """
+    tx = optax.sgd(learning_rate, momentum=momentum, nesterov=True)
+
+    def init_state(key):
+        params = init_mlp_params(key)
+        if mesh is not None:
+            params = jax.device_put(params, NamedSharding(mesh, P()))
+        return {"params": params, "opt": tx.init(params)}
+
+    def loss_fn(params, epochs, labels, mask):
+        probs = forward(params, extract_features(epochs))
+        y = jnp.stack([labels, 1.0 - labels], axis=1)
+        p = jnp.clip(probs, 1e-7, 1.0)
+        per_example = -jnp.sum(y * jnp.log(p), axis=1) * mask
+        return per_example.sum() / jnp.maximum(mask.sum(), 1.0)
+
+    @jax.jit
+    def train_step(state, epochs, labels, mask):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            state["params"], epochs, labels, mask
+        )
+        updates, opt = tx.update(grads, state["opt"], state["params"])
+        return {
+            "params": optax.apply_updates(state["params"], updates),
+            "opt": opt,
+        }, loss
+
+    return init_state, train_step
+
+
+def stage_batch(
+    epochs: np.ndarray, labels: np.ndarray, mesh
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Pad + shard a host batch over the data axis; returns mask too."""
+    ep, lb, mask = pmesh.shard_batch_with_mask(mesh, epochs, labels)
+    return ep, lb, mask

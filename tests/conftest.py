@@ -9,15 +9,19 @@ jax initializes its backends, hence the env mutation at import time.
 import os
 import sys
 
-# JAX_PLATFORMS (plural) is ignored when the axon TPU plugin is
-# present; JAX_PLATFORM_NAME is honored. Set both.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
+# The axon site hook (sitecustomize) pre-imports jax before this file
+# runs, so env vars alone are too late; jax.config.update before the
+# first backend touch still works. XLA_FLAGS is read at CPU client
+# creation, so setting it here (pre-backend) is effective.
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
