@@ -128,6 +128,14 @@ class BalanceState:
         self.n_nontargets = 0
 
     def scan(self, is_target: np.ndarray) -> np.ndarray:
+        from ..io import native
+
+        counters = np.array([self.n_targets, self.n_nontargets], dtype=np.int64)
+        keep_native = native.balance_scan(np.asarray(is_target, bool), counters)
+        if keep_native is not None:
+            self.n_targets = int(counters[0])
+            self.n_nontargets = int(counters[1])
+            return keep_native
         keep = np.zeros(len(is_target), dtype=bool)
         for i, t in enumerate(is_target):
             if t and self.n_targets <= self.n_nontargets:
@@ -156,15 +164,22 @@ def extract_epochs(
     the label is 1.0 iff stimulus_index + 1 == guessed_number, and the
     global balance scan decides retention.
     """
+    from ..io import native
+
     positions = np.array([m.position for m in markers], dtype=np.int64)
     stim_idx = np.array([m.stimulus_index() for m in markers], dtype=int)
 
-    windows, valid = gather_windows(channels, positions, pre, post)
+    native_out = native.gather_baseline(
+        np.asarray(channels, dtype=np.float64), positions, pre, post
+    )
+    if native_out is not None:
+        epochs, valid = native_out
+    else:
+        windows, valid = gather_windows(channels, positions, pre, post)
+        corrected = baseline_correct_f32(windows, pre)
+        # widen to float64 and drop the pre-stimulus prefix (EpochHolder)
+        epochs = corrected[..., pre:].astype(np.float64)
     stim_idx = stim_idx[valid]
-
-    corrected = baseline_correct_f32(windows, pre)
-    # widen to float64 and drop the pre-stimulus prefix (EpochHolder)
-    epochs = corrected[..., pre:].astype(np.float64)
 
     is_target = (stim_idx + 1) == guessed_number
     balance = balance or BalanceState()

@@ -211,10 +211,28 @@ class Recording:
         return (self._raw[:, index].astype(np.float32) * res).astype(np.float64)
 
     def read_channels(self, indices: Sequence[int]) -> np.ndarray:
-        """(len(indices), num_samples) float64 scaled channel matrix."""
+        """(len(indices), num_samples) float64 scaled channel matrix.
+
+        Demuxed by the native C++ kernel (io/native.py) when built;
+        the numpy path below is bit-identical.
+        """
         res = np.array(
             [self.header.channels[i].resolution for i in indices], dtype=np.float32
         )
+        if self._raw.dtype == np.int16:
+            from . import native
+
+            if self._raw.flags["C_CONTIGUOUS"]:
+                out = native.demux_int16(self._raw, indices, res)
+            elif self._raw.T.flags["C_CONTIGUOUS"]:
+                out = native.demux_int16(
+                    np.ascontiguousarray(self._raw.T), indices, res,
+                    vectorized=True,
+                )
+            else:
+                out = None
+            if out is not None:
+                return out
         scaled32 = self._raw[:, list(indices)].T.astype(np.float32) * res[:, None]
         return scaled32.astype(np.float64)
 

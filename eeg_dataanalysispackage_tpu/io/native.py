@@ -1,0 +1,178 @@
+"""ctypes binding to the native host kernels (``native/eeg_host.cc``).
+
+The C++ library is the TPU-native stand-in for the reference's closed
+``eegloader-hdfs`` jar and the per-marker epoching loop
+(OffLineDataProvider.java:167-196, 200-265): int16 demux with
+per-channel resolution scaling, window gather + float32 baseline
+correction, and the sequential class-balance scan — the host-side hot
+loops that fill device staging buffers.
+
+The library is built on demand with ``make`` (g++) and cached next to
+the source; every entry point has a bit-identical numpy fallback in
+``io/brainvision.py`` / ``epochs/extractor.py``, so the framework is
+fully functional without a toolchain. Set ``EEG_TPU_NATIVE=0`` to
+force the numpy paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libeeg_host.so")
+
+# The C++ gather kernel uses a fixed stack window buffer.
+MAX_WINDOW = 4096
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+_i64 = ctypes.c_int64
+_pd = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+_pf = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+_pi16 = np.ctypeslib.ndpointer(np.int16, flags="C_CONTIGUOUS")
+_pi64 = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+_pu8 = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["make", "-s"],
+            cwd=_NATIVE_DIR,
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError) as e:
+        logger.warning("native build failed, using numpy paths: %s", e)
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("EEG_TPU_NATIVE", "1") == "0":
+            return None
+        src = os.path.join(_NATIVE_DIR, "eeg_host.cc")
+        if not os.path.exists(src):
+            return None
+        stale = not os.path.exists(_LIB_PATH) or (
+            os.path.getmtime(_LIB_PATH) < os.path.getmtime(src)
+        )
+        if stale and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError as e:
+            logger.warning("could not load %s: %s", _LIB_PATH, e)
+            return None
+
+        lib.eeg_demux_int16.argtypes = [
+            _pi16, _i64, _i64, _pi64, _i64, _pf, _pd,
+        ]
+        lib.eeg_demux_int16_vectorized.argtypes = list(
+            lib.eeg_demux_int16.argtypes
+        )
+        lib.eeg_valid_windows.argtypes = [_pi64, _i64, _i64, _i64, _pu8]
+        lib.eeg_valid_windows.restype = _i64
+        lib.eeg_gather_baseline.argtypes = [
+            _pd, _i64, _i64, _pi64, _pu8, _i64, _i64, _i64, _pd,
+        ]
+        lib.eeg_balance_scan.argtypes = [_pu8, _i64, _pi64, _pu8]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    """True if the native library is built/loadable (builds on demand)."""
+    return _load() is not None
+
+
+def demux_int16(
+    raw: np.ndarray,
+    indices,
+    resolutions,
+    vectorized: bool = False,
+) -> Optional[np.ndarray]:
+    """(S, C) [or (C, S) vectorized] int16 -> (n_sel, S) float64.
+
+    Returns None when the native library is unavailable; callers fall
+    back to the numpy path.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    raw = np.ascontiguousarray(raw, dtype=np.int16)
+    if vectorized:
+        n_channels, n_samples = raw.shape
+    else:
+        n_samples, n_channels = raw.shape
+    idx = np.ascontiguousarray(indices, dtype=np.int64)
+    res = np.ascontiguousarray(resolutions, dtype=np.float32)
+    out = np.empty((idx.size, n_samples), dtype=np.float64)
+    fn = lib.eeg_demux_int16_vectorized if vectorized else lib.eeg_demux_int16
+    fn(raw, n_samples, n_channels, idx, idx.size, res, out)
+    return out
+
+
+def gather_baseline(
+    channels: np.ndarray,
+    positions: np.ndarray,
+    pre: int,
+    post: int,
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Window gather + f32 baseline correction, epochs of ``post`` samples.
+
+    channels: (n_channels, n_samples) float64. Returns
+    (epochs (n_valid, n_channels, post) float64, valid (n_pos,) bool),
+    or None when the native library is unavailable or the window
+    exceeds the native buffer.
+    """
+    if pre + post > MAX_WINDOW:
+        return None
+    lib = _load()
+    if lib is None:
+        return None
+    channels = np.ascontiguousarray(channels, dtype=np.float64)
+    pos = np.ascontiguousarray(positions, dtype=np.int64)
+    n_channels, n_samples = channels.shape
+    valid = np.empty(pos.size, dtype=np.uint8)
+    n_valid = lib.eeg_valid_windows(pos, pos.size, pre, n_samples, valid)
+    out = np.empty((int(n_valid), n_channels, post), dtype=np.float64)
+    lib.eeg_gather_baseline(
+        channels, n_channels, n_samples, pos, valid, pos.size, pre, post, out
+    )
+    return out, valid.astype(bool)
+
+
+def balance_scan(
+    is_target: np.ndarray, counters: np.ndarray
+) -> Optional[np.ndarray]:
+    """Sequential balance filter; mutates ``counters`` ([n_t, n_nt])."""
+    lib = _load()
+    if lib is None:
+        return None
+    t = np.ascontiguousarray(is_target, dtype=np.uint8)
+    keep = np.empty(t.size, dtype=np.uint8)
+    c = np.ascontiguousarray(counters, dtype=np.int64)
+    lib.eeg_balance_scan(t, t.size, c, keep)
+    counters[:] = c
+    return keep.astype(bool)

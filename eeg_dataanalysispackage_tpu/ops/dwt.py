@@ -153,6 +153,8 @@ def make_batched_extractor(
     method='conv': the level-by-level filter-bank formulation (kept
     for cross-checking and for future Pallas work on long signals).
     """
+    if method not in ("matmul", "conv"):
+        raise ValueError(f"unknown method {method!r}; use 'matmul' or 'conv'")
     h_np, g_np = eegdsp_compat.filter_pair(wavelet_index)
     ch_idx = np.array([c - 1 for c in channels])
     if method == "matmul":

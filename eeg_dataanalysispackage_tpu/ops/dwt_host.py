@@ -23,23 +23,29 @@ def _seq_dot(block: np.ndarray, f: np.ndarray) -> np.ndarray:
 
 
 def fwt_periodic(signal: np.ndarray, h: np.ndarray, g: np.ndarray) -> np.ndarray:
-    """Full in-place-layout FWT over the last axis.
+    """Full FWT over the last axis in the eegdsp coefficient layout.
 
-    signal: (..., n) float64, n a power of two >= len(h).
-    Returns (..., n): [a_K | d_K | d_{K-1} | ... | d_1] where K is the
-    number of levels run (decompose while current length >= len(h)).
+    signal: (..., n) float64 with n >= len(h). Returns
+    (..., m): [a_K | d_K | d_{K-1} | ... | d_1] where K is the number
+    of levels run (decompose while current length >= len(h)). For
+    power-of-two n this matches eegdsp's in-place layout exactly and
+    m == n; odd intermediate lengths (e.g. n=750 -> 375) keep
+    floor(n/2) coefficients per level with indices taken mod n, the
+    same convention as the conv formulation in ``ops/dwt.py``, and
+    m < n.
     """
-    out = np.array(signal, dtype=np.float64, copy=True)
-    n = out.shape[-1]
+    a = np.array(signal, dtype=np.float64, copy=True)
+    n = a.shape[-1]
     L = len(h)
+    details = []
     while n >= L:
         half = n // 2
         idx = (2 * np.arange(half)[:, None] + np.arange(L)[None, :]) % n
-        block = out[..., :n][..., idx]  # (..., half, L)
-        out[..., :half] = _seq_dot(block, h)
-        out[..., half:n] = _seq_dot(block, g)
+        block = a[..., idx]  # (..., half, L)
+        details.append(_seq_dot(block, g))
+        a = _seq_dot(block, h)
         n = half
-    return out
+    return np.concatenate([a] + details[::-1], axis=-1)
 
 
 def dwt_coefficients(

@@ -62,6 +62,8 @@ def make_streaming_extractor(
     callers either arrange T as a multiple of the window or drop the
     last ``window//stride`` rows.
     """
+    if not 0 < stride <= window:
+        raise ValueError(f"stride {stride} must be in (0, window={window}]")
     fmask_np = bandpass_mask(window, fs, *band)
     n_shards = mesh.shape[axis]
 
@@ -102,9 +104,28 @@ def make_streaming_extractor(
         out_specs=P(axis),
     )
 
-    @jax.jit
     def extract(signal: jnp.ndarray) -> jnp.ndarray:
-        return sharded(signal)
+        # Shapes are static under jit, so the layout contract is
+        # enforced at trace time — JAX's clamped out-of-bounds gather
+        # would otherwise return silently wrong windows.
+        T = signal.shape[-1]
+        if T % n_shards != 0:
+            raise ValueError(
+                f"recording length {T} not divisible by mesh axis "
+                f"{axis!r} size {n_shards}"
+            )
+        block = T // n_shards
+        if block % stride != 0:
+            raise ValueError(
+                f"per-shard block length {block} not a multiple of "
+                f"stride {stride}"
+            )
+        if window - stride > block:
+            raise ValueError(
+                f"halo {window - stride} exceeds block length {block}; "
+                f"use fewer shards or a smaller window"
+            )
+        return jax.jit(sharded)(signal)
 
     return extract
 

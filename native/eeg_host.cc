@@ -1,0 +1,137 @@
+// Native host-side kernels for the TPU EEG framework.
+//
+// The TPU-native equivalent of the closed `eegloader-hdfs` jar's hot
+// path (reference usage: OffLineDataProvider.java:167-196): demux of
+// multiplexed int16 BrainVision samples into per-channel scaled
+// float64 rows, and the stimulus-locked window gather + float32
+// baseline correction (Baseline.java:29-42, EpochHolder.java:75-91).
+// These are the host-side loops that feed device staging buffers;
+// everything downstream is XLA.
+//
+// Bit-exactness contract with the Python/numpy fallback paths
+// (io/brainvision.py, epochs/extractor.py):
+//  - int16 -> float32, scaled by float32 resolution, widened to double;
+//  - baseline = sequential float32 left-fold sum of the first `pre`
+//    samples divided by float32(pre); subtraction in float32;
+//  - windows running past the end of the recording zero-pad (Java's
+//    Arrays.copyOfRange semantics); windows starting out of range are
+//    marked invalid.
+// Compiled without -ffast-math so float arithmetic is strict IEEE.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// Demux `n_sel` channels out of a multiplexed (n_samples, n_channels)
+// int16 block: out[k][s] = (double)((float)raw[s*C + idx[k]] * res[k]).
+// `out` is (n_sel, n_samples) row-major float64.
+void eeg_demux_int16(const int16_t* raw, int64_t n_samples,
+                     int64_t n_channels, const int64_t* sel_indices,
+                     int64_t n_sel, const float* resolutions, double* out) {
+  for (int64_t k = 0; k < n_sel; ++k) {
+    const int64_t ch = sel_indices[k];
+    const float res = resolutions[k];
+    double* row = out + k * n_samples;
+    const int16_t* base = raw + ch;
+    for (int64_t s = 0; s < n_samples; ++s) {
+      const float v = static_cast<float>(base[s * n_channels]) * res;
+      row[s] = static_cast<double>(v);
+    }
+  }
+}
+
+// Same demux for VECTORIZED orientation: raw is (n_channels, n_samples).
+void eeg_demux_int16_vectorized(const int16_t* raw, int64_t n_samples,
+                                int64_t n_channels,
+                                const int64_t* sel_indices, int64_t n_sel,
+                                const float* resolutions, double* out) {
+  for (int64_t k = 0; k < n_sel; ++k) {
+    const int16_t* src = raw + sel_indices[k] * n_samples;
+    const float res = resolutions[k];
+    double* row = out + k * n_samples;
+    for (int64_t s = 0; s < n_samples; ++s) {
+      row[s] = static_cast<double>(static_cast<float>(src[s]) * res);
+    }
+  }
+}
+
+// Validity of marker windows [pos-pre, pos+post): a window is kept iff
+// pos-pre >= 0 and pos-pre <= n_samples (Java copyOfRange throws only
+// on a negative/overshooting *from*; a `to` past the end zero-pads —
+// OffLineDataProvider.java:262-264). Returns the number of valid rows.
+int64_t eeg_valid_windows(const int64_t* positions, int64_t n_pos,
+                          int64_t pre, int64_t n_samples, uint8_t* valid) {
+  int64_t n_valid = 0;
+  for (int64_t i = 0; i < n_pos; ++i) {
+    const int64_t start = positions[i] - pre;
+    const bool ok = start >= 0 && start <= n_samples;
+    valid[i] = ok ? 1 : 0;
+    n_valid += ok ? 1 : 0;
+  }
+  return n_valid;
+}
+
+// Gather + float32 baseline-correct the valid windows.
+//   channels: (n_channels, n_samples) float64 (demux output)
+//   positions/valid: as produced by eeg_valid_windows
+//   out: (n_valid, n_channels, post) float64 — the 750-sample epochs
+//        with the pre-stimulus prefix dropped (EpochHolder offset).
+void eeg_gather_baseline(const double* channels, int64_t n_channels,
+                         int64_t n_samples, const int64_t* positions,
+                         const uint8_t* valid, int64_t n_pos, int64_t pre,
+                         int64_t post, double* out) {
+  const int64_t win = pre + post;
+  int64_t row = 0;
+  for (int64_t i = 0; i < n_pos; ++i) {
+    if (!valid[i]) continue;
+    const int64_t start = positions[i] - pre;
+    for (int64_t c = 0; c < n_channels; ++c) {
+      const double* src = channels + c * n_samples;
+      // narrow the window to float32 (DataProviderUtils.toFloatArray)
+      float w32[4096];  // win <= 4096 enforced by the binding
+      for (int64_t t = 0; t < win; ++t) {
+        const int64_t idx = start + t;
+        w32[t] = idx < n_samples ? static_cast<float>(src[idx]) : 0.0f;
+      }
+      // sequential float32 baseline fold (Baseline.java:29-42)
+      float sum = 0.0f;
+      for (int64_t t = 0; t < pre; ++t) sum += w32[t];
+      const float baseline = sum / static_cast<float>(pre);
+      double* dst = out + (row * n_channels + c) * post;
+      for (int64_t t = 0; t < post; ++t) {
+        dst[t] = static_cast<double>(w32[pre + t] - baseline);
+      }
+    }
+    ++row;
+  }
+}
+
+// The order-dependent class-balance scan
+// (OffLineDataProvider.java:248-260). counters[0]=n_targets,
+// counters[1]=n_nontargets persist across files of a run.
+void eeg_balance_scan(const uint8_t* is_target, int64_t n, int64_t* counters,
+                      uint8_t* keep) {
+  int64_t n_t = counters[0], n_nt = counters[1];
+  for (int64_t i = 0; i < n; ++i) {
+    if (is_target[i]) {
+      if (n_t <= n_nt) {
+        keep[i] = 1;
+        ++n_t;
+      } else {
+        keep[i] = 0;
+      }
+    } else {
+      if (n_t >= n_nt) {
+        keep[i] = 1;
+        ++n_nt;
+      } else {
+        keep[i] = 0;
+      }
+    }
+  }
+  counters[0] = n_t;
+  counters[1] = n_nt;
+}
+
+}  // extern "C"

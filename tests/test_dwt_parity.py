@@ -123,3 +123,25 @@ def test_window_exceeding_epoch_raises(fixture_epochs):
     fe = wavelet.WaveletTransform(8, 750, 750, 16)
     with pytest.raises(ValueError, match="exceeds the epoch length"):
         fe.extract_batch(fixture_epochs.epochs)
+
+
+def test_xla_backend_non_power_of_two_epoch_size():
+    """epoch_size=750 is allowed by the setter range (0, 750]; the
+    matmul cascade must handle the odd intermediate lengths
+    (750 -> 375 -> 187 ...) instead of crashing, and agree with the
+    host path and the conv formulation (on 1000-sample inputs so the
+    analysis window fits past the default 175-sample skip)."""
+    epochs = np.random.RandomState(3).randn(4, 3, 1000) * 40.0
+    host = wavelet.WaveletTransform(epoch_size=750, backend="host")
+    xla = wavelet.WaveletTransform(epoch_size=750, backend="xla")
+    f_host = host.extract_batch(epochs)
+    f_xla = xla.extract_batch(epochs)
+    assert f_host.shape == f_xla.shape == (4, 48)
+    np.testing.assert_allclose(f_xla, f_host, atol=5e-5)
+
+
+def test_unknown_extractor_method_raises():
+    from eeg_dataanalysispackage_tpu.ops import dwt as dwt_xla
+
+    with pytest.raises(ValueError, match="unknown method"):
+        dwt_xla.make_batched_extractor(method="Matmul")

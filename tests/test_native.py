@@ -1,0 +1,123 @@
+"""Bit-parity tests: native C++ host kernels vs the numpy fallbacks.
+
+The C++ library (native/eeg_host.cc) replaces the reference's closed
+``eegloader-hdfs`` demux and the per-marker epoching loop
+(OffLineDataProvider.java:167-196, 200-265). Every kernel must be
+bit-identical to the numpy path, which is itself pinned against the
+Java reference's golden sums (test_epoch_parity.py).
+"""
+
+import numpy as np
+import pytest
+
+from eeg_dataanalysispackage_tpu.epochs import extractor
+from eeg_dataanalysispackage_tpu.io import native, provider
+
+
+needs_native = pytest.mark.skipif(
+    not native.available(), reason="native toolchain unavailable"
+)
+
+
+@needs_native
+def test_demux_matches_numpy():
+    rng = np.random.RandomState(7)
+    raw = rng.randint(-32768, 32768, size=(5000, 8), dtype=np.int16)
+    indices = [3, 0, 5]
+    res = [0.1, 1.0, 0.0488281]
+
+    out = native.demux_int16(raw, indices, res)
+    res32 = np.asarray(res, dtype=np.float32)
+    expect = (
+        raw[:, indices].T.astype(np.float32) * res32[:, None]
+    ).astype(np.float64)
+    np.testing.assert_array_equal(out, expect)
+
+
+@needs_native
+def test_demux_vectorized_matches_numpy():
+    rng = np.random.RandomState(8)
+    raw = rng.randint(-32768, 32768, size=(4, 3000), dtype=np.int16)
+    out = native.demux_int16(raw, [2, 1], [0.5, 0.25], vectorized=True)
+    res32 = np.asarray([0.5, 0.25], dtype=np.float32)
+    expect = (raw[[2, 1]].astype(np.float32) * res32[:, None]).astype(
+        np.float64
+    )
+    np.testing.assert_array_equal(out, expect)
+
+
+@needs_native
+def test_gather_baseline_matches_numpy():
+    rng = np.random.RandomState(9)
+    channels = rng.randn(3, 2000) * 1000.0
+    # include out-of-range starts (negative, > n) and a tail overhang
+    positions = np.array([-50, 100, 150, 1990, 1500, 2150, 2090], dtype=np.int64)
+    pre, post = 100, 750
+
+    out = native.gather_baseline(channels, positions, pre, post)
+    assert out is not None
+    epochs_native, valid_native = out
+
+    windows, valid_np = extractor.gather_windows(channels, positions, pre, post)
+    corrected = extractor.baseline_correct_f32(windows, pre)
+    epochs_np = corrected[..., pre:].astype(np.float64)
+
+    np.testing.assert_array_equal(valid_native, valid_np)
+    np.testing.assert_array_equal(epochs_native, epochs_np)
+
+
+@needs_native
+def test_balance_scan_matches_python():
+    rng = np.random.RandomState(10)
+    is_target = rng.rand(500) > 0.8
+
+    counters = np.array([0, 0], dtype=np.int64)
+    keep_native = native.balance_scan(is_target, counters)
+    assert keep_native is not None
+
+    state = extractor.BalanceState()
+    keep_py = np.zeros(len(is_target), dtype=bool)
+    n_t = n_nt = 0
+    for i, t in enumerate(is_target):
+        if t and n_t <= n_nt:
+            keep_py[i] = True
+            n_t += 1
+        elif not t and n_t >= n_nt:
+            keep_py[i] = True
+            n_nt += 1
+    np.testing.assert_array_equal(keep_native, keep_py)
+    assert counters[0] == n_t and counters[1] == n_nt
+
+    # BalanceState routes through the native kernel when available and
+    # must land on the same counters.
+    state.scan(is_target)
+    assert (state.n_targets, state.n_nontargets) == (n_t, n_nt)
+
+
+@needs_native
+def test_native_pipeline_hits_golden_sums(fixture_dir):
+    """The full ingest through the native kernels still reproduces the
+    reference's golden epoch sums (OfflineDataProviderTest.java:81,88)."""
+    from tests.test_epoch_parity import java_epoch_sum
+
+    odp = provider.OfflineDataProvider([fixture_dir + "/infoTrain.txt"])
+    batch = odp.load()
+    assert batch.epochs.shape == (11, 3, 750)
+    assert java_epoch_sum(batch.epochs) == -253772.18676757812
+    assert int(batch.targets.sum()) == 5
+
+
+def test_numpy_fallback_forced(fixture_dir, monkeypatch):
+    """EEG_TPU_NATIVE=0 must force the numpy paths and produce the
+    same golden sums (the two paths are interchangeable)."""
+    from tests.test_epoch_parity import java_epoch_sum
+
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_tried", True)
+    assert native.demux_int16(
+        np.zeros((4, 2), np.int16), [0], [1.0]
+    ) is None
+
+    odp = provider.OfflineDataProvider([fixture_dir + "/infoTrain.txt"])
+    batch = odp.load()
+    assert java_epoch_sum(batch.epochs) == -253772.18676757812

@@ -7,6 +7,7 @@ streaming extractor with its ppermute halo exchange.
 """
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -95,3 +96,25 @@ def test_streaming_halo_windows_cross_shard_boundaries(mesh8):
     coeffs = dwt_host.dwt_coefficients(win, 8, 16).reshape(-1)
     expected = coeffs / np.sqrt((coeffs**2).sum())
     np.testing.assert_allclose(feats[1], expected, rtol=0, atol=2e-4)
+
+
+def test_streaming_rejects_bad_block_layout(mesh8):
+    """Block length not divisible by stride must raise loudly — JAX's
+    clamped out-of-bounds gather would otherwise return silently wrong
+    windows (code-review finding)."""
+    tmesh = pmesh.make_mesh(8, axes=(pmesh.TIME_AXIS,))
+    extract = streaming.make_streaming_extractor(tmesh, window=512, stride=256)
+    signal = np.random.RandomState(0).randn(2, 8 * 600).astype(np.float32)
+    staged = streaming.stage_recording(signal, tmesh)
+    with pytest.raises(ValueError, match="not a multiple of"):
+        extract(staged)
+    with pytest.raises(ValueError, match="not divisible by"):
+        # unstaged on purpose: the length check fires before sharding
+        extract(jnp.asarray(signal[:, : 8 * 600 - 3]))
+
+
+def test_streaming_rejects_bad_stride():
+    with pytest.raises(ValueError, match="stride"):
+        streaming.make_streaming_extractor(
+            pmesh.make_mesh(1, axes=(pmesh.TIME_AXIS,)), window=256, stride=512
+        )
