@@ -1,1 +1,23 @@
+"""Checkpoint / resume subsystem.
 
+The reference's only persistence is whole-model save/load driven by
+``save_clf``/``load_clf`` query params (MLlib ``model.save`` dirs,
+DL4J ``ModelSerializer`` — SURVEY.md section 5 'Checkpoint / resume');
+a crashed training run restarts from scratch. This module adds the
+TPU-native equivalent plus what the reference lacks: step-numbered
+checkpoints of the *full training state* (params + optimizer state)
+with atomic writes, retention, and mid-run resume.
+
+Two layers:
+
+- :class:`CheckpointManager` — step-numbered pytree checkpoints
+  (flax msgpack payload + JSON metadata, atomic tmp-dir rename,
+  ``max_to_keep`` retention).
+- :func:`run_resumable` — drives a jitted train step over batches,
+  checkpointing every ``save_every`` steps and resuming from the
+  latest step after interruption.
+"""
+
+from .manager import CheckpointManager, run_resumable
+
+__all__ = ["CheckpointManager", "run_resumable"]

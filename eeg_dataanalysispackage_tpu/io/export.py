@@ -1,0 +1,35 @@
+"""Epoch CSV export (reference: DataTransformation/DataProviderUtils.java).
+
+``writeEpochsToCSV`` dumps channel Pz (``epoch[2]``) of every epoch as
+a comma-separated row with a trailing comma (DataProviderUtils.java:30-47;
+the ``Epochs.csv`` artifact at the reference repo root is its output).
+Number formatting uses Python's shortest-roundtrip repr, which parses
+back to the same float64 bits as Java's ``Double.toString`` output.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def write_epochs_to_csv(
+    epochs: np.ndarray, path: str = "Epochs.csv", channel: int = 2
+) -> None:
+    """Write ``epochs[:, channel, :]`` rows as ``v0,v1,...,v749,\\n``."""
+    arr = np.asarray(epochs, dtype=np.float64)
+    with open(path, "w") as f:
+        for row in arr[:, channel, :]:
+            f.write("".join(f"{float(v)!r}," for v in row))
+            f.write("\n")
+
+
+def read_epochs_csv(path: str) -> np.ndarray:
+    """Read a ``writeEpochsToCSV``-format file back into (n, T) float64
+    (rows have a trailing comma)."""
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip().rstrip(",")
+            if line:
+                rows.append([float(x) for x in line.split(",")])
+    return np.array(rows, dtype=np.float64)

@@ -37,6 +37,9 @@ def _register_builtins() -> None:
 
     register("dt", trees.DecisionTreeClassifier)
     register("rf", trees.RandomForestClassifier)
+    # restored from the reference's commented-out test surface
+    # (ClassifierTest.java:213) — MLlib GradientBoostedTrees analogue
+    register("gbt", trees.GradientBoostedTreesClassifier)
     from . import nn
 
     register("nn", nn.NeuralNetworkClassifier)

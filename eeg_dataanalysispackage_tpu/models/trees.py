@@ -347,3 +347,134 @@ class RandomForestClassifier(DecisionTreeClassifier):
         if strategy == "onethird":
             return max(1, int(np.ceil(d / 3.0)))
         raise ValueError(f"unsupported feature subset strategy: {strategy}")
+
+
+def _grow_regression_tree(
+    binned: np.ndarray,
+    residuals: np.ndarray,
+    max_bins: int,
+    max_depth: int,
+    min_instances: int,
+) -> _Tree:
+    """Variance-reduction CART on binned features for GBT residuals.
+
+    Same vectorized-histogram shape as ``_grow_tree``, but the per-bin
+    statistics are (count, sum r, sum r^2) and leaves predict the mean
+    residual.
+    """
+    n, d = binned.shape
+    tree = _Tree()
+    root = tree.add_node()
+    active = {root: np.arange(n)}
+
+    for _depth in range(max_depth):
+        if not active:
+            break
+        next_active: Dict[int, np.ndarray] = {}
+        for node_id, idx in active.items():
+            r = residuals[idx]
+            tree.prediction[node_id] = float(r.mean())
+            if len(idx) < 2 * min_instances:
+                continue
+            sub = binned[idx]  # (m, d)
+            m = len(idx)
+            flat = np.arange(d)[None, :] * max_bins + sub
+            cnt = np.bincount(flat.ravel(), minlength=d * max_bins).reshape(
+                d, max_bins
+            )
+            s1 = np.bincount(
+                flat.ravel(), weights=np.repeat(r, d), minlength=d * max_bins
+            ).reshape(d, max_bins)
+            c_cnt, c_s1 = cnt.cumsum(axis=1), s1.cumsum(axis=1)
+            nl = c_cnt[:, :-1]
+            nr = m - nl
+            sl = c_s1[:, :-1]
+            sr = c_s1[:, -1:] - sl
+            # SSE reduction: parent SSE - (left SSE + right SSE); the
+            # sum-of-squares terms cancel, leaving the mean terms
+            with np.errstate(divide="ignore", invalid="ignore"):
+                score = sl**2 / np.maximum(nl, _EPS) + sr**2 / np.maximum(
+                    nr, _EPS
+                )
+            valid = (nl >= min_instances) & (nr >= min_instances)
+            score = np.where(valid, score, -np.inf)
+            bf, bb = divmod(int(np.argmax(score)), max_bins - 1)
+            if not np.isfinite(score[bf, bb]):
+                continue
+            parent_score = c_s1[bf, -1] ** 2 / m
+            if score[bf, bb] <= parent_score + 1e-12:
+                continue  # no variance reduction
+            go_left = binned[idx, bf] <= bb
+            li, ri = tree.add_node(), tree.add_node()
+            tree.feature[node_id] = int(bf)
+            tree.threshold_bin[node_id] = int(bb)
+            tree.left[node_id] = li
+            tree.right[node_id] = ri
+            next_active[li] = idx[go_left]
+            next_active[ri] = idx[~go_left]
+        active = next_active
+
+    for node_id, idx in active.items():
+        tree.prediction[node_id] = float(residuals[idx].mean())
+    return tree
+
+
+class GradientBoostedTreesClassifier(DecisionTreeClassifier):
+    """Gradient-boosted trees with logistic loss.
+
+    The reference's test suite exercises a ``GradientBoostedTreesClassifier``
+    (MLlib ``GradientBoostedTrees``) that was removed from its main
+    tree (ClassifierTest.java:213, commented out) — restored here as a
+    first-class registry entry (``train_clf=gbt``). Defaults follow
+    MLlib 1.6 ``BoostingStrategy.defaultParams("Classification")``:
+    100 iterations, learning rate 0.1, depth-3 trees, LogLoss.
+
+    Boosting: F_0 = 0; per round fit a variance-reduction regression
+    tree to the logistic residual ``y - sigmoid(F)`` and update
+    ``F += lr * tree(x)``. Prediction: ``sigmoid(F) >= 0.5``.
+    """
+
+    required_keys = (
+        "config_num_iterations",
+        "config_learning_rate",
+        "config_max_depth",
+    )
+
+    def _boost_params(self) -> Dict:
+        c = self.config
+        if all(k in c for k in self.required_keys):
+            return {
+                "num_iterations": int(c["config_num_iterations"]),
+                "learning_rate": float(c["config_learning_rate"]),
+                "max_depth": int(c["config_max_depth"]),
+            }
+        return {"num_iterations": 100, "learning_rate": 0.1, "max_depth": 3}
+
+    def fit(self, features: np.ndarray, labels: np.ndarray) -> None:
+        p = self._boost_params()
+        bp = {"max_bins": 32, "min_instances": 1}
+        self._params = {**p, **bp}
+        y = np.floor(np.asarray(labels, dtype=np.float64) + 0.5)
+        self.edges = compute_bin_edges(features, bp["max_bins"])
+        binned = bin_features(features, self.edges)
+        F = np.zeros(len(y), dtype=np.float64)
+        self.trees = []
+        for _round in range(p["num_iterations"]):
+            residual = y - 1.0 / (1.0 + np.exp(-F))
+            tree = _grow_regression_tree(
+                binned, residual, bp["max_bins"], p["max_depth"],
+                bp["min_instances"],
+            )
+            arrays = tree.to_arrays()
+            self.trees.append(arrays)
+            F += p["learning_rate"] * _predict_tree(arrays, binned)
+
+    def predict(self, features: np.ndarray) -> np.ndarray:
+        if not self.trees or self.edges is None:
+            raise ValueError("model not trained or loaded")
+        binned = bin_features(np.asarray(features, dtype=np.float64), self.edges)
+        lr = self._params.get("learning_rate", 0.1)
+        F = np.zeros(binned.shape[0], dtype=np.float64)
+        for t in self.trees:
+            F += lr * _predict_tree(t, binned)
+        return (F >= 0.0).astype(np.float64)

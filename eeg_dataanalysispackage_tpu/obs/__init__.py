@@ -1,1 +1,164 @@
+"""Observability: stage timers, metrics, profiler hooks, logging setup.
 
+The reference's observability is log4j timestamps plus whatever the
+Spark UI exposes (SURVEY.md section 5 'Tracing / profiling' — no
+first-party tracing at all). This module is the TPU-native upgrade:
+
+- :class:`StageTimer`  — wall-clock accumulation per pipeline stage
+  (ingest / feature extraction / train / test), queryable and
+  renderable, replacing "read the log4j timestamps";
+- :class:`Metrics`     — process-wide counters/gauges with JSON export
+  (the dropwizard-metrics equivalent that Spark dragged in);
+- :func:`trace` / :func:`annotate` — ``jax.profiler`` hooks: one
+  context manager around a run produces an XLA trace viewable in
+  TensorBoard/Perfetto; ``annotate`` names host-side regions inside it;
+- :func:`configure_logging` — timestamped console + optional rolling
+  file handler; the log path comes from the ``LOGFILE_NAME`` env var,
+  mirroring the reference's ``-Dlogfile.name`` system property
+  (log4j.xml:23-31).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import logging.handlers
+import os
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, Iterator, Optional
+
+
+class StageTimer:
+    """Accumulates wall time per named stage; reentrant-safe per name."""
+
+    def __init__(self) -> None:
+        self._totals: Dict[str, float] = defaultdict(float)
+        self._counts: Dict[str, int] = defaultdict(int)
+        self._lock = threading.Lock()
+
+    @contextlib.contextmanager
+    def stage(self, name: str) -> Iterator[None]:
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            elapsed = time.perf_counter() - start
+            with self._lock:
+                self._totals[name] += elapsed
+                self._counts[name] += 1
+
+    def total(self, name: str) -> float:
+        return self._totals[name]
+
+    def as_dict(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {
+                name: {"seconds": self._totals[name], "count": self._counts[name]}
+                for name in self._totals
+            }
+
+    def report(self) -> str:
+        rows = sorted(self.as_dict().items(), key=lambda kv: -kv[1]["seconds"])
+        width = max((len(n) for n, _ in rows), default=5)
+        lines = [
+            f"{name:<{width}}  {v['seconds']:9.4f}s  x{v['count']}"
+            for name, v in rows
+        ]
+        return "\n".join(lines)
+
+
+class Metrics:
+    """Counters and gauges with JSON export."""
+
+    def __init__(self) -> None:
+        self._counters: Dict[str, float] = defaultdict(float)
+        self._gauges: Dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def count(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] += value
+
+    def gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+            }
+
+    def to_json(self) -> str:
+        return json.dumps(self.snapshot(), sort_keys=True)
+
+
+#: process-wide default registry (modules may also build their own)
+metrics = Metrics()
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """``jax.profiler.trace`` around a region; no-op if unavailable.
+
+    The produced trace covers device (XLA) activity and annotated host
+    regions — open ``log_dir`` with TensorBoard's profile plugin or
+    Perfetto.
+    """
+    try:
+        import jax.profiler as jp
+    except Exception:  # pragma: no cover - jax is a hard dep in practice
+        yield
+        return
+    jp.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jp.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named host-side region inside a profiler trace (TraceAnnotation)."""
+    try:
+        import jax.profiler as jp
+
+        cm = jp.TraceAnnotation(name)
+    except Exception:  # pragma: no cover
+        yield
+        return
+    with cm:
+        yield
+
+
+def configure_logging(
+    level: int = logging.INFO,
+    logfile: Optional[str] = None,
+) -> None:
+    """Console + optional daily-rolling file logging.
+
+    ``logfile`` defaults to the ``LOGFILE_NAME`` env var, the analogue
+    of the reference's ``-Dlogfile.name`` injection at spark-submit
+    time (log4j.xml:23-31, README 'Deployment'); when neither is set,
+    console only.
+    """
+    handlers: list = [logging.StreamHandler()]
+    logfile = logfile or os.environ.get("LOGFILE_NAME")
+    if logfile:
+        os.makedirs(os.path.dirname(logfile) or ".", exist_ok=True)
+        handlers.append(
+            logging.handlers.TimedRotatingFileHandler(
+                logfile, when="midnight", backupCount=7
+            )
+        )
+    logging.basicConfig(
+        level=level,
+        format="%(asctime)s.%(msecs)03d %(levelname)s %(name)s - %(message)s",
+        datefmt="%H:%M:%S",
+        handlers=handlers,
+        force=True,
+    )

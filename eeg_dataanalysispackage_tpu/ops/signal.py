@@ -1,0 +1,68 @@
+"""Signal-processing utilities (reference: Utils/SignalProcessing.java).
+
+- :func:`decimate` — stride subsampling, ``output[i] = input[i*factor]``
+  (SignalProcessing.java:29-36; unused in the reference's main path
+  since ``DOWN_SMPL_FACTOR=1`` but part of its public surface);
+- :func:`normalize` — in the reference an in-place L2 divide
+  (SignalProcessing.java:38-52); here the bit-exact sequential host
+  form lives in ``ops.dwt_host.l2_normalize_seq`` and the guarded
+  device form in ``ops.dwt.safe_l2_normalize`` — both re-exported;
+- :func:`fft_bandpass` — rfft-mask-irfft band-pass for the streaming
+  front end (jnp.fft replaces the JTransforms jar on the reference's
+  classpath, SURVEY.md section 2.2).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .dwt import safe_l2_normalize
+from .dwt_host import l2_normalize_seq
+
+__all__ = [
+    "decimate",
+    "normalize",
+    "l2_normalize_seq",
+    "safe_l2_normalize",
+    "bandpass_mask",
+    "fft_bandpass",
+]
+
+
+def bandpass_mask(n: int, fs: float, low: float, high: float) -> np.ndarray:
+    """rfft-domain 0/1 mask keeping [low, high] Hz (inclusive edges)."""
+    freqs = np.fft.rfftfreq(n, d=1.0 / fs)
+    return ((freqs >= low) & (freqs <= high)).astype(np.float32)
+
+
+def decimate(signal: np.ndarray, factor: int) -> np.ndarray:
+    """Stride subsample over the last axis: keep every ``factor``-th
+    sample, output length ``n // factor`` (SignalProcessing.java:29-36)."""
+    if factor < 1:
+        raise ValueError(f"decimation factor must be >= 1, got {factor}")
+    n = signal.shape[-1] // factor
+    return signal[..., : n * factor : factor]
+
+
+def normalize(features: np.ndarray) -> np.ndarray:
+    """L2-normalize over the last axis with the reference's exact
+    arithmetic (alias of :func:`l2_normalize_seq`)."""
+    return l2_normalize_seq(np.asarray(features, dtype=np.float64))
+
+
+def fft_bandpass(
+    signal, fs: float, low: float, high: float, axis: int = -1
+):
+    """Zero out rfft bins outside [low, high] Hz over ``axis``.
+
+    Traceable (jnp) — usable inside jitted programs; the streaming
+    extractor applies the same mask per window
+    (parallel/streaming.py)."""
+    x = jnp.asarray(signal)
+    n = x.shape[axis]
+    mask = bandpass_mask(n, fs, low, high)
+    shape = [1] * x.ndim
+    shape[axis] = mask.size
+    spec = jnp.fft.rfft(x, axis=axis) * jnp.asarray(mask).reshape(shape)
+    return jnp.fft.irfft(spec, n=n, axis=axis).astype(x.dtype)

@@ -30,13 +30,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from ..ops import dwt as dwt_xla
+from ..ops.signal import bandpass_mask
 from . import mesh as pmesh
-
-
-def bandpass_mask(n: int, fs: float, low: float, high: float) -> np.ndarray:
-    """rfft-domain 0/1 mask keeping [low, high] Hz."""
-    freqs = np.fft.rfftfreq(n, d=1.0 / fs)
-    return ((freqs >= low) & (freqs <= high)).astype(np.float32)
 
 
 def _window_starts(block_len: int, stride: int) -> np.ndarray:
@@ -97,11 +92,13 @@ def make_streaming_extractor(
         feats = coeffs.reshape(W, C * feature_count)
         return dwt_xla.safe_l2_normalize(feats)
 
-    sharded = shard_map(
-        block_fn,
-        mesh=mesh,
-        in_specs=P(None, axis),
-        out_specs=P(axis),
+    sharded = jax.jit(
+        shard_map(
+            block_fn,
+            mesh=mesh,
+            in_specs=P(None, axis),
+            out_specs=P(axis),
+        )
     )
 
     def extract(signal: jnp.ndarray) -> jnp.ndarray:
@@ -125,7 +122,7 @@ def make_streaming_extractor(
                 f"halo {window - stride} exceeds block length {block}; "
                 f"use fewer shards or a smaller window"
             )
-        return jax.jit(sharded)(signal)
+        return sharded(signal)
 
     return extract
 

@@ -17,6 +17,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from .. import obs
 from ..features import registry as fe_registry
 from ..io import provider, sources
 from ..models import registry as clf_registry
@@ -46,6 +47,8 @@ class PipelineBuilder:
         self.query = query
         self._fs = filesystem or sources.LocalFileSystem()
         self.statistics: Optional[stats.ClassificationStatistics] = None
+        #: per-stage wall times for the run (obs.StageTimer)
+        self.timers = obs.StageTimer()
 
     def execute(self) -> stats.ClassificationStatistics:
         query_map = get_query_map(self.query)
@@ -60,7 +63,9 @@ class PipelineBuilder:
             raise ValueError("Missing the input file argument")
 
         odp = provider.OfflineDataProvider(files, filesystem=self._fs)
-        batch = odp.load()
+        with self.timers.stage("ingest"):
+            batch = odp.load()
+        obs.metrics.count("pipeline.epochs_loaded", len(batch))
 
         # 2. feature extraction (PipelineBuilder.java:128-139)
         if "fe" not in query_map:
@@ -77,9 +82,10 @@ class PipelineBuilder:
                 k: v for k, v in query_map.items() if k.startswith("config_")
             }
             classifier.set_config(config)
-            classifier.train(
-                batch.epochs[train_idx], batch.targets[train_idx], fe
-            )
+            with self.timers.stage("train"):
+                classifier.train(
+                    batch.epochs[train_idx], batch.targets[train_idx], fe
+                )
             logger.info("trained %s", query_map["train_clf"])
 
             if query_map.get("save_clf") == "true":
@@ -90,9 +96,10 @@ class PipelineBuilder:
                     )
                 classifier.save(query_map["save_name"])
 
-            statistics = classifier.test(
-                batch.epochs[test_idx], batch.targets[test_idx]
-            )
+            with self.timers.stage("test"):
+                statistics = classifier.test(
+                    batch.epochs[test_idx], batch.targets[test_idx]
+                )
 
         elif "load_clf" in query_map:
             classifier = clf_registry.create(query_map["load_clf"])
@@ -104,12 +111,16 @@ class PipelineBuilder:
             perm = java_compat.java_shuffle_indices(n, seed=1)
             classifier.set_feature_extraction(fe)
             classifier.load(query_map["load_name"])
-            statistics = classifier.test(batch.epochs[perm], batch.targets[perm])
+            with self.timers.stage("test"):
+                statistics = classifier.test(
+                    batch.epochs[perm], batch.targets[perm]
+                )
 
         else:
             raise ValueError("Missing classifier argument")
 
         logger.info("statistics:\n%s", statistics)
+        logger.info("stage timings:\n%s", self.timers.report())
 
         if "result_path" in query_map:
             with open(query_map["result_path"], "w") as f:

@@ -13,15 +13,13 @@ import logging
 import sys
 
 from . import builder
+from .. import obs
 
 
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
-    logging.basicConfig(
-        level=logging.INFO,
-        format="%(asctime)s.%(msecs)03d %(levelname)s %(name)s - %(message)s",
-        datefmt="%H:%M:%S",
-    )
+    # logfile path via LOGFILE_NAME, the -Dlogfile.name analogue
+    obs.configure_logging(level=logging.INFO)
     log = logging.getLogger("eeg_dataanalysispackage_tpu")
     log.info("Hello from the TPU-native EEG analysis pipeline")
     log.info("Application started with arguments %s", argv)
