@@ -49,6 +49,27 @@ class CheckpointManager:
         self.directory = directory
         self.max_to_keep = max_to_keep
         os.makedirs(directory, exist_ok=True)
+        self._recover()
+
+    def _recover(self) -> None:
+        """Clean up after a crash mid-save.
+
+        ``.tmp-*`` dirs are partial writes — discarded. ``.old-<step>``
+        dirs are displaced previous checkpoints: if the crash hit
+        between the two renames of an overwrite, the final dir is
+        missing and the old data is moved back; otherwise the
+        overwrite completed and the old copy is deleted.
+        """
+        for name in os.listdir(self.directory):
+            path = os.path.join(self.directory, name)
+            if name.startswith(".tmp-"):
+                shutil.rmtree(path)
+            elif name.startswith(".old-"):
+                final = os.path.join(self.directory, "step_" + name[5:])
+                if os.path.exists(final):
+                    shutil.rmtree(path)
+                else:
+                    os.rename(path, final)
 
     # -- inventory -----------------------------------------------------
 
@@ -83,9 +104,17 @@ class CheckpointManager:
                 f.write(serialization.to_bytes(_to_host(state)))
             with open(os.path.join(tmp, "metadata.json"), "w") as f:
                 json.dump({"step": step, "extra": extra or {}}, f)
+            old = os.path.join(self.directory, f".old-{step:08d}")
             if os.path.exists(final):
-                shutil.rmtree(final)
+                # displace rather than delete: a crash between these
+                # renames is repaired by _recover(), so the previous
+                # valid checkpoint is never lost
+                if os.path.exists(old):
+                    shutil.rmtree(old)
+                os.rename(final, old)
             os.rename(tmp, final)
+            if os.path.exists(old):
+                shutil.rmtree(old)
         finally:
             if os.path.exists(tmp):
                 shutil.rmtree(tmp)

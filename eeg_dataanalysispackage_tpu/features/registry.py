@@ -25,16 +25,18 @@ def register(name: str, factory: Callable[[], base.FeatureExtraction]) -> None:
 def create(name: str) -> base.FeatureExtraction:
     if name in _REGISTRY:
         return _REGISTRY[name]()
-    m = re.fullmatch(r"dwt-(\d+)(-tpu)?", name)
+    m = re.fullmatch(r"dwt-(\d+)(-tpu|-pallas)?", name)
     if m:
-        return wavelet.WaveletTransform(
-            name=int(m.group(1)),
-            backend="xla" if m.group(2) else "host",
-        )
+        backend = {None: "host", "-tpu": "xla", "-pallas": "pallas"}[m.group(2)]
+        return wavelet.WaveletTransform(name=int(m.group(1)), backend=backend)
     raise ValueError("Unsupported feature extraction argument")
 
 
 register("dwt-8", lambda: wavelet.WaveletTransform(8, 512, 175, 16, backend="host"))
 register(
     "dwt-8-tpu", lambda: wavelet.WaveletTransform(8, 512, 175, 16, backend="xla")
+)
+register(
+    "dwt-8-pallas",
+    lambda: wavelet.WaveletTransform(8, 512, 175, 16, backend="pallas"),
 )

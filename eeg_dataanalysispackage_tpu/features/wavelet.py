@@ -107,6 +107,22 @@ class WaveletTransform(base.FeatureExtraction):
                     channels=self.channels,
                 )
             return np.asarray(self._jit_cache(epochs))
+        if self.backend == "pallas":
+            from ..ops import dwt_pallas
+
+            if self._jit_cache is None:
+                self._jit_cache = dwt_pallas.make_batched_extractor_pallas(
+                    wavelet_index=self.name,
+                    epoch_size=self.epoch_size,
+                    skip_samples=self.skip_samples,
+                    feature_size=self.feature_size,
+                )
+            arr = np.asarray(epochs, np.float32)
+            # same channel selection as the host/xla backends
+            ch_idx = [c - 1 for c in self.channels]
+            if ch_idx != list(range(arr.shape[1])):
+                arr = arr[:, ch_idx, :]
+            return np.asarray(self._jit_cache(arr))
         return self._extract_batch_host(np.asarray(epochs, dtype=np.float64))
 
     def _extract_batch_host(self, epochs: np.ndarray) -> np.ndarray:
