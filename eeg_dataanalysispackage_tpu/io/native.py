@@ -71,18 +71,27 @@ def _load() -> Optional[ctypes.CDLL]:
         _tried = True
         if os.environ.get("EEG_TPU_NATIVE", "1") == "0":
             return None
+        lib_path = _LIB_PATH
         src = os.path.join(_NATIVE_DIR, "eeg_host.cc")
         if not os.path.exists(src):
-            return None
-        stale = not os.path.exists(_LIB_PATH) or (
-            os.path.getmtime(_LIB_PATH) < os.path.getmtime(src)
-        )
-        if stale and not _build():
-            return None
+            # installed wheel: setup.py ships the prebuilt library as
+            # package data next to this module
+            packaged = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "libeeg_host.so"
+            )
+            if not os.path.exists(packaged):
+                return None
+            lib_path = packaged
+        else:
+            stale = not os.path.exists(_LIB_PATH) or (
+                os.path.getmtime(_LIB_PATH) < os.path.getmtime(src)
+            )
+            if stale and not _build():
+                return None
         try:
-            lib = ctypes.CDLL(_LIB_PATH)
+            lib = ctypes.CDLL(lib_path)
         except OSError as e:
-            logger.warning("could not load %s: %s", _LIB_PATH, e)
+            logger.warning("could not load %s: %s", lib_path, e)
             return None
 
         lib.eeg_demux_int16.argtypes = [

@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Launcher parity with the reference's spark.sh / README deployment
+# block (spark-submit --class cz.zcu.kiv.Main ... '<query string>'
+# with -Dlogfile.name=<log>): run the pipeline from a query string.
+#
+#   ./run.sh 'info_file=test-data/info.txt&fe=dwt-8&train_clf=logreg&result_path=result.txt'
+#
+# LOGFILE_NAME is the -Dlogfile.name analogue (obs.configure_logging).
+set -euo pipefail
+cd "$(dirname "$0")"
+exec python -m eeg_dataanalysispackage_tpu.pipeline.cli "$@"
