@@ -1,0 +1,186 @@
+"""Multi-host runtime: process bootstrap, hybrid DCN x ICI meshes,
+per-process global-batch staging.
+
+The reference's cluster story is Spark's akka control plane + netty
+data plane, latent behind ``master=local[*]`` (SparkInitializer.java:
+40-49; SURVEY.md section 2.3 — every shipped config is one process).
+The TPU-native equivalent is first-class here:
+
+- :func:`initialize` — ``jax.distributed.initialize`` bootstrap (the
+  control plane: coordinator discovery, process ids), idempotent and
+  a no-op for single-process runs, so the same program runs unchanged
+  from a laptop to a multi-host pod slice;
+- :func:`hybrid_mesh` — a mesh whose outer axis spans hosts/slices
+  over DCN and whose inner axes span chips over ICI, so gradient
+  all-reduces ride ICI within a slice and only the slice-level
+  reduction crosses DCN (the bandwidth hierarchy the scaling-book
+  recipe prescribes, replacing Spark's flat driver<->executor TCP);
+- :func:`stage_global_batch` — each process materializes only its own
+  shard of a logically global batch
+  (``jax.make_array_from_process_local_data``), the multi-host form of
+  ``mesh.shard_batch``'s host->device staging (and of the reference's
+  ``sc.parallelize`` driver->executor scatter);
+- :func:`replicate_across_hosts` — host-local array -> globally
+  replicated device array (broadcast of model parameters).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import mesh as pmesh
+
+logger = logging.getLogger(__name__)
+
+DCN_AXIS = "hosts"
+
+_initialized = False
+
+
+def _env_int(name: str) -> Optional[int]:
+    value = os.environ.get(name)
+    return int(value) if value is not None else None
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Bootstrap the multi-process JAX runtime (idempotent).
+
+    Single-process runs (no coordinator configured anywhere) are a
+    no-op. Arguments default to the ``JAX_COORDINATOR_ADDRESS`` /
+    ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID`` env vars, falling back
+    to the cluster auto-detection built into
+    ``jax.distributed.initialize`` (SLURM/OMPI/TPU metadata).
+
+    Must run before anything touches a JAX backend — this function
+    deliberately makes no backend-initializing JAX calls on the way to
+    the bootstrap.
+    """
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    if num_processes is None:
+        num_processes = _env_int("JAX_NUM_PROCESSES")
+    if process_id is None:
+        process_id = _env_int("JAX_PROCESS_ID")
+    if coordinator_address is None and num_processes is None:
+        return  # single process; nothing to bootstrap
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+    _initialized = True
+    logger.info(
+        "distributed runtime up: process %d/%d, %d local / %d global devices",
+        jax.process_index(),
+        jax.process_count(),
+        jax.local_device_count(),
+        jax.device_count(),
+    )
+
+
+def hybrid_mesh(
+    ici_axes: Tuple[str, ...] = (pmesh.DATA_AXIS,),
+    ici_shape: Optional[Sequence[int]] = None,
+    dcn_axis: str = DCN_AXIS,
+) -> Mesh:
+    """Mesh with ``dcn_axis`` (outermost) spanning processes over DCN
+    and ``ici_axes`` spanning each process's local chips over ICI.
+
+    Single-process: the DCN axis has size 1 and the result degenerates
+    to a plain local mesh — callers write one sharding
+    (``P((DCN_AXIS, DATA_AXIS))`` for batch axes) for both worlds.
+    Collectives over a batch sharded this way reduce over ICI first
+    and cross DCN once per slice, never per chip.
+    """
+    n_local = jax.local_device_count()
+    n_proc = jax.process_count()
+    if ici_shape is None:
+        if len(ici_axes) != 1:
+            raise ValueError("ici_shape required for multi-axis ICI layouts")
+        ici_shape = (n_local,)
+    if int(np.prod(ici_shape)) != n_local:
+        raise ValueError(
+            f"ici_shape {tuple(ici_shape)} must cover the {n_local} "
+            "local devices"
+        )
+    if n_proc > 1:
+        from jax.experimental import mesh_utils
+
+        # rank = 1 + len(ici_shape); per-granule ICI extent is 1 on the
+        # DCN axis and n_proc is 1 on every ICI axis, so the result has
+        # shape (n_proc, *ici_shape). The shapes above are derived from
+        # process_count/local_device_count, so the granule is the
+        # process (also the only option for devices without a
+        # slice_index attribute, e.g. GPU/CPU clusters).
+        devices = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(1,) + tuple(ici_shape),
+            dcn_mesh_shape=(n_proc,) + tuple(1 for _ in ici_shape),
+            process_is_granule=True,
+        )
+        return Mesh(devices, (dcn_axis,) + tuple(ici_axes))
+    devices = np.array(jax.devices()).reshape((1,) + tuple(ici_shape))
+    return Mesh(devices, (dcn_axis,) + tuple(ici_axes))
+
+
+def batch_spec(mesh: Mesh) -> P:
+    """PartitionSpec sharding the leading batch axis over every
+    data-parallel mesh axis present (DCN outer, ICI inner)."""
+    axes = tuple(
+        a for a in (DCN_AXIS, pmesh.DATA_AXIS) if a in mesh.axis_names
+    )
+    if not axes:
+        raise ValueError(
+            f"mesh {mesh.axis_names} has no data-parallel axis"
+        )
+    return P(axes if len(axes) > 1 else axes[0])
+
+
+def stage_global_batch(
+    local_batch: np.ndarray, mesh: Mesh
+) -> jax.Array:
+    """Per-process host shard -> one global device array.
+
+    ``local_batch`` is this process's slice of the global batch (the
+    data loader reads only its own files); the returned array's global
+    leading dimension is ``sum over processes`` and is sharded by
+    :func:`batch_spec`. Single-process this is exactly
+    ``device_put`` + batch sharding.
+    """
+    sharding = NamedSharding(mesh, batch_spec(mesh))
+    local = np.asarray(local_batch)
+    if jax.process_count() == 1:
+        return jax.device_put(local, sharding)
+    return jax.make_array_from_process_local_data(sharding, local)
+
+
+def replicate_across_hosts(tree, mesh: Mesh):
+    """Host-local pytree -> globally replicated device arrays (the
+    parameter broadcast; every process must pass identical values)."""
+    sharding = NamedSharding(mesh, P())
+    if jax.process_count() == 1:
+        return jax.device_put(tree, sharding)
+    from jax.experimental import multihost_utils
+
+    return jax.tree_util.tree_map(
+        lambda x: multihost_utils.host_local_array_to_global_array(
+            np.asarray(x), mesh, P()
+        ),
+        tree,
+    )

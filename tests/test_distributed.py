@@ -1,0 +1,112 @@
+"""Multi-host runtime surface, exercised single-process.
+
+Real DCN needs multiple hosts; what is testable hermetically is the
+single-process degeneration (the same code paths a laptop run takes)
+plus the 2-D hosts x data mesh structure itself: an 8-device CPU mesh
+reshaped to (2, 4) stands in for 2 hosts x 4 chips, and the flagship
+train step must produce the same result sharded over both axes as it
+does single-device.
+"""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from eeg_dataanalysispackage_tpu.parallel import (
+    distributed,
+    mesh as pmesh,
+    train as ptrain,
+)
+
+
+@pytest.fixture(scope="module")
+def devices8():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    return jax.devices()[:8]
+
+
+def test_initialize_single_process_noop():
+    distributed.initialize()  # no coordinator configured -> no-op
+    assert jax.process_count() == 1
+
+
+def test_hybrid_mesh_degenerates_single_process(devices8):
+    mesh = distributed.hybrid_mesh()
+    assert mesh.axis_names == (distributed.DCN_AXIS, pmesh.DATA_AXIS)
+    assert mesh.shape[distributed.DCN_AXIS] == 1
+    assert mesh.shape[pmesh.DATA_AXIS] == jax.local_device_count()
+
+
+def test_hybrid_mesh_rejects_bad_ici_shape():
+    with pytest.raises(ValueError, match="local devices"):
+        distributed.hybrid_mesh(ici_shape=(3,))
+
+
+def test_batch_spec_covers_dcn_and_data_axes(devices8):
+    mesh = distributed.hybrid_mesh()
+    spec = distributed.batch_spec(mesh)
+    assert spec == P((distributed.DCN_AXIS, pmesh.DATA_AXIS))
+    data_only = pmesh.make_mesh(8)
+    assert distributed.batch_spec(data_only) == P(pmesh.DATA_AXIS)
+    time_only = pmesh.make_mesh(8, axes=(pmesh.TIME_AXIS,))
+    with pytest.raises(ValueError, match="no data-parallel axis"):
+        distributed.batch_spec(time_only)
+
+
+def test_stage_global_batch_single_process(devices8):
+    mesh = distributed.hybrid_mesh()
+    x = np.arange(16 * 3, dtype=np.float32).reshape(16, 3)
+    arr = distributed.stage_global_batch(x, mesh)
+    assert arr.shape == (16, 3)
+    np.testing.assert_array_equal(np.asarray(arr), x)
+    # the leading axis is sharded over hosts*data
+    assert arr.sharding.spec == distributed.batch_spec(mesh)
+
+
+def test_replicate_across_hosts_single_process(devices8):
+    mesh = distributed.hybrid_mesh()
+    params = {"w": np.ones((4, 2), np.float32), "b": np.zeros(2, np.float32)}
+    rep = distributed.replicate_across_hosts(params, mesh)
+    assert rep["w"].sharding.is_fully_replicated
+    np.testing.assert_array_equal(np.asarray(rep["w"]), params["w"])
+
+
+def test_train_step_on_hosts_by_data_mesh(devices8):
+    """Flagship train step over a 2-D (2 hosts x 4 chips) mesh matches
+    the single-device result — the sharding layout a 2-host pod run
+    would use, minus the DCN wire."""
+    mesh2d = Mesh(
+        np.array(devices8).reshape(2, 4),
+        (distributed.DCN_AXIS, pmesh.DATA_AXIS),
+    )
+    rng = np.random.RandomState(0)
+    epochs = rng.randn(24, 3, 750).astype(np.float32)
+    labels = (rng.rand(24) > 0.5).astype(np.float32)
+
+    init_state, train_step = ptrain.make_train_step()
+    state0 = init_state(jax.random.PRNGKey(0))
+    mask = np.ones(24, np.float32)
+    state_ref, loss_ref = train_step(state0, epochs, labels, mask)
+
+    sharding = NamedSharding(mesh2d, distributed.batch_spec(mesh2d))
+    ep = jax.device_put(epochs, sharding)
+    lb = jax.device_put(labels, sharding)
+    mk = jax.device_put(mask, sharding)
+    state0b = init_state(jax.random.PRNGKey(0))
+    state0b = {
+        "params": jax.device_put(
+            state0b["params"], NamedSharding(mesh2d, P())
+        ),
+        "opt": state0b["opt"],
+    }
+    state_dist, loss_dist = train_step(state0b, ep, lb, mk)
+
+    np.testing.assert_allclose(float(loss_dist), float(loss_ref), atol=1e-6)
+    for k in state_ref["params"]:
+        np.testing.assert_allclose(
+            np.asarray(state_dist["params"][k]),
+            np.asarray(state_ref["params"][k]),
+            atol=1e-5,
+        )
