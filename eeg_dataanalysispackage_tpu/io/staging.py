@@ -1,0 +1,147 @@
+"""Host->device staging: double-buffered prefetch of epoch batches.
+
+The reference materializes its whole dataset as RDDs up front
+(``sc.parallelize(epochs)``, LogisticRegressionClassifier.java:87-88)
+and Spark's laziness hides the staging cost inside each job. The
+TPU-native input pipeline instead overlaps host work (file parsing,
+epoching, padding) with device compute explicitly: a background thread
+pulls host batches from an iterator, stages each onto the device(s)
+with ``jax.device_put`` — an async dispatch, so the copy itself
+overlaps the consumer's current step — and hands them over through a
+small bounded buffer (SURVEY.md section 7 stage 6: "double-buffered
+device_put prefetch").
+
+Typical use::
+
+    batches = staging.minibatches(epochs, targets, batch_size=1024)
+    for ep, lb, mask in staging.prefetch(batches, mesh=mesh):
+        state, loss = train_step(state, ep, lb, mask)
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, Iterator, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..parallel import mesh as pmesh
+
+_END = object()
+
+
+def minibatches(
+    *arrays: np.ndarray,
+    batch_size: int,
+    drop_remainder: bool = False,
+) -> Iterator[Tuple[np.ndarray, ...]]:
+    """Slice aligned host arrays into leading-axis minibatches."""
+    if batch_size <= 0:
+        raise ValueError(f"batch_size must be positive, got {batch_size}")
+    n = arrays[0].shape[0]
+    for a in arrays[1:]:
+        if a.shape[0] != n:
+            raise ValueError(
+                f"misaligned batch arrays: {a.shape[0]} vs {n} rows"
+            )
+    for start in range(0, n, batch_size):
+        if drop_remainder and start + batch_size > n:
+            return
+        yield tuple(a[start : start + batch_size] for a in arrays)
+
+
+def prefetch(
+    batches: Iterable[Sequence[np.ndarray]],
+    mesh=None,
+    buffer_size: int = 2,
+    with_mask: bool = True,
+) -> Iterator[Tuple[jax.Array, ...]]:
+    """Stage host batches onto device(s) ahead of consumption.
+
+    Each yielded element is the input tuple staged with
+    ``jax.device_put`` — committed to the default device when ``mesh``
+    is None, or padded + sharded over the mesh's data axis (with a
+    trailing validity mask appended when ``with_mask``, the
+    ``mesh.shard_batch_with_mask`` convention) otherwise.
+
+    ``buffer_size`` bounds how many staged batches may be in flight;
+    2 = classic double buffering. Exceptions raised by the source
+    iterator or by staging surface at the consumer, not in the thread.
+    """
+    if buffer_size < 1:
+        raise ValueError(f"buffer_size must be >= 1, got {buffer_size}")
+
+    def stage(batch: Sequence[np.ndarray]) -> Tuple[jax.Array, ...]:
+        if mesh is None:
+            return tuple(jax.device_put(np.asarray(a)) for a in batch)
+        if with_mask:
+            return pmesh.shard_batch_with_mask(mesh, *batch)
+        return tuple(
+            pmesh.shard_batch(np.asarray(a), mesh)[0] for a in batch
+        )
+
+    buf: "queue.Queue" = queue.Queue(maxsize=buffer_size)
+    stop = threading.Event()
+
+    def producer() -> None:
+        try:
+            for batch in batches:
+                if stop.is_set():
+                    return
+                staged = stage(batch)
+                # re-check after the (possibly long) staging call, and
+                # poll the put so an abandoned consumer never wedges us
+                while not stop.is_set():
+                    try:
+                        buf.put(staged, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+        except BaseException as e:  # re-raised at the consumer
+            buf.put(e)
+            return
+        buf.put(_END)
+
+    thread = threading.Thread(
+        target=producer, name="eeg-tpu-prefetch", daemon=True
+    )
+    thread.start()
+    try:
+        while True:
+            item = buf.get()
+            if item is _END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        # consumer stopped (exhaustion, error, or early close): tell
+        # the producer to quit at its next check rather than staging
+        # the rest of the source
+        stop.set()
+        thread.join(timeout=5.0)
+
+
+def prefetch_epochs(
+    epochs: np.ndarray,
+    targets: np.ndarray,
+    batch_size: int,
+    mesh=None,
+    buffer_size: int = 2,
+) -> Iterator[Tuple[jax.Array, ...]]:
+    """Convenience: ``minibatches`` + ``prefetch`` over an epoch set,
+    the staged-input form consumed by ``parallel.train.make_train_step``
+    and ``checkpoint.run_resumable``."""
+    return prefetch(
+        minibatches(
+            np.asarray(epochs, np.float32),
+            np.asarray(targets, np.float32),
+            batch_size=batch_size,
+        ),
+        mesh=mesh,
+        buffer_size=buffer_size,
+    )

@@ -76,6 +76,13 @@ def initialize(
     if process_id is None:
         process_id = _env_int("JAX_PROCESS_ID")
     if coordinator_address is None and num_processes is None:
+        if process_id is not None:
+            raise ValueError(
+                "JAX_PROCESS_ID/process_id is set but neither a "
+                "coordinator address nor a process count is configured "
+                "— refusing to run as single-process with a partial "
+                "multi-host setup"
+            )
         return  # single process; nothing to bootstrap
     kwargs = {}
     if coordinator_address is not None:
@@ -139,11 +146,12 @@ def hybrid_mesh(
     return Mesh(devices, (dcn_axis,) + tuple(ici_axes))
 
 
-def batch_spec(mesh: Mesh) -> P:
+def batch_spec(mesh: Mesh, dcn_axis: str = DCN_AXIS) -> P:
     """PartitionSpec sharding the leading batch axis over every
-    data-parallel mesh axis present (DCN outer, ICI inner)."""
+    data-parallel mesh axis present (DCN outer, ICI inner). Pass the
+    same ``dcn_axis`` given to :func:`hybrid_mesh` if overridden."""
     axes = tuple(
-        a for a in (DCN_AXIS, pmesh.DATA_AXIS) if a in mesh.axis_names
+        a for a in (dcn_axis, pmesh.DATA_AXIS) if a in mesh.axis_names
     )
     if not axes:
         raise ValueError(
@@ -153,7 +161,7 @@ def batch_spec(mesh: Mesh) -> P:
 
 
 def stage_global_batch(
-    local_batch: np.ndarray, mesh: Mesh
+    local_batch: np.ndarray, mesh: Mesh, dcn_axis: str = DCN_AXIS
 ) -> jax.Array:
     """Per-process host shard -> one global device array.
 
@@ -163,7 +171,7 @@ def stage_global_batch(
     :func:`batch_spec`. Single-process this is exactly
     ``device_put`` + batch sharding.
     """
-    sharding = NamedSharding(mesh, batch_spec(mesh))
+    sharding = NamedSharding(mesh, batch_spec(mesh, dcn_axis))
     local = np.asarray(local_batch)
     if jax.process_count() == 1:
         return jax.device_put(local, sharding)

@@ -1,0 +1,93 @@
+"""Host->device prefetch staging (io/staging.py)."""
+
+import jax
+import numpy as np
+import pytest
+
+from eeg_dataanalysispackage_tpu.io import staging
+from eeg_dataanalysispackage_tpu.parallel import mesh as pmesh, train as ptrain
+
+
+def test_minibatches_shapes_and_remainder():
+    x = np.arange(10).reshape(10, 1)
+    y = np.arange(10)
+    got = list(staging.minibatches(x, y, batch_size=4))
+    assert [b[0].shape[0] for b in got] == [4, 4, 2]
+    np.testing.assert_array_equal(np.concatenate([b[1] for b in got]), y)
+    dropped = list(staging.minibatches(x, y, batch_size=4, drop_remainder=True))
+    assert [b[0].shape[0] for b in dropped] == [4, 4]
+
+
+def test_minibatches_rejects_misaligned():
+    with pytest.raises(ValueError, match="misaligned"):
+        list(staging.minibatches(np.ones(4), np.ones(5), batch_size=2))
+
+
+def test_prefetch_matches_direct_staging():
+    rng = np.random.RandomState(0)
+    x = rng.randn(9, 3).astype(np.float32)
+    y = rng.randn(9).astype(np.float32)
+    got = list(
+        staging.prefetch(staging.minibatches(x, y, batch_size=4))
+    )
+    assert len(got) == 3
+    for (gx, gy), start in zip(got, range(0, 9, 4)):
+        assert isinstance(gx, jax.Array)
+        np.testing.assert_array_equal(np.asarray(gx), x[start : start + 4])
+        np.testing.assert_array_equal(np.asarray(gy), y[start : start + 4])
+
+
+def test_prefetch_propagates_source_errors():
+    def bad():
+        yield (np.ones(2),)
+        raise RuntimeError("boom in loader")
+
+    it = staging.prefetch(bad())
+    next(it)
+    with pytest.raises(RuntimeError, match="boom in loader"):
+        next(it)
+
+
+def test_prefetch_early_stop_does_not_hang():
+    it = staging.prefetch(
+        staging.minibatches(np.ones((100, 2)), batch_size=1), buffer_size=2
+    )
+    next(it)
+    it.close()  # consumer abandons; producer must unblock
+
+
+def test_prefetch_early_stop_cancels_producer():
+    pulled = []
+
+    def source():
+        for i in range(10_000):
+            pulled.append(i)
+            yield (np.full(2, i, np.float32),)
+
+    it = staging.prefetch(source(), buffer_size=2)
+    next(it)
+    it.close()
+    # the producer must stop near where the consumer left off, not
+    # stage the remaining ~10k batches during close()
+    assert len(pulled) <= 8
+
+
+def test_prefetch_sharded_feeds_train_step():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    mesh = pmesh.make_mesh(8)
+    rng = np.random.RandomState(0)
+    epochs = rng.randn(21, 3, 750).astype(np.float32)  # not /8: pad+mask
+    targets = (rng.rand(21) > 0.5).astype(np.float32)
+
+    init_state, train_step = ptrain.make_train_step(mesh)
+    state = init_state(jax.random.PRNGKey(0))
+    seen = 0
+    for ep, lb, mask in staging.prefetch_epochs(
+        epochs, targets, batch_size=8, mesh=mesh
+    ):
+        assert ep.shape[0] % 8 == 0
+        state, loss = train_step(state, ep, lb, mask)
+        seen += int(np.asarray(mask).sum())
+    assert seen == 21
+    assert np.isfinite(float(loss))
