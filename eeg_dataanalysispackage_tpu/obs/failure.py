@@ -1,0 +1,236 @@
+"""Failure detection + elastic recovery for training runs.
+
+The reference has no failure handling beyond "log and continue":
+``loadData`` swallows every exception (OffLineDataProvider.java:95-97),
+unloadable files are skipped (:157-161), ``Main`` prints stack traces
+(Main.java:46-50), and a crashed training run restarts from scratch
+(SURVEY.md section 5 "Failure detection / elastic recovery: None").
+This module is the TPU-native upgrade, layered on the atomic
+checkpoint store (``checkpoint.manager``):
+
+- :func:`probe_devices` — active health check: a tiny jitted program
+  is dispatched to every device and fetched; devices that error or
+  exceed a deadline are reported failed (the liveness signal Spark got
+  from executor heartbeats);
+- :class:`DivergenceSentinel` — numeric failure detector over the loss
+  stream: non-finite values or a sustained explosion relative to a
+  rolling window raise :class:`TrainingDiverged` at the step that went
+  bad rather than poisoning every parameter silently;
+- :func:`elastic_train` — a bounded-restart driver around
+  ``checkpoint.run_resumable``: on a transient failure it restores the
+  latest checkpoint, re-probes device health, and replays only the
+  un-checkpointed steps — the recovery story the reference lacks.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections import deque
+from typing import Any, Callable, Iterable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+class TrainingDiverged(RuntimeError):
+    """Raised by :class:`DivergenceSentinel` when the loss stream goes
+    non-finite or explodes."""
+
+
+class DeviceProbeResult:
+    def __init__(self, healthy: List, failed: List[Tuple[Any, str]],
+                 latencies_s: List[float]):
+        self.healthy = healthy
+        self.failed = failed
+        self.latencies_s = latencies_s
+
+    @property
+    def all_healthy(self) -> bool:
+        return not self.failed
+
+    def __repr__(self) -> str:
+        return (
+            f"DeviceProbeResult(healthy={len(self.healthy)}, "
+            f"failed={[(str(d), e) for d, e in self.failed]})"
+        )
+
+
+def _probe_one(dev) -> float:
+    x = jax.device_put(jnp.arange(8, dtype=jnp.float32), dev)
+    got = float(jnp.sum(x * 2.0).block_until_ready())
+    if got != 56.0:
+        raise RuntimeError(f"bad arithmetic: {got!r}")
+    return got
+
+
+def probe_devices(devices=None, deadline_s: float = 30.0) -> DeviceProbeResult:
+    """Dispatch a trivial computation to every device and fetch it.
+
+    A device is failed if the dispatch/fetch raises or does not finish
+    within ``deadline_s`` (the blocking fetch runs on a worker thread
+    so a wedged device cannot hang the probe itself), or if it returns
+    the wrong answer (memory corruption surfaces as bad arithmetic
+    long before a crash).
+    """
+    import concurrent.futures
+
+    devices = list(devices if devices is not None else jax.devices())
+    healthy, failed, latencies = [], [], []
+    # one thread per device: a wedged fetch strands its thread, never
+    # the probe — so no `with` block, whose exit would join the
+    # stranded thread and hang anyway
+    pool = concurrent.futures.ThreadPoolExecutor(
+        max_workers=max(1, len(devices)), thread_name_prefix="eeg-tpu-probe"
+    )
+    try:
+        futures = {dev: pool.submit(_probe_one, dev) for dev in devices}
+        start = time.perf_counter()
+        for dev, fut in futures.items():
+            remaining = deadline_s - (time.perf_counter() - start)
+            try:
+                fut.result(timeout=max(0.0, remaining))
+                latencies.append(time.perf_counter() - start)
+                healthy.append(dev)
+            except concurrent.futures.TimeoutError:
+                fut.cancel()
+                failed.append((dev, f"no response within {deadline_s:.0f}s"))
+            except Exception as e:  # device loss surfaces as runtime errors
+                failed.append((dev, f"{type(e).__name__}: {e}"))
+    finally:
+        pool.shutdown(wait=False, cancel_futures=True)
+    if failed:
+        logger.warning("device probe failures: %s", failed)
+    return DeviceProbeResult(healthy, failed, latencies)
+
+
+class DivergenceSentinel:
+    """Loss-stream failure detector.
+
+    ``check(step, loss)`` raises :class:`TrainingDiverged` when the
+    loss is non-finite, or when it exceeds ``explode_factor`` times the
+    rolling median of the last ``window`` finite losses for
+    ``patience`` consecutive steps (a single spiky minibatch is not a
+    failure; a sustained explosion is).
+    """
+
+    def __init__(
+        self,
+        window: int = 20,
+        explode_factor: float = 1e3,
+        patience: int = 3,
+    ):
+        if window < 1 or patience < 1:
+            raise ValueError("window and patience must be >= 1")
+        self.window = window
+        self.explode_factor = explode_factor
+        self.patience = patience
+        self._history: deque = deque(maxlen=window)
+        self._strikes = 0
+
+    def reset(self) -> None:
+        """Forget history — called when a run restarts from a
+        checkpoint, so replayed steps are not double-counted."""
+        self._history.clear()
+        self._strikes = 0
+
+    def check(self, step: int, loss) -> None:
+        value = float(loss)
+        if not np.isfinite(value):
+            raise TrainingDiverged(
+                f"non-finite loss {value!r} at step {step}"
+            )
+        if len(self._history) == self.window:
+            ref = float(np.median(self._history))
+            if ref > 0 and value > self.explode_factor * ref:
+                self._strikes += 1
+                if self._strikes >= self.patience:
+                    raise TrainingDiverged(
+                        f"loss exploded at step {step}: {value:.3e} > "
+                        f"{self.explode_factor:.0e} × rolling median "
+                        f"{ref:.3e} for {self._strikes} steps"
+                    )
+            else:
+                self._strikes = 0
+        self._history.append(value)
+
+
+def elastic_train(
+    manager,
+    init_state: Callable[[], Any],
+    train_step: Callable,
+    make_batches: Callable[[], Iterable],
+    max_restarts: int = 3,
+    save_every: int = 10,
+    sentinel: Optional[DivergenceSentinel] = None,
+    on_step: Optional[Callable[[int, Any], None]] = None,
+    probe_on_failure: bool = True,
+):
+    """Run to completion across transient failures.
+
+    Each incarnation drives ``checkpoint.run_resumable`` (which skips
+    steps already checkpointed under ``manager``). When ``train_step``
+    (or the batch source) raises, the failure is logged, device health
+    is re-probed, and the run restarts from the latest checkpoint — at
+    most ``max_restarts`` times, so a deterministic fault (e.g. a
+    divergence that replays identically) eventually surfaces instead of
+    looping forever. ``make_batches`` must return a fresh pass over the
+    same batch sequence on every call.
+
+    Returns (state, last_step, restarts_used).
+    """
+    from ..checkpoint.manager import run_resumable
+
+    def stepper(step: int, loss) -> None:
+        if sentinel is not None:
+            sentinel.check(step, loss)
+        if on_step is not None:
+            on_step(step, loss)
+
+    restarts = 0
+    while True:
+        try:
+            state, last = run_resumable(
+                manager,
+                init_state,
+                train_step,
+                make_batches(),
+                save_every=save_every,
+                on_step=stepper,
+            )
+            return state, last, restarts
+        except TrainingDiverged:
+            # deterministic under the replay contract (same batches,
+            # same restored state -> same divergence): restarting would
+            # replay to the identical failure, so surface it at once
+            raise
+        except Exception as e:
+            restarts += 1
+            logger.error(
+                "training incarnation failed (%s: %s); restart %d/%d "
+                "from step %s",
+                type(e).__name__,
+                e,
+                restarts,
+                max_restarts,
+                manager.latest_step() or 0,
+            )
+            if restarts > max_restarts:
+                raise
+            if probe_on_failure:
+                probe = probe_devices()
+                if not probe.all_healthy:
+                    # dead hardware won't heal by replaying onto it:
+                    # fail fast with the probe evidence so the
+                    # scheduler/operator reconfigures the device set
+                    raise RuntimeError(
+                        f"device(s) unhealthy after training failure, "
+                        f"not restarting: {probe!r}"
+                    ) from e
+            if sentinel is not None:
+                # replayed steps must not double-count in the rolling
+                # window / strike counter
+                sentinel.reset()
