@@ -9,16 +9,19 @@ contracted against the cascade matrix on the MXU at HIGHEST precision,
 and row-normalized on the VPU before the single ``(TILE_B, C*K)``
 result leaves for HBM.
 
-Measured on v5e-1 (131072-epoch batches of 3x1000 f32): ~11.0M
-epochs/s vs ~29.3M epochs/s for the XLA einsum formulation
-(``ops.dwt.epoch_features``), both bit-comparable (max diff 1.8e-7).
-The einsum path stays the default — XLA already fuses this pattern to
-the HBM roofline — and the Pallas kernel is the explicit-fusion
-counterpart for shapes/stages XLA cannot fuse (e.g. appending
-quantization, scatter, or streaming halo logic to the feature stage)
-and the template for long-signal kernels. VMEM budget: the epoch tile
-is the dominant term (TILE_B*C*T*4 bytes x2 for double buffering;
-TILE_B=256 at 3x1000 is ~6 MB of the ~16 MB/core).
+Measured on v5e-1 (131072-epoch batches of 3x1000 f32): ~9.8M
+epochs/s at tile_b=128 vs ~23-37M epochs/s for the XLA einsum
+formulation (``ops.dwt.epoch_features``), both bit-comparable (max
+diff 1.8e-7). The einsum path stays the default — XLA already fuses
+this pattern to the HBM roofline — and the Pallas kernel is the
+explicit-fusion counterpart for shapes/stages XLA cannot fuse (e.g.
+appending quantization, scatter, or streaming halo logic to the
+feature stage) and the template for long-signal kernels. VMEM budget:
+the epoch tile is the dominant term (TILE_B*C*T*4 bytes x2 for double
+buffering; TILE_B=128 at 3x1000 is ~3 MB of the ~16 MB/core budget —
+tile_b=256 measurably overflows scoped VMEM once an upstream
+elementwise producer is fused into the kernel's input DMA, so 128 is
+the default).
 
 Replaces: the reference's per-epoch eegdsp ``processSignal`` Spark map
 (WaveletTransform.java:108-141, LogisticRegressionClassifier.java:55-61).
@@ -65,7 +68,7 @@ def epoch_features_pallas(
     skip_samples: int = 175,
     epoch_size: int = 512,
     feature_size: int = 16,
-    tile_b: int = 256,
+    tile_b: int = 128,
     interpret: bool | None = None,
 ) -> jnp.ndarray:
     """Traceable (B, C, T) epochs -> (B, C*K) normalized features.
@@ -115,7 +118,7 @@ def make_batched_extractor_pallas(
     epoch_size: int = 512,
     skip_samples: int = 175,
     feature_size: int = 16,
-    tile_b: int = 256,
+    tile_b: int = 128,
     interpret: bool | None = None,
 ):
     """Jitted ``(B, C, T) -> (B, C*feature_size)`` Pallas extractor
