@@ -37,6 +37,11 @@ def _register_builtins() -> None:
 
     register("dt", trees.DecisionTreeClassifier)
     register("rf", trees.RandomForestClassifier)
+    # -tpu variants grow the whole forest in one XLA program
+    # (models/trees_device.py), mirroring the fe= dwt-8/dwt-8-tpu
+    # naming convention
+    register("dt-tpu", lambda: trees.DecisionTreeClassifier(backend="device"))
+    register("rf-tpu", lambda: trees.RandomForestClassifier(backend="device"))
     # restored from the reference's commented-out test surface
     # (ClassifierTest.java:213) — MLlib GradientBoostedTrees analogue
     register("gbt", trees.GradientBoostedTreesClassifier)

@@ -199,8 +199,16 @@ class DecisionTreeClassifier(base.Classifier):
         "config_min_instances_per_node",
     )
 
-    def __init__(self) -> None:
+    def __init__(self, backend: str = "host") -> None:
+        """``backend='host'`` is the numpy reference grower;
+        ``'device'`` grows the whole forest in one XLA program
+        (``models/trees_device.py``; also selectable per run via the
+        ``config_backend`` extension key). Both produce the same tree
+        array format, so prediction and persistence are shared."""
         super().__init__()
+        if backend not in ("host", "device"):
+            raise ValueError(f"unknown tree backend: {backend!r}")
+        self.backend = backend
         self.trees: List[Dict[str, np.ndarray]] = []
         self.edges: Optional[np.ndarray] = None
         self._params: Dict = {}
@@ -229,6 +237,12 @@ class DecisionTreeClassifier(base.Classifier):
         y = np.floor(np.asarray(labels, dtype=np.float64) + 0.5).astype(np.int64)
         self.edges = compute_bin_edges(features, p["max_bins"])
         binned = bin_features(features, self.edges)
+        backend = self.config.get("config_backend", self.backend)
+        if backend not in ("host", "device"):
+            raise ValueError(f"unknown tree backend: {backend!r}")
+        if backend == "device":
+            self._fit_device(binned, y, p)
+            return
         rng = np.random.RandomState(12345)  # RandomForestClassifier.java:104
         n = len(y)
         self.trees = []
@@ -248,6 +262,42 @@ class DecisionTreeClassifier(base.Classifier):
                 rng,
             )
             self.trees.append(tree.to_arrays())
+
+    def _fit_device(self, binned: np.ndarray, y: np.ndarray, p: Dict) -> None:
+        """Grow the whole forest in one XLA program (vmap over trees).
+
+        Bootstrap draws and per-heap-slot feature masks are set up
+        host-side with the reference's fixed seed 12345; the growth
+        itself — one batched histogram scatter + gain argmax per tree
+        level — runs on device (models/trees_device.py)."""
+        import jax.numpy as jnp
+
+        from . import trees_device
+
+        n, d = binned.shape
+        T = self._n_trees()
+        rng = np.random.RandomState(12345)
+        if T > 1:
+            boot = rng.randint(0, n, size=(T, n))
+        else:
+            boot = np.arange(n)[None, :]
+        masks = trees_device.draw_feature_masks(
+            T,
+            trees_device.n_heap_nodes(p["max_depth"] - 1),  # internal nodes
+            d,
+            self._feature_subset(d),
+        )
+        forest = trees_device.grow_forest(
+            jnp.asarray(binned, jnp.int32),
+            jnp.asarray(y, jnp.int32),
+            jnp.asarray(boot, jnp.int32),
+            jnp.asarray(masks),
+            max_bins=p["max_bins"],
+            impurity=p["impurity"],
+            max_depth=p["max_depth"],
+            min_instances=p["min_instances"],
+        )
+        self.trees = trees_device.heap_to_host_arrays(forest)
 
     def predict(self, features: np.ndarray) -> np.ndarray:
         if not self.trees or self.edges is None:

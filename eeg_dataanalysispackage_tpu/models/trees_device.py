@@ -1,0 +1,305 @@
+"""On-device (XLA) histogram tree growth: the whole forest at once.
+
+The host path (``models/trees.py``) grows trees level-by-level with
+numpy bincounts — already MLlib's aggregation shape
+(per-(node, feature, bin, class) histograms, SURVEY.md section 2.2
+"Spark MLlib -> histogram-based DT/RF built from batched jnp
+reductions"). This module is the same algorithm as one jitted XLA
+program:
+
+- nodes live in a **heap layout** (node ``i`` -> children ``2i+1``,
+  ``2i+2``), so a tree of depth D is a set of fixed-shape arrays of
+  length ``2^(D+1)-1`` — no dynamic allocation, no Python recursion;
+- each level is ONE batched scatter-add building every node's
+  (feature, bin, class) histogram simultaneously, followed by a
+  vectorized gain argmax — compiler-friendly control flow only;
+- the forest dimension is ``vmap``: all of a random forest's trees
+  (each with its own bootstrap sample and per-node feature masks) grow
+  in the same XLA program, histograms batched as (T, nodes, d, bins,
+  classes). MLlib ships tree-at-a-time jobs; here tree-parallelism is
+  a batch axis.
+
+Split semantics match the host grower exactly (same gain formula, same
+validity rules, same first-max tie-break over the same (feature, bin)
+layout); the only intended divergence is RNG plumbing: host RF draws
+feature subsets lazily per *splittable* node, the device path pre-draws
+a mask per heap slot (``draw_feature_masks``), so host and device
+forests are each deterministic but not bit-identical to each other.
+Single trees with no feature subsetting agree exactly (pinned by
+tests/test_trees_device.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_EPS = 1e-12
+
+
+def n_heap_nodes(max_depth: int) -> int:
+    return 2 ** (max_depth + 1) - 1
+
+
+def _impurity(counts: jnp.ndarray, kind: str) -> jnp.ndarray:
+    """counts (..., 2) -> impurity (...). f32 throughout."""
+    total = counts.sum(axis=-1, keepdims=True)
+    p = counts / jnp.maximum(total, _EPS)
+    if kind == "entropy":
+        return -(p * jnp.log2(jnp.maximum(p, _EPS))).sum(axis=-1)
+    return 1.0 - (p * p).sum(axis=-1)
+
+
+#: deepest tree the device backend accepts: heap storage is 2^(D+1)-1
+#: slots, so beyond this the dense layout loses to the host grower's
+#: active-frontier representation (MLlib allows maxDepth up to 30).
+MAX_DEVICE_DEPTH = 12
+
+
+def draw_feature_masks(
+    n_trees: int,
+    n_nodes: int,
+    d: int,
+    subset: Optional[int],
+    seed: int = 12345,
+) -> np.ndarray:
+    """(T, n_nodes, d) bool — per-heap-slot feature availability.
+
+    ``n_nodes`` only needs to cover *internal* slots
+    (``n_heap_nodes(max_depth - 1)``): the deepest level never splits.
+    ``subset=None`` (or >= d) means all features everywhere. The draw
+    is host-side numpy (seeded like the reference's fixed RF seed,
+    RandomForestClassifier.java:104) because it is setup, not compute;
+    a vectorized argsort draw keeps it O(T·n_nodes·d log d) with no
+    Python-level per-node loop.
+    """
+    if subset is None or subset >= d:
+        return np.ones((n_trees, n_nodes, d), dtype=bool)
+    rng = np.random.RandomState(seed)
+    order = rng.rand(n_trees, n_nodes, d).argsort(axis=-1)
+    return order < subset
+
+
+def _grow_one(
+    binned: jnp.ndarray,  # (n, d) int32 in [0, max_bins)
+    labels: jnp.ndarray,  # (n,) int32 in {0, 1}
+    feature_mask: jnp.ndarray,  # (internal nodes, d) bool
+    *,
+    max_bins: int,
+    impurity: str,
+    max_depth: int,
+    min_instances: int,
+) -> Dict[str, jnp.ndarray]:
+    """Single-tree growth; vmapped over the forest axis by the caller.
+
+    Returns heap arrays: feature (n_nodes,) int32 (-1 = leaf),
+    threshold_bin (n_nodes,) int32, prediction (n_nodes,) f32.
+    """
+    n, d = binned.shape
+    B = max_bins
+    n_nodes = n_heap_nodes(max_depth)
+
+    feature = jnp.full((n_nodes,), -1, jnp.int32)
+    thresh = jnp.full((n_nodes,), -1, jnp.int32)
+    pred = jnp.zeros((n_nodes,), jnp.float32)
+    assign = jnp.zeros((n,), jnp.int32)  # every sample starts at the root
+
+    y = labels.astype(jnp.int32)
+
+    # (n, d*B) one-hot of every sample's bin per feature, built once
+    # and contracted on the MXU at every level — TPU scatters are
+    # sort-based and an order of magnitude slower than this matmul
+    # formulation (counts are exact in f32 below 2^24 samples/node)
+    oh_bins = (
+        (binned[:, :, None] == jnp.arange(B, dtype=jnp.int32)[None, None, :])
+        .astype(jnp.float32)
+        .reshape(n, d * B)
+    )
+
+    for level in range(max_depth + 1):
+        offset = 2**level - 1
+        L = 2**level
+        local = assign - offset
+        live = (local >= 0) & (local < L)  # at this level & not a leaf
+
+        # (n, L*2) one-hot of (node, class); dead samples map to the
+        # out-of-range index -1 -> all-zeros row
+        oh_node = jax.nn.one_hot(
+            jnp.where(live, local * 2 + y, -1), L * 2, dtype=jnp.float32
+        )
+        # every node's (f, bin, class) histogram in one contraction
+        hist = jax.lax.dot_general(
+            oh_node,
+            oh_bins,
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (L*2, d*B)
+        hist = hist.reshape(L, 2, d, B).transpose(0, 2, 3, 1)
+
+        total = hist.sum(axis=2)  # (L, d, 2) — identical per feature
+        node_counts = total[:, 0, :]  # (L, 2)
+        m = node_counts.sum(-1)  # (L,)
+        pos = node_counts[:, 1]
+        node_pred = jnp.where(pos * 2 > m, 1.0, 0.0)
+        pred = jax.lax.dynamic_update_slice(pred, node_pred, (offset,))
+
+        if level == max_depth:
+            break  # deepest level: predictions only, no further splits
+
+        cum = jnp.cumsum(hist, axis=2)  # (L, d, B, 2)
+        left = cum[:, :, :-1, :]  # split "bin <= b", b in [0, B-2]
+        right = cum[:, :, -1:, :] - left
+        nl = left.sum(-1)
+        nr = right.sum(-1)
+        valid = (nl >= min_instances) & (nr >= min_instances)
+        valid &= feature_mask[offset : offset + L][:, :, None]
+        parent_imp = _impurity(node_counts, impurity)  # (L,)
+        child = (
+            nl * _impurity(left, impurity) + nr * _impurity(right, impurity)
+        ) / jnp.maximum(m, _EPS)[:, None, None]
+        gain = jnp.where(valid, parent_imp[:, None, None] - child, -jnp.inf)
+
+        flat_gain = gain.reshape(L, d * (B - 1))
+        best = jnp.argmax(flat_gain, axis=1).astype(jnp.int32)  # first max
+        best_gain = jnp.take_along_axis(flat_gain, best[:, None], axis=1)[:, 0]
+        bf = best // (B - 1)
+        bb = best % (B - 1)
+
+        splittable = (
+            (m >= 2 * min_instances)
+            & (pos > 0)
+            & (pos < m)
+            & jnp.isfinite(best_gain)
+            & (best_gain > 0)
+        )
+        feature = jax.lax.dynamic_update_slice(
+            feature, jnp.where(splittable, bf, -1), (offset,)
+        )
+        thresh = jax.lax.dynamic_update_slice(
+            thresh, jnp.where(splittable, bb, -1), (offset,)
+        )
+
+        # route live samples at split nodes to their heap children
+        node_split = jnp.where(
+            live, jnp.take(splittable, jnp.clip(local, 0, L - 1)), False
+        )
+        feat_of_sample = jnp.take(bf, jnp.clip(local, 0, L - 1))
+        thr_of_sample = jnp.take(bb, jnp.clip(local, 0, L - 1))
+        sample_bin = jnp.take_along_axis(
+            binned, feat_of_sample[:, None].astype(jnp.int32), axis=1
+        )[:, 0]
+        go_right = (sample_bin > thr_of_sample).astype(jnp.int32)
+        assign = jnp.where(node_split, 2 * assign + 1 + go_right, assign)
+
+    return {"feature": feature, "threshold_bin": thresh, "prediction": pred}
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "max_bins",
+        "impurity",
+        "max_depth",
+        "min_instances",
+        "tree_chunk",
+    ),
+)
+def grow_forest(
+    binned: jnp.ndarray,  # (n, d) int32 — the base (un-bootstrapped) data
+    labels: jnp.ndarray,  # (n,) int32
+    bootstrap: jnp.ndarray,  # (T, n) int32 sample indices per tree
+    feature_masks: jnp.ndarray,  # (T, internal nodes, d) bool
+    *,
+    max_bins: int,
+    impurity: str,
+    max_depth: int,
+    min_instances: int,
+    tree_chunk: int = 8,
+) -> Dict[str, jnp.ndarray]:
+    """Grow T trees simultaneously.
+
+    Trees are vmapped in chunks of ``tree_chunk`` (``lax.map`` over
+    chunks). The dataset is stored once; each chunk gathers its own
+    bootstrap view, so peak memory is the chunk's (n, d*max_bins) bin
+    one-hots — ``tree_chunk * n * d * max_bins * 4`` bytes — never a
+    dense (T, n, d) replica of the training set."""
+    if max_depth > MAX_DEVICE_DEPTH:
+        raise ValueError(
+            f"device tree backend supports max_depth <= {MAX_DEVICE_DEPTH} "
+            f"(heap storage is 2^(depth+1)-1 slots); got {max_depth} — "
+            "use backend='host' for deeper trees"
+        )
+
+    def grow(args):
+        boot, fm = args
+        return _grow_one(
+            jnp.take(binned, boot, axis=0),
+            jnp.take(labels, boot),
+            fm,
+            max_bins=max_bins,
+            impurity=impurity,
+            max_depth=max_depth,
+            min_instances=min_instances,
+        )
+
+    return jax.lax.map(
+        grow,
+        (bootstrap, feature_masks),
+        batch_size=min(tree_chunk, bootstrap.shape[0]),
+    )
+
+
+@partial(jax.jit, static_argnames=("max_depth",))
+def predict_forest(
+    forest: Dict[str, jnp.ndarray],
+    binned: jnp.ndarray,  # (n, d) int32
+    max_depth: int,
+) -> jnp.ndarray:
+    """(T trees, n samples) heap walk -> (n,) mean vote in [0, 1]."""
+
+    def one_tree(feature, thresh, pred):
+        node = jnp.zeros((binned.shape[0],), jnp.int32)
+        for _ in range(max_depth):
+            f = jnp.take(feature, node)
+            is_leaf = f < 0
+            sample_bin = jnp.take_along_axis(
+                binned, jnp.maximum(f, 0)[:, None].astype(jnp.int32), axis=1
+            )[:, 0]
+            go_right = (sample_bin > jnp.take(thresh, node)).astype(jnp.int32)
+            node = jnp.where(is_leaf, node, 2 * node + 1 + go_right)
+        return jnp.take(pred, node)
+
+    votes = jax.vmap(one_tree)(
+        forest["feature"], forest["threshold_bin"], forest["prediction"]
+    )
+    return votes.mean(axis=0)
+
+
+def heap_to_host_arrays(forest: Dict[str, jnp.ndarray]) -> list:
+    """Device heap forest -> the host path's per-tree array dicts
+    (explicit left/right links), so persistence and the host
+    ``_predict_tree`` work unchanged on device-grown trees."""
+    out = []
+    feature = np.asarray(forest["feature"])
+    thresh = np.asarray(forest["threshold_bin"])
+    pred = np.asarray(forest["prediction"], dtype=np.float64)
+    n_nodes = feature.shape[1]
+    for t in range(feature.shape[0]):
+        split = feature[t] >= 0
+        idx = np.arange(n_nodes)
+        left = np.where(split, 2 * idx + 1, -1).astype(np.int32)
+        right = np.where(split, 2 * idx + 2, -1).astype(np.int32)
+        out.append(
+            {
+                "feature": feature[t],
+                "threshold_bin": thresh[t],
+                "left": left,
+                "right": right,
+                "prediction": pred[t],
+            }
+        )
+    return out

@@ -1,0 +1,185 @@
+"""Device (XLA) forest growth vs the host reference grower."""
+
+import numpy as np
+import pytest
+
+from eeg_dataanalysispackage_tpu.models import registry, trees, trees_device
+
+
+def _toy(n=400, d=6, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, d)
+    y = ((x[:, 0] + 0.5 * x[:, 2] - 0.25 * x[:, 4]) > 0).astype(np.float64)
+    flip = rng.rand(n) < 0.05
+    y[flip] = 1 - y[flip]
+    return x, y
+
+
+def test_single_tree_matches_host_exactly():
+    """No feature subsetting: device and host growers must pick the
+    same splits and predict identically on train and held-out data."""
+    x, y = _toy()
+    xt, yt = _toy(seed=1)
+
+    host = trees.DecisionTreeClassifier(backend="host")
+    host.set_config(
+        {
+            "config_max_bins": "16",
+            "config_impurity": "gini",
+            "config_max_depth": "4",
+            "config_min_instances_per_node": "2",
+        }
+    )
+    host.fit(x, y)
+
+    dev = trees.DecisionTreeClassifier(backend="device")
+    dev.set_config(host.config)
+    dev.fit(x, y)
+
+    np.testing.assert_array_equal(dev.predict(x), host.predict(x))
+    np.testing.assert_array_equal(dev.predict(xt), host.predict(xt))
+    # root split agreement pins the gain computation, not just outputs
+    assert dev.trees[0]["feature"][0] == host.trees[0]["feature"][0]
+    assert dev.trees[0]["threshold_bin"][0] == host.trees[0]["threshold_bin"][0]
+
+
+def test_single_tree_matches_host_entropy_defaults():
+    x, y = _toy(seed=2)
+    host = trees.DecisionTreeClassifier(backend="host")
+    host.set_config(
+        {
+            "config_max_bins": "8",
+            "config_impurity": "entropy",
+            "config_max_depth": "3",
+            "config_min_instances_per_node": "1",
+        }
+    )
+    host.fit(x, y)
+    dev = trees.DecisionTreeClassifier(backend="device")
+    dev.set_config(host.config)
+    dev.fit(x, y)
+    np.testing.assert_array_equal(dev.predict(x), host.predict(x))
+
+
+def test_device_forest_accuracy_and_determinism():
+    x, y = _toy(n=600)
+    xt, yt = _toy(n=300, seed=3)
+    cfg = {
+        "config_max_bins": "16",
+        "config_impurity": "gini",
+        "config_max_depth": "5",
+        "config_min_instances_per_node": "1",
+        "config_num_trees": "20",
+        "config_feature_subset": "sqrt",
+    }
+    a = trees.RandomForestClassifier(backend="device")
+    a.set_config(cfg)
+    a.fit(x, y)
+    acc = (a.predict(xt) == yt).mean()
+    assert acc > 0.85
+
+    b = trees.RandomForestClassifier(backend="device")
+    b.set_config(cfg)
+    b.fit(x, y)
+    np.testing.assert_array_equal(a.predict(xt), b.predict(xt))
+
+
+def test_predict_forest_device_matches_host_walk():
+    x, y = _toy()
+    import jax.numpy as jnp
+
+    edges = trees.compute_bin_edges(x, 16)
+    binned = trees.bin_features(x, edges)
+    masks = trees_device.draw_feature_masks(3, trees_device.n_heap_nodes(3), 6, 3)
+    rng = np.random.RandomState(12345)
+    boot = rng.randint(0, len(y), size=(3, len(y)))
+    forest = trees_device.grow_forest(
+        jnp.asarray(binned, jnp.int32),
+        jnp.asarray(y.astype(np.int64), jnp.int32),
+        jnp.asarray(boot, jnp.int32),
+        jnp.asarray(masks),
+        max_bins=16,
+        impurity="gini",
+        max_depth=4,
+        min_instances=1,
+    )
+    dev_votes = np.asarray(
+        trees_device.predict_forest(forest, jnp.asarray(binned, jnp.int32), 4)
+    )
+    host_arrays = trees_device.heap_to_host_arrays(forest)
+    host_votes = np.stack(
+        [trees._predict_tree(t, binned) for t in host_arrays]
+    ).mean(axis=0)
+    np.testing.assert_allclose(dev_votes, host_votes, atol=1e-6)
+
+
+def test_device_backend_save_load_roundtrip(tmp_path):
+    x, y = _toy()
+    clf = trees.RandomForestClassifier(backend="device")
+    clf.set_config(
+        {
+            "config_max_bins": "8",
+            "config_impurity": "gini",
+            "config_max_depth": "3",
+            "config_min_instances_per_node": "1",
+            "config_num_trees": "5",
+            "config_feature_subset": "sqrt",
+        }
+    )
+    clf.fit(x, y)
+    path = str(tmp_path / "forest")
+    clf.save(path)
+    clf2 = trees.RandomForestClassifier()
+    clf2.load(path)
+    np.testing.assert_array_equal(clf2.predict(x), clf.predict(x))
+
+
+def test_device_backend_rejects_deep_trees():
+    x, y = _toy(n=100)
+    clf = trees.DecisionTreeClassifier(backend="device")
+    clf.set_config(
+        {
+            "config_max_bins": "8",
+            "config_impurity": "gini",
+            "config_max_depth": str(trees_device.MAX_DEVICE_DEPTH + 1),
+            "config_min_instances_per_node": "1",
+        }
+    )
+    with pytest.raises(ValueError, match="backend='host'"):
+        clf.fit(x, y)
+
+
+def test_unknown_config_backend_rejected():
+    x, y = _toy(n=100)
+    clf = trees.DecisionTreeClassifier()
+    clf.set_config({"config_backend": "tpu"})
+    with pytest.raises(ValueError, match="unknown tree backend"):
+        clf.fit(x, y)
+    with pytest.raises(ValueError, match="unknown tree backend"):
+        trees.DecisionTreeClassifier(backend="Device")
+
+
+def test_registry_tpu_variants():
+    assert isinstance(registry.create("dt-tpu"), trees.DecisionTreeClassifier)
+    rf = registry.create("rf-tpu")
+    assert isinstance(rf, trees.RandomForestClassifier)
+    assert rf.backend == "device"
+
+
+def test_config_backend_key_selects_device():
+    x, y = _toy(n=200)
+    clf = trees.DecisionTreeClassifier()  # host default
+    clf.set_config(
+        {
+            "config_max_bins": "8",
+            "config_impurity": "gini",
+            "config_max_depth": "3",
+            "config_min_instances_per_node": "1",
+            "config_backend": "device",
+        }
+    )
+    clf.fit(x, y)
+    # heap layout is the device grower's signature: left child of a
+    # split root is node 1
+    if clf.trees[0]["feature"][0] >= 0:
+        assert clf.trees[0]["left"][0] == 1

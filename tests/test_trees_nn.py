@@ -160,5 +160,8 @@ def test_nn_dropout_path():
 
 def test_all_classifier_families_registered():
     # the reference's five (PipelineBuilder.java:156-169) plus the
-    # restored gbt (ClassifierTest.java:213)
-    assert registry.names() == ["dt", "gbt", "logreg", "nn", "rf", "svm"]
+    # restored gbt (ClassifierTest.java:213) and the device-forest
+    # -tpu variants
+    assert registry.names() == [
+        "dt", "dt-tpu", "gbt", "logreg", "nn", "rf", "rf-tpu", "svm",
+    ]
