@@ -119,6 +119,22 @@ def _unescape_name(name: str) -> str:
 
 
 def parse_vhdr(text: str) -> Header:
+    """Parse a .vhdr header (C++ parser when built, Python otherwise).
+
+    The native parser (native/eeg_host.cc::eeg_parse_vhdr) is kept in
+    semantic lockstep with :func:`parse_vhdr_py` and returns None for
+    any input it cannot represent exactly, so behavior is always
+    defined by the Python implementation.
+    """
+    from . import native
+
+    header = native.parse_vhdr(text)
+    if header is not None:
+        return header
+    return parse_vhdr_py(text)
+
+
+def parse_vhdr_py(text: str) -> Header:
     sections = _parse_ini(text)
     common = sections.get("Common Infos", {})
     binary = sections.get("Binary Infos", {})
@@ -159,6 +175,16 @@ _MARKER_KEY_RE = re.compile(r"^Mk\d+$")
 
 
 def parse_vmrk(text: str) -> List[Marker]:
+    """Parse a .vmrk marker file (C++ parser when built, Python otherwise)."""
+    from . import native
+
+    markers = native.parse_vmrk(text)
+    if markers is not None:
+        return markers
+    return parse_vmrk_py(text)
+
+
+def parse_vmrk_py(text: str) -> List[Marker]:
     sections = _parse_ini(text)
     infos = sections.get("Marker Infos", {})
     markers: List[Marker] = []

@@ -19,6 +19,7 @@ from __future__ import annotations
 import ctypes
 import logging
 import os
+import re
 import subprocess
 import threading
 from typing import Optional, Tuple
@@ -106,8 +107,52 @@ def _load() -> Optional[ctypes.CDLL]:
             _pd, _i64, _i64, _pi64, _pu8, _i64, _i64, _i64, _pd,
         ]
         lib.eeg_balance_scan.argtypes = [_pu8, _i64, _pi64, _pu8]
+        try:  # absent from pre-parser prebuilt libraries
+            lib.eeg_parse_vhdr.argtypes = [
+                ctypes.c_char_p, _i64, ctypes.POINTER(_HeaderInfo),
+                ctypes.POINTER(_ChannelInfo), _i64,
+            ]
+            lib.eeg_parse_vhdr.restype = _i64
+            lib.eeg_parse_vmrk.argtypes = [
+                ctypes.c_char_p, _i64, ctypes.POINTER(_MarkerInfo), _i64,
+            ]
+            lib.eeg_parse_vmrk.restype = _i64
+            lib.has_parsers = True
+        except AttributeError:
+            lib.has_parsers = False
         _lib = lib
         return _lib
+
+
+class _HeaderInfo(ctypes.Structure):
+    _fields_ = [
+        ("sampling_interval_us", ctypes.c_double),
+        ("num_channels", ctypes.c_int64),
+        ("data_file", ctypes.c_char * 256),
+        ("marker_file", ctypes.c_char * 256),
+        ("data_format", ctypes.c_char * 32),
+        ("orientation", ctypes.c_char * 32),
+        ("binary_format", ctypes.c_char * 32),
+    ]
+
+
+class _ChannelInfo(ctypes.Structure):
+    _fields_ = [
+        ("resolution", ctypes.c_double),
+        ("number", ctypes.c_int64),
+        ("name", ctypes.c_char * 128),
+        ("reference", ctypes.c_char * 64),
+        ("units", ctypes.c_char * 32),
+    ]
+
+
+class _MarkerInfo(ctypes.Structure):
+    _fields_ = [
+        ("position", ctypes.c_int64),
+        ("name", ctypes.c_char * 32),
+        ("kind", ctypes.c_char * 64),
+        ("stimulus", ctypes.c_char * 64),
+    ]
 
 
 def available() -> bool:
@@ -185,3 +230,100 @@ def balance_scan(
     lib.eeg_balance_scan(t, t.size, c, keep)
     counters[:] = c
     return keep.astype(bool)
+
+
+# The C++ parser works byte-wise on '\n'/'\r\n' line structure and
+# ASCII whitespace/digits; Python's splitlines()/strip()/\d/int()
+# additionally honor \v, \f, \x1c-\x1e, lone \r, and the Unicode
+# decimal-digit and whitespace classes. Inputs using any of those
+# route to the Python parser so it defines behavior. Other non-ASCII
+# text (channel names, µV units) is byte-transparent and stays native.
+# \x00: ctypes c_char-array reads stop at the first NUL, which would
+# silently truncate fields the Python parser keeps whole.
+_EXOTIC_TEXT_RE = re.compile(r"\r(?!\n)|[\x00\v\f\x1c\x1d\x1e]")
+
+
+def _native_parseable(text: str) -> bool:
+    if _EXOTIC_TEXT_RE.search(text):
+        return False
+    if text.isascii():
+        return True
+    return not any(
+        ord(c) > 127 and (c.isdigit() or c.isspace()) for c in text
+    )
+
+
+def parse_vhdr(text: str):
+    """Parse a .vhdr via the C++ parser; None -> caller falls back.
+
+    Returns an ``io.brainvision.Header``. A negative status from the
+    native side (numeric parse failure, oversized field) also returns
+    None so the Python parser defines the behavior for exotic inputs.
+    """
+    lib = _load()
+    if lib is None or not getattr(lib, "has_parsers", False):
+        return None
+    if not _native_parseable(text):
+        return None
+    from . import brainvision
+
+    try:
+        data = text.encode("utf-8")
+    except UnicodeEncodeError:  # lone surrogates (surrogateescape reads)
+        return None
+    max_channels = data.count(b"\n") + 2
+    hdr = _HeaderInfo()
+    chans = (_ChannelInfo * max_channels)()
+    n = lib.eeg_parse_vhdr(data, len(data), ctypes.byref(hdr), chans,
+                           max_channels)
+    if n < 0:
+        return None
+    channels = [
+        brainvision.ChannelInfo(
+            number=int(c.number),
+            name=c.name.decode("utf-8"),
+            reference=c.reference.decode("utf-8"),
+            resolution=float(c.resolution),
+            units=c.units.decode("utf-8"),
+        )
+        for c in chans[:n]
+    ]
+    return brainvision.Header(
+        data_file=hdr.data_file.decode("utf-8"),
+        marker_file=hdr.marker_file.decode("utf-8"),
+        data_format=hdr.data_format.decode("utf-8"),
+        orientation=hdr.orientation.decode("utf-8"),
+        num_channels=int(hdr.num_channels),
+        sampling_interval_us=float(hdr.sampling_interval_us),
+        binary_format=hdr.binary_format.decode("utf-8"),
+        channels=channels,
+    )
+
+
+def parse_vmrk(text: str):
+    """Parse a .vmrk via the C++ parser; None -> caller falls back."""
+    lib = _load()
+    if lib is None or not getattr(lib, "has_parsers", False):
+        return None
+    if not _native_parseable(text):
+        return None
+    from . import brainvision
+
+    try:
+        data = text.encode("utf-8")
+    except UnicodeEncodeError:  # lone surrogates (surrogateescape reads)
+        return None
+    max_markers = data.count(b"\n") + 2
+    marks = (_MarkerInfo * max_markers)()
+    n = lib.eeg_parse_vmrk(data, len(data), marks, max_markers)
+    if n < 0:
+        return None
+    return [
+        brainvision.Marker(
+            name=m.name.decode("utf-8"),
+            kind=m.kind.decode("utf-8"),
+            stimulus=m.stimulus.decode("utf-8"),
+            position=int(m.position),
+        )
+        for m in marks[:n]
+    ]

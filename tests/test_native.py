@@ -121,3 +121,171 @@ def test_numpy_fallback_forced(fixture_dir, monkeypatch):
     odp = provider.OfflineDataProvider([fixture_dir + "/infoTrain.txt"])
     batch = odp.load()
     assert java_epoch_sum(batch.epochs) == -253772.18676757812
+
+
+# ---------------------------------------------------------------------------
+# C++ BrainVision .vhdr/.vmrk parser vs the Python parser
+# (native/eeg_host.cc::eeg_parse_vhdr/eeg_parse_vmrk vs
+# io/brainvision.py::parse_vhdr_py/parse_vmrk_py)
+# ---------------------------------------------------------------------------
+
+import glob
+import os
+
+from eeg_dataanalysispackage_tpu.io import brainvision
+
+
+@needs_native
+def test_parse_vhdr_fixture_parity(fixture_dir):
+    paths = sorted(glob.glob(os.path.join(fixture_dir, "DoD", "*.vhdr")))
+    assert paths, "no .vhdr fixtures found"
+    for p in paths:
+        with open(p, "r", errors="replace") as f:
+            text = f.read()
+        got = native.parse_vhdr(text)
+        assert got is not None, f"native parser unavailable for {p}"
+        assert got == brainvision.parse_vhdr_py(text)
+
+
+@needs_native
+def test_parse_vmrk_fixture_parity(fixture_dir):
+    paths = sorted(glob.glob(os.path.join(fixture_dir, "DoD", "*.vmrk")))
+    assert paths, "no .vmrk fixtures found"
+    for p in paths:
+        with open(p, "r", errors="replace") as f:
+            text = f.read()
+        got = native.parse_vmrk(text)
+        assert got is not None, f"native parser unavailable for {p}"
+        assert got == brainvision.parse_vmrk_py(text)
+
+
+@needs_native
+def test_parse_vhdr_edge_semantics():
+    """Duplicate sections merge, duplicate keys overwrite in place,
+    comments/blank lines skip, escaped commas, empty resolution
+    defaults, numeric channel ordering (Ch10 after Ch2)."""
+    text = (
+        "; comment line\n"
+        "Brain Vision Data Exchange Header File Version 1.0\n"
+        "[Common Infos]\r\n"
+        "DataFile=a.eeg\n"
+        "MarkerFile=a.vmrk\n"
+        "  ; indented comment\n"
+        "DataOrientation=VECTORIZED\n"
+        "NumberOfChannels= 12 \n"
+        "SamplingInterval=500\n"
+        "[Binary Infos]\n"
+        "BinaryFormat=IEEE_FLOAT_32\n"
+        "[Channel Infos]\n"
+        "Ch10=Late,,0.5,uV\n"
+        "Ch2=Cz,REF,,mV\n"
+        "Ch1=Fp\\1z,,0.1\n"
+        "Ch2=Cz2,REF2,2.0,mV\n"
+        "[Common Infos]\n"
+        "DataFile=b.eeg\n"
+    )
+    got = native.parse_vhdr(text)
+    want = brainvision.parse_vhdr_py(text)
+    assert got is not None
+    assert got == want
+    assert want.data_file == "b.eeg"  # later dup key wins
+    assert [c.name for c in want.channels] == ["Fp,z", "Cz2", "Late"]
+    assert want.channels[1].resolution == 2.0  # in-place overwrite
+    assert want.num_channels == 12
+    assert want.orientation == "VECTORIZED"
+
+
+@needs_native
+def test_parse_vmrk_edge_semantics():
+    text = (
+        "[Marker Infos]\n"
+        "Mk2=Stimulus,S  2,2000,1,0\n"
+        "Mk1=New Segment,,1,1,0,20130611104808482924\n"
+        "Mk10=Stimulus,S10,9000,1,0\n"
+        "Mk3=Stimulus,S\\1x,notanint,1,0\n"
+        "Codepage=UTF-8\n"
+    )
+    got = native.parse_vmrk(text)
+    want = brainvision.parse_vmrk_py(text)
+    assert got is not None
+    assert got == want
+    assert [m.name for m in want] == ["Mk1", "Mk2", "Mk3", "Mk10"]
+    assert want[3].position == 9000
+    assert want[2].position == 0  # unparseable position -> 0
+    assert want[2].stimulus == "S,x"
+    assert [m.stimulus_index() for m in want] == [-1, 1, -1, 9]
+
+
+@needs_native
+def test_parse_fallback_on_exotic_input():
+    """Inputs the C++ side cannot represent exactly return None so the
+    Python parser defines behavior."""
+    # oversized channel name (>127 bytes) forces fallback
+    big = "[Channel Infos]\nCh1=" + "x" * 400 + ",,0.1,uV\n"
+    assert native.parse_vhdr(big) is None
+    assert len(brainvision.parse_vhdr(big).channels[0].name) == 400
+
+    # bad resolution float: native refuses; Python raises ValueError
+    bad = "[Channel Infos]\nCh1=Fz,,zzz,uV\n"
+    assert native.parse_vhdr(bad) is None
+    with pytest.raises(ValueError):
+        brainvision.parse_vhdr(bad)
+
+
+@needs_native
+def test_parse_divergence_guards():
+    """Inputs where a byte-wise C++ parse would silently diverge from
+    Python (exotic line terminators, Unicode, underscore numerals,
+    int64 overflow, NAN(char-seq)) must route to the Python parser."""
+    # lone-\r line terminators (classic-Mac export)
+    mac = "[Common Infos]\rDataFile=x.eeg\r"
+    assert native.parse_vhdr(mac) is None
+    assert brainvision.parse_vhdr(mac).data_file == "x.eeg"
+
+    # \v / \f are splitlines() terminators in Python
+    assert native.parse_vhdr("[Common Infos]\vDataFile=y.eeg\n") is None
+
+    # non-ASCII: Unicode digits in keys, U+00A0 around keys
+    uni = "[Channel Infos]\nCh١=Fz,,0.1,uV\n"
+    assert native.parse_vhdr(uni) is None
+    assert len(brainvision.parse_vhdr(uni).channels) == 1
+    nbsp = "[Common Infos]\nDataFile =x.eeg\n"
+    assert native.parse_vhdr(nbsp) is None
+    assert brainvision.parse_vhdr(nbsp).data_file == "x.eeg"
+
+    # underscore numerals: Python int("1_000") == 1000
+    und = "[Marker Infos]\nMk1=Stimulus,S  1,1_000,1,0\n"
+    got = brainvision.parse_vmrk(und)
+    assert got[0].position == 1000
+    native_got = native.parse_vmrk(und)
+    assert native_got is None or native_got == got
+
+    # int64 overflow in a marker position: Python bigint succeeds
+    big = "[Marker Infos]\nMk1=Stimulus,S  1,99999999999999999999,1,0\n"
+    assert native.parse_vmrk(big) is None
+    assert brainvision.parse_vmrk(big)[0].position == 10**20 - 1
+
+    # Ch key number overflowing int64 keeps the channel in Python
+    bigch = "[Channel Infos]\nCh99999999999999999999=Fz,,0.1,uV\n"
+    assert native.parse_vhdr(bigch) is None
+    assert len(brainvision.parse_vhdr(bigch).channels) == 1
+
+    # glibc strtod accepts "nan(123)"; Python float() raises
+    nanish = "[Common Infos]\nSamplingInterval=nan(123)\n"
+    assert native.parse_vhdr(nanish) is None
+    with pytest.raises(ValueError):
+        brainvision.parse_vhdr(nanish)
+
+
+@needs_native
+def test_parse_nul_and_surrogates_fall_back():
+    """NUL bytes (c_char truncation) and lone surrogates
+    (surrogateescape reads) must route to the Python parser."""
+    nul = "[Common Infos]\nDataFile=a\x00b.eeg\n"
+    assert native.parse_vhdr(nul) is None
+    assert brainvision.parse_vhdr(nul).data_file == "a\x00b.eeg"
+
+    surr = "[Common Infos]\nDataFile=a\udcffb.eeg\n"
+    assert native.parse_vhdr(surr) is None
+    assert brainvision.parse_vhdr(surr).data_file == "a\udcffb.eeg"
+    assert native.parse_vmrk(surr) is None
