@@ -1,0 +1,155 @@
+"""One test per BASELINE.json "configs" entry.
+
+The driver's BASELINE.json names five benchmark configurations the new
+framework must support; each gets a scaled-down hermetic test here
+(full-size numbers run in bench.py on real hardware). Shapes are tiny
+because conftest pins tests to an 8-device virtual CPU mesh.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from eeg_dataanalysispackage_tpu.features import registry as fe_registry
+from eeg_dataanalysispackage_tpu.features import wavelet
+from eeg_dataanalysispackage_tpu.io import provider, staging
+from eeg_dataanalysispackage_tpu.models import registry as clf_registry
+from eeg_dataanalysispackage_tpu.parallel import mesh as pmesh, streaming
+from eeg_dataanalysispackage_tpu.pipeline import builder
+
+
+def test_config1_info_txt_dwt8_logreg_cpu_reference(fixture_dir, tmp_path):
+    """Config 1: test-data/info.txt, fe=dwt-8, train_clf=logreg."""
+    result = tmp_path / "result.txt"
+    query = (
+        f"info_file={fixture_dir}/infoTrain.txt&fe=dwt-8"
+        f"&train_clf=logreg&result_path={result}"
+    )
+    builder.PipelineBuilder(query).execute()
+    text = result.read_text()
+    assert "Accuracy:" in text and "Number of patterns:" in text
+
+
+def test_config2_p300_corpus_dwt8_tpu_logreg(fixture_dir):
+    """Config 2: P300 corpus (Fz/Cz/Pz, 1000ms epochs), fe=dwt-8-tpu."""
+    batch = provider.OfflineDataProvider(
+        [fixture_dir + "/infoTrain.txt"]
+    ).load()
+    assert batch.epochs.shape == (11, 3, 750)
+    fe = fe_registry.create("dwt-8-tpu")
+    clf = clf_registry.create("logreg")
+    clf.train(batch.epochs, batch.targets, fe)
+    stats = clf.test(batch.epochs, batch.targets)
+    assert 0.0 <= stats.calc_accuracy() <= 1.0
+
+
+def test_config3_synthetic_64ch_stream_db8_svm():
+    """Config 3: synthetic 64-channel epoch stream, batched db8 DWT, svm."""
+    rng = np.random.RandomState(3)
+    n, n_ch = 96, 64
+    epochs = rng.randn(n, n_ch, 750).astype(np.float64) * 20.0
+    labels = (rng.rand(n) > 0.5).astype(np.float64)
+
+    fe = wavelet.WaveletTransform(
+        8, 512, 175, 16, channels=tuple(range(1, n_ch + 1)), backend="xla"
+    )
+    assert fe.feature_dimension == n_ch * 16
+    feats = fe.extract_batch(epochs)
+    assert feats.shape == (n, n_ch * 16)
+    norms = np.linalg.norm(feats, axis=1)
+    np.testing.assert_allclose(norms, 1.0, rtol=1e-5)
+
+    clf = clf_registry.create("svm")
+    clf.train(epochs, labels, fe)
+    stats = clf.test(epochs, labels)
+    assert stats.num_patterns == n
+
+
+def test_config4_multi_subject_info_shard_rf(fixture_dir, tmp_path):
+    """Config 4: multi-subject info.txt shard -> host batches, rf."""
+    os.symlink(os.path.join(fixture_dir, "DoD"), tmp_path / "DoD")
+    info = tmp_path / "info.txt"
+    info.write_text(
+        "# multi-subject shard\n"
+        "DoD/DoD2015_01.eeg 1\n"
+        "DoD/DoD_2015_02.eeg 4\n"
+        "DoD/missing_subject.eeg 2\n"  # skipped with a log, not fatal
+        "\n"
+    )
+    batch = provider.OfflineDataProvider([str(info)]).load()
+    # both recordings contribute; balance counters span the whole run
+    assert batch.epochs.shape[0] > 11
+    assert batch.epochs.shape[1:] == (3, 750)
+
+    fe = fe_registry.create("dwt-8-tpu")
+    feats = fe.extract_batch(batch.epochs)
+    # host->device staging in minibatches feeds the classifier
+    staged = [
+        np.asarray(fx)
+        for fx, _ in staging.prefetch(
+            staging.minibatches(feats, batch.targets, batch_size=16)
+        )
+    ]
+    assert sum(s.shape[0] for s in staged) == batch.epochs.shape[0]
+
+    clf = clf_registry.create("rf")
+    # all six keys must be present or the reference-parity all-or-
+    # nothing branch falls back to the 100-tree defaults
+    clf.set_config(
+        {
+            "config_num_trees": "8",
+            "config_max_depth": "4",
+            "config_max_bins": "16",
+            "config_impurity": "gini",
+            "config_min_instances_per_node": "1",
+            "config_feature_subset": "auto",
+        }
+    )
+    clf.train(batch.epochs, batch.targets, fe)
+    stats = clf.test(batch.epochs, batch.targets)
+    assert stats.num_patterns == batch.epochs.shape[0]
+
+
+def test_config5_streaming_bandpass_dwt_nn_8dev():
+    """Config 5: streaming FFT bandpass + DWT on continuous EEG, nn,
+    time axis sharded over an 8-device mesh (v5e-8 stand-in)."""
+    n_ch, T = 16, 8 * 1024
+    rng = np.random.RandomState(5)
+    signal = rng.randn(n_ch, T).astype(np.float32) * 30.0
+
+    mesh = pmesh.make_mesh(8, axes=(pmesh.TIME_AXIS,))
+    extract = streaming.make_streaming_extractor(
+        mesh, window=512, stride=256, fs=1000.0
+    )
+    feats = np.asarray(extract(streaming.stage_recording(signal, mesh)))
+    assert feats.shape == (T // 256, n_ch * 16)
+    assert np.isfinite(feats).all()
+
+    labels = (rng.rand(feats.shape[0]) > 0.5).astype(np.float64)
+    clf = clf_registry.create("nn")
+    clf.set_config(
+        {
+            "config_seed": "1",
+            "config_num_iterations": "30",
+            "config_learning_rate": "0.05",
+            "config_momentum": "0.9",
+            "config_weight_init": "xavier",
+            "config_updater": "nesterovs",
+            "config_optimization_algo": "sgd",
+            "config_pretrain": "false",
+            "config_backprop": "true",
+            "config_layer1_layer_type": "dense",
+            "config_layer1_n_out": "32",
+            "config_layer1_activation_function": "relu",
+            "config_layer1_drop_out": "0",
+            "config_layer2_layer_type": "output",
+            "config_layer2_n_out": "2",
+            "config_layer2_activation_function": "softmax",
+            "config_layer2_drop_out": "0",
+        }
+    )
+    clf.fit(feats, labels)
+    preds = clf.predict(feats)
+    assert preds.shape == (feats.shape[0],)
+    assert np.isfinite(preds).all()
