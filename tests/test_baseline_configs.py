@@ -20,10 +20,10 @@ from eeg_dataanalysispackage_tpu.pipeline import builder
 
 
 def test_config1_info_txt_dwt8_logreg_cpu_reference(fixture_dir, tmp_path):
-    """Config 1: test-data/info.txt, fe=dwt-8, train_clf=logreg."""
+    """Config 1: test-data/info.txt (3-token lines), fe=dwt-8, logreg."""
     result = tmp_path / "result.txt"
     query = (
-        f"info_file={fixture_dir}/infoTrain.txt&fe=dwt-8"
+        f"info_file={fixture_dir}/info.txt&fe=dwt-8"
         f"&train_clf=logreg&result_path={result}"
     )
     builder.PipelineBuilder(query).execute()

@@ -1,0 +1,81 @@
+"""Real-hardware parity check: fixture golden sums on the TPU chip.
+
+The hermetic test suite pins bit-exact parity on the host (float64)
+path and float32-tolerance parity for the XLA path on CPU
+(tests/test_dwt_parity.py). This tool closes the last gap: it runs the
+full ingest -> DWT feature path on the *real* attached accelerator and
+reports the deviation of the device (float32) features from the
+bit-exact host (float64) reference, plus the golden sums themselves.
+
+Usage: python tools/tpu_parity_check.py  (prints one JSON line)
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+# One source of truth for the fold orders and golden constants: the
+# hermetic parity tests themselves.
+from tests.test_dwt_parity import java_feature_sum
+from tests.test_epoch_parity import java_epoch_sum
+
+REFERENCE_DATA = os.environ.get(
+    "EEG_REFERENCE_DATA", "/root/reference/test-data"
+)
+FIXTURE = os.path.join(REFERENCE_DATA, "infoTrain.txt")
+GOLDEN_EPOCH_SUM = -253772.18676757812
+GOLDEN_FEATURE_SUM = -24.861844096031625
+
+
+def main() -> None:
+    from eeg_dataanalysispackage_tpu.features import wavelet
+    from eeg_dataanalysispackage_tpu.io import provider
+
+    if not os.path.exists(FIXTURE):
+        sys.exit(
+            f"fixture not found: {FIXTURE} — point EEG_REFERENCE_DATA at "
+            "the reference test-data directory"
+        )
+    batch = provider.OfflineDataProvider([FIXTURE]).load()
+    epoch_sum = java_epoch_sum(batch.epochs)
+
+    host_fe = wavelet.WaveletTransform(8, 512, 175, 16, backend="host")
+    host_feats = host_fe.extract_batch(batch.epochs)
+    feature_sum = java_feature_sum(host_feats)
+
+    device_fe = wavelet.WaveletTransform(8, 512, 175, 16, backend="xla")
+    device_feats = np.asarray(
+        device_fe.extract_batch(batch.epochs), dtype=np.float64
+    )
+    max_abs_dev = float(np.max(np.abs(device_feats - host_feats)))
+
+    print(
+        json.dumps(
+            {
+                "platform": jax.devices()[0].platform,
+                "epochs": list(batch.epochs.shape),
+                "epoch_sum_bit_exact": epoch_sum == GOLDEN_EPOCH_SUM,
+                "epoch_sum": epoch_sum,
+                "host_feature_sum_bit_exact": feature_sum
+                == GOLDEN_FEATURE_SUM,
+                "host_feature_sum": feature_sum,
+                "device_feature_max_abs_dev_vs_host_f64": max_abs_dev,
+                "device_feature_sum": java_feature_sum(device_feats),
+            }
+        )
+    )
+    if epoch_sum != GOLDEN_EPOCH_SUM or feature_sum != GOLDEN_FEATURE_SUM:
+        sys.exit(1)
+    # L2-normalized features are O(1); anything past f32 rounding noise
+    # indicates a device-path defect.
+    if max_abs_dev > 1e-5:
+        sys.exit(2)
+
+
+if __name__ == "__main__":
+    main()
