@@ -67,6 +67,23 @@ class EpochBatch:
         )
 
 
+def valid_window_starts(
+    positions: np.ndarray, pre: int, n_samples: int
+) -> np.ndarray:
+    """Boolean validity of ``[pos-pre, pos+post)`` windows.
+
+    Java's Arrays.copyOfRange(arr, from, to) throws only when
+    from < 0 or from > arr.length; a ``to`` beyond the end ZERO-PADS.
+    So windows starting in-range but running past the end are kept,
+    zero-padded — only windows starting before 0 or after the end are
+    dropped (the reference's swallowed AIOOBE,
+    OffLineDataProvider.java:262-264). Shared by the host gather and
+    the device-ingest planner so retention can never desynchronize.
+    """
+    positions = np.asarray(positions, dtype=np.int64)
+    return (positions - pre >= 0) & (positions - pre <= n_samples)
+
+
 def gather_windows(
     channels: np.ndarray,
     positions: np.ndarray,
@@ -83,12 +100,7 @@ def gather_windows(
     """
     n_samples = channels.shape[1]
     positions = np.asarray(positions, dtype=np.int64)
-    # Java's Arrays.copyOfRange(arr, from, to) throws only when
-    # from < 0 or from > arr.length; a `to` beyond the end ZERO-PADS.
-    # So windows starting in-range but running past the end are kept,
-    # zero-padded — only windows starting before 0 or after the end
-    # are dropped (the swallowed AIOOBE).
-    valid = (positions - pre >= 0) & (positions - pre <= n_samples)
+    valid = valid_window_starts(positions, pre, n_samples)
     starts = positions[valid] - pre
     padded = np.pad(channels, ((0, 0), (0, pre + post)))
     idx = starts[:, None] + np.arange(pre + post)[None, :]

@@ -236,6 +236,28 @@ class Recording:
         res = np.float32(self.header.channels[index].resolution)
         return (self._raw[:, index].astype(np.float32) * res).astype(np.float64)
 
+    def raw_int16(self, indices: Sequence[int]) -> np.ndarray:
+        """(len(indices), num_samples) UNSCALED int16 channel matrix.
+
+        The device-ingest path (ops/device_ingest.py) ships these raw
+        samples to HBM and applies the resolution scaling on device,
+        halving host->device transfer vs staging float32 epochs.
+        Raises for non-INT_16 recordings (callers fall back to
+        :meth:`read_channels`).
+        """
+        if self._raw.dtype != np.int16:
+            raise TypeError(
+                f"raw_int16 requires INT_16 data, got {self._raw.dtype}"
+            )
+        return np.ascontiguousarray(self._raw[:, list(indices)].T)
+
+    def resolutions(self, indices: Sequence[int]) -> np.ndarray:
+        """(len(indices),) float32 per-channel resolution factors."""
+        return np.array(
+            [self.header.channels[i].resolution for i in indices],
+            dtype=np.float32,
+        )
+
     def read_channels(self, indices: Sequence[int]) -> np.ndarray:
         """(len(indices), num_samples) float64 scaled channel matrix.
 
