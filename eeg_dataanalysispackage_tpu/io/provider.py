@@ -105,12 +105,73 @@ class OfflineDataProvider:
     # Reference-compatible alias (OffLineDataProvider.loadData).
     load_data = load
 
-    def _process_recording(
+    def load_features_device(
         self,
-        rec: brainvision.Recording,
-        guessed: int,
-        balance: extractor.BalanceState,
-    ) -> extractor.EpochBatch:
+        wavelet_index: int = 8,
+        epoch_size: int = 512,
+        skip_samples: int = 175,
+        feature_size: int = 16,
+    ):
+        """TPU fast path: info.txt run -> DWT features without host epochs.
+
+        Per recording, raw int16 channels stage to the device and one
+        fused XLA program (ops/device_ingest.py) produces the
+        L2-normalized feature rows; the host handles only marker
+        metadata and the cross-file balance state. Returns
+        (features (n, C*feature_size) float32, targets (n,) float64).
+
+        Numerics follow the float32 device path (tolerance-level vs
+        the bit-exact host path) — use :meth:`load` + a host-backend
+        WaveletTransform when bit parity with the Java reference is
+        required.
+        """
+        from ..epochs.extractor import BalanceState
+        from ..ops import device_ingest
+
+        prefix, files = self._resolve_files()
+        balance = BalanceState()
+        featurizer = device_ingest.make_device_ingest_featurizer(
+            wavelet_index=wavelet_index,
+            epoch_size=epoch_size,
+            skip_samples=skip_samples,
+            feature_size=feature_size,
+            channels=tuple(range(1, len(self._channel_names) + 1)),
+            pre=self._pre,
+            post=self._post,
+        )
+        feats: List[np.ndarray] = []
+        targets: List[np.ndarray] = []
+        for rel_path, guessed in files.items():
+            try:
+                rec = brainvision.load_recording(
+                    prefix + rel_path, filesystem=self._fs
+                )
+            except FileNotFoundError as e:
+                logger.warning("Did not load %s: %s", rel_path, e)
+                continue
+            raw, res, n_samples = device_ingest.stage_raw(
+                rec, self._channel_indices(rec)
+            )
+            plan = device_ingest.plan_ingest(
+                rec.markers,
+                guessed,
+                n_samples,
+                pre=self._pre,
+                post=self._post,
+                balance=balance,
+            )
+            out = featurizer(raw, res, plan.positions, plan.mask)
+            feats.append(np.asarray(out)[plan.mask])
+            targets.append(plan.targets)
+        n_feat = len(self._channel_names) * feature_size
+        if not feats:
+            return (
+                np.zeros((0, n_feat), dtype=np.float32),
+                np.zeros((0,), dtype=np.float64),
+            )
+        return np.concatenate(feats), np.concatenate(targets)
+
+    def _channel_indices(self, rec: brainvision.Recording) -> List[int]:
         indices = []
         for name in self._channel_names:
             idx = rec.header.channel_index(name)
@@ -121,7 +182,15 @@ class OfflineDataProvider:
                 )
             self._last_indices[name] = idx
             indices.append(idx)
-        channels = rec.read_channels(indices)
+        return indices
+
+    def _process_recording(
+        self,
+        rec: brainvision.Recording,
+        guessed: int,
+        balance: extractor.BalanceState,
+    ) -> extractor.EpochBatch:
+        channels = rec.read_channels(self._channel_indices(rec))
         return extractor.extract_epochs(
             channels,
             rec.markers,

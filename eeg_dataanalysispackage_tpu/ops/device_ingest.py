@@ -46,13 +46,47 @@ def _round_capacity(n: int, multiple: int) -> int:
     return max(multiple, ((n + multiple - 1) // multiple) * multiple)
 
 
+def stage_raw(
+    recording: Recording,
+    channel_indices: Sequence[int],
+    sample_multiple: int = 16384,
+):
+    """Host-side staging of a recording's channels for device ingest.
+
+    Returns (raw (C, S_padded), resolutions (C,), n_samples). Uses
+    unscaled int16 when the recording is INT_16 (half the float32
+    transfer bytes); other formats fall back to the already scaled
+    float32 channels with unit resolutions — same graph either way.
+
+    The sample axis is zero-padded up to a multiple of
+    ``sample_multiple``: together with the epoch-capacity bucketing,
+    every jitted ingest shape is a bucket size, so recordings of
+    different lengths reuse the compiled program instead of retracing
+    per file. The padding is semantically free — window validity is
+    decided against the *true* ``n_samples``, and windows overhanging
+    the end read zeros exactly as Java's copyOfRange zero-pad does.
+    """
+    try:
+        raw = recording.raw_int16(channel_indices)
+        res = recording.resolutions(channel_indices)
+    except TypeError:
+        raw = recording.read_channels(channel_indices).astype(np.float32)
+        res = np.ones(len(channel_indices), dtype=np.float32)
+    n_samples = raw.shape[1]
+    padded = _round_capacity(n_samples, sample_multiple)
+    if padded != n_samples:
+        raw = np.pad(raw, ((0, 0), (0, padded - n_samples)))
+    return raw, res, n_samples
+
+
 @dataclasses.dataclass
 class IngestPlan:
     """Host-side metadata for one recording's device ingest.
 
-    Arrays are padded to ``capacity`` (a bucketed static size, so jit
-    recompiles only when a recording overflows the current bucket);
-    ``mask`` marks the real rows.
+    Arrays are padded to ``capacity`` (a bucketed static size; with
+    :func:`stage_raw`'s sample-axis bucketing, jit recompiles only
+    when a recording overflows the current buckets); ``mask`` marks
+    the real rows.
     """
 
     positions: np.ndarray  # (capacity,) int32 marker positions (kept rows)
@@ -139,6 +173,45 @@ def make_device_epocher(
     return epoch
 
 
+@functools.lru_cache(maxsize=None)
+def make_device_ingest_featurizer(
+    wavelet_index: int = 8,
+    epoch_size: int = 512,
+    skip_samples: int = 175,
+    feature_size: int = 16,
+    channels: Sequence[int] = (1, 2, 3),
+    pre: int = constants.PRESTIMULUS_SAMPLES,
+    post: int = constants.POSTSTIMULUS_SAMPLES,
+):
+    """Fused jitted (raw int16, resolutions, positions, mask) ->
+    (cap, n_channels*feature_size) float32 L2-normalized features.
+
+    One XLA program from raw samples to DWT features: scaling, window
+    gather, baseline correction, the cascade matmul, and normalization
+    all fuse — no epoch tensor ever materializes in HBM. ``channels``
+    are 1-based positions within the already-gathered channel rows
+    (the WaveletTransform convention).
+    """
+    from . import dwt as dwt_xla
+
+    epocher = make_device_epocher(pre, post)
+    extract = dwt_xla.make_batched_extractor(
+        wavelet_index=wavelet_index,
+        epoch_size=epoch_size,
+        skip_samples=skip_samples,
+        feature_size=feature_size,
+        channels=channels,
+    )
+
+    @jax.jit
+    def ingest_features(raw, resolutions, positions, mask):
+        epochs = epocher(raw, resolutions, positions, mask)
+        feats = extract(epochs)
+        return feats * mask[:, None].astype(feats.dtype)
+
+    return ingest_features
+
+
 def ingest_recording(
     recording: Recording,
     guessed_number: int,
@@ -158,16 +231,11 @@ def ingest_recording(
     scaled float32 channels instead of raw int16 — same graph, unit
     resolutions, just without the 2x transfer saving.
     """
-    try:
-        raw = recording.raw_int16(channel_indices)
-        res = recording.resolutions(channel_indices)
-    except TypeError:
-        raw = recording.read_channels(channel_indices).astype(np.float32)
-        res = np.ones(len(channel_indices), dtype=np.float32)
+    raw, res, n_samples = stage_raw(recording, channel_indices)
     plan = plan_ingest(
         recording.markers,
         guessed_number,
-        raw.shape[1],
+        n_samples,
         pre=pre,
         post=post,
         balance=balance,
