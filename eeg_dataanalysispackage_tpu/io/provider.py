@@ -160,8 +160,10 @@ class OfflineDataProvider:
                 post=self._post,
                 balance=balance,
             )
-            out = featurizer(raw, res, plan.positions, plan.mask)
-            feats.append(np.asarray(out)[plan.mask])
+            # async dispatch: keep the device array; the next file's
+            # host parse/stage overlaps this file's device compute
+            feats.append((featurizer(raw, res, plan.positions, plan.mask),
+                          plan.mask))
             targets.append(plan.targets)
         n_feat = len(self._channel_names) * feature_size
         if not feats:
@@ -169,7 +171,10 @@ class OfflineDataProvider:
                 np.zeros((0, n_feat), dtype=np.float32),
                 np.zeros((0,), dtype=np.float64),
             )
-        return np.concatenate(feats), np.concatenate(targets)
+        return (
+            np.concatenate([np.asarray(out)[mask] for out, mask in feats]),
+            np.concatenate(targets),
+        )
 
     def _channel_indices(self, rec: brainvision.Recording) -> List[int]:
         indices = []

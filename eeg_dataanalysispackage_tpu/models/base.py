@@ -57,7 +57,19 @@ class Classifier(abc.ABC):
         epochs: Sequence[np.ndarray] | np.ndarray,
         targets: Sequence[float] | np.ndarray,
     ) -> stats.ClassificationStatistics:
-        features = self._extract(epochs)
+        return self.test_features(self._extract(epochs), targets)
+
+    def test_features(
+        self,
+        features: np.ndarray,
+        targets: Sequence[float] | np.ndarray,
+    ) -> stats.ClassificationStatistics:
+        """Evaluate on already-extracted feature rows.
+
+        The single place statistics are built from predictions — used
+        by :meth:`test` and by the pipeline's fused device path, where
+        features come straight off the accelerator.
+        """
         labels = np.asarray(targets, dtype=np.float64)
         predictions = self.predict(features)
         return stats.ClassificationStatistics.from_arrays(

@@ -63,17 +63,29 @@ class PipelineBuilder:
             raise ValueError("Missing the input file argument")
 
         odp = provider.OfflineDataProvider(files, filesystem=self._fs)
-        with self.timers.stage("ingest"):
-            batch = odp.load()
-        obs.metrics.count("pipeline.epochs_loaded", len(batch))
 
-        # 2. feature extraction (PipelineBuilder.java:128-139)
-        if "fe" not in query_map:
-            raise ValueError("Missing the feature extraction argument")
-        fe = fe_registry.create(query_map["fe"])
+        # 2. feature extraction (PipelineBuilder.java:128-139).
+        # fe=dwt-8-fused is the TPU fast-path mode: ingest + DWT run as
+        # one on-device program (provider.load_features_device), so no
+        # host epoch batch ever exists and classifiers consume feature
+        # rows directly. All other fe= values follow the reference
+        # shape: epochs load first, the registry extractor maps them.
+        fused = query_map.get("fe") == "dwt-8-fused"
+        if fused:
+            with self.timers.stage("ingest"):
+                features, targets = odp.load_features_device()
+            fe = None
+            n = len(targets)
+        else:
+            with self.timers.stage("ingest"):
+                batch = odp.load()
+            if "fe" not in query_map:
+                raise ValueError("Missing the feature extraction argument")
+            fe = fe_registry.create(query_map["fe"])
+            n = len(batch)
+        obs.metrics.count("pipeline.epochs_loaded", n)
 
         # 3. classifier (PipelineBuilder.java:151-284)
-        n = len(batch)
         if "train_clf" in query_map:
             classifier = clf_registry.create(query_map["train_clf"])
 
@@ -83,9 +95,12 @@ class PipelineBuilder:
             }
             classifier.set_config(config)
             with self.timers.stage("train"):
-                classifier.train(
-                    batch.epochs[train_idx], batch.targets[train_idx], fe
-                )
+                if fused:
+                    classifier.fit(features[train_idx], targets[train_idx])
+                else:
+                    classifier.train(
+                        batch.epochs[train_idx], batch.targets[train_idx], fe
+                    )
             logger.info("trained %s", query_map["train_clf"])
 
             if query_map.get("save_clf") == "true":
@@ -97,8 +112,14 @@ class PipelineBuilder:
                 classifier.save(query_map["save_name"])
 
             with self.timers.stage("test"):
-                statistics = classifier.test(
-                    batch.epochs[test_idx], batch.targets[test_idx]
+                statistics = (
+                    classifier.test_features(
+                        features[test_idx], targets[test_idx]
+                    )
+                    if fused
+                    else classifier.test(
+                        batch.epochs[test_idx], batch.targets[test_idx]
+                    )
                 )
 
         elif "load_clf" in query_map:
@@ -109,11 +130,14 @@ class PipelineBuilder:
             # load mode tests on ALL shuffled data — no split
             # (PipelineBuilder.java:261-278)
             perm = java_compat.java_shuffle_indices(n, seed=1)
-            classifier.set_feature_extraction(fe)
+            if not fused:
+                classifier.set_feature_extraction(fe)
             classifier.load(query_map["load_name"])
             with self.timers.stage("test"):
-                statistics = classifier.test(
-                    batch.epochs[perm], batch.targets[perm]
+                statistics = (
+                    classifier.test_features(features[perm], targets[perm])
+                    if fused
+                    else classifier.test(batch.epochs[perm], batch.targets[perm])
                 )
 
         else:

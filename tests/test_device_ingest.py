@@ -195,3 +195,46 @@ def test_stage_raw_buckets_sample_axis(recording):
     raw2, _, n2 = device_ingest.stage_raw(shorter, idx)
     assert n2 == shorter_len
     assert raw2.shape == raw.shape
+
+
+def test_fused_pipeline_query_mode(fixture_dir, tmp_path):
+    """fe=dwt-8-fused runs the whole query pipeline on the device
+    fast path: train/save, then load/test, result file written."""
+    from eeg_dataanalysispackage_tpu.pipeline import builder
+
+    result = tmp_path / "result.txt"
+    save_dir = tmp_path / "clf"
+    q = (
+        f"info_file={fixture_dir}/infoTrain.txt&fe=dwt-8-fused"
+        f"&train_clf=logreg&save_clf=true&save_name={save_dir}"
+        f"&result_path={result}"
+    )
+    pb = builder.PipelineBuilder(q)
+    stats_train = pb.execute()
+    assert stats_train.num_patterns == 11 - int(0.7 * 11)
+    assert "Accuracy:" in result.read_text()
+
+    q_load = (
+        f"info_file={fixture_dir}/infoTrain.txt&fe=dwt-8-fused"
+        f"&load_clf=logreg&load_name={save_dir}"
+    )
+    stats_load = builder.PipelineBuilder(q_load).execute()
+    assert stats_load.num_patterns == 11  # load mode: all shuffled data
+
+
+def test_fused_pipeline_matches_host_pipeline_split(fixture_dir, tmp_path):
+    """The fused mode uses the same seed-1 shuffle + 70/30 split as
+    the reference path, so the two modes test on the same rows."""
+    from eeg_dataanalysispackage_tpu.pipeline import builder
+
+    q_host = (
+        f"info_file={fixture_dir}/infoTrain.txt&fe=dwt-8-tpu"
+        "&train_clf=logreg"
+    )
+    q_fused = (
+        f"info_file={fixture_dir}/infoTrain.txt&fe=dwt-8-fused"
+        "&train_clf=logreg"
+    )
+    s_host = builder.PipelineBuilder(q_host).execute()
+    s_fused = builder.PipelineBuilder(q_fused).execute()
+    assert s_host.num_patterns == s_fused.num_patterns
