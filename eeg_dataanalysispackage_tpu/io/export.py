@@ -23,6 +23,25 @@ def write_epochs_to_csv(
             f.write("\n")
 
 
+def write_channel_text(
+    channel: np.ndarray, path: str, filesystem=None
+) -> None:
+    """Write one raw channel as text, one sample per line.
+
+    The equivalent of the reference's raw-read smoke path
+    (HadoopLoadingTest.tryRAWEEG, HadoopLoadingTest.java:56-119: read
+    a channel, ``sc.parallelize``, ``saveAsTextFile`` back to storage)
+    — here a straight write through the pluggable filesystem.
+    """
+    from . import sources
+
+    fs = filesystem or sources.LocalFileSystem()
+    arr = np.asarray(channel, dtype=np.float64).ravel()
+    fs.write_bytes(
+        path, "".join(f"{float(v)!r}\n" for v in arr).encode("ascii")
+    )
+
+
 def read_epochs_csv(path: str) -> np.ndarray:
     """Read a ``writeEpochsToCSV``-format file back into (n, T) float64
     (rows have a trailing comma)."""

@@ -29,6 +29,8 @@ class FileSystem(Protocol):
 
     def read_text(self, path: str) -> str: ...
 
+    def write_bytes(self, path: str, data: bytes) -> None: ...
+
 
 class LocalFileSystem:
     """POSIX filesystem."""
@@ -43,6 +45,10 @@ class LocalFileSystem:
     def read_text(self, path: str) -> str:
         with open(path, "r", encoding="utf-8", errors="replace") as f:
             return f.read()
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        with open(path, "wb") as f:
+            f.write(data)
 
 
 class InMemoryFileSystem:

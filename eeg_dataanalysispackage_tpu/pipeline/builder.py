@@ -54,6 +54,16 @@ class PipelineBuilder:
         query_map = get_query_map(self.query)
         logger.info("query: %s", query_map)
 
+        # net-new observability: trace_path=<dir> wraps the run in a
+        # jax.profiler trace (device + annotated host activity),
+        # viewable in TensorBoard/Perfetto
+        if "trace_path" in query_map and query_map["trace_path"]:
+            with obs.trace(query_map["trace_path"]):
+                return self._execute(query_map)
+        return self._execute(query_map)
+
+    def _execute(self, query_map) -> stats.ClassificationStatistics:
+
         # 1. input (PipelineBuilder.java:104-113)
         if "info_file" in query_map:
             files = [query_map["info_file"]]

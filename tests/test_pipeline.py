@@ -160,3 +160,16 @@ def test_nn_pipeline(fixture_dir):
     assert stats.num_patterns == 4
     # NN stats use the incremental path: MSE/class sums are populated
     assert stats.mse >= 0.0
+
+
+def test_trace_path_query_param(fixture_dir, tmp_path):
+    """trace_path wraps the run in a jax.profiler trace directory."""
+    trace_dir = tmp_path / "trace"
+    q = (
+        f"info_file={fixture_dir}/infoTrain.txt&fe=dwt-8-tpu"
+        f"&train_clf=logreg&trace_path={trace_dir}"
+    )
+    stats = builder.PipelineBuilder(q).execute()
+    assert stats.num_patterns > 0
+    # jax writes plugins/profile/<ts>/ under the trace dir
+    assert trace_dir.exists() and any(trace_dir.rglob("*"))

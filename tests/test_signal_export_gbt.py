@@ -109,3 +109,17 @@ def test_gbt_through_pipeline(fixture_dir, tmp_path):
     stats = builder.PipelineBuilder(q).execute()
     assert 0.0 <= stats.calc_accuracy() <= 1.0
     assert "Accuracy" in open(result).read()
+
+
+def test_write_channel_text_round_trip(tmp_path):
+    from eeg_dataanalysispackage_tpu.io import export, sources
+
+    ch = np.array([1.5, -2.25, 0.1], dtype=np.float64)
+    path = str(tmp_path / "raw.txt")
+    export.write_channel_text(ch, path)
+    lines = open(path).read().splitlines()
+    assert [float(x) for x in lines] == list(ch)
+
+    fs = sources.InMemoryFileSystem()
+    export.write_channel_text(ch, "out/raw.txt", filesystem=fs)
+    assert fs.exists("out/raw.txt")
