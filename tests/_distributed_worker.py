@@ -1,0 +1,66 @@
+"""Worker process for the two-process collective test.
+
+Launched by tests/test_distributed.py with JAX_COORDINATOR_ADDRESS /
+JAX_NUM_PROCESSES / JAX_PROCESS_ID in the environment. Runs the
+framework's real multi-host path — distributed.initialize ->
+hybrid_mesh -> stage_global_batch -> cross-process collectives over
+gloo — and prints one JSON line of results for the parent to check.
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax.numpy as jnp
+import numpy as np
+
+from eeg_dataanalysispackage_tpu.parallel import distributed
+
+
+def main() -> None:
+    distributed.initialize()  # env-driven bootstrap
+    pid = jax.process_index()
+    mesh = distributed.hybrid_mesh()
+
+    # each process stages only its own shard of the global batch
+    local = np.arange(6, dtype=np.float32).reshape(2, 3) + 10 * pid
+    batch = distributed.stage_global_batch(local, mesh)
+    assert batch.shape == (4, 3), batch.shape
+
+    # cross-process reduction (gloo under XLA): global sum
+    total = float(jax.jit(jnp.sum)(batch))
+
+    # parameter broadcast + gradient that reduces over the DCN axis
+    params = distributed.replicate_across_hosts(
+        {"w": np.full(3, 2.0, dtype=np.float32)}, mesh
+    )
+    grad = jax.jit(
+        lambda w, x: jax.grad(lambda w_: jnp.sum(x @ w_))(w)
+    )(params["w"], batch)
+
+    print(
+        json.dumps(
+            {
+                "pid": pid,
+                "procs": jax.process_count(),
+                "devices": jax.device_count(),
+                "mesh": dict(mesh.shape),
+                "total": total,
+                "wsum": float(jnp.sum(params["w"])),
+                "grad": np.asarray(grad).tolist(),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
