@@ -160,6 +160,20 @@ def batch_spec(mesh: Mesh, dcn_axis: str = DCN_AXIS) -> P:
     return P(axes if len(axes) > 1 else axes[0])
 
 
+def stage_local(sharding: NamedSharding, local: np.ndarray) -> jax.Array:
+    """Per-process host data -> one global array under ``sharding``.
+
+    The single dispatch point for multi-host staging: single-process
+    runs are a plain ``device_put`` (no intermediate default-device
+    commit), multi-process runs assemble the global array from each
+    process's addressable shards.
+    """
+    local = np.asarray(local)
+    if jax.process_count() == 1:
+        return jax.device_put(local, sharding)
+    return jax.make_array_from_process_local_data(sharding, local)
+
+
 def stage_global_batch(
     local_batch: np.ndarray, mesh: Mesh, dcn_axis: str = DCN_AXIS
 ) -> jax.Array:
@@ -171,11 +185,9 @@ def stage_global_batch(
     :func:`batch_spec`. Single-process this is exactly
     ``device_put`` + batch sharding.
     """
-    sharding = NamedSharding(mesh, batch_spec(mesh, dcn_axis))
-    local = np.asarray(local_batch)
-    if jax.process_count() == 1:
-        return jax.device_put(local, sharding)
-    return jax.make_array_from_process_local_data(sharding, local)
+    return stage_local(
+        NamedSharding(mesh, batch_spec(mesh, dcn_axis)), local_batch
+    )
 
 
 def replicate_across_hosts(tree, mesh: Mesh):

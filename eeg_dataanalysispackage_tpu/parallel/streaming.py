@@ -131,3 +131,23 @@ def stage_recording(signal: np.ndarray, mesh: Mesh, axis: str = pmesh.TIME_AXIS)
     """Host->device staging of a (C, T) recording, time-sharded."""
     sharding = NamedSharding(mesh, P(None, axis))
     return jax.device_put(jnp.asarray(signal, dtype=jnp.float32), sharding)
+
+
+def stage_recording_local(
+    local_block: np.ndarray, mesh: Mesh, axis: str = pmesh.TIME_AXIS
+):
+    """Multi-host staging: per-process time block -> global recording.
+
+    Each process passes only its contiguous (C, T_local) chunk of the
+    recording (its slice of the stream); the result is the global
+    (C, T_total) array time-sharded over ``axis``, with the halo
+    exchange of :func:`make_streaming_extractor` crossing process
+    boundaries over DCN. Single-process this degenerates to
+    :func:`stage_recording`.
+    """
+    from . import distributed
+
+    return distributed.stage_local(
+        NamedSharding(mesh, P(None, axis)),
+        np.asarray(local_block, dtype=np.float32),
+    )

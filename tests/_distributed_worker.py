@@ -23,7 +23,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax.numpy as jnp
 import numpy as np
 
-from eeg_dataanalysispackage_tpu.parallel import distributed
+from eeg_dataanalysispackage_tpu.parallel import (
+    distributed,
+    mesh as pmesh,
+    streaming,
+    train as ptrain,
+)
 
 
 def main() -> None:
@@ -47,6 +52,35 @@ def main() -> None:
         lambda w, x: jax.grad(lambda w_: jnp.sum(x @ w_))(w)
     )(params["w"], batch)
 
+    # ---- full flagship train step over the hybrid mesh --------------
+    rng = np.random.RandomState(0)
+    epochs_global = rng.randn(4, 3, 750).astype(np.float32)
+    labels_global = (rng.rand(4) > 0.5).astype(np.float32)
+    init_state, train_step = ptrain.make_train_step()
+    state = distributed.replicate_across_hosts(
+        jax.tree_util.tree_map(
+            np.asarray, init_state(jax.random.PRNGKey(0))
+        ),
+        mesh,
+    )
+    ep = distributed.stage_global_batch(epochs_global[2 * pid : 2 * pid + 2], mesh)
+    lb = distributed.stage_global_batch(labels_global[2 * pid : 2 * pid + 2], mesh)
+    mk = distributed.stage_global_batch(np.ones(2, np.float32), mesh)
+    _, loss = train_step(state, ep, lb, mk)
+    loss = float(loss)
+
+    # ---- sequence-parallel streaming: halo crosses the process
+    # boundary over DCN ----------------------------------------------
+    rng2 = np.random.RandomState(1)
+    sig_global = rng2.randn(2, 2048).astype(np.float32) * 30.0
+    tmesh = pmesh.make_mesh(4, axes=(pmesh.TIME_AXIS,))
+    extract = streaming.make_streaming_extractor(tmesh, window=512, stride=256)
+    staged = streaming.stage_recording_local(
+        sig_global[:, 1024 * pid : 1024 * (pid + 1)], tmesh
+    )
+    feats = extract(staged)
+    stream_sum = float(jax.jit(jnp.sum)(feats))
+
     print(
         json.dumps(
             {
@@ -57,6 +91,9 @@ def main() -> None:
                 "total": total,
                 "wsum": float(jnp.sum(params["w"])),
                 "grad": np.asarray(grad).tolist(),
+                "loss": loss,
+                "stream_sum": stream_sum,
+                "stream_shape": list(feats.shape),
             }
         )
     )

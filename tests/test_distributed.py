@@ -16,6 +16,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from eeg_dataanalysispackage_tpu.parallel import (
     distributed,
     mesh as pmesh,
+    streaming,
     train as ptrain,
 )
 
@@ -167,3 +168,30 @@ def test_two_process_gloo_collectives():
         assert o["total"] == 15.0 + 75.0
         assert o["wsum"] == 6.0
         assert o["grad"] == [26.0, 30.0, 34.0]  # global column sums
+
+    # the full train step and the sequence-parallel streaming
+    # extractor must agree across processes and with a single-process
+    # run of the identical code on the same global data
+    rng = np.random.RandomState(0)
+    epochs_global = rng.randn(4, 3, 750).astype(np.float32)
+    labels_global = (rng.rand(4) > 0.5).astype(np.float32)
+    init_state, train_step = ptrain.make_train_step()
+    _, ref_loss = train_step(
+        init_state(jax.random.PRNGKey(0)),
+        epochs_global,
+        labels_global,
+        np.ones(4, np.float32),
+    )
+
+    rng2 = np.random.RandomState(1)
+    sig_global = rng2.randn(2, 2048).astype(np.float32) * 30.0
+    tmesh = pmesh.make_mesh(4, axes=(pmesh.TIME_AXIS,))
+    extract = streaming.make_streaming_extractor(tmesh, window=512, stride=256)
+    ref_feats = extract(streaming.stage_recording(sig_global, tmesh))
+    ref_sum = float(np.asarray(ref_feats).sum())
+
+    assert outs[0]["loss"] == outs[1]["loss"]
+    assert outs[0]["stream_sum"] == outs[1]["stream_sum"]
+    np.testing.assert_allclose(outs[0]["loss"], float(ref_loss), rtol=1e-5)
+    assert outs[0]["stream_shape"] == list(ref_feats.shape) == [8, 32]
+    np.testing.assert_allclose(outs[0]["stream_sum"], ref_sum, rtol=1e-5)
