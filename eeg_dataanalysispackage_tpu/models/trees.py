@@ -17,7 +17,7 @@ Config surface parity:
   (DecisionTreeClassifier.java:103-120), else MLlib classification
   defaults (gini, maxDepth 5, maxBins 32, minInstances 1);
 - RF additionally requires ``config_num_trees`` and
-  ``config_feature_subset_strategy`` (auto|all|sqrt|log2|onethird;
+  ``config_feature_subset`` (auto|all|sqrt|log2|onethird;
   RandomForestClassifier.java:106-129), defaulting to numTrees=100,
   'auto' (RandomForestClassifier.java:132-135); bootstrap + subset
   sampling is seeded with MLlib's fixed seed 12345
