@@ -38,6 +38,34 @@ def _window_starts(block_len: int, stride: int) -> np.ndarray:
     return np.arange(0, block_len, stride)
 
 
+def _windowed_pipeline(
+    ext: jnp.ndarray,
+    window: int,
+    stride: int,
+    fmask: jnp.ndarray,
+    wavelet_index: int,
+    feature_count: int,
+) -> jnp.ndarray:
+    """(C, B+halo) extended block -> (B//stride, C*feature_count).
+
+    The one implementation of the per-window pipeline — gather windows
+    every ``stride`` samples, FFT band-pass, DWT coefficient prefix,
+    L2 normalize — shared by the mesh-sharded extractor and the
+    single-device blocked iterator so the two paths cannot diverge.
+    """
+    C, total = ext.shape
+    B = total - (window - stride)
+    starts = _window_starts(B, stride)
+    idx = starts[:, None] + np.arange(window)[None, :]  # (W, window)
+    wins = ext[:, idx]  # (C, W, window)
+    spec = jnp.fft.rfft(wins, axis=-1)
+    filtered = jnp.fft.irfft(spec * fmask, n=window, axis=-1).astype(ext.dtype)
+    W = starts.shape[0]
+    flat = filtered.transpose(1, 0, 2).reshape(W * C, window)
+    coeffs = dwt_xla.windowed_features(flat, wavelet_index, feature_count)
+    return dwt_xla.safe_l2_normalize(coeffs.reshape(W, C * feature_count))
+
+
 def make_streaming_extractor(
     mesh: Mesh,
     window: int = 512,
@@ -63,7 +91,6 @@ def make_streaming_extractor(
     n_shards = mesh.shape[axis]
 
     def block_fn(x_block):  # (C, B) on each device
-        C, B = x_block.shape
         # windows start at 0, stride, ..., B-stride; the last one ends
         # at B - stride + window, so only window - stride halo samples
         # are ever read from the right neighbor
@@ -74,23 +101,10 @@ def make_streaming_extractor(
         head = x_block[:, :halo]
         incoming = jax.lax.ppermute(head, axis, perm)
         ext = jnp.concatenate([x_block, incoming], axis=1)  # (C, B+halo)
-
-        starts = _window_starts(B, stride)
-        idx = starts[:, None] + np.arange(window)[None, :]  # (W, window)
-        wins = ext[:, idx]  # (C, W, window)
-        W = starts.shape[0]
-
-        # FFT band-pass per window
-        fmask = jnp.asarray(fmask_np)
-        spec = jnp.fft.rfft(wins, axis=-1)
-        filtered = jnp.fft.irfft(spec * fmask, n=window, axis=-1).astype(
-            x_block.dtype
+        return _windowed_pipeline(
+            ext, window, stride, jnp.asarray(fmask_np), wavelet_index,
+            feature_count,
         )
-
-        flat = filtered.transpose(1, 0, 2).reshape(W * C, window)
-        coeffs = dwt_xla.windowed_features(flat, wavelet_index, feature_count)
-        feats = coeffs.reshape(W, C * feature_count)
-        return dwt_xla.safe_l2_normalize(feats)
 
     sharded = jax.jit(
         shard_map(
@@ -125,6 +139,81 @@ def make_streaming_extractor(
         return sharded(signal)
 
     return extract
+
+
+@partial(jax.jit, static_argnums=(1, 2, 3, 4))
+def _chunk_features(chunk, window, stride, wavelet_index, feature_count, fmask):
+    """(C, block+halo) chunk -> (block//stride, C*feature_count)."""
+    return _windowed_pipeline(
+        chunk, window, stride, fmask, wavelet_index, feature_count
+    )
+
+
+def iter_blocked_features(
+    signal: np.ndarray,
+    window: int = 512,
+    stride: int = 256,
+    block: int = 8192,
+    fs: float = 1000.0,
+    band: tuple = (0.5, 40.0),
+    wavelet_index: int = 8,
+    feature_count: int = 16,
+):
+    """Bounded-memory streaming on ONE device: yield feature blocks.
+
+    The mesh version above shards a whole recording across devices; a
+    recording too long even for that streams here instead — the host
+    feeds ``block``-sample chunks (plus the ``window - stride`` halo
+    read from the next chunk) to a fixed-shape jitted program, so
+    device memory is O(block), independent of T. Windows are every
+    ``stride`` samples with the whole window in-bounds:
+    ``(T - window)//stride + 1`` rows total, no periodic wrap.
+
+    Yields (n_rows, C*feature_count) float32 arrays; concatenate for
+    the full matrix (:func:`blocked_features`).
+    """
+    if not 0 < stride <= window:
+        raise ValueError(f"stride {stride} must be in (0, window={window}]")
+    if block % stride != 0:
+        raise ValueError(f"block {block} must be a multiple of stride {stride}")
+    signal = np.asarray(signal)  # no copy/cast: may be a memmap view
+    C, T = signal.shape
+    if T < window:
+        return
+    halo = window - stride
+    fmask = jnp.asarray(bandpass_mask(window, fs, *band))
+    n_windows = (T - window) // stride + 1
+    emitted = 0
+    for start in range(0, T, block):
+        take = min(block // stride, n_windows - emitted)
+        if take <= 0:
+            break
+        # per-chunk cast keeps host memory O(block) even for f64/int
+        # memmapped sources
+        chunk = np.asarray(
+            signal[:, start : start + block + halo], dtype=np.float32
+        )
+        if chunk.shape[1] < block + halo:  # final chunk: zero-pad
+            chunk = np.pad(
+                chunk, ((0, 0), (0, block + halo - chunk.shape[1]))
+            )
+        feats = _chunk_features(
+            jnp.asarray(chunk), window, stride, wavelet_index, feature_count,
+            fmask,
+        )
+        emitted += take
+        yield np.asarray(feats)[:take]
+
+
+def blocked_features(signal: np.ndarray, **kwargs) -> np.ndarray:
+    """Concatenated :func:`iter_blocked_features` output:
+    ((T-window)//stride + 1, C*feature_count) float32."""
+    parts = list(iter_blocked_features(signal, **kwargs))
+    if not parts:
+        C = np.asarray(signal).shape[0]
+        f = kwargs.get("feature_count", 16)
+        return np.zeros((0, C * f), dtype=np.float32)
+    return np.concatenate(parts)
 
 
 def stage_recording(signal: np.ndarray, mesh: Mesh, axis: str = pmesh.TIME_AXIS):

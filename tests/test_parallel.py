@@ -118,3 +118,50 @@ def test_streaming_rejects_bad_stride():
         streaming.make_streaming_extractor(
             pmesh.make_mesh(1, axes=(pmesh.TIME_AXIS,)), window=256, stride=512
         )
+
+
+class TestBlockedStreaming:
+    """Single-device bounded-memory streaming (iter_blocked_features)."""
+
+    def _signal(self, C=3, T=4096 + 128, seed=11):
+        return (
+            np.random.RandomState(seed).randn(C, T).astype(np.float32) * 25.0
+        )
+
+    def test_block_size_invariance(self):
+        sig = self._signal()
+        whole = streaming.blocked_features(sig, block=8192)
+        small = streaming.blocked_features(sig, block=1024)
+        tiny = streaming.blocked_features(sig, block=256)
+        n_expected = (sig.shape[1] - 512) // 256 + 1
+        assert whole.shape == (n_expected, 48)
+        np.testing.assert_allclose(small, whole, rtol=0, atol=1e-6)
+        np.testing.assert_allclose(tiny, whole, rtol=0, atol=1e-6)
+
+    def test_first_window_matches_direct_math(self):
+        from eeg_dataanalysispackage_tpu.ops import dwt as dwt_xla
+        from eeg_dataanalysispackage_tpu.ops.signal import bandpass_mask
+
+        sig = self._signal(C=2)
+        got = streaming.blocked_features(sig, block=1024)[0]
+
+        win = sig[:, :512]
+        mask = np.asarray(bandpass_mask(512, 1000.0, 0.5, 40.0))
+        spec = np.fft.rfft(win, axis=-1)
+        filt = np.fft.irfft(spec * mask, n=512, axis=-1).astype(np.float32)
+        coeffs = np.asarray(
+            dwt_xla.windowed_features(jnp.asarray(filt), 8, 16)
+        ).reshape(-1)
+        want = coeffs / np.linalg.norm(coeffs)
+        np.testing.assert_allclose(got, want, rtol=0, atol=2e-5)
+
+    def test_short_and_invalid_inputs(self):
+        assert streaming.blocked_features(
+            np.zeros((2, 100), np.float32)
+        ).shape == (0, 32)
+        with pytest.raises(ValueError, match="multiple of stride"):
+            list(
+                streaming.iter_blocked_features(
+                    np.zeros((1, 2048), np.float32), block=1000
+                )
+            )
