@@ -165,3 +165,13 @@ class TestBlockedStreaming:
                     np.zeros((1, 2048), np.float32), block=1000
                 )
             )
+
+    def test_int16_source_ships_raw_and_scales_on_device(self):
+        rng = np.random.RandomState(2)
+        raw = rng.randint(-3000, 3000, size=(3, 2048 + 64)).astype(np.int16)
+        res = np.array([0.1, 0.5, 1.0], dtype=np.float32)
+        got = streaming.blocked_features(raw, block=1024, resolutions=res)
+        want = streaming.blocked_features(
+            raw.astype(np.float32) * res[:, None], block=1024
+        )
+        np.testing.assert_allclose(got, want, rtol=0, atol=2e-6)
