@@ -23,7 +23,9 @@ BASELINE_EPOCHS_PER_SEC = 50_000.0
 def main() -> None:
     from eeg_dataanalysispackage_tpu.ops import dwt as dwt_xla
 
-    batch = int(os.environ.get("BENCH_BATCH", 131072))
+    # 262144 epochs x 3x1000 f32 = 3.1 GB in HBM; measured ~6% more
+    # throughput than 131072 on v5e (39.7M vs 37.4M epochs/s)
+    batch = int(os.environ.get("BENCH_BATCH", 262144))
     iters = int(os.environ.get("BENCH_ITERS", 50))
 
     extract = dwt_xla.make_batched_extractor(

@@ -289,3 +289,76 @@ def test_parse_nul_and_surrogates_fall_back():
     assert native.parse_vhdr(surr) is None
     assert brainvision.parse_vhdr(surr).data_file == "a\udcffb.eeg"
     assert native.parse_vmrk(surr) is None
+
+
+@needs_native
+def test_parser_differential_fuzz():
+    """Deterministic differential fuzz: on random structured inputs the
+    native parse must either equal the Python parse or decline (None).
+    Alphabet stresses the INI edge cases: '=', ';', '[', ']', commas,
+    backslash-escapes, whitespace, CRLF, digits."""
+    import random
+
+    rng = random.Random(42)
+    # no bare "\r" in line bodies — it would make _native_parseable
+    # decline the whole input and skip the comparison; CRLF coverage
+    # comes from the per-line terminator choice below
+    tokens = list("ab=;[]\\,.0123456789 \t") + ["Ch", "Mk", "_", "#"]
+
+    def rand_line():
+        return "".join(
+            rng.choice(tokens) for _ in range(rng.randrange(0, 30))
+        )
+
+    sections = ["[Common Infos]", "[Channel Infos]", "[Marker Infos]",
+                "[Binary Infos]", "[junk]"]
+    native_parses = 0
+    for trial in range(300):
+        n = rng.randrange(0, 12)
+        lines = []
+        for _ in range(n):
+            r = rng.random()
+            if r < 0.2:
+                lines.append(rng.choice(sections))
+            elif r < 0.5:
+                lines.append(
+                    f"Ch{rng.randrange(0, 20)}=" + rand_line()
+                    if rng.random() < 0.5
+                    else f"Mk{rng.randrange(0, 20)}=" + rand_line()
+                )
+            else:
+                lines.append(rand_line())
+        text = "".join(
+            line + rng.choice(["\n", "\r\n"]) for line in lines
+        ) + rng.choice(["", "trailing no-newline"])
+
+        try:
+            want_h = brainvision.parse_vhdr_py(text)
+            err_h = None
+        except Exception as e:
+            want_h, err_h = None, e
+        got_h = native.parse_vhdr(text)
+        if got_h is not None:
+            native_parses += 1
+            assert err_h is None, (
+                f"trial {trial}: native parsed what Python rejects: "
+                f"{text!r} ({err_h})"
+            )
+            assert got_h == want_h, f"trial {trial}: vhdr mismatch on {text!r}"
+
+        try:
+            want_m = brainvision.parse_vmrk_py(text)
+            err_m = None
+        except Exception as e:
+            want_m, err_m = None, e
+        got_m = native.parse_vmrk(text)
+        if got_m is not None:
+            assert err_m is None, (
+                f"trial {trial}: native parsed what Python rejects: "
+                f"{text!r} ({err_m})"
+            )
+            assert got_m == want_m, f"trial {trial}: vmrk mismatch on {text!r}"
+
+    # the differential comparison must actually run — if the native
+    # side declines most inputs the test is vacuous
+    assert native_parses >= 200, f"only {native_parses}/300 native parses"
