@@ -1,0 +1,44 @@
+"""Train a P300 target/non-target classifier, two ways.
+
+Usage: python examples/train_p300.py [path/to/info.txt]
+(defaults to the reference fixture if present)
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_INFO = "/root/reference/test-data/infoTrain.txt"
+
+
+def main() -> None:
+    info = sys.argv[1] if len(sys.argv) > 1 else DEFAULT_INFO
+    if not os.path.exists(info):
+        sys.exit(f"info.txt not found: {info}")
+
+    # --- way 1: the reference's query-string surface -----------------
+    from eeg_dataanalysispackage_tpu.pipeline import builder
+
+    stats = builder.PipelineBuilder(
+        f"info_file={info}&fe=dwt-8-tpu&train_clf=logreg"
+        "&config_num_iterations=100&config_step_size=1.0"
+        "&config_mini_batch_fraction=1.0"
+    ).execute()
+    print("query-string pipeline:")
+    print(stats)
+
+    # --- way 2: the library API with the TPU fast path ---------------
+    from eeg_dataanalysispackage_tpu.io import provider
+    from eeg_dataanalysispackage_tpu.models import registry as clf_registry
+
+    features, targets = provider.OfflineDataProvider(
+        [info]
+    ).load_features_device()
+    clf = clf_registry.create("logreg")
+    clf.fit(features, targets)
+    print("fused device path:", clf.test_features(features, targets))
+
+
+if __name__ == "__main__":
+    main()

@@ -312,7 +312,7 @@ def test_parser_differential_fuzz():
 
     sections = ["[Common Infos]", "[Channel Infos]", "[Marker Infos]",
                 "[Binary Infos]", "[junk]"]
-    native_parses = 0
+    native_parses = vmrk_parses = 0
     for trial in range(300):
         n = rng.randrange(0, 12)
         lines = []
@@ -353,6 +353,7 @@ def test_parser_differential_fuzz():
             want_m, err_m = None, e
         got_m = native.parse_vmrk(text)
         if got_m is not None:
+            vmrk_parses += 1
             assert err_m is None, (
                 f"trial {trial}: native parsed what Python rejects: "
                 f"{text!r} ({err_m})"
@@ -361,4 +362,5 @@ def test_parser_differential_fuzz():
 
     # the differential comparison must actually run — if the native
     # side declines most inputs the test is vacuous
-    assert native_parses >= 200, f"only {native_parses}/300 native parses"
+    assert native_parses >= 200, f"only {native_parses}/300 vhdr parses"
+    assert vmrk_parses >= 200, f"only {vmrk_parses}/300 vmrk parses"
