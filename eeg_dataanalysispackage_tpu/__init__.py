@@ -8,23 +8,31 @@ target/non-target classification, rebuilt TPU-first.
 
 Layer map (mirrors SURVEY.md section 7):
 
-- ``io``        BrainVision vhdr/vmrk/eeg parsing, info.txt sources,
-                host staging (native C++ demux when built).
+- ``io``        BrainVision vhdr/vmrk/eeg parsing (C++ parsers/demux
+                when built), info.txt sources, pluggable filesystem,
+                host->device prefetch staging, CSV/text export.
 - ``epochs``    marker -> window gather, baseline correction, the
                 order-dependent target/non-target balance scan.
-- ``ops``       numeric kernels: db8 DWT (host-parity and batched XLA
-                variants), baseline, normalization, FFT band-pass.
-- ``features``  the ``fe=`` plugin registry (dwt-8, dwt-8-tpu).
+- ``ops``       numeric kernels: eegdsp-parity DWT (host f64, batched
+                XLA einsum, Pallas), signal utils, and the fused
+                on-device ingest (``device_ingest``).
+- ``features``  the ``fe=`` plugin registry (dwt-<0..17>, -tpu,
+                -pallas backends).
 - ``models``    the ``train_clf=`` plugin registry (logreg, svm, dt,
-                rf, nn) + classification statistics.
-- ``parallel``  jax.sharding Mesh construction, data-parallel batch
-                sharding, collective-based SGD.
+                rf, nn, gbt, dt/rf-tpu on-device growth) +
+                classification statistics.
+- ``parallel``  jax.sharding Mesh construction, data-parallel train
+                step, multi-host DCN x ICI runtime (``distributed``),
+                sequence-parallel + bounded-memory streaming
+                (``streaming``).
 - ``pipeline``  query-string DSL front end (parity with the reference
-                run-time configuration surface) + CLI.
+                run-time configuration surface; ``fe=dwt-8-fused``
+                fast path) + CLI.
 - ``utils``     Java interop shims (java.util.Random / shuffle for
-                split parity), config handling.
-- ``checkpoint`` model/optimizer persistence.
-- ``obs``       profiling hooks, stage timers, metrics.
+                split parity), constants.
+- ``checkpoint`` step-numbered pytree checkpoints + model persistence.
+- ``obs``       profiling/trace hooks, stage timers, metrics, failure
+                detection + elastic recovery.
 """
 
 __version__ = "0.1.0"

@@ -135,6 +135,24 @@ def annotate(name: str) -> Iterator[None]:
         yield
 
 
+def save_memory_profile(path: str) -> bool:
+    """Snapshot live device-memory allocations to ``path`` (pprof
+    format, ``jax.profiler.save_device_memory_profile``). Returns
+    False when the backend does not support memory profiling instead
+    of raising — callers treat it as best-effort observability.
+    """
+    try:
+        import jax.profiler as jp
+
+        jp.save_device_memory_profile(path)
+        return True
+    except Exception as e:
+        logging.getLogger(__name__).warning(
+            "device memory profile unavailable (%s): %s", path, e
+        )
+        return False
+
+
 def configure_logging(
     level: int = logging.INFO,
     logfile: Optional[str] = None,

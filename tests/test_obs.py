@@ -76,3 +76,16 @@ def test_pipeline_records_stage_timings(fixture_dir, tmp_path):
     d = pb.timers.as_dict()
     assert {"ingest", "train", "test"} <= set(d)
     assert all(v["seconds"] > 0 for v in d.values())
+
+
+def test_save_memory_profile(tmp_path):
+    import jax.numpy as jnp
+
+    from eeg_dataanalysispackage_tpu import obs
+
+    _ = jnp.ones(128) + 1  # ensure a live allocation
+    path = tmp_path / "mem.prof"
+    ok = obs.save_memory_profile(str(path))
+    if not ok:
+        pytest.skip("backend lacks device memory profiling")
+    assert path.exists() and path.stat().st_size > 0
