@@ -4,29 +4,117 @@ The BASELINE.json headline metric: (3ch x 1000samp) epochs through the
 batched eegdsp-parity DWT feature extractor (slice [175,687) -> 6-level
 db10 cascade -> 48-dim L2-normalized features), target >= 50,000
 epochs/sec on one TPU v5e chip. Prints exactly one JSON line.
+
+Resilience contract (round-1 BENCH artifact died rc=1 on a single
+``Unable to initialize backend 'axon': UNAVAILABLE``): the parent
+process never touches JAX. It probes the TPU backend in a
+timeout-guarded subprocess with bounded backoff; when the backend
+comes up, the measurement itself runs in a fresh child with its own
+deadline. If the TPU never becomes available within the retry budget,
+the same measurement runs on CPU and the JSON line says so via
+``"platform": "cpu_fallback"`` — a parseable, honest number instead of
+a dead artifact.
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-
-import jax
-import jax.numpy as jnp
-import numpy as np
+_REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _REPO_ROOT)
 
 BASELINE_EPOCHS_PER_SEC = 50_000.0
 
+# Backend probe schedule: attempt, then sleep; total budget ~4 min.
+_PROBE_TIMEOUT_S = 75
+_PROBE_SLEEPS_S = (10, 20, 40, 60)
+# One real-chip measurement (includes ~20-40s first compile).
+_RUN_TIMEOUT_S = int(os.environ.get("BENCH_RUN_TIMEOUT", 420))
 
-def main() -> None:
+
+def _probe_tpu_once() -> bool:
+    """True iff a fresh interpreter can enumerate the axon devices."""
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import jax; d = jax.devices(); "
+                "print(d[0].platform, len(d))",
+            ],
+            timeout=_PROBE_TIMEOUT_S,
+            capture_output=True,
+            text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    return proc.returncode == 0
+
+
+def _tpu_available() -> bool:
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        return False
+    for i, sleep_s in enumerate((*_PROBE_SLEEPS_S, 0)):
+        if _probe_tpu_once():
+            return True
+        print(
+            f"bench: TPU probe {i + 1} failed; "
+            f"retrying in {sleep_s}s" if sleep_s else "bench: TPU unavailable",
+            file=sys.stderr,
+        )
+        if sleep_s:
+            time.sleep(sleep_s)
+    return False
+
+
+def _cpu_env() -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # axon hook never registers
+    env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _run_child(platform: str) -> dict:
+    """Run the measurement in a fresh child; returns the parsed JSON."""
+    if platform == "tpu":
+        env = dict(os.environ)
+        env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    else:
+        env = _cpu_env()
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child"],
+        timeout=_RUN_TIMEOUT_S,
+        capture_output=True,
+        text=True,
+        env=env,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"bench child rc={proc.returncode}\n{proc.stderr[-2000:]}"
+        )
+    # last stdout line is the JSON payload
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def _measure() -> dict:
+    """The measurement body (child process; JAX is safe to touch here)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
     from eeg_dataanalysispackage_tpu.ops import dwt as dwt_xla
 
+    platform = jax.devices()[0].platform
+    on_tpu = platform not in ("cpu",)
+
     # 262144 epochs x 3x1000 f32 = 3.1 GB in HBM; measured ~6% more
-    # throughput than 131072 on v5e (39.7M vs 37.4M epochs/s)
-    batch = int(os.environ.get("BENCH_BATCH", 262144))
-    iters = int(os.environ.get("BENCH_ITERS", 50))
+    # throughput than 131072 on v5e (39.7M vs 37.4M epochs/s). CPU
+    # fallback uses a small batch so the artifact stays fast.
+    batch = int(os.environ.get("BENCH_BATCH", 262144 if on_tpu else 8192))
+    iters = int(os.environ.get("BENCH_ITERS", 50 if on_tpu else 5))
 
     extract = dwt_xla.make_batched_extractor(
         wavelet_index=8, epoch_size=512, skip_samples=175, feature_size=16
@@ -55,17 +143,31 @@ def main() -> None:
     assert np.isfinite(checksum), "non-finite checksum"
 
     eps = batch * iters / elapsed
-    print(
-        json.dumps(
-            {
-                "metric": "epochs/sec (3ch×1000samp) through dwt-8 feature extraction",
-                "value": round(eps, 1),
-                "unit": "epochs/s",
-                "vs_baseline": round(eps / BASELINE_EPOCHS_PER_SEC, 3),
-            }
-        )
-    )
+    payload = {
+        "metric": "epochs/sec (3ch×1000samp) through dwt-8 feature extraction",
+        "value": round(eps, 1),
+        "unit": "epochs/s",
+        "vs_baseline": round(eps / BASELINE_EPOCHS_PER_SEC, 3),
+    }
+    if not on_tpu:
+        payload["platform"] = "cpu_fallback"
+    return payload
+
+
+def main() -> None:
+    if _tpu_available():
+        try:
+            payload = _run_child("tpu")
+        except (RuntimeError, subprocess.TimeoutExpired, ValueError) as e:
+            print(f"bench: TPU run failed ({e}); CPU fallback", file=sys.stderr)
+            payload = _run_child("cpu")
+    else:
+        payload = _run_child("cpu")
+    print(json.dumps(payload))
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        print(json.dumps(_measure()))
+    else:
+        main()

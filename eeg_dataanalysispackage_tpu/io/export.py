@@ -3,13 +3,17 @@
 ``writeEpochsToCSV`` dumps channel Pz (``epoch[2]``) of every epoch as
 a comma-separated row with a trailing comma (DataProviderUtils.java:30-47;
 the ``Epochs.csv`` artifact at the reference repo root is its output).
-Number formatting uses Python's shortest-roundtrip repr, which parses
-back to the same float64 bits as Java's ``Double.toString`` output.
+Numbers are formatted with ``utils.java_compat.java_double_to_string``
+— ``Double.toString`` semantics — so output diffs byte-exactly against
+reference artifacts (modulo the documented pre-JDK-19 shortest-digit
+cases, which parse equal).
 """
 
 from __future__ import annotations
 
 import numpy as np
+
+from ..utils.java_compat import java_double_to_string
 
 
 def write_epochs_to_csv(
@@ -19,7 +23,7 @@ def write_epochs_to_csv(
     arr = np.asarray(epochs, dtype=np.float64)
     with open(path, "w") as f:
         for row in arr[:, channel, :]:
-            f.write("".join(f"{float(v)!r}," for v in row))
+            f.write("".join(f"{java_double_to_string(v)}," for v in row))
             f.write("\n")
 
 
@@ -31,14 +35,17 @@ def write_channel_text(
     The equivalent of the reference's raw-read smoke path
     (HadoopLoadingTest.tryRAWEEG, HadoopLoadingTest.java:56-119: read
     a channel, ``sc.parallelize``, ``saveAsTextFile`` back to storage)
-    — here a straight write through the pluggable filesystem.
+    — here a straight write through the pluggable filesystem, with
+    ``Double.toString`` number formatting for byte parity with
+    ``saveAsTextFile`` artifacts.
     """
     from . import sources
 
     fs = filesystem or sources.LocalFileSystem()
     arr = np.asarray(channel, dtype=np.float64).ravel()
     fs.write_bytes(
-        path, "".join(f"{float(v)!r}\n" for v in arr).encode("ascii")
+        path,
+        "".join(f"{java_double_to_string(v)}\n" for v in arr).encode("ascii"),
     )
 
 

@@ -60,6 +60,15 @@ def _impurity(counts: jnp.ndarray, kind: str) -> jnp.ndarray:
 MAX_DEVICE_DEPTH = 12
 
 
+def _check_device_depth(max_depth: int) -> None:
+    if max_depth > MAX_DEVICE_DEPTH:
+        raise ValueError(
+            f"device tree backend supports max_depth <= {MAX_DEVICE_DEPTH} "
+            f"(heap storage is 2^(depth+1)-1 slots); got {max_depth} — "
+            "use backend='host' for deeper trees"
+        )
+
+
 def draw_feature_masks(
     n_trees: int,
     n_nodes: int,
@@ -227,12 +236,7 @@ def grow_forest(
     bootstrap view, so peak memory is the chunk's (n, d*max_bins) bin
     one-hots — ``tree_chunk * n * d * max_bins * 4`` bytes — never a
     dense (T, n, d) replica of the training set."""
-    if max_depth > MAX_DEVICE_DEPTH:
-        raise ValueError(
-            f"device tree backend supports max_depth <= {MAX_DEVICE_DEPTH} "
-            f"(heap storage is 2^(depth+1)-1 slots); got {max_depth} — "
-            "use backend='host' for deeper trees"
-        )
+    _check_device_depth(max_depth)
 
     def grow(args):
         boot, fm = args
@@ -251,6 +255,80 @@ def grow_forest(
         (bootstrap, feature_masks),
         batch_size=min(tree_chunk, bootstrap.shape[0]),
     )
+
+
+def grow_forest_sharded(
+    binned: np.ndarray,  # (n, d) int32 — the base (un-bootstrapped) data
+    labels: np.ndarray,  # (n,) int32
+    bootstrap: np.ndarray,  # (T, n) int32 sample indices per tree
+    feature_masks: np.ndarray,  # (T, internal nodes, d) bool
+    *,
+    mesh,
+    max_bins: int,
+    impurity: str,
+    max_depth: int,
+    min_instances: int,
+) -> Dict[str, jnp.ndarray]:
+    """Tree-parallel forest growth over a device mesh.
+
+    The forest axis is the natural parallel dimension (MLlib grows
+    trees as independent jobs, RandomForest.scala via
+    ``RandomForestClassifier.java:104``); here each device grows
+    ``T / n_devices`` trees of the same vmapped program: bootstrap
+    indices and feature masks are sharded over the mesh's first axis,
+    the (n, d) dataset and labels are replicated, and XLA runs the
+    per-tree histogram growth with zero cross-device traffic until the
+    caller gathers the heap arrays. ``T`` is padded up to a multiple
+    of the mesh size with repeat trees, then trimmed, so any
+    ``config_num_trees`` works on any mesh.
+    """
+    _check_device_depth(max_depth)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    axis = mesh.axis_names[0]
+    n_dev = mesh.devices.shape[0]
+    T = bootstrap.shape[0]
+    pad = (-T) % n_dev
+    if pad:
+        bootstrap = np.concatenate([bootstrap, bootstrap[:pad]], axis=0)
+        feature_masks = np.concatenate(
+            [feature_masks, feature_masks[:pad]], axis=0
+        )
+    shard = NamedSharding(mesh, P(axis))
+    repl = NamedSharding(mesh, P())
+    forest = _grow_all_vmapped(
+        jax.device_put(jnp.asarray(binned, jnp.int32), repl),
+        jax.device_put(jnp.asarray(labels, jnp.int32), repl),
+        jax.device_put(jnp.asarray(bootstrap, jnp.int32), shard),
+        jax.device_put(jnp.asarray(feature_masks), shard),
+        max_bins=max_bins,
+        impurity=impurity,
+        max_depth=max_depth,
+        min_instances=min_instances,
+    )
+    return {k: v[:T] for k, v in forest.items()}
+
+
+@partial(
+    jax.jit,
+    static_argnames=("max_bins", "impurity", "max_depth", "min_instances"),
+)
+def _grow_all_vmapped(
+    binned, labels, bootstrap, feature_masks, *, max_bins, impurity,
+    max_depth, min_instances,
+):
+    def grow(boot_i, fm_i):
+        return _grow_one(
+            jnp.take(binned, boot_i, axis=0),
+            jnp.take(labels, boot_i),
+            fm_i,
+            max_bins=max_bins,
+            impurity=impurity,
+            max_depth=max_depth,
+            min_instances=min_instances,
+        )
+
+    return jax.vmap(grow)(bootstrap, feature_masks)
 
 
 @partial(jax.jit, static_argnames=("max_depth",))

@@ -131,6 +131,12 @@ def plan_ingest(
     keep = balance.scan(is_target)
 
     kept = positions[keep]
+    if kept.size and kept.max() > np.iinfo(np.int32).max:
+        raise ValueError(
+            f"marker position {int(kept.max())} exceeds int32 range; "
+            "corrupt .vmrk? The host path (epochs/extractor.py) stays "
+            "int64 — use it for recordings this long."
+        )
     capacity = _round_capacity(kept.shape[0], capacity_multiple)
     padded = np.zeros(capacity, dtype=np.int32)
     padded[: kept.shape[0]] = kept

@@ -183,3 +183,52 @@ def test_config_backend_key_selects_device():
     # split root is node 1
     if clf.trees[0]["feature"][0] >= 0:
         assert clf.trees[0]["left"][0] == 1
+
+
+def test_grow_forest_sharded_matches_unsharded():
+    """Tree-parallel growth over the mesh produces the exact same
+    forest as the single-device lax.map path, including when T is not
+    a multiple of the mesh size (pad-with-repeats then trim)."""
+    import jax
+    import jax.numpy as jnp
+
+    from eeg_dataanalysispackage_tpu.parallel import mesh as pmesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    x, y = _toy(n=200)
+    edges = trees.compute_bin_edges(x, 16)
+    binned = trees.bin_features(x, edges)
+    yi = y.astype(np.int64)
+    mesh = pmesh.make_mesh(8)
+    for T in (8, 11):  # even and ragged tree counts
+        rng = np.random.RandomState(12345)
+        boot = rng.randint(0, len(y), size=(T, len(y)))
+        masks = trees_device.draw_feature_masks(
+            T, trees_device.n_heap_nodes(3), 6, 3
+        )
+        ref = trees_device.grow_forest(
+            jnp.asarray(binned, jnp.int32),
+            jnp.asarray(yi, jnp.int32),
+            jnp.asarray(boot, jnp.int32),
+            jnp.asarray(masks),
+            max_bins=16,
+            impurity="gini",
+            max_depth=4,
+            min_instances=1,
+        )
+        sharded = trees_device.grow_forest_sharded(
+            binned,
+            yi,
+            boot,
+            masks,
+            mesh=mesh,
+            max_bins=16,
+            impurity="gini",
+            max_depth=4,
+            min_instances=1,
+        )
+        for k in ref:
+            np.testing.assert_array_equal(
+                np.asarray(ref[k]), np.asarray(sharded[k]), err_msg=k
+            )
