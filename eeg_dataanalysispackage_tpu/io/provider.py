@@ -51,7 +51,14 @@ class OfflineDataProvider:
                 "2. <location of a .eeg file> <guessed number> *<optional values>"
             )
         self._args = list(args)
-        self._fs = filesystem or sources.LocalFileSystem()
+        if filesystem is None:
+            # URI-scheme routing (Const.java's fixed HDFS endpoint,
+            # made pluggable): info_file=https://... or gs://... runs
+            # the whole provider over the remote object store.
+            from . import remote
+
+            filesystem = remote.filesystem_for(self._args[0])
+        self._fs = filesystem
         self._channel_names = [c.lower() for c in channel_names]
         self._pre = pre
         self._post = post

@@ -33,21 +33,28 @@ class FileSystem(Protocol):
 
 
 class LocalFileSystem:
-    """POSIX filesystem."""
+    """POSIX filesystem (``file://`` URIs tolerated, like the
+    reference's path handling)."""
+
+    @staticmethod
+    def _strip(path: str) -> str:
+        return path[len("file://") :] if path.startswith("file://") else path
 
     def exists(self, path: str) -> bool:
-        return os.path.exists(path)
+        return os.path.exists(self._strip(path))
 
     def read_bytes(self, path: str) -> bytes:
-        with open(path, "rb") as f:
+        with open(self._strip(path), "rb") as f:
             return f.read()
 
     def read_text(self, path: str) -> str:
-        with open(path, "r", encoding="utf-8", errors="replace") as f:
+        with open(
+            self._strip(path), "r", encoding="utf-8", errors="replace"
+        ) as f:
             return f.read()
 
     def write_bytes(self, path: str, data: bytes) -> None:
-        with open(path, "wb") as f:
+        with open(self._strip(path), "wb") as f:
             f.write(data)
 
 

@@ -45,7 +45,9 @@ class PipelineBuilder:
         filesystem: Optional[sources.FileSystem] = None,
     ):
         self.query = query
-        self._fs = filesystem or sources.LocalFileSystem()
+        # None = route by the input URI scheme (http/gs/file/local) in
+        # the provider; an explicit filesystem overrides routing.
+        self._fs = filesystem
         self.statistics: Optional[stats.ClassificationStatistics] = None
         #: per-stage wall times for the run (obs.StageTimer)
         self.timers = obs.StageTimer()

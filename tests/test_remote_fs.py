@@ -1,0 +1,355 @@
+"""Remote filesystem: retry/resume semantics + provider/pipeline over HTTP.
+
+The mock server is a real ``http.server`` on 127.0.0.1 with failure
+injection (transient 500s, mid-body truncation, Range-ignoring mode),
+so the full client machinery — bounded retries with backoff, chunked
+ranged reads, resume-after-drop, 404 skip — is exercised hermetically.
+The end-to-end tests serve the reference BrainVision fixtures and run
+the provider and the whole pipeline with ``info_file=http://...``
+(the reference's HDFS-borne flow, OffLineDataProvider.java:90).
+"""
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from eeg_dataanalysispackage_tpu.io import provider, remote, sources
+
+
+class _Store:
+    """Shared state between the test and the handler threads."""
+
+    def __init__(self):
+        self.files = {}
+        self.fail_next = 0  # respond 500 to this many requests
+        self.truncate_next = 0  # send half the promised body, then drop
+        self.ignore_range = False  # pretend Range is not supported
+        self.unknown_total = False  # Content-Range: bytes x-y/* (RFC 7233)
+        self.no_head = False  # 405 on HEAD (object stores without HEAD)
+        self.requests = []
+
+
+class _Handler(BaseHTTPRequestHandler):
+    store: _Store
+    protocol_version = "HTTP/1.1"  # keep-alive: exercises conn reuse
+
+    def log_message(self, *args):  # silence
+        pass
+
+    def _object(self):
+        return self.store.files.get(self.path)
+
+    def _common(self, method: str):
+        self.store.requests.append((method, self.path))
+        if self.store.fail_next > 0:
+            self.store.fail_next -= 1
+            self.send_response(500)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return None
+        data = self._object()
+        if data is None:
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return None
+        return data
+
+    def do_HEAD(self):
+        if self.store.no_head:
+            self.store.requests.append(("HEAD", self.path))
+            self.send_response(405)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        data = self._common("HEAD")
+        if data is None:
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+
+    def do_GET(self):
+        data = self._common("GET")
+        if data is None:
+            return
+        rng = self.headers.get("Range")
+        if rng and not self.store.ignore_range:
+            spec = rng.split("=")[1]
+            start_s, end_s = spec.split("-")
+            start = int(start_s)
+            end = min(int(end_s), len(data) - 1) if end_s else len(data) - 1
+            if start >= len(data):
+                self.send_response(416)
+                self.send_header("Content-Range", f"bytes */{len(data)}")
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            body = data[start : end + 1]
+            total = "*" if self.store.unknown_total else str(len(data))
+            self.send_response(206)
+            self.send_header("Content-Range", f"bytes {start}-{end}/{total}")
+        else:
+            body = data
+            self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if self.store.truncate_next > 0 and len(body) > 1:
+            self.store.truncate_next -= 1
+            self.wfile.write(body[: len(body) // 2])
+            self.wfile.flush()
+            self.connection.close()
+            return
+        self.wfile.write(body)
+
+    def do_PUT(self):
+        self.store.requests.append(("PUT", self.path))
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        if self.store.fail_next > 0:
+            self.store.fail_next -= 1
+            self.send_response(503)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        self.store.files[self.path] = body
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+
+@pytest.fixture()
+def server():
+    store = _Store()
+    handler = type("Handler", (_Handler,), {"store": store})
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        yield base, store
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def _fast_retry():
+    return remote.RetryPolicy(max_attempts=4, timeout_s=5.0, backoff_s=0.01)
+
+
+def _fs(base, **kw):
+    return remote.HttpFileSystem(base_url=base, retry=_fast_retry(), **kw)
+
+
+def test_basic_read_write_exists(server):
+    base, store = server
+    fs = _fs(base)
+    assert not fs.exists(f"{base}/a.txt")
+    fs.write_bytes(f"{base}/a.txt", b"hello remote")
+    assert fs.exists(f"{base}/a.txt")
+    assert fs.read_bytes(f"{base}/a.txt") == b"hello remote"
+    assert fs.read_text(f"{base}/a.txt") == "hello remote"
+
+
+def test_missing_object_raises_filenotfound(server):
+    base, _ = server
+    with pytest.raises(FileNotFoundError):
+        _fs(base).read_bytes(f"{base}/nope.bin")
+
+
+def test_chunked_ranged_read_reassembles(server):
+    base, store = server
+    payload = bytes(range(256)) * 1000  # 256000 B
+    store.files["/blob.bin"] = payload
+    fs = _fs(base, chunk_size=10_000)
+    assert fs.read_bytes(f"{base}/blob.bin") == payload
+    gets = [p for m, p in store.requests if m == "GET"]
+    assert len(gets) == 26  # ceil(256000 / 10000)
+
+
+def test_transient_500s_are_retried(server):
+    base, store = server
+    store.files["/flaky.bin"] = b"x" * 100
+    store.fail_next = 2
+    assert _fs(base).read_bytes(f"{base}/flaky.bin") == b"x" * 100
+
+
+def test_retry_budget_exhausts_loudly(server):
+    base, store = server
+    store.files["/dead.bin"] = b"x"
+    store.fail_next = 99
+    with pytest.raises(remote.RemoteIOError, match="after 4 attempts"):
+        _fs(base).read_bytes(f"{base}/dead.bin")
+
+
+def test_mid_body_truncation_resumes(server):
+    base, store = server
+    payload = np.random.RandomState(0).bytes(50_000)
+    store.files["/drop.bin"] = payload
+    store.truncate_next = 2  # first two chunk bodies die halfway
+    fs = _fs(base, chunk_size=20_000)
+    assert fs.read_bytes(f"{base}/drop.bin") == payload
+
+
+def test_server_without_range_support(server):
+    base, store = server
+    payload = b"y" * 30_000
+    store.files["/whole.bin"] = payload
+    store.ignore_range = True
+    fs = _fs(base, chunk_size=1_000)
+    assert fs.read_bytes(f"{base}/whole.bin") == payload
+
+
+def test_read_range_block_read(server):
+    base, store = server
+    store.files["/blk.bin"] = bytes(range(200))
+    assert _fs(base).read_range(f"{base}/blk.bin", 10, 5) == bytes(
+        range(10, 15)
+    )
+
+
+def test_empty_object(server):
+    base, store = server
+    store.files["/empty.bin"] = b""
+    fs = _fs(base)
+    assert fs.exists(f"{base}/empty.bin")
+    assert fs.read_bytes(f"{base}/empty.bin") == b""
+
+
+def test_unknown_total_content_range(server):
+    """'Content-Range: bytes x-y/*' (RFC 7233 unknown length): the
+    short-chunk / 416-at-EOF heuristics still reassemble the object."""
+    base, store = server
+    for size in (25_000, 30_000):  # short-final-chunk and exact-multiple
+        store.files["/u.bin"] = np.random.RandomState(size).bytes(size)
+        store.unknown_total = True
+        fs = _fs(base, chunk_size=10_000)
+        assert fs.read_bytes(f"{base}/u.bin") == store.files["/u.bin"]
+
+
+def test_headless_endpoint_exists_including_empty(server):
+    base, store = server
+    store.no_head = True
+    store.files["/some.bin"] = b"data"
+    store.files["/empty.bin"] = b""
+    fs = _fs(base)
+    assert fs.exists(f"{base}/some.bin")
+    assert fs.exists(f"{base}/empty.bin")  # 416 on 1-byte probe = exists
+    assert not fs.exists(f"{base}/nope.bin")
+
+
+def test_connection_reuse_across_chunks(server):
+    base, store = server
+    store.files["/r.bin"] = b"q" * 50_000
+    fs = _fs(base, chunk_size=10_000)
+    fs.read_bytes(f"{base}/r.bin")
+    assert len(fs._conns) == 1  # one keep-alive conn, reused 5x
+    conn = next(iter(fs._conns.values()))
+    fs.read_bytes(f"{base}/r.bin")
+    assert next(iter(fs._conns.values())) is conn
+
+
+def test_gcs_uri_maps_to_endpoint(server):
+    base, store = server
+    store.files["/bucket/obj.txt"] = b"in the bucket"
+    fs = remote.GcsFileSystem(endpoint=base, retry=_fast_retry())
+    assert fs.read_bytes("gs://bucket/obj.txt") == b"in the bucket"
+    assert fs.exists("gs://bucket/obj.txt")
+
+
+def test_gcs_token_sets_bearer_header(server):
+    base, store = server
+    store.files["/b/o"] = b"z"
+    fs = remote.GcsFileSystem(endpoint=base, token="tok123", retry=_fast_retry())
+    assert fs.headers["Authorization"] == "Bearer tok123"
+    assert fs.read_bytes("gs://b/o") == b"z"
+
+
+def test_filesystem_for_routing():
+    assert isinstance(
+        remote.filesystem_for("http://x/info.txt"), remote.HttpFileSystem
+    )
+    assert isinstance(
+        remote.filesystem_for("gs://b/info.txt"), remote.GcsFileSystem
+    )
+    assert isinstance(
+        remote.filesystem_for("/local/info.txt"), sources.LocalFileSystem
+    )
+    assert isinstance(
+        remote.filesystem_for("file:///local/info.txt"),
+        sources.LocalFileSystem,
+    )
+
+
+def test_local_file_uri_tolerated(tmp_path):
+    p = tmp_path / "x.txt"
+    p.write_bytes(b"local")
+    fs = sources.LocalFileSystem()
+    assert fs.exists(f"file://{p}")
+    assert fs.read_bytes(f"file://{p}") == b"local"
+
+
+# -- end to end over the reference fixtures ---------------------------
+
+
+def _serve_fixture(store, fixture_dir):
+    names = [
+        "infoTrain.txt",
+        "DoD/DoD2015_01.eeg",
+        "DoD/DoD2015_01.vhdr",
+        "DoD/DoD2015_01.vmrk",
+    ]
+    for name in names:
+        with open(f"{fixture_dir}/{name}", "rb") as f:
+            store.files[f"/data/{name}"] = f.read()
+
+
+def test_provider_over_http_matches_local(server, fixture_dir):
+    base, store = server
+    _serve_fixture(store, fixture_dir)
+    fs = _fs(base, chunk_size=1 << 20)
+    batch_http = provider.OfflineDataProvider(
+        [f"{base}/data/infoTrain.txt"], filesystem=fs
+    ).load()
+    batch_local = provider.OfflineDataProvider(
+        [f"{fixture_dir}/infoTrain.txt"]
+    ).load()
+    np.testing.assert_array_equal(batch_http.epochs, batch_local.epochs)
+    np.testing.assert_array_equal(batch_http.targets, batch_local.targets)
+
+
+def test_provider_over_http_default_routing(server, fixture_dir):
+    """No explicit filesystem: the URI scheme selects HttpFileSystem."""
+    base, store = server
+    _serve_fixture(store, fixture_dir)
+    batch = provider.OfflineDataProvider([f"{base}/data/infoTrain.txt"]).load()
+    assert batch.epochs.shape[0] > 0
+
+
+def test_provider_over_http_skips_missing_files(server, fixture_dir):
+    base, store = server
+    _serve_fixture(store, fixture_dir)
+    info = store.files["/data/infoTrain.txt"] + b"missing/gone.eeg 3 1\n"
+    store.files["/data/infoTrain.txt"] = info
+    fs = _fs(base)
+    batch = provider.OfflineDataProvider(
+        [f"{base}/data/infoTrain.txt"], filesystem=fs
+    ).load()
+    assert batch.epochs.shape == (11, 3, 750)  # the missing file skipped
+
+
+def test_pipeline_over_http_end_to_end(server, fixture_dir, tmp_path):
+    """info_file=http://... through the full pipeline query DSL."""
+    from eeg_dataanalysispackage_tpu.pipeline import builder
+
+    base, store = server
+    _serve_fixture(store, fixture_dir)
+    result_path = str(tmp_path / "result.txt")
+    builder.PipelineBuilder(
+        f"info_file={base}/data/infoTrain.txt&fe=dwt-8&train_clf=logreg"
+        f"&result_path={result_path}"
+    ).execute()
+    text = open(result_path).read()
+    assert "Accuracy" in text
