@@ -4,8 +4,10 @@ Parity surfaces of ``Classification/LogisticRegressionClassifier.java``
 and ``Classification/SVMClassifier.java``: the same ``config_*`` keys
 gate custom vs default hyperparameters exactly as the reference's
 all-present checks do (LogisticRegressionClassifier.java:104-112,
-SVMClassifier.java:95-109); prediction thresholds match MLlib
-(logreg: sigmoid >= 0.5, i.e. margin >= 0; svm: margin >= 0).
+SVMClassifier.java:95-109); prediction thresholds match MLlib's
+strict comparisons (logreg: sigmoid(margin) > 0.5 i.e. margin > 0,
+``LogisticRegressionModel.predictPoint``; svm: margin > 0,
+``SVMModel.predictPoint`` — both predict 0.0 at exactly threshold).
 
 Model persistence is a single ``.npz`` with weights + config instead
 of MLlib's parquet+json directories.
@@ -44,7 +46,7 @@ class _LinearClassifier(base.Classifier):
                 np.asarray(features, dtype=np.float32), self.weights
             )
         )
-        return (margin >= 0.0).astype(np.float64)
+        return (margin > 0.0).astype(np.float64)
 
     def save(self, path: str) -> None:
         # The reference deletes any existing save target first

@@ -1,0 +1,144 @@
+"""Exact float64 host emulation of Spark MLlib 1.6 ``GradientDescent``.
+
+The device path (``models/sgd.py``) is the production engine: one f32
+XLA program per training run. This module is its *oracle*: a plain
+NumPy float64 re-enactment of what the reference's JVM actually
+computes when ``ClassifierTest.java:98-105`` runs
+``new LogisticRegressionWithSGD().run(rdd)`` — every operation in the
+order MLlib 1.6.2's ``GradientDescent.runMiniBatchSGD`` performs it:
+
+- zero initial weights, no intercept, no feature scaling
+  (``GeneralizedLinearAlgorithm`` defaults; the reference never calls
+  ``setIntercept``);
+- iteration ``i`` (1-based): full-batch gradient sum over the data in
+  RDD order (``treeAggregate`` seqOp accumulation), divided by the
+  batch count;
+- ``SquaredL2Updater``: ``w = w*(1 - step_i*regParam) - step_i*g``
+  with ``step_i = stepSize/sqrt(i)``;
+- the **convergence check** MLlib applies from iteration 2 onward:
+  stop when ``norm(w_prev - w_cur) < tol * max(norm(w_cur), 1)`` with
+  default ``convergenceTol = 0.001`` — the reference's default-config
+  classifiers inherit this early stop;
+- prediction thresholds: logreg ``sigmoid(margin) > 0.5`` (strict,
+  ``LogisticRegressionModel.predictPoint``), svm ``margin > 0.0``
+  (``SVMModel.predictPoint``).
+
+Only the deterministic full-batch path (``miniBatchFraction == 1.0``)
+is emulated; the sampled path depends on Spark's per-partition
+XORShift sampler and cannot be bit-reproduced (documented in
+``models/sgd.py``).
+
+Why this exists: the reference's informal accuracy pin
+0.6415094339622641 (``ClassifierTest.java:105``, commented out) is
+34/53 — it needs a 53-point test split, i.e. a ~177-epoch corpus that
+is NOT in the shipped ``test-data/`` fixture (which yields 11 epochs
+→ a 4-point test split whose accuracies are multiples of 0.25). The
+reproducible contract is therefore: this oracle's trajectory on the
+shipped fixture, pinned by ``tests/test_mllib_accuracy_parity.py``,
+with the device f32 path asserted to agree.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+
+def run_gradient_descent(
+    features: np.ndarray,
+    labels: np.ndarray,
+    *,
+    loss: str,
+    step_size: float = 1.0,
+    num_iterations: int = 100,
+    reg_param: float = 0.01,
+    mini_batch_fraction: float = 1.0,
+    convergence_tol: float = 0.001,
+) -> tuple[np.ndarray, list[float], int]:
+    """Return (weights_f64, loss_history, iterations_run).
+
+    ``loss`` is "logistic" (LogisticGradient, binary) or "hinge"
+    (HingeGradient). Raises on mini_batch_fraction != 1.0 — the
+    sampled path is not deterministic in the reference either.
+    """
+    if mini_batch_fraction != 1.0:
+        raise ValueError(
+            "oracle emulates the deterministic full-batch path only; "
+            "MLlib's Bernoulli sampling (seed 42+i per-partition "
+            "XORShift) is not bit-reproducible"
+        )
+    if loss not in ("logistic", "hinge"):
+        raise ValueError(f"unknown loss: {loss}")
+
+    x = np.asarray(features, dtype=np.float64)
+    y = np.asarray(labels, dtype=np.float64)
+    n, d = x.shape
+    w = np.zeros(d, dtype=np.float64)
+
+    loss_history: list[float] = []
+    # regVal seeding: updater.compute(w0, 0, 0, 1, regParam)._2 with
+    # w0 == 0 gives 0.0 for SquaredL2Updater.
+    reg_val = 0.5 * reg_param * float(np.dot(w, w))
+
+    prev_w: np.ndarray | None = None
+    cur_w: np.ndarray | None = None
+    converged = False
+    i = 1
+    while not converged and i <= num_iterations:
+        grad_sum = np.zeros(d, dtype=np.float64)
+        loss_sum = 0.0
+        if loss == "logistic":
+            # LogisticGradient.compute (binary): margin = -w.x,
+            # multiplier = 1/(1+exp(margin)) - label
+            for k in range(n):
+                margin = -float(np.dot(x[k], w))
+                multiplier = 1.0 / (1.0 + math.exp(margin)) - y[k]
+                grad_sum += multiplier * x[k]
+                # MLUtils.log1pExp(margin), minus margin for label 0
+                if margin > 0:
+                    point_loss = margin + math.log1p(math.exp(-margin))
+                else:
+                    point_loss = math.log1p(math.exp(margin))
+                loss_sum += point_loss if y[k] > 0 else point_loss - margin
+        else:  # hinge
+            for k in range(n):
+                dot = float(np.dot(x[k], w))
+                label_scaled = 2.0 * y[k] - 1.0
+                if 1.0 > label_scaled * dot:
+                    grad_sum += (-label_scaled) * x[k]
+                    loss_sum += 1.0 - label_scaled * dot
+
+        # miniBatchSize == n > 0 always here
+        loss_history.append(loss_sum / n + reg_val)
+        # SquaredL2Updater.compute
+        step_i = step_size / math.sqrt(i)
+        w_new = w * (1.0 - step_i * reg_param) - step_i * (grad_sum / n)
+        reg_val = 0.5 * reg_param * float(np.dot(w_new, w_new))
+        w = w_new
+
+        prev_w = cur_w
+        cur_w = w
+        if prev_w is not None:
+            diff = float(np.linalg.norm(prev_w - cur_w))
+            converged = diff < convergence_tol * max(
+                float(np.linalg.norm(cur_w)), 1.0
+            )
+        i += 1
+
+    return w, loss_history, i - 1
+
+
+def predict_logreg(features: np.ndarray, weights: np.ndarray) -> np.ndarray:
+    """LogisticRegressionModel.predictPoint: sigmoid(w.x) > 0.5, strict."""
+    x = np.asarray(features, dtype=np.float64)
+    margin = x @ np.asarray(weights, dtype=np.float64)
+    score = 1.0 / (1.0 + np.exp(-margin))
+    return (score > 0.5).astype(np.float64)
+
+
+def predict_svm(features: np.ndarray, weights: np.ndarray) -> np.ndarray:
+    """SVMModel.predictPoint: margin > 0.0, strict."""
+    x = np.asarray(features, dtype=np.float64)
+    margin = x @ np.asarray(weights, dtype=np.float64)
+    return (margin > 0.0).astype(np.float64)

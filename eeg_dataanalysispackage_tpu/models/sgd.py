@@ -18,7 +18,16 @@ SVMClassifier.java:95-110). Semantics preserved:
   (svm), while the default constructors use 0.01;
 - zero initial weights, no intercept (the reference never calls
   setIntercept, and MLlib's default is off);
-- an iteration whose sample is empty leaves weights unchanged.
+- an iteration whose sample is empty leaves weights unchanged;
+- MLlib's convergence early stop (GradientDescent default
+  ``convergenceTol = 0.001``): once two updates have happened, stop
+  when ``norm(w_prev - w_cur) < tol * max(norm(w_cur), 1)``. Inside
+  the scan this is a carried ``converged`` flag that freezes the
+  weights — fixed trip count, same result, XLA-friendly.
+
+``models/mllib_oracle.py`` is the float64 host oracle for the
+deterministic full-batch path; tests assert this engine agrees with
+it on the reference fixture.
 
 The whole loop is a ``lax.scan`` inside one jit — no per-iteration
 host round trips (the reference pays a driver->executor treeAggregate
@@ -45,6 +54,8 @@ class SGDConfig:
     reg_param: float = 0.0  # SquaredL2Updater when > 0 path used (svm)
     loss: str = "logistic"  # "logistic" | "hinge"
     seed: int = 42
+    # MLlib GradientDescent default; 0.0 disables the early stop
+    convergence_tol: float = 0.001
 
 
 @partial(jax.jit, static_argnames=("num_iterations", "loss", "full_batch"))
@@ -55,6 +66,7 @@ def _run_sgd(
     mini_batch_fraction: float,
     reg_param: float,
     seed,
+    convergence_tol: float,
     num_iterations: int,
     loss: str,
     full_batch: bool,
@@ -76,8 +88,9 @@ def _run_sgd(
         weighted = mult * mask
         return x.T @ weighted  # (d,) — lowers to MXU matmul + all-reduce
 
-    def step(w, t):
+    def step(carry, t):
         # t is 1-based iteration index
+        w, converged, n_updates = carry
         if full_batch:
             mask = ones
         else:
@@ -91,11 +104,25 @@ def _run_sgd(
         step_t = step_size / jnp.sqrt(t.astype(x.dtype))
         scale = jnp.where(count > 0, 1.0 / jnp.maximum(count, 1.0), 0.0)
         decay = jnp.where(count > 0, 1.0 - step_t * reg_param, 1.0)
-        w_new = w * decay - step_t * scale * g
-        return w_new, None
+        w_cand = w * decay - step_t * scale * g
+        updated = count > 0
+        # MLlib isConverged: consecutive iterates, only once a previous
+        # update exists (GradientDescent.runMiniBatchSGD)
+        diff = jnp.linalg.norm(w - w_cand)
+        bound = convergence_tol * jnp.maximum(jnp.linalg.norm(w_cand), 1.0)
+        hit = updated & (n_updates >= 1) & (diff < bound)
+        w_new = jnp.where(converged, w, w_cand)
+        converged_new = converged | (~converged & hit)
+        n_updates_new = n_updates + jnp.where(
+            updated & ~converged, 1, 0
+        ).astype(n_updates.dtype)
+        return (w_new, converged_new, n_updates_new), None
 
     w0 = jnp.zeros((d,), dtype=x.dtype)
-    w_final, _ = jax.lax.scan(step, w0, jnp.arange(1, num_iterations + 1))
+    carry0 = (w0, jnp.asarray(False), jnp.asarray(0, jnp.int32))
+    (w_final, _, _), _ = jax.lax.scan(
+        step, carry0, jnp.arange(1, num_iterations + 1)
+    )
     return w_final
 
 
@@ -128,6 +155,7 @@ def train_linear(
         float(config.mini_batch_fraction),
         float(config.reg_param),
         int(config.seed),
+        float(config.convergence_tol),
         num_iterations=int(config.num_iterations),
         loss=config.loss,
         full_batch=config.mini_batch_fraction >= 1.0,

@@ -1,0 +1,154 @@
+"""MLlib accuracy parity on the reference fixture (ClassifierTest.java).
+
+Reproduces ``ClassifierTest.java:98-105`` exactly with the shipped
+fixture: ``infoTrain.txt`` -> 11 epochs, ``WaveletTransform(8, 512,
+175, 16)`` features, ``Collections.shuffle(new Random(1))``, 70/30
+split (7 train / 4 test), then the default-constructor MLlib paths
+(``new LogisticRegressionWithSGD().run(rdd)`` /
+``new SVMWithSGD().run(rdd)``: step 1.0, 100 iterations, regParam
+0.01, full batch, convergenceTol 1e-3, zero init, no intercept).
+
+About the reference's informal pin 0.6415094339622641
+(``ClassifierTest.java:105``, commented out in the reference itself):
+that value is 34/53, which requires a 53-point test split — i.e. a
+~177-epoch corpus. The corpus shipped in ``test-data/`` yields 11
+epochs, so the largest reachable test split is 4 points and every
+achievable accuracy is a multiple of 0.25; 0.6415... is unreachable
+from the shipped data under ANY classifier. The assert was written
+against a private corpus (per ``Const.java`` the disabled
+``DIRECTORIES`` lists of school recordings) that the reference does
+not distribute. The reproducible contract is therefore the exact
+float64 trajectory of MLlib's deterministic full-batch path on the
+shipped fixture (``models/mllib_oracle.py``), pinned below, with the
+production f32 XLA engine asserted to agree prediction-for-prediction.
+"""
+
+import numpy as np
+import pytest
+
+from eeg_dataanalysispackage_tpu.features import wavelet
+from eeg_dataanalysispackage_tpu.io import provider
+from eeg_dataanalysispackage_tpu.models import linear, mllib_oracle, sgd
+from eeg_dataanalysispackage_tpu.utils import java_compat
+
+
+@pytest.fixture(scope="module")
+def fixture_split(fixture_dir):
+    batch = provider.OfflineDataProvider(
+        [fixture_dir + "/infoTrain.txt"]
+    ).load()
+    fe = wavelet.WaveletTransform(8, 512, 175, 16, backend="host")
+    feats = fe.extract_batch(batch.epochs)  # float64 bit-parity path
+    perm = java_compat.java_shuffle_indices(len(batch.targets), seed=1)
+    f = feats[perm]
+    t = np.asarray(batch.targets, dtype=np.float64)[perm]
+    n_train = int(len(t) * 0.7)  # (int)(11*0.7) == 7
+    return f[:n_train], t[:n_train], f[n_train:], t[n_train:]
+
+
+def test_logreg_default_path_oracle_accuracy(fixture_split):
+    ftr, ttr, fte, tte = fixture_split
+    assert ftr.shape == (7, 48) and fte.shape == (4, 48)
+    w, _, iters = mllib_oracle.run_gradient_descent(ftr, ttr, loss="logistic")
+    # no early convergence on this fixture: all 100 iterations run
+    assert iters == 100
+    preds = mllib_oracle.predict_logreg(fte, w)
+    acc = float((preds == tte).mean())
+    # The deterministic full-batch trajectory on the SHIPPED corpus:
+    # all four test points predicted 0.0 -> accuracy 2/4. The
+    # reference's 0.6415094339622641 (= 34/53) needs a 53-point test
+    # split and is unreachable from the shipped 11-epoch fixture.
+    assert preds.tolist() == [0.0, 0.0, 0.0, 0.0]
+    assert acc == 0.5
+    # trajectory fingerprint, full f64 precision
+    assert float(np.linalg.norm(w)) == pytest.approx(
+        1.0861711073763858, abs=1e-15
+    )
+
+
+def test_svm_default_path_oracle_accuracy(fixture_split):
+    ftr, ttr, fte, tte = fixture_split
+    w, _, iters = mllib_oracle.run_gradient_descent(ftr, ttr, loss="hinge")
+    assert iters == 100
+    preds = mllib_oracle.predict_svm(fte, w)
+    assert preds.tolist() == [0.0, 0.0, 0.0, 0.0]
+    assert float((preds == tte).mean()) == 0.5
+    assert float(np.linalg.norm(w)) == pytest.approx(
+        1.9602503911207547, abs=1e-15
+    )
+
+
+@pytest.mark.parametrize(
+    "cls,oracle_pred,loss",
+    [
+        (linear.LogisticRegressionClassifier, mllib_oracle.predict_logreg,
+         "logistic"),
+        (linear.SVMClassifier, mllib_oracle.predict_svm, "hinge"),
+    ],
+)
+def test_device_f32_path_agrees_with_oracle(fixture_split, cls, oracle_pred,
+                                            loss):
+    """The production one-scan XLA engine (f32) must reproduce the
+    oracle's predictions and weights on the fixture."""
+    ftr, ttr, fte, tte = fixture_split
+    w64, _, _ = mllib_oracle.run_gradient_descent(ftr, ttr, loss=loss)
+
+    clf = cls()
+    clf.set_config({})  # default branch, like ClassifierTest
+    clf.fit(ftr, ttr)
+    np.testing.assert_allclose(clf.weights, w64, rtol=0, atol=5e-5)
+    preds = clf.predict(fte)
+    assert preds.tolist() == oracle_pred(fte, w64).tolist()
+    assert float((preds == tte).mean()) == 0.5
+
+
+def test_convergence_early_stop_matches_oracle():
+    """MLlib's convergenceTol early stop: engineered data where the
+    trajectory converges before num_iterations; the f32 engine must
+    freeze at the same iteration as the f64 oracle."""
+    rng = np.random.RandomState(7)
+    x = rng.randn(32, 4) * 0.01  # tiny margins -> tiny steps
+    y = (rng.rand(32) > 0.5).astype(np.float64)
+    w64, _, iters = mllib_oracle.run_gradient_descent(
+        x, y, loss="logistic", step_size=0.01, num_iterations=50,
+        reg_param=0.01,
+    )
+    assert iters < 50  # the early stop actually fired
+    cfg = sgd.SGDConfig(
+        num_iterations=50, step_size=0.01, mini_batch_fraction=1.0,
+        reg_param=0.01, loss="logistic",
+    )
+    w32 = sgd.train_linear(x.astype(np.float32), y.astype(np.float32), cfg)
+    np.testing.assert_allclose(w32, w64, rtol=0, atol=1e-6)
+
+
+def test_convergence_tol_zero_disables_early_stop():
+    rng = np.random.RandomState(7)
+    x = rng.randn(32, 4) * 0.01
+    y = (rng.rand(32) > 0.5).astype(np.float64)
+    w_stop, _, iters = mllib_oracle.run_gradient_descent(
+        x, y, loss="logistic", step_size=0.01, num_iterations=50,
+    )
+    w_full, _, iters_full = mllib_oracle.run_gradient_descent(
+        x, y, loss="logistic", step_size=0.01, num_iterations=50,
+        convergence_tol=0.0,
+    )
+    assert iters < iters_full == 50
+    cfg = sgd.SGDConfig(
+        num_iterations=50, step_size=0.01, loss="logistic",
+        convergence_tol=0.0,
+    )
+    w32 = sgd.train_linear(x.astype(np.float32), y.astype(np.float32), cfg)
+    np.testing.assert_allclose(w32, w_full, rtol=0, atol=1e-6)
+    assert float(np.linalg.norm(w_stop - w_full)) > 0
+
+
+def test_strict_threshold_at_zero_margin():
+    """MLlib predicts 0.0 at exactly the threshold (strict >): an
+    all-zero weight vector classifies everything as 0.0."""
+    f = np.eye(3, dtype=np.float64)
+    assert mllib_oracle.predict_logreg(f, np.zeros(3)).tolist() == [0, 0, 0]
+    assert mllib_oracle.predict_svm(f, np.zeros(3)).tolist() == [0, 0, 0]
+    clf = linear.LogisticRegressionClassifier()
+    clf.weights = np.zeros(3, dtype=np.float32)
+    assert clf.predict(f).tolist() == [0.0, 0.0, 0.0]
