@@ -1,4 +1,4 @@
-"""Neural-network classifier: flax MLP + optax updaters.
+"""Neural-network classifier: flax network + optax updaters.
 
 TPU-native re-design of ``Classification/NeuralNetworkClassifier.java``
 (DL4J 0.8 ``MultiLayerNetwork`` + ND4J C++ backend -> flax module +
@@ -14,9 +14,7 @@ config surface is preserved:
   ``config_layer{i}_layer_type`` (output|dense|auto_encoder|rbm|
   graves_lstm), ``_n_out``, ``_drop_out``, ``_activation_function``;
   output layers read the global ``config_loss_function``
-  (NeuralNetworkClassifier.java:258-320). auto_encoder/rbm/graves_lstm
-  forward like dense layers over a 48-dim feature vector, which is
-  exactly what DL4J's backprop-only path does with them here;
+  (NeuralNetworkClassifier.java:258-320);
 - enum mappings with the reference's silent fallbacks
   (NeuralNetworkClassifier.java:201-255): weight_init xavier|zero|
   sigmoid|uniform|relu (default relu), updater sgd|adam|nesterovs|
@@ -26,9 +24,27 @@ config surface is preserved:
 - labels are one-hot pairs [target, 1-target]
   (NeuralNetworkClassifier.java:81-84) and the prediction is
   ``output[0]`` (:161);
-- ``config_pretrain``/``config_backprop`` are required flags; pretrain
-  is accepted and ignored (DL4J 0.8 layerwise pretraining of RBM/AE
-  stacks is not reproduced — backprop training subsumes it here).
+- ``config_pretrain``/``config_backprop`` are required flags with
+  DL4J's ``model.fit`` semantics (NeuralNetworkClassifier.java:126-137,
+  145): pretrain=true runs **greedy layerwise pretraining** of the
+  auto_encoder/rbm layers before (optional) backprop; backprop=false
+  skips supervised training entirely. Pretraining here: auto_encoder
+  layers train a tied-weight denoising autoencoder (corruption 0.3,
+  DL4J 0.8's AutoEncoder default) on the layer's input activations by
+  MSE reconstruction; rbm layers run CD-1 contrastive divergence
+  (sigmoid hidden units, linear visible reconstruction — the
+  Gaussian-visible convention for real-valued features). Both use the
+  configured updater/learning-rate/iterations. Exact DL4J RNG
+  trajectories are not reproduced (closed native backend);
+- ``graves_lstm`` is a **real LSTM** (``linen.OptimizedLSTMCell``
+  scanned over time via ``linen.RNN``), not a dense stand-in
+  (NeuralNetworkClassifier.java:258-320 layer switch). The layer's
+  configured activation function becomes the cell activation, as in
+  DL4J. Flat ``(batch, features)`` inputs — the reference's only
+  shipped shape — run the cell for a single step; ``(batch, time,
+  features)`` sequences (net-new TPU capability) are scanned on
+  device, recurrent layers emit full sequences, and the output layer
+  reads the final timestep.
 
 Training runs ``config_num_iterations`` full-batch optimizer steps
 (DL4J ``.iterations(n)`` + ``model.fit(dataSet)``) inside a single
@@ -60,6 +76,9 @@ _ACTIVATIONS = {
     "elu": jax.nn.elu,
 }
 _LAYER_TYPES = ("output", "dense", "auto_encoder", "rbm", "graves_lstm")
+_PRETRAINABLE = ("auto_encoder", "rbm")
+# DL4J 0.8 AutoEncoder default corruption level (denoising)
+_AE_CORRUPTION = 0.3
 
 
 def _activation(name: str):
@@ -88,7 +107,13 @@ def _updater(name: str, lr: float, momentum: float):
     return opts.get(name, opts["nesterovs"])()
 
 
-class _MLP(linen.Module):
+class _Net(linen.Module):
+    """The configured layer stack. Layer i's parameters live under
+    ``params/layer{i}`` (Dense: kernel/bias; graves_lstm: the RNN cell
+    pytree), which is what lets greedy pretraining write tensors back
+    by name and lets prefix sub-networks reuse the same params."""
+
+    layer_types: Sequence[str]
     n_outs: Sequence[int]
     activations: Sequence[str]
     dropouts: Sequence[float]
@@ -96,13 +121,37 @@ class _MLP(linen.Module):
 
     @linen.compact
     def __call__(self, x, train: bool = False):
-        for i, (n_out, act, drop) in enumerate(
-            zip(self.n_outs, self.activations, self.dropouts)
+        n_layers = len(self.n_outs)
+        for i, (ltype, n_out, act, drop) in enumerate(
+            zip(self.layer_types, self.n_outs, self.activations,
+                self.dropouts)
         ):
-            x = linen.Dense(
-                n_out, kernel_init=_weight_init(self.weight_init), name=f"layer{i+1}"
-            )(x)
-            x = _activation(act)(x)
+            is_last = i == n_layers - 1
+            if ltype == "graves_lstm":
+                seq = x if x.ndim == 3 else x[:, None, :]
+                # RNN is scope-transparent: naming the cell puts its
+                # gate params directly under params/layer{i+1}
+                rnn = linen.RNN(
+                    linen.OptimizedLSTMCell(
+                        n_out,
+                        activation_fn=_activation(act),
+                        kernel_init=_weight_init(self.weight_init),
+                        name=f"layer{i+1}",
+                    ),
+                )
+                seq = rnn(seq)
+                x = seq if x.ndim == 3 else seq[:, -1, :]
+            else:
+                if is_last and x.ndim == 3:
+                    # output layer reads the final timestep of a
+                    # recurrent sequence
+                    x = x[:, -1, :]
+                x = linen.Dense(
+                    n_out,
+                    kernel_init=_weight_init(self.weight_init),
+                    name=f"layer{i+1}",
+                )(x)
+                x = _activation(act)(x)
             if drop > 0.0:
                 x = linen.Dropout(rate=drop, deterministic=not train)(x)
         return x
@@ -124,6 +173,81 @@ def _loss_fn(name: str):
             "negativeloglikelihood": nll}.get(name, mse)
 
 
+# -- greedy layerwise pretraining --------------------------------------
+
+
+def _pretrain_ae(key, h, kernel, bias, act_name, tx, iterations):
+    """Tied-weight denoising autoencoder on activations ``h``:
+    encode z = act(h_corrupt @ W + b), decode r = z @ W.T + c (linear
+    visible units), minimize MSE(r, h). Returns trained (W, b)."""
+    act = _activation(act_name)
+    c0 = jnp.zeros((h.shape[1],), h.dtype)
+    params = {"W": kernel, "b": bias, "c": c0}
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def run(params, opt_state, h):
+        def step(carry, it):
+            params, opt_state = carry
+            mask_key = jax.random.fold_in(key, it)
+            keep = jax.random.bernoulli(
+                mask_key, 1.0 - _AE_CORRUPTION, h.shape
+            ).astype(h.dtype)
+
+            def objective(p):
+                z = act((h * keep) @ p["W"] + p["b"])
+                r = z @ p["W"].T + p["c"]
+                return jnp.mean((r - h) ** 2)
+
+            grads = jax.grad(objective)(params)
+            updates, opt_state2 = tx.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), opt_state2), None
+
+        (params, opt_state), _ = jax.lax.scan(
+            step, (params, opt_state), jnp.arange(iterations)
+        )
+        return params
+
+    out = run(params, opt_state, h)
+    return out["W"], out["b"]
+
+
+def _pretrain_rbm(key, h, kernel, bias, tx, iterations):
+    """CD-1 contrastive divergence: sigmoid hidden units, linear
+    (Gaussian-convention) visible reconstruction. Returns (W, b)."""
+    c0 = jnp.zeros((h.shape[1],), h.dtype)
+    params = {"W": kernel, "b": bias, "c": c0}
+    opt_state = tx.init(params)
+    n = h.shape[0]
+
+    @jax.jit
+    def run(params, opt_state, v0):
+        def step(carry, it):
+            params, opt_state = carry
+            W, b, c = params["W"], params["b"], params["c"]
+            h0_prob = jax.nn.sigmoid(v0 @ W + b)
+            h0_sample = jax.random.bernoulli(
+                jax.random.fold_in(key, it), h0_prob
+            ).astype(v0.dtype)
+            v1 = h0_sample @ W.T + c
+            h1_prob = jax.nn.sigmoid(v1 @ W + b)
+            # negative gradients (CD ascends the likelihood proxy)
+            g_w = -(v0.T @ h0_prob - v1.T @ h1_prob) / n
+            g_b = -jnp.mean(h0_prob - h1_prob, axis=0)
+            g_c = -jnp.mean(v0 - v1, axis=0)
+            grads = {"W": g_w, "b": g_b, "c": g_c}
+            updates, opt_state2 = tx.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), opt_state2), None
+
+        (params, opt_state), _ = jax.lax.scan(
+            step, (params, opt_state), jnp.arange(iterations)
+        )
+        return params
+
+    out = run(params, opt_state, h)
+    return out["W"], out["b"]
+
+
 class NeuralNetworkClassifier(base.Classifier):
     confusion_only_stats = False  # reference NN uses incremental add()
 
@@ -139,6 +263,7 @@ class NeuralNetworkClassifier(base.Classifier):
         num_layers = sum(1 for k in c if k.startswith("config_layer")) // 4
         if num_layers == 0:
             raise ValueError("no config_layer* keys; at least one layer required")
+        ltypes: List[str] = []
         n_outs: List[int] = []
         acts: List[str] = []
         drops: List[float] = []
@@ -146,16 +271,26 @@ class NeuralNetworkClassifier(base.Classifier):
             ltype = c.get(f"config_layer{i}_layer_type", "output")
             if ltype not in _LAYER_TYPES:
                 ltype = "output"
+            ltypes.append(ltype)
             n_outs.append(int(c[f"config_layer{i}_n_out"]))
             acts.append(c[f"config_layer{i}_activation_function"])
             drops.append(float(c[f"config_layer{i}_drop_out"]))
-        return n_outs, acts, drops
+        return ltypes, n_outs, acts, drops
 
     def _require(self, key: str) -> str:
         # the reference NPEs on missing keys; fail with a named error
         if key not in self.config:
             raise ValueError(f"missing required NN config key: {key}")
         return self.config[key]
+
+    def _build(self) -> _Net:
+        return _Net(
+            tuple(self._arch["layer_types"]),
+            tuple(self._arch["n_outs"]),
+            tuple(self._arch["activations"]),
+            tuple(self._arch["dropouts"]),
+            self._arch["weight_init"],
+        )
 
     # -- training ------------------------------------------------------
 
@@ -167,61 +302,114 @@ class NeuralNetworkClassifier(base.Classifier):
         weight_init = self._require("config_weight_init")
         updater_name = self._require("config_updater")
         self._require("config_optimization_algo")  # accepted; SGD family only
-        self._require("config_pretrain")
-        self._require("config_backprop")
-        n_outs, acts, drops = self._parse_layers()
+        # Boolean.parseBoolean semantics: "true" (any case) is true
+        pretrain = self._require("config_pretrain").lower() == "true"
+        backprop = self._require("config_backprop").lower() == "true"
+        ltypes, n_outs, acts, drops = self._parse_layers()
 
         x = jnp.asarray(features, dtype=jnp.float32)
         # one-hot pairs: [target, 1-target] (NeuralNetworkClassifier.java:81-84)
         t = jnp.asarray(labels, dtype=jnp.float32)
         y = jnp.stack([t, jnp.abs(1.0 - t)], axis=1)
 
-        model = _MLP(tuple(n_outs), tuple(acts), tuple(drops), weight_init)
-        rng = jax.random.PRNGKey(seed)
-        params = model.init({"params": rng, "dropout": rng}, x[:1], train=False)
-        tx = _updater(updater_name, lr, momentum)
-        opt_state = tx.init(params)
-        loss = _loss_fn(self.config.get("config_loss_function", "mse"))
-
-        @jax.jit
-        def run(params, opt_state, x, y):
-            def step(carry, it):
-                params, opt_state = carry
-
-                def objective(p):
-                    pred = model.apply(
-                        p, x, train=True,
-                        rngs={"dropout": jax.random.fold_in(rng, it)},
-                    )
-                    return loss(pred, y)
-
-                grads = jax.grad(objective)(params)
-                updates, opt_state2 = tx.update(grads, opt_state, params)
-                return (optax.apply_updates(params, updates), opt_state2), None
-
-            (params, opt_state), _ = jax.lax.scan(
-                step, (params, opt_state), jnp.arange(iterations)
-            )
-            return params
-
-        self.params = run(params, opt_state, x, y)
         self._arch = {
+            "layer_types": ltypes,
             "n_outs": n_outs,
             "activations": acts,
             "dropouts": drops,
             "weight_init": weight_init,
-            "n_in": int(x.shape[1]),
+            "n_in": int(x.shape[-1]),
         }
+        model = self._build()
+        rng = jax.random.PRNGKey(seed)
+        params = model.init({"params": rng, "dropout": rng}, x[:1], train=False)
+        tx = _updater(updater_name, lr, momentum)
+        loss = _loss_fn(self.config.get("config_loss_function", "mse"))
+
+        if pretrain:
+            params = self._greedy_pretrain(
+                model, params, x, ltypes, n_outs, acts, drops, weight_init,
+                updater_name, lr, momentum, iterations, rng,
+            )
+
+        if backprop:
+            opt_state = tx.init(params)
+
+            @jax.jit
+            def run(params, opt_state, x, y):
+                def step(carry, it):
+                    params, opt_state = carry
+
+                    def objective(p):
+                        pred = model.apply(
+                            p, x, train=True,
+                            rngs={"dropout": jax.random.fold_in(rng, it)},
+                        )
+                        return loss(pred, y)
+
+                    grads = jax.grad(objective)(params)
+                    updates, opt_state2 = tx.update(grads, opt_state, params)
+                    return (optax.apply_updates(params, updates),
+                            opt_state2), None
+
+                (params, opt_state), _ = jax.lax.scan(
+                    step, (params, opt_state), jnp.arange(iterations)
+                )
+                return params
+
+            params = run(params, opt_state, x, y)
+
+        self.params = params
+
+    def _greedy_pretrain(
+        self, model, params, x, ltypes, n_outs, acts, drops, weight_init,
+        updater_name, lr, momentum, iterations, rng,
+    ):
+        """DL4J MultiLayerNetwork pretrain walk: for each pretrainable
+        layer, feed the input forward through the preceding layers
+        (with their current weights) and train that layer unsupervised
+        on the resulting activations, writing the tensors back into
+        the model's params by layer name."""
+        params = jax.tree_util.tree_map(lambda a: a, params)  # shallow copy
+        for i, ltype in enumerate(ltypes):
+            if ltype not in _PRETRAINABLE or i == len(ltypes) - 1:
+                continue
+            if i == 0:
+                h = x
+            else:
+                prefix = _Net(
+                    tuple(ltypes[:i]), tuple(n_outs[:i]), tuple(acts[:i]),
+                    (0.0,) * i, weight_init,
+                )
+                sub = {
+                    "params": {
+                        k: v for k, v in params["params"].items()
+                        if k in {f"layer{j+1}" for j in range(i)}
+                    }
+                }
+                h = prefix.apply(sub, x, train=False)
+            if h.ndim == 3:  # recurrent activations: fold time into batch
+                h = h.reshape(-1, h.shape[-1])
+            name = f"layer{i+1}"
+            kernel = params["params"][name]["kernel"]
+            bias = params["params"][name]["bias"]
+            tx = _updater(updater_name, lr, momentum)
+            key = jax.random.fold_in(rng, 1000 + i)
+            if ltype == "auto_encoder":
+                w, b = _pretrain_ae(
+                    key, h, kernel, bias, acts[i], tx, iterations
+                )
+            else:  # rbm
+                w, b = _pretrain_rbm(key, h, kernel, bias, tx, iterations)
+            params["params"][name] = dict(
+                params["params"][name], kernel=w, bias=b
+            )
+        return params
 
     def predict(self, features: np.ndarray) -> np.ndarray:
         if self.params is None:
             raise ValueError("model not trained or loaded")
-        model = _MLP(
-            tuple(self._arch["n_outs"]),
-            tuple(self._arch["activations"]),
-            tuple(self._arch["dropouts"]),
-            self._arch["weight_init"],
-        )
+        model = self._build()
         out = model.apply(
             self.params, jnp.asarray(features, dtype=jnp.float32), train=False
         )
@@ -250,13 +438,12 @@ class NeuralNetworkClassifier(base.Classifier):
             header = json.loads(f.read(hlen).decode())
             blob = f.read()
         self._arch = header["arch"]
+        if "layer_types" not in self._arch:  # round-1 save files
+            self._arch["layer_types"] = (
+                ["dense"] * (len(self._arch["n_outs"]) - 1) + ["output"]
+            )
         self.config = header["config"]
-        model = _MLP(
-            tuple(self._arch["n_outs"]),
-            tuple(self._arch["activations"]),
-            tuple(self._arch["dropouts"]),
-            self._arch["weight_init"],
-        )
+        model = self._build()
         template = model.init(
             jax.random.PRNGKey(0),
             jnp.zeros((1, self._arch["n_in"]), jnp.float32),

@@ -1,0 +1,224 @@
+"""NN completeness: greedy layerwise pretraining and the real LSTM.
+
+Pins the two DL4J behaviors the round-1 build stubbed
+(NeuralNetworkClassifier.java:126-137 pretrain,
+:258-320 graves_lstm layer switch): pretrain=true must actually move
+the pretrainable layers' weights before backprop, and graves_lstm
+must be a genuine recurrent cell whose output depends on the whole
+sequence, not a dense stand-in.
+"""
+
+import numpy as np
+import pytest
+
+from eeg_dataanalysispackage_tpu.models import nn
+
+BASE = {
+    "config_seed": "7",
+    "config_num_iterations": "60",
+    "config_learning_rate": "0.05",
+    "config_momentum": "0.9",
+    "config_weight_init": "xavier",
+    "config_updater": "sgd",
+    "config_optimization_algo": "stochastic_gradient_descent",
+    "config_pretrain": "false",
+    "config_backprop": "true",
+    "config_loss_function": "xent",
+}
+
+
+def layer(i, ltype, n_out, act, drop="0.0"):
+    return {
+        f"config_layer{i}_layer_type": ltype,
+        f"config_layer{i}_n_out": str(n_out),
+        f"config_layer{i}_drop_out": drop,
+        f"config_layer{i}_activation_function": act,
+    }
+
+
+def make_data(n=128, d=12, seed=3):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, d).astype(np.float64)
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float64)
+    return x, y
+
+
+def fit_nn(cfg, x, y):
+    clf = nn.NeuralNetworkClassifier()
+    clf.set_config(cfg)
+    clf.fit(x, y)
+    return clf
+
+
+def kernel(clf, i):
+    return np.asarray(clf.params["params"][f"layer{i}"]["kernel"])
+
+
+# -- pretraining -------------------------------------------------------
+
+
+@pytest.mark.parametrize("ltype", ["auto_encoder", "rbm"])
+def test_pretrain_changes_initial_weights(ltype):
+    """With backprop=false, fit() == (init + pretrain). pretrain=true
+    must move the pretrainable layer's weights; the output layer,
+    which is never pretrained, must stay at its initializer values."""
+    x, y = make_data()
+    cfg = dict(BASE, config_pretrain="false", config_backprop="false")
+    cfg.update(layer(1, ltype, 8, "sigmoid"))
+    cfg.update(layer(2, "output", 2, "softmax"))
+    frozen = fit_nn(cfg, x, y)
+
+    cfg2 = dict(cfg, config_pretrain="true")
+    pre = fit_nn(cfg2, x, y)
+
+    # same seed -> identical initial draws; pretraining moved layer 1
+    assert not np.allclose(kernel(frozen, 1), kernel(pre, 1))
+    np.testing.assert_array_equal(kernel(frozen, 2), kernel(pre, 2))
+
+
+def test_pretrain_ae_reduces_reconstruction_error():
+    x, y = make_data()
+    cfg = dict(BASE, config_pretrain="false", config_backprop="false")
+    cfg.update(layer(1, "auto_encoder", 8, "sigmoid"))
+    cfg.update(layer(2, "output", 2, "softmax"))
+    frozen = fit_nn(cfg, x, y)
+    pre = fit_nn(dict(cfg, config_pretrain="true"), x, y)
+
+    def recon_err(w, b):
+        z = 1.0 / (1.0 + np.exp(-(x.astype(np.float32) @ w + b)))
+        # linear decode through the tied weights (visible bias ~ 0
+        # at init; compare apples to apples without it)
+        r = z @ w.T
+        return float(np.mean((r - x) ** 2))
+
+    b1 = np.asarray(frozen.params["params"]["layer1"]["bias"])
+    b2 = np.asarray(pre.params["params"]["layer1"]["bias"])
+    assert recon_err(kernel(pre, 1), b2) < recon_err(kernel(frozen, 1), b1)
+
+
+def test_pretrain_then_backprop_still_learns():
+    x, y = make_data(n=200)
+    cfg = dict(BASE, config_pretrain="true", config_num_iterations="300",
+               config_updater="nesterovs", config_learning_rate="0.1")
+    cfg.update(layer(1, "auto_encoder", 16, "sigmoid"))
+    cfg.update(layer(2, "output", 2, "softmax"))
+    clf = fit_nn(cfg, x, y)
+    preds = (clf.predict(x) > 0.5).astype(np.float64)
+    assert (preds == y).mean() > 0.8
+
+
+def test_pretrain_stacked_layers_both_move():
+    """Greedy = layer 2 pretrains on layer 1's pretrained output."""
+    x, y = make_data()
+    cfg = dict(BASE, config_pretrain="false", config_backprop="false")
+    cfg.update(layer(1, "auto_encoder", 10, "sigmoid"))
+    cfg.update(layer(2, "rbm", 6, "sigmoid"))
+    cfg.update(layer(3, "output", 2, "softmax"))
+    frozen = fit_nn(cfg, x, y)
+    pre = fit_nn(dict(cfg, config_pretrain="true"), x, y)
+    assert not np.allclose(kernel(frozen, 1), kernel(pre, 1))
+    assert not np.allclose(kernel(frozen, 2), kernel(pre, 2))
+    np.testing.assert_array_equal(kernel(frozen, 3), kernel(pre, 3))
+
+
+def test_backprop_false_without_pretrain_keeps_init():
+    """DL4J model.fit with pretrain=false, backprop=false trains
+    nothing at all."""
+    x, y = make_data()
+    cfg = dict(BASE, config_pretrain="false", config_backprop="false")
+    cfg.update(layer(1, "dense", 8, "relu"))
+    cfg.update(layer(2, "output", 2, "softmax"))
+    a = fit_nn(cfg, x, y)
+    b = fit_nn(cfg, x, y)
+    np.testing.assert_array_equal(kernel(a, 1), kernel(b, 1))
+
+
+# -- graves_lstm -------------------------------------------------------
+
+
+def lstm_cfg(extra=None):
+    cfg = dict(BASE, config_num_iterations="40")
+    cfg.update(layer(1, "graves_lstm", 8, "tanh"))
+    cfg.update(layer(2, "output", 2, "softmax"))
+    if extra:
+        cfg.update(extra)
+    return cfg
+
+
+def test_lstm_trains_on_flat_features():
+    """The reference's only shipped shape: (batch, 48) flat features
+    run the cell for one step and classify."""
+    x, y = make_data()
+    clf = fit_nn(lstm_cfg(), x, y)
+    out = clf.predict(x)
+    assert out.shape == (len(x),)
+    assert np.all((out >= 0) & (out <= 1))
+    # a real LSTM cell: input and recurrent gate kernels present
+    gates = set(clf.params["params"]["layer1"].keys())
+    assert {"ii", "if", "ig", "io", "hi", "hf", "hg", "ho"} <= gates
+
+
+def test_lstm_depends_on_sequence_history_dense_does_not():
+    """Two sequences with identical final timesteps but different
+    histories: a dense stack (per-timestep affine + last-step output
+    read) cannot tell them apart; a real LSTM must."""
+    rng = np.random.RandomState(0)
+    n, t, d = 16, 6, 12
+    seq_a = rng.randn(n, t, d).astype(np.float64)
+    seq_b = np.array(seq_a)
+    seq_b[:, :-1] = rng.randn(n, t - 1, d)  # same last step, new history
+    y = (rng.rand(n) > 0.5).astype(np.float64)
+
+    lstm = fit_nn(lstm_cfg({"config_backprop": "false"}), seq_a[:, 0], y)
+    out_a = lstm_forward(lstm, seq_a)
+    out_b = lstm_forward(lstm, seq_b)
+    assert not np.allclose(out_a, out_b)
+
+    dense_cfg = dict(BASE, config_backprop="false")
+    dense_cfg.update(layer(1, "dense", 8, "tanh"))
+    dense_cfg.update(layer(2, "output", 2, "softmax"))
+    dense = fit_nn(dense_cfg, seq_a[:, 0], y)
+    np.testing.assert_array_equal(
+        lstm_forward(dense, seq_a), lstm_forward(dense, seq_b)
+    )
+
+
+def lstm_forward(clf, seq):
+    import jax.numpy as jnp
+
+    model = clf._build()
+    return np.asarray(
+        model.apply(clf.params, jnp.asarray(seq, jnp.float32), train=False)
+    )
+
+
+def test_lstm_sequence_training_learns_order():
+    """Net-new TPU capability: train on (batch, time, features)
+    sequences where only the order carries the label."""
+    rng = np.random.RandomState(1)
+    n, t = 120, 8
+    base = rng.randn(n, t, 4).astype(np.float64)
+    ramp = np.linspace(-1, 1, t)[None, :, None]
+    y = (rng.rand(n) > 0.5).astype(np.float64)
+    # label 1: rising ramp on channel 0; label 0: falling
+    base[:, :, 0] = np.where(y[:, None] > 0, ramp[0, :, 0], -ramp[0, :, 0])
+    cfg = lstm_cfg({
+        "config_num_iterations": "200",
+        "config_updater": "adam",
+        "config_learning_rate": "0.02",
+    })
+    clf = nn.NeuralNetworkClassifier()
+    clf.set_config(cfg)
+    clf.fit(base, y)
+    preds = (lstm_forward(clf, base)[:, 0] > 0.5).astype(np.float64)
+    assert (preds == y).mean() > 0.9
+
+
+def test_lstm_save_load_roundtrip(tmp_path):
+    x, y = make_data()
+    clf = fit_nn(lstm_cfg(), x, y)
+    p = str(tmp_path / "lstm_model")
+    clf.save(p)
+    clf2 = nn.NeuralNetworkClassifier()
+    clf2.load(p)
+    np.testing.assert_array_equal(clf.predict(x), clf2.predict(x))
