@@ -5,13 +5,24 @@ batched eegdsp-parity DWT feature extractor (slice [175,687) -> 6-level
 db10 cascade -> 48-dim L2-normalized features), target >= 50,000
 epochs/sec on one TPU v5e chip. Prints exactly one JSON line.
 
+Beyond the headline, the same line carries the fused-ingest and
+train-step variants (tools/ingest_bench.py) with HBM-roofline context:
+
+  einsum          f32 epochs resident in HBM -> features (headline)
+  regular_ingest  fused int16 ingest, fixed-SOA stimulus train ->
+                  features (static reshape + one einsum, no gather)
+  pallas_ingest   fused int16 ingest, irregular marker positions ->
+                  features (ops/ingest_pallas.py kernel)
+  train_step      f32 epochs -> features -> MLP fwd/bwd/update
+
 Resilience contract (round-1 BENCH artifact died rc=1 on a single
 ``Unable to initialize backend 'axon': UNAVAILABLE``): the parent
 process never touches JAX. It probes the TPU backend in a
-timeout-guarded subprocess with bounded backoff; when the backend
-comes up, the measurement itself runs in a fresh child with its own
-deadline. If the TPU never becomes available within the retry budget,
-the same measurement runs on CPU and the JSON line says so via
+timeout-guarded subprocess with bounded backoff; each variant then
+runs in its own fresh child with its own deadline, and a variant
+failure is recorded in the payload instead of killing the artifact.
+If the TPU never becomes available within the retry budget, the same
+measurements run on CPU and the JSON line says so via
 ``"platform": "cpu_fallback"`` — a parseable, honest number instead of
 a dead artifact.
 """
@@ -32,6 +43,25 @@ _PROBE_TIMEOUT_S = 75
 _PROBE_SLEEPS_S = (10, 20, 40, 60)
 # One real-chip measurement (includes ~20-40s first compile).
 _RUN_TIMEOUT_S = int(os.environ.get("BENCH_RUN_TIMEOUT", 420))
+
+# (n_epochs, iters) per variant: TPU-sized vs CPU-fallback-sized.
+# BENCH_BATCH / BENCH_ITERS override the headline (einsum) sizing,
+# e.g. to fit a smaller chip.
+_VARIANTS_TPU = {
+    "einsum": (
+        int(os.environ.get("BENCH_BATCH", 262144)),
+        int(os.environ.get("BENCH_ITERS", 50)),
+    ),
+    "regular_ingest": (262144, 20),
+    "pallas_ingest": (131072, 20),
+    "train_step": (131072, 20),
+}
+_VARIANTS_CPU = {
+    "einsum": (8192, 5),
+    "regular_ingest": (8192, 3),
+    "pallas_ingest": (2048, 2),
+    "train_step": (8192, 3),
+}
 
 
 def _probe_tpu_once() -> bool:
@@ -77,15 +107,21 @@ def _cpu_env() -> dict:
     return env
 
 
-def _run_child(platform: str) -> dict:
-    """Run the measurement in a fresh child; returns the parsed JSON."""
+def _run_variant(variant: str, platform: str, n: int, iters: int) -> dict:
+    """Run one variant in a fresh child; returns its parsed JSON."""
     if platform == "tpu":
         env = dict(os.environ)
         env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
     else:
         env = _cpu_env()
     proc = subprocess.run(
-        [sys.executable, os.path.abspath(__file__), "--child"],
+        [
+            sys.executable,
+            os.path.join(_REPO_ROOT, "tools", "ingest_bench.py"),
+            variant,
+            str(n),
+            str(iters),
+        ],
         timeout=_RUN_TIMEOUT_S,
         capture_output=True,
         text=True,
@@ -93,63 +129,39 @@ def _run_child(platform: str) -> dict:
     )
     if proc.returncode != 0:
         raise RuntimeError(
-            f"bench child rc={proc.returncode}\n{proc.stderr[-2000:]}"
+            f"variant {variant} rc={proc.returncode}\n{proc.stderr[-1500:]}"
         )
-    # last stdout line is the JSON payload
     return json.loads(proc.stdout.strip().splitlines()[-1])
 
 
-def _measure() -> dict:
-    """The measurement body (child process; JAX is safe to touch here)."""
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-
-    from eeg_dataanalysispackage_tpu.ops import dwt as dwt_xla
-
-    platform = jax.devices()[0].platform
-    on_tpu = platform not in ("cpu",)
-
-    # 262144 epochs x 3x1000 f32 = 3.1 GB in HBM; measured ~6% more
-    # throughput than 131072 on v5e (39.7M vs 37.4M epochs/s). CPU
-    # fallback uses a small batch so the artifact stays fast.
-    batch = int(os.environ.get("BENCH_BATCH", 262144 if on_tpu else 8192))
-    iters = int(os.environ.get("BENCH_ITERS", 50 if on_tpu else 5))
-
-    extract = dwt_xla.make_batched_extractor(
-        wavelet_index=8, epoch_size=512, skip_samples=175, feature_size=16
-    )
-
-    key = jax.random.PRNGKey(0)
-    epochs = jax.random.normal(key, (batch, 3, 1000), dtype=jnp.float32) * 50.0
-
-    # The axon tunnel does not synchronize on block_until_ready, so the
-    # iteration loop runs inside one jitted lax.scan and the timing is
-    # closed by fetching a scalar that depends on every iteration.
-    @jax.jit
-    def bench_loop(x):
-        def body(acc, i):
-            y = extract(x + i.astype(jnp.float32))
-            return acc + y.sum(), None
-
-        acc, _ = jax.lax.scan(body, jnp.float32(0), jnp.arange(iters))
-        return acc
-
-    float(bench_loop(epochs))  # warmup + compile
-
-    start = time.perf_counter()
-    checksum = float(bench_loop(epochs))
-    elapsed = time.perf_counter() - start
-    assert np.isfinite(checksum), "non-finite checksum"
-
-    eps = batch * iters / elapsed
+def _collect(platform: str) -> dict:
+    sizes = _VARIANTS_TPU if platform == "tpu" else _VARIANTS_CPU
+    variants: dict = {}
+    for name, (n, iters) in sizes.items():
+        try:
+            r = _run_variant(name, platform, n, iters)
+            variants[name] = {
+                "epochs_per_s": r["epochs_per_s"],
+                "bytes_per_epoch": r["bytes_per_epoch"],
+                "pct_of_hbm_roofline": r["pct_of_hbm_roofline"],
+            }
+        except (RuntimeError, subprocess.TimeoutExpired, ValueError,
+                KeyError) as e:
+            variants[name] = {"error": str(e)[:300]}
+    if "epochs_per_s" not in variants.get("einsum", {}):
+        raise RuntimeError(f"headline variant failed: {variants}")
+    eps = variants["einsum"]["epochs_per_s"]
     payload = {
-        "metric": "epochs/sec (3ch×1000samp) through dwt-8 feature extraction",
-        "value": round(eps, 1),
+        "metric": (
+            "epochs/sec (3ch×1000samp) through dwt-8 feature extraction"
+        ),
+        "value": eps,
         "unit": "epochs/s",
         "vs_baseline": round(eps / BASELINE_EPOCHS_PER_SEC, 3),
+        "pct_of_hbm_roofline": variants["einsum"]["pct_of_hbm_roofline"],
+        "variants": variants,
     }
-    if not on_tpu:
+    if platform != "tpu":
         payload["platform"] = "cpu_fallback"
     return payload
 
@@ -157,17 +169,14 @@ def _measure() -> dict:
 def main() -> None:
     if _tpu_available():
         try:
-            payload = _run_child("tpu")
+            payload = _collect("tpu")
         except (RuntimeError, subprocess.TimeoutExpired, ValueError) as e:
             print(f"bench: TPU run failed ({e}); CPU fallback", file=sys.stderr)
-            payload = _run_child("cpu")
+            payload = _collect("cpu")
     else:
-        payload = _run_child("cpu")
+        payload = _collect("cpu")
     print(json.dumps(payload))
 
 
 if __name__ == "__main__":
-    if len(sys.argv) > 1 and sys.argv[1] == "--child":
-        print(json.dumps(_measure()))
-    else:
-        main()
+    main()

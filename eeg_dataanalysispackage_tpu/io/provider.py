@@ -118,14 +118,19 @@ class OfflineDataProvider:
         epoch_size: int = 512,
         skip_samples: int = 175,
         feature_size: int = 16,
+        backend: str = "xla",
     ):
         """TPU fast path: info.txt run -> DWT features without host epochs.
 
         Per recording, raw int16 channels stage to the device and one
-        fused XLA program (ops/device_ingest.py) produces the
-        L2-normalized feature rows; the host handles only marker
-        metadata and the cross-file balance state. Returns
-        (features (n, C*feature_size) float32, targets (n,) float64).
+        fused program produces the L2-normalized feature rows; the
+        host handles only marker metadata and the cross-file balance
+        state. Returns (features (n, C*feature_size) float32,
+        targets (n,) float64).
+
+        ``backend``: "xla" (ops/device_ingest.py — gather + einsum) or
+        "pallas" (ops/ingest_pallas.py — the fully fused VMEM-chunked
+        kernel; interpret mode off-TPU).
 
         Numerics follow the float32 device path (tolerance-level vs
         the bit-exact host path) — use :meth:`load` + a host-backend
@@ -135,8 +140,20 @@ class OfflineDataProvider:
         from ..epochs.extractor import BalanceState
         from ..ops import device_ingest
 
+        if backend not in ("xla", "pallas"):
+            raise ValueError(f"unknown device-ingest backend {backend!r}")
         prefix, files = self._resolve_files()
         balance = BalanceState()
+        if backend == "pallas":
+            from ..ops import ingest_pallas
+
+            pallas_featurizer = ingest_pallas.make_pallas_ingest_featurizer(
+                wavelet_index=wavelet_index,
+                epoch_size=epoch_size,
+                skip_samples=skip_samples,
+                feature_size=feature_size,
+                pre=self._pre,
+            )
         featurizer = device_ingest.make_device_ingest_featurizer(
             wavelet_index=wavelet_index,
             epoch_size=epoch_size,
@@ -169,8 +186,14 @@ class OfflineDataProvider:
             )
             # async dispatch: keep the device array; the next file's
             # host parse/stage overlaps this file's device compute
-            feats.append((featurizer(raw, res, plan.positions, plan.mask),
-                          plan.mask))
+            if backend == "pallas":
+                kept = plan.positions[plan.mask]
+                feats.append((pallas_featurizer(raw, res, kept), None))
+            else:
+                feats.append(
+                    (featurizer(raw, res, plan.positions, plan.mask),
+                     plan.mask)
+                )
             targets.append(plan.targets)
         n_feat = len(self._channel_names) * feature_size
         if not feats:
@@ -179,7 +202,12 @@ class OfflineDataProvider:
                 np.zeros((0,), dtype=np.float64),
             )
         return (
-            np.concatenate([np.asarray(out)[mask] for out, mask in feats]),
+            np.concatenate(
+                [
+                    np.asarray(out) if mask is None else np.asarray(out)[mask]
+                    for out, mask in feats
+                ]
+            ),
             np.concatenate(targets),
         )
 

@@ -1,0 +1,317 @@
+"""Pallas TPU kernel: fused int16 ingest for IRREGULAR marker positions.
+
+The full reference ingest+feature chain — int16 -> resolution scale ->
+marker-window gather -> float32 baseline correction -> analysis-window
+slice -> 6-level DWT cascade -> channel concat -> L2 normalize
+(OffLineDataProvider.java:167-265 + WaveletTransform.java:108-141) —
+as ONE Pallas kernel over the raw recording. This is the fusion XLA
+cannot do: ``ops/device_ingest.py``'s XLA formulation must materialize
+a window gather (dynamic-slice chains over HBM); here the raw int16
+stream is tiled into VMEM once and windows are cut *in VMEM*.
+
+Design (see docs/ingest_kernel.md for the roofline discussion):
+
+- Host planner (:func:`plan_pallas_tiles`): sort windows by start,
+  greedily pack up to ``tile_b`` epochs whose windows fit in one
+  ``chunk`` of the stream, aligned to half-chunk boundaries so the
+  kernel's two half-chunk BlockSpecs (standard pipelined DMA — no
+  manual descriptors, automatic double buffering, and a revisited
+  half-chunk is NOT re-fetched) cover every tile. Any window fits
+  some aligned chunk because ``window <= chunk/2``.
+- Kernel: per grid step, the two int16 half-chunks are joined and
+  scaled to float32 once; each epoch's 800-sample window (787 live +
+  alignment slack) is a dynamic lane-slice from VMEM, baseline-
+  corrected against the mean of its first ``pre`` samples (explicit
+  subtraction — folding the baseline into the operator cancels
+  catastrophically on real EEG DC offsets), and packed into a
+  (tile_b*C, 800) scratch; one MXU contraction against the padded
+  cascade operator (:func:`..ops.device_ingest.ingest_matrix` with
+  ``fold_baseline=False``; rows past 787 are zero, so the slack needs
+  no masking) yields all features, which are normalized on the VPU
+  and written as one (tile_b, C*K) block.
+- Padded tile rows point at offset 0 and are dropped on unsort.
+
+Interpret mode runs the same kernel on CPU for hermetic tests; on TPU
+it compiles to Mosaic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..utils import constants
+from . import device_ingest
+from . import dwt as dwt_xla
+
+
+@dataclasses.dataclass
+class PallasTilePlan:
+    """Host-side tiling of sorted epoch windows into VMEM chunks."""
+
+    half_idx: np.ndarray  # (n_tiles,) int32 — first half-chunk index
+    offsets: np.ndarray  # (n_tiles, tile_b) int32 — window start - half_idx*half
+    src_rows: np.ndarray  # (n_tiles, tile_b) int32 — original epoch index (-1 pad)
+    chunk: int
+    tile_b: int
+
+    @property
+    def n_tiles(self) -> int:
+        return self.half_idx.shape[0]
+
+
+def plan_pallas_tiles(
+    positions: np.ndarray,
+    pre: int = constants.PRESTIMULUS_SAMPLES,
+    window: int = 800,
+    chunk: int = 65536,
+    tile_b: int = 32,
+) -> PallasTilePlan:
+    """Pack marker windows into (chunk, tile_b) kernel tiles.
+
+    ``positions`` are marker sample positions (window starts at
+    ``position - pre``); callers guarantee validity (the ingest
+    planner's job, device_ingest.plan_ingest). Windows are sorted,
+    then packed greedily: a tile's base is the half-chunk containing
+    its first window; epochs join while their window still fits the
+    base-aligned chunk and the tile has room.
+    """
+    if window > chunk // 2:
+        raise ValueError(f"window {window} must be <= chunk/2 {chunk // 2}")
+    half = chunk // 2
+    starts = np.asarray(positions, dtype=np.int64) - pre
+    if starts.size and starts.min() < 0:
+        raise ValueError("window start < 0; filter invalid markers first")
+    order = np.argsort(starts, kind="stable")
+
+    tiles_half: list[int] = []
+    tiles_rows: list[list[int]] = []
+    tiles_offs: list[list[int]] = []
+    for idx in order:
+        s = int(starts[idx])
+        k = s // half
+        fits = (
+            tiles_half
+            and len(tiles_rows[-1]) < tile_b
+            and s + window <= tiles_half[-1] * half + chunk
+        )
+        if not fits:
+            tiles_half.append(k)
+            tiles_rows.append([])
+            tiles_offs.append([])
+        tiles_rows[-1].append(int(idx))
+        tiles_offs[-1].append(s - tiles_half[-1] * half)
+
+    n_tiles = max(1, len(tiles_half))
+    half_idx = np.zeros(n_tiles, dtype=np.int32)
+    offsets = np.zeros((n_tiles, tile_b), dtype=np.int32)
+    src_rows = np.full((n_tiles, tile_b), -1, dtype=np.int32)
+    for t, (k, rows, offs) in enumerate(
+        zip(tiles_half, tiles_rows, tiles_offs)
+    ):
+        half_idx[t] = k
+        offsets[t, : len(offs)] = offs
+        src_rows[t, : len(rows)] = rows
+    return PallasTilePlan(half_idx, offsets, src_rows, chunk, tile_b)
+
+
+def _make_kernel(
+    n_channels: int, tile_b: int, window: int, chunk: int, pre: int
+):
+    half = chunk // 2
+
+    def kernel(half_ref, offs_ref, a_ref, b_ref, res_ref, e_ref, o_ref,
+               chunk_ref, xa_ref):
+        i = pl.program_id(0)
+        chunk_ref[:, :half] = a_ref[:].astype(jnp.float32) * res_ref[:]
+        chunk_ref[:, half:] = b_ref[:].astype(jnp.float32) * res_ref[:]
+        for e in range(tile_b):
+            off = offs_ref[i, e]
+            seg = chunk_ref[:, pl.ds(off, window)]
+            # explicit f32 baseline subtraction (Baseline.java:29-57);
+            # not folded into E — DC offsets would cancel in f32
+            base = jnp.mean(seg[:, :pre], axis=1, keepdims=True)
+            xa_ref[e * n_channels : (e + 1) * n_channels, :] = seg - base
+        y = lax.dot_general(
+            xa_ref[:],
+            e_ref[:],
+            (((1,), (0,)), ((), ())),
+            precision=lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        )  # (tile_b*C, K)
+        feats = y.reshape(tile_b, n_channels * y.shape[-1])
+        # the shared zero-guarded normalize keeps the XLA and Pallas
+        # device backends parity-locked on the epsilon
+        o_ref[:] = dwt_xla.safe_l2_normalize(feats)
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "tile_b", "chunk", "window", "feature_size", "interpret", "pre",
+    ),
+)
+def _ingest_tiles(
+    raw_i16,
+    resolutions,
+    half_idx,
+    offsets,
+    E,
+    *,
+    tile_b: int,
+    chunk: int,
+    window: int,
+    feature_size: int,
+    interpret: bool,
+    pre: int = constants.PRESTIMULUS_SAMPLES,
+):
+    C = raw_i16.shape[0]
+    n_tiles = half_idx.shape[0]
+    half = chunk // 2
+    K = C * feature_size
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # half_idx, offsets
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((C, half), lambda i, hi, off: (0, hi[i])),
+            pl.BlockSpec((C, half), lambda i, hi, off: (0, hi[i] + 1)),
+            pl.BlockSpec((C, 1), lambda i, hi, off: (0, 0)),
+            pl.BlockSpec((window, feature_size), lambda i, hi, off: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((tile_b, K), lambda i, hi, off: (i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((C, chunk), jnp.float32),
+            pltpu.VMEM((tile_b * C, window), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        _make_kernel(C, tile_b, window, chunk, pre),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n_tiles * tile_b, K), jnp.float32),
+        interpret=interpret,
+    )(half_idx, offsets, raw_i16, raw_i16, resolutions[:, None], E)
+
+
+def ingest_features_pallas(
+    raw_i16: np.ndarray,
+    resolutions: np.ndarray,
+    positions: np.ndarray,
+    wavelet_index: int = 8,
+    epoch_size: int = 512,
+    skip_samples: int = 175,
+    feature_size: int = 16,
+    pre: int = constants.PRESTIMULUS_SAMPLES,
+    chunk: int = 65536,
+    tile_b: int = 32,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """(C, S) int16 raw + (n,) marker positions -> (n, C*K) features.
+
+    The Pallas counterpart of
+    ``device_ingest.make_device_ingest_featurizer``; positions must be
+    pre-validated (plan_ingest). Output rows are in input marker
+    order.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    live = pre + skip_samples + epoch_size
+    window = ((live + 7) // 8) * 8  # alignment slack; E zero past live
+    plan = plan_pallas_tiles(
+        positions, pre=pre, window=window, chunk=chunk, tile_b=tile_b
+    )
+    E = jnp.asarray(
+        device_ingest.ingest_matrix(
+            wavelet_index, epoch_size, skip_samples, feature_size, pre,
+            window_len=window, fold_baseline=False,
+        )
+    )
+    half = chunk // 2
+    # Bucket both jit-cache keys so multi-recording runs reuse the
+    # compiled kernel instead of recompiling per marker layout:
+    # (a) tile count rounds up to a multiple of 8 (padded tiles point
+    # at block 0 with src_rows -1 and are dropped on unsort);
+    # (b) the raw sample axis rounds up to a multiple of 8 chunks.
+    n_tiles = plan.half_idx.shape[0]
+    bucket = ((n_tiles + 7) // 8) * 8
+    if bucket != n_tiles:
+        pad_t = bucket - n_tiles
+        plan = PallasTilePlan(
+            np.concatenate([plan.half_idx,
+                            np.zeros(pad_t, np.int32)]),
+            np.concatenate([plan.offsets,
+                            np.zeros((pad_t, tile_b), np.int32)]),
+            np.concatenate([plan.src_rows,
+                            np.full((pad_t, tile_b), -1, np.int32)]),
+            chunk,
+            tile_b,
+        )
+    # every referenced half-chunk (hi and hi+1) must exist
+    needed = (int(plan.half_idx.max(initial=0)) + 2) * half
+    C, S = raw_i16.shape
+    sample_bucket = 8 * chunk
+    padded = ((max(S, needed) + sample_bucket - 1)
+              // sample_bucket) * sample_bucket
+    if padded != S:
+        raw_i16 = np.pad(raw_i16, ((0, 0), (0, padded - S)))
+    tiled = _ingest_tiles(
+        jnp.asarray(raw_i16),
+        jnp.asarray(resolutions, jnp.float32),
+        jnp.asarray(plan.half_idx),
+        jnp.asarray(plan.offsets),
+        E,
+        tile_b=tile_b,
+        chunk=chunk,
+        window=window,
+        feature_size=feature_size,
+        interpret=bool(interpret),
+        pre=pre,
+    )
+    # unsort: tiled row t*tile_b+e holds epoch src_rows[t, e]
+    flat_src = plan.src_rows.reshape(-1)
+    real = flat_src >= 0
+    inv = np.empty(int(real.sum()), dtype=np.int64)
+    inv[flat_src[real]] = np.nonzero(real)[0]
+    return tiled[jnp.asarray(inv)]
+
+
+def make_pallas_ingest_featurizer(
+    wavelet_index: int = 8,
+    epoch_size: int = 512,
+    skip_samples: int = 175,
+    feature_size: int = 16,
+    pre: int = constants.PRESTIMULUS_SAMPLES,
+    chunk: int = 65536,
+    tile_b: int = 32,
+    interpret: bool | None = None,
+):
+    """Callable (raw int16, resolutions, positions) -> features, the
+    plug-in counterpart of ``make_device_ingest_featurizer`` for the
+    Pallas path (host planning happens per call; the kernel is jitted
+    and cached by shape)."""
+
+    def featurize(raw_i16, resolutions, positions):
+        return ingest_features_pallas(
+            np.asarray(raw_i16),
+            np.asarray(resolutions, np.float32),
+            np.asarray(positions),
+            wavelet_index=wavelet_index,
+            epoch_size=epoch_size,
+            skip_samples=skip_samples,
+            feature_size=feature_size,
+            pre=pre,
+            chunk=chunk,
+            tile_b=tile_b,
+            interpret=interpret,
+        )
+
+    return featurize

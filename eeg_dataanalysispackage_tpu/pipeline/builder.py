@@ -82,10 +82,17 @@ class PipelineBuilder:
         # host epoch batch ever exists and classifiers consume feature
         # rows directly. All other fe= values follow the reference
         # shape: epochs load first, the registry extractor maps them.
-        fused = query_map.get("fe") == "dwt-8-fused"
+        # dwt-8-fused-pallas routes the same mode through the Pallas
+        # ingest kernel (ops/ingest_pallas.py)
+        fused = query_map.get("fe") in ("dwt-8-fused", "dwt-8-fused-pallas")
         if fused:
+            backend = (
+                "pallas"
+                if query_map["fe"] == "dwt-8-fused-pallas"
+                else "xla"
+            )
             with self.timers.stage("ingest"):
-                features, targets = odp.load_features_device()
+                features, targets = odp.load_features_device(backend=backend)
             fe = None
             n = len(targets)
         else:

@@ -73,3 +73,35 @@ def test_train_step_learns_on_fixture(fixture_dir):
     )[: len(batch)]
     acc = ((probs > 0.5).astype(float) == batch.targets).mean()
     assert acc >= 0.7
+
+
+def test_bench_cpu_fallback_contract():
+    """bench.py must print ONE parseable JSON line with the headline
+    and the fused-ingest/train-step variants even with no TPU
+    (BENCH_FORCE_CPU=1) — the driver-artifact contract."""
+    import json
+    import subprocess
+    import sys
+
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    # per-variant child timeout small enough that 4 worst-case
+    # children still finish inside this test's own 580s deadline
+    env = dict(os.environ, BENCH_FORCE_CPU="1", BENCH_RUN_TIMEOUT="120")
+    env.pop("JAX_PLATFORMS", None)  # bench manages its own children env
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py")],
+        capture_output=True,
+        text=True,
+        timeout=580,
+        env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    lines = proc.stdout.strip().splitlines()
+    assert len(lines) == 1
+    payload = json.loads(lines[0])
+    assert payload["unit"] == "epochs/s"
+    assert payload["value"] > 0
+    assert payload["platform"] == "cpu_fallback"
+    assert "pct_of_hbm_roofline" in payload
+    for v in ("einsum", "regular_ingest", "pallas_ingest", "train_step"):
+        assert payload["variants"][v]["epochs_per_s"] > 0, payload

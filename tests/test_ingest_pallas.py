@@ -1,0 +1,200 @@
+"""Fused-ingest kernels: Pallas irregular path + regular stimulus train.
+
+Pins the ops/ingest_pallas.py kernel (interpret mode on CPU) and the
+regular-stride static ingest against the established XLA device-ingest
+path (itself pinned against the bit-exact host path in
+tests/test_device_ingest.py).
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from eeg_dataanalysispackage_tpu.ops import (  # noqa: E402
+    device_ingest,
+    dwt as dwt_xla,
+    ingest_pallas,
+)
+
+
+def xla_reference_features(raw, res, positions):
+    """Features via the XLA epocher + extractor (the pinned path)."""
+    n = len(positions)
+    cap = ((n + 63) // 64) * 64
+    pos_pad = np.zeros(cap, np.int32)
+    pos_pad[:n] = positions
+    mask = np.zeros(cap, bool)
+    mask[:n] = True
+    epocher = device_ingest.make_device_epocher()
+    epochs = epocher(
+        jnp.asarray(np.pad(raw, ((0, 0), (0, 900)))),
+        jnp.asarray(res),
+        jnp.asarray(pos_pad),
+        jnp.asarray(mask),
+    )
+    return np.asarray(dwt_xla.make_batched_extractor()(epochs))[:n]
+
+
+@pytest.fixture(scope="module")
+def fixture_raw():
+    rng = np.random.RandomState(0)
+    raw = rng.randint(-3000, 3000, size=(3, 120000), dtype=np.int16)
+    res = np.array([0.1, 0.1, 0.2], np.float32)
+    return raw, res
+
+
+def test_pallas_matches_xla_ingest(fixture_raw):
+    raw, res = fixture_raw
+    rng = np.random.RandomState(1)
+    positions = rng.choice(
+        np.arange(200, raw.shape[1] - 800), size=41, replace=False
+    ).astype(np.int64)  # unsorted on purpose: output must be input-order
+    got = np.asarray(ingest_pallas.ingest_features_pallas(raw, res, positions))
+    want = xla_reference_features(raw, res, positions)
+    assert got.shape == want.shape == (41, 48)
+    np.testing.assert_allclose(got, want, rtol=0, atol=5e-6)
+
+
+def test_pallas_dense_markers_small_chunk(fixture_raw):
+    """Markers denser than a tile's span: plan must split tiles
+    correctly and windows near half-chunk boundaries must read across
+    the two half blocks."""
+    raw, res = fixture_raw
+    positions = (100 + 173 * np.arange(300)).astype(np.int64)
+    got = np.asarray(
+        ingest_pallas.ingest_features_pallas(
+            raw, res, positions, chunk=8192, tile_b=8
+        )
+    )
+    want = xla_reference_features(raw, res, positions)
+    np.testing.assert_allclose(got, want, rtol=0, atol=5e-6)
+
+
+def test_pallas_single_epoch(fixture_raw):
+    raw, res = fixture_raw
+    got = np.asarray(
+        ingest_pallas.ingest_features_pallas(
+            raw, res, np.array([5000], dtype=np.int64)
+        )
+    )
+    want = xla_reference_features(raw, res, np.array([5000]))
+    np.testing.assert_allclose(got, want, rtol=0, atol=5e-6)
+
+
+def test_plan_tiles_packing():
+    positions = np.array([100, 900, 1700, 60000, 60800], dtype=np.int64)
+    plan = ingest_pallas.plan_pallas_tiles(
+        positions, chunk=65536, tile_b=4
+    )
+    # first three windows share a chunk; the 60000s pair starts a new
+    # tile only if it overflows the first tile's aligned chunk —
+    # 60800-100+800 <= 65536 so all five could fit but tile_b=4 splits
+    assert plan.n_tiles == 2
+    assert (plan.src_rows >= 0).sum() == 5
+    # every offset in range for its chunk
+    assert (plan.offsets >= 0).all()
+    assert (plan.offsets <= plan.chunk - 800).all()
+
+
+def test_plan_rejects_negative_start():
+    with pytest.raises(ValueError):
+        ingest_pallas.plan_pallas_tiles(np.array([50], dtype=np.int64))
+
+
+def test_ingest_matrix_folds_baseline():
+    """E applied to a raw window == baseline-correct + slice + cascade."""
+    rng = np.random.RandomState(3)
+    x = rng.randn(787).astype(np.float64) * 40
+    E = device_ingest.ingest_matrix(window_len=800).astype(np.float64)
+    got = np.pad(x, (0, 13)) @ E
+    corrected = x[100:] - x[:100].mean()
+    W = np.asarray(dwt_xla.cascade_matrix(8, 512, 16))
+    want = corrected[175 : 175 + 512] @ W
+    # E is stored float32 (the device operand dtype)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_regular_ingest_matches_xla(fixture_raw):
+    raw, res = fixture_raw
+    n, stride, first = 30, 800, 150
+    ing = device_ingest.make_regular_ingest_featurizer(stride, n)
+    got = np.asarray(ing(jnp.asarray(raw), jnp.asarray(res), first))
+    positions = first + stride * np.arange(n)
+    want = xla_reference_features(raw, res, positions)
+    np.testing.assert_allclose(got, want, rtol=0, atol=5e-6)
+
+
+def test_regular_ingest_rejects_overlapping_stride():
+    with pytest.raises(ValueError):
+        device_ingest.make_regular_ingest_featurizer(700, 10)
+
+
+def test_provider_pallas_backend_matches_xla(fixture_dir):
+    """load_features_device(backend='pallas') returns the same rows
+    (to f32 tolerance) and targets as the XLA gather backend on the
+    reference fixture."""
+    from eeg_dataanalysispackage_tpu.io import provider
+
+    odp_x = provider.OfflineDataProvider([fixture_dir + "/infoTrain.txt"])
+    fx, tx = odp_x.load_features_device()
+    odp_p = provider.OfflineDataProvider([fixture_dir + "/infoTrain.txt"])
+    fp, tp = odp_p.load_features_device(backend="pallas")
+    assert fx.shape == fp.shape == (11, 48)
+    np.testing.assert_array_equal(tx, tp)
+    np.testing.assert_allclose(fp, fx, rtol=0, atol=5e-6)
+
+
+def test_fused_pallas_pipeline_query_mode(fixture_dir, tmp_path):
+    """fe=dwt-8-fused-pallas drives the whole query pipeline through
+    the Pallas ingest kernel."""
+    from eeg_dataanalysispackage_tpu.pipeline import builder
+
+    result = tmp_path / "result.txt"
+    q = (
+        f"info_file={fixture_dir}/infoTrain.txt&fe=dwt-8-fused-pallas"
+        f"&train_clf=logreg&result_path={result}"
+    )
+    stats = builder.PipelineBuilder(q).execute()
+    assert stats.num_patterns == 11 - int(0.7 * 11)
+    assert "Accuracy:" in result.read_text()
+
+
+def test_provider_rejects_unknown_backend(fixture_dir):
+    from eeg_dataanalysispackage_tpu.io import provider
+
+    odp = provider.OfflineDataProvider([fixture_dir + "/infoTrain.txt"])
+    with pytest.raises(ValueError):
+        odp.load_features_device(backend="cuda")
+
+
+def test_regular_ingest_bounds_check(fixture_raw):
+    """dynamic_slice would clamp out-of-range starts and silently
+    shift every window; the wrapper must raise instead."""
+    raw, res = fixture_raw
+    ing = device_ingest.make_regular_ingest_featurizer(800, 10)
+    with pytest.raises(ValueError):
+        ing(jnp.asarray(raw[:, : 10 * 800]), jnp.asarray(res), 150)
+    with pytest.raises(ValueError):
+        ing(jnp.asarray(raw), jnp.asarray(res), 50)  # first < pre
+
+
+def test_pallas_jit_key_is_bucketed(fixture_raw):
+    """Different marker layouts of similar size must reuse the same
+    compiled kernel: tile count and padded raw length are bucketed."""
+    raw, res = fixture_raw
+    pos_a = (200 + 900 * np.arange(40)).astype(np.int64)
+    pos_b = (350 + 911 * np.arange(43)).astype(np.int64)
+    window, chunk, tile_b = 800, 65536, 32
+    for pos in (pos_a, pos_b):
+        plan = ingest_pallas.plan_pallas_tiles(
+            pos, window=window, chunk=chunk, tile_b=tile_b
+        )
+        assert plan.n_tiles <= 8  # both bucket to 8 tiles after padding
+    before = ingest_pallas._ingest_tiles._cache_size()
+    a = ingest_pallas.ingest_features_pallas(raw, res, pos_a)
+    b = ingest_pallas.ingest_features_pallas(raw, res, pos_b)
+    after = ingest_pallas._ingest_tiles._cache_size()
+    assert after - before <= 1
+    assert a.shape == (40, 48) and b.shape == (43, 48)

@@ -1,0 +1,234 @@
+"""Per-variant ingest/feature benchmark (real chip or CPU).
+
+Usage: python tools/ingest_bench.py <variant> [n_epochs] [iters]
+
+Variants:
+  einsum          f32 epochs resident in HBM -> dwt-8 features
+                  (the round-1 headline path, ops/dwt.py)
+  xla_ingest      int16 raw + irregular markers -> features via the
+                  XLA gather formulation (ops/device_ingest.py)
+  pallas_ingest   int16 raw + irregular markers -> features via the
+                  fused Pallas kernel (ops/ingest_pallas.py)
+  regular_ingest  int16 raw + regular stimulus train -> features via
+                  the static-reshape einsum (no gather)
+  train_step      f32 epochs -> features -> logreg forward/backward/
+                  update (parallel/train.py one-step)
+
+Prints one JSON line: {"variant", "epochs_per_s", "bytes_per_epoch",
+"pct_of_hbm_roofline", ...}. Run each variant in its own process (the
+driver-facing bench.py orchestrates that with timeouts/fallbacks).
+
+Timing: the axon tunnel does not synchronize on block_until_ready, so
+the loop runs inside one jitted lax.scan whose per-iteration input is
+perturbed (prevents hoisting) and the clock closes on fetching a
+scalar that depends on every iteration.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# v5e HBM bandwidth (GB/s) for roofline context; override for other gens.
+HBM_GBPS = float(os.environ.get("BENCH_HBM_GBPS", 819.0))
+
+STRIDE = 750  # irregular-marker mean spacing (samples at 1 kHz)
+REGULAR_STRIDE = 800  # fixed-SOA paradigm
+
+
+def run(variant: str, n: int, iters: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    on_tpu = jax.devices()[0].platform not in ("cpu",)
+    rng = np.random.RandomState(0)
+    res = np.array([0.1, 0.1, 0.2], np.float32)
+
+    if variant == "einsum":
+        from eeg_dataanalysispackage_tpu.ops import dwt as dwt_xla
+
+        extract = dwt_xla.make_batched_extractor()
+        epochs = jax.random.normal(
+            jax.random.PRNGKey(0), (n, 3, 1000), dtype=jnp.float32
+        ) * 50.0
+
+        @jax.jit
+        def loop(x):
+            def body(acc, i):
+                y = extract(x + i.astype(jnp.float32))
+                return acc + y.sum(), None
+
+            acc, _ = jax.lax.scan(body, jnp.float32(0), jnp.arange(iters))
+            return acc
+
+        arg = epochs
+        bytes_per_epoch = 3 * 1000 * 4
+
+    elif variant in ("xla_ingest", "pallas_ingest"):
+        S = 200 + n * STRIDE + 1000
+        raw = rng.randint(-3000, 3000, size=(3, S), dtype=np.int16)
+        base = np.arange(n, dtype=np.int64) * STRIDE + 200
+        jitter = rng.randint(-200, 200, size=n)
+        positions = np.clip(base + jitter, 100, S - 800)
+        bytes_per_epoch = 3 * STRIDE * 2
+
+        if variant == "xla_ingest":
+            from eeg_dataanalysispackage_tpu.ops import device_ingest
+
+            feat = device_ingest.make_device_ingest_featurizer()
+            cap = ((n + 63) // 64) * 64
+            pos_pad = np.zeros(cap, np.int32)
+            pos_pad[:n] = positions
+            mask = np.zeros(cap, bool)
+            mask[:n] = True
+            raw_p = np.pad(raw, ((0, 0), (0, 900)))
+            args = (
+                jnp.asarray(raw_p), jnp.asarray(res),
+                jnp.asarray(pos_pad), jnp.asarray(mask),
+            )
+
+            @jax.jit
+            def loop(raw_a, res_a, pos_a, mask_a):
+                def body(acc, i):
+                    y = feat(
+                        raw_a + (i % 2).astype(jnp.int16), res_a, pos_a,
+                        mask_a,
+                    )
+                    return acc + y.sum(), None
+
+                acc, _ = jax.lax.scan(body, jnp.float32(0),
+                                      jnp.arange(iters))
+                return acc
+
+            arg = args
+        else:
+            from eeg_dataanalysispackage_tpu.ops import ingest_pallas
+
+            window = 800
+            chunk = int(os.environ.get("BENCH_CHUNK", 65536))
+            tile_b = int(os.environ.get("BENCH_TILE_B", 32))
+            plan = ingest_pallas.plan_pallas_tiles(
+                positions, window=window, chunk=chunk, tile_b=tile_b
+            )
+            from eeg_dataanalysispackage_tpu.ops import device_ingest
+
+            E = jnp.asarray(
+                device_ingest.ingest_matrix(
+                    window_len=window, fold_baseline=False
+                )
+            )
+            half = chunk // 2
+            needed = (int(plan.half_idx.max(initial=0)) + 2) * half
+            if raw.shape[1] < needed:
+                raw = np.pad(raw, ((0, 0), (0, needed - raw.shape[1])))
+            elif raw.shape[1] % half:
+                raw = np.pad(
+                    raw, ((0, 0), (0, half - raw.shape[1] % half))
+                )
+            fill = float((plan.src_rows >= 0).mean())
+            args = (
+                jnp.asarray(raw), jnp.asarray(res, jnp.float32),
+                jnp.asarray(plan.half_idx), jnp.asarray(plan.offsets), E,
+            )
+
+            @jax.jit
+            def loop(raw_a, res_a, hi, offs, E_a):
+                def body(acc, i):
+                    y = ingest_pallas._ingest_tiles(
+                        raw_a + (i % 2).astype(jnp.int16), res_a, hi, offs,
+                        E_a, tile_b=tile_b, chunk=chunk, window=window,
+                        feature_size=16, interpret=not on_tpu,
+                    )
+                    return acc + y.sum(), None
+
+                acc, _ = jax.lax.scan(body, jnp.float32(0),
+                                      jnp.arange(iters))
+                return acc
+
+            arg = args
+
+    elif variant == "regular_ingest":
+        from eeg_dataanalysispackage_tpu.ops import device_ingest
+
+        S = 200 + n * REGULAR_STRIDE + 1000
+        raw = rng.randint(-3000, 3000, size=(3, S), dtype=np.int16)
+        ing = device_ingest.make_regular_ingest_featurizer(REGULAR_STRIDE, n)
+        bytes_per_epoch = 3 * REGULAR_STRIDE * 2
+        args = (jnp.asarray(raw), jnp.asarray(res))
+
+        @jax.jit
+        def loop(raw_a, res_a):
+            def body(acc, i):
+                y = ing(raw_a + (i % 2).astype(jnp.int16), res_a, 150)
+                return acc + y.sum(), None
+
+            acc, _ = jax.lax.scan(body, jnp.float32(0), jnp.arange(iters))
+            return acc
+
+        arg = args
+
+    elif variant == "train_step":
+        from eeg_dataanalysispackage_tpu.parallel import train as ptrain
+
+        epochs = jax.random.normal(
+            jax.random.PRNGKey(0), (n, 3, 1000), dtype=jnp.float32
+        ) * 50.0
+        labels = jnp.asarray(
+            rng.randint(0, 2, size=n).astype(np.float32)
+        )
+        init_state, step = ptrain.make_train_step()
+        state0 = init_state(jax.random.PRNGKey(0))
+        mask = jnp.ones((n,), jnp.float32)
+        bytes_per_epoch = 3 * 1000 * 4
+
+        @jax.jit
+        def loop(x, y, m):
+            def body(state, i):
+                state2, loss = step(state, x + i, y, m)
+                return state2, loss
+
+            state, losses = jax.lax.scan(
+                body, state0, jnp.arange(iters, dtype=jnp.float32)
+            )
+            return jax.tree_util.tree_reduce(
+                lambda a, b: a + b.sum(), state, jnp.float32(0)
+            ) + losses.sum()
+
+        arg = (epochs, labels, mask)
+
+    else:
+        raise SystemExit(f"unknown variant {variant!r}")
+
+    args = arg if isinstance(arg, tuple) else (arg,)
+    float(loop(*args))  # compile + warmup
+    start = time.perf_counter()
+    checksum = float(loop(*args))
+    elapsed = time.perf_counter() - start
+    assert np.isfinite(checksum), "non-finite checksum"
+
+    eps = n * iters / elapsed
+    gbps = eps * bytes_per_epoch / 1e9
+    payload = {
+        "variant": variant,
+        "epochs_per_s": round(eps, 1),
+        "n": n,
+        "iters": iters,
+        "elapsed_s": round(elapsed, 3),
+        "bytes_per_epoch": bytes_per_epoch,
+        "achieved_GBps": round(gbps, 1),
+        "pct_of_hbm_roofline": round(100.0 * gbps / HBM_GBPS, 1),
+        "platform": jax.devices()[0].platform,
+    }
+    if variant == "pallas_ingest":
+        payload["tile_fill"] = round(fill, 3)
+    return payload
+
+
+if __name__ == "__main__":
+    variant = sys.argv[1]
+    n = int(sys.argv[2]) if len(sys.argv) > 2 else 131072
+    iters = int(sys.argv[3]) if len(sys.argv) > 3 else 20
+    print(json.dumps(run(variant, n, iters)))
