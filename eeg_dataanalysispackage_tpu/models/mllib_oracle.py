@@ -93,7 +93,12 @@ def run_gradient_descent(
             # multiplier = 1/(1+exp(margin)) - label
             for k in range(n):
                 margin = -float(np.dot(x[k], w))
-                multiplier = 1.0 / (1.0 + math.exp(margin)) - y[k]
+                # np.exp returns inf past ~709 (Java Math.exp
+                # semantics: 1/(1+Inf) == 0); math.exp would raise
+                with np.errstate(over="ignore"):
+                    multiplier = float(
+                        1.0 / (1.0 + np.exp(np.float64(margin)))
+                    ) - y[k]
                 grad_sum += multiplier * x[k]
                 # MLUtils.log1pExp(margin), minus margin for label 0
                 if margin > 0:

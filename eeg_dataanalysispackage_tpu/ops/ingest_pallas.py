@@ -19,8 +19,9 @@ Design (see docs/ingest_kernel.md for the roofline discussion):
   half-chunk is NOT re-fetched) cover every tile. Any window fits
   some aligned chunk because ``window <= chunk/2``.
 - Kernel: per grid step, the two int16 half-chunks are joined and
-  scaled to float32 once; each epoch's 800-sample window (787 live +
-  alignment slack) is a dynamic lane-slice from VMEM, baseline-
+  scaled to float32 once; each epoch's 8-aligned window (787 live
+  samples + slack; ``DEFAULT_WINDOW`` = 792) is a dynamic lane-slice
+  from VMEM, baseline-
   corrected against the mean of its first ``pre`` samples (explicit
   subtraction — folding the baseline into the operator cancels
   catastrophically on real EEG DC offsets), and packed into a
@@ -68,10 +69,13 @@ class PallasTilePlan:
         return self.half_idx.shape[0]
 
 
+DEFAULT_WINDOW = 792  # ((100 + 175 + 512) + 7) // 8 * 8 — 787 live + slack
+
+
 def plan_pallas_tiles(
     positions: np.ndarray,
     pre: int = constants.PRESTIMULUS_SAMPLES,
-    window: int = 800,
+    window: int = DEFAULT_WINDOW,
     chunk: int = 65536,
     tile_b: int = 32,
 ) -> PallasTilePlan:

@@ -107,7 +107,7 @@ def run(variant: str, n: int, iters: int) -> dict:
         else:
             from eeg_dataanalysispackage_tpu.ops import ingest_pallas
 
-            window = 800
+            window = ingest_pallas.DEFAULT_WINDOW  # the shipped kernel shape
             chunk = int(os.environ.get("BENCH_CHUNK", 65536))
             tile_b = int(os.environ.get("BENCH_TILE_B", 32))
             plan = ingest_pallas.plan_pallas_tiles(
