@@ -21,6 +21,10 @@ config surface is preserved:
   adagrad|rmsprop (default nesterovs), loss mse|xent|squared_loss|
   negativeloglikelihood (default mse), activation sigmoid|softmax|
   relu|tanh|identity|softplus|elu (default sigmoid);
+  optimization_algo stochastic_gradient_descent|lbfgs|
+  conjugate_gradient|line_gradient_descent are all FUNCTIONAL
+  (optax L-BFGS / PR+ CG / backtracking line search; unknown values
+  fall back to the sgd family silently, like DL4J);
 - labels are one-hot pairs [target, 1-target]
   (NeuralNetworkClassifier.java:81-84) and the prediction is
   ``output[0]`` (:161);
@@ -105,6 +109,69 @@ def _updater(name: str, lr: float, momentum: float):
         "rmsprop": lambda: optax.rmsprop(lr),
     }
     return opts.get(name, opts["nesterovs"])()
+
+
+def _conjugate_gradient(lr: float) -> optax.GradientTransformation:
+    """Polak-Ribière+ nonlinear CG. DL4J pairs CG with a line search;
+    here the configured learning rate fixes the step (documented
+    functional equivalent, not a DL4J trajectory match)."""
+
+    def tdot(a, b):
+        leaves_a = jax.tree_util.tree_leaves(a)
+        leaves_b = jax.tree_util.tree_leaves(b)
+        return sum(jnp.vdot(x, y) for x, y in zip(leaves_a, leaves_b))
+
+    def init_fn(params):
+        z = jax.tree_util.tree_map(jnp.zeros_like, params)
+        return (z, z)  # (prev_grad, prev_dir)
+
+    def update_fn(grads, state, params=None):
+        del params
+        prev_g, prev_d = state
+        num = tdot(
+            grads,
+            jax.tree_util.tree_map(lambda g, p: g - p, grads, prev_g),
+        )
+        den = tdot(prev_g, prev_g)
+        # first step (den == 0) and PR+ restart both give beta = 0,
+        # i.e. plain steepest descent
+        beta = jnp.where(
+            den > 0.0, jnp.maximum(num / jnp.maximum(den, 1e-30), 0.0), 0.0
+        )
+        d = jax.tree_util.tree_map(
+            lambda g, pd: -g + beta * pd, grads, prev_d
+        )
+        updates = jax.tree_util.tree_map(lambda x: lr * x, d)
+        return updates, (grads, d)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def _optimizer(algo: str, updater_name: str, lr: float, momentum: float):
+    """(transform, needs_value_fn) for ``config_optimization_algo``.
+
+    DL4J's four algorithms (NeuralNetworkClassifier.java:246-255,
+    silent fallback to STOCHASTIC_GRADIENT_DESCENT): sgd runs the
+    configured updater; lbfgs and line_gradient_descent run optax's
+    L-BFGS / steepest-descent-with-backtracking-line-search (their
+    ``update`` needs value/grad/value_fn); conjugate_gradient runs
+    Polak-Ribière+ CG.
+    """
+    if algo == "lbfgs":
+        return optax.lbfgs(), True
+    if algo == "line_gradient_descent":
+        return (
+            optax.chain(
+                optax.sgd(learning_rate=1.0),
+                optax.scale_by_backtracking_linesearch(
+                    max_backtracking_steps=15
+                ),
+            ),
+            True,
+        )
+    if algo == "conjugate_gradient":
+        return _conjugate_gradient(lr), False
+    return _updater(updater_name, lr, momentum), False
 
 
 class _Net(linen.Module):
@@ -301,7 +368,7 @@ class NeuralNetworkClassifier(base.Classifier):
         momentum = float(self._require("config_momentum"))
         weight_init = self._require("config_weight_init")
         updater_name = self._require("config_updater")
-        self._require("config_optimization_algo")  # accepted; SGD family only
+        algo = self._require("config_optimization_algo").lower()
         # Boolean.parseBoolean semantics: "true" (any case) is true
         pretrain = self._require("config_pretrain").lower() == "true"
         backprop = self._require("config_backprop").lower() == "true"
@@ -323,7 +390,7 @@ class NeuralNetworkClassifier(base.Classifier):
         model = self._build()
         rng = jax.random.PRNGKey(seed)
         params = model.init({"params": rng, "dropout": rng}, x[:1], train=False)
-        tx = _updater(updater_name, lr, momentum)
+        tx, needs_value_fn = _optimizer(algo, updater_name, lr, momentum)
         loss = _loss_fn(self.config.get("config_loss_function", "mse"))
 
         if pretrain:
@@ -347,8 +414,16 @@ class NeuralNetworkClassifier(base.Classifier):
                         )
                         return loss(pred, y)
 
-                    grads = jax.grad(objective)(params)
-                    updates, opt_state2 = tx.update(grads, opt_state, params)
+                    value, grads = jax.value_and_grad(objective)(params)
+                    if needs_value_fn:  # lbfgs / line-search transforms
+                        updates, opt_state2 = tx.update(
+                            grads, opt_state, params,
+                            value=value, grad=grads, value_fn=objective,
+                        )
+                    else:
+                        updates, opt_state2 = tx.update(
+                            grads, opt_state, params
+                        )
                     return (optax.apply_updates(params, updates),
                             opt_state2), None
 

@@ -25,7 +25,7 @@ Design (see docs/ingest_kernel.md for the roofline discussion):
   corrected against the mean of its first ``pre`` samples (explicit
   subtraction — folding the baseline into the operator cancels
   catastrophically on real EEG DC offsets), and packed into a
-  (tile_b*C, 800) scratch; one MXU contraction against the padded
+  (tile_b*C, window) scratch; one MXU contraction against the padded
   cascade operator (:func:`..ops.device_ingest.ingest_matrix` with
   ``fold_baseline=False``; rows past 787 are zero, so the slack needs
   no masking) yields all features, which are normalized on the VPU

@@ -1,0 +1,39 @@
+#!/bin/bash
+# Real-chip validation sweep: parity + all bench variants (+ a Pallas
+# tile-geometry sweep). Run in background with a generous timeout and
+# NEVER kill it mid-compile (axon tunnel wedges). Results land in
+# /tmp/sweep/*.json, one JSON line each.
+set -u
+OUT=${1:-/tmp/sweep}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+probe() {
+  timeout 90 python -c "import jax; print(jax.devices()[0].platform)" 2>/dev/null
+}
+
+plat=$(probe)
+if [ "$plat" != "axon" ] && [ -z "$plat" ]; then
+  echo "TPU not reachable; aborting sweep" >&2
+  exit 1
+fi
+echo "platform: $plat"
+
+run() { # name, timeout, cmd...
+  name=$1; t=$2; shift 2
+  echo "== $name =="
+  timeout "$t" "$@" >"$OUT/$name.json" 2>"$OUT/$name.err"
+  echo "rc=$? $(tail -c 400 "$OUT/$name.json")"
+}
+
+run parity        420 python tools/tpu_parity_check.py
+run einsum        420 python tools/ingest_bench.py einsum 262144 50
+run regular       420 python tools/ingest_bench.py regular_ingest 262144 20
+run pallas_64k32  420 python tools/ingest_bench.py pallas_ingest 131072 20
+BENCH_CHUNK=131072 BENCH_TILE_B=64 \
+run pallas_128k64 420 python tools/ingest_bench.py pallas_ingest 131072 20
+BENCH_CHUNK=32768 BENCH_TILE_B=16 \
+run pallas_32k16  420 python tools/ingest_bench.py pallas_ingest 131072 20
+run xla_ingest    420 python tools/ingest_bench.py xla_ingest 32768 10
+run train_step    420 python tools/ingest_bench.py train_step 131072 20
+echo "sweep done"
