@@ -243,10 +243,15 @@ def _loss_fn(name: str):
 # -- greedy layerwise pretraining --------------------------------------
 
 
-def _pretrain_ae(key, h, kernel, bias, act_name, tx, iterations):
+def _pretrain_ae(key, h, kernel, bias, act_name, tx, iterations,
+                 needs_value_fn=False):
     """Tied-weight denoising autoencoder on activations ``h``:
     encode z = act(h_corrupt @ W + b), decode r = z @ W.T + c (linear
-    visible units), minimize MSE(r, h). Returns trained (W, b)."""
+    visible units), minimize MSE(r, h). Returns trained (W, b).
+
+    The AE objective is a real scalar loss, so the configured
+    optimization algorithm applies here too (lbfgs/line-search pass
+    value/grad/value_fn through ``needs_value_fn``)."""
     act = _activation(act_name)
     c0 = jnp.zeros((h.shape[1],), h.dtype)
     params = {"W": kernel, "b": bias, "c": c0}
@@ -266,8 +271,14 @@ def _pretrain_ae(key, h, kernel, bias, act_name, tx, iterations):
                 r = z @ p["W"].T + p["c"]
                 return jnp.mean((r - h) ** 2)
 
-            grads = jax.grad(objective)(params)
-            updates, opt_state2 = tx.update(grads, opt_state, params)
+            value, grads = jax.value_and_grad(objective)(params)
+            if needs_value_fn:
+                updates, opt_state2 = tx.update(
+                    grads, opt_state, params,
+                    value=value, grad=grads, value_fn=objective,
+                )
+            else:
+                updates, opt_state2 = tx.update(grads, opt_state, params)
             return (optax.apply_updates(params, updates), opt_state2), None
 
         (params, opt_state), _ = jax.lax.scan(
@@ -396,7 +407,7 @@ class NeuralNetworkClassifier(base.Classifier):
         if pretrain:
             params = self._greedy_pretrain(
                 model, params, x, ltypes, n_outs, acts, drops, weight_init,
-                updater_name, lr, momentum, iterations, rng,
+                updater_name, lr, momentum, iterations, rng, algo,
             )
 
         if backprop:
@@ -439,12 +450,18 @@ class NeuralNetworkClassifier(base.Classifier):
     def _greedy_pretrain(
         self, model, params, x, ltypes, n_outs, acts, drops, weight_init,
         updater_name, lr, momentum, iterations, rng,
+        algo="stochastic_gradient_descent",
     ):
         """DL4J MultiLayerNetwork pretrain walk: for each pretrainable
         layer, feed the input forward through the preceding layers
         (with their current weights) and train that layer unsupervised
         on the resulting activations, writing the tensors back into
-        the model's params by layer name."""
+        the model's params by layer name.
+
+        AE layers honor ``config_optimization_algo`` (their
+        reconstruction loss is a real objective); RBM layers always
+        use the first-order updater — CD-1's pseudo-gradient has no
+        scalar objective for a line search to evaluate."""
         params = jax.tree_util.tree_map(lambda a: a, params)  # shallow copy
         for i, ltype in enumerate(ltypes):
             if ltype not in _PRETRAINABLE or i == len(ltypes) - 1:
@@ -468,13 +485,17 @@ class NeuralNetworkClassifier(base.Classifier):
             name = f"layer{i+1}"
             kernel = params["params"][name]["kernel"]
             bias = params["params"][name]["bias"]
-            tx = _updater(updater_name, lr, momentum)
             key = jax.random.fold_in(rng, 1000 + i)
             if ltype == "auto_encoder":
-                w, b = _pretrain_ae(
-                    key, h, kernel, bias, acts[i], tx, iterations
+                tx, needs_value_fn = _optimizer(
+                    algo, updater_name, lr, momentum
                 )
-            else:  # rbm
+                w, b = _pretrain_ae(
+                    key, h, kernel, bias, acts[i], tx, iterations,
+                    needs_value_fn=needs_value_fn,
+                )
+            else:  # rbm: CD-1 pseudo-gradient, first-order updater only
+                tx = _updater(updater_name, lr, momentum)
                 w, b = _pretrain_rbm(key, h, kernel, bias, tx, iterations)
             params["params"][name] = dict(
                 params["params"][name], kernel=w, bias=b

@@ -198,3 +198,15 @@ def test_pallas_jit_key_is_bucketed(fixture_raw):
     after = ingest_pallas._ingest_tiles._cache_size()
     assert after - before <= 1
     assert a.shape == (40, 48) and b.shape == (43, 48)
+
+
+def test_pallas_window_overhangs_recording_end(fixture_raw):
+    """Java copyOfRange zero-pads past the end; a marker whose window
+    overhangs the recording must read zeros, exactly like the XLA
+    epocher's padded path."""
+    raw, res = fixture_raw
+    S = raw.shape[1]
+    positions = np.array([S - 300, 5000], dtype=np.int64)  # first overhangs
+    got = np.asarray(ingest_pallas.ingest_features_pallas(raw, res, positions))
+    want = xla_reference_features(raw, res, positions)
+    np.testing.assert_allclose(got, want, rtol=0, atol=5e-6)

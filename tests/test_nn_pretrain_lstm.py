@@ -270,3 +270,17 @@ def test_lbfgs_beats_few_iteration_sgd():
         return float(-np.mean(y * np.log(p) + (1 - y) * np.log1p(-p)))
 
     assert final_loss("lbfgs") < final_loss("stochastic_gradient_descent")
+
+
+def test_pretrain_honors_optimization_algo():
+    """AE pretraining uses the configured algorithm: lbfgs pretraining
+    must produce different layer-1 weights than sgd pretraining from
+    the same init (RBM layers stay first-order — CD-1 has no scalar
+    objective to line-search)."""
+    x, y = make_data()
+    base = dict(BASE, config_pretrain="true", config_backprop="false")
+    base.update(layer(1, "auto_encoder", 8, "sigmoid"))
+    base.update(layer(2, "output", 2, "softmax"))
+    sgd = fit_nn(dict(base), x, y)
+    lb = fit_nn(dict(base, config_optimization_algo="lbfgs"), x, y)
+    assert not np.allclose(kernel(sgd, 1), kernel(lb, 1))

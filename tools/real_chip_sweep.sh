@@ -8,13 +8,15 @@ OUT=${1:-/tmp/sweep}
 mkdir -p "$OUT"
 cd "$(dirname "$0")/.."
 
+# Generous probe timeout: SIGTERM on an axon-INITIALIZING process is
+# the known tunnel-wedging event; 240s comfortably covers cold init.
 probe() {
-  timeout 90 python -c "import jax; print(jax.devices()[0].platform)" 2>/dev/null
+  timeout 240 python -c "import jax; print(jax.devices()[0].platform)" 2>/dev/null
 }
 
 plat=$(probe)
-if [ "$plat" != "axon" ] && [ -z "$plat" ]; then
-  echo "TPU not reachable; aborting sweep" >&2
+if [ "$plat" != "axon" ] && [ "$plat" != "tpu" ]; then
+  echo "real TPU not reachable (got '${plat:-none}'); aborting sweep" >&2
   exit 1
 fi
 echo "platform: $plat"
@@ -26,14 +28,17 @@ run() { # name, timeout, cmd...
   echo "rc=$? $(tail -c 400 "$OUT/$name.json")"
 }
 
-run parity        420 python tools/tpu_parity_check.py
-run einsum        420 python tools/ingest_bench.py einsum 262144 50
-run regular       420 python tools/ingest_bench.py regular_ingest 262144 20
-run pallas_64k32  420 python tools/ingest_bench.py pallas_ingest 131072 20
+# Timeouts are generous (first Mosaic/XLA compiles can take minutes);
+# a kill mid-compile wedges the tunnel, so prefer waiting.
+run parity        600 python tools/tpu_parity_check.py
+run einsum        600 python tools/ingest_bench.py einsum 262144 50
+run einsum_2d     600 python tools/ingest_bench.py einsum_2d 262144 50
+run regular       600 python tools/ingest_bench.py regular_ingest 262144 20
+run pallas_64k32  900 python tools/ingest_bench.py pallas_ingest 131072 20
 BENCH_CHUNK=131072 BENCH_TILE_B=64 \
-run pallas_128k64 420 python tools/ingest_bench.py pallas_ingest 131072 20
+run pallas_128k64 900 python tools/ingest_bench.py pallas_ingest 131072 20
 BENCH_CHUNK=32768 BENCH_TILE_B=16 \
-run pallas_32k16  420 python tools/ingest_bench.py pallas_ingest 131072 20
-run xla_ingest    420 python tools/ingest_bench.py xla_ingest 32768 10
-run train_step    420 python tools/ingest_bench.py train_step 131072 20
+run pallas_32k16  900 python tools/ingest_bench.py pallas_ingest 131072 20
+run xla_ingest    900 python tools/ingest_bench.py xla_ingest 32768 10
+run train_step    600 python tools/ingest_bench.py train_step 131072 20
 echo "sweep done"

@@ -54,14 +54,19 @@ def main() -> None:
     )
     max_abs_dev = float(np.max(np.abs(device_feats - host_feats)))
 
-    # fused device-ingest paths on the same fixture (f32, vs host f64)
+    # fused device-ingest paths on the same fixture (f32, vs host f64).
+    # A fused-path failure must not lose the baseline parity numbers
+    # above, so capture errors instead of propagating.
     devs = {}
     for backend in ("xla", "pallas"):
-        odp = provider.OfflineDataProvider([FIXTURE])
-        feats, _ = odp.load_features_device(backend=backend)
-        devs[backend] = float(
-            np.max(np.abs(np.asarray(feats, np.float64) - host_feats))
-        )
+        try:
+            odp = provider.OfflineDataProvider([FIXTURE])
+            feats, _ = odp.load_features_device(backend=backend)
+            devs[backend] = float(
+                np.max(np.abs(np.asarray(feats, np.float64) - host_feats))
+            )
+        except Exception as e:  # noqa: BLE001 — tool must always print
+            devs[backend] = f"error: {e}"[:300]
 
     print(
         json.dumps(
@@ -89,7 +94,10 @@ def main() -> None:
     # The fused paths compute the baseline mean in f32 over DC-laden
     # raw (host: f64 scale + sequential f32 fold), so their inherent
     # tolerance is wider — tests/test_device_ingest.py pins 5e-4.
-    if max(devs["xla"], devs["pallas"]) > 5e-4:
+    fused_bad = any(
+        not isinstance(v, float) or v > 5e-4 for v in devs.values()
+    )
+    if fused_bad:
         sys.exit(3)
 
 
