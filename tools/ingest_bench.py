@@ -5,6 +5,9 @@ Usage: python tools/ingest_bench.py <variant> [n_epochs] [iters]
 Variants:
   einsum          f32 epochs resident in HBM -> dwt-8 features
                   (the round-1 headline path, ops/dwt.py)
+  einsum_2d       A/B formulation of the headline: same geometry, but
+                  (B, C, T) flattened to (B*C, T) and contracted as
+                  one explicit 2-D matmul instead of the bct,tk einsum
   xla_ingest      int16 raw + irregular markers -> features via the
                   XLA gather formulation (ops/device_ingest.py)
   pallas_ingest   int16 raw + irregular markers -> features via the

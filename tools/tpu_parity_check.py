@@ -88,14 +88,15 @@ def main() -> None:
     if epoch_sum != GOLDEN_EPOCH_SUM or feature_sum != GOLDEN_FEATURE_SUM:
         sys.exit(1)
     # L2-normalized features are O(1); anything past f32 rounding noise
-    # indicates a device-path defect.
-    if max_abs_dev > 1e-5:
+    # indicates a device-path defect. `not (x <= tol)` fails CLOSED on
+    # NaN (a NaN deviation is a defect, not a pass).
+    if not (max_abs_dev <= 1e-5):
         sys.exit(2)
     # The fused paths compute the baseline mean in f32 over DC-laden
     # raw (host: f64 scale + sequential f32 fold), so their inherent
     # tolerance is wider — tests/test_device_ingest.py pins 5e-4.
     fused_bad = any(
-        not isinstance(v, float) or v > 5e-4 for v in devs.values()
+        not isinstance(v, float) or not (v <= 5e-4) for v in devs.values()
     )
     if fused_bad:
         sys.exit(3)
