@@ -43,6 +43,16 @@ _PROBE_TIMEOUT_S = 75
 _PROBE_SLEEPS_S = (10, 20, 40, 60)
 # One real-chip measurement (includes ~20-40s first compile).
 _RUN_TIMEOUT_S = int(os.environ.get("BENCH_RUN_TIMEOUT", 420))
+# Total wall budget for the variant loop: the headline always runs;
+# a further variant starts only if it could finish inside the budget.
+# Keeps the whole artifact comfortably under driver patience so the
+# parent is never killed mid-variant (which loses the JSON line and
+# can wedge the tunnel).
+# Default scales with the per-variant timeout so raising
+# BENCH_RUN_TIMEOUT alone never silently skips variants.
+_TOTAL_BUDGET_S = int(
+    os.environ.get("BENCH_TOTAL_BUDGET", max(1500, 3 * _RUN_TIMEOUT_S))
+)
 
 # (n_epochs, iters) per variant: TPU-sized vs CPU-fallback-sized.
 # BENCH_BATCH / BENCH_ITERS override the headline (einsum) sizing,
@@ -137,7 +147,12 @@ def _run_variant(variant: str, platform: str, n: int, iters: int) -> dict:
 def _collect(platform: str) -> dict:
     sizes = _VARIANTS_TPU if platform == "tpu" else _VARIANTS_CPU
     variants: dict = {}
-    for name, (n, iters) in sizes.items():
+    start = time.monotonic()
+    for idx, (name, (n, iters)) in enumerate(sizes.items()):
+        remaining = _TOTAL_BUDGET_S - (time.monotonic() - start)
+        if idx > 0 and remaining < _RUN_TIMEOUT_S:
+            variants[name] = {"error": "skipped: total budget exhausted"}
+            continue
         try:
             r = _run_variant(name, platform, n, iters)
             variants[name] = {
