@@ -210,3 +210,42 @@ def test_pallas_window_overhangs_recording_end(fixture_raw):
     got = np.asarray(ingest_pallas.ingest_features_pallas(raw, res, positions))
     want = xla_reference_features(raw, res, positions)
     np.testing.assert_allclose(got, want, rtol=0, atol=5e-6)
+
+
+@pytest.mark.parametrize("kind", ["uniform", "clusters", "boundary"])
+@pytest.mark.parametrize("seed", [11, 12])
+def test_pallas_randomized_differential(fixture_raw, seed, kind):
+    """Randomized differential check: each marker layout family
+    (uniform, dense clusters with gaps, boundary-adjacent with
+    duplicates) and random tile geometry must match the XLA path.
+    Seeded — deterministic CI."""
+    raw, res = fixture_raw
+    rng = np.random.RandomState(seed)
+    S = raw.shape[1]
+    n = int(rng.randint(5, 120))
+    if kind == "uniform":
+        positions = rng.randint(100, S - 100, size=n)
+    elif kind == "clusters":  # dense clusters with gaps
+        n_centers = n // 10 + 1
+        centers = rng.randint(200, S - 2000, size=n_centers)
+        positions = np.concatenate(
+            [c + rng.randint(0, 1500, size=10) for c in centers]
+        )[:n]
+        positions = np.clip(positions, 100, S - 100)
+    else:  # boundary-adjacent + duplicates
+        positions = np.concatenate([
+            rng.randint(100, 400, size=n // 2 + 1),
+            rng.randint(S - 900, S - 100, size=n // 2 + 1),
+        ])[:n]
+        positions[0] = positions[-1]  # duplicate
+    assert len(positions) == n
+    positions = positions.astype(np.int64)
+    chunk = int(rng.choice([8192, 16384, 65536]))
+    tile_b = int(rng.choice([4, 8, 32]))
+    got = np.asarray(
+        ingest_pallas.ingest_features_pallas(
+            raw, res, positions, chunk=chunk, tile_b=tile_b
+        )
+    )
+    want = xla_reference_features(raw, res, positions)
+    np.testing.assert_allclose(got, want, rtol=0, atol=5e-6)
