@@ -20,7 +20,6 @@ staging. Input-contract parity:
 from __future__ import annotations
 
 import logging
-import os
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np

@@ -15,7 +15,6 @@ from __future__ import annotations
 import logging
 from typing import Dict, Optional
 
-import numpy as np
 
 from .. import obs
 from ..features import registry as fe_registry

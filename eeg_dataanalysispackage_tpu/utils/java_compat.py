@@ -9,7 +9,7 @@ requires Java's 48-bit LCG and Fisher-Yates order, implemented here.
 from __future__ import annotations
 
 import math
-from typing import List, Sequence, TypeVar
+from typing import List, TypeVar
 
 T = TypeVar("T")
 
