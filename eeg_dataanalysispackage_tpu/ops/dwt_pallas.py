@@ -83,7 +83,9 @@ def epoch_features_pallas(
             f"exceeds epoch length {T}"
         )
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        from . import pallas_support
+
+        interpret = pallas_support.default_interpret()
     W = jnp.asarray(
         np.asarray(
             dwt_xla.cascade_matrix(wavelet_index, epoch_size, feature_size),

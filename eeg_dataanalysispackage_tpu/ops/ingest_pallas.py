@@ -226,7 +226,9 @@ def ingest_features_pallas(
     order.
     """
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        from . import pallas_support
+
+        interpret = pallas_support.default_interpret()
     live = pre + skip_samples + epoch_size
     window = ((live + 7) // 8) * 8  # alignment slack; E zero past live
     plan = plan_pallas_tiles(

@@ -1,0 +1,23 @@
+"""Shared Pallas platform support checks."""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def default_interpret() -> bool:
+    """Should a Pallas kernel default to interpreter mode here?
+
+    Compiled Mosaic runs on a native TPU backend, and on the axon
+    platform (a real TPU behind a tunnel) only when its remote-compile
+    hook is enabled (``PALLAS_AXON_REMOTE_COMPILE``). Everything else
+    (CPU test meshes, plain CPU) interprets.
+    """
+    backend = jax.default_backend()
+    if backend == "tpu":
+        return False
+    if backend == "axon":
+        return not os.environ.get("PALLAS_AXON_REMOTE_COMPILE")
+    return True
