@@ -25,9 +25,14 @@ def register(name: str, factory: Callable[[], base.FeatureExtraction]) -> None:
 def create(name: str) -> base.FeatureExtraction:
     if name in _REGISTRY:
         return _REGISTRY[name]()
-    m = re.fullmatch(r"dwt-(\d+)(-tpu|-pallas)?", name)
+    m = re.fullmatch(r"dwt-(\d+)(-tpu-bf16|-tpu|-pallas)?", name)
     if m:
-        backend = {None: "host", "-tpu": "xla", "-pallas": "pallas"}[m.group(2)]
+        backend = {
+            None: "host",
+            "-tpu": "xla",
+            "-tpu-bf16": "xla-bf16",
+            "-pallas": "pallas",
+        }[m.group(2)]
         return wavelet.WaveletTransform(name=int(m.group(1)), backend=backend)
     raise ValueError("Unsupported feature extraction argument")
 

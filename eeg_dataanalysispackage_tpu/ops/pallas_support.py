@@ -19,5 +19,6 @@ def default_interpret() -> bool:
     if backend == "tpu":
         return False
     if backend == "axon":
-        return not os.environ.get("PALLAS_AXON_REMOTE_COMPILE")
+        enabled = os.environ.get("PALLAS_AXON_REMOTE_COMPILE", "")
+        return enabled.strip().lower() not in ("1", "true", "yes")
     return True

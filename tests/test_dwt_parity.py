@@ -145,3 +145,51 @@ def test_unknown_extractor_method_raises():
 
     with pytest.raises(ValueError, match="unknown method"):
         dwt_xla.make_batched_extractor(method="Matmul")
+
+
+def test_bf16_backend_bounded_deviation_and_same_classification(
+    fixture_epochs,
+):
+    """fe=dwt-8-tpu-bf16: half the HBM bytes for a bounded feature
+    deviation; on the reference fixture the default-logreg
+    classification outcome is identical to f32."""
+    from eeg_dataanalysispackage_tpu.models import mllib_oracle
+    from eeg_dataanalysispackage_tpu.utils import java_compat
+
+    f32 = registry.create("dwt-8-tpu").extract_batch(fixture_epochs.epochs)
+    bf16 = registry.create("dwt-8-tpu-bf16").extract_batch(
+        fixture_epochs.epochs
+    )
+    assert bf16.dtype == np.float32  # returned widened for classifiers
+    assert bf16.shape == f32.shape == (11, 48)
+    dev = np.abs(bf16.astype(np.float64) - f32.astype(np.float64)).max()
+    assert dev < 5e-3  # bf16 rounding on unit-normalized features
+    perm = java_compat.java_shuffle_indices(11, seed=1)
+    targets = np.asarray(fixture_epochs.targets)[perm]
+    preds = {}
+    for name, feats in (("f32", f32), ("bf16", bf16)):
+        f = feats.astype(np.float64)[perm]
+        w, _, _ = mllib_oracle.run_gradient_descent(
+            f[:7], targets[:7], loss="logistic"
+        )
+        preds[name] = mllib_oracle.predict_logreg(f[7:], w).tolist()
+    assert preds["bf16"] == preds["f32"]
+
+
+def test_registry_bf16_name_family():
+    fe = registry.create("dwt-5-tpu-bf16")
+    assert fe.backend == "xla-bf16" and fe.name == 5
+    with pytest.raises(ValueError):
+        registry.create("dwt-8-tpu-bf32")
+
+
+def test_backend_switch_invalidates_jit_cache(fixture_epochs):
+    """backend is a property: reassigning it must drop the cached
+    jitted extractor (which is backend/dtype-specific)."""
+    fe = wavelet.WaveletTransform(8, 512, 175, 16, backend="xla")
+    a = fe.extract_batch(fixture_epochs.epochs)
+    assert fe._jit_cache is not None
+    fe.backend = "xla-bf16"
+    assert fe._jit_cache is None
+    b = fe.extract_batch(fixture_epochs.epochs)
+    assert not np.array_equal(a, b)  # bf16 path really ran

@@ -33,6 +33,7 @@ run() { # name, timeout, cmd...
 run parity        600 python tools/tpu_parity_check.py
 run einsum        600 python tools/ingest_bench.py einsum 262144 50
 run einsum_2d     600 python tools/ingest_bench.py einsum_2d 262144 50
+run einsum_bf16   600 python tools/ingest_bench.py einsum_bf16 262144 50
 run regular       600 python tools/ingest_bench.py regular_ingest 262144 20
 run pallas_64k32  900 python tools/ingest_bench.py pallas_ingest 131072 20
 BENCH_CHUNK=131072 BENCH_TILE_B=64 \
