@@ -353,3 +353,21 @@ def test_pipeline_over_http_end_to_end(server, fixture_dir, tmp_path):
     ).execute()
     text = open(result_path).read()
     assert "Accuracy" in text
+
+
+def test_fused_pallas_pipeline_over_http(server, fixture_dir, tmp_path):
+    """Round-2 features compose: the remote object-store filesystem
+    feeding the fully fused Pallas ingest mode, end to end through the
+    query DSL — raw bytes come over HTTP ranged reads, features come
+    out of one Pallas kernel."""
+    from eeg_dataanalysispackage_tpu.pipeline import builder
+
+    base, store = server
+    _serve_fixture(store, fixture_dir)
+    result_path = str(tmp_path / "result.txt")
+    stats = builder.PipelineBuilder(
+        f"info_file={base}/data/infoTrain.txt&fe=dwt-8-fused-pallas"
+        f"&train_clf=logreg&result_path={result_path}"
+    ).execute()
+    assert stats.num_patterns == 4
+    assert "Accuracy" in open(result_path).read()
