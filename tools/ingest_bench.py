@@ -183,6 +183,35 @@ def run(variant: str, n: int, iters: int) -> dict:
                 jnp.asarray(raw), jnp.asarray(res, jnp.float32),
                 jnp.asarray(plan.half_idx), jnp.asarray(plan.offsets), E,
             )
+            # on-device parity spot check before timing: the first 64
+            # markers through the Pallas kernel must match the XLA
+            # ingest path — catches silent Mosaic miscompiles so the
+            # recorded throughput is known-correct
+            spot = positions[:64]
+            raw_spot = raw[:, : int(spot.max()) + 2048]
+            got = np.asarray(
+                ingest_pallas.ingest_features_pallas(
+                    raw_spot, res, spot, chunk=chunk, tile_b=tile_b,
+                )
+            )
+            feat_ref = device_ingest.make_device_ingest_featurizer()
+            pos_pad = np.zeros(64, np.int32)
+            pos_pad[: len(spot)] = spot
+            spot_mask = np.zeros(64, bool)
+            spot_mask[: len(spot)] = True
+            want = np.asarray(
+                feat_ref(
+                    jnp.asarray(raw_spot), jnp.asarray(res),
+                    jnp.asarray(pos_pad), jnp.asarray(spot_mask),
+                )
+            )[: len(spot)]
+            parity_dev = float(np.max(np.abs(got - want)))
+            if not (parity_dev <= 5e-6):
+                raise RuntimeError(
+                    f"pallas/XLA ingest parity failed on device: "
+                    f"max abs dev {parity_dev} — refusing to publish "
+                    "a throughput number for a miscompiled kernel"
+                )
 
             @jax.jit
             def loop(raw_a, res_a, hi, offs, E_a):
@@ -279,6 +308,8 @@ def run(variant: str, n: int, iters: int) -> dict:
     }
     if variant == "pallas_ingest":
         payload["tile_fill"] = round(fill, 3)
+        # a failed check raised above, so a published number is valid
+        payload["parity_max_abs_dev"] = parity_dev
     return payload
 
 
