@@ -62,12 +62,18 @@ _VARIANTS_TPU = {
         int(os.environ.get("BENCH_BATCH", 262144)),
         int(os.environ.get("BENCH_ITERS", 50)),
     ),
+    # the bf16 twin shares the headline's geometry and its overrides
+    "einsum_bf16": (
+        int(os.environ.get("BENCH_BATCH", 262144)),
+        int(os.environ.get("BENCH_ITERS", 50)),
+    ),
     "regular_ingest": (262144, 20),
     "pallas_ingest": (131072, 20),
     "train_step": (131072, 20),
 }
 _VARIANTS_CPU = {
     "einsum": (8192, 5),
+    "einsum_bf16": (8192, 3),
     "regular_ingest": (8192, 3),
     "pallas_ingest": (2048, 2),
     "train_step": (8192, 3),
