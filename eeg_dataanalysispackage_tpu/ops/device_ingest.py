@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import math
 from typing import Optional, Sequence
 
 import jax
@@ -267,6 +268,31 @@ def ingest_matrix(
     return E.astype(np.float32)
 
 
+# phase-formulation group size: how many strides one lane-tile-aligned
+# row holds. Guarded — odd strides give G=128, where the (ROW, G*K)
+# operator tables reach GB scale and the einsum pays ~2G x the MACs.
+_PHASE_MAX_GROUP = 16
+
+
+def _phase_group(stride: int) -> int:
+    return math.lcm(stride, 128) // stride
+
+
+def resolve_regular_formulation(formulation: str, stride: int) -> str:
+    """'auto' -> the platform/stride default: reshape on CPU
+    (subtract-first accuracy, no lane tiling); phase on accelerators
+    when the stride is 2^k-friendly (small group size), else conv."""
+    if formulation == "auto":
+        if jax.devices()[0].platform == "cpu":
+            return "reshape"
+        return "phase" if _phase_group(stride) <= _PHASE_MAX_GROUP else "conv"
+    if formulation not in ("reshape", "conv", "phase"):
+        raise ValueError(
+            f"unknown regular-ingest formulation {formulation!r}"
+        )
+    return formulation
+
+
 @functools.lru_cache(maxsize=None)
 def make_regular_ingest_featurizer(
     stride: int,
@@ -277,6 +303,7 @@ def make_regular_ingest_featurizer(
     feature_size: int = 16,
     pre: int = constants.PRESTIMULUS_SAMPLES,
     n_channels: int = 3,
+    formulation: str = "auto",
 ):
     """Fused int16 ingest for a *regular stimulus train* (fixed
     stimulus-onset asynchrony ``stride``, the shipped P300 paradigm's
@@ -284,12 +311,45 @@ def make_regular_ingest_featurizer(
 
     Jitted (raw int16 (C, S), resolutions (C,), first_position) ->
     (n_epochs, C*feature_size) features. Epoch k's marker sits at
-    ``first_position + k*stride``; its raw window is a static slice of
-    the int16 stream, so the whole ingest is reshape + one einsum
-    against :func:`ingest_matrix` — int16 scaling, window formation,
-    baseline correction, DWT, and normalization fuse into a single
-    MXU contraction with **no gather**. Reads ~2x fewer HBM bytes per
-    epoch than the float32-epoch path (int16, no pre/post duplication).
+    ``first_position + k*stride``; window formation is *static*, so
+    int16 scaling, windowing, baseline correction, DWT, and
+    normalization run as one XLA program with **no gather**, reading
+    ~2x fewer HBM bytes per epoch than the float32-epoch path.
+
+    ``formulation`` selects how windows are formed on TPU (identical
+    semantics, different layout behavior — measured on v5e,
+    `docs/ingest_kernel.md`):
+
+    - ``"reshape"``: `(C, n·Δ) -> (C, n, Δ)` + subtract-first einsum.
+      Most accurate (baseline subtracted before the contraction) but
+      Δ=800 is not lane-tile aligned, so XLA relays the whole stream
+      lane-by-lane — measured 25x below roofline.
+    - ``"conv"``: the window/contraction expressed as a strided
+      `conv_general_dilated` over the flat stream (window_strides=Δ),
+      baseline via a second 1-tap-bank conv, combined two-term
+      (`z@W - mean(z)·colsum(W)`). No reshape exists; XLA's conv
+      lowering handles alignment. To keep the two-term f32
+      cancellation harmless, a per-channel DC proxy (mean of the
+      stream's first samples) is subtracted from the stream first —
+      algebraically a no-op (baseline correction is invariant to any
+      per-channel constant) that shrinks both cancelling terms from
+      int16-range DC to residual scale. Caveat: the proxy is one
+      constant per channel, so *slow baseline drift* across a long
+      recording re-grows the cancelling terms (error scales with
+      drift amplitude, ~5e-5 at full int16-range drift).
+    - ``"phase"``: tile-aligned group reshape. Rows of
+      ``lcm(Δ, 128)`` samples hold exactly ``G`` strides, so the
+      reshape `(C, M·ROW) -> (C, M, ROW)` never crosses lane tiles
+      (a free relayout); each window is contracted from its row pair
+      via phase-shifted block operators, and the DC proxy is the
+      *per-row* mean — constant over every window it covers, hence
+      exactly invariant — so accuracy matches subtract-first even
+      under baseline drift. One compile serves all phases (operator
+      tables are per-phase arguments, not constants).
+    - ``"auto"``: reshape on CPU (no lane tiling, subtract-first
+      accuracy), phase on accelerators — unless the stride makes
+      ``G = lcm(Δ,128)/Δ`` large (odd strides give G=128: ~GB-scale
+      operator tables and ~256x MACs), in which case conv.
 
     Requires ``stride >= pre + skip + epoch_size`` (787 default) so a
     window never crosses into the next epoch's row; the general
@@ -301,6 +361,13 @@ def make_regular_ingest_featurizer(
             f"regular ingest needs stride >= {win}; got {stride} "
             "(use the Pallas irregular-position kernel instead)"
         )
+    formulation = resolve_regular_formulation(formulation, stride)
+    if formulation == "phase" and _phase_group(stride) > _PHASE_MAX_GROUP:
+        raise ValueError(
+            f"phase formulation with stride {stride} needs group size "
+            f"{_phase_group(stride)} > {_PHASE_MAX_GROUP}: its operator "
+            "tables would reach GB scale; use formulation='conv'"
+        )
     from . import dwt as dwt_xla
 
     E_np = ingest_matrix(
@@ -309,7 +376,7 @@ def make_regular_ingest_featurizer(
     )
 
     @jax.jit
-    def _ingest_jit(raw_i16, resolutions, first_position):
+    def _ingest_reshape(raw_i16, resolutions, first_position):
         E = jnp.asarray(E_np)
         start = first_position - pre
         rows = jax.lax.dynamic_slice_in_dim(
@@ -330,6 +397,143 @@ def make_regular_ingest_featurizer(
             feats.reshape(n_epochs, raw_i16.shape[0] * feats.shape[-1])
         )
 
+    if formulation != "conv":
+        _ingest_conv = None
+    else:
+        # conv formulation: kernel banks as (out_features, in=1, taps)
+        _W_colsum = E_np.sum(axis=0)
+        _M_np = np.zeros((1, 1, stride), np.float32)
+        _M_np[0, 0, :pre] = 1.0 / pre
+
+        @jax.jit
+        def _ingest_conv(raw_i16, resolutions, first_position):
+            C = raw_i16.shape[0]
+            start = first_position - pre
+            x = jax.lax.dynamic_slice_in_dim(
+                raw_i16, start, n_epochs * stride, axis=1
+            )
+            xf = x.astype(jnp.float32) * resolutions[:, None]
+            # per-channel DC proxy: baseline correction is invariant
+            # to subtracting any per-channel constant, and doing it
+            # here (fused into the conv operand read) shrinks the
+            # two-term cancellation from int16-range DC to residual
+            prefix = min(8192, n_epochs * stride)
+            dc = jnp.mean(xf[:, :prefix], axis=1, keepdims=True)
+            lhs = (xf - dc)[:, None, :]  # channels as conv batch dim
+            yW = jax.lax.conv_general_dilated(
+                lhs, jnp.asarray(E_np.T[:, None, :]),
+                window_strides=(stride,), padding="VALID",
+                dimension_numbers=("NCH", "OIH", "NCH"),
+                precision=jax.lax.Precision.HIGHEST,
+            )  # (C, K, n)
+            yM = jax.lax.conv_general_dilated(
+                lhs, jnp.asarray(_M_np),
+                window_strides=(stride,), padding="VALID",
+                dimension_numbers=("NCH", "OIH", "NCH"),
+                precision=jax.lax.Precision.HIGHEST,
+            )  # (C, 1, n)
+            feats = yW - yM * jnp.asarray(_W_colsum)[None, :, None]
+            feats = jnp.transpose(feats, (2, 0, 1)).reshape(
+                n_epochs, C * feature_size
+            )
+            return dwt_xla.safe_l2_normalize(feats)
+
+    if formulation != "phase":
+        _run_phase = None
+    else:
+        # phase formulation: ROW = lcm(stride, 128) samples hold
+        # exactly G strides, so (C, M·ROW) -> (C, M, ROW) is a
+        # tile-aligned (free) reshape; windows are cut by per-phase
+        # block operators over each row pair, and the per-row mean is
+        # an exactly-invariant DC proxy.
+        _G = _phase_group(stride)
+        _ROW = _G * stride
+        _W_np = ingest_matrix(
+            wavelet_index, epoch_size, skip_samples, feature_size, pre,
+            window_len=win, fold_baseline=False,
+        )  # (win, K): the window-relative cascade operator
+        _M_groups = -(-n_epochs // _G)  # ceil
+        _colsum_np = _W_np.sum(axis=0)
+
+        # bounded: tables are ~3.5 MB per phase (stride 800) and a
+        # service ingesting many recordings must not accumulate them
+        @functools.lru_cache(maxsize=8)
+        def _phase_tables(phase: int):
+            # phase < stride (the wrapper mods by stride), so every
+            # window's first tap lands inside its own row:
+            # off <= (stride-1) + (G-1)*stride < _ROW; only the tail
+            # may cross into the next row (the E4b/B4b halves).
+            assert 0 <= phase < stride
+            K = feature_size
+            E4a = np.zeros((_ROW, _G * K), np.float32)
+            E4b = np.zeros((_ROW, _G * K), np.float32)
+            B4a = np.zeros((_ROW, _G), np.float32)
+            B4b = np.zeros((_ROW, _G), np.float32)
+            for j in range(_G):
+                off = phase + j * stride
+                cut = min(win, _ROW - off)  # taps before the row edge
+                E4a[off : off + cut, j * K : (j + 1) * K] = _W_np[:cut]
+                if cut < win:
+                    E4b[: win - cut, j * K : (j + 1) * K] = _W_np[cut:]
+                bcut = min(pre, _ROW - off)
+                B4a[off : off + bcut, j] = 1.0 / pre
+                if bcut < pre:
+                    B4b[: pre - bcut, j] = 1.0 / pre
+            return (
+                jnp.asarray(E4a), jnp.asarray(E4b),
+                jnp.asarray(B4a), jnp.asarray(B4b),
+            )
+
+        @jax.jit
+        def _ingest_phase(raw_i16, resolutions, s0, E4a, E4b, B4a, B4b):
+            C = raw_i16.shape[0]
+            K = feature_size
+            slab = jax.lax.dynamic_slice_in_dim(
+                raw_i16, s0, (_M_groups + 1) * _ROW, axis=1
+            )
+            xf = slab.astype(jnp.float32) * resolutions[:, None]
+            rows = xf.reshape(C, _M_groups + 1, _ROW)
+            ra, rb = rows[:, :-1], rows[:, 1:]
+            # per-row DC proxy: constant over every window the row
+            # pair carries, so baseline invariance makes this exact
+            d = jnp.mean(ra, axis=2, keepdims=True)
+            za, zb = ra - d, rb - d
+            hi = jax.lax.Precision.HIGHEST
+            yW = (
+                jnp.einsum("cms,sk->cmk", za, E4a, precision=hi)
+                + jnp.einsum("cms,sk->cmk", zb, E4b, precision=hi)
+            ).reshape(C, _M_groups, _G, K)
+            pm = (
+                jnp.einsum("cms,sj->cmj", za, B4a, precision=hi)
+                + jnp.einsum("cms,sj->cmj", zb, B4b, precision=hi)
+            )  # (C, M, G)
+            colsum = jnp.asarray(_colsum_np)
+            feats = yW - pm[..., None] * colsum[None, None, None, :]
+            out = jnp.transpose(feats, (1, 2, 0, 3)).reshape(
+                _M_groups * _G, C * K
+            )[:n_epochs]
+            return dwt_xla.safe_l2_normalize(out)
+
+        def _run_phase(raw_i16, resolutions, start):
+            # mod by STRIDE, not _ROW: keeps every window's start
+            # inside its own row (offsets phase + j*stride < _ROW)
+            # and shrinks the table-cache key space. The slab's
+            # absolute start s0 needs no alignment — the reshape is
+            # relative to the slab.
+            phase = start % stride
+            s0 = start - phase
+            need = s0 + (_M_groups + 1) * _ROW
+            if s0 < 0 or need > raw_i16.shape[1]:
+                return None  # slab out of range; caller falls back
+            tables = _phase_tables(phase)
+            return _ingest_phase(raw_i16, resolutions, s0, *tables)
+
+    _ingest_jit = {
+        "conv": _ingest_conv,
+        "reshape": _ingest_reshape,
+        "phase": None,  # dispatched in the wrapper (slab bounds)
+    }[formulation]
+
     def ingest(raw_i16, resolutions, first_position):
         # host-side bounds check: dynamic_slice CLAMPS out-of-range
         # starts, which would silently shift every window
@@ -341,6 +545,14 @@ def make_regular_ingest_featurizer(
                 f"regular ingest window [{start}, {end}) out of range "
                 f"for recording of {raw_i16.shape[1]} samples"
             )
+        if formulation == "phase":
+            out = _run_phase(raw_i16, resolutions, start)
+            if out is not None:
+                return out
+            # recording too short for the aligned slab (needs up to
+            # ROW of tail slack): the subtract-first reshape path is
+            # equally exact, just slower on TPU — fine at this size
+            return _ingest_reshape(raw_i16, resolutions, first)
         return _ingest_jit(raw_i16, resolutions, first)
 
     return ingest

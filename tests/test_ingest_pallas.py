@@ -131,6 +131,133 @@ def test_regular_ingest_rejects_overlapping_stride():
         device_ingest.make_regular_ingest_featurizer(700, 10)
 
 
+def _dc_heavy_fixture(n=30, stride=800, first=150, drift=0.0, tail=0):
+    """Synthetic int16 stream with near-int16-range DC offsets and
+    optional slow per-channel baseline drift across the recording."""
+    rng = np.random.RandomState(0)
+    dc = np.array([[1800], [-2200], [900]], np.float64)
+    S = first - 100 + n * stride + 100 + tail
+    t = np.linspace(0.0, 1.0, S)[None, :]
+    wander = drift * np.array([[1.0], [-1.0], [0.5]]) * t
+    raw = np.clip(
+        rng.randint(-3000, 3000, size=(3, S)) + dc + wander,
+        -32768, 32767,
+    ).astype(np.int16)
+    res = np.array([0.1, 0.1, 0.2], np.float32)
+    return raw, res
+
+
+@pytest.mark.parametrize("formulation", ["conv", "phase"])
+def test_regular_ingest_formulations_dc_heavy(formulation):
+    """The TPU-friendly formulations (no lane-unaligned reshape) must
+    match the subtract-first reshape formulation to f32 tolerance with
+    int16-range DC offsets — their DC proxies keep the two-term
+    baseline from cancelling catastrophically (docs/ingest_kernel.md).
+    ``tail`` gives the phase path its aligned-slab slack."""
+    n, stride, first = 30, 800, 150
+    raw, res = _dc_heavy_fixture(n, stride, first, tail=8192)
+    ing_r = device_ingest.make_regular_ingest_featurizer(
+        stride, n, formulation="reshape"
+    )
+    ing_f = device_ingest.make_regular_ingest_featurizer(
+        stride, n, formulation=formulation
+    )
+    a = np.asarray(ing_r(jnp.asarray(raw), jnp.asarray(res), first))
+    b = np.asarray(ing_f(jnp.asarray(raw), jnp.asarray(res), first))
+    np.testing.assert_allclose(b, a, rtol=0, atol=5e-6)
+
+
+def test_regular_ingest_conv_drift_within_device_tolerance():
+    """The conv formulation's single global DC proxy degrades under
+    baseline drift (documented caveat) but must stay inside the
+    framework's device-path tolerance (2e-4, the same bound the
+    fused gather path is held to)."""
+    n, stride, first = 30, 800, 150
+    raw, res = _dc_heavy_fixture(n, stride, first, drift=2500.0, tail=8192)
+    ing_r = device_ingest.make_regular_ingest_featurizer(
+        stride, n, formulation="reshape"
+    )
+    ing_c = device_ingest.make_regular_ingest_featurizer(
+        stride, n, formulation="conv"
+    )
+    a = np.asarray(ing_r(jnp.asarray(raw), jnp.asarray(res), first))
+    b = np.asarray(ing_c(jnp.asarray(raw), jnp.asarray(res), first))
+    np.testing.assert_allclose(b, a, rtol=0, atol=2e-4)
+
+
+def test_regular_ingest_phase_guard_on_odd_stride():
+    """Odd strides give group size 128 (GB-scale tables): auto must
+    resolve away from phase and an explicit phase request must fail
+    loudly instead of OOMing."""
+    assert (
+        device_ingest.resolve_regular_formulation("auto", 787)
+        in ("reshape", "conv")  # cpu -> reshape; accelerator -> conv
+    )
+    with pytest.raises(ValueError):
+        device_ingest.make_regular_ingest_featurizer(
+            801, 10, formulation="phase"
+        )
+
+
+def test_regular_ingest_phase_exact_under_drift():
+    """The phase formulation's per-row DC proxy is exactly invariant,
+    so slow baseline wander (electrode drift) must NOT degrade it —
+    unlike the conv path's single global proxy."""
+    n, stride, first = 30, 800, 150
+    raw, res = _dc_heavy_fixture(n, stride, first, drift=2500.0, tail=8192)
+    ing_r = device_ingest.make_regular_ingest_featurizer(
+        stride, n, formulation="reshape"
+    )
+    ing_p = device_ingest.make_regular_ingest_featurizer(
+        stride, n, formulation="phase"
+    )
+    a = np.asarray(ing_r(jnp.asarray(raw), jnp.asarray(res), first))
+    b = np.asarray(ing_p(jnp.asarray(raw), jnp.asarray(res), first))
+    np.testing.assert_allclose(b, a, rtol=0, atol=5e-6)
+
+
+@pytest.mark.parametrize("first", [150, 1000, 887, 3250, 4000])
+def test_regular_ingest_phase_arbitrary_first_position(first):
+    """Regression: phase table placement must be correct for ANY
+    marker phase — first=1000 (start 900 >= stride) once misplaced
+    every 4th window's taps because offsets past ROW were clamped to
+    next-row offset 0 instead of off-ROW."""
+    n, stride = 13, 800
+    raw, res = _dc_heavy_fixture(n, stride, first, tail=16384)
+    ing_r = device_ingest.make_regular_ingest_featurizer(
+        stride, n, formulation="reshape"
+    )
+    ing_p = device_ingest.make_regular_ingest_featurizer(
+        stride, n, formulation="phase"
+    )
+    a = np.asarray(ing_r(jnp.asarray(raw), jnp.asarray(res), first))
+    b = np.asarray(ing_p(jnp.asarray(raw), jnp.asarray(res), first))
+    np.testing.assert_allclose(b, a, rtol=0, atol=5e-6)
+
+
+def test_regular_ingest_phase_short_recording_falls_back():
+    """A recording too short for the aligned slab still returns exact
+    features via the reshape fallback."""
+    n, stride, first = 4, 800, 150
+    raw, res = _dc_heavy_fixture(n, stride, first, tail=0)
+    ing_p = device_ingest.make_regular_ingest_featurizer(
+        stride, n, formulation="phase"
+    )
+    ing_r = device_ingest.make_regular_ingest_featurizer(
+        stride, n, formulation="reshape"
+    )
+    a = np.asarray(ing_r(jnp.asarray(raw), jnp.asarray(res), first))
+    b = np.asarray(ing_p(jnp.asarray(raw), jnp.asarray(res), first))
+    np.testing.assert_allclose(b, a, rtol=0, atol=5e-6)
+
+
+def test_regular_ingest_rejects_unknown_formulation():
+    with pytest.raises(ValueError):
+        device_ingest.make_regular_ingest_featurizer(
+            800, 10, formulation="cuda"
+        )
+
+
 def test_provider_pallas_backend_matches_xla(fixture_dir):
     """load_features_device(backend='pallas') returns the same rows
     (to f32 tolerance) and targets as the XLA gather backend on the

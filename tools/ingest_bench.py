@@ -15,8 +15,11 @@ Variants:
                   XLA gather formulation (ops/device_ingest.py)
   pallas_ingest   int16 raw + irregular markers -> features via the
                   fused Pallas kernel (ops/ingest_pallas.py)
-  regular_ingest  int16 raw + regular stimulus train -> features via
-                  the static-reshape einsum (no gather)
+  regular_ingest  int16 raw + regular stimulus train -> features, no
+                  gather (static window formation); the formulation
+                  (reshape | conv | phase, see device_ingest) defaults
+                  to auto and can be forced with BENCH_FORMULATION;
+                  the JSON line records which one ran
   train_step      f32 epochs -> features -> logreg forward/backward/
                   update (parallel/train.py one-step)
 
@@ -144,8 +147,8 @@ def run(variant: str, n: int, iters: int) -> dict:
             def loop(raw_a, res_a, pos_a, mask_a):
                 def body(acc, i):
                     y = feat(
-                        raw_a + (i % 2).astype(jnp.int16), res_a, pos_a,
-                        mask_a,
+                        raw_a, res_a + i.astype(jnp.float32) * 1e-12,
+                        pos_a, mask_a,
                     )
                     return acc + y.sum(), None
 
@@ -221,7 +224,8 @@ def run(variant: str, n: int, iters: int) -> dict:
                     )
 
                     y = ingest_pallas._ingest_tiles(
-                        raw_a + (i % 2).astype(jnp.int16), res_a, hi, offs,
+                        raw_a, res_a + i.astype(jnp.float32) * 1e-12,
+                        hi, offs,
                         E_a, tile_b=tile_b, chunk=chunk, window=window,
                         feature_size=16,
                         interpret=pallas_support.default_interpret(),
@@ -237,16 +241,24 @@ def run(variant: str, n: int, iters: int) -> dict:
     elif variant == "regular_ingest":
         from eeg_dataanalysispackage_tpu.ops import device_ingest
 
-        S = 200 + n * REGULAR_STRIDE + 1000
+        formulation = os.environ.get("BENCH_FORMULATION", "auto")
+        # tail slack covers the phase formulation's aligned slab
+        S = 200 + n * REGULAR_STRIDE + 8192
         raw = rng.randint(-3000, 3000, size=(3, S), dtype=np.int16)
-        ing = device_ingest.make_regular_ingest_featurizer(REGULAR_STRIDE, n)
+        ing = device_ingest.make_regular_ingest_featurizer(
+            REGULAR_STRIDE, n, formulation=formulation
+        )
         bytes_per_epoch = 3 * REGULAR_STRIDE * 2
         args = (jnp.asarray(raw), jnp.asarray(res))
 
         @jax.jit
         def loop(raw_a, res_a):
             def body(acc, i):
-                y = ing(raw_a + (i % 2).astype(jnp.int16), res_a, 150)
+                # perturb the (C,) resolutions, not the GB-scale int16
+                # stream: a stream perturbation materializes a full
+                # copy every iteration (unfusable into the reshape),
+                # tripling the measured traffic
+                y = ing(raw_a, res_a + i.astype(jnp.float32) * 1e-12, 150)
                 return acc + y.sum(), None
 
             acc, _ = jax.lax.scan(body, jnp.float32(0), jnp.arange(iters))
@@ -310,6 +322,12 @@ def run(variant: str, n: int, iters: int) -> dict:
         payload["tile_fill"] = round(fill, 3)
         # a failed check raised above, so a published number is valid
         payload["parity_max_abs_dev"] = parity_dev
+    if variant == "regular_ingest":
+        from eeg_dataanalysispackage_tpu.ops import device_ingest
+
+        payload["formulation"] = device_ingest.resolve_regular_formulation(
+            formulation, REGULAR_STRIDE
+        )
     return payload
 
 
