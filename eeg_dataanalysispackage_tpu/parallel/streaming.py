@@ -77,23 +77,47 @@ def _windowed_pipeline(
 ) -> jnp.ndarray:
     """(C, B+halo) extended block -> (B//stride, C*feature_count).
 
-    The one implementation of the per-window pipeline — gather windows
-    every ``stride`` samples, band-passed DWT prefix via the composed
+    The one implementation of the per-window pipeline — windows every
+    ``stride`` samples, band-passed DWT prefix via the composed
     kernel, L2 normalize — shared by the mesh-sharded extractor and
     the single-device blocked iterator so the two paths cannot
     diverge.
+
+    When the stride is lane-tile aligned (multiple of 128) and divides
+    the window — the default 512/256 geometry — windows are never
+    *gathered*: the block reshapes into aligned stride-slabs (a free
+    relayout on TPU) and each window is the sum of ``window//stride``
+    slab matmuls against the matching kernel rows — the same
+    block-operator decomposition as ``device_ingest``'s phase
+    formulation. Other geometries fall back to the index gather.
     """
     C, total = ext.shape
     B = total - (window - stride)
     starts = _window_starts(B, stride)
+    W = starts.shape[0]
+    feature_count = kernel.shape[1]
+    k = kernel.astype(ext.dtype)
+    if stride % 128 == 0 and window % stride == 0 and B % stride == 0:
+        m = window // stride
+        slabs = ext[:, : (W + m - 1) * stride].reshape(
+            C, W + m - 1, stride
+        )
+        coeffs = None
+        for i in range(m):
+            part = jnp.einsum(
+                "cws,sk->wck",
+                slabs[:, i : i + W, :],
+                k[i * stride : (i + 1) * stride],
+                precision=jax.lax.Precision.HIGHEST,
+            )
+            coeffs = part if coeffs is None else coeffs + part
+        return dwt_xla.safe_l2_normalize(
+            coeffs.reshape(W, C * feature_count)
+        )
     idx = starts[:, None] + np.arange(window)[None, :]  # (W, window)
     wins = ext[:, idx]  # (C, W, window)
-    W = starts.shape[0]
     flat = wins.transpose(1, 0, 2).reshape(W * C, window)
-    coeffs = jnp.dot(
-        flat, kernel.astype(ext.dtype), precision=jax.lax.Precision.HIGHEST
-    )
-    feature_count = kernel.shape[1]
+    coeffs = jnp.dot(flat, k, precision=jax.lax.Precision.HIGHEST)
     return dwt_xla.safe_l2_normalize(coeffs.reshape(W, C * feature_count))
 
 

@@ -22,6 +22,10 @@ Variants:
                   the JSON line records which one ran
   train_step      f32 epochs -> features -> logreg forward/backward/
                   update (parallel/train.py one-step)
+  rf_train        rf-tpu whole-forest growth as one XLA program
+                  (models/trees_device.py): 100 trees, depth 5,
+                  32 bins over n rows x 48 binned features;
+                  epochs_per_s = rows through the full forest growth
 
 Prints one JSON line: {"variant", "epochs_per_s", "bytes_per_epoch",
 "pct_of_hbm_roofline", ...}. Run each variant in its own process (the
@@ -294,6 +298,41 @@ def run(variant: str, n: int, iters: int) -> dict:
             ) + losses.sum()
 
         arg = (epochs, labels, mask)
+
+    elif variant == "rf_train":
+        from eeg_dataanalysispackage_tpu.models import trees, trees_device
+
+        T, depth, bins = 100, 5, 32
+        feats = rng.randn(n, 48)
+        labels = (feats[:, 0] + 0.3 * rng.randn(n) > 0).astype(np.int32)
+        edges = trees.compute_bin_edges(feats, bins)
+        binned = trees.bin_features(feats, edges)
+        boot = np.random.RandomState(12345).randint(0, n, size=(T, n))
+        masks = trees_device.draw_feature_masks(
+            T, trees_device.n_heap_nodes(depth - 1), 48, None
+        )
+        # dominant per-tree traffic: the bootstrap-gathered (n, 48)
+        # int32 view each tree reads while building histograms
+        bytes_per_epoch = T * 48 * 4
+        args = (
+            jnp.asarray(binned, jnp.int32), jnp.asarray(labels),
+            jnp.asarray(boot), jnp.asarray(masks),
+        )
+
+        @jax.jit
+        def loop(binned_a, labels_a, boot_a, masks_a):
+            def body(acc, i):
+                forest = trees_device.grow_forest(
+                    binned_a, labels_a, (boot_a + i) % n, masks_a,
+                    max_bins=bins, impurity="gini", max_depth=depth,
+                    min_instances=1,
+                )
+                return acc + forest["prediction"].sum(), None
+
+            acc, _ = jax.lax.scan(body, jnp.float32(0), jnp.arange(iters))
+            return acc
+
+        arg = args
 
     else:
         raise SystemExit(f"unknown variant {variant!r}")

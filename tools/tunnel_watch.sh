@@ -1,0 +1,49 @@
+#!/bin/bash
+# Watch the axon TPU tunnel; when it recovers, immediately collect the
+# measurements that are blocked on it, then stop. Safe by constraint:
+# everything it runs is jit-only (never eager through the tunnel) and
+# nothing is killed mid-compile (generous timeouts, sequential).
+#
+#   nohup setsid bash tools/tunnel_watch.sh /tmp/tunnel_watch > /dev/null 2>&1 &
+#
+# Status: $OUT/watch.log; results: $OUT/*.json
+set -u
+cd "$(dirname "$0")/.."
+OUT=$(readlink -f "${1:-/tmp/tunnel_watch}")
+mkdir -p "$OUT"
+log() { echo "$(date +%H:%M:%S) $*" >> "$OUT/watch.log"; }
+
+log "watch started"
+while :; do
+  # 240s probe timeout: SIGTERM on an axon-INITIALIZING process is the
+  # known tunnel-wedging event, and a recovered-but-cold tunnel can
+  # take minutes to init — never kill a probe that might be mid-init
+  # on a healthy tunnel (same budget as real_chip_sweep.sh)
+  if timeout 240 python -c "import jax; print(jax.devices()[0].platform)" \
+      > "$OUT/probe.out" 2>/dev/null; then
+    plat=$(cat "$OUT/probe.out")
+    if [ "$plat" = "axon" ] || [ "$plat" = "tpu" ]; then
+      log "tunnel recovered (platform $plat); collecting"
+      break
+    fi
+  fi
+  log "still wedged"
+  sleep 600
+done
+
+run() { # name timeout cmd...
+  name=$1; t=$2; shift 2
+  log "run $name"
+  timeout "$t" "$@" > "$OUT/$name.json" 2> "$OUT/$name.err"
+  log "done $name rc=$? $(tail -c 200 "$OUT/$name.json")"
+}
+
+BENCH_FORMULATION=phase run regular_phase 900 \
+  python tools/ingest_bench.py regular_ingest 262144 20
+BENCH_FORMULATION=conv run regular_conv 900 \
+  python tools/ingest_bench.py regular_ingest 262144 20
+BENCH_FORMULATION=reshape run regular_reshape 900 \
+  python tools/ingest_bench.py regular_ingest 262144 20
+run einsum 600 python tools/ingest_bench.py einsum 262144 50
+run bench_full 1800 python bench.py
+log "collection complete"
