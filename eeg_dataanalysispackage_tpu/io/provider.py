@@ -127,9 +127,11 @@ class OfflineDataProvider:
         state. Returns (features (n, C*feature_size) float32,
         targets (n,) float64).
 
-        ``backend``: "xla" (ops/device_ingest.py — gather + einsum) or
-        "pallas" (ops/ingest_pallas.py — the fully fused VMEM-chunked
-        kernel; interpret mode off-TPU).
+        ``backend``: "xla" (ops/device_ingest.py — gather + einsum),
+        "block" (ops/device_ingest.make_block_ingest_featurizer —
+        tile-row gathers + 128-variant operator bank, no element
+        gather), or "pallas" (ops/ingest_pallas.py — the fully fused
+        VMEM-chunked kernel; interpret mode off-TPU).
 
         Numerics follow the float32 device path (tolerance-level vs
         the bit-exact host path) — use :meth:`load` + a host-backend
@@ -139,7 +141,7 @@ class OfflineDataProvider:
         from ..epochs.extractor import BalanceState
         from ..ops import device_ingest
 
-        if backend not in ("xla", "pallas"):
+        if backend not in ("xla", "block", "pallas"):
             raise ValueError(f"unknown device-ingest backend {backend!r}")
         prefix, files = self._resolve_files()
         balance = BalanceState()
@@ -153,15 +155,24 @@ class OfflineDataProvider:
                 feature_size=feature_size,
                 pre=self._pre,
             )
-        featurizer = device_ingest.make_device_ingest_featurizer(
-            wavelet_index=wavelet_index,
-            epoch_size=epoch_size,
-            skip_samples=skip_samples,
-            feature_size=feature_size,
-            channels=tuple(range(1, len(self._channel_names) + 1)),
-            pre=self._pre,
-            post=self._post,
-        )
+        if backend == "block":
+            featurizer = device_ingest.make_block_ingest_featurizer(
+                wavelet_index=wavelet_index,
+                epoch_size=epoch_size,
+                skip_samples=skip_samples,
+                feature_size=feature_size,
+                pre=self._pre,
+            )
+        else:
+            featurizer = device_ingest.make_device_ingest_featurizer(
+                wavelet_index=wavelet_index,
+                epoch_size=epoch_size,
+                skip_samples=skip_samples,
+                feature_size=feature_size,
+                channels=tuple(range(1, len(self._channel_names) + 1)),
+                pre=self._pre,
+                post=self._post,
+            )
         feats: List[np.ndarray] = []
         targets: List[np.ndarray] = []
         for rel_path, guessed in files.items():

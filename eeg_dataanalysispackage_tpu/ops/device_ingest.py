@@ -558,6 +558,125 @@ def make_regular_ingest_featurizer(
     return ingest
 
 
+@functools.lru_cache(maxsize=None)
+def _shift_variant_banks(
+    wavelet_index: int,
+    epoch_size: int,
+    skip_samples: int,
+    feature_size: int,
+    pre: int,
+    slab: int,
+    n_variants: int,
+):
+    """Operator banks for the block-gather irregular ingest.
+
+    ``Wv`` (slab, n_variants*K): variant v holds the window operator
+    shifted down by v rows (window taps at slab rows [v, v+win)).
+    ``Mv`` (slab, n_variants): variant v's pre-stimulus mean taps.
+    """
+    W = ingest_matrix(
+        wavelet_index, epoch_size, skip_samples, feature_size, pre,
+        window_len=pre + skip_samples + epoch_size, fold_baseline=False,
+    )
+    win, K = W.shape
+    assert n_variants - 1 + win <= slab
+    Wv = np.zeros((slab, n_variants * K), np.float32)
+    Mv = np.zeros((slab, n_variants), np.float32)
+    for v in range(n_variants):
+        Wv[v : v + win, v * K : (v + 1) * K] = W
+        Mv[v : v + pre, v] = 1.0 / pre
+    return Wv, Mv, W.sum(axis=0)
+
+
+@functools.lru_cache(maxsize=None)
+def make_block_ingest_featurizer(
+    wavelet_index: int = 8,
+    epoch_size: int = 512,
+    skip_samples: int = 175,
+    feature_size: int = 16,
+    pre: int = constants.PRESTIMULUS_SAMPLES,
+):
+    """Irregular-marker fused int16 ingest with NO element gather.
+
+    Same signature and semantics as
+    :func:`make_device_ingest_featurizer` (raw int16 (C, S),
+    resolutions, positions, mask -> (cap, C*K) features), but the
+    window formation is TPU-layout-native where the gather
+    formulation's per-element index gather measured ~0% of roofline:
+
+    - the stream is viewed as 128-lane blocks (tile rows); each
+      window start splits into ``block = start // 128`` and
+      ``shift = start % 128``;
+    - per window, 8 consecutive block-rows (1024 samples >= 787 live
+      + 127 max shift) are gathered — whole-tile row gathers, the
+      layout-friendly kind;
+    - the residual shift never moves data: a 128-variant operator
+      bank (:func:`_shift_variant_banks`) computes every shift's
+      features in one MXU contraction and a one-hot matmul selects
+      each window's variant — gather converted to dense FLOPs, which
+      this op has idle (~6.3M MACs/epoch, microseconds per million
+      epochs on the MXU).
+    - baseline: per-window slab mean as the DC proxy (exactly
+      invariant), then the two-term pre-mean correction — both terms
+      at residual scale, so f32-safe.
+
+    Windows overhanging the recording end read zeros (Java
+    copyOfRange semantics, matching the gather path).
+    """
+    from . import dwt as dwt_xla
+
+    SLAB_BLOCKS = 8
+    BLK = 128
+    slab = SLAB_BLOCKS * BLK  # 1024
+    win = pre + skip_samples + epoch_size
+    if BLK - 1 + win > slab:
+        raise ValueError("window too long for the 8-block slab")
+    Wv_np, Mv_np, colsum_np = _shift_variant_banks(
+        wavelet_index, epoch_size, skip_samples, feature_size, pre,
+        slab, BLK,
+    )
+
+    @jax.jit
+    def ingest_features(raw, resolutions, positions, mask):
+        C, S = raw.shape
+        K = feature_size
+        # pad so every gathered slab exists: tail of slab zeros, then
+        # round the block count up
+        S_pad = ((S + slab + BLK - 1) // BLK) * BLK
+        padded = jnp.pad(raw, ((0, 0), (0, S_pad - S)))
+        blocks = padded.reshape(C, S_pad // BLK, BLK)
+        starts = jnp.clip(positions - pre, 0, S)
+        b0 = starts // BLK
+        shift = starts % BLK  # (cap,)
+        bidx = b0[:, None] + jnp.arange(SLAB_BLOCKS, dtype=b0.dtype)
+        gathered = blocks[:, bidx]  # (C, cap, 8, BLK) — row gathers
+        xw = gathered.reshape(C, -1, slab).astype(jnp.float32) * (
+            resolutions[:, None, None]
+        )
+        # per-window slab mean: a per-window constant, which baseline
+        # correction cancels exactly — keeps both terms below small
+        d = jnp.mean(xw, axis=-1, keepdims=True)
+        z = xw - d
+        hi = jax.lax.Precision.HIGHEST
+        y = jnp.einsum(
+            "cnt,tv->cnv", z, jnp.asarray(Wv_np), precision=hi
+        ).reshape(C, -1, BLK, K)
+        pm = jnp.einsum(
+            "cnt,tv->cnv", z, jnp.asarray(Mv_np), precision=hi
+        )  # (C, cap, BLK)
+        onehot = (
+            shift[:, None] == jnp.arange(BLK, dtype=shift.dtype)[None, :]
+        ).astype(jnp.float32)  # (cap, BLK)
+        yk = jnp.einsum("cnvk,nv->cnk", y, onehot, precision=hi)
+        pmn = jnp.einsum("cnv,nv->cn", pm, onehot, precision=hi)
+        feats = yk - pmn[..., None] * jnp.asarray(colsum_np)[None, None, :]
+        out = jnp.transpose(feats, (1, 0, 2)).reshape(-1, C * K)
+        out = dwt_xla.safe_l2_normalize(out)
+        return out * mask[:, None].astype(out.dtype)
+
+    return ingest_features
+
+
 def ingest_recording(
     recording: Recording,
     guessed_number: int,

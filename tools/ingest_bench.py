@@ -8,11 +8,19 @@ Variants:
   einsum_2d       A/B formulation of the headline: same geometry, but
                   (B, C, T) flattened to (B*C, T) and contracted as
                   one explicit 2-D matmul instead of the bct,tk einsum
+  einsum_flat     A/B formulation of the headline: epochs stored
+                  channel-flat (B, C*T) and contracted against a
+                  block-diagonal (C*T, C*K) operator — no C dimension
+                  exists for XLA to lay out or relayout
   einsum_bf16     the headline with bfloat16 epochs resident (half the
                   HBM bytes; ~2e-3 feature deviation, classification
                   unchanged on the fixture — fe=dwt-8-tpu-bf16)
   xla_ingest      int16 raw + irregular markers -> features via the
                   XLA gather formulation (ops/device_ingest.py)
+  block_ingest    int16 raw + irregular markers -> features via the
+                  tile-row-gather + 128-variant-bank formulation
+                  (make_block_ingest_featurizer) — the XLA-only
+                  replacement for the element gather
   pallas_ingest   int16 raw + irregular markers -> features via the
                   fused Pallas kernel (ops/ingest_pallas.py)
   regular_ingest  int16 raw + regular stimulus train -> features, no
@@ -60,13 +68,34 @@ def run(variant: str, n: int, iters: int) -> dict:
     rng = np.random.RandomState(0)
     res = np.array([0.1, 0.1, 0.2], np.float32)
 
-    if variant in ("einsum", "einsum_2d", "einsum_bf16"):
+    if variant in ("einsum", "einsum_2d", "einsum_bf16", "einsum_flat"):
         from eeg_dataanalysispackage_tpu.ops import dwt as dwt_xla
 
         if variant == "einsum":
             extract = dwt_xla.make_batched_extractor()
         elif variant == "einsum_bf16":
             extract = dwt_xla.make_batched_extractor(dtype=jnp.bfloat16)
+        elif variant == "einsum_flat":
+            # channel-flat layout: (B, C*T) against a block-diagonal
+            # operator; 3x the MACs (zeros) but zero layout questions
+            T, C, fsize = 1000, 3, 16
+            skip, esize = 175, 512
+            blk = np.zeros((T, fsize), np.float32)
+            blk[skip : skip + esize] = np.asarray(
+                dwt_xla.cascade_matrix(8, esize, fsize), np.float32
+            )
+            bd = np.zeros((C * T, C * fsize), np.float32)
+            for c in range(C):
+                bd[c * T : (c + 1) * T, c * fsize : (c + 1) * fsize] = blk
+
+            @jax.jit
+            def extract(xflat):
+                y = jax.lax.dot_general(
+                    xflat, jnp.asarray(bd), (((1,), (0,)), ((), ())),
+                    precision=jax.lax.Precision.HIGHEST,
+                )
+                return dwt_xla.safe_l2_normalize(y)
+
         else:
             # A/B formulation: flatten (B, C, T) -> (B*C, T) and run
             # one explicit 2-D matmul instead of the bct,tk einsum.
@@ -102,8 +131,9 @@ def run(variant: str, n: int, iters: int) -> dict:
                 )
                 return dwt_xla.safe_l2_normalize(y.reshape(B, C * fsize))
 
+        shape = (n, 3 * 1000) if variant == "einsum_flat" else (n, 3, 1000)
         epochs = jax.random.normal(
-            jax.random.PRNGKey(0), (n, 3, 1000), dtype=jnp.float32
+            jax.random.PRNGKey(0), shape, dtype=jnp.float32
         ) * 50.0
         if variant == "einsum_bf16":
             # bf16-RESIDENT epochs: the HBM bytes halve only if the
@@ -124,7 +154,7 @@ def run(variant: str, n: int, iters: int) -> dict:
 
         arg = epochs
 
-    elif variant in ("xla_ingest", "pallas_ingest"):
+    elif variant in ("xla_ingest", "block_ingest", "pallas_ingest"):
         S = 200 + n * STRIDE + 1000
         raw = rng.randint(-3000, 3000, size=(3, S), dtype=np.int16)
         base = np.arange(n, dtype=np.int64) * STRIDE + 200
@@ -132,10 +162,14 @@ def run(variant: str, n: int, iters: int) -> dict:
         positions = np.clip(base + jitter, 100, S - 800)
         bytes_per_epoch = 3 * STRIDE * 2
 
-        if variant == "xla_ingest":
+        if variant in ("xla_ingest", "block_ingest"):
             from eeg_dataanalysispackage_tpu.ops import device_ingest
 
-            feat = device_ingest.make_device_ingest_featurizer()
+            feat = (
+                device_ingest.make_device_ingest_featurizer()
+                if variant == "xla_ingest"
+                else device_ingest.make_block_ingest_featurizer()
+            )
             cap = ((n + 63) // 64) * 64
             pos_pad = np.zeros(cap, np.int32)
             pos_pad[:n] = positions

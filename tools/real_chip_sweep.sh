@@ -41,6 +41,8 @@ run pallas_128k64 900 python tools/ingest_bench.py pallas_ingest 131072 20
 BENCH_CHUNK=32768 BENCH_TILE_B=16 \
 run pallas_32k16  900 python tools/ingest_bench.py pallas_ingest 131072 20
 run xla_ingest    900 python tools/ingest_bench.py xla_ingest 32768 10
+run block_ingest  900 python tools/ingest_bench.py block_ingest 32768 10
+run einsum_flat   600 python tools/ingest_bench.py einsum_flat 262144 50
 run train_step    600 python tools/ingest_bench.py train_step 131072 20
 BENCH_FORMULATION=phase \
 run regular_phase 900 python tools/ingest_bench.py regular_ingest 262144 20
