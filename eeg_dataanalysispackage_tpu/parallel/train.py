@@ -70,6 +70,26 @@ def make_train_step(mesh=None, learning_rate: float = 0.05, momentum: float = 0.
     jitted program; with a mesh, ``epochs``/``labels``/``mask`` are
     expected sharded over the data axis and params replicated.
     """
+    init_state, feat_step = make_feature_train_step(
+        mesh, learning_rate, momentum
+    )
+
+    @jax.jit
+    def train_step(state, epochs, labels, mask):
+        # features are constant w.r.t. params, so extracting before
+        # the grad is exactly the fused-in-loss formulation; one jit
+        # still traces extraction + fwd/bwd/update as one program
+        return feat_step(state, extract_features(epochs), labels, mask)
+
+    return init_state, train_step
+
+
+def make_feature_train_step(
+    mesh=None, learning_rate: float = 0.05, momentum: float = 0.9
+):
+    """(init_state, step) on precomputed (B, 48) features — the MLP
+    half of :func:`make_train_step`, for callers that produce
+    features by other fused paths (e.g. the raw-stream step below)."""
     tx = optax.sgd(learning_rate, momentum=momentum, nesterov=True)
 
     def init_state(key):
@@ -78,17 +98,17 @@ def make_train_step(mesh=None, learning_rate: float = 0.05, momentum: float = 0.
             params = jax.device_put(params, NamedSharding(mesh, P()))
         return {"params": params, "opt": tx.init(params)}
 
-    def loss_fn(params, epochs, labels, mask):
-        probs = forward(params, extract_features(epochs))
+    def loss_fn(params, features, labels, mask):
+        probs = forward(params, features)
         y = jnp.stack([labels, 1.0 - labels], axis=1)
         p = jnp.clip(probs, 1e-7, 1.0)
         per_example = -jnp.sum(y * jnp.log(p), axis=1) * mask
         return per_example.sum() / jnp.maximum(mask.sum(), 1.0)
 
     @jax.jit
-    def train_step(state, epochs, labels, mask):
+    def step(state, features, labels, mask):
         loss, grads = jax.value_and_grad(loss_fn)(
-            state["params"], epochs, labels, mask
+            state["params"], features, labels, mask
         )
         updates, opt = tx.update(grads, state["opt"], state["params"])
         return {
@@ -96,7 +116,37 @@ def make_train_step(mesh=None, learning_rate: float = 0.05, momentum: float = 0.
             "opt": opt,
         }, loss
 
-    return init_state, train_step
+    return init_state, step
+
+
+def make_raw_train_step(
+    stride: int,
+    n_epochs: int,
+    mesh=None,
+    learning_rate: float = 0.05,
+    momentum: float = 0.9,
+    formulation: str = "auto",
+):
+    """Train straight from the int16 stream: one step =
+    fused regular-SOA ingest (ops/device_ingest, ~4.8 KB HBM/epoch vs
+    the 12 KB of f32-resident epochs) -> features -> MLP fwd/bwd ->
+    update. ``step(state, raw_i16, resolutions, labels, mask,
+    first_position)``; ``first_position`` is a host int (the
+    featurizer's phase planning is host-side)."""
+    from ..ops import device_ingest
+
+    ing = device_ingest.make_regular_ingest_featurizer(
+        stride, n_epochs, formulation=formulation
+    )
+    init_state, feat_step = make_feature_train_step(
+        mesh, learning_rate, momentum
+    )
+
+    def step(state, raw_i16, resolutions, labels, mask, first_position):
+        feats = ing(raw_i16, resolutions, int(first_position))
+        return feat_step(state, feats, labels, mask)
+
+    return init_state, step
 
 
 def stage_batch(

@@ -113,6 +113,42 @@ def test_streaming_rejects_bad_block_layout(mesh8):
         extract(jnp.asarray(signal[:, : 8 * 600 - 3]))
 
 
+def test_raw_train_step_matches_feature_step_composition():
+    """make_raw_train_step == fused ingest + make_feature_train_step:
+    identical state updates and losses, and the loss moves."""
+    import jax
+    import jax.numpy as jnp
+    from eeg_dataanalysispackage_tpu.ops import device_ingest
+    from eeg_dataanalysispackage_tpu.parallel import train as ptrain
+
+    rng = np.random.RandomState(0)
+    n, stride, first = 32, 800, 150
+    S = 200 + n * stride + 8192
+    raw = rng.randint(-3000, 3000, size=(3, S)).astype(np.int16)
+    res = np.array([0.1, 0.1, 0.2], np.float32)
+    labels = jnp.asarray(rng.randint(0, 2, size=n).astype(np.float32))
+    mask = jnp.ones((n,), jnp.float32)
+
+    init_raw, raw_step = ptrain.make_raw_train_step(stride, n)
+    state = init_raw(jax.random.PRNGKey(0))
+    losses = []
+    for _ in range(5):
+        state, loss = raw_step(
+            state, jnp.asarray(raw), jnp.asarray(res), labels, mask, first
+        )
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+    ing = device_ingest.make_regular_ingest_featurizer(stride, n)
+    feats = ing(jnp.asarray(raw), jnp.asarray(res), first)
+    init_f, feat_step = ptrain.make_feature_train_step()
+    state_f = init_f(jax.random.PRNGKey(0))
+    for i in range(5):
+        state_f, loss_f = feat_step(state_f, feats, labels, mask)
+        np.testing.assert_allclose(float(loss_f), losses[i], rtol=1e-6)
+
+
 def test_windowed_pipeline_aligned_slab_matches_gather():
     """The tile-aligned slab decomposition (stride % 128 == 0) must
     agree with the index-gather formulation — same windows, same

@@ -30,6 +30,10 @@ Variants:
                   the JSON line records which one ran
   train_step      f32 epochs -> features -> logreg forward/backward/
                   update (parallel/train.py one-step)
+  train_step_raw  int16 raw stream -> fused regular ingest ->
+                  features -> logreg fwd/bwd/update: the full
+                  training loop at int16 bytes/epoch
+                  (parallel/train.make_raw_train_step)
   rf_train        rf-tpu whole-forest growth as one XLA program
                   (models/trees_device.py): 100 trees, depth 5,
                   32 bins over n rows x 48 binned features;
@@ -71,6 +75,23 @@ def run(variant: str, n: int, iters: int) -> dict:
     if variant in ("einsum", "einsum_2d", "einsum_bf16", "einsum_flat"):
         from eeg_dataanalysispackage_tpu.ops import dwt as dwt_xla
 
+        # A/B variants derive geometry from the extractor's own
+        # defaults so every twin benchmarks the identical computation
+        import inspect
+
+        defaults = {
+            k: p.default
+            for k, p in inspect.signature(
+                dwt_xla.epoch_features
+            ).parameters.items()
+            if p.default is not inspect.Parameter.empty
+        }
+        skip = defaults["skip_samples"]
+        esize = defaults["epoch_size"]
+        fsize = defaults["feature_size"]
+        widx = defaults["wavelet_index"]
+        T, C = 1000, 3
+
         if variant == "einsum":
             extract = dwt_xla.make_batched_extractor()
         elif variant == "einsum_bf16":
@@ -78,11 +99,9 @@ def run(variant: str, n: int, iters: int) -> dict:
         elif variant == "einsum_flat":
             # channel-flat layout: (B, C*T) against a block-diagonal
             # operator; 3x the MACs (zeros) but zero layout questions
-            T, C, fsize = 1000, 3, 16
-            skip, esize = 175, 512
             blk = np.zeros((T, fsize), np.float32)
             blk[skip : skip + esize] = np.asarray(
-                dwt_xla.cascade_matrix(8, esize, fsize), np.float32
+                dwt_xla.cascade_matrix(widx, esize, fsize), np.float32
             )
             bd = np.zeros((C * T, C * fsize), np.float32)
             for c in range(C):
@@ -98,23 +117,7 @@ def run(variant: str, n: int, iters: int) -> dict:
 
         else:
             # A/B formulation: flatten (B, C, T) -> (B*C, T) and run
-            # one explicit 2-D matmul instead of the bct,tk einsum.
-            # Geometry derived from the same defaults as the extractor
-            # so both variants benchmark the identical computation.
-            import inspect
-
-            defaults = {
-                k: p.default
-                for k, p in inspect.signature(
-                    dwt_xla.epoch_features
-                ).parameters.items()
-                if p.default is not inspect.Parameter.empty
-            }
-            skip = defaults["skip_samples"]
-            esize = defaults["epoch_size"]
-            fsize = defaults["feature_size"]
-            widx = defaults["wavelet_index"]
-            T, C = 1000, 3
+            # one explicit 2-D matmul instead of the bct,tk einsum
             kernel_np = np.zeros((T, fsize), np.float32)
             kernel_np[skip : skip + esize] = np.asarray(
                 dwt_xla.cascade_matrix(widx, esize, fsize), np.float32
@@ -333,6 +336,39 @@ def run(variant: str, n: int, iters: int) -> dict:
 
         arg = (epochs, labels, mask)
 
+    elif variant == "train_step_raw":
+        from eeg_dataanalysispackage_tpu.parallel import train as ptrain
+
+        first = 150
+        S = 200 + n * REGULAR_STRIDE + 8192
+        raw = rng.randint(-3000, 3000, size=(3, S), dtype=np.int16)
+        labels = jnp.asarray(rng.randint(0, 2, size=n).astype(np.float32))
+        init_state, step = ptrain.make_raw_train_step(
+            REGULAR_STRIDE, n,
+            formulation=os.environ.get("BENCH_FORMULATION", "auto"),
+        )
+        state0 = init_state(jax.random.PRNGKey(0))
+        mask = jnp.ones((n,), jnp.float32)
+        bytes_per_epoch = 3 * REGULAR_STRIDE * 2
+        args = (jnp.asarray(raw), jnp.asarray(res), labels, mask)
+
+        @jax.jit
+        def loop(raw_a, res_a, y, m):
+            def body(state, i):
+                state2, loss = step(
+                    state, raw_a, res_a + i * 1e-12, y, m, first
+                )
+                return state2, loss
+
+            state, losses = jax.lax.scan(
+                body, state0, jnp.arange(iters, dtype=jnp.float32)
+            )
+            return jax.tree_util.tree_reduce(
+                lambda a, b: a + b.sum(), state, jnp.float32(0)
+            ) + losses.sum()
+
+        arg = args
+
     elif variant == "rf_train":
         from eeg_dataanalysispackage_tpu.models import trees, trees_device
 
@@ -395,11 +431,11 @@ def run(variant: str, n: int, iters: int) -> dict:
         payload["tile_fill"] = round(fill, 3)
         # a failed check raised above, so a published number is valid
         payload["parity_max_abs_dev"] = parity_dev
-    if variant == "regular_ingest":
+    if variant in ("regular_ingest", "train_step_raw"):
         from eeg_dataanalysispackage_tpu.ops import device_ingest
 
         payload["formulation"] = device_ingest.resolve_regular_formulation(
-            formulation, REGULAR_STRIDE
+            os.environ.get("BENCH_FORMULATION", "auto"), REGULAR_STRIDE
         )
     return payload
 
