@@ -9,11 +9,17 @@ Beyond the headline, the same line carries the fused-ingest and
 train-step variants (tools/ingest_bench.py) with HBM-roofline context:
 
   einsum          f32 epochs resident in HBM -> features (headline)
+  einsum_bf16     bf16-resident twin of the headline
   regular_ingest  fused int16 ingest, fixed-SOA stimulus train ->
-                  features (static reshape + one einsum, no gather)
+                  features (formulation auto: phase on TPU)
+  block_ingest    fused int16 ingest, irregular markers -> features
+                  via tile-row gathers + the 128-variant operator
+                  bank (XLA-only; no element gather)
+  train_step      f32 epochs -> features -> MLP fwd/bwd/update
+  train_step_raw  int16 stream -> fused ingest -> features -> MLP
+                  fwd/bwd/update (training at int16 bytes/epoch)
   pallas_ingest   fused int16 ingest, irregular marker positions ->
                   features (ops/ingest_pallas.py kernel)
-  train_step      f32 epochs -> features -> MLP fwd/bwd/update
 
 Resilience contract (round-1 BENCH artifact died rc=1 on a single
 ``Unable to initialize backend 'axon': UNAVAILABLE``): the parent
@@ -48,10 +54,17 @@ _RUN_TIMEOUT_S = int(os.environ.get("BENCH_RUN_TIMEOUT", 420))
 # Keeps the whole artifact comfortably under driver patience so the
 # parent is never killed mid-variant (which loses the JSON line and
 # can wedge the tunnel).
-# Default scales with the per-variant timeout so raising
-# BENCH_RUN_TIMEOUT alone never silently skips variants.
+# Default scales with the per-variant timeout AND the variant count
+# (budget ~ one timeout per variant), capped at 40 min to stay under
+# driver patience — real variants run 1-3 min each (sweep evidence),
+# so the cap only bites if several variants hit their full timeout;
+# BENCH_TOTAL_BUDGET overrides.
+_N_VARIANTS = 7  # asserted against the variant tables below
 _TOTAL_BUDGET_S = int(
-    os.environ.get("BENCH_TOTAL_BUDGET", max(1500, 3 * _RUN_TIMEOUT_S))
+    os.environ.get(
+        "BENCH_TOTAL_BUDGET",
+        min(2400, max(1500, _N_VARIANTS * _RUN_TIMEOUT_S)),
+    )
 )
 
 # (n_epochs, iters) per variant: TPU-sized vs CPU-fallback-sized.
@@ -68,16 +81,23 @@ _VARIANTS_TPU = {
         int(os.environ.get("BENCH_ITERS", 50)),
     ),
     "regular_ingest": (262144, 20),
-    "pallas_ingest": (131072, 20),
+    "block_ingest": (32768, 10),
     "train_step": (131072, 20),
+    "train_step_raw": (131072, 20),
+    # last: known to fail fast while the terminal-side Mosaic compile
+    # crash stands (the failure is recorded, not fatal)
+    "pallas_ingest": (131072, 20),
 }
 _VARIANTS_CPU = {
     "einsum": (8192, 5),
     "einsum_bf16": (8192, 3),
     "regular_ingest": (8192, 3),
-    "pallas_ingest": (2048, 2),
+    "block_ingest": (2048, 2),
     "train_step": (8192, 3),
+    "train_step_raw": (4096, 2),
+    "pallas_ingest": (2048, 2),
 }
+assert len(_VARIANTS_TPU) == len(_VARIANTS_CPU) == _N_VARIANTS
 
 
 def _probe_tpu_once() -> bool:
@@ -166,6 +186,8 @@ def _collect(platform: str) -> dict:
                 "bytes_per_epoch": r["bytes_per_epoch"],
                 "pct_of_hbm_roofline": r["pct_of_hbm_roofline"],
             }
+            if "formulation" in r:
+                variants[name]["formulation"] = r["formulation"]
         except (RuntimeError, subprocess.TimeoutExpired, ValueError,
                 KeyError) as e:
             variants[name] = {"error": str(e)[:300]}
