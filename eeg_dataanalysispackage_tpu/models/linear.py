@@ -15,8 +15,8 @@ of MLlib's parquet+json directories.
 
 from __future__ import annotations
 
+import io
 import json
-import os
 
 import numpy as np
 
@@ -49,23 +49,31 @@ class _LinearClassifier(base.Classifier):
         return (margin > 0.0).astype(np.float64)
 
     def save(self, path: str) -> None:
-        # The reference deletes any existing save target first
-        # (LogisticRegressionClassifier.java:144-147).
-        if os.path.isdir(path):
-            import shutil
+        # serialize to bytes, then hand off to the pluggable
+        # filesystem (local path or remote URI — the HDFS-parity
+        # flow); a stale directory at the raw target is deleted
+        # first (LogisticRegressionClassifier.java:144-147)
+        from ..io import modelfiles
 
-            shutil.rmtree(path)
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        modelfiles.delete_local_dir_target(path)
+        buf = io.BytesIO()
         np.savez(
-            path if path.endswith(".npz") else path + ".npz",
+            buf,
             weights=self.weights,
             config=json.dumps(self.config),
             kind=self.__class__.__name__,
         )
+        fname = path if path.endswith(".npz") else path + ".npz"
+        modelfiles.write_model_bytes(fname, buf.getvalue())
 
     def load(self, path: str) -> None:
+        from ..io import modelfiles
+
         fname = path if path.endswith(".npz") else path + ".npz"
-        data = np.load(fname, allow_pickle=False)
+        data = np.load(
+            io.BytesIO(modelfiles.read_model_bytes(fname)),
+            allow_pickle=False,
+        )
         kind = str(data["kind"])
         if kind != self.__class__.__name__:
             raise ValueError(

@@ -59,7 +59,6 @@ collapse into one XLA program.
 from __future__ import annotations
 
 import json
-import os
 from typing import Dict, List, Sequence
 
 import jax
@@ -516,23 +515,24 @@ class NeuralNetworkClassifier(base.Classifier):
     def save(self, path: str) -> None:
         from flax import serialization
 
-        if os.path.exists(path) and os.path.isfile(path):
-            os.remove(path)  # reference deletes the target first (:171)
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        from ..io import modelfiles
+
         blob = serialization.to_bytes(self.params)
-        with open(path, "wb") as f:
-            header = json.dumps({"arch": self._arch, "config": self.config})
-            f.write(len(header).to_bytes(8, "little"))
-            f.write(header.encode())
-            f.write(blob)
+        header = json.dumps({"arch": self._arch, "config": self.config})
+        data = (
+            len(header).to_bytes(8, "little") + header.encode() + blob
+        )
+        modelfiles.write_model_bytes(path, data)
 
     def load(self, path: str) -> None:
         from flax import serialization
 
-        with open(path, "rb") as f:
-            hlen = int.from_bytes(f.read(8), "little")
-            header = json.loads(f.read(hlen).decode())
-            blob = f.read()
+        from ..io import modelfiles
+
+        raw = modelfiles.read_model_bytes(path)
+        hlen = int.from_bytes(raw[:8], "little")
+        header = json.loads(raw[8 : 8 + hlen].decode())
+        blob = raw[8 + hlen :]
         self._arch = header["arch"]
         if "layer_types" not in self._arch:  # round-1 save files
             self._arch["layer_types"] = (

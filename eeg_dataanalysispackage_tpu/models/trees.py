@@ -29,7 +29,7 @@ Config surface parity:
 from __future__ import annotations
 
 import json
-import os
+import io
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -313,12 +313,10 @@ class DecisionTreeClassifier(base.Classifier):
         return path[7:] if path.startswith("file://") else path
 
     def save(self, path: str) -> None:
-        path = self._strip_prefix(path)
-        if os.path.isdir(path):
-            import shutil
+        from ..io import modelfiles
 
-            shutil.rmtree(path)
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        path = self._strip_prefix(path)
+        modelfiles.delete_local_dir_target(path)
         payload = {
             "kind": self.__class__.__name__,
             "params": self._params,
@@ -330,19 +328,27 @@ class DecisionTreeClassifier(base.Classifier):
         for i, t in enumerate(self.trees):
             for k, v in t.items():
                 flat[f"tree{i}_{k}"] = v
+        buf = io.BytesIO()
         np.savez(
-            path if path.endswith(".npz") else path + ".npz",
+            buf,
             meta=json.dumps(
                 {k: v for k, v in payload.items() if k not in ("edges",)}
             ),
             edges=payload["edges"],
             **flat,
         )
+        fname = path if path.endswith(".npz") else path + ".npz"
+        modelfiles.write_model_bytes(fname, buf.getvalue())
 
     def load(self, path: str) -> None:
+        from ..io import modelfiles
+
         path = self._strip_prefix(path)
         fname = path if path.endswith(".npz") else path + ".npz"
-        data = np.load(fname, allow_pickle=False)
+        data = np.load(
+            io.BytesIO(modelfiles.read_model_bytes(fname)),
+            allow_pickle=False,
+        )
         meta = json.loads(str(data["meta"]))
         if meta["kind"] != self.__class__.__name__:
             raise ValueError(

@@ -136,6 +136,55 @@ def test_save_load_roundtrip(tmp_path):
     np.testing.assert_array_equal(clf.weights, clf2.weights)
 
 
+def test_save_load_file_uri(tmp_path):
+    """file:// URIs are tolerated like the reference's path handling
+    (DecisionTreeClassifier.java:157-165 prefixes them itself)."""
+    x, y = make_separable()
+    clf = linear.LogisticRegressionClassifier()
+    clf.set_config({})
+    clf.fit(x, y)
+    clf.save(f"file://{tmp_path}/model")
+    assert (tmp_path / "model.npz").exists()
+    clf2 = linear.LogisticRegressionClassifier()
+    clf2.load(str(tmp_path / "model"))
+    np.testing.assert_array_equal(clf.weights, clf2.weights)
+
+
+def test_save_deletes_stale_directory_target(tmp_path):
+    """Reference parity: the MLlib savers delete an existing
+    directory at the raw save target first
+    (LogisticRegressionClassifier.java:144-147)."""
+    x, y = make_separable()
+    clf = linear.LogisticRegressionClassifier()
+    clf.set_config({})
+    clf.fit(x, y)
+    stale = tmp_path / "model"
+    stale.mkdir()
+    (stale / "old-part").write_text("stale directory-format model")
+    clf.save(str(stale))
+    assert not stale.is_dir()
+    assert (tmp_path / "model.npz").exists()
+
+
+def test_nn_save_onto_directory_errors(tmp_path):
+    """The NN saver must NOT inherit the MLlib delete-directory
+    quirk: writing onto an existing directory errors loudly instead
+    of destroying it."""
+    from eeg_dataanalysispackage_tpu.models import nn as nn_mod
+
+    target = tmp_path / "models"
+    target.mkdir()
+    (target / "other").write_text("another model")
+    clf = nn_mod.NeuralNetworkClassifier()
+    clf.params = {}  # minimal state; failure happens at write time
+    clf._arch = {"n_in": 1, "n_outs": [2], "layer_types": ["output"],
+                 "activations": ["softmax"], "dropouts": [0.0],
+                 "weight_init": "xavier"}
+    with pytest.raises(IsADirectoryError):
+        clf.save(str(target))
+    assert (target / "other").exists()
+
+
 def test_registry_unknown_raises():
     with pytest.raises(ValueError, match="Unsupported classifier"):
         registry.create("xgboost")
