@@ -21,11 +21,17 @@ import os
 
 from . import sources
 
-_REMOTE_SCHEMES = ("http://", "https://", "gs://")
+
+def _fs_for(path: str):
+    """Single source of scheme dispatch: io/remote.filesystem_for
+    (so a scheme added there is automatically supported here)."""
+    from . import remote
+
+    return remote.filesystem_for(path)
 
 
-def is_remote(path: str) -> bool:
-    return path.startswith(_REMOTE_SCHEMES)
+def _is_local(path: str) -> bool:
+    return isinstance(_fs_for(path), sources.LocalFileSystem)
 
 
 def delete_local_dir_target(path: str) -> None:
@@ -33,7 +39,7 @@ def delete_local_dir_target(path: str) -> None:
     existing *directory* at the raw (un-suffixed) save target
     (LogisticRegressionClassifier.java:144-147). No-op for remote
     URIs and non-directories."""
-    if is_remote(path):
+    if not _is_local(path):
         return
     local = sources.LocalFileSystem._strip(path)
     if os.path.isdir(local):
@@ -50,25 +56,16 @@ def write_model_bytes(path: str, data: bytes) -> None:
     see :func:`delete_local_dir_target` for the savers that want the
     reference's delete-first quirk).
     """
-    if is_remote(path):
-        from . import remote
-
-        remote.filesystem_for(path).write_bytes(path, data)
-        return
-    fs = sources.LocalFileSystem()
-    local = fs._strip(path)
-    os.makedirs(os.path.dirname(local) or ".", exist_ok=True)
-    fs.write_bytes(local, data)
+    fs = _fs_for(path)
+    if isinstance(fs, sources.LocalFileSystem):
+        local = fs._strip(path)
+        os.makedirs(os.path.dirname(local) or ".", exist_ok=True)
+    fs.write_bytes(path, data)
 
 
 def read_model_bytes(path: str) -> bytes:
     """Read serialized model bytes from a local path or remote URI.
 
     Raises ``FileNotFoundError`` for missing objects on either side
-    (the remote layer maps 404 onto it already).
-    """
-    if is_remote(path):
-        from . import remote
-
-        return remote.filesystem_for(path).read_bytes(path)
-    return sources.LocalFileSystem().read_bytes(path)
+    (the remote layer maps 404 onto it already)."""
+    return _fs_for(path).read_bytes(path)

@@ -1,0 +1,201 @@
+"""Sequence-parallel irregular-marker ingest: epoch a time-sharded
+recording.
+
+Completes the long-context story for the *marker-driven* pipeline the
+reference actually runs (OffLineDataProvider.java:200-265): a
+recording too long for one chip's HBM is sharded over the mesh's time
+axis, and each device cuts + featurizes the epochs whose windows start
+in its block — windows straddling a block boundary read their tail
+from the right neighbor via a ``ppermute`` ring halo, exactly like
+``parallel/streaming.py``'s regular-window extractor. Window
+formation on each shard is the block-gather formulation
+(``ops/device_ingest.make_block_ingest_featurizer``): tile-row
+gathers + the 128-variant operator bank, no element gather.
+
+Division of labor mirrors ``ops/device_ingest``: the host plans
+(marker validity, the order-dependent balance scan, shard assignment,
+per-shard padding); devices touch the waveform.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..epochs.extractor import BalanceState
+from ..ops import device_ingest
+from ..utils import constants
+from . import mesh as pmesh
+
+# the block-ingest slab: 8 x 128-lane rows per window (>= 787 live
+# samples + 127 max shift) — also the halo length a shard needs from
+# its right neighbor
+_SLAB = 1024
+
+
+@dataclasses.dataclass
+class ShardedIngestPlan:
+    """Host-side shard assignment for one recording's markers."""
+
+    local_positions: np.ndarray  # (n_shards, cap) int32 positions - shard base
+    mask: np.ndarray  # (n_shards, cap) bool
+    unsort: np.ndarray  # (n_kept,) row index into the flat (S*cap) output
+    targets: np.ndarray  # (n_kept,) float64
+    stimulus_indices: np.ndarray  # (n_kept,) int
+    # geometry the plan was computed against — extract() verifies it
+    # so a plan built for a different sharding cannot silently
+    # produce wrong features
+    block: int = 0
+    n_samples: int = 0
+    pre: int = constants.PRESTIMULUS_SAMPLES
+
+
+def plan_sharded_ingest(
+    markers,
+    guessed_number: int,
+    n_samples: int,
+    n_shards: int,
+    block: int,
+    pre: int = constants.PRESTIMULUS_SAMPLES,
+    balance: Optional[BalanceState] = None,
+    capacity_multiple: int = 8,
+) -> ShardedIngestPlan:
+    """Assign each kept marker to the shard containing its window
+    start; reference validity + balance semantics come from
+    :func:`device_ingest.plan_ingest` (same host scan)."""
+    base = device_ingest.plan_ingest(
+        markers,
+        guessed_number,
+        n_samples,
+        pre=pre,
+        balance=balance,
+        capacity_multiple=1,
+    )
+    kept = base.positions[base.mask].astype(np.int64)
+    shard_of = np.clip((kept - pre) // block, 0, n_shards - 1)
+    counts = np.bincount(shard_of, minlength=n_shards)
+    cap = max(
+        capacity_multiple,
+        int(-(-max(1, counts.max()) // capacity_multiple)) * capacity_multiple,
+    )
+    local = np.zeros((n_shards, cap), np.int32)
+    mask = np.zeros((n_shards, cap), bool)
+    unsort = np.empty(kept.shape[0], np.int64)
+    fill = np.zeros(n_shards, np.int64)
+    for row, (pos, s) in enumerate(zip(kept, shard_of)):
+        j = fill[s]
+        local[s, j] = pos - s * block
+        mask[s, j] = True
+        unsort[row] = s * cap + j
+        fill[s] += 1
+    return ShardedIngestPlan(
+        local_positions=local,
+        mask=mask,
+        unsort=unsort,
+        targets=base.targets,
+        stimulus_indices=base.stimulus_indices,
+        block=block,
+        n_samples=n_samples,
+        pre=pre,
+    )
+
+
+def make_sharded_ingest(
+    mesh: Mesh,
+    wavelet_index: int = 8,
+    epoch_size: int = 512,
+    skip_samples: int = 175,
+    feature_size: int = 16,
+    pre: int = constants.PRESTIMULUS_SAMPLES,
+    axis: str = pmesh.TIME_AXIS,
+):
+    """Build ``extract(raw_sharded, resolutions, plan) -> features``.
+
+    ``raw_sharded`` is the (C, T) int16 recording sharded over
+    ``axis`` (T divisible by the mesh axis size; per-shard block must
+    be >= the 1024-sample halo). Returns the (n_kept, C*K) float32
+    feature rows in original kept-marker order.
+    """
+    n_shards = mesh.shape[axis]
+    featurize = device_ingest.make_block_ingest_featurizer(
+        wavelet_index=wavelet_index,
+        epoch_size=epoch_size,
+        skip_samples=skip_samples,
+        feature_size=feature_size,
+        pre=pre,
+    )
+
+    def block_fn(x_block, res, pos_block, mask_block):
+        # right halo: receive the next shard's leading _SLAB samples;
+        # the LAST shard gets zeros (windows overhanging the global
+        # end zero-pad — Java copyOfRange semantics), not the ring
+        # wrap of shard 0's head.
+        perm = [(i, (i - 1) % n_shards) for i in range(n_shards)]
+        head = x_block[:, :_SLAB]
+        incoming = jax.lax.ppermute(head, axis, perm)
+        shard = jax.lax.axis_index(axis)
+        incoming = jnp.where(shard == n_shards - 1, 0, incoming)
+        ext = jnp.concatenate([x_block, incoming], axis=1)
+        # marker position local to this shard; window start =
+        # position - pre lies inside [0, block) by the plan
+        return featurize(ext, res, pos_block[0], mask_block[0])[None]
+
+    sharded = jax.jit(
+        shard_map(
+            block_fn,
+            mesh=mesh,
+            in_specs=(P(None, axis), P(), P(axis, None), P(axis, None)),
+            out_specs=P(axis, None, None),
+        )
+    )
+
+    def extract(raw_sharded, resolutions, plan: ShardedIngestPlan):
+        T = raw_sharded.shape[1]
+        if T % n_shards != 0:
+            raise ValueError(
+                f"recording length {T} not divisible by {n_shards} shards"
+            )
+        if T // n_shards < _SLAB:
+            raise ValueError(
+                f"per-shard block {T // n_shards} smaller than the "
+                f"{_SLAB}-sample halo; use fewer shards"
+            )
+        if (
+            plan.block != T // n_shards
+            or plan.n_samples != T
+            or plan.local_positions.shape[0] != n_shards
+            or plan.pre != pre
+        ):
+            raise ValueError(
+                f"plan geometry (block {plan.block}, T {plan.n_samples}, "
+                f"{plan.local_positions.shape[0]} shards, pre {plan.pre}) "
+                f"does not match this extractor/recording "
+                f"(block {T // n_shards}, T {T}, {n_shards} shards, "
+                f"pre {pre}); re-plan with plan_sharded_ingest"
+            )
+        feats = sharded(
+            raw_sharded,
+            jnp.asarray(resolutions, jnp.float32),
+            jnp.asarray(plan.local_positions),
+            jnp.asarray(plan.mask),
+        )
+        flat = np.asarray(feats).reshape(-1, feats.shape[-1])
+        return flat[plan.unsort]
+
+    return extract
+
+
+def stage_recording_int16(
+    signal: np.ndarray, mesh: Mesh, axis: str = pmesh.TIME_AXIS
+):
+    """Host->device staging of a (C, T) int16 recording, time-sharded
+    (raw int16 bytes on the wire — half the f32 transfer)."""
+    from . import streaming
+
+    return streaming.stage_recording(signal, mesh, axis, dtype=jnp.int16)

@@ -1,0 +1,127 @@
+"""Sequence-parallel irregular-marker ingest (parallel/sharded_ingest):
+time-sharded epoching with ring halo on the virtual 8-device mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from eeg_dataanalysispackage_tpu.io.brainvision import Marker
+from eeg_dataanalysispackage_tpu.ops import device_ingest
+from eeg_dataanalysispackage_tpu.parallel import (
+    mesh as pmesh,
+    sharded_ingest,
+)
+
+
+@pytest.fixture(scope="module")
+def tmesh():
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 virtual devices")
+    return pmesh.make_mesh(8, axes=(pmesh.TIME_AXIS,))
+
+
+def _markers(positions, stimuli):
+    return [
+        Marker(f"Mk{i}", "Stimulus", f"S  {s}", int(p))
+        for i, (p, s) in enumerate(zip(positions, stimuli))
+    ]
+
+
+def _recording(T, seed=0):
+    rng = np.random.RandomState(seed)
+    dc = np.array([[1500], [-900], [400]], np.int16)
+    raw = (rng.randint(-3000, 3000, size=(3, T)) + dc).astype(np.int16)
+    res = np.array([0.1, 0.1, 0.2], np.float32)
+    return raw, res
+
+
+def test_sharded_ingest_matches_single_device(tmesh):
+    """Features from the time-sharded extractor == the single-device
+    block featurizer on the same kept markers, in the same order —
+    including windows that straddle shard boundaries."""
+    T = 8 * 4096
+    raw, res = _recording(T)
+    block = T // 8
+    # markers everywhere, several right before shard boundaries so
+    # their windows cross into the neighbor via the halo
+    positions = [500, 3000, block - 50, block + 200, 2 * block - 10,
+                 3 * block + 77, 5 * block - 100, 7 * block + 900,
+                 6 * block + 123, 4 * block + 1]
+    stimuli = [1, 2, 3, 4, 5, 6, 7, 8, 9, 1]
+    markers = _markers(positions, stimuli)
+
+    plan = sharded_ingest.plan_sharded_ingest(
+        markers, guessed_number=4, n_samples=T, n_shards=8, block=block
+    )
+    extract = sharded_ingest.make_sharded_ingest(tmesh)
+    staged = sharded_ingest.stage_recording_int16(raw, tmesh)
+    got = extract(staged, res, plan)
+
+    base = device_ingest.plan_ingest(markers, 4, T)
+    feat = device_ingest.make_block_ingest_featurizer()
+    want = np.asarray(
+        feat(jnp.asarray(raw), jnp.asarray(res),
+             jnp.asarray(base.positions), jnp.asarray(base.mask))
+    )[base.mask]
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, rtol=0, atol=5e-6)
+    np.testing.assert_array_equal(plan.targets, base.targets)
+
+
+def test_sharded_ingest_end_overhang_zero_pads(tmesh):
+    """A window overhanging the global recording end reads zeros
+    (Java copyOfRange), NOT the ring-wrapped head of shard 0."""
+    T = 8 * 4096
+    raw, res = _recording(T, seed=3)
+    block = T // 8
+    positions = [1000, T - 200]  # second window overhangs the end
+    markers = _markers(positions, [1, 2])
+    plan = sharded_ingest.plan_sharded_ingest(
+        markers, guessed_number=2, n_samples=T, n_shards=8, block=block
+    )
+    extract = sharded_ingest.make_sharded_ingest(tmesh)
+    got = extract(sharded_ingest.stage_recording_int16(raw, tmesh), res, plan)
+
+    base = device_ingest.plan_ingest(markers, 2, T)
+    feat = device_ingest.make_block_ingest_featurizer()
+    want = np.asarray(
+        feat(jnp.asarray(raw), jnp.asarray(res),
+             jnp.asarray(base.positions), jnp.asarray(base.mask))
+    )[base.mask]
+    np.testing.assert_allclose(got, want, rtol=0, atol=5e-6)
+
+
+def test_sharded_ingest_balance_scan_matches_reference_semantics(tmesh):
+    """The order-dependent class-balance scan runs globally on the
+    host before sharding, so kept markers and targets are identical
+    to the single-device plan."""
+    T = 8 * 4096
+    raw, res = _recording(T, seed=5)
+    block = T // 8
+    positions = list(range(500, T - 1000, 2500))
+    stimuli = [(i % 9) + 1 for i in range(len(positions))]
+    markers = _markers(positions, stimuli)
+    plan = sharded_ingest.plan_sharded_ingest(
+        markers, guessed_number=3, n_samples=T, n_shards=8, block=block
+    )
+    base = device_ingest.plan_ingest(markers, 3, T)
+    np.testing.assert_array_equal(plan.targets, base.targets)
+    np.testing.assert_array_equal(
+        plan.stimulus_indices, base.stimulus_indices
+    )
+
+
+def test_sharded_ingest_rejects_bad_layouts(tmesh):
+    T = 8 * 4096
+    raw, res = _recording(T, seed=1)
+    extract = sharded_ingest.make_sharded_ingest(tmesh)
+    plan = sharded_ingest.plan_sharded_ingest(
+        _markers([1000], [1]), 1, T, 8, T // 8
+    )
+    with pytest.raises(ValueError, match="divisible"):
+        extract(jnp.asarray(raw[:, : T - 4]), res, plan)
+    small = np.zeros((3, 8 * 512), np.int16)
+    with pytest.raises(ValueError, match="halo"):
+        extract(jnp.asarray(small), res, plan)
