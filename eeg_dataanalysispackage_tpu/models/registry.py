@@ -45,6 +45,10 @@ def _register_builtins() -> None:
     # restored from the reference's commented-out test surface
     # (ClassifierTest.java:213) — MLlib GradientBoostedTrees analogue
     register("gbt", trees.GradientBoostedTreesClassifier)
+    register(
+        "gbt-tpu",
+        lambda: trees.GradientBoostedTreesClassifier(backend="device"),
+    )
     from . import nn
 
     register("nn", nn.NeuralNetworkClassifier)

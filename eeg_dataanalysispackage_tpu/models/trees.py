@@ -513,6 +513,12 @@ class GradientBoostedTreesClassifier(DecisionTreeClassifier):
         y = np.floor(np.asarray(labels, dtype=np.float64) + 0.5)
         self.edges = compute_bin_edges(features, bp["max_bins"])
         binned = bin_features(features, self.edges)
+        backend = self.config.get("config_backend", self.backend)
+        if backend not in ("host", "device"):
+            raise ValueError(f"unknown tree backend: {backend!r}")
+        if backend == "device":
+            self._fit_device_boost(binned, y, p, bp)
+            return
         F = np.zeros(len(y), dtype=np.float64)
         self.trees = []
         for _round in range(p["num_iterations"]):
@@ -524,6 +530,29 @@ class GradientBoostedTreesClassifier(DecisionTreeClassifier):
             arrays = tree.to_arrays()
             self.trees.append(arrays)
             F += p["learning_rate"] * _predict_tree(arrays, binned)
+
+    def _fit_device_boost(self, binned, y, p: Dict, bp: Dict) -> None:
+        """gbt-tpu: the whole boosting loop as one XLA program
+        (trees_device.boost_gbt — a lax.scan over rounds, each round
+        one matmul-histogram regression tree), versus MLlib's
+        one-Spark-job-per-round shape. Trees come back through
+        ``heap_to_host_arrays`` so prediction and persistence share
+        the host format."""
+        import jax.numpy as jnp
+
+        from . import trees_device
+
+        trees_device._check_device_depth(p["max_depth"])
+        heaps = trees_device.boost_gbt(
+            jnp.asarray(binned, jnp.int32),
+            jnp.asarray(y, jnp.float32),
+            rounds=p["num_iterations"],
+            learning_rate=p["learning_rate"],
+            max_bins=bp["max_bins"],
+            max_depth=p["max_depth"],
+            min_instances=bp["min_instances"],
+        )
+        self.trees = trees_device.heap_to_host_arrays(heaps)
 
     def predict(self, features: np.ndarray) -> np.ndarray:
         if not self.trees or self.edges is None:

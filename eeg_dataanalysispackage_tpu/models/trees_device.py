@@ -93,20 +93,31 @@ def draw_feature_masks(
     return order < subset
 
 
-def _grow_one(
+def _grow_heap_tree(
     binned: jnp.ndarray,  # (n, d) int32 in [0, max_bins)
-    labels: jnp.ndarray,  # (n,) int32 in {0, 1}
-    feature_mask: jnp.ndarray,  # (internal nodes, d) bool
+    channel_w: jnp.ndarray,  # (n, 2) f32 per-sample channel weights
     *,
     max_bins: int,
-    impurity: str,
     max_depth: int,
-    min_instances: int,
+    node_pred_fn,
+    split_fn,
 ) -> Dict[str, jnp.ndarray]:
-    """Single-tree growth; vmapped over the forest axis by the caller.
+    """Shared level-by-level heap growth (the frontier mechanics both
+    the classification and regression growers run).
 
-    Returns heap arrays: feature (n_nodes,) int32 (-1 = leaf),
-    threshold_bin (n_nodes,) int32, prediction (n_nodes,) f32.
+    Per level, every node's two-channel (feature, bin) histogram is
+    ONE matmul of the node/channel one-hot against the per-sample bin
+    one-hot — TPU scatters are sort-based and an order of magnitude
+    slower than this formulation (sums are exact in f32 below 2^24
+    weight magnitude per node).
+
+    ``node_pred_fn(tot) -> (L,)`` maps per-node channel totals
+    ``tot (2, L)`` to predictions. ``split_fn(hist2, tot, offset, L)
+    -> (flat_score (L, d*(B-1)) with -inf at invalid, accept_fn)``
+    scores candidate splits; ``accept_fn(best_score) -> (L,) bool``
+    applies the grower's acceptance rule (the shared loop adds only
+    finiteness). First-max argmax over the (feature, bin) flat layout
+    is the host growers' tie-break.
     """
     n, d = binned.shape
     B = max_bins
@@ -117,12 +128,6 @@ def _grow_one(
     pred = jnp.zeros((n_nodes,), jnp.float32)
     assign = jnp.zeros((n,), jnp.int32)  # every sample starts at the root
 
-    y = labels.astype(jnp.int32)
-
-    # (n, d*B) one-hot of every sample's bin per feature, built once
-    # and contracted on the MXU at every level — TPU scatters are
-    # sort-based and an order of magnitude slower than this matmul
-    # formulation (counts are exact in f32 below 2^24 samples/node)
     oh_bins = (
         (binned[:, :, None] == jnp.arange(B, dtype=jnp.int32)[None, None, :])
         .astype(jnp.float32)
@@ -135,56 +140,38 @@ def _grow_one(
         local = assign - offset
         live = (local >= 0) & (local < L)  # at this level & not a leaf
 
-        # (n, L*2) one-hot of (node, class); dead samples map to the
-        # out-of-range index -1 -> all-zeros row
-        oh_node = jax.nn.one_hot(
-            jnp.where(live, local * 2 + y, -1), L * 2, dtype=jnp.float32
+        # dead samples map to the out-of-range index -1 -> zero rows
+        oh = jax.nn.one_hot(
+            jnp.where(live, local, -1), L, dtype=jnp.float32
         )
-        # every node's (f, bin, class) histogram in one contraction
-        hist = jax.lax.dot_general(
-            oh_node,
+        A = jnp.concatenate(
+            [oh * channel_w[:, 0][:, None], oh * channel_w[:, 1][:, None]],
+            axis=1,
+        )  # (n, 2L)
+        hist2 = jax.lax.dot_general(
+            A,
             oh_bins,
             (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # (L*2, d*B)
-        hist = hist.reshape(L, 2, d, B).transpose(0, 2, 3, 1)
+        ).reshape(2, L, d, B)
 
-        total = hist.sum(axis=2)  # (L, d, 2) — identical per feature
-        node_counts = total[:, 0, :]  # (L, 2)
-        m = node_counts.sum(-1)  # (L,)
-        pos = node_counts[:, 1]
-        node_pred = jnp.where(pos * 2 > m, 1.0, 0.0)
-        pred = jax.lax.dynamic_update_slice(pred, node_pred, (offset,))
+        tot = hist2.sum(axis=3)[:, :, 0]  # (2, L) — identical per feature
+        pred = jax.lax.dynamic_update_slice(
+            pred, node_pred_fn(tot), (offset,)
+        )
 
         if level == max_depth:
             break  # deepest level: predictions only, no further splits
 
-        cum = jnp.cumsum(hist, axis=2)  # (L, d, B, 2)
-        left = cum[:, :, :-1, :]  # split "bin <= b", b in [0, B-2]
-        right = cum[:, :, -1:, :] - left
-        nl = left.sum(-1)
-        nr = right.sum(-1)
-        valid = (nl >= min_instances) & (nr >= min_instances)
-        valid &= feature_mask[offset : offset + L][:, :, None]
-        parent_imp = _impurity(node_counts, impurity)  # (L,)
-        child = (
-            nl * _impurity(left, impurity) + nr * _impurity(right, impurity)
-        ) / jnp.maximum(m, _EPS)[:, None, None]
-        gain = jnp.where(valid, parent_imp[:, None, None] - child, -jnp.inf)
-
-        flat_gain = gain.reshape(L, d * (B - 1))
-        best = jnp.argmax(flat_gain, axis=1).astype(jnp.int32)  # first max
-        best_gain = jnp.take_along_axis(flat_gain, best[:, None], axis=1)[:, 0]
+        flat_score, accept_fn = split_fn(hist2, tot, offset, L)
+        best = jnp.argmax(flat_score, axis=1).astype(jnp.int32)  # first max
+        best_score = jnp.take_along_axis(flat_score, best[:, None], axis=1)[
+            :, 0
+        ]
         bf = best // (B - 1)
         bb = best % (B - 1)
 
-        splittable = (
-            (m >= 2 * min_instances)
-            & (pos > 0)
-            & (pos < m)
-            & jnp.isfinite(best_gain)
-            & (best_gain > 0)
-        )
+        splittable = jnp.isfinite(best_score) & accept_fn(best_score)
         feature = jax.lax.dynamic_update_slice(
             feature, jnp.where(splittable, bf, -1), (offset,)
         )
@@ -205,6 +192,70 @@ def _grow_one(
         assign = jnp.where(node_split, 2 * assign + 1 + go_right, assign)
 
     return {"feature": feature, "threshold_bin": thresh, "prediction": pred}
+
+
+def _grow_one(
+    binned: jnp.ndarray,  # (n, d) int32 in [0, max_bins)
+    labels: jnp.ndarray,  # (n,) int32 in {0, 1}
+    feature_mask: jnp.ndarray,  # (internal nodes, d) bool
+    *,
+    max_bins: int,
+    impurity: str,
+    max_depth: int,
+    min_instances: int,
+) -> Dict[str, jnp.ndarray]:
+    """Single classification tree (gini/entropy); vmapped over the
+    forest axis by the caller. Channels are the class indicators, so
+    the shared histogram is the per-(node, feature, bin, class) count
+    table (MLlib's aggregation shape)."""
+    B = max_bins
+    d = binned.shape[1]
+    y = labels.astype(jnp.int32)
+    channel_w = jnp.stack(
+        [(y == 0), (y == 1)], axis=1
+    ).astype(jnp.float32)
+
+    def node_pred_fn(tot):
+        m = tot[0] + tot[1]
+        pos = tot[1]
+        return jnp.where(pos * 2 > m, 1.0, 0.0)
+
+    def split_fn(hist2, tot, offset, L):
+        hist = jnp.moveaxis(hist2, 0, -1)  # (L, d, B, 2)
+        node_counts = jnp.stack([tot[0], tot[1]], axis=1)  # (L, 2)
+        m = node_counts.sum(-1)
+        pos = node_counts[:, 1]
+        cum = jnp.cumsum(hist, axis=2)  # (L, d, B, 2)
+        left = cum[:, :, :-1, :]  # split "bin <= b", b in [0, B-2]
+        right = cum[:, :, -1:, :] - left
+        nl = left.sum(-1)
+        nr = right.sum(-1)
+        valid = (nl >= min_instances) & (nr >= min_instances)
+        valid &= feature_mask[offset : offset + L][:, :, None]
+        parent_imp = _impurity(node_counts, impurity)  # (L,)
+        child = (
+            nl * _impurity(left, impurity) + nr * _impurity(right, impurity)
+        ) / jnp.maximum(m, _EPS)[:, None, None]
+        gain = jnp.where(valid, parent_imp[:, None, None] - child, -jnp.inf)
+
+        def accept(best_gain):
+            return (
+                (m >= 2 * min_instances)
+                & (pos > 0)
+                & (pos < m)
+                & (best_gain > 0)
+            )
+
+        return gain.reshape(L, d * (B - 1)), accept
+
+    return _grow_heap_tree(
+        binned,
+        channel_w,
+        max_bins=max_bins,
+        max_depth=max_depth,
+        node_pred_fn=node_pred_fn,
+        split_fn=split_fn,
+    )
 
 
 @partial(
@@ -331,6 +382,128 @@ def _grow_all_vmapped(
     return jax.vmap(grow)(bootstrap, feature_masks)
 
 
+def _grow_one_reg(
+    binned: jnp.ndarray,  # (n, d) int32 in [0, max_bins)
+    residuals: jnp.ndarray,  # (n,) f32
+    *,
+    max_bins: int,
+    max_depth: int,
+    min_instances: int,
+) -> Dict[str, jnp.ndarray]:
+    """Variance-reduction regression tree in heap layout (the GBT
+    grower — host twin: trees._grow_regression_tree).
+
+    Same shared frontier loop as :func:`_grow_one`
+    (:func:`_grow_heap_tree`), but the two channels are
+    (count, sum of residuals) instead of class counts: the
+    SSE-reduction argmax only needs ``sl^2/nl + sr^2/nr`` (the
+    sum-of-squares terms cancel between parent and children). Split
+    acceptance matches the host grower: best score must beat the
+    parent's ``S^2/m`` by 1e-12.
+    """
+    B = max_bins
+    d = binned.shape[1]
+    r = residuals.astype(jnp.float32)
+    channel_w = jnp.stack([jnp.ones_like(r), r], axis=1)
+
+    def node_pred_fn(tot):
+        return tot[1] / jnp.maximum(tot[0], _EPS)
+
+    def split_fn(hist2, tot, offset, L):
+        cnt, s1 = hist2[0], hist2[1]
+        m, S = tot[0], tot[1]
+        ccnt = jnp.cumsum(cnt, axis=2)
+        cs1 = jnp.cumsum(s1, axis=2)
+        nl = ccnt[:, :, :-1]  # (L, d, B-1)
+        sl = cs1[:, :, :-1]
+        nr = m[:, None, None] - nl
+        sr = S[:, None, None] - sl
+        score = sl * sl / jnp.maximum(nl, _EPS) + sr * sr / jnp.maximum(
+            nr, _EPS
+        )
+        valid = (nl >= min_instances) & (nr >= min_instances)
+        score = jnp.where(valid, score, -jnp.inf)
+        parent_score = S * S / jnp.maximum(m, _EPS)
+
+        def accept(best_score):
+            return (m >= 2 * min_instances) & (
+                best_score > parent_score + 1e-12
+            )
+
+        return score.reshape(L, d * (B - 1)), accept
+
+    return _grow_heap_tree(
+        binned,
+        channel_w,
+        max_bins=max_bins,
+        max_depth=max_depth,
+        node_pred_fn=node_pred_fn,
+        split_fn=split_fn,
+    )
+
+
+def _predict_heap_tree(feature, thresh, pred, binned, max_depth):
+    """(n,) leaf values for one heap tree (shared walk)."""
+    node = jnp.zeros((binned.shape[0],), jnp.int32)
+    for _ in range(max_depth):
+        f = jnp.take(feature, node)
+        is_leaf = f < 0
+        sample_bin = jnp.take_along_axis(
+            binned, jnp.maximum(f, 0)[:, None].astype(jnp.int32), axis=1
+        )[:, 0]
+        go_right = (sample_bin > jnp.take(thresh, node)).astype(jnp.int32)
+        node = jnp.where(is_leaf, node, 2 * node + 1 + go_right)
+    return jnp.take(pred, node)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "rounds", "max_bins", "max_depth", "min_instances",
+    ),
+)
+def boost_gbt(
+    binned: jnp.ndarray,  # (n, d) int32
+    labels: jnp.ndarray,  # (n,) f32 in {0, 1}
+    *,
+    rounds: int,
+    learning_rate: float,
+    max_bins: int,
+    max_depth: int,
+    min_instances: int,
+) -> Dict[str, jnp.ndarray]:
+    """The whole GBT boosting loop as ONE XLA program.
+
+    ``lax.scan`` over rounds: residual = y - sigmoid(F), grow a
+    regression tree (fixed-shape heap), F += lr * tree(x). MLlib runs
+    each round as separate Spark jobs; here the 100-round loop is one
+    compiled program with no host round trips. Returns stacked heap
+    arrays (rounds, n_nodes).
+    """
+    _check_device_depth(max_depth)
+    y = labels.astype(jnp.float32)
+
+    def body(F, _):
+        residual = y - jax.nn.sigmoid(F)
+        tree = _grow_one_reg(
+            binned,
+            residual,
+            max_bins=max_bins,
+            max_depth=max_depth,
+            min_instances=min_instances,
+        )
+        contrib = _predict_heap_tree(
+            tree["feature"], tree["threshold_bin"], tree["prediction"],
+            binned, max_depth,
+        )
+        return F + learning_rate * contrib, tree
+
+    _, trees = jax.lax.scan(
+        body, jnp.zeros_like(y), None, length=rounds
+    )
+    return trees
+
+
 @partial(jax.jit, static_argnames=("max_depth",))
 def predict_forest(
     forest: Dict[str, jnp.ndarray],
@@ -338,22 +511,9 @@ def predict_forest(
     max_depth: int,
 ) -> jnp.ndarray:
     """(T trees, n samples) heap walk -> (n,) mean vote in [0, 1]."""
-
-    def one_tree(feature, thresh, pred):
-        node = jnp.zeros((binned.shape[0],), jnp.int32)
-        for _ in range(max_depth):
-            f = jnp.take(feature, node)
-            is_leaf = f < 0
-            sample_bin = jnp.take_along_axis(
-                binned, jnp.maximum(f, 0)[:, None].astype(jnp.int32), axis=1
-            )[:, 0]
-            go_right = (sample_bin > jnp.take(thresh, node)).astype(jnp.int32)
-            node = jnp.where(is_leaf, node, 2 * node + 1 + go_right)
-        return jnp.take(pred, node)
-
-    votes = jax.vmap(one_tree)(
-        forest["feature"], forest["threshold_bin"], forest["prediction"]
-    )
+    votes = jax.vmap(
+        lambda f, t, p: _predict_heap_tree(f, t, p, binned, max_depth)
+    )(forest["feature"], forest["threshold_bin"], forest["prediction"])
     return votes.mean(axis=0)
 
 

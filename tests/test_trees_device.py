@@ -232,3 +232,81 @@ def test_grow_forest_sharded_matches_unsharded():
             np.testing.assert_array_equal(
                 np.asarray(ref[k]), np.asarray(sharded[k]), err_msg=k
             )
+
+
+def test_regression_grower_matches_host_exactly():
+    """Quantized residuals (multiples of 1/64, f32-exact sums): the
+    device regression grower must choose the same splits and leaf
+    means as the host _grow_regression_tree."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(4)
+    n, d = 300, 5
+    x = rng.randn(n, d)
+    residual = rng.randint(-64, 65, size=n).astype(np.float64) / 64.0
+    edges = trees.compute_bin_edges(x, 16)
+    binned = trees.bin_features(x, edges)
+
+    host = trees._grow_regression_tree(binned, residual, 16, 4, 1)
+    host_arrays = host.to_arrays()
+    dev = trees_device._grow_one_reg(
+        jnp.asarray(binned, jnp.int32),
+        jnp.asarray(residual, jnp.float32),
+        max_bins=16,
+        max_depth=4,
+        min_instances=1,
+    )
+    dev_trees = trees_device.heap_to_host_arrays(
+        {k: np.asarray(v)[None] for k, v in dev.items()}
+    )
+    got = trees._predict_tree(dev_trees[0], binned)
+    want = trees._predict_tree(host_arrays, binned)
+    np.testing.assert_allclose(got, want, rtol=0, atol=1e-6)
+
+
+def test_gbt_device_matches_host_predictions():
+    """Few boosting rounds on clean data: gbt-tpu and host gbt agree
+    prediction-for-prediction (trajectel parity; the f32 device loop
+    may diverge on pathological ties only)."""
+    x, y = _toy(seed=9)
+    cfg = {
+        "config_num_iterations": "15",
+        "config_learning_rate": "0.2",
+        "config_max_depth": "3",
+    }
+    host = trees.GradientBoostedTreesClassifier()
+    host.set_config(cfg)
+    host.fit(x, y)
+    dev = trees.GradientBoostedTreesClassifier(backend="device")
+    dev.set_config(cfg)
+    dev.fit(x, y)
+    hp = host.predict(x)
+    dp = dev.predict(x)
+    assert (hp == dp).mean() >= 0.99
+    assert (dp == y).mean() >= 0.9  # it actually learned
+
+
+def test_gbt_tpu_registry_and_save_load(tmp_path):
+    x, y = _toy(seed=11)
+    clf = registry.create("gbt-tpu")
+    clf.set_config({})  # MLlib defaults: 100 rounds, lr 0.1, depth 3
+    clf.fit(x, y)
+    acc = (clf.predict(x) == y).mean()
+    assert acc >= 0.9
+    path = str(tmp_path / "gbt")
+    clf.save(path)
+    clf2 = registry.create("gbt")  # host class loads device-grown trees
+    clf2.load(path)
+    np.testing.assert_array_equal(clf2.predict(x), clf.predict(x))
+
+
+def test_gbt_device_rejects_deep_trees():
+    clf = trees.GradientBoostedTreesClassifier(backend="device")
+    clf.set_config({
+        "config_num_iterations": "2",
+        "config_learning_rate": "0.1",
+        "config_max_depth": "13",
+    })
+    x, y = _toy(n=50)
+    with pytest.raises(ValueError, match="max_depth"):
+        clf.fit(x, y)

@@ -163,5 +163,6 @@ def test_all_classifier_families_registered():
     # restored gbt (ClassifierTest.java:213) and the device-forest
     # -tpu variants
     assert registry.names() == [
-        "dt", "dt-tpu", "gbt", "logreg", "nn", "rf", "rf-tpu", "svm",
+        "dt", "dt-tpu", "gbt", "gbt-tpu", "logreg", "nn", "rf",
+        "rf-tpu", "svm",
     ]
