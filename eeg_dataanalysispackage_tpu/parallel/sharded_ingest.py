@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import shard_map
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..epochs.extractor import BalanceState
 from ..ops import device_ingest
@@ -154,6 +154,12 @@ def make_sharded_ingest(
             out_specs=P(axis, None, None),
         )
     )
+    # feature rows are tiny; allgather them to every host (a sharded
+    # global array spans non-addressable devices on multi-host runs,
+    # so the host fetches a replicated copy instead)
+    replicate = jax.jit(
+        lambda x: x, out_shardings=NamedSharding(mesh, P())
+    )
 
     def extract(raw_sharded, resolutions, plan: ShardedIngestPlan):
         T = raw_sharded.shape[1]
@@ -185,7 +191,8 @@ def make_sharded_ingest(
             jnp.asarray(plan.local_positions),
             jnp.asarray(plan.mask),
         )
-        flat = np.asarray(feats).reshape(-1, feats.shape[-1])
+        rep = replicate(feats)
+        flat = np.asarray(rep).reshape(-1, feats.shape[-1])
         return flat[plan.unsort]
 
     return extract
@@ -199,3 +206,15 @@ def stage_recording_int16(
     from . import streaming
 
     return streaming.stage_recording(signal, mesh, axis, dtype=jnp.int16)
+
+
+def stage_recording_local_int16(
+    local_block: np.ndarray, mesh: Mesh, axis: str = pmesh.TIME_AXIS
+):
+    """Multi-host twin of :func:`stage_recording_int16`: each process
+    stages only its contiguous time block, raw int16 on the wire."""
+    from . import streaming
+
+    return streaming.stage_recording_local(
+        local_block, mesh, axis, dtype=np.int16
+    )

@@ -311,7 +311,10 @@ def stage_recording(
 
 
 def stage_recording_local(
-    local_block: np.ndarray, mesh: Mesh, axis: str = pmesh.TIME_AXIS
+    local_block: np.ndarray,
+    mesh: Mesh,
+    axis: str = pmesh.TIME_AXIS,
+    dtype=np.float32,
 ):
     """Multi-host staging: per-process time block -> global recording.
 
@@ -320,11 +323,13 @@ def stage_recording_local(
     (C, T_total) array time-sharded over ``axis``, with the halo
     exchange of :func:`make_streaming_extractor` crossing process
     boundaries over DCN. Single-process this degenerates to
-    :func:`stage_recording`.
+    :func:`stage_recording`. ``dtype=np.int16`` ships raw recording
+    bytes (half the wire traffic; the sharded-ingest path scales on
+    device).
     """
     from . import distributed
 
     return distributed.stage_local(
         NamedSharding(mesh, P(None, axis)),
-        np.asarray(local_block, dtype=np.float32),
+        np.asarray(local_block, dtype=dtype),
     )

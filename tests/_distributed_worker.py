@@ -23,9 +23,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax.numpy as jnp
 import numpy as np
 
+from eeg_dataanalysispackage_tpu.io.brainvision import Marker
+from eeg_dataanalysispackage_tpu.ops import device_ingest
 from eeg_dataanalysispackage_tpu.parallel import (
     distributed,
     mesh as pmesh,
+    sharded_ingest,
     streaming,
     train as ptrain,
 )
@@ -81,6 +84,36 @@ def main() -> None:
     feats = extract(staged)
     stream_sum = float(jax.jit(jnp.sum)(feats))
 
+    # ---- sequence-parallel marker ingest: epoch windows straddling
+    # the process boundary read their tail over DCN ------------------
+    rng3 = np.random.RandomState(2)
+    T = 4 * 2048  # 4 time shards x 2048; processes own 2 shards each
+    raw_global = (rng3.randn(3, T) * 200).astype(np.int16)
+    res = np.full(3, 0.1, np.float32)
+    block = T // 4
+    positions = [500, block - 30, 2 * block - 5, 3 * block + 40]
+    markers = [
+        Marker(f"Mk{i}", "Stimulus", f"S  {1 + i % 9}", p)
+        for i, p in enumerate(positions)
+    ]
+    plan = sharded_ingest.plan_sharded_ingest(markers, 2, T, 4, block)
+    ing_extract = sharded_ingest.make_sharded_ingest(tmesh)
+    local_block = raw_global[:, 2 * block * pid : 2 * block * (pid + 1)]
+    staged_i16 = sharded_ingest.stage_recording_local_int16(
+        local_block, tmesh
+    )
+    ingest_feats = ing_extract(staged_i16, res, plan)
+    # both processes hold the full synthetic recording, so each can
+    # verify against the single-device block featurizer directly
+    base = device_ingest.plan_ingest(markers, 2, T)
+    ref = np.asarray(
+        device_ingest.make_block_ingest_featurizer()(
+            jnp.asarray(raw_global), jnp.asarray(res),
+            jnp.asarray(base.positions), jnp.asarray(base.mask),
+        )
+    )[base.mask]
+    ingest_dev = float(np.max(np.abs(ingest_feats - ref)))
+
     print(
         json.dumps(
             {
@@ -94,6 +127,8 @@ def main() -> None:
                 "loss": loss,
                 "stream_sum": stream_sum,
                 "stream_shape": list(feats.shape),
+                "ingest_dev": ingest_dev,
+                "ingest_rows": int(ingest_feats.shape[0]),
             }
         )
     )

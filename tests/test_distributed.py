@@ -195,3 +195,11 @@ def test_two_process_gloo_collectives():
     np.testing.assert_allclose(outs[0]["loss"], float(ref_loss), rtol=1e-5)
     assert outs[0]["stream_shape"] == list(ref_feats.shape) == [8, 32]
     np.testing.assert_allclose(outs[0]["stream_sum"], ref_sum, rtol=1e-5)
+
+    # sequence-parallel marker ingest: each worker verified the
+    # DCN-crossing halo against the single-device featurizer itself
+    for o in outs:
+        # 4 markers -> 3 kept (the order-dependent balance scan drops
+        # the last non-target once non-targets outnumber targets)
+        assert o["ingest_rows"] == 3
+        assert o["ingest_dev"] <= 5e-6, o["ingest_dev"]
