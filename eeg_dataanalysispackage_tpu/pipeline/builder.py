@@ -82,14 +82,17 @@ class PipelineBuilder:
         # rows directly. All other fe= values follow the reference
         # shape: epochs load first, the registry extractor maps them.
         # dwt-8-fused-pallas routes the same mode through the Pallas
-        # ingest kernel (ops/ingest_pallas.py)
-        fused = query_map.get("fe") in ("dwt-8-fused", "dwt-8-fused-pallas")
+        # ingest kernel (ops/ingest_pallas.py); dwt-8-fused-block
+        # through the tile-row-gather + 128-variant-bank formulation
+        # (device_ingest.make_block_ingest_featurizer)
+        _FUSED_BACKENDS = {
+            "dwt-8-fused": "xla",
+            "dwt-8-fused-pallas": "pallas",
+            "dwt-8-fused-block": "block",
+        }
+        fused = query_map.get("fe") in _FUSED_BACKENDS
         if fused:
-            backend = (
-                "pallas"
-                if query_map["fe"] == "dwt-8-fused-pallas"
-                else "xla"
-            )
+            backend = _FUSED_BACKENDS[query_map["fe"]]
             with self.timers.stage("ingest"):
                 features, targets = odp.load_features_device(backend=backend)
             fe = None

@@ -360,6 +360,21 @@ def test_fused_pallas_pipeline_query_mode(fixture_dir, tmp_path):
     assert "Accuracy:" in result.read_text()
 
 
+def test_fused_block_pipeline_query_mode(fixture_dir, tmp_path):
+    """fe=dwt-8-fused-block drives the whole query pipeline through
+    the block-gather ingest formulation."""
+    from eeg_dataanalysispackage_tpu.pipeline import builder
+
+    result = tmp_path / "result.txt"
+    q = (
+        f"info_file={fixture_dir}/infoTrain.txt&fe=dwt-8-fused-block"
+        f"&train_clf=logreg&result_path={result}"
+    )
+    stats = builder.PipelineBuilder(q).execute()
+    assert stats.num_patterns == 11 - int(0.7 * 11)
+    assert "Accuracy:" in result.read_text()
+
+
 def test_provider_rejects_unknown_backend(fixture_dir):
     from eeg_dataanalysispackage_tpu.io import provider
 
