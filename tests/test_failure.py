@@ -165,3 +165,68 @@ def test_elastic_train_restarts_skip_checkpointed_steps(tmp_path):
     # 5 good calls + 1 crashing call, then resume from step 4: steps
     # 5..8 replay (4 calls) — total 10, not 14
     assert len(executed) == 10
+
+
+def test_elastic_raw_stream_training_end_to_end(tmp_path):
+    """The subsystems compose: elastic_train drives
+    make_raw_train_step (fused int16 ingest -> MLP update) across an
+    injected transient crash, resuming from checkpoints, and lands on
+    the same state as an uninterrupted run."""
+    import jax
+
+    from eeg_dataanalysispackage_tpu.parallel import train as ptrain
+
+    rng = np.random.RandomState(0)
+    n, stride, first = 16, 800, 150
+    S = 200 + n * stride + 8192
+    res = np.array([0.1, 0.1, 0.2], np.float32)
+    batches = []
+    for b in range(6):
+        raw = rng.randint(-3000, 3000, size=(3, S)).astype(np.int16)
+        labels = rng.randint(0, 2, size=n).astype(np.float32)
+        batches.append(
+            (
+                jnp.asarray(raw),
+                jnp.asarray(res),
+                jnp.asarray(labels),
+                jnp.ones((n,), jnp.float32),
+                first,
+            )
+        )
+
+    init_state, raw_step = ptrain.make_raw_train_step(stride, n)
+    init = lambda: init_state(jax.random.PRNGKey(0))
+
+    ref_mgr = CheckpointManager(str(tmp_path / "ref"))
+    ref_state, ref_last = run_resumable(
+        ref_mgr, init, raw_step, list(batches), save_every=1
+    )
+
+    calls = {"n": 0}
+    crashed = set()
+
+    def flaky_step(state, *batch):
+        calls["n"] += 1
+        if calls["n"] == 4 and 4 not in crashed:
+            crashed.add(4)
+            raise RuntimeError("injected fault")
+        return raw_step(state, *batch)
+
+    mgr = CheckpointManager(str(tmp_path / "flaky"))
+    state, last, restarts = failure.elastic_train(
+        mgr,
+        init,
+        flaky_step,
+        lambda: list(batches),
+        max_restarts=3,
+        save_every=1,
+        probe_on_failure=False,
+    )
+    assert restarts == 1
+    assert last == ref_last == 6
+    for k in ("w0", "b0", "w1", "b1"):
+        np.testing.assert_allclose(
+            np.asarray(state["params"][k]),
+            np.asarray(ref_state["params"][k]),
+            atol=1e-6,
+        )
