@@ -63,6 +63,31 @@ STRIDE = 750  # irregular-marker mean spacing (samples at 1 kHz)
 REGULAR_STRIDE = 800  # fixed-SOA paradigm
 
 
+def _gather_reference_rows(raw_spot, res, spot):
+    """Reference feature rows for a parity spot check: the first
+    ``len(spot)`` markers through the gather featurizer. Returns
+    (want (len(spot), 48), pos_pad, mask) — handles len(spot) < 64.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    from eeg_dataanalysispackage_tpu.ops import device_ingest
+
+    cap = max(64, len(spot))
+    pos_pad = np.zeros(cap, np.int32)
+    pos_pad[: len(spot)] = spot
+    mask = np.zeros(cap, bool)
+    mask[: len(spot)] = True
+    ref = device_ingest.make_device_ingest_featurizer()
+    want = np.asarray(
+        ref(
+            jnp.asarray(raw_spot), jnp.asarray(res),
+            jnp.asarray(pos_pad), jnp.asarray(mask),
+        )
+    )[: len(spot)]
+    return want, pos_pad, mask
+
+
 def run(variant: str, n: int, iters: int) -> dict:
     import jax
     import jax.numpy as jnp
@@ -173,6 +198,30 @@ def run(variant: str, n: int, iters: int) -> dict:
                 if variant == "xla_ingest"
                 else device_ingest.make_block_ingest_featurizer()
             )
+            if variant == "block_ingest":
+                # on-device parity spot check before timing (same
+                # contract as the pallas variant): the first markers
+                # must match the gather formulation
+                spot = positions[:64]
+                raw_spot = np.pad(
+                    raw[:, : int(spot.max()) + 2048], ((0, 0), (0, 2048))
+                )
+                want, pos_pad, spot_mask = _gather_reference_rows(
+                    raw_spot, res, spot
+                )
+                got = np.asarray(
+                    feat(
+                        jnp.asarray(raw_spot), jnp.asarray(res),
+                        jnp.asarray(pos_pad), jnp.asarray(spot_mask),
+                    )
+                )[: len(spot)]
+                block_parity = float(np.max(np.abs(got - want)))
+                if not (block_parity <= 5e-5):
+                    raise RuntimeError(
+                        f"block/gather ingest parity failed on device: "
+                        f"max abs dev {block_parity} — refusing to "
+                        "publish a throughput number"
+                    )
             cap = ((n + 63) // 64) * 64
             pos_pad = np.zeros(cap, np.int32)
             pos_pad[:n] = positions
@@ -238,17 +287,7 @@ def run(variant: str, n: int, iters: int) -> dict:
                     raw_spot, res, spot, chunk=chunk, tile_b=tile_b,
                 )
             )
-            feat_ref = device_ingest.make_device_ingest_featurizer()
-            pos_pad = np.zeros(64, np.int32)
-            pos_pad[: len(spot)] = spot
-            spot_mask = np.zeros(64, bool)
-            spot_mask[: len(spot)] = True
-            want = np.asarray(
-                feat_ref(
-                    jnp.asarray(raw_spot), jnp.asarray(res),
-                    jnp.asarray(pos_pad), jnp.asarray(spot_mask),
-                )
-            )[: len(spot)]
+            want, _, _ = _gather_reference_rows(raw_spot, res, spot)
             parity_dev = float(np.max(np.abs(got - want)))
             if not (parity_dev <= 5e-6):
                 raise RuntimeError(
@@ -431,6 +470,8 @@ def run(variant: str, n: int, iters: int) -> dict:
         payload["tile_fill"] = round(fill, 3)
         # a failed check raised above, so a published number is valid
         payload["parity_max_abs_dev"] = parity_dev
+    if variant == "block_ingest":
+        payload["parity_max_abs_dev"] = block_parity
     if variant in ("regular_ingest", "train_step_raw"):
         from eeg_dataanalysispackage_tpu.ops import device_ingest
 

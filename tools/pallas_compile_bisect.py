@@ -1,0 +1,166 @@
+"""Bisect which Pallas construct crashes the axon remote compile helper.
+
+Each candidate kernel is tiny (fast compiles) and compiled+run in
+sequence; every step prints ok/error so the first failing feature is
+identifiable. All state is per-step; a crash in compile raises, it
+does not kill the process.
+"""
+import json
+import os
+import sys
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+print("platform:", jax.devices()[0].platform, flush=True)
+
+CH, HALF, TILE_B, WIN, PRE = 3, 1024, 4, 792, 100
+CHUNK = 2 * HALF
+
+
+def step(name, fn):
+    try:
+        out = fn()
+        print(json.dumps({"step": name, "ok": True,
+                          "sum": float(np.asarray(out).sum())}), flush=True)
+    except Exception as e:
+        msg = f"{type(e).__name__}: {e}"
+        print(json.dumps({"step": name, "ok": False,
+                          "error": msg[:500]}), flush=True)
+
+
+# k0: trivial copy kernel, plain grid
+def k0():
+    def kernel(x_ref, o_ref):
+        o_ref[:] = x_ref[:] * 2.0
+    x = jnp.ones((8, 128), jnp.float32)
+    return pl.pallas_call(
+        kernel, out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32)
+    )(x)
+
+
+# k1: PrefetchScalarGridSpec, scalar-prefetch-driven block index
+def k1():
+    def kernel(idx_ref, x_ref, o_ref):
+        o_ref[:] = x_ref[:] + idx_ref[pl.program_id(0)].astype(jnp.float32)
+    idx = jnp.arange(4, dtype=jnp.int32)
+    x = jnp.ones((4 * 8, 128), jnp.float32)
+    gs = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1, grid=(4,),
+        in_specs=[pl.BlockSpec((8, 128), lambda i, idx: (idx[i], 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i, idx: (i, 0)),
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=gs,
+        out_shape=jax.ShapeDtypeStruct((4 * 8, 128), jnp.float32),
+    )(idx, x)
+
+
+# k2: int16 input block -> f32
+def k2():
+    def kernel(x_ref, o_ref):
+        o_ref[:] = x_ref[:].astype(jnp.float32) * 0.5
+    x = jnp.ones((8, 128), jnp.int16)
+    return pl.pallas_call(
+        kernel, out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32)
+    )(x)
+
+
+# k3: f32 VMEM scratch, halves assignment (C rows like the real kernel)
+def k3():
+    def kernel(a_ref, b_ref, o_ref, chunk_ref):
+        chunk_ref[:, :HALF] = a_ref[:].astype(jnp.float32)
+        chunk_ref[:, HALF:] = b_ref[:].astype(jnp.float32)
+        o_ref[:] = chunk_ref[:, :128]
+    a = jnp.ones((CH, HALF), jnp.int16)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((CH, 128), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((CH, CHUNK), jnp.float32)],
+    )(a, a)
+
+
+# k4: dynamic lane slice with a traced (SMEM scalar) offset
+def k4():
+    def kernel(off_ref, x_ref, o_ref):
+        off = off_ref[0]
+        o_ref[:] = x_ref[:, pl.ds(off, 128)]
+    off = jnp.array([37], jnp.int32)
+    x = jnp.ones((8, 1024), jnp.float32)
+    gs = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1, grid=(1,),
+        in_specs=[pl.BlockSpec((8, 1024), lambda i, off: (0, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i, off: (0, 0)),
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=gs,
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+    )(off, x)
+
+
+# k5: loop of dynamic lane slices (WIN=792 wide) + scratch stores
+def k5():
+    def kernel(offs_ref, x_ref, o_ref, xa_ref):
+        for e in range(TILE_B):
+            off = offs_ref[e]
+            seg = x_ref[:, pl.ds(off, WIN)]
+            base = jnp.mean(seg[:, :PRE], axis=1, keepdims=True)
+            xa_ref[e * CH:(e + 1) * CH, :] = seg - base
+        o_ref[:] = xa_ref[:]
+    offs = jnp.array([0, 11, 23, 800], jnp.int32)
+    x = jnp.ones((CH, CHUNK), jnp.float32)
+    gs = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1, grid=(1,),
+        in_specs=[pl.BlockSpec((CH, CHUNK), lambda i, offs: (0, 0))],
+        out_specs=pl.BlockSpec((TILE_B * CH, WIN), lambda i, offs: (0, 0)),
+        scratch_shapes=[pltpu.VMEM((TILE_B * CH, WIN), jnp.float32)],
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=gs,
+        out_shape=jax.ShapeDtypeStruct((TILE_B * CH, WIN), jnp.float32),
+    )(offs, x)
+
+
+# k6: dot_general HIGHEST from scratch operand
+def k6():
+    def kernel(x_ref, e_ref, o_ref):
+        y = lax.dot_general(
+            x_ref[:], e_ref[:], (((1,), (0,)), ((), ())),
+            precision=lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        )
+        o_ref[:] = y
+    x = jnp.ones((TILE_B * CH, WIN), jnp.float32)
+    E = jnp.ones((WIN, 16), jnp.float32)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((TILE_B * CH, 16), jnp.float32),
+    )(x, E)
+
+
+# k7: the real _ingest_tiles on tiny shapes
+def k7():
+    from eeg_dataanalysispackage_tpu.ops import ingest_pallas, device_ingest
+    raw = np.ones((CH, 8 * CHUNK), np.int16)
+    res = np.array([0.1, 0.1, 0.2], np.float32)
+    E = jnp.asarray(device_ingest.ingest_matrix(
+        window_len=WIN, fold_baseline=False))
+    plan = ingest_pallas.plan_pallas_tiles(
+        np.array([100, 900, 1700]), window=WIN, chunk=CHUNK, tile_b=TILE_B)
+    return ingest_pallas._ingest_tiles(
+        jnp.asarray(raw), jnp.asarray(res), jnp.asarray(plan.half_idx),
+        jnp.asarray(plan.offsets), E, tile_b=TILE_B, chunk=CHUNK,
+        window=WIN, feature_size=16, interpret=False)
+
+
+for name, fn in [("k0_copy", k0), ("k1_prefetch", k1), ("k2_int16", k2),
+                 ("k3_scratch_halves", k3), ("k4_dyn_lane_slice", k4),
+                 ("k5_slice_loop", k5), ("k6_dot_highest", k6),
+                 ("k7_full_tiny", k7)]:
+    step(name, fn)
+print("done", flush=True)

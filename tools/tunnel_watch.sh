@@ -46,4 +46,8 @@ BENCH_FORMULATION=reshape run regular_reshape 900 \
   python tools/ingest_bench.py regular_ingest 262144 20
 run einsum 600 python tools/ingest_bench.py einsum 262144 50
 run bench_full 1800 python bench.py
+# LAST, after every measurement is safely on disk: the bisect probes
+# the construct that crashes the remote compiler, and a helper crash
+# may re-wedge the tunnel — nothing of value runs after it
+run pallas_bisect 900 python tools/pallas_compile_bisect.py
 log "collection complete"
