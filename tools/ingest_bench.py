@@ -63,6 +63,20 @@ STRIDE = 750  # irregular-marker mean spacing (samples at 1 kHz)
 REGULAR_STRIDE = 800  # fixed-SOA paradigm
 
 
+def _check_parity(got, want, tol: float, label: str) -> float:
+    """max-abs-dev gate shared by the parity-checked variants: a
+    miscompiled/miswired fast path must never publish a number."""
+    import numpy as np
+
+    dev = float(np.max(np.abs(got - want)))
+    if not (dev <= tol):
+        raise RuntimeError(
+            f"{label} ingest parity failed on device: max abs dev "
+            f"{dev} — refusing to publish a throughput number"
+        )
+    return dev
+
+
 def _gather_reference_rows(raw_spot, res, spot):
     """Reference feature rows for a parity spot check: the first
     ``len(spot)`` markers through the gather featurizer. Returns
@@ -215,13 +229,7 @@ def run(variant: str, n: int, iters: int) -> dict:
                         jnp.asarray(pos_pad), jnp.asarray(spot_mask),
                     )
                 )[: len(spot)]
-                block_parity = float(np.max(np.abs(got - want)))
-                if not (block_parity <= 5e-5):
-                    raise RuntimeError(
-                        f"block/gather ingest parity failed on device: "
-                        f"max abs dev {block_parity} — refusing to "
-                        "publish a throughput number"
-                    )
+                block_parity = _check_parity(got, want, 5e-5, "block/gather")
             cap = ((n + 63) // 64) * 64
             pos_pad = np.zeros(cap, np.int32)
             pos_pad[:n] = positions
@@ -288,13 +296,7 @@ def run(variant: str, n: int, iters: int) -> dict:
                 )
             )
             want, _, _ = _gather_reference_rows(raw_spot, res, spot)
-            parity_dev = float(np.max(np.abs(got - want)))
-            if not (parity_dev <= 5e-6):
-                raise RuntimeError(
-                    f"pallas/XLA ingest parity failed on device: "
-                    f"max abs dev {parity_dev} — refusing to publish "
-                    "a throughput number for a miscompiled kernel"
-                )
+            parity_dev = _check_parity(got, want, 5e-6, "pallas/XLA")
 
             @jax.jit
             def loop(raw_a, res_a, hi, offs, E_a):
@@ -466,11 +468,11 @@ def run(variant: str, n: int, iters: int) -> dict:
         "pct_of_hbm_roofline": round(100.0 * gbps / HBM_GBPS, 1),
         "platform": jax.devices()[0].platform,
     }
+    # a failed _check_parity raised above, so published numbers are valid
     if variant == "pallas_ingest":
         payload["tile_fill"] = round(fill, 3)
-        # a failed check raised above, so a published number is valid
         payload["parity_max_abs_dev"] = parity_dev
-    if variant == "block_ingest":
+    elif variant == "block_ingest":
         payload["parity_max_abs_dev"] = block_parity
     if variant in ("regular_ingest", "train_step_raw"):
         from eeg_dataanalysispackage_tpu.ops import device_ingest
