@@ -13,6 +13,7 @@ by one jitted program, classifiers consume whole batches.
 from __future__ import annotations
 
 import logging
+import re
 from typing import Dict, Optional
 
 
@@ -81,20 +82,24 @@ class PipelineBuilder:
         # host epoch batch ever exists and classifiers consume feature
         # rows directly. All other fe= values follow the reference
         # shape: epochs load first, the registry extractor maps them.
-        # dwt-8-fused-pallas routes the same mode through the Pallas
-        # ingest kernel (ops/ingest_pallas.py); dwt-8-fused-block
+        # dwt-<i>-fused-pallas routes the same mode through the Pallas
+        # ingest kernel (ops/ingest_pallas.py); dwt-<i>-fused-block
         # through the tile-row-gather + 128-variant-bank formulation
-        # (device_ingest.make_block_ingest_featurizer)
-        _FUSED_BACKENDS = {
-            "dwt-8-fused": "xla",
-            "dwt-8-fused-pallas": "pallas",
-            "dwt-8-fused-block": "block",
-        }
-        fused = query_map.get("fe") in _FUSED_BACKENDS
+        # (device_ingest.make_block_ingest_featurizer). Any registry
+        # wavelet index works, like the host fe= family.
+        fused_match = re.fullmatch(
+            r"dwt-(\d+)-fused(-pallas|-block)?", query_map.get("fe", "")
+        )
+        fused = fused_match is not None
         if fused:
-            backend = _FUSED_BACKENDS[query_map["fe"]]
+            wavelet_index = int(fused_match.group(1))
+            backend = {
+                None: "xla", "-pallas": "pallas", "-block": "block",
+            }[fused_match.group(2)]
             with self.timers.stage("ingest"):
-                features, targets = odp.load_features_device(backend=backend)
+                features, targets = odp.load_features_device(
+                    wavelet_index=wavelet_index, backend=backend
+                )
             fe = None
             n = len(targets)
         else:

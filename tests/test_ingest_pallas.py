@@ -375,6 +375,34 @@ def test_fused_block_pipeline_query_mode(fixture_dir, tmp_path):
     assert "Accuracy:" in result.read_text()
 
 
+def test_fused_generic_wavelet_index(fixture_dir, tmp_path):
+    """The fused modes accept any registry wavelet (dwt-<i>-fused*),
+    like the host fe= family; features match the host extractor for
+    the same index to device-path tolerance."""
+    from eeg_dataanalysispackage_tpu.features import wavelet
+    from eeg_dataanalysispackage_tpu.io import provider
+
+    odp = provider.OfflineDataProvider([fixture_dir + "/infoTrain.txt"])
+    f4, _ = odp.load_features_device(wavelet_index=4)
+    batch = provider.OfflineDataProvider(
+        [fixture_dir + "/infoTrain.txt"]
+    ).load()
+    wt = wavelet.WaveletTransform(4, 512, 175, 16)
+    host = np.stack(
+        [wt.extract_features(e) for e in np.asarray(batch.epochs)]
+    )
+    np.testing.assert_allclose(f4, host, rtol=0, atol=5e-4)
+
+    from eeg_dataanalysispackage_tpu.pipeline import builder
+
+    result = tmp_path / "r.txt"
+    stats = builder.PipelineBuilder(
+        f"info_file={fixture_dir}/infoTrain.txt&fe=dwt-4-fused-block"
+        f"&train_clf=logreg&result_path={result}"
+    ).execute()
+    assert stats.num_patterns == 4
+
+
 def test_provider_rejects_unknown_backend(fixture_dir):
     from eeg_dataanalysispackage_tpu.io import provider
 
