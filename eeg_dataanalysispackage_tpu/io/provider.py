@@ -163,7 +163,7 @@ class OfflineDataProvider:
                 feature_size=feature_size,
                 pre=self._pre,
             )
-        else:
+        elif backend == "xla":
             featurizer = device_ingest.make_device_ingest_featurizer(
                 wavelet_index=wavelet_index,
                 epoch_size=epoch_size,
