@@ -212,6 +212,9 @@ class DecisionTreeClassifier(base.Classifier):
         self.trees: List[Dict[str, np.ndarray]] = []
         self.edges: Optional[np.ndarray] = None
         self._params: Dict = {}
+        # packed (T, n_nodes) device arrays for predict_linked_forest,
+        # built lazily and invalidated whenever self.trees changes
+        self._device_pack = None
 
     # MLlib Strategy.defaultStrategy("Classification") values
     def _tree_params(self) -> Dict:
@@ -234,6 +237,7 @@ class DecisionTreeClassifier(base.Classifier):
     def fit(self, features: np.ndarray, labels: np.ndarray) -> None:
         p = self._tree_params()
         self._params = p
+        self._device_pack = None
         y = np.floor(np.asarray(labels, dtype=np.float64) + 0.5).astype(np.int64)
         self.edges = compute_bin_edges(features, p["max_bins"])
         binned = bin_features(features, self.edges)
@@ -303,7 +307,28 @@ class DecisionTreeClassifier(base.Classifier):
         if not self.trees or self.edges is None:
             raise ValueError("model not trained or loaded")
         binned = bin_features(np.asarray(features, dtype=np.float64), self.edges)
-        votes = np.stack([_predict_tree(t, binned) for t in self.trees])
+        if self.config.get("config_backend", self.backend) == "device":
+            # whole-forest inference as one XLA program; votes are
+            # 0/1 so the f32 mean is exact for any practical T
+            import jax.numpy as jnp
+
+            from . import trees_device
+
+            if self._device_pack is None:
+                self._device_pack = trees_device.host_trees_to_device(
+                    self.trees
+                )
+            votes = np.asarray(
+                trees_device.predict_linked_forest(
+                    *self._device_pack,
+                    jnp.asarray(binned, jnp.int32),
+                    max_iters=int(self._params["max_depth"]),
+                )
+            )
+        else:
+            votes = np.stack(
+                [_predict_tree(t, binned) for t in self.trees]
+            )
         return (votes.mean(axis=0) > 0.5).astype(np.float64)
 
     # -- persistence (file:// prefix tolerated like the reference) -----
@@ -358,6 +383,7 @@ class DecisionTreeClassifier(base.Classifier):
         self._params = meta["params"]
         self.config = meta["config"]
         self.edges = data["edges"]
+        self._device_pack = None
         self.trees = [
             {
                 k: data[f"tree{i}_{k}"]
@@ -510,6 +536,7 @@ class GradientBoostedTreesClassifier(DecisionTreeClassifier):
         p = self._boost_params()
         bp = {"max_bins": 32, "min_instances": 1}
         self._params = {**p, **bp}
+        self._device_pack = None
         y = np.floor(np.asarray(labels, dtype=np.float64) + 0.5)
         self.edges = compute_bin_edges(features, bp["max_bins"])
         binned = bin_features(features, self.edges)

@@ -517,6 +517,67 @@ def predict_forest(
     return votes.mean(axis=0)
 
 
+@partial(jax.jit, static_argnames=("max_iters",))
+def predict_linked_forest(
+    feature: jnp.ndarray,  # (T, n_nodes) int32, -1 = leaf
+    thresh: jnp.ndarray,  # (T, n_nodes) int32
+    left: jnp.ndarray,  # (T, n_nodes) int32
+    right: jnp.ndarray,  # (T, n_nodes) int32
+    pred: jnp.ndarray,  # (T, n_nodes) f32
+    binned: jnp.ndarray,  # (n, d) int32
+    max_iters: int = 64,
+) -> jnp.ndarray:
+    """(T, n) leaf values for explicit-link trees (the host storage
+    format, `trees._Tree.to_arrays`) — device inference for forests
+    of ANY origin, including host-grown/loaded ones where the heap
+    walk of :func:`predict_forest` does not apply. ``max_iters``
+    bounds the walk like the host `_predict_tree`'s depth bound."""
+    n = binned.shape[0]
+
+    def one(f, t, l, r, p):
+        def body(node, _):
+            fo = jnp.take(f, node)
+            is_leaf = fo < 0
+            sample_bin = jnp.take_along_axis(
+                binned, jnp.maximum(fo, 0)[:, None].astype(jnp.int32),
+                axis=1,
+            )[:, 0]
+            go_left = sample_bin <= jnp.take(t, node)
+            nxt = jnp.where(
+                go_left, jnp.take(l, node), jnp.take(r, node)
+            )
+            return jnp.where(is_leaf, node, nxt), None
+
+        node, _ = jax.lax.scan(
+            body, jnp.zeros((n,), jnp.int32), None, length=max_iters
+        )
+        return jnp.take(p, node)
+
+    return jax.vmap(one)(feature, thresh, left, right, pred)
+
+
+def host_trees_to_device(trees: list):
+    """Pad a list of host-format tree dicts to one (T, n_nodes) array
+    set for :func:`predict_linked_forest` (padding nodes are leaves
+    predicting 0 and are unreachable from the root)."""
+    n_nodes = max(t["feature"].shape[0] for t in trees)
+
+    def pad(key, fill, dtype):
+        out = np.full((len(trees), n_nodes), fill, dtype)
+        for i, t in enumerate(trees):
+            arr = np.asarray(t[key])
+            out[i, : arr.shape[0]] = arr
+        return jnp.asarray(out)
+
+    return (
+        pad("feature", -1, np.int32),
+        pad("threshold_bin", -1, np.int32),
+        pad("left", -1, np.int32),
+        pad("right", -1, np.int32),
+        pad("prediction", 0.0, np.float32),
+    )
+
+
 def heap_to_host_arrays(forest: Dict[str, jnp.ndarray]) -> list:
     """Device heap forest -> the host path's per-tree array dicts
     (explicit left/right links), so persistence and the host

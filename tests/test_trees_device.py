@@ -310,3 +310,64 @@ def test_gbt_device_rejects_deep_trees():
     x, y = _toy(n=50)
     with pytest.raises(ValueError, match="max_depth"):
         clf.fit(x, y)
+
+
+def test_device_linked_predict_matches_host_walk():
+    """predict_linked_forest on the host tree format == the host
+    per-tree walk, for both device- and host-grown forests."""
+    import jax.numpy as jnp
+
+    x, y = _toy(seed=13)
+    for backend in ("host", "device"):
+        clf = trees.RandomForestClassifier(backend=backend)
+        clf.set_config({
+            "config_max_bins": "16", "config_impurity": "gini",
+            "config_max_depth": "4",
+            "config_min_instances_per_node": "1",
+            "config_num_trees": "9", "config_feature_subset": "all",
+        })
+        clf.fit(x, y)
+        binned = trees.bin_features(x, clf.edges)
+        votes_dev = np.asarray(
+            trees_device.predict_linked_forest(
+                *trees_device.host_trees_to_device(clf.trees),
+                jnp.asarray(binned, jnp.int32),
+            )
+        )
+        votes_host = np.stack(
+            [trees._predict_tree(t, binned) for t in clf.trees]
+        )
+        np.testing.assert_array_equal(votes_dev, votes_host)
+
+
+def test_rf_tpu_predict_routes_through_device(monkeypatch):
+    """rf-tpu fit+predict agrees with the host forest walk of the
+    same trees AND actually takes the device inference path (a
+    routing regression would otherwise pass silently — both branches
+    walk the same trees)."""
+    x, y = _toy(seed=14)
+    clf = registry.create("rf-tpu")
+    clf.set_config({
+        "config_max_bins": "16", "config_impurity": "gini",
+        "config_max_depth": "4", "config_min_instances_per_node": "1",
+        "config_num_trees": "7", "config_feature_subset": "all",
+    })
+    clf.fit(x, y)
+    calls = {"n": 0}
+    real = trees_device.predict_linked_forest
+
+    def spy(*args, **kwargs):
+        calls["n"] += 1
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(trees_device, "predict_linked_forest", spy)
+    got = clf.predict(x)
+    assert calls["n"] == 1, "rf-tpu predict did not take the device path"
+    binned = trees.bin_features(x, clf.edges)
+    votes = np.stack([trees._predict_tree(t, binned) for t in clf.trees])
+    want = (votes.mean(axis=0) > 0.5).astype(np.float64)
+    np.testing.assert_array_equal(got, want)
+    # the packed forest is cached: a second predict re-uses it
+    clf.predict(x)
+    assert calls["n"] == 2
+    assert clf._device_pack is not None

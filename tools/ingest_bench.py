@@ -38,6 +38,8 @@ Variants:
                   (models/trees_device.py): 100 trees, depth 5,
                   32 bins over n rows x 48 binned features;
                   epochs_per_s = rows through the full forest growth
+  rf_predict      whole-forest device inference
+                  (predict_linked_forest): rows/s through 100 trees
 
 Prints one JSON line: {"variant", "epochs_per_s", "bytes_per_epoch",
 "pct_of_hbm_roofline", ...}. Run each variant in its own process (the
@@ -439,6 +441,40 @@ def run(variant: str, n: int, iters: int) -> dict:
                     min_instances=1,
                 )
                 return acc + forest["prediction"].sum(), None
+
+            acc, _ = jax.lax.scan(body, jnp.float32(0), jnp.arange(iters))
+            return acc
+
+        arg = args
+
+    elif variant == "rf_predict":
+        from eeg_dataanalysispackage_tpu.models import trees, trees_device
+
+        T, depth, bins = 100, 5, 32
+        feats = rng.randn(4096, 48)
+        labels = (feats[:, 0] + 0.3 * rng.randn(4096) > 0).astype(np.int32)
+        clf = trees.RandomForestClassifier(backend="device")
+        clf.set_config({})
+        clf.fit(feats, labels.astype(np.float64))
+        test_feats = rng.randn(n, 48)
+        binned = jnp.asarray(
+            trees.bin_features(test_feats, clf.edges), jnp.int32
+        )
+        packed = trees_device.host_trees_to_device(clf.trees)
+        # per-row forest traffic: each tree's walk gathers one bin
+        # per level from the (n, 48) int32 row
+        bytes_per_epoch = T * depth * 4
+        args = (*packed, binned)
+
+        @jax.jit
+        def loop(f, t, l, r, p, b):
+            def body(acc, i):
+                votes = trees_device.predict_linked_forest(
+                    f, t, l, r, p,
+                    (b + (i % 2).astype(jnp.int32)) % bins,
+                    max_iters=depth,  # bench walks what it bills
+                )
+                return acc + votes.sum(), None
 
             acc, _ = jax.lax.scan(body, jnp.float32(0), jnp.arange(iters))
             return acc

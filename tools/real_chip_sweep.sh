@@ -49,5 +49,6 @@ run regular_phase 900 python tools/ingest_bench.py regular_ingest 262144 20
 BENCH_FORMULATION=conv \
 run regular_conv  900 python tools/ingest_bench.py regular_ingest 262144 20
 run rf_train      900 python tools/ingest_bench.py rf_train 65536 3
+run rf_predict    600 python tools/ingest_bench.py rf_predict 262144 10
 run train_raw     900 python tools/ingest_bench.py train_step_raw 131072 20
 echo "sweep done"
