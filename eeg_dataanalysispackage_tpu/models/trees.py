@@ -216,6 +216,16 @@ class DecisionTreeClassifier(base.Classifier):
         # built lazily and invalidated whenever self.trees changes
         self._device_pack = None
 
+    def _resolved_backend(self) -> str:
+        """The run's backend: ``config_backend`` overrides the ctor
+        choice; invalid values raise here, so every consumer (fit and
+        predict alike) fails loudly instead of silently routing to
+        the host path."""
+        backend = self.config.get("config_backend", self.backend)
+        if backend not in ("host", "device"):
+            raise ValueError(f"unknown tree backend: {backend!r}")
+        return backend
+
     # MLlib Strategy.defaultStrategy("Classification") values
     def _tree_params(self) -> Dict:
         c = self.config
@@ -241,10 +251,7 @@ class DecisionTreeClassifier(base.Classifier):
         y = np.floor(np.asarray(labels, dtype=np.float64) + 0.5).astype(np.int64)
         self.edges = compute_bin_edges(features, p["max_bins"])
         binned = bin_features(features, self.edges)
-        backend = self.config.get("config_backend", self.backend)
-        if backend not in ("host", "device"):
-            raise ValueError(f"unknown tree backend: {backend!r}")
-        if backend == "device":
+        if self._resolved_backend() == "device":
             self._fit_device(binned, y, p)
             return
         rng = np.random.RandomState(12345)  # RandomForestClassifier.java:104
@@ -307,7 +314,7 @@ class DecisionTreeClassifier(base.Classifier):
         if not self.trees or self.edges is None:
             raise ValueError("model not trained or loaded")
         binned = bin_features(np.asarray(features, dtype=np.float64), self.edges)
-        if self.config.get("config_backend", self.backend) == "device":
+        if self._resolved_backend() == "device":
             # whole-forest inference as one XLA program; votes are
             # 0/1 so the f32 mean is exact for any practical T
             import jax.numpy as jnp
@@ -540,10 +547,7 @@ class GradientBoostedTreesClassifier(DecisionTreeClassifier):
         y = np.floor(np.asarray(labels, dtype=np.float64) + 0.5)
         self.edges = compute_bin_edges(features, bp["max_bins"])
         binned = bin_features(features, self.edges)
-        backend = self.config.get("config_backend", self.backend)
-        if backend not in ("host", "device"):
-            raise ValueError(f"unknown tree backend: {backend!r}")
-        if backend == "device":
+        if self._resolved_backend() == "device":
             self._fit_device_boost(binned, y, p, bp)
             return
         F = np.zeros(len(y), dtype=np.float64)

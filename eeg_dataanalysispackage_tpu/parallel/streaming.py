@@ -130,6 +130,7 @@ def make_streaming_extractor(
     wavelet_index: int = 8,
     feature_count: int = 16,
     axis: str = pmesh.TIME_AXIS,
+    resolutions=None,
 ):
     """Build a jitted (C, T)->(n_windows, C*feature_count) extractor
     with T sharded over ``axis`` of ``mesh``.
@@ -139,6 +140,13 @@ def make_streaming_extractor(
     recording wrap into the first block (periodic over the ring) —
     callers either arrange T as a multiple of the window or drop the
     last ``window//stride`` rows.
+
+    int16 recordings may be staged raw (``stage_recording(...,
+    dtype=jnp.int16)`` / ``stage_recording_local(..., dtype=
+    np.int16)`` — half the host->device and DCN staging bytes); the
+    scale to physical units happens on device via per-channel
+    ``resolutions`` (default 1.0), exactly like the single-device
+    ``iter_blocked_features`` path.
     """
     if not 0 < stride <= window:
         raise ValueError(f"stride {stride} must be in (0, window={window}]")
@@ -146,8 +154,14 @@ def make_streaming_extractor(
         window, wavelet_index, feature_count, fs, tuple(band)
     )
     n_shards = mesh.shape[axis]
+    res_np = (
+        None
+        if resolutions is None
+        else np.asarray(resolutions, dtype=np.float32)
+    )
 
     def block_fn(x_block):  # (C, B) on each device
+        x_block = _scale_block(x_block, res_np)
         # windows start at 0, stride, ..., B-stride; the last one ends
         # at B - stride + window, so only window - stride halo samples
         # are ever read from the right neighbor
@@ -195,6 +209,16 @@ def make_streaming_extractor(
     return extract
 
 
+def _scale_block(x, resolutions):
+    """The ONE cast+scale step every streaming path runs: float32
+    compute dtype (int16 ships raw, f64 does not silently upcast the
+    pipeline), optional per-channel resolutions."""
+    x = x.astype(jnp.float32)
+    if resolutions is not None:
+        x = x * jnp.asarray(resolutions, jnp.float32)[:, None]
+    return x
+
+
 @functools.partial(jax.jit, static_argnums=(1, 2))
 def _chunk_features(chunk, window, stride, kernel, resolutions):
     """(C, block+halo) chunk -> (block//stride, C*feature_count).
@@ -203,8 +227,9 @@ def _chunk_features(chunk, window, stride, kernel, resolutions):
     as in ops/device_ingest) or float; per-channel ``resolutions``
     scale on device.
     """
-    scaled = chunk.astype(jnp.float32) * resolutions[:, None]
-    return _windowed_pipeline(scaled, window, stride, kernel)
+    return _windowed_pipeline(
+        _scale_block(chunk, resolutions), window, stride, kernel
+    )
 
 
 def iter_blocked_features(

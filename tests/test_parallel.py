@@ -113,6 +113,29 @@ def test_streaming_rejects_bad_block_layout(mesh8):
         extract(jnp.asarray(signal[:, : 8 * 600 - 3]))
 
 
+def test_streaming_extractor_int16_staging_matches_f32(mesh8):
+    """int16-staged recording + on-device resolutions == pre-scaled
+    f32 staging, through the mesh extractor's halo ring."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(2)
+    raw = (rng.randn(3, 4096) * 500).astype(np.int16)
+    res = np.array([0.1, 0.1, 0.2], np.float32)
+    tmesh = pmesh.make_mesh(8, axes=(pmesh.TIME_AXIS,))
+
+    ext16 = streaming.make_streaming_extractor(
+        tmesh, window=512, stride=256, resolutions=res
+    )
+    f16 = np.asarray(
+        ext16(streaming.stage_recording(raw, tmesh, dtype=jnp.int16))
+    )
+
+    extf = streaming.make_streaming_extractor(tmesh, window=512, stride=256)
+    scaled = raw.astype(np.float32) * res[:, None]
+    ff = np.asarray(extf(streaming.stage_recording(scaled, tmesh)))
+    np.testing.assert_allclose(f16, ff, rtol=0, atol=2e-5)
+
+
 def test_raw_train_step_matches_feature_step_composition():
     """make_raw_train_step == fused ingest + make_feature_train_step:
     identical state updates and losses, and the loss moves."""

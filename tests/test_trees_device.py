@@ -360,14 +360,23 @@ def test_rf_tpu_predict_routes_through_device(monkeypatch):
         calls["n"] += 1
         return real(*args, **kwargs)
 
+    pack_calls = {"n": 0}
+    real_pack = trees_device.host_trees_to_device
+
+    def pack_spy(*args, **kwargs):
+        pack_calls["n"] += 1
+        return real_pack(*args, **kwargs)
+
     monkeypatch.setattr(trees_device, "predict_linked_forest", spy)
+    monkeypatch.setattr(trees_device, "host_trees_to_device", pack_spy)
     got = clf.predict(x)
     assert calls["n"] == 1, "rf-tpu predict did not take the device path"
     binned = trees.bin_features(x, clf.edges)
     votes = np.stack([trees._predict_tree(t, binned) for t in clf.trees])
     want = (votes.mean(axis=0) > 0.5).astype(np.float64)
     np.testing.assert_array_equal(got, want)
-    # the packed forest is cached: a second predict re-uses it
+    # the packed forest is cached: a second predict walks again but
+    # does NOT repack/re-upload the forest
     clf.predict(x)
     assert calls["n"] == 2
-    assert clf._device_pack is not None
+    assert pack_calls["n"] == 1

@@ -454,8 +454,16 @@ def run(variant: str, n: int, iters: int) -> dict:
         feats = rng.randn(4096, 48)
         labels = (feats[:, 0] + 0.3 * rng.randn(4096) > 0).astype(np.int32)
         clf = trees.RandomForestClassifier(backend="device")
-        clf.set_config({})
+        # explicit config: the bench's walk depth and byte model must
+        # never drift from what the forest was actually grown with
+        clf.set_config({
+            "config_max_bins": str(bins), "config_impurity": "gini",
+            "config_max_depth": str(depth),
+            "config_min_instances_per_node": "1",
+            "config_num_trees": str(T), "config_feature_subset": "auto",
+        })
         clf.fit(feats, labels.astype(np.float64))
+        assert clf._params["max_depth"] == depth and len(clf.trees) == T
         test_feats = rng.randn(n, 48)
         binned = jnp.asarray(
             trees.bin_features(test_feats, clf.edges), jnp.int32
