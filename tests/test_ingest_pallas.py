@@ -235,6 +235,30 @@ def test_regular_ingest_phase_arbitrary_first_position(first):
     np.testing.assert_allclose(b, a, rtol=0, atol=5e-6)
 
 
+@pytest.mark.parametrize("stride", [800, 832, 896, 1024, 960])
+def test_regular_ingest_phase_across_group_sizes(stride):
+    """The phase formulation must be exact for every lane-tile group
+    size its guard admits: stride 800 -> G=4 rows of 3200, 832 ->
+    G=2, 896/1024 -> G=1, 960 -> G=2 — including windows crossing
+    the row boundary at awkward phases."""
+    from eeg_dataanalysispackage_tpu.ops.device_ingest import _phase_group
+
+    assert _phase_group(stride) <= 4  # all admitted by the guard
+    n, first = 11, 150 + (stride // 3)
+    raw, res = _dc_heavy_fixture(
+        n, stride, first, tail=4 * _phase_group(stride) * stride + 8192
+    )
+    ing_r = device_ingest.make_regular_ingest_featurizer(
+        stride, n, formulation="reshape"
+    )
+    ing_p = device_ingest.make_regular_ingest_featurizer(
+        stride, n, formulation="phase"
+    )
+    a = np.asarray(ing_r(jnp.asarray(raw), jnp.asarray(res), first))
+    b = np.asarray(ing_p(jnp.asarray(raw), jnp.asarray(res), first))
+    np.testing.assert_allclose(b, a, rtol=0, atol=5e-6)
+
+
 def test_regular_ingest_phase_short_recording_falls_back():
     """A recording too short for the aligned slab still returns exact
     features via the reshape fallback."""
