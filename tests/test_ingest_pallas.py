@@ -317,6 +317,27 @@ def test_block_ingest_matches_gather_featurizer():
     assert np.abs(got[n:]).max() == 0.0
 
 
+def test_block_ingest_start_edge_matches_gather():
+    """Windows starting at the very first valid sample (position ==
+    pre -> start 0, shift 0, block 0) match the gather path."""
+    rng = np.random.RandomState(5)
+    raw = rng.randint(-3000, 3000, size=(3, 6000)).astype(np.int16)
+    res = np.array([0.1, 0.1, 0.2], np.float32)
+    pos = np.array([100, 101, 227], np.int32)  # start 0, 1, 127
+    mask = np.ones(3, bool)
+    gather = device_ingest.make_device_ingest_featurizer()
+    block = device_ingest.make_block_ingest_featurizer()
+    want = np.asarray(
+        gather(jnp.asarray(raw), jnp.asarray(res), jnp.asarray(pos),
+               jnp.asarray(mask))
+    )
+    got = np.asarray(
+        block(jnp.asarray(raw), jnp.asarray(res), jnp.asarray(pos),
+              jnp.asarray(mask))
+    )
+    np.testing.assert_allclose(got, want, rtol=0, atol=5e-6)
+
+
 def test_block_ingest_window_overhang_reads_zeros():
     """A window overhanging the end of the recording zero-pads (Java
     copyOfRange semantics), exactly like the gather path."""
