@@ -26,7 +26,9 @@ from __future__ import annotations
 
 import dataclasses
 import http.client
+import json
 import logging
+import os
 import time
 import urllib.parse
 from typing import Optional, Tuple
@@ -61,6 +63,9 @@ class RemoteIOError(IOError):
 
 #: statuses worth retrying: transient server/gateway conditions.
 _RETRYABLE_STATUSES = (429, 500, 502, 503, 504)
+
+#: redirect statuses followed by the WebHDFS namenode->datanode hops.
+_REDIRECT_STATUSES = (301, 302, 303, 307, 308)
 
 
 class HttpFileSystem:
@@ -270,6 +275,195 @@ class GcsFileSystem(HttpFileSystem):
         return super()._split(path)
 
 
+class WebHdfsFileSystem(HttpFileSystem):
+    """``hdfs://host:port/path`` over the WebHDFS REST API.
+
+    The reference's storage is literally HDFS — ``Const.java:38-39``
+    hard-codes ``hdfs://localhost:8020`` and every data path dials it
+    (``OffLineDataProvider.java:90``). This adapter speaks the WebHDFS
+    REST protocol (the HTTP face of the same namenode), so
+    ``info_file=hdfs://...`` works end-to-end with zero Hadoop client
+    dependency:
+
+    - ``GETFILESTATUS`` answers ``exists`` and supplies the object
+      length that drives the chunked read loop,
+    - ``OPEN`` with ``offset``/``length`` params is the ranged read
+      (WebHDFS's native form of the HTTP ``Range`` header),
+    - ``CREATE`` is the namenode/datanode two-step: a body-less PUT
+      that 307-redirects to the datanode which takes the bytes.
+
+    Redirects are first-class (the namenode redirects OPEN/CREATE to a
+    datanode); gateways that answer directly (HttpFS-style, no
+    redirect) are handled too. Retry/backoff/timeout semantics are
+    inherited per request from :class:`HttpFileSystem`, and a chunk
+    body that dies mid-transfer is retried by the same machinery.
+
+    ``endpoint`` overrides the URI authority — real clusters serve
+    WebHDFS on the HTTP port (9870), not the RPC port carried in
+    ``hdfs://`` URIs (8020); without an override the authority is used
+    verbatim, which also lets hermetic tests serve a namenode on
+    127.0.0.1. ``user`` adds ``user.name=`` pseudo-authentication.
+    Both default from ``WEBHDFS_ENDPOINT`` / ``WEBHDFS_USER`` env
+    vars so scheme-routed instances (``filesystem_for`` from
+    ``info_file=hdfs://...`` — no kwargs path) can still reach a
+    gateway whose HTTP authority differs from the URI's RPC one.
+    """
+
+    def __init__(
+        self,
+        endpoint: Optional[str] = None,
+        user: Optional[str] = None,
+        api_prefix: str = "/webhdfs/v1",
+        **kwargs,
+    ):
+        super().__init__(base_url="", **kwargs)
+        endpoint = endpoint or os.environ.get("WEBHDFS_ENDPOINT")
+        self.endpoint = endpoint.rstrip("/") if endpoint else None
+        self.user = user or os.environ.get("WEBHDFS_USER")
+        self.api_prefix = api_prefix
+
+    # -- URL construction ----------------------------------------------
+
+    def _rest_url(self, path: str, op: str, **params) -> str:
+        """hdfs path -> full http REST URL for one operation."""
+        if path.startswith("hdfs://"):
+            rest = path[len("hdfs://") :]
+            authority, _, hpath = rest.partition("/")
+            hpath = "/" + hpath
+            if not authority and self.endpoint is None:
+                # hdfs:///path (Hadoop default-FS form) has no
+                # authority to dial — fail fast rather than letting
+                # http.client resolve an empty netloc to localhost:80
+                raise ValueError(
+                    f"{path!r} has no authority; set endpoint= or "
+                    f"WEBHDFS_ENDPOINT for default-FS hdfs:/// URIs"
+                )
+            base = self.endpoint or f"http://{authority}"
+        else:
+            if self.endpoint is None:
+                raise ValueError(
+                    f"WebHdfsFileSystem needs an hdfs:// URI or an "
+                    f"endpoint=, got {path!r}"
+                )
+            base = self.endpoint
+            hpath = path if path.startswith("/") else "/" + path
+        query = {"op": op, **params}
+        if self.user:
+            query["user.name"] = self.user
+        return (
+            f"{base}{self.api_prefix}"
+            f"{urllib.parse.quote(hpath)}?{urllib.parse.urlencode(query)}"
+        )
+
+    def _follow(self, method: str, url: str, body: Optional[bytes] = None):
+        """A request plus namenode->datanode redirect hops (each hop
+        gets the full retry budget). Relative Location headers (RFC
+        7231, emitted by some proxies) resolve against the current
+        hop's URL."""
+        for _ in range(4):
+            status, headers, data = self._request(method, url, body=body)
+            if status in _REDIRECT_STATUSES and "location" in headers:
+                url = urllib.parse.urljoin(url, headers["location"])
+                continue
+            return status, headers, data
+        raise RemoteIOError(f"{method} {url}: too many redirects")
+
+    # -- FileSystem protocol -------------------------------------------
+
+    def _file_status(self, path: str) -> Optional[dict]:
+        status, _, data = self._follow(
+            "GET", self._rest_url(path, "GETFILESTATUS")
+        )
+        if status in (404, 410):
+            return None
+        if status != 200:
+            raise RemoteIOError(f"GETFILESTATUS {path}: HTTP {status}")
+        try:
+            return json.loads(data)["FileStatus"]
+        except (ValueError, KeyError, TypeError) as e:
+            # a 200 from something that isn't WebHDFS (captive portal,
+            # misrouted gateway) stays inside the module's IOError
+            # contract instead of leaking JSONDecodeError/KeyError
+            raise RemoteIOError(
+                f"GETFILESTATUS {path}: unparseable response "
+                f"({data[:80]!r})"
+            ) from e
+
+    def exists(self, path: str) -> bool:
+        return self._file_status(path) is not None
+
+    def read_range(self, path: str, start: int, length: int) -> bytes:
+        url = self._rest_url(path, "OPEN", offset=start, length=length)
+        status, _, data = self._follow("GET", url)
+        if status in (404, 410):
+            raise FileNotFoundError(path)
+        if status != 200:
+            raise RemoteIOError(f"OPEN {path} @{start}: HTTP {status}")
+        return data
+
+    def read_bytes(self, path: str) -> bytes:
+        st = self._file_status(path)
+        if st is None:
+            raise FileNotFoundError(path)
+        if st.get("type") == "DIRECTORY":
+            # LocalFileSystem raises IsADirectoryError for the same
+            # mistake; a DIRECTORY status has length 0 and would
+            # otherwise silently read as b""
+            raise IsADirectoryError(path)
+        try:
+            total = int(st["length"])
+        except (KeyError, ValueError, TypeError) as e:
+            raise RemoteIOError(
+                f"GETFILESTATUS {path}: malformed FileStatus ({st!r})"
+            ) from e
+        got = bytearray()
+        while len(got) < total:
+            n = min(self.chunk_size, total - len(got))
+            chunk = self.read_range(path, len(got), n)
+            if not chunk:
+                raise RemoteIOError(
+                    f"OPEN {path}: empty body at offset {len(got)}/{total}"
+                )
+            got.extend(chunk)
+        return bytes(got)
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        url = self._rest_url(path, "CREATE", overwrite="true")
+        # Step 1: body-less PUT to the namenode; it answers 307 with
+        # the datanode location that takes the bytes (the WebHDFS
+        # CREATE contract). HttpFS-style gateways skip the redirect
+        # and take the body directly on a second PUT to the same URL.
+        status, headers, _ = self._request("PUT", url)
+        if status in _REDIRECT_STATUSES and "location" in headers:
+            # _follow handles further hops (HA proxy -> namenode ->
+            # datanode chains) and relative Locations
+            status2, _, _ = self._follow(
+                "PUT", urllib.parse.urljoin(url, headers["location"]), body=data
+            )
+            if not 200 <= status2 < 300:
+                raise RemoteIOError(f"CREATE {path} (data): HTTP {status2}")
+        elif 200 <= status < 300:
+            status2, _, _ = self._request(
+                "PUT",
+                self._rest_url(path, "CREATE", overwrite="true", data="true"),
+                body=data,
+                extra_headers={"Content-Type": "application/octet-stream"},
+            )
+            if not 200 <= status2 < 300:
+                # the gateway 2xx-accepted the body-less step-1 CREATE
+                # (overwrite=true rides step 1 because the real
+                # namenode protocol consumes it there), so the target
+                # may already be truncated — say so rather than leave
+                # a later empty read() as the only clue
+                raise RemoteIOError(
+                    f"CREATE {path} (direct): HTTP {status2}; target "
+                    f"may be left truncated by the accepted step-1 "
+                    f"CREATE"
+                )
+        else:
+            raise RemoteIOError(f"CREATE {path}: HTTP {status}")
+
+
 def _total_from_content_range(value: str) -> Optional[int]:
     # "bytes 0-1048575/31719424" -> 31719424
     if "/" in value:
@@ -284,9 +478,11 @@ def filesystem_for(path: str, **kwargs):
     selection, made pluggable).
 
     ``http(s)://`` -> :class:`HttpFileSystem`; ``gs://`` ->
-    :class:`GcsFileSystem`; ``file://`` and plain paths -> local
-    POSIX. The returned filesystem accepts the original URI form in
-    every call, so callers can thread one (fs, path) pair everywhere.
+    :class:`GcsFileSystem`; ``hdfs://`` -> :class:`WebHdfsFileSystem`
+    (the reference's actual scheme — Const.java:38-39); ``file://``
+    and plain paths -> local POSIX. The returned filesystem accepts
+    the original URI form in every call, so callers can thread one
+    (fs, path) pair everywhere.
     """
     from . import sources
 
@@ -294,4 +490,6 @@ def filesystem_for(path: str, **kwargs):
         return HttpFileSystem(**kwargs)
     if path.startswith("gs://"):
         return GcsFileSystem(**kwargs)
+    if path.startswith("hdfs://"):
+        return WebHdfsFileSystem(**kwargs)
     return sources.LocalFileSystem()
