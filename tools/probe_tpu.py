@@ -1,0 +1,43 @@
+"""Kill-free axon tunnel probe: prints one JSON line and exits on its own.
+
+Killing an axon process mid device-init or mid-compile is the known
+tunnel-wedging event, so this probe carries NO external timeout
+contract — it initializes the backend, jits one trivial op (never
+eager through the tunnel), and returns by itself:
+
+- healthy tunnel: ``{"ok": true, "platform": "axon", ...}`` in ~1 min
+  cold / seconds warm,
+- down-but-failing-fast tunnel: ``{"ok": false, "err": "...
+  UNAVAILABLE ..."}`` (observed ~25 min to surface),
+- truly wedged tunnel: hangs — the caller waits with it rather than
+  killing it.
+
+Used by tools/tunnel_watch.sh; fine standalone.
+"""
+
+import json
+import time
+
+
+def main() -> None:
+    t0 = time.time()
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        devs = jax.devices()
+        r = jax.jit(lambda x: x * 2 + 1)(jnp.ones((8, 128), jnp.float32))
+        r.block_until_ready()
+        out = {
+            "ok": True,
+            "platform": devs[0].platform,
+            "n_devices": len(devs),
+            "t_s": round(time.time() - t0, 1),
+        }
+    except Exception as e:  # noqa: BLE001 — probe must always print
+        out = {"ok": False, "err": str(e)[:300], "t_s": round(time.time() - t0, 1)}
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

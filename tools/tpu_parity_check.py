@@ -58,7 +58,7 @@ def main() -> None:
     # A fused-path failure must not lose the baseline parity numbers
     # above, so capture errors instead of propagating.
     devs = {}
-    for backend in ("xla", "pallas"):
+    for backend in ("xla", "block", "pallas"):
         try:
             odp = provider.OfflineDataProvider([FIXTURE])
             feats, _ = odp.load_features_device(backend=backend)
@@ -81,6 +81,7 @@ def main() -> None:
                 "device_feature_max_abs_dev_vs_host_f64": max_abs_dev,
                 "device_feature_sum": java_feature_sum(device_feats),
                 "fused_ingest_max_abs_dev": devs["xla"],
+                "block_ingest_max_abs_dev": devs["block"],
                 "pallas_ingest_max_abs_dev": devs["pallas"],
             }
         )

@@ -1,7 +1,8 @@
 #!/bin/bash
 # Watch the axon TPU tunnel; when it recovers, immediately collect the
 # measurements that are blocked on it, then stop. Safe by constraint:
-# everything it runs is jit-only (never eager through the tunnel) and
+# everything it runs is jit-only (never eager through the tunnel), the
+# probe is kill-free (it returns on its own — tools/probe_tpu.py), and
 # nothing is killed mid-compile (generous timeouts, sequential).
 #
 #   nohup setsid bash tools/tunnel_watch.sh /tmp/tunnel_watch > /dev/null 2>&1 &
@@ -13,21 +14,20 @@ OUT=$(readlink -f "${1:-/tmp/tunnel_watch}")
 mkdir -p "$OUT"
 log() { echo "$(date +%H:%M:%S) $*" >> "$OUT/watch.log"; }
 
-log "watch started"
+log "watch started (kill-free probe)"
 while :; do
-  # 240s probe timeout: SIGTERM on an axon-INITIALIZING process is the
-  # known tunnel-wedging event, and a recovered-but-cold tunnel can
-  # take minutes to init — never kill a probe that might be mid-init
-  # on a healthy tunnel (same budget as real_chip_sweep.sh)
-  if timeout 240 python -c "import jax; print(jax.devices()[0].platform)" \
-      > "$OUT/probe.out" 2>/dev/null; then
-    plat=$(cat "$OUT/probe.out")
-    if [ "$plat" = "axon" ] || [ "$plat" = "tpu" ]; then
-      log "tunnel recovered (platform $plat); collecting"
-      break
-    fi
+  # NO external timeout on the probe: SIGTERM on an axon-INITIALIZING
+  # process is the known tunnel-wedging event. The probe returns by
+  # itself — ok JSON on a healthy tunnel, an UNAVAILABLE error after
+  # ~25 min on a down-but-failing-fast one; on a truly wedged tunnel
+  # it hangs and this watcher waits with it.
+  python tools/probe_tpu.py > "$OUT/probe.out" 2>> "$OUT/probe.err"
+  if grep -q '"ok": true' "$OUT/probe.out" \
+      && grep -Eq '"platform": "(axon|tpu)"' "$OUT/probe.out"; then
+    log "tunnel recovered: $(cat "$OUT/probe.out")"
+    break
   fi
-  log "still wedged"
+  log "probe not-ok: $(tail -c 200 "$OUT/probe.out")"
   sleep 600
 done
 
@@ -35,19 +35,32 @@ run() { # name timeout cmd...
   name=$1; t=$2; shift 2
   log "run $name"
   timeout "$t" "$@" > "$OUT/$name.json" 2> "$OUT/$name.err"
-  log "done $name rc=$? $(tail -c 200 "$OUT/$name.json")"
+  log "done $name rc=$? $(tail -c 300 "$OUT/$name.json")"
 }
 
+# Order = evidence priority (VERDICT r2): the irregular-ingest
+# fast-path numbers and the chip-staged rows first, the driver bench
+# artifact once the core numbers are safe, Pallas (whose kernel
+# crashes the remote compile helper) after everything XLA-only, and
+# the compiler bisect DEAD LAST because a helper crash may re-wedge.
+run parity        900 python tools/tpu_parity_check.py
+run einsum        600 python tools/ingest_bench.py einsum 262144 50
+run xla_ingest    900 python tools/ingest_bench.py xla_ingest 32768 10
+run block_ingest  900 python tools/ingest_bench.py block_ingest 32768 10
 BENCH_FORMULATION=phase run regular_phase 900 \
   python tools/ingest_bench.py regular_ingest 262144 20
 BENCH_FORMULATION=conv run regular_conv 900 \
   python tools/ingest_bench.py regular_ingest 262144 20
 BENCH_FORMULATION=reshape run regular_reshape 900 \
   python tools/ingest_bench.py regular_ingest 262144 20
-run einsum 600 python tools/ingest_bench.py einsum 262144 50
-run bench_full 1800 python bench.py
-# LAST, after every measurement is safely on disk: the bisect probes
-# the construct that crashes the remote compiler, and a helper crash
-# may re-wedge the tunnel — nothing of value runs after it
+run train_raw     900 python tools/ingest_bench.py train_step_raw 131072 20
+run rf_train      900 python tools/ingest_bench.py rf_train 65536 3
+run rf_predict    600 python tools/ingest_bench.py rf_predict 262144 10
+run einsum_flat   600 python tools/ingest_bench.py einsum_flat 262144 50
+run einsum_2d     600 python tools/ingest_bench.py einsum_2d 262144 50
+run einsum_bf16   600 python tools/ingest_bench.py einsum_bf16 262144 50
+run train_step    600 python tools/ingest_bench.py train_step 131072 20
+run bench_full   2400 python bench.py
+run pallas_ingest 900 python tools/ingest_bench.py pallas_ingest 131072 20
 run pallas_bisect 900 python tools/pallas_compile_bisect.py
 log "collection complete"
