@@ -23,10 +23,13 @@ order MLlib 1.6.2's ``GradientDescent.runMiniBatchSGD`` performs it:
   ``LogisticRegressionModel.predictPoint``), svm ``margin > 0.0``
   (``SVMModel.predictPoint``).
 
-Only the deterministic full-batch path (``miniBatchFraction == 1.0``)
-is emulated; the sampled path depends on Spark's per-partition
+The deterministic full-batch path (``miniBatchFraction == 1.0``) is
+emulated exactly. The sampled path depends on Spark's per-partition
 XORShift sampler and cannot be bit-reproduced (documented in
-``models/sgd.py``).
+``models/sgd.py``); it is emulated *statistically* — same per-element
+Bernoulli process, numpy PRNG — so seed-sweep distributions of the
+device engine, this oracle, and the JVM are mutually comparable even
+though individual trajectories are not.
 
 Why this exists: the reference's informal accuracy pin
 0.6415094339622641 (``ClassifierTest.java:105``, commented out) is
@@ -55,21 +58,34 @@ def run_gradient_descent(
     reg_param: float = 0.01,
     mini_batch_fraction: float = 1.0,
     convergence_tol: float = 0.001,
+    seed: int = 42,
 ) -> tuple[np.ndarray, list[float], int]:
     """Return (weights_f64, loss_history, iterations_run).
 
     ``loss`` is "logistic" (LogisticGradient, binary) or "hinge"
-    (HingeGradient). Raises on mini_batch_fraction != 1.0 — the
-    sampled path is not deterministic in the reference either.
+    (HingeGradient).
+
+    ``mini_batch_fraction < 1.0`` runs the *sampled emulation*: per
+    iteration, each row is kept Bernoulli(fraction) — the same
+    per-element sampling model as MLlib's ``RDD.sample`` — but drawn
+    from numpy's PRNG seeded ``[seed, i]``, NOT Spark's per-partition
+    XORShift seeded ``42 + i``, so individual trajectories are NOT
+    bit-comparable to the JVM (or to the device engine, which folds
+    ``i`` into a JAX PRNG key). What IS comparable — and what
+    tests/test_mllib_accuracy_parity.py asserts — is the seed-sweep
+    *distribution* of outcomes (final weight norm, accuracy): three
+    different PRNGs driving the same Bernoulli process must land in
+    the same place statistically. MLlib's empty-sample semantics are
+    kept: a sampled-empty iteration leaves the weights unchanged and
+    appends no loss, and the convergence check compares consecutive
+    *updated* iterates only.
     """
-    if mini_batch_fraction != 1.0:
-        raise ValueError(
-            "oracle emulates the deterministic full-batch path only; "
-            "MLlib's Bernoulli sampling (seed 42+i per-partition "
-            "XORShift) is not bit-reproducible"
-        )
     if loss not in ("logistic", "hinge"):
         raise ValueError(f"unknown loss: {loss}")
+    if not 0.0 < mini_batch_fraction <= 1.0:
+        raise ValueError(
+            f"mini_batch_fraction must be in (0, 1]; got {mini_batch_fraction}"
+        )
 
     x = np.asarray(features, dtype=np.float64)
     y = np.asarray(labels, dtype=np.float64)
@@ -86,12 +102,21 @@ def run_gradient_descent(
     converged = False
     i = 1
     while not converged and i <= num_iterations:
+        if mini_batch_fraction >= 1.0:
+            sampled = range(n)
+            batch_size = n
+        else:
+            rng = np.random.default_rng([seed, i])
+            keep = rng.random(n) < mini_batch_fraction
+            sampled = np.flatnonzero(keep)
+            batch_size = int(keep.sum())
+
         grad_sum = np.zeros(d, dtype=np.float64)
         loss_sum = 0.0
         if loss == "logistic":
             # LogisticGradient.compute (binary): margin = -w.x,
             # multiplier = 1/(1+exp(margin)) - label
-            for k in range(n):
+            for k in sampled:
                 margin = -float(np.dot(x[k], w))
                 # np.exp returns inf past ~709 (Java Math.exp
                 # semantics: 1/(1+Inf) == 0); math.exp would raise
@@ -107,28 +132,30 @@ def run_gradient_descent(
                     point_loss = math.log1p(math.exp(margin))
                 loss_sum += point_loss if y[k] > 0 else point_loss - margin
         else:  # hinge
-            for k in range(n):
+            for k in sampled:
                 dot = float(np.dot(x[k], w))
                 label_scaled = 2.0 * y[k] - 1.0
                 if 1.0 > label_scaled * dot:
                     grad_sum += (-label_scaled) * x[k]
                     loss_sum += 1.0 - label_scaled * dot
 
-        # miniBatchSize == n > 0 always here
-        loss_history.append(loss_sum / n + reg_val)
-        # SquaredL2Updater.compute
-        step_i = step_size / math.sqrt(i)
-        w_new = w * (1.0 - step_i * reg_param) - step_i * (grad_sum / n)
-        reg_val = 0.5 * reg_param * float(np.dot(w_new, w_new))
-        w = w_new
-
-        prev_w = cur_w
-        cur_w = w
-        if prev_w is not None:
-            diff = float(np.linalg.norm(prev_w - cur_w))
-            converged = diff < convergence_tol * max(
-                float(np.linalg.norm(cur_w)), 1.0
+        if batch_size > 0:
+            loss_history.append(loss_sum / batch_size + reg_val)
+            # SquaredL2Updater.compute
+            step_i = step_size / math.sqrt(i)
+            w_new = w * (1.0 - step_i * reg_param) - step_i * (
+                grad_sum / batch_size
             )
+            reg_val = 0.5 * reg_param * float(np.dot(w_new, w_new))
+            w = w_new
+
+            prev_w = cur_w
+            cur_w = w
+            if prev_w is not None:
+                diff = float(np.linalg.norm(prev_w - cur_w))
+                converged = diff < convergence_tol * max(
+                    float(np.linalg.norm(cur_w)), 1.0
+                )
         i += 1
 
     return w, loss_history, i - 1

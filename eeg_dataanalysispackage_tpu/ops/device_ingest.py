@@ -293,7 +293,6 @@ def resolve_regular_formulation(formulation: str, stride: int) -> str:
     return formulation
 
 
-@functools.lru_cache(maxsize=None)
 def make_regular_ingest_featurizer(
     stride: int,
     n_epochs: int,
@@ -354,14 +353,52 @@ def make_regular_ingest_featurizer(
     Requires ``stride >= pre + skip + epoch_size`` (787 default) so a
     window never crosses into the next epoch's row; the general
     overlapping/irregular case is ``ops/ingest_pallas.py``.
+
+    ``'auto'`` is resolved HERE, before the lru_cache boundary of the
+    private builder: the resolution consults the default platform, so
+    caching on the literal ``'auto'`` would pin whichever platform was
+    live at the first call — a later platform switch (e.g. a
+    CPU-override child) would silently reuse a featurizer built for
+    the old one. The returned callable carries the resolved name as
+    ``.formulation``.
     """
+    formulation = resolve_regular_formulation(formulation, stride)
+    return _make_regular_ingest_featurizer(
+        stride, n_epochs, wavelet_index, epoch_size, skip_samples,
+        feature_size, pre, n_channels, formulation,
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _make_regular_ingest_featurizer(
+    stride: int,
+    n_epochs: int,
+    wavelet_index: int,
+    epoch_size: int,
+    skip_samples: int,
+    feature_size: int,
+    pre: int,
+    n_channels: int,
+    formulation: str,
+):
+    """Cached builder behind :func:`make_regular_ingest_featurizer`.
+
+    ``formulation`` must be a concrete, already-resolved name (never
+    ``'auto'`` — resolving here would pin the first caller's platform
+    into the cache key). No parameter defaults: the public wrapper
+    owns the signature.
+    """
+    if formulation == "auto":
+        raise ValueError(
+            "internal: 'auto' must be resolved by "
+            "make_regular_ingest_featurizer before the cache boundary"
+        )
     win = pre + skip_samples + epoch_size
     if stride < win:
         raise ValueError(
             f"regular ingest needs stride >= {win}; got {stride} "
             "(use the Pallas irregular-position kernel instead)"
         )
-    formulation = resolve_regular_formulation(formulation, stride)
     if formulation == "phase" and _phase_group(stride) > _PHASE_MAX_GROUP:
         raise ValueError(
             f"phase formulation with stride {stride} needs group size "
@@ -555,6 +592,7 @@ def make_regular_ingest_featurizer(
             return _ingest_reshape(raw_i16, resolutions, first)
         return _ingest_jit(raw_i16, resolutions, first)
 
+    ingest.formulation = formulation
     return ingest
 
 
@@ -595,6 +633,7 @@ def make_block_ingest_featurizer(
     skip_samples: int = 175,
     feature_size: int = 16,
     pre: int = constants.PRESTIMULUS_SAMPLES,
+    chunk_epochs: int = 32768,
 ):
     """Irregular-marker fused int16 ingest with NO element gather.
 
@@ -622,6 +661,17 @@ def make_block_ingest_featurizer(
 
     Windows overhanging the recording end read zeros (Java
     copyOfRange semantics, matching the gather path).
+
+    The per-window intermediates cost ~25 KB/epoch of HBM (the
+    (C, n, BLK, K) variant tensor + the gathered slab), so a whole
+    long recording featurized in one call could exhaust HBM where the
+    element-gather path would not. Capacities above ``chunk_epochs``
+    therefore run as a ``lax.map`` over fixed-size position chunks —
+    same compiled body per chunk, HBM bounded at
+    ``chunk_epochs * ~25 KB`` regardless of recording length. At or
+    below ``chunk_epochs`` (every bench size and the shipped
+    paradigm's recordings) the single-chunk body is emitted directly,
+    unchanged.
     """
     from . import dwt as dwt_xla
 
@@ -636,20 +686,15 @@ def make_block_ingest_featurizer(
         slab, BLK,
     )
 
-    @jax.jit
-    def ingest_features(raw, resolutions, positions, mask):
-        C, S = raw.shape
+    def _featurize(blocks, resolutions, starts):
+        """(C, n_blocks, BLK) tile rows + (m,) window starts ->
+        (m, C*K) normalized features (no mask)."""
+        C = blocks.shape[0]
         K = feature_size
-        # pad so every gathered slab exists: tail of slab zeros, then
-        # round the block count up
-        S_pad = ((S + slab + BLK - 1) // BLK) * BLK
-        padded = jnp.pad(raw, ((0, 0), (0, S_pad - S)))
-        blocks = padded.reshape(C, S_pad // BLK, BLK)
-        starts = jnp.clip(positions - pre, 0, S)
         b0 = starts // BLK
-        shift = starts % BLK  # (cap,)
+        shift = starts % BLK  # (m,)
         bidx = b0[:, None] + jnp.arange(SLAB_BLOCKS, dtype=b0.dtype)
-        gathered = blocks[:, bidx]  # (C, cap, 8, BLK) — row gathers
+        gathered = blocks[:, bidx]  # (C, m, 8, BLK) — row gathers
         xw = gathered.reshape(C, -1, slab).astype(jnp.float32) * (
             resolutions[:, None, None]
         )
@@ -663,15 +708,38 @@ def make_block_ingest_featurizer(
         ).reshape(C, -1, BLK, K)
         pm = jnp.einsum(
             "cnt,tv->cnv", z, jnp.asarray(Mv_np), precision=hi
-        )  # (C, cap, BLK)
+        )  # (C, m, BLK)
         onehot = (
             shift[:, None] == jnp.arange(BLK, dtype=shift.dtype)[None, :]
-        ).astype(jnp.float32)  # (cap, BLK)
+        ).astype(jnp.float32)  # (m, BLK)
         yk = jnp.einsum("cnvk,nv->cnk", y, onehot, precision=hi)
         pmn = jnp.einsum("cnv,nv->cn", pm, onehot, precision=hi)
         feats = yk - pmn[..., None] * jnp.asarray(colsum_np)[None, None, :]
         out = jnp.transpose(feats, (1, 0, 2)).reshape(-1, C * K)
-        out = dwt_xla.safe_l2_normalize(out)
+        return dwt_xla.safe_l2_normalize(out)
+
+    @jax.jit
+    def ingest_features(raw, resolutions, positions, mask):
+        C, S = raw.shape
+        cap = positions.shape[0]
+        # pad so every gathered slab exists: tail of slab zeros, then
+        # round the block count up
+        S_pad = ((S + slab + BLK - 1) // BLK) * BLK
+        padded = jnp.pad(raw, ((0, 0), (0, S_pad - S)))
+        blocks = padded.reshape(C, S_pad // BLK, BLK)
+        starts = jnp.clip(positions - pre, 0, S)
+        if cap <= chunk_epochs:
+            out = _featurize(blocks, resolutions, starts)
+        else:
+            n_chunks = -(-cap // chunk_epochs)
+            pad_rows = n_chunks * chunk_epochs - cap
+            # padded starts gather block 0 — valid rows, masked off
+            chunked = jnp.pad(starts, (0, pad_rows)).reshape(
+                n_chunks, chunk_epochs
+            )
+            out = jax.lax.map(
+                lambda s: _featurize(blocks, resolutions, s), chunked
+            ).reshape(n_chunks * chunk_epochs, -1)[:cap]
         return out * mask[:, None].astype(out.dtype)
 
     return ingest_features

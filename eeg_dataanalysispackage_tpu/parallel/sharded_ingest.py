@@ -195,6 +195,10 @@ def make_sharded_ingest(
         flat = np.asarray(rep).reshape(-1, feats.shape[-1])
         return flat[plan.unsort]
 
+    # inner jitted shard_map program, exposed for compiled-HLO
+    # inspection (driver dryrun asserts the ring halo lowers to a
+    # collective-permute)
+    extract._sharded_jit = sharded
     return extract
 
 

@@ -206,6 +206,11 @@ def make_streaming_extractor(
             )
         return sharded(signal)
 
+    # the inner jitted shard_map program, exposed so callers (the
+    # driver dryrun, tests) can inspect its compiled HLO — e.g. assert
+    # the ppermute halo really lowers to a collective-permute instead
+    # of XLA silently replicating
+    extract._sharded_jit = sharded
     return extract
 
 

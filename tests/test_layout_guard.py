@@ -1,0 +1,187 @@
+"""Guards for the fast device layouts and platform-correct formulation.
+
+Round-2 real-chip A/B runs showed the headline featurizer is one
+input-layout mistake away from a 5.6x collapse (`einsum_2d.json`: the
+same geometry contracted as a flattened (B*C, T) 2-D matmul measured
+8.37 M eps vs 46.8 M for the batched rank-3 einsum). These tests pin
+the fast shapes structurally:
+
+- the jitted extractor the provider/staging arrays feed must lower to
+  ONE rank-3 ``dot_general`` applied directly to the input operand —
+  no flattening reshape, no transpose of the epochs tensor before the
+  contraction (the exact HLO the 46.8 M eps measurement compiled to);
+- ``formulation='auto'`` for the fused regular-ingest path must
+  re-resolve per platform (the ADVICE r2 cache bug: an lru_cache
+  keyed on the literal 'auto' pinned the first platform's choice),
+  picking the lane-tile-aligned ``phase`` form on accelerators and
+  ``reshape`` on CPU;
+- the block irregular-ingest featurizer's capacity chunking (HBM
+  bound for long recordings, ADVICE r2) must be bit-compatible with
+  the unchunked body.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from eeg_dataanalysispackage_tpu.ops import device_ingest, dwt
+
+
+# -- the 5.6x layout cliff: structural HLO guard ----------------------
+
+
+def _lowered_text(shape):
+    ex = dwt.make_batched_extractor()
+    return ex.lower(jax.ShapeDtypeStruct(shape, jnp.float32)).as_text()
+
+
+@pytest.mark.parametrize(
+    "shape",
+    [
+        (11, 3, 750),  # provider.load() fixture batch (epochs/extractor)
+        (1024, 3, 750),  # staging.prefetch_epochs minibatch shape
+        (64, 3, 1000),  # streaming window shape (parallel/streaming)
+    ],
+)
+def test_extractor_lowers_to_rank3_dot_on_the_input(shape):
+    """The contraction must be a single batched rank-3 dot_general
+    taking the input operand DIRECTLY — the formulation that measured
+    46.8 M eps — not the flattened 2-D matmul that measured 5.6x
+    slower on the same chip."""
+    B, C, T = shape
+    txt = _lowered_text(shape)
+
+    # exactly the fast contraction: (B, C, T) x (T, K) -> (B, C, K),
+    # applied to %arg0 itself (no reshape/transpose in between)
+    fast = re.search(
+        rf"dot_general %arg0, .*contracting_dims = \[2\] x \[0\].*"
+        rf"tensor<{B}x{C}x{T}xf32>, tensor<{T}x16xf32>",
+        txt,
+    )
+    assert fast, f"rank-3 dot_general on the input not found:\n{txt}"
+
+    # the slow formulation's signature: epochs flattened to (B*C, T)
+    assert f"tensor<{B * C}x{T}xf32>" not in txt, (
+        "extractor lowered through the flattened (B*C, T) layout — "
+        "the einsum_2d formulation measured 5.6x slower on chip"
+    )
+
+    # nothing may relayout the big operand before the contraction
+    assert not re.search(
+        rf"transpose .*tensor<{B}x{C}x{T}xf32>", txt
+    ), "input operand transposed before the contraction"
+
+
+def test_wavelet_xla_backend_routes_through_guarded_extractor():
+    """WaveletTransform(backend='xla') — the object the pipeline hands
+    provider arrays to — uses make_batched_extractor, so the HLO guard
+    above covers the production path."""
+    from eeg_dataanalysispackage_tpu.features import wavelet
+
+    fe = wavelet.WaveletTransform(8, 512, 175, 16, backend="xla")
+    epochs = np.random.RandomState(0).randn(8, 3, 750).astype(np.float32)
+    feats = fe.extract_batch(epochs)
+    assert feats.shape == (8, 48)
+    # the cached jit closure is the guarded extractor’s output
+    assert fe._jit_cache is not None
+
+
+# -- 'auto' formulation: per-platform re-resolution -------------------
+
+
+class _FakeDevice:
+    def __init__(self, platform):
+        self.platform = platform
+
+
+def test_auto_formulation_reresolves_after_platform_switch(monkeypatch):
+    """ADVICE r2: lru_cache keyed on the literal 'auto' pinned the
+    first platform's resolution. The wrapper resolves BEFORE the
+    cache, so the same 'auto' call yields phase on an accelerator and
+    reshape on CPU within one process."""
+    stride, n = 800, 16
+
+    monkeypatch.setattr(
+        jax, "devices", lambda *a, **k: [_FakeDevice("tpu")]
+    )
+    ing_tpu = device_ingest.make_regular_ingest_featurizer(
+        stride, n, formulation="auto"
+    )
+    assert ing_tpu.formulation == "phase"
+
+    monkeypatch.setattr(
+        jax, "devices", lambda *a, **k: [_FakeDevice("cpu")]
+    )
+    ing_cpu = device_ingest.make_regular_ingest_featurizer(
+        stride, n, formulation="auto"
+    )
+    assert ing_cpu.formulation == "reshape"
+    assert ing_cpu is not ing_tpu
+
+    # concrete names cache-hit as before, independent of platform
+    assert (
+        device_ingest.make_regular_ingest_featurizer(
+            stride, n, formulation="phase"
+        )
+        is ing_tpu
+    )
+
+
+def test_auto_picks_conv_for_odd_strides_on_accelerator(monkeypatch):
+    """Odd strides give phase group size 128 (GB-scale tables): auto
+    must fall to conv, not phase."""
+    monkeypatch.setattr(
+        jax, "devices", lambda *a, **k: [_FakeDevice("tpu")]
+    )
+    assert device_ingest.resolve_regular_formulation("auto", 801) == "conv"
+    assert device_ingest.resolve_regular_formulation("auto", 800) == "phase"
+
+
+# -- block-ingest capacity chunking -----------------------------------
+
+
+def _random_case(rng, cap, n_samples=40_000):
+    raw = rng.randint(-3000, 3000, size=(3, n_samples)).astype(np.int16)
+    res = np.array([0.1, 0.1, 0.1], np.float32)
+    positions = np.sort(
+        rng.randint(100, n_samples - 900, size=cap)
+    ).astype(np.int32)
+    mask = rng.rand(cap) < 0.9
+    return raw, res, positions, mask
+
+
+def test_block_chunking_matches_unchunked():
+    """lax.map over position chunks (HBM bound for long recordings)
+    must reproduce the single-chunk body exactly — including a
+    capacity that is NOT a multiple of the chunk size."""
+    rng = np.random.RandomState(42)
+    cap = 192
+    raw, res, positions, mask = _random_case(rng, cap)
+
+    whole = device_ingest.make_block_ingest_featurizer()  # cap << 32768
+    chunked = device_ingest.make_block_ingest_featurizer(chunk_epochs=100)
+    assert whole is not chunked
+
+    out_whole = np.asarray(whole(raw, res, positions, mask))
+    out_chunked = np.asarray(chunked(raw, res, positions, mask))
+    assert out_whole.shape == (cap, 48)
+    np.testing.assert_allclose(out_whole, out_chunked, rtol=0, atol=1e-6)
+    # masked rows stay zero through the chunked path too
+    assert np.all(out_chunked[~mask] == 0.0)
+
+
+def test_block_chunking_exact_multiple():
+    rng = np.random.RandomState(7)
+    cap = 128
+    raw, res, positions, mask = _random_case(rng, cap)
+    whole = device_ingest.make_block_ingest_featurizer()
+    chunked = device_ingest.make_block_ingest_featurizer(chunk_epochs=64)
+    np.testing.assert_allclose(
+        np.asarray(whole(raw, res, positions, mask)),
+        np.asarray(chunked(raw, res, positions, mask)),
+        rtol=0,
+        atol=1e-6,
+    )

@@ -180,3 +180,111 @@ def test_tree_families_fixture_regression(fixture_split, name,
     preds = (np.asarray(clf.predict(fte)) > 0.5).astype(np.float64)
     assert preds.tolist() == expected_preds
     assert float((preds == tte).mean()) == expected_acc
+
+
+# -- sampled-path statistical equivalence (miniBatchFraction < 1) -----
+#
+# The device engine folds the iteration into a JAX PRNG key while
+# Spark seeds a per-partition XORShift with 42+t (models/sgd.py), so
+# individual sampled trajectories are not bit-comparable — the claim
+# carried on trust until round 3 was that they are *statistically*
+# equivalent. These tests quantify it: a 20-seed sweep of the device
+# engine vs the f64 oracle's sampled emulation (numpy PRNG, same
+# Bernoulli process) must produce the same outcome distribution.
+
+
+def _sweep_dataset():
+    rng = np.random.RandomState(0)
+    n, d = 200, 48
+    w_true = rng.randn(d)
+    x = rng.randn(n, d).astype(np.float32)
+    margin = x @ w_true * 0.3
+    y = (1.0 / (1.0 + np.exp(-margin)) > rng.rand(n)).astype(np.float64)
+    return x, y
+
+
+@pytest.mark.parametrize("loss", ["logistic", "hinge"])
+def test_sampled_sgd_seed_sweep_matches_oracle_distribution(loss):
+    """mini_batch_fraction=0.5, 20 seeds each: final weight-norm and
+    accuracy distributions of the device engine and the oracle's
+    sampled emulation agree in mean (2% / 0.03) and spread (std ratio
+    within [0.4, 2.5]). Calibrated against measured agreement of
+    ~0.1% mean-norm and ~0.3% mean-accuracy deviation."""
+    x, y = _sweep_dataset()
+    seeds = range(20)
+
+    dev_norms, dev_accs, ora_norms, ora_accs = [], [], [], []
+    for s in seeds:
+        w_dev = sgd.train_linear(
+            x, y,
+            sgd.SGDConfig(
+                num_iterations=30, mini_batch_fraction=0.5, seed=s,
+                reg_param=0.01, loss=loss,
+            ),
+        )
+        dev_norms.append(float(np.linalg.norm(w_dev)))
+        dev_accs.append(float(((x @ w_dev > 0) == (y > 0.5)).mean()))
+
+        w_ora, _, _ = mllib_oracle.run_gradient_descent(
+            x, y, loss=loss, num_iterations=30,
+            mini_batch_fraction=0.5, seed=s, reg_param=0.01,
+        )
+        ora_norms.append(float(np.linalg.norm(w_ora)))
+        ora_accs.append(float(((x @ w_ora > 0) == (y > 0.5)).mean()))
+
+    dev_norms, ora_norms = np.array(dev_norms), np.array(ora_norms)
+    dev_accs, ora_accs = np.array(dev_accs), np.array(ora_accs)
+
+    norm_rel = abs(dev_norms.mean() - ora_norms.mean()) / ora_norms.mean()
+    assert norm_rel < 0.02, (
+        f"mean weight-norm diverges: device {dev_norms.mean():.4f} vs "
+        f"oracle {ora_norms.mean():.4f} ({norm_rel:.1%})"
+    )
+    assert abs(dev_accs.mean() - ora_accs.mean()) < 0.03, (
+        f"mean accuracy diverges: device {dev_accs.mean():.4f} vs "
+        f"oracle {ora_accs.mean():.4f}"
+    )
+    # same spread scale: the engines sample the same Bernoulli process
+    ratio = (dev_norms.std() + 1e-12) / (ora_norms.std() + 1e-12)
+    assert 0.4 < ratio < 2.5, (
+        f"weight-norm spread mismatch: device std {dev_norms.std():.5f} "
+        f"vs oracle std {ora_norms.std():.5f}"
+    )
+    # sampling must actually vary the outcome (guards against a
+    # vacuous pass where both paths silently run full-batch)
+    assert dev_norms.std() > 0 and ora_norms.std() > 0
+
+
+def test_sampled_oracle_empty_iterations_leave_weights_unchanged():
+    """MLlib semantics: a sampled-empty iteration performs no update.
+    With a fraction tiny enough that every draw over 8 rows is empty,
+    the oracle must return zero weights (and run all iterations)."""
+    x = np.ones((8, 4))
+    y = np.ones(8)
+    w, history, it = mllib_oracle.run_gradient_descent(
+        x, y, loss="logistic", num_iterations=5,
+        mini_batch_fraction=1e-12, seed=3, reg_param=0.0,
+    )
+    assert np.all(w == 0.0)
+    assert history == []
+    assert it == 5
+
+
+def test_full_batch_path_is_seed_invariant():
+    """fraction=1.0 must ignore the seed entirely (deterministic
+    treeAggregate order) — on device and in the oracle."""
+    x, y = _sweep_dataset()
+    w_a = sgd.train_linear(
+        x, y, sgd.SGDConfig(num_iterations=10, seed=1)
+    )
+    w_b = sgd.train_linear(
+        x, y, sgd.SGDConfig(num_iterations=10, seed=99)
+    )
+    np.testing.assert_array_equal(w_a, w_b)
+    o_a, _, _ = mllib_oracle.run_gradient_descent(
+        x, y, loss="logistic", num_iterations=10, reg_param=0.0, seed=1
+    )
+    o_b, _, _ = mllib_oracle.run_gradient_descent(
+        x, y, loss="logistic", num_iterations=10, reg_param=0.0, seed=99
+    )
+    np.testing.assert_array_equal(o_a, o_b)
