@@ -23,14 +23,23 @@ train-step variants (tools/ingest_bench.py) with HBM-roofline context:
 
 Resilience contract (round-1 BENCH artifact died rc=1 on a single
 ``Unable to initialize backend 'axon': UNAVAILABLE``): the parent
-process never touches JAX. It probes the TPU backend in a
-timeout-guarded subprocess with bounded backoff; each variant then
-runs in its own fresh child with its own deadline, and a variant
-failure is recorded in the payload instead of killing the artifact.
-If the TPU never becomes available within the retry budget, the same
-measurements run on CPU and the JSON line says so via
-``"platform": "cpu_fallback"`` — a parseable, honest number instead of
-a dead artifact.
+process never touches JAX. It probes the TPU backend in a subprocess
+(tools/probe_tpu.py — device enumeration AND one jitted op, so a
+tunnel that lists devices but cannot compile is caught here instead
+of burning every variant's timeout); each variant then runs in its
+own fresh child with its own deadline, and a variant failure is
+recorded in the payload instead of killing the artifact. If the TPU
+is not available, the same measurements run on CPU and the JSON line
+says so via ``"platform": "cpu_fallback"`` — a parseable, honest
+number instead of a dead artifact.
+
+Probe design vs the axon tunnel's observed failure modes: ONE
+generous probe (default 420 s, ``BENCH_PROBE_TIMEOUT``) instead of
+round 2's five short timeout-killed attempts — a healthy-but-cold
+tunnel inits well inside the budget, a down-but-failing-fast tunnel
+surfaces UNAVAILABLE by itself at ~25 min (we stop waiting at the
+budget), and killing a probe mid-init is the known tunnel-wedging
+event, so fewer, longer probes strictly reduce wedge exposure.
 """
 
 import json
@@ -44,9 +53,9 @@ sys.path.insert(0, _REPO_ROOT)
 
 BASELINE_EPOCHS_PER_SEC = 50_000.0
 
-# Backend probe schedule: attempt, then sleep; total budget ~4 min.
-_PROBE_TIMEOUT_S = 75
-_PROBE_SLEEPS_S = (10, 20, 40, 60)
+# One generous probe (see docstring): healthy cold init is ~1-2 min,
+# and short timeout-killed probes are the tunnel-wedging event.
+_PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT", 420))
 # One real-chip measurement (includes ~20-40s first compile).
 _RUN_TIMEOUT_S = int(os.environ.get("BENCH_RUN_TIMEOUT", 420))
 # Total wall budget for the variant loop: the headline always runs;
@@ -100,39 +109,48 @@ _VARIANTS_CPU = {
 assert len(_VARIANTS_TPU) == len(_VARIANTS_CPU) == _N_VARIANTS
 
 
-def _probe_tpu_once() -> bool:
-    """True iff a fresh interpreter can enumerate the axon devices."""
-    try:
-        proc = subprocess.run(
-            [
-                sys.executable,
-                "-c",
-                "import jax; d = jax.devices(); "
-                "print(d[0].platform, len(d))",
-            ],
-            timeout=_PROBE_TIMEOUT_S,
-            capture_output=True,
-            text=True,
-        )
-    except subprocess.TimeoutExpired:
-        return False
-    return proc.returncode == 0
-
-
 def _tpu_available() -> bool:
+    """One generous kill-averse probe: device enumeration + a jitted
+    op on a real accelerator platform (tools/probe_tpu.py prints one
+    JSON line and returns on its own; the subprocess timeout is a
+    last resort, not the schedule)."""
     if os.environ.get("BENCH_FORCE_CPU") == "1":
         return False
-    for i, sleep_s in enumerate((*_PROBE_SLEEPS_S, 0)):
-        if _probe_tpu_once():
-            return True
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            os.path.join(_REPO_ROOT, "tools", "probe_tpu.py"),
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+    )
+    deadline = time.monotonic() + _PROBE_TIMEOUT_S
+    while proc.poll() is None and time.monotonic() < deadline:
+        time.sleep(2)
+    if proc.poll() is None:
+        # Budget exhausted while the probe is still mid device-init:
+        # ABANDON it, never kill it — SIGKILLing an axon process
+        # mid-init is the known tunnel-wedging event. The orphan
+        # finishes (or errors) on its own and exits.
         print(
-            f"bench: TPU probe {i + 1} failed; "
-            f"retrying in {sleep_s}s" if sleep_s else "bench: TPU unavailable",
+            f"bench: TPU probe still initializing after "
+            f"{_PROBE_TIMEOUT_S}s; abandoning it (no kill) and "
+            f"falling back to CPU",
             file=sys.stderr,
         )
-        if sleep_s:
-            time.sleep(sleep_s)
-    return False
+        return False
+    stdout = proc.stdout.read() if proc.stdout else ""
+    try:
+        out = json.loads(stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        print(f"bench: unparseable probe output: {stdout[-200:]}",
+              file=sys.stderr)
+        return False
+    ok = bool(out.get("ok")) and out.get("platform") in ("axon", "tpu")
+    if not ok:
+        print(f"bench: TPU unavailable ({out})", file=sys.stderr)
+    return ok
 
 
 def _cpu_env() -> dict:

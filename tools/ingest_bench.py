@@ -15,6 +15,11 @@ Variants:
   einsum_bf16     the headline with bfloat16 epochs resident (half the
                   HBM bytes; ~2e-3 feature deviation, classification
                   unchanged on the fixture — fe=dwt-8-tpu-bf16)
+  einsum_bf16_flat  bf16-resident epochs in the channel-flat (B, C*T)
+                  layout against the block-diagonal operator: isolates
+                  whether the bf16 twin's roofline shortfall (55.2% vs
+                  f32's 68.6%, VERDICT r2) is (B, C, T) tiling at 2-byte
+                  elements or inherent to bf16 HBM streams
   xla_ingest      int16 raw + irregular markers -> features via the
                   XLA gather formulation (ops/device_ingest.py)
   block_ingest    int16 raw + irregular markers -> features via the
@@ -113,7 +118,10 @@ def run(variant: str, n: int, iters: int) -> dict:
     rng = np.random.RandomState(0)
     res = np.array([0.1, 0.1, 0.2], np.float32)
 
-    if variant in ("einsum", "einsum_2d", "einsum_bf16", "einsum_flat"):
+    if variant in (
+        "einsum", "einsum_2d", "einsum_bf16", "einsum_flat",
+        "einsum_bf16_flat",
+    ):
         from eeg_dataanalysispackage_tpu.ops import dwt as dwt_xla
 
         # A/B variants derive geometry from the extractor's own
@@ -137,7 +145,7 @@ def run(variant: str, n: int, iters: int) -> dict:
             extract = dwt_xla.make_batched_extractor()
         elif variant == "einsum_bf16":
             extract = dwt_xla.make_batched_extractor(dtype=jnp.bfloat16)
-        elif variant == "einsum_flat":
+        elif variant in ("einsum_flat", "einsum_bf16_flat"):
             # channel-flat layout: (B, C*T) against a block-diagonal
             # operator; 3x the MACs (zeros) but zero layout questions
             blk = np.zeros((T, fsize), np.float32)
@@ -147,11 +155,21 @@ def run(variant: str, n: int, iters: int) -> dict:
             bd = np.zeros((C * T, C * fsize), np.float32)
             for c in range(C):
                 bd[c * T : (c + 1) * T, c * fsize : (c + 1) * fsize] = blk
+            # the bf16 twin must be bf16 x bf16 like einsum_bf16
+            # (epoch_features casts its kernel to the epoch dtype) —
+            # an f32 operator would promote the batch and confound
+            # the layout A/B with a dtype-regime change
+            op_dtype = (
+                jnp.bfloat16
+                if variant == "einsum_bf16_flat"
+                else jnp.float32
+            )
+            bd_dev = jnp.asarray(bd, dtype=op_dtype)
 
             @jax.jit
             def extract(xflat):
                 y = jax.lax.dot_general(
-                    xflat, jnp.asarray(bd), (((1,), (0,)), ((), ())),
+                    xflat, bd_dev, (((1,), (0,)), ((), ())),
                     precision=jax.lax.Precision.HIGHEST,
                 )
                 return dwt_xla.safe_l2_normalize(y)
@@ -175,11 +193,15 @@ def run(variant: str, n: int, iters: int) -> dict:
                 )
                 return dwt_xla.safe_l2_normalize(y.reshape(B, C * fsize))
 
-        shape = (n, 3 * 1000) if variant == "einsum_flat" else (n, 3, 1000)
+        shape = (
+            (n, 3 * 1000)
+            if variant in ("einsum_flat", "einsum_bf16_flat")
+            else (n, 3, 1000)
+        )
         epochs = jax.random.normal(
             jax.random.PRNGKey(0), shape, dtype=jnp.float32
         ) * 50.0
-        if variant == "einsum_bf16":
+        if variant in ("einsum_bf16", "einsum_bf16_flat"):
             # bf16-RESIDENT epochs: the HBM bytes halve only if the
             # array in memory is bf16, not merely cast inside the jit
             epochs = epochs.astype(jnp.bfloat16)

@@ -59,8 +59,17 @@ run rf_predict    600 python tools/ingest_bench.py rf_predict 262144 10
 run einsum_flat   600 python tools/ingest_bench.py einsum_flat 262144 50
 run einsum_2d     600 python tools/ingest_bench.py einsum_2d 262144 50
 run einsum_bf16   600 python tools/ingest_bench.py einsum_bf16 262144 50
+# bf16 roofline-gap diagnostics (VERDICT r2 item 4): layout A/B at
+# 2-byte elements, plus batch-size halving/doubling for dispatch
+# amortization
+run einsum_bf16_flat 600 python tools/ingest_bench.py einsum_bf16_flat 262144 50
+run einsum_bf16_131k 600 python tools/ingest_bench.py einsum_bf16 131072 50
+run einsum_bf16_524k 600 python tools/ingest_bench.py einsum_bf16 524288 50
 run train_step    600 python tools/ingest_bench.py train_step 131072 20
-run bench_full   2400 python bench.py
+# outer timeout must exceed bench.py's worst case (probe 420 +
+# variant budget 1500 + one variant overrun 420) so the watcher never
+# SIGTERMs bench mid-variant
+BENCH_TOTAL_BUDGET=1500 run bench_full 3600 python bench.py
 run pallas_ingest 900 python tools/ingest_bench.py pallas_ingest 131072 20
 run pallas_bisect 900 python tools/pallas_compile_bisect.py
 log "collection complete"
