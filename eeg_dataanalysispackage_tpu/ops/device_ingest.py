@@ -593,6 +593,14 @@ def _make_regular_ingest_featurizer(
         return _ingest_jit(raw_i16, resolutions, first)
 
     ingest.formulation = formulation
+    # inner jitted programs, exposed for compiled-HLO/cost inspection
+    # (tools/cost_report.py; same pattern as parallel/*._sharded_jit)
+    ingest._jit = _ingest_jit  # None for phase (wrapper dispatches)
+    ingest._phase_jit = _ingest_phase if formulation == "phase" else None
+    ingest._phase_tables = _phase_tables if formulation == "phase" else None
+    ingest._phase_geometry = (
+        (_M_groups, _ROW) if formulation == "phase" else None
+    )
     return ingest
 
 

@@ -1,0 +1,138 @@
+"""XLA cost-model report for the hot programs (bytes/flops per epoch).
+
+For each key jitted program, compile it and print XLA's own
+``cost_analysis()`` — bytes accessed and flops — normalized per epoch,
+next to the hand-derived bytes from ``docs/ingest_kernel.md``. The
+point: when a real-chip number comes in below roofline, the first
+question is whether the *compiled program* moves more bytes than the
+design assumed (relayout copies, materialized intermediates) or
+whether the bytes are right and the gap is elsewhere (dispatch,
+bandwidth ceiling, tiling). The cost model answers that without a
+device: it is computed from the optimized HLO.
+
+Usage: python tools/cost_report.py [n_epochs]  (default 32768; runs on
+whatever backend is default — use the env-level CPU recipe for a
+hermetic run, or the real chip for the deployed layout).
+
+Prints one JSON line per program:
+  {"program", "bytes_accessed_per_epoch", "design_bytes_per_epoch",
+   "flops_per_epoch", "bytes_ratio", ...}
+``bytes_ratio`` > ~1.5 means the compiled program moves materially
+more than the design — look for relayouts/materializations in the HLO.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _cost(jitted, *args) -> dict:
+    compiled = jitted.lower(*args).compile()
+    ca = compiled.cost_analysis()
+    # cost_analysis returns a dict (or list of dicts on older jax)
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0]
+    return dict(ca or {})
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from eeg_dataanalysispackage_tpu.ops import device_ingest, dwt as dwt_xla
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 32768
+    platform = jax.devices()[0].platform
+
+    def report(name, jitted, args, design_bytes):
+        # one line per program, printed AS PRODUCED: a later program's
+        # compile failure (remote-compile crash, missing cost keys)
+        # must not discard minutes of already-spent chip compiles
+        try:
+            c = _cost(jitted, *args)
+        except Exception as e:  # noqa: BLE001 — tool must keep going
+            print(json.dumps({"program": name, "error": str(e)[:300]}))
+            sys.stdout.flush()
+            return
+        bytes_acc = c.get("bytes accessed")
+        flops = c.get("flops")
+        line = {
+            "program": name,
+            "platform": platform,
+            "n_epochs": n,
+            "bytes_accessed_per_epoch": (
+                round(float(bytes_acc) / n, 1)
+                if bytes_acc is not None
+                else None
+            ),
+            "design_bytes_per_epoch": design_bytes,
+            "bytes_ratio": (
+                round(float(bytes_acc) / n / design_bytes, 3)
+                if bytes_acc is not None and design_bytes
+                else None
+            ),
+            "flops_per_epoch": (
+                round(float(flops) / n, 1) if flops is not None else None
+            ),
+        }
+        print(json.dumps(line))
+        sys.stdout.flush()
+
+    # headline: f32 epochs resident -> features (12 KB/epoch design)
+    extract = dwt_xla.make_batched_extractor()
+    epochs = jax.ShapeDtypeStruct((n, 3, 1000), jnp.float32)
+    report("einsum", extract, (epochs,), 3 * 1000 * 4)
+
+    # bf16 twin (6 KB/epoch design)
+    extract_bf16 = dwt_xla.make_batched_extractor(dtype=jnp.bfloat16)
+    epochs_bf16 = jax.ShapeDtypeStruct((n, 3, 1000), jnp.bfloat16)
+    report("einsum_bf16", extract_bf16, (epochs_bf16,), 3 * 1000 * 2)
+
+    # regular int16 ingest, each formulation (4.8 KB/epoch design)
+    stride = 800
+    S = 200 + n * stride + 2 * 3200
+    raw = jax.ShapeDtypeStruct((3, S), jnp.int16)
+    res = jax.ShapeDtypeStruct((3,), jnp.float32)
+    for formulation in ("reshape", "conv", "phase"):
+        ing = device_ingest.make_regular_ingest_featurizer(
+            stride, n, formulation=formulation
+        )
+        if formulation == "phase":
+            # the public wrapper plans the aligned slab host-side;
+            # cost the inner jitted program exactly as the wrapper
+            # calls it (phase-0 tables, slab start 0)
+            tables = ing._phase_tables(0)
+            report(
+                "regular_phase",
+                ing._phase_jit,
+                (raw, res, 0, *tables),
+                3 * stride * 2,
+            )
+        else:
+            report(
+                f"regular_{formulation}",
+                ing._jit,
+                (raw, res, 200),
+                3 * stride * 2,
+            )
+
+    # block irregular ingest. Design bytes are the formulation's OWN
+    # budget from docs/ingest_kernel.md (~61 KB/epoch: slab write+read
+    # ~12 KB + the (C, n, BLK, K) variant tensor ~49 KB) — the
+    # intermediates are inherent to the variant-bank design, so a
+    # ratio near 1 is healthy and >1.5 still means unexpected copies.
+    cap = ((n + 63) // 64) * 64
+    block = device_ingest.make_block_ingest_featurizer()
+    args = (
+        jax.ShapeDtypeStruct((3, 200 + n * stride + 1000), jnp.int16),
+        res,
+        jax.ShapeDtypeStruct((cap,), jnp.int32),
+        jax.ShapeDtypeStruct((cap,), jnp.bool_),
+    )
+    report("block_ingest", block, args, 61_000)
+
+
+if __name__ == "__main__":
+    main()

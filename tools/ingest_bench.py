@@ -28,6 +28,9 @@ Variants:
                   replacement for the element gather
   pallas_ingest   int16 raw + irregular markers -> features via the
                   fused Pallas kernel (ops/ingest_pallas.py)
+  pallas_dwt      f32 epochs resident -> features via the Pallas
+                  epochs-resident kernel (ops/dwt_pallas.py) — the
+                  Mosaic compile-health canary for the Pallas stack
   regular_ingest  int16 raw + regular stimulus train -> features, no
                   gather (static window formation); the formulation
                   (reshape | conv | phase, see device_ingest) defaults
@@ -120,7 +123,7 @@ def run(variant: str, n: int, iters: int) -> dict:
 
     if variant in (
         "einsum", "einsum_2d", "einsum_bf16", "einsum_flat",
-        "einsum_bf16_flat",
+        "einsum_bf16_flat", "pallas_dwt",
     ):
         from eeg_dataanalysispackage_tpu.ops import dwt as dwt_xla
 
@@ -143,6 +146,15 @@ def run(variant: str, n: int, iters: int) -> dict:
 
         if variant == "einsum":
             extract = dwt_xla.make_batched_extractor()
+        elif variant == "pallas_dwt":
+            # epochs-resident Pallas extractor: compiled to Mosaic on
+            # chip in round 2 (~9.8M eps at tile_b=128) — serves as
+            # the remote-compile health canary for the Pallas stack
+            # (its construct profile lacks the ingest kernel's scalar-
+            # prefetch index maps / int16 loads / aliased inputs)
+            from eeg_dataanalysispackage_tpu.ops import dwt_pallas
+
+            extract = dwt_pallas.make_batched_extractor_pallas()
         elif variant == "einsum_bf16":
             extract = dwt_xla.make_batched_extractor(dtype=jnp.bfloat16)
         elif variant in ("einsum_flat", "einsum_bf16_flat"):

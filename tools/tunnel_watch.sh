@@ -70,6 +70,19 @@ run train_step    600 python tools/ingest_bench.py train_step 131072 20
 # variant budget 1500 + one variant overrun 420) so the watcher never
 # SIGTERMs bench mid-variant
 BENCH_TOTAL_BUDGET=1500 run bench_full 3600 python bench.py
+# compile-only: XLA cost model (bytes/epoch) for the TPU-compiled hot
+# programs — answers "does the compiled program move more bytes than
+# the design assumed" for every below-roofline number above. 3600s:
+# ~6 fresh chip compiles in one process; a SIGTERM mid-remote-compile
+# is the wedging event, so this gets the most generous budget of all
+# (and the tool prints each program's line as it completes, so even a
+# timeout preserves the finished ones)
+run cost_report  3600 python tools/cost_report.py 32768
+# pallas_dwt first: it compiled to Mosaic on chip in round 2, so it
+# separates "remote compiler regressed globally" from "the ingest
+# kernel's construct delta (scalar-prefetch index maps / int16 loads /
+# aliased inputs / dynamic lane slices) is the crasher"
+run pallas_dwt    900 python tools/ingest_bench.py pallas_dwt 131072 20
 run pallas_ingest 900 python tools/ingest_bench.py pallas_ingest 131072 20
 run pallas_bisect 900 python tools/pallas_compile_bisect.py
 log "collection complete"
