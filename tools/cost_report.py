@@ -102,12 +102,18 @@ def main() -> None:
         if formulation == "phase":
             # the public wrapper plans the aligned slab host-side;
             # cost the inner jitted program exactly as the wrapper
-            # calls it (phase-0 tables, slab start 0)
+            # calls it (phase-0 tables, slab start 0). The raw length
+            # must cover the aligned slab, whose geometry the
+            # featurizer itself exports.
+            m_groups, row = ing._phase_geometry
+            raw_phase = jax.ShapeDtypeStruct(
+                (3, max(S, (m_groups + 1) * row)), jnp.int16
+            )
             tables = ing._phase_tables(0)
             report(
                 "regular_phase",
                 ing._phase_jit,
-                (raw, res, 0, *tables),
+                (raw_phase, res, 0, *tables),
                 3 * stride * 2,
             )
         else:

@@ -1,0 +1,107 @@
+"""Merge a sweep/watcher output directory into one markdown table.
+
+Reads every ``*.json`` in the given directory (one JSON line per file,
+the format ``tools/ingest_bench.py`` / ``bench.py`` /
+``tpu_parity_check.py`` / ``cost_report.py`` emit), and prints a
+BASELINE.md-ready markdown table plus a short parity/bench digest —
+the post-recovery bookkeeping (`BASELINE.md` "Achieved" rows,
+`docs/ingest_kernel.md` Measured table) without hand-transcription.
+
+Usage: python tools/summarize_sweep.py [/tmp/tunnel_watch]
+"""
+
+import json
+import os
+import sys
+
+
+def _load(path: str):
+    """Parse every JSON line of a file (most tools print exactly one;
+    cost_report prints one per program — all are kept)."""
+    try:
+        with open(path) as f:
+            lines = [ln.strip() for ln in f if ln.strip()]
+    except OSError:
+        return None
+    out = []
+    for ln in lines:
+        if ln.startswith("{"):
+            try:
+                out.append(json.loads(ln))
+            except ValueError:
+                pass
+    return out or None
+
+
+def main() -> None:
+    d = sys.argv[1] if len(sys.argv) > 1 else "/tmp/tunnel_watch"
+    if not os.path.isdir(d):
+        sys.exit(f"no such directory: {d}")
+    names = sorted(
+        f[:-5] for f in os.listdir(d) if f.endswith(".json")
+    )
+    if not names:
+        sys.exit(f"no *.json in {d}")
+
+    bench_rows = []
+    cost_rows = []
+    other = {}
+    for name in names:
+        docs = _load(os.path.join(d, f"{name}.json"))
+        if not docs:
+            other.setdefault(name, []).append("EMPTY (see .err)")
+            continue
+        for doc in docs:
+            if "epochs_per_s" in doc:
+                bench_rows.append((name, doc))
+            elif "bytes_accessed_per_epoch" in doc or (
+                "program" in doc and "error" in doc
+            ):
+                cost_rows.append(doc)
+            else:
+                other.setdefault(name, []).append(doc)
+
+    if bench_rows:
+        print("## Measured variants\n")
+        print(
+            "| artifact | variant | epochs/s | % HBM roofline |"
+            " formulation | platform |"
+        )
+        print("|---|---|---|---|---|---|")
+        for name, doc in bench_rows:
+            eps = doc.get("epochs_per_s")
+            eps_s = f"{eps / 1e6:.2f} M" if eps and eps > 1e5 else f"{eps}"
+            print(
+                f"| {name} | {doc.get('variant', '')} | {eps_s} "
+                f"| {doc.get('pct_of_hbm_roofline', '')} "
+                f"| {doc.get('formulation', '')} "
+                f"| {doc.get('platform', '')} |"
+            )
+        print()
+
+    if cost_rows:
+        print("## Cost model (bytes/epoch, compiled)\n")
+        print("| program | bytes/epoch | design | ratio | flops/epoch |")
+        print("|---|---|---|---|---|")
+        for doc in cost_rows:
+            if "error" in doc:
+                err = doc["error"][:60].replace("|", "/").replace("\n", " ")
+                print(f"| {doc['program']} | ERROR: {err} ||||")
+                continue
+            print(
+                f"| {doc['program']} | {doc['bytes_accessed_per_epoch']} "
+                f"| {doc['design_bytes_per_epoch']} "
+                f"| {doc['bytes_ratio']} | {doc['flops_per_epoch']} |"
+            )
+        print()
+
+    for name, docs in other.items():
+        print(f"## {name}\n")
+        print("```json")
+        for doc in docs:
+            print(json.dumps(doc, indent=1)[:2000])
+        print("```\n")
+
+
+if __name__ == "__main__":
+    main()
