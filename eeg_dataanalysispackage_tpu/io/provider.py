@@ -146,6 +146,8 @@ class OfflineDataProvider:
         prefix, files = self._resolve_files()
         balance = BalanceState()
         if backend == "pallas":
+            import os
+
             from ..ops import ingest_pallas
 
             pallas_featurizer = ingest_pallas.make_pallas_ingest_featurizer(
@@ -154,6 +156,10 @@ class OfflineDataProvider:
                 skip_samples=skip_samples,
                 feature_size=feature_size,
                 pre=self._pre,
+                # "aligned8" = every dynamic lane slice on a sublane
+                # boundary (the remote-compile-crash fix path); the
+                # default stays "exact" until chip evidence flips it
+                mode=os.environ.get("EEG_PALLAS_MODE", "exact"),
             )
         if backend == "block":
             featurizer = device_ingest.make_block_ingest_featurizer(

@@ -71,6 +71,41 @@ class PallasTilePlan:
 DEFAULT_WINDOW = 792  # ((100 + 175 + 512) + 7) // 8 * 8 — 787 live + slack
 
 
+def kernel_window(
+    mode: str,
+    pre: int = constants.PRESTIMULUS_SAMPLES,
+    skip_samples: int = 175,
+    epoch_size: int = 512,
+) -> int:
+    """Kernel segment width for a mode (single source for the library
+    and the bench): ``exact`` pads the live window to 8; ``aligned8``
+    additionally covers the residual 0..7 shift."""
+    live = pre + skip_samples + epoch_size
+    if mode == "aligned8":
+        return -(-(live + _ALIGN - 1) // _ALIGN) * _ALIGN
+    if mode == "exact":
+        return ((live + 7) // 8) * 8
+    raise ValueError(f"unknown pallas ingest mode {mode!r}")
+
+
+def aligned8_banks(
+    wavelet_index: int = 8,
+    epoch_size: int = 512,
+    skip_samples: int = 175,
+    feature_size: int = 16,
+    pre: int = constants.PRESTIMULUS_SAMPLES,
+):
+    """(Wv, Mv, colsum, window8) for the aligned8 kernel — the shared
+    constructor the featurizer and the bench both use, so a geometry
+    change cannot leave the bench timing a stale kernel shape."""
+    window8 = kernel_window("aligned8", pre, skip_samples, epoch_size)
+    Wv, Mv, colsum = device_ingest._shift_variant_banks(
+        wavelet_index, epoch_size, skip_samples, feature_size, pre,
+        window8, _ALIGN,
+    )
+    return Wv, Mv, colsum, window8
+
+
 def plan_pallas_tiles(
     positions: np.ndarray,
     pre: int = constants.PRESTIMULUS_SAMPLES,
@@ -158,6 +193,75 @@ def _make_kernel(
     return kernel
 
 
+#: aligned8 mode: residual-shift variant count (one sublane's worth).
+_ALIGN = 8
+
+
+def _make_kernel_aligned(
+    n_channels: int, tile_b: int, window8: int, chunk: int,
+    feature_size: int,
+):
+    """The ``aligned8`` kernel: every dynamic lane slice lands on an
+    8-aligned (sublane) offset.
+
+    The exact kernel's ``pl.ds(off, window)`` at an *arbitrary* sample
+    offset is the one construct the Mosaic-compiled twin
+    (``ops/dwt_pallas.py``, chip-proven round 2) does not use, making
+    it the prime remote-compile-crash suspect. Here the host rounds
+    each window start down to a multiple of 8 and the kernel cuts a
+    ``window8``-wide segment at that aligned offset (``pl.multiple_of``
+    hint); the residual shift (0..7) never moves data — an 8-variant
+    operator bank (``device_ingest._shift_variant_banks``: variant v =
+    the window operator shifted down v rows) computes all 8 shifts'
+    features in one MXU contraction and a per-epoch one-hot sum
+    selects the right one on the VPU. Baseline correction follows the
+    block formulation's f32-safe shape: per-epoch segment mean as the
+    exactly-invariant DC proxy pre-contraction, then the two-term
+    pre-mean correction post-selection, all terms at residual scale.
+    """
+    half = chunk // 2
+    K = feature_size
+
+    def kernel(half_ref, offs_ref, shifts_ref, a_ref, b_ref, res_ref,
+               wv_ref, mv_ref, cs_ref, o_ref, chunk_ref, xa_ref):
+        i = pl.program_id(0)
+        chunk_ref[:, :half] = a_ref[:].astype(jnp.float32) * res_ref[:]
+        chunk_ref[:, half:] = b_ref[:].astype(jnp.float32) * res_ref[:]
+        for e in range(tile_b):
+            off8 = pl.multiple_of(offs_ref[i, e], _ALIGN)
+            seg = chunk_ref[:, pl.ds(off8, window8)]
+            # per-epoch segment mean: a constant the baseline algebra
+            # cancels exactly; keeps the two cancelling terms below at
+            # residual scale (f32-safe, same analysis as block ingest)
+            d = jnp.mean(seg, axis=1, keepdims=True)
+            xa_ref[e * n_channels : (e + 1) * n_channels, :] = seg - d
+        yv = lax.dot_general(
+            xa_ref[:], wv_ref[:], (((1,), (0,)), ((), ())),
+            precision=lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        )  # (tile_b*C, 8*K) — all 8 shifts' features
+        pv = lax.dot_general(
+            xa_ref[:], mv_ref[:], (((1,), (0,)), ((), ())),
+            precision=lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        )  # (tile_b*C, 8) — all 8 shifts' pre-means
+        sh = shifts_ref[i]  # (tile_b,)
+        onehot = (
+            sh[:, None]
+            == lax.broadcasted_iota(jnp.int32, (tile_b, _ALIGN), 1)
+        ).astype(jnp.float32)
+        yb = yv.reshape(tile_b, n_channels, _ALIGN, K)
+        pb = pv.reshape(tile_b, n_channels, _ALIGN)
+        yk = jnp.sum(yb * onehot[:, None, :, None], axis=2)
+        pk = jnp.sum(pb * onehot[:, None, :], axis=2)
+        feats = yk - pk[..., None] * cs_ref[:]
+        o_ref[:] = dwt_xla.safe_l2_normalize(
+            feats.reshape(tile_b, n_channels * K)
+        )
+
+    return kernel
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -205,6 +309,63 @@ def _ingest_tiles(
     )(half_idx, offsets, raw_i16, raw_i16, resolutions[:, None], E)
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "tile_b", "chunk", "window8", "feature_size", "interpret",
+    ),
+)
+def _ingest_tiles_aligned(
+    raw_i16,
+    resolutions,
+    half_idx,
+    offsets8,
+    shifts,
+    Wv,
+    Mv,
+    colsum,
+    *,
+    tile_b: int,
+    chunk: int,
+    window8: int,
+    feature_size: int,
+    interpret: bool,
+):
+    C = raw_i16.shape[0]
+    n_tiles = half_idx.shape[0]
+    half = chunk // 2
+    K = C * feature_size
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,  # half_idx, offsets8, shifts
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((C, half), lambda i, hi, off, sh: (0, hi[i])),
+            pl.BlockSpec((C, half), lambda i, hi, off, sh: (0, hi[i] + 1)),
+            pl.BlockSpec((C, 1), lambda i, hi, off, sh: (0, 0)),
+            pl.BlockSpec(
+                (window8, _ALIGN * feature_size),
+                lambda i, hi, off, sh: (0, 0),
+            ),
+            pl.BlockSpec((window8, _ALIGN), lambda i, hi, off, sh: (0, 0)),
+            pl.BlockSpec((1, feature_size), lambda i, hi, off, sh: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((tile_b, K), lambda i, hi, off, sh: (i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((C, chunk), jnp.float32),
+            pltpu.VMEM((tile_b * C, window8), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        _make_kernel_aligned(C, tile_b, window8, chunk, feature_size),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n_tiles * tile_b, K), jnp.float32),
+        interpret=interpret,
+    )(
+        half_idx, offsets8, shifts, raw_i16, raw_i16,
+        resolutions[:, None], Wv, Mv, colsum,
+    )
+
+
 def ingest_features_pallas(
     raw_i16: np.ndarray,
     resolutions: np.ndarray,
@@ -217,6 +378,7 @@ def ingest_features_pallas(
     chunk: int = 65536,
     tile_b: int = 32,
     interpret: bool | None = None,
+    mode: str = "exact",
 ) -> jnp.ndarray:
     """(C, S) int16 raw + (n,) marker positions -> (n, C*K) features.
 
@@ -224,21 +386,29 @@ def ingest_features_pallas(
     ``device_ingest.make_device_ingest_featurizer``; positions must be
     pre-validated (plan_ingest). Output rows are in input marker
     order.
+
+    ``mode``:
+
+    - ``"exact"``: the original kernel — windows cut by a dynamic
+      lane slice at the exact sample offset, explicit pre-stimulus
+      baseline subtraction before one contraction.
+    - ``"aligned8"``: every dynamic lane slice 8-aligned (sublane
+      boundary, ``pl.multiple_of``); the residual 0..7 shift is
+      absorbed by an 8-variant operator bank + one-hot select (see
+      :func:`_make_kernel_aligned`). Built as the fix path for the
+      axon remote-compile crash, whose prime suspect is the exact
+      kernel's arbitrary-offset lane slice (the chip-proven
+      ``dwt_pallas`` kernel differs from it mainly by that construct);
+      numerics follow the block formulation's f32-safe two-term shape
+      (parity pinned in tests/test_ingest_pallas.py).
     """
     if interpret is None:
         from . import pallas_support
 
         interpret = pallas_support.default_interpret()
-    live = pre + skip_samples + epoch_size
-    window = ((live + 7) // 8) * 8  # alignment slack; E zero past live
+    window = kernel_window(mode, pre, skip_samples, epoch_size)
     plan = plan_pallas_tiles(
         positions, pre=pre, window=window, chunk=chunk, tile_b=tile_b
-    )
-    E = jnp.asarray(
-        device_ingest.ingest_matrix(
-            wavelet_index, epoch_size, skip_samples, feature_size, pre,
-            window_len=window, fold_baseline=False,
-        )
     )
     half = chunk // 2
     # Bucket both jit-cache keys so multi-recording runs reuse the
@@ -268,19 +438,49 @@ def ingest_features_pallas(
               // sample_bucket) * sample_bucket
     if padded != S:
         raw_i16 = np.pad(raw_i16, ((0, 0), (0, padded - S)))
-    tiled = _ingest_tiles(
-        jnp.asarray(raw_i16),
-        jnp.asarray(resolutions, jnp.float32),
-        jnp.asarray(plan.half_idx),
-        jnp.asarray(plan.offsets),
-        E,
-        tile_b=tile_b,
-        chunk=chunk,
-        window=window,
-        feature_size=feature_size,
-        interpret=bool(interpret),
-        pre=pre,
-    )
+    if mode == "aligned8":
+        Wv_np, Mv_np, colsum_np, _ = aligned8_banks(
+            wavelet_index, epoch_size, skip_samples, feature_size, pre
+        )
+        # tile bases are half-chunk aligned (half % 8 == 0), so the
+        # tile-relative offset and the absolute start agree mod 8
+        offsets8 = plan.offsets & ~(_ALIGN - 1)
+        shifts = plan.offsets & (_ALIGN - 1)
+        tiled = _ingest_tiles_aligned(
+            jnp.asarray(raw_i16),
+            jnp.asarray(resolutions, jnp.float32),
+            jnp.asarray(plan.half_idx),
+            jnp.asarray(offsets8),
+            jnp.asarray(shifts),
+            jnp.asarray(Wv_np),
+            jnp.asarray(Mv_np),
+            jnp.asarray(colsum_np)[None, :],
+            tile_b=tile_b,
+            chunk=chunk,
+            window8=window,
+            feature_size=feature_size,
+            interpret=bool(interpret),
+        )
+    else:
+        E = jnp.asarray(
+            device_ingest.ingest_matrix(
+                wavelet_index, epoch_size, skip_samples, feature_size, pre,
+                window_len=window, fold_baseline=False,
+            )
+        )
+        tiled = _ingest_tiles(
+            jnp.asarray(raw_i16),
+            jnp.asarray(resolutions, jnp.float32),
+            jnp.asarray(plan.half_idx),
+            jnp.asarray(plan.offsets),
+            E,
+            tile_b=tile_b,
+            chunk=chunk,
+            window=window,
+            feature_size=feature_size,
+            interpret=bool(interpret),
+            pre=pre,
+        )
     # unsort: tiled row t*tile_b+e holds epoch src_rows[t, e]
     flat_src = plan.src_rows.reshape(-1)
     real = flat_src >= 0
@@ -298,11 +498,14 @@ def make_pallas_ingest_featurizer(
     chunk: int = 65536,
     tile_b: int = 32,
     interpret: bool | None = None,
+    mode: str = "exact",
 ):
     """Callable (raw int16, resolutions, positions) -> features, the
     plug-in counterpart of ``make_device_ingest_featurizer`` for the
     Pallas path (host planning happens per call; the kernel is jitted
-    and cached by shape)."""
+    and cached by shape). ``mode`` selects the kernel formulation —
+    see :func:`ingest_features_pallas`."""
+    kernel_window(mode)  # validate at build time, not first featurize
 
     def featurize(raw_i16, resolutions, positions):
         return ingest_features_pallas(
@@ -317,6 +520,7 @@ def make_pallas_ingest_featurizer(
             chunk=chunk,
             tile_b=tile_b,
             interpret=interpret,
+            mode=mode,
         )
 
     return featurize

@@ -536,3 +536,85 @@ def test_pallas_randomized_differential(fixture_raw, seed, kind):
     )
     want = xla_reference_features(raw, res, positions)
     np.testing.assert_allclose(got, want, rtol=0, atol=5e-6)
+
+
+# -- aligned8 Pallas mode (the remote-compile-crash fix path) ---------
+#
+# Every dynamic lane slice in the aligned8 kernel lands on a sublane
+# (8) boundary; the residual 0..7 shift goes through the 8-variant
+# operator bank + one-hot select. Numerics follow the block
+# formulation's two-term f32-safe shape, so the gate is the block
+# path's 5e-5 (vs the exact kernel's 5e-6 subtract-first gate).
+
+
+def test_pallas_aligned8_matches_xla_ingest(fixture_raw):
+    raw, res = fixture_raw
+    rng = np.random.RandomState(3)
+    positions = rng.choice(
+        np.arange(200, raw.shape[1] - 800), size=41, replace=False
+    ).astype(np.int64)
+    got = np.asarray(
+        ingest_pallas.ingest_features_pallas(
+            raw, res, positions, mode="aligned8"
+        )
+    )
+    want = xla_reference_features(raw, res, positions)
+    assert got.shape == want.shape == (41, 48)
+    np.testing.assert_allclose(got, want, rtol=0, atol=5e-5)
+
+
+def test_pallas_aligned8_covers_every_shift(fixture_raw):
+    """One marker per residual shift 0..7 — each variant column of the
+    bank must select correctly."""
+    raw, res = fixture_raw
+    positions = (4096 + 100 + np.arange(8) * (800 + 1)).astype(np.int64)
+    assert sorted(set((p - 100) % 8 for p in positions)) == list(range(8))
+    got = np.asarray(
+        ingest_pallas.ingest_features_pallas(
+            raw, res, positions, mode="aligned8"
+        )
+    )
+    want = xla_reference_features(raw, res, positions)
+    np.testing.assert_allclose(got, want, rtol=0, atol=5e-5)
+
+
+def test_pallas_aligned8_small_chunk_and_overhang(fixture_raw):
+    raw, res = fixture_raw
+    S = raw.shape[1]
+    positions = np.concatenate([
+        (100 + 173 * np.arange(40)),
+        [S - 300, 5000],  # overhanging window reads zeros
+    ]).astype(np.int64)
+    got = np.asarray(
+        ingest_pallas.ingest_features_pallas(
+            raw, res, positions, chunk=8192, tile_b=8, mode="aligned8"
+        )
+    )
+    want = xla_reference_features(raw, res, positions)
+    np.testing.assert_allclose(got, want, rtol=0, atol=5e-5)
+
+
+@pytest.mark.parametrize("seed", [21, 22])
+def test_pallas_aligned8_randomized_differential(fixture_raw, seed):
+    raw, res = fixture_raw
+    rng = np.random.RandomState(seed)
+    S = raw.shape[1]
+    n = int(rng.randint(5, 100))
+    positions = rng.randint(100, S - 100, size=n).astype(np.int64)
+    chunk = int(rng.choice([8192, 16384, 65536]))
+    tile_b = int(rng.choice([4, 8, 32]))
+    got = np.asarray(
+        ingest_pallas.ingest_features_pallas(
+            raw, res, positions, chunk=chunk, tile_b=tile_b, mode="aligned8"
+        )
+    )
+    want = xla_reference_features(raw, res, positions)
+    np.testing.assert_allclose(got, want, rtol=0, atol=5e-5)
+
+
+def test_pallas_unknown_mode_raises(fixture_raw):
+    raw, res = fixture_raw
+    with pytest.raises(ValueError, match="unknown pallas ingest mode"):
+        ingest_pallas.ingest_features_pallas(
+            raw, res, np.array([5000]), mode="warp"
+        )

@@ -143,6 +143,68 @@ def k6():
     )(x, E)
 
 
+# k4b: dynamic lane slice at an 8-ALIGNED offset with multiple_of hint
+# (the aligned8 kernel's slice shape) — if k4 crashes and this
+# compiles, the fix path is confirmed
+def k4b():
+    def kernel(off_ref, x_ref, o_ref):
+        off = pl.multiple_of(off_ref[0], 8)
+        o_ref[:] = x_ref[:, pl.ds(off, 128)]
+    off = jnp.array([32], jnp.int32)
+    x = jnp.ones((8, 1024), jnp.float32)
+    gs = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1, grid=(1,),
+        in_specs=[pl.BlockSpec((8, 1024), lambda i, off: (0, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i, off: (0, 0)),
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=gs,
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+    )(off, x)
+
+
+# k5b: aligned slice loop + variant-bank contraction + one-hot select
+# (the aligned8 kernel's full compute shape on tiny operands)
+def k5b():
+    W8 = 800
+    def kernel(offs_ref, sh_ref, x_ref, wv_ref, o_ref, xa_ref):
+        for e in range(TILE_B):
+            off = pl.multiple_of(offs_ref[e], 8)
+            seg = x_ref[:, pl.ds(off, W8)]
+            d = jnp.mean(seg, axis=1, keepdims=True)
+            xa_ref[e * CH:(e + 1) * CH, :] = seg - d
+        yv = lax.dot_general(
+            xa_ref[:], wv_ref[:], (((1,), (0,)), ((), ())),
+            precision=lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        )
+        onehot = (
+            sh_ref[:][:, None]
+            == lax.broadcasted_iota(jnp.int32, (TILE_B, 8), 1)
+        ).astype(jnp.float32)
+        yb = yv.reshape(TILE_B, CH, 8, 16)
+        o_ref[:] = jnp.sum(
+            yb * onehot[:, None, :, None], axis=2
+        ).reshape(TILE_B, CH * 16)
+    offs = jnp.array([0, 8, 16, 800], jnp.int32)
+    sh = jnp.array([0, 3, 7, 1], jnp.int32)
+    x = jnp.ones((CH, CHUNK), jnp.float32)
+    wv = jnp.ones((W8, 8 * 16), jnp.float32)
+    gs = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2, grid=(1,),
+        in_specs=[
+            pl.BlockSpec((CH, CHUNK), lambda i, offs, sh: (0, 0)),
+            pl.BlockSpec((W8, 8 * 16), lambda i, offs, sh: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((TILE_B, CH * 16), lambda i, offs, sh: (0, 0)),
+        scratch_shapes=[pltpu.VMEM((TILE_B * CH, W8), jnp.float32)],
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=gs,
+        out_shape=jax.ShapeDtypeStruct((TILE_B, CH * 16), jnp.float32),
+    )(offs, sh, x, wv)
+
+
 # k7: the real _ingest_tiles on tiny shapes
 def k7():
     from eeg_dataanalysispackage_tpu.ops import ingest_pallas, device_ingest
@@ -158,9 +220,20 @@ def k7():
         window=WIN, feature_size=16, interpret=False)
 
 
+# k8: the real aligned8 path end-to-end on tiny shapes
+def k8():
+    from eeg_dataanalysispackage_tpu.ops import ingest_pallas
+    raw = np.ones((CH, 8 * CHUNK), np.int16)
+    res = np.array([0.1, 0.1, 0.2], np.float32)
+    return ingest_pallas.ingest_features_pallas(
+        raw, res, np.array([100, 900, 1700]), chunk=CHUNK, tile_b=TILE_B,
+        interpret=False, mode="aligned8")
+
+
 for name, fn in [("k0_copy", k0), ("k1_prefetch", k1), ("k2_int16", k2),
                  ("k3_scratch_halves", k3), ("k4_dyn_lane_slice", k4),
-                 ("k5_slice_loop", k5), ("k6_dot_highest", k6),
-                 ("k7_full_tiny", k7)]:
+                 ("k4b_aligned_slice", k4b), ("k5_slice_loop", k5),
+                 ("k5b_aligned_bank", k5b), ("k6_dot_highest", k6),
+                 ("k7_full_tiny", k7), ("k8_aligned8_tiny", k8)]:
     step(name, fn)
 print("done", flush=True)

@@ -84,5 +84,9 @@ run cost_report  3600 python tools/cost_report.py 32768
 # aliased inputs / dynamic lane slices) is the crasher"
 run pallas_dwt    900 python tools/ingest_bench.py pallas_dwt 131072 20
 run pallas_ingest 900 python tools/ingest_bench.py pallas_ingest 131072 20
+# the 8-aligned-slice variant-bank kernel: the fix path if the exact
+# kernel's arbitrary-offset lane slice is what crashes the compiler
+BENCH_PALLAS_MODE=aligned8 run pallas_aligned8 900 \
+  python tools/ingest_bench.py pallas_ingest 131072 20
 run pallas_bisect 900 python tools/pallas_compile_bisect.py
 log "collection complete"
