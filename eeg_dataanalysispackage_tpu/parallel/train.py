@@ -149,6 +149,47 @@ def make_raw_train_step(
     return init_state, step
 
 
+def make_irregular_train_step(
+    mesh=None,
+    learning_rate: float = 0.05,
+    momentum: float = 0.9,
+    chunk_epochs: int = 32768,
+):
+    """Train straight from the int16 stream with IRREGULAR markers:
+    one step = block-gather fused ingest (the gather-free irregular
+    formulation, ops/device_ingest.make_block_ingest_featurizer) ->
+    features -> MLP fwd/bwd -> update.
+
+    Completes the raw-stream training family: ``make_train_step``
+    consumes staged f32 epochs, ``make_raw_train_step`` a regular
+    stimulus train, and this the general irregular-marker case the
+    reference's per-marker host loop handles
+    (OffLineDataProvider.java:200-265) — at int16 bytes/epoch with no
+    host epochs and no element gather.
+
+    ``step(state, raw_i16, resolutions, positions, mask, labels)``:
+    ``positions``/``mask`` are an IngestPlan's static-capacity arrays
+    (device_ingest.plan_ingest), ``labels`` padded to the same
+    capacity. Padded rows contribute nothing: the featurizer zeroes
+    their rows and the loss masks them out.
+    """
+    from ..ops import device_ingest
+
+    featurize = device_ingest.make_block_ingest_featurizer(
+        chunk_epochs=chunk_epochs
+    )
+    init_state, feat_step = make_feature_train_step(
+        mesh, learning_rate, momentum
+    )
+
+    @jax.jit
+    def step(state, raw_i16, resolutions, positions, mask, labels):
+        feats = featurize(raw_i16, resolutions, positions, mask)
+        return feat_step(state, feats, labels, mask.astype(feats.dtype))
+
+    return init_state, step
+
+
 def stage_batch(
     epochs: np.ndarray, labels: np.ndarray, mesh
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:

@@ -42,6 +42,9 @@ Variants:
                   features -> logreg fwd/bwd/update: the full
                   training loop at int16 bytes/epoch
                   (parallel/train.make_raw_train_step)
+  train_step_block  int16 raw + IRREGULAR markers -> block-gather
+                  fused ingest -> features -> logreg fwd/bwd/update
+                  (parallel/train.make_irregular_train_step)
   rf_train        rf-tpu whole-forest growth as one XLA program
                   (models/trees_device.py): 100 trees, depth 5,
                   32 bins over n rows x 48 binned features;
@@ -482,6 +485,52 @@ def run(variant: str, n: int, iters: int) -> dict:
             def body(state, i):
                 state2, loss = step(
                     state, raw_a, res_a + i * 1e-12, y, m, first
+                )
+                return state2, loss
+
+            state, losses = jax.lax.scan(
+                body, state0, jnp.arange(iters, dtype=jnp.float32)
+            )
+            return jax.tree_util.tree_reduce(
+                lambda a, b: a + b.sum(), state, jnp.float32(0)
+            ) + losses.sum()
+
+        arg = args
+
+    elif variant == "train_step_block":
+        from eeg_dataanalysispackage_tpu.parallel import train as ptrain
+
+        S = 200 + n * STRIDE + 1000
+        raw = rng.randint(-3000, 3000, size=(3, S), dtype=np.int16)
+        base = np.arange(n, dtype=np.int64) * STRIDE + 200
+        jitter = rng.randint(-200, 200, size=n)
+        positions = np.clip(base + jitter, 100, S - 800)
+        cap = ((n + 63) // 64) * 64
+        pos_pad = np.zeros(cap, np.int32)
+        pos_pad[:n] = positions
+        mask = np.zeros(cap, bool)
+        mask[:n] = True
+        labels = jnp.asarray(
+            np.pad(rng.randint(0, 2, size=n).astype(np.float32),
+                   (0, cap - n))
+        )
+        init_state, step = ptrain.make_irregular_train_step()
+        state0 = init_state(jax.random.PRNGKey(0))
+        # same byte model as the bare block_ingest variant (stream
+        # bytes), so the two roofline numbers are directly comparable
+        bytes_per_epoch = 3 * STRIDE * 2
+        # no caller-side pad: the block featurizer zero-pads the
+        # stream internally for overhanging slabs
+        args = (
+            jnp.asarray(raw), jnp.asarray(res),
+            jnp.asarray(pos_pad), jnp.asarray(mask), labels,
+        )
+
+        @jax.jit
+        def loop(raw_a, res_a, pos_a, mask_a, y):
+            def body(state, i):
+                state2, loss = step(
+                    state, raw_a, res_a + i * 1e-12, pos_a, mask_a, y
                 )
                 return state2, loss
 
