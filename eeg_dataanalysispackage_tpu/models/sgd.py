@@ -126,6 +126,30 @@ def _run_sgd(
     return w_final
 
 
+def sgd_invocation(x_arr, y_arr, config: SGDConfig, sample_mask=None):
+    """(jitted, args, kwargs) for the engine exactly as
+    :func:`train_linear` invokes it — the single source of the
+    ``_run_sgd`` call contract, so AOT inspectors (the driver dryrun's
+    collective-structure check) lower the same program production
+    runs rather than a hand-copied approximation."""
+    args = (
+        x_arr,
+        y_arr,
+        float(config.step_size),
+        float(config.mini_batch_fraction),
+        float(config.reg_param),
+        int(config.seed),
+        float(config.convergence_tol),
+    )
+    kwargs = dict(
+        num_iterations=int(config.num_iterations),
+        loss=config.loss,
+        full_batch=config.mini_batch_fraction >= 1.0,
+        sample_mask=sample_mask,
+    )
+    return _run_sgd, args, kwargs
+
+
 def train_linear(
     features: np.ndarray,
     labels: np.ndarray,
@@ -148,20 +172,8 @@ def train_linear(
         x_arr = jnp.asarray(features, dtype=jnp.float32)
         y_arr = jnp.asarray(labels, dtype=jnp.float32)
         mask = None
-    w = _run_sgd(
-        x_arr,
-        y_arr,
-        float(config.step_size),
-        float(config.mini_batch_fraction),
-        float(config.reg_param),
-        int(config.seed),
-        float(config.convergence_tol),
-        num_iterations=int(config.num_iterations),
-        loss=config.loss,
-        full_batch=config.mini_batch_fraction >= 1.0,
-        sample_mask=mask,
-    )
-    return np.asarray(w)
+    fn, args, kwargs = sgd_invocation(x_arr, y_arr, config, sample_mask=mask)
+    return np.asarray(fn(*args, **kwargs))
 
 
 @jax.jit
