@@ -4,6 +4,7 @@ only persists finished models — SURVEY.md section 5)."""
 import os
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -144,3 +145,59 @@ def test_recover_discards_partial_tmp(tmp_path):
     mgr = CheckpointManager(str(tmp_path))
     assert mgr.all_steps() == []
     assert not (tmp_path / ".tmp-00000009").exists()
+
+
+def test_resumable_irregular_raw_stream_training(tmp_path):
+    """The checkpoint/resume loop composes with the irregular
+    raw-stream train step (parallel/train.make_irregular_train_step):
+    crash after a few steps, resume, and land bit-identical to an
+    uninterrupted run — the full int16-stream recovery story."""
+    rng = np.random.RandomState(9)
+    S = 60_000
+    raw = jnp.asarray(
+        rng.randint(-3000, 3000, size=(3, S)).astype(np.int16)
+    )
+    res = jnp.asarray(np.array([0.1, 0.1, 0.2], np.float32))
+    cap = 64
+
+    def batches():
+        # each "batch" is a fresh marker plan over the same stream
+        for k in range(7):
+            r = np.random.RandomState(100 + k)
+            pos = np.sort(
+                r.choice(np.arange(200, S - 900), size=cap, replace=False)
+            ).astype(np.int32)
+            mask = np.ones(cap, bool)
+            lbl = (r.rand(cap) > 0.5).astype(np.float32)
+            yield (raw, res, jnp.asarray(pos), jnp.asarray(mask),
+                   jnp.asarray(lbl))
+
+    init_state, step = ptrain.make_irregular_train_step()
+
+    def init():
+        return init_state(jax.random.PRNGKey(3))
+
+    # uninterrupted reference
+    ref = CheckpointManager(str(tmp_path / "ref"))
+    ref_state, ref_steps = run_resumable(ref, init, step, batches(),
+                                         save_every=3)
+    assert ref_steps == 7
+
+    # crash after 4 steps, then resume
+    crash = CheckpointManager(str(tmp_path / "crash"))
+
+    class Boom(Exception):
+        pass
+
+    def exploding(n):
+        for i, b in enumerate(batches()):
+            if i == n:
+                raise Boom()
+            yield b
+
+    with pytest.raises(Boom):
+        run_resumable(crash, init, step, exploding(4), save_every=3)
+    state, steps = run_resumable(crash, init, step, batches(),
+                                 save_every=3)
+    assert steps == 7
+    _tree_equal(state, ref_state)  # params AND optimizer buffers
