@@ -437,3 +437,29 @@ def test_model_save_load_over_hdfs(namenode):
     clf2 = LogisticRegressionClassifier()
     clf2.load(f"hdfs://{auth}/models/logreg")
     np.testing.assert_array_equal(clf2.weights, clf.weights)
+
+
+def test_pipeline_save_load_model_over_hdfs(namenode, fixture_dir, tmp_path):
+    """save_clf/load_clf with an hdfs:// save_name through the query
+    DSL — the reference's literal models-on-HDFS flow
+    (LogisticRegressionClassifier.java:144-152 against Const.java's
+    hdfs:// endpoint)."""
+    from eeg_dataanalysispackage_tpu.pipeline import builder
+
+    auth, store = namenode
+    _serve_fixture(store, fixture_dir)
+    model_uri = f"hdfs://{auth}/models/pipeline-logreg"
+    r1 = str(tmp_path / "r1.txt")
+    builder.PipelineBuilder(
+        f"info_file=hdfs://{auth}/data/infoTrain.txt&fe=dwt-8"
+        f"&train_clf=logreg&save_clf=true&save_name={model_uri}"
+        f"&result_path={r1}"
+    ).execute()
+    assert "/models/pipeline-logreg.npz" in store.files
+    r2 = str(tmp_path / "r2.txt")
+    stats = builder.PipelineBuilder(
+        f"info_file=hdfs://{auth}/data/infoTrain.txt&fe=dwt-8"
+        f"&load_clf=logreg&load_name={model_uri}&result_path={r2}"
+    ).execute()
+    assert stats.num_patterns == 11  # load branch tests on ALL data
+    assert "Accuracy" in open(r2).read()

@@ -1,25 +1,24 @@
 #!/bin/bash
-# Real-chip validation sweep: parity + all bench variants (+ a Pallas
-# tile-geometry sweep). Run in background with a generous timeout and
+# Real-chip validation sweep: parity + all bench variants + the Pallas
+# compile canary/bisect. Run in background with a generous timeout and
 # NEVER kill it mid-compile (axon tunnel wedges). Results land in
-# /tmp/sweep/*.json, one JSON line each.
+# /tmp/sweep/*.json, one JSON line each. This is the manual
+# reproduction of tools/tunnel_watch.sh's collection (same list, same
+# order); tools/summarize_sweep.py renders either directory.
 set -u
 OUT=${1:-/tmp/sweep}
 mkdir -p "$OUT"
 cd "$(dirname "$0")/.."
 
-# Generous probe timeout: SIGTERM on an axon-INITIALIZING process is
-# the known tunnel-wedging event; 240s comfortably covers cold init.
-probe() {
-  timeout 240 python -c "import jax; print(jax.devices()[0].platform)" 2>/dev/null
-}
-
-plat=$(probe)
-if [ "$plat" != "axon" ] && [ "$plat" != "tpu" ]; then
-  echo "real TPU not reachable (got '${plat:-none}'); aborting sweep" >&2
+# Kill-free probe: returns on its own (tools/probe_tpu.py) — ok JSON
+# on a healthy tunnel, UNAVAILABLE after ~25 min on a down one.
+plat=$(python tools/probe_tpu.py 2>/dev/null)
+if ! echo "$plat" | grep -q '"ok": true' \
+    || ! echo "$plat" | grep -Eq '"platform": "(axon|tpu)"'; then
+  echo "real TPU not reachable ($plat); aborting sweep" >&2
   exit 1
 fi
-echo "platform: $plat"
+echo "platform probe: $plat"
 
 run() { # name, timeout, cmd...
   name=$1; t=$2; shift 2
@@ -28,27 +27,6 @@ run() { # name, timeout, cmd...
   echo "rc=$? $(tail -c 400 "$OUT/$name.json")"
 }
 
-# Timeouts are generous (first Mosaic/XLA compiles can take minutes);
-# a kill mid-compile wedges the tunnel, so prefer waiting.
-run parity        600 python tools/tpu_parity_check.py
-run einsum        600 python tools/ingest_bench.py einsum 262144 50
-run einsum_2d     600 python tools/ingest_bench.py einsum_2d 262144 50
-run einsum_bf16   600 python tools/ingest_bench.py einsum_bf16 262144 50
-run regular       600 python tools/ingest_bench.py regular_ingest 262144 20
-run pallas_64k32  900 python tools/ingest_bench.py pallas_ingest 131072 20
-BENCH_CHUNK=131072 BENCH_TILE_B=64 \
-run pallas_128k64 900 python tools/ingest_bench.py pallas_ingest 131072 20
-BENCH_CHUNK=32768 BENCH_TILE_B=16 \
-run pallas_32k16  900 python tools/ingest_bench.py pallas_ingest 131072 20
-run xla_ingest    900 python tools/ingest_bench.py xla_ingest 32768 10
-run block_ingest  900 python tools/ingest_bench.py block_ingest 32768 10
-run einsum_flat   600 python tools/ingest_bench.py einsum_flat 262144 50
-run train_step    600 python tools/ingest_bench.py train_step 131072 20
-BENCH_FORMULATION=phase \
-run regular_phase 900 python tools/ingest_bench.py regular_ingest 262144 20
-BENCH_FORMULATION=conv \
-run regular_conv  900 python tools/ingest_bench.py regular_ingest 262144 20
-run rf_train      900 python tools/ingest_bench.py rf_train 65536 3
-run rf_predict    600 python tools/ingest_bench.py rf_predict 262144 10
-run train_raw     900 python tools/ingest_bench.py train_step_raw 131072 20
+# the single shared collection list (also used by tunnel_watch.sh)
+source tools/collect_chip_runs.sh
 echo "sweep done"

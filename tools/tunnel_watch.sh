@@ -38,56 +38,6 @@ run() { # name timeout cmd...
   log "done $name rc=$? $(tail -c 300 "$OUT/$name.json")"
 }
 
-# Order = evidence priority (VERDICT r2): the irregular-ingest
-# fast-path numbers and the chip-staged rows first, the driver bench
-# artifact once the core numbers are safe, Pallas (whose kernel
-# crashes the remote compile helper) after everything XLA-only, and
-# the compiler bisect DEAD LAST because a helper crash may re-wedge.
-run parity        900 python tools/tpu_parity_check.py
-run einsum        600 python tools/ingest_bench.py einsum 262144 50
-run xla_ingest    900 python tools/ingest_bench.py xla_ingest 32768 10
-run block_ingest  900 python tools/ingest_bench.py block_ingest 32768 10
-BENCH_FORMULATION=phase run regular_phase 900 \
-  python tools/ingest_bench.py regular_ingest 262144 20
-BENCH_FORMULATION=conv run regular_conv 900 \
-  python tools/ingest_bench.py regular_ingest 262144 20
-BENCH_FORMULATION=reshape run regular_reshape 900 \
-  python tools/ingest_bench.py regular_ingest 262144 20
-run train_raw     900 python tools/ingest_bench.py train_step_raw 131072 20
-run train_block   900 python tools/ingest_bench.py train_step_block 32768 10
-run rf_train      900 python tools/ingest_bench.py rf_train 65536 3
-run rf_predict    600 python tools/ingest_bench.py rf_predict 262144 10
-run einsum_flat   600 python tools/ingest_bench.py einsum_flat 262144 50
-run einsum_2d     600 python tools/ingest_bench.py einsum_2d 262144 50
-run einsum_bf16   600 python tools/ingest_bench.py einsum_bf16 262144 50
-# bf16 roofline-gap diagnostics (VERDICT r2 item 4): layout A/B at
-# 2-byte elements, plus batch-size halving/doubling for dispatch
-# amortization
-run einsum_bf16_flat 600 python tools/ingest_bench.py einsum_bf16_flat 262144 50
-run einsum_bf16_131k 600 python tools/ingest_bench.py einsum_bf16 131072 50
-run einsum_bf16_524k 600 python tools/ingest_bench.py einsum_bf16 524288 50
-run train_step    600 python tools/ingest_bench.py train_step 131072 20
-# outer timeout must exceed bench.py's worst case (probe 420 +
-# variant budget 1500 + one variant overrun 420) so the watcher never
-# SIGTERMs bench mid-variant
-BENCH_TOTAL_BUDGET=1500 run bench_full 3600 python bench.py
-# compile-only: XLA cost model (bytes/epoch) for the TPU-compiled hot
-# programs — answers "does the compiled program move more bytes than
-# the design assumed" for every below-roofline number above. 3600s:
-# ~6 fresh chip compiles in one process; a SIGTERM mid-remote-compile
-# is the wedging event, so this gets the most generous budget of all
-# (and the tool prints each program's line as it completes, so even a
-# timeout preserves the finished ones)
-run cost_report  3600 python tools/cost_report.py 32768
-# pallas_dwt first: it compiled to Mosaic on chip in round 2, so it
-# separates "remote compiler regressed globally" from "the ingest
-# kernel's construct delta (scalar-prefetch index maps / int16 loads /
-# aliased inputs / dynamic lane slices) is the crasher"
-run pallas_dwt    900 python tools/ingest_bench.py pallas_dwt 131072 20
-run pallas_ingest 900 python tools/ingest_bench.py pallas_ingest 131072 20
-# the 8-aligned-slice variant-bank kernel: the fix path if the exact
-# kernel's arbitrary-offset lane slice is what crashes the compiler
-BENCH_PALLAS_MODE=aligned8 run pallas_aligned8 900 \
-  python tools/ingest_bench.py pallas_ingest 131072 20
-run pallas_bisect 900 python tools/pallas_compile_bisect.py
+# the single shared collection list (also used by real_chip_sweep.sh)
+source tools/collect_chip_runs.sh
 log "collection complete"
