@@ -286,7 +286,7 @@ def resolve_regular_formulation(formulation: str, stride: int) -> str:
         if jax.devices()[0].platform == "cpu":
             return "reshape"
         return "phase" if _phase_group(stride) <= _PHASE_MAX_GROUP else "conv"
-    if formulation not in ("reshape", "conv", "phase"):
+    if formulation not in ("reshape", "conv", "phase", "partial"):
         raise ValueError(
             f"unknown regular-ingest formulation {formulation!r}"
         )
@@ -345,6 +345,14 @@ def make_regular_ingest_featurizer(
       exactly invariant — so accuracy matches subtract-first even
       under baseline drift. One compile serves all phases (operator
       tables are per-phase arguments, not constants).
+    - ``"partial"``: phase's tile-aligned geometry with a SINGLE pass
+      over the stream — each row is contracted once against the
+      concatenated operator ``[E4a|B4a|E4b|B4b]`` and neighbor
+      partials combine afterwards, removing phase's row-pair operand
+      (za/zb) that dominates its compiled byte count (cost-model
+      cross-check, docs/ingest_kernel.md). DC proxy is per-channel
+      global (must be shared by both rows of a window), so accuracy
+      is conv-class under drift rather than phase-exact.
     - ``"auto"``: reshape on CPU (no lane tiling, subtract-first
       accuracy), phase on accelerators — unless the stride makes
       ``G = lcm(Δ,128)/Δ`` large (odd strides give G=128: ~GB-scale
@@ -399,11 +407,14 @@ def _make_regular_ingest_featurizer(
             f"regular ingest needs stride >= {win}; got {stride} "
             "(use the Pallas irregular-position kernel instead)"
         )
-    if formulation == "phase" and _phase_group(stride) > _PHASE_MAX_GROUP:
+    if (
+        formulation in ("phase", "partial")
+        and _phase_group(stride) > _PHASE_MAX_GROUP
+    ):
         raise ValueError(
-            f"phase formulation with stride {stride} needs group size "
-            f"{_phase_group(stride)} > {_PHASE_MAX_GROUP}: its operator "
-            "tables would reach GB scale; use formulation='conv'"
+            f"{formulation} formulation with stride {stride} needs group "
+            f"size {_phase_group(stride)} > {_PHASE_MAX_GROUP}: its "
+            "operator tables would reach GB scale; use formulation='conv'"
         )
     from . import dwt as dwt_xla
 
@@ -475,14 +486,10 @@ def _make_regular_ingest_featurizer(
             )
             return dwt_xla.safe_l2_normalize(feats)
 
-    if formulation != "phase":
-        _run_phase = None
-    else:
-        # phase formulation: ROW = lcm(stride, 128) samples hold
+    if formulation in ("phase", "partial"):
+        # shared group geometry: ROW = lcm(stride, 128) samples hold
         # exactly G strides, so (C, M·ROW) -> (C, M, ROW) is a
-        # tile-aligned (free) reshape; windows are cut by per-phase
-        # block operators over each row pair, and the per-row mean is
-        # an exactly-invariant DC proxy.
+        # tile-aligned (free) reshape.
         _G = _phase_group(stride)
         _ROW = _G * stride
         _W_np = ingest_matrix(
@@ -492,11 +499,22 @@ def _make_regular_ingest_featurizer(
         _M_groups = -(-n_epochs // _G)  # ceil
         _colsum_np = _W_np.sum(axis=0)
 
-        # bounded: tables are ~3.5 MB per phase (stride 800) and a
-        # service ingesting many recordings must not accumulate them
-        @functools.lru_cache(maxsize=8)
-        def _phase_tables(phase: int):
-            # phase < stride (the wrapper mods by stride), so every
+        def _plan_slab(raw_i16, start):
+            """(phase, s0) for the aligned slab, or None if the
+            recording is too short (caller falls back to reshape).
+            Mod by STRIDE, not ROW: keeps every window's start inside
+            its own row (offsets phase + j*stride < ROW) and shrinks
+            the table-cache key space. The slab's absolute start s0
+            needs no alignment — the reshape is relative to the slab."""
+            phase = start % stride
+            s0 = start - phase
+            need = s0 + (_M_groups + 1) * _ROW
+            if s0 < 0 or need > raw_i16.shape[1]:
+                return None
+            return phase, s0
+
+        def _group_tables_np(phase: int):
+            # phase < stride (the wrappers mod by stride), so every
             # window's first tap lands inside its own row:
             # off <= (stride-1) + (G-1)*stride < _ROW; only the tail
             # may cross into the next row (the E4b/B4b halves).
@@ -516,10 +534,21 @@ def _make_regular_ingest_featurizer(
                 B4a[off : off + bcut, j] = 1.0 / pre
                 if bcut < pre:
                     B4b[: pre - bcut, j] = 1.0 / pre
-            return (
-                jnp.asarray(E4a), jnp.asarray(E4b),
-                jnp.asarray(B4a), jnp.asarray(B4b),
-            )
+            return E4a, E4b, B4a, B4b
+
+    if formulation != "phase":
+        _run_phase = None
+    else:
+        # phase formulation: windows are cut by per-phase block
+        # operators over each row PAIR, and the per-row mean is an
+        # exactly-invariant DC proxy (subtract-first accuracy even
+        # under electrode drift).
+
+        # bounded: tables are ~3.5 MB per phase (stride 800) and a
+        # service ingesting many recordings must not accumulate them
+        @functools.lru_cache(maxsize=8)
+        def _phase_tables(phase: int):
+            return tuple(jnp.asarray(t) for t in _group_tables_np(phase))
 
         @jax.jit
         def _ingest_phase(raw_i16, resolutions, s0, E4a, E4b, B4a, B4b):
@@ -552,23 +581,81 @@ def _make_regular_ingest_featurizer(
             return dwt_xla.safe_l2_normalize(out)
 
         def _run_phase(raw_i16, resolutions, start):
-            # mod by STRIDE, not _ROW: keeps every window's start
-            # inside its own row (offsets phase + j*stride < _ROW)
-            # and shrinks the table-cache key space. The slab's
-            # absolute start s0 needs no alignment — the reshape is
-            # relative to the slab.
-            phase = start % stride
-            s0 = start - phase
-            need = s0 + (_M_groups + 1) * _ROW
-            if s0 < 0 or need > raw_i16.shape[1]:
+            plan = _plan_slab(raw_i16, start)
+            if plan is None:
                 return None  # slab out of range; caller falls back
-            tables = _phase_tables(phase)
-            return _ingest_phase(raw_i16, resolutions, s0, *tables)
+            phase, s0 = plan
+            return _ingest_phase(
+                raw_i16, resolutions, s0, *_phase_tables(phase)
+            )
+
+    if formulation != "partial":
+        _run_partial = None
+    else:
+        # partial formulation: each row is contracted ONCE against a
+        # concatenated operator [E4a|B4a|E4b|B4b] and neighbor
+        # partials combine afterwards — the phase formulation's
+        # row-pair operand (each row read as `ra` for its group and
+        # `rb` for the previous one, za/zb materialized) becomes a
+        # single pass over the stream. The cost-model cross-check in
+        # docs/ingest_kernel.md is the motivation: phase's compiled
+        # bytes are dominated by the pair materialization.
+        #
+        # Numerics: the DC proxy must be the SAME constant for both
+        # rows a window spans (the proxy enters via rows m and m+1,
+        # combined later), so it is per-channel global (the stream
+        # prefix mean, like conv) rather than per-row — baseline
+        # correction is exactly invariant to it, and both cancelling
+        # terms sit at (residual + drift) scale: conv-class accuracy
+        # (~5e-5 under full int16-range drift), vs phase's
+        # subtract-first exactness. Trade bytes for the last decimal.
+        @functools.lru_cache(maxsize=8)
+        def _partial_tables(phase: int):
+            E4a, E4b, B4a, B4b = _group_tables_np(phase)
+            cat = np.concatenate([E4a, B4a, E4b, B4b], axis=1)
+            return jnp.asarray(cat)  # (ROW, 2(G*K + G))
+
+        @jax.jit
+        def _ingest_partial(raw_i16, resolutions, s0, CAT):
+            C = raw_i16.shape[0]
+            K = feature_size
+            GK = _G * K
+            slab = jax.lax.dynamic_slice_in_dim(
+                raw_i16, s0, (_M_groups + 1) * _ROW, axis=1
+            )
+            xf = slab.astype(jnp.float32) * resolutions[:, None]
+            prefix = min(8192, (_M_groups + 1) * _ROW)
+            dc = jnp.mean(xf[:, :prefix], axis=1, keepdims=True)
+            rows = (xf - dc).reshape(C, _M_groups + 1, _ROW)
+            hi = jax.lax.Precision.HIGHEST
+            P = jnp.einsum("cms,se->cme", rows, CAT, precision=hi)
+            Pa = P[..., :GK]
+            Ba = P[..., GK : GK + _G]
+            Pb = P[..., GK + _G : 2 * GK + _G]
+            Bb = P[..., 2 * GK + _G :]
+            yW = (Pa[:, :-1] + Pb[:, 1:]).reshape(C, _M_groups, _G, K)
+            pm = Ba[:, :-1] + Bb[:, 1:]  # (C, M, G)
+            colsum = jnp.asarray(_colsum_np)
+            feats = yW - pm[..., None] * colsum[None, None, None, :]
+            out = jnp.transpose(feats, (1, 2, 0, 3)).reshape(
+                _M_groups * _G, C * K
+            )[:n_epochs]
+            return dwt_xla.safe_l2_normalize(out)
+
+        def _run_partial(raw_i16, resolutions, start):
+            plan = _plan_slab(raw_i16, start)
+            if plan is None:
+                return None  # slab out of range; caller falls back
+            phase, s0 = plan
+            return _ingest_partial(
+                raw_i16, resolutions, s0, _partial_tables(phase)
+            )
 
     _ingest_jit = {
         "conv": _ingest_conv,
         "reshape": _ingest_reshape,
         "phase": None,  # dispatched in the wrapper (slab bounds)
+        "partial": None,  # dispatched in the wrapper (slab bounds)
     }[formulation]
 
     def ingest(raw_i16, resolutions, first_position):
@@ -582,8 +669,9 @@ def _make_regular_ingest_featurizer(
                 f"regular ingest window [{start}, {end}) out of range "
                 f"for recording of {raw_i16.shape[1]} samples"
             )
-        if formulation == "phase":
-            out = _run_phase(raw_i16, resolutions, start)
+        if formulation in ("phase", "partial"):
+            runner = _run_phase if formulation == "phase" else _run_partial
+            out = runner(raw_i16, resolutions, start)
             if out is not None:
                 return out
             # recording too short for the aligned slab (needs up to
@@ -595,11 +683,17 @@ def _make_regular_ingest_featurizer(
     ingest.formulation = formulation
     # inner jitted programs, exposed for compiled-HLO/cost inspection
     # (tools/cost_report.py; same pattern as parallel/*._sharded_jit)
-    ingest._jit = _ingest_jit  # None for phase (wrapper dispatches)
+    ingest._jit = _ingest_jit  # None for phase/partial (wrapper dispatches)
     ingest._phase_jit = _ingest_phase if formulation == "phase" else None
     ingest._phase_tables = _phase_tables if formulation == "phase" else None
+    ingest._partial_jit = (
+        _ingest_partial if formulation == "partial" else None
+    )
+    ingest._partial_tables = (
+        _partial_tables if formulation == "partial" else None
+    )
     ingest._phase_geometry = (
-        (_M_groups, _ROW) if formulation == "phase" else None
+        (_M_groups, _ROW) if formulation in ("phase", "partial") else None
     )
     return ingest
 

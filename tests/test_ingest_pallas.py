@@ -618,3 +618,77 @@ def test_pallas_unknown_mode_raises(fixture_raw):
         ingest_pallas.ingest_features_pallas(
             raw, res, np.array([5000]), mode="warp"
         )
+
+
+# -- partial regular-ingest formulation (single-pass, round 3) --------
+
+
+@pytest.mark.parametrize("first", [150, 1000, 887, 3250, 4000])
+def test_regular_ingest_partial_arbitrary_first_position(first):
+    """The partial formulation (one contraction per row against the
+    concatenated [E4a|B4a|E4b|B4b] operator, neighbor partials
+    combined) must match subtract-first reshape for any marker
+    phase. No drift in this fixture, so the gate is tight."""
+    n, stride = 13, 800
+    raw, res = _dc_heavy_fixture(n, stride, first, tail=16384)
+    ing_r = device_ingest.make_regular_ingest_featurizer(
+        stride, n, formulation="reshape"
+    )
+    ing_q = device_ingest.make_regular_ingest_featurizer(
+        stride, n, formulation="partial"
+    )
+    assert ing_q.formulation == "partial"
+    a = np.asarray(ing_r(jnp.asarray(raw), jnp.asarray(res), first))
+    b = np.asarray(ing_q(jnp.asarray(raw), jnp.asarray(res), first))
+    np.testing.assert_allclose(b, a, rtol=0, atol=5e-6)
+
+
+@pytest.mark.parametrize("stride", [800, 832, 896, 1024, 960])
+def test_regular_ingest_partial_across_group_sizes(stride):
+    from eeg_dataanalysispackage_tpu.ops.device_ingest import _phase_group
+
+    assert _phase_group(stride) <= 4
+    n, first = 11, 150 + (stride // 3)
+    raw, res = _dc_heavy_fixture(
+        n, stride, first, tail=4 * _phase_group(stride) * stride + 8192
+    )
+    ing_r = device_ingest.make_regular_ingest_featurizer(
+        stride, n, formulation="reshape"
+    )
+    ing_q = device_ingest.make_regular_ingest_featurizer(
+        stride, n, formulation="partial"
+    )
+    a = np.asarray(ing_r(jnp.asarray(raw), jnp.asarray(res), first))
+    b = np.asarray(ing_q(jnp.asarray(raw), jnp.asarray(res), first))
+    np.testing.assert_allclose(b, a, rtol=0, atol=5e-6)
+
+
+def test_regular_ingest_partial_conv_class_under_drift():
+    """The partial formulation's global DC proxy makes it conv-class
+    under electrode drift: bounded by the documented 5e-5 envelope,
+    NOT the phase formulation's exactness."""
+    n, stride, first = 30, 800, 150
+    raw, res = _dc_heavy_fixture(n, stride, first, drift=2500.0, tail=8192)
+    ing_r = device_ingest.make_regular_ingest_featurizer(
+        stride, n, formulation="reshape"
+    )
+    ing_q = device_ingest.make_regular_ingest_featurizer(
+        stride, n, formulation="partial"
+    )
+    a = np.asarray(ing_r(jnp.asarray(raw), jnp.asarray(res), first))
+    b = np.asarray(ing_q(jnp.asarray(raw), jnp.asarray(res), first))
+    np.testing.assert_allclose(b, a, rtol=0, atol=5e-5)
+
+
+def test_regular_ingest_partial_short_recording_falls_back():
+    n, stride, first = 4, 800, 150
+    raw, res = _dc_heavy_fixture(n, stride, first, tail=0)
+    ing_q = device_ingest.make_regular_ingest_featurizer(
+        stride, n, formulation="partial"
+    )
+    ing_r = device_ingest.make_regular_ingest_featurizer(
+        stride, n, formulation="reshape"
+    )
+    a = np.asarray(ing_r(jnp.asarray(raw), jnp.asarray(res), first))
+    b = np.asarray(ing_q(jnp.asarray(raw), jnp.asarray(res), first))
+    np.testing.assert_allclose(b, a, rtol=0, atol=5e-6)

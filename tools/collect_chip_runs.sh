@@ -15,6 +15,8 @@ BENCH_FORMULATION=conv run regular_conv 900 \
   python tools/ingest_bench.py regular_ingest 262144 20
 BENCH_FORMULATION=reshape run regular_reshape 900 \
   python tools/ingest_bench.py regular_ingest 262144 20
+BENCH_FORMULATION=partial run regular_partial 900 \
+  python tools/ingest_bench.py regular_ingest 262144 20
 run train_raw     900 python tools/ingest_bench.py train_step_raw 131072 20
 run train_block   900 python tools/ingest_bench.py train_step_block 32768 10
 run rf_train      900 python tools/ingest_bench.py rf_train 65536 3

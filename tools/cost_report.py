@@ -95,11 +95,11 @@ def main() -> None:
     S = 200 + n * stride + 2 * 3200
     raw = jax.ShapeDtypeStruct((3, S), jnp.int16)
     res = jax.ShapeDtypeStruct((3,), jnp.float32)
-    for formulation in ("reshape", "conv", "phase"):
+    for formulation in ("reshape", "conv", "phase", "partial"):
         ing = device_ingest.make_regular_ingest_featurizer(
             stride, n, formulation=formulation
         )
-        if formulation == "phase":
+        if formulation in ("phase", "partial"):
             # the public wrapper plans the aligned slab host-side;
             # cost the inner jitted program exactly as the wrapper
             # calls it (phase-0 tables, slab start 0). The raw length
@@ -109,11 +109,14 @@ def main() -> None:
             raw_phase = jax.ShapeDtypeStruct(
                 (3, max(S, (m_groups + 1) * row)), jnp.int16
             )
-            tables = ing._phase_tables(0)
+            if formulation == "phase":
+                inner, targs = ing._phase_jit, ing._phase_tables(0)
+            else:
+                inner, targs = ing._partial_jit, (ing._partial_tables(0),)
             report(
-                "regular_phase",
-                ing._phase_jit,
-                (raw_phase, res, 0, *tables),
+                f"regular_{formulation}",
+                inner,
+                (raw_phase, res, 0, *targs),
                 3 * stride * 2,
             )
         else:
