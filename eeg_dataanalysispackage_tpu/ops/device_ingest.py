@@ -545,10 +545,16 @@ def _make_regular_ingest_featurizer(
         # under electrode drift).
 
         # bounded: tables are ~3.5 MB per phase (stride 800) and a
-        # service ingesting many recordings must not accumulate them
+        # service ingesting many recordings must not accumulate them.
+        # NUMPY in the cache, never jnp: a jnp conversion executed
+        # while an outer jit is tracing (the dryrun's
+        # jit(vmap(featurizer)) pattern) would cache a TRACER, and the
+        # module-level featurizer cache would then poison every later
+        # call in the process with UnexpectedTracerError. The jitted
+        # ingest converts its numpy arguments per-call, trace-safely.
         @functools.lru_cache(maxsize=8)
         def _phase_tables(phase: int):
-            return tuple(jnp.asarray(t) for t in _group_tables_np(phase))
+            return _group_tables_np(phase)
 
         @jax.jit
         def _ingest_phase(raw_i16, resolutions, s0, E4a, E4b, B4a, B4b):
@@ -609,11 +615,14 @@ def _make_regular_ingest_featurizer(
         # terms sit at (residual + drift) scale: conv-class accuracy
         # (~5e-5 under full int16-range drift), vs phase's
         # subtract-first exactness. Trade bytes for the last decimal.
+        # numpy in the cache for the same tracer-poisoning reason as
+        # _phase_tables above
         @functools.lru_cache(maxsize=8)
         def _partial_tables(phase: int):
             E4a, E4b, B4a, B4b = _group_tables_np(phase)
-            cat = np.concatenate([E4a, B4a, E4b, B4b], axis=1)
-            return jnp.asarray(cat)  # (ROW, 2(G*K + G))
+            return np.concatenate(
+                [E4a, B4a, E4b, B4b], axis=1
+            )  # (ROW, 2(G*K + G))
 
         @jax.jit
         def _ingest_partial(raw_i16, resolutions, s0, CAT):

@@ -692,3 +692,26 @@ def test_regular_ingest_partial_short_recording_falls_back():
     a = np.asarray(ing_r(jnp.asarray(raw), jnp.asarray(res), first))
     b = np.asarray(ing_q(jnp.asarray(raw), jnp.asarray(res), first))
     np.testing.assert_allclose(b, a, rtol=0, atol=5e-6)
+
+
+@pytest.mark.parametrize("formulation", ["phase", "partial"])
+def test_regular_ingest_outer_jit_does_not_poison_cache(formulation):
+    """Calling a phase/partial featurizer inside an OUTER jit (the
+    driver dryrun's jit(vmap(...)) pattern) must not cache tracers:
+    the lazily-built operator tables are cached as numpy, so a later
+    plain call of the same module-globally-cached featurizer works.
+    Regression for an UnexpectedTracerError found in round 3."""
+    n, stride, first = 4, 800, 150
+    raw, res = _dc_heavy_fixture(n, stride, first, tail=16384)
+    ing = device_ingest.make_regular_ingest_featurizer(
+        stride, n, formulation=formulation
+    )
+    # first use: under an outer trace
+    under_jit = np.asarray(
+        jax.jit(jax.vmap(lambda r: ing(r, jnp.asarray(res), first)))(
+            jnp.asarray(raw)[None]
+        )
+    )[0]
+    # second use: plain call — raised UnexpectedTracerError before
+    plain = np.asarray(ing(jnp.asarray(raw), jnp.asarray(res), first))
+    np.testing.assert_allclose(plain, under_jit, rtol=0, atol=1e-6)
