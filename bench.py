@@ -18,6 +18,8 @@ train-step variants (tools/ingest_bench.py) with HBM-roofline context:
   train_step      f32 epochs -> features -> MLP fwd/bwd/update
   train_step_raw  int16 stream -> fused ingest -> features -> MLP
                   fwd/bwd/update (training at int16 bytes/epoch)
+  train_step_block  int16 stream + IRREGULAR markers -> block-gather
+                  fused ingest -> features -> MLP fwd/bwd/update
   pallas_ingest   fused int16 ingest, irregular marker positions ->
                   features (ops/ingest_pallas.py kernel)
 
@@ -68,7 +70,7 @@ _RUN_TIMEOUT_S = int(os.environ.get("BENCH_RUN_TIMEOUT", 420))
 # driver patience — real variants run 1-3 min each (sweep evidence),
 # so the cap only bites if several variants hit their full timeout;
 # BENCH_TOTAL_BUDGET overrides.
-_N_VARIANTS = 7  # asserted against the variant tables below
+_N_VARIANTS = 8  # asserted against the variant tables below
 _TOTAL_BUDGET_S = int(
     os.environ.get(
         "BENCH_TOTAL_BUDGET",
@@ -93,6 +95,7 @@ _VARIANTS_TPU = {
     "block_ingest": (32768, 10),
     "train_step": (131072, 20),
     "train_step_raw": (131072, 20),
+    "train_step_block": (32768, 10),
     # last: known to fail fast while the terminal-side Mosaic compile
     # crash stands (the failure is recorded, not fatal)
     "pallas_ingest": (131072, 20),
@@ -104,6 +107,7 @@ _VARIANTS_CPU = {
     "block_ingest": (2048, 2),
     "train_step": (8192, 3),
     "train_step_raw": (4096, 2),
+    "train_step_block": (2048, 2),
     "pallas_ingest": (2048, 2),
 }
 assert len(_VARIANTS_TPU) == len(_VARIANTS_CPU) == _N_VARIANTS

@@ -32,9 +32,10 @@ run einsum_bf16_131k 600 python tools/ingest_bench.py einsum_bf16 131072 50
 run einsum_bf16_524k 600 python tools/ingest_bench.py einsum_bf16 524288 50
 run train_step    600 python tools/ingest_bench.py train_step 131072 20
 # outer timeout must exceed bench.py's worst case (probe 420 +
-# variant budget 1500 + one variant overrun 420) so the caller never
-# SIGTERMs bench mid-variant
-BENCH_TOTAL_BUDGET=1500 run bench_full 3600 python bench.py
+# variant budget 1800 + one variant overrun 420 = 2640 < 3600) so the
+# caller never SIGTERMs bench mid-variant; 1800 gives all 8 variants
+# headroom at the documented 1-3 min each
+BENCH_TOTAL_BUDGET=1800 run bench_full 3600 python bench.py
 # compile-only: XLA cost model (bytes/epoch) for the TPU-compiled hot
 # programs — answers "does the compiled program move more bytes than
 # the design assumed" for every below-roofline number above. 3600s:
