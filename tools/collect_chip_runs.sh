@@ -39,7 +39,7 @@ BENCH_TOTAL_BUDGET=1800 run bench_full 3600 python bench.py
 # compile-only: XLA cost model (bytes/epoch) for the TPU-compiled hot
 # programs — answers "does the compiled program move more bytes than
 # the design assumed" for every below-roofline number above. 3600s:
-# ~6 fresh chip compiles in one process, printed as produced.
+# 7 fresh chip compiles in one process, printed as produced.
 run cost_report  3600 python tools/cost_report.py 32768
 # pallas_dwt first: it compiled to Mosaic on chip in round 2, so it
 # separates "remote compiler regressed globally" from "the ingest
