@@ -37,11 +37,13 @@ def write_channel_text(
     a channel, ``sc.parallelize``, ``saveAsTextFile`` back to storage)
     — here a straight write through the pluggable filesystem, with
     ``Double.toString`` number formatting for byte parity with
-    ``saveAsTextFile`` artifacts.
+    ``saveAsTextFile`` artifacts. Without an explicit ``filesystem``
+    the path's scheme routes it (``hdfs://``/``http(s)://``/``gs://``
+    / local), same as the provider and model persistence.
     """
-    from . import sources
+    from . import remote
 
-    fs = filesystem or sources.LocalFileSystem()
+    fs = filesystem or remote.filesystem_for(path)
     arr = np.asarray(channel, dtype=np.float64).ravel()
     fs.write_bytes(
         path,

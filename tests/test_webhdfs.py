@@ -463,3 +463,32 @@ def test_pipeline_save_load_model_over_hdfs(namenode, fixture_dir, tmp_path):
     ).execute()
     assert stats.num_patterns == 11  # load branch tests on ALL data
     assert "Accuracy" in open(r2).read()
+
+
+def test_raw_channel_text_export_over_hdfs(namenode, fixture_dir):
+    """The reference's HadoopLoadingTest.tryRAWEEG flow
+    (HadoopLoadingTest.java:56-119) over the WebHDFS protocol: read a
+    recording channel from hdfs://, write it back as saveAsTextFile-
+    format text (Double.toString lines) to hdfs://, and re-parse what
+    the cluster stored."""
+    from eeg_dataanalysispackage_tpu.io import brainvision, export
+
+    auth, store = namenode
+    _serve_fixture(store, fixture_dir)
+    fs = _fs(chunk_size=1 << 20)
+    rec = brainvision.load_recording(
+        f"hdfs://{auth}/data/DoD/DoD2015_01.eeg", filesystem=fs
+    )
+    channel = rec.read_channels([2])[0]  # channel 3, 0-indexed
+
+    # "/Dod" (not "DoD") mirrors the reference's own output path
+    # literal (HadoopLoadingTest.java: outputFileLocation = ... + "/Dod")
+    out_uri = f"hdfs://{auth}/data/Dod/raw.txt"
+    export.write_channel_text(channel, out_uri)  # scheme-routed write
+    assert "/data/Dod/raw.txt" in store.files
+
+    lines = store.files["/data/Dod/raw.txt"].decode("ascii").splitlines()
+    assert len(lines) == channel.shape[0]
+    np.testing.assert_array_equal(
+        np.array([float(x) for x in lines]), channel.astype(np.float64)
+    )
