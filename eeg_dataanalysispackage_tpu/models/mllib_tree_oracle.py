@@ -1,0 +1,835 @@
+"""Exact float64 host emulation of Spark MLlib 1.6.2 decision trees.
+
+Companion to ``models/mllib_oracle.py`` (which plays this role for
+``GradientDescent``): a plain-NumPy re-enactment of what the
+reference's JVM computes when ``DecisionTreeClassifier.java:127`` runs
+``new DecisionTree(strategy).run(rdd)`` and
+``RandomForestClassifier.java:129`` runs
+``new RandomForest(strategy, numTrees, featureSubsetStrategy, 12345)
+.run(rdd)`` — every float64 operation in the order MLlib 1.6.2's
+``tree.RandomForest``/``tree.DecisionTree`` perform it.
+
+What is emulated exactly (and why it is *deterministic* for DT):
+
+- **Split sketch** (``DecisionTree.findSplitsForContinuousFeature``):
+  thresholds are *observed feature values* chosen by the
+  count-stride walk over sorted distinct values — NOT interpolated
+  quantiles. The sketch runs on a sample only when
+  ``numExamples > max(maxBins^2, 10000)``; the reference's corpora are
+  far below that, so the sampler's ``fraction`` is 1.0 and *no RNG
+  affects the sketch* (``DecisionTree.findSplitsBins``:
+  ``requiredSamples = max(metadata.maxBins * metadata.maxBins,
+  10000)``; a ``BernoulliSampler`` at fraction 1.0 keeps every row).
+- **Bin semantics** (``TreePoint.findBin``): bin ``b`` covers
+  ``(split(b-1), split(b)]`` — a value *equal* to a threshold goes
+  left. NumPy equivalent: ``searchsorted(thresholds, v, 'left')``.
+- **maxPossibleBins** = ``min(maxBins, numExamples)``
+  (``DecisionTreeMetadata.buildMetadata``), so a 7-row training set
+  has at most 6 candidate splits per feature regardless of
+  ``config_max_bins``.
+- **Gain semantics** (``InformationGainStats`` via
+  ``calculateGainForSplit``): a split is *invalid* when either child's
+  **Long-truncated** weighted count is below ``minInstancesPerNode``
+  or when ``gain < minInfoGain`` (default 0.0 — an exactly-zero gain
+  is a *valid* split, but the node still becomes a leaf because
+  ``findBestSplits`` marks ``isLeaf = stats.gain <= 0``);
+  ``gain = impurity - leftWeight*leftImpurity -
+  rightWeight*rightImpurity`` with the weights formed from the Long
+  counts — this exact association order is mirrored so near-tie
+  argmaxes bit-match.
+- **Tie-break**: ``maxBy(_._2.gain)`` keeps the *first* maximum, with
+  features iterated in subset order and splits in threshold order —
+  NumPy's first-max ``argmax`` over the same iteration order.
+- **Leaf rules**: a node is a leaf when its best gain ``<= 0`` or its
+  heap level equals ``maxDepth``; a *child* is born a leaf when the
+  next level is ``maxDepth`` or its impurity is exactly 0.0 — such
+  children are never enqueued (``DecisionTree.findBestSplits``).
+- **Prediction**: leaf predicts the first-max class of its weighted
+  counts; model prediction walks raw (un-binned) features with
+  ``value <= threshold`` going left (``Node.predict``); the forest
+  takes an unweighted majority vote (``TreeEnsembleModel
+  .predictByVoting``, all ``treeWeights`` 1.0).
+
+For the forest, MLlib's randomness is reproduced at the generator
+level (seed 12345, ``RandomForestClassifier.java:104``):
+
+- **Bootstrap**: Poisson(subsamplingRate = 1.0) weights per
+  (instance, tree) from commons-math 3 ``PoissonDistribution`` backed
+  by a ``Well19937c`` generator reseeded
+  ``seed + partitionIndex + 1`` (``BaggedPoint
+  .convertToBaggedRDDSamplingWithReplacement``). The oracle pins the
+  single-partition layout (partitionIndex 0 → Well19937c seed
+  ``12346``); on a real cluster the weights — and therefore the whole
+  model — depend on how Spark happened to partition the RDD (see
+  *Environmental dependences* below).
+- **Per-node feature subsets**: ``numFeaturesPerNode`` =
+  ``ceil(sqrt(numFeatures))`` for classification under ``auto``
+  (→ "sqrt"; ``DecisionTreeMetadata.buildMetadata``), drawn by
+  reservoir sampling over ``0 until numFeatures``
+  (``SamplingUtils.reservoirSampleAndCount``) with a Spark
+  ``XORShiftRandom`` seeded from ``new scala.util.Random(seed)
+  .nextLong()`` — one draw per queued node, consumed in FIFO queue
+  order (``RandomForest.selectNodesToSplit``). The reservoir is left
+  in draw order (NOT sorted); feature iteration order — and hence
+  gain tie-breaks — follow it.
+
+Environmental dependences of the JVM (why bit-exact RF emulation is
+*impossible in principle* and what the oracle pins instead):
+
+1. ``parallelize(...)`` partition count equals the cluster's default
+   parallelism (local[*] → host core count), and each partition
+   reseeds its own Poisson stream — the reference's RF model is a
+   function of the submitting machine's core count. Oracle: 1
+   partition.
+2. Child nodes are re-enqueued by iterating a scala ``Map`` keyed by
+   tree index (``nodesForGroup``); for >4 trees its iteration order
+   follows scala's hash-trie internals, which shifts which
+   ``rng.nextLong()`` seeds which node's reservoir. Oracle: ascending
+   tree index (exact for ≤1 tree; canonical otherwise).
+3. ``maxMemoryInMB`` (default 256) can split a level into several
+   groups on huge bin counts, interleaving draws. Oracle: unbounded
+   group (correct for every corpus this package targets).
+
+The DT path has none of these (numTrees=1 → ``featureSubsetStrategy
+"all"`` → no subset draws; bootstrap replaced by weight-1.0
+``convertToBaggedRDDWithoutSampling``; seed 0 unused), so
+``oracle_decision_tree`` is an *exact, RNG-free* float64 re-enactment
+for every corpus small enough that the sketch fraction is 1.0.
+
+The JVM RNG tower is re-implemented bit-faithfully from the published
+algorithms (java.util.Random LCG; Spark ``XORShiftRandom`` = scala
+``MurmurHash3.bytesHash`` seed-hash + 21/35/4 xorshift; commons-math
+``Well19937c`` + the multiplicative Knuth Poisson sampler), with
+regression pins in ``tests/test_mllib_tree_parity.py``.
+
+No JVM runs in this environment, so fixture values pinned from this
+oracle are the package's reproducible contract for MLlib-tree
+behavior — same posture as ``models/mllib_oracle.py``'s SGD pins.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_M32 = 0xFFFFFFFF
+_M64 = 0xFFFFFFFFFFFFFFFF
+
+
+def _i32(x: int) -> int:
+    x &= _M32
+    return x - (1 << 32) if x >= (1 << 31) else x
+
+
+def _i64(x: int) -> int:
+    x &= _M64
+    return x - (1 << 64) if x >= (1 << 63) else x
+
+
+# --------------------------------------------------------------------------
+# java.util.Random (the LCG behind scala.util.Random)
+# --------------------------------------------------------------------------
+
+
+class JavaRandom:
+    """java.util.Random: 48-bit LCG, the engine behind
+    ``new scala.util.Random(seed)`` in ``RandomForest.run``."""
+
+    _MULT = 0x5DEECE66D
+    _ADD = 0xB
+    _MASK = (1 << 48) - 1
+
+    def __init__(self, seed: int) -> None:
+        self.set_seed(seed)
+
+    def set_seed(self, seed: int) -> None:
+        self._state = (seed ^ self._MULT) & self._MASK
+
+    def next(self, bits: int) -> int:
+        self._state = (self._state * self._MULT + self._ADD) & self._MASK
+        return _i32(self._state >> (48 - bits))
+
+    def next_long(self) -> int:
+        hi = self.next(32)
+        lo = self.next(32)
+        return _i64((hi << 32) + lo)
+
+
+# --------------------------------------------------------------------------
+# scala.util.hashing.MurmurHash3.bytesHash + Spark's XORShiftRandom
+# --------------------------------------------------------------------------
+
+
+def _rotl32(x: int, r: int) -> int:
+    x &= _M32
+    return ((x << r) | (x >> (32 - r))) & _M32
+
+
+def scala_murmur3_bytes(data: bytes, seed: int) -> int:
+    """scala 2.10 ``MurmurHash3.bytesHash`` (murmur3_x86_32 body with
+    scala's tail/finalization), returning a signed Int."""
+    h = seed & _M32
+    n = len(data)
+    i = 0
+    remaining = n
+    while remaining >= 4:
+        k = (
+            data[i]
+            | (data[i + 1] << 8)
+            | (data[i + 2] << 16)
+            | (data[i + 3] << 24)
+        )
+        k = (k * 0xCC9E2D51) & _M32
+        k = _rotl32(k, 15)
+        k = (k * 0x1B873593) & _M32
+        h ^= k
+        h = _rotl32(h, 13)
+        h = (h * 5 + 0xE6546B64) & _M32
+        i += 4
+        remaining -= 4
+    k = 0
+    if remaining == 3:
+        k ^= data[i + 2] << 16
+    if remaining >= 2:
+        k ^= data[i + 1] << 8
+    if remaining >= 1:
+        k ^= data[i]
+        k = (k * 0xCC9E2D51) & _M32
+        k = _rotl32(k, 15)
+        k = (k * 0x1B873593) & _M32
+        h ^= k
+    h ^= n
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & _M32
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & _M32
+    h ^= h >> 16
+    return _i32(h)
+
+
+_SCALA_ARRAY_SEED = 0x3C074A61  # MurmurHash3.arraySeed
+
+
+class XORShiftRandom:
+    """Spark's ``org.apache.spark.util.random.XORShiftRandom``: a
+    java.util.Random subclass whose ``next(bits)`` is a 21/35/4
+    xorshift over a MurmurHash3-whitened seed.  The seed hash mirrors
+    Spark 1.6's quirk of hashing a ``ByteBuffer.allocate(Long.SIZE)``
+    buffer — ``Long.SIZE`` is 64 *bits*, so the hashed message is the
+    8 seed bytes (big-endian) followed by 56 zeros."""
+
+    def __init__(self, init: int) -> None:
+        self._seed = self.hash_seed(init)
+
+    @staticmethod
+    def hash_seed(seed: int) -> int:
+        data = (seed & _M64).to_bytes(8, "big") + b"\x00" * 56
+        low = scala_murmur3_bytes(data, _SCALA_ARRAY_SEED)
+        high = scala_murmur3_bytes(data, low)
+        return _i64((high << 32) | (low & _M32))
+
+    def next(self, bits: int) -> int:
+        s = self._seed & _M64
+        s ^= (s << 21) & _M64
+        s ^= s >> 35
+        s ^= (s << 4) & _M64
+        self._seed = s
+        return _i32(s & ((1 << bits) - 1))
+
+    def next_double(self) -> float:
+        # java.util.Random.nextDouble over the overridden next()
+        return ((self.next(26) << 27) + self.next(27)) * (2.0 ** -53)
+
+
+# --------------------------------------------------------------------------
+# commons-math3 Well19937c + PoissonDistribution sampler
+# --------------------------------------------------------------------------
+
+
+class Well19937c:
+    """commons-math3 ``Well19937c`` (the default generator inside
+    ``PoissonDistribution``): 624-word WELL lattice, parameters
+    (m1, m2, m3) = (70, 179, 449), Matsumoto–Kurita tempering."""
+
+    _R = 624
+    _M1 = 70
+    _M2 = 179
+    _M3 = 449
+
+    def __init__(self, seed: Optional[int] = None) -> None:
+        self.v = [0] * self._R
+        self.index = 0
+        if seed is not None:
+            self.set_seed_long(seed)
+
+    def set_seed_long(self, seed: int) -> None:
+        s = seed & _M64
+        self.set_seed_ints([_i32(s >> 32), _i32(s & _M32)])
+
+    def set_seed_ints(self, seed: Sequence[int]) -> None:
+        # AbstractWell.setSeed(int[]): copy, then MT-style spread
+        v = [0] * self._R
+        for i, x in enumerate(list(seed)[: self._R]):
+            v[i] = _i32(x)
+        for i in range(len(seed), self._R):
+            l = v[i - len(seed)]  # sign-extended int -> long
+            v[i] = _i32((1812433253 * (l ^ (l >> 30)) + i) & _M32)
+        self.v = v
+        self.index = 0
+
+    def next(self, bits: int) -> int:
+        R, v = self._R, self.v
+        idx = self.index
+        i_rm1 = (idx + R - 1) % R
+        i_rm2 = (idx + R - 2) % R
+        v0 = v[idx] & _M32
+        vm1 = v[(idx + self._M1) % R] & _M32
+        vm2 = v[(idx + self._M2) % R] & _M32
+        vm3 = v[(idx + self._M3) % R] & _M32
+        z0 = ((0x80000000 & v[i_rm1]) ^ (0x7FFFFFFF & v[i_rm2])) & _M32
+        z1 = ((v0 ^ ((v0 << 25) & _M32)) ^ (vm1 ^ (vm1 >> 27))) & _M32
+        z2 = ((vm2 >> 9) ^ (vm3 ^ (vm3 >> 1))) & _M32
+        z3 = (z1 ^ z2) & _M32
+        z4 = (
+            z0
+            ^ (z1 ^ ((z1 << 9) & _M32))
+            ^ (z2 ^ ((z2 << 21) & _M32))
+            ^ (z3 ^ (z3 >> 21))
+        ) & _M32
+        v[idx] = _i32(z3)
+        v[i_rm1] = _i32(z4)
+        v[i_rm2] = _i32(v[i_rm2] & 0x80000000)
+        self.index = i_rm1
+        # Matsumoto-Kurita tempering (the "c" in Well19937c)
+        z4 = (z4 ^ ((z4 << 7) & 0xE46E1700)) & _M32
+        z4 = (z4 ^ ((z4 << 15) & 0x9B868000)) & _M32
+        return z4 >> (32 - bits)
+
+    def next_double(self) -> float:
+        # BitsStreamGenerator.nextDouble: 26+26 bits * 2^-52
+        high = self.next(26) << 26
+        low = self.next(26)
+        return (high | low) * (2.0 ** -52)
+
+
+def poisson_sample(rng: Well19937c, mean: float = 1.0) -> int:
+    """commons-math3 ``PoissonDistribution.sample`` for ``mean < 40``:
+    Knuth's multiplicative method over ``rng.next_double()``."""
+    p = math.exp(-mean)
+    n = 0
+    r = 1.0
+    while n < 1000 * mean:
+        r *= rng.next_double()
+        if r >= p:
+            n += 1
+        else:
+            return n
+    return n
+
+
+def reservoir_sample_range(n: int, k: int, seed: int) -> List[int]:
+    """``SamplingUtils.reservoirSampleAndCount(Range(0, n).iterator,
+    k, seed)``: first-k fill, then each later item ``i`` replaces slot
+    ``(nextDouble() * itemsSeen).toLong`` when that lands below ``k``.
+    The result is left in reservoir order (NOT sorted) — feature
+    iteration order, and hence gain tie-breaks, follow it."""
+    if n <= k:
+        return list(range(n))
+    reservoir = list(range(k))
+    rand = XORShiftRandom(seed)
+    seen = k
+    for item in range(k, n):
+        seen += 1
+        replacement = int(rand.next_double() * seen)
+        if replacement < k:
+            reservoir[replacement] = item
+    return reservoir
+
+
+# --------------------------------------------------------------------------
+# Split sketch (DecisionTree.findSplitsForContinuousFeature)
+# --------------------------------------------------------------------------
+
+
+def find_splits_for_continuous_feature(
+    samples: np.ndarray, num_splits: int
+) -> np.ndarray:
+    """Candidate thresholds for one continuous feature, exactly as
+    MLlib 1.6.2 computes them: if there are at most ``num_splits``
+    distinct values, every distinct value is a threshold; otherwise a
+    stride walk over the sorted (value, count) sequence emits the
+    previous value whenever adding the current count would move the
+    cumulative count further from the running target."""
+    samples = np.asarray(samples, dtype=np.float64)
+    values, counts = np.unique(samples, return_counts=True)
+    if len(values) <= num_splits:
+        return values.astype(np.float64, copy=True)
+    stride = len(samples) / (num_splits + 1)  # Double division
+    out: List[float] = []
+    target = stride
+    current = int(counts[0])
+    for index in range(1, len(values)):
+        previous = current
+        current += int(counts[index])
+        if abs(previous - target) < abs(current - target):
+            out.append(float(values[index - 1]))
+            target += stride
+    return np.array(out, dtype=np.float64)
+
+
+def find_splits_bins(
+    features: np.ndarray, max_bins: int
+) -> List[np.ndarray]:
+    """Per-feature threshold arrays (ragged), MLlib
+    ``findSplitsBins`` semantics: ``maxPossibleBins = min(maxBins,
+    numExamples)``; the sketch runs over *all* rows because every
+    corpus this package targets satisfies ``numExamples <=
+    max(maxPossibleBins^2, 10000)`` (sampling fraction 1.0 — see
+    module docstring)."""
+    features = np.asarray(features, dtype=np.float64)
+    n, d = features.shape
+    max_possible_bins = min(max_bins, n)
+    num_splits = max_possible_bins - 1
+    required = max(max_possible_bins * max_possible_bins, 10000)
+    if n > required:  # pragma: no cover - beyond targeted corpus sizes
+        raise NotImplementedError(
+            "corpus large enough to trigger MLlib's sampled sketch "
+            f"({n} > {required}); the sampled path is "
+            "partition-layout-dependent on the JVM and is not emulated"
+        )
+    out = []
+    for j in range(d):
+        th = find_splits_for_continuous_feature(features[:, j], num_splits)
+        if len(th) == 0:
+            # only reachable when num_splits == 0 (a 1-row corpus or
+            # max_bins <= 1): a constant feature still yields itself
+            # as a threshold via the <=num_splits branch above
+            raise ValueError(
+                f"no candidate splits for feature {j} (num_splits="
+                f"{num_splits}); MLlib asserts splits.length > 0 and "
+                "would abort too"
+            )
+        out.append(th)
+    return out
+
+
+def bin_features_mllib(
+    features: np.ndarray, thresholds: List[np.ndarray]
+) -> np.ndarray:
+    """``TreePoint.findBin``: bin ``b`` covers ``(split(b-1),
+    split(b)]`` — equality goes LEFT, i.e. ``searchsorted(...,
+    'left')`` (the production path's historical ``'right'`` convention
+    was aligned to this; see ``trees.bin_features``)."""
+    features = np.asarray(features, dtype=np.float64)
+    n, d = features.shape
+    binned = np.empty((n, d), dtype=np.int32)
+    for j in range(d):
+        binned[:, j] = np.searchsorted(thresholds[j], features[:, j], side="left")
+    return binned
+
+
+# --------------------------------------------------------------------------
+# Impurity / gain (float64, MLlib association order)
+# --------------------------------------------------------------------------
+
+_INVALID_GAIN = -np.finfo(np.float64).max  # Double.MinValue
+
+
+def _calculate(counts: np.ndarray, impurity: str) -> float:
+    """Gini.calculate / Entropy.calculate on weighted class counts."""
+    total = float(counts.sum())
+    if total == 0.0:
+        return 0.0
+    if impurity == "entropy":
+        acc = 0.0
+        for c in counts:
+            if c != 0.0:
+                freq = c / total
+                acc -= freq * (math.log(freq) / math.log(2.0))
+        return acc
+    acc = 0.0
+    for c in counts:
+        freq = c / total
+        acc += freq * freq
+    return 1.0 - acc
+
+
+def _predict_from(counts: np.ndarray) -> float:
+    """ImpurityCalculator.predict: first-max class index."""
+    return float(int(np.argmax(counts)))
+
+
+@dataclass
+class GainStats:
+    gain: float
+    left_counts: np.ndarray
+    right_counts: np.ndarray
+    left_impurity: float
+    right_impurity: float
+
+
+def _gain_for_split(
+    left_counts: np.ndarray,
+    right_counts: np.ndarray,
+    node_impurity: float,
+    impurity: str,
+    min_instances: int,
+    min_info_gain: float = 0.0,
+) -> GainStats:
+    """``calculateGainForSplit``: Long-truncated counts gate
+    minInstances; weights are formed from those Longs; the gain is
+    accumulated in MLlib's exact association order."""
+    left_count = int(float(left_counts.sum()))  # stats.sum.toLong
+    right_count = int(float(right_counts.sum()))
+    if left_count < min_instances or right_count < min_instances:
+        return GainStats(_INVALID_GAIN, left_counts, right_counts, 0.0, 0.0)
+    total = left_count + right_count
+    left_imp = _calculate(left_counts, impurity)
+    right_imp = _calculate(right_counts, impurity)
+    left_weight = left_count / float(total)
+    right_weight = right_count / float(total)
+    gain = node_impurity - left_weight * left_imp - right_weight * right_imp
+    if gain < min_info_gain:
+        return GainStats(_INVALID_GAIN, left_counts, right_counts, 0.0, 0.0)
+    return GainStats(gain, left_counts, right_counts, left_imp, right_imp)
+
+
+# --------------------------------------------------------------------------
+# Tree growth (RandomForest.run / DecisionTree.findBestSplits)
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class OracleNode:
+    """One node of the emulated tree, heap-indexed like MLlib's
+    ``Node`` (root id 1; children ``2i``/``2i+1``; level =
+    ``floor(log2(id))``)."""
+
+    id: int
+    predict: float = 0.0
+    impurity: float = 0.0
+    is_leaf: bool = True
+    split_feature: int = -1
+    split_threshold: float = 0.0
+    left: Optional["OracleNode"] = None
+    right: Optional["OracleNode"] = None
+    # growth-time state (sample indices reaching this node)
+    idx: Optional[np.ndarray] = field(default=None, repr=False)
+
+    @property
+    def level(self) -> int:
+        return self.id.bit_length() - 1  # Node.indexToLevel
+
+    def predict_row(self, row: np.ndarray) -> float:
+        node = self
+        while not node.is_leaf and node.left is not None:
+            if row[node.split_feature] <= node.split_threshold:
+                node = node.left
+            else:
+                node = node.right
+        return node.predict
+
+
+def _class_counts(
+    labels: np.ndarray, idx: np.ndarray, weights: np.ndarray, n_classes: int
+) -> np.ndarray:
+    counts = np.zeros(n_classes, dtype=np.float64)
+    for c in range(n_classes):
+        counts[c] = float(weights[idx[labels[idx] == c]].sum())
+    return counts
+
+
+def _best_split_for_node(
+    node: OracleNode,
+    binned: np.ndarray,
+    labels: np.ndarray,
+    weights: np.ndarray,
+    thresholds: List[np.ndarray],
+    feature_subset: Optional[List[int]],
+    impurity: str,
+    min_instances: int,
+    n_classes: int,
+) -> Tuple[int, int, GainStats, np.ndarray, float]:
+    """binsToBestSplit over the node's samples: first-max over
+    features in subset order, splits in threshold order.  Returns
+    (feature, split_idx, stats, total_counts, node_impurity)."""
+    idx = node.idx
+    assert idx is not None
+    features_iter = (
+        feature_subset if feature_subset is not None else range(binned.shape[1])
+    )
+    total_counts = _class_counts(labels, idx, weights, n_classes)
+    node_impurity = _calculate(total_counts, impurity)
+    best: Tuple[int, int, GainStats] = (-1, -1, GainStats(
+        _INVALID_GAIN, total_counts, total_counts, 0.0, 0.0
+    ))
+    best_gain = _INVALID_GAIN
+    for f in features_iter:
+        n_splits = len(thresholds[f])
+        if n_splits == 0:
+            continue
+        # per-(bin, class) weighted histogram for this feature
+        hist = np.zeros((n_splits + 1, n_classes), dtype=np.float64)
+        for c in range(n_classes):
+            sel = idx[labels[idx] == c]
+            np.add.at(hist[:, c], binned[sel, f], weights[sel])
+        cum = hist.cumsum(axis=0)
+        for s in range(n_splits):
+            left_counts = cum[s].copy()
+            right_counts = total_counts - left_counts
+            stats = _gain_for_split(
+                left_counts,
+                right_counts,
+                node_impurity,
+                impurity,
+                min_instances,
+            )
+            if stats.gain > best_gain:  # strict: first max wins
+                best_gain = stats.gain
+                best = (f, s, stats)
+    return best[0], best[1], best[2], total_counts, node_impurity
+
+
+def _grow_forest_oracle(
+    features: np.ndarray,
+    labels: np.ndarray,
+    bag_weights: np.ndarray,  # (T, n) float64 instance weights
+    thresholds: List[np.ndarray],
+    *,
+    impurity: str,
+    max_depth: int,
+    min_instances: int,
+    num_features_per_node: int,
+    node_rng: Optional[JavaRandom],
+    n_classes: int = 2,
+) -> List[OracleNode]:
+    """The FIFO node-queue loop of ``RandomForest.run``: groups are
+    whole queue snapshots (maxMemoryInMB unbounded — module
+    docstring #3); per-node feature subsets are drawn in queue order
+    at selection time; children are re-enqueued per tree in ascending
+    tree index (exact for a single tree; canonical otherwise)."""
+    binned = bin_features_mllib(features, thresholds)
+    n, d = binned.shape
+    T = bag_weights.shape[0]
+    subsampling = num_features_per_node < d
+
+    roots = [OracleNode(id=1, idx=np.arange(n)) for _ in range(T)]
+    queue: deque = deque((t, roots[t]) for t in range(T))
+
+    while queue:
+        group = list(queue)
+        queue.clear()
+        # selectNodesToSplit: one nextLong per queued node, queue order
+        subsets: Dict[int, Optional[List[int]]] = {}
+        for gi, (t, node) in enumerate(group):
+            if subsampling:
+                assert node_rng is not None
+                subsets[gi] = reservoir_sample_range(
+                    d, num_features_per_node, node_rng.next_long()
+                )
+            else:
+                subsets[gi] = None
+        # findBestSplits application + child enqueue, canonical order:
+        # ascending tree index, nodes within a tree in queue order
+        order = sorted(range(len(group)), key=lambda gi: (group[gi][0], gi))
+        for gi in order:
+            t, node = group[gi]
+            f, s, stats, total_counts, node_imp = _best_split_for_node(
+                node,
+                binned,
+                labels,
+                bag_weights[t],
+                thresholds,
+                subsets[gi],
+                impurity,
+                min_instances,
+                n_classes,
+            )
+            node.predict = _predict_from(total_counts)
+            node.impurity = node_imp
+            is_leaf = stats.gain <= 0.0 or node.level == max_depth
+            node.is_leaf = is_leaf
+            if is_leaf:
+                node.idx = None
+                continue
+            node.split_feature = f
+            node.split_threshold = float(thresholds[f][s])
+            idx = node.idx
+            assert idx is not None
+            go_left = binned[idx, f] <= s
+            child_level_is_max = node.level + 1 == max_depth
+            left = OracleNode(
+                id=2 * node.id,
+                predict=_predict_from(stats.left_counts),
+                impurity=stats.left_impurity,
+                is_leaf=child_level_is_max or stats.left_impurity == 0.0,
+                idx=idx[go_left],
+            )
+            right = OracleNode(
+                id=2 * node.id + 1,
+                predict=_predict_from(stats.right_counts),
+                impurity=stats.right_impurity,
+                is_leaf=child_level_is_max or stats.right_impurity == 0.0,
+                idx=idx[~go_left],
+            )
+            node.left, node.right = left, right
+            node.idx = None
+            if not left.is_leaf:
+                queue.append((t, left))
+            if not right.is_leaf:
+                queue.append((t, right))
+    return roots
+
+
+# --------------------------------------------------------------------------
+# Public entry points
+# --------------------------------------------------------------------------
+
+
+def oracle_decision_tree(
+    features: np.ndarray,
+    labels: np.ndarray,
+    *,
+    max_bins: int = 32,
+    impurity: str = "gini",
+    max_depth: int = 5,
+    min_instances: int = 1,
+) -> OracleNode:
+    """``new DecisionTree(strategy).run(rdd)``: numTrees=1,
+    featureSubsetStrategy "all", weight-1 bagging — fully
+    deterministic (no RNG is consumed; see module docstring)."""
+    features = np.asarray(features, dtype=np.float64)
+    y = np.asarray(labels, dtype=np.float64).astype(np.int64)
+    thresholds = find_splits_bins(features, max_bins)
+    bag = np.ones((1, len(y)), dtype=np.float64)
+    roots = _grow_forest_oracle(
+        features,
+        y,
+        bag,
+        thresholds,
+        impurity=impurity,
+        max_depth=max_depth,
+        min_instances=min_instances,
+        num_features_per_node=features.shape[1],
+        node_rng=None,
+    )
+    return roots[0]
+
+
+def num_features_per_node(
+    strategy: str, num_features: int, num_trees: int
+) -> int:
+    """``DecisionTreeMetadata.buildMetadata`` featureSubsetStrategy
+    resolution for classification."""
+    if strategy == "auto":
+        strategy = "all" if num_trees == 1 else "sqrt"
+    if strategy == "all":
+        return num_features
+    if strategy == "sqrt":
+        return int(math.ceil(math.sqrt(num_features)))
+    if strategy == "log2":
+        return max(1, int(math.ceil(math.log(num_features) / math.log(2))))
+    if strategy == "onethird":
+        return int(math.ceil(num_features / 3.0))
+    raise ValueError(f"unknown featureSubsetStrategy: {strategy!r}")
+
+
+def poisson_bag_weights(
+    n: int, num_trees: int, seed: int, subsample: float = 1.0
+) -> np.ndarray:
+    """``BaggedPoint.convertToBaggedRDDSamplingWithReplacement`` on a
+    single partition: one Well19937c reseeded ``seed + 0 + 1``, then
+    per instance (RDD order) ``num_trees`` Poisson draws."""
+    rng = Well19937c(seed + 1)
+    w = np.empty((num_trees, n), dtype=np.float64)
+    for i in range(n):
+        for t in range(num_trees):
+            w[t, i] = float(poisson_sample(rng, subsample))
+    return w
+
+
+def oracle_random_forest(
+    features: np.ndarray,
+    labels: np.ndarray,
+    *,
+    num_trees: int = 100,
+    feature_subset_strategy: str = "auto",
+    max_bins: int = 32,
+    impurity: str = "gini",
+    max_depth: int = 5,
+    min_instances: int = 1,
+    seed: int = 12345,
+) -> List[OracleNode]:
+    """``new RandomForest(strategy, numTrees, featureSubsetStrategy,
+    seed).run(rdd)`` under the canonical single-partition,
+    ascending-tree-order layout (module docstring: *Environmental
+    dependences*)."""
+    features = np.asarray(features, dtype=np.float64)
+    y = np.asarray(labels, dtype=np.float64).astype(np.int64)
+    n, d = features.shape
+    thresholds = find_splits_bins(features, max_bins)
+    if num_trees > 1:
+        bag = poisson_bag_weights(n, num_trees, seed)
+    else:
+        bag = np.ones((1, n), dtype=np.float64)
+    return _grow_forest_oracle(
+        features,
+        y,
+        bag,
+        thresholds,
+        impurity=impurity,
+        max_depth=max_depth,
+        min_instances=min_instances,
+        num_features_per_node=num_features_per_node(
+            feature_subset_strategy, d, num_trees
+        ),
+        node_rng=JavaRandom(seed),
+    )
+
+
+def predict_tree(root: OracleNode, features: np.ndarray) -> np.ndarray:
+    """``DecisionTreeModel.predict``: raw-feature threshold walk."""
+    features = np.asarray(features, dtype=np.float64)
+    return np.array(
+        [root.predict_row(features[i]) for i in range(features.shape[0])],
+        dtype=np.float64,
+    )
+
+
+def predict_forest(roots: List[OracleNode], features: np.ndarray) -> np.ndarray:
+    """``TreeEnsembleModel.predictByVoting`` with unit tree weights:
+    unweighted majority vote; a 50/50 tie resolves to the class first
+    reaching the maximum in tree order (scala's mutable-map maxBy on
+    a 2-entry map keeps the first maximal entry in insertion order,
+    i.e. the class the earliest tree voted for)."""
+    features = np.asarray(features, dtype=np.float64)
+    votes = np.stack([predict_tree(r, features) for r in roots])  # (T, n)
+    n = features.shape[0]
+    out = np.empty(n, dtype=np.float64)
+    for i in range(n):
+        tally: Dict[float, float] = {}
+        for t in range(votes.shape[0]):
+            v = votes[t, i]
+            tally[v] = tally.get(v, 0.0) + 1.0
+        best_v, best_c = None, -1.0
+        for v, c in tally.items():  # insertion order = first-vote order
+            if c > best_c:
+                best_v, best_c = v, c
+        out[i] = best_v
+    return out
+
+
+def tree_depth(root: OracleNode) -> int:
+    if root.is_leaf or root.left is None:
+        return 0
+    return 1 + max(tree_depth(root.left), tree_depth(root.right))
+
+
+def tree_node_count(root: OracleNode) -> int:
+    if root.is_leaf or root.left is None:
+        return 1
+    return 1 + tree_node_count(root.left) + tree_node_count(root.right)

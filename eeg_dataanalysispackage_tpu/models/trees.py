@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import json
 import io
+import math
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -44,7 +45,9 @@ def _impurity(counts: np.ndarray, kind: str) -> np.ndarray:
     total = counts.sum(axis=-1, keepdims=True)
     p = counts / np.maximum(total, _EPS)
     if kind == "entropy":
-        return -(p * np.log2(np.maximum(p, _EPS))).sum(axis=-1)
+        # MLlib's Entropy.log2 is log(x)/log(2), NOT a fused log2 —
+        # matched so impurities bit-agree with mllib_tree_oracle
+        return -(p * (np.log(np.maximum(p, _EPS)) / math.log(2.0))).sum(axis=-1)
     return 1.0 - (p**2).sum(axis=-1)  # gini
 
 
@@ -79,17 +82,44 @@ class _Tree:
 
 
 def compute_bin_edges(features: np.ndarray, max_bins: int) -> np.ndarray:
-    """Quantile bin edges per feature, MLlib-style: (d, max_bins-1)."""
-    qs = np.linspace(0, 1, max_bins + 1)[1:-1]
-    return np.quantile(features, qs, axis=0).T  # (d, max_bins-1)
+    """Candidate split thresholds per feature: (d, max_bins-1).
+
+    Thresholds come from MLlib 1.6.2's count-stride sketch over sorted
+    distinct *observed values* (``DecisionTree
+    .findSplitsForContinuousFeature``; emulated exactly in
+    ``models/mllib_tree_oracle.py``), NOT from interpolated
+    ``np.quantile`` — so the production tree evaluates the same
+    candidate set the reference's JVM does.  ``maxPossibleBins =
+    min(maxBins, numExamples)`` as in ``DecisionTreeMetadata``.
+    Features with fewer thresholds than ``max_bins - 1`` are padded
+    with ``+inf``; padded candidates produce an empty right child and
+    are rejected by the min-instances validity mask in both growers,
+    keeping the dense (d, max_bins-1) shape the device path tiles."""
+    from . import mllib_tree_oracle
+
+    features = np.asarray(features, dtype=np.float64)
+    n, d = features.shape
+    num_splits = min(max_bins, n) - 1
+    edges = np.full((d, max_bins - 1), np.inf, dtype=np.float64)
+    for j in range(d):
+        th = mllib_tree_oracle.find_splits_for_continuous_feature(
+            features[:, j], num_splits
+        )
+        edges[j, : len(th)] = th
+    return edges
 
 
 def bin_features(features: np.ndarray, edges: np.ndarray) -> np.ndarray:
-    """(n, d) continuous -> (n, d) int bin indices in [0, max_bins)."""
+    """(n, d) continuous -> (n, d) int bin indices in [0, max_bins).
+
+    ``side='left'``: a value equal to a threshold lands in the bin
+    that threshold closes, so the split ``bin <= b`` sends it LEFT —
+    MLlib's ``(split(b-1), split(b)]`` bin semantics
+    (``TreePoint.findBin``)."""
     n, d = features.shape
     binned = np.empty((n, d), dtype=np.int32)
     for j in range(d):
-        binned[:, j] = np.searchsorted(edges[j], features[:, j], side="right")
+        binned[:, j] = np.searchsorted(edges[j], features[:, j], side="left")
     return binned
 
 
@@ -148,11 +178,16 @@ def _grow_tree(
             nr = right_counts.sum(-1)
             valid = (nl >= min_instances) & (nr >= min_instances)
             parent_imp = _impurity(total[:, 0, :], impurity)[:, None]
-            child = (
-                nl * _impurity(left_counts, impurity)
-                + nr * _impurity(right_counts, impurity)
-            ) / m
-            gain = np.where(valid, parent_imp - child, -np.inf)
+            # MLlib association order (calculateGainForSplit):
+            # impurity - lw*lImp - rw*rImp, mirrored by the device
+            # grower and models/mllib_tree_oracle.py so near-tie
+            # argmaxes bit-match the oracle
+            gain = (
+                parent_imp
+                - (nl / m) * _impurity(left_counts, impurity)
+                - (nr / m) * _impurity(right_counts, impurity)
+            )
+            gain = np.where(valid, gain, -np.inf)
             best_flat = int(np.argmax(gain))
             bf, bb = divmod(best_flat, max_bins - 1)
             if not np.isfinite(gain[bf, bb]) or gain[bf, bb] <= 0:

@@ -31,6 +31,7 @@ tests/test_trees_device.py).
 
 from __future__ import annotations
 
+import math
 from functools import partial
 from typing import Dict, Optional
 
@@ -50,7 +51,11 @@ def _impurity(counts: jnp.ndarray, kind: str) -> jnp.ndarray:
     total = counts.sum(axis=-1, keepdims=True)
     p = counts / jnp.maximum(total, _EPS)
     if kind == "entropy":
-        return -(p * jnp.log2(jnp.maximum(p, _EPS))).sum(axis=-1)
+        # log(x)/log(2), matching the host grower and MLlib's
+        # Entropy.log2 so near-tie argmaxes track the same formulation
+        return -(
+            p * (jnp.log(jnp.maximum(p, _EPS)) / math.log(2.0))
+        ).sum(axis=-1)
     return 1.0 - (p * p).sum(axis=-1)
 
 
@@ -233,10 +238,17 @@ def _grow_one(
         valid = (nl >= min_instances) & (nr >= min_instances)
         valid &= feature_mask[offset : offset + L][:, :, None]
         parent_imp = _impurity(node_counts, impurity)  # (L,)
-        child = (
-            nl * _impurity(left, impurity) + nr * _impurity(right, impurity)
-        ) / jnp.maximum(m, _EPS)[:, None, None]
-        gain = jnp.where(valid, parent_imp[:, None, None] - child, -jnp.inf)
+        # MLlib association order: impurity - lw*lImp - rw*rImp
+        # (InformationGainStats.calculateGainForSplit), mirrored by the
+        # host grower and models/mllib_tree_oracle.py so near-tie
+        # argmaxes agree across all three
+        mm = jnp.maximum(m, _EPS)[:, None, None]
+        gain = (
+            parent_imp[:, None, None]
+            - (nl / mm) * _impurity(left, impurity)
+            - (nr / mm) * _impurity(right, impurity)
+        )
+        gain = jnp.where(valid, gain, -jnp.inf)
 
         def accept(best_gain):
             return (
