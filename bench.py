@@ -206,8 +206,13 @@ def _collect(platform: str) -> dict:
             variants[name] = {
                 "epochs_per_s": r["epochs_per_s"],
                 "bytes_per_epoch": r["bytes_per_epoch"],
-                "pct_of_hbm_roofline": r["pct_of_hbm_roofline"],
             }
+            # present only for TPU timings (ingest_bench omits it on
+            # CPU so fallback output can't be misread as a roofline)
+            if "pct_of_hbm_roofline" in r:
+                variants[name]["pct_of_hbm_roofline"] = r[
+                    "pct_of_hbm_roofline"
+                ]
             if "formulation" in r:
                 variants[name]["formulation"] = r["formulation"]
         except (RuntimeError, subprocess.TimeoutExpired, ValueError,
@@ -223,9 +228,12 @@ def _collect(platform: str) -> dict:
         "value": eps,
         "unit": "epochs/s",
         "vs_baseline": round(eps / BASELINE_EPOCHS_PER_SEC, 3),
-        "pct_of_hbm_roofline": variants["einsum"]["pct_of_hbm_roofline"],
         "variants": variants,
     }
+    if "pct_of_hbm_roofline" in variants["einsum"]:
+        payload["pct_of_hbm_roofline"] = variants["einsum"][
+            "pct_of_hbm_roofline"
+        ]
     if platform != "tpu":
         payload["platform"] = "cpu_fallback"
     return payload

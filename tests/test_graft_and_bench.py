@@ -102,7 +102,11 @@ def test_bench_cpu_fallback_contract():
     assert payload["unit"] == "epochs/s"
     assert payload["value"] > 0
     assert payload["platform"] == "cpu_fallback"
-    assert "pct_of_hbm_roofline" in payload
+    # CPU timings must never carry a TPU-HBM roofline claim
+    # (VERDICT r3 weak #6): the field is TPU-platform-only, both at
+    # the headline and inside every variant
+    assert "pct_of_hbm_roofline" not in payload
     for v in ("einsum", "einsum_bf16", "regular_ingest", "pallas_ingest",
               "train_step"):
         assert payload["variants"][v]["epochs_per_s"] > 0, payload
+        assert "pct_of_hbm_roofline" not in payload["variants"][v], payload

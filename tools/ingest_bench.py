@@ -632,6 +632,7 @@ def run(variant: str, n: int, iters: int) -> dict:
 
     eps = n * iters / elapsed
     gbps = eps * bytes_per_epoch / 1e9
+    platform = jax.devices()[0].platform
     payload = {
         "variant": variant,
         "epochs_per_s": round(eps, 1),
@@ -640,9 +641,14 @@ def run(variant: str, n: int, iters: int) -> dict:
         "elapsed_s": round(elapsed, 3),
         "bytes_per_epoch": bytes_per_epoch,
         "achieved_GBps": round(gbps, 1),
-        "pct_of_hbm_roofline": round(100.0 * gbps / HBM_GBPS, 1),
-        "platform": jax.devices()[0].platform,
+        "platform": platform,
     }
+    # pct_of_hbm_roofline divides by the v5e HBM bandwidth, which is
+    # only a meaningful denominator when the timing came from a TPU;
+    # CPU runs omit the field entirely so a fallback artifact can
+    # never be misread as a roofline claim (VERDICT r3 weak #6)
+    if platform in ("tpu", "axon"):
+        payload["pct_of_hbm_roofline"] = round(100.0 * gbps / HBM_GBPS, 1)
     # a failed _check_parity raised above, so published numbers are valid
     if variant == "pallas_ingest":
         payload["tile_fill"] = round(fill, 3)
