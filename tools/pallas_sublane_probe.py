@@ -1,0 +1,362 @@
+"""Probe the constructs for a bank128 Pallas ingest kernel on chip.
+
+Round-4 bisect (tools/sweep_results/r4/pallas_bisect.json) proved the
+remote compile helper crashes on ANY dynamic-offset lane slice from
+VMEM (aligned or not: k4 and k4b), while scalar-prefetch block
+indexing, int16 convert, VMEM scratch and HIGHEST dots all compile.
+The fix path must therefore cut epoch windows with dynamic SUBLANE
+(row) slices over a rows-of-128 layout, absorbing the in-row shift
+with a 128-variant operator bank (the block_ingest trick from
+ops/device_ingest.py, moved into VMEM). Each step below is one
+construct of that kernel, tiny shapes, compiled+run in sequence.
+"""
+import json
+import os
+import sys
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+print("platform:", jax.devices()[0].platform, flush=True)
+
+if os.environ.get("PROBE_INTERPRET") == "1":
+    # hermetic CPU smoke of the same probe bodies (tests the Python,
+    # not Mosaic)
+    import functools
+    pl.pallas_call = functools.partial(pl.pallas_call, interpret=True)
+
+C = 3          # channels
+R = 16         # 128-lane rows per channel chunk
+R2 = R // 2    # rows per half-chunk
+B = 4          # epochs per tile
+SLAB = 8       # rows per epoch slab (8*128=1024 >= 787+127)
+K = 64         # probe feature width (multiple of lanes not needed)
+
+
+def step(name, fn, expect=None):
+    try:
+        out = np.asarray(fn())
+        s = float(out.sum())
+        ok = expect is None or abs(s - expect) < 1e-3 * max(1.0, abs(expect))
+        print(json.dumps({"step": name, "ok": bool(ok), "sum": s,
+                          "expect": expect}), flush=True)
+    except Exception as e:
+        msg = f"{type(e).__name__}: {e}"
+        print(json.dumps({"step": name, "ok": False,
+                          "error": msg[:400]}), flush=True)
+
+
+# s1: dynamic SUBLANE slice from an input ref (the k4 mirror, rows not
+# lanes) — the load-bearing construct
+def s1():
+    def kernel(off_ref, x_ref, o_ref):
+        o_ref[:] = x_ref[pl.ds(off_ref[0], 8), :]
+    off = jnp.array([37], jnp.int32)
+    x = jnp.arange(64 * 128, dtype=jnp.float32).reshape(64, 128)
+    gs = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1, grid=(1,),
+        in_specs=[pl.BlockSpec((64, 128), lambda i, off: (0, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i, off: (0, 0)),
+    )
+    out = pl.pallas_call(
+        kernel, grid_spec=gs,
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+    )(off, x)
+    return out
+
+
+def _s1_expect():
+    x = np.arange(64 * 128, dtype=np.float64).reshape(64, 128)
+    return float(x[37:45].sum())
+
+
+# s2: dynamic sublane slice on the MIDDLE dim of a 3D VMEM scratch
+# (the slab cut: chunk_ref[c, ds(b, 8), :])
+def s2():
+    def kernel(off_ref, x_ref, o_ref, ch_ref):
+        ch_ref[:, :, :] = x_ref[:].astype(jnp.float32) * 2.0
+        for c in range(C):
+            o_ref[c, :, :] = ch_ref[c, pl.ds(off_ref[c], SLAB), :]
+    off = jnp.array([0, 3, 8], jnp.int32)
+    x = jnp.ones((C, R, 128), jnp.int16)
+    gs = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1, grid=(1,),
+        in_specs=[pl.BlockSpec((C, R, 128), lambda i, off: (0, 0, 0))],
+        out_specs=pl.BlockSpec((C, SLAB, 128), lambda i, off: (0, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((C, R, 128), jnp.float32)],
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=gs,
+        out_shape=jax.ShapeDtypeStruct((C, SLAB, 128), jnp.float32),
+    )(off, x)
+
+
+# s3: 3D int16 input block via scalar-prefetched index on the row dim
+# (the half-chunk fetch in rows-of-128 layout)
+def s3():
+    def kernel(hi_ref, a_ref, b_ref, o_ref):
+        del hi_ref
+        o_ref[:, :R2, :] = a_ref[:].astype(jnp.float32)
+        o_ref[:, R2:, :] = b_ref[:].astype(jnp.float32) * 10.0
+    hi = jnp.array([2], jnp.int32)
+    x = jnp.ones((C, 8 * R2, 128), jnp.int16)
+    gs = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1, grid=(1,),
+        in_specs=[
+            pl.BlockSpec((C, R2, 128), lambda i, hi: (0, hi[0], 0)),
+            pl.BlockSpec((C, R2, 128), lambda i, hi: (0, hi[0] + 1, 0)),
+        ],
+        out_specs=pl.BlockSpec((C, R, 128), lambda i, hi: (0, 0, 0)),
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=gs,
+        out_shape=jax.ShapeDtypeStruct((C, R, 128), jnp.float32),
+    )(hi, x, x)
+
+
+# s4: write an (SLAB,128) slab into one leading index of a 3D scratch,
+# then read the whole scratch back reshaped (B*C, SLAB*128) for a
+# HIGHEST dot — the xa accumulation + contraction shape
+def s4():
+    def kernel(off_ref, x_ref, w_ref, o_ref, xa_ref):
+        for i in range(B * C):
+            xa_ref[i, :, :] = x_ref[pl.ds(off_ref[i % B], SLAB), :]
+        flat = xa_ref[:].reshape(B * C, SLAB * 128)
+        o_ref[:] = lax.dot_general(
+            flat, w_ref[:], (((1,), (0,)), ((), ())),
+            precision=lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        )
+    off = jnp.array([0, 1, 2, 3], jnp.int32)
+    x = jnp.ones((R * 2, 128), jnp.float32)
+    w = jnp.full((SLAB * 128, K), 0.5, jnp.float32)
+    gs = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1, grid=(1,),
+        in_specs=[
+            pl.BlockSpec((R * 2, 128), lambda i, off: (0, 0)),
+            pl.BlockSpec((SLAB * 128, K), lambda i, off: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((B * C, K), lambda i, off: (0, 0)),
+        scratch_shapes=[pltpu.VMEM((B * C, SLAB, 128), jnp.float32)],
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=gs,
+        out_shape=jax.ShapeDtypeStruct((B * C, K), jnp.float32),
+    )(off, x, w)
+
+
+# s5: one-hot shift select on the VPU (iota compare + mul-sum), the
+# bank-select construct, fed from a dot result
+def s5():
+    NV = 8
+    def kernel(sh_ref, y_ref, o_ref):
+        # shifts ride in VMEM as a (B, 1) int32 operand: SMEM scalar
+        # refs only allow scalar loads on TPU, and the one-hot needs
+        # the whole vector
+        onehot = (
+            sh_ref[:]
+            == lax.broadcasted_iota(jnp.int32, (B, NV), 1)
+        ).astype(jnp.float32)
+        yb = y_ref[:].reshape(B, NV, K)
+        o_ref[:] = jnp.sum(yb * onehot[:, :, None], axis=1)
+    sh = jnp.array([[0], [3], [7], [1]], jnp.int32)
+    y = jnp.ones((B, NV * K), jnp.float32)
+    return pl.pallas_call(
+        kernel,
+        in_specs=[
+            pl.BlockSpec((B, 1), lambda: (0, 0)),
+            pl.BlockSpec((B, NV * K), lambda: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((B, K), lambda: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, K), jnp.float32),
+    )(sh, y)
+
+
+# s6: mini end-to-end bank kernel: int16 rows in, per-epoch dynamic
+# sublane slab cut, mean-center, flatten, one HIGHEST dot against a
+# bank, one-hot select — every construct of the real bank128 kernel
+def s6():
+    NV = 4
+    KK = 16
+    def kernel(blk_ref, x_ref, sh_ref, wv_ref, o_ref, ch_ref, xa_ref):
+        ch_ref[:, :, :] = x_ref[:].astype(jnp.float32) * 0.5
+        for e in range(B):
+            for c in range(C):
+                xa_ref[e * C + c, :, :] = ch_ref[c, pl.ds(blk_ref[e], SLAB), :]
+        flat = xa_ref[:].reshape(B * C, SLAB * 128)
+        d = jnp.mean(flat, axis=1, keepdims=True)
+        yv = lax.dot_general(
+            flat - d, wv_ref[:], (((1,), (0,)), ((), ())),
+            precision=lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        )  # (B*C, NV*KK)
+        onehot = (
+            sh_ref[:]
+            == lax.broadcasted_iota(jnp.int32, (B, NV), 1)
+        ).astype(jnp.float32)
+        yb = yv.reshape(B, C, NV, KK)
+        o_ref[:] = jnp.sum(
+            yb * onehot[:, None, :, None], axis=2
+        ).reshape(B, C * KK)
+    blk = jnp.array([0, 2, 5, 8], jnp.int32)
+    sh = jnp.array([[0], [1], [3], [2]], jnp.int32)
+    x = jnp.ones((C, R, 128), jnp.int16)
+    wv = jnp.ones((SLAB * 128, NV * KK), jnp.float32)
+    gs = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1, grid=(1,),
+        in_specs=[
+            pl.BlockSpec((C, R, 128), lambda i, blk: (0, 0, 0)),
+            pl.BlockSpec((B, 1), lambda i, blk: (0, 0)),
+            pl.BlockSpec((SLAB * 128, NV * KK), lambda i, blk: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((B, C * KK), lambda i, blk: (0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((C, R, 128), jnp.float32),
+            pltpu.VMEM((B * C, SLAB, 128), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=gs,
+        out_shape=jax.ShapeDtypeStruct((B, C * KK), jnp.float32),
+    )(blk, x, sh, wv)
+
+
+# s5a: the iota-compare mask ALONE (no reshape) — splits s5's crash
+# between the mask build and the (B, NV*K) -> (B, NV, K) lane-split
+# reshape
+def s5a():
+    NV = 8
+    def kernel(sh_ref, o_ref):
+        o_ref[:] = (
+            sh_ref[:]
+            == lax.broadcasted_iota(jnp.int32, (B, NV * K), 1) // K
+        ).astype(jnp.float32)
+    sh = jnp.array([[0], [3], [7], [1]], jnp.int32)
+    return pl.pallas_call(
+        kernel,
+        in_specs=[pl.BlockSpec((B, 1), lambda: (0, 0))],
+        out_specs=pl.BlockSpec((B, NV * K), lambda: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, NV * K), jnp.float32),
+    )(sh)
+
+
+# s5b: reshape-free select — lane-iota mask * y, then a STATIC 0/1
+# fold matrix dot collapses the strided variant groups (MXU, no
+# relayout). The production select if s5's reshape is the crasher.
+def s5b():
+    NV = 8
+    fold = np.zeros((NV * K, K), np.float32)
+    for v in range(NV):
+        fold[v * K : (v + 1) * K, :] = np.eye(K, dtype=np.float32)
+    def kernel(sh_ref, y_ref, f_ref, o_ref):
+        mask = (
+            sh_ref[:]
+            == lax.broadcasted_iota(jnp.int32, (B, NV * K), 1) // K
+        ).astype(jnp.float32)
+        o_ref[:] = lax.dot_general(
+            y_ref[:] * mask, f_ref[:], (((1,), (0,)), ((), ())),
+            precision=lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        )
+    sh = jnp.array([[0], [3], [7], [1]], jnp.int32)
+    y = jnp.arange(B * NV * K, dtype=jnp.float32).reshape(B, NV * K)
+    return pl.pallas_call(
+        kernel,
+        in_specs=[
+            pl.BlockSpec((B, 1), lambda: (0, 0)),
+            pl.BlockSpec((B, NV * K), lambda: (0, 0)),
+            pl.BlockSpec((NV * K, K), lambda: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((B, K), lambda: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, K), jnp.float32),
+    )(sh, y, jnp.asarray(fold))
+
+
+def _s5b_expect():
+    y = np.arange(B * NV_ * K, dtype=np.float64).reshape(B, NV_ * K)
+    sh = [0, 3, 7, 1]
+    return float(sum(y[b, sh[b] * K : (sh[b] + 1) * K].sum()
+                     for b in range(B)))
+
+
+NV_ = 8
+
+
+# s7: mini bank kernel, production constructs only: dynamic sublane
+# slab cut + mean center + bank dot + reshape-free mask/fold select,
+# output (B*C, K) rows (the (B, C*K) packing happens outside in XLA)
+def s7():
+    NV = 4
+    KK = 16
+    fold = np.zeros((NV * KK, KK), np.float32)
+    for v in range(NV):
+        fold[v * KK : (v + 1) * KK, :] = np.eye(KK, dtype=np.float32)
+    def kernel(blk_ref, x_ref, sh_ref, wv_ref, f_ref, o_ref,
+               ch_ref, xa_ref):
+        ch_ref[:, :, :] = x_ref[:].astype(jnp.float32) * 0.5
+        for e in range(B):
+            for c in range(C):
+                xa_ref[e * C + c, :, :] = ch_ref[c, pl.ds(blk_ref[e], SLAB), :]
+        flat = xa_ref[:].reshape(B * C, SLAB * 128)
+        d = jnp.mean(flat, axis=1, keepdims=True)
+        yv = lax.dot_general(
+            flat - d, wv_ref[:], (((1,), (0,)), ((), ())),
+            precision=lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        )  # (B*C, NV*KK)
+        mask = (
+            sh_ref[:]
+            == lax.broadcasted_iota(jnp.int32, (B * C, NV * KK), 1) // KK
+        ).astype(jnp.float32)
+        o_ref[:] = lax.dot_general(
+            yv * mask, f_ref[:], (((1,), (0,)), ((), ())),
+            precision=lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        )
+    blk = jnp.array([0, 2, 5, 8], jnp.int32)
+    # per-ROW shifts (epoch's shift repeated for each channel row)
+    sh = jnp.asarray(
+        np.repeat([0, 1, 3, 2], C)[:, None].astype(np.int32)
+    )
+    x = jnp.ones((C, R, 128), jnp.int16)
+    wv = jnp.ones((SLAB * 128, NV * KK), jnp.float32)
+    gs = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1, grid=(1,),
+        in_specs=[
+            pl.BlockSpec((C, R, 128), lambda i, blk: (0, 0, 0)),
+            pl.BlockSpec((B * C, 1), lambda i, blk: (0, 0)),
+            pl.BlockSpec((SLAB * 128, NV * KK), lambda i, blk: (0, 0)),
+            pl.BlockSpec((NV * KK, KK), lambda i, blk: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((B * C, KK), lambda i, blk: (0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((C, R, 128), jnp.float32),
+            pltpu.VMEM((B * C, SLAB, 128), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=gs,
+        out_shape=jax.ShapeDtypeStruct((B * C, KK), jnp.float32),
+    )(blk, x, sh, wv, jnp.asarray(fold))
+
+
+if __name__ == "__main__":
+    steps = [
+        ("s1_dyn_sublane_input", s1, _s1_expect()),
+        ("s2_dyn_sublane_scratch_3d", s2, None),
+        ("s3_3d_block_fetch", s3, None),
+        ("s4_slab_write_reshape_dot", s4, None),
+        ("s5_onehot_select", s5, None),
+        ("s5a_iota_mask", s5a, None),
+        ("s5b_mask_fold_select", s5b, _s5b_expect()),
+        ("s6_mini_bank_kernel", s6, None),
+        ("s7_mini_bank_maskfold", s7, None),
+    ]
+    for name, fn, expect in steps:
+        step(name, fn, expect)
+    print("done", flush=True)
