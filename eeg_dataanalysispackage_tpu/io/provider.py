@@ -148,7 +148,7 @@ class OfflineDataProvider:
         if backend == "pallas":
             import os
 
-            from ..ops import ingest_pallas
+            from ..ops import ingest_pallas, pallas_support
 
             pallas_featurizer = ingest_pallas.make_pallas_ingest_featurizer(
                 wavelet_index=wavelet_index,
@@ -156,10 +156,11 @@ class OfflineDataProvider:
                 skip_samples=skip_samples,
                 feature_size=feature_size,
                 pre=self._pre,
-                # "aligned8" = every dynamic lane slice on a sublane
-                # boundary (the remote-compile-crash fix path); the
-                # default stays "exact" until chip evidence flips it
-                mode=os.environ.get("EEG_PALLAS_MODE", "exact"),
+                # platform-aware: bank128 on compiled Mosaic (the one
+                # chip-compiling formulation, r4 probe), exact on
+                # interpreter platforms; EEG_PALLAS_MODE overrides
+                mode=os.environ.get("EEG_PALLAS_MODE")
+                or pallas_support.default_ingest_mode(),
             )
         if backend == "block":
             featurizer = device_ingest.make_block_ingest_featurizer(

@@ -79,13 +79,26 @@ def kernel_window(
 ) -> int:
     """Kernel segment width for a mode (single source for the library
     and the bench): ``exact`` pads the live window to 8; ``aligned8``
-    additionally covers the residual 0..7 shift."""
+    additionally covers the residual 0..7 shift; ``bank128`` rounds
+    the live-window+127-shift slab up to whole 128-lane rows."""
     live = pre + skip_samples + epoch_size
+    if mode == "bank128":
+        return _bank_slab_rows(live) * _BANK_BLK
     if mode == "aligned8":
         return -(-(live + _ALIGN - 1) // _ALIGN) * _ALIGN
     if mode == "exact":
         return ((live + 7) // 8) * 8
     raise ValueError(f"unknown pallas ingest mode {mode!r}")
+
+
+#: bank128 mode: lanes per row / residual-shift variant count.
+_BANK_BLK = 128
+
+
+def _bank_slab_rows(live_window: int) -> int:
+    """128-lane rows per epoch slab: the live window plus the worst
+    in-row shift (127) must fit."""
+    return -(-(live_window + _BANK_BLK - 1) // _BANK_BLK)
 
 
 def aligned8_banks(
@@ -104,6 +117,41 @@ def aligned8_banks(
         window8, _ALIGN,
     )
     return Wv, Mv, colsum, window8
+
+
+def bank128_banks(
+    wavelet_index: int = 8,
+    epoch_size: int = 512,
+    skip_samples: int = 175,
+    feature_size: int = 16,
+    pre: int = constants.PRESTIMULUS_SAMPLES,
+):
+    """(Wvm, fold, slab_rows) for the bank128 kernel — the shared
+    constructor the featurizer and the bench both use (same role as
+    :func:`aligned8_banks` for the aligned8 kernel).
+
+    ``Wvm`` (slab, 128*K + 128) is ``[Wv | Mv]``: variant v's window
+    operator (taps at slab rows [v, v+win)) next to its pre-stimulus
+    mean taps, so one contraction yields every shift's features AND
+    pre-means. ``fold`` ((128*K + 128), K) is the static select/fold
+    matrix: feature rows carry identity blocks, pre-mean rows carry
+    ``-colsum``, so ``dot(masked, fold) = yk - pk*colsum`` — the
+    two-term baseline correction fused into the select dot."""
+    live = pre + skip_samples + epoch_size
+    slab_rows = _bank_slab_rows(live)
+    slab = slab_rows * _BANK_BLK
+    Wv, Mv, colsum = device_ingest._shift_variant_banks(
+        wavelet_index, epoch_size, skip_samples, feature_size, pre,
+        slab, _BANK_BLK,
+    )
+    K = feature_size
+    NVK = _BANK_BLK * K
+    Wvm = np.concatenate([Wv, Mv], axis=1)
+    fold = np.zeros((NVK + _BANK_BLK, K), np.float32)
+    for v in range(_BANK_BLK):
+        fold[v * K : (v + 1) * K, :] = np.eye(K, dtype=np.float32)
+    fold[NVK:, :] = -colsum
+    return Wvm, fold, slab_rows
 
 
 def plan_pallas_tiles(
@@ -309,6 +357,204 @@ def _ingest_tiles(
     )(half_idx, offsets, raw_i16, raw_i16, resolutions[:, None], E)
 
 
+def _make_kernel_bank(
+    n_channels: int, tile_b: int, chunk: int, feature_size: int,
+    slab_rows: int,
+):
+    """The ``bank128`` kernel: the only formulation whose every
+    construct is proven to compile through the axon remote-compile
+    helper (tools/pallas_sublane_probe.py, run on chip r4).
+
+    The exact kernel's dynamic lane slice and the select's lane-split
+    reshape both crash the helper (r4 bisect k4/k4b, probe s5), so
+    windows are cut as dynamic SUBLANE slices over a rows-of-128
+    layout — ``slab_rows`` whole 128-lane rows starting at the row
+    containing the window start — and the residual in-row shift
+    (0..127) never moves data: a 128-variant operator bank
+    (``device_ingest._shift_variant_banks``, the block_ingest trick
+    moved into VMEM) computes every shift's features and pre-means in
+    ONE MXU contraction against ``[Wv | Mv]``, and a reshape-free
+    mask/fold select — lane-iota//K compare + a static 0/1 fold
+    matrix whose pre-mean rows carry ``-colsum`` — projects out each
+    epoch's shift AND applies the two-term baseline correction in one
+    more dot. Output rows are (epoch, channel) pairs; the per-channel
+    resolution scale, the (tile_b, C*K) packing, and the L2 normalize
+    happen outside in XLA (linear, so commuting them out is exact —
+    all three are cheap on (n, C*K) features).
+    """
+    rows = chunk // _BANK_BLK
+    hrows = rows // 2
+    K = feature_size
+    NVK = _BANK_BLK * K
+
+    def kernel(half_ref, blks_ref, a_ref, b_ref, sh_ref, wvm_ref,
+               fold_ref, o_ref, ch_ref, xa_ref):
+        del half_ref
+        i = pl.program_id(0)
+        ch_ref[:, :hrows, :] = a_ref[:].astype(jnp.float32)
+        ch_ref[:, hrows:, :] = b_ref[:].astype(jnp.float32)
+        for e in range(tile_b):
+            blk = blks_ref[i, e]
+            for c in range(n_channels):
+                xa_ref[e * n_channels + c, :, :] = ch_ref[
+                    c, pl.ds(blk, slab_rows), :
+                ]
+        flat = xa_ref[:].reshape(
+            tile_b * n_channels, slab_rows * _BANK_BLK
+        )
+        # per-slab mean: a per-epoch constant the two-term baseline
+        # algebra cancels exactly; keeps both cancelling terms at
+        # residual scale (f32-safe, same analysis as block ingest)
+        d = jnp.mean(flat, axis=1, keepdims=True)
+        yv = lax.dot_general(
+            flat - d, wvm_ref[:], (((1,), (0,)), ((), ())),
+            precision=lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        )  # (tile_b*C, NVK + NV): all shifts' features | pre-means
+        lane = lax.broadcasted_iota(
+            jnp.int32, (tile_b * n_channels, NVK + _BANK_BLK), 1
+        )
+        v_of_lane = jnp.where(lane < NVK, lane // K, lane - NVK)
+        mask = (sh_ref[:] == v_of_lane).astype(jnp.float32)
+        o_ref[:] = lax.dot_general(
+            yv * mask, fold_ref[:], (((1,), (0,)), ((), ())),
+            precision=lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        )  # (tile_b*C, K) = yk - pk*colsum via the fold matrix
+
+    return kernel
+
+
+#: bank128: max tiles per pallas_call — the scalar-prefetched
+#: ``blocks`` array lives in SMEM (1 MiB on v5e; the r4 chip compile
+#: diagnostic showed a 2 MiB prefetch rejected), so one call handles
+#: at most 2048 tiles (2048*33*4B = 270 KiB of scalars) and callers
+#: split larger runs into equal groups.
+_BANK_MAX_TILES = 2048
+
+
+def bank_ingest_rows(
+    raw_rows_i16,
+    half_idx,
+    blocks,
+    shifts_rows,
+    Wvm,
+    fold,
+    *,
+    tile_b: int,
+    chunk: int,
+    feature_size: int,
+    slab_rows: int,
+    interpret: bool,
+):
+    """Chunked driver for :func:`_ingest_tiles_bank`: splits the tile
+    axis into SMEM-sized groups (static Python loop — jit/scan safe)
+    and concatenates the row outputs. The last group may be smaller
+    (one extra compiled shape, vs up to 2047 dead padded tiles)."""
+    n_tiles = half_idx.shape[0]
+    C = raw_rows_i16.shape[0]
+    if chunk % (2 * _BANK_BLK):
+        # half-chunks must be whole 128-lane rows or the two
+        # BlockSpec fetches land off the planner's sample offsets —
+        # silently wrong features, so fail loudly
+        raise ValueError(
+            f"bank128 needs chunk % {2 * _BANK_BLK} == 0; got {chunk}"
+        )
+    # ragged last group: the SMEM cap only bounds tiles PER CALL, so
+    # a remainder group just compiles one extra (smaller) shape
+    # instead of paying up to _BANK_MAX_TILES-1 dead padded tiles
+    groups = [
+        (g, min(g + _BANK_MAX_TILES, n_tiles))
+        for g in range(0, max(n_tiles, 1), _BANK_MAX_TILES)
+    ]
+    outs = [
+        _ingest_tiles_bank(
+            raw_rows_i16,
+            half_idx[g0:g1],
+            blocks[g0:g1],
+            shifts_rows[g0 * tile_b * C : g1 * tile_b * C],
+            Wvm,
+            fold,
+            tile_b=tile_b,
+            chunk=chunk,
+            feature_size=feature_size,
+            slab_rows=slab_rows,
+            interpret=interpret,
+        )
+        for g0, g1 in groups
+    ]
+    return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "tile_b", "chunk", "feature_size", "slab_rows", "interpret",
+    ),
+)
+def _ingest_tiles_bank(
+    raw_rows_i16,
+    half_idx,
+    blocks,
+    shifts_rows,
+    Wvm,
+    fold,
+    *,
+    tile_b: int,
+    chunk: int,
+    feature_size: int,
+    slab_rows: int,
+    interpret: bool,
+):
+    C = raw_rows_i16.shape[0]
+    n_tiles = half_idx.shape[0]
+    rows = chunk // _BANK_BLK
+    hrows = rows // 2
+    K = feature_size
+    NVK = _BANK_BLK * K
+    slab = slab_rows * _BANK_BLK
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # half_idx, blocks
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec(
+                (C, hrows, _BANK_BLK), lambda i, hi, blk: (0, hi[i], 0)
+            ),
+            pl.BlockSpec(
+                (C, hrows, _BANK_BLK),
+                lambda i, hi, blk: (0, hi[i] + 1, 0),
+            ),
+            pl.BlockSpec(
+                (tile_b * C, 1), lambda i, hi, blk: (i, 0)
+            ),
+            pl.BlockSpec(
+                (slab, NVK + _BANK_BLK), lambda i, hi, blk: (0, 0)
+            ),
+            pl.BlockSpec(
+                (NVK + _BANK_BLK, K), lambda i, hi, blk: (0, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (tile_b * C, K), lambda i, hi, blk: (i, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((C, rows, _BANK_BLK), jnp.float32),
+            pltpu.VMEM((tile_b * C, slab_rows, _BANK_BLK), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        _make_kernel_bank(C, tile_b, chunk, feature_size, slab_rows),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (n_tiles * tile_b * C, K), jnp.float32
+        ),
+        interpret=interpret,
+    )(
+        half_idx, blocks, raw_rows_i16, raw_rows_i16, shifts_rows,
+        Wvm, fold,
+    )
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -395,12 +641,18 @@ def ingest_features_pallas(
     - ``"aligned8"``: every dynamic lane slice 8-aligned (sublane
       boundary, ``pl.multiple_of``); the residual 0..7 shift is
       absorbed by an 8-variant operator bank + one-hot select (see
-      :func:`_make_kernel_aligned`). Built as the fix path for the
-      axon remote-compile crash, whose prime suspect is the exact
-      kernel's arbitrary-offset lane slice (the chip-proven
-      ``dwt_pallas`` kernel differs from it mainly by that construct);
-      numerics follow the block formulation's f32-safe two-term shape
-      (parity pinned in tests/test_ingest_pallas.py).
+      :func:`_make_kernel_aligned`). Built round 3 as a fix
+      hypothesis for the axon remote-compile crash; the round-4 chip
+      bisect FALSIFIED it — the helper crashes on aligned dynamic
+      lane slices too (tools/sweep_results/r4/pallas_bisect.json
+      k4b). Kept for its interpret-mode parity value.
+    - ``"bank128"``: the chip-proven formulation (round-4 probe
+      tools/pallas_sublane_probe.py: every construct compiles through
+      the remote helper). Windows are cut as dynamic SUBLANE slices
+      over a rows-of-128 layout and the in-row shift (0..127) is
+      absorbed by a 128-variant bank + reshape-free mask/fold select
+      (see :func:`_make_kernel_bank`); numerics follow the block
+      formulation's f32-safe two-term shape.
     """
     if interpret is None:
         from . import pallas_support
@@ -438,7 +690,39 @@ def ingest_features_pallas(
               // sample_bucket) * sample_bucket
     if padded != S:
         raw_i16 = np.pad(raw_i16, ((0, 0), (0, padded - S)))
-    if mode == "aligned8":
+    if mode == "bank128":
+        Wvm, fold, slab_rows = bank128_banks(
+            wavelet_index, epoch_size, skip_samples, feature_size, pre
+        )
+        K = feature_size
+        blocks = (plan.offsets // _BANK_BLK).astype(np.int32)
+        shifts = (plan.offsets % _BANK_BLK).astype(np.int32)
+        # per-(epoch, channel) output rows need per-row shifts
+        C = raw_i16.shape[0]
+        shifts_rows = np.repeat(shifts.reshape(-1), C)[:, None]
+        rows_out = bank_ingest_rows(
+            jnp.asarray(
+                raw_i16.reshape(C, -1, _BANK_BLK)
+            ),
+            jnp.asarray(plan.half_idx),
+            jnp.asarray(blocks),
+            jnp.asarray(shifts_rows),
+            jnp.asarray(Wvm),
+            jnp.asarray(fold),
+            tile_b=tile_b,
+            chunk=chunk,
+            feature_size=feature_size,
+            slab_rows=slab_rows,
+            interpret=bool(interpret),
+        )  # (n_tiles*tile_b*C, K), unscaled (resolution applied below)
+        n_rows_total = rows_out.shape[0]
+        res_rows = jnp.tile(
+            jnp.asarray(resolutions, jnp.float32), n_rows_total // C
+        )[:, None]
+        tiled = dwt_xla.safe_l2_normalize(
+            (rows_out * res_rows).reshape(n_rows_total // C, C * K)
+        )
+    elif mode == "aligned8":
         Wv_np, Mv_np, colsum_np, _ = aligned8_banks(
             wavelet_index, epoch_size, skip_samples, feature_size, pre
         )

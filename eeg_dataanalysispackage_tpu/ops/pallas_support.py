@@ -22,3 +22,16 @@ def default_interpret() -> bool:
         enabled = os.environ.get("PALLAS_AXON_REMOTE_COMPILE", "")
         return enabled.strip().lower() not in ("1", "true", "yes")
     return True
+
+
+def default_ingest_mode() -> str:
+    """Platform-aware default for the irregular Pallas ingest kernel.
+
+    Compiled Mosaic (TPU, or axon with remote compile): ``bank128`` —
+    the only formulation whose every construct compiles through the
+    axon remote helper (round-4 chip bisect + probe: dynamic lane
+    slices and lane-split reshapes crash it; the exact and aligned8
+    kernels use one each). Interpreter platforms: ``exact`` — the
+    subtract-first parity anchor the other modes are tested against.
+    """
+    return "exact" if default_interpret() else "bank128"

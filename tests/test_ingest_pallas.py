@@ -620,6 +620,173 @@ def test_pallas_unknown_mode_raises(fixture_raw):
         )
 
 
+# -- bank128 Pallas mode (the chip-proven formulation, round 4) -------
+#
+# The r4 chip bisect proved the axon remote compiler crashes on ANY
+# dynamic lane slice (aligned or not) and on lane-split reshapes —
+# the exact and aligned8 kernels each use one. bank128 uses neither:
+# windows are cut as dynamic SUBLANE slices over rows-of-128, the
+# in-row shift (0..127) goes through a 128-variant bank, and the
+# select is the reshape-free mask/fold dot (probe s5b/s7, chip-run).
+# Numerics are block-formulation two-term, so the gate is 5e-5.
+
+
+def test_pallas_bank128_matches_xla_ingest(fixture_raw):
+    raw, res = fixture_raw
+    rng = np.random.RandomState(5)
+    positions = rng.choice(
+        np.arange(200, raw.shape[1] - 800), size=41, replace=False
+    ).astype(np.int64)  # unsorted: output must be input-order
+    got = np.asarray(
+        ingest_pallas.ingest_features_pallas(
+            raw, res, positions, mode="bank128"
+        )
+    )
+    want = xla_reference_features(raw, res, positions)
+    assert got.shape == want.shape == (41, 48)
+    np.testing.assert_allclose(got, want, rtol=0, atol=5e-5)
+
+
+def test_pallas_bank128_covers_every_shift(fixture_raw):
+    """One marker per residual in-row shift 0..127 — every variant
+    column of the 128-bank must select correctly (gcd(801, 128) = 1,
+    so 128 consecutive markers at stride 801 hit every residue)."""
+    raw, res = fixture_raw
+    positions = (4096 + 100 + np.arange(128) * 801).astype(np.int64)
+    assert len(set((p - 100) % 128 for p in positions)) == 128
+    got = np.asarray(
+        ingest_pallas.ingest_features_pallas(
+            raw, res, positions, mode="bank128"
+        )
+    )
+    want = xla_reference_features(raw, res, positions)
+    np.testing.assert_allclose(got, want, rtol=0, atol=5e-5)
+
+
+def test_pallas_bank128_small_chunk_and_overhang(fixture_raw):
+    raw, res = fixture_raw
+    S = raw.shape[1]
+    positions = np.concatenate([
+        (100 + 173 * np.arange(40)),
+        [S - 300, 5000],  # overhanging window reads zeros
+    ]).astype(np.int64)
+    got = np.asarray(
+        ingest_pallas.ingest_features_pallas(
+            raw, res, positions, chunk=8192, tile_b=8, mode="bank128"
+        )
+    )
+    want = xla_reference_features(raw, res, positions)
+    np.testing.assert_allclose(got, want, rtol=0, atol=5e-5)
+
+
+@pytest.mark.parametrize("seed", [31, 32])
+def test_pallas_bank128_randomized_differential(fixture_raw, seed):
+    raw, res = fixture_raw
+    rng = np.random.RandomState(seed)
+    S = raw.shape[1]
+    n = int(rng.randint(5, 100))
+    positions = rng.randint(100, S - 100, size=n).astype(np.int64)
+    chunk = int(rng.choice([8192, 16384, 65536]))
+    tile_b = int(rng.choice([4, 8, 32]))
+    got = np.asarray(
+        ingest_pallas.ingest_features_pallas(
+            raw, res, positions, chunk=chunk, tile_b=tile_b, mode="bank128"
+        )
+    )
+    want = xla_reference_features(raw, res, positions)
+    np.testing.assert_allclose(got, want, rtol=0, atol=5e-5)
+
+
+def test_pallas_bank128_adversarial_plan_boundaries(fixture_raw):
+    """Adversarial tile plans (VERDICT r3 item 6): windows straddling
+    half-chunk boundaries, duplicate markers clustered on one sample,
+    and a first-possible-position window, all in one plan."""
+    raw, res = fixture_raw
+    half = 4096  # chunk 8192
+    positions = np.concatenate([
+        # straddle every half-chunk boundary in the first 8 halves:
+        # window start (pos-100) lands 512 before each boundary, so
+        # the 1024-sample slab crosses it
+        np.arange(1, 9) * half + 100 - 512,
+        np.full(7, 9 * half),  # pathological clustering: duplicates
+        [100],  # earliest valid marker (window start 0)
+    ]).astype(np.int64)
+    got = np.asarray(
+        ingest_pallas.ingest_features_pallas(
+            raw, res, positions, chunk=8192, tile_b=4, mode="bank128"
+        )
+    )
+    want = xla_reference_features(raw, res, positions)
+    np.testing.assert_allclose(got, want, rtol=0, atol=5e-5)
+
+
+def test_pallas_bank128_group_chunking():
+    """More tiles than _BANK_MAX_TILES must route through the
+    SMEM-sized group split (+ plan padding to a group multiple) and
+    still match the reference in input order."""
+    rng = np.random.RandomState(6)
+    raw = rng.randint(-3000, 3000, size=(3, 120000), dtype=np.int16)
+    res = np.array([0.1, 0.1, 0.2], np.float32)
+    # dense markers + tiny tile_b force n_tiles > _BANK_MAX_TILES
+    positions = (100 + np.arange(5000) * 20).astype(np.int64)
+    plan = ingest_pallas.plan_pallas_tiles(
+        positions, window=ingest_pallas.kernel_window("bank128"),
+        chunk=8192, tile_b=2,
+    )
+    assert plan.n_tiles > ingest_pallas._BANK_MAX_TILES
+    got = np.asarray(
+        ingest_pallas.ingest_features_pallas(
+            raw, res, positions, chunk=8192, tile_b=2, mode="bank128"
+        )
+    )
+    want = xla_reference_features(raw, res, positions)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, rtol=0, atol=5e-5)
+
+
+def test_pallas_bank128_rejects_unaligned_chunk(fixture_raw):
+    """Half-chunks must be whole 128-lane rows; anything else would
+    silently misalign the BlockSpec fetches (review finding r4)."""
+    raw, res = fixture_raw
+    with pytest.raises(ValueError, match="chunk % 256"):
+        ingest_pallas.ingest_features_pallas(
+            raw, res, np.array([5000]), chunk=8320, mode="bank128"
+        )
+
+
+def test_bank128_banks_fold_algebra():
+    """The fold matrix must reproduce yk - pk*colsum for every
+    variant: push a one-hot masked synthetic through it and compare
+    against the direct two-term combination."""
+    Wvm, fold, slab_rows = ingest_pallas.bank128_banks()
+    K = 16
+    NVK = 128 * K
+    assert Wvm.shape == (slab_rows * 128, NVK + 128)
+    assert fold.shape == (NVK + 128, K)
+    rng = np.random.RandomState(7)
+    yv = rng.randn(5, NVK + 128).astype(np.float32)
+    for row, v in enumerate([0, 1, 63, 127, 90]):
+        mask = np.zeros(NVK + 128, np.float32)
+        mask[v * K : (v + 1) * K] = 1.0
+        mask[NVK + v] = 1.0
+        got = (yv[row] * mask) @ fold
+        want = yv[row, v * K : (v + 1) * K] + yv[row, NVK + v] * fold[NVK + v]
+        np.testing.assert_allclose(got, want, rtol=0, atol=1e-5)
+
+
+def test_default_ingest_mode_is_platform_aware(monkeypatch):
+    from eeg_dataanalysispackage_tpu.ops import pallas_support
+
+    monkeypatch.setattr(
+        pallas_support, "default_interpret", lambda: True
+    )
+    assert pallas_support.default_ingest_mode() == "exact"
+    monkeypatch.setattr(
+        pallas_support, "default_interpret", lambda: False
+    )
+    assert pallas_support.default_ingest_mode() == "bank128"
+
+
 # -- partial regular-ingest formulation (single-pass, round 3) --------
 
 

@@ -1,0 +1,15 @@
+# Targeted round-4b list: what still needs chip time after the full
+# r4 sweep (tools/sweep_results/r4) landed. Callers define
+# `run name timeout cmd...` first (tools/tunnel_watch.sh).
+#
+# bank128 is the chip-proven Pallas ingest formulation (probe s7 +
+# the n=4096 production run: compiled, parity 2.7e-7). The 131072
+# run's compile coincided with the tunnel dying, so re-establish at
+# 32768 (single SMEM group, one kernel shape) before the 131072
+# 3-group program.
+BENCH_PALLAS_MODE=bank128 run bank128_32k 1200 \
+  python tools/ingest_bench.py pallas_ingest 32768 10
+BENCH_PALLAS_MODE=bank128 run bank128_131k 1800 \
+  python tools/ingest_bench.py pallas_ingest 131072 20
+BENCH_PALLAS_MODE=bank128 BENCH_TILE_B=64 run bank128_131k_b64 1800 \
+  python tools/ingest_bench.py pallas_ingest 131072 20

@@ -312,7 +312,18 @@ def run(variant: str, n: int, iters: int) -> dict:
             )
             from eeg_dataanalysispackage_tpu.ops import device_ingest
 
-            if mode == "aligned8":
+            if mode == "bank128":
+                Wvm_np, fold_np, slab_rows = ingest_pallas.bank128_banks()
+                BLK = ingest_pallas._BANK_BLK
+                blocks = (plan.offsets // BLK).astype(np.int32)
+                shifts_rows = np.repeat(
+                    (plan.offsets % BLK).astype(np.int32).reshape(-1), 3
+                )[:, None]
+                bank_extra = (
+                    jnp.asarray(blocks), jnp.asarray(shifts_rows),
+                    jnp.asarray(Wvm_np), jnp.asarray(fold_np),
+                )
+            elif mode == "aligned8":
                 Wv_np, Mv_np, colsum_np, _ = ingest_pallas.aligned8_banks()
                 aligned_extra = (
                     jnp.asarray(plan.offsets & ~7),
@@ -335,14 +346,23 @@ def run(variant: str, n: int, iters: int) -> dict:
                     raw, ((0, 0), (0, half - raw.shape[1] % half))
                 )
             fill = float((plan.src_rows >= 0).mean())
-            args = (
-                jnp.asarray(raw), jnp.asarray(res, jnp.float32),
-                jnp.asarray(plan.half_idx),
-            )
-            if mode == "aligned8":
-                args = args + aligned_extra
+            if mode == "bank128":
+                # the bank kernel takes the stream pre-viewed as
+                # 128-lane rows; resolution scaling rides outside
+                args = (
+                    jnp.asarray(raw.reshape(3, -1, 128)),
+                    jnp.asarray(res, jnp.float32),
+                    jnp.asarray(plan.half_idx),
+                ) + bank_extra
             else:
-                args = args + (jnp.asarray(plan.offsets), E)
+                args = (
+                    jnp.asarray(raw), jnp.asarray(res, jnp.float32),
+                    jnp.asarray(plan.half_idx),
+                )
+                if mode == "aligned8":
+                    args = args + aligned_extra
+                else:
+                    args = args + (jnp.asarray(plan.offsets), E)
             # on-device parity spot check before timing: the first 64
             # markers through the Pallas kernel must match the XLA
             # ingest path — catches silent Mosaic miscompiles so the
@@ -356,14 +376,46 @@ def run(variant: str, n: int, iters: int) -> dict:
                 )
             )
             want, _, _ = _gather_reference_rows(raw_spot, res, spot)
-            # aligned8 uses the block-style two-term correction, whose
-            # f32 floor is 5e-5 (same gate as the block variant)
+            # aligned8/bank128 use the block-style two-term
+            # correction, whose f32 floor is 5e-5 (same gate as the
+            # block variant)
             parity_dev = _check_parity(
-                got, want, 5e-5 if mode == "aligned8" else 5e-6,
+                got, want, 5e-5 if mode in ("aligned8", "bank128") else 5e-6,
                 f"pallas[{mode}]/XLA",
             )
 
-            if mode == "aligned8":
+            if mode == "bank128":
+                @jax.jit
+                def loop(raw_rows, res_a, hi, blks, sh, Wvm, fold):
+                    def body(acc, i):
+                        from eeg_dataanalysispackage_tpu.ops import (
+                            dwt as dwt_xla,
+                            pallas_support,
+                        )
+
+                        # perturb the 8.9MB bank, not the GB-scale
+                        # stream (same anti-CSE rationale as the
+                        # regular variant's resolution perturbation)
+                        rows_out = ingest_pallas.bank_ingest_rows(
+                            raw_rows, hi, blks, sh,
+                            Wvm + i.astype(jnp.float32) * 1e-12, fold,
+                            tile_b=tile_b, chunk=chunk, feature_size=16,
+                            slab_rows=slab_rows,
+                            interpret=pallas_support.default_interpret(),
+                        )
+                        res_rows = jnp.tile(
+                            res_a, rows_out.shape[0] // 3
+                        )[:, None]
+                        y = dwt_xla.safe_l2_normalize(
+                            (rows_out * res_rows).reshape(-1, 48)
+                        )
+                        return acc + y.sum(), None
+
+                    acc, _ = jax.lax.scan(body, jnp.float32(0),
+                                          jnp.arange(iters))
+                    return acc
+
+            elif mode == "aligned8":
                 @jax.jit
                 def loop(raw_a, res_a, hi, offs8, sh, Wv, Mv, cs):
                     def body(acc, i):

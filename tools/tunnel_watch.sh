@@ -38,6 +38,7 @@ run() { # name timeout cmd...
   log "done $name rc=$? $(tail -c 300 "$OUT/$name.json")"
 }
 
-# the single shared collection list (also used by real_chip_sweep.sh)
-source tools/collect_chip_runs.sh
+# the collection list: $2 overrides for targeted re-runs (default is
+# the single shared list, also used by real_chip_sweep.sh)
+source "${2:-tools/collect_chip_runs.sh}"
 log "collection complete"
