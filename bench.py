@@ -96,8 +96,8 @@ _VARIANTS_TPU = {
     "train_step": (131072, 20),
     "train_step_raw": (131072, 20),
     "train_step_block": (32768, 10),
-    # last: known to fail fast while the terminal-side Mosaic compile
-    # crash stands (the failure is recorded, not fatal)
+    # last (longest fresh compile): the bank128 kernel, the one
+    # formulation that compiles through the axon remote helper
     "pallas_ingest": (131072, 20),
 }
 _VARIANTS_CPU = {

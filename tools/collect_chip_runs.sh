@@ -41,13 +41,16 @@ BENCH_TOTAL_BUDGET=1800 run bench_full 3600 python bench.py
 # the design assumed" for every below-roofline number above. 3600s:
 # 7 fresh chip compiles in one process, printed as produced.
 run cost_report  3600 python tools/cost_report.py 32768
-# pallas_dwt first: it compiled to Mosaic on chip in round 2, so it
-# separates "remote compiler regressed globally" from "the ingest
-# kernel's construct delta is the crasher"
+# pallas_dwt first: it compiled to Mosaic on chip in rounds 2+4, so
+# it separates "remote compiler regressed globally" from "a kernel
+# construct is the crasher"
 run pallas_dwt    900 python tools/ingest_bench.py pallas_dwt 131072 20
-run pallas_ingest 900 python tools/ingest_bench.py pallas_ingest 131072 20
-# the 8-aligned-slice variant-bank kernel: the fix path if the exact
-# kernel's arbitrary-offset lane slice is what crashes the compiler
-BENCH_PALLAS_MODE=aligned8 run pallas_aligned8 900 \
-  python tools/ingest_bench.py pallas_ingest 131072 20
+# pallas_ingest defaults to bank128 — the one formulation whose every
+# construct compiles through the remote helper (r4 probe/bisect: the
+# exact and aligned8 kernels' dynamic lane slices crash it, aligned
+# or not, as do lane-split reshapes). Small run first (single SMEM
+# tile group, small compile), then the full-scale 3-group program.
+run pallas_bank_32k 1200 python tools/ingest_bench.py pallas_ingest 32768 10
+run pallas_ingest 1800 python tools/ingest_bench.py pallas_ingest 131072 20
 run pallas_bisect 900 python tools/pallas_compile_bisect.py
+run sublane_probe 900 python tools/pallas_sublane_probe.py
