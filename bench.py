@@ -86,9 +86,11 @@ _VARIANTS_TPU = {
         int(os.environ.get("BENCH_BATCH", 262144)),
         int(os.environ.get("BENCH_ITERS", 50)),
     ),
-    # the bf16 twin shares the headline's geometry and its overrides
+    # the bf16 twin runs at 2x the headline batch: the r4 chip batch
+    # curve (39.8% @131k, 55.7% @262k, 69.8% @524k of roofline)
+    # showed the 2-byte stream needs the larger dispatch to amortize
     "einsum_bf16": (
-        int(os.environ.get("BENCH_BATCH", 262144)),
+        2 * int(os.environ.get("BENCH_BATCH", 262144)),
         int(os.environ.get("BENCH_ITERS", 50)),
     ),
     "regular_ingest": (262144, 20),

@@ -79,10 +79,11 @@ def kernel_window(
 ) -> int:
     """Kernel segment width for a mode (single source for the library
     and the bench): ``exact`` pads the live window to 8; ``aligned8``
-    additionally covers the residual 0..7 shift; ``bank128`` rounds
-    the live-window+127-shift slab up to whole 128-lane rows."""
+    additionally covers the residual 0..7 shift; ``bank128`` (and its
+    ``bank128_bf16`` twin) rounds the live-window+127-shift slab up
+    to whole 128-lane rows."""
     live = pre + skip_samples + epoch_size
-    if mode == "bank128":
+    if mode in BANK_MODES:
         return _bank_slab_rows(live) * _BANK_BLK
     if mode == "aligned8":
         return -(-(live + _ALIGN - 1) // _ALIGN) * _ALIGN
@@ -93,6 +94,15 @@ def kernel_window(
 
 #: bank128 mode: lanes per row / residual-shift variant count.
 _BANK_BLK = 128
+
+#: the bank-kernel mode family — single source for the library, the
+#: bench, and the provider (a new bank mode added here propagates)
+BANK_MODES = ("bank128", "bank128_bf16")
+
+
+def bank_wvm_dtype(mode: str):
+    """Operand dtype the ``Wvm`` bank ships in for a bank mode."""
+    return jnp.bfloat16 if mode == "bank128_bf16" else jnp.float32
 
 
 def _bank_slab_rows(live_window: int) -> int:
@@ -359,7 +369,7 @@ def _ingest_tiles(
 
 def _make_kernel_bank(
     n_channels: int, tile_b: int, chunk: int, feature_size: int,
-    slab_rows: int,
+    slab_rows: int, bank_bf16: bool = False,
 ):
     """The ``bank128`` kernel: the only formulation whose every
     construct is proven to compile through the axon remote-compile
@@ -406,11 +416,26 @@ def _make_kernel_bank(
         # algebra cancels exactly; keeps both cancelling terms at
         # residual scale (f32-safe, same analysis as block ingest)
         d = jnp.mean(flat, axis=1, keepdims=True)
-        yv = lax.dot_general(
-            flat - d, wvm_ref[:], (((1,), (0,)), ((), ())),
-            precision=lax.Precision.HIGHEST,
-            preferred_element_type=jnp.float32,
-        )  # (tile_b*C, NVK + NV): all shifts' features | pre-means
+        xc = flat - d
+        if bank_bf16:
+            # the bank arrives pre-cast to bf16 (half the VMEM, no
+            # per-step cast); mean-centering happens in f32 FIRST, so
+            # the bf16 cast rounds residual-scale values, not
+            # int16-range DC — the same ordering argument as the bf16
+            # feature tier. f32 accumulation via
+            # preferred_element_type.
+            yv = lax.dot_general(
+                xc.astype(jnp.bfloat16),
+                wvm_ref[:],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        else:
+            yv = lax.dot_general(
+                xc, wvm_ref[:], (((1,), (0,)), ((), ())),
+                precision=lax.Precision.HIGHEST,
+                preferred_element_type=jnp.float32,
+            )  # (tile_b*C, NVK + NV): all shifts' features | pre-means
         lane = lax.broadcasted_iota(
             jnp.int32, (tile_b * n_channels, NVK + _BANK_BLK), 1
         )
@@ -446,6 +471,7 @@ def bank_ingest_rows(
     feature_size: int,
     slab_rows: int,
     interpret: bool,
+    bank_bf16: bool = False,
 ):
     """Chunked driver for :func:`_ingest_tiles_bank`: splits the tile
     axis into SMEM-sized groups (static Python loop — jit/scan safe)
@@ -480,6 +506,7 @@ def bank_ingest_rows(
             feature_size=feature_size,
             slab_rows=slab_rows,
             interpret=interpret,
+            bank_bf16=bank_bf16,
         )
         for g0, g1 in groups
     ]
@@ -490,6 +517,7 @@ def bank_ingest_rows(
     jax.jit,
     static_argnames=(
         "tile_b", "chunk", "feature_size", "slab_rows", "interpret",
+        "bank_bf16",
     ),
 )
 def _ingest_tiles_bank(
@@ -505,6 +533,7 @@ def _ingest_tiles_bank(
     feature_size: int,
     slab_rows: int,
     interpret: bool,
+    bank_bf16: bool = False,
 ):
     C = raw_rows_i16.shape[0]
     n_tiles = half_idx.shape[0]
@@ -543,7 +572,9 @@ def _ingest_tiles_bank(
         ],
     )
     return pl.pallas_call(
-        _make_kernel_bank(C, tile_b, chunk, feature_size, slab_rows),
+        _make_kernel_bank(
+            C, tile_b, chunk, feature_size, slab_rows, bank_bf16
+        ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(
             (n_tiles * tile_b * C, K), jnp.float32
@@ -690,7 +721,8 @@ def ingest_features_pallas(
               // sample_bucket) * sample_bucket
     if padded != S:
         raw_i16 = np.pad(raw_i16, ((0, 0), (0, padded - S)))
-    if mode == "bank128":
+    if mode in BANK_MODES:
+        bank_bf16 = mode == "bank128_bf16"
         Wvm, fold, slab_rows = bank128_banks(
             wavelet_index, epoch_size, skip_samples, feature_size, pre
         )
@@ -707,13 +739,14 @@ def ingest_features_pallas(
             jnp.asarray(plan.half_idx),
             jnp.asarray(blocks),
             jnp.asarray(shifts_rows),
-            jnp.asarray(Wvm),
+            jnp.asarray(Wvm, bank_wvm_dtype(mode)),
             jnp.asarray(fold),
             tile_b=tile_b,
             chunk=chunk,
             feature_size=feature_size,
             slab_rows=slab_rows,
             interpret=bool(interpret),
+            bank_bf16=bank_bf16,
         )  # (n_tiles*tile_b*C, K), unscaled (resolution applied below)
         n_rows_total = rows_out.shape[0]
         res_rows = jnp.tile(

@@ -744,6 +744,35 @@ def test_pallas_bank128_group_chunking():
     np.testing.assert_allclose(got, want, rtol=0, atol=5e-5)
 
 
+def test_pallas_bank128_bf16_within_bf16_envelope(fixture_raw):
+    """The bf16-bank twin (MXU fast path: bf16 operands, f32
+    accumulate, mean-centered BEFORE the cast so bf16 rounds
+    residual-scale values) must stay inside the bf16 feature tier's
+    5e-3 envelope vs the f32 gather reference."""
+    raw, res = fixture_raw
+    rng = np.random.RandomState(9)
+    positions = rng.choice(
+        np.arange(200, raw.shape[1] - 800), size=64, replace=False
+    ).astype(np.int64)
+    got = np.asarray(
+        ingest_pallas.ingest_features_pallas(
+            raw, res, positions, mode="bank128_bf16"
+        )
+    )
+    want = xla_reference_features(raw, res, positions)
+    np.testing.assert_allclose(got, want, rtol=0, atol=5e-3)
+    # and the f32 bank twin agrees to the same envelope
+    f32 = np.asarray(
+        ingest_pallas.ingest_features_pallas(
+            raw, res, positions, mode="bank128"
+        )
+    )
+    np.testing.assert_allclose(got, f32, rtol=0, atol=5e-3)
+    assert ingest_pallas.kernel_window(
+        "bank128_bf16"
+    ) == ingest_pallas.kernel_window("bank128")
+
+
 def test_pallas_bank128_rejects_unaligned_chunk(fixture_raw):
     """Half-chunks must be whole 128-lane rows; anything else would
     silently misalign the BlockSpec fetches (review finding r4)."""

@@ -6,10 +6,26 @@
 # the n=4096 production run: compiled, parity 2.7e-7). The 131072
 # run's compile coincided with the tunnel dying, so re-establish at
 # 32768 (single SMEM group, one kernel shape) before the 131072
-# 3-group program.
+# 3-group program. The 524288 einsum rows chase the bf16 batch-curve
+# finding (dispatch amortization: 69.8% at 524k) for the f32
+# headline too. rf_predict faulted the TPU worker once (r4) - one
+# retry distinguishes transient from reproducible.
 BENCH_PALLAS_MODE=bank128 run bank128_32k 1200 \
   python tools/ingest_bench.py pallas_ingest 32768 10
+run einsum_524k 600 python tools/ingest_bench.py einsum 524288 50
 BENCH_PALLAS_MODE=bank128 run bank128_131k 1800 \
   python tools/ingest_bench.py pallas_ingest 131072 20
+run rf_predict_retry 900 python tools/ingest_bench.py rf_predict 262144 10
 BENCH_PALLAS_MODE=bank128 BENCH_TILE_B=64 run bank128_131k_b64 1800 \
   python tools/ingest_bench.py pallas_ingest 131072 20
+# the bf16 bank twin: if the f32 bank measures MXU-bound (6.7M
+# HIGHEST MACs/epoch), bf16 operands + f32 accumulate are the 4-8x
+# unlock; parity gate 5e-3 (bf16 tier envelope, measured 1.9e-3)
+BENCH_PALLAS_MODE=bank128_bf16 run bank128_bf16_131k 1800 \
+  python tools/ingest_bench.py pallas_ingest 131072 20
+# warm the persistent compile cache for the driver's bench.py run:
+# same shapes bench.py uses for its slowest-compiling variants
+BENCH_FORMULATION=phase run warm_regular 1200 \
+  python tools/ingest_bench.py regular_ingest 262144 20
+run warm_train_raw 1200 python tools/ingest_bench.py train_step_raw 131072 20
+BENCH_TOTAL_BUDGET=1800 run bench_full 3600 python bench.py
