@@ -286,7 +286,7 @@ def resolve_regular_formulation(formulation: str, stride: int) -> str:
         if jax.devices()[0].platform == "cpu":
             return "reshape"
         return "phase" if _phase_group(stride) <= _PHASE_MAX_GROUP else "conv"
-    if formulation not in ("reshape", "conv", "phase", "partial"):
+    if formulation not in ("reshape", "conv", "phase", "partial", "bank"):
         raise ValueError(
             f"unknown regular-ingest formulation {formulation!r}"
         )
@@ -353,10 +353,22 @@ def make_regular_ingest_featurizer(
       cross-check, docs/ingest_kernel.md). DC proxy is per-channel
       global (must be shared by both rows of a window), so accuracy
       is conv-class under drift rather than phase-exact.
+    - ``"bank"``: the regular train routed through the chip-proven
+      bank128 Pallas kernel (``ops/ingest_pallas.py``) — windows cut
+      in VMEM by dynamic sublane slabs + the 128-variant operator
+      bank, so the slab/operand materializations the r4 chip cost
+      report measured at 16.4x the design bytes for ``phase`` never
+      reach HBM. Block-formulation two-term numerics (5e-5 class);
+      works for ANY stride (no group-size constraint — odd strides
+      that force ``conv`` elsewhere are fine here). Planning is
+      position-static, so the featurizer stays traceable under an
+      outer jit.
     - ``"auto"``: reshape on CPU (no lane tiling, subtract-first
       accuracy), phase on accelerators — unless the stride makes
       ``G = lcm(Δ,128)/Δ`` large (odd strides give G=128: ~GB-scale
-      operator tables and ~256x MACs), in which case conv.
+      operator tables and ~256x MACs), in which case conv. (``bank``
+      stays opt-in until its chip timing lands — staged in
+      tools/collect_chip_runs_r4b.sh.)
 
     Requires ``stride >= pre + skip + epoch_size`` (787 default) so a
     window never crosses into the next epoch's row; the general
@@ -660,11 +672,118 @@ def _make_regular_ingest_featurizer(
                 raw_i16, resolutions, s0, _partial_tables(phase)
             )
 
+    if formulation != "bank":
+        _run_bank = None
+    else:
+        # bank formulation: the regular train routed through the
+        # chip-proven bank128 Pallas kernel (ops/ingest_pallas.py) —
+        # windows are cut in VMEM (dynamic sublane slabs + the
+        # 128-variant operator bank), so the f32 slab and dot-operand
+        # materializations the r4 chip cost report measured at 16.4x
+        # the design bytes for phase never reach HBM. Planning is
+        # position-static (positions = first + k*stride, no data
+        # dependence), so the runner is traceable inside an outer jit
+        # (the bench's scan) AND eager-safe through the axon tunnel:
+        # host planning consumes only concrete ints, and every device
+        # op lives inside the jitted _bank_run.
+        from . import ingest_pallas as _ip  # lazy: _ip imports us
+        from . import pallas_support as _ps
+
+        _BCHUNK = 65536
+        _BTILE = 32
+        _Wvm_np, _fold_np, _bank_slab_rows = _ip.bank128_banks(
+            wavelet_index, epoch_size, skip_samples, feature_size, pre
+        )
+
+        # numpy in the cache, never jnp (same tracer-poisoning
+        # rationale as _phase_tables)
+        @functools.lru_cache(maxsize=8)
+        def _bank_tables(first: int, S: int):
+            positions = (
+                first + np.arange(n_epochs, dtype=np.int64) * stride
+            )
+            window = _ip.kernel_window(
+                "bank128", pre, skip_samples, epoch_size
+            )
+            plan = _ip.bucket_plan_8(
+                _ip.plan_pallas_tiles(
+                    positions, pre=pre, window=window,
+                    chunk=_BCHUNK, tile_b=_BTILE,
+                )
+            )
+            half = _BCHUNK // 2
+            needed = (int(plan.half_idx.max(initial=0)) + 2) * half
+            pad_to = ((max(S, needed) + _BCHUNK - 1)
+                      // _BCHUNK) * _BCHUNK
+            blocks = (plan.offsets // _ip._BANK_BLK).astype(np.int32)
+            shifts_rows = np.repeat(
+                (plan.offsets % _ip._BANK_BLK)
+                .astype(np.int32).reshape(-1),
+                n_channels,
+            )[:, None]
+            inv = _ip.plan_unsort_index(plan)
+            return plan.half_idx, blocks, shifts_rows, inv, pad_to
+
+        @functools.partial(
+            jax.jit, static_argnames=("pad_to", "interpret")
+        )
+        def _bank_run(raw_i16, resolutions, half_idx, blocks,
+                      shifts_rows, inv, *, pad_to, interpret):
+            C, S = raw_i16.shape
+            if pad_to != S:
+                raw_i16 = jnp.pad(raw_i16, ((0, 0), (0, pad_to - S)))
+            rows = _ip.bank_ingest_rows(
+                raw_i16.reshape(C, -1, _ip._BANK_BLK),
+                half_idx, blocks, shifts_rows,
+                # trace-time constants: baked into the executable, no
+                # per-call host->device upload of the ~9MB bank (the
+                # _ingest_reshape/E_np pattern)
+                jnp.asarray(_Wvm_np), jnp.asarray(_fold_np),
+                tile_b=_BTILE, chunk=_BCHUNK,
+                feature_size=feature_size,
+                slab_rows=_bank_slab_rows,
+                interpret=interpret,
+            )  # (n_tiles*_BTILE*C, K), unscaled
+            res_rows = jnp.tile(
+                resolutions, rows.shape[0] // C
+            )[:, None]
+            feats = dwt_xla.safe_l2_normalize(
+                (rows * res_rows).reshape(
+                    rows.shape[0] // C, C * feature_size
+                )
+            )
+            return feats[inv]
+
+        def _run_bank(raw_i16, resolutions, start):
+            if raw_i16.shape[0] != n_channels:
+                raise ValueError(
+                    f"bank formulation built for {n_channels} "
+                    f"channels; got raw with {raw_i16.shape[0]}"
+                )
+            first = start + pre
+            half_idx, blocks, shifts_rows, inv, pad_to = _bank_tables(
+                int(first), int(raw_i16.shape[1])
+            )
+            return _bank_run(
+                raw_i16,
+                jnp.asarray(resolutions, jnp.float32),
+                jnp.asarray(half_idx),
+                jnp.asarray(blocks),
+                jnp.asarray(shifts_rows),
+                jnp.asarray(inv),
+                pad_to=pad_to,
+                # resolved per call: the featurizer cache is
+                # process-wide and must not pin the first caller's
+                # platform (the 'auto'-resolution staleness class)
+                interpret=_ps.default_interpret(),
+            )
+
     _ingest_jit = {
         "conv": _ingest_conv,
         "reshape": _ingest_reshape,
         "phase": None,  # dispatched in the wrapper (slab bounds)
         "partial": None,  # dispatched in the wrapper (slab bounds)
+        "bank": None,  # dispatched in the wrapper (host tile planning)
     }[formulation]
 
     def ingest(raw_i16, resolutions, first_position):
@@ -678,6 +797,8 @@ def _make_regular_ingest_featurizer(
                 f"regular ingest window [{start}, {end}) out of range "
                 f"for recording of {raw_i16.shape[1]} samples"
             )
+        if formulation == "bank":
+            return _run_bank(raw_i16, resolutions, start)
         if formulation in ("phase", "partial"):
             runner = _run_phase if formulation == "phase" else _run_partial
             out = runner(raw_i16, resolutions, start)

@@ -164,6 +164,41 @@ def bank128_banks(
     return Wvm, fold, slab_rows
 
 
+def bucket_plan_8(plan: "PallasTilePlan") -> "PallasTilePlan":
+    """Pad a tile plan's tile count up to a multiple of 8 (jit-cache
+    bucketing; padded tiles point at block 0 with ``src_rows`` -1 and
+    are dropped by :func:`plan_unsort_index`). Shared by the
+    irregular featurizer and the regular 'bank' formulation."""
+    n_tiles = plan.half_idx.shape[0]
+    bucket = ((n_tiles + 7) // 8) * 8
+    if bucket == n_tiles:
+        return plan
+    pad_t = bucket - n_tiles
+    tile_b = plan.tile_b
+    return PallasTilePlan(
+        np.concatenate([plan.half_idx, np.zeros(pad_t, np.int32)]),
+        np.concatenate(
+            [plan.offsets, np.zeros((pad_t, tile_b), np.int32)]
+        ),
+        np.concatenate(
+            [plan.src_rows, np.full((pad_t, tile_b), -1, np.int32)]
+        ),
+        plan.chunk,
+        tile_b,
+    )
+
+
+def plan_unsort_index(plan: "PallasTilePlan") -> np.ndarray:
+    """Unsort index for kernel-row outputs: row ``t*tile_b + e``
+    holds epoch ``src_rows[t, e]``; the returned ``inv`` maps epoch
+    order -> kernel row, dropping padded rows."""
+    flat_src = plan.src_rows.reshape(-1)
+    real = flat_src >= 0
+    inv = np.empty(int(real.sum()), dtype=np.int64)
+    inv[flat_src[real]] = np.nonzero(real)[0]
+    return inv
+
+
 def plan_pallas_tiles(
     positions: np.ndarray,
     pre: int = constants.PRESTIMULUS_SAMPLES,
@@ -699,20 +734,7 @@ def ingest_features_pallas(
     # (a) tile count rounds up to a multiple of 8 (padded tiles point
     # at block 0 with src_rows -1 and are dropped on unsort);
     # (b) the raw sample axis rounds up to a multiple of 8 chunks.
-    n_tiles = plan.half_idx.shape[0]
-    bucket = ((n_tiles + 7) // 8) * 8
-    if bucket != n_tiles:
-        pad_t = bucket - n_tiles
-        plan = PallasTilePlan(
-            np.concatenate([plan.half_idx,
-                            np.zeros(pad_t, np.int32)]),
-            np.concatenate([plan.offsets,
-                            np.zeros((pad_t, tile_b), np.int32)]),
-            np.concatenate([plan.src_rows,
-                            np.full((pad_t, tile_b), -1, np.int32)]),
-            chunk,
-            tile_b,
-        )
+    plan = bucket_plan_8(plan)
     # every referenced half-chunk (hi and hi+1) must exist
     needed = (int(plan.half_idx.max(initial=0)) + 2) * half
     C, S = raw_i16.shape
@@ -799,11 +821,7 @@ def ingest_features_pallas(
             pre=pre,
         )
     # unsort: tiled row t*tile_b+e holds epoch src_rows[t, e]
-    flat_src = plan.src_rows.reshape(-1)
-    real = flat_src >= 0
-    inv = np.empty(int(real.sum()), dtype=np.int64)
-    inv[flat_src[real]] = np.nonzero(real)[0]
-    return tiled[jnp.asarray(inv)]
+    return tiled[jnp.asarray(plan_unsort_index(plan))]
 
 
 def make_pallas_ingest_featurizer(

@@ -23,6 +23,10 @@ BENCH_PALLAS_MODE=bank128 BENCH_TILE_B=64 run bank128_131k_b64 1800 \
 # unlock; parity gate 5e-3 (bf16 tier envelope, measured 1.9e-3)
 BENCH_PALLAS_MODE=bank128_bf16 run bank128_bf16_131k 1800 \
   python tools/ingest_bench.py pallas_ingest 131072 20
+# the regular train through the bank128 kernel vs partial's 5.40M:
+# the head-to-head that decides whether auto flips to bank
+BENCH_FORMULATION=bank run regular_bank 1800 \
+  python tools/ingest_bench.py regular_ingest 262144 20
 # warm the persistent compile cache for the driver's bench.py run:
 # same shapes bench.py uses for its slowest-compiling variants
 BENCH_FORMULATION=phase run warm_regular 1200 \
