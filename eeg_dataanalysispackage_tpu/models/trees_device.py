@@ -568,6 +568,34 @@ def predict_linked_forest(
     return jax.vmap(one)(feature, thresh, left, right, pred)
 
 
+@partial(jax.jit, static_argnames=("max_iters", "row_chunk"))
+def predict_linked_forest_chunked(
+    feature, thresh, left, right, pred, binned,
+    max_iters: int = 64, row_chunk: int = 8192,
+):
+    """:func:`predict_linked_forest` with the row axis processed in
+    ``row_chunk`` blocks via ``lax.map`` — same result, bounded
+    working set. Built as the fallback measurement for the r4 chip
+    observation that the full-size program faulted the TPU worker
+    process (tools/sweep_results/r4/rf_predict.err): if the chunked
+    form runs where the monolith faults, the fault is size-dependent,
+    not a construct problem. Requires ``n % row_chunk == 0`` (bench
+    sizes are powers of two; pad otherwise)."""
+    n = binned.shape[0]
+    if n % row_chunk:
+        raise ValueError(
+            f"n {n} must be a multiple of row_chunk {row_chunk}"
+        )
+    blocks = binned.reshape(n // row_chunk, row_chunk, binned.shape[1])
+    votes = jax.lax.map(
+        lambda b: predict_linked_forest(
+            feature, thresh, left, right, pred, b, max_iters=max_iters
+        ),
+        blocks,
+    )  # (n_blocks, T, row_chunk)
+    return jnp.moveaxis(votes, 0, 1).reshape(feature.shape[0], n)
+
+
 def host_trees_to_device(trees: list):
     """Pad a list of host-format tree dicts to one (T, n_nodes) array
     set for :func:`predict_linked_forest` (padding nodes are leaves

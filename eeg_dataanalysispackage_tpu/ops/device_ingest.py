@@ -713,8 +713,13 @@ def _make_regular_ingest_featurizer(
             )
             half = _BCHUNK // 2
             needed = (int(plan.half_idx.max(initial=0)) + 2) * half
-            pad_to = ((max(S, needed) + _BCHUNK - 1)
-                      // _BCHUNK) * _BCHUNK
+            # 8-chunk sample bucket, matching ingest_features_pallas:
+            # pad_to is a static jit key (and the ~9MB bank is baked
+            # per executable), so coarse buckets keep recordings of
+            # different lengths on one compiled kernel
+            sample_bucket = 8 * _BCHUNK
+            pad_to = ((max(S, needed) + sample_bucket - 1)
+                      // sample_bucket) * sample_bucket
             blocks = (plan.offsets // _ip._BANK_BLK).astype(np.int32)
             shifts_rows = np.repeat(
                 (plan.offsets % _ip._BANK_BLK)

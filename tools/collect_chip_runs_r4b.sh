@@ -16,6 +16,10 @@ run einsum_524k 600 python tools/ingest_bench.py einsum 524288 50
 BENCH_PALLAS_MODE=bank128 run bank128_131k 1800 \
   python tools/ingest_bench.py pallas_ingest 131072 20
 run rf_predict_retry 900 python tools/ingest_bench.py rf_predict 262144 10
+# if the retry faults the worker again, the lax.map row-chunked form
+# separates size-dependent faults from construct faults
+BENCH_RF_ROW_CHUNK=8192 run rf_predict_chunked 900 \
+  python tools/ingest_bench.py rf_predict 262144 10
 BENCH_PALLAS_MODE=bank128 BENCH_TILE_B=64 run bank128_131k_b64 1800 \
   python tools/ingest_bench.py pallas_ingest 131072 20
 # the bf16 bank twin: if the f32 bank measures MXU-bound (6.7M

@@ -684,14 +684,24 @@ def run(variant: str, n: int, iters: int) -> dict:
         bytes_per_epoch = T * depth * 4
         args = (*packed, binned)
 
+        # BENCH_RF_ROW_CHUNK=8192 runs the lax.map row-chunked form —
+        # the fallback probe for the r4 full-size worker fault
+        row_chunk = int(os.environ.get("BENCH_RF_ROW_CHUNK", 0))
+
         @jax.jit
         def loop(f, t, l, r, p, b):
             def body(acc, i):
-                votes = trees_device.predict_linked_forest(
-                    f, t, l, r, p,
-                    (b + (i % 2).astype(jnp.int32)) % bins,
-                    max_iters=depth,  # bench walks what it bills
-                )
+                bb = (b + (i % 2).astype(jnp.int32)) % bins
+                if row_chunk:
+                    votes = trees_device.predict_linked_forest_chunked(
+                        f, t, l, r, p, bb,
+                        max_iters=depth, row_chunk=row_chunk,
+                    )
+                else:
+                    votes = trees_device.predict_linked_forest(
+                        f, t, l, r, p, bb,
+                        max_iters=depth,  # bench walks what it bills
+                    )
                 return acc + votes.sum(), None
 
             acc, _ = jax.lax.scan(body, jnp.float32(0), jnp.arange(iters))
