@@ -278,6 +278,16 @@ def _phase_group(stride: int) -> int:
     return math.lcm(stride, 128) // stride
 
 
+def default_fused_backend() -> str:
+    """Platform default for the irregular fused-ingest backend
+    (``fe=dwt-<i>-fused`` with no explicit suffix): accelerators get
+    ``block`` — on the r4 chip it ran 1.15M epochs/s = 21x the XLA
+    element gather's 54.8k (tools/sweep_results/r4, parity 3e-7) —
+    while CPU keeps ``xla``, where the element gather is cheap and
+    the 128-variant bank is pure overhead (docs/ingest_kernel.md)."""
+    return "xla" if jax.devices()[0].platform == "cpu" else "block"
+
+
 def resolve_regular_formulation(formulation: str, stride: int) -> str:
     """'auto' -> the platform/stride default: reshape on CPU
     (subtract-first accuracy, no lane tiling); phase on accelerators

@@ -88,13 +88,22 @@ class PipelineBuilder:
         # (device_ingest.make_block_ingest_featurizer). Any registry
         # wavelet index works, like the host fe= family.
         fused_match = re.fullmatch(
-            r"dwt-(\d+)-fused(-pallas|-block)?", query_map.get("fe", "")
+            r"dwt-(\d+)-fused(-pallas|-block|-xla)?",
+            query_map.get("fe", ""),
         )
         fused = fused_match is not None
         if fused:
+            from ..ops import device_ingest
+
             wavelet_index = int(fused_match.group(1))
+            # bare -fused resolves per platform (block on
+            # accelerators - 21x the element gather on the r4 chip -
+            # xla on CPU); explicit suffixes always win
             backend = {
-                None: "xla", "-pallas": "pallas", "-block": "block",
+                None: device_ingest.default_fused_backend(),
+                "-pallas": "pallas",
+                "-block": "block",
+                "-xla": "xla",
             }[fused_match.group(2)]
             with self.timers.stage("ingest"):
                 features, targets = odp.load_features_device(
