@@ -31,6 +31,10 @@ BENCH_PALLAS_MODE=bank128_bf16 run bank128_bf16_131k 1800 \
 # the head-to-head that decides whether auto flips to bank
 BENCH_FORMULATION=bank run regular_bank 1800 \
   python tools/ingest_bench.py regular_ingest 262144 20
+# training straight from the int16 stream via the bank kernel
+# (fused regular featurizer inside the SGD step) vs phase's 4.59M
+BENCH_FORMULATION=bank run train_raw_bank 1800 \
+  python tools/ingest_bench.py train_step_raw 131072 20
 # warm the persistent compile cache for the driver's bench.py run:
 # same shapes bench.py uses for its slowest-compiling variants
 BENCH_FORMULATION=phase run warm_regular 1200 \
