@@ -730,13 +730,9 @@ def _make_regular_ingest_featurizer(
             sample_bucket = 8 * _BCHUNK
             pad_to = ((max(S, needed) + sample_bucket - 1)
                       // sample_bucket) * sample_bucket
-            blocks = (plan.offsets // _ip._BANK_BLK).astype(np.int32)
-            shifts_rows = np.repeat(
-                (plan.offsets % _ip._BANK_BLK)
-                .astype(np.int32).reshape(-1),
-                n_channels,
-            )[:, None]
-            inv = _ip.plan_unsort_index(plan)
+            blocks, shifts_rows, inv = _ip.bank_plan_arrays(
+                plan, n_channels
+            )
             return plan.half_idx, blocks, shifts_rows, inv, pad_to
 
         @functools.partial(
@@ -759,15 +755,7 @@ def _make_regular_ingest_featurizer(
                 slab_rows=_bank_slab_rows,
                 interpret=interpret,
             )  # (n_tiles*_BTILE*C, K), unscaled
-            res_rows = jnp.tile(
-                resolutions, rows.shape[0] // C
-            )[:, None]
-            feats = dwt_xla.safe_l2_normalize(
-                (rows * res_rows).reshape(
-                    rows.shape[0] // C, C * feature_size
-                )
-            )
-            return feats[inv]
+            return _ip.bank_finish(rows, resolutions, inv)
 
         def _run_bank(raw_i16, resolutions, start):
             if raw_i16.shape[0] != n_channels:

@@ -188,6 +188,33 @@ def bucket_plan_8(plan: "PallasTilePlan") -> "PallasTilePlan":
     )
 
 
+def bank_plan_arrays(plan: "PallasTilePlan", n_channels: int):
+    """(blocks, shifts_rows, inv) for the bank kernel from a
+    (bucketed) tile plan — the one place the offset -> row-block +
+    in-row-shift encoding lives (featurizer, regular 'bank'
+    formulation, and the bank train step all consume this)."""
+    blocks = (plan.offsets // _BANK_BLK).astype(np.int32)
+    shifts_rows = np.repeat(
+        (plan.offsets % _BANK_BLK).astype(np.int32).reshape(-1),
+        n_channels,
+    )[:, None]
+    return blocks, shifts_rows, plan_unsort_index(plan)
+
+
+def bank_finish(rows, resolutions, inv):
+    """Shared linear tail of every bank-kernel consumer: per-channel
+    resolution scale, (n, C*K) packing, L2 normalize, unsort. ``rows``
+    is the kernel's (N*C, K) output; C = len(resolutions)."""
+    C = resolutions.shape[0]
+    res_rows = jnp.tile(
+        jnp.asarray(resolutions, jnp.float32), rows.shape[0] // C
+    )[:, None]
+    feats = dwt_xla.safe_l2_normalize(
+        (rows * res_rows).reshape(rows.shape[0] // C, -1)
+    )
+    return feats[jnp.asarray(inv)]
+
+
 def plan_unsort_index(plan: "PallasTilePlan") -> np.ndarray:
     """Unsort index for kernel-row outputs: row ``t*tile_b + e``
     holds epoch ``src_rows[t, e]``; the returned ``inv`` maps epoch
@@ -744,16 +771,11 @@ def ingest_features_pallas(
     if padded != S:
         raw_i16 = np.pad(raw_i16, ((0, 0), (0, padded - S)))
     if mode in BANK_MODES:
-        bank_bf16 = mode == "bank128_bf16"
         Wvm, fold, slab_rows = bank128_banks(
             wavelet_index, epoch_size, skip_samples, feature_size, pre
         )
-        K = feature_size
-        blocks = (plan.offsets // _BANK_BLK).astype(np.int32)
-        shifts = (plan.offsets % _BANK_BLK).astype(np.int32)
-        # per-(epoch, channel) output rows need per-row shifts
         C = raw_i16.shape[0]
-        shifts_rows = np.repeat(shifts.reshape(-1), C)[:, None]
+        blocks, shifts_rows, inv = bank_plan_arrays(plan, C)
         rows_out = bank_ingest_rows(
             jnp.asarray(
                 raw_i16.reshape(C, -1, _BANK_BLK)
@@ -768,16 +790,13 @@ def ingest_features_pallas(
             feature_size=feature_size,
             slab_rows=slab_rows,
             interpret=bool(interpret),
-            bank_bf16=bank_bf16,
-        )  # (n_tiles*tile_b*C, K), unscaled (resolution applied below)
-        n_rows_total = rows_out.shape[0]
-        res_rows = jnp.tile(
-            jnp.asarray(resolutions, jnp.float32), n_rows_total // C
-        )[:, None]
-        tiled = dwt_xla.safe_l2_normalize(
-            (rows_out * res_rows).reshape(n_rows_total // C, C * K)
+            bank_bf16=mode == "bank128_bf16",
+        )  # (n_tiles*tile_b*C, K), unscaled
+        # scale/pack/normalize/unsort: the shared bank tail
+        return bank_finish(
+            rows_out, np.asarray(resolutions, np.float32), inv
         )
-    elif mode == "aligned8":
+    if mode == "aligned8":
         Wv_np, Mv_np, colsum_np, _ = aligned8_banks(
             wavelet_index, epoch_size, skip_samples, feature_size, pre
         )

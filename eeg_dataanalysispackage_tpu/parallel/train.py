@@ -190,6 +190,89 @@ def make_irregular_train_step(
     return init_state, step
 
 
+def make_irregular_bank_train_step(
+    positions,
+    mesh=None,
+    learning_rate: float = 0.05,
+    momentum: float = 0.9,
+    n_channels: int = 3,
+    chunk: int = 65536,
+    tile_b: int = 32,
+    mode: str = "bank128",
+):
+    """Irregular raw-stream training through the bank128 Pallas
+    featurizer (``ops/ingest_pallas.py``): windows cut in VMEM, none
+    of the block formulation's HBM intermediates (measured 120.8
+    KB/epoch on the r4 chip vs the 4.5 KB stream bytes).
+
+    Unlike :func:`make_irregular_train_step` (positions traced,
+    block-gather featurizer), marker ``positions`` are CONCRETE at
+    build time — the usual case: an IngestPlan is host metadata — so
+    the VMEM tile planning runs once here and the returned
+    ``step(state, raw_i16, resolutions, labels)`` is fully jitted
+    with the plan baked in. ``labels`` are in marker order (len ==
+    len(positions)); no capacity padding is involved (the plan's
+    internal tile padding never leaves the kernel).
+    """
+    from ..ops import ingest_pallas as ip
+    from ..ops import pallas_support as ps
+
+    if mode not in ip.BANK_MODES:
+        raise ValueError(
+            f"make_irregular_bank_train_step supports {ip.BANK_MODES}; "
+            f"got {mode!r}"
+        )
+    positions = np.asarray(positions)
+    n = positions.shape[0]
+    window = ip.kernel_window(mode)
+    plan = ip.bucket_plan_8(
+        ip.plan_pallas_tiles(
+            positions, window=window, chunk=chunk, tile_b=tile_b
+        )
+    )
+    half = chunk // 2
+    needed = (int(plan.half_idx.max(initial=0)) + 2) * half
+    sample_bucket = 8 * chunk
+    blocks_np, shifts_rows_np, inv_np = ip.bank_plan_arrays(
+        plan, n_channels
+    )
+    Wvm_np, fold_np, slab_rows = ip.bank128_banks()
+    bank_bf16 = mode == "bank128_bf16"
+    interpret = ps.default_interpret()
+    init_state, feat_step = make_feature_train_step(
+        mesh, learning_rate, momentum
+    )
+
+    @jax.jit
+    def step(state, raw_i16, resolutions, labels):
+        C, S = raw_i16.shape
+        if C != n_channels:
+            raise ValueError(
+                f"bank train step built for {n_channels} channels; "
+                f"got raw with {C}"
+            )
+        pad_to = ((max(S, needed) + sample_bucket - 1)
+                  // sample_bucket) * sample_bucket
+        if pad_to != S:
+            raw_i16 = jnp.pad(raw_i16, ((0, 0), (0, pad_to - S)))
+        rows = ip.bank_ingest_rows(
+            raw_i16.reshape(C, -1, ip._BANK_BLK),
+            jnp.asarray(plan.half_idx),
+            jnp.asarray(blocks_np),
+            jnp.asarray(shifts_rows_np),
+            jnp.asarray(Wvm_np, ip.bank_wvm_dtype(mode)),
+            jnp.asarray(fold_np),
+            tile_b=tile_b, chunk=chunk, feature_size=16,
+            slab_rows=slab_rows, interpret=interpret,
+            bank_bf16=bank_bf16,
+        )
+        feats = ip.bank_finish(rows, resolutions, inv_np)
+        mask = jnp.ones((n,), feats.dtype)
+        return feat_step(state, feats, labels, mask)
+
+    return init_state, step
+
+
 def stage_batch(
     epochs: np.ndarray, labels: np.ndarray, mesh
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:

@@ -35,6 +35,9 @@ BENCH_FORMULATION=bank run regular_bank 1800 \
 # (fused regular featurizer inside the SGD step) vs phase's 4.59M
 BENCH_FORMULATION=bank run train_raw_bank 1800 \
   python tools/ingest_bench.py train_step_raw 131072 20
+# IRREGULAR-stream training through the bank kernel vs
+# train_step_block's 1.34M (positions concrete at step build)
+run train_bank 1800 python tools/ingest_bench.py train_step_bank 32768 10
 # warm the persistent compile cache for the driver's bench.py run:
 # same shapes bench.py uses for its slowest-compiling variants
 BENCH_FORMULATION=phase run warm_regular 1200 \

@@ -45,6 +45,10 @@ Variants:
   train_step_block  int16 raw + IRREGULAR markers -> block-gather
                   fused ingest -> features -> logreg fwd/bwd/update
                   (parallel/train.make_irregular_train_step)
+  train_step_bank int16 raw + IRREGULAR markers -> bank128 Pallas
+                  fused ingest -> features -> logreg fwd/bwd/update
+                  (parallel/train.make_irregular_bank_train_step;
+                  BENCH_PALLAS_MODE selects the bank twin)
   rf_train        rf-tpu whole-forest growth as one XLA program
                   (models/trees_device.py): 100 trees, depth 5,
                   32 bins over n rows x 48 binned features;
@@ -611,6 +615,40 @@ def run(variant: str, n: int, iters: int) -> dict:
                 state2, loss = step(
                     state, raw_a, res_a + i * 1e-12, pos_a, mask_a, y
                 )
+                return state2, loss
+
+            state, losses = jax.lax.scan(
+                body, state0, jnp.arange(iters, dtype=jnp.float32)
+            )
+            return jax.tree_util.tree_reduce(
+                lambda a, b: a + b.sum(), state, jnp.float32(0)
+            ) + losses.sum()
+
+        arg = args
+
+    elif variant == "train_step_bank":
+        from eeg_dataanalysispackage_tpu.parallel import train as ptrain
+
+        S = 200 + n * STRIDE + 1000
+        raw = rng.randint(-3000, 3000, size=(3, S), dtype=np.int16)
+        base = np.arange(n, dtype=np.int64) * STRIDE + 200
+        jitter = rng.randint(-200, 200, size=n)
+        positions = np.clip(base + jitter, 100, S - 800)
+        labels = jnp.asarray(rng.randint(0, 2, size=n).astype(np.float32))
+        mode = os.environ.get("BENCH_PALLAS_MODE") or "bank128"
+        init_state, step = ptrain.make_irregular_bank_train_step(
+            positions, mode=mode
+        )
+        state0 = init_state(jax.random.PRNGKey(0))
+        # same byte model as train_step_block (stream bytes), so the
+        # block vs bank training rows are directly comparable
+        bytes_per_epoch = 3 * STRIDE * 2
+        args = (jnp.asarray(raw), jnp.asarray(res), labels)
+
+        @jax.jit
+        def loop(raw_a, res_a, y):
+            def body(state, i):
+                state2, loss = step(state, raw_a, res_a + i * 1e-12, y)
                 return state2, loss
 
             state, losses = jax.lax.scan(
