@@ -148,7 +148,7 @@ class OfflineDataProvider:
         if backend == "pallas":
             import os
 
-            from ..ops import ingest_pallas, pallas_support
+            from ..ops import ingest_pallas
 
             pallas_featurizer = ingest_pallas.make_pallas_ingest_featurizer(
                 wavelet_index=wavelet_index,
@@ -156,11 +156,10 @@ class OfflineDataProvider:
                 skip_samples=skip_samples,
                 feature_size=feature_size,
                 pre=self._pre,
-                # platform-aware: bank128 on compiled Mosaic (the one
-                # chip-compiling formulation, r4 probe), exact on
-                # interpreter platforms; EEG_PALLAS_MODE overrides
-                mode=os.environ.get("EEG_PALLAS_MODE")
-                or pallas_support.default_ingest_mode(),
+                # None -> the library's platform default (bank128 on
+                # compiled Mosaic, exact on interpreter platforms);
+                # EEG_PALLAS_MODE overrides
+                mode=os.environ.get("EEG_PALLAS_MODE") or None,
             )
         if backend == "block":
             featurizer = device_ingest.make_block_ingest_featurizer(

@@ -717,7 +717,7 @@ def ingest_features_pallas(
     chunk: int = 65536,
     tile_b: int = 32,
     interpret: bool | None = None,
-    mode: str = "exact",
+    mode: str | None = None,
 ) -> jnp.ndarray:
     """(C, S) int16 raw + (n,) marker positions -> (n, C*K) features.
 
@@ -747,10 +747,17 @@ def ingest_features_pallas(
       (see :func:`_make_kernel_bank`); numerics follow the block
       formulation's f32-safe two-term shape.
     """
-    if interpret is None:
-        from . import pallas_support
+    from . import pallas_support
 
+    if interpret is None:
         interpret = pallas_support.default_interpret()
+    if mode is None:
+        # default follows the RESOLVED interpret flag (not the
+        # platform) so an explicit interpret= override gets the
+        # matching formulation: compiled Mosaic -> bank128 (the one
+        # formulation that compiles through the axon remote helper),
+        # interpreter -> exact (the parity anchor)
+        mode = "exact" if interpret else "bank128"
     window = kernel_window(mode, pre, skip_samples, epoch_size)
     plan = plan_pallas_tiles(
         positions, pre=pre, window=window, chunk=chunk, tile_b=tile_b
@@ -852,14 +859,15 @@ def make_pallas_ingest_featurizer(
     chunk: int = 65536,
     tile_b: int = 32,
     interpret: bool | None = None,
-    mode: str = "exact",
+    mode: str | None = None,
 ):
     """Callable (raw int16, resolutions, positions) -> features, the
     plug-in counterpart of ``make_device_ingest_featurizer`` for the
     Pallas path (host planning happens per call; the kernel is jitted
     and cached by shape). ``mode`` selects the kernel formulation —
     see :func:`ingest_features_pallas`."""
-    kernel_window(mode)  # validate at build time, not first featurize
+    if mode is not None:
+        kernel_window(mode)  # validate at build time, not first featurize
 
     def featurize(raw_i16, resolutions, positions):
         return ingest_features_pallas(
