@@ -15,6 +15,10 @@ Variants:
   einsum_bf16     the headline with bfloat16 epochs resident (half the
                   HBM bytes; ~2e-3 feature deviation, classification
                   unchanged on the fixture — fe=dwt-8-tpu-bf16)
+  einsum_sliced   A/B of the headline: rank-preserving static slice
+                  to the live [skip, skip+size) columns + the same
+                  einsum — reads 51% of the headline's bytes IF XLA
+                  fuses the subrange read into the dot
   einsum_bf16_flat  bf16-resident epochs in the channel-flat (B, C*T)
                   layout against the block-diagonal operator: isolates
                   whether the bf16 twin's roofline shortfall (55.2% vs
@@ -144,7 +148,7 @@ def run(variant: str, n: int, iters: int) -> dict:
 
     if variant in (
         "einsum", "einsum_2d", "einsum_bf16", "einsum_flat",
-        "einsum_bf16_flat", "pallas_dwt",
+        "einsum_bf16_flat", "einsum_sliced", "pallas_dwt",
     ):
         from eeg_dataanalysispackage_tpu.ops import dwt as dwt_xla
 
@@ -167,6 +171,35 @@ def run(variant: str, n: int, iters: int) -> dict:
 
         if variant == "einsum":
             extract = dwt_xla.make_batched_extractor()
+        elif variant == "einsum_sliced":
+            # rank-preserving slice + same einsum: the operator's
+            # rows outside [skip, skip+size) are zero, so the full
+            # contraction reads 1000 columns to use 512. If XLA fuses
+            # the subrange read into the dot (no relayout — unlike
+            # the 16x-slower slice-RESHAPE-matmul the docstring of
+            # epoch_features measured), the op reads 51% of the
+            # headline's bytes. bytes_per_epoch stays 12000: the
+            # resident array is unchanged, so an honest win shows up
+            # as >100%-of-roofline at the counted bytes.
+            k512 = jnp.asarray(
+                np.asarray(
+                    dwt_xla.cascade_matrix(widx, esize, fsize),
+                    np.float32,
+                )
+            )
+
+            @jax.jit
+            def extract(x):
+                z = jax.lax.slice_in_dim(
+                    x, skip, skip + esize, axis=2
+                )
+                y = jnp.einsum(
+                    "bct,tk->bck", z, k512,
+                    precision=jax.lax.Precision.HIGHEST,
+                )
+                return dwt_xla.safe_l2_normalize(
+                    y.reshape(x.shape[0], C * fsize)
+                )
         elif variant == "pallas_dwt":
             # epochs-resident Pallas extractor: compiled to Mosaic on
             # chip in round 2 (~9.8M eps at tile_b=128) — serves as
