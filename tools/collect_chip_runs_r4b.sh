@@ -16,6 +16,9 @@ run einsum_524k 600 python tools/ingest_bench.py einsum 524288 50
 # sliced headline: reads 512 of 1000 columns if the subrange read
 # fuses; an honest win shows as >100% of roofline at counted bytes
 run einsum_sliced 600 python tools/ingest_bench.py einsum_sliced 262144 50
+# compact-resident epochs (B, C, 512) at honest 6144 B/epoch - the
+# feature-only storage layout's headline
+run einsum_512 600 python tools/ingest_bench.py einsum_512 262144 50
 BENCH_PALLAS_MODE=bank128 run bank128_131k 1800 \
   python tools/ingest_bench.py pallas_ingest 131072 20
 run rf_predict_retry 900 python tools/ingest_bench.py rf_predict 262144 10
