@@ -140,6 +140,29 @@ def test_xla_backend_non_power_of_two_epoch_size():
     np.testing.assert_allclose(f_xla, f_host, atol=5e-5)
 
 
+def test_sliced_contraction_matches_full_operator():
+    """The bench's einsum_sliced/einsum_512 formulation — static
+    slice to the live [skip, skip+size) columns + the 512-row cascade
+    operator — must equal the full 1000-row zero-padded contraction
+    (the r4b chip A/B is only honest if the two are the same math)."""
+    import jax
+    import jax.numpy as jnp
+
+    from eeg_dataanalysispackage_tpu.ops import dwt as dwt_xla
+
+    x = np.random.RandomState(11).randn(16, 3, 1000).astype(np.float32) * 50
+    full = np.asarray(dwt_xla.make_batched_extractor()(jnp.asarray(x)))
+    k512 = jnp.asarray(
+        np.asarray(dwt_xla.cascade_matrix(8, 512, 16), np.float32)
+    )
+    z = jnp.asarray(x)[:, :, 175 : 175 + 512]
+    y = jnp.einsum(
+        "bct,tk->bck", z, k512, precision=jax.lax.Precision.HIGHEST
+    )
+    sliced = np.asarray(dwt_xla.safe_l2_normalize(y.reshape(16, 48)))
+    np.testing.assert_allclose(sliced, full, rtol=0, atol=1e-6)
+
+
 def test_unknown_extractor_method_raises():
     from eeg_dataanalysispackage_tpu.ops import dwt as dwt_xla
 
