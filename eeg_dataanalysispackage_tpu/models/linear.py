@@ -9,8 +9,12 @@ strict comparisons (logreg: sigmoid(margin) > 0.5 i.e. margin > 0,
 ``LogisticRegressionModel.predictPoint``; svm: margin > 0,
 ``SVMModel.predictPoint`` — both predict 0.0 at exactly threshold).
 
-Model persistence is a single ``.npz`` with weights + config instead
-of MLlib's parquet+json directories.
+Model persistence is a single ``.npz`` with weights + config; MLlib's
+parquet+json model *directories* (what an existing reference
+deployment has on disk, LogisticRegressionClassifier.java:144-152)
+load drop-in too — ``load()`` detects the directory layout and routes
+through io/mllib_format.py, adopting the saved intercept and
+threshold with MLlib's strict-greater predict semantics.
 """
 
 from __future__ import annotations
@@ -31,22 +35,52 @@ class _LinearClassifier(base.Classifier):
     def __init__(self) -> None:
         super().__init__()
         self.weights: np.ndarray | None = None
+        # MLlib GLM predict state: margin = x.w + intercept, label =
+        # margin > margin_threshold (strict). Natively-trained models
+        # keep (0, 0) — MLlib's own defaults (prob 0.5 <=> margin 0) —
+        # so behavior is unchanged; imports adopt the saved values.
+        self.intercept: float = 0.0
+        self.margin_threshold: float = 0.0
+
+    # MLlib class tag this classifier accepts from a model directory
+    _mllib_class: str | None = None
+    # margin threshold from the saved threshold field: logreg stores a
+    # probability (margin = logit(p)), svm a margin (identity)
+    @staticmethod
+    def _to_margin_threshold(saved: float) -> float:
+        raise NotImplementedError
 
     def _sgd_config(self) -> sgd.SGDConfig:
         raise NotImplementedError
 
     def fit(self, features: np.ndarray, labels: np.ndarray) -> None:
         self.weights = sgd.train_linear(features, labels, self._sgd_config())
+        # training replaces any imported MLlib state: native MLlib-SGD
+        # semantics are interceptless with the margin-0 threshold
+        self.intercept = 0.0
+        self.margin_threshold = 0.0
 
     def predict(self, features: np.ndarray) -> np.ndarray:
         if self.weights is None:
             raise ValueError("model not trained or loaded")
-        margin = np.asarray(
-            sgd.predict_margin(
-                np.asarray(features, dtype=np.float32), self.weights
+        if self.weights.dtype == np.float64:
+            # imported MLlib weights stay f64 end-to-end so the import
+            # predicts bit-identically to the JVM's double margins
+            margin = (
+                np.asarray(features, dtype=np.float64) @ self.weights
+                + self.intercept
             )
-        )
-        return (margin > 0.0).astype(np.float64)
+        else:
+            margin = (
+                np.asarray(
+                    sgd.predict_margin(
+                        np.asarray(features, dtype=np.float32),
+                        self.weights,
+                    )
+                )
+                + self.intercept
+            )
+        return (margin > self.margin_threshold).astype(np.float64)
 
     def save(self, path: str) -> None:
         # serialize to bytes, then hand off to the pluggable
@@ -62,13 +96,18 @@ class _LinearClassifier(base.Classifier):
             weights=self.weights,
             config=json.dumps(self.config),
             kind=self.__class__.__name__,
+            intercept=np.float64(self.intercept),
+            margin_threshold=np.float64(self.margin_threshold),
         )
         fname = path if path.endswith(".npz") else path + ".npz"
         modelfiles.write_model_bytes(fname, buf.getvalue())
 
     def load(self, path: str) -> None:
-        from ..io import modelfiles
+        from ..io import mllib_format, modelfiles
 
+        if mllib_format.is_model_dir(path):
+            self._load_mllib_dir(path)
+            return
         fname = path if path.endswith(".npz") else path + ".npz"
         data = np.load(
             io.BytesIO(modelfiles.read_model_bytes(fname)),
@@ -82,6 +121,48 @@ class _LinearClassifier(base.Classifier):
             )
         self.weights = data["weights"]
         self.config = json.loads(str(data["config"]))
+        # absent in pre-interchange archives: those models were
+        # trained natively, where both are structurally zero
+        self.intercept = (
+            float(data["intercept"]) if "intercept" in data.files else 0.0
+        )
+        self.margin_threshold = (
+            float(data["margin_threshold"])
+            if "margin_threshold" in data.files
+            else 0.0
+        )
+
+    def _load_mllib_dir(self, path: str) -> None:
+        """Adopt a reference-deployment MLlib model directory
+        (LogisticRegressionClassifier.java:150-152 loads the same
+        artifact via ``LogisticRegressionModel.load``)."""
+        from ..io import mllib_format
+
+        m = mllib_format.read_glm(path)
+        if m.model_class != self._mllib_class:
+            raise ValueError(
+                f"model dir at {path} holds {m.model_class}, but "
+                f"{self.__class__.__name__} loads {self._mllib_class}"
+            )
+        if m.num_classes != 2:
+            # multinomial logreg packs (numClasses-1) weight blocks;
+            # the binary margin predict below would misread them
+            raise NotImplementedError(
+                f"multinomial MLlib model (numClasses="
+                f"{m.num_classes}) is not supported; the reference "
+                f"pipeline is binary"
+            )
+        self.weights = m.weights  # f64: routes predict to the f64 path
+        self.intercept = m.intercept
+        # a cleared threshold (MLlib clearThreshold, raw-score mode)
+        # has no label semantics; the pipeline always classifies, so
+        # refuse rather than guess
+        if m.threshold is None:
+            raise ValueError(
+                "model dir was saved with a cleared threshold (raw "
+                "scores); set one before exporting"
+            )
+        self.margin_threshold = self._to_margin_threshold(m.threshold)
 
 
 class LogisticRegressionClassifier(_LinearClassifier):
@@ -91,6 +172,15 @@ class LogisticRegressionClassifier(_LinearClassifier):
         "config_step_size",
         "config_mini_batch_fraction",
     )
+    _mllib_class = (
+        "org.apache.spark.mllib.classification.LogisticRegressionModel"
+    )
+
+    @staticmethod
+    def _to_margin_threshold(saved: float) -> float:
+        # LogisticRegressionModel stores a PROBABILITY threshold;
+        # sigmoid(margin) > p  <=>  margin > logit(p)
+        return float(np.log(saved / (1.0 - saved)))
 
     def _sgd_config(self) -> sgd.SGDConfig:
         c = self.config
@@ -121,6 +211,12 @@ class SVMClassifier(_LinearClassifier):
         "config_reg_param",
         "config_mini_batch_fraction",
     )
+    _mllib_class = "org.apache.spark.mllib.classification.SVMModel"
+
+    @staticmethod
+    def _to_margin_threshold(saved: float) -> float:
+        # SVMModel's threshold IS a margin (SVMModel.predictPoint)
+        return float(saved)
 
     def _sgd_config(self) -> sgd.SGDConfig:
         c = self.config

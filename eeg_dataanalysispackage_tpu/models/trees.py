@@ -250,6 +250,13 @@ class DecisionTreeClassifier(base.Classifier):
         # packed (T, n_nodes) device arrays for predict_linked_forest,
         # built lazily and invalidated whenever self.trees changes
         self._device_pack = None
+        # a loaded MLlib model directory (io/mllib_format.py) — raw
+        # continuous thresholds, so prediction routes through its own
+        # reference-semantics descent instead of the binned forest
+        self._mllib = None
+
+    # MLlib class tag this classifier accepts from a model directory
+    _mllib_class = "org.apache.spark.mllib.tree.model.DecisionTreeModel"
 
     def _resolved_backend(self) -> str:
         """The run's backend: ``config_backend`` overrides the ctor
@@ -283,6 +290,9 @@ class DecisionTreeClassifier(base.Classifier):
         p = self._tree_params()
         self._params = p
         self._device_pack = None
+        # training replaces any previously imported MLlib model; the
+        # predict short-circuit must follow the new trees
+        self._mllib = None
         y = np.floor(np.asarray(labels, dtype=np.float64) + 0.5).astype(np.int64)
         self.edges = compute_bin_edges(features, p["max_bins"])
         binned = bin_features(features, self.edges)
@@ -346,6 +356,8 @@ class DecisionTreeClassifier(base.Classifier):
         self.trees = trees_device.heap_to_host_arrays(forest)
 
     def predict(self, features: np.ndarray) -> np.ndarray:
+        if self._mllib is not None:
+            return self._mllib.predict(features)
         if not self.trees or self.edges is None:
             raise ValueError("model not trained or loaded")
         binned = bin_features(np.asarray(features, dtype=np.float64), self.edges)
@@ -382,6 +394,14 @@ class DecisionTreeClassifier(base.Classifier):
     def save(self, path: str) -> None:
         from ..io import modelfiles
 
+        if self._mllib is not None:
+            # re-exporting an imported directory is an explicit
+            # operation (io/mllib_format.write_tree_ensemble), not a
+            # silent format change under the native save path
+            raise ValueError(
+                "this model was loaded from an MLlib model directory; "
+                "re-export it with io.mllib_format.write_tree_ensemble"
+            )
         path = self._strip_prefix(path)
         modelfiles.delete_local_dir_target(path)
         payload = {
@@ -408,9 +428,25 @@ class DecisionTreeClassifier(base.Classifier):
         modelfiles.write_model_bytes(fname, buf.getvalue())
 
     def load(self, path: str) -> None:
-        from ..io import modelfiles
+        from ..io import mllib_format, modelfiles
 
         path = self._strip_prefix(path)
+        if mllib_format.is_model_dir(path):
+            # a reference-deployment artifact (the same directory
+            # DecisionTreeClassifier.java:163-165 hands to
+            # DecisionTreeModel.load)
+            ens = mllib_format.read_tree_ensemble(path)
+            if ens.model_class != self._mllib_class:
+                raise ValueError(
+                    f"model dir at {path} holds {ens.model_class}, but "
+                    f"{self.__class__.__name__} loads {self._mllib_class}"
+                )
+            self._mllib = ens
+            self.trees = []
+            self.edges = None
+            self._device_pack = None
+            return
+        self._mllib = None
         fname = path if path.endswith(".npz") else path + ".npz"
         data = np.load(
             io.BytesIO(modelfiles.read_model_bytes(fname)),
@@ -443,6 +479,7 @@ class RandomForestClassifier(DecisionTreeClassifier):
         "config_num_trees",
         "config_feature_subset",
     )
+    _mllib_class = "org.apache.spark.mllib.tree.model.RandomForestModel"
 
     def _n_trees(self) -> int:
         c = self.config
@@ -563,6 +600,9 @@ class GradientBoostedTreesClassifier(DecisionTreeClassifier):
         "config_learning_rate",
         "config_max_depth",
     )
+    _mllib_class = (
+        "org.apache.spark.mllib.tree.model.GradientBoostedTreesModel"
+    )
 
     def _boost_params(self) -> Dict:
         c = self.config
@@ -579,6 +619,7 @@ class GradientBoostedTreesClassifier(DecisionTreeClassifier):
         bp = {"max_bins": 32, "min_instances": 1}
         self._params = {**p, **bp}
         self._device_pack = None
+        self._mllib = None  # training replaces any imported model
         y = np.floor(np.asarray(labels, dtype=np.float64) + 0.5)
         self.edges = compute_bin_edges(features, bp["max_bins"])
         binned = bin_features(features, self.edges)
@@ -621,6 +662,8 @@ class GradientBoostedTreesClassifier(DecisionTreeClassifier):
         self.trees = trees_device.heap_to_host_arrays(heaps)
 
     def predict(self, features: np.ndarray) -> np.ndarray:
+        if self._mllib is not None:
+            return self._mllib.predict(features)
         if not self.trees or self.edges is None:
             raise ValueError("model not trained or loaded")
         binned = bin_features(np.asarray(features, dtype=np.float64), self.edges)

@@ -199,6 +199,11 @@ def make_irregular_bank_train_step(
     chunk: int = 65536,
     tile_b: int = 32,
     mode: str = "bank128",
+    wavelet_index: int = 8,
+    epoch_size: int = 512,
+    skip_samples: int = 175,
+    feature_size: int = 16,
+    pre: int | None = None,
 ):
     """Irregular raw-stream training through the bank128 Pallas
     featurizer (``ops/ingest_pallas.py``): windows cut in VMEM, none
@@ -213,21 +218,34 @@ def make_irregular_bank_train_step(
     with the plan baked in. ``labels`` are in marker order (len ==
     len(positions)); no capacity padding is involved (the plan's
     internal tile padding never leaves the kernel).
+
+    The DWT geometry (``wavelet_index``/``epoch_size``/
+    ``skip_samples``/``feature_size``/``pre``) is plumbed through to
+    the kernel-window and operator-bank constructors, so a caller
+    with non-default geometry gets a bank built for it rather than
+    silently-wrong default-geometry features.
     """
+    from functools import partial as _partial
+
     from ..ops import ingest_pallas as ip
     from ..ops import pallas_support as ps
+    from ..utils import constants as _const
 
     if mode not in ip.BANK_MODES:
         raise ValueError(
             f"make_irregular_bank_train_step supports {ip.BANK_MODES}; "
             f"got {mode!r}"
         )
+    if pre is None:
+        pre = _const.PRESTIMULUS_SAMPLES
     positions = np.asarray(positions)
     n = positions.shape[0]
-    window = ip.kernel_window(mode)
+    window = ip.kernel_window(
+        mode, pre=pre, skip_samples=skip_samples, epoch_size=epoch_size
+    )
     plan = ip.bucket_plan_8(
         ip.plan_pallas_tiles(
-            positions, window=window, chunk=chunk, tile_b=tile_b
+            positions, pre=pre, window=window, chunk=chunk, tile_b=tile_b
         )
     )
     half = chunk // 2
@@ -236,15 +254,20 @@ def make_irregular_bank_train_step(
     blocks_np, shifts_rows_np, inv_np = ip.bank_plan_arrays(
         plan, n_channels
     )
-    Wvm_np, fold_np, slab_rows = ip.bank128_banks()
+    Wvm_np, fold_np, slab_rows = ip.bank128_banks(
+        wavelet_index=wavelet_index,
+        epoch_size=epoch_size,
+        skip_samples=skip_samples,
+        feature_size=feature_size,
+        pre=pre,
+    )
     bank_bf16 = mode == "bank128_bf16"
-    interpret = ps.default_interpret()
     init_state, feat_step = make_feature_train_step(
         mesh, learning_rate, momentum
     )
 
-    @jax.jit
-    def step(state, raw_i16, resolutions, labels):
+    @_partial(jax.jit, static_argnames=("interpret",))
+    def _bank_step(state, raw_i16, resolutions, labels, *, interpret):
         C, S = raw_i16.shape
         if C != n_channels:
             raise ValueError(
@@ -262,13 +285,24 @@ def make_irregular_bank_train_step(
             jnp.asarray(shifts_rows_np),
             jnp.asarray(Wvm_np, ip.bank_wvm_dtype(mode)),
             jnp.asarray(fold_np),
-            tile_b=tile_b, chunk=chunk, feature_size=16,
+            tile_b=tile_b, chunk=chunk, feature_size=feature_size,
             slab_rows=slab_rows, interpret=interpret,
             bank_bf16=bank_bf16,
         )
         feats = ip.bank_finish(rows, resolutions, inv_np)
         mask = jnp.ones((n,), feats.dtype)
         return feat_step(state, feats, labels, mask)
+
+    def step(state, raw_i16, resolutions, labels):
+        # interpret resolved per CALL, not at build: the step object
+        # may outlive a platform switch (CPU test mesh -> chip), and
+        # baking the first caller's platform in is the
+        # 'auto'-resolution staleness class device_ingest._run_bank
+        # names; as a static arg it costs one retrace on change
+        return _bank_step(
+            state, raw_i16, resolutions, labels,
+            interpret=ps.default_interpret(),
+        )
 
     return init_state, step
 

@@ -1,0 +1,132 @@
+"""Native-RPC HDFS driver (io/remote.NativeHdfsFileSystem).
+
+The reference reaches HDFS over the native Hadoop RPC protocol
+(``Const.java:38-42`` — ``hdfs://localhost:8020``, the RPC port;
+``OffLineDataProvider.java:90``). The repo's default driver is
+WebHDFS (zero dependencies); ``HDFS_DRIVER=native`` routes the same
+``hdfs://`` URIs through libhdfs for clusters with WebHDFS disabled.
+These tests pin the driver selection, the URI -> (host, port, path)
+mapping, the FileSystem-protocol semantics over a faked libhdfs
+layer (the module-level ``_hadoop_connect`` seam), and the
+actionable error when the native runtime is absent (which it is in
+this image — no JVM)."""
+
+import io
+
+import numpy as np  # noqa: F401  (import parity with sibling tests)
+import pytest
+
+from eeg_dataanalysispackage_tpu.io import remote
+
+
+class _FakeStream(io.BytesIO):
+    def __init__(self, store, path):
+        super().__init__()
+        self._store, self._path = store, path
+
+    def __exit__(self, *exc):
+        self._store[self._path] = self.getvalue()
+        return super().__exit__(*exc)
+
+
+class _FakeHadoopFS:
+    """Just enough of pyarrow.fs.HadoopFileSystem for the adapter."""
+
+    def __init__(self):
+        self.files = {}
+        self.dirs = set()
+
+    def get_file_info(self, paths):
+        from pyarrow import fs as pafs
+
+        out = []
+        for p in paths:
+            if p in self.files:
+                out.append(
+                    pafs.FileInfo(
+                        p, type=pafs.FileType.File, size=len(self.files[p])
+                    )
+                )
+            elif p in self.dirs:
+                out.append(pafs.FileInfo(p, type=pafs.FileType.Directory))
+            else:
+                out.append(pafs.FileInfo(p, type=pafs.FileType.NotFound))
+        return out
+
+    def open_input_stream(self, p):
+        return io.BytesIO(self.files[p])
+
+    def open_output_stream(self, p):
+        return _FakeStream(self.files, p)
+
+
+@pytest.fixture
+def fake_connect(monkeypatch):
+    calls = []
+    fake = _FakeHadoopFS()
+
+    def connect(host, port, user):
+        calls.append((host, port, user))
+        return fake
+
+    monkeypatch.setattr(remote, "_hadoop_connect", connect)
+    return fake, calls
+
+
+def test_driver_selection(monkeypatch):
+    monkeypatch.delenv("HDFS_DRIVER", raising=False)
+    assert isinstance(
+        remote.filesystem_for("hdfs://nn:8020/x"), remote.WebHdfsFileSystem
+    )
+    monkeypatch.setenv("HDFS_DRIVER", "native")
+    assert isinstance(
+        remote.filesystem_for("hdfs://nn:8020/x"),
+        remote.NativeHdfsFileSystem,
+    )
+    monkeypatch.setenv("HDFS_DRIVER", "bogus")
+    with pytest.raises(ValueError, match="HDFS_DRIVER"):
+        remote.filesystem_for("hdfs://nn:8020/x")
+
+
+def test_round_trip_and_authority_mapping(fake_connect):
+    fake, calls = fake_connect
+    fs = remote.NativeHdfsFileSystem(user="eeg")
+    uri = "hdfs://namenode:9000/data/infoTrain.txt"
+    assert not fs.exists(uri)
+    fs.write_bytes(uri, b"a;b;c\n")
+    assert fs.exists(uri)
+    assert fs.read_bytes(uri) == b"a;b;c\n"
+    assert fs.read_text(uri) == "a;b;c\n"
+    # one cached connection, dialed with the URI's RPC authority
+    assert calls == [("namenode", 9000, "eeg")]
+
+
+def test_default_port_and_default_fs(fake_connect):
+    fake, calls = fake_connect
+    fs = remote.NativeHdfsFileSystem()
+    fs.write_bytes("hdfs://nn/x", b"1")  # no port -> 8020 (Const.java:39)
+    fs.write_bytes("hdfs:///y", b"2")  # default-FS form -> libhdfs 'default'
+    assert [c[:2] for c in calls] == [("nn", 8020), ("default", 0)]
+
+
+def test_directory_and_missing_semantics(fake_connect):
+    fake, _ = fake_connect
+    fake.dirs.add("/d")
+    fs = remote.NativeHdfsFileSystem()
+    with pytest.raises(IsADirectoryError):
+        fs.read_bytes("hdfs://nn/d")
+    with pytest.raises(FileNotFoundError):
+        fs.read_bytes("hdfs://nn/nope")
+
+
+def test_non_hdfs_uri_rejected(fake_connect):
+    fs = remote.NativeHdfsFileSystem()
+    with pytest.raises(ValueError, match="hdfs://"):
+        fs.read_bytes("http://x/y")
+
+
+def test_missing_native_runtime_error_is_actionable():
+    """No JVM/libhdfs in this image: the real connect must fail fast
+    with the WebHDFS pointer, not an opaque loader error."""
+    with pytest.raises(remote.RemoteIOError, match="WebHDFS"):
+        remote._hadoop_connect("localhost", 1, None)

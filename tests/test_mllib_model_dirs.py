@@ -1,0 +1,372 @@
+"""MLlib model-directory interchange (io/mllib_format.py).
+
+The reference persists models with MLlib's own ``model.save``
+(LogisticRegressionClassifier.java:144-152, DecisionTreeClassifier
+.java:156-165 with its ``file://`` prefix): parquet data + JSON
+metadata directories. These tests pin that a directory in that
+format — built by the module's own format-1.0 writer, whose schema
+follows the layout documented in the module docstring — loads
+drop-in through the classifiers' ``load()`` seam and predicts with
+MLlib's semantics (f64 margins, strict-greater thresholds, Vote/Sum
+ensemble combining), plus the native-npz compatibility edges around
+the new intercept/threshold state.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from eeg_dataanalysispackage_tpu.io import mllib_format as mf
+from eeg_dataanalysispackage_tpu.models.linear import (
+    LogisticRegressionClassifier,
+    SVMClassifier,
+)
+from eeg_dataanalysispackage_tpu.models.trees import (
+    DecisionTreeClassifier,
+    GradientBoostedTreesClassifier,
+    RandomForestClassifier,
+)
+
+RNG = np.random.RandomState(7)
+
+
+def _features(n=64, d=48):
+    return RNG.randn(n, d) * 2.0
+
+
+# ------------------------------------------------------------- GLM
+
+
+def test_glm_dir_round_trip(tmp_path):
+    w = RNG.randn(48)
+    d = str(tmp_path / "m")
+    mf.write_glm(d, mf.GLM_LOGREG, w, intercept=0.25, threshold=0.5)
+    m = mf.read_glm(d)
+    assert m.model_class == mf.GLM_LOGREG
+    np.testing.assert_array_equal(m.weights, w)  # f64 bit round-trip
+    assert m.intercept == 0.25
+    assert m.threshold == 0.5
+    assert m.num_features == 48 and m.num_classes == 2
+
+
+def test_logreg_loads_mllib_dir_and_predicts_like_mllib(tmp_path):
+    w = RNG.randn(48)
+    b = 0.3
+    d = str(tmp_path / "m")
+    mf.write_glm(d, mf.GLM_LOGREG, w, intercept=b, threshold=0.5)
+    clf = LogisticRegressionClassifier()
+    clf.load(d)
+    X = _features()
+    # LogisticRegressionModel.predictPoint: sigmoid(x.w + b) > 0.5,
+    # i.e. margin > 0, all in doubles
+    want = ((X @ w + b) > 0.0).astype(np.float64)
+    np.testing.assert_array_equal(clf.predict(X), want)
+
+
+def test_logreg_honors_probability_threshold(tmp_path):
+    w = RNG.randn(48)
+    d = str(tmp_path / "m")
+    mf.write_glm(d, mf.GLM_LOGREG, w, intercept=0.0, threshold=0.7)
+    clf = LogisticRegressionClassifier()
+    clf.load(d)
+    X = _features()
+    prob = 1.0 / (1.0 + np.exp(-(X @ w)))
+    np.testing.assert_array_equal(
+        clf.predict(X), (prob > 0.7).astype(np.float64)
+    )
+
+
+def test_svm_threshold_is_a_margin(tmp_path):
+    w = RNG.randn(48)
+    d = str(tmp_path / "m")
+    mf.write_glm(d, mf.GLM_SVM, w, intercept=-0.1, threshold=1.5)
+    clf = SVMClassifier()
+    clf.load(d)
+    X = _features()
+    want = ((X @ w - 0.1) > 1.5).astype(np.float64)
+    np.testing.assert_array_equal(clf.predict(X), want)
+
+
+def test_glm_class_mismatch_raises(tmp_path):
+    d = str(tmp_path / "m")
+    mf.write_glm(d, mf.GLM_SVM, RNG.randn(48))
+    with pytest.raises(ValueError, match="SVMModel"):
+        LogisticRegressionClassifier().load(d)
+
+
+def test_cleared_threshold_refused(tmp_path):
+    d = str(tmp_path / "m")
+    mf.write_glm(d, mf.GLM_LOGREG, RNG.randn(48), threshold=None)
+    with pytest.raises(ValueError, match="cleared threshold"):
+        LogisticRegressionClassifier().load(d)
+
+
+def test_sparse_vector_decoding():
+    v = {
+        "type": 0,
+        "size": 6,
+        "indices": [1, 4],
+        "values": [2.5, -1.0],
+    }
+    np.testing.assert_array_equal(
+        mf._vector_to_np(v), [0.0, 2.5, 0.0, 0.0, -1.0, 0.0]
+    )
+
+
+def test_npz_back_compat_without_interchange_fields(tmp_path):
+    """Model archives from before the intercept/threshold fields load
+    with the structural zeros native training implies."""
+    import io as _io
+
+    w = RNG.randn(48).astype(np.float32)
+    buf = _io.BytesIO()
+    np.savez(
+        buf,
+        weights=w,
+        config=json.dumps({}),
+        kind="LogisticRegressionClassifier",
+    )
+    p = str(tmp_path / "old.npz")
+    with open(p, "wb") as f:
+        f.write(buf.getvalue())
+    clf = LogisticRegressionClassifier()
+    clf.load(p)
+    assert clf.intercept == 0.0 and clf.margin_threshold == 0.0
+    X = _features().astype(np.float32)
+    np.testing.assert_array_equal(
+        clf.predict(X),
+        (np.asarray(X @ w) > 0.0).astype(np.float64),
+    )
+
+
+def test_npz_round_trips_interchange_fields(tmp_path):
+    d = str(tmp_path / "m")
+    mf.write_glm(d, mf.GLM_SVM, RNG.randn(48), intercept=0.5, threshold=0.25)
+    clf = SVMClassifier()
+    clf.load(d)
+    p = str(tmp_path / "native")
+    clf.save(p)
+    clf2 = SVMClassifier()
+    clf2.load(p)
+    assert clf2.intercept == 0.5
+    assert clf2.margin_threshold == 0.25
+    X = _features()
+    np.testing.assert_array_equal(clf2.predict(X), clf.predict(X))
+
+
+# ------------------------------------------------------------ trees
+
+
+def _manual_tree():
+    """Depth-2 stump pair: root on feature 3 @ 0.0; left child leaf
+    -> 0; right child splits feature 10 @ 1.0 into 1 / 0."""
+    return {
+        "feature": np.array([3, 0, 10, 0, 0]),
+        "threshold": np.array([0.0, np.inf, 1.0, np.inf, np.inf]),
+        "left": np.array([1, 1, 3, 3, 4]),
+        "right": np.array([2, 1, 4, 3, 4]),
+        "leaf": np.array([False, True, False, True, True]),
+        "predict": np.array([0.0, 0.0, 0.0, 1.0, 0.0]),
+    }
+
+
+def _manual_tree_predict(X):
+    out = np.zeros(len(X))
+    right = X[:, 3] > 0.0
+    out[right & (X[:, 10] <= 1.0)] = 1.0
+    return out
+
+
+def test_dt_dir_round_trip_and_predict(tmp_path):
+    d = str(tmp_path / "dt")
+    mf.write_tree_ensemble(d, mf.TREE_DT, [_manual_tree()])
+    clf = DecisionTreeClassifier()
+    # the reference passes "file://" + path (DecisionTreeClassifier
+    # .java:164); the importer strips it
+    clf.load("file://" + d)
+    X = _features()
+    np.testing.assert_array_equal(clf.predict(X), _manual_tree_predict(X))
+
+
+def test_rf_vote_combining(tmp_path):
+    t1 = _manual_tree()
+    t0 = _manual_tree()
+    t0["predict"] = np.zeros(5)  # always votes 0
+    talways = _manual_tree()
+    talways["predict"] = np.array([0.0, 1.0, 0.0, 1.0, 1.0])  # votes 1
+    d = str(tmp_path / "rf")
+    mf.write_tree_ensemble(d, mf.TREE_RF, [t1, t0, talways])
+    clf = RandomForestClassifier()
+    clf.load(d)
+    X = _features()
+    votes = _manual_tree_predict(X) + 0.0 + 1.0
+    np.testing.assert_array_equal(
+        clf.predict(X), (votes > 1.5).astype(np.float64)
+    )
+
+
+def test_gbt_sum_combining(tmp_path):
+    # regression trees emitting margins; Sum with treeWeights, label
+    # = 1 iff weighted sum > 0 (GradientBoostedTreesModel predict)
+    t = _manual_tree()
+    t["predict"] = np.array([0.0, -1.0, 0.0, 2.0, -1.0])
+    d = str(tmp_path / "gbt")
+    mf.write_tree_ensemble(
+        d, mf.TREE_GBT, [t, t], tree_weights=[1.0, 0.25]
+    )
+    clf = GradientBoostedTreesClassifier()
+    clf.load(d)
+    X = _features()
+    per = np.where(
+        X[:, 3] > 0.0, np.where(X[:, 10] <= 1.0, 2.0, -1.0), -1.0
+    )
+    want = ((1.25 * per) > 0.0).astype(np.float64)
+    np.testing.assert_array_equal(clf.predict(X), want)
+
+
+def test_tree_class_mismatch_raises(tmp_path):
+    d = str(tmp_path / "rf")
+    mf.write_tree_ensemble(d, mf.TREE_RF, [_manual_tree()])
+    with pytest.raises(ValueError, match="RandomForestModel"):
+        DecisionTreeClassifier().load(d)
+
+
+def test_imported_tree_save_is_explicit(tmp_path):
+    d = str(tmp_path / "dt")
+    mf.write_tree_ensemble(d, mf.TREE_DT, [_manual_tree()])
+    clf = DecisionTreeClassifier()
+    clf.load(d)
+    with pytest.raises(ValueError, match="write_tree_ensemble"):
+        clf.save(str(tmp_path / "native"))
+    # explicit re-export round-trips
+    d2 = str(tmp_path / "dt2")
+    mf.write_tree_ensemble(d2, clf._mllib.model_class, clf._mllib.trees)
+    clf2 = DecisionTreeClassifier()
+    clf2.load(d2)
+    X = _features()
+    np.testing.assert_array_equal(clf2.predict(X), clf.predict(X))
+
+
+def test_categorical_split_refused(tmp_path):
+    d = str(tmp_path / "dt")
+    mf.write_tree_ensemble(d, mf.TREE_DT, [_manual_tree()])
+    # rewrite the parquet with a categorical featureType
+    import pyarrow.parquet as pq
+
+    data_dir = os.path.join(d, "data")
+    f = [
+        os.path.join(data_dir, p)
+        for p in os.listdir(data_dir)
+        if p.endswith(".parquet")
+    ][0]
+    rows = pq.read_table(f).to_pylist()
+    for r in rows:
+        if r["split"] is not None:
+            r["split"]["featureType"] = 1
+    import pyarrow as pa
+
+    pq.write_table(
+        pa.Table.from_pylist(rows, schema=pq.read_table(f).schema), f
+    )
+    with pytest.raises(NotImplementedError, match="categorical"):
+        mf.read_tree_ensemble(d)
+
+
+def test_is_model_dir_detection(tmp_path):
+    assert not mf.is_model_dir(str(tmp_path))
+    d = str(tmp_path / "m")
+    mf.write_glm(d, mf.GLM_LOGREG, RNG.randn(4))
+    assert mf.is_model_dir(d)
+    assert mf.is_model_dir("file://" + d)
+    # a directory with an empty metadata dir is not a model dir
+    os.makedirs(str(tmp_path / "x" / "metadata"))
+    assert not mf.is_model_dir(str(tmp_path / "x"))
+
+
+def test_native_npz_load_still_works_beside_dirs(tmp_path):
+    """A trained-and-saved native model loads unchanged through the
+    same seam that detects MLlib dirs."""
+    X = _features(128).astype(np.float64)
+    y = (X[:, 0] > 0).astype(np.float64)
+    clf = LogisticRegressionClassifier()
+    clf.set_config({})
+    clf.fit(X, y)
+    p = str(tmp_path / "native")
+    clf.save(p)
+    clf2 = LogisticRegressionClassifier()
+    clf2.load(p)
+    np.testing.assert_array_equal(clf2.predict(X), clf.predict(X))
+
+
+def test_fit_after_import_replaces_the_imported_model(tmp_path):
+    """Training must clear imported MLlib state (review finding:
+    stale _mllib/intercept/threshold silently shadowing fresh
+    training)."""
+    X = _features(128)
+    y = (X[:, 0] > 0).astype(np.float64)
+
+    d = str(tmp_path / "glm")
+    mf.write_glm(d, mf.GLM_LOGREG, RNG.randn(48), intercept=2.3, threshold=0.7)
+    clf = LogisticRegressionClassifier()
+    clf.load(d)
+    clf.set_config({})
+    clf.fit(X.astype(np.float64), y)
+    assert clf.intercept == 0.0 and clf.margin_threshold == 0.0
+    # native semantics: f32 margin > 0, no imported intercept
+    np.testing.assert_array_equal(
+        clf.predict(X),
+        (
+            np.asarray(X.astype(np.float32) @ clf.weights) > 0.0
+        ).astype(np.float64),
+    )
+
+    d2 = str(tmp_path / "dt")
+    always_one = _manual_tree()
+    always_one["predict"] = np.array([0.0, 1.0, 0.0, 1.0, 1.0])
+    mf.write_tree_ensemble(d2, mf.TREE_DT, [always_one])
+    tclf = DecisionTreeClassifier()
+    tclf.load(d2)
+    tclf.set_config({})
+    tclf.fit(X, y)
+    assert tclf._mllib is None
+    assert not np.all(tclf.predict(X) == 1.0)  # not the imported stump
+
+
+def test_multiclass_models_refused(tmp_path):
+    """Binary-only consumers refuse multiclass artifacts instead of
+    silently collapsing labels (review finding)."""
+    d = str(tmp_path / "glm3")
+    mf.write_glm(d, mf.GLM_LOGREG, RNG.randn(96), num_classes=3)
+    with pytest.raises(NotImplementedError, match="multinomial"):
+        LogisticRegressionClassifier().load(d)
+
+    t = _manual_tree()
+    t["predict"] = np.array([0.0, 2.0, 0.0, 1.0, 0.0])  # class-2 leaf
+    d2 = str(tmp_path / "dt3")
+    mf.write_tree_ensemble(d2, mf.TREE_DT, [t])
+    with pytest.raises(NotImplementedError, match="multiclass"):
+        mf.read_tree_ensemble(d2)
+    # GBT margins are NOT class labels: arbitrary leaf values stay
+    # legal on the sum path
+    d3 = str(tmp_path / "gbt_margin")
+    mf.write_tree_ensemble(d3, mf.TREE_GBT, [t])
+    assert mf.read_tree_ensemble(d3).combining == "sum"
+
+
+def test_pipeline_load_clf_from_mllib_dir(tmp_path, fixture_dir):
+    """End-to-end drop-in: ``load_clf=logreg&load_name=<mllib dir>``
+    through the full query pipeline (PipelineBuilder.java:261-278
+    load branch), on the reference fixture recording."""
+    from eeg_dataanalysispackage_tpu.pipeline import builder
+
+    d = str(tmp_path / "mllib_model")
+    mf.write_glm(d, mf.GLM_LOGREG, RNG.randn(48) * 0.1, intercept=0.05)
+    result = str(tmp_path / "res.txt")
+    stats = builder.PipelineBuilder(
+        f"info_file={fixture_dir}/infoTrain.txt&fe=dwt-8"
+        f"&load_clf=logreg&load_name={d}&result_path={result}"
+    ).execute()
+    assert stats is not None
+    assert os.path.exists(result)

@@ -10,6 +10,15 @@
 # finding (dispatch amortization: 69.8% at 524k) for the f32
 # headline too. rf_predict faulted the TPU worker once (r4) - one
 # retry distinguishes transient from reproducible.
+# FIRST in any healthy window (VERDICT r4 weakness 1): a
+# driver-format bench artifact with platform=tpu, budget-bounded so
+# it records the fast-compiling headline rows and budget-skips the
+# cold fused programs rather than burning the window on their
+# 10-14 min compiles (they get the full-budget bench_full at the
+# end, behind the warmed cache). bench.py embeds this artifact as
+# dated chip_evidence in every later bench run, including the
+# driver's round-end one.
+BENCH_TOTAL_BUDGET=480 run bench_early 2400 python bench.py
 BENCH_PALLAS_MODE=bank128 run bank128_32k 1200 \
   python tools/ingest_bench.py pallas_ingest 32768 10
 run einsum_524k 600 python tools/ingest_bench.py einsum 524288 50
@@ -44,9 +53,29 @@ BENCH_FORMULATION=bank run train_raw_bank 1800 \
 # IRREGULAR-stream training through the bank kernel vs
 # train_step_block's 1.34M (positions concrete at step build)
 run train_bank 1800 python tools/ingest_bench.py train_step_bank 32768 10
+# train-step batch curve (VERDICT r4 weakness 6): the 35.4% r4 row
+# ran at 131k while the headline ran at 262k; the bf16 batch curve
+# showed exactly this dispatch-amortization signature (39.8% @131k
+# -> 69.8% @524k), so measure the same step at 262k before blaming
+# program bytes
+run train_step_262k 900 python tools/ingest_bench.py train_step 262144 30
+# train-step roofline diagnosis (VERDICT r4 weakness 6: 35.4% vs the
+# feature-only 69.6%): XLA's own cost model on the train_step /
+# feature_step programs — bytes_ratio >> 1 localizes the gap to
+# program traffic (optimizer state, loss tail), ~1 means dispatch
+run cost_train 1800 python tools/cost_report.py 131072
 # warm the persistent compile cache for the driver's bench.py run:
 # same shapes bench.py uses for its slowest-compiling variants
 BENCH_FORMULATION=phase run warm_regular 1200 \
   python tools/ingest_bench.py regular_ingest 262144 20
 run warm_train_raw 1200 python tools/ingest_bench.py train_step_raw 131072 20
 BENCH_TOTAL_BUDGET=1800 run bench_full 3600 python bench.py
+
+# evidence hygiene (VERDICT r4 item 9): every chip claim needs its
+# raw artifact — flag any run whose JSON came out empty so a number
+# can never be cited without a file behind it
+: > "$OUT/MISSING.txt"
+for f in "$OUT"/*.json; do
+  [ -s "$f" ] || basename "$f" >> "$OUT/MISSING.txt"
+done
+log "hygiene: $(wc -l < "$OUT/MISSING.txt") empty artifacts: $(tr '\n' ' ' < "$OUT/MISSING.txt")"

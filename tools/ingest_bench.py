@@ -400,11 +400,12 @@ def run(variant: str, n: int, iters: int) -> dict:
             bank_modes = ingest_pallas.BANK_MODES
             if mode in bank_modes:
                 Wvm_np, fold_np, slab_rows = ingest_pallas.bank128_banks()
-                BLK = ingest_pallas._BANK_BLK
-                blocks = (plan.offsets // BLK).astype(np.int32)
-                shifts_rows = np.repeat(
-                    (plan.offsets % BLK).astype(np.int32).reshape(-1), 3
-                )[:, None]
+                # the offset -> row-block + in-row-shift encoding has
+                # exactly one home (bank_plan_arrays); the bench must
+                # time the shipped layout, never a re-derived one
+                blocks, shifts_rows, _ = ingest_pallas.bank_plan_arrays(
+                    plan, 3
+                )
                 bank_bf16 = mode == "bank128_bf16"
                 bank_extra = (
                     jnp.asarray(blocks), jnp.asarray(shifts_rows),
