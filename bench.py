@@ -149,12 +149,51 @@ def _wait_or_abandon(proc, deadline_s: float) -> bool:
     return proc.poll() is not None
 
 
+def _collection_in_progress() -> bool:
+    """True iff a staged chip collection (tools/tunnel_watch.sh +
+    collect_chip_runs*.sh) holds a fresh advisory lock. Two processes
+    on the tunnel at once is the documented wedge class, so a
+    concurrently-running collection wins and this bench takes the CPU
+    fallback — whose chip_evidence field carries the very numbers the
+    collection is producing. Stale locks (>3 h — longer than any
+    collection pass) are ignored; the collection's own bench
+    invocations opt out via BENCH_IGNORE_COLLECT_LOCK."""
+    if os.environ.get("BENCH_IGNORE_COLLECT_LOCK") == "1":
+        return False
+    import glob
+
+    # both homes: committed sweep dirs AND tunnel_watch.sh's default
+    # /tmp output dir (its usage line suggests /tmp/tunnel_watch)
+    patterns = [
+        os.path.join(
+            _REPO_ROOT, "tools", "sweep_results", "*", "COLLECTING.lock"
+        ),
+        "/tmp/tunnel_watch*/COLLECTING.lock",
+    ]
+    for lock in (p for pat in patterns for p in glob.glob(pat)):
+        try:
+            age = time.time() - os.path.getmtime(lock)
+        except OSError:
+            continue
+        if age < 3 * 3600:
+            print(
+                f"bench: chip collection in progress ({lock}, "
+                f"{int(age)}s old); yielding the tunnel and falling "
+                f"back to CPU",
+                file=sys.stderr,
+            )
+            return True
+    return False
+
+
 def _tpu_available() -> bool:
     """One generous kill-averse probe: device enumeration + a jitted
     op on a real accelerator platform (tools/probe_tpu.py prints one
     JSON line and returns on its own; the subprocess timeout is a
     last resort, not the schedule)."""
     if os.environ.get("BENCH_FORCE_CPU") == "1":
+        return False
+    if _collection_in_progress():
         return False
     proc = subprocess.Popen(
         [
@@ -309,6 +348,7 @@ def _chip_evidence() -> dict:
 
     def _freshest(pattern, want):
         best = None
+        best_key = None
         for path in glob.glob(os.path.join(base, "*", pattern)):
             try:
                 if os.path.getsize(path) == 0:
@@ -320,8 +360,13 @@ def _chip_evidence() -> dict:
             if not want(rec):
                 continue
             stamp, src = _stamp(path, rec)
-            if best is None or (stamp, path) > (best[0], best[1]):
-                best = (stamp, path, rec, src)
+            # payload-stamped records outrank mtime-stamped ones
+            # OUTRIGHT: after a clone, every unstamped artifact's
+            # mtime is checkout time, which would otherwise outrank a
+            # genuinely newer self-stamped record
+            key = (src == "payload", stamp, path)
+            if best_key is None or key > best_key:
+                best, best_key = (stamp, path, rec, src), key
         return best
 
     evidence: dict = {}

@@ -179,7 +179,14 @@ class LogisticRegressionClassifier(_LinearClassifier):
     @staticmethod
     def _to_margin_threshold(saved: float) -> float:
         # LogisticRegressionModel stores a PROBABILITY threshold;
-        # sigmoid(margin) > p  <=>  margin > logit(p)
+        # sigmoid(margin) > p  <=>  margin > logit(p). The legal
+        # extremes map to the constant classifiers they mean in
+        # MLlib: p=1 -> score>1 never (always 0), p=0 -> score>0
+        # always (always 1) — not a ZeroDivisionError.
+        if saved >= 1.0:
+            return float("inf")
+        if saved <= 0.0:
+            return float("-inf")
         return float(np.log(saved / (1.0 - saved)))
 
     def _sgd_config(self) -> sgd.SGDConfig:

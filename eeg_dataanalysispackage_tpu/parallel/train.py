@@ -85,15 +85,20 @@ def make_train_step(mesh=None, learning_rate: float = 0.05, momentum: float = 0.
 
 
 def make_feature_train_step(
-    mesh=None, learning_rate: float = 0.05, momentum: float = 0.9
+    mesh=None,
+    learning_rate: float = 0.05,
+    momentum: float = 0.9,
+    feature_dim: int = 48,
 ):
-    """(init_state, step) on precomputed (B, 48) features — the MLP
-    half of :func:`make_train_step`, for callers that produce
-    features by other fused paths (e.g. the raw-stream step below)."""
+    """(init_state, step) on precomputed (B, feature_dim) features —
+    the MLP half of :func:`make_train_step`, for callers that produce
+    features by other fused paths (e.g. the raw-stream step below).
+    ``feature_dim`` sizes the MLP input (default 48 = 3 channels x
+    16 DWT features)."""
     tx = optax.sgd(learning_rate, momentum=momentum, nesterov=True)
 
     def init_state(key):
-        params = init_mlp_params(key)
+        params = init_mlp_params(key, sizes=(feature_dim, 64, 2))
         if mesh is not None:
             params = jax.device_put(params, NamedSharding(mesh, P()))
         return {"params": params, "opt": tx.init(params)}
@@ -262,8 +267,12 @@ def make_irregular_bank_train_step(
         pre=pre,
     )
     bank_bf16 = mode == "bank128_bf16"
+    # the MLP input follows the bank geometry (review finding: a
+    # non-default feature_size produced (n, C*K) features against a
+    # fixed 48-input network)
     init_state, feat_step = make_feature_train_step(
-        mesh, learning_rate, momentum
+        mesh, learning_rate, momentum,
+        feature_dim=n_channels * feature_size,
     )
 
     @_partial(jax.jit, static_argnames=("interpret",))

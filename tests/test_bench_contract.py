@@ -1,0 +1,135 @@
+"""Driver-contract hardening in bench.py (no device work here).
+
+Pins the round-5 resilience pieces: the chip-evidence harvester that
+embeds dated silicon records in every bench line (VERDICT r4
+weakness 1 — three rounds of cpu_fallback BENCH artifacts while real
+chip numbers sat in sweep_results), its timestamp provenance rules
+(self-stamped payloads beat git-rewritten file mtimes), and the
+advisory collection lock that keeps a driver-launched bench from
+racing a staged chip collection for the tunnel (concurrent tunnel
+use is the documented wedge class — tools/tunnel_watch.sh)."""
+
+import importlib.util
+import json
+import os
+import time
+
+import pytest
+
+_spec = importlib.util.spec_from_file_location(
+    "bench",
+    os.path.join(os.path.dirname(os.path.dirname(__file__)), "bench.py"),
+)
+bench = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bench)
+
+
+@pytest.fixture
+def sweep_root(tmp_path, monkeypatch):
+    """A fake repo root with a sweep_results tree; bench reads
+    everything relative to _REPO_ROOT."""
+    root = tmp_path
+    (root / "tools" / "sweep_results").mkdir(parents=True)
+    monkeypatch.setattr(bench, "_REPO_ROOT", str(root))
+    return root / "tools" / "sweep_results"
+
+
+def _write(p, payload):
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(json.dumps(payload) + "\n")
+
+
+def test_chip_evidence_empty_without_artifacts(sweep_root):
+    assert bench._chip_evidence() == {}
+
+
+def test_chip_evidence_prefers_payload_timestamp(sweep_root):
+    """A self-stamped artifact wins over a later-mtime unstamped one:
+    git checkouts rewrite mtimes, payload stamps survive."""
+    _write(
+        sweep_root / "r4" / "bench_full.json",
+        {"value": 1.0, "unit": "epochs/s", "variants": {}},
+    )
+    # the payload stamp is OLDER than the unstamped file's mtime —
+    # exactly the fresh-clone case (checkout rewrote the r4 mtime to
+    # "now"); the self-stamped record must still win outright
+    _write(
+        sweep_root / "r5" / "bench_early.json",
+        {
+            "value": 2.0,
+            "unit": "epochs/s",
+            "variants": {"einsum": {"epochs_per_s": 2.0}},
+            "recorded_utc": "2020-01-01T00:00:00Z",
+        },
+    )
+    late = time.time() + 60
+    os.utime(sweep_root / "r4" / "bench_full.json", (late, late))
+    ev = bench._chip_evidence()
+    assert ev["bench"]["value"] == 2.0
+    assert ev["bench"]["timestamp_source"] == "payload"
+    assert ev["bench"]["recorded_utc"] == "2020-01-01T00:00:00Z"
+    assert ev["bench"]["variants_epochs_per_s"] == {"einsum": 2.0}
+
+
+def test_chip_evidence_skips_cpu_fallback_and_empty(sweep_root):
+    _write(
+        sweep_root / "r4" / "bench_full.json",
+        {"value": 3.0, "platform": "cpu_fallback"},
+    )
+    (sweep_root / "r4" / "bench_other.json").write_text("")
+    assert "bench" not in bench._chip_evidence()
+
+
+def test_chip_evidence_ties_break_deterministically(sweep_root):
+    """Equal stamps (post-clone mtimes) resolve by path order — the
+    later round directory wins, regardless of glob order."""
+    for rnd, v in (("r2", 1.0), ("r4b", 2.0), ("r4", 3.0)):
+        _write(sweep_root / rnd / "bench_full.json", {"value": v, "variants": {}})
+        t = 1700000000
+        os.utime(sweep_root / rnd / "bench_full.json", (t, t))
+    assert bench._chip_evidence()["bench"]["value"] == 2.0  # r4b
+
+
+def test_parity_evidence_requires_tpu_platform(sweep_root):
+    _write(sweep_root / "r4" / "parity.json", {"platform": "cpu"})
+    assert "parity" not in bench._chip_evidence()
+    _write(
+        sweep_root / "r4" / "parity.json",
+        {"platform": "tpu", "epoch_sum_bit_exact": True},
+    )
+    assert bench._chip_evidence()["parity"]["epoch_sum_bit_exact"] is True
+
+
+def test_collection_lock_yields_the_tunnel(sweep_root, monkeypatch):
+    monkeypatch.delenv("BENCH_IGNORE_COLLECT_LOCK", raising=False)
+    assert not bench._collection_in_progress()
+    lock = sweep_root / "r5" / "COLLECTING.lock"
+    lock.parent.mkdir(parents=True, exist_ok=True)
+    lock.write_text("")
+    assert bench._collection_in_progress()
+    # the collection's own bench invocations opt out
+    monkeypatch.setenv("BENCH_IGNORE_COLLECT_LOCK", "1")
+    assert not bench._collection_in_progress()
+    # stale locks (crashed collection) do not block forever
+    monkeypatch.delenv("BENCH_IGNORE_COLLECT_LOCK", raising=False)
+    old = time.time() - 4 * 3600
+    os.utime(lock, (old, old))
+    assert not bench._collection_in_progress()
+
+
+def test_probe_respects_lock_before_touching_the_tunnel(
+    sweep_root, monkeypatch
+):
+    """_tpu_available must short-circuit on the lock without spawning
+    the probe subprocess (the probe itself dials the tunnel)."""
+    lock = sweep_root / "r5" / "COLLECTING.lock"
+    lock.parent.mkdir(parents=True, exist_ok=True)
+    lock.write_text("")
+    monkeypatch.delenv("BENCH_IGNORE_COLLECT_LOCK", raising=False)
+    monkeypatch.delenv("BENCH_FORCE_CPU", raising=False)
+
+    def boom(*a, **k):  # pragma: no cover - the assertion
+        raise AssertionError("probe subprocess launched under lock")
+
+    monkeypatch.setattr(bench.subprocess, "Popen", boom)
+    assert bench._tpu_available() is False

@@ -334,6 +334,24 @@ def test_fit_after_import_replaces_the_imported_model(tmp_path):
     assert not np.all(tclf.predict(X) == 1.0)  # not the imported stump
 
 
+def test_logreg_threshold_extremes_import_as_constant_classifiers(
+    tmp_path,
+):
+    """setThreshold(1.0)/(0.0) are legal MLlib states meaning
+    always-0 / always-1; they must import, not ZeroDivisionError
+    (review finding)."""
+    w = RNG.randn(48)
+    X = _features()
+    for thr, const in ((1.0, 0.0), (0.0, 1.0)):
+        d = str(tmp_path / f"t{thr}")
+        mf.write_glm(d, mf.GLM_LOGREG, w, threshold=thr)
+        clf = LogisticRegressionClassifier()
+        clf.load(d)
+        np.testing.assert_array_equal(
+            clf.predict(X), np.full(len(X), const)
+        )
+
+
 def test_multiclass_models_refused(tmp_path):
     """Binary-only consumers refuse multiclass artifacts instead of
     silently collapsing labels (review finding)."""

@@ -358,6 +358,26 @@ class TestIrregularTrainStep:
         for k in state_k2["params"]:
             assert np.all(np.isfinite(np.asarray(state_k2["params"][k])))
 
+    def test_bank_step_nondefault_feature_size_sizes_the_mlp(self):
+        """A non-default feature_size must size the MLP input to
+        C*feature_size (review finding: the geometry knob crashed at
+        the first step against the fixed 48-input network)."""
+        from eeg_dataanalysispackage_tpu.parallel import train as ptrain
+
+        raw, res, pos, mask, labels = self._case()
+        n = int(mask.sum())
+        positions = np.asarray(pos)[:n]
+        init_k, step_k = ptrain.make_irregular_bank_train_step(
+            positions, feature_size=8
+        )
+        state = init_k(jax.random.PRNGKey(0))
+        assert state["params"]["w0"].shape[0] == 3 * 8
+        _, loss = step_k(
+            state, jnp.asarray(raw), jnp.asarray(res),
+            jnp.asarray(labels[:n]),
+        )
+        assert np.isfinite(float(loss))
+
     def test_masked_rows_do_not_affect_the_update(self):
         from eeg_dataanalysispackage_tpu.parallel import train as ptrain
 
