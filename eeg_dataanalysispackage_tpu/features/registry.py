@@ -25,12 +25,15 @@ def register(name: str, factory: Callable[[], base.FeatureExtraction]) -> None:
 def create(name: str) -> base.FeatureExtraction:
     if name in _REGISTRY:
         return _REGISTRY[name]()
-    m = re.fullmatch(r"dwt-(\d+)(-tpu-bf16|-tpu|-pallas)?", name)
+    m = re.fullmatch(
+        r"dwt-(\d+)(-tpu-bf16|-tpu-compact|-tpu|-pallas)?", name
+    )
     if m:
         backend = {
             None: "host",
             "-tpu": "xla",
             "-tpu-bf16": "xla-bf16",
+            "-tpu-compact": "xla-compact",
             "-pallas": "pallas",
         }[m.group(2)]
         return wavelet.WaveletTransform(name=int(m.group(1)), backend=backend)
@@ -44,4 +47,10 @@ register(
 register(
     "dwt-8-pallas",
     lambda: wavelet.WaveletTransform(8, 512, 175, 16, backend="pallas"),
+)
+register(
+    "dwt-8-tpu-compact",
+    lambda: wavelet.WaveletTransform(
+        8, 512, 175, 16, backend="xla-compact"
+    ),
 )

@@ -19,6 +19,13 @@ Two backends:
   absolute feature deviation; classification results on the
   reference fixture are unchanged (pinned by test). Use when
   throughput matters more than f32-level feature parity.
+- ``backend='xla-compact'`` — compact-resident variant
+  (``fe=dwt-8-tpu-compact``): the analysis window is sliced on the
+  host, so the device-resident batch is (B, C, epoch_size) — honest
+  6144 B/epoch instead of carrying the 488 dead columns the
+  full-width layout reads to use 512 (WaveletTransform.java:127-130
+  consumes only the window). Same math as 'xla' to float rounding
+  of an identical contraction.
 """
 
 from __future__ import annotations
@@ -110,6 +117,26 @@ class WaveletTransform(base.FeatureExtraction):
                 f"skip_samples ({self.skip_samples}) + epoch_size "
                 f"({self.epoch_size}) exceeds the epoch length ({n_samples})"
             )
+        if self.backend == "xla-compact":
+            from ..ops import dwt as dwt_xla
+
+            if self._jit_cache is None:
+                self._jit_cache = dwt_xla.make_compact_extractor(
+                    wavelet_index=self.name,
+                    epoch_size=self.epoch_size,
+                    feature_size=self.feature_size,
+                )
+            x = np.asarray(epochs, np.float32)
+            ch_idx = [c - 1 for c in self.channels]
+            if ch_idx != list(range(x.shape[1])):
+                x = x[:, ch_idx, :]
+            # slice on the HOST: the device-resident buffer (and the
+            # transfer) must be the compact window, or the layout's
+            # whole point — fewer true bytes — is lost
+            x = np.ascontiguousarray(
+                x[:, :, self.skip_samples : self.skip_samples + self.epoch_size]
+            )
+            return np.asarray(self._jit_cache(x), dtype=np.float32)
         if self.backend in ("xla", "xla-bf16"):
             import jax.numpy as jnp
 

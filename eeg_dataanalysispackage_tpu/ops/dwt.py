@@ -132,6 +132,46 @@ def epoch_features(
     return safe_l2_normalize(coeffs.reshape(B, C * feature_size))
 
 
+def make_compact_extractor(
+    wavelet_index: int = 8,
+    epoch_size: int = 512,
+    feature_size: int = 16,
+    dtype=jnp.float32,
+):
+    """Jitted ``(B, C, epoch_size) -> (B, C*feature_size)`` extractor
+    over COMPACT-RESIDENT epochs (the analysis window only, no dead
+    columns).
+
+    The full-width headline path (:func:`epoch_features`) embeds the
+    [skip, skip+size) slice into the operator and reads all T=1000
+    resident columns to consume 512 of them
+    (WaveletTransform.java:127-130 — only the window is ever used).
+    Storing epochs pre-sliced halves true HBM bytes/epoch (12000 ->
+    6144 f32); this is the ``fe=dwt-8-tpu-compact`` backend and the
+    library home of the bench's ``einsum_512`` variant, armed as the
+    honest-bytes headline candidate (VERDICT r4 weakness 7 /
+    docs/chip_playbook.md einsum_512 row).
+    """
+    cascade_matrix(wavelet_index, epoch_size, feature_size)  # warm cache
+
+    @jax.jit
+    def extract(epochs: jnp.ndarray) -> jnp.ndarray:
+        ep = jnp.asarray(epochs, dtype=dtype)
+        B, C, n = ep.shape
+        if n != epoch_size:
+            # windowed_features sizes its cascade from the input, so a
+            # mis-sliced batch would silently get a different-depth
+            # transform; fail loudly instead
+            raise ValueError(
+                f"compact extractor built for epoch_size {epoch_size}; "
+                f"got windowed batch of width {n}"
+            )
+        coeffs = windowed_features(ep, wavelet_index, feature_size)
+        return safe_l2_normalize(coeffs.reshape(B, C * feature_size))
+
+    return extract
+
+
 def make_batched_extractor(
     wavelet_index: int = 8,
     epoch_size: int = 512,

@@ -32,6 +32,35 @@ def test_golden_feature_sum_bitwise(fixture_epochs):
     assert java_feature_sum(feats) == -24.861844096031625
 
 
+def test_compact_backend_matches_host(fixture_epochs):
+    """fe=dwt-8-tpu-compact (host-sliced (B, C, 512) residency,
+    honest 6144 B/epoch — the einsum_512 headline candidate) must
+    match the host features to the f32 contraction envelope, and the
+    full-width xla backend to near-identity (identical math, only
+    the 488 zero-row columns removed)."""
+    host = registry.create("dwt-8").extract_batch(fixture_epochs.epochs)
+    compact = registry.create("dwt-8-tpu-compact").extract_batch(
+        fixture_epochs.epochs
+    )
+    assert compact.shape == (11, 48)
+    np.testing.assert_allclose(compact, host, rtol=0, atol=5e-6)
+    xla = registry.create("dwt-8-tpu").extract_batch(fixture_epochs.epochs)
+    np.testing.assert_allclose(compact, xla, rtol=0, atol=1e-6)
+
+
+def test_compact_backend_respects_geometry_setters(fixture_epochs):
+    from eeg_dataanalysispackage_tpu.features import wavelet
+
+    host = wavelet.WaveletTransform(
+        8, 256, 100, 8, backend="host"
+    ).extract_batch(fixture_epochs.epochs)
+    compact = wavelet.WaveletTransform(
+        8, 256, 100, 8, backend="xla-compact"
+    ).extract_batch(fixture_epochs.epochs)
+    assert compact.shape == host.shape == (11, 24)
+    np.testing.assert_allclose(compact, host, rtol=0, atol=5e-6)
+
+
 def test_xla_backend_matches_host(fixture_epochs):
     host = registry.create("dwt-8").extract_batch(fixture_epochs.epochs)
     xla = registry.create("dwt-8-tpu").extract_batch(fixture_epochs.epochs)
