@@ -47,6 +47,10 @@ run einsum_sliced 600 python tools/ingest_bench.py einsum_sliced 262144 50
 # compact-resident epochs (B, C, 512) at honest 6144 B/epoch - the
 # feature-only storage layout's headline
 run einsum_512 600 python tools/ingest_bench.py einsum_512 262144 50
+# compact x bf16 compound (3072 B/epoch): if both effects hold at the
+# 524k dispatch-amortized batch, this is the absolute headline
+# candidate (~180M eps at the bf16 twin's 69.8% roofline)
+run einsum_512_bf16 600 python tools/ingest_bench.py einsum_512_bf16 524288 50
 BENCH_PALLAS_MODE=bank128 run bank128_131k 1800 \
   python tools/ingest_bench.py pallas_ingest 131072 20
 run rf_predict_retry 900 python tools/ingest_bench.py rf_predict 262144 10

@@ -19,6 +19,8 @@ Variants:
                   to the live [skip, skip+size) columns + the same
                   einsum — reads 51% of the headline's bytes IF XLA
                   fuses the subrange read into the dot
+  einsum_512_bf16 the compact layout in bf16 residency (3072
+                  B/epoch) — compact x bf16 compound headline candidate
   einsum_512      epochs resident as (B, C, 512) — the compact
                   feature-only layout — at the honest 6144 B/epoch
   einsum_bf16_flat  bf16-resident epochs in the channel-flat (B, C*T)
@@ -150,7 +152,8 @@ def run(variant: str, n: int, iters: int) -> dict:
 
     if variant in (
         "einsum", "einsum_2d", "einsum_bf16", "einsum_flat",
-        "einsum_bf16_flat", "einsum_sliced", "einsum_512", "pallas_dwt",
+        "einsum_bf16_flat", "einsum_sliced", "einsum_512",
+        "einsum_512_bf16", "pallas_dwt",
     ):
         from eeg_dataanalysispackage_tpu.ops import dwt as dwt_xla
 
@@ -173,7 +176,7 @@ def run(variant: str, n: int, iters: int) -> dict:
 
         if variant == "einsum":
             extract = dwt_xla.make_batched_extractor()
-        elif variant in ("einsum_sliced", "einsum_512"):
+        elif variant in ("einsum_sliced", "einsum_512", "einsum_512_bf16"):
             # einsum_sliced: rank-preserving slice + same einsum over
             # the FULL (B, C, 1000) resident array — the operator's
             # rows outside [skip, skip+size) are zero, so the
@@ -200,7 +203,10 @@ def run(variant: str, n: int, iters: int) -> dict:
                     else x
                 )
                 y = jnp.einsum(
-                    "bct,tk->bck", z, kern,
+                    # operator follows the stream dtype (the
+                    # epoch_features twin-parity rule): bf16 x bf16
+                    # for the bf16-resident variant, f32 otherwise
+                    "bct,tk->bck", z, kern.astype(z.dtype),
                     precision=jax.lax.Precision.HIGHEST,
                 )
                 return dwt_xla.safe_l2_normalize(
@@ -267,7 +273,7 @@ def run(variant: str, n: int, iters: int) -> dict:
 
         if variant in ("einsum_flat", "einsum_bf16_flat"):
             shape = (n, 3 * 1000)
-        elif variant == "einsum_512":
+        elif variant in ("einsum_512", "einsum_512_bf16"):
             shape = (n, 3, esize)
         else:
             shape = (n, 3, 1000)
@@ -279,12 +285,15 @@ def run(variant: str, n: int, iters: int) -> dict:
             # array in memory is bf16, not merely cast inside the jit
             epochs = epochs.astype(jnp.bfloat16)
             bytes_per_epoch = 3 * 1000 * 2
+        elif variant == "einsum_512_bf16":
+            epochs = epochs.astype(jnp.bfloat16)
+            bytes_per_epoch = 3 * esize * 2
         elif variant == "einsum_512":
             bytes_per_epoch = 3 * esize * 4
         else:
             bytes_per_epoch = 3 * 1000 * 4
 
-        if variant in ("einsum_sliced", "einsum_512"):
+        if variant in ("einsum_sliced", "einsum_512", "einsum_512_bf16"):
             # perturb the SMALL operator, not the stream: an x + i
             # perturbation would materialize a full-width copy per
             # iteration and confound the byte-traffic A/B these
