@@ -415,6 +415,26 @@ def write_tree_ensemble(
     path = strip_file_prefix(path)
     if tree_weights is None:
         tree_weights = [1.0] * len(trees)
+
+    # DFS-reachable node order per tree, computed up front: the
+    # emitted rows AND the metadata numNodes must agree. Device-grown
+    # heaps carry unreachable padded slots (trees_device
+    # .heap_to_host_arrays fixed-size arrays), and Spark 1.6's
+    # DecisionTreeModel.load asserts reconstructed count ==
+    # metadata numNodes — counting array length would make the
+    # exported directory unloadable there.
+    orders: List[List[int]] = []
+    for tree in trees:
+        order: List[int] = []
+        stack = [0]
+        while stack:
+            i = stack.pop()
+            order.append(i)
+            if not tree["leaf"][i]:
+                stack.append(int(tree["right"][i]))
+                stack.append(int(tree["left"][i]))
+        orders.append(order)
+
     if model_class == TREE_DT:
         if len(trees) != 1:
             raise ValueError("DecisionTreeModel holds exactly one tree")
@@ -422,7 +442,7 @@ def write_tree_ensemble(
             "class": model_class,
             "version": _FORMAT_VERSION,
             "algo": algo,
-            "numNodes": int(len(trees[0]["leaf"])),
+            "numNodes": len(orders[0]),
         }
     elif model_class in (TREE_RF, TREE_GBT):
         meta = {
@@ -448,14 +468,7 @@ def write_tree_ensemble(
     for tid, tree in enumerate(trees):
         # depth-first renumbering from 1 (ids are explicit links, any
         # injective assignment round-trips)
-        order: List[int] = []
-        stack = [0]
-        while stack:
-            i = stack.pop()
-            order.append(i)
-            if not tree["leaf"][i]:
-                stack.append(int(tree["right"][i]))
-                stack.append(int(tree["left"][i]))
+        order = orders[tid]
         ids = {i: k + 1 for k, i in enumerate(order)}
         for i in order:
             leaf = bool(tree["leaf"][i])

@@ -132,6 +132,29 @@ class _LinearClassifier(base.Classifier):
             else 0.0
         )
 
+    def export_mllib_dir(self, path: str) -> None:
+        """Write this model as a Spark-1.6 MLlib model directory —
+        the reverse migration (the artifact
+        ``LogisticRegressionModel.load`` / ``SVMModel.load``
+        consumes, LogisticRegressionClassifier.java:150-152).
+        Weights widen f32 -> f64 exactly; the margin threshold maps
+        back to the class's saved-threshold convention."""
+        from ..io import mllib_format
+
+        if self.weights is None:
+            raise ValueError("model not trained or loaded")
+        mllib_format.write_glm(
+            path,
+            self._mllib_class,
+            np.asarray(self.weights, dtype=np.float64),
+            intercept=self.intercept,
+            threshold=self._from_margin_threshold(self.margin_threshold),
+        )
+
+    @staticmethod
+    def _from_margin_threshold(margin: float) -> float:
+        raise NotImplementedError
+
     def _load_mllib_dir(self, path: str) -> None:
         """Adopt a reference-deployment MLlib model directory
         (LogisticRegressionClassifier.java:150-152 loads the same
@@ -189,6 +212,12 @@ class LogisticRegressionClassifier(_LinearClassifier):
             return float("-inf")
         return float(np.log(saved / (1.0 - saved)))
 
+    @staticmethod
+    def _from_margin_threshold(margin: float) -> float:
+        # inverse of _to_margin_threshold: sigmoid maps +/-inf to the
+        # constant-classifier probabilities 1.0 / 0.0
+        return float(1.0 / (1.0 + np.exp(-margin)))
+
     def _sgd_config(self) -> sgd.SGDConfig:
         c = self.config
         if all(k in c for k in self.required_keys):
@@ -224,6 +253,10 @@ class SVMClassifier(_LinearClassifier):
     def _to_margin_threshold(saved: float) -> float:
         # SVMModel's threshold IS a margin (SVMModel.predictPoint)
         return float(saved)
+
+    @staticmethod
+    def _from_margin_threshold(margin: float) -> float:
+        return float(margin)
 
     def _sgd_config(self) -> sgd.SGDConfig:
         c = self.config

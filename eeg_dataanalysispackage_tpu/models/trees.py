@@ -391,16 +391,77 @@ class DecisionTreeClassifier(base.Classifier):
     def _strip_prefix(path: str) -> str:
         return path[7:] if path.startswith("file://") else path
 
+    def export_mllib_dir(self, path: str) -> None:
+        """Write this model as a Spark-1.6 MLlib model directory
+        (io/mllib_format.py format 1.0) — the reverse migration: a
+        model trained here keeps serving on an existing Spark
+        deployment (the artifact ``DecisionTreeModel.load`` /
+        ``RandomForestModel.load`` consumes,
+        DecisionTreeClassifier.java:163-165).
+
+        The production trees store BINNED split thresholds; each maps
+        back to its real-valued bin edge exactly (``bin <= b`` in
+        ``bin_features``'s ``(lo, hi]`` semantics is ``value <=
+        edges[feature, b]`` — MLlib's own continuous-split
+        predicate), so the exported model predicts identically to
+        this one. An imported model re-exports as-is."""
+        from ..io import mllib_format as mf
+
+        if self._mllib is not None:
+            mf.write_tree_ensemble(
+                path,
+                self._mllib.model_class,
+                self._mllib.trees,
+                tree_weights=self._mllib.tree_weights,
+                algo=self._mllib.algo,
+                # preserved verbatim (re-export-as-is contract), in
+                # Spark's capitalized spelling
+                combining={
+                    "vote": "Vote", "sum": "Sum", "average": "Average"
+                }[self._mllib.combining],
+            )
+            return
+        if not self.trees or self.edges is None:
+            raise ValueError("model not trained or loaded")
+        trees = []
+        for t in self.trees:
+            feat = np.asarray(t["feature"])
+            leaf = feat < 0  # the growers' leaf marker
+            safe_feat = np.maximum(feat, 0)
+            thr_bin = np.clip(
+                np.asarray(t["threshold_bin"]), 0, self.edges.shape[1] - 1
+            )
+            k = len(feat)
+            trees.append(
+                {
+                    "feature": safe_feat,
+                    "threshold": np.where(
+                        leaf, np.inf, self.edges[safe_feat, thr_bin]
+                    ),
+                    "left": np.where(leaf, np.arange(k), t["left"]),
+                    "right": np.where(leaf, np.arange(k), t["right"]),
+                    "leaf": leaf,
+                    "predict": np.asarray(t["prediction"], np.float64),
+                }
+            )
+        mf.write_tree_ensemble(
+            path, self._mllib_class, trees,
+            tree_weights=self._export_tree_weights(len(trees)),
+        )
+
+    def _export_tree_weights(self, n_trees: int):
+        return [1.0] * n_trees
+
     def save(self, path: str) -> None:
         from ..io import modelfiles
 
         if self._mllib is not None:
             # re-exporting an imported directory is an explicit
-            # operation (io/mllib_format.write_tree_ensemble), not a
-            # silent format change under the native save path
+            # operation, not a silent format change under the native
+            # save path
             raise ValueError(
                 "this model was loaded from an MLlib model directory; "
-                "re-export it with io.mllib_format.write_tree_ensemble"
+                "re-export it with export_mllib_dir(path)"
             )
         path = self._strip_prefix(path)
         modelfiles.delete_local_dir_target(path)
@@ -603,6 +664,15 @@ class GradientBoostedTreesClassifier(DecisionTreeClassifier):
     _mllib_class = (
         "org.apache.spark.mllib.tree.model.GradientBoostedTreesModel"
     )
+
+    def _export_tree_weights(self, n_trees: int):
+        # our boosting applies the learning rate to EVERY round
+        # (F = sum lr * t_i, fit()); MLlib's Sum combining computes
+        # sum(w_i * t_i), so uniform lr weights reproduce F. The only
+        # semantic daylight vs this class's predict is the F == 0
+        # boundary (MLlib: > 0 -> 1; here: >= 0 -> 1).
+        lr = float(self._params.get("learning_rate", 0.1))
+        return [lr] * n_trees
 
     def _boost_params(self) -> Dict:
         c = self.config

@@ -238,11 +238,11 @@ def test_imported_tree_save_is_explicit(tmp_path):
     mf.write_tree_ensemble(d, mf.TREE_DT, [_manual_tree()])
     clf = DecisionTreeClassifier()
     clf.load(d)
-    with pytest.raises(ValueError, match="write_tree_ensemble"):
+    with pytest.raises(ValueError, match="export_mllib_dir"):
         clf.save(str(tmp_path / "native"))
     # explicit re-export round-trips
     d2 = str(tmp_path / "dt2")
-    mf.write_tree_ensemble(d2, clf._mllib.model_class, clf._mllib.trees)
+    clf.export_mllib_dir(d2)
     clf2 = DecisionTreeClassifier()
     clf2.load(d2)
     X = _features()
@@ -371,6 +371,152 @@ def test_multiclass_models_refused(tmp_path):
     d3 = str(tmp_path / "gbt_margin")
     mf.write_tree_ensemble(d3, mf.TREE_GBT, [t])
     assert mf.read_tree_ensemble(d3).combining == "sum"
+
+
+def test_export_mllib_dir_glm_round_trip(tmp_path):
+    """Reverse migration: a natively-trained GLM exports to a
+    format-1.0 directory that loads back bit-equivalently."""
+    X = _features(128).astype(np.float64)
+    y = (X[:, 0] > 0).astype(np.float64)
+    clf = LogisticRegressionClassifier()
+    clf.set_config({})
+    clf.fit(X, y)
+    d = str(tmp_path / "exported")
+    clf.export_mllib_dir(d)
+    m = mf.read_glm(d)
+    assert m.model_class == mf.GLM_LOGREG
+    np.testing.assert_array_equal(
+        m.weights, np.asarray(clf.weights, np.float64)
+    )
+    assert m.threshold == 0.5  # margin 0 -> probability 0.5
+    clf2 = LogisticRegressionClassifier()
+    clf2.load(d)
+    np.testing.assert_array_equal(clf2.predict(X), clf.predict(X))
+
+
+def test_export_mllib_dir_trees_predict_identically(tmp_path):
+    """DT/RF/GBT export maps binned splits back to real bin edges;
+    the exported model must predict identically on fresh data (the
+    (lo, hi] bin semantics make the mapping exact)."""
+    X = _features(256)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    X_test = _features(512)
+    cases = [
+        (DecisionTreeClassifier, {
+            "config_max_depth": "4", "config_max_bins": "16",
+            "config_min_instances_per_node": "1",
+            "config_impurity": "gini",
+        }),
+        (RandomForestClassifier, {
+            "config_max_depth": "4", "config_max_bins": "16",
+            "config_min_instances_per_node": "1",
+            "config_impurity": "gini", "config_num_trees": "7",
+            "config_feature_subset": "sqrt",
+        }),
+        (GradientBoostedTreesClassifier, {
+            "config_num_iterations": "12",
+            "config_learning_rate": "0.2", "config_max_depth": "3",
+        }),
+    ]
+    for cls, config in cases:
+        clf = cls()
+        clf.set_config(config)
+        clf.fit(X, y)
+        d = str(tmp_path / cls.__name__)
+        clf.export_mllib_dir(d)
+        loaded = cls()
+        loaded.load(d)
+        assert loaded._mllib.model_class == cls._mllib_class
+        np.testing.assert_array_equal(
+            loaded.predict(X_test),
+            clf.predict(X_test),
+            err_msg=cls.__name__,
+        )
+
+
+def test_export_counts_only_reachable_nodes(tmp_path):
+    """Device-grown heap trees carry unreachable padded slots
+    (fixed-size arrays, feature = -1); metadata numNodes must count
+    the DFS-reachable nodes Spark will reconstruct, or its load-time
+    assert rejects the directory (review finding)."""
+    # stump + 4 unreachable heap-padding slots
+    padded = {
+        "feature": np.array([3, 0, 0, 0, 0, 0, 0]),
+        "threshold": np.array([0.0] + [np.inf] * 6),
+        "left": np.array([1, 1, 2, 3, 4, 5, 6]),
+        "right": np.array([2, 1, 2, 3, 4, 5, 6]),
+        "leaf": np.array([False, True, True, True, True, True, True]),
+        "predict": np.array([0.0, 0.0, 1.0, 0.0, 0.0, 0.0, 0.0]),
+    }
+    d = str(tmp_path / "dt")
+    mf.write_tree_ensemble(d, mf.TREE_DT, [padded])
+    assert mf.read_metadata(d)["numNodes"] == 3
+    ens = mf.read_tree_ensemble(d)
+    assert len(ens.trees[0]["leaf"]) == 3
+    X = _features()
+    want = (X[:, 3] > 0.0).astype(np.float64)
+    np.testing.assert_array_equal(ens.predict(X), want)
+
+
+def test_device_backend_export_round_trips(tmp_path):
+    """The rf-tpu whole-forest grower's heap arrays (the other
+    producer of padded slots) export and load back with identical
+    predictions."""
+    X = _features(256)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    clf = RandomForestClassifier(backend="device")
+    clf.set_config(
+        {
+            "config_max_depth": "4", "config_max_bins": "16",
+            "config_min_instances_per_node": "1",
+            "config_impurity": "gini", "config_num_trees": "5",
+            "config_feature_subset": "sqrt",
+        }
+    )
+    clf.fit(X, y)
+    d = str(tmp_path / "rf_dev")
+    clf.export_mllib_dir(d)
+    X_test = _features(512)
+    loaded = RandomForestClassifier()
+    loaded.load(d)
+    np.testing.assert_array_equal(
+        loaded.predict(X_test), clf.predict(X_test)
+    )
+
+
+def test_reexport_preserves_combining(tmp_path):
+    """'An imported model re-exports as-is' includes the combining
+    strategy (review finding: Average was silently rewritten)."""
+    t = _manual_tree()
+    t["predict"] = np.array([0.0, -1.0, 0.0, 2.0, -1.0])
+    d = str(tmp_path / "avg")
+    mf.write_tree_ensemble(
+        d, mf.TREE_RF, [t, t], combining="Average"
+    )
+    clf = RandomForestClassifier()
+    clf.load(d)
+    assert clf._mllib.combining == "average"
+    d2 = str(tmp_path / "re")
+    clf.export_mllib_dir(d2)
+    meta = mf.read_metadata(d2)
+    assert meta["metadata"]["combiningStrategy"] == "Average"
+    X = _features()
+    np.testing.assert_array_equal(
+        mf.read_tree_ensemble(d2).predict(X), clf._mllib.predict(X)
+    )
+
+
+def test_export_of_imported_model_is_stable(tmp_path):
+    d = str(tmp_path / "src")
+    mf.write_tree_ensemble(d, mf.TREE_DT, [_manual_tree()])
+    clf = DecisionTreeClassifier()
+    clf.load(d)
+    d2 = str(tmp_path / "re")
+    clf.export_mllib_dir(d2)
+    X = _features()
+    clf2 = DecisionTreeClassifier()
+    clf2.load(d2)
+    np.testing.assert_array_equal(clf2.predict(X), clf.predict(X))
 
 
 def test_pipeline_load_clf_from_mllib_dir(tmp_path, fixture_dir):
