@@ -199,12 +199,29 @@ def write_glm(
 ) -> None:
     """Write a format-1.0 GLM model directory a Spark 1.6 cluster (or
     :func:`read_glm`) can load. Also the fixture generator for the
-    import tests."""
+    import tests. ``path`` may be a remote URI (built locally, then
+    uploaded file-by-file through io/modelfiles)."""
+    materialize_model_dir(
+        path,
+        lambda local: _write_glm_local(
+            local, model_class, weights, intercept, threshold,
+            num_classes,
+        ),
+    )
+
+
+def _write_glm_local(
+    path: str,
+    model_class: str,
+    weights: np.ndarray,
+    intercept: float,
+    threshold: Optional[float],
+    num_classes: int,
+) -> None:
     import pyarrow as pa
 
     pq = _pq()
     weights = np.asarray(weights, dtype=np.float64)
-    path = strip_file_prefix(path)
     _write_metadata(
         path,
         {
@@ -408,11 +425,28 @@ def write_tree_ensemble(
     form (:class:`MLlibTreeEnsemble` layout). NodeIds use MLlib's
     heap convention (root 1, children ``2n``/``2n+1``-free explicit
     links are what the reader consumes, so any injective id works;
-    the writer emits depth-first ids starting at 1)."""
+    the writer emits depth-first ids starting at 1). ``path`` may be
+    a remote URI (built locally, then uploaded through
+    io/modelfiles)."""
+    materialize_model_dir(
+        path,
+        lambda local: _write_tree_ensemble_local(
+            local, model_class, trees, tree_weights, algo, combining
+        ),
+    )
+
+
+def _write_tree_ensemble_local(
+    path: str,
+    model_class: str,
+    trees: Sequence[Dict[str, np.ndarray]],
+    tree_weights: Optional[Sequence[float]],
+    algo: str,
+    combining: Optional[str],
+) -> None:
     import pyarrow as pa
 
     pq = _pq()
-    path = strip_file_prefix(path)
     if tree_weights is None:
         tree_weights = [1.0] * len(trees)
 
@@ -529,6 +563,43 @@ def write_tree_ensemble(
 
 
 # ------------------------------------------------------------ helpers
+
+
+def materialize_model_dir(path: str, build_fn) -> None:
+    """Run ``build_fn(local_dir)`` and land the resulting model
+    directory at ``path`` — directly for local paths, or by building
+    in a temp dir and uploading every file through the pluggable
+    filesystem for remote URIs (``hdfs://``/``gs://``/``http(s)://``
+    — the reference's models-on-HDFS flow,
+    LogisticRegressionClassifier.java:144-152 saving to the
+    Const.java namenode). Without this, a remote ``save_name`` would
+    silently become a junk relative local directory (review
+    finding)."""
+    import shutil
+    import tempfile
+
+    from . import modelfiles
+
+    if modelfiles._is_local(path):
+        build_fn(strip_file_prefix(path))
+        return
+    tmp = tempfile.mkdtemp(prefix="mllib_export_")
+    try:
+        build_fn(tmp)
+        for root, _dirs, files in os.walk(tmp):
+            rel_root = os.path.relpath(root, tmp)
+            for name in files:
+                rel = (
+                    name
+                    if rel_root == "."
+                    else f"{rel_root}/{name}"
+                )
+                with open(os.path.join(root, name), "rb") as f:
+                    modelfiles.write_model_bytes(
+                        path.rstrip("/") + "/" + rel, f.read()
+                    )
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def _write_metadata(path: str, meta: dict) -> None:

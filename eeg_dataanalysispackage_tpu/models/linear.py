@@ -89,6 +89,14 @@ class _LinearClassifier(base.Classifier):
         # first (LogisticRegressionClassifier.java:144-147)
         from ..io import modelfiles
 
+        if self.config.get("config_model_format") == "mllib":
+            # query-level reverse migration: save_clf=true&
+            # config_model_format=mllib writes the Spark-loadable
+            # model directory instead of the native npz
+            modelfiles.delete_local_dir_target(path)
+            self.export_mllib_dir(path)
+            return
+
         modelfiles.delete_local_dir_target(path)
         buf = io.BytesIO()
         np.savez(

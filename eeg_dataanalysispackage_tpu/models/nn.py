@@ -333,6 +333,19 @@ class NeuralNetworkClassifier(base.Classifier):
         self.params = None
         self._arch: Dict | None = None
 
+    def set_config(self, config) -> None:
+        # fail at CONFIG time, not after a full training run: the
+        # pipeline sets config, fits (potentially hours), then saves
+        # — save-time rejection would waste the training (review
+        # finding). See save() for why mllib output is impossible.
+        if dict(config).get("config_model_format") == "mllib":
+            raise NotImplementedError(
+                "config_model_format=mllib is not available for nn: "
+                "DL4J ModelSerializer zips wrap closed ND4J "
+                "serialization (docs/MIGRATION.md)"
+            )
+        super().set_config(config)
+
     # -- config parsing ------------------------------------------------
 
     def _parse_layers(self) -> tuple:
@@ -517,6 +530,17 @@ class NeuralNetworkClassifier(base.Classifier):
 
         from ..io import modelfiles
 
+        if self.config.get("config_model_format") == "mllib":
+            # the GLM/tree classifiers honor this key
+            # (io/mllib_format.py); the NN's JVM twin is a DL4J
+            # ModelSerializer zip around closed ND4J array
+            # serialization — refuse loudly rather than write npz
+            # under a name the user asked to be Spark-loadable
+            raise NotImplementedError(
+                "config_model_format=mllib is not available for nn: "
+                "DL4J ModelSerializer zips wrap closed ND4J "
+                "serialization (docs/MIGRATION.md)"
+            )
         blob = serialization.to_bytes(self.params)
         header = json.dumps({"arch": self._arch, "config": self.config})
         data = (

@@ -455,6 +455,16 @@ class DecisionTreeClassifier(base.Classifier):
     def save(self, path: str) -> None:
         from ..io import modelfiles
 
+        path = self._strip_prefix(path)
+        if self.config.get("config_model_format") == "mllib":
+            # query-level reverse migration (see linear.py save) —
+            # checked BEFORE the imported-model guard: with the
+            # explicit format key, re-saving an imported directory is
+            # exactly what the user asked for (export_mllib_dir
+            # handles the imported case verbatim)
+            modelfiles.delete_local_dir_target(path)
+            self.export_mllib_dir(path)
+            return
         if self._mllib is not None:
             # re-exporting an imported directory is an explicit
             # operation, not a silent format change under the native
@@ -463,7 +473,6 @@ class DecisionTreeClassifier(base.Classifier):
                 "this model was loaded from an MLlib model directory; "
                 "re-export it with export_mllib_dir(path)"
             )
-        path = self._strip_prefix(path)
         modelfiles.delete_local_dir_target(path)
         payload = {
             "kind": self.__class__.__name__,
