@@ -53,7 +53,6 @@ from __future__ import annotations
 
 import json
 import os
-import uuid
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence
 
@@ -88,15 +87,82 @@ def strip_file_prefix(path: str) -> str:
     return path[7:] if path.startswith("file://") else path
 
 
+def _is_remote(path: str) -> bool:
+    """Remoteness from the SAME dispatch that will serve the IO
+    (io/modelfiles -> remote.filesystem_for): an unregistered scheme
+    falls through to the local filesystem there, so it must count as
+    local here too or writer and reader route one URI differently
+    (review finding)."""
+    from . import modelfiles
+
+    return not modelfiles._is_local(path)
+
+
+def _remote_fs(path: str):
+    from . import modelfiles
+
+    return modelfiles._fs_for(path)
+
+
 def is_model_dir(path: str) -> bool:
     """True iff ``path`` looks like an MLlib model directory (has the
     ``metadata/`` part files). The classifiers use this to route
-    ``load()`` between their native npz and this importer."""
+    ``load()`` between their native npz and this importer. Remote
+    URIs are probed through the pluggable filesystem when it can
+    list directories (``hdfs://`` — both drivers); listing-less
+    schemes (plain http, the gs ranged-read adapter) return False
+    and fall through to the byte-level npz path."""
+    if _is_remote(path):
+        fs = _remote_fs(path)
+        if not hasattr(fs, "list_dir"):
+            return False
+        from .remote import RemoteIOError
+
+        try:
+            return any(
+                name.startswith("part-")
+                for name in fs.list_dir(path.rstrip("/") + "/metadata")
+            )
+        except (FileNotFoundError, OSError, RemoteIOError, ValueError):
+            return False
     path = strip_file_prefix(path)
     meta = os.path.join(path, "metadata")
     return os.path.isdir(meta) and any(
         name.startswith("part-") for name in os.listdir(meta)
     )
+
+
+def _ensure_local(path: str):
+    """(local_dir, cleanup_fn): identity for local paths; for remote
+    URIs, download the model directory's metadata/ and data/ entries
+    into a temp dir (the reference's load-models-from-HDFS flow,
+    DecisionTreeClassifier.java:163-165 against the Const.java
+    namenode)."""
+    if not _is_remote(path):
+        return strip_file_prefix(path), (lambda: None)
+    import shutil
+    import tempfile
+
+    fs = _remote_fs(path)
+    if not hasattr(fs, "list_dir"):
+        raise ValueError(
+            f"loading an MLlib model directory from {path!r} needs a "
+            f"filesystem with directory listing (local paths or "
+            f"hdfs:// — WebHDFS and native drivers); stage it "
+            f"locally for other schemes"
+        )
+    tmp = tempfile.mkdtemp(prefix="mllib_import_")
+    try:
+        base = path.rstrip("/")
+        for sub in ("metadata", "data"):
+            os.makedirs(os.path.join(tmp, sub), exist_ok=True)
+            for name in fs.list_dir(f"{base}/{sub}"):
+                with open(os.path.join(tmp, sub, name), "wb") as f:
+                    f.write(fs.read_bytes(f"{base}/{sub}/{name}"))
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    return tmp, (lambda: shutil.rmtree(tmp, ignore_errors=True))
 
 
 def read_metadata(path: str) -> dict:
@@ -167,6 +233,14 @@ def read_glm(path: str) -> GLMModel:
     ``LogisticRegressionModel.save`` / ``SVMModel.save`` (the
     reference's save/load seam, LogisticRegressionClassifier.java:
     144-152)."""
+    path, cleanup = _ensure_local(path)
+    try:
+        return _read_glm_local(path)
+    finally:
+        cleanup()
+
+
+def _read_glm_local(path: str) -> GLMModel:
     meta = read_metadata(path)
     cls = meta.get("class", "")
     if cls not in (GLM_LOGREG, GLM_SVM):
@@ -358,6 +432,14 @@ def read_tree_ensemble(path: str) -> MLlibTreeEnsemble:
     """Load a DecisionTreeModel / RandomForestModel /
     GradientBoostedTreesModel directory (the save targets at
     DecisionTreeClassifier.java:156-157 and the RF/GBT analogues)."""
+    path, cleanup = _ensure_local(path)
+    try:
+        return _read_tree_ensemble_local(path)
+    finally:
+        cleanup()
+
+
+def _read_tree_ensemble_local(path: str) -> MLlibTreeEnsemble:
     meta = read_metadata(path)
     cls = meta.get("class", "")
     if cls == TREE_DT:
@@ -586,6 +668,14 @@ def materialize_model_dir(path: str, build_fn) -> None:
     tmp = tempfile.mkdtemp(prefix="mllib_export_")
     try:
         build_fn(tmp)
+        # clear any previous export first (the remote analogue of
+        # delete_local_dir_target): a surviving old data part file
+        # would be concatenated with the new one by every reader —
+        # ours and Spark's (review finding). Filesystems without
+        # delete rely on the deterministic part naming to overwrite.
+        fs = modelfiles._fs_for(path)
+        if hasattr(fs, "delete_dir"):
+            fs.delete_dir(path.rstrip("/"))
         for root, _dirs, files in os.walk(tmp):
             rel_root = os.path.relpath(root, tmp)
             for name in files:
@@ -615,9 +705,13 @@ def _write_metadata(path: str, meta: dict) -> None:
 def _write_data(pq, table, path: str) -> None:
     data_dir = os.path.join(path, "data")
     os.makedirs(data_dir, exist_ok=True)
-    # Spark 1.6's own part naming + gzip default codec
-    # (spark.sql.parquet.compression.codec)
-    name = f"part-r-00000-{uuid.uuid4()}.gz.parquet"
+    # Spark-style part naming + gzip default codec
+    # (spark.sql.parquet.compression.codec). DETERMINISTIC name, no
+    # uuid: a re-export to the same remote directory must overwrite
+    # the previous part file, not accumulate a second one the reader
+    # (ours or Spark's) would concatenate into a corrupt model
+    # (review finding).
+    name = "part-r-00000.gz.parquet"
     pq.write_table(
         table, os.path.join(data_dir, name), compression="gzip"
     )

@@ -392,6 +392,37 @@ class WebHdfsFileSystem(HttpFileSystem):
     def exists(self, path: str) -> bool:
         return self._file_status(path) is not None
 
+    def list_dir(self, path: str) -> list:
+        """Child entry names of a directory (WebHDFS ``LISTSTATUS``).
+        The capability MLlib model-*directory* reads need — object
+        stores without listing (plain http, gs ranged-read adapter)
+        don't implement this method, which is how callers detect
+        support."""
+        status, _, data = self._follow(
+            "GET", self._rest_url(path, "LISTSTATUS")
+        )
+        if status in (404, 410):
+            raise FileNotFoundError(path)
+        if status != 200:
+            raise RemoteIOError(f"LISTSTATUS {path}: HTTP {status}")
+        try:
+            entries = json.loads(data)["FileStatuses"]["FileStatus"]
+            return [e["pathSuffix"] for e in entries]
+        except (ValueError, KeyError, TypeError) as e:
+            raise RemoteIOError(
+                f"LISTSTATUS {path}: unparseable response "
+                f"({data[:80]!r})"
+            ) from e
+
+    def delete_dir(self, path: str) -> None:
+        """Recursive delete (WebHDFS ``DELETE`` op). Missing targets
+        are fine — the caller wants the path gone, not an error."""
+        status, _, _ = self._request(
+            "DELETE", self._rest_url(path, "DELETE", recursive="true")
+        )
+        if status not in (200, 404, 410):
+            raise RemoteIOError(f"DELETE {path}: HTTP {status}")
+
     def read_range(self, path: str, start: int, length: int) -> bytes:
         url = self._rest_url(path, "OPEN", offset=start, length=length)
         status, _, data = self._follow("GET", url)
@@ -558,6 +589,26 @@ class NativeHdfsFileSystem:
 
     def read_text(self, path: str) -> str:
         return self.read_bytes(path).decode("utf-8", errors="replace")
+
+    def list_dir(self, path: str) -> list:
+        """Child entry names (same contract as
+        ``WebHdfsFileSystem.list_dir``)."""
+        from pyarrow import fs as pafs
+
+        authority, hpath = self._split(path)
+        infos = self._fs(authority).get_file_info(
+            pafs.FileSelector(hpath, recursive=False)
+        )
+        return [os.path.basename(i.path) for i in infos]
+
+    def delete_dir(self, path: str) -> None:
+        """Recursive delete; missing targets are fine (same contract
+        as ``WebHdfsFileSystem.delete_dir``)."""
+        authority, hpath = self._split(path)
+        try:
+            self._fs(authority).delete_dir(hpath)
+        except FileNotFoundError:
+            pass
 
     def write_bytes(self, path: str, data: bytes) -> None:
         authority, hpath = self._split(path)

@@ -39,6 +39,20 @@ class _FakeHadoopFS:
     def get_file_info(self, paths):
         from pyarrow import fs as pafs
 
+        if isinstance(paths, pafs.FileSelector):
+            base = paths.base_dir.rstrip("/") + "/"
+            names = sorted(
+                {
+                    base + k[len(base):].split("/", 1)[0]
+                    for k in self.files
+                    if k.startswith(base)
+                }
+            )
+            if not names:
+                raise FileNotFoundError(paths.base_dir)
+            return [
+                pafs.FileInfo(n, type=pafs.FileType.File) for n in names
+            ]
         out = []
         for p in paths:
             if p in self.files:
@@ -52,6 +66,18 @@ class _FakeHadoopFS:
             else:
                 out.append(pafs.FileInfo(p, type=pafs.FileType.NotFound))
         return out
+
+    def delete_dir(self, p):
+        prefix = p.rstrip("/")
+        doomed = [
+            k
+            for k in self.files
+            if k == prefix or k.startswith(prefix + "/")
+        ]
+        if not doomed and prefix not in self.dirs:
+            raise FileNotFoundError(p)
+        for k in doomed:
+            del self.files[k]
 
     def open_input_stream(self, p):
         return io.BytesIO(self.files[p])
@@ -117,6 +143,36 @@ def test_directory_and_missing_semantics(fake_connect):
         fs.read_bytes("hdfs://nn/d")
     with pytest.raises(FileNotFoundError):
         fs.read_bytes("hdfs://nn/nope")
+
+
+def test_list_dir_and_mllib_dir_over_native_driver(
+    fake_connect, monkeypatch
+):
+    """Directory listing (the capability MLlib model-dir reads need)
+    and the full model-directory round trip over the native
+    driver."""
+    import numpy as np
+
+    from eeg_dataanalysispackage_tpu.io import mllib_format as mf
+
+    fake, _ = fake_connect
+    fs = remote.NativeHdfsFileSystem()
+    fs.write_bytes("hdfs://nn/m/metadata/part-00000", b"x")
+    fs.write_bytes("hdfs://nn/m/data/part-r-0.gz.parquet", b"y")
+    assert fs.list_dir("hdfs://nn/m") == ["data", "metadata"]
+    assert fs.list_dir("hdfs://nn/m/metadata") == ["part-00000"]
+    with pytest.raises(FileNotFoundError):
+        fs.list_dir("hdfs://nn/nope")
+
+    # full GLM round trip with hdfs:// routed to the native driver
+    monkeypatch.setenv("HDFS_DRIVER", "native")
+    w = np.arange(8.0)
+    uri = "hdfs://nn/models/glm"
+    mf.write_glm(uri, mf.GLM_LOGREG, w, intercept=0.5)
+    assert mf.is_model_dir(uri)
+    m = mf.read_glm(uri)
+    np.testing.assert_array_equal(m.weights, w)
+    assert m.intercept == 0.5
 
 
 def test_non_hdfs_uri_rejected(fake_connect):
