@@ -10,6 +10,8 @@ train-step variants (tools/ingest_bench.py) with HBM-roofline context:
 
   einsum          f32 epochs resident in HBM -> features (headline)
   einsum_bf16     bf16-resident twin of the headline
+  einsum_512      compact-resident (B, C, 512) twin (honest 6144
+                  B/epoch); einsum_512_bf16: its bf16 form (3072 B)
   regular_ingest  fused int16 ingest, fixed-SOA stimulus train ->
                   features (formulation auto: phase on TPU)
   block_ingest    fused int16 ingest, irregular markers -> features
@@ -84,7 +86,7 @@ _VARIANT_TIMEOUTS = {
 # patience — on a warm compile cache everything fits easily; on a
 # cold cache the tail variants may be budget-skipped (recorded as
 # such, artifact intact). BENCH_TOTAL_BUDGET overrides.
-_N_VARIANTS = 8  # asserted against the variant tables below
+_N_VARIANTS = 10  # asserted against the variant tables below
 _TOTAL_BUDGET_S = int(
     os.environ.get(
         "BENCH_TOTAL_BUDGET",
@@ -111,6 +113,18 @@ _VARIANTS_TPU = {
         2 * int(os.environ.get("BENCH_BATCH", 262144)),
         int(os.environ.get("BENCH_ITERS", 50)),
     ),
+    # compact-resident layouts (honest bytes: 6144 f32 / 3072 bf16
+    # per epoch) — the armed headline candidates (VERDICT r4 item 7);
+    # bf16 at 2x batch for the same dispatch-amortization reason as
+    # einsum_bf16
+    "einsum_512": (
+        int(os.environ.get("BENCH_BATCH", 262144)),
+        int(os.environ.get("BENCH_ITERS", 50)),
+    ),
+    "einsum_512_bf16": (
+        2 * int(os.environ.get("BENCH_BATCH", 262144)),
+        int(os.environ.get("BENCH_ITERS", 50)),
+    ),
     "regular_ingest": (262144, 20),
     "block_ingest": (32768, 10),
     "train_step": (131072, 20),
@@ -123,6 +137,8 @@ _VARIANTS_TPU = {
 _VARIANTS_CPU = {
     "einsum": (8192, 5),
     "einsum_bf16": (8192, 3),
+    "einsum_512": (8192, 3),
+    "einsum_512_bf16": (8192, 3),
     "regular_ingest": (8192, 3),
     "block_ingest": (2048, 2),
     "train_step": (8192, 3),
