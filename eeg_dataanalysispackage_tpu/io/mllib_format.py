@@ -713,6 +713,13 @@ def _write_data(pq, table, path: str) -> None:
     # (review finding).
     name = "part-r-00000.gz.parquet"
     pq.write_table(
-        table, os.path.join(data_dir, name), compression="gzip"
+        table,
+        os.path.join(data_dir, name),
+        compression="gzip",
+        # parquet format 1.0: Spark 1.6 bundles parquet-mr 1.7, which
+        # predates the v2 file metadata; every type in these schemas
+        # (double/int/bool/struct/list) is expressible in 1.0, so the
+        # floor costs nothing and maximizes JVM-side readability
+        version="1.0",
     )
     open(os.path.join(data_dir, "_SUCCESS"), "w").close()
