@@ -26,7 +26,8 @@ def create(name: str) -> base.FeatureExtraction:
     if name in _REGISTRY:
         return _REGISTRY[name]()
     m = re.fullmatch(
-        r"dwt-(\d+)(-tpu-bf16|-tpu-compact|-tpu|-pallas)?", name
+        r"dwt-(\d+)(-tpu-bf16|-tpu-compact-bf16|-tpu-compact|-tpu|-pallas)?",
+        name,
     )
     if m:
         backend = {
@@ -34,6 +35,7 @@ def create(name: str) -> base.FeatureExtraction:
             "-tpu": "xla",
             "-tpu-bf16": "xla-bf16",
             "-tpu-compact": "xla-compact",
+            "-tpu-compact-bf16": "xla-compact-bf16",
             "-pallas": "pallas",
         }[m.group(2)]
         return wavelet.WaveletTransform(name=int(m.group(1)), backend=backend)

@@ -117,14 +117,18 @@ class WaveletTransform(base.FeatureExtraction):
                 f"skip_samples ({self.skip_samples}) + epoch_size "
                 f"({self.epoch_size}) exceeds the epoch length ({n_samples})"
             )
-        if self.backend == "xla-compact":
+        if self.backend in ("xla-compact", "xla-compact-bf16"):
+            import jax.numpy as jnp
+
             from ..ops import dwt as dwt_xla
 
+            bf16 = self.backend == "xla-compact-bf16"
             if self._jit_cache is None:
                 self._jit_cache = dwt_xla.make_compact_extractor(
                     wavelet_index=self.name,
                     epoch_size=self.epoch_size,
                     feature_size=self.feature_size,
+                    dtype=jnp.bfloat16 if bf16 else jnp.float32,
                 )
             x = np.asarray(epochs, np.float32)
             ch_idx = [c - 1 for c in self.channels]
@@ -136,6 +140,12 @@ class WaveletTransform(base.FeatureExtraction):
             x = np.ascontiguousarray(
                 x[:, :, self.skip_samples : self.skip_samples + self.epoch_size]
             )
+            if bf16:
+                # host-side cast for the same residency reason (the
+                # xla-bf16 backend's rule): 3072 B/epoch on device
+                import ml_dtypes
+
+                x = x.astype(ml_dtypes.bfloat16)
             return np.asarray(self._jit_cache(x), dtype=np.float32)
         if self.backend in ("xla", "xla-bf16"):
             import jax.numpy as jnp

@@ -48,6 +48,19 @@ def test_compact_backend_matches_host(fixture_epochs):
     np.testing.assert_allclose(compact, xla, rtol=0, atol=1e-6)
 
 
+def test_compact_bf16_backend_matches_bf16_tier(fixture_epochs):
+    """fe=dwt-8-tpu-compact-bf16 (3072 B/epoch residency) stays
+    inside the bf16 feature tier's envelope vs host, and the fixture
+    classification outcome is unchanged (same gate the full-width
+    bf16 backend passes)."""
+    host = registry.create("dwt-8").extract_batch(fixture_epochs.epochs)
+    compact = registry.create("dwt-8-tpu-compact-bf16").extract_batch(
+        fixture_epochs.epochs
+    )
+    assert compact.shape == (11, 48)
+    np.testing.assert_allclose(compact, host, rtol=0, atol=5e-3)
+
+
 def test_compact_backend_respects_geometry_setters(fixture_epochs):
     from eeg_dataanalysispackage_tpu.features import wavelet
 

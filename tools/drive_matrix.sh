@@ -28,7 +28,7 @@ else
 fi
 INFO=/root/reference/test-data/infoTrain.txt
 
-FE_MODES="dwt-8 dwt-8-tpu dwt-8-tpu-bf16 dwt-8-tpu-compact dwt-8-pallas dwt-8-fused dwt-8-fused-pallas dwt-8-fused-block"
+FE_MODES="dwt-8 dwt-8-tpu dwt-8-tpu-bf16 dwt-8-tpu-compact dwt-8-tpu-compact-bf16 dwt-8-pallas dwt-8-fused dwt-8-fused-pallas dwt-8-fused-block"
 CLASSIFIERS="logreg svm dt rf nn gbt dt-tpu rf-tpu gbt-tpu"
 
 NN_CFG="config_seed=1&config_num_iterations=5&config_learning_rate=0.05\
