@@ -18,6 +18,8 @@ train-step variants (tools/ingest_bench.py) with HBM-roofline context:
                   via tile-row gathers + the 128-variant operator
                   bank (XLA-only; no element gather)
   train_step      f32 epochs -> features -> MLP fwd/bwd/update
+  train_step_512  the train step over compact-resident (B, C, 512)
+                  epochs (honest 6144 B/epoch)
   train_step_raw  int16 stream -> fused ingest -> features -> MLP
                   fwd/bwd/update (training at int16 bytes/epoch)
   train_step_block  int16 stream + IRREGULAR markers -> block-gather
@@ -86,7 +88,7 @@ _VARIANT_TIMEOUTS = {
 # patience — on a warm compile cache everything fits easily; on a
 # cold cache the tail variants may be budget-skipped (recorded as
 # such, artifact intact). BENCH_TOTAL_BUDGET overrides.
-_N_VARIANTS = 10  # asserted against the variant tables below
+_N_VARIANTS = 11  # asserted against the variant tables below
 _TOTAL_BUDGET_S = int(
     os.environ.get(
         "BENCH_TOTAL_BUDGET",
@@ -128,6 +130,9 @@ _VARIANTS_TPU = {
     "regular_ingest": (262144, 20),
     "block_ingest": (32768, 10),
     "train_step": (131072, 20),
+    # the compact train twin at the headline batch (honest 6144
+    # B/epoch step read)
+    "train_step_512": (262144, 30),
     "train_step_raw": (131072, 20),
     "train_step_block": (32768, 10),
     # last (longest fresh compile): the bank128 kernel, the one
@@ -142,6 +147,7 @@ _VARIANTS_CPU = {
     "regular_ingest": (8192, 3),
     "block_ingest": (2048, 2),
     "train_step": (8192, 3),
+    "train_step_512": (8192, 3),
     "train_step_raw": (4096, 2),
     "train_step_block": (2048, 2),
     "pallas_ingest": (2048, 2),

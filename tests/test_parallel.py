@@ -358,6 +358,45 @@ class TestIrregularTrainStep:
         for k in state_k2["params"]:
             assert np.all(np.isfinite(np.asarray(state_k2["params"][k])))
 
+    def test_compact_train_step_matches_full_width(self):
+        """make_compact_train_step over the host-sliced (B, C, 512)
+        window must produce the same one-step loss as make_train_step
+        over the full (B, C, 1000) layout (identical contraction, the
+        488 dead columns removed) — the honest-bytes training twin."""
+        from eeg_dataanalysispackage_tpu.parallel import train as ptrain
+        from eeg_dataanalysispackage_tpu.utils import constants
+
+        rng = np.random.RandomState(3)
+        n = 32
+        epochs = rng.randn(n, 3, 1000).astype(np.float32) * 40.0
+        labels = rng.randint(0, 2, size=n).astype(np.float32)
+        mask = np.ones(n, np.float32)
+
+        init_f, step_f = ptrain.make_train_step()
+        state = init_f(jax.random.PRNGKey(0))
+        _, loss_full = step_f(
+            state, jnp.asarray(epochs), jnp.asarray(labels),
+            jnp.asarray(mask),
+        )
+
+        skip = 175
+        sliced = np.ascontiguousarray(epochs[:, :, skip : skip + 512])
+        init_c, step_c = ptrain.make_compact_train_step()
+        state_c = init_c(jax.random.PRNGKey(0))
+        _, loss_compact = step_c(
+            state_c, jnp.asarray(sliced), jnp.asarray(labels),
+            jnp.asarray(mask),
+        )
+        np.testing.assert_allclose(
+            float(loss_compact), float(loss_full), rtol=0, atol=1e-6
+        )
+        # wrong window width fails loudly at trace time
+        with pytest.raises(ValueError, match="epoch_size"):
+            step_c(
+                state_c, jnp.asarray(epochs), jnp.asarray(labels),
+                jnp.asarray(mask),
+            )
+
     def test_bank_step_nondefault_feature_size_sizes_the_mlp(self):
         """A non-default feature_size must size the MLP input to
         C*feature_size (review finding: the geometry knob crashed at

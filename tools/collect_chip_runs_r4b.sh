@@ -82,6 +82,10 @@ run train_bank 1800 python tools/ingest_bench.py train_step_bank 32768 10
 # -> 69.8% @524k), so measure the same step at 262k before blaming
 # program bytes
 run train_step_262k 900 python tools/ingest_bench.py train_step 262144 30
+# the compact train twin: halves the step's dominant read; with
+# einsum_512 it decides whether the whole pipeline (features AND
+# training) moves to the compact residency
+run train_step_512 900 python tools/ingest_bench.py train_step_512 262144 30
 # train-step roofline diagnosis (VERDICT r4 weakness 6: 35.4% vs the
 # feature-only 69.6%): XLA's own cost model on the train_step /
 # feature_step programs — bytes_ratio >> 1 localizes the gap to

@@ -46,6 +46,9 @@ Variants:
                   the JSON line records which one ran
   train_step      f32 epochs -> features -> logreg forward/backward/
                   update (parallel/train.py one-step)
+  train_step_512  the train step over compact-resident (B, C, 512)
+                  epochs (honest 6144 B/epoch read;
+                  parallel/train.make_compact_train_step)
   train_step_raw  int16 raw stream -> fused regular ingest ->
                   features -> logreg fwd/bwd/update: the full
                   training loop at int16 bytes/epoch
@@ -602,6 +605,35 @@ def run(variant: str, n: int, iters: int) -> dict:
         state0 = init_state(jax.random.PRNGKey(0))
         mask = jnp.ones((n,), jnp.float32)
         bytes_per_epoch = 3 * 1000 * 4
+
+        @jax.jit
+        def loop(x, y, m):
+            def body(state, i):
+                state2, loss = step(state, x + i, y, m)
+                return state2, loss
+
+            state, losses = jax.lax.scan(
+                body, state0, jnp.arange(iters, dtype=jnp.float32)
+            )
+            return jax.tree_util.tree_reduce(
+                lambda a, b: a + b.sum(), state, jnp.float32(0)
+            ) + losses.sum()
+
+        arg = (epochs, labels, mask)
+
+    elif variant == "train_step_512":
+        from eeg_dataanalysispackage_tpu.parallel import train as ptrain
+
+        epochs = jax.random.normal(
+            jax.random.PRNGKey(0), (n, 3, 512), dtype=jnp.float32
+        ) * 50.0
+        labels = jnp.asarray(
+            rng.randint(0, 2, size=n).astype(np.float32)
+        )
+        init_state, step = ptrain.make_compact_train_step()
+        state0 = init_state(jax.random.PRNGKey(0))
+        mask = jnp.ones((n,), jnp.float32)
+        bytes_per_epoch = 3 * 512 * 4
 
         @jax.jit
         def loop(x, y, m):
