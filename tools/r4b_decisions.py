@@ -1,0 +1,211 @@
+"""Render the round-4b chip artifacts into playbook decisions.
+
+Usage: python tools/r4b_decisions.py [tools/sweep_results/r4b]
+
+Reads the staged collection's raw JSONs and evaluates each
+pre-registered decision from docs/chip_playbook.md (round-4b table),
+printing one line per decision: the measured numbers, the threshold,
+and the action (default flip / keep / record-bound). Pure file
+reading — safe to run any time; missing artifacts print as PENDING.
+"""
+
+import json
+import os
+import sys
+
+
+def _load(d, name):
+    p = os.path.join(d, f"{name}.json")
+    try:
+        if os.path.getsize(p) == 0:
+            return None
+        with open(p) as f:
+            lines = [ln for ln in f.read().splitlines() if ln.strip()]
+        return json.loads(lines[-1])
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+def _eps(doc):
+    if doc is None:
+        return None
+    return doc.get("epochs_per_s") or doc.get("value")
+
+
+def main() -> None:
+    d = sys.argv[1] if len(sys.argv) > 1 else "tools/sweep_results/r4b"
+    if not os.path.isdir(d):
+        sys.exit(f"no such directory: {d}")
+
+    # r4 reference numbers (tools/sweep_results/r4, BASELINE.md)
+    R4 = {
+        "block_ingest": 1.15e6,
+        "regular_partial": 5.40e6,
+        "train_step_raw_phase": 4.59e6,
+        "train_step_block": 1.34e6,
+        "train_step_131k": 24.14e6,
+        "einsum_262k": 47.50e6,
+        "einsum_roofline_pct": 69.6,
+    }
+
+    def line(name, verdict):
+        print(f"{name:22s} {verdict}")
+
+    def pending(name):
+        line(name, "PENDING (no artifact)")
+
+    b32 = _load(d, "bank128_32k")
+    b131 = _load(d, "bank128_131k")
+    bank = _eps(b131) or _eps(b32)
+    if bank is None:
+        pending("bank128")
+    else:
+        ratio = bank / R4["block_ingest"]
+        act = (
+            "FLIP default_fused_backend accelerator branch block->pallas"
+            if ratio >= 2
+            else "keep block default; record the bound"
+        )
+        line(
+            "bank128",
+            f"{bank/1e6:.2f}M eps = {ratio:.1f}x block(1.15M) -> {act}",
+        )
+
+    rb = _load(d, "regular_bank")
+    if rb is None:
+        pending("regular_bank")
+    else:
+        eps = _eps(rb)
+        act = (
+            "FLIP resolve_regular_formulation('auto') accelerator -> bank"
+            if eps and eps > R4["regular_partial"]
+            else "keep partial/phase; record why"
+        )
+        line("regular_bank", f"{(eps or 0)/1e6:.2f}M vs partial 5.40M -> {act}")
+
+    e524 = _load(d, "einsum_524k")
+    if e524 is None:
+        pending("einsum_524k")
+    else:
+        eps = _eps(e524)
+        act = (
+            "raise BENCH_BATCH default to 524288"
+            if eps and eps > R4["einsum_262k"] * 1.05
+            else "keep 262144"
+        )
+        line("einsum_524k", f"{(eps or 0)/1e6:.2f}M vs 47.50M @262k -> {act}")
+
+    for name, bytes_ok in (("einsum_sliced", False), ("einsum_512", True)):
+        doc = _load(d, name)
+        if doc is None:
+            pending(name)
+            continue
+        pct = doc.get("pct_of_hbm_roofline")
+        eps = _eps(doc)
+        if name == "einsum_512":
+            act = (
+                "make compact-resident the headline row "
+                "(fe=dwt-8-tpu-compact shipped); state 6144 B/epoch"
+                if pct and pct >= 65
+                else "full-width stands; write the accounting caveat"
+            )
+        else:
+            act = (
+                "subrange read fuses: report effective bytes"
+                if pct and pct > 100
+                else "XLA reads dead columns; compact is the honest win"
+            )
+        line(name, f"{(eps or 0)/1e6:.2f}M eps, {pct}% roofline -> {act}")
+
+    eb = _load(d, "einsum_512_bf16")
+    if eb is None:
+        pending("einsum_512_bf16")
+    else:
+        pct = eb.get("pct_of_hbm_roofline")
+        act = (
+            "compact-bf16 is the absolute-throughput tier "
+            "(fe=dwt-8-tpu-compact-bf16 shipped)"
+            if pct and pct >= 65
+            else "record which effect failed to compound"
+        )
+        line(
+            "einsum_512_bf16",
+            f"{(_eps(eb) or 0)/1e6:.2f}M eps, {pct}% roofline -> {act}",
+        )
+
+    r1 = _load(d, "rf_predict_retry")
+    r2 = _load(d, "rf_predict_chunked")
+    if r1 is None and r2 is None:
+        pending("rf_predict")
+    elif r1 is not None:
+        line(
+            "rf_predict",
+            f"retry ok ({(_eps(r1) or 0)/1e3:.1f}k rows/s) -> r4 fault "
+            f"was transient; keep full predict default",
+        )
+    else:
+        line(
+            "rf_predict",
+            f"retry faulted, chunked "
+            f"{'ok (' + format((_eps(r2) or 0)/1e3, '.1f') + 'k rows/s)' if r2 else 'ALSO faulted'}"
+            f" -> {'make row-chunked the device predict default' if r2 else 'construct fault: bisect the walk'}",
+        )
+
+    t262 = _load(d, "train_step_262k")
+    if t262 is None:
+        pending("train_step_262k")
+    else:
+        eps = _eps(t262)
+        recovered = eps and eps > R4["train_step_131k"] * 1.5
+        line(
+            "train_step_262k",
+            f"{(eps or 0)/1e6:.2f}M vs 24.14M @131k -> "
+            f"{'dispatch amortization confirmed; raise bench train batch' if recovered else 'not dispatch: read cost_train bytes_ratio'}",
+        )
+
+    t512 = _load(d, "train_step_512")
+    if t512 is None:
+        pending("train_step_512")
+    else:
+        line(
+            "train_step_512",
+            f"{(_eps(t512) or 0)/1e6:.2f}M at 6144 B/epoch (pair with "
+            f"einsum_512's flip decision)",
+        )
+
+    tb = _load(d, "train_bank")
+    if tb is None:
+        pending("train_bank")
+    else:
+        eps = _eps(tb)
+        line(
+            "train_bank",
+            f"{(eps or 0)/1e6:.2f}M vs train_step_block 1.34M -> "
+            f"{'bank wins irregular training' if eps and eps > 1.34e6 else 'block stands'}",
+        )
+
+    trb = _load(d, "train_raw_bank")
+    if trb is None:
+        pending("train_raw_bank")
+    else:
+        eps = _eps(trb)
+        line(
+            "train_raw_bank",
+            f"{(eps or 0)/1e6:.2f}M vs phase 4.59M -> "
+            f"{'bank wins raw training' if eps and eps > 4.59e6 else 'phase stands'}",
+        )
+
+    be = _load(d, "bench_early") or _load(d, "bench_full")
+    if be is None:
+        pending("bench (driver format)")
+    else:
+        line(
+            "driver bench",
+            f"value {be.get('value', 0)/1e6:.2f}M, platform "
+            f"{be.get('platform', 'tpu')} -> chip_evidence source for "
+            f"every later bench line",
+        )
+
+
+if __name__ == "__main__":
+    main()
