@@ -130,15 +130,17 @@ class WaveletTransform(base.FeatureExtraction):
                     feature_size=self.feature_size,
                     dtype=jnp.bfloat16 if bf16 else jnp.float32,
                 )
-            x = np.asarray(epochs, np.float32)
+            # slice on the HOST and BEFORE any dtype copy: the
+            # device-resident buffer (and the transfer) must be the
+            # compact window, and converting the full-width array
+            # first would copy the dead columns just to drop them
+            x = np.asarray(epochs)
             ch_idx = [c - 1 for c in self.channels]
             if ch_idx != list(range(x.shape[1])):
                 x = x[:, ch_idx, :]
-            # slice on the HOST: the device-resident buffer (and the
-            # transfer) must be the compact window, or the layout's
-            # whole point — fewer true bytes — is lost
             x = np.ascontiguousarray(
-                x[:, :, self.skip_samples : self.skip_samples + self.epoch_size]
+                x[:, :, self.skip_samples : self.skip_samples + self.epoch_size],
+                dtype=np.float32,
             )
             if bf16:
                 # host-side cast for the same residency reason (the

@@ -156,20 +156,37 @@ def make_compact_extractor(
 
     @jax.jit
     def extract(epochs: jnp.ndarray) -> jnp.ndarray:
-        ep = jnp.asarray(epochs, dtype=dtype)
-        B, C, n = ep.shape
-        if n != epoch_size:
-            # windowed_features sizes its cascade from the input, so a
-            # mis-sliced batch would silently get a different-depth
-            # transform; fail loudly instead
-            raise ValueError(
-                f"compact extractor built for epoch_size {epoch_size}; "
-                f"got windowed batch of width {n}"
-            )
-        coeffs = windowed_features(ep, wavelet_index, feature_size)
-        return safe_l2_normalize(coeffs.reshape(B, C * feature_size))
+        return compact_epoch_features(
+            jnp.asarray(epochs, dtype=dtype),
+            wavelet_index,
+            epoch_size,
+            feature_size,
+        )
 
     return extract
+
+
+def compact_epoch_features(
+    ep: jnp.ndarray,
+    wavelet_index: int,
+    epoch_size: int,
+    feature_size: int,
+) -> jnp.ndarray:
+    """Traceable (B, C, epoch_size) pre-windowed epochs ->
+    (B, C*feature_size) normalized features — the shared compact-
+    residency body (the extractor above and
+    parallel/train.make_compact_train_step both call this)."""
+    B, C, n = ep.shape
+    if n != epoch_size:
+        # windowed_features sizes its cascade from the input, so a
+        # mis-sliced batch would silently get a different-depth
+        # transform; fail loudly instead
+        raise ValueError(
+            f"compact path built for epoch_size {epoch_size}; "
+            f"got windowed batch of width {n}"
+        )
+    coeffs = windowed_features(ep, wavelet_index, feature_size)
+    return safe_l2_normalize(coeffs.reshape(B, C * feature_size))
 
 
 def make_batched_extractor(

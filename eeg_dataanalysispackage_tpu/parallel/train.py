@@ -109,17 +109,8 @@ def make_compact_train_step(
 
     @jax.jit
     def step(state, epochs_512, labels, mask):
-        B, C, n = epochs_512.shape
-        if n != epoch_size:
-            raise ValueError(
-                f"compact train step built for epoch_size "
-                f"{epoch_size}; got windowed batch of width {n}"
-            )
-        coeffs = dwt_xla.windowed_features(
-            epochs_512, wavelet_index, feature_size
-        )
-        feats = dwt_xla.safe_l2_normalize(
-            coeffs.reshape(B, C * feature_size)
+        feats = dwt_xla.compact_epoch_features(
+            epochs_512, wavelet_index, epoch_size, feature_size
         )
         return feat_step(state, feats, labels, mask)
 
