@@ -313,6 +313,83 @@ def test_categorical_split_refused(tmp_path):
         mf.read_tree_ensemble(d)
 
 
+def test_reader_error_paths(tmp_path):
+    """Every refusal branch raises its documented error, not an
+    accidental KeyError/IndexError."""
+    # empty metadata dir
+    d0 = tmp_path / "empty"
+    (d0 / "metadata").mkdir(parents=True)
+    with pytest.raises(FileNotFoundError, match="metadata part"):
+        mf.read_metadata(str(d0))
+    # metadata present but blank
+    (d0 / "metadata" / "part-00000").write_text("\n\n")
+    with pytest.raises(ValueError, match="empty metadata"):
+        mf.read_metadata(str(d0))
+    # wrong class for each reader
+    d1 = str(tmp_path / "dt")
+    mf.write_tree_ensemble(d1, mf.TREE_DT, [_manual_tree()])
+    with pytest.raises(ValueError, match="not a GLM"):
+        mf.read_glm(d1)
+    d2 = str(tmp_path / "glm")
+    mf.write_glm(d2, mf.GLM_LOGREG, RNG.randn(4))
+    with pytest.raises(ValueError, match="not an MLlib tree"):
+        mf.read_tree_ensemble(d2)
+    # multi-row GLM data refuses
+    import pyarrow.parquet as pq
+
+    data_dir = os.path.join(d2, "data")
+    f = [
+        os.path.join(data_dir, p)
+        for p in os.listdir(data_dir)
+        if p.endswith(".parquet")
+    ][0]
+    t = pq.read_table(f)
+    import pyarrow as pa
+
+    pq.write_table(pa.concat_tables([t, t]), f)
+    with pytest.raises(ValueError, match="single row"):
+        mf.read_glm(d2)
+    # unknown vector type tag
+    with pytest.raises(ValueError, match="type tag"):
+        mf._vector_to_np({"type": 7, "values": [1.0]})
+    # unknown combining strategy
+    with pytest.raises(ValueError, match="combining"):
+        mf._normalize_combining("median")
+    # DT must hold exactly one tree
+    with pytest.raises(ValueError, match="exactly one"):
+        mf.write_tree_ensemble(
+            str(tmp_path / "x"), mf.TREE_DT, [_manual_tree()] * 2
+        )
+    # treeWeights length mismatch
+    d3 = str(tmp_path / "rf")
+    mf.write_tree_ensemble(
+        d3, mf.TREE_RF, [_manual_tree()], tree_weights=[1.0]
+    )
+    meta = mf.read_metadata(d3)
+    meta["metadata"]["treeWeights"] = [1.0, 2.0]
+    with open(os.path.join(d3, "metadata", "part-00000"), "w") as fh:
+        fh.write(json.dumps(meta))
+    with pytest.raises(ValueError, match="treeWeights"):
+        mf.read_tree_ensemble(d3)
+    # internal node with a null split record
+    d4 = str(tmp_path / "nosplit")
+    mf.write_tree_ensemble(d4, mf.TREE_DT, [_manual_tree()])
+    data_dir = os.path.join(d4, "data")
+    f4 = [
+        os.path.join(data_dir, p)
+        for p in os.listdir(data_dir)
+        if p.endswith(".parquet")
+    ][0]
+    rows = pq.read_table(f4).to_pylist()
+    for r in rows:
+        r["split"] = None
+    pq.write_table(
+        pa.Table.from_pylist(rows, schema=pq.read_table(f4).schema), f4
+    )
+    with pytest.raises(ValueError, match="no split"):
+        mf.read_tree_ensemble(d4)
+
+
 def test_is_model_dir_detection(tmp_path):
     assert not mf.is_model_dir(str(tmp_path))
     d = str(tmp_path / "m")
