@@ -26,7 +26,7 @@ Everything downstream (DWT, classifiers) consumes the resulting
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Sequence, Tuple
+from typing import Sequence, Tuple
 
 import numpy as np
 

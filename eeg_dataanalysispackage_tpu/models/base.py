@@ -13,7 +13,7 @@ the only safe configuration; SURVEY.md section 5 'race detection').
 from __future__ import annotations
 
 import abc
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, Optional, Sequence
 
 import numpy as np
 

@@ -9,7 +9,6 @@ because conftest pins tests to an 8-device virtual CPU mesh.
 import os
 
 import numpy as np
-import pytest
 
 from eeg_dataanalysispackage_tpu.features import registry as fe_registry
 from eeg_dataanalysispackage_tpu.features import wavelet

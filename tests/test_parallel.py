@@ -364,7 +364,6 @@ class TestIrregularTrainStep:
         over the full (B, C, 1000) layout (identical contraction, the
         488 dead columns removed) — the honest-bytes training twin."""
         from eeg_dataanalysispackage_tpu.parallel import train as ptrain
-        from eeg_dataanalysispackage_tpu.utils import constants
 
         rng = np.random.RandomState(3)
         n = 32
