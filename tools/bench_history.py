@@ -1,0 +1,92 @@
+"""Render the BENCH_r*.json round history as one table.
+
+Usage: python tools/bench_history.py [repo_root]
+
+One row per round artifact: platform, headline value, vs_baseline,
+roofline, per-variant epochs/s, and (round 5+) the embedded dated
+chip_evidence — the at-a-glance evolution of the driver contract
+across rounds, without opening five JSON files.
+"""
+
+import glob
+import json
+import os
+import sys
+
+
+def main() -> None:
+    root = sys.argv[1] if len(sys.argv) > 1 else "."
+    paths = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
+    if not paths:
+        sys.exit(f"no BENCH_r*.json under {root}")
+    for p in paths:
+        try:
+            with open(p) as f:
+                raw = f.read()
+            wrapper = json.loads(raw)
+            # the driver wraps the bench line: {"n","cmd","rc","tail"}
+            # with the payload as the last JSON line of "tail"
+            if "tail" in wrapper and "value" not in wrapper:
+                doc = None
+                for ln in reversed(wrapper["tail"].splitlines()):
+                    if ln.lstrip().startswith("{"):
+                        doc = json.loads(ln)
+                        break
+                if doc is None:
+                    print(
+                        f"{os.path.basename(p)}: rc={wrapper.get('rc')} "
+                        f"no payload line; tail: "
+                        f"{wrapper['tail'][-120:]!r}"
+                    )
+                    continue
+            else:
+                doc = wrapper
+        except (OSError, ValueError, IndexError) as e:
+            print(f"{os.path.basename(p)}: unreadable ({e})")
+            continue
+        plat = doc.get("platform", "tpu")
+        head = doc.get("value")
+        line = (
+            f"{os.path.basename(p)}: platform={plat} "
+            f"headline={head/1e6:.2f}M eps" if head else
+            f"{os.path.basename(p)}: platform={plat} headline=?"
+        )
+        if "vs_baseline" in doc:
+            line += f" ({doc['vs_baseline']}x target)"
+        if "pct_of_hbm_roofline" in doc:
+            line += f" {doc['pct_of_hbm_roofline']}% roofline"
+        print(line)
+        for name, v in doc.get("variants", {}).items():
+            if isinstance(v, dict) and "epochs_per_s" in v:
+                extra = (
+                    f" {v['pct_of_hbm_roofline']}%"
+                    if "pct_of_hbm_roofline" in v
+                    else ""
+                )
+                print(
+                    f"    {name:18s} {v['epochs_per_s']/1e6:9.3f}M eps"
+                    f"{extra}"
+                )
+            elif isinstance(v, dict) and "error" in v:
+                print(f"    {name:18s} ERROR {v['error'][:60]}")
+        ce = doc.get("chip_evidence", {})
+        if ce.get("bench"):
+            b = ce["bench"]
+            print(
+                f"    chip_evidence: {b['value']/1e6:.2f}M eps "
+                f"({b.get('vs_baseline')}x) from {b['source']} "
+                f"@ {b['recorded_utc']} [{b.get('timestamp_source')}]"
+            )
+        if ce.get("parity"):
+            pr = ce["parity"]
+            print(
+                f"    chip parity: epoch_sum_bit_exact="
+                f"{pr.get('epoch_sum_bit_exact')} "
+                f"feature_sum_bit_exact="
+                f"{pr.get('host_feature_sum_bit_exact')} "
+                f"({pr['source']})"
+            )
+
+
+if __name__ == "__main__":
+    main()
