@@ -70,6 +70,42 @@ def test_sharded_ingest_matches_single_device(tmesh):
     np.testing.assert_array_equal(plan.targets, base.targets)
 
 
+def test_sharded_ingest_backward_reach_and_exact_boundaries(tmesh):
+    """Markers in [k*block, k*block + PRESTIMULUS) have windows that
+    START in the previous shard (the backward pre-stimulus reach),
+    and markers exactly on a boundary start PRESTIMULUS samples
+    before it — both must match the single-device featurizer
+    bit-for-bit with the ring halo in play."""
+    T = 8 * 4096
+    raw, res = _recording(T, seed=7)
+    block = T // 8
+    positions = [
+        block,            # exactly on a boundary
+        block + 10,       # window starts 90 samples into shard 0
+        3 * block + 99,   # last backward-reaching offset (pre=100)
+        5 * block + 100,  # first NON-reaching offset (window starts at 5*block)
+        7 * block,        # boundary of the last shard
+    ]
+    stimuli = [1, 2, 3, 4, 5]
+    markers = _markers(positions, stimuli)
+
+    plan = sharded_ingest.plan_sharded_ingest(
+        markers, guessed_number=4, n_samples=T, n_shards=8, block=block
+    )
+    extract = sharded_ingest.make_sharded_ingest(tmesh)
+    staged = sharded_ingest.stage_recording_int16(raw, tmesh)
+    got = extract(staged, res, plan)
+
+    base = device_ingest.plan_ingest(markers, 4, T)
+    feat = device_ingest.make_block_ingest_featurizer()
+    want = np.asarray(
+        feat(jnp.asarray(raw), jnp.asarray(res),
+             jnp.asarray(base.positions), jnp.asarray(base.mask))
+    )[base.mask]
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, rtol=0, atol=5e-6)
+
+
 def test_sharded_ingest_end_overhang_zero_pads(tmesh):
     """A window overhanging the global recording end reads zeros
     (Java copyOfRange), NOT the ring-wrapped head of shard 0."""
