@@ -554,6 +554,19 @@ class NeuralNetworkClassifier(base.Classifier):
         from ..io import modelfiles
 
         raw = modelfiles.read_model_bytes(path)
+        if raw[:2] == b"PK":
+            # a reference deployment's ModelSerializer archive
+            # (sniffed on the BYTES so remote URIs and file:// paths
+            # hit the same refusal — review finding): the
+            # architecture (configuration.json) IS importable — the
+            # weights are not (closed ND4J serialization)
+            raise NotImplementedError(
+                "this is a DL4J ModelSerializer zip; its weights use "
+                "closed ND4J serialization and cannot be imported — "
+                "port the architecture with "
+                "io.dl4j_compat.import_dl4j_architecture(path), "
+                "set_config() it, and retrain (docs/MIGRATION.md)"
+            )
         hlen = int.from_bytes(raw[:8], "little")
         header = json.loads(raw[8 : 8 + hlen].decode())
         blob = raw[8 + hlen :]

@@ -1,0 +1,197 @@
+"""DL4J architecture import (io/dl4j_compat.py).
+
+The reference persists NNs as ModelSerializer zips
+(NeuralNetworkClassifier.java:171-176); the weights are closed ND4J
+bytes but configuration.json is plain Jackson JSON of the
+MultiLayerConfiguration built from the config_* keys
+(NeuralNetworkClassifier.java:96-130, 258-320). These tests pin the
+inverse mapping across the 0.x encoding variants, the zip plumbing,
+the classifier-seam refusal that names the importer, and an
+import -> set_config -> fit round trip."""
+
+import json
+import zipfile
+
+import numpy as np
+import pytest
+
+from eeg_dataanalysispackage_tpu.io import dl4j_compat as dc
+from eeg_dataanalysispackage_tpu.models import registry as clf_registry
+
+
+def _conf_v08(n_layers=2):
+    """0.8-style: one-key layer wrappers, activationFn @class,
+    training globals cloned per layer."""
+    confs = []
+    for i in range(n_layers):
+        last = i == n_layers - 1
+        fields = {
+            "nout": 2 if last else 20,
+            "dropOut": 0.0 if last else 0.5,
+            "activationFn": {
+                "@class": (
+                    "org.nd4j.linalg.activations.impl."
+                    + ("ActivationSoftmax" if last else "ActivationReLU")
+                )
+            },
+            "updater": "NESTEROVS",
+            "learningRate": 0.1,
+            "momentum": 0.5,
+            "weightInit": "XAVIER",
+        }
+        if last:
+            fields["lossFn"] = {
+                "@class": (
+                    "org.nd4j.linalg.lossfunctions.impl.LossMCXENT"
+                )
+            }
+        confs.append(
+            {
+                "seed": 12345,
+                "numIterations": 7,
+                "optimizationAlgo": "CONJUGATE_GRADIENT",
+                "layer": {("output" if last else "dense"): fields},
+            }
+        )
+    return {"backprop": True, "pretrain": False, "confs": confs}
+
+
+def test_v08_import_full_key_surface(tmp_path):
+    p = tmp_path / "configuration.json"
+    p.write_text(json.dumps(_conf_v08()))
+    cfg = dc.import_dl4j_architecture(str(p))
+    assert cfg["config_layer1_layer_type"] == "dense"
+    assert cfg["config_layer1_n_out"] == "20"
+    assert cfg["config_layer1_drop_out"] == "0.5"
+    assert cfg["config_layer1_activation_function"] == "relu"
+    assert cfg["config_layer2_layer_type"] == "output"
+    assert cfg["config_layer2_n_out"] == "2"
+    assert cfg["config_layer2_activation_function"] == "softmax"
+    assert cfg["config_loss_function"] == "xent"
+    assert cfg["config_seed"] == "12345"
+    assert cfg["config_num_iterations"] == "7"
+    assert cfg["config_optimization_algo"] == "conjugate_gradient"
+    assert cfg["config_updater"] == "nesterovs"
+    assert cfg["config_learning_rate"] == "0.1"
+    assert cfg["config_momentum"] == "0.5"
+    assert cfg["config_weight_init"] == "xavier"
+    assert cfg["config_backprop"] == "true"
+    assert cfg["config_pretrain"] == "false"
+
+
+def test_pre07_string_activation_and_class_tagged_layers(tmp_path):
+    """Older encodings: @class-tagged flat layers and bare-string
+    activationFunction values."""
+    doc = {
+        "backprop": True,
+        "pretrain": True,
+        "confs": [
+            {
+                # pre-0.7: training globals on the CONF object, not
+                # cloned into the layer (review finding)
+                "seed": 11,
+                "iterations": 5,
+                "learningRate": 0.05,
+                "momentum": 0.4,
+                "updater": "SGD",
+                "weightInit": "RELU",
+                "optimizationAlgorithm": "LBFGS",
+                "layer": {
+                    "@class": (
+                        "org.deeplearning4j.nn.conf.layers.AutoEncoder"
+                    ),
+                    "nOut": 16,
+                    "dropout": 0.3,
+                    "activationFunction": "sigmoid",
+                }
+            },
+            {
+                "layer": {
+                    "@class": (
+                        "org.deeplearning4j.nn.conf.layers.OutputLayer"
+                    ),
+                    "nOut": 2,
+                    "activationFunction": "softmax",
+                    "lossFunction": "NEGATIVELOGLIKELIHOOD",
+                }
+            },
+        ],
+    }
+    p = tmp_path / "configuration.json"
+    p.write_text(json.dumps(doc))
+    cfg = dc.import_dl4j_architecture(str(p))
+    assert cfg["config_layer1_layer_type"] == "auto_encoder"
+    assert cfg["config_layer1_drop_out"] == "0.3"
+    assert cfg["config_layer1_activation_function"] == "sigmoid"
+    assert cfg["config_layer2_layer_type"] == "output"
+    assert cfg["config_loss_function"] == "negativeloglikelihood"
+    assert cfg["config_pretrain"] == "true"
+    assert cfg["config_learning_rate"] == "0.05"
+    assert cfg["config_updater"] == "sgd"
+    assert cfg["config_momentum"] == "0.4"
+    assert cfg["config_weight_init"] == "relu"
+    assert cfg["config_optimization_algo"] == "lbfgs"
+
+    # the ported pre-0.7 config must actually FIT (it carries every
+    # key the classifier requires)
+    rng = np.random.RandomState(2)
+    X = rng.randn(48, 48)
+    y = (X[:, 0] > 0).astype(np.float64)
+    nn = clf_registry.create("nn")
+    nn.set_config(dict(cfg, config_pretrain="false",
+                       config_num_iterations="10"))
+    nn.fit(X, y)
+    assert np.isfinite(nn.predict(X)).all()
+
+
+def test_zip_archive_and_refusal_seam(tmp_path):
+    z = tmp_path / "model.zip"
+    with zipfile.ZipFile(z, "w") as zf:
+        zf.writestr("configuration.json", json.dumps(_conf_v08()))
+        zf.writestr("coefficients.bin", b"\x00" * 64)  # opaque ND4J
+    cfg = dc.import_dl4j_architecture(str(z))
+    assert cfg["config_layer2_layer_type"] == "output"
+
+    nn = clf_registry.create("nn")
+    with pytest.raises(NotImplementedError, match="import_dl4j_architecture"):
+        nn.load(str(z))
+
+    # a zip with no configuration entry is refused with context
+    z2 = tmp_path / "other.zip"
+    with zipfile.ZipFile(z2, "w") as zf:
+        zf.writestr("something.bin", b"x")
+    with pytest.raises(ValueError, match="configuration.json"):
+        dc.read_configuration_json(str(z2))
+
+
+def test_import_set_config_fit_round_trip(tmp_path):
+    """The ported architecture trains through the real classifier —
+    the migration's actual end state."""
+    doc = _conf_v08()
+    p = tmp_path / "configuration.json"
+    p.write_text(json.dumps(doc))
+    cfg = dc.import_dl4j_architecture(str(p))
+    cfg["config_num_iterations"] = "30"
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(96, 48)
+    y = (X[:, 0] > 0).astype(np.float64)
+    nn = clf_registry.create("nn")
+    nn.set_config(cfg)
+    nn.fit(X, y)
+    # predict returns P(target) — the reference's output.getDouble(0)
+    acc = float(((nn.predict(X) > 0.5).astype(np.float64) == y).mean())
+    assert acc > 0.7
+
+
+def test_not_a_configuration_raises(tmp_path):
+    p = tmp_path / "x.json"
+    p.write_text(json.dumps({"weights": [1, 2, 3]}))
+    with pytest.raises(ValueError, match="confs"):
+        dc.import_dl4j_architecture(str(p))
+    p2 = tmp_path / "bad_layer.json"
+    p2.write_text(json.dumps({"confs": [{"layer": {"conv2d": {}}}]}))
+    with pytest.raises(ValueError, match="layer type"):
+        dc.import_dl4j_architecture(str(p2))
+    with pytest.raises(ValueError, match="activation"):
+        dc._enum("ActivationSwish", dc._ACTIVATIONS, "activation")
