@@ -117,6 +117,41 @@ def test_collection_lock_yields_the_tunnel(sweep_root, monkeypatch):
     assert not bench._collection_in_progress()
 
 
+def test_collection_script_lock_lifecycle(tmp_path):
+    """Sourcing the staged list (with every python invocation
+    stubbed) must hold COLLECTING.lock for the duration — refreshed
+    by the run() wrapper — and remove it at the end, leaving the
+    hygiene MISSING.txt behind. Pins the tunnel mutual-exclusion
+    machinery end to end in bash, the way tunnel_watch.sh drives it."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = tmp_path
+    script = f"""
+set -u
+cd {repo}
+OUT={out}
+log() {{ :; }}
+run() {{ name=$1; t=$2; shift 2
+  [ -f "$OUT/COLLECTING.lock" ] || echo "NOLOCK $name" >> "$OUT/violations"
+  echo '{{}}' > "$OUT/$name.json"
+}}
+source <(sed 's|python |true python |g' tools/collect_chip_runs_r4b.sh)
+"""
+    r = subprocess.run(
+        ["bash", "-c", script], capture_output=True, text=True, timeout=60
+    )
+    assert r.returncode == 0, r.stderr[-500:]
+    assert not (out / "violations").exists(), (
+        out / "violations").read_text()
+    # lock released at the end; hygiene ledger written
+    assert not (out / "COLLECTING.lock").exists()
+    assert (out / "MISSING.txt").exists()
+    # every staged run produced its artifact (evidence hygiene)
+    assert (out / "bench_early.json").exists()
+    assert (out / "bench_full.json").exists()
+
+
 def test_probe_respects_lock_before_touching_the_tunnel(
     sweep_root, monkeypatch
 ):
