@@ -1,0 +1,84 @@
+"""The r4b decision helper (tools/r4b_decisions.py) against
+synthetic artifacts: the pre-registered thresholds from
+docs/chip_playbook.md must map measured numbers to the right
+actions, and missing artifacts must read PENDING — the tool is the
+post-recovery bookkeeping, so its verdicts need pinning before the
+chip window, not after."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "r4b_decisions.py")
+
+
+def _run(d):
+    r = subprocess.run(
+        [sys.executable, TOOL, str(d)],
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert r.returncode == 0, r.stderr
+    return r.stdout
+
+
+def _write(d, name, payload):
+    with open(os.path.join(d, f"{name}.json"), "w") as f:
+        f.write(json.dumps(payload) + "\n")
+
+
+def test_all_pending_on_empty_dir(tmp_path):
+    out = _run(tmp_path)
+    assert out.count("PENDING") >= 10
+
+
+def test_flip_thresholds(tmp_path):
+    d = str(tmp_path)
+    # bank128 at 3x block -> flip to pallas default
+    _write(d, "bank128_131k", {"epochs_per_s": 3.5e6})
+    # regular bank beats partial -> flip auto to bank
+    _write(d, "regular_bank", {"epochs_per_s": 6.1e6})
+    # einsum_512 at roofline -> compact headline
+    _write(d, "einsum_512", {"epochs_per_s": 9.0e7, "pct_of_hbm_roofline": 68.0})
+    # compact-bf16 short of roofline -> record, no flip
+    _write(
+        d, "einsum_512_bf16",
+        {"epochs_per_s": 9.5e7, "pct_of_hbm_roofline": 36.0},
+    )
+    # rf retry ok -> transient
+    _write(d, "rf_predict_retry", {"epochs_per_s": 2.5e5})
+    # train at 262k recovered -> dispatch amortization
+    _write(d, "train_step_262k", {"epochs_per_s": 4.0e7})
+    out = _run(d)
+    assert "FLIP default_fused_backend" in out
+    assert "FLIP resolve_regular_formulation" in out
+    assert "make compact-resident the headline" in out
+    assert "failed to compound" in out
+    assert "transient" in out
+    assert "dispatch amortization confirmed" in out
+
+
+def test_keep_thresholds(tmp_path):
+    d = str(tmp_path)
+    _write(d, "bank128_32k", {"epochs_per_s": 1.5e6})  # only 1.3x block
+    _write(d, "regular_bank", {"epochs_per_s": 4.0e6})  # < partial 5.40M
+    _write(d, "einsum_512", {"epochs_per_s": 5.0e7, "pct_of_hbm_roofline": 38.0})
+    _write(d, "train_step_262k", {"epochs_per_s": 2.5e7})  # no recovery
+    out = _run(d)
+    assert "keep block default" in out
+    assert "keep partial/phase" in out
+    assert "full-width stands" in out
+    assert "read cost_train" in out
+
+
+def test_empty_artifacts_stay_pending(tmp_path):
+    (tmp_path / "einsum_512.json").write_text("")  # hygiene case
+    out = _run(tmp_path)
+    assert "einsum_512" in out
+    # the empty file must not parse as a number
+    for line in out.splitlines():
+        if line.startswith("einsum_512 "):
+            assert "PENDING" in line
