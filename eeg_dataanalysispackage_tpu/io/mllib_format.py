@@ -39,10 +39,12 @@ Layouts (Spark 1.6.2, format class tags in the metadata JSON):
   regression ensembles.
 
 The DL4J side (``NeuralNetworkClassifier.java:171-187``,
-``ModelSerializer`` zips) is NOT importable: the zip wraps ND4J's
-closed native array serialization, for which no public layout
-contract exists — documented out of scope (models/nn.py keeps its
-own open msgpack format).
+``ModelSerializer`` zips): the WEIGHTS are not importable — the zip
+wraps ND4J's closed native array serialization, for which no public
+layout contract exists — but the ARCHITECTURE is
+(``io/dl4j_compat.py`` reads the zip's open ``configuration.json``
+back into the ``config_*`` surface; retrain after porting).
+models/nn.py keeps its own open serialization for native round trips.
 
 Categorical splits never occur in the reference's pipelines (all 48
 DWT features are continuous), so importing a tree with a
