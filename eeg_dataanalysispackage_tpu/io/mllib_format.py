@@ -332,7 +332,87 @@ def _write_glm_local(
         "intercept": float(intercept),
         "threshold": None if threshold is None else float(threshold),
     }
-    _write_data(pq, pa.Table.from_pylist([row], schema=schema), path)
+    _write_data(
+        pq,
+        pa.Table.from_pylist([row], schema=schema),
+        path,
+        spark_schema=_GLM_SPARK_SCHEMA,
+    )
+
+
+#: Spark SQL schema JSON for the GLM data table, embedded verbatim as
+#: the ``org.apache.spark.sql.parquet.row.metadata`` footer key. Spark
+#: 1.6's ``GLMClassificationModel.SaveLoadV1_0.loadData`` pattern-
+#: matches ``Row(weights: Vector, ...)`` — without the ``udt`` entry
+#: tagging the weights struct as VectorUDT, the row deserializes as a
+#: plain struct and the match throws MatchError, so an exported
+#: logreg/svm dir would not load on an actual cluster (ADVICE,
+#: medium). Field order mirrors the parquet schema; ``metadata`` maps
+#: are the empty defaults ``CatalystTypeConverters`` writes.
+_GLM_SPARK_SCHEMA = {
+    "type": "struct",
+    "fields": [
+        {
+            "name": "weights",
+            "type": {
+                "type": "udt",
+                "class": "org.apache.spark.mllib.linalg.VectorUDT",
+                "pyClass": "pyspark.mllib.linalg.VectorUDT",
+                "sqlType": {
+                    "type": "struct",
+                    "fields": [
+                        {
+                            "name": "type",
+                            "type": "byte",
+                            "nullable": False,
+                            "metadata": {},
+                        },
+                        {
+                            "name": "size",
+                            "type": "integer",
+                            "nullable": True,
+                            "metadata": {},
+                        },
+                        {
+                            "name": "indices",
+                            "type": {
+                                "type": "array",
+                                "elementType": "integer",
+                                "containsNull": False,
+                            },
+                            "nullable": True,
+                            "metadata": {},
+                        },
+                        {
+                            "name": "values",
+                            "type": {
+                                "type": "array",
+                                "elementType": "double",
+                                "containsNull": False,
+                            },
+                            "nullable": True,
+                            "metadata": {},
+                        },
+                    ],
+                },
+            },
+            "nullable": True,
+            "metadata": {},
+        },
+        {
+            "name": "intercept",
+            "type": "double",
+            "nullable": False,
+            "metadata": {},
+        },
+        {
+            "name": "threshold",
+            "type": "double",
+            "nullable": True,
+            "metadata": {},
+        },
+    ],
+}
 
 
 # -------------------------------------------------------------- trees
@@ -355,7 +435,19 @@ class MLlibTreeEnsemble:
         """Reference-semantics prediction over raw (continuous)
         features: TreeEnsembleModel.predict — Vote = per-tree class
         majority; Sum = ``1 if sum(w_i * t_i(x)) > 0 else 0`` (the
-        GBT classification threshold); Average = weighted mean."""
+        GBT classification threshold); Average = weighted mean.
+
+        Vote ties follow Spark 1.6 ``predictByVoting`` exactly: it
+        takes ``maxBy`` over a ``mutable.HashMap[Int, Double]``, and
+        ``maxBy`` keeps the FIRST maximum in the map's iteration
+        order. For the binary vote keys {0, 1} that order is fixed by
+        the hash table, not by tree order: with the initial 16-bucket
+        table, byteswap32-improved Int hashing puts key 1 in bucket 6
+        and key 0 in bucket 0, and ``entriesIterator`` walks buckets
+        DOWNWARD from the highest populated index — so key 1 always
+        iterates first and an exact weighted tie deterministically
+        predicts class 1.0 (ADVICE divergence note; reachable for
+        even-sized equal-weight forests)."""
         X = np.asarray(features, dtype=np.float64)
         per_tree = np.stack([_descend(t, X) for t in self.trees])
         w = self.tree_weights[:, None]
@@ -365,7 +457,9 @@ class MLlibTreeEnsemble:
         if self.combining == "vote":
             votes1 = ((per_tree > 0.5) * w).sum(axis=0)
             votes0 = ((per_tree <= 0.5) * w).sum(axis=0)
-            return (votes1 > votes0).astype(np.float64)
+            # >= : the tie goes to class 1 (the JVM vote map's
+            # iteration order above), never to class 0
+            return (votes1 >= votes0).astype(np.float64)
         return (w * per_tree).sum(axis=0) / self.tree_weights.sum()
 
 
@@ -674,10 +768,16 @@ def materialize_model_dir(path: str, build_fn) -> None:
         # delete_local_dir_target): a surviving old data part file
         # would be concatenated with the new one by every reader —
         # ours and Spark's (review finding). Filesystems without
-        # delete rely on the deterministic part naming to overwrite.
+        # delete can still LIST: deterministic part naming overwrites
+        # our own previous export, but a directory Spark itself wrote
+        # uses uuid-suffixed parts (part-r-00000-<uuid>.gz.parquet)
+        # that no overwrite reaches — refuse rather than silently
+        # coexist with them (ADVICE, low).
         fs = modelfiles._fs_for(path)
         if hasattr(fs, "delete_dir"):
             fs.delete_dir(path.rstrip("/"))
+        elif hasattr(fs, "list_dir"):
+            _check_no_stale_parts(fs, path.rstrip("/"), tmp)
         for root, _dirs, files in os.walk(tmp):
             rel_root = os.path.relpath(root, tmp)
             for name in files:
@@ -694,6 +794,38 @@ def materialize_model_dir(path: str, build_fn) -> None:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _check_no_stale_parts(fs, path: str, tmp: str) -> None:
+    """For a listing-capable filesystem WITHOUT recursive delete:
+    refuse to upload over part files the upcoming writes won't
+    overwrite. Every model-dir reader (ours and Spark's) concatenates
+    all ``part-*`` files in ``data/``, so a uuid-suffixed leftover
+    from a Spark-written directory would merge with the new export
+    into a corrupt model. Missing target dirs are fine (fresh
+    export); only per-subdir mismatched part files raise."""
+    for sub in ("data", "metadata"):
+        local_sub = os.path.join(tmp, sub)
+        if not os.path.isdir(local_sub):
+            continue
+        new_names = set(os.listdir(local_sub))
+        try:
+            existing = fs.list_dir(f"{path}/{sub}")
+        except (FileNotFoundError, OSError):
+            continue
+        stale = [
+            name
+            for name in existing
+            if name.startswith("part-") and name not in new_names
+        ]
+        if stale:
+            raise IOError(
+                f"refusing to export model dir over {path}/{sub}: "
+                f"existing part files {sorted(stale)} would not be "
+                f"overwritten (uuid-suffixed Spark output?) and every "
+                f"reader would concatenate them with the new rows — "
+                f"delete the directory first"
+            )
+
+
 def _write_metadata(path: str, meta: dict) -> None:
     meta_dir = os.path.join(path, "metadata")
     os.makedirs(meta_dir, exist_ok=True)
@@ -704,9 +836,21 @@ def _write_metadata(path: str, meta: dict) -> None:
     open(os.path.join(meta_dir, "_SUCCESS"), "w").close()
 
 
-def _write_data(pq, table, path: str) -> None:
+def _write_data(pq, table, path: str, spark_schema: dict = None) -> None:
     data_dir = os.path.join(path, "data")
     os.makedirs(data_dir, exist_ok=True)
+    if spark_schema is not None:
+        # Spark SQL reads its row schema (UDT tags included) from this
+        # footer key in preference to the parquet schema; pyarrow's
+        # own tables carry no footer metadata from from_pylist, so
+        # replace rather than merge
+        table = table.replace_schema_metadata(
+            {
+                "org.apache.spark.sql.parquet.row.metadata": json.dumps(
+                    spark_schema, separators=(",", ":")
+                )
+            }
+        )
     # Spark-style part naming + gzip default codec
     # (spark.sql.parquet.compression.codec). DETERMINISTIC name, no
     # uuid: a re-export to the same remote directory must overwrite

@@ -128,10 +128,13 @@ class OfflineDataProvider:
         targets (n,) float64).
 
         ``backend``: "xla" (ops/device_ingest.py — gather + einsum),
-        "block" (ops/device_ingest.make_block_ingest_featurizer —
-        tile-row gathers + 128-variant operator bank, no element
-        gather), or "pallas" (ops/ingest_pallas.py — the fully fused
-        VMEM-chunked kernel; interpret mode off-TPU).
+        "block" (ops/device_ingest.make_classed_block_ingest_featurizer
+        — tile-row gathers with windows batched by alignment class, so
+        each class contracts as one matmul; the host gather plan is
+        memoized in ops/plan_cache, and re-ingesting an unchanged
+        recording re-plans nothing), or "pallas"
+        (ops/ingest_pallas.py — the fully fused VMEM-chunked kernel;
+        interpret mode off-TPU).
 
         Numerics follow the float32 device path (tolerance-level vs
         the bit-exact host path) — use :meth:`load` + a host-backend
@@ -162,7 +165,10 @@ class OfflineDataProvider:
                 mode=os.environ.get("EEG_PALLAS_MODE") or None,
             )
         if backend == "block":
-            featurizer = device_ingest.make_block_ingest_featurizer(
+            # the host-planned alignment-classed form: positions here
+            # are always concrete IngestPlan metadata, so the plan
+            # cache applies and the 128-variant bank's MACs don't
+            featurizer = device_ingest.make_classed_block_ingest_featurizer(
                 wavelet_index=wavelet_index,
                 epoch_size=epoch_size,
                 skip_samples=skip_samples,

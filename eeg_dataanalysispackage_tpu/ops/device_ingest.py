@@ -329,10 +329,14 @@ def make_regular_ingest_featurizer(
     semantics, different layout behavior — measured on v5e,
     `docs/ingest_kernel.md`):
 
-    - ``"reshape"``: `(C, n·Δ) -> (C, n, Δ)` + subtract-first einsum.
-      Most accurate (baseline subtracted before the contraction) but
-      Δ=800 is not lane-tile aligned, so XLA relays the whole stream
-      lane-by-lane — measured 25x below roofline.
+    - ``"reshape"``: `(C, n·Δ) -> (C, n, Δ)`, subtract-first, then one
+      explicit 2-D matmul of the live analysis columns against the
+      cascade operator (channels flattened into rows — no transposed
+      einsum output, and the dead window columns never convert to
+      f32). Most accurate (baseline subtracted before the
+      contraction); on TPU Δ=800 is still not lane-tile aligned, so
+      the reshape relays the stream lane-by-lane — the aligned
+      formulations below exist for that.
     - ``"conv"``: the window/contraction expressed as a strided
       `conv_general_dilated` over the flat stream (window_strides=Δ),
       baseline via a second 1-tap-bank conv, combined two-term
@@ -444,27 +448,48 @@ def _make_regular_ingest_featurizer(
         wavelet_index, epoch_size, skip_samples, feature_size, pre,
         window_len=stride, fold_baseline=False,
     )
+    # the live rows of E: the cascade operator W at window-relative
+    # rows [pre+skip, pre+skip+epoch_size). Every other E row is zero,
+    # so contracting only the live columns is exact — and it lets the
+    # reshape formulation read 612 of the 800 window columns (live +
+    # pre-stimulus) instead of all of them.
+    W_np = E_np[pre + skip_samples : pre + skip_samples + epoch_size]
 
     @jax.jit
     def _ingest_reshape(raw_i16, resolutions, first_position):
-        E = jnp.asarray(E_np)
+        W = jnp.asarray(W_np)
+        C = raw_i16.shape[0]
         start = first_position - pre
         rows = jax.lax.dynamic_slice_in_dim(
             raw_i16, start, n_epochs * stride, axis=1
-        ).reshape(raw_i16.shape[0], n_epochs, stride)
-        # int16 -> f32 scale fuses into the einsum's operand read
-        scaled = rows.astype(jnp.float32) * resolutions[:, None, None]
-        # explicit baseline subtraction (not folded into E): real EEG
+        ).reshape(C, n_epochs, stride)
+        # only the columns the math consumes are converted/scaled: the
+        # pre-stimulus head (baseline mean) and the live analysis
+        # window (the contraction); the dead columns between and after
+        # them never leave int16
+        scale = resolutions[:, None, None]
+        pre_f = rows[:, :, :pre].astype(jnp.float32) * scale
+        live = rows[
+            :, :, pre + skip_samples : pre + skip_samples + epoch_size
+        ].astype(jnp.float32) * scale
+        # explicit baseline subtraction (not folded into W): real EEG
         # DC offsets make the folded form cancel catastrophically
-        base = jnp.mean(scaled[:, :, :pre], axis=2, keepdims=True)
-        feats = jnp.einsum(
-            "cns,sk->nck",
-            scaled - base,
-            E,
+        base = jnp.mean(pre_f, axis=2, keepdims=True)
+        # one explicit 2-D matmul over (C*n, epoch_size): the bct,tk
+        # einsum's transposed (n, c, k) output forces a relayout on
+        # every backend; flattening channels into rows keeps the dot
+        # on the fast GEMM path (measured 3x on the CPU fallback) and
+        # the only transpose left is the tiny (C, n, K) feature tensor
+        z = (live - base).reshape(C * n_epochs, epoch_size)
+        y = jax.lax.dot_general(
+            z, W, (((1,), (0,)), ((), ())),
             precision=jax.lax.Precision.HIGHEST,
         )
+        feats = jnp.transpose(
+            y.reshape(C, n_epochs, feature_size), (1, 0, 2)
+        )
         return dwt_xla.safe_l2_normalize(
-            feats.reshape(n_epochs, raw_i16.shape[0] * feats.shape[-1])
+            feats.reshape(n_epochs, C * feature_size)
         )
 
     if formulation != "conv":
@@ -706,9 +731,26 @@ def _make_regular_ingest_featurizer(
         )
 
         # numpy in the cache, never jnp (same tracer-poisoning
-        # rationale as _phase_tables)
-        @functools.lru_cache(maxsize=8)
+        # rationale as _phase_tables); routed through the shared plan
+        # cache so steady-state steps re-plan nothing and the bench's
+        # plan_cache field counts the hits
+        from . import plan_cache as _pc
+
+        _bank_plan_cache = _pc.cache("regular_bank_plan")
+
         def _bank_tables(first: int, S: int):
+            key = _pc.digest(
+                extra=(
+                    "regular_bank", first, S, stride, n_epochs,
+                    wavelet_index, epoch_size, skip_samples,
+                    feature_size, pre, n_channels,
+                ),
+            )
+            return _bank_plan_cache.get_or_build(
+                key, lambda: _build_bank_tables(first, S)
+            )
+
+        def _build_bank_tables(first: int, S: int):
             positions = (
                 first + np.arange(n_epochs, dtype=np.int64) * stride
             )
@@ -978,6 +1020,352 @@ def make_block_ingest_featurizer(
         return out * mask[:, None].astype(out.dtype)
 
     return ingest_features
+
+
+@dataclasses.dataclass
+class BlockClassPlan:
+    """Host gather plan for the alignment-classed block ingest.
+
+    Windows are grouped by *alignment class* — the residual in-block
+    shift ``(position - pre) % 128`` — so every window in a class
+    shares ONE (slab, K) operator and the whole class contracts as a
+    single MXU matmul, instead of every window paying the 128-variant
+    bank (128x the MACs) the traced block formulation needs because
+    its shifts are data-dependent. All arrays are numpy (host): a plan
+    is pure marker metadata, built once per (marker layout, staged
+    shape, geometry) and memoized in ``ops/plan_cache``.
+    """
+
+    class_b0: np.ndarray  # (V, max_m) int32 first gathered block per slot
+    row_of: np.ndarray  # (capacity,) int32 kernel row of each epoch
+    Wc: np.ndarray  # (V, slab, K) f32 per-class window operator
+    Mc: np.ndarray  # (V, slab) f32 per-class pre-stimulus mean taps
+    colsum: np.ndarray  # (K,) f32 window-operator column sums
+
+    @property
+    def n_classes(self) -> int:
+        return self.class_b0.shape[0]
+
+    @property
+    def slots_per_class(self) -> int:
+        return self.class_b0.shape[1]
+
+
+def _block_class_operators(
+    classes: np.ndarray,
+    V: int,
+    wavelet_index: int,
+    epoch_size: int,
+    skip_samples: int,
+    feature_size: int,
+    pre: int,
+):
+    """(Wc, Mc, colsum) for one class SET — the shifted (V, slab, K)
+    operators every class contracts against. Keyed on the class set
+    and the DWT geometry, NOT the marker layout, and memoized
+    separately from the per-layout plan: the operator tables are the
+    plan's only MB-scale arrays (V=128 -> ~8 MB), and dense layouts
+    all share the single all-128-classes entry, so per-layout cache
+    entries stay at the KB scale ``ops/plan_cache`` sizes its
+    capacity by."""
+    from . import plan_cache as _pc
+
+    BLK = 128
+    SLAB_BLOCKS = 8
+    slab = SLAB_BLOCKS * BLK
+    win = pre + skip_samples + epoch_size
+    classes = np.asarray(classes, np.int32)
+
+    def build():
+        W = ingest_matrix(
+            wavelet_index, epoch_size, skip_samples, feature_size, pre,
+            window_len=win, fold_baseline=False,
+        )
+        K = feature_size
+        Wc = np.zeros((V, slab, K), np.float32)
+        Mc = np.zeros((V, slab), np.float32)
+        for i, v in enumerate(classes):
+            Wc[i, v : v + win, :] = W
+            Mc[i, v : v + pre] = 1.0 / pre
+        return Wc, Mc, W.sum(axis=0).astype(np.float32)
+
+    key = _pc.digest(
+        classes,
+        extra=(
+            "block_class_ops", V, wavelet_index, epoch_size,
+            skip_samples, feature_size, pre,
+        ),
+    )
+    # entries here are MB-scale (unlike the KB-scale layout plans the
+    # shared default capacity is sized for), so this cache gets its
+    # own small bound: 16 x <=8.4 MB keeps worst-case host RAM for
+    # operator tables near 100 MB even with many distinct class sets
+    return _pc.cache("block_class_operators", capacity=16).get_or_build(
+        key, build
+    )
+
+
+def plan_block_classes(
+    positions: np.ndarray,
+    mask: np.ndarray,
+    n_samples: int,
+    wavelet_index: int = 8,
+    epoch_size: int = 512,
+    skip_samples: int = 175,
+    feature_size: int = 16,
+    pre: int = constants.PRESTIMULUS_SAMPLES,
+    class_multiple: int = 8,
+    slot_multiple: int = 8,
+) -> BlockClassPlan:
+    """Build the alignment-class gather plan for one marker layout.
+
+    ``positions``/``mask`` are an IngestPlan's static-capacity arrays;
+    ``n_samples`` is the staged stream length (``raw.shape[1]``) the
+    window starts clip against — the same clip the traced block
+    featurizer applies, so the two formulations cut identical windows.
+    Class count and slots-per-class round up to ``class_multiple`` /
+    ``slot_multiple`` so near-identical layouts land on one compiled
+    shape. Padded slots gather block 0 and are never selected by
+    ``row_of``; padded classes carry zero operators.
+    """
+    BLK = 128
+    SLAB_BLOCKS = 8
+    slab = SLAB_BLOCKS * BLK
+    win = pre + skip_samples + epoch_size
+    # same build-time guard as the traced block featurizer: the worst
+    # in-block shift is BLK-1, and shift + window must fit the slab —
+    # without this, a long-epoch geometry only fails when a recording
+    # happens to contain a badly-aligned marker (an opaque numpy
+    # broadcast error mid-run instead of a deterministic ValueError)
+    if BLK - 1 + win > slab:
+        raise ValueError("window too long for the 8-block slab")
+    positions = np.asarray(positions)
+    mask = np.asarray(mask, dtype=bool)
+    capacity = positions.shape[0]
+
+    starts = np.clip(positions.astype(np.int64) - pre, 0, n_samples)
+    real = np.nonzero(mask)[0]
+    shifts = (starts[real] % BLK).astype(np.int32)
+    b0 = (starts[real] // BLK).astype(np.int32)
+
+    classes, inv_class = np.unique(shifts, return_inverse=True)
+    V_real = len(classes)
+    V = max(
+        class_multiple,
+        -(-max(V_real, 1) // class_multiple) * class_multiple,
+    )
+    counts = (
+        np.bincount(inv_class, minlength=max(V_real, 1))
+        if real.size
+        else np.zeros(1, np.int64)
+    )
+    max_m = max(
+        slot_multiple,
+        int(-(-max(int(counts.max(initial=1)), 1) // slot_multiple))
+        * slot_multiple,
+    )
+
+    class_b0 = np.zeros((V, max_m), np.int32)
+    row_of = np.zeros(capacity, np.int32)
+    if real.size:
+        order = np.argsort(inv_class, kind="stable")
+        sorted_cls = inv_class[order]  # nondecreasing class ids
+        # slot within class = rank in the class-sorted order minus the
+        # class's start offset
+        slot = np.arange(real.size) - np.repeat(
+            np.cumsum(counts) - counts, counts
+        )
+        class_b0[sorted_cls, slot] = b0[order]
+        row_of[real[order]] = sorted_cls * max_m + slot
+
+    # the MB-scale operator tables are keyed on the class SET (not
+    # the layout) and shared across plans — see _block_class_operators
+    Wc, Mc, colsum = _block_class_operators(
+        classes, V, wavelet_index, epoch_size, skip_samples,
+        feature_size, pre,
+    )
+    return BlockClassPlan(
+        class_b0=class_b0,
+        row_of=row_of,
+        Wc=Wc,
+        Mc=Mc,
+        colsum=colsum,
+    )
+
+
+def cached_block_class_plan(
+    positions: np.ndarray,
+    mask: np.ndarray,
+    n_samples: int,
+    wavelet_index: int = 8,
+    epoch_size: int = 512,
+    skip_samples: int = 175,
+    feature_size: int = 16,
+    pre: int = constants.PRESTIMULUS_SAMPLES,
+) -> BlockClassPlan:
+    """:func:`plan_block_classes` behind the shared plan cache, keyed
+    on (marker-layout digest, staged shape, geometry): the same
+    recording featurized again does zero host re-planning."""
+    from . import plan_cache as _pc
+
+    positions = np.asarray(positions)
+    mask = np.asarray(mask, dtype=bool)
+    key = _pc.digest(
+        positions,
+        mask,
+        extra=(
+            "block_class", int(n_samples), wavelet_index, epoch_size,
+            skip_samples, feature_size, pre,
+        ),
+    )
+    return _pc.cache("block_class_plan").get_or_build(
+        key,
+        lambda: plan_block_classes(
+            positions, mask, n_samples,
+            wavelet_index=wavelet_index,
+            epoch_size=epoch_size,
+            skip_samples=skip_samples,
+            feature_size=feature_size,
+            pre=pre,
+        ),
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def make_classed_block_ingest_featurizer(
+    wavelet_index: int = 8,
+    epoch_size: int = 512,
+    skip_samples: int = 175,
+    feature_size: int = 16,
+    pre: int = constants.PRESTIMULUS_SAMPLES,
+    chunk_epochs: int = 32768,
+):
+    """Irregular-marker fused int16 ingest, windows batched by
+    alignment class (the host-planned fast form of
+    :func:`make_block_ingest_featurizer`).
+
+    Same (raw int16 (C, S), resolutions, positions, mask) ->
+    (capacity, C*K) contract and identical numerics to the traced
+    block featurizer — same slab gather, same per-slab DC proxy, same
+    two-term baseline correction — but ``positions``/``mask`` must be
+    CONCRETE host arrays (an IngestPlan's metadata, the usual case):
+    the host groups windows by their in-block shift
+    (:func:`plan_block_classes`, memoized in ``ops/plan_cache``), so
+
+    - each class contracts against its single (slab, K) shifted
+      operator as one batched matmul — ~128x fewer MACs than the
+      128-variant bank, and no (C, n, 128, K) variant tensor ever
+      exists (the traced formulation's dominant HBM intermediate);
+    - steady-state calls over an unchanged layout reuse the cached
+      plan: zero host re-planning per step.
+
+    Per-class contraction is bitwise-identical to bank-then-select
+    (the selected variant's column block IS the class operator), so
+    parity with the traced block featurizer is exact.
+
+    When classes x slots exceeds ``chunk_epochs`` the slot axis runs
+    as a ``lax.map`` over fixed-size chunks (bounded HBM on long
+    recordings, same policy as the traced featurizer).
+    """
+    from . import dwt as dwt_xla
+
+    BLK = 128
+    SLAB_BLOCKS = 8
+    slab = SLAB_BLOCKS * BLK
+    # same guard as the traced featurizer: fail at BUILD time, not
+    # only when a recording happens to contain a badly-aligned marker
+    if BLK - 1 + pre + skip_samples + epoch_size > slab:
+        raise ValueError("window too long for the 8-block slab")
+
+    def _featurize_classes(blocks, resolutions, cb0, Wc, Mc, colsum):
+        """(C, nb, BLK) tile rows + (V, m) class plan -> per-class
+        feature tensor (C, V, m, K)."""
+        C = blocks.shape[0]
+        bidx = cb0[:, :, None] + jnp.arange(SLAB_BLOCKS, dtype=cb0.dtype)
+        gathered = blocks[:, bidx]  # (C, V, m, 8, BLK) — row gathers
+        xw = gathered.reshape(
+            C, cb0.shape[0], cb0.shape[1], slab
+        ).astype(jnp.float32) * resolutions[:, None, None, None]
+        # per-window slab mean: exactly invariant DC proxy, keeps the
+        # two-term correction at residual scale (the block-ingest
+        # f32-safety analysis)
+        d = jnp.mean(xw, axis=-1, keepdims=True)
+        z = xw - d
+        hi = jax.lax.Precision.HIGHEST
+        y = jnp.einsum("cvms,vsk->cvmk", z, Wc, precision=hi)
+        pm = jnp.einsum("cvms,vs->cvm", z, Mc, precision=hi)
+        return y - pm[..., None] * colsum[None, None, None, :]
+
+    @jax.jit
+    def _run(raw, resolutions, cb0, Wc, Mc, colsum, row_of, mask):
+        C, S = raw.shape
+        V, max_m = cb0.shape
+        S_pad = ((S + slab + BLK - 1) // BLK) * BLK
+        padded = jnp.pad(raw, ((0, 0), (0, S_pad - S)))
+        blocks = padded.reshape(C, S_pad // BLK, BLK)
+        if V * max_m <= chunk_epochs:
+            feats = _featurize_classes(
+                blocks, resolutions, cb0, Wc, Mc, colsum
+            )
+        else:
+            mchunk = max(8, (chunk_epochs // V) // 8 * 8)
+            n_chunks = -(-max_m // mchunk)
+            pad_m = n_chunks * mchunk - max_m
+            # padded slots gather block 0 — valid rows, never selected
+            cbp = jnp.pad(cb0, ((0, 0), (0, pad_m)))
+            per_chunk = jnp.transpose(
+                cbp.reshape(V, n_chunks, mchunk), (1, 0, 2)
+            )
+            feats = jax.lax.map(
+                lambda cb: _featurize_classes(
+                    blocks, resolutions, cb, Wc, Mc, colsum
+                ),
+                per_chunk,
+            )  # (n_chunks, C, V, mchunk, K)
+            feats = jnp.transpose(feats, (1, 2, 0, 3, 4)).reshape(
+                C, V, n_chunks * mchunk, -1
+            )[:, :, :max_m]
+        K = feats.shape[-1]
+        out = jnp.transpose(feats, (1, 2, 0, 3)).reshape(
+            V * max_m, C * K
+        )
+        out = dwt_xla.safe_l2_normalize(out)
+        return out[row_of] * mask[:, None].astype(out.dtype)
+
+    def featurize(raw_i16, resolutions, positions, mask):
+        plan = cached_block_class_plan(
+            np.asarray(positions),
+            np.asarray(mask),
+            int(raw_i16.shape[1]),
+            wavelet_index=wavelet_index,
+            epoch_size=epoch_size,
+            skip_samples=skip_samples,
+            feature_size=feature_size,
+            pre=pre,
+        )
+        return _run(
+            raw_i16,
+            jnp.asarray(resolutions, jnp.float32),
+            jnp.asarray(plan.class_b0),
+            jnp.asarray(plan.Wc),
+            jnp.asarray(plan.Mc),
+            jnp.asarray(plan.colsum),
+            jnp.asarray(plan.row_of),
+            jnp.asarray(np.asarray(mask, dtype=bool)),
+        )
+
+    # host planner + inner jitted program, exposed so callers that
+    # loop on device (the bench's scan) can plan once and time _run
+    featurize.plan = lambda positions, mask, n_samples: (
+        cached_block_class_plan(
+            np.asarray(positions), np.asarray(mask), int(n_samples),
+            wavelet_index=wavelet_index, epoch_size=epoch_size,
+            skip_samples=skip_samples, feature_size=feature_size,
+            pre=pre,
+        )
+    )
+    featurize._run = _run
+    return featurize
 
 
 def ingest_recording(

@@ -281,6 +281,36 @@ def plan_pallas_tiles(
     return PallasTilePlan(half_idx, offsets, src_rows, chunk, tile_b)
 
 
+def cached_plan_pallas_tiles(
+    positions: np.ndarray,
+    pre: int = constants.PRESTIMULUS_SAMPLES,
+    window: int = DEFAULT_WINDOW,
+    chunk: int = 65536,
+    tile_b: int = 32,
+    bucket: bool = True,
+) -> PallasTilePlan:
+    """:func:`plan_pallas_tiles` (+ :func:`bucket_plan_8` when
+    ``bucket``) behind the shared host-plan cache (``ops/plan_cache``),
+    keyed on the marker-layout digest and the tile geometry: a
+    steady-state consumer re-featurizing the same recording does zero
+    host re-planning — the greedy sort/pack runs once per layout."""
+    from . import plan_cache as _pc
+
+    positions = np.asarray(positions)
+    key = _pc.digest(
+        positions,
+        extra=("pallas_tiles", pre, window, chunk, tile_b, bucket),
+    )
+
+    def build():
+        plan = plan_pallas_tiles(
+            positions, pre=pre, window=window, chunk=chunk, tile_b=tile_b
+        )
+        return bucket_plan_8(plan) if bucket else plan
+
+    return _pc.cache("pallas_tile_plan").get_or_build(key, build)
+
+
 def _make_kernel(
     n_channels: int, tile_b: int, window: int, chunk: int, pre: int
 ):
@@ -759,16 +789,16 @@ def ingest_features_pallas(
         # interpreter -> exact (the parity anchor)
         mode = "exact" if interpret else "bank128"
     window = kernel_window(mode, pre, skip_samples, epoch_size)
-    plan = plan_pallas_tiles(
-        positions, pre=pre, window=window, chunk=chunk, tile_b=tile_b
-    )
-    half = chunk // 2
-    # Bucket both jit-cache keys so multi-recording runs reuse the
+    # Cached host planning (zero re-planning for a repeated layout),
+    # and bucket both jit-cache keys so multi-recording runs reuse the
     # compiled kernel instead of recompiling per marker layout:
     # (a) tile count rounds up to a multiple of 8 (padded tiles point
     # at block 0 with src_rows -1 and are dropped on unsort);
     # (b) the raw sample axis rounds up to a multiple of 8 chunks.
-    plan = bucket_plan_8(plan)
+    plan = cached_plan_pallas_tiles(
+        positions, pre=pre, window=window, chunk=chunk, tile_b=tile_b
+    )
+    half = chunk // 2
     # every referenced half-chunk (hi and hi+1) must exist
     needed = (int(plan.half_idx.max(initial=0)) + 2) * half
     C, S = raw_i16.shape

@@ -25,8 +25,9 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .shardmap_compat import shard_map
 
 from ..epochs.extractor import BalanceState
 from ..ops import device_ingest

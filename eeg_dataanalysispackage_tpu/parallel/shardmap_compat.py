@@ -1,0 +1,21 @@
+"""Version-compat import for ``shard_map``.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to a
+top-level ``jax.shard_map`` export (jax >= 0.6); importing it from
+``jax`` directly on the older line (0.4.x/0.5.x — the installed
+toolchain) raises ImportError at module import time, which kills test
+COLLECTION for every module in the dependency chain, not just the
+sharded paths. Both homes accept the same ``(f, mesh=..., in_specs=...,
+out_specs=...)`` keyword call shape used throughout ``parallel/``, so
+one try/except covers every jax this package supports. Import it from
+here, never from jax directly.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.6: the graduated top-level export
+    from jax import shard_map  # type: ignore[attr-defined]
+except ImportError:  # jax <= 0.5: the experimental home
+    from jax.experimental.shard_map import shard_map
+
+__all__ = ["shard_map"]

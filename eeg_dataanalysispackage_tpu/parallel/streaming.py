@@ -29,11 +29,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from jax import shard_map
-
 from ..ops import dwt as dwt_xla
 from ..ops.signal import bandpass_mask
 from . import mesh as pmesh
+from .shardmap_compat import shard_map
 
 
 def _window_starts(block_len: int, stride: int) -> np.ndarray:

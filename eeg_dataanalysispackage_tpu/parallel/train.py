@@ -16,6 +16,7 @@ sequence-parallel dimension, exercised in ``parallel/streaming.py``):
 
 from __future__ import annotations
 
+import functools
 from typing import Dict, Tuple
 
 import jax
@@ -63,18 +64,38 @@ def forward_step(params: Dict[str, jnp.ndarray], epochs: jnp.ndarray) -> jnp.nda
     return forward(params, extract_features(epochs))[:, 0]
 
 
-def make_train_step(mesh=None, learning_rate: float = 0.05, momentum: float = 0.9):
+def make_train_step(
+    mesh=None,
+    learning_rate: float = 0.05,
+    momentum: float = 0.9,
+    donate_state: bool = True,
+    donate_epochs: bool = False,
+):
     """Build (init_state, train_step) for the full pipeline.
 
     ``train_step(state, epochs, labels, mask) -> (state, loss)`` is one
     jitted program; with a mesh, ``epochs``/``labels``/``mask`` are
     expected sharded over the data axis and params replicated.
+
+    ``donate_state`` (default on) donates the incoming state's buffers
+    to the update — params/optimizer memory is reused in place instead
+    of sitting double-resident in HBM for the step. Callers must
+    rebind (``state, loss = train_step(state, ...)``), which every
+    consumer of this functional-update contract already does; pass
+    ``False`` to keep the old state alive (e.g. for A/B comparisons).
+    ``donate_epochs`` (opt-in) additionally donates the epoch batch —
+    at (B, C, 1000) f32 the single biggest buffer of a step — correct
+    only when each step consumes a fresh batch (the streaming case),
+    never when the caller re-feeds the same staged batch.
     """
     init_state, feat_step = make_feature_train_step(
-        mesh, learning_rate, momentum
+        mesh, learning_rate, momentum, donate_state=donate_state
     )
+    donate = (0,) if donate_state else ()
+    if donate_epochs:
+        donate = donate + (1,)
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=donate)
     def train_step(state, epochs, labels, mask):
         # features are constant w.r.t. params, so extracting before
         # the grad is exactly the fused-in-loss formulation; one jit
@@ -92,6 +113,8 @@ def make_compact_train_step(
     epoch_size: int = 512,
     feature_size: int = 16,
     n_channels: int = 3,
+    donate_state: bool = True,
+    donate_epochs: bool = False,
 ):
     """(init_state, step) over COMPACT-RESIDENT epochs: ``step(state,
     epochs_512, labels, mask)`` with ``epochs_512`` of shape
@@ -101,13 +124,19 @@ def make_compact_train_step(
     .make_compact_extractor): :func:`make_train_step` reads the full
     (B, C, 1000) layout to consume 512 columns
     (WaveletTransform.java:127-130); storing epochs pre-sliced halves
-    the step's dominant HBM read (12000 -> 6144 B/epoch f32)."""
+    the step's dominant HBM read (12000 -> 6144 B/epoch f32).
+    ``donate_state``/``donate_epochs`` follow
+    :func:`make_train_step`'s buffer-donation contract."""
     init_state, feat_step = make_feature_train_step(
         mesh, learning_rate, momentum,
         feature_dim=n_channels * feature_size,
+        donate_state=donate_state,
     )
+    donate = (0,) if donate_state else ()
+    if donate_epochs:
+        donate = donate + (1,)
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=donate)
     def step(state, epochs_512, labels, mask):
         feats = dwt_xla.compact_epoch_features(
             epochs_512, wavelet_index, epoch_size, feature_size
@@ -122,12 +151,16 @@ def make_feature_train_step(
     learning_rate: float = 0.05,
     momentum: float = 0.9,
     feature_dim: int = 48,
+    donate_state: bool = True,
 ):
     """(init_state, step) on precomputed (B, feature_dim) features —
     the MLP half of :func:`make_train_step`, for callers that produce
     features by other fused paths (e.g. the raw-stream step below).
     ``feature_dim`` sizes the MLP input (default 48 = 3 channels x
-    16 DWT features)."""
+    16 DWT features). ``donate_state`` (default on) donates the
+    incoming state to the update — the params/optimizer buffers are
+    reused in place; callers rebind the returned state (the
+    functional-update contract every consumer already follows)."""
     tx = optax.sgd(learning_rate, momentum=momentum, nesterov=True)
 
     def init_state(key):
@@ -143,7 +176,9 @@ def make_feature_train_step(
         per_example = -jnp.sum(y * jnp.log(p), axis=1) * mask
         return per_example.sum() / jnp.maximum(mask.sum(), 1.0)
 
-    @jax.jit
+    @functools.partial(
+        jax.jit, donate_argnums=(0,) if donate_state else ()
+    )
     def step(state, features, labels, mask):
         loss, grads = jax.value_and_grad(loss_fn)(
             state["params"], features, labels, mask
@@ -164,20 +199,23 @@ def make_raw_train_step(
     learning_rate: float = 0.05,
     momentum: float = 0.9,
     formulation: str = "auto",
+    donate_state: bool = True,
 ):
     """Train straight from the int16 stream: one step =
     fused regular-SOA ingest (ops/device_ingest, ~4.8 KB HBM/epoch vs
     the 12 KB of f32-resident epochs) -> features -> MLP fwd/bwd ->
     update. ``step(state, raw_i16, resolutions, labels, mask,
     first_position)``; ``first_position`` is a host int (the
-    featurizer's phase planning is host-side)."""
+    featurizer's phase planning is host-side). ``donate_state``
+    follows :func:`make_feature_train_step`'s donation contract (the
+    raw stream itself is never donated — it is reused every step)."""
     from ..ops import device_ingest
 
     ing = device_ingest.make_regular_ingest_featurizer(
         stride, n_epochs, formulation=formulation
     )
     init_state, feat_step = make_feature_train_step(
-        mesh, learning_rate, momentum
+        mesh, learning_rate, momentum, donate_state=donate_state
     )
 
     def step(state, raw_i16, resolutions, labels, mask, first_position):
@@ -192,6 +230,7 @@ def make_irregular_train_step(
     learning_rate: float = 0.05,
     momentum: float = 0.9,
     chunk_epochs: int = 32768,
+    donate_state: bool = True,
 ):
     """Train straight from the int16 stream with IRREGULAR markers:
     one step = block-gather fused ingest (the gather-free irregular
@@ -217,10 +256,12 @@ def make_irregular_train_step(
         chunk_epochs=chunk_epochs
     )
     init_state, feat_step = make_feature_train_step(
-        mesh, learning_rate, momentum
+        mesh, learning_rate, momentum, donate_state=donate_state
     )
 
-    @jax.jit
+    @functools.partial(
+        jax.jit, donate_argnums=(0,) if donate_state else ()
+    )
     def step(state, raw_i16, resolutions, positions, mask, labels):
         feats = featurize(raw_i16, resolutions, positions, mask)
         return feat_step(state, feats, labels, mask.astype(feats.dtype))
@@ -242,6 +283,7 @@ def make_irregular_bank_train_step(
     skip_samples: int = 175,
     feature_size: int = 16,
     pre: int | None = None,
+    donate_state: bool = True,
 ):
     """Irregular raw-stream training through the bank128 Pallas
     featurizer (``ops/ingest_pallas.py``): windows cut in VMEM, none
@@ -281,10 +323,11 @@ def make_irregular_bank_train_step(
     window = ip.kernel_window(
         mode, pre=pre, skip_samples=skip_samples, epoch_size=epoch_size
     )
-    plan = ip.bucket_plan_8(
-        ip.plan_pallas_tiles(
-            positions, pre=pre, window=window, chunk=chunk, tile_b=tile_b
-        )
+    # cached host planning (ops/plan_cache): rebuilding a step for the
+    # same marker layout — checkpoint restore, repeated experiment —
+    # reuses the tile plan instead of re-running the sort/pack
+    plan = ip.cached_plan_pallas_tiles(
+        positions, pre=pre, window=window, chunk=chunk, tile_b=tile_b
     )
     half = chunk // 2
     needed = (int(plan.half_idx.max(initial=0)) + 2) * half
@@ -306,9 +349,14 @@ def make_irregular_bank_train_step(
     init_state, feat_step = make_feature_train_step(
         mesh, learning_rate, momentum,
         feature_dim=n_channels * feature_size,
+        donate_state=donate_state,
     )
 
-    @_partial(jax.jit, static_argnames=("interpret",))
+    @_partial(
+        jax.jit,
+        static_argnames=("interpret",),
+        donate_argnums=(0,) if donate_state else (),
+    )
     def _bank_step(state, raw_i16, resolutions, labels, *, interpret):
         C, S = raw_i16.shape
         if C != n_channels:

@@ -56,6 +56,18 @@ class PipelineBuilder:
         query_map = get_query_map(self.query)
         logger.info("query: %s", query_map)
 
+        # persistent XLA compilation cache before any device work:
+        # fresh-chip compiles of the fused variants ran 10-14 min in
+        # the r4 sweep, and a repeat run of the same query must read
+        # the serialized executable instead (utils/compile_cache;
+        # EEG_TPU_COMPILE_CACHE_DIR overrides, EEG_TPU_NO_COMPILE_CACHE
+        # disables, failures degrade to plain compiles)
+        from ..utils import compile_cache
+
+        cache_dir = compile_cache.enable_persistent_cache()
+        if cache_dir:
+            logger.info("persistent compile cache: %s", cache_dir)
+
         # net-new observability: trace_path=<dir> wraps the run in a
         # jax.profiler trace (device + annotated host activity),
         # viewable in TensorBoard/Perfetto

@@ -1,0 +1,113 @@
+"""Persistent XLA compilation-cache wiring.
+
+Fresh-chip compiles of the fused-ingest programs ran 10-14 minutes in
+the r4 sweep (tools/sweep_results/r4/watch.log) — long enough to time
+out bench variants and to dominate any short pipeline run — yet JAX's
+persistent compilation cache ships disabled. This module is the one
+place the package turns it on: resolve a cache directory (explicit
+argument > ``EEG_TPU_COMPILE_CACHE_DIR`` > the standard
+``JAX_COMPILATION_CACHE_DIR`` > a per-user scratch default), create
+it, and point ``jax.config`` at it, so the second process compiling
+the same program reads a serialized executable instead of re-running
+the compiler.
+
+Consumers: ``pipeline/builder.py`` enables it for every query run,
+``bench.py``/``tools/ingest_bench.py`` for every bench child (the
+bench defaults to the repo-local ``.jax_compile_cache`` scratch dir
+so repeat runs are warm), and ``run.sh`` exports the directory so the
+CLI inherits it. ``EEG_TPU_NO_COMPILE_CACHE=1`` opts out everywhere.
+
+This module must stay importable without jax: the bench parent
+process resolves the directory for its children but never touches a
+backend itself.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+#: explicit package-level override for the cache directory.
+ENV_DIR = "EEG_TPU_COMPILE_CACHE_DIR"
+#: the standard JAX variable — respected when already set.
+ENV_JAX_DIR = "JAX_COMPILATION_CACHE_DIR"
+#: set to "1" to disable persistent caching entirely.
+ENV_DISABLE = "EEG_TPU_NO_COMPILE_CACHE"
+#: minimum compile seconds worth persisting (JAX-standard variable).
+ENV_MIN_COMPILE = "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"
+
+#: don't persist trivial compiles: sub-second CPU test compiles would
+#: only churn the cache; the compiles this exists for run minutes.
+DEFAULT_MIN_COMPILE_SECS = 5.0
+
+
+def default_cache_dir() -> str:
+    """Per-user scratch default (XDG-style) for non-bench runs."""
+    root = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache"
+    )
+    return os.path.join(root, "eeg-tpu", "jax-compile-cache")
+
+
+def resolve_cache_dir(path: Optional[str] = None) -> Optional[str]:
+    """The directory persistent caching should use, or None when
+    disabled. Precedence: explicit ``path`` > ``EEG_TPU_COMPILE_CACHE_DIR``
+    > ``JAX_COMPILATION_CACHE_DIR`` > the per-user default."""
+    if os.environ.get(ENV_DISABLE) == "1":
+        return None
+    return (
+        path
+        or os.environ.get(ENV_DIR)
+        or os.environ.get(ENV_JAX_DIR)
+        or default_cache_dir()
+    )
+
+
+def prime_env(default_dir: Optional[str] = None) -> Optional[str]:
+    """Resolve the cache dir and export it as environment for child
+    processes / a not-yet-imported jax (the bench parent's path — it
+    must configure children without importing jax itself). Returns
+    the exported directory, or None when caching is disabled."""
+    d = resolve_cache_dir(
+        os.environ.get(ENV_DIR) or os.environ.get(ENV_JAX_DIR) or default_dir
+    )
+    if d is None:
+        return None
+    os.environ[ENV_JAX_DIR] = d
+    os.environ.setdefault(ENV_MIN_COMPILE, str(DEFAULT_MIN_COMPILE_SECS))
+    return d
+
+
+def enable_persistent_cache(path: Optional[str] = None) -> Optional[str]:
+    """Turn the persistent compilation cache on for THIS process.
+
+    Returns the active cache directory, or None when disabled or the
+    directory cannot be created (an unwritable scratch dir must never
+    kill a pipeline run — cache misses just degrade to plain
+    compiles). Idempotent; safe before or after backend init."""
+    d = resolve_cache_dir(path)
+    if d is None:
+        return None
+    try:
+        os.makedirs(d, exist_ok=True)
+    except OSError:
+        return None
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", d)
+    try:
+        min_secs = float(
+            os.environ.get(ENV_MIN_COMPILE, DEFAULT_MIN_COMPILE_SECS)
+        )
+    except ValueError:
+        min_secs = DEFAULT_MIN_COMPILE_SECS
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", min_secs)
+    return d
+
+
+def active_cache_dir() -> Optional[str]:
+    """The directory this process's jax is actually configured with
+    (ground truth for the bench's ``compile_cache`` payload field)."""
+    import jax
+
+    return jax.config.jax_compilation_cache_dir or None

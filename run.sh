@@ -8,4 +8,14 @@
 # LOGFILE_NAME is the -Dlogfile.name analogue (obs.configure_logging).
 set -euo pipefail
 cd "$(dirname "$0")"
+
+# Persistent XLA compile cache (docs/caches.md): repeat runs of the
+# same query read serialized executables instead of re-paying the
+# fused-program compiles (10-14 min on a fresh chip in the r4 sweep).
+# Respect an explicit EEG_TPU_COMPILE_CACHE_DIR / JAX standard var;
+# EEG_TPU_NO_COMPILE_CACHE=1 opts out (pipeline/builder.py honors it).
+if [ "${EEG_TPU_NO_COMPILE_CACHE:-0}" != "1" ]; then
+  export EEG_TPU_COMPILE_CACHE_DIR="${EEG_TPU_COMPILE_CACHE_DIR:-${JAX_COMPILATION_CACHE_DIR:-$PWD/.jax_compile_cache}}"
+fi
+
 exec python -m eeg_dataanalysispackage_tpu.pipeline.cli "$@"

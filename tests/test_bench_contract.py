@@ -7,7 +7,16 @@ chip numbers sat in sweep_results), its timestamp provenance rules
 (self-stamped payloads beat git-rewritten file mtimes), and the
 advisory collection lock that keeps a driver-launched bench from
 racing a staged chip collection for the tunnel (concurrent tunnel
-use is the documented wedge class — tools/tunnel_watch.sh)."""
+use is the documented wedge class — tools/tunnel_watch.sh).
+
+Also pins the ISSUE-1 attribution contract: the parent exports one
+persistent compile-cache dir to every child (utils/compile_cache,
+jax-free in the parent), and each variant payload carries
+``plan_cache`` hit/miss counters and the active ``compile_cache``
+directory, so a BENCH-trajectory speedup is attributable to warm
+plans/compiles vs kernel changes. The variant-payload test is the
+one test here that runs real (CPU) device work — a tiny
+``block_ingest`` measurement through tools/ingest_bench.run."""
 
 import importlib.util
 import json
@@ -150,6 +159,83 @@ source <(sed 's|python |true python |g' tools/collect_chip_runs_r4b.sh)
     # every staged run produced its artifact (evidence hygiene)
     assert (out / "bench_early.json").exists()
     assert (out / "bench_full.json").exists()
+
+
+def test_parent_exports_compile_cache_to_children():
+    """bench.py never imports jax (resilience contract) but must
+    still hand every child one persistent compile-cache dir via the
+    environment, so a repeat bench run reads serialized executables
+    instead of re-paying the 10-14 min fused-program compiles."""
+    assert bench._COMPILE_CACHE_DIR
+    env = bench._cpu_env()
+    assert env["JAX_COMPILATION_CACHE_DIR"] == bench._COMPILE_CACHE_DIR
+    # trivial sub-second CPU compiles are not worth persisting
+    assert float(env["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]) > 0
+
+
+def test_compile_cache_resolution_precedence(monkeypatch):
+    from eeg_dataanalysispackage_tpu.utils import compile_cache as cc
+
+    monkeypatch.delenv(cc.ENV_DISABLE, raising=False)
+    monkeypatch.setenv(cc.ENV_DIR, "/pkg-dir")
+    monkeypatch.setenv(cc.ENV_JAX_DIR, "/jax-std-dir")
+    assert cc.resolve_cache_dir("/explicit") == "/explicit"
+    assert cc.resolve_cache_dir() == "/pkg-dir"
+    monkeypatch.delenv(cc.ENV_DIR)
+    assert cc.resolve_cache_dir() == "/jax-std-dir"
+    monkeypatch.delenv(cc.ENV_JAX_DIR)
+    assert cc.resolve_cache_dir()  # per-user scratch default
+    # the kill switch beats everything, including an explicit path
+    monkeypatch.setenv(cc.ENV_DISABLE, "1")
+    assert cc.resolve_cache_dir("/explicit") is None
+    assert cc.prime_env("/somewhere") is None
+
+
+def test_variant_payload_carries_cache_attribution_fields():
+    """Every variant JSON line records the host-plan cache counters
+    and the compile-cache directory in effect (None = caching off) —
+    the fields that let a BENCH trajectory attribute a throughput
+    move to warm plans/compiles instead of guessing. block_ingest
+    exercises a real planner, so its misses must be nonzero."""
+    import importlib.util as iu
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = iu.spec_from_file_location(
+        "ingest_bench", os.path.join(repo, "tools", "ingest_bench.py")
+    )
+    ib = iu.module_from_spec(spec)
+    spec.loader.exec_module(ib)
+
+    payload = ib.run("block_ingest", 64, 2)
+    assert set(payload["plan_cache"]) == {"hits", "misses"}
+    assert payload["plan_cache"]["misses"] >= 1
+    assert payload["compile_cache"] is None or isinstance(
+        payload["compile_cache"], str
+    )
+
+    # schema-stable on variants that never plan, too
+    payload2 = ib.run("einsum", 64, 2)
+    assert set(payload2["plan_cache"]) == {"hits", "misses"}
+
+
+def test_collect_propagates_cache_attribution_fields(monkeypatch):
+    """The parent's variant whitelist must carry the child's
+    plan_cache/compile_cache fields into the published line."""
+    monkeypatch.setattr(bench, "_VARIANTS_CPU", {"einsum": (8, 2)})
+    monkeypatch.setattr(
+        bench,
+        "_run_variant",
+        lambda name, platform, n, iters: {
+            "epochs_per_s": 1.0,
+            "bytes_per_epoch": 12000,
+            "n": n,
+            "plan_cache": {"hits": 3, "misses": 1},
+            "compile_cache": "/tmp/cc",
+        },
+    )
+    v = bench._collect("cpu_fallback")["variants"]["einsum"]
+    assert v["plan_cache"] == {"hits": 3, "misses": 1}
+    assert v["compile_cache"] == "/tmp/cc"
 
 
 def test_probe_respects_lock_before_touching_the_tunnel(

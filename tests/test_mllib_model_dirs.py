@@ -246,6 +246,28 @@ def test_rf_vote_combining(tmp_path):
     )
 
 
+def test_rf_vote_tie_goes_to_class_one(tmp_path):
+    """Spark 1.6 ``predictByVoting`` takes ``maxBy`` over a
+    ``mutable.HashMap`` whose iteration order for the binary keys
+    {0, 1} is fixed by the hash table (key 1's bucket iterates before
+    key 0's; see MLlibTreeEnsemble.predict), so an exact weighted tie
+    deterministically predicts class 1.0 — independent of tree order
+    (ADVICE divergence). Pinned in BOTH tree orders."""
+    t1 = _manual_tree()
+    t1["predict"] = np.ones(5)  # always votes 1
+    t0 = _manual_tree()
+    t0["predict"] = np.zeros(5)  # always votes 0
+    X = _features(8)
+
+    for name, trees in (("rf_1_first", [t1, t0]),
+                        ("rf_0_first", [t0, t1])):
+        d = str(tmp_path / name)
+        mf.write_tree_ensemble(d, mf.TREE_RF, trees)
+        clf = RandomForestClassifier()
+        clf.load(d)
+        np.testing.assert_array_equal(clf.predict(X), np.ones(8))
+
+
 def test_gbt_sum_combining(tmp_path):
     # regression trees emitting margins; Sum with treeWeights, label
     # = 1 iff weighted sum > 0 (GradientBoostedTreesModel predict)
@@ -710,6 +732,106 @@ def test_remote_uri_export_uploads_through_modelfiles(monkeypatch):
     assert "gs://bucket/models/logreg/metadata/_SUCCESS" in names
     assert "gs://bucket/models/logreg/data/_SUCCESS" in names
     assert not os.path.exists("gs:")  # no junk local dir
+
+
+def test_glm_parquet_embeds_spark_row_metadata(tmp_path):
+    """Spark 1.6's ``GLMClassificationModel.SaveLoadV1_0.loadData``
+    pattern-matches ``Row(weights: Vector, ...)``; without the
+    VectorUDT ``udt`` entry in the
+    ``org.apache.spark.sql.parquet.row.metadata`` footer key the row
+    deserializes as a plain struct and throws MatchError on the
+    cluster (ADVICE, medium). Tree exports stay footer-free (NodeData
+    has no UDT)."""
+    import pyarrow.parquet as pq
+
+    d = str(tmp_path / "glm")
+    mf.write_glm(d, mf.GLM_LOGREG, RNG.randn(8))
+    (part,) = [
+        n
+        for n in os.listdir(os.path.join(d, "data"))
+        if n.startswith("part-")
+    ]
+    meta = pq.read_schema(os.path.join(d, "data", part)).metadata
+    schema = json.loads(
+        meta[b"org.apache.spark.sql.parquet.row.metadata"]
+    )
+    fields = {f["name"]: f for f in schema["fields"]}
+    assert list(fields) == ["weights", "intercept", "threshold"]
+    wt = fields["weights"]["type"]
+    assert wt["type"] == "udt"
+    assert wt["class"] == "org.apache.spark.mllib.linalg.VectorUDT"
+    assert [f["name"] for f in wt["sqlType"]["fields"]] == [
+        "type", "size", "indices", "values",
+    ]
+    assert fields["intercept"]["type"] == "double"
+    # our own reader still round-trips the tagged file
+    np.testing.assert_equal(mf.read_glm(d).weights.shape, (8,))
+
+    d2 = str(tmp_path / "tree")
+    mf.write_tree_ensemble(d2, mf.TREE_DT, [_manual_tree()])
+    (part2,) = [
+        n
+        for n in os.listdir(os.path.join(d2, "data"))
+        if n.startswith("part-")
+    ]
+    tmeta = pq.read_schema(os.path.join(d2, "data", part2)).metadata
+    assert not tmeta or (
+        b"org.apache.spark.sql.parquet.row.metadata" not in tmeta
+    )
+
+
+def test_remote_export_refuses_stale_uuid_parts(monkeypatch):
+    """A listing-capable filesystem WITHOUT recursive delete: a
+    directory Spark itself wrote holds uuid-suffixed part files
+    (part-r-00000-<uuid>.gz.parquet) that deterministic naming never
+    overwrites — the export must refuse before uploading anything,
+    not silently coexist into a corrupt concatenated model (ADVICE,
+    low). Our own previous export (matching names) still overwrites."""
+    from eeg_dataanalysispackage_tpu.io import modelfiles
+
+    uploaded = {}
+    monkeypatch.setattr(
+        modelfiles,
+        "write_model_bytes",
+        lambda path, data: uploaded.__setitem__(path, data),
+    )
+
+    class SparkWrittenFs:
+        def list_dir(self, path):
+            if path.endswith("/data"):
+                return [
+                    "part-r-00000-8bba3c02-bf4c-4bde.gz.parquet",
+                    "_SUCCESS",
+                ]
+            return ["part-00000", "_SUCCESS"]
+
+    monkeypatch.setattr(
+        modelfiles, "_fs_for", lambda p: SparkWrittenFs()
+    )
+    with pytest.raises(IOError, match="part files"):
+        mf.write_glm("hdfs://nn/models/m", mf.GLM_LOGREG, RNG.randn(8))
+    assert not uploaded  # refused before the first upload
+
+    class OurOwnExportFs:
+        def list_dir(self, path):
+            if path.endswith("/data"):
+                return ["part-r-00000.gz.parquet", "_SUCCESS"]
+            return ["part-00000", "_SUCCESS"]
+
+    monkeypatch.setattr(
+        modelfiles, "_fs_for", lambda p: OurOwnExportFs()
+    )
+    mf.write_glm("hdfs://nn/models/m", mf.GLM_LOGREG, RNG.randn(8))
+    assert any(p.endswith(".gz.parquet") for p in uploaded)
+
+    class FreshTargetFs:  # no dir yet: FileNotFoundError is fine
+        def list_dir(self, path):
+            raise FileNotFoundError(path)
+
+    monkeypatch.setattr(
+        modelfiles, "_fs_for", lambda p: FreshTargetFs()
+    )
+    mf.write_glm("hdfs://nn/models/fresh", mf.GLM_LOGREG, RNG.randn(8))
 
 
 def test_pipeline_load_clf_from_mllib_dir(tmp_path, fixture_dir):

@@ -420,7 +420,12 @@ class TestIrregularTrainStep:
         from eeg_dataanalysispackage_tpu.parallel import train as ptrain
 
         raw, res, pos, mask, labels = self._case()
-        init_state, step = ptrain.make_irregular_train_step()
+        # the A/B comparison feeds the SAME state to two independent
+        # steps — the documented donate_state=False case (the default
+        # donates the state's buffers to the update)
+        init_state, step = ptrain.make_irregular_train_step(
+            donate_state=False
+        )
         state = init_state(jax.random.PRNGKey(1))
         _, loss_a = step(
             state, jnp.asarray(raw), jnp.asarray(res),

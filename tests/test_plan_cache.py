@@ -1,0 +1,335 @@
+"""The fused-ingest hot-path caches and buffer-donation contracts.
+
+Pins the ISSUE-1 perf machinery: the shared host gather-plan cache
+(``ops/plan_cache``) that the block/Pallas planners memoize through
+(same marker layout -> the SAME plan object, zero re-planning; any
+input change -> a rebuild), the alignment-classed block featurizer's
+parity with the traced formulations it replaces, and the
+``donate_argnums`` threading through the jitted extractor / train-step
+entry points (a donated buffer must actually be invalidated, and the
+opt-out must actually keep it alive)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from eeg_dataanalysispackage_tpu.ops import (
+    device_ingest,
+    dwt as dwt_xla,
+    ingest_pallas,
+    plan_cache,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_caches():
+    """Counter/entry isolation: every test sees a cold plan cache."""
+    plan_cache.clear()
+    yield
+    plan_cache.clear()
+
+
+def _irregular_case(n=40, seed=3, cap=64, S=60_000):
+    rng = np.random.RandomState(seed)
+    raw = rng.randint(-3000, 3000, size=(3, S)).astype(np.int16)
+    res = np.array([0.1, 0.1, 0.2], np.float32)
+    positions = np.sort(
+        rng.choice(np.arange(200, S - 900), size=n, replace=False)
+    ).astype(np.int32)
+    pos = np.zeros(cap, np.int32)
+    pos[:n] = positions
+    mask = np.zeros(cap, bool)
+    mask[:n] = True
+    return raw, res, pos, mask
+
+
+# ---------------------------------------------------------- the memo
+
+
+def test_digest_keys_on_content_shape_dtype_and_extra():
+    a = np.arange(8, dtype=np.int32)
+    base = plan_cache.digest(a, extra=("geom", 512))
+    assert base == plan_cache.digest(a.copy(), extra=("geom", 512))
+    changed = a.copy()
+    changed[3] += 1
+    assert plan_cache.digest(changed, extra=("geom", 512)) != base
+    assert plan_cache.digest(a.astype(np.int64), extra=("geom", 512)) != base
+    assert plan_cache.digest(a.reshape(2, 4), extra=("geom", 512)) != base
+    assert plan_cache.digest(a, extra=("geom", 513)) != base
+
+
+def test_get_or_build_hits_and_evicts(monkeypatch):
+    monkeypatch.setenv("EEG_TPU_PLAN_CACHE_SIZE", "2")
+    c = plan_cache.cache("unit")
+    builds = []
+
+    def builder(tag):
+        builds.append(tag)
+        return {"plan": tag}
+
+    first = c.get_or_build("k1", lambda: builder("k1"))
+    # hit: the SAME object comes back, the builder does not run again
+    assert c.get_or_build("k1", lambda: builder("k1-again")) is first
+    assert builds == ["k1"]
+    assert c.stats() == {"hits": 1, "misses": 1, "entries": 1}
+    # capacity 2: a third key evicts the least-recently-used one
+    c.get_or_build("k2", lambda: builder("k2"))
+    c.get_or_build("k3", lambda: builder("k3"))
+    rebuilt = c.get_or_build("k1", lambda: builder("k1-rebuilt"))
+    assert rebuilt is not first and rebuilt["plan"] == "k1-rebuilt"
+
+
+def test_stats_aggregate_is_schema_stable():
+    s = plan_cache.stats()
+    # zeros before any planner runs — the bench field relies on this
+    # (named caches persist in the registry; clear() zeroes them)
+    assert s["hits"] == 0 and s["misses"] == 0
+    assert all(
+        c["hits"] == 0 and c["misses"] == 0 and c["entries"] == 0
+        for c in s["caches"].values()
+    )
+
+
+def test_per_cache_capacity_override():
+    """A cache created with its own capacity ignores the shared
+    default bound — how the MB-scale block-class operator cache stays
+    small while layout-plan caches keep the roomy default."""
+    c = plan_cache.cache("unit_capped", capacity=1)
+    c.get_or_build("a", lambda: "A")
+    c.get_or_build("b", lambda: "B")  # evicts "a"
+    assert c.get_or_build("a", lambda: "A2") == "A2"
+    assert c.stats()["entries"] == 1
+
+
+# --------------------------------------- the block-class gather plan
+
+
+def test_block_class_plan_cache_hit_and_miss():
+    raw, _res, pos, mask = _irregular_case()
+    kw = dict(wavelet_index=8, epoch_size=512, skip_samples=175,
+              feature_size=16)
+    p1 = device_ingest.cached_block_class_plan(
+        pos, mask, raw.shape[1], **kw
+    )
+    p2 = device_ingest.cached_block_class_plan(
+        pos, mask, raw.shape[1], **kw
+    )
+    assert p2 is p1  # same layout -> the cached plan object, re-planned
+    stats = plan_cache.cache("block_class_plan").stats()
+    assert stats["hits"] == 1 and stats["misses"] == 1
+    # any marker moves -> a different key -> a rebuild
+    moved = pos.copy()
+    moved[0] += 1
+    p3 = device_ingest.cached_block_class_plan(
+        moved, mask, raw.shape[1], **kw
+    )
+    assert p3 is not p1
+    # ... and so does a different staged length (the clip boundary)
+    p4 = device_ingest.cached_block_class_plan(
+        pos, mask, raw.shape[1] + 128, **kw
+    )
+    assert p4 is not p1
+    assert plan_cache.cache("block_class_plan").stats()["misses"] == 3
+
+
+def test_pallas_tile_plan_cache_hit_and_miss():
+    _raw, _res, pos, mask = _irregular_case()
+    positions = pos[mask]
+    p1 = ingest_pallas.cached_plan_pallas_tiles(positions)
+    assert ingest_pallas.cached_plan_pallas_tiles(positions) is p1
+    stats = plan_cache.cache("pallas_tile_plan").stats()
+    assert stats["hits"] == 1 and stats["misses"] == 1
+    moved = positions.copy()
+    moved[-1] += 7
+    assert ingest_pallas.cached_plan_pallas_tiles(moved) is not p1
+    # geometry participates in the key (same layout, different tiling)
+    assert ingest_pallas.cached_plan_pallas_tiles(
+        positions, tile_b=16
+    ) is not p1
+
+
+# ------------------------------------ classed block featurizer parity
+
+
+def test_classed_block_matches_gather_all_residues():
+    """The alignment-classed formulation must match the gather+einsum
+    featurizer with every one of the 128 shift-residue classes
+    populated (positions step by a stride coprime to 128) on DC-heavy
+    data — a misplaced class operator or row_of slot fails here."""
+    rng = np.random.RandomState(7)
+    n, cap = 128, 192
+    dc = np.array([[1800], [-2200], [900]], np.int16)
+    step = 901  # coprime to 128 -> all residues in 128 windows
+    positions = (200 + step * np.arange(n)).astype(np.int32)
+    assert len(set((positions - 100) % 128)) == 128
+    S = int(positions.max()) + 2000
+    raw = (rng.randint(-3000, 3000, size=(3, S)) + dc).astype(np.int16)
+    res = np.array([0.1, 0.1, 0.2], np.float32)
+    pos = np.zeros(cap, np.int32)
+    pos[:n] = positions
+    mask = np.zeros(cap, bool)
+    mask[:n] = True
+    gather = device_ingest.make_device_ingest_featurizer()
+    classed = device_ingest.make_classed_block_ingest_featurizer()
+    want = np.asarray(
+        gather(jnp.asarray(raw), jnp.asarray(res), jnp.asarray(pos),
+               jnp.asarray(mask))
+    )
+    got = np.asarray(classed(jnp.asarray(raw), res, pos, mask))
+    assert got.shape == want.shape == (cap, 48)
+    np.testing.assert_allclose(got, want, rtol=0, atol=5e-6)
+    # padded rows zeroed
+    assert np.abs(got[n:]).max() == 0.0
+    # the call planned exactly once
+    assert plan_cache.cache("block_class_plan").stats()["misses"] == 1
+
+
+def test_classed_block_edges_and_overhang_match_gather():
+    """Window at start 0 and a window overhanging the recording end
+    (Java copyOfRange zero-pad semantics) through the classed path."""
+    rng = np.random.RandomState(5)
+    S = 6000
+    raw = rng.randint(-3000, 3000, size=(3, S)).astype(np.int16)
+    res = np.array([0.1, 0.1, 0.2], np.float32)
+    pos = np.array([100, 101, 227, S - 300], np.int32)
+    mask = np.ones(4, bool)
+    gather = device_ingest.make_device_ingest_featurizer()
+    classed = device_ingest.make_classed_block_ingest_featurizer()
+    want = np.asarray(
+        gather(jnp.asarray(raw), jnp.asarray(res), jnp.asarray(pos),
+               jnp.asarray(mask))
+    )
+    got = np.asarray(classed(jnp.asarray(raw), res, pos, mask))
+    np.testing.assert_allclose(got, want, rtol=0, atol=5e-6)
+
+
+def test_classed_block_chunked_matches_whole():
+    """The lax.map chunked path (classes x slots > chunk_epochs) is
+    numerically identical to the single-shot program."""
+    raw, res, pos, mask = _irregular_case(n=50, cap=64)
+    whole = device_ingest.make_classed_block_ingest_featurizer()
+    chunked = device_ingest.make_classed_block_ingest_featurizer(
+        chunk_epochs=64
+    )
+    a = np.asarray(whole(jnp.asarray(raw), res, pos, mask))
+    b = np.asarray(chunked(jnp.asarray(raw), res, pos, mask))
+    np.testing.assert_allclose(b, a, rtol=0, atol=1e-6)
+
+
+def test_classed_block_rejects_oversized_window_at_build():
+    """Same guard as the traced featurizer: a window that cannot fit
+    the 8-block slab at the worst in-block shift fails deterministically
+    at BUILD time, never as a data-dependent numpy broadcast error
+    when an unluckily-aligned marker shows up (review finding)."""
+    with pytest.raises(ValueError, match="8-block slab"):
+        device_ingest.make_classed_block_ingest_featurizer(
+            epoch_size=640
+        )
+    with pytest.raises(ValueError, match="8-block slab"):
+        device_ingest.plan_block_classes(
+            np.array([220], np.int32), np.array([True]), 5000,
+            epoch_size=640,
+        )
+
+
+def test_block_class_operator_tables_shared_across_layouts():
+    """The MB-scale Wc/Mc operator tables are keyed on the class SET
+    + geometry, not the marker layout: two distinct layouts with the
+    same in-block shifts share one table object, keeping per-layout
+    cache entries KB-scale (review finding)."""
+    _raw, _res, pos, mask = _irregular_case()
+    p1 = device_ingest.cached_block_class_plan(pos, mask, 60_000)
+    # +128 samples: every block index moves, every in-block shift
+    # (and so the class set) stays identical
+    shifted = np.where(mask, pos + 128, pos).astype(pos.dtype)
+    p2 = device_ingest.cached_block_class_plan(shifted, mask, 60_000)
+    assert p2 is not p1  # different layout -> different plan
+    assert p2.Wc is p1.Wc and p2.Mc is p1.Mc  # shared operators
+
+
+# --------------------------------------------------- buffer donation
+
+
+def test_compact_extractor_donation_is_numerically_invisible():
+    """donate_epochs changes buffer lifetime only, never values. The
+    (B, C, 512) -> (B, 48) shapes never alias, so whether the backend
+    can actually retire the donated buffer is platform-dependent (CPU
+    warns 'not usable' and keeps it; TPU reuses the HBM) — the
+    portable contract is that the default call leaves the batch
+    usable and the donated call computes the identical result."""
+    ex_keep = dwt_xla.make_compact_extractor()
+    ex_don = dwt_xla.make_compact_extractor(donate_epochs=True)
+    x = jnp.asarray(np.random.RandomState(0)
+                    .randn(8, 3, 512).astype(np.float32))
+    want = np.asarray(ex_keep(x))
+    assert not x.is_deleted()  # default: caller keeps the batch
+    got = np.asarray(ex_don(x))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_feature_train_step_donates_state_by_default():
+    from eeg_dataanalysispackage_tpu.parallel import train as ptrain
+
+    rng = np.random.RandomState(0)
+    feats = jnp.asarray(rng.randn(16, 48).astype(np.float32))
+    labels = jnp.asarray((rng.rand(16) > 0.5).astype(np.float32))
+    mask = jnp.ones(16, jnp.float32)
+
+    init, step = ptrain.make_feature_train_step()
+    state = init(jax.random.PRNGKey(0))
+    donated_leaf = state["params"]["w1"]
+    state2, loss = step(state, feats, labels, mask)
+    assert np.isfinite(float(loss))
+    assert donated_leaf.is_deleted()  # old params freed, not resident
+    assert not state2["params"]["w1"].is_deleted()
+
+    # opt-out keeps the old state alive (A/B comparison use)
+    init, step_keep = ptrain.make_feature_train_step(donate_state=False)
+    state = init(jax.random.PRNGKey(0))
+    kept_leaf = state["params"]["w1"]
+    step_keep(state, feats, labels, mask)
+    assert not kept_leaf.is_deleted()
+
+
+def test_train_step_donation_preserves_the_update():
+    """Donation must be invisible to the math: the donated and
+    non-donated steps produce identical losses and params from the
+    same start."""
+    from eeg_dataanalysispackage_tpu.parallel import train as ptrain
+
+    rng = np.random.RandomState(1)
+    epochs = rng.randn(8, 3, 1000).astype(np.float32)
+    labels = (rng.rand(8) > 0.5).astype(np.float32)
+    mask = np.ones(8, np.float32)
+
+    init_k, step_keep = ptrain.make_train_step(donate_state=False)
+    state = init_k(jax.random.PRNGKey(2))
+    ref = state
+    losses_keep = []
+    for _ in range(3):
+        state, loss = step_keep(
+            state, jnp.asarray(epochs), jnp.asarray(labels),
+            jnp.asarray(mask),
+        )
+        losses_keep.append(float(loss))
+
+    init_d, step_don = ptrain.make_train_step(
+        donate_state=True, donate_epochs=True
+    )
+    dstate = init_d(jax.random.PRNGKey(2))
+    losses_don = []
+    for _ in range(3):
+        batch = jnp.asarray(epochs)  # fresh batch each step: donatable
+        dstate, loss = step_don(
+            dstate, batch, jnp.asarray(labels), jnp.asarray(mask)
+        )
+        losses_don.append(float(loss))
+    np.testing.assert_allclose(losses_don, losses_keep, rtol=1e-6)
+    for k in dstate["params"]:
+        np.testing.assert_allclose(
+            np.asarray(dstate["params"][k]),
+            np.asarray(state["params"][k]),
+            rtol=0, atol=1e-7,
+        )
+    del ref

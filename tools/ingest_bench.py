@@ -31,9 +31,11 @@ Variants:
   xla_ingest      int16 raw + irregular markers -> features via the
                   XLA gather formulation (ops/device_ingest.py)
   block_ingest    int16 raw + irregular markers -> features via the
-                  tile-row-gather + 128-variant-bank formulation
-                  (make_block_ingest_featurizer) — the XLA-only
-                  replacement for the element gather
+                  tile-row-gather formulation with windows batched by
+                  alignment class (make_classed_block_ingest_featurizer
+                  — one matmul per shift class instead of the
+                  128-variant bank; host plan cached in ops/plan_cache)
+                  — the XLA-only replacement for the element gather
   pallas_ingest   int16 raw + irregular markers -> features via the
                   fused Pallas kernel (ops/ingest_pallas.py)
   pallas_dwt      f32 epochs resident -> features via the Pallas
@@ -85,18 +87,19 @@ import time
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
-# Persistent compilation cache (must be set before jax import): the
-# chip-side fresh compiles of regular_ingest / train_step_raw run
+# Persistent compilation cache (primed into env before jax import):
+# the chip-side fresh compiles of regular_ingest / train_step_raw run
 # 10-14 min (r4 sweep), which is what times bench.py variants out at
 # 420 s — a warm cache turns the second process's compile into a
 # read. Harmless if the backend can't serialize executables (cache
-# misses degrade to a plain compile). BENCH_NO_COMPILE_CACHE opts out.
-if not os.environ.get("BENCH_NO_COMPILE_CACHE"):
-    os.environ.setdefault(
-        "JAX_COMPILATION_CACHE_DIR",
-        os.path.join(_REPO, ".jax_compile_cache"),
-    )
-    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "5")
+# misses degrade to a plain compile). The wiring lives in
+# utils/compile_cache (shared with the pipeline builder and run.sh);
+# BENCH_NO_COMPILE_CACHE opts out, like EEG_TPU_NO_COMPILE_CACHE.
+if os.environ.get("BENCH_NO_COMPILE_CACHE"):
+    os.environ.setdefault("EEG_TPU_NO_COMPILE_CACHE", "1")
+from eeg_dataanalysispackage_tpu.utils import compile_cache as _compile_cache
+
+_compile_cache.prime_env(os.path.join(_REPO, ".jax_compile_cache"))
 
 # v5e HBM bandwidth (GB/s) for roofline context; override for other gens.
 HBM_GBPS = float(os.environ.get("BENCH_HBM_GBPS", 819.0))
@@ -340,7 +343,11 @@ def run(variant: str, n: int, iters: int) -> dict:
             feat = (
                 device_ingest.make_device_ingest_featurizer()
                 if variant == "xla_ingest"
-                else device_ingest.make_block_ingest_featurizer()
+                # the host-planned alignment-classed formulation (one
+                # matmul per shift class instead of the 128-variant
+                # bank) — what the pipeline's fe=...-fused-block mode
+                # ships, so the bench times the shipped path
+                else device_ingest.make_classed_block_ingest_featurizer()
             )
             if variant == "block_ingest":
                 # on-device parity spot check before timing (same
@@ -356,7 +363,7 @@ def run(variant: str, n: int, iters: int) -> dict:
                 got = np.asarray(
                     feat(
                         jnp.asarray(raw_spot), jnp.asarray(res),
-                        jnp.asarray(pos_pad), jnp.asarray(spot_mask),
+                        pos_pad, spot_mask,
                     )
                 )[: len(spot)]
                 block_parity = _check_parity(got, want, 5e-5, "block/gather")
@@ -371,10 +378,29 @@ def run(variant: str, n: int, iters: int) -> dict:
                 jnp.asarray(pos_pad), jnp.asarray(mask),
             )
 
+            if variant == "block_ingest":
+                # host gather plan once (cached in ops/plan_cache);
+                # the timed loop drives the inner jitted program with
+                # the plan arrays closed over — planning is metadata
+                # work per layout, not per step, so the steady state
+                # being measured is plan-free by design
+                plan = feat.plan(pos_pad, mask, raw_p.shape[1])
+                plan_args = (
+                    jnp.asarray(plan.class_b0), jnp.asarray(plan.Wc),
+                    jnp.asarray(plan.Mc), jnp.asarray(plan.colsum),
+                    jnp.asarray(plan.row_of),
+                )
+
+                def step(raw_a, res_a, pos_a, mask_a):
+                    return feat._run(raw_a, res_a, *plan_args, mask_a)
+
+            else:
+                step = feat
+
             @jax.jit
             def loop(raw_a, res_a, pos_a, mask_a):
                 def body(acc, i):
-                    y = feat(
+                    y = step(
                         raw_a, res_a + i.astype(jnp.float32) * 1e-12,
                         pos_a, mask_a,
                     )
@@ -879,6 +905,18 @@ def run(variant: str, n: int, iters: int) -> dict:
     # never be misread as a roofline claim (VERDICT r3 weak #6)
     if platform in ("tpu", "axon"):
         payload["pct_of_hbm_roofline"] = round(100.0 * gbps / HBM_GBPS, 1)
+    # attribution fields (ISSUE 1): every variant line records the
+    # host-plan cache counters for this process and the persistent
+    # compile cache directory in effect (None = caching off), so a
+    # BENCH trajectory can tell a warm-plan/warm-compile speedup from
+    # a kernel change
+    from eeg_dataanalysispackage_tpu.ops import plan_cache as _plan_cache
+
+    pstats = _plan_cache.stats()
+    payload["plan_cache"] = {
+        "hits": pstats["hits"], "misses": pstats["misses"],
+    }
+    payload["compile_cache"] = _compile_cache.active_cache_dir()
     # a failed _check_parity raised above, so published numbers are valid
     if variant == "pallas_ingest":
         payload["tile_fill"] = round(fill, 3)
