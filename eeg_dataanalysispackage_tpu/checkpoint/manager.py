@@ -39,6 +39,33 @@ from flax import serialization
 _STEP_RE = re.compile(r"^step_(\d{8})$")
 
 
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Crash-safe small-file write: tmp sibling + ``os.replace``.
+
+    The byte-level form of the checkpoint store's tmp-then-rename
+    discipline, for single-file artifacts (run reports, metrics
+    dumps): a crash mid-write leaves the previous content (or
+    nothing), never a truncated file.
+    """
+    directory = os.path.dirname(path) or "."
+    tmp = os.path.join(
+        directory, f".tmp-{os.getpid()}-{os.path.basename(path)}"
+    )
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    atomic_write_bytes(path, text.encode("utf-8"))
+
+
 def _to_host(tree):
     """Device arrays -> host numpy (gathers sharded arrays)."""
     return jax.tree_util.tree_map(np.asarray, tree)
@@ -147,6 +174,18 @@ class CheckpointManager:
 
         state = jax.tree_util.tree_map(_restage, template, host_state)
         return state, metadata
+
+    def clear(self) -> None:
+        """Delete every checkpoint under the directory.
+
+        Called when the run the checkpoints protected has COMPLETED:
+        they exist to survive a crash, and leaving them would make
+        the next run under the same directory restore a finished
+        trajectory and silently skip its own training
+        (``run_resumable`` skips steps below ``latest_step``).
+        """
+        for step in self.all_steps():
+            shutil.rmtree(self._step_dir(step))
 
     def _enforce_retention(self) -> None:
         if self.max_to_keep is None:

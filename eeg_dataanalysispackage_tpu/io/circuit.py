@@ -1,0 +1,218 @@
+"""Shared circuit breaker for remote endpoints.
+
+The retry machinery in :mod:`io.remote` bounds the cost of ONE request
+against a flaky endpoint (``max_attempts * timeout + backoff``), but a
+*dead* endpoint still charges that full budget to every call — a
+pipeline touching hundreds of objects over a downed WebHDFS gateway
+stalls for minutes doing nothing but backing off. The reference has no
+answer at all (its Hadoop client blocks until the RPC layer gives up,
+per call, forever).
+
+This module is the classic three-state breaker, shared process-wide
+per endpoint authority so every filesystem instance dialing the same
+gateway pools its failure evidence:
+
+- **closed** — requests flow; each exhausted retry budget increments a
+  consecutive-failure count (any completed request resets it);
+- **open** — after ``threshold`` consecutive exhausted budgets, calls
+  fail fast with :class:`CircuitOpenError` carrying the aggregated
+  evidence (when it opened, how many failures, the recent errors) —
+  no more per-call full-backoff stalls;
+- **half-open** — after ``cooldown_s`` one probe call is let through;
+  success closes the circuit, failure re-opens it (and restarts the
+  cooldown clock).
+
+State transitions are counted in ``obs.metrics``
+(``circuit.opened`` / ``circuit.closed`` / ``circuit.fast_fail`` /
+``circuit.probe``). ``EEG_TPU_CIRCUIT_THRESHOLD=0`` disables breaking
+entirely (every call behaves as closed).
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import os
+import threading
+import time
+from typing import Deque, Dict, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+#: consecutive exhausted retry budgets before the circuit opens;
+#: 0 disables the breaker
+DEFAULT_THRESHOLD = 3
+#: seconds the circuit stays open before a half-open probe is allowed
+DEFAULT_COOLDOWN_S = 15.0
+#: recent failure messages kept as evidence
+_EVIDENCE_KEEP = 5
+
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+
+class CircuitOpenError(IOError):
+    """Fail-fast refusal: the endpoint's circuit is open.
+
+    Subclasses ``IOError`` (like ``RemoteIOError``) so callers that
+    already treat remote failures as I/O errors handle it unchanged —
+    the message carries the aggregated evidence instead of one more
+    timed-out attempt.
+    """
+
+
+def _metrics():
+    from .. import obs
+
+    return obs.metrics
+
+
+class CircuitBreaker:
+    """Per-endpoint breaker; thread-safe. ``clock`` is injectable so
+    tests drive the cooldown without sleeping."""
+
+    def __init__(
+        self,
+        endpoint: str,
+        threshold: int = DEFAULT_THRESHOLD,
+        cooldown_s: float = DEFAULT_COOLDOWN_S,
+        clock=time.monotonic,
+    ):
+        self.endpoint = endpoint
+        self.threshold = threshold
+        self.cooldown_s = cooldown_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive_failures = 0
+        self._total_failures = 0
+        self._opened_at: Optional[float] = None
+        self._probe_in_flight = False
+        self._evidence: Deque[str] = collections.deque(maxlen=_EVIDENCE_KEEP)
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    # -- call protocol -------------------------------------------------
+
+    def allow(self) -> None:
+        """Gate one call: raises :class:`CircuitOpenError` when open
+        (and not yet due for a probe); otherwise lets the call proceed
+        (possibly as the half-open probe)."""
+        if self.threshold <= 0:
+            return
+        with self._lock:
+            if self._state == CLOSED:
+                return
+            now = self._clock()
+            if (
+                self._state == OPEN
+                and self._opened_at is not None
+                and now - self._opened_at >= self.cooldown_s
+            ):
+                self._state = HALF_OPEN
+            if self._state == HALF_OPEN and not self._probe_in_flight:
+                # exactly one caller probes; the rest keep failing fast
+                self._probe_in_flight = True
+                _metrics().count("circuit.probe")
+                logger.warning(
+                    "circuit %s half-open: probing endpoint", self.endpoint
+                )
+                return
+            _metrics().count("circuit.fast_fail")
+            raise CircuitOpenError(
+                f"circuit open for {self.endpoint}: "
+                f"{self._total_failures} exhausted retry budgets "
+                f"({self._consecutive_failures} consecutive), open for "
+                f"{0.0 if self._opened_at is None else now - self._opened_at:.1f}s; "
+                f"recent errors: {list(self._evidence)}"
+            )
+
+    def record_success(self) -> None:
+        """A request completed (any response counts — the endpoint is
+        alive); closes a half-open circuit."""
+        if self.threshold <= 0:
+            return
+        with self._lock:
+            was = self._state
+            self._state = CLOSED
+            self._consecutive_failures = 0
+            self._probe_in_flight = False
+            self._opened_at = None
+            if was != CLOSED:
+                _metrics().count("circuit.closed")
+                logger.warning(
+                    "circuit %s closed after successful probe",
+                    self.endpoint,
+                )
+
+    def record_failure(self, error: Exception) -> None:
+        """One exhausted retry budget against the endpoint."""
+        if self.threshold <= 0:
+            return
+        with self._lock:
+            self._consecutive_failures += 1
+            self._total_failures += 1
+            self._evidence.append(f"{type(error).__name__}: {error}")
+            half_open_probe_failed = self._state == HALF_OPEN
+            self._probe_in_flight = False
+            if (
+                self._consecutive_failures >= self.threshold
+                or half_open_probe_failed
+            ):
+                if self._state != OPEN:
+                    _metrics().count("circuit.opened")
+                    logger.error(
+                        "circuit %s OPEN after %d consecutive exhausted "
+                        "retry budgets; failing fast for %.0fs. Evidence: %s",
+                        self.endpoint,
+                        self._consecutive_failures,
+                        self.cooldown_s,
+                        list(self._evidence),
+                    )
+                self._state = OPEN
+                self._opened_at = self._clock()
+
+
+# -- process-wide registry ---------------------------------------------
+
+_REGISTRY: Dict[str, CircuitBreaker] = {}
+_REGISTRY_LOCK = threading.Lock()
+
+
+def _env_config() -> Tuple[int, float]:
+    try:
+        threshold = int(
+            os.environ.get("EEG_TPU_CIRCUIT_THRESHOLD", DEFAULT_THRESHOLD)
+        )
+    except ValueError:
+        threshold = DEFAULT_THRESHOLD
+    try:
+        cooldown = float(
+            os.environ.get("EEG_TPU_CIRCUIT_COOLDOWN", DEFAULT_COOLDOWN_S)
+        )
+    except ValueError:
+        cooldown = DEFAULT_COOLDOWN_S
+    return threshold, cooldown
+
+
+def breaker_for(endpoint: str) -> CircuitBreaker:
+    """The process-shared breaker for an endpoint authority (e.g.
+    ``http://nn.example:9870``) — every filesystem instance dialing the
+    same authority shares one failure history."""
+    with _REGISTRY_LOCK:
+        breaker = _REGISTRY.get(endpoint)
+        if breaker is None:
+            threshold, cooldown = _env_config()
+            breaker = CircuitBreaker(
+                endpoint, threshold=threshold, cooldown_s=cooldown
+            )
+            _REGISTRY[endpoint] = breaker
+        return breaker
+
+
+def reset() -> None:
+    """Drop all shared breakers (tests; operator 'clear the fuse')."""
+    with _REGISTRY_LOCK:
+        _REGISTRY.clear()

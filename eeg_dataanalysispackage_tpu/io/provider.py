@@ -30,6 +30,29 @@ from ..utils import constants
 
 logger = logging.getLogger(__name__)
 
+#: the backend degradation ladder for fused device ingest, fastest
+#: first: Pallas kernel -> block (alignment-classed matmul) -> XLA
+#: element gather -> host epochs + registry extractor. Each rung
+#: produces the same features (tolerance-level numerics), so stepping
+#: down trades speed for survival, never correctness.
+FUSED_DEGRADATION_LADDER = ("pallas", "block", "xla", "host")
+
+
+def degradation_ladder(backend: str):
+    """Backends to try, in order, starting from ``backend``.
+
+    ``pallas`` -> ``["pallas", "block", "xla", "host"]``; ``xla`` ->
+    ``["xla", "host"]``. The terminal ``"host"`` rung is not a
+    ``load_features_device`` backend — it signals the caller
+    (pipeline/builder.py) to fall back to host epoch loading plus the
+    registry feature extractor.
+    """
+    if backend not in FUSED_DEGRADATION_LADDER[:-1]:
+        raise ValueError(f"unknown device-ingest backend {backend!r}")
+    return list(
+        FUSED_DEGRADATION_LADDER[FUSED_DEGRADATION_LADDER.index(backend):]
+    )
+
 
 class OfflineDataProvider:
     """Loads BrainVision recordings and extracts balanced P300 epochs."""
@@ -142,10 +165,15 @@ class OfflineDataProvider:
         required.
         """
         from ..epochs.extractor import BalanceState
+        from ..obs import chaos
         from ..ops import device_ingest
 
         if backend not in ("xla", "block", "pallas"):
             raise ValueError(f"unknown device-ingest backend {backend!r}")
+        # chaos injection: one fused-backend attempt fails (a Pallas
+        # lowering error, an OOM) — the pipeline's degradation ladder
+        # catches it and steps down a backend
+        chaos.maybe_fire("ingest.fused")
         prefix, files = self._resolve_files()
         balance = BalanceState()
         if backend == "pallas":

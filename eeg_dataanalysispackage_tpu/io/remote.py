@@ -29,9 +29,13 @@ import http.client
 import json
 import logging
 import os
+import random
 import time
 import urllib.parse
 from typing import Optional, Tuple
+
+from ..obs import chaos
+from . import circuit
 
 logger = logging.getLogger(__name__)
 
@@ -46,15 +50,31 @@ class RetryPolicy:
     timeout — a hung endpoint costs at most
     ``max_attempts * timeout_s + total backoff`` per request, never an
     unbounded stall.
+
+    ``jitter="full"`` opts into full-jitter backoff (uniform over
+    ``[0, deterministic wait]``): many workers retrying the same
+    recovering endpoint spread out instead of synchronizing their
+    backoff waves into periodic thundering herds. The default stays
+    deterministic so tests and chaos runs replay exactly.
     """
 
     max_attempts: int = 4
     timeout_s: float = 20.0
     backoff_s: float = 0.25
     max_backoff_s: float = 4.0
+    jitter: str = "none"  # "none" | "full"
+
+    def __post_init__(self):
+        if self.jitter not in ("none", "full"):
+            raise ValueError(
+                f"jitter must be 'none' or 'full', got {self.jitter!r}"
+            )
 
     def sleep_for(self, attempt: int) -> float:
-        return min(self.backoff_s * (2.0**attempt), self.max_backoff_s)
+        wait = min(self.backoff_s * (2.0**attempt), self.max_backoff_s)
+        if self.jitter == "full":
+            return random.uniform(0.0, wait)
+        return wait
 
 
 class RemoteIOError(IOError):
@@ -138,12 +158,24 @@ class HttpFileSystem:
         """One request with the retry budget; returns (status, headers,
         body bytes or b'' for HEAD). Retries connection errors,
         timeouts, and transient statuses; mid-body drops on GET are
-        handled by the caller (it owns resume state)."""
+        handled by the caller (it owns resume state).
+
+        The per-endpoint circuit breaker (io/circuit.py) wraps the
+        whole budget: when consecutive calls have exhausted their
+        retries, ``allow()`` fails fast with the aggregated evidence
+        instead of stalling through one more full backoff ladder.
+        """
         scheme, netloc, req_path = self._split(path)
+        breaker = circuit.breaker_for(f"{scheme}://{netloc}")
+        breaker.allow()
         last_err: Exception | None = None
         for attempt in range(self.retry.max_attempts):
             conn = self._connect(scheme, netloc)
             try:
+                # chaos injection: one request attempt dropped — lands
+                # in this loop's own retry contract like a real
+                # transient (timeout / connection reset / 5xx)
+                chaos.maybe_fire("remote.request", RemoteIOError)
                 headers = {**self.headers, **(extra_headers or {})}
                 conn.request(method, req_path, body=body, headers=headers)
                 resp = conn.getresponse()
@@ -155,6 +187,7 @@ class HttpFileSystem:
                 resp_headers = {k.lower(): v for k, v in resp.getheaders()}
                 if resp.will_close:
                     self._drop(scheme, netloc)
+                breaker.record_success()
                 return status, resp_headers, data
             except (OSError, http.client.HTTPException, RemoteIOError) as e:
                 last_err = e
@@ -169,10 +202,12 @@ class HttpFileSystem:
                 )
                 if attempt + 1 < self.retry.max_attempts:
                     time.sleep(self.retry.sleep_for(attempt))
-        raise RemoteIOError(
+        exhausted = RemoteIOError(
             f"{method} {scheme}://{netloc}{req_path} failed after "
             f"{self.retry.max_attempts} attempts: {last_err}"
         )
+        breaker.record_failure(exhausted)
+        raise exhausted
 
     # -- FileSystem protocol -------------------------------------------
 

@@ -20,6 +20,7 @@ Typical use::
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 from typing import Iterable, Iterator, Sequence, Tuple
@@ -27,9 +28,19 @@ from typing import Iterable, Iterator, Sequence, Tuple
 import jax
 import numpy as np
 
+from ..obs import chaos
 from ..parallel import mesh as pmesh
 
+logger = logging.getLogger(__name__)
+
 _END = object()
+
+
+class _Poison:
+    """A producer exception in transit to the consumer."""
+
+    def __init__(self, error: BaseException):
+        self.error = error
 
 
 def minibatches(
@@ -84,27 +95,55 @@ def prefetch(
 
     buf: "queue.Queue" = queue.Queue(maxsize=buffer_size)
     stop = threading.Event()
+    # the producer's failure slot: set BEFORE attempting delivery, so
+    # a fault can never vanish silently — if the poisoned sentinel
+    # never reaches the consumer (it stopped first / the queue stayed
+    # full), the finally block below still sees and logs it
+    failure: dict = {"error": None, "delivered": False, "logged": False}
+
+    def _put_stop_aware(item) -> bool:
+        """Poll the put so an abandoned consumer never wedges the
+        producer thread; returns False when stop cut the delivery."""
+        while not stop.is_set():
+            try:
+                buf.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def producer() -> None:
         try:
             for batch in batches:
                 if stop.is_set():
                     return
+                # chaos injection: one staged batch fails (a poisoned
+                # device_put / host parse) — must surface at the
+                # consumer, never drop silently
+                chaos.maybe_fire("staging.producer")
                 staged = stage(batch)
-                # re-check after the (possibly long) staging call, and
-                # poll the put so an abandoned consumer never wedges us
-                while not stop.is_set():
-                    try:
-                        buf.put(staged, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
-                if stop.is_set():
+                # re-check after the (possibly long) staging call
+                if not _put_stop_aware(staged):
                     return
         except BaseException as e:  # re-raised at the consumer
-            buf.put(e)
+            # "delivered" is set by the CONSUMER on receipt — a poison
+            # that entered the queue but was never read (the consumer
+            # closed first) still counts as undelivered and gets
+            # logged on join
+            failure["error"] = e
+            if not _put_stop_aware(_Poison(e)):
+                # delivery aborted (consumer already stopped) — log
+                # HERE too: a producer stranded past the consumer's
+                # join budget fails after the consumer-side check ran,
+                # and its error must not evaporate
+                failure["logged"] = True
+                logger.warning(
+                    "prefetch producer failed after the consumer "
+                    "stopped (%s: %s); error was never delivered",
+                    type(e).__name__, e,
+                )
             return
-        buf.put(_END)
+        _put_stop_aware(_END)
 
     thread = threading.Thread(
         target=producer, name="eeg-tpu-prefetch", daemon=True
@@ -115,8 +154,9 @@ def prefetch(
             item = buf.get()
             if item is _END:
                 return
-            if isinstance(item, BaseException):
-                raise item
+            if isinstance(item, _Poison):
+                failure["delivered"] = True
+                raise item.error
             yield item
     finally:
         # consumer stopped (exhaustion, error, or early close): tell
@@ -124,6 +164,24 @@ def prefetch(
         # the rest of the source
         stop.set()
         thread.join(timeout=5.0)
+        if thread.is_alive():
+            # a wedged device_put (or similar) stranded the daemon
+            # thread past the join budget — say so instead of leaking
+            # it invisibly
+            logger.warning(
+                "prefetch producer thread %s still alive after 5s "
+                "join; abandoning it (daemon)", thread.name
+            )
+        err = failure["error"]
+        if err is not None and not failure["delivered"] and not failure["logged"]:
+            # the poisoned sentinel entered the queue but the consumer
+            # exited without reading it — the failure must not
+            # evaporate (the producer logs its own put-aborted case)
+            logger.warning(
+                "prefetch producer failed after the consumer stopped "
+                "(%s: %s); error was never delivered",
+                type(err).__name__, err,
+            )
 
 
 def prefetch_epochs(

@@ -13,12 +13,15 @@ the only safe configuration; SURVEY.md section 5 'race detection').
 from __future__ import annotations
 
 import abc
+import logging
 from typing import Dict, Optional, Sequence
 
 import numpy as np
 
 from . import stats
 from ..features import base as features_base
+
+logger = logging.getLogger(__name__)
 
 
 class Classifier(abc.ABC):
@@ -52,6 +55,21 @@ class Classifier(abc.ABC):
         labels = np.asarray(targets, dtype=np.float64)
         self.fit(features, labels)
 
+    def train_elastic(
+        self,
+        epochs: Sequence[np.ndarray] | np.ndarray,
+        targets: Sequence[float] | np.ndarray,
+        fe: features_base.FeatureExtraction,
+        manager,
+        **elastic_kwargs,
+    ) -> None:
+        """:meth:`train` routed through :meth:`fit_elastic` — the host
+        epoch path's entry to checkpointed, restartable training."""
+        self.fe = fe
+        features = self._extract(epochs)
+        labels = np.asarray(targets, dtype=np.float64)
+        self.fit_elastic(features, labels, manager, **elastic_kwargs)
+
     def test(
         self,
         epochs: Sequence[np.ndarray] | np.ndarray,
@@ -81,6 +99,36 @@ class Classifier(abc.ABC):
     @abc.abstractmethod
     def fit(self, features: np.ndarray, labels: np.ndarray) -> None:
         """(n, d) features + (n,) {0,1} labels -> trained state."""
+
+    def fit_elastic(
+        self,
+        features: np.ndarray,
+        labels: np.ndarray,
+        manager,
+        save_every: int = 1,
+        max_restarts: int = 3,
+        sentinel=None,
+        chunk_iters: int = 10,
+        probe_on_failure: bool = True,
+    ) -> None:
+        """:meth:`fit` with mid-train checkpoint/restore when the
+        classifier's training loop is steppable.
+
+        The SGD/NN families override this to chunk their iteration
+        scans through ``obs.failure.elastic_train`` (checkpoints under
+        ``manager``, bounded restarts, divergence ``sentinel``). The
+        default — classifiers whose training is a single opaque
+        program (tree growers) — trains monolithically; there is no
+        intermediate state to checkpoint.
+        """
+        del manager, save_every, max_restarts, sentinel, chunk_iters
+        del probe_on_failure
+        logger.info(
+            "%s has no steppable training loop; elastic mode trains "
+            "monolithically (no mid-train checkpoints)",
+            type(self).__name__,
+        )
+        self.fit(features, labels)
 
     @abc.abstractmethod
     def predict(self, features: np.ndarray) -> np.ndarray:

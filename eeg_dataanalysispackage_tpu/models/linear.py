@@ -60,6 +60,37 @@ class _LinearClassifier(base.Classifier):
         self.intercept = 0.0
         self.margin_threshold = 0.0
 
+    def fit_elastic(
+        self,
+        features: np.ndarray,
+        labels: np.ndarray,
+        manager,
+        save_every: int = 1,
+        max_restarts: int = 3,
+        sentinel=None,
+        chunk_iters: int = 10,
+        probe_on_failure: bool = True,
+    ) -> None:
+        """MLlib-SGD training with mid-train checkpoint/restore: the
+        iteration scan runs in chunks through
+        ``obs.failure.elastic_train`` (sgd.train_linear_elastic), so a
+        transient mid-train failure restores the latest chunk carry
+        instead of restarting from zero weights. Absolute iteration
+        indexing keeps the trajectory identical to :meth:`fit`."""
+        self.weights = sgd.train_linear_elastic(
+            features,
+            np.asarray(labels, dtype=np.float64),
+            self._sgd_config(),
+            manager,
+            chunk_iters=chunk_iters,
+            save_every=save_every,
+            max_restarts=max_restarts,
+            sentinel=sentinel,
+            probe_on_failure=probe_on_failure,
+        )
+        self.intercept = 0.0
+        self.margin_threshold = 0.0
+
     def predict(self, features: np.ndarray) -> np.ndarray:
         if self.weights is None:
             raise ValueError("model not trained or loaded")

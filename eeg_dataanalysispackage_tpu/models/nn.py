@@ -239,6 +239,42 @@ def _loss_fn(name: str):
             "negativeloglikelihood": nll}.get(name, mse)
 
 
+def _make_backprop_step(model, tx, needs_value_fn, loss, rng, x, y):
+    """The per-iteration supervised scan body, shared by the
+    monolithic fit scan and the chunked elastic scan so the two can
+    never drift (the sgd.py ``_make_scan_step`` discipline).
+    ``step((params, opt_state), it) -> ((params, opt_state), value)``
+    with ``it`` the ABSOLUTE iteration index (it keys the dropout
+    rng, so chunked and monolithic runs share trajectories).
+
+    Callers pass ``x``/``y`` through their jit boundary and build the
+    step inside the traced function (x/y arrive as tracers) — binding
+    concrete arrays here would bake the whole training set into the
+    lowered program as constants."""
+
+    def step(carry, it):
+        params, opt_state = carry
+
+        def objective(p):
+            pred = model.apply(
+                p, x, train=True,
+                rngs={"dropout": jax.random.fold_in(rng, it)},
+            )
+            return loss(pred, y)
+
+        value, grads = jax.value_and_grad(objective)(params)
+        if needs_value_fn:  # lbfgs / line-search transforms
+            updates, opt_state2 = tx.update(
+                grads, opt_state, params,
+                value=value, grad=grads, value_fn=objective,
+            )
+        else:
+            updates, opt_state2 = tx.update(grads, opt_state, params)
+        return (optax.apply_updates(params, updates), opt_state2), value
+
+    return step
+
+
 # -- greedy layerwise pretraining --------------------------------------
 
 
@@ -384,7 +420,13 @@ class NeuralNetworkClassifier(base.Classifier):
 
     # -- training ------------------------------------------------------
 
-    def fit(self, features: np.ndarray, labels: np.ndarray) -> None:
+    def _prepare_fit(self, features: np.ndarray, labels: np.ndarray):
+        """The shared front half of training: config parsing, arch
+        recording, param init, optimizer/loss construction, and
+        (optional) greedy pretraining. Returns everything the
+        backprop loop needs, so :meth:`fit` (monolithic scan) and
+        :meth:`fit_elastic` (chunked resumable scan) start from the
+        identical state."""
         seed = int(self._require("config_seed"))
         iterations = int(self._require("config_num_iterations"))
         lr = float(self._require("config_learning_rate"))
@@ -421,35 +463,25 @@ class NeuralNetworkClassifier(base.Classifier):
                 model, params, x, ltypes, n_outs, acts, drops, weight_init,
                 updater_name, lr, momentum, iterations, rng, algo,
             )
+        return (
+            model, params, tx, needs_value_fn, loss, x, y, rng,
+            iterations, backprop,
+        )
+
+    def fit(self, features: np.ndarray, labels: np.ndarray) -> None:
+        (
+            model, params, tx, needs_value_fn, loss, x, y, rng,
+            iterations, backprop,
+        ) = self._prepare_fit(features, labels)
 
         if backprop:
             opt_state = tx.init(params)
 
             @jax.jit
             def run(params, opt_state, x, y):
-                def step(carry, it):
-                    params, opt_state = carry
-
-                    def objective(p):
-                        pred = model.apply(
-                            p, x, train=True,
-                            rngs={"dropout": jax.random.fold_in(rng, it)},
-                        )
-                        return loss(pred, y)
-
-                    value, grads = jax.value_and_grad(objective)(params)
-                    if needs_value_fn:  # lbfgs / line-search transforms
-                        updates, opt_state2 = tx.update(
-                            grads, opt_state, params,
-                            value=value, grad=grads, value_fn=objective,
-                        )
-                    else:
-                        updates, opt_state2 = tx.update(
-                            grads, opt_state, params
-                        )
-                    return (optax.apply_updates(params, updates),
-                            opt_state2), None
-
+                step = _make_backprop_step(
+                    model, tx, needs_value_fn, loss, rng, x, y
+                )
                 (params, opt_state), _ = jax.lax.scan(
                     step, (params, opt_state), jnp.arange(iterations)
                 )
@@ -458,6 +490,77 @@ class NeuralNetworkClassifier(base.Classifier):
             params = run(params, opt_state, x, y)
 
         self.params = params
+
+    def fit_elastic(
+        self,
+        features: np.ndarray,
+        labels: np.ndarray,
+        manager,
+        save_every: int = 1,
+        max_restarts: int = 3,
+        sentinel=None,
+        chunk_iters: int = 10,
+        probe_on_failure: bool = True,
+    ) -> None:
+        """:meth:`fit` with mid-train checkpoint/restore: the backprop
+        scan runs in ``chunk_iters``-sized chunks through
+        ``obs.failure.elastic_train``, checkpointing
+        ``{"params", "opt"}`` after every chunk. Absolute iteration
+        indices keep the per-iteration dropout keys identical to the
+        monolithic scan, so an uninterrupted elastic run and a
+        crash-restored one land on the same parameters. Greedy
+        pretraining (when configured) runs up front, un-chunked — it
+        is small relative to backprop and re-runs deterministically.
+        """
+        import functools
+
+        from ..obs import chaos, failure
+
+        (
+            model, params0, tx, needs_value_fn, loss, x, y, rng,
+            iterations, backprop,
+        ) = self._prepare_fit(features, labels)
+        if not backprop:
+            self.params = params0
+            return
+        opt0 = tx.init(params0)
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def run_chunk(state, it0, x, y, *, n):
+            step = _make_backprop_step(
+                model, tx, needs_value_fn, loss, rng, x, y
+            )
+            (params, opt_state), values = jax.lax.scan(
+                step, (state["params"], state["opt"]),
+                it0 + jnp.arange(n),
+            )
+            return {"params": params, "opt": opt_state}, values[-1]
+
+        def init_state():
+            return {"params": params0, "opt": opt0}
+
+        chunks = [
+            (it0, min(int(chunk_iters), iterations - it0))
+            for it0 in range(0, iterations, int(chunk_iters))
+        ]
+
+        def chunk_step(state, it0, n):
+            # host-level chaos injection point (one chunk = one
+            # "device step" of the elastic driver)
+            chaos.maybe_fire("device.step")
+            return run_chunk(state, it0, x, y, n=n)
+
+        state, _, _ = failure.elastic_train(
+            manager,
+            init_state,
+            chunk_step,
+            lambda: list(chunks),
+            max_restarts=max_restarts,
+            save_every=save_every,
+            sentinel=sentinel,
+            probe_on_failure=probe_on_failure,
+        )
+        self.params = state["params"]
 
     def _greedy_pretrain(
         self, model, params, x, ltypes, n_outs, acts, drops, weight_init,

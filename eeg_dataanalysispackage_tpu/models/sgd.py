@@ -58,24 +58,14 @@ class SGDConfig:
     convergence_tol: float = 0.001
 
 
-@partial(jax.jit, static_argnames=("num_iterations", "loss", "full_batch"))
-def _run_sgd(
-    features: jnp.ndarray,
-    labels: jnp.ndarray,
-    step_size: float,
-    mini_batch_fraction: float,
-    reg_param: float,
-    seed,
-    convergence_tol: float,
-    num_iterations: int,
-    loss: str,
-    full_batch: bool,
-    sample_mask: jnp.ndarray | None = None,
+def _make_scan_step(
+    x, y, ones, step_size, mini_batch_fraction, reg_param, seed,
+    convergence_tol, loss, full_batch,
 ):
-    n, d = features.shape
-    x = features
-    y = labels
-    ones = jnp.ones_like(y) if sample_mask is None else sample_mask
+    """The per-iteration MLlib-SGD scan body, shared by the monolithic
+    engine (:func:`_run_sgd`) and the chunked resumable engine
+    (:func:`_run_sgd_chunk`) so the two can never drift."""
+    n = x.shape[0]
 
     def gradient_sum(w, mask):
         margin = x @ w  # (n,)
@@ -118,12 +108,72 @@ def _run_sgd(
         ).astype(n_updates.dtype)
         return (w_new, converged_new, n_updates_new), None
 
-    w0 = jnp.zeros((d,), dtype=x.dtype)
+    return step
+
+
+@partial(jax.jit, static_argnames=("num_iterations", "loss", "full_batch"))
+def _run_sgd(
+    features: jnp.ndarray,
+    labels: jnp.ndarray,
+    step_size: float,
+    mini_batch_fraction: float,
+    reg_param: float,
+    seed,
+    convergence_tol: float,
+    num_iterations: int,
+    loss: str,
+    full_batch: bool,
+    sample_mask: jnp.ndarray | None = None,
+):
+    x = features
+    y = labels
+    ones = jnp.ones_like(y) if sample_mask is None else sample_mask
+    step = _make_scan_step(
+        x, y, ones, step_size, mini_batch_fraction, reg_param, seed,
+        convergence_tol, loss, full_batch,
+    )
+    w0 = jnp.zeros((x.shape[1],), dtype=x.dtype)
     carry0 = (w0, jnp.asarray(False), jnp.asarray(0, jnp.int32))
     (w_final, _, _), _ = jax.lax.scan(
         step, carry0, jnp.arange(1, num_iterations + 1)
     )
     return w_final
+
+
+@partial(jax.jit, static_argnames=("n_iterations", "loss", "full_batch"))
+def _run_sgd_chunk(
+    carry,
+    t_start,
+    features: jnp.ndarray,
+    labels: jnp.ndarray,
+    step_size: float,
+    mini_batch_fraction: float,
+    reg_param: float,
+    seed,
+    convergence_tol: float,
+    n_iterations: int,
+    loss: str,
+    full_batch: bool,
+    sample_mask: jnp.ndarray | None = None,
+):
+    """Iterations ``t_start+1 .. t_start+n_iterations`` of the same
+    scan :func:`_run_sgd` runs monolithically, resuming from ``carry``
+    = ``(w, converged, n_updates)``. Iteration indices are absolute,
+    so the per-iteration step sizes and Bernoulli sample keys match
+    the monolithic engine exactly — a chunked run replays the same
+    trajectory, which is what makes mid-train checkpoint/restore
+    (models.linear fit_elastic) transparent to the result."""
+    x = features
+    y = labels
+    ones = jnp.ones_like(y) if sample_mask is None else sample_mask
+    step = _make_scan_step(
+        x, y, ones, step_size, mini_batch_fraction, reg_param, seed,
+        convergence_tol, loss, full_batch,
+    )
+    carry, _ = jax.lax.scan(
+        step, carry, t_start + jnp.arange(1, n_iterations + 1)
+    )
+    return carry
 
 
 def sgd_invocation(x_arr, y_arr, config: SGDConfig, sample_mask=None):
@@ -174,6 +224,96 @@ def train_linear(
         mask = None
     fn, args, kwargs = sgd_invocation(x_arr, y_arr, config, sample_mask=mask)
     return np.asarray(fn(*args, **kwargs))
+
+
+def train_linear_elastic(
+    features: np.ndarray,
+    labels: np.ndarray,
+    config: SGDConfig,
+    manager,
+    chunk_iters: int = 10,
+    save_every: int = 1,
+    max_restarts: int = 3,
+    sentinel=None,
+    probe_on_failure: bool = True,
+    mesh=None,
+) -> np.ndarray:
+    """:func:`train_linear` with mid-train checkpoint/restore.
+
+    The iteration scan runs in ``chunk_iters``-sized chunks through
+    ``obs.failure.elastic_train``: every chunk's carry ``(w,
+    converged, n_updates)`` checkpoints under ``manager``, so a
+    transient failure (device loss, injected ``device.step`` chaos
+    fault) restores the latest carry and replays only the
+    un-checkpointed iterations — instead of restarting the whole SGD
+    run from zero weights. Absolute iteration indices keep the
+    per-iteration step sizes and sample keys identical to the
+    monolithic engine.
+
+    Returns (d,) float32 weights, like :func:`train_linear`.
+    """
+    from ..obs import chaos, failure
+
+    if mesh is not None:
+        from ..parallel import mesh as pmesh
+
+        x_arr, y_arr, sample_mask = pmesh.shard_batch_with_mask(
+            mesh, features, labels
+        )
+    else:
+        x_arr = jnp.asarray(features, dtype=jnp.float32)
+        y_arr = jnp.asarray(labels, dtype=jnp.float32)
+        sample_mask = None
+    total = int(config.num_iterations)
+    full_batch = config.mini_batch_fraction >= 1.0
+    chunks = [
+        (t0, min(int(chunk_iters), total - t0))
+        for t0 in range(0, total, int(chunk_iters))
+    ]
+    d = x_arr.shape[1]
+
+    def init_state():
+        return {
+            "w": jnp.zeros((d,), x_arr.dtype),
+            "converged": jnp.asarray(False),
+            "n_updates": jnp.asarray(0, jnp.int32),
+        }
+
+    def chunk_step(state, t0, n):
+        # host-level chaos injection point: a chunk is one "device
+        # step" of the elastic driver
+        chaos.maybe_fire("device.step")
+        w, converged, n_updates = _run_sgd_chunk(
+            (state["w"], state["converged"], state["n_updates"]),
+            t0,
+            x_arr,
+            y_arr,
+            float(config.step_size),
+            float(config.mini_batch_fraction),
+            float(config.reg_param),
+            int(config.seed),
+            float(config.convergence_tol),
+            n_iterations=int(n),
+            loss=config.loss,
+            full_batch=full_batch,
+            sample_mask=sample_mask,
+        )
+        new = {"w": w, "converged": converged, "n_updates": n_updates}
+        # the weight norm is the sentinel's loss stream: divergence
+        # (non-finite weights) surfaces as a non-finite "loss"
+        return new, jnp.linalg.norm(w)
+
+    state, _, _ = failure.elastic_train(
+        manager,
+        init_state,
+        chunk_step,
+        lambda: list(chunks),
+        max_restarts=max_restarts,
+        save_every=save_every,
+        sentinel=sentinel,
+        probe_on_failure=probe_on_failure,
+    )
+    return np.asarray(state["w"])
 
 
 @jax.jit

@@ -1,0 +1,248 @@
+"""Deterministic fault injection: a seedable, process-global fault plan.
+
+The reference's failure story is "log and continue" (``loadData``
+swallows every exception, OffLineDataProvider.java:95-97) and its test
+suite never exercises a failure path at all. This module is the chaos
+half of the resilience story: named injection points threaded through
+the I/O and device layers fire *deterministically* from a parsed fault
+plan, so the retry/degradation/elastic-restart machinery is provable —
+a chaos run under a fixed spec+seed replays bit-identically.
+
+Spec grammar (query param ``faults=`` / env ``EEG_TPU_FAULTS``)::
+
+    spec    := entry (';' entry)*
+    entry   := 'seed=' int            -- plan seed (default 0)
+             | point ':' directive
+    point   := dotted name, e.g. remote.request, ingest.fused,
+               staging.producer, device.step
+    directive :=
+        'p=' float                    -- fire each call with prob. p
+                                         (seeded; deterministic)
+        'once@' n                     -- fire exactly once, on the
+                                         n-th call of the point
+        'err@' n                      -- alias of once@n (reads better
+                                         for step-indexed errors)
+        'every@' n                    -- fire on every n-th call
+
+Example: ``remote.request:p=0.2;ingest.fused:once@1;device.step:err@7``.
+
+Injection points call :func:`maybe_fire`; with no plan installed the
+call is a single global-None check — zero overhead, nothing recorded.
+When a plan decides to fire, the point raises (``ChaosInjectedError``
+by default, or the exception type the site passes so the fault lands
+inside the site's existing retry contract) and the firing is counted
+in ``obs.metrics`` under ``chaos.fired.<point>``.
+
+Known points (the contract between specs and the codebase):
+
+==================  ====================================================
+``remote.request``  one HTTP request attempt (io/remote.py) — fires a
+                    retryable ``RemoteIOError``, exercising
+                    retry/backoff and the circuit breaker
+``staging.producer``  one staged batch in the prefetch producer thread
+                    (io/staging.py) — surfaces at the consumer
+``ingest.fused``    one ``load_features_device`` backend attempt
+                    (io/provider.py) — exercises the degradation ladder
+``device.step``     one host-level train-step call (parallel/train.py
+                    wrappers and the elastic chunk drivers in models/)
+==================  ====================================================
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import re
+import threading
+from contextlib import contextmanager
+from typing import Dict, Iterator, Optional
+
+logger = logging.getLogger(__name__)
+
+#: env var consulted by the pipeline when no ``faults=`` query param
+ENV_SPEC = "EEG_TPU_FAULTS"
+
+
+class ChaosInjectedError(RuntimeError):
+    """The default exception raised by a firing injection point."""
+
+
+class FaultSpecError(ValueError):
+    """A ``faults=`` spec string does not parse."""
+
+
+_DIRECTIVE_RE = re.compile(
+    r"^(?:p=(?P<p>[0-9.eE+-]+)|(?P<mode>once|err|every)@(?P<n>\d+))$"
+)
+
+
+class FaultRule:
+    """One ``point:directive`` entry; thread-safe call accounting."""
+
+    def __init__(self, point: str, mode: str, value: float):
+        self.point = point
+        self.mode = mode  # "p" | "once" | "every"
+        self.value = value
+        self.calls = 0
+        self.fired = 0
+
+    def should_fire(self, seed: int) -> bool:
+        self.calls += 1
+        if self.mode == "p":
+            # seeded per (seed, point, call): same spec+seed replays
+            # the identical firing sequence in any process
+            rng = random.Random(f"{seed}:{self.point}:{self.calls}")
+            hit = rng.random() < self.value
+        elif self.mode == "once":
+            hit = self.calls == int(self.value)
+        else:  # every
+            hit = self.calls % int(self.value) == 0
+        if hit:
+            self.fired += 1
+        return hit
+
+    def __repr__(self) -> str:
+        tag = {"p": f"p={self.value}", "once": f"once@{int(self.value)}",
+               "every": f"every@{int(self.value)}"}[self.mode]
+        return (
+            f"FaultRule({self.point}:{tag}, calls={self.calls}, "
+            f"fired={self.fired})"
+        )
+
+
+class FaultPlan:
+    """A parsed spec: rules keyed by injection point, plus the seed."""
+
+    def __init__(self, rules: Dict[str, FaultRule], seed: int = 0,
+                 spec: str = ""):
+        self.rules = rules
+        self.seed = seed
+        self.spec = spec
+        self._lock = threading.Lock()
+
+    def should_fire(self, point: str) -> bool:
+        rule = self.rules.get(point)
+        if rule is None:
+            return False
+        with self._lock:
+            return rule.should_fire(self.seed)
+
+    def __repr__(self) -> str:
+        return f"FaultPlan(seed={self.seed}, {list(self.rules.values())})"
+
+
+def parse_fault_spec(spec: str, seed: int = 0) -> FaultPlan:
+    """``faults=`` string -> :class:`FaultPlan` (see module grammar)."""
+    rules: Dict[str, FaultRule] = {}
+    for raw in spec.split(";"):
+        entry = raw.strip()
+        if not entry:
+            continue
+        if entry.startswith("seed="):
+            try:
+                seed = int(entry[len("seed="):])
+            except ValueError as e:
+                raise FaultSpecError(f"bad seed in {entry!r}") from e
+            continue
+        point, sep, directive = entry.partition(":")
+        if not sep or not point:
+            raise FaultSpecError(
+                f"fault entry {entry!r} is not 'point:directive' "
+                f"(e.g. 'remote.request:p=0.2')"
+            )
+        m = _DIRECTIVE_RE.match(directive.strip())
+        if m is None:
+            raise FaultSpecError(
+                f"bad directive {directive!r} for point {point!r}; "
+                f"expected p=<float>, once@<n>, err@<n>, or every@<n>"
+            )
+        if m.group("p") is not None:
+            try:
+                p = float(m.group("p"))
+            except ValueError as e:
+                raise FaultSpecError(
+                    f"bad probability in {entry!r}"
+                ) from e
+            if not 0.0 <= p <= 1.0:
+                raise FaultSpecError(
+                    f"probability {p} out of [0, 1] in {entry!r}"
+                )
+            rule = FaultRule(point.strip(), "p", p)
+        else:
+            n = int(m.group("n"))
+            if n < 1:
+                raise FaultSpecError(f"call index must be >= 1 in {entry!r}")
+            mode = "every" if m.group("mode") == "every" else "once"
+            rule = FaultRule(point.strip(), mode, float(n))
+        rules[rule.point] = rule
+    return FaultPlan(rules, seed=seed, spec=spec)
+
+
+#: the process-global active plan; None = chaos off (the hot-path
+#: no-op check every injection point performs)
+_PLAN: Optional[FaultPlan] = None
+
+
+def active_plan() -> Optional[FaultPlan]:
+    return _PLAN
+
+
+def install(spec_or_plan, seed: int = 0) -> FaultPlan:
+    """Activate a fault plan process-wide; returns it."""
+    global _PLAN
+    plan = (
+        spec_or_plan
+        if isinstance(spec_or_plan, FaultPlan)
+        else parse_fault_spec(spec_or_plan, seed=seed)
+    )
+    _PLAN = plan
+    logger.warning("chaos fault plan installed: %r", plan)
+    return plan
+
+
+def uninstall() -> None:
+    global _PLAN
+    _PLAN = None
+
+
+@contextmanager
+def faults(spec: str, seed: int = 0) -> Iterator[FaultPlan]:
+    """Scoped installation; restores whatever plan was active before."""
+    global _PLAN
+    previous = _PLAN
+    plan = install(spec, seed=seed)
+    try:
+        yield plan
+    finally:
+        _PLAN = previous
+
+
+def plan_from_env() -> Optional[str]:
+    """The ``EEG_TPU_FAULTS`` spec string, or None when unset/empty."""
+    return os.environ.get(ENV_SPEC) or None
+
+
+def maybe_fire(point: str, exc_type: type = ChaosInjectedError) -> None:
+    """The injection-point call. No plan installed -> immediate return
+    (one global read — the zero-overhead contract). When the plan's
+    rule for ``point`` fires, the firing is counted in ``obs.metrics``
+    (``chaos.fired.<point>``) and ``exc_type`` is raised — sites pass
+    the exception class their retry/degradation machinery already
+    handles (e.g. ``RemoteIOError`` for ``remote.request``).
+    """
+    plan = _PLAN
+    if plan is None:
+        return
+    if plan.should_fire(point):
+        from .. import obs
+
+        rule = plan.rules[point]
+        obs.metrics.count(f"chaos.fired.{point}")
+        logger.warning(
+            "chaos: firing %s (call %d, firing %d)",
+            point, rule.calls, rule.fired,
+        )
+        raise exc_type(
+            f"chaos: injected fault at {point} (call {rule.calls})"
+        )

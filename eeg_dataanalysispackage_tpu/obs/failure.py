@@ -208,7 +208,10 @@ def elastic_train(
             # replay to the identical failure, so surface it at once
             raise
         except Exception as e:
+            from .. import obs
+
             restarts += 1
+            obs.metrics.count("elastic.restarts")
             logger.error(
                 "training incarnation failed (%s: %s); restart %d/%d "
                 "from step %s",
@@ -219,10 +222,12 @@ def elastic_train(
                 manager.latest_step() or 0,
             )
             if restarts > max_restarts:
+                obs.metrics.count("elastic.exhausted")
                 raise
             if probe_on_failure:
                 probe = probe_devices()
                 if not probe.all_healthy:
+                    obs.metrics.count("elastic.unhealthy_abort")
                     # dead hardware won't heal by replaying onto it:
                     # fail fast with the probe evidence so the
                     # scheduler/operator reconfigures the device set

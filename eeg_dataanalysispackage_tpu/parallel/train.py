@@ -25,8 +25,38 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..obs import chaos
 from ..ops import dwt as dwt_xla
 from . import mesh as pmesh
+
+
+def _chaos_step(step):
+    """Host-side ``device.step`` injection point around a train step.
+
+    Applied to the step each factory RETURNS, never to the inner
+    function another factory embeds in its own jit (firing during a
+    trace would inject once at compile time instead of per call) —
+    factories unwrap via ``__wrapped__`` before composing.
+
+    ``functools.wraps`` copies the jit wrapper's ``__dict__``, which
+    is where jax attaches the AOT surface (``lower`` /
+    ``eval_shape``), so inspectors like ``__graft_entry__``'s
+    collective-structure dryrun keep lowering the underlying jitted
+    program through the wrapper (chaos never fires on the AOT path —
+    correct: nothing executes).
+    """
+
+    @functools.wraps(step)
+    def wrapped(state, *args, **kwargs):
+        chaos.maybe_fire("device.step")
+        return step(state, *args, **kwargs)
+
+    return wrapped
+
+
+def _raw_step(step):
+    """The unwrapped (jit-composable) form of a factory-returned step."""
+    return getattr(step, "__wrapped__", step)
 
 
 def init_mlp_params(
@@ -91,6 +121,7 @@ def make_train_step(
     init_state, feat_step = make_feature_train_step(
         mesh, learning_rate, momentum, donate_state=donate_state
     )
+    feat_step = _raw_step(feat_step)
     donate = (0,) if donate_state else ()
     if donate_epochs:
         donate = donate + (1,)
@@ -102,7 +133,7 @@ def make_train_step(
         # still traces extraction + fwd/bwd/update as one program
         return feat_step(state, extract_features(epochs), labels, mask)
 
-    return init_state, train_step
+    return init_state, _chaos_step(train_step)
 
 
 def make_compact_train_step(
@@ -132,6 +163,7 @@ def make_compact_train_step(
         feature_dim=n_channels * feature_size,
         donate_state=donate_state,
     )
+    feat_step = _raw_step(feat_step)
     donate = (0,) if donate_state else ()
     if donate_epochs:
         donate = donate + (1,)
@@ -143,7 +175,7 @@ def make_compact_train_step(
         )
         return feat_step(state, feats, labels, mask)
 
-    return init_state, step
+    return init_state, _chaos_step(step)
 
 
 def make_feature_train_step(
@@ -189,7 +221,7 @@ def make_feature_train_step(
             "opt": opt,
         }, loss
 
-    return init_state, step
+    return init_state, _chaos_step(step)
 
 
 def make_raw_train_step(
@@ -217,12 +249,13 @@ def make_raw_train_step(
     init_state, feat_step = make_feature_train_step(
         mesh, learning_rate, momentum, donate_state=donate_state
     )
+    feat_step = _raw_step(feat_step)
 
     def step(state, raw_i16, resolutions, labels, mask, first_position):
         feats = ing(raw_i16, resolutions, int(first_position))
         return feat_step(state, feats, labels, mask)
 
-    return init_state, step
+    return init_state, _chaos_step(step)
 
 
 def make_irregular_train_step(
@@ -258,6 +291,7 @@ def make_irregular_train_step(
     init_state, feat_step = make_feature_train_step(
         mesh, learning_rate, momentum, donate_state=donate_state
     )
+    feat_step = _raw_step(feat_step)
 
     @functools.partial(
         jax.jit, donate_argnums=(0,) if donate_state else ()
@@ -266,7 +300,7 @@ def make_irregular_train_step(
         feats = featurize(raw_i16, resolutions, positions, mask)
         return feat_step(state, feats, labels, mask.astype(feats.dtype))
 
-    return init_state, step
+    return init_state, _chaos_step(step)
 
 
 def make_irregular_bank_train_step(
@@ -351,6 +385,7 @@ def make_irregular_bank_train_step(
         feature_dim=n_channels * feature_size,
         donate_state=donate_state,
     )
+    feat_step = _raw_step(feat_step)
 
     @_partial(
         jax.jit,
@@ -394,7 +429,7 @@ def make_irregular_bank_train_step(
             interpret=ps.default_interpret(),
         )
 
-    return init_state, step
+    return init_state, _chaos_step(step)
 
 
 def stage_batch(

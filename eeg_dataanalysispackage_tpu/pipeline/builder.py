@@ -12,6 +12,7 @@ by one jitted program, classifiers consume whole batches.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import re
 from typing import Dict, Optional
@@ -22,6 +23,7 @@ from ..features import registry as fe_registry
 from ..io import provider, sources
 from ..models import registry as clf_registry
 from ..models import stats
+from ..obs import chaos
 from ..utils import java_compat
 
 logger = logging.getLogger(__name__)
@@ -36,6 +38,20 @@ def get_query_map(query: str) -> Dict[str, str]:
         value = parts[1] if len(parts) > 1 else ""
         out[name] = value
     return out
+
+
+def get_raw_param(query: str, name: str) -> Optional[str]:
+    """The full (first-'='-to-end) value of one query parameter.
+
+    :func:`get_query_map` keeps the reference's quirk of truncating a
+    value at its second ``=`` (``split('=')[1]``). Parameters whose
+    grammar legitimately contains ``=`` — the ``faults=`` chaos spec
+    (``remote.request:p=0.2;...``) — are re-extracted here verbatim.
+    """
+    for param in query.split("&"):
+        if param.startswith(name + "="):
+            return param[len(name) + 1:]
+    return None
 
 
 class PipelineBuilder:
@@ -68,13 +84,25 @@ class PipelineBuilder:
         if cache_dir:
             logger.info("persistent compile cache: %s", cache_dir)
 
-        # net-new observability: trace_path=<dir> wraps the run in a
-        # jax.profiler trace (device + annotated host activity),
-        # viewable in TensorBoard/Perfetto
-        if "trace_path" in query_map and query_map["trace_path"]:
-            with obs.trace(query_map["trace_path"]):
-                return self._execute(query_map)
-        return self._execute(query_map)
+        # chaos fault plan: faults=<spec> (or EEG_TPU_FAULTS) installs
+        # deterministic fault injection for the run, scoped so nested /
+        # subsequent runs in the process are unaffected (docs/
+        # resilience.md). faults_seed= seeds the p= directives.
+        spec = get_raw_param(self.query, "faults") or chaos.plan_from_env()
+        fault_scope = (
+            chaos.faults(spec, seed=int(query_map.get("faults_seed", 0) or 0))
+            if spec
+            else contextlib.nullcontext()
+        )
+
+        with fault_scope:
+            # net-new observability: trace_path=<dir> wraps the run in
+            # a jax.profiler trace (device + annotated host activity),
+            # viewable in TensorBoard/Perfetto
+            if "trace_path" in query_map and query_map["trace_path"]:
+                with obs.trace(query_map["trace_path"]):
+                    return self._execute(query_map)
+            return self._execute(query_map)
 
     def _execute(self, query_map) -> stats.ClassificationStatistics:
 
@@ -117,12 +145,84 @@ class PipelineBuilder:
                 "-block": "block",
                 "-xla": "xla",
             }[fused_match.group(2)]
-            with self.timers.stage("ingest"):
-                features, targets = odp.load_features_device(
-                    wavelet_index=wavelet_index, backend=backend
+            # backend degradation ladder (io/provider.py): a fused
+            # backend that fails to lower, OOMs, or sits on unhealthy
+            # devices degrades pallas -> block -> xla -> host epochs +
+            # registry extractor instead of killing the run. Same
+            # ClassificationStatistics out the other end, every step
+            # down counted in obs.metrics. degrade=false opts out
+            # (fail fast on the requested backend).
+            degrade = query_map.get("degrade", "true") != "false"
+            ladder = (
+                provider.degradation_ladder(backend)
+                if degrade
+                else [backend]
+            )
+            landed = None
+            for rung in ladder:
+                if rung == "host":
+                    break
+                try:
+                    with self.timers.stage("ingest"):
+                        features, targets = odp.load_features_device(
+                            wavelet_index=wavelet_index, backend=rung
+                        )
+                    landed = rung
+                    break
+                except OSError:
+                    # input/IO errors (missing or unreadable recording,
+                    # a remote endpoint that already exhausted its
+                    # retries + circuit): every rung would re-read the
+                    # same input and fail identically — surface the
+                    # root cause at once instead of masking it under
+                    # three backend attempts and a device probe.
+                    # ValueError stays degradable: backend-capability
+                    # limits (the block slab bound, the Pallas
+                    # window<=chunk/2 constraint) are ValueErrors the
+                    # next rung may not share.
+                    raise
+                except Exception as e:
+                    if len(ladder) == 1:
+                        raise
+                    logger.error(
+                        "fused ingest backend %r failed (%s: %s); "
+                        "degrading",
+                        rung, type(e).__name__, e,
+                    )
+                    obs.metrics.count("pipeline.degraded")
+                    obs.metrics.count(f"pipeline.degraded.from.{rung}")
+                    if self._devices_unhealthy():
+                        # dead hardware fails every device rung the
+                        # same way — jump straight to the host floor
+                        obs.metrics.count(
+                            "pipeline.degraded.unhealthy_devices"
+                        )
+                        logger.error(
+                            "device probe reports unhealthy devices; "
+                            "skipping remaining device backends"
+                        )
+                        break
+            if landed is not None:
+                if landed != backend:
+                    logger.warning(
+                        "fused ingest degraded %r -> %r", backend, landed
+                    )
+                fe = None
+                n = len(targets)
+            else:
+                # the host floor of the ladder: reference-shaped epoch
+                # loading plus the registry extractor — slower, but the
+                # run survives and the statistics contract holds
+                logger.error(
+                    "all fused backends failed; degrading to host "
+                    "epochs + registry extractor (dwt-%d)", wavelet_index
                 )
-            fe = None
-            n = len(targets)
+                obs.metrics.count("pipeline.degraded.to_host")
+                fused = False
+                fe = fe_registry.create(f"dwt-{wavelet_index}")
+                with self.timers.stage("ingest"):
+                    batch = odp.load()
+                n = len(batch)
         else:
             with self.timers.stage("ingest"):
                 batch = odp.load()
@@ -141,13 +241,42 @@ class PipelineBuilder:
                 k: v for k, v in query_map.items() if k.startswith("config_")
             }
             classifier.set_config(config)
+            # elastic=true&checkpoint_path=<dir>: the train stage runs
+            # through fit_elastic — chunked training with per-chunk
+            # checkpoints, bounded restarts, and a divergence sentinel
+            # (obs/failure.py), so a mid-train transient restores the
+            # latest checkpoint instead of restarting the run. The
+            # SGD/NN families checkpoint mid-scan; tree growers train
+            # monolithically with a logged note.
+            elastic_kwargs = self._elastic_kwargs(query_map)
             with self.timers.stage("train"):
-                if fused:
-                    classifier.fit(features[train_idx], targets[train_idx])
-                else:
-                    classifier.train(
-                        batch.epochs[train_idx], batch.targets[train_idx], fe
+                if elastic_kwargs is None:
+                    if fused:
+                        classifier.fit(
+                            features[train_idx], targets[train_idx]
+                        )
+                    else:
+                        classifier.train(
+                            batch.epochs[train_idx],
+                            batch.targets[train_idx],
+                            fe,
+                        )
+                elif fused:
+                    classifier.fit_elastic(
+                        features[train_idx], targets[train_idx],
+                        **elastic_kwargs,
                     )
+                else:
+                    classifier.train_elastic(
+                        batch.epochs[train_idx], batch.targets[train_idx],
+                        fe, **elastic_kwargs,
+                    )
+            if elastic_kwargs is not None:
+                # the checkpoints' job (surviving a crash of THIS run)
+                # is done; left behind, the next run under the same
+                # checkpoint_path would restore this finished
+                # trajectory and silently skip its own training
+                elastic_kwargs["manager"].clear()
             logger.info("trained %s", query_map["train_clf"])
 
             if query_map.get("save_clf") == "true":
@@ -192,11 +321,57 @@ class PipelineBuilder:
 
         logger.info("statistics:\n%s", statistics)
         logger.info("stage timings:\n%s", self.timers.report())
+        if chaos.active_plan() is not None:
+            logger.info("chaos plan after run: %r", chaos.active_plan())
+            logger.info("metrics: %s", obs.metrics.to_json())
 
         if "result_path" in query_map:
-            with open(query_map["result_path"], "w") as f:
-                # PrintWriter.println appends a newline to toString()
-                f.write(str(statistics) + "\n")
+            from ..checkpoint.manager import atomic_write_text
+
+            # tmp + os.replace (the checkpoint store's atomic-write
+            # discipline): a crash mid-write can no longer leave a
+            # truncated report. PrintWriter.println parity: a newline
+            # after toString().
+            atomic_write_text(
+                query_map["result_path"], str(statistics) + "\n"
+            )
 
         self.statistics = statistics
         return statistics
+
+    # -- resilience plumbing -------------------------------------------
+
+    @staticmethod
+    def _devices_unhealthy() -> bool:
+        """Active device probe after a fused-backend failure: True
+        when any device fails the probe (the ladder then skips the
+        remaining device rungs). Probe errors count as healthy — the
+        ladder's own attempts are the better evidence."""
+        try:
+            from ..obs import failure
+
+            return not failure.probe_devices(deadline_s=30.0).all_healthy
+        except Exception as e:  # pragma: no cover - defensive
+            logger.warning("device probe itself failed: %s", e)
+            return False
+
+    @staticmethod
+    def _elastic_kwargs(query_map) -> Optional[dict]:
+        """``elastic=true`` query wiring -> fit_elastic kwargs, or
+        None when elastic training is off (the default)."""
+        if query_map.get("elastic") != "true":
+            return None
+        ckpt = query_map.get("checkpoint_path")
+        if not ckpt:
+            raise ValueError(
+                "elastic=true requires a checkpoint_path query parameter"
+            )
+        from ..checkpoint.manager import CheckpointManager
+        from ..obs import failure
+
+        return {
+            "manager": CheckpointManager(ckpt),
+            "save_every": int(query_map.get("save_every", 1) or 1),
+            "max_restarts": int(query_map.get("max_restarts", 3) or 3),
+            "sentinel": failure.DivergenceSentinel(),
+        }

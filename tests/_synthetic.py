@@ -1,0 +1,73 @@
+"""Synthetic BrainVision recordings for hermetic pipeline tests.
+
+The reference fixture set (/root/reference/test-data) is not always
+present; the chaos suite must run everywhere, so it fabricates a
+small but structurally faithful guess-the-number session: INT_16
+multiplexed .eeg + .vhdr/.vmrk siblings + an info.txt, with Fz/Cz/Pz
+among the channels and stimulus markers spaced so every epoch window
+is in range.
+"""
+
+import os
+
+import numpy as np
+
+CHANNELS = ("Fz", "Cz", "Pz", "Oz")  # one extra channel to exercise selection
+RESOLUTION = 0.1
+
+
+def write_recording(
+    directory: str,
+    name: str = "synth_01",
+    n_markers: int = 48,
+    guessed: int = 2,
+    seed: int = 0,
+    marker_stride: int = 1000,
+):
+    """Write <name>.eeg/.vhdr/.vmrk under ``directory``; returns the
+    .eeg path. Stimulus numbers cycle 1..9 so a balanced target /
+    non-target split exists for any guessed number."""
+    rng = np.random.RandomState(seed)
+    n_ch = len(CHANNELS)
+    n_samples = 200 + n_markers * marker_stride + 900
+    raw = rng.randint(-3000, 3000, size=(n_samples, n_ch)).astype("<i2")
+    eeg = os.path.join(directory, name + ".eeg")
+    with open(eeg, "wb") as f:
+        f.write(raw.tobytes())
+
+    vhdr = [
+        "Brain Vision Data Exchange Header File Version 1.0",
+        "[Common Infos]",
+        f"DataFile={name}.eeg",
+        f"MarkerFile={name}.vmrk",
+        "DataFormat=BINARY",
+        "DataOrientation=MULTIPLEXED",
+        f"NumberOfChannels={n_ch}",
+        "SamplingInterval=1000",
+        "[Binary Infos]",
+        "BinaryFormat=INT_16",
+        "[Channel Infos]",
+    ] + [
+        f"Ch{i + 1}={ch},,{RESOLUTION},uV" for i, ch in enumerate(CHANNELS)
+    ]
+    with open(os.path.join(directory, name + ".vhdr"), "w") as f:
+        f.write("\n".join(vhdr) + "\n")
+
+    vmrk = ["Brain Vision Data Exchange Marker File, Version 1.0",
+            "[Marker Infos]"]
+    for i in range(n_markers):
+        stim = (i % 9) + 1
+        pos = 200 + i * marker_stride
+        vmrk.append(f"Mk{i + 1}=Stimulus,S  {stim},{pos},1,0")
+    with open(os.path.join(directory, name + ".vmrk"), "w") as f:
+        f.write("\n".join(vmrk) + "\n")
+    return eeg
+
+
+def write_session(directory: str, guessed: int = 2, **kwargs) -> str:
+    """One-recording session: returns the info.txt path."""
+    write_recording(directory, guessed=guessed, **kwargs)
+    info = os.path.join(directory, "info.txt")
+    with open(info, "w") as f:
+        f.write(f"synth_01.eeg {guessed}\n")
+    return info

@@ -1,0 +1,400 @@
+"""Chaos suite: deterministic fault injection (obs/chaos.py), the
+fused-backend degradation ladder, elastic pipeline training, and the
+end-to-end chaos-parity contract.
+
+The acceptance bar (ISSUE 2): a full pipeline query run under injected
+faults — remote request drops, a forced fused-backend failure, a
+mid-train step error — completes via retry/degradation/elastic restart
+and produces ClassificationStatistics identical to the fault-free
+run, with every event visible in obs.metrics; with faults unset the
+injection points are no-ops.
+"""
+
+import functools
+import os
+import threading
+from http.server import SimpleHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+import _synthetic
+from eeg_dataanalysispackage_tpu import obs
+from eeg_dataanalysispackage_tpu.io import provider, remote, staging
+from eeg_dataanalysispackage_tpu.obs import chaos
+from eeg_dataanalysispackage_tpu.pipeline import builder
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    assert chaos.active_plan() is None
+    yield
+    chaos.uninstall()
+
+
+def _counter_delta(before, name):
+    after = obs.metrics.snapshot()["counters"]
+    return after.get(name, 0.0) - before.get(name, 0.0)
+
+
+# -- spec parsing ------------------------------------------------------
+
+
+def test_parse_spec_full_grammar():
+    plan = chaos.parse_fault_spec(
+        "seed=7;remote.request:p=0.2;ingest.fused:once@1;"
+        "device.step:err@7;staging.producer:every@3"
+    )
+    assert plan.seed == 7
+    assert plan.rules["remote.request"].mode == "p"
+    assert plan.rules["remote.request"].value == 0.2
+    assert plan.rules["ingest.fused"].mode == "once"
+    # err@N is an alias of once@N
+    assert plan.rules["device.step"].mode == "once"
+    assert plan.rules["device.step"].value == 7
+    assert plan.rules["staging.producer"].mode == "every"
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "remote.request",  # no directive
+        "remote.request:p=1.5",  # probability out of range
+        "remote.request:sometimes",  # unknown directive
+        "remote.request:once@0",  # 1-based call index
+        "seed=abc",  # unparseable seed
+    ],
+)
+def test_parse_spec_rejects(bad):
+    with pytest.raises(chaos.FaultSpecError):
+        chaos.parse_fault_spec(bad)
+
+
+def test_probabilistic_rule_is_deterministic_per_seed():
+    fires = []
+    for _ in range(2):
+        plan = chaos.parse_fault_spec("x:p=0.3", seed=11)
+        fires.append(
+            [plan.should_fire("x") for _ in range(50)]
+        )
+    assert fires[0] == fires[1]
+    assert 0 < sum(fires[0]) < 50  # actually probabilistic
+    other = chaos.parse_fault_spec("x:p=0.3", seed=12)
+    assert [other.should_fire("x") for _ in range(50)] != fires[0]
+
+
+def test_once_and_every_rules():
+    plan = chaos.parse_fault_spec("a:once@3;b:every@2")
+    assert [plan.should_fire("a") for _ in range(6)] == [
+        False, False, True, False, False, False
+    ]
+    assert [plan.should_fire("b") for _ in range(6)] == [
+        False, True, False, True, False, True
+    ]
+
+
+# -- injection-point mechanics -----------------------------------------
+
+
+def test_maybe_fire_is_noop_without_plan():
+    before = obs.metrics.snapshot()["counters"]
+    for _ in range(100):
+        chaos.maybe_fire("remote.request")
+    assert _counter_delta(before, "chaos.fired.remote.request") == 0
+
+
+def test_maybe_fire_raises_requested_type_and_counts():
+    before = obs.metrics.snapshot()["counters"]
+    with chaos.faults("pt:once@1"):
+        with pytest.raises(remote.RemoteIOError, match="injected fault"):
+            chaos.maybe_fire("pt", remote.RemoteIOError)
+        chaos.maybe_fire("pt")  # call 2: no further firing
+    assert _counter_delta(before, "chaos.fired.pt") == 1
+
+
+def test_faults_context_restores_previous_plan():
+    outer = chaos.install("a:once@1")
+    try:
+        with chaos.faults("b:once@1") as inner:
+            assert chaos.active_plan() is inner
+        assert chaos.active_plan() is outer
+    finally:
+        chaos.uninstall()
+
+
+# -- staging producer faults -------------------------------------------
+
+
+@pytest.mark.chaos
+def test_staging_producer_fault_surfaces_at_consumer():
+    with chaos.faults("staging.producer:once@2"):
+        it = staging.prefetch(
+            staging.minibatches(np.ones((8, 2), np.float32), batch_size=2)
+        )
+        next(it)  # batch 1 stages fine
+        with pytest.raises(chaos.ChaosInjectedError, match="staging.producer"):
+            for _ in it:
+                pass
+
+
+# -- remote retry absorbs request-level faults -------------------------
+
+
+@pytest.fixture()
+def http_dir(tmp_path):
+    handler = functools.partial(
+        SimpleHTTPRequestHandler, directory=str(tmp_path)
+    )
+    handler.log_message = lambda *a, **k: None
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        yield f"http://127.0.0.1:{httpd.server_address[1]}", tmp_path
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def _fast_fs():
+    return remote.HttpFileSystem(
+        retry=remote.RetryPolicy(max_attempts=4, timeout_s=5.0, backoff_s=0.01)
+    )
+
+
+@pytest.mark.chaos
+def test_remote_request_drops_are_retried(http_dir):
+    base, tmp = http_dir
+    (tmp / "obj.bin").write_bytes(b"payload" * 100)
+    before = obs.metrics.snapshot()["counters"]
+    with chaos.faults("remote.request:p=0.3", seed=5):
+        got = _fast_fs().read_bytes(f"{base}/obj.bin")
+    assert got == b"payload" * 100
+    assert _counter_delta(before, "chaos.fired.remote.request") >= 1
+
+
+# -- degradation ladder ------------------------------------------------
+
+
+def test_degradation_ladder_shape():
+    assert provider.degradation_ladder("pallas") == [
+        "pallas", "block", "xla", "host"
+    ]
+    assert provider.degradation_ladder("xla") == ["xla", "host"]
+    with pytest.raises(ValueError, match="unknown device-ingest backend"):
+        provider.degradation_ladder("host")
+
+
+@pytest.fixture()
+def session(tmp_path):
+    return _synthetic.write_session(str(tmp_path), n_markers=90)
+
+
+def _logreg_query(info, extra=""):
+    return (
+        f"info_file={info}&train_clf=logreg&config_step_size=1.0"
+        "&config_num_iterations=40&config_mini_batch_fraction=1.0" + extra
+    )
+
+
+@pytest.mark.chaos
+def test_fused_backend_failure_degrades_one_rung(session):
+    baseline = builder.PipelineBuilder(
+        _logreg_query(session, "&fe=dwt-8-fused-block")
+    ).execute()
+    before = obs.metrics.snapshot()["counters"]
+    stats = builder.PipelineBuilder(
+        _logreg_query(
+            session, "&fe=dwt-8-fused-block&faults=ingest.fused:once@1"
+        )
+    ).execute()
+    assert str(stats) == str(baseline)
+    assert _counter_delta(before, "pipeline.degraded") == 1
+    assert _counter_delta(before, "pipeline.degraded.from.block") == 1
+    assert _counter_delta(before, "chaos.fired.ingest.fused") == 1
+
+
+@pytest.mark.chaos
+def test_all_device_backends_failing_degrades_to_host(session):
+    host_stats = builder.PipelineBuilder(
+        _logreg_query(session, "&fe=dwt-8")
+    ).execute()
+    before = obs.metrics.snapshot()["counters"]
+    # every@1 fires on every load_features_device attempt: pallas,
+    # block, and xla all die -> the ladder lands on the host floor
+    stats = builder.PipelineBuilder(
+        _logreg_query(
+            session, "&fe=dwt-8-fused-pallas&faults=ingest.fused:every@1"
+        )
+    ).execute()
+    assert str(stats) == str(host_stats)
+    assert _counter_delta(before, "pipeline.degraded") == 3
+    assert _counter_delta(before, "pipeline.degraded.to_host") == 1
+
+
+@pytest.mark.chaos
+def test_degrade_false_fails_fast(session):
+    with pytest.raises(chaos.ChaosInjectedError):
+        builder.PipelineBuilder(
+            _logreg_query(
+                session,
+                "&fe=dwt-8-fused-xla&degrade=false"
+                "&faults=ingest.fused:once@1",
+            )
+        ).execute()
+
+
+@pytest.mark.chaos
+def test_input_errors_do_not_degrade(tmp_path):
+    """A missing input fails every rung identically: the root cause
+    surfaces at once instead of being masked by backend retries."""
+    before = obs.metrics.snapshot()["counters"]
+    with pytest.raises(FileNotFoundError):
+        builder.PipelineBuilder(
+            _logreg_query(
+                f"{tmp_path}/does_not_exist.txt", "&fe=dwt-8-fused-pallas"
+            )
+        ).execute()
+    assert _counter_delta(before, "pipeline.degraded") == 0
+
+
+# -- elastic pipeline training -----------------------------------------
+
+
+@pytest.mark.chaos
+def test_elastic_requires_checkpoint_path(session):
+    with pytest.raises(ValueError, match="checkpoint_path"):
+        builder.PipelineBuilder(
+            _logreg_query(session, "&fe=dwt-8&elastic=true")
+        ).execute()
+
+
+@pytest.mark.chaos
+def test_midtrain_fault_recovers_via_elastic_restart(session, tmp_path):
+    q = _logreg_query(session, "&fe=dwt-8&elastic=true&save_every=1")
+    baseline = builder.PipelineBuilder(
+        q + f"&checkpoint_path={tmp_path}/ck_base"
+    ).execute()
+    before = obs.metrics.snapshot()["counters"]
+    stats = builder.PipelineBuilder(
+        q
+        + f"&checkpoint_path={tmp_path}/ck_chaos"
+        + "&faults=device.step:err@2"
+    ).execute()
+    assert str(stats) == str(baseline)
+    assert _counter_delta(before, "chaos.fired.device.step") == 1
+    assert _counter_delta(before, "elastic.restarts") == 1
+
+
+@pytest.mark.chaos
+def test_elastic_matches_monolithic_training(session, tmp_path):
+    mono = builder.PipelineBuilder(
+        _logreg_query(session, "&fe=dwt-8")
+    ).execute()
+    elastic = builder.PipelineBuilder(
+        _logreg_query(
+            session,
+            f"&fe=dwt-8&elastic=true&checkpoint_path={tmp_path}/ck",
+        )
+    ).execute()
+    assert str(elastic) == str(mono)
+
+
+@pytest.mark.chaos
+def test_elastic_completed_run_clears_checkpoints(session, tmp_path):
+    """A completed elastic run clears its checkpoints — a re-run under
+    the same checkpoint_path must train fresh, not silently restore
+    the finished trajectory."""
+    ck = tmp_path / "ck"
+    q = _logreg_query(
+        session, f"&fe=dwt-8&elastic=true&checkpoint_path={ck}"
+    )
+    first = builder.PipelineBuilder(q).execute()
+    assert not [p for p in os.listdir(ck) if p.startswith("step_")]
+    second = builder.PipelineBuilder(q).execute()
+    assert str(second) == str(first)
+
+
+@pytest.mark.chaos
+def test_elastic_nn_midtrain_fault_parity(session, tmp_path):
+    nn_cfg = (
+        "&train_clf=nn&config_seed=5&config_num_iterations=30"
+        "&config_learning_rate=0.05&config_momentum=0.9"
+        "&config_weight_init=xavier&config_updater=nesterovs"
+        "&config_optimization_algo=stochastic_gradient_descent"
+        "&config_pretrain=false&config_backprop=true"
+        "&config_layer1_layer_type=dense&config_layer1_n_out=8"
+        "&config_layer1_drop_out=0&config_layer1_activation_function=relu"
+        "&config_layer2_layer_type=output&config_layer2_n_out=2"
+        "&config_layer2_drop_out=0"
+        "&config_layer2_activation_function=softmax"
+        "&config_loss_function=negativeloglikelihood"
+    )
+    q = (
+        f"info_file={session}&fe=dwt-8{nn_cfg}"
+        "&elastic=true&save_every=1"
+    )
+    baseline = builder.PipelineBuilder(
+        q + f"&checkpoint_path={tmp_path}/nn_base"
+    ).execute()
+    stats = builder.PipelineBuilder(
+        q
+        + f"&checkpoint_path={tmp_path}/nn_chaos"
+        + "&faults=device.step:err@2"
+    ).execute()
+    assert str(stats) == str(baseline)
+
+
+# -- the acceptance criterion: full chaos parity -----------------------
+
+
+@pytest.mark.chaos
+def test_chaos_parity_end_to_end(http_dir, tmp_path):
+    """Remote drops (p=0.2) + one fused-backend failure + one
+    mid-train step error: the run completes via retry + degradation +
+    elastic restart, statistics identical to the fault-free run,
+    every event visible in obs.metrics."""
+    base, serve_dir = http_dir
+    _synthetic.write_session(str(serve_dir), n_markers=90)
+    q = (
+        f"info_file={base}/info.txt&fe=dwt-8-fused-pallas"
+        "&train_clf=logreg&config_step_size=1.0"
+        "&config_num_iterations=40&config_mini_batch_fraction=1.0"
+        "&elastic=true&save_every=1"
+    )
+    result = tmp_path / "report.txt"
+    baseline = builder.PipelineBuilder(
+        q + f"&checkpoint_path={tmp_path}/ck_base", filesystem=_fast_fs()
+    ).execute()
+
+    before = obs.metrics.snapshot()["counters"]
+    stats = builder.PipelineBuilder(
+        q
+        + f"&checkpoint_path={tmp_path}/ck_chaos&result_path={result}"
+        + "&faults=remote.request:p=0.2;ingest.fused:once@1;"
+        + "device.step:err@2&faults_seed=3",
+        filesystem=_fast_fs(),
+    ).execute()
+
+    assert str(stats) == str(baseline)
+    # the atomic report write landed, whole
+    assert result.read_text() == str(stats) + "\n"
+    for counter in (
+        "chaos.fired.remote.request",
+        "chaos.fired.ingest.fused",
+        "chaos.fired.device.step",
+        "pipeline.degraded.from.pallas",
+        "elastic.restarts",
+    ):
+        assert _counter_delta(before, counter) >= 1, counter
+    # the faults= scope ended with the run: later work is unaffected
+    assert chaos.active_plan() is None
+
+
+def test_get_raw_param_keeps_equals_signs():
+    q = "a=1&faults=remote.request:p=0.2;x:once@1&b=2"
+    assert builder.get_query_map(q)["faults"] == "remote.request:p"
+    assert (
+        builder.get_raw_param(q, "faults")
+        == "remote.request:p=0.2;x:once@1"
+    )
+    assert builder.get_raw_param(q, "missing") is None

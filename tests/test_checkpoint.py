@@ -201,3 +201,40 @@ def test_resumable_irregular_raw_stream_training(tmp_path):
                                  save_every=3)
     assert steps == 7
     _tree_equal(state, ref_state)  # params AND optimizer buffers
+
+
+def test_atomic_write_bytes_replaces_whole_or_not_at_all(tmp_path):
+    from eeg_dataanalysispackage_tpu.checkpoint.manager import (
+        atomic_write_bytes,
+        atomic_write_text,
+    )
+
+    target = tmp_path / "report.txt"
+    atomic_write_text(str(target), "first version\n")
+    assert target.read_text() == "first version\n"
+    # overwrite goes through a tmp sibling + os.replace: the old
+    # content survives any crash before the rename
+    atomic_write_bytes(str(target), b"second version\n")
+    assert target.read_bytes() == b"second version\n"
+    # no tmp litter left behind
+    assert [p.name for p in tmp_path.iterdir()] == ["report.txt"]
+
+
+def test_atomic_write_failure_leaves_previous_content(tmp_path, monkeypatch):
+    from eeg_dataanalysispackage_tpu.checkpoint import manager
+
+    target = tmp_path / "report.txt"
+    manager.atomic_write_text(str(target), "good\n")
+
+    real_replace = os.replace
+
+    def exploding_replace(src, dst):
+        raise OSError("simulated crash at rename")
+
+    monkeypatch.setattr(os, "replace", exploding_replace)
+    with pytest.raises(OSError, match="simulated crash"):
+        manager.atomic_write_text(str(target), "half-written garbage\n")
+    monkeypatch.setattr(os, "replace", real_replace)
+    # the target was never touched, and the tmp file was cleaned up
+    assert target.read_text() == "good\n"
+    assert [p.name for p in tmp_path.iterdir()] == ["report.txt"]

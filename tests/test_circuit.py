@@ -1,0 +1,177 @@
+"""Circuit breaker for remote endpoints (io/circuit.py): state
+machine unit tests plus integration through HttpFileSystem against a
+hermetic failing server."""
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from eeg_dataanalysispackage_tpu import obs
+from eeg_dataanalysispackage_tpu.io import circuit, remote
+
+
+class _Clock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+def _breaker(threshold=3, cooldown=10.0):
+    clock = _Clock()
+    return circuit.CircuitBreaker(
+        "http://ep", threshold=threshold, cooldown_s=cooldown, clock=clock
+    ), clock
+
+
+def test_opens_after_consecutive_failures_only():
+    cb, _ = _breaker(threshold=3)
+    for _ in range(2):
+        cb.allow()
+        cb.record_failure(IOError("x"))
+    cb.allow()
+    cb.record_success()  # resets the consecutive count
+    for _ in range(2):
+        cb.allow()
+        cb.record_failure(IOError("x"))
+    assert cb.state == circuit.CLOSED
+    cb.record_failure(IOError("third consecutive"))
+    assert cb.state == circuit.OPEN
+
+
+def test_open_fails_fast_with_evidence():
+    cb, _ = _breaker(threshold=2)
+    cb.record_failure(IOError("first budget"))
+    cb.record_failure(IOError("second budget"))
+    with pytest.raises(circuit.CircuitOpenError) as ei:
+        cb.allow()
+    msg = str(ei.value)
+    assert "2 exhausted retry budgets" in msg
+    assert "first budget" in msg and "second budget" in msg
+    # CircuitOpenError is an IOError: existing remote-failure handling
+    # catches it unchanged
+    assert isinstance(ei.value, IOError)
+
+
+def test_half_open_probe_closes_on_success():
+    cb, clock = _breaker(threshold=1, cooldown=5.0)
+    cb.record_failure(IOError("x"))
+    with pytest.raises(circuit.CircuitOpenError):
+        cb.allow()
+    clock.now = 5.1
+    cb.allow()  # the probe goes through
+    assert cb.state == circuit.HALF_OPEN
+    with pytest.raises(circuit.CircuitOpenError):
+        cb.allow()  # concurrent callers keep failing fast mid-probe
+    cb.record_success()
+    assert cb.state == circuit.CLOSED
+    cb.allow()  # closed again: calls flow
+
+
+def test_half_open_probe_failure_reopens():
+    cb, clock = _breaker(threshold=1, cooldown=5.0)
+    cb.record_failure(IOError("x"))
+    clock.now = 5.1
+    cb.allow()
+    cb.record_failure(IOError("still down"))
+    assert cb.state == circuit.OPEN
+    with pytest.raises(circuit.CircuitOpenError):
+        cb.allow()  # cooldown clock restarted
+    clock.now = 10.3
+    cb.allow()  # next probe window
+
+
+def test_threshold_zero_disables():
+    cb = circuit.CircuitBreaker("http://ep", threshold=0)
+    for _ in range(10):
+        cb.record_failure(IOError("x"))
+        cb.allow()  # never opens
+
+
+def test_registry_shares_per_endpoint():
+    circuit.reset()
+    try:
+        a = circuit.breaker_for("http://one:80")
+        b = circuit.breaker_for("http://one:80")
+        c = circuit.breaker_for("http://two:80")
+        assert a is b and a is not c
+    finally:
+        circuit.reset()
+
+
+# -- integration through HttpFileSystem --------------------------------
+
+
+class _FlakyHandler(BaseHTTPRequestHandler):
+    store: dict
+
+    def log_message(self, *args):
+        pass
+
+    def do_GET(self):
+        self.store["requests"] += 1
+        if self.store["down"]:
+            self.send_response(503)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        body = b"alive"
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+@pytest.fixture()
+def flaky_server():
+    store = {"down": True, "requests": 0}
+    handler = type("H", (_FlakyHandler,), {"store": store})
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        yield f"http://127.0.0.1:{httpd.server_address[1]}", store
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_breaker_wraps_http_filesystem(flaky_server, monkeypatch):
+    base, store = flaky_server
+    monkeypatch.setenv("EEG_TPU_CIRCUIT_THRESHOLD", "2")
+    monkeypatch.setenv("EEG_TPU_CIRCUIT_COOLDOWN", "0.2")
+    circuit.reset()
+    try:
+        fs = remote.HttpFileSystem(
+            retry=remote.RetryPolicy(
+                max_attempts=2, timeout_s=5.0, backoff_s=0.01
+            )
+        )
+        before = obs.metrics.snapshot()["counters"]
+        # two exhausted budgets (2 attempts each) open the circuit
+        for _ in range(2):
+            with pytest.raises(remote.RemoteIOError, match="after 2 attempts"):
+                fs.read_bytes(f"{base}/x.bin")
+        assert store["requests"] == 4
+        # open: fail fast, no request leaves the process
+        with pytest.raises(circuit.CircuitOpenError, match="circuit open"):
+            fs.read_bytes(f"{base}/x.bin")
+        assert store["requests"] == 4
+        after = obs.metrics.snapshot()["counters"]
+        assert after.get("circuit.opened", 0) - before.get(
+            "circuit.opened", 0
+        ) == 1
+        assert after.get("circuit.fast_fail", 0) > before.get(
+            "circuit.fast_fail", 0.0
+        )
+        # endpoint recovers; after the cooldown the half-open probe
+        # closes the circuit and calls flow again
+        store["down"] = False
+        import time
+
+        time.sleep(0.25)
+        assert fs.read_bytes(f"{base}/x.bin") == b"alive"
+        assert fs.read_bytes(f"{base}/x.bin") == b"alive"
+    finally:
+        circuit.reset()

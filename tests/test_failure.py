@@ -167,6 +167,146 @@ def test_elastic_train_restarts_skip_checkpointed_steps(tmp_path):
     assert len(executed) == 10
 
 
+class _FakeManager:
+    """In-memory stand-in for CheckpointManager: just enough of the
+    save/restore/latest_step contract for run_resumable, with full
+    visibility into what elastic_train saved and restored."""
+
+    def __init__(self):
+        self.saved = {}
+        self.save_calls = []
+        self.restore_calls = []
+
+    def latest_step(self):
+        return max(self.saved) if self.saved else None
+
+    def save(self, step, state, extra=None):
+        self.saved[step] = jax.tree_util.tree_map(np.asarray, state)
+        self.save_calls.append(step)
+
+    def restore(self, template, step=None):
+        step = step if step is not None else self.latest_step()
+        return self.saved[step], {"step": step, "extra": {}}
+
+
+def test_elastic_train_restart_accounting_fake_manager():
+    """Scripted failing train_step: restarts counts exactly the
+    failed incarnations, checkpoints drive the replay skip, and
+    exceeding max_restarts re-raises the scripted error."""
+    calls = {"n": 0}
+    fail_at_calls = {3, 5}
+
+    def scripted_step(state, x):
+        calls["n"] += 1
+        if calls["n"] in fail_at_calls:
+            raise RuntimeError(f"scripted fault (call {calls['n']})")
+        return {"w": state["w"] + x}, jnp.float32(1.0)
+
+    mgr = _FakeManager()
+    state, last, restarts = failure.elastic_train(
+        mgr,
+        lambda: {"w": jnp.zeros(())},
+        scripted_step,
+        lambda: [(jnp.float32(i),) for i in range(1, 6)],
+        max_restarts=3,
+        save_every=1,
+        probe_on_failure=False,
+    )
+    assert restarts == 2
+    assert last == 5
+    assert float(np.asarray(state["w"])) == 15.0  # 1+2+3+4+5, no replays lost
+    # incarnation 1: steps 1-2 checkpoint, call 3 (step 3) fails;
+    # incarnation 2: step 3 replays (call 4), call 5 (step 4) fails;
+    # incarnation 3: steps 4-5 (calls 6-7). 5 good + 2 failed = 7.
+    assert calls["n"] == 7
+    assert mgr.save_calls == [1, 2, 3, 4, 5]
+
+
+def test_elastic_train_exhausted_restarts_reraises():
+    def always_fail(state, x):
+        raise RuntimeError("permanent fault")
+
+    mgr = _FakeManager()
+    with pytest.raises(RuntimeError, match="permanent fault"):
+        failure.elastic_train(
+            mgr,
+            lambda: {"w": jnp.zeros(())},
+            always_fail,
+            lambda: [(jnp.float32(1.0),)],
+            max_restarts=2,
+            save_every=1,
+            probe_on_failure=False,
+        )
+    assert mgr.save_calls == []  # nothing ever succeeded
+
+
+def test_elastic_train_probe_on_failure_fails_fast(monkeypatch):
+    """probe_on_failure=True + an unhealthy probe: no restart happens
+    — the run aborts at once with the probe evidence chained to the
+    training failure (obs/failure.py:210-236)."""
+    calls = {"n": 0}
+
+    def crash_once(state, x):
+        calls["n"] += 1
+        raise RuntimeError("device went away")
+
+    monkeypatch.setattr(
+        failure,
+        "probe_devices",
+        lambda *a, **k: failure.DeviceProbeResult(
+            healthy=[], failed=[("fake-dev", "no response")], latencies_s=[]
+        ),
+    )
+    mgr = _FakeManager()
+    with pytest.raises(RuntimeError, match="unhealthy after training") as ei:
+        failure.elastic_train(
+            mgr,
+            lambda: {"w": jnp.zeros(())},
+            crash_once,
+            lambda: [(jnp.float32(1.0),)] * 4,
+            max_restarts=5,
+            save_every=1,
+            probe_on_failure=True,
+        )
+    # the scripted failure is chained as the cause, and the step was
+    # NOT retried onto dead hardware
+    assert isinstance(ei.value.__cause__, RuntimeError)
+    assert "device went away" in str(ei.value.__cause__)
+    assert calls["n"] == 1
+
+
+def test_elastic_train_healthy_probe_allows_restart(monkeypatch):
+    probes = {"n": 0}
+
+    def healthy_probe(*a, **k):
+        probes["n"] += 1
+        return failure.DeviceProbeResult(
+            healthy=["fake-dev"], failed=[], latencies_s=[0.01]
+        )
+
+    monkeypatch.setattr(failure, "probe_devices", healthy_probe)
+    armed = {"fail": True}
+
+    def step(state, x):
+        if armed["fail"]:
+            armed["fail"] = False
+            raise RuntimeError("transient")
+        return {"w": state["w"] + x}, jnp.float32(0.5)
+
+    mgr = _FakeManager()
+    _, last, restarts = failure.elastic_train(
+        mgr,
+        lambda: {"w": jnp.zeros(())},
+        step,
+        lambda: [(jnp.float32(1.0),)] * 3,
+        max_restarts=2,
+        save_every=1,
+        probe_on_failure=True,
+    )
+    assert restarts == 1 and last == 3
+    assert probes["n"] == 1
+
+
 def test_elastic_raw_stream_training_end_to_end(tmp_path):
     """The subsystems compose: elastic_train drives
     make_raw_train_step (fused int16 ingest -> MLP update) across an

@@ -464,3 +464,21 @@ def test_pipeline_save_load_model_over_http(server, fixture_dir, tmp_path):
     ).execute()
     assert stats.num_patterns == 11  # load branch tests on ALL data
     assert "Accuracy" in open(r2).read()
+
+
+def test_retry_policy_full_jitter_opt_in():
+    """Full jitter (satellite of ISSUE 2): opt-in uniform-[0, wait)
+    backoff so concurrent workers desynchronize; the default stays
+    deterministic for reproducibility."""
+    deterministic = remote.RetryPolicy(backoff_s=0.5, max_backoff_s=4.0)
+    assert [deterministic.sleep_for(a) for a in range(4)] == [
+        0.5, 1.0, 2.0, 4.0
+    ]
+    jittered = remote.RetryPolicy(
+        backoff_s=0.5, max_backoff_s=4.0, jitter="full"
+    )
+    waits = [jittered.sleep_for(2) for _ in range(50)]
+    assert all(0.0 <= w <= 2.0 for w in waits)
+    assert len(set(waits)) > 1  # actually random, not a constant
+    with pytest.raises(ValueError, match="jitter"):
+        remote.RetryPolicy(jitter="half")

@@ -91,3 +91,35 @@ def test_prefetch_sharded_feeds_train_step():
         seen += int(np.asarray(mask).sum())
     assert seen == 21
     assert np.isfinite(float(loss))
+
+
+def test_prefetch_undelivered_producer_error_is_logged(caplog):
+    """The silent-loss fix: a producer that dies after the consumer
+    walked away can no longer vanish — the stop-aware put gives up
+    (stop is already set, so the poisoned sentinel is undeliverable)
+    and the error is logged when the consumer joins."""
+    import logging
+    import threading
+
+    stop_seen = threading.Event()
+
+    def source():
+        yield (np.ones(2, np.float32),)
+        # block until the consumer has closed (set stop), then fail:
+        # delivery is impossible, so the error must hit the log
+        stop_seen.wait(5.0)
+        raise RuntimeError("boom after close")
+
+    it = staging.prefetch(source(), buffer_size=1)
+    next(it)  # consume batch 1; batch 2 fills the buffer
+    with caplog.at_level(logging.WARNING,
+                         logger="eeg_dataanalysispackage_tpu.io.staging"):
+        import time
+
+        stop_seen.set()
+        # the producer is now raising; its poisoned sentinel cannot
+        # enter the full buffer, so it polls until close() sets stop
+        time.sleep(0.2)
+        it.close()
+    assert "never delivered" in caplog.text
+    assert "boom after close" in caplog.text

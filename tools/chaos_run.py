@@ -1,0 +1,115 @@
+#!/usr/bin/env python3
+"""Run a pipeline query under a chaos fault spec and diff against the
+fault-free baseline.
+
+The operational form of the chaos-parity acceptance test
+(docs/resilience.md): the same query runs twice — once clean, once
+with ``faults=<spec>`` injected — and the two
+``ClassificationStatistics`` are diffed. Exit 0 = parity (the
+resilience machinery absorbed every injected fault); exit 1 = the
+runs diverged; exit 2 = the chaos run died outright.
+
+Usage::
+
+    python tools/chaos_run.py 'info_file=...&fe=dwt-8-fused&train_clf=logreg' \
+        --faults 'remote.request:p=0.2;ingest.fused:once@1' [--seed 3]
+
+Add ``elastic=true&checkpoint_path=<dir>`` to the query when the spec
+injects ``device.step`` errors — mid-train recovery needs the
+checkpointed train path. A fresh checkpoint dir per run is required
+for a fair diff (pass it in the query; this tool clones the query and
+appends ``-chaos`` to the checkpoint path for the faulted run).
+"""
+
+import argparse
+import difflib
+import json
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from eeg_dataanalysispackage_tpu import obs  # noqa: E402
+from eeg_dataanalysispackage_tpu.pipeline import builder  # noqa: E402
+
+
+def _with_param(query: str, name: str, value: str) -> str:
+    params = [p for p in query.split("&") if not p.startswith(name + "=")]
+    params.append(f"{name}={value}")
+    return "&".join(params)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("query", help="pipeline query string (no faults= in it)")
+    ap.add_argument("--faults", required=True, help="chaos fault spec")
+    ap.add_argument("--seed", type=int, default=0, help="fault-plan seed")
+    args = ap.parse_args(argv)
+
+    query_map = builder.get_query_map(args.query)
+    if builder.get_raw_param(args.query, "faults"):
+        ap.error("put the spec in --faults, not in the query")
+    # an exported EEG_TPU_FAULTS would contaminate the "fault-free"
+    # baseline through the builder's env fallback — the diff would be
+    # meaningless
+    import os
+
+    from eeg_dataanalysispackage_tpu.obs import chaos
+
+    if os.environ.pop(chaos.ENV_SPEC, None):
+        print(f"(ignoring exported {chaos.ENV_SPEC} for both runs)")
+
+    print(f"== baseline (no faults) ==", flush=True)
+    baseline = builder.PipelineBuilder(args.query).execute()
+    base_text = str(baseline)
+    print(base_text)
+
+    chaos_query = _with_param(
+        _with_param(args.query, "faults", args.faults),
+        "faults_seed",
+        str(args.seed),
+    )
+    if "checkpoint_path" in query_map:
+        # a warm checkpoint dir would make the chaos run resume the
+        # baseline's training instead of running its own
+        chaos_query = _with_param(
+            chaos_query, "checkpoint_path",
+            query_map["checkpoint_path"] + "-chaos",
+        )
+
+    before = obs.metrics.snapshot()["counters"]
+    print(f"\n== chaos run (faults={args.faults!r}, seed={args.seed}) ==",
+          flush=True)
+    try:
+        chaotic = builder.PipelineBuilder(chaos_query).execute()
+    except Exception as e:
+        print(f"CHAOS RUN DIED: {type(e).__name__}: {e}")
+        return 2
+    chaos_text = str(chaotic)
+    print(chaos_text)
+
+    after = obs.metrics.snapshot()["counters"]
+    events = {
+        k: after[k] - before.get(k, 0.0)
+        for k in sorted(after)
+        if after[k] != before.get(k, 0.0)
+        and k.split(".")[0] in ("chaos", "circuit", "elastic", "pipeline")
+    }
+    print("\n== resilience events ==")
+    print(json.dumps(events, indent=2, sort_keys=True))
+
+    if base_text == chaos_text:
+        print("\nPARITY: statistics identical under injected faults")
+        return 0
+    print("\nDIVERGED: statistics differ under injected faults")
+    sys.stdout.writelines(
+        difflib.unified_diff(
+            base_text.splitlines(keepends=True),
+            chaos_text.splitlines(keepends=True),
+            "baseline", "chaos",
+        )
+    )
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

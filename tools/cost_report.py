@@ -100,7 +100,10 @@ def main() -> None:
     # tiling, not bytes.
     from eeg_dataanalysispackage_tpu.parallel import train as ptrain
 
+    # AOT-lower the raw jitted step (the factory returns a host-side
+    # chaos-injection wrapper; __wrapped__ is the jit object)
     init_state, tstep = ptrain.make_train_step()
+    tstep = ptrain._raw_step(tstep)
     state0 = init_state(jax.random.PRNGKey(0))
     vec_f = jax.ShapeDtypeStruct((n,), jnp.float32)
     report(
@@ -114,6 +117,7 @@ def main() -> None:
     # this row from train_step's separates extraction traffic from
     # optimizer/loss traffic
     _, fstep = ptrain.make_feature_train_step()
+    fstep = ptrain._raw_step(fstep)
     feats = jax.ShapeDtypeStruct((n, 48), jnp.float32)
     report("feature_step", fstep, (state0, feats, vec_f, vec_f), 3 * 48 * 4)
 
