@@ -1,1 +1,27 @@
+"""I/O layer shared knobs.
 
+``EEG_TPU_PREFETCH_DEPTH`` is one knob for both sides of the input
+pipeline — the provider's host-parse look-ahead (io/provider) and the
+staged-batch buffer default (io/staging.prefetch). This module is its
+single source, so the two consumers cannot desynchronize.
+"""
+
+from __future__ import annotations
+
+import os
+
+ENV_PREFETCH_DEPTH = "EEG_TPU_PREFETCH_DEPTH"
+_DEFAULT_PREFETCH_DEPTH = 2
+
+
+def env_int(name: str, default: int) -> int:
+    """Positive-int env knob; unset/garbage resolves ``default``."""
+    try:
+        return max(1, int(os.environ.get(name, "")))
+    except ValueError:
+        return default
+
+
+def default_prefetch_depth() -> int:
+    """``EEG_TPU_PREFETCH_DEPTH``, else 2 (classic double buffering)."""
+    return env_int(ENV_PREFETCH_DEPTH, _DEFAULT_PREFETCH_DEPTH)

@@ -1,0 +1,223 @@
+"""Content-addressed on-disk cache of extracted feature matrices.
+
+The reference re-reads and re-featurizes every BrainVision recording
+on every run (PipelineBuilder.java:94-295 — there is no persistence
+between the loader and the classifiers), and the fused device path
+inherited that shape: ingest + DWT ran per query even when nothing
+about the inputs had changed. This module closes that gap for the
+pipeline's fused feature path: the ``(n, C*K)`` float32 feature matrix
+and its ``(n,)`` float64 targets are stored once per *content key* and
+re-runs load them instead of re-parsing, re-staging, and re-running
+the device program.
+
+Key scheme (all content, no paths or mtimes)::
+
+    blake2b(
+        per-recording [relative path, guessed number,
+                       digest(.vhdr bytes), digest(.vmrk bytes),
+                       digest(.eeg bytes)] in load order
+        + channel set + epoch window (pre, post)
+        + extractor id/config (family, wavelet index, epoch size,
+          skip, feature size)
+    )
+
+so editing any byte of any file of the run, changing the guessed
+number, the channel selection, the window, or the extractor geometry
+all invalidate naturally — there is nothing to expire. The key
+deliberately does NOT include the fused backend rung: every rung
+produces tolerance-level-identical features by contract
+(io/provider.FUSED_DEGRADATION_LADDER), so a cache hit serves whatever
+backend computed the entry first and *skips the degradation ladder
+entirely* — the fastest rung of all is not running one.
+
+Storage is one ``.npz`` per key under the cache directory, written
+via the checkpoint store's atomic tmp+``os.replace`` discipline
+(``checkpoint.manager.atomic_write_bytes``), so a crash mid-store can
+never leave a truncated entry. A corrupt or truncated entry (failed
+``np.load``, missing arrays, shape mismatch) is treated as a miss —
+counted, deleted best-effort, and rebuilt — never a crash.
+
+Configuration:
+
+- ``EEG_TPU_FEATURE_CACHE_DIR`` — cache directory (default: the
+  XDG-style per-user scratch ``~/.cache/eeg-tpu/feature-cache``);
+- ``EEG_TPU_NO_FEATURE_CACHE=1`` — disable globally;
+- ``cache=false`` query parameter — disable for one pipeline run.
+
+Attribution mirrors ``ops/plan_cache``: hits/misses/corrupt land in
+``obs.metrics`` (``feature_cache.*``) and :func:`stats` is embedded on
+every bench line as the ``feature_cache`` field.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import logging
+import os
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+#: cache directory override (explicit argument wins over it).
+ENV_DIR = "EEG_TPU_FEATURE_CACHE_DIR"
+#: set to "1" to disable the feature cache everywhere.
+ENV_DISABLE = "EEG_TPU_NO_FEATURE_CACHE"
+
+_FORMAT_VERSION = 1
+
+_lock = threading.Lock()
+_hits = 0
+_misses = 0
+_corrupt = 0
+
+
+def default_cache_dir() -> str:
+    """Per-user scratch default (XDG-style), sibling of the persistent
+    compile cache's default."""
+    root = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache"
+    )
+    return os.path.join(root, "eeg-tpu", "feature-cache")
+
+
+def resolve_cache_dir(path: Optional[str] = None) -> Optional[str]:
+    """The directory the cache should use, or None when disabled.
+    Precedence: explicit ``path`` > ``EEG_TPU_FEATURE_CACHE_DIR`` >
+    the per-user default; ``EEG_TPU_NO_FEATURE_CACHE=1`` wins over
+    everything."""
+    if os.environ.get(ENV_DISABLE) == "1":
+        return None
+    return path or os.environ.get(ENV_DIR) or default_cache_dir()
+
+
+def stats() -> Dict[str, int]:
+    """Process-wide hit/miss/corrupt counters — the bench's
+    ``feature_cache`` payload field (schema-stable zeros when the
+    cache never ran, like ``plan_cache.stats``)."""
+    with _lock:
+        return {"hits": _hits, "misses": _misses, "corrupt": _corrupt}
+
+
+def reset_stats() -> None:
+    """Zero the counters (test/bench isolation)."""
+    global _hits, _misses, _corrupt
+    with _lock:
+        _hits = _misses = _corrupt = 0
+
+
+def _count(kind: str) -> None:
+    global _hits, _misses, _corrupt
+    from .. import obs
+
+    with _lock:
+        if kind == "hit":
+            _hits += 1
+        elif kind == "miss":
+            _misses += 1
+        else:
+            _corrupt += 1
+    obs.metrics.count(f"feature_cache.{kind}")
+
+
+def run_key(content_digests, channel_names, pre: int, post: int,
+            extractor: Tuple) -> str:
+    """Content key for one pipeline run's feature matrix.
+
+    ``content_digests`` is the ordered ``(rel_path, guessed, digest)``
+    list from ``OfflineDataProvider.content_digests()`` — the files
+    that will actually load, in load order (cross-file balance state
+    makes the feature/target rows a function of the whole ordered run,
+    so per-run is the finest sound granularity). ``extractor`` is the
+    static id/config tuple, e.g. ``("dwt-fused", 8, 512, 175, 16)``.
+    """
+    h = hashlib.blake2b(digest_size=20)
+    h.update(b"eeg-tpu-feature-cache-v%d" % _FORMAT_VERSION)
+    for rel_path, guessed, digest in content_digests:
+        h.update(repr((rel_path, int(guessed), digest)).encode())
+    h.update(repr(tuple(channel_names)).encode())
+    h.update(repr((int(pre), int(post))).encode())
+    h.update(repr(tuple(extractor)).encode())
+    return h.hexdigest()
+
+
+class FeatureCache:
+    """One directory of content-addressed ``(features, targets)``
+    entries. Construct via :func:`open_cache`."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+
+    def _entry_path(self, key: str) -> str:
+        return os.path.join(self.directory, f"{key}.npz")
+
+    def lookup(self, key: str) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """(features, targets) for ``key``, or None on a miss. Corrupt
+        entries count as misses and are removed best-effort."""
+        path = self._entry_path(key)
+        if not os.path.exists(path):
+            _count("miss")
+            return None
+        try:
+            with np.load(path, allow_pickle=False) as data:
+                features = np.asarray(data["features"])
+                targets = np.asarray(data["targets"])
+            if features.ndim != 2 or targets.shape != (features.shape[0],):
+                raise ValueError(
+                    f"inconsistent entry shapes {features.shape} / "
+                    f"{targets.shape}"
+                )
+        except Exception as e:
+            # truncated write survivor, zip damage, missing arrays:
+            # the entry is dead weight — drop it and rebuild
+            logger.warning(
+                "feature cache entry %s is corrupt (%s: %s); treating "
+                "as a miss", path, type(e).__name__, e,
+            )
+            _count("corrupt")
+            _count("miss")
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return None
+        _count("hit")
+        return features, targets
+
+    def store(self, key: str, features: np.ndarray,
+              targets: np.ndarray) -> Optional[str]:
+        """Atomically persist an entry; returns its path, or None when
+        the directory is unwritable (a broken scratch dir must never
+        kill the run that just computed the features)."""
+        from ..checkpoint.manager import atomic_write_bytes
+        from .. import obs
+
+        buf = io.BytesIO()
+        np.savez(
+            buf,
+            features=np.asarray(features),
+            targets=np.asarray(targets),
+        )
+        path = self._entry_path(key)
+        try:
+            os.makedirs(self.directory, exist_ok=True)
+            atomic_write_bytes(path, buf.getvalue())
+        except OSError as e:
+            logger.warning(
+                "feature cache store failed for %s (%s); continuing "
+                "uncached", path, e,
+            )
+            return None
+        obs.metrics.count("feature_cache.store")
+        return path
+
+
+def open_cache(path: Optional[str] = None) -> Optional[FeatureCache]:
+    """The cache for the resolved directory, or None when disabled."""
+    d = resolve_cache_dir(path)
+    if d is None:
+        return None
+    return FeatureCache(d)

@@ -3,7 +3,10 @@
 TPU-first re-design of ``DataTransformation/OffLineDataProvider.java``:
 instead of a stateful loader mutating epoch lists per marker, files are
 parsed on the host into dense ``(n, 3, 750)`` arrays ready for device
-staging. Input-contract parity:
+staging. Multi-file runs parse their triplets in a bounded thread pool
+with an order-preserving merge (``_iter_recordings``), overlapping the
+next files' host parse with the current file's epoching / device
+work — bit-identical output at any pool size. Input-contract parity:
 
 - args ``[<info.txt path>]`` or ``[<.eeg path>, <guessed number>]``
   (OffLineDataProvider.java:111-141);
@@ -19,16 +22,39 @@ staging. Input-contract parity:
 
 from __future__ import annotations
 
+import collections
+import hashlib
 import logging
-from typing import Dict, List, Optional, Sequence
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from . import brainvision, sources
+from . import ENV_PREFETCH_DEPTH, default_prefetch_depth, env_int  # noqa: F401
 from ..epochs import extractor
 from ..utils import constants
 
 logger = logging.getLogger(__name__)
+
+#: parse-pool size for multi-file runs (``EEG_TPU_INGEST_WORKERS``
+#: overrides; a pipeline query overrides per run via ``ingest_workers=``).
+#: The decoded look-ahead beyond the in-flight parses is the shared
+#: ``EEG_TPU_PREFETCH_DEPTH`` knob (io/__init__ — one source for the
+#: provider look-ahead and io/staging's staged-batch buffer).
+ENV_INGEST_WORKERS = "EEG_TPU_INGEST_WORKERS"
+_DEFAULT_INGEST_WORKERS = 4
+
+
+def default_ingest_workers() -> int:
+    """Parse-pool size when the caller does not pin one: the env
+    override, else min(4, cpu count) — file parsing is I/O plus numpy
+    demux, both of which release the GIL, but past a few workers the
+    ordered merge is the bottleneck, not parsing."""
+    if os.environ.get(ENV_INGEST_WORKERS):
+        return env_int(ENV_INGEST_WORKERS, _DEFAULT_INGEST_WORKERS)
+    return min(_DEFAULT_INGEST_WORKERS, os.cpu_count() or 1)
 
 #: the backend degradation ladder for fused device ingest, fastest
 #: first: Pallas kernel -> block (alignment-classed matmul) -> XLA
@@ -54,6 +80,30 @@ def degradation_ladder(backend: str):
     )
 
 
+def fused_extractor_id(wavelet_index: int) -> Tuple:
+    """The fused path's static extractor id/config tuple (feature-
+    cache key component), derived from
+    :meth:`OfflineDataProvider.load_features_device`'s own parameter
+    defaults — so the key can never drift from the geometry the
+    computation actually runs with."""
+    import inspect
+
+    defaults = {
+        k: p.default
+        for k, p in inspect.signature(
+            OfflineDataProvider.load_features_device
+        ).parameters.items()
+        if p.default is not inspect.Parameter.empty
+    }
+    return (
+        "dwt-fused",
+        int(wavelet_index),
+        defaults["epoch_size"],
+        defaults["skip_samples"],
+        defaults["feature_size"],
+    )
+
+
 class OfflineDataProvider:
     """Loads BrainVision recordings and extracts balanced P300 epochs."""
 
@@ -64,6 +114,8 @@ class OfflineDataProvider:
         channel_names: Sequence[str] = constants.CHANNEL_NAMES,
         pre: int = constants.PRESTIMULUS_SAMPLES,
         post: int = constants.POSTSTIMULUS_SAMPLES,
+        workers: Optional[int] = None,
+        prefetch_depth: Optional[int] = None,
     ):
         args = [a for a in args if a is not None]
         if len(args) == 0 or len(args) > 6:
@@ -84,6 +136,14 @@ class OfflineDataProvider:
         self._channel_names = [c.lower() for c in channel_names]
         self._pre = pre
         self._post = post
+        self._workers = (
+            max(1, int(workers)) if workers is not None
+            else default_ingest_workers()
+        )
+        self._prefetch_depth = (
+            max(1, int(prefetch_depth)) if prefetch_depth is not None
+            else default_prefetch_depth()
+        )
         self._batch: Optional[extractor.EpochBatch] = None
         # Resolved channel indices persist across files of a run: the
         # reference's FZIndex/CZIndex/PZIndex are instance fields, so a
@@ -115,21 +175,125 @@ class OfflineDataProvider:
 
     # -- loading --------------------------------------------------------
 
+    def _resolved_workers(self, n_files: int) -> int:
+        """Parse-pool size for this run. Deterministic chaos replay
+        (``faults=``) counts injection-point invocations in call
+        order, which a parallel parse would scramble — an installed
+        fault plan therefore forces the sequential path, keeping the
+        chaos-parity contract bit-stable."""
+        from ..obs import chaos
+
+        if chaos.active_plan() is not None:
+            return 1
+        return min(self._workers, n_files)
+
+    def _iter_recordings(
+        self, prefix: str, files: Dict[str, int]
+    ) -> Iterator[Tuple[str, int, brainvision.Recording]]:
+        """Yield ``(rel_path, guessed, recording)`` in ``files`` order.
+
+        Parsing runs in a bounded thread pool (``workers`` in flight,
+        ``prefetch_depth`` decoded results queued ahead), but results
+        are merged back in submission order, so epoch order, the
+        cross-file balance counters, the stale-channel-index reuse,
+        and the seed-1 shuffle downstream are all bit-identical to the
+        sequential loop. Files whose sibling is missing are skipped
+        with the same log line as before; any other parse error
+        surfaces at the file's in-order position. The consumer stepping
+        the generator overlaps the *next* files' host parse with its
+        own epoching/featurizing/device work.
+        """
+        items = list(files.items())
+        workers = self._resolved_workers(len(items))
+        if workers <= 1:
+            for rel_path, guessed in items:
+                try:
+                    rec = brainvision.load_recording(
+                        prefix + rel_path, filesystem=self._fs
+                    )
+                except FileNotFoundError as e:
+                    logger.warning("Did not load %s: %s", rel_path, e)
+                    continue
+                yield rel_path, guessed, rec
+            return
+
+        from .. import obs
+
+        obs.metrics.gauge("ingest.parallel_workers", workers)
+        depth = workers + self._prefetch_depth
+        pool = ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix="eeg-tpu-ingest"
+        )
+        pending: "collections.deque" = collections.deque()
+        idx = 0
+        try:
+            while idx < len(items) or pending:
+                while idx < len(items) and len(pending) < depth:
+                    rel_path, guessed = items[idx]
+                    pending.append(
+                        (
+                            rel_path,
+                            guessed,
+                            pool.submit(
+                                brainvision.load_recording,
+                                prefix + rel_path,
+                                filesystem=self._fs,
+                            ),
+                        )
+                    )
+                    idx += 1
+                rel_path, guessed, fut = pending.popleft()
+                try:
+                    rec = fut.result()
+                except FileNotFoundError as e:
+                    logger.warning("Did not load %s: %s", rel_path, e)
+                    continue
+                obs.metrics.count("ingest.files_parsed")
+                yield rel_path, guessed, rec
+        finally:
+            # consumer stopped early or a parse failed: cancel queued
+            # work and let in-flight parses finish on their own
+            # instead of blocking the exit on them
+            pool.shutdown(wait=False, cancel_futures=True)
+
     def load(self) -> extractor.EpochBatch:
         """Parse inputs and extract epochs from every resolvable file."""
         prefix, files = self._resolve_files()
         balance = extractor.BalanceState()
         batches: List[extractor.EpochBatch] = []
-        for rel_path, guessed in files.items():
-            eeg_path = prefix + rel_path
-            try:
-                rec = brainvision.load_recording(eeg_path, filesystem=self._fs)
-            except FileNotFoundError as e:
-                logger.warning("Did not load %s: %s", rel_path, e)
-                continue
+        for _rel_path, guessed, rec in self._iter_recordings(prefix, files):
             batches.append(self._process_recording(rec, guessed, balance))
         self._batch = extractor.EpochBatch.concatenate(batches)
         return self._batch
+
+    def content_digests(self) -> List[Tuple[str, int, str]]:
+        """Ordered ``(rel_path, guessed, content digest)`` for every
+        recording this run would load.
+
+        The digest covers the raw bytes of the whole BrainVision
+        triplet (.vhdr, .vmrk, .eeg), so any content change — new
+        samples, edited markers, a different channel table — yields a
+        new digest. Files whose sibling is missing are omitted, exactly
+        as :meth:`load` skips them, so the list fingerprints the run
+        that would actually happen. This is the provider half of the
+        feature-cache key (io/feature_cache.run_key).
+        """
+        prefix, files = self._resolve_files()
+        out: List[Tuple[str, int, str]] = []
+        for rel_path, guessed in files.items():
+            eeg_path = prefix + rel_path
+            base = os.path.splitext(eeg_path)[0]
+            triplet = (base + ".vhdr", base + ".vmrk", eeg_path)
+            if not all(self._fs.exists(p) for p in triplet):
+                continue
+            # sha256, not blake2b: hardware SHA extensions make it
+            # ~1.7x faster on the multi-MB .eeg streams this walks,
+            # and digest speed is the warm-cache run's floor
+            h = hashlib.sha256()
+            for p in triplet:
+                h.update(self._fs.read_bytes(p))
+            out.append((rel_path, guessed, h.hexdigest()))
+        return out
 
     # Reference-compatible alias (OffLineDataProvider.loadData).
     load_data = load
@@ -215,14 +379,10 @@ class OfflineDataProvider:
             )
         feats: List[np.ndarray] = []
         targets: List[np.ndarray] = []
-        for rel_path, guessed in files.items():
-            try:
-                rec = brainvision.load_recording(
-                    prefix + rel_path, filesystem=self._fs
-                )
-            except FileNotFoundError as e:
-                logger.warning("Did not load %s: %s", rel_path, e)
-                continue
+        # the ordered parallel parse: while this loop runs one file's
+        # staging + fused program dispatch, the pool is already
+        # parsing the next files' triplets on the host
+        for rel_path, guessed, rec in self._iter_recordings(prefix, files):
             raw, res, n_samples = device_ingest.stage_raw(
                 rec, self._channel_indices(rec)
             )
@@ -259,6 +419,21 @@ class OfflineDataProvider:
                 ]
             ),
             np.concatenate(targets),
+        )
+
+    def feature_cache_key(self, extractor: Tuple) -> str:
+        """Content key for this run's feature matrix: the ordered
+        triplet digests plus the provider's channel set and epoch
+        window, plus the static ``extractor`` id/config tuple
+        (io/feature_cache.run_key)."""
+        from . import feature_cache
+
+        return feature_cache.run_key(
+            self.content_digests(),
+            self._channel_names,
+            self._pre,
+            self._post,
+            extractor,
         )
 
     def _channel_indices(self, rec: brainvision.Recording) -> List[int]:

@@ -23,17 +23,25 @@ from __future__ import annotations
 import logging
 import queue
 import threading
-from typing import Iterable, Iterator, Sequence, Tuple
+from typing import Iterable, Iterator, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 
+from . import ENV_PREFETCH_DEPTH  # noqa: F401  (re-export: the knob's name)
+from . import default_prefetch_depth
 from ..obs import chaos
 from ..parallel import mesh as pmesh
 
 logger = logging.getLogger(__name__)
 
 _END = object()
+
+#: default in-flight staged-batch bound when the caller does not pass
+#: ``buffer_size`` explicitly — the shared ``EEG_TPU_PREFETCH_DEPTH``
+#: knob (io/__init__), same source as io/provider's host-parse
+#: look-ahead.
+default_buffer_size = default_prefetch_depth
 
 
 class _Poison:
@@ -66,7 +74,7 @@ def minibatches(
 def prefetch(
     batches: Iterable[Sequence[np.ndarray]],
     mesh=None,
-    buffer_size: int = 2,
+    buffer_size: Optional[int] = None,
     with_mask: bool = True,
 ) -> Iterator[Tuple[jax.Array, ...]]:
     """Stage host batches onto device(s) ahead of consumption.
@@ -78,9 +86,12 @@ def prefetch(
     ``mesh.shard_batch_with_mask`` convention) otherwise.
 
     ``buffer_size`` bounds how many staged batches may be in flight;
-    2 = classic double buffering. Exceptions raised by the source
-    iterator or by staging surface at the consumer, not in the thread.
+    None resolves ``EEG_TPU_PREFETCH_DEPTH`` (default 2 = classic
+    double buffering). Exceptions raised by the source iterator or by
+    staging surface at the consumer, not in the thread.
     """
+    if buffer_size is None:
+        buffer_size = default_buffer_size()
     if buffer_size < 1:
         raise ValueError(f"buffer_size must be >= 1, got {buffer_size}")
 
@@ -189,7 +200,7 @@ def prefetch_epochs(
     targets: np.ndarray,
     batch_size: int,
     mesh=None,
-    buffer_size: int = 2,
+    buffer_size: Optional[int] = None,
 ) -> Iterator[Tuple[jax.Array, ...]]:
     """Convenience: ``minibatches`` + ``prefetch`` over an epoch set,
     the staged-input form consumed by ``parallel.train.make_train_step``

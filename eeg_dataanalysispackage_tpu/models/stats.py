@@ -120,3 +120,19 @@ class ClassificationStatistics:
             f"Non-targets: {self.class1_sum}\n"
             f"Targets: {self.class2_sum}\n"
         )
+
+
+class FanOutStatistics(dict):
+    """Ordered ``{classifier name: ClassificationStatistics}`` from a
+    ``classifiers=`` fan-out run (pipeline/builder.py).
+
+    A plain dict, so callers index per-classifier statistics directly
+    (``stats["svm"].calc_accuracy()``); ``str()`` renders the
+    concatenated per-classifier reports in request order — the form
+    ``result_path`` persists.
+    """
+
+    def __str__(self) -> str:
+        return "\n".join(
+            f"classifier: {name}\n{stats}" for name, stats in self.items()
+        )

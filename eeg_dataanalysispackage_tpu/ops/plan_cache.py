@@ -146,3 +146,105 @@ def clear() -> None:
         caches = list(_registry.values())
     for c in caches:
         c.clear()
+
+
+# -- cross-process persistence ------------------------------------------
+#
+# Each bench variant runs in its own fresh child (bench.py's
+# resilience contract), so without persistence every recorded
+# block_ingest/pallas_ingest line shows ``hits: 0`` — the cache's
+# effectiveness was structurally unmeasurable. When
+# ``EEG_TPU_PLAN_CACHE_FILE`` names a file, a process can load the
+# previous process's plans at startup and save the union at exit
+# (tools/ingest_bench.py does both), so a repeat bench run — or a
+# later variant of the same run that plans the same layout — reports
+# real hit counts. The file is a local, trusted pickle (plans are
+# plain numpy containers produced by this package); loading ignores a
+# missing or unreadable file and counts nothing.
+
+ENV_FILE = "EEG_TPU_PLAN_CACHE_FILE"
+
+
+def persist_path(path: str = None) -> str:
+    """The persistence file in effect (explicit > env), or None."""
+    return path or os.environ.get(ENV_FILE) or None
+
+
+def save_file(path: str = None) -> str:
+    """Pickle every registered cache's entries to ``path`` (atomic
+    tmp + ``os.replace``); returns the path, or None when persistence
+    is off or the write failed (never fatal)."""
+    import pickle
+    import tempfile
+
+    path = persist_path(path)
+    if path is None:
+        return None
+    with _registry_lock:
+        caches = list(_registry.items())
+    payload = {}
+    for name, c in caches:
+        with c._lock:
+            # capacity rides along: a warm-started process must not
+            # recreate a deliberately small cache (the MB-scale
+            # block-class operator table's capacity=16) at the roomy
+            # shared default
+            payload[name] = {
+                "capacity": c.capacity,
+                "entries": dict(c._entries),
+            }
+    try:
+        directory = os.path.dirname(os.path.abspath(path))
+        os.makedirs(directory, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=directory, prefix=".plan-cache-")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+    except (OSError, pickle.PicklingError):
+        return None
+    return path
+
+
+def load_file(path: str = None) -> int:
+    """Populate the registered caches from ``path``; returns the
+    number of entries loaded (0 on a missing/corrupt file — a warm
+    start is best-effort). Loaded entries count as neither hits nor
+    misses; the capacity bound applies normally."""
+    import pickle
+
+    path = persist_path(path)
+    if path is None or not os.path.exists(path):
+        return 0
+    try:
+        with open(path, "rb") as f:
+            payload = pickle.load(f)
+        if not isinstance(payload, dict):
+            return 0
+    except Exception:
+        return 0
+    loaded = 0
+    for name, record in payload.items():
+        if not isinstance(record, dict) or "entries" not in record:
+            continue
+        entries = record["entries"]
+        if not isinstance(entries, dict):
+            continue
+        c = cache(name, capacity=record.get("capacity"))
+        if c.capacity is None:
+            # the cache may predate this load (created by a planner
+            # import with no explicit bound); adopt the saved bound so
+            # a warm start never voids a deliberately small capacity
+            c.capacity = record.get("capacity")
+        with c._lock:
+            for key, value in entries.items():
+                c._entries[key] = value
+                c._entries.move_to_end(key)
+                loaded += 1
+            cap = c.capacity or _capacity()
+            while len(c._entries) > cap:
+                c._entries.popitem(last=False)
+    return loaded

@@ -15,8 +15,9 @@ from __future__ import annotations
 import contextlib
 import logging
 import re
-from typing import Dict, Optional
+from typing import Dict, Optional, Union
 
+import numpy as np
 
 from .. import obs
 from ..features import registry as fe_registry
@@ -64,11 +65,17 @@ class PipelineBuilder:
         # None = route by the input URI scheme (http/gs/file/local) in
         # the provider; an explicit filesystem overrides routing.
         self._fs = filesystem
-        self.statistics: Optional[stats.ClassificationStatistics] = None
+        #: ClassificationStatistics, or FanOutStatistics (a dict of
+        #: them, one per name) for classifiers= runs
+        self.statistics: Optional[
+            Union[stats.ClassificationStatistics, stats.FanOutStatistics]
+        ] = None
         #: per-stage wall times for the run (obs.StageTimer)
         self.timers = obs.StageTimer()
 
-    def execute(self) -> stats.ClassificationStatistics:
+    def execute(
+        self,
+    ) -> Union[stats.ClassificationStatistics, stats.FanOutStatistics]:
         query_map = get_query_map(self.query)
         logger.info("query: %s", query_map)
 
@@ -104,7 +111,9 @@ class PipelineBuilder:
                     return self._execute(query_map)
             return self._execute(query_map)
 
-    def _execute(self, query_map) -> stats.ClassificationStatistics:
+    def _execute(
+        self, query_map
+    ) -> Union[stats.ClassificationStatistics, stats.FanOutStatistics]:
 
         # 1. input (PipelineBuilder.java:104-113)
         if "info_file" in query_map:
@@ -114,7 +123,17 @@ class PipelineBuilder:
         else:
             raise ValueError("Missing the input file argument")
 
-        odp = provider.OfflineDataProvider(files, filesystem=self._fs)
+        # ingest_workers= bounds the provider's parallel parse pool;
+        # prefetch= its decoded look-ahead (both default from
+        # EEG_TPU_INGEST_WORKERS / EEG_TPU_PREFETCH_DEPTH). The merge
+        # is order-preserving, so epoch order and the balance counters
+        # are bit-identical at any pool size.
+        odp = provider.OfflineDataProvider(
+            files,
+            filesystem=self._fs,
+            workers=self._int_param(query_map, "ingest_workers"),
+            prefetch_depth=self._int_param(query_map, "prefetch"),
+        )
 
         # 2. feature extraction (PipelineBuilder.java:128-139).
         # fe=dwt-8-fused is the TPU fast-path mode: ingest + DWT run as
@@ -145,6 +164,46 @@ class PipelineBuilder:
                 "-block": "block",
                 "-xla": "xla",
             }[fused_match.group(2)]
+            # content-addressed feature cache (io/feature_cache.py):
+            # keyed on the triplet bytes + channel set + window +
+            # extractor geometry — deliberately NOT the backend rung
+            # (every rung is tolerance-identical by contract), so a
+            # hit serves whatever backend computed the entry first and
+            # skips the degradation ladder entirely. cache=false opts
+            # a run out; EEG_TPU_NO_FEATURE_CACHE=1 disables globally.
+            from ..io import feature_cache
+
+            cache = (
+                feature_cache.open_cache()
+                if query_map.get("cache", "true") != "false"
+                else None
+            )
+            cache_key = None
+            features = targets = None
+            landed = None
+            if cache is not None:
+                try:
+                    with self.timers.stage("ingest"):
+                        cache_key = odp.feature_cache_key(
+                            provider.fused_extractor_id(wavelet_index)
+                        )
+                        hit = cache.lookup(cache_key)
+                except Exception as e:
+                    # an unreadable input surfaces properly from the
+                    # compute path below; a broken cache dir must not
+                    # kill a run the uncached path can finish
+                    logger.warning(
+                        "feature cache unavailable (%s: %s); running "
+                        "uncached", type(e).__name__, e,
+                    )
+                    cache = cache_key = hit = None
+                if hit is not None:
+                    features, targets = hit
+                    landed = "cache"
+                    logger.info(
+                        "feature cache hit (%d rows): ingest + "
+                        "featurization skipped", len(targets),
+                    )
             # backend degradation ladder (io/provider.py): a fused
             # backend that fails to lower, OOMs, or sits on unhealthy
             # devices degrades pallas -> block -> xla -> host epochs +
@@ -158,7 +217,8 @@ class PipelineBuilder:
                 if degrade
                 else [backend]
             )
-            landed = None
+            if landed is not None:
+                ladder = []
             for rung in ladder:
                 if rung == "host":
                     break
@@ -203,10 +263,16 @@ class PipelineBuilder:
                         )
                         break
             if landed is not None:
-                if landed != backend:
+                if landed != backend and landed != "cache":
                     logger.warning(
                         "fused ingest degraded %r -> %r", backend, landed
                     )
+                if (
+                    landed != "cache"
+                    and cache is not None
+                    and cache_key is not None
+                ):
+                    cache.store(cache_key, features, targets)
                 fe = None
                 n = len(targets)
             else:
@@ -233,7 +299,24 @@ class PipelineBuilder:
         obs.metrics.count("pipeline.epochs_loaded", n)
 
         # 3. classifier (PipelineBuilder.java:151-284)
-        if "train_clf" in query_map:
+        if "classifiers" in query_map:
+            # shared-feature fan-out: the expensive-to-produce feature
+            # matrix is computed once (above) and every requested
+            # classifier trains + tests against the same in-memory
+            # rows — the reference trains exactly one classifier per
+            # execution, so comparing five meant five full
+            # ingest+featurization passes. Single-classifier
+            # train_clf= runs are untouched (byte-identical output).
+            statistics = self._execute_fanout(
+                query_map,
+                n,
+                features=features if fused else None,
+                targets=targets if fused else None,
+                batch=None if fused else batch,
+                fe=fe,
+            )
+
+        elif "train_clf" in query_map:
             classifier = clf_registry.create(query_map["train_clf"])
 
             train_idx, test_idx = java_compat.train_test_split_indices(n, seed=1)
@@ -338,6 +421,86 @@ class PipelineBuilder:
 
         self.statistics = statistics
         return statistics
+
+    # -- shared-feature fan-out ----------------------------------------
+
+    def _execute_fanout(
+        self, query_map, n, features, targets, batch, fe
+    ) -> stats.FanOutStatistics:
+        """``classifiers=a,b,c``: train + test every named classifier
+        against the one feature matrix this run already produced.
+
+        Same seed-1 70/30 split, same per-classifier fit/test calls as
+        the single-classifier path, so ``classifiers=logreg`` and
+        ``train_clf=logreg`` produce identical per-classifier
+        statistics — only the ingest+featurization cost stops scaling
+        with the classifier count. Duplicate names collapse (last
+        wins, dict semantics); ``config_*`` passes to every classifier,
+        each picking the keys it knows.
+        """
+        if "train_clf" in query_map or "load_clf" in query_map:
+            raise ValueError(
+                "classifiers= replaces train_clf=/load_clf=; "
+                "pass exactly one of them"
+            )
+        if query_map.get("save_clf") == "true":
+            raise ValueError(
+                "classifiers= fan-out does not support save_clf; "
+                "train the model to persist via train_clf="
+            )
+        if query_map.get("elastic") == "true":
+            raise ValueError(
+                "classifiers= fan-out does not support elastic=true; "
+                "use train_clf= for elastic training"
+            )
+        names = [s for s in query_map["classifiers"].split(",") if s]
+        if not names:
+            raise ValueError(
+                "classifiers= requires a comma-separated classifier list"
+            )
+
+        if features is None:
+            # host path: one extraction pass over the whole epoch
+            # batch (per-epoch independent, so slicing rows afterwards
+            # equals extracting the slices)
+            with self.timers.stage("features"):
+                features = np.asarray(
+                    fe.extract_batch(np.asarray(batch.epochs, np.float64))
+                )
+            targets = np.asarray(batch.targets, dtype=np.float64)
+
+        train_idx, test_idx = java_compat.train_test_split_indices(n, seed=1)
+        config = {
+            k: v for k, v in query_map.items() if k.startswith("config_")
+        }
+        statistics = stats.FanOutStatistics()
+        for name in names:
+            classifier = clf_registry.create(name)
+            classifier.set_config(config)
+            with self.timers.stage("train"):
+                classifier.fit(features[train_idx], targets[train_idx])
+            logger.info("trained %s", name)
+            with self.timers.stage("test"):
+                statistics[name] = classifier.test_features(
+                    features[test_idx], targets[test_idx]
+                )
+            obs.metrics.count("pipeline.fanout.classifiers")
+        return statistics
+
+    @staticmethod
+    def _int_param(query_map, name: str) -> Optional[int]:
+        """An optional integer query parameter (None when absent or
+        empty)."""
+        value = query_map.get(name, "")
+        if not value:
+            return None
+        try:
+            return int(value)
+        except ValueError:
+            raise ValueError(
+                f"query parameter {name}= must be an integer, "
+                f"got {value!r}"
+            )
 
     # -- resilience plumbing -------------------------------------------
 

@@ -19,6 +19,13 @@ if "xla_force_host_platform_device_count" not in xla_flags:
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Hermeticity: the content-addressed feature cache (io/feature_cache)
+# defaults to a per-user scratch directory, which would couple test
+# runs to each other (a warm entry from a previous session would skip
+# the ingest/degradation paths chaos and ladder tests pin). Tests that
+# exercise the cache opt back in with monkeypatch (delenv + a tmp dir).
+os.environ.setdefault("EEG_TPU_NO_FEATURE_CACHE", "1")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

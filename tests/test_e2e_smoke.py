@@ -1,0 +1,34 @@
+"""The end-to-end pipeline smoke gate (tools/e2e_smoke.py), wired as
+a slow-marked test so tier-1 stays fast while CI can run the full
+cold -> warm -> fan-out ladder. The gates: warm-cache faster than
+cold, cache hit/miss attribution correct, cached-vs-uncached and
+fan-out-vs-single statistics bit-identical, fan-out amortized."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_SMOKE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools",
+    "e2e_smoke.py",
+)
+
+
+@pytest.mark.slow
+def test_e2e_smoke_trio():
+    proc = subprocess.run(
+        [sys.executable, _SMOKE],  # tool defaults: 2000 markers x 4 files
+        capture_output=True,
+        text=True,
+        timeout=900,
+    )
+    assert proc.returncode == 0, (
+        f"smoke gate failed:\n{proc.stdout}\n{proc.stderr[-2000:]}"
+    )
+    summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert summary["ok"], summary["failures"]
+    assert summary["warm_speedup"] > 1.0

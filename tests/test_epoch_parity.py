@@ -26,6 +26,20 @@ def test_info_txt_fixture(fixture_dir):
     assert int(batch.targets.sum()) == 5
 
 
+def test_info_txt_fixture_parallel_pool(fixture_dir):
+    """The parallel parse pool must not move a single bit: the pinned
+    golden epoch sum survives any worker count (order-preserving
+    merge, io/provider._iter_recordings)."""
+    for workers in (2, 4):
+        odp = provider.OfflineDataProvider(
+            [fixture_dir + "/infoTrain.txt"], workers=workers
+        )
+        batch = odp.load()
+        assert batch.epochs.shape == (11, 3, 750)
+        assert java_epoch_sum(batch.epochs) == -253772.18676757812
+        assert int(batch.targets.sum()) == 5
+
+
 def test_single_eeg_with_guess(fixture_dir):
     odp = provider.OfflineDataProvider(
         [fixture_dir + "/DoD/DoD_2015_02.eeg", "4"]

@@ -1,0 +1,230 @@
+"""Content-addressed feature cache (io/feature_cache.py) + the
+classifiers= shared-feature fan-out (pipeline/builder.py).
+
+The contract under test (ISSUE 3): cached and uncached runs of the
+same query produce bit-identical ClassificationStatistics; editing a
+recording's bytes invalidates its run's entry; a corrupt/truncated
+entry is a miss, never a crash; and a fan-out run's per-classifier
+statistics match the corresponding single-classifier runs exactly.
+Everything is hermetic (tests/_synthetic.py)."""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+import _synthetic
+from eeg_dataanalysispackage_tpu.io import feature_cache
+from eeg_dataanalysispackage_tpu.models import stats
+from eeg_dataanalysispackage_tpu.pipeline import builder
+
+
+def _session(directory, n_files=2, n_markers=30):
+    """Multi-file synthetic session; returns the info.txt path."""
+    lines = []
+    for i in range(n_files):
+        name = f"synth_{i:02d}"
+        guessed = 2 + i
+        _synthetic.write_recording(
+            str(directory), name=name, n_markers=n_markers,
+            guessed=guessed, seed=i,
+        )
+        lines.append(f"{name}.eeg {guessed}")
+    info = os.path.join(str(directory), "info.txt")
+    with open(info, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return info
+
+
+def _query(info, classifier="train_clf=logreg", **extra):
+    parts = [
+        f"info_file={info}", "fe=dwt-8-fused", classifier,
+        "config_num_iterations=10", "config_step_size=1.0",
+        "config_mini_batch_fraction=1.0",
+    ]
+    parts += [f"{k}={v}" for k, v in extra.items()]
+    return "&".join(parts)
+
+
+def _stats_equal(a, b):
+    assert str(a) == str(b)
+    assert (a.true_positives, a.true_negatives, a.false_positives,
+            a.false_negatives, a.mse, a.class1_sum, a.class2_sum) == (
+        b.true_positives, b.true_negatives, b.false_positives,
+        b.false_negatives, b.mse, b.class1_sum, b.class2_sum,
+    )
+
+
+@pytest.fixture
+def cache_env(tmp_path, monkeypatch):
+    """Opt back into the cache (conftest disables it hermetically)
+    with a per-test directory; counters zeroed."""
+    monkeypatch.delenv(feature_cache.ENV_DISABLE, raising=False)
+    cache_dir = tmp_path / "fcache"
+    monkeypatch.setenv(feature_cache.ENV_DIR, str(cache_dir))
+    feature_cache.reset_stats()
+    yield cache_dir
+    feature_cache.reset_stats()
+
+
+# ------------------------------------------------------- cache core
+
+
+def test_cached_vs_uncached_statistics_bit_identical(tmp_path, cache_env):
+    info = _session(tmp_path)
+    s_cold = builder.PipelineBuilder(_query(info)).execute()
+    after_cold = feature_cache.stats()
+    assert after_cold["hits"] == 0
+    assert after_cold["misses"] == 1
+    assert glob.glob(str(cache_env / "*.npz"))  # the entry was stored
+
+    s_warm = builder.PipelineBuilder(_query(info)).execute()
+    after_warm = feature_cache.stats()
+    assert after_warm["hits"] == 1
+    assert after_warm["misses"] == 1
+    _stats_equal(s_cold, s_warm)
+
+
+def test_eeg_content_change_invalidates(tmp_path, cache_env):
+    info = _session(tmp_path)
+    builder.PipelineBuilder(_query(info)).execute()
+    assert feature_cache.stats()["misses"] == 1
+
+    # flip one sample byte: a new content digest, so a new key
+    eeg = str(tmp_path / "synth_00.eeg")
+    with open(eeg, "r+b") as f:
+        f.seek(100)
+        b = f.read(1)
+        f.seek(100)
+        f.write(bytes([b[0] ^ 0xFF]))
+    s_changed = builder.PipelineBuilder(_query(info)).execute()
+    st = feature_cache.stats()
+    assert st["misses"] == 2 and st["hits"] == 0
+    assert s_changed.num_patterns > 0
+    # the changed content now has its own warm entry
+    builder.PipelineBuilder(_query(info)).execute()
+    assert feature_cache.stats()["hits"] == 1
+
+
+def test_corrupt_entry_is_a_miss_not_a_crash(tmp_path, cache_env):
+    info = _session(tmp_path)
+    s_cold = builder.PipelineBuilder(_query(info)).execute()
+    (entry,) = glob.glob(str(cache_env / "*.npz"))
+    with open(entry, "wb") as f:
+        f.write(b"not an npz at all")
+    s_rebuilt = builder.PipelineBuilder(_query(info)).execute()
+    st = feature_cache.stats()
+    assert st["corrupt"] == 1
+    assert st["hits"] == 0 and st["misses"] == 2
+    _stats_equal(s_cold, s_rebuilt)
+    # the rebuild re-stored a good entry
+    builder.PipelineBuilder(_query(info)).execute()
+    assert feature_cache.stats()["hits"] == 1
+
+
+def test_truncated_entry_is_a_miss(tmp_path, cache_env):
+    info = _session(tmp_path)
+    builder.PipelineBuilder(_query(info)).execute()
+    (entry,) = glob.glob(str(cache_env / "*.npz"))
+    data = open(entry, "rb").read()
+    with open(entry, "wb") as f:
+        f.write(data[: len(data) // 2])  # a crash-mid-copy survivor
+    s = builder.PipelineBuilder(_query(info)).execute()
+    assert feature_cache.stats()["corrupt"] == 1
+    assert s.num_patterns > 0
+
+
+def test_cache_false_opts_a_run_out(tmp_path, cache_env):
+    info = _session(tmp_path)
+    builder.PipelineBuilder(_query(info, cache="false")).execute()
+    st = feature_cache.stats()
+    assert st == {"hits": 0, "misses": 0, "corrupt": 0}
+    assert not glob.glob(str(cache_env / "*.npz"))
+
+
+def test_guessed_number_is_part_of_the_key(tmp_path, cache_env):
+    """Same bytes, different guess -> different targets -> new key."""
+    info = _session(tmp_path, n_files=1)
+    builder.PipelineBuilder(_query(info)).execute()
+    with open(info, "w") as f:
+        f.write("synth_00.eeg 5\n")
+    builder.PipelineBuilder(_query(info)).execute()
+    st = feature_cache.stats()
+    assert st["misses"] == 2 and st["hits"] == 0
+
+
+def test_disabled_globally_without_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv(feature_cache.ENV_DISABLE, "1")
+    assert feature_cache.open_cache() is None
+
+
+# ------------------------------------------------- classifier fan-out
+
+
+def test_fanout_matches_single_classifier_runs(tmp_path):
+    info = _session(tmp_path)
+    fan = builder.PipelineBuilder(
+        _query(info, classifier="classifiers=logreg,svm,dt")
+    ).execute()
+    assert isinstance(fan, stats.FanOutStatistics)
+    assert list(fan) == ["logreg", "svm", "dt"]
+    for name in ("logreg", "svm", "dt"):
+        single = builder.PipelineBuilder(
+            _query(info, classifier=f"train_clf={name}")
+        ).execute()
+        _stats_equal(fan[name], single)
+
+
+def test_fanout_result_path_report(tmp_path):
+    info = _session(tmp_path)
+    result = str(tmp_path / "report.txt")
+    fan = builder.PipelineBuilder(
+        _query(info, classifier="classifiers=logreg,svm",
+               result_path=result)
+    ).execute()
+    text = open(result).read()
+    assert text.startswith("classifier: logreg\n")
+    assert "classifier: svm\n" in text
+    assert str(fan["logreg"]) in text
+
+
+def test_fanout_host_fe_path(tmp_path):
+    """classifiers= composes with the reference-shaped host fe= path
+    (one extraction pass shared), and matches the single run."""
+    info = _session(tmp_path)
+
+    def q(classifier):
+        return (
+            f"info_file={info}&fe=dwt-8&{classifier}"
+            "&config_num_iterations=10&config_step_size=1.0"
+            "&config_mini_batch_fraction=1.0"
+        )
+
+    fan = builder.PipelineBuilder(q("classifiers=logreg")).execute()
+    single = builder.PipelineBuilder(q("train_clf=logreg")).execute()
+    _stats_equal(fan["logreg"], single)
+
+
+@pytest.mark.parametrize(
+    "classifier,match",
+    [
+        ("classifiers=logreg&train_clf=svm", "exactly one"),
+        ("classifiers=logreg&load_clf=svm", "exactly one"),
+        ("classifiers=logreg&save_clf=true", "save_clf"),
+        ("classifiers=logreg&elastic=true", "elastic"),
+        ("classifiers=,", "comma-separated"),
+    ],
+)
+def test_fanout_rejects_conflicts(tmp_path, classifier, match):
+    info = _session(tmp_path, n_files=1)
+    with pytest.raises(ValueError, match=match):
+        builder.PipelineBuilder(_query(info, classifier=classifier)).execute()
+
+
+def test_fanout_unknown_classifier_uses_reference_error(tmp_path):
+    info = _session(tmp_path, n_files=1)
+    with pytest.raises(ValueError, match="Unsupported classifier"):
+        builder.PipelineBuilder(
+            _query(info, classifier="classifiers=nosuch")
+        ).execute()

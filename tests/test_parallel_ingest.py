@@ -1,0 +1,136 @@
+"""Parallel-ingest determinism (io/provider.py).
+
+The ordered merge contract: any parse-pool size produces byte-for-byte
+the same epoch batch — epoch order, targets, cross-file balance
+counters, fused feature rows — as the sequential loop. Also covers
+the configuration surface (EEG_TPU_INGEST_WORKERS /
+EEG_TPU_PREFETCH_DEPTH / query params) and the chaos clamp."""
+
+import os
+
+import numpy as np
+import pytest
+
+import _synthetic
+from eeg_dataanalysispackage_tpu.io import provider, staging
+from eeg_dataanalysispackage_tpu.obs import chaos
+
+
+def _session(directory, n_files=3, n_markers=24, missing=0):
+    lines = []
+    for i in range(n_files):
+        name = f"synth_{i:02d}"
+        guessed = 2 + (i % 7)
+        _synthetic.write_recording(
+            str(directory), name=name, n_markers=n_markers,
+            guessed=guessed, seed=i,
+        )
+        lines.append(f"{name}.eeg {guessed}")
+    for i in range(missing):
+        # listed but absent triplets: must be skipped, not fatal
+        lines.insert(1, f"ghost_{i}.eeg 4")
+    info = os.path.join(str(directory), "info.txt")
+    with open(info, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return info
+
+
+def _java_epoch_sum(epochs):
+    row_sums = np.cumsum(epochs, axis=-1)[..., -1]
+    return float(np.cumsum(row_sums.reshape(-1))[-1])
+
+
+def test_pool_sizes_produce_identical_batches(tmp_path):
+    info = _session(tmp_path, n_files=4)
+    batches = {}
+    for workers in (1, 4):
+        b = provider.OfflineDataProvider([info], workers=workers).load()
+        batches[workers] = b
+    b1, b4 = batches[1], batches[4]
+    np.testing.assert_array_equal(b1.epochs, b4.epochs)
+    np.testing.assert_array_equal(b1.targets, b4.targets)
+    assert _java_epoch_sum(b1.epochs) == _java_epoch_sum(b4.epochs)
+
+
+def test_pool_sizes_produce_identical_fused_features(tmp_path):
+    info = _session(tmp_path, n_files=3)
+    f1, t1 = provider.OfflineDataProvider(
+        [info], workers=1
+    ).load_features_device(backend="xla")
+    f4, t4 = provider.OfflineDataProvider(
+        [info], workers=4
+    ).load_features_device(backend="xla")
+    np.testing.assert_array_equal(np.asarray(f1), np.asarray(f4))
+    np.testing.assert_array_equal(np.asarray(t1), np.asarray(t4))
+
+
+def test_missing_files_skipped_in_parallel(tmp_path, caplog):
+    import logging
+
+    info = _session(tmp_path, n_files=3, missing=2)
+    with caplog.at_level(
+        logging.WARNING, logger="eeg_dataanalysispackage_tpu.io.provider"
+    ):
+        b4 = provider.OfflineDataProvider([info], workers=4).load()
+    assert caplog.text.count("Did not load") == 2
+    b1 = provider.OfflineDataProvider([info], workers=1).load()
+    np.testing.assert_array_equal(b1.epochs, b4.epochs)
+    np.testing.assert_array_equal(b1.targets, b4.targets)
+
+
+def test_parse_error_surfaces_in_order(tmp_path):
+    """A non-missing-file parse failure must still surface (at the
+    file's in-order position), not hang or vanish in the pool."""
+    info = _session(tmp_path, n_files=3)
+    # break the middle file's header so parsing raises
+    with open(str(tmp_path / "synth_01.vhdr"), "w") as f:
+        f.write("BinaryFormat=NO_SUCH_FORMAT\n[Binary Infos]\n"
+                "BinaryFormat=NO_SUCH_FORMAT\n")
+    with pytest.raises(ValueError, match="Unsupported BinaryFormat"):
+        provider.OfflineDataProvider([info], workers=4).load()
+
+
+def test_worker_configuration(monkeypatch):
+    monkeypatch.setenv(provider.ENV_INGEST_WORKERS, "7")
+    assert provider.default_ingest_workers() == 7
+    monkeypatch.setenv(provider.ENV_INGEST_WORKERS, "garbage")
+    assert provider.default_ingest_workers() == 4
+    monkeypatch.delenv(provider.ENV_INGEST_WORKERS)
+    assert provider.default_ingest_workers() >= 1
+    odp = provider.OfflineDataProvider(["x.txt"], workers=3)
+    assert odp._workers == 3
+
+
+def test_prefetch_depth_configuration(monkeypatch):
+    monkeypatch.setenv(provider.ENV_PREFETCH_DEPTH, "5")
+    assert provider.default_prefetch_depth() == 5
+    assert staging.default_buffer_size() == 5
+    monkeypatch.setenv(provider.ENV_PREFETCH_DEPTH, "bad")
+    assert provider.default_prefetch_depth() == 2
+    assert staging.default_buffer_size() == 2
+    monkeypatch.delenv(provider.ENV_PREFETCH_DEPTH)
+    assert staging.default_buffer_size() == 2
+
+
+def test_prefetch_uses_env_default(monkeypatch):
+    """staging.prefetch with buffer_size=None resolves the env knob
+    (and still rejects nonsense explicit values)."""
+    monkeypatch.setenv(staging.ENV_PREFETCH_DEPTH, "3")
+    got = list(
+        staging.prefetch(
+            staging.minibatches(np.ones((6, 2), np.float32), batch_size=2)
+        )
+    )
+    assert len(got) == 3
+    with pytest.raises(ValueError, match="buffer_size"):
+        list(staging.prefetch(iter([]), buffer_size=0))
+
+
+def test_chaos_plan_forces_sequential_parse(tmp_path):
+    """Deterministic chaos replay counts injection-point calls in
+    order; an installed plan must clamp the pool to 1 worker."""
+    odp = provider.OfflineDataProvider(["x.txt"], workers=8)
+    assert odp._resolved_workers(8) == 8
+    with chaos.faults("remote.request:p=0.5", seed=1):
+        assert odp._resolved_workers(8) == 1
+    assert odp._resolved_workers(8) == 8

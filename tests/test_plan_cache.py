@@ -102,6 +102,53 @@ def test_per_cache_capacity_override():
     assert c.stats()["entries"] == 1
 
 
+def test_save_and_load_file_roundtrip(tmp_path):
+    """Cross-process persistence (the bench child warm start): the
+    saved plans load into a cold registry and the next lookup is a
+    HIT — the counter behavior that finally lets a recorded
+    block_ingest line show hits > 0."""
+    path = str(tmp_path / "plans.pkl")
+    c = plan_cache.cache("unit_persist")
+    plan = {"rows": np.arange(6, dtype=np.int32)}
+    c.get_or_build("layout-1", lambda: plan)
+    assert plan_cache.save_file(path) == path
+
+    plan_cache.clear()
+    assert plan_cache.load_file(path) == 1
+    got = plan_cache.cache("unit_persist").get_or_build(
+        "layout-1", lambda: {"rows": "rebuilt"}
+    )
+    np.testing.assert_array_equal(got["rows"], plan["rows"])
+    # a warm load counts as neither hit nor miss; the lookup is a hit
+    assert plan_cache.cache("unit_persist").stats() == {
+        "hits": 1, "misses": 0, "entries": 1,
+    }
+
+
+def test_load_file_preserves_capacity_override(tmp_path):
+    """A warm start must not recreate a deliberately small cache (the
+    MB-scale operator tables' capacity=16) at the roomy shared
+    default — the capacity rides along in the persisted payload."""
+    path = str(tmp_path / "plans.pkl")
+    c = plan_cache.cache("unit_cap_persist", capacity=3)
+    c.get_or_build("k", lambda: "v")
+    plan_cache.save_file(path)
+    # simulate a fresh process: the registry has never seen the name
+    with plan_cache._registry_lock:
+        del plan_cache._registry["unit_cap_persist"]
+    assert plan_cache.load_file(path) == 1
+    assert plan_cache.cache("unit_cap_persist").capacity == 3
+
+
+def test_load_file_tolerates_missing_and_corrupt(tmp_path, monkeypatch):
+    monkeypatch.delenv(plan_cache.ENV_FILE, raising=False)
+    assert plan_cache.load_file(str(tmp_path / "nope.pkl")) == 0
+    bad = tmp_path / "bad.pkl"
+    bad.write_bytes(b"\x80garbage")
+    assert plan_cache.load_file(str(bad)) == 0
+    assert plan_cache.save_file(None) is None  # persistence off: no-op
+
+
 # --------------------------------------- the block-class gather plan
 
 

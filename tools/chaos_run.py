@@ -57,6 +57,11 @@ def main(argv=None) -> int:
 
     if os.environ.pop(chaos.ENV_SPEC, None):
         print(f"(ignoring exported {chaos.ENV_SPEC} for both runs)")
+    # the feature cache would make the diff vacuous: the baseline
+    # stores the feature matrix, the faulted run hits it and skips the
+    # very ingest paths the spec injects into — both runs must
+    # exercise the real pipeline
+    os.environ["EEG_TPU_NO_FEATURE_CACHE"] = "1"
 
     print(f"== baseline (no faults) ==", flush=True)
     baseline = builder.PipelineBuilder(args.query).execute()

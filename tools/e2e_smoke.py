@@ -1,0 +1,133 @@
+"""End-to-end pipeline smoke gate: cold -> warm -> fan-out.
+
+Runs the pipeline_e2e trio (tools/pipeline_bench.py children, one
+fresh process each — the same process discipline bench.py uses) over
+one shared hermetic synthetic session and FAILS unless the
+performance contract holds:
+
+- the warm-cache run is faster than the cold run (the feature cache
+  must actually buy something);
+- the warm run hits the cache (hits > 0, and the cold run stored the
+  entries it missed);
+- cold and warm produce byte-identical ClassificationStatistics
+  (``report_sha256`` equality — a cache that changes results is a
+  correctness bug, not a speedup);
+- the 5-classifier fan-out's logreg statistics match the
+  single-classifier run's exactly (shared features must not perturb
+  any individual classifier);
+- fan-out wall time stays under 3x the single-classifier cold run
+  (ingest+featurization amortized across the five classifiers).
+
+Usage: python tools/e2e_smoke.py [n_markers_per_file] [n_files]
+
+Prints a JSON summary line; exit 0 iff every gate passed. Wired into
+the suite as a ``slow``-marked pytest (tests/test_e2e_smoke.py), so
+tier-1 stays fast while CI can still run the whole ladder.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PIPELINE_BENCH = os.path.join(_REPO, "tools", "pipeline_bench.py")
+
+
+def _run_variant(variant: str, n_markers: int, n_files: int,
+                 data_dir: str, cache_dir: str) -> dict:
+    proc = subprocess.run(
+        [
+            sys.executable, _PIPELINE_BENCH, variant,
+            str(n_markers), str(n_files),
+            f"--data-dir={data_dir}", f"--cache-dir={cache_dir}",
+        ],
+        capture_output=True,
+        text=True,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"{variant} child failed rc={proc.returncode}\n"
+            f"{proc.stderr[-2000:]}"
+        )
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def run(n_markers: int = 2000, n_files: int = 4) -> dict:
+    failures = []
+    with tempfile.TemporaryDirectory(prefix="eeg_tpu_smoke_") as tmp:
+        data_dir = os.path.join(tmp, "data")
+        cold = _run_variant(
+            "pipeline_e2e_cold", n_markers, n_files,
+            data_dir, os.path.join(tmp, "cache_cold"),
+        )
+        warm = _run_variant(
+            "pipeline_e2e_warm", n_markers, n_files,
+            data_dir, os.path.join(tmp, "cache_warm"),
+        )
+        fanout = _run_variant(
+            "pipeline_e2e_fanout5", n_markers, n_files,
+            data_dir, os.path.join(tmp, "cache_fanout"),
+        )
+
+    if not warm["wall_s"] < cold["wall_s"]:
+        failures.append(
+            f"warm run not faster than cold: {warm['wall_s']}s vs "
+            f"{cold['wall_s']}s"
+        )
+    if not warm["feature_cache"]["hits"] > 0:
+        failures.append(
+            f"warm run never hit the cache: {warm['feature_cache']}"
+        )
+    if not (
+        cold["feature_cache"]["misses"] > 0
+        and cold["feature_cache"]["hits"] == 0
+    ):
+        failures.append(
+            f"cold run was not cold: {cold['feature_cache']}"
+        )
+    if cold["report_sha256"] != warm["report_sha256"]:
+        failures.append(
+            "cached vs uncached statistics drifted: "
+            f"{cold['report_sha256']} vs {warm['report_sha256']}"
+        )
+    if fanout["accuracy"].get("logreg") != cold["accuracy"]:
+        failures.append(
+            "fan-out logreg accuracy drifted from the single-"
+            f"classifier run: {fanout['accuracy'].get('logreg')} vs "
+            f"{cold['accuracy']}"
+        )
+    if len(fanout.get("accuracy", {})) != 5:
+        failures.append(
+            f"fan-out did not report 5 classifiers: {fanout.get('accuracy')}"
+        )
+    if not fanout["wall_s"] < 3 * cold["wall_s"]:
+        failures.append(
+            f"fan-out not amortized: {fanout['wall_s']}s vs 3x cold "
+            f"{cold['wall_s']}s"
+        )
+
+    return {
+        "ok": not failures,
+        "failures": failures,
+        "cold_wall_s": cold["wall_s"],
+        "warm_wall_s": warm["wall_s"],
+        "fanout5_wall_s": fanout["wall_s"],
+        "warm_speedup": round(cold["wall_s"] / warm["wall_s"], 2),
+        "fanout_vs_cold": round(fanout["wall_s"] / cold["wall_s"], 2),
+        "warm_feature_cache": warm["feature_cache"],
+        "cold_feature_cache": cold["feature_cache"],
+    }
+
+
+def main(argv) -> int:
+    n_markers = int(argv[0]) if argv else 2000
+    n_files = int(argv[1]) if len(argv) > 1 else 4
+    summary = run(n_markers, n_files)
+    print(json.dumps(summary))
+    return 0 if summary["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

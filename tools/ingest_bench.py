@@ -910,6 +910,7 @@ def run(variant: str, n: int, iters: int) -> dict:
     # compile cache directory in effect (None = caching off), so a
     # BENCH trajectory can tell a warm-plan/warm-compile speedup from
     # a kernel change
+    from eeg_dataanalysispackage_tpu.io import feature_cache as _feature_cache
     from eeg_dataanalysispackage_tpu.ops import plan_cache as _plan_cache
 
     pstats = _plan_cache.stats()
@@ -917,6 +918,9 @@ def run(variant: str, n: int, iters: int) -> dict:
         "hits": pstats["hits"], "misses": pstats["misses"],
     }
     payload["compile_cache"] = _compile_cache.active_cache_dir()
+    # schema parity with the pipeline_e2e family (zeros here: kernel
+    # variants never touch the feature cache)
+    payload["feature_cache"] = _feature_cache.stats()
     # a failed _check_parity raised above, so published numbers are valid
     if variant == "pallas_ingest":
         payload["tile_fill"] = round(fill, 3)
@@ -937,4 +941,15 @@ if __name__ == "__main__":
     variant = sys.argv[1]
     n = int(sys.argv[2]) if len(sys.argv) > 2 else 131072
     iters = int(sys.argv[3]) if len(sys.argv) > 3 else 20
-    print(json.dumps(run(variant, n, iters)))
+    # cross-process plan-cache persistence: each bench variant runs in
+    # its own fresh child, so without a warm start every recorded line
+    # showed plan_cache hits: 0 forever. When EEG_TPU_PLAN_CACHE_FILE
+    # is set (bench.py primes it), load the previous child's plans
+    # before timing and save the union after, so repeat runs — and
+    # later variants planning the same layout — report real hits.
+    from eeg_dataanalysispackage_tpu.ops import plan_cache as _pc
+
+    _pc.load_file()
+    _payload = run(variant, n, iters)
+    _pc.save_file()
+    print(json.dumps(_payload))

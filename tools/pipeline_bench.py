@@ -1,0 +1,239 @@
+"""Whole-pipeline end-to-end benchmark child (the pipeline_e2e family).
+
+Usage: python tools/pipeline_bench.py <variant> <n_markers> <n_files>
+           [--data-dir D] [--cache-dir D]
+
+Variants:
+  pipeline_e2e_cold     one full query run — parse + fused featurize +
+                        train + test — against a FRESH feature cache
+                        (every entry a miss, stored for later runs)
+  pipeline_e2e_warm     the same query against a cache populated by a
+                        separate child process, so the timed run's
+                        process state (jit caches, imports) matches the
+                        cold child's exactly and the measured delta is
+                        the feature cache alone: ingest, staging, and
+                        the device featurizer never run on a hit
+  pipeline_e2e_fanout5  classifiers=logreg,svm,dt,rf,nn against a
+                        fresh cache: one ingest+featurization pass
+                        amortized over five classifiers (vs five full
+                        reference-shaped runs)
+  populate              internal: run the cold query to fill
+                        --cache-dir, print nothing (the warm variant's
+                        helper child)
+
+Everything is hermetic: the input session is fabricated by
+tests/_synthetic.py (INT_16 BrainVision triplets + info.txt) in a temp
+dir, so the family runs anywhere — including ``cpu_fallback``, where
+the numbers are still meaningful because the wins are host-side
+(parallel parse, skipped featurization, amortized ingest).
+
+The persistent XLA compile cache is disabled in this process (and its
+populate child): the e2e family measures honest cold compiles, not
+whatever a previous bench run left serialized. Prints one JSON line in
+the driver-facing ingest_bench schema (epochs_per_s / bytes_per_epoch
+/ plan_cache / compile_cache) plus ``wall_s``, ``feature_cache``
+hit/miss attribution, and a ``report_sha256`` over the
+ClassificationStatistics text so parity across cold/warm runs is
+checkable from the artifact alone.
+"""
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tests"))
+
+# honest cold compiles (see module docstring); must precede jax import
+os.environ["EEG_TPU_NO_COMPILE_CACHE"] = "1"
+
+#: the bytes each epoch's window reads from the int16 stream at the
+#: synthetic generator's default 1000-sample marker stride — the same
+#: stream-byte model the fused ingest variants bill.
+_MARKER_STRIDE = 1000
+_BYTES_PER_EPOCH = 3 * _MARKER_STRIDE * 2
+
+#: config union: every classifier picks the keys it knows, so one
+#: query string configures the whole fan-out (small/fast settings —
+#: the family measures pipeline amortization, not model quality)
+_CONFIG = (
+    "&config_num_iterations=20&config_step_size=1.0"
+    "&config_mini_batch_fraction=1.0&config_reg_param=0.01"
+    "&config_max_bins=16&config_impurity=gini&config_max_depth=4"
+    "&config_min_instances_per_node=1&config_num_trees=5"
+    "&config_feature_subset=auto"
+    "&config_seed=1&config_learning_rate=0.1&config_momentum=0.9"
+    "&config_weight_init=xavier&config_updater=nesterovs"
+    "&config_optimization_algo=stochastic_gradient_descent"
+    "&config_pretrain=false&config_backprop=true"
+    "&config_loss_function=xent"
+    "&config_layer1_layer_type=dense&config_layer1_n_out=8"
+    "&config_layer1_drop_out=0.0&config_layer1_activation_function=relu"
+    "&config_layer2_layer_type=output&config_layer2_n_out=2"
+    "&config_layer2_drop_out=0.0"
+    "&config_layer2_activation_function=softmax"
+)
+
+_FANOUT_CLASSIFIERS = "logreg,svm,dt,rf,nn"
+
+#: scratch dir this invocation created itself (cleaned on exit)
+_OWNED_TMP = None
+
+
+def write_session(directory: str, n_markers: int, n_files: int) -> str:
+    """Fabricate an ``n_files``-recording session; returns info.txt."""
+    import _synthetic
+
+    lines = []
+    for i in range(n_files):
+        name = f"synth_{i:02d}"
+        guessed = 2 + (i % 7)
+        _synthetic.write_recording(
+            directory,
+            name=name,
+            n_markers=n_markers,
+            guessed=guessed,
+            seed=i,
+            marker_stride=_MARKER_STRIDE,
+        )
+        lines.append(f"{name}.eeg {guessed}")
+    info = os.path.join(directory, "info.txt")
+    with open(info, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return info
+
+
+def build_query(info: str, fanout: bool) -> str:
+    classifier = (
+        f"classifiers={_FANOUT_CLASSIFIERS}"
+        if fanout
+        else "train_clf=logreg"
+    )
+    return f"info_file={info}&fe=dwt-8-fused&{classifier}{_CONFIG}"
+
+
+def run_query(query: str):
+    """(statistics, wall_s, n_epochs) for one pipeline execution."""
+    from eeg_dataanalysispackage_tpu import obs
+    from eeg_dataanalysispackage_tpu.pipeline import builder
+
+    before = obs.metrics.snapshot()["counters"]
+    start = time.perf_counter()
+    statistics = builder.PipelineBuilder(query).execute()
+    wall = time.perf_counter() - start
+    after = obs.metrics.snapshot()["counters"]
+    n_epochs = int(
+        after.get("pipeline.epochs_loaded", 0.0)
+        - before.get("pipeline.epochs_loaded", 0.0)
+    )
+    return statistics, wall, n_epochs
+
+
+def main(argv) -> dict:
+    variant = argv[0]
+    n_markers = int(argv[1]) if len(argv) > 1 else 240
+    n_files = int(argv[2]) if len(argv) > 2 else 3
+    data_dir = cache_dir = None
+    for arg in argv[3:]:
+        if arg.startswith("--data-dir="):
+            data_dir = arg.split("=", 1)[1]
+        elif arg.startswith("--cache-dir="):
+            cache_dir = arg.split("=", 1)[1]
+        else:
+            raise SystemExit(f"unknown argument {arg!r}")
+    if variant not in (
+        "pipeline_e2e_cold", "pipeline_e2e_warm", "pipeline_e2e_fanout5",
+        "populate",
+    ):
+        raise SystemExit(f"unknown variant {variant!r}")
+
+    global _OWNED_TMP
+    if data_dir is None or cache_dir is None:
+        _OWNED_TMP = tempfile.mkdtemp(prefix="eeg_tpu_e2e_")
+        data_dir = data_dir or os.path.join(_OWNED_TMP, "data")
+        cache_dir = cache_dir or os.path.join(_OWNED_TMP, "cache")
+    os.makedirs(data_dir, exist_ok=True)
+    os.makedirs(cache_dir, exist_ok=True)
+    info = os.path.join(data_dir, "info.txt")
+    if not os.path.exists(info):
+        info = write_session(data_dir, n_markers, n_files)
+
+    # the feature cache must be live in this child regardless of the
+    # hermetic-test default, and must point at the per-run directory
+    os.environ.pop("EEG_TPU_NO_FEATURE_CACHE", None)
+    os.environ["EEG_TPU_FEATURE_CACHE_DIR"] = cache_dir
+
+    if variant == "populate":
+        run_query(build_query(info, fanout=False))
+        return {}
+
+    if variant == "pipeline_e2e_warm":
+        # populate from a separate process so the timed run's jit/
+        # import state matches the cold child's — the measured delta
+        # is the feature cache, nothing else
+        subprocess.run(
+            [
+                sys.executable, os.path.abspath(__file__), "populate",
+                str(n_markers), str(n_files),
+                f"--data-dir={data_dir}", f"--cache-dir={cache_dir}",
+            ],
+            check=True,
+            stdout=subprocess.DEVNULL,
+        )
+
+    query = build_query(info, fanout=variant == "pipeline_e2e_fanout5")
+    statistics, wall, n_epochs = run_query(query)
+
+    import jax
+
+    from eeg_dataanalysispackage_tpu.io import feature_cache
+    from eeg_dataanalysispackage_tpu.ops import plan_cache
+    from eeg_dataanalysispackage_tpu.utils import compile_cache
+
+    pstats = plan_cache.stats()
+    payload = {
+        "variant": variant,
+        "epochs_per_s": round(n_epochs / wall, 1) if wall > 0 else 0.0,
+        "n": n_epochs,
+        "iters": 1,
+        "wall_s": round(wall, 3),
+        "elapsed_s": round(wall, 3),
+        "bytes_per_epoch": _BYTES_PER_EPOCH,
+        "n_markers_per_file": n_markers,
+        "n_files": n_files,
+        "platform": jax.devices()[0].platform,
+        "feature_cache": feature_cache.stats(),
+        "plan_cache": {
+            "hits": pstats["hits"], "misses": pstats["misses"],
+        },
+        "compile_cache": compile_cache.active_cache_dir(),
+        "report_sha256": hashlib.sha256(
+            str(statistics).encode()
+        ).hexdigest(),
+    }
+    if variant == "pipeline_e2e_fanout5":
+        payload["classifiers"] = _FANOUT_CLASSIFIERS.split(",")
+        payload["accuracy"] = {
+            name: round(s.calc_accuracy(), 6)
+            for name, s in statistics.items()
+        }
+    else:
+        payload["accuracy"] = round(statistics.calc_accuracy(), 6)
+    return payload
+
+
+if __name__ == "__main__":
+    payload = main(sys.argv[1:])
+    if payload:
+        print(json.dumps(payload))
+    # drop this invocation's own scratch (synthetic session + cache);
+    # caller-provided --data-dir/--cache-dir are the caller's to keep
+    if _OWNED_TMP:
+        import shutil
+
+        shutil.rmtree(_OWNED_TMP, ignore_errors=True)
