@@ -518,10 +518,12 @@ def _collect(platform: str) -> dict:
             # effect for the child, so a BENCH-trajectory speedup is
             # attributable to warm plans/features/compiles vs kernel
             # changes; wall_s/accuracy/classifiers carry the
-            # pipeline_e2e family's whole-run context
+            # pipeline_e2e family's whole-run context, and stages
+            # (ISSUE 4) the per-stage wall breakdown behind wall_s
             for extra_field in (
                 "plan_cache", "compile_cache", "feature_cache",
                 "wall_s", "classifiers", "accuracy", "report_sha256",
+                "stages",
             ):
                 if extra_field in r:
                     variants[name][extra_field] = r[extra_field]

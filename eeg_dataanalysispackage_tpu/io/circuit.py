@@ -66,6 +66,14 @@ def _metrics():
     return obs.metrics
 
 
+def _event(name: str, **attrs) -> None:
+    """Telemetry span event for a breaker transition (no-op when no
+    run telemetry is active — obs/events.py)."""
+    from ..obs import events
+
+    events.event(name, **attrs)
+
+
 class CircuitBreaker:
     """Per-endpoint breaker; thread-safe. ``clock`` is injectable so
     tests drive the cooldown without sleeping."""
@@ -117,9 +125,22 @@ class CircuitBreaker:
                 self._probe_in_flight = True
                 _metrics().count("circuit.probe")
                 logger.warning(
-                    "circuit %s half-open: probing endpoint", self.endpoint
+                    "circuit.transition endpoint=%s open->half_open "
+                    "probe=allowed consecutive_failures=%d evidence=%s",
+                    self.endpoint,
+                    self._consecutive_failures,
+                    list(self._evidence),
+                )
+                _event(
+                    "circuit.half_open",
+                    endpoint=self.endpoint,
+                    consecutive_failures=self._consecutive_failures,
                 )
                 return
+            # counter only — no ring event per fast-fail: an open-
+            # circuit storm would flood the 512-slot flight recorder
+            # and evict the one circuit.opened event (with evidence)
+            # a crash report actually needs
             _metrics().count("circuit.fast_fail")
             raise CircuitOpenError(
                 f"circuit open for {self.endpoint}: "
@@ -143,8 +164,17 @@ class CircuitBreaker:
             if was != CLOSED:
                 _metrics().count("circuit.closed")
                 logger.warning(
-                    "circuit %s closed after successful probe",
+                    "circuit.transition endpoint=%s %s->closed "
+                    "(successful probe) prior_failures=%d evidence=%s",
                     self.endpoint,
+                    was,
+                    self._total_failures,
+                    list(self._evidence),
+                )
+                _event(
+                    "circuit.closed",
+                    endpoint=self.endpoint,
+                    prior_failures=self._total_failures,
                 )
 
     def record_failure(self, error: Exception) -> None:
@@ -164,12 +194,20 @@ class CircuitBreaker:
                 if self._state != OPEN:
                     _metrics().count("circuit.opened")
                     logger.error(
-                        "circuit %s OPEN after %d consecutive exhausted "
-                        "retry budgets; failing fast for %.0fs. Evidence: %s",
+                        "circuit.transition endpoint=%s %s->open "
+                        "consecutive_failures=%d cooldown_s=%.0f "
+                        "evidence=%s",
                         self.endpoint,
+                        self._state,
                         self._consecutive_failures,
                         self.cooldown_s,
                         list(self._evidence),
+                    )
+                    _event(
+                        "circuit.opened",
+                        endpoint=self.endpoint,
+                        consecutive_failures=self._consecutive_failures,
+                        evidence=list(self._evidence),
                     )
                 self._state = OPEN
                 self._opened_at = self._clock()

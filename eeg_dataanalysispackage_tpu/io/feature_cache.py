@@ -112,6 +112,7 @@ def reset_stats() -> None:
 def _count(kind: str) -> None:
     global _hits, _misses, _corrupt
     from .. import obs
+    from ..obs import events
 
     with _lock:
         if kind == "hit":
@@ -121,6 +122,9 @@ def _count(kind: str) -> None:
         else:
             _corrupt += 1
     obs.metrics.count(f"feature_cache.{kind}")
+    # telemetry: hit/miss/corrupt as span events, so a run report's
+    # trace shows WHERE in the run the cache decided (no-op when off)
+    events.event(f"feature_cache.{kind}")
 
 
 def run_key(content_digests, channel_names, pre: int, post: int,

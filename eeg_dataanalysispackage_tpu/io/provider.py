@@ -203,14 +203,19 @@ class OfflineDataProvider:
         the generator overlaps the *next* files' host parse with its
         own epoching/featurizing/device work.
         """
+        from ..obs import events
+
         items = list(files.items())
         workers = self._resolved_workers(len(items))
         if workers <= 1:
             for rel_path, guessed in items:
                 try:
-                    rec = brainvision.load_recording(
-                        prefix + rel_path, filesystem=self._fs
-                    )
+                    # telemetry: one span per recording parse (no-op
+                    # without an active recorder)
+                    with events.span("ingest.parse", file=rel_path):
+                        rec = brainvision.load_recording(
+                            prefix + rel_path, filesystem=self._fs
+                        )
                 except FileNotFoundError as e:
                     logger.warning("Did not load %s: %s", rel_path, e)
                     continue
@@ -220,6 +225,16 @@ class OfflineDataProvider:
         from .. import obs
 
         obs.metrics.gauge("ingest.parallel_workers", workers)
+
+        def _parse_one(path: str, rel: str):
+            # runs on a pool thread: the span's parent falls back to
+            # the recorder's run root (per-thread stacks keep the
+            # consumer's span nesting uncorrupted)
+            with events.span("ingest.parse", file=rel, pooled=True):
+                return brainvision.load_recording(
+                    path, filesystem=self._fs
+                )
+
         depth = workers + self._prefetch_depth
         pool = ThreadPoolExecutor(
             max_workers=workers, thread_name_prefix="eeg-tpu-ingest"
@@ -235,9 +250,7 @@ class OfflineDataProvider:
                             rel_path,
                             guessed,
                             pool.submit(
-                                brainvision.load_recording,
-                                prefix + rel_path,
-                                filesystem=self._fs,
+                                _parse_one, prefix + rel_path, rel_path
                             ),
                         )
                     )
@@ -329,11 +342,18 @@ class OfflineDataProvider:
         required.
         """
         from ..epochs.extractor import BalanceState
-        from ..obs import chaos
+        from ..obs import chaos, events
         from ..ops import device_ingest
 
         if backend not in ("xla", "block", "pallas"):
             raise ValueError(f"unknown device-ingest backend {backend!r}")
+        # telemetry: record which fused rung this attempt runs — the
+        # builder's ladder may call several times before one lands
+        events.event(
+            "ingest.fused_attempt",
+            backend=backend,
+            wavelet_index=int(wavelet_index),
+        )
         # chaos injection: one fused-backend attempt fails (a Pallas
         # lowering error, an OOM) — the pipeline's degradation ladder
         # catches it and steps down a backend

@@ -34,7 +34,7 @@ import time
 import urllib.parse
 from typing import Optional, Tuple
 
-from ..obs import chaos
+from ..obs import chaos, events
 from . import circuit
 
 logger = logging.getLogger(__name__)
@@ -199,6 +199,16 @@ class HttpFileSystem:
                     attempt + 1,
                     self.retry.max_attempts,
                     e,
+                )
+                # telemetry: each retry attempt is a span event, so a
+                # crash/run report shows the retry ladder per request
+                events.event(
+                    "remote.retry",
+                    method=method,
+                    path=req_path,
+                    attempt=attempt + 1,
+                    max_attempts=self.retry.max_attempts,
+                    error=f"{type(e).__name__}: {e}",
                 )
                 if attempt + 1 < self.retry.max_attempts:
                     time.sleep(self.retry.sleep_for(attempt))

@@ -30,7 +30,7 @@ import numpy as np
 
 from . import ENV_PREFETCH_DEPTH  # noqa: F401  (re-export: the knob's name)
 from . import default_prefetch_depth
-from ..obs import chaos
+from ..obs import chaos, events
 from ..parallel import mesh as pmesh
 
 logger = logging.getLogger(__name__)
@@ -124,37 +124,52 @@ def prefetch(
         return False
 
     def producer() -> None:
-        try:
-            for batch in batches:
-                if stop.is_set():
-                    return
-                # chaos injection: one staged batch fails (a poisoned
-                # device_put / host parse) — must surface at the
-                # consumer, never drop silently
-                chaos.maybe_fire("staging.producer")
-                staged = stage(batch)
-                # re-check after the (possibly long) staging call
-                if not _put_stop_aware(staged):
-                    return
-        except BaseException as e:  # re-raised at the consumer
-            # "delivered" is set by the CONSUMER on receipt — a poison
-            # that entered the queue but was never read (the consumer
-            # closed first) still counts as undelivered and gets
-            # logged on join
-            failure["error"] = e
-            if not _put_stop_aware(_Poison(e)):
-                # delivery aborted (consumer already stopped) — log
-                # HERE too: a producer stranded past the consumer's
-                # join budget fails after the consumer-side check ran,
-                # and its error must not evaporate
-                failure["logged"] = True
-                logger.warning(
-                    "prefetch producer failed after the consumer "
-                    "stopped (%s: %s); error was never delivered",
-                    type(e).__name__, e,
+        staged_n = 0
+        # telemetry: the producer thread's lifetime is one span
+        # (parented on the run root — its own thread); batch count
+        # lands as an attribute, and the error event is emitted
+        # INSIDE the span so the flight recorder attributes the
+        # failure to staging.producer, not the run root
+        with events.span("staging.producer") as _span_rec:
+            try:
+                for batch in batches:
+                    if stop.is_set():
+                        return
+                    # chaos injection: one staged batch fails (a
+                    # poisoned device_put / host parse) — must surface
+                    # at the consumer, never drop silently
+                    chaos.maybe_fire("staging.producer")
+                    staged = stage(batch)
+                    staged_n += 1
+                    if _span_rec is not None:
+                        _span_rec["attrs"]["batches"] = staged_n
+                    # re-check after the (possibly long) staging call
+                    if not _put_stop_aware(staged):
+                        return
+            except BaseException as e:  # re-raised at the consumer
+                # "delivered" is set by the CONSUMER on receipt — a
+                # poison that entered the queue but was never read
+                # (the consumer closed first) still counts as
+                # undelivered and gets logged on join
+                failure["error"] = e
+                events.event(
+                    "staging.producer_error",
+                    error=f"{type(e).__name__}: {e}",
+                    batches_staged=staged_n,
                 )
-            return
-        _put_stop_aware(_END)
+                if not _put_stop_aware(_Poison(e)):
+                    # delivery aborted (consumer already stopped) —
+                    # log HERE too: a producer stranded past the
+                    # consumer's join budget fails after the consumer-
+                    # side check ran, and its error must not evaporate
+                    failure["logged"] = True
+                    logger.warning(
+                        "prefetch producer failed after the consumer "
+                        "stopped (%s: %s); error was never delivered",
+                        type(e).__name__, e,
+                    )
+                return
+            _put_stop_aware(_END)
 
     thread = threading.Thread(
         target=producer, name="eeg-tpu-prefetch", daemon=True

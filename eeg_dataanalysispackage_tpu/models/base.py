@@ -50,10 +50,19 @@ class Classifier(abc.ABC):
         targets: Sequence[float] | np.ndarray,
         fe: features_base.FeatureExtraction,
     ) -> None:
+        from ..obs import events
+
         self.fe = fe
-        features = self._extract(epochs)
+        with events.span(
+            "model.extract", classifier=type(self).__name__
+        ):
+            features = self._extract(epochs)
         labels = np.asarray(targets, dtype=np.float64)
-        self.fit(features, labels)
+        with events.span(
+            "model.fit", classifier=type(self).__name__,
+            rows=int(labels.shape[0]),
+        ):
+            self.fit(features, labels)
 
     def train_elastic(
         self,
@@ -88,11 +97,18 @@ class Classifier(abc.ABC):
         by :meth:`test` and by the pipeline's fused device path, where
         features come straight off the accelerator.
         """
+        from ..obs import events
+
         labels = np.asarray(targets, dtype=np.float64)
-        predictions = self.predict(features)
-        return stats.ClassificationStatistics.from_arrays(
-            predictions, labels, confusion_only=self.confusion_only_stats
-        )
+        with events.span(
+            "model.test", classifier=type(self).__name__,
+            rows=int(labels.shape[0]),
+        ):
+            predictions = self.predict(features)
+            return stats.ClassificationStatistics.from_arrays(
+                predictions, labels,
+                confusion_only=self.confusion_only_stats,
+            )
 
     # -- batched core (the TPU-native surface) -------------------------
 

@@ -545,6 +545,11 @@ class NeuralNetworkClassifier(base.Classifier):
         ]
 
         def chunk_step(state, it0, n):
+            from ..obs import events
+
+            # telemetry: one event per elastic chunk (crash reports
+            # show how far backprop got before a failure)
+            events.event("train.nn_chunk", it0=int(it0), iters=int(n))
             # host-level chaos injection point (one chunk = one
             # "device step" of the elastic driver)
             chaos.maybe_fire("device.step")

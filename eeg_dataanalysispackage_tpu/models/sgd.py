@@ -280,6 +280,11 @@ def train_linear_elastic(
         }
 
     def chunk_step(state, t0, n):
+        from ..obs import events
+
+        # telemetry: one event per elastic chunk — a crash report
+        # shows exactly how far training got before the failure
+        events.event("train.sgd_chunk", t0=int(t0), iters=int(n))
         # host-level chaos injection point: a chunk is one "device
         # step" of the elastic driver
         chaos.maybe_fire("device.step")

@@ -32,11 +32,18 @@ from typing import Dict, Iterator, Optional
 
 
 class StageTimer:
-    """Accumulates wall time per named stage; reentrant-safe per name."""
+    """Accumulates wall time per named stage; reentrant-safe per name.
+
+    Tracks total/count plus min/max (mean derives) per stage — the
+    shape the run report (obs/report.py) embeds, so a report diff can
+    tell "one slow call" from "uniformly slower".
+    """
 
     def __init__(self) -> None:
         self._totals: Dict[str, float] = defaultdict(float)
         self._counts: Dict[str, int] = defaultdict(int)
+        self._mins: Dict[str, float] = {}
+        self._maxs: Dict[str, float] = {}
         self._lock = threading.Lock()
 
     @contextlib.contextmanager
@@ -49,42 +56,86 @@ class StageTimer:
             with self._lock:
                 self._totals[name] += elapsed
                 self._counts[name] += 1
+                if name not in self._mins or elapsed < self._mins[name]:
+                    self._mins[name] = elapsed
+                if name not in self._maxs or elapsed > self._maxs[name]:
+                    self._maxs[name] = elapsed
 
     def total(self, name: str) -> float:
-        return self._totals[name]
+        # .get, not the defaultdict: probing a never-recorded stage
+        # must not seed a zero-count row that as_dict would divide by
+        return self._totals.get(name, 0.0)
 
     def as_dict(self) -> Dict[str, Dict[str, float]]:
         with self._lock:
             return {
-                name: {"seconds": self._totals[name], "count": self._counts[name]}
+                name: {
+                    "seconds": self._totals[name],
+                    "count": self._counts[name],
+                    "min_s": self._mins.get(name, 0.0),
+                    "max_s": self._maxs.get(name, 0.0),
+                    "mean_s": (
+                        self._totals[name] / max(1, self._counts[name])
+                    ),
+                }
                 for name in self._totals
             }
 
     def report(self) -> str:
-        rows = sorted(self.as_dict().items(), key=lambda kv: -kv[1]["seconds"])
+        """Aligned per-stage table, slowest first (name ties broken
+        alphabetically so identical timings render identically)."""
+        rows = sorted(
+            self.as_dict().items(),
+            key=lambda kv: (-kv[1]["seconds"], kv[0]),
+        )
         width = max((len(n) for n, _ in rows), default=5)
+        cwidth = max(
+            (len(str(v["count"])) for _, v in rows), default=1
+        )
         lines = [
-            f"{name:<{width}}  {v['seconds']:9.4f}s  x{v['count']}"
+            f"{name:<{width}}  {v['seconds']:9.4f}s  "
+            f"x{v['count']:<{cwidth}}  "
+            f"mean {v['mean_s']:9.4f}s  min {v['min_s']:9.4f}s  "
+            f"max {v['max_s']:9.4f}s"
             for name, v in rows
         ]
         return "\n".join(lines)
 
 
 class Metrics:
-    """Counters and gauges with JSON export."""
+    """Counters and gauges with JSON export.
+
+    The process-wide :data:`metrics` instance is the default sink
+    every subsystem counts into, which made per-run accounting
+    impossible: counters leaked across fan-out legs and repeated
+    ``execute()`` calls in one process. :meth:`scope` fixes the
+    scoping — it registers a fresh child ``Metrics`` that receives a
+    copy of every count/gauge for the duration of the ``with`` block
+    (the pipeline builder opens one per run and hands it to the run
+    report), while the global keeps accumulating as the default sink.
+    :meth:`reset` zeroes an instance outright (test isolation,
+    operator "start a fresh window").
+    """
 
     def __init__(self) -> None:
         self._counters: Dict[str, float] = defaultdict(float)
         self._gauges: Dict[str, float] = {}
+        self._scopes: list = []
         self._lock = threading.Lock()
 
     def count(self, name: str, value: float = 1.0) -> None:
         with self._lock:
             self._counters[name] += value
+            scopes = list(self._scopes)
+        for scope in scopes:
+            scope.count(name, value)
 
     def gauge(self, name: str, value: float) -> None:
         with self._lock:
             self._gauges[name] = value
+            scopes = list(self._scopes)
+        for scope in scopes:
+            scope.gauge(name, value)
 
     def snapshot(self) -> Dict[str, Dict[str, float]]:
         with self._lock:
@@ -92,6 +143,27 @@ class Metrics:
                 "counters": dict(self._counters),
                 "gauges": dict(self._gauges),
             }
+
+    def reset(self) -> None:
+        """Zero all counters and gauges (active scopes are kept)."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+
+    @contextlib.contextmanager
+    def scope(self) -> Iterator["Metrics"]:
+        """A per-run child registry: every count/gauge recorded on
+        this instance while the block is open is mirrored into the
+        yielded fresh ``Metrics`` — per-run numbers without giving up
+        the process-wide default sink."""
+        child = Metrics()
+        with self._lock:
+            self._scopes.append(child)
+        try:
+            yield child
+        finally:
+            with self._lock:
+                self._scopes.remove(child)
 
     def to_json(self) -> str:
         return json.dumps(self.snapshot(), sort_keys=True)

@@ -236,9 +236,17 @@ def maybe_fire(point: str, exc_type: type = ChaosInjectedError) -> None:
         return
     if plan.should_fire(point):
         from .. import obs
+        from . import events
 
         rule = plan.rules[point]
         obs.metrics.count(f"chaos.fired.{point}")
+        # telemetry: the firing annotates the enclosing span and lands
+        # in the flight-recorder ring, so a crash report carries the
+        # exact injection that killed the run
+        events.event(
+            "chaos.fired", point=point, call=rule.calls,
+            firing=rule.fired,
+        )
         logger.warning(
             "chaos: firing %s (call %d, firing %d)",
             point, rule.calls, rule.fired,

@@ -1,0 +1,342 @@
+"""Structured span/event telemetry: the tracing half of ``obs``.
+
+The reference's observability is log4j timestamps plus the Spark UI
+(SURVEY.md §5 — no first-party tracing), and until now this build
+stopped at process-global counters plus a wall-clock StageTimer whose
+report died in a log line. This module adds the missing layer: a
+thread-safe, zero-dependency **span recorder** — hierarchical spans
+with ids / parent ids / monotonic timestamps / attributes, a
+context-manager API, bounded in-memory retention, an optional JSONL
+sink, and a ring buffer of recent *events* that the flight recorder
+(obs/report.py) dumps when a run dies.
+
+Design mirrors :mod:`obs.chaos`: one process-global active recorder,
+installed for the scope of a run (``recording(...)``), and module
+-level :func:`span` / :func:`event` entry points that are a single
+global-``None`` check when telemetry is off — instrumented code pays
+nothing unless a run opted in (``report=`` / ``EEG_TPU_RUN_REPORT_DIR``).
+Telemetry observes, never steers: enabling it leaves
+ClassificationStatistics bit-identical (pinned in
+tests/test_telemetry.py).
+
+Span model:
+
+- every span has ``id``, ``parent`` (span id or None for the root),
+  ``name``, ``start``/``end`` (seconds since the recorder was
+  created, ``time.perf_counter`` based), ``thread``, ``attrs``;
+- nesting is tracked per thread (a thread-local stack), so the
+  parallel-ingest pool's parse spans land as children of the run root
+  rather than corrupting another thread's stack;
+- *events* are point-in-time marks (``chaos.fired``,
+  ``feature_cache.hit``, ``circuit.opened`` …) attached to the current
+  span and retained in the recorder's bounded ring;
+- when a recorder is active, every span also emits a
+  ``jax.profiler.TraceAnnotation`` so host spans line up with XLA
+  activity in a TensorBoard/Perfetto trace captured via
+  ``trace_path=``.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import itertools
+import json
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+#: finished spans kept in memory per recorder; beyond this, spans are
+#: still counted (and written to the JSONL sink) but not retained
+DEFAULT_MAX_SPANS = 10_000
+#: recent events retained for the flight recorder
+DEFAULT_RING_CAPACITY = 512
+#: events attached per span before the span only counts them
+_MAX_EVENTS_PER_SPAN = 64
+
+
+class SpanRecorder:
+    """Hierarchical span/event recorder for one run. Thread-safe.
+
+    ``jsonl_path`` appends one JSON line per finished span and per
+    event (``{"kind": "span"|"event", ...}``) — the durable form of
+    the trace; the in-memory lists are bounded working state for the
+    run report.
+    """
+
+    def __init__(
+        self,
+        name: str = "run",
+        jsonl_path: Optional[str] = None,
+        max_spans: int = DEFAULT_MAX_SPANS,
+        ring_capacity: int = DEFAULT_RING_CAPACITY,
+    ):
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._t0 = time.perf_counter()
+        self.wall_start = time.time()
+        self._local = threading.local()
+        self._spans: List[Dict[str, Any]] = []
+        self._dropped_spans = 0
+        self._max_spans = int(max_spans)
+        self._ring: "collections.deque" = collections.deque(
+            maxlen=int(ring_capacity)
+        )
+        self._jsonl_path = jsonl_path
+        self._jsonl_file = None
+        self._jsonl_failed = False
+        self._jsonl_closed = False
+        # the root span is open for the recorder's whole life and
+        # closed by finish(); orphan threads parent onto it
+        self.root: Dict[str, Any] = {
+            "id": next(self._ids),
+            "parent": None,
+            "name": name,
+            "start": 0.0,
+            "end": None,
+            "thread": threading.current_thread().name,
+            "attrs": {},
+            "events": [],
+        }
+
+    # -- time ----------------------------------------------------------
+
+    def _now(self) -> float:
+        return time.perf_counter() - self._t0
+
+    # -- thread-local span stack ---------------------------------------
+
+    def _stack(self) -> List[Dict[str, Any]]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = []
+            self._local.stack = stack
+        return stack
+
+    def current_span(self) -> Dict[str, Any]:
+        stack = self._stack()
+        return stack[-1] if stack else self.root
+
+    # -- recording -----------------------------------------------------
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs: Any) -> Iterator[Dict[str, Any]]:
+        """Open a child of the calling thread's current span; the
+        span closes (and is retained/sunk) when the block exits, with
+        ``error`` recorded if the block raised."""
+        stack = self._stack()
+        rec = {
+            "id": next(self._ids),
+            "parent": self.current_span()["id"],
+            "name": name,
+            "start": self._now(),
+            "end": None,
+            "thread": threading.current_thread().name,
+            "attrs": dict(attrs),
+            "events": [],
+        }
+        stack.append(rec)
+        try:
+            with _annotation(name):
+                yield rec
+        except BaseException as e:
+            rec["attrs"]["error"] = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            rec["end"] = self._now()
+            stack.pop()
+            self._finish_span(rec)
+
+    def _finish_span(self, rec: Dict[str, Any]) -> None:
+        with self._lock:
+            if len(self._spans) < self._max_spans:
+                self._spans.append(rec)
+            else:
+                self._dropped_spans += 1
+        self._sink({"kind": "span", **_span_line(rec)})
+
+    def event(self, name: str, **attrs: Any) -> None:
+        """Point-in-time mark on the current span; retained in the
+        flight-recorder ring."""
+        span = self.current_span()
+        rec = {
+            "t": self._now(),
+            "span": span["id"],
+            "span_name": span["name"],
+            "name": name,
+            "attrs": dict(attrs),
+        }
+        with self._lock:
+            self._ring.append(rec)
+            if len(span["events"]) < _MAX_EVENTS_PER_SPAN:
+                span["events"].append(rec)
+        self._sink({"kind": "event", **rec})
+
+    def set_attr(self, name: str, value: Any) -> None:
+        """Attach an attribute to the calling thread's current span."""
+        self.current_span()["attrs"][name] = value
+
+    def finish(self) -> None:
+        """Close the root span and latch the JSONL sink closed — a
+        straggler thread (e.g. a stranded prefetch producer) finishing
+        a span later must not silently reopen the file."""
+        if self.root["end"] is None:
+            self.root["end"] = self._now()
+            self._sink({"kind": "span", **_span_line(self.root)})
+        with self._lock:
+            self._jsonl_closed = True
+            if self._jsonl_file is not None:
+                try:
+                    self._jsonl_file.close()
+                except OSError:
+                    pass
+                self._jsonl_file = None
+
+    # -- introspection -------------------------------------------------
+
+    def spans(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._spans)
+
+    def recent_events(self) -> List[Dict[str, Any]]:
+        """The flight-recorder ring, oldest first."""
+        with self._lock:
+            return list(self._ring)
+
+    def summary(self) -> Dict[str, Any]:
+        """Aggregate view for the run report: per-name count/total/
+        min/max seconds plus retention accounting."""
+        by_name: Dict[str, Dict[str, float]] = {}
+        with self._lock:
+            spans = list(self._spans)
+            dropped = self._dropped_spans
+        for s in spans:
+            dur = (s["end"] if s["end"] is not None else self._now()) - s["start"]
+            agg = by_name.setdefault(
+                s["name"],
+                {"count": 0, "seconds": 0.0, "min_s": dur, "max_s": dur},
+            )
+            agg["count"] += 1
+            agg["seconds"] += dur
+            agg["min_s"] = min(agg["min_s"], dur)
+            agg["max_s"] = max(agg["max_s"], dur)
+        for agg in by_name.values():
+            agg["seconds"] = round(agg["seconds"], 6)
+            agg["min_s"] = round(agg["min_s"], 6)
+            agg["max_s"] = round(agg["max_s"], 6)
+        return {
+            "root": self.root["name"],
+            "wall_start": self.wall_start,
+            "span_count": len(spans) + dropped + 1,
+            "dropped_spans": dropped,
+            "by_name": dict(sorted(by_name.items())),
+        }
+
+    # -- JSONL sink ----------------------------------------------------
+
+    def _sink(self, line: Dict[str, Any]) -> None:
+        if self._jsonl_path is None or self._jsonl_failed:
+            return
+        with self._lock:
+            if self._jsonl_closed:
+                return
+            try:
+                if self._jsonl_file is None:
+                    # "w", not "a": one recorder = one run = one trace
+                    # file — repeated runs into a fixed report dir
+                    # (EEG_TPU_RUN_REPORT_DIR) replace the trace the
+                    # same way run_report.json is replaced
+                    self._jsonl_file = open(self._jsonl_path, "w")
+                self._jsonl_file.write(
+                    json.dumps(line, sort_keys=True, default=str) + "\n"
+                )
+                self._jsonl_file.flush()
+            except OSError:
+                # a broken sink must never kill (or slow) the run it
+                # observes — drop the sink, keep the in-memory trace
+                self._jsonl_failed = True
+                self._jsonl_file = None
+
+
+def _span_line(rec: Dict[str, Any]) -> Dict[str, Any]:
+    out = {k: rec[k] for k in ("id", "parent", "name", "start", "end",
+                               "thread", "attrs")}
+    out["events"] = len(rec["events"])
+    return out
+
+
+@contextlib.contextmanager
+def _annotation(name: str) -> Iterator[None]:
+    """``jax.profiler.TraceAnnotation`` alongside the span, so host
+    spans line up with XLA traces; best-effort."""
+    try:
+        import jax.profiler as jp
+
+        cm = jp.TraceAnnotation(name)
+    except Exception:  # pragma: no cover - jax is a hard dep in practice
+        yield
+        return
+    with cm:
+        yield
+
+
+# -- process-global active recorder (the obs.chaos pattern) -------------
+
+_RECORDER: Optional[SpanRecorder] = None
+
+
+def active_recorder() -> Optional[SpanRecorder]:
+    return _RECORDER
+
+
+def install(recorder: SpanRecorder) -> SpanRecorder:
+    global _RECORDER
+    _RECORDER = recorder
+    return recorder
+
+
+def uninstall() -> None:
+    global _RECORDER
+    _RECORDER = None
+
+
+@contextlib.contextmanager
+def recording(recorder: SpanRecorder) -> Iterator[SpanRecorder]:
+    """Scoped installation; restores whatever recorder was active
+    before (nested runs keep their own traces)."""
+    global _RECORDER
+    previous = _RECORDER
+    _RECORDER = recorder
+    try:
+        yield recorder
+    finally:
+        _RECORDER = previous
+        recorder.finish()
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs: Any) -> Iterator[Optional[Dict[str, Any]]]:
+    """Module-level span entry point; yields the live span record (or
+    None when telemetry is off — a single global read and an empty
+    context, the zero-overhead contract instrumented code relies on)."""
+    rec = _RECORDER
+    if rec is None:
+        yield None
+        return
+    with rec.span(name, **attrs) as s:
+        yield s
+
+
+def event(name: str, **attrs: Any) -> None:
+    """Module-level event entry point; no-op without a recorder."""
+    rec = _RECORDER
+    if rec is not None:
+        rec.event(name, **attrs)
+
+
+def set_attr(name: str, value: Any) -> None:
+    """Attach an attribute to the current span; no-op without a
+    recorder."""
+    rec = _RECORDER
+    if rec is not None:
+        rec.set_attr(name, value)

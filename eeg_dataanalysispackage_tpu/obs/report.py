@@ -1,0 +1,337 @@
+"""Per-run report artifacts + the failure flight recorder.
+
+One pipeline ``execute()`` under ``report=<dir>`` (or
+``EEG_TPU_RUN_REPORT_DIR``) produces **one atomic ``run_report.json``**
+— the machine-readable record that previously died in log lines:
+query + resolved env knobs, device/backend + the degradation rung
+actually used, StageTimer totals (min/max/mean), the per-run metrics
+snapshot, feature/plan/compile-cache attribution, the span-tree
+summary (obs/events.py), and XLA compilation count/seconds captured
+via ``jax.monitoring`` listeners.
+
+When the run dies instead — an unhandled pipeline exception, a
+``CircuitOpenError``, an exhausted elastic-restart budget — the same
+telemetry dumps ``crash_report.json``: the recent-event ring (the
+flight recorder), metrics, the active chaos plan with per-rule firing
+counts, and the degradation history, so a chaos-run failure is a
+diagnosable artifact instead of a stack trace.
+
+Render or diff the artifacts with ``tools/obs_report.py``
+(cold-vs-warm, degraded-vs-clean). Schema identifiers:
+``eeg-tpu-run-report/v1`` and ``eeg-tpu-crash-report/v1``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import traceback
+from typing import Any, Dict, List, Optional
+
+from . import events
+
+logger = logging.getLogger(__name__)
+
+#: enables telemetry for every run in the process (a ``report=`` query
+#: parameter overrides per run; ``report=false`` opts one run out)
+ENV_REPORT_DIR = "EEG_TPU_RUN_REPORT_DIR"
+
+RUN_SCHEMA = "eeg-tpu-run-report/v1"
+CRASH_SCHEMA = "eeg-tpu-crash-report/v1"
+
+#: env knobs echoed into the report when set — the run's resolved
+#: configuration surface beyond the query string itself
+_ENV_KNOBS = (
+    "EEG_TPU_INGEST_WORKERS",
+    "EEG_TPU_PREFETCH_DEPTH",
+    "EEG_TPU_FEATURE_CACHE_DIR",
+    "EEG_TPU_NO_FEATURE_CACHE",
+    "EEG_TPU_COMPILE_CACHE_DIR",
+    "EEG_TPU_NO_COMPILE_CACHE",
+    "EEG_TPU_PLAN_CACHE_FILE",
+    "EEG_TPU_CIRCUIT_THRESHOLD",
+    "EEG_TPU_CIRCUIT_COOLDOWN",
+    "EEG_TPU_FAULTS",
+    "EEG_TPU_RUN_REPORT_DIR",
+    "EEG_PALLAS_MODE",
+    "JAX_PLATFORMS",
+)
+
+
+def resolve_report_dir(query_map: Dict[str, str]) -> Optional[str]:
+    """Where this run's report artifacts go, or None (telemetry off).
+
+    ``report=<dir>`` wins; ``report=true`` writes next to
+    ``result_path`` (its directory, else the cwd); ``report=false``
+    opts out even when ``EEG_TPU_RUN_REPORT_DIR`` is set; otherwise
+    the env var decides. Any explicit ``report=`` value beats the env
+    var — the query is the per-run override.
+    """
+    value = query_map.get("report", "")
+    if value == "false":
+        return None
+    if value and value != "true":
+        return value
+    if value == "true":
+        result_path = query_map.get("result_path", "")
+        return os.path.dirname(result_path) or "."
+    return os.environ.get(ENV_REPORT_DIR) or None
+
+
+# -- XLA compilation accounting (jax.monitoring) -------------------------
+
+_COMPILE_DURATION_PREFIX = "/jax/core/compile/"
+_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_monitor_lock = threading.Lock()
+_active_monitors: List["CompilationMonitor"] = []
+_listener_registered = False
+
+
+def _on_duration(event_name: str, duration: float, **_kwargs) -> None:
+    if not event_name.startswith(_COMPILE_DURATION_PREFIX):
+        return
+    with _monitor_lock:
+        monitors = list(_active_monitors)
+    for m in monitors:
+        m._record(event_name, duration)
+
+
+def _ensure_listener() -> bool:
+    """Register ONE process-wide jax.monitoring listener that fans out
+    to the active monitors — jax has no per-listener deregistration,
+    so per-run registration would leak a listener per run."""
+    global _listener_registered
+    with _monitor_lock:
+        if _listener_registered:
+            return True
+        try:
+            import jax.monitoring as jm
+
+            jm.register_event_duration_secs_listener(_on_duration)
+        except Exception as e:  # pragma: no cover - jax is a hard dep
+            logger.warning("jax.monitoring unavailable: %s", e)
+            return False
+        _listener_registered = True
+        return True
+
+
+class CompilationMonitor:
+    """Counts XLA compilations and their seconds for one run scope."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._durations: Dict[str, float] = {}
+        self._counts: Dict[str, int] = {}
+        self.available = _ensure_listener()
+
+    def __enter__(self) -> "CompilationMonitor":
+        with _monitor_lock:
+            _active_monitors.append(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        with _monitor_lock:
+            if self in _active_monitors:
+                _active_monitors.remove(self)
+
+    def _record(self, event_name: str, duration: float) -> None:
+        key = event_name[len(_COMPILE_DURATION_PREFIX):]
+        with self._lock:
+            self._durations[key] = self._durations.get(key, 0.0) + duration
+            self._counts[key] = self._counts.get(key, 0) + 1
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            backend_key = _BACKEND_COMPILE_EVENT[
+                len(_COMPILE_DURATION_PREFIX):
+            ]
+            return {
+                "available": self.available,
+                "compilations": self._counts.get(backend_key, 0),
+                "backend_compile_s": round(
+                    self._durations.get(backend_key, 0.0), 6
+                ),
+                "phases": {
+                    k: {
+                        "count": self._counts[k],
+                        "seconds": round(self._durations[k], 6),
+                    }
+                    for k in sorted(self._durations)
+                },
+            }
+
+
+# -- the per-run telemetry bundle ----------------------------------------
+
+class RunTelemetry:
+    """Everything one reported run carries: the span recorder (with a
+    JSONL sink next to the report), the compilation monitor, and the
+    degradation history the builder appends to. Constructed only when
+    a run opted in, so un-reported runs pay the module's no-op path.
+    """
+
+    def __init__(self, query: str, query_map: Dict[str, str],
+                 directory: str):
+        self.query = query
+        self.query_map = dict(query_map)
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self.recorder = events.SpanRecorder(
+            name="run",
+            jsonl_path=os.path.join(directory, "spans.jsonl"),
+        )
+        self.compilation = CompilationMonitor()
+        #: builder-appended: one entry per degradation-ladder step
+        self.degradation: List[Dict[str, Any]] = []
+        #: backend attribution: {"requested": ..., "landed": ...}
+        self.backend: Dict[str, Any] = {}
+
+    @property
+    def report_path(self) -> str:
+        return os.path.join(self.directory, "run_report.json")
+
+    @property
+    def crash_path(self) -> str:
+        return os.path.join(self.directory, "crash_report.json")
+
+    # -- shared payload pieces -----------------------------------------
+
+    def _common(self, timers, metrics) -> Dict[str, Any]:
+        from ..io import feature_cache
+        from ..ops import plan_cache
+        from ..utils import compile_cache
+        from . import chaos
+
+        try:
+            import jax
+
+            devices = jax.devices()
+            device = {
+                "platform": devices[0].platform,
+                "device_count": len(devices),
+            }
+        except Exception as e:  # pragma: no cover - defensive
+            device = {"platform": "unknown", "error": str(e)}
+        plan = chaos.active_plan()
+        pstats = plan_cache.stats()
+        return {
+            "query": self.query,
+            "query_map": self.query_map,
+            "env": {
+                k: os.environ[k] for k in _ENV_KNOBS if k in os.environ
+            },
+            "device": device,
+            "backend": dict(self.backend),
+            "degradation": list(self.degradation),
+            "stages": timers.as_dict() if timers is not None else {},
+            "metrics": metrics.snapshot() if metrics is not None else {},
+            "caches": {
+                "feature_cache": feature_cache.stats(),
+                "plan_cache": {
+                    "hits": pstats["hits"], "misses": pstats["misses"],
+                },
+                "compile_cache_dir": compile_cache.active_cache_dir(),
+            },
+            "xla": self.compilation.snapshot(),
+            "chaos": None if plan is None else {
+                "spec": plan.spec,
+                "seed": plan.seed,
+                "rules": {
+                    point: {"calls": rule.calls, "fired": rule.fired}
+                    for point, rule in plan.rules.items()
+                },
+            },
+        }
+
+    # -- artifacts ------------------------------------------------------
+
+    def write_report(self, statistics, timers, metrics,
+                     wall_s: float) -> str:
+        """The success artifact: one atomic ``run_report.json``."""
+        import hashlib
+
+        self.recorder.finish()
+        payload = {
+            "schema": RUN_SCHEMA,
+            "outcome": "ok",
+            "wall_s": round(wall_s, 6),
+            **self._common(timers, metrics),
+            "spans": self.recorder.summary(),
+            "statistics_sha256": hashlib.sha256(
+                str(statistics).encode()
+            ).hexdigest(),
+            "accuracy": _accuracy_of(statistics),
+        }
+        _atomic_json(self.report_path, payload)
+        # a stale crash artifact from an earlier failed run into the
+        # same directory must not sit next to a fresh outcome=ok
+        # report looking like it belongs to this run
+        try:
+            os.unlink(self.crash_path)
+        except OSError:
+            pass
+        logger.info("run report written: %s", self.report_path)
+        return self.report_path
+
+    def dump_crash(self, error: BaseException, timers, metrics) -> str:
+        """The failure artifact: flight-recorder ring + run state."""
+        self.recorder.finish()
+        payload = {
+            "schema": CRASH_SCHEMA,
+            "outcome": "error",
+            "error": {
+                "type": type(error).__name__,
+                "message": str(error),
+                "traceback": traceback.format_exception(
+                    type(error), error, error.__traceback__
+                ),
+            },
+            **self._common(timers, metrics),
+            "spans": self.recorder.summary(),
+            "events": self.recorder.recent_events(),
+        }
+        try:
+            _atomic_json(self.crash_path, payload)
+            # mirror of write_report's cleanup: an earlier run's
+            # outcome=ok report must not sit next to this crash
+            # looking like it describes the run that just died
+            try:
+                os.unlink(self.report_path)
+            except OSError:
+                pass
+        except OSError as e:  # the dump must never mask the real error
+            logger.error("crash report write failed: %s", e)
+            return ""
+        logger.error(
+            "crash report written: %s (%s: %s)",
+            self.crash_path, type(error).__name__, error,
+        )
+        return self.crash_path
+
+
+def _accuracy_of(statistics) -> Any:
+    """Per-classifier accuracy for fan-out results, a scalar
+    otherwise; best-effort (None if statistics are exotic)."""
+    try:
+        if hasattr(statistics, "items") and not hasattr(
+            statistics, "calc_accuracy"
+        ):
+            return {
+                name: round(s.calc_accuracy(), 6)
+                for name, s in statistics.items()
+            }
+        return round(statistics.calc_accuracy(), 6)
+    except Exception:
+        return None
+
+
+def _atomic_json(path: str, payload: Dict[str, Any]) -> None:
+    from ..checkpoint.manager import atomic_write_text
+
+    atomic_write_text(
+        path, json.dumps(payload, indent=2, sort_keys=True, default=str)
+        + "\n"
+    )

@@ -48,6 +48,10 @@ def _chaos_step(step):
 
     @functools.wraps(step)
     def wrapped(state, *args, **kwargs):
+        # no per-step telemetry event here: thousands of steps would
+        # flood the flight-recorder ring and evict the diagnostic
+        # events a crash report exists for — the sgd/nn elastic chunk
+        # events already record training progress at sane granularity
         chaos.maybe_fire("device.step")
         return step(state, *args, **kwargs)
 

@@ -15,6 +15,7 @@ from __future__ import annotations
 import contextlib
 import logging
 import re
+import time
 from typing import Dict, Optional, Union
 
 import numpy as np
@@ -24,7 +25,7 @@ from ..features import registry as fe_registry
 from ..io import provider, sources
 from ..models import registry as clf_registry
 from ..models import stats
-from ..obs import chaos
+from ..obs import chaos, events
 from ..utils import java_compat
 
 logger = logging.getLogger(__name__)
@@ -72,6 +73,21 @@ class PipelineBuilder:
         ] = None
         #: per-stage wall times for the run (obs.StageTimer)
         self.timers = obs.StageTimer()
+        #: obs.report.RunTelemetry when the run opted in (``report=`` /
+        #: EEG_TPU_RUN_REPORT_DIR), else None
+        self.telemetry = None
+        #: per-run metrics scope (obs.Metrics child) for the last run
+        self.run_metrics: Optional[obs.Metrics] = None
+        #: degradation-ladder history of the last run, oldest first
+        self.degradation_history: list = []
+
+    @contextlib.contextmanager
+    def _stage(self, name: str, **attrs):
+        """One pipeline stage: StageTimer accumulation + a telemetry
+        span (``stage.<name>``) carrying the stage's attributes —
+        a no-op context beyond the timer when telemetry is off."""
+        with self.timers.stage(name), events.span(f"stage.{name}", **attrs):
+            yield
 
     def execute(
         self,
@@ -102,14 +118,84 @@ class PipelineBuilder:
             else contextlib.nullcontext()
         )
 
-        with fault_scope:
-            # net-new observability: trace_path=<dir> wraps the run in
-            # a jax.profiler trace (device + annotated host activity),
-            # viewable in TensorBoard/Perfetto
-            if "trace_path" in query_map and query_map["trace_path"]:
-                with obs.trace(query_map["trace_path"]):
-                    return self._execute(query_map)
-            return self._execute(query_map)
+        # structured run telemetry (obs/events.py + obs/report.py):
+        # report=<dir> (or EEG_TPU_RUN_REPORT_DIR) installs a span
+        # recorder for the run and writes one atomic run_report.json on
+        # success — or crash_report.json (the flight recorder's recent
+        # -event ring + metrics + chaos plan + degradation history) on
+        # any unhandled pipeline exception, CircuitOpenError included.
+        # Telemetry observes, never steers: statistics are bit-identical
+        # with it on or off (tests/test_telemetry.py).
+        from ..obs import report as run_report
+
+        self.telemetry = None
+        self.degradation_history = []
+        # fresh per run, like the metrics scope below: a reused
+        # builder must not report run 1's stage seconds under run 2
+        self.timers = obs.StageTimer()
+        report_dir = run_report.resolve_report_dir(query_map)
+        if report_dir:
+            try:
+                self.telemetry = run_report.RunTelemetry(
+                    self.query, query_map, report_dir
+                )
+                # the builder appends rung drops as they happen; the
+                # report reads this shared list
+                self.telemetry.degradation = self.degradation_history
+            except OSError as e:
+                logger.warning(
+                    "run telemetry unavailable (%s: %s); running "
+                    "unreported", type(e).__name__, e,
+                )
+        telemetry = self.telemetry
+        telem_scope = (
+            events.recording(telemetry.recorder)
+            if telemetry is not None
+            else contextlib.nullcontext()
+        )
+        comp_scope = (
+            telemetry.compilation
+            if telemetry is not None
+            else contextlib.nullcontext()
+        )
+
+        start = time.perf_counter()
+        # per-run metrics scope: the run report gets THIS run's
+        # counters, not the process's whole history (the global
+        # registry keeps accumulating as the default sink)
+        with obs.metrics.scope() as run_metrics:
+            self.run_metrics = run_metrics
+            with comp_scope, telem_scope, fault_scope:
+                try:
+                    # net-new observability: trace_path=<dir> wraps the
+                    # run in a jax.profiler trace (device + annotated
+                    # host activity), viewable in TensorBoard/Perfetto
+                    if query_map.get("trace_path"):
+                        with obs.trace(query_map["trace_path"]):
+                            statistics = self._execute(query_map)
+                    else:
+                        statistics = self._execute(query_map)
+                except Exception as e:
+                    # flight recorder: dumped INSIDE the fault scope so
+                    # the crash artifact carries the active chaos plan
+                    # with its per-rule firing counts
+                    if telemetry is not None:
+                        telemetry.dump_crash(e, self.timers, run_metrics)
+                    raise
+                if telemetry is not None:
+                    # written inside the fault scope too, so a
+                    # SUCCESSFUL chaos run's report still records the
+                    # plan's per-rule call/firing accounting; and
+                    # guarded — a telemetry write failure must never
+                    # fail the run it observed
+                    try:
+                        telemetry.write_report(
+                            statistics, self.timers, run_metrics,
+                            wall_s=time.perf_counter() - start,
+                        )
+                    except OSError as e:
+                        logger.error("run report write failed: %s", e)
+        return statistics
 
     def _execute(
         self, query_map
@@ -183,7 +269,7 @@ class PipelineBuilder:
             landed = None
             if cache is not None:
                 try:
-                    with self.timers.stage("ingest"):
+                    with self._stage("ingest", phase="cache_lookup"):
                         cache_key = odp.feature_cache_key(
                             provider.fused_extractor_id(wavelet_index)
                         )
@@ -223,7 +309,7 @@ class PipelineBuilder:
                 if rung == "host":
                     break
                 try:
-                    with self.timers.stage("ingest"):
+                    with self._stage("ingest", backend=rung):
                         features, targets = odp.load_features_device(
                             wavelet_index=wavelet_index, backend=rung
                         )
@@ -244,13 +330,20 @@ class PipelineBuilder:
                 except Exception as e:
                     if len(ladder) == 1:
                         raise
+                    evidence = f"{type(e).__name__}: {e}"
                     logger.error(
-                        "fused ingest backend %r failed (%s: %s); "
-                        "degrading",
-                        rung, type(e).__name__, e,
+                        "pipeline.degrade rung_failed backend=%s "
+                        "requested=%s evidence=%s",
+                        rung, backend, evidence,
                     )
                     obs.metrics.count("pipeline.degraded")
                     obs.metrics.count(f"pipeline.degraded.from.{rung}")
+                    events.event(
+                        "pipeline.degraded", rung=rung, error=evidence
+                    )
+                    self.degradation_history.append(
+                        {"from": rung, "error": evidence}
+                    )
                     if self._devices_unhealthy():
                         # dead hardware fails every device rung the
                         # same way — jump straight to the host floor
@@ -258,15 +351,25 @@ class PipelineBuilder:
                             "pipeline.degraded.unhealthy_devices"
                         )
                         logger.error(
-                            "device probe reports unhealthy devices; "
+                            "pipeline.degrade unhealthy_devices=true: "
                             "skipping remaining device backends"
                         )
+                        events.event("pipeline.degraded.unhealthy_devices")
                         break
             if landed is not None:
                 if landed != backend and landed != "cache":
                     logger.warning(
-                        "fused ingest degraded %r -> %r", backend, landed
+                        "pipeline.degrade landed requested=%s landed=%s "
+                        "steps=%d",
+                        backend, landed, len(self.degradation_history),
                     )
+                events.event(
+                    "pipeline.rung_landed", requested=backend, landed=landed
+                )
+                if self.telemetry is not None:
+                    self.telemetry.backend = {
+                        "requested": backend, "landed": landed,
+                    }
                 if (
                     landed != "cache"
                     and cache is not None
@@ -280,17 +383,27 @@ class PipelineBuilder:
                 # loading plus the registry extractor — slower, but the
                 # run survives and the statistics contract holds
                 logger.error(
-                    "all fused backends failed; degrading to host "
-                    "epochs + registry extractor (dwt-%d)", wavelet_index
+                    "pipeline.degrade landed requested=%s landed=host "
+                    "(epochs + registry dwt-%d)", backend, wavelet_index
                 )
                 obs.metrics.count("pipeline.degraded.to_host")
+                events.event(
+                    "pipeline.rung_landed", requested=backend, landed="host"
+                )
+                self.degradation_history.append(
+                    {"from": backend, "to": "host"}
+                )
+                if self.telemetry is not None:
+                    self.telemetry.backend = {
+                        "requested": backend, "landed": "host",
+                    }
                 fused = False
                 fe = fe_registry.create(f"dwt-{wavelet_index}")
-                with self.timers.stage("ingest"):
+                with self._stage("ingest", backend="host"):
                     batch = odp.load()
                 n = len(batch)
         else:
-            with self.timers.stage("ingest"):
+            with self._stage("ingest"):
                 batch = odp.load()
             if "fe" not in query_map:
                 raise ValueError("Missing the feature extraction argument")
@@ -332,7 +445,11 @@ class PipelineBuilder:
             # SGD/NN families checkpoint mid-scan; tree growers train
             # monolithically with a logged note.
             elastic_kwargs = self._elastic_kwargs(query_map)
-            with self.timers.stage("train"):
+            with self._stage(
+                "train",
+                classifier=query_map["train_clf"],
+                elastic=elastic_kwargs is not None,
+            ):
                 if elastic_kwargs is None:
                     if fused:
                         classifier.fit(
@@ -370,7 +487,7 @@ class PipelineBuilder:
                     )
                 classifier.save(query_map["save_name"])
 
-            with self.timers.stage("test"):
+            with self._stage("test", classifier=query_map["train_clf"]):
                 statistics = (
                     classifier.test_features(
                         features[test_idx], targets[test_idx]
@@ -392,7 +509,7 @@ class PipelineBuilder:
             if not fused:
                 classifier.set_feature_extraction(fe)
             classifier.load(query_map["load_name"])
-            with self.timers.stage("test"):
+            with self._stage("test", classifier=query_map["load_clf"]):
                 statistics = (
                     classifier.test_features(features[perm], targets[perm])
                     if fused
@@ -463,7 +580,7 @@ class PipelineBuilder:
             # host path: one extraction pass over the whole epoch
             # batch (per-epoch independent, so slicing rows afterwards
             # equals extracting the slices)
-            with self.timers.stage("features"):
+            with self._stage("features"):
                 features = np.asarray(
                     fe.extract_batch(np.asarray(batch.epochs, np.float64))
                 )
@@ -475,15 +592,19 @@ class PipelineBuilder:
         }
         statistics = stats.FanOutStatistics()
         for name in names:
-            classifier = clf_registry.create(name)
-            classifier.set_config(config)
-            with self.timers.stage("train"):
-                classifier.fit(features[train_idx], targets[train_idx])
-            logger.info("trained %s", name)
-            with self.timers.stage("test"):
-                statistics[name] = classifier.test_features(
-                    features[test_idx], targets[test_idx]
-                )
+            # each fan-out leg is one span (fanout.<name>) wrapping its
+            # train+test stages, so a run report separates the shared
+            # featurization from the per-classifier cost
+            with events.span(f"fanout.{name}", classifier=name):
+                classifier = clf_registry.create(name)
+                classifier.set_config(config)
+                with self._stage("train", classifier=name):
+                    classifier.fit(features[train_idx], targets[train_idx])
+                logger.info("trained %s", name)
+                with self._stage("test", classifier=name):
+                    statistics[name] = classifier.test_features(
+                        features[test_idx], targets[test_idx]
+                    )
             obs.metrics.count("pipeline.fanout.classifiers")
         return statistics
 

@@ -2,7 +2,10 @@
 a slow-marked test so tier-1 stays fast while CI can run the full
 cold -> warm -> fan-out ladder. The gates: warm-cache faster than
 cold, cache hit/miss attribution correct, cached-vs-uncached and
-fan-out-vs-single statistics bit-identical, fan-out amortized."""
+fan-out-vs-single statistics bit-identical, fan-out amortized — and
+every timed run must produce a well-formed ``run_report.json``
+(obs/report.py schema, nonzero stage spans, cache attribution
+matching the bench line)."""
 
 import json
 import os
@@ -32,3 +35,7 @@ def test_e2e_smoke_trio():
     summary = json.loads(proc.stdout.strip().splitlines()[-1])
     assert summary["ok"], summary["failures"]
     assert summary["warm_speedup"] > 1.0
+    # the run-report gate ran for all three variants, and the stage
+    # breakdown rode along on the bench lines
+    assert summary["reports_checked"] == 3
+    assert summary["cold_stages"]["ingest"] > 0

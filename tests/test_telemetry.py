@@ -1,0 +1,487 @@
+"""Structured run telemetry (obs/events.py + obs/report.py): span
+nesting and thread-safety under the parallel-ingest pool, run-report
+schema round-trip, the flight recorder's crash artifact on an injected
+``faults=`` failure, per-run metrics scoping, and the pinned contract
+that telemetry-on vs telemetry-off ClassificationStatistics are
+bit-identical."""
+
+import json
+import os
+import threading
+
+import pytest
+
+import _synthetic
+from eeg_dataanalysispackage_tpu import obs
+from eeg_dataanalysispackage_tpu.obs import events
+from eeg_dataanalysispackage_tpu.obs import report as obs_report
+
+
+# -- span recorder -------------------------------------------------------
+
+
+def test_span_nesting_parents_and_attrs():
+    rec = events.SpanRecorder(name="run")
+    with events.recording(rec):
+        with events.span("outer", kind="test") as outer:
+            with events.span("inner") as inner:
+                events.event("mark", x=1)
+            assert inner["parent"] == outer["id"]
+        assert outer["parent"] == rec.root["id"]
+    spans = {s["name"]: s for s in rec.spans()}
+    assert set(spans) == {"outer", "inner"}
+    assert spans["outer"]["attrs"]["kind"] == "test"
+    assert spans["inner"]["end"] >= spans["inner"]["start"]
+    # the event landed on the innermost open span and in the ring
+    assert spans["inner"]["events"][0]["name"] == "mark"
+    assert [e["name"] for e in rec.recent_events()] == ["mark"]
+    # root closed by the recording() exit
+    assert rec.root["end"] is not None
+
+
+def test_span_error_annotation():
+    rec = events.SpanRecorder()
+    with events.recording(rec):
+        with pytest.raises(ValueError):
+            with events.span("will-fail"):
+                raise ValueError("boom")
+    (span,) = rec.spans()
+    assert span["attrs"]["error"] == "ValueError: boom"
+
+
+def test_span_thread_safety():
+    """Concurrent spans from many threads: per-thread stacks never
+    cross, orphan threads parent onto the run root, nothing is lost."""
+    rec = events.SpanRecorder()
+    n_threads, per_thread = 8, 25
+    barrier = threading.Barrier(n_threads)
+
+    def work(tid):
+        barrier.wait()
+        for i in range(per_thread):
+            with rec.span(f"t{tid}", i=i) as outer:
+                with rec.span(f"t{tid}.child") as child:
+                    assert child["parent"] == outer["id"]
+                rec.event("tick")
+
+    threads = [
+        threading.Thread(target=work, args=(t,)) for t in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    spans = rec.spans()
+    assert len(spans) == n_threads * per_thread * 2
+    by_id = {s["id"]: s for s in spans}
+    for s in spans:
+        if s["name"].endswith(".child"):
+            # child's parent is the same thread's outer span
+            assert by_id[s["parent"]]["name"] == s["name"].rsplit(".", 1)[0]
+        else:
+            # outer spans from pool threads parent onto the root
+            assert s["parent"] == rec.root["id"]
+    summary = rec.summary()
+    assert summary["dropped_spans"] == 0
+    assert sum(v["count"] for v in summary["by_name"].values()) == len(spans)
+
+
+def test_events_are_noop_without_recorder():
+    events.uninstall()
+    with events.span("nothing", a=1) as s:
+        assert s is None
+    events.event("nothing")  # must not raise
+    assert events.active_recorder() is None
+
+
+def test_jsonl_sink_round_trip(tmp_path):
+    path = str(tmp_path / "spans.jsonl")
+    rec = events.SpanRecorder(jsonl_path=path)
+    with events.recording(rec):
+        with events.span("a"):
+            events.event("ev", k="v")
+    lines = [json.loads(l) for l in open(path).read().splitlines()]
+    kinds = [l["kind"] for l in lines]
+    assert kinds.count("event") == 1
+    assert kinds.count("span") == 2  # "a" + the root on finish()
+    (ev,) = [l for l in lines if l["kind"] == "event"]
+    assert ev["name"] == "ev" and ev["attrs"] == {"k": "v"}
+
+
+def test_jsonl_sink_truncates_per_run_and_latches_on_finish(tmp_path):
+    """A fixed report dir (EEG_TPU_RUN_REPORT_DIR) replaces the trace
+    per run rather than interleaving runs, and a straggler thread
+    finishing a span after finish() cannot reopen the closed sink."""
+    path = str(tmp_path / "spans.jsonl")
+    rec1 = events.SpanRecorder(jsonl_path=path)
+    with rec1.span("first-run"):
+        pass
+    rec1.finish()
+    rec2 = events.SpanRecorder(jsonl_path=path)
+    with rec2.span("second-run"):
+        pass
+    rec2.finish()
+    names = [
+        json.loads(l)["name"] for l in open(path).read().splitlines()
+    ]
+    assert "second-run" in names and "first-run" not in names
+    # post-finish span: retained in memory, but the sink stays closed
+    with rec2.span("straggler"):
+        pass
+    assert "straggler" in {s["name"] for s in rec2.spans()}
+    assert "straggler" not in [
+        json.loads(l)["name"] for l in open(path).read().splitlines()
+    ]
+
+
+def test_staging_producer_error_event_lands_on_producer_span():
+    import numpy as np
+
+    from eeg_dataanalysispackage_tpu.io import staging
+
+    def bad_batches():
+        yield (np.zeros((2, 4), np.float32),)
+        raise RuntimeError("poisoned batch")
+
+    rec = events.SpanRecorder()
+    with events.recording(rec):
+        with pytest.raises(RuntimeError, match="poisoned batch"):
+            for _ in staging.prefetch(bad_batches()):
+                pass
+    (ev,) = [
+        e for e in rec.recent_events()
+        if e["name"] == "staging.producer_error"
+    ]
+    assert ev["span_name"] == "staging.producer"
+    assert ev["attrs"]["batches_staged"] == 1
+
+
+# -- parallel-ingest spans ----------------------------------------------
+
+
+def _write_multi_session(directory, n_files=4, n_markers=24):
+    lines = []
+    for i in range(n_files):
+        name = f"synth_{i:02d}"
+        _synthetic.write_recording(
+            directory, name=name, n_markers=n_markers, guessed=2 + i,
+            seed=i,
+        )
+        lines.append(f"{name}.eeg {2 + i}")
+    info = os.path.join(directory, "info.txt")
+    with open(info, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return info
+
+
+def test_parallel_ingest_parse_spans(tmp_path):
+    """The worker pool's per-recording parse spans are recorded
+    thread-safely and parent onto the run root."""
+    from eeg_dataanalysispackage_tpu.io import provider
+
+    info = _write_multi_session(str(tmp_path), n_files=4)
+    rec = events.SpanRecorder()
+    with events.recording(rec):
+        provider.OfflineDataProvider([info], workers=4).load()
+    parse = [s for s in rec.spans() if s["name"] == "ingest.parse"]
+    assert len(parse) == 4
+    assert {s["attrs"]["file"] for s in parse} == {
+        f"synth_{i:02d}.eeg" for i in range(4)
+    }
+    assert all(s["parent"] == rec.root["id"] for s in parse)
+    assert all(s["attrs"].get("pooled") for s in parse)
+
+
+# -- metrics scoping -----------------------------------------------------
+
+
+def test_metrics_scope_isolates_runs():
+    m = obs.Metrics()
+    m.count("before_scope")
+    with m.scope() as run1:
+        m.count("pipeline.x", 2)
+        m.gauge("g", 7.0)
+    with m.scope() as run2:
+        m.count("pipeline.x", 5)
+    # each scope saw only its own window
+    assert run1.snapshot()["counters"] == {"pipeline.x": 2}
+    assert run1.snapshot()["gauges"] == {"g": 7.0}
+    assert run2.snapshot()["counters"] == {"pipeline.x": 5}
+    assert "before_scope" not in run1.snapshot()["counters"]
+    # the global kept accumulating as the default sink
+    assert m.snapshot()["counters"]["pipeline.x"] == 7
+
+
+def test_metrics_reset():
+    m = obs.Metrics()
+    m.count("a", 3)
+    m.gauge("b", 1.0)
+    m.reset()
+    assert m.snapshot() == {"counters": {}, "gauges": {}}
+    m.count("a")  # still usable after reset
+    assert m.snapshot()["counters"]["a"] == 1
+
+
+# -- StageTimer min/max/mean --------------------------------------------
+
+
+def test_stage_timer_min_max_mean():
+    t = obs.StageTimer()
+    import time as _time
+
+    with t.stage("s"):
+        _time.sleep(0.02)
+    with t.stage("s"):
+        pass
+    d = t.as_dict()["s"]
+    assert d["count"] == 2
+    assert d["min_s"] <= d["mean_s"] <= d["max_s"]
+    assert d["max_s"] >= 0.02
+    assert abs(d["mean_s"] - d["seconds"] / 2) < 1e-9
+    report = t.report()
+    assert "mean" in report and "min" in report and "max" in report
+    # deterministic alignment: every line same length
+    lines = report.splitlines()
+    assert len({len(l) for l in lines}) == 1
+
+
+# -- pipeline integration ------------------------------------------------
+
+_QUERY_TMPL = (
+    "info_file={info}&fe=dwt-8-fused&train_clf=logreg&cache=false"
+    "&config_num_iterations=5&config_step_size=1.0"
+    "&config_mini_batch_fraction=1.0"
+)
+
+
+def _run_pipeline(query):
+    from eeg_dataanalysispackage_tpu.pipeline import builder
+
+    pb = builder.PipelineBuilder(query)
+    return pb, pb.execute()
+
+
+def test_run_report_schema_round_trip(tmp_path):
+    (tmp_path / "d").mkdir()
+    info = _synthetic.write_session(str(tmp_path / "d"), n_markers=48)
+    report_dir = str(tmp_path / "report")
+    query = _QUERY_TMPL.format(info=info) + f"&report={report_dir}"
+    pb, statistics = _run_pipeline(query)
+
+    path = os.path.join(report_dir, "run_report.json")
+    assert os.path.exists(path)
+    report = json.load(open(path))
+    assert report["schema"] == obs_report.RUN_SCHEMA
+    assert report["outcome"] == "ok"
+    assert report["query"] == query
+    assert report["wall_s"] > 0
+    # stage totals present with the min/max/mean shape
+    for stage in ("ingest", "train", "test"):
+        entry = report["stages"][stage]
+        assert entry["seconds"] > 0
+        assert entry["min_s"] <= entry["mean_s"] <= entry["max_s"]
+    # per-run metrics, not process history
+    assert report["metrics"]["counters"]["pipeline.epochs_loaded"] > 0
+    # span summary recorded the stage spans
+    by_name = report["spans"]["by_name"]
+    for name in ("stage.ingest", "stage.train", "stage.test",
+                 "ingest.parse"):
+        assert by_name[name]["count"] >= 1, name
+    # backend attribution (CPU resolves the bare -fused to xla)
+    assert report["backend"]["landed"] in ("xla", "block", "pallas")
+    # cache attribution is schema-stable even for a cache=false run
+    assert set(report["caches"]) == {
+        "feature_cache", "plan_cache", "compile_cache_dir"
+    }
+    assert report["statistics_sha256"]
+    assert report["accuracy"] == round(statistics.calc_accuracy(), 6)
+    # spans.jsonl sink sits next to the report
+    assert os.path.exists(os.path.join(report_dir, "spans.jsonl"))
+    # telemetry was scoped to the run: nothing left installed
+    assert events.active_recorder() is None
+
+
+def test_telemetry_on_off_statistics_bit_identical(tmp_path):
+    """The acceptance pin: enabling telemetry must not perturb the
+    classification result in any way."""
+    (tmp_path / "d").mkdir()
+    info = _synthetic.write_session(str(tmp_path / "d"), n_markers=48)
+    _, stats_off = _run_pipeline(_QUERY_TMPL.format(info=info))
+    _, stats_on = _run_pipeline(
+        _QUERY_TMPL.format(info=info)
+        + f"&report={tmp_path / 'report'}"
+    )
+    assert str(stats_on) == str(stats_off)
+
+
+def test_successful_chaos_run_report_carries_plan_accounting(tmp_path):
+    """A chaos run the defenses absorb still succeeds — and its
+    run_report.json must record the plan's per-rule firing counts
+    (the report writes inside the fault scope)."""
+    (tmp_path / "d").mkdir()
+    info = _synthetic.write_session(str(tmp_path / "d"), n_markers=48)
+    report_dir = str(tmp_path / "report")
+    query = (
+        _QUERY_TMPL.format(info=info)
+        + f"&report={report_dir}"
+        + "&faults=ingest.fused:once@1"  # absorbed by the ladder
+    )
+    _run_pipeline(query)
+    report = json.load(
+        open(os.path.join(report_dir, "run_report.json"))
+    )
+    assert report["outcome"] == "ok"
+    assert report["chaos"]["rules"]["ingest.fused"]["fired"] == 1
+    assert report["backend"]["landed"] == "host"
+    assert report["degradation"]
+
+
+def test_crash_clears_stale_run_report_and_timers_reset(tmp_path):
+    """The mirror lifecycle: success then crash into the same dir
+    leaves only crash_report.json — and a reused builder's second
+    run reports its own stage times, not accumulated ones."""
+    (tmp_path / "d").mkdir()
+    info = _synthetic.write_session(str(tmp_path / "d"), n_markers=48)
+    report_dir = str(tmp_path / "report")
+    from eeg_dataanalysispackage_tpu.obs import chaos
+    from eeg_dataanalysispackage_tpu.pipeline import builder
+
+    pb1, _ = _run_pipeline(
+        _QUERY_TMPL.format(info=info) + f"&report={report_dir}"
+    )
+    first_ingest = pb1.timers.as_dict()["ingest"]
+    # same builder re-executed: per-run timers, no accumulation
+    pb1.execute()
+    assert pb1.timers.as_dict()["ingest"]["count"] == \
+        first_ingest["count"]
+    # now a crashing run into the same directory
+    pb2 = builder.PipelineBuilder(
+        _QUERY_TMPL.format(info=info)
+        + f"&report={report_dir}&degrade=false"
+        + "&faults=ingest.fused:once@1"
+    )
+    with pytest.raises(chaos.ChaosInjectedError):
+        pb2.execute()
+    assert os.path.exists(os.path.join(report_dir, "crash_report.json"))
+    assert not os.path.exists(
+        os.path.join(report_dir, "run_report.json")
+    )
+
+
+def test_successful_run_clears_stale_crash_artifact(tmp_path):
+    """Run 1 crashes into a fixed report dir; run 2 succeeds there —
+    the stale crash_report.json must not survive next to a fresh
+    outcome=ok report."""
+    (tmp_path / "d").mkdir()
+    info = _synthetic.write_session(str(tmp_path / "d"), n_markers=48)
+    report_dir = str(tmp_path / "report")
+    from eeg_dataanalysispackage_tpu.obs import chaos
+
+    with pytest.raises(chaos.ChaosInjectedError):
+        _run_pipeline(
+            _QUERY_TMPL.format(info=info)
+            + f"&report={report_dir}&degrade=false"
+            + "&faults=ingest.fused:once@1"
+        )
+    assert os.path.exists(os.path.join(report_dir, "crash_report.json"))
+    _run_pipeline(_QUERY_TMPL.format(info=info) + f"&report={report_dir}")
+    assert not os.path.exists(
+        os.path.join(report_dir, "crash_report.json")
+    )
+    assert os.path.exists(os.path.join(report_dir, "run_report.json"))
+
+
+def test_resolve_report_dir_precedence(tmp_path, monkeypatch):
+    """Explicit report= values beat EEG_TPU_RUN_REPORT_DIR; =true
+    resolves next to result_path; =false opts out of everything."""
+    monkeypatch.setenv(obs_report.ENV_REPORT_DIR, "/env-dir")
+    assert obs_report.resolve_report_dir({"report": "/q-dir"}) == "/q-dir"
+    assert obs_report.resolve_report_dir(
+        {"report": "true", "result_path": "/out/res.txt"}
+    ) == "/out"
+    assert obs_report.resolve_report_dir({"report": "true"}) == "."
+    assert obs_report.resolve_report_dir({"report": "false"}) is None
+    assert obs_report.resolve_report_dir({}) == "/env-dir"
+    monkeypatch.delenv(obs_report.ENV_REPORT_DIR)
+    assert obs_report.resolve_report_dir({}) is None
+
+
+def test_stage_timer_total_probe_does_not_poison():
+    t = obs.StageTimer()
+    assert t.total("never-ran") == 0.0
+    assert t.as_dict() == {}  # the probe left no zero-count row
+
+
+def test_flight_recorder_dumps_crash_report(tmp_path):
+    """A chaos run that fails produces crash_report.json carrying the
+    firing event and the degradation history (the acceptance
+    criterion for the flight recorder)."""
+    (tmp_path / "d").mkdir()
+    info = _synthetic.write_session(str(tmp_path / "d"), n_markers=48)
+    report_dir = str(tmp_path / "report")
+    query = (
+        _QUERY_TMPL.format(info=info)
+        + f"&report={report_dir}"
+        + f"&elastic=true&checkpoint_path={tmp_path / 'ckpt'}"
+        + "&max_restarts=0"
+        + "&faults=ingest.fused:once@1;device.step:once@1"
+    )
+    from eeg_dataanalysispackage_tpu.obs import chaos
+
+    with pytest.raises(chaos.ChaosInjectedError):
+        _run_pipeline(query)
+
+    path = os.path.join(report_dir, "crash_report.json")
+    assert os.path.exists(path)
+    crash = json.load(open(path))
+    assert crash["schema"] == obs_report.CRASH_SCHEMA
+    assert crash["error"]["type"] == "ChaosInjectedError"
+    assert "device.step" in crash["error"]["message"]
+    # the firing events are in the flight-recorder ring, annotated
+    # with the span they interrupted
+    fired = [e for e in crash["events"] if e["name"] == "chaos.fired"]
+    assert {e["attrs"]["point"] for e in fired} == {
+        "ingest.fused", "device.step"
+    }
+    assert any(e["span_name"] == "stage.train" for e in fired)
+    # degradation history: the injected fused failure stepped the run
+    # down to the host floor before training died
+    assert crash["degradation"][0]["from"] in ("xla", "block", "pallas")
+    assert crash["degradation"][-1]["to"] == "host"
+    assert crash["backend"] == {
+        "requested": crash["degradation"][0]["from"], "landed": "host",
+    }
+    # the chaos plan rode along with per-rule firing accounting
+    assert crash["chaos"]["rules"]["device.step"]["fired"] == 1
+    # no dangling recorder after the crash
+    assert events.active_recorder() is None
+
+
+def test_obs_report_tool_show_and_diff(tmp_path, capsys):
+    """tools/obs_report.py renders and diffs real artifacts."""
+    import sys
+
+    sys.path.insert(
+        0,
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools",
+        ),
+    )
+    import obs_report as tool
+
+    (tmp_path / "d").mkdir()
+    info = _synthetic.write_session(str(tmp_path / "d"), n_markers=48)
+    dir_a, dir_b = str(tmp_path / "a"), str(tmp_path / "b")
+    _run_pipeline(_QUERY_TMPL.format(info=info) + f"&report={dir_a}")
+    _run_pipeline(_QUERY_TMPL.format(info=info) + f"&report={dir_b}")
+    a = os.path.join(dir_a, "run_report.json")
+    b = os.path.join(dir_b, "run_report.json")
+
+    assert tool.main(["show", a]) == 0
+    out = capsys.readouterr().out
+    assert "RUN report" in out and "stages:" in out
+
+    assert tool.main(["diff", a, b]) == 0
+    out = capsys.readouterr().out
+    assert "statistics: IDENTICAL" in out

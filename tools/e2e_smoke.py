@@ -16,7 +16,13 @@ performance contract holds:
   single-classifier run's exactly (shared features must not perturb
   any individual classifier);
 - fan-out wall time stays under 3x the single-classifier cold run
-  (ingest+featurization amortized across the five classifiers).
+  (ingest+featurization amortized across the five classifiers);
+- every timed run wrote a well-formed ``run_report.json``
+  (obs/report.py schema): nonzero stage spans for ingest/train/test,
+  a span summary that actually recorded the stage spans, and
+  feature-cache attribution identical to the bench line's
+  ``feature_cache`` field (the report and the bench artifact must
+  tell the same story).
 
 Usage: python tools/e2e_smoke.py [n_markers_per_file] [n_files]
 
@@ -36,12 +42,14 @@ _PIPELINE_BENCH = os.path.join(_REPO, "tools", "pipeline_bench.py")
 
 
 def _run_variant(variant: str, n_markers: int, n_files: int,
-                 data_dir: str, cache_dir: str) -> dict:
+                 data_dir: str, cache_dir: str,
+                 report_dir: str) -> dict:
     proc = subprocess.run(
         [
             sys.executable, _PIPELINE_BENCH, variant,
             str(n_markers), str(n_files),
             f"--data-dir={data_dir}", f"--cache-dir={cache_dir}",
+            f"--report-dir={report_dir}",
         ],
         capture_output=True,
         text=True,
@@ -54,21 +62,97 @@ def _run_variant(variant: str, n_markers: int, n_files: int,
     return json.loads(proc.stdout.strip().splitlines()[-1])
 
 
+#: stages a timed pipeline run must have spent real time in
+_REQUIRED_STAGES = ("ingest", "train", "test")
+
+
+def _check_report(tag: str, bench_line: dict, report_dir: str,
+                  failures: list, checked: list) -> None:
+    """The run-report half of the gate: the artifact exists, parses,
+    matches the schema, recorded nonzero stage spans, and agrees with
+    the bench line's cache attribution."""
+    checked.append(tag)
+    path = os.path.join(report_dir, "run_report.json")
+    if not os.path.exists(path):
+        failures.append(f"{tag}: no run_report.json in {report_dir}")
+        return
+    try:
+        with open(path) as f:
+            report = json.load(f)
+    except ValueError as e:
+        failures.append(f"{tag}: run_report.json unparseable: {e}")
+        return
+    if report.get("schema") != "eeg-tpu-run-report/v1":
+        failures.append(
+            f"{tag}: bad report schema {report.get('schema')!r}"
+        )
+        return
+    stages = report.get("stages", {})
+    for stage in _REQUIRED_STAGES:
+        if stages.get(stage, {}).get("seconds", 0.0) <= 0.0:
+            failures.append(
+                f"{tag}: stage {stage!r} has no recorded time: "
+                f"{stages.get(stage)}"
+            )
+    by_name = (report.get("spans") or {}).get("by_name", {})
+    for stage in _REQUIRED_STAGES:
+        if by_name.get(f"stage.{stage}", {}).get("count", 0) < 1:
+            failures.append(
+                f"{tag}: span stage.{stage} missing from the report's "
+                f"span summary: {sorted(by_name)}"
+            )
+    report_fc = (report.get("caches") or {}).get("feature_cache")
+    if report_fc != bench_line["feature_cache"]:
+        failures.append(
+            f"{tag}: report cache attribution {report_fc} != bench "
+            f"line {bench_line['feature_cache']}"
+        )
+    # both come from the same StageTimer, so the report's stage totals
+    # must match the bench line's breakdown exactly (modulo rounding)
+    for stage, entry in bench_line.get("stages", {}).items():
+        got = round(stages.get(stage, {}).get("seconds", -1.0), 6)
+        if abs(got - entry["seconds"]) > 1e-6:
+            failures.append(
+                f"{tag}: stage {stage!r} drifted between report "
+                f"({got}) and bench line ({entry['seconds']})"
+            )
+    if report.get("outcome") != "ok":
+        failures.append(f"{tag}: outcome {report.get('outcome')!r}")
+
+
 def run(n_markers: int = 2000, n_files: int = 4) -> dict:
     failures = []
+    reports_checked = []
     with tempfile.TemporaryDirectory(prefix="eeg_tpu_smoke_") as tmp:
         data_dir = os.path.join(tmp, "data")
+        report_dirs = {
+            v: os.path.join(tmp, f"report_{v}")
+            for v in ("cold", "warm", "fanout")
+        }
         cold = _run_variant(
             "pipeline_e2e_cold", n_markers, n_files,
             data_dir, os.path.join(tmp, "cache_cold"),
+            report_dirs["cold"],
         )
         warm = _run_variant(
             "pipeline_e2e_warm", n_markers, n_files,
             data_dir, os.path.join(tmp, "cache_warm"),
+            report_dirs["warm"],
         )
         fanout = _run_variant(
             "pipeline_e2e_fanout5", n_markers, n_files,
             data_dir, os.path.join(tmp, "cache_fanout"),
+            report_dirs["fanout"],
+        )
+        _check_report(
+            "cold", cold, report_dirs["cold"], failures, reports_checked
+        )
+        _check_report(
+            "warm", warm, report_dirs["warm"], failures, reports_checked
+        )
+        _check_report(
+            "fanout", fanout, report_dirs["fanout"], failures,
+            reports_checked,
         )
 
     if not warm["wall_s"] < cold["wall_s"]:
@@ -118,6 +202,13 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
         "fanout_vs_cold": round(fanout["wall_s"] / cold["wall_s"], 2),
         "warm_feature_cache": warm["feature_cache"],
         "cold_feature_cache": cold["feature_cache"],
+        "reports_checked": len(reports_checked),
+        "cold_stages": {
+            k: v["seconds"] for k, v in cold.get("stages", {}).items()
+        },
+        "warm_stages": {
+            k: v["seconds"] for k, v in warm.get("stages", {}).items()
+        },
     }
 
 

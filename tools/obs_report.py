@@ -1,0 +1,226 @@
+"""Render / diff the pipeline's run-report artifacts.
+
+Usage:
+    python tools/obs_report.py show <run_report.json | crash_report.json>
+    python tools/obs_report.py diff <a.json> <b.json>
+
+``show`` renders one artifact (obs/report.py schemas) as an aligned
+human-readable summary: stage table (total/count/mean/min/max), cache
+attribution, XLA compilation accounting, degradation history, top
+metrics counters, span aggregates — and for crash reports the error
+plus the flight-recorder event tail.
+
+``diff`` compares two run reports side by side — the cold-vs-warm and
+degraded-vs-clean questions: per-stage seconds with the ratio, cache
+attribution deltas, backend rung drift, compilation count/seconds
+deltas, and metrics counters that changed. Exit code 0 always (it is
+a lens, not a gate; gates live in tools/e2e_smoke.py).
+
+Stdlib only, like every tool in this repo.
+"""
+
+import json
+import os
+import sys
+
+_STAGE_COLS = ("seconds", "count", "mean_s", "min_s", "max_s")
+
+
+def _load(path: str) -> dict:
+    with open(path) as f:
+        data = json.load(f)
+    schema = data.get("schema", "")
+    if not schema.startswith(("eeg-tpu-run-report/", "eeg-tpu-crash-report/")):
+        raise SystemExit(
+            f"{path}: not a run/crash report (schema={schema!r})"
+        )
+    return data
+
+
+def _fmt_stage_table(stages: dict) -> list:
+    if not stages:
+        return ["  (no stages recorded)"]
+    rows = sorted(
+        stages.items(), key=lambda kv: (-kv[1]["seconds"], kv[0])
+    )
+    width = max(len(n) for n, _ in rows)
+    out = [
+        f"  {'stage':<{width}}  {'total':>9}  {'count':>5}  "
+        f"{'mean':>9}  {'min':>9}  {'max':>9}"
+    ]
+    for name, v in rows:
+        out.append(
+            f"  {name:<{width}}  {v['seconds']:9.4f}  {v['count']:>5}  "
+            f"{v.get('mean_s', v['seconds'] / max(1, v['count'])):9.4f}  "
+            f"{v.get('min_s', 0.0):9.4f}  {v.get('max_s', 0.0):9.4f}"
+        )
+    return out
+
+
+def _top_counters(metrics: dict, n: int = 12) -> list:
+    counters = (metrics or {}).get("counters", {})
+    rows = sorted(counters.items(), key=lambda kv: (-kv[1], kv[0]))[:n]
+    if not rows:
+        return ["  (no counters)"]
+    width = max(len(k) for k, _ in rows)
+    return [f"  {k:<{width}}  {v:g}" for k, v in rows]
+
+
+def show(path: str) -> None:
+    data = _load(path)
+    crash = data["schema"].startswith("eeg-tpu-crash-report/")
+    print(f"{'CRASH' if crash else 'RUN'} report  {path}")
+    print(f"  schema   {data['schema']}")
+    print(f"  outcome  {data.get('outcome')}")
+    if "wall_s" in data:
+        print(f"  wall     {data['wall_s']:.3f}s")
+    print(f"  query    {data.get('query', '')}")
+    dev = data.get("device", {})
+    print(
+        f"  device   {dev.get('platform')} x{dev.get('device_count', '?')}"
+    )
+    backend = data.get("backend") or {}
+    if backend:
+        print(
+            f"  backend  requested={backend.get('requested')} "
+            f"landed={backend.get('landed')}"
+        )
+    if crash:
+        err = data.get("error", {})
+        print(f"\nerror: {err.get('type')}: {err.get('message')}")
+    deg = data.get("degradation") or []
+    if deg:
+        print("\ndegradation history:")
+        for step in deg:
+            print(f"  {step}")
+    print("\nstages:")
+    for line in _fmt_stage_table(data.get("stages", {})):
+        print(line)
+    caches = data.get("caches", {})
+    print(
+        f"\ncaches: feature={caches.get('feature_cache')} "
+        f"plan={caches.get('plan_cache')} "
+        f"compile_dir={caches.get('compile_cache_dir')}"
+    )
+    xla = data.get("xla", {})
+    print(
+        f"xla: compilations={xla.get('compilations')} "
+        f"backend_compile_s={xla.get('backend_compile_s')}"
+    )
+    chaos = data.get("chaos")
+    if chaos:
+        print(f"chaos: spec={chaos.get('spec')!r} seed={chaos.get('seed')}")
+        for point, rule in (chaos.get("rules") or {}).items():
+            print(
+                f"  {point}: calls={rule['calls']} fired={rule['fired']}"
+            )
+    spans = data.get("spans", {})
+    by_name = spans.get("by_name", {})
+    if by_name:
+        print(
+            f"\nspans ({spans.get('span_count')} total, "
+            f"{spans.get('dropped_spans', 0)} dropped):"
+        )
+        width = max(len(k) for k in by_name)
+        for name, agg in by_name.items():
+            print(
+                f"  {name:<{width}}  x{agg['count']:<5} "
+                f"{agg['seconds']:9.4f}s  "
+                f"[{agg['min_s']:.4f} .. {agg['max_s']:.4f}]"
+            )
+    print("\ntop metrics counters:")
+    for line in _top_counters(data.get("metrics", {})):
+        print(line)
+    if crash:
+        events = data.get("events") or []
+        print(f"\nflight recorder (last {len(events)} events):")
+        for ev in events[-20:]:
+            print(
+                f"  t={ev['t']:9.4f}  {ev['name']:<28} "
+                f"span={ev.get('span_name')}  {ev.get('attrs') or ''}"
+            )
+
+
+def diff(path_a: str, path_b: str) -> None:
+    a, b = _load(path_a), _load(path_b)
+    print(f"A: {path_a}")
+    print(f"B: {path_b}")
+    wall_a, wall_b = a.get("wall_s"), b.get("wall_s")
+    if wall_a and wall_b:
+        print(
+            f"\nwall: A {wall_a:.3f}s  B {wall_b:.3f}s  "
+            f"(B/A = {wall_b / wall_a:.2f}x)"
+        )
+    ba, bb = a.get("backend") or {}, b.get("backend") or {}
+    if ba != bb:
+        print(f"backend: A {ba}  B {bb}")
+    da, db = a.get("degradation") or [], b.get("degradation") or []
+    if len(da) != len(db):
+        print(f"degradation steps: A {len(da)}  B {len(db)}")
+
+    print("\nstages (A vs B):")
+    stages_a, stages_b = a.get("stages", {}), b.get("stages", {})
+    names = sorted(set(stages_a) | set(stages_b))
+    if names:
+        width = max(len(n) for n in names)
+        for name in names:
+            sa = stages_a.get(name, {}).get("seconds", 0.0)
+            sb = stages_b.get(name, {}).get("seconds", 0.0)
+            ratio = f"{sb / sa:7.2f}x" if sa > 0 else "      --"
+            print(
+                f"  {name:<{width}}  A {sa:9.4f}s  B {sb:9.4f}s  {ratio}"
+            )
+
+    print("\ncaches:")
+    for kind in ("feature_cache", "plan_cache"):
+        ca = (a.get("caches") or {}).get(kind)
+        cb = (b.get("caches") or {}).get(kind)
+        marker = " " if ca == cb else "*"
+        print(f" {marker} {kind}: A {ca}  B {cb}")
+    xa, xb = a.get("xla", {}), b.get("xla", {})
+    print(
+        f"\nxla: A compilations={xa.get('compilations')} "
+        f"({xa.get('backend_compile_s')}s)  "
+        f"B compilations={xb.get('compilations')} "
+        f"({xb.get('backend_compile_s')}s)"
+    )
+
+    ca = (a.get("metrics") or {}).get("counters", {})
+    cb = (b.get("metrics") or {}).get("counters", {})
+    changed = {
+        k for k in set(ca) | set(cb) if ca.get(k, 0) != cb.get(k, 0)
+    }
+    if changed:
+        print("\nmetrics counters that differ:")
+        width = max(len(k) for k in changed)
+        for k in sorted(changed):
+            print(
+                f"  {k:<{width}}  A {ca.get(k, 0):g}  B {cb.get(k, 0):g}"
+            )
+    sa = a.get("statistics_sha256")
+    sb = b.get("statistics_sha256")
+    if sa and sb:
+        verdict = "IDENTICAL" if sa == sb else "DIFFER"
+        print(f"\nstatistics: {verdict} (A {sa[:12]}… B {sb[:12]}…)")
+
+
+def main(argv) -> int:
+    if len(argv) >= 2 and argv[0] == "show":
+        show(argv[1])
+        return 0
+    if len(argv) >= 3 and argv[0] == "diff":
+        diff(argv[1], argv[2])
+        return 0
+    print(__doc__)
+    return 2
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main(sys.argv[1:]))
+    except BrokenPipeError:
+        # `obs_report.py show ... | head` closing the pipe early is
+        # fine — exit quietly like any well-behaved filter
+        os_devnull = open(os.devnull, "w")
+        sys.stdout = os_devnull
+        sys.exit(0)

@@ -118,32 +118,43 @@ def build_query(info: str, fanout: bool) -> str:
 
 
 def run_query(query: str):
-    """(statistics, wall_s, n_epochs) for one pipeline execution."""
+    """(statistics, wall_s, n_epochs, stage dict) for one pipeline
+    execution. The stage dict is the builder's StageTimer breakdown
+    (total/count/min/max/mean per stage), so every bench line carries
+    where the wall time went, not just that it went."""
     from eeg_dataanalysispackage_tpu import obs
     from eeg_dataanalysispackage_tpu.pipeline import builder
 
     before = obs.metrics.snapshot()["counters"]
     start = time.perf_counter()
-    statistics = builder.PipelineBuilder(query).execute()
+    pb = builder.PipelineBuilder(query)
+    statistics = pb.execute()
     wall = time.perf_counter() - start
     after = obs.metrics.snapshot()["counters"]
     n_epochs = int(
         after.get("pipeline.epochs_loaded", 0.0)
         - before.get("pipeline.epochs_loaded", 0.0)
     )
-    return statistics, wall, n_epochs
+    stages = {
+        name: {k: round(v, 6) if isinstance(v, float) else v
+               for k, v in entry.items()}
+        for name, entry in pb.timers.as_dict().items()
+    }
+    return statistics, wall, n_epochs, stages
 
 
 def main(argv) -> dict:
     variant = argv[0]
     n_markers = int(argv[1]) if len(argv) > 1 else 240
     n_files = int(argv[2]) if len(argv) > 2 else 3
-    data_dir = cache_dir = None
+    data_dir = cache_dir = report_dir = None
     for arg in argv[3:]:
         if arg.startswith("--data-dir="):
             data_dir = arg.split("=", 1)[1]
         elif arg.startswith("--cache-dir="):
             cache_dir = arg.split("=", 1)[1]
+        elif arg.startswith("--report-dir="):
+            report_dir = arg.split("=", 1)[1]
         else:
             raise SystemExit(f"unknown argument {arg!r}")
     if variant not in (
@@ -167,6 +178,13 @@ def main(argv) -> dict:
     # hermetic-test default, and must point at the per-run directory
     os.environ.pop("EEG_TPU_NO_FEATURE_CACHE", None)
     os.environ["EEG_TPU_FEATURE_CACHE_DIR"] = cache_dir
+    # --report-dir: the timed run writes a run_report.json there
+    # (obs/report.py) so the smoke gate can cross-check the bench line
+    # against the report's own attribution. The populate child never
+    # inherits it (it must not overwrite the timed run's artifact).
+    os.environ.pop("EEG_TPU_RUN_REPORT_DIR", None)
+    if report_dir and variant != "populate":
+        os.environ["EEG_TPU_RUN_REPORT_DIR"] = report_dir
 
     if variant == "populate":
         run_query(build_query(info, fanout=False))
@@ -187,7 +205,7 @@ def main(argv) -> dict:
         )
 
     query = build_query(info, fanout=variant == "pipeline_e2e_fanout5")
-    statistics, wall, n_epochs = run_query(query)
+    statistics, wall, n_epochs, stages = run_query(query)
 
     import jax
 
@@ -212,6 +230,7 @@ def main(argv) -> dict:
             "hits": pstats["hits"], "misses": pstats["misses"],
         },
         "compile_cache": compile_cache.active_cache_dir(),
+        "stages": stages,
         "report_sha256": hashlib.sha256(
             str(statistics).encode()
         ).hexdigest(),
