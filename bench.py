@@ -34,6 +34,14 @@ train-step variants (tools/ingest_bench.py) with HBM-roofline context:
                   — the end-to-end numbers the kernel epochs/s lines
                   never captured, meaningful even on cpu_fallback
                   (the wins are host-side)
+  population_vmap / population_looped
+                  a 16-member population (cv=4 x a 2x2 lr/reg grid,
+                  models/population.py) trained as one vmapped
+                  program vs the same members dispatched sequentially;
+                  each line carries the stages breakdown (the train-
+                  stage delta is the engine's win) and the per-member
+                  accuracy table, with report_sha256 equality across
+                  the pair proving per-member statistics parity
 
 Resilience contract (round-1 BENCH artifact died rc=1 on a single
 ``Unable to initialize backend 'axon': UNAVAILABLE``): the parent
@@ -123,7 +131,7 @@ _VARIANT_TIMEOUTS = {
 # patience — on a warm compile cache everything fits easily; on a
 # cold cache the tail variants may be budget-skipped (recorded as
 # such, artifact intact). BENCH_TOTAL_BUDGET overrides.
-_N_VARIANTS = 14  # asserted against the variant tables below
+_N_VARIANTS = 16  # asserted against the variant tables below
 _TOTAL_BUDGET_S = int(
     os.environ.get(
         "BENCH_TOTAL_BUDGET",
@@ -180,6 +188,10 @@ _VARIANTS_TPU = {
     "pipeline_e2e_cold": (2000, 4),
     "pipeline_e2e_warm": (2000, 4),
     "pipeline_e2e_fanout5": (2000, 4),
+    # population training engine (markers per file, file count): 16
+    # SGD members as one vmapped program vs the same members looped
+    "population_vmap": (800, 2),
+    "population_looped": (800, 2),
 }
 _VARIANTS_CPU = {
     "einsum": (8192, 5),
@@ -196,6 +208,8 @@ _VARIANTS_CPU = {
     "pipeline_e2e_cold": (2000, 4),
     "pipeline_e2e_warm": (2000, 4),
     "pipeline_e2e_fanout5": (2000, 4),
+    "population_vmap": (800, 2),
+    "population_looped": (800, 2),
 }
 assert len(_VARIANTS_TPU) == len(_VARIANTS_CPU) == _N_VARIANTS
 
@@ -334,12 +348,13 @@ def _run_variant(variant: str, platform: str, n: int, iters: int) -> dict:
     err_f = tempfile.NamedTemporaryFile(
         mode="w+", suffix=f".{variant}.err", delete=False
     )
-    # pipeline_e2e_* time whole query runs (tools/pipeline_bench.py,
-    # where n/iters are markers-per-file/file-count); everything else
-    # is a kernel variant through tools/ingest_bench.py
+    # pipeline_e2e_* and population_* time whole query runs
+    # (tools/pipeline_bench.py, where n/iters are markers-per-file/
+    # file-count); everything else is a kernel variant through
+    # tools/ingest_bench.py
     script = (
         "pipeline_bench.py"
-        if variant.startswith("pipeline_e2e")
+        if variant.startswith(("pipeline_e2e", "population_"))
         else "ingest_bench.py"
     )
     try:
@@ -523,7 +538,7 @@ def _collect(platform: str) -> dict:
             for extra_field in (
                 "plan_cache", "compile_cache", "feature_cache",
                 "wall_s", "classifiers", "accuracy", "report_sha256",
-                "stages",
+                "stages", "population",
             ):
                 if extra_field in r:
                     variants[name][extra_field] = r[extra_field]

@@ -285,6 +285,24 @@ class Recording:
         return scaled32.astype(np.float64)
 
 
+def load_recording_bytes(
+    vhdr_bytes: bytes, vmrk_bytes: bytes, eeg_bytes: bytes
+) -> Recording:
+    """Build a :class:`Recording` from an already-read triplet.
+
+    The single-read seam: callers that need both the raw bytes (for a
+    content digest — io/feature_cache keys) and the parsed recording
+    read each file exactly once and hand the bytes here, instead of
+    digesting in one pass and re-reading in :func:`load_recording`.
+    Text decodes utf-8 with replacement, matching the FileSystem
+    protocol's ``read_text`` (io/sources.py), so both entry points
+    parse identical header/marker text.
+    """
+    header = parse_vhdr(vhdr_bytes.decode("utf-8", errors="replace"))
+    markers = parse_vmrk(vmrk_bytes.decode("utf-8", errors="replace"))
+    return _recording_from_blob(header, markers, eeg_bytes)
+
+
 def load_recording(
     eeg_path: str,
     vhdr_path: Optional[str] = None,
@@ -311,7 +329,12 @@ def load_recording(
     header = parse_vhdr(fs.read_text(vhdr_path))
     markers = parse_vmrk(fs.read_text(vmrk_path))
     blob = fs.read_bytes(eeg_path)
+    return _recording_from_blob(header, markers, blob)
 
+
+def _recording_from_blob(
+    header: Header, markers: List[Marker], blob: bytes
+) -> Recording:
     dtype = _BINARY_DTYPES.get(header.binary_format)
     if dtype is None:
         raise ValueError(f"Unsupported BinaryFormat: {header.binary_format}")

@@ -23,6 +23,7 @@ work — bit-identical output at any pool size. Input-contract parity:
 from __future__ import annotations
 
 import collections
+import dataclasses
 import hashlib
 import logging
 import os
@@ -102,6 +103,30 @@ def fused_extractor_id(wavelet_index: int) -> Tuple:
         defaults["skip_samples"],
         defaults["feature_size"],
     )
+
+
+@dataclasses.dataclass
+class PreparedRun:
+    """One read pass's products: the feature-cache key AND the parsed
+    recordings behind it.
+
+    Before this existed, a cold cache-enabled run paid a double read:
+    ``feature_cache_key`` streamed every triplet's bytes for the
+    content digest, then ``load_features_device`` re-read the same
+    files to parse them (documented in PR3's review round). The
+    provider now digests the bytes it parses — one physical read per
+    file — at the cost of holding the run's parsed recordings in
+    memory between the key lookup and the (miss-path) featurization.
+    On a cache HIT the parse work is wasted, but cheap: the sample
+    blob becomes a zero-copy ``np.frombuffer`` view (no scaling —
+    that happens at featurization, which a hit skips), so the
+    hit-path overhead over a pure digest pass is the vhdr/vmrk text
+    parse only. For multi-GB remote sessions where the byte residency
+    is unwanted, ``cache=false`` restores pure streaming.
+    """
+
+    key: str
+    recordings: List[Tuple[str, int, "brainvision.Recording"]]
 
 
 class OfflineDataProvider:
@@ -187,10 +212,38 @@ class OfflineDataProvider:
             return 1
         return min(self._workers, n_files)
 
+    def _read_recording(
+        self, eeg_path: str, digest: bool = False
+    ) -> Tuple[brainvision.Recording, Optional[str]]:
+        """Read ONE BrainVision triplet — one physical read per file —
+        and parse it; with ``digest``, the content digest (sha256 over
+        vhdr+vmrk+eeg bytes, the :meth:`content_digests` scheme) is
+        computed from those same bytes, which is what keeps a cold
+        cache-enabled run from reading every file twice. Reads land in
+        ``obs.metrics`` (``ingest.file_reads``) so the exactly-once
+        contract is observable."""
+        from .. import obs
+
+        base = os.path.splitext(eeg_path)[0]
+        triplet = (base + ".vhdr", base + ".vmrk", eeg_path)
+        for p in triplet:
+            if not self._fs.exists(p):
+                raise FileNotFoundError(f"No related file found: {p}")
+        blobs = [self._fs.read_bytes(p) for p in triplet]
+        obs.metrics.count("ingest.file_reads", len(blobs))
+        fingerprint = None
+        if digest:
+            h = hashlib.sha256()
+            for blob in blobs:
+                h.update(blob)
+            fingerprint = h.hexdigest()
+        return brainvision.load_recording_bytes(*blobs), fingerprint
+
     def _iter_recordings(
-        self, prefix: str, files: Dict[str, int]
-    ) -> Iterator[Tuple[str, int, brainvision.Recording]]:
-        """Yield ``(rel_path, guessed, recording)`` in ``files`` order.
+        self, prefix: str, files: Dict[str, int], with_digests: bool = False
+    ) -> Iterator[Tuple[str, int, brainvision.Recording, Optional[str]]]:
+        """Yield ``(rel_path, guessed, recording, digest)`` in
+        ``files`` order (``digest`` is None unless ``with_digests``).
 
         Parsing runs in a bounded thread pool (``workers`` in flight,
         ``prefetch_depth`` decoded results queued ahead), but results
@@ -213,13 +266,13 @@ class OfflineDataProvider:
                     # telemetry: one span per recording parse (no-op
                     # without an active recorder)
                     with events.span("ingest.parse", file=rel_path):
-                        rec = brainvision.load_recording(
-                            prefix + rel_path, filesystem=self._fs
+                        rec, fingerprint = self._read_recording(
+                            prefix + rel_path, digest=with_digests
                         )
                 except FileNotFoundError as e:
                     logger.warning("Did not load %s: %s", rel_path, e)
                     continue
-                yield rel_path, guessed, rec
+                yield rel_path, guessed, rec, fingerprint
             return
 
         from .. import obs
@@ -231,9 +284,7 @@ class OfflineDataProvider:
             # the recorder's run root (per-thread stacks keep the
             # consumer's span nesting uncorrupted)
             with events.span("ingest.parse", file=rel, pooled=True):
-                return brainvision.load_recording(
-                    path, filesystem=self._fs
-                )
+                return self._read_recording(path, digest=with_digests)
 
         depth = workers + self._prefetch_depth
         pool = ThreadPoolExecutor(
@@ -257,12 +308,12 @@ class OfflineDataProvider:
                     idx += 1
                 rel_path, guessed, fut = pending.popleft()
                 try:
-                    rec = fut.result()
+                    rec, fingerprint = fut.result()
                 except FileNotFoundError as e:
                     logger.warning("Did not load %s: %s", rel_path, e)
                     continue
                 obs.metrics.count("ingest.files_parsed")
-                yield rel_path, guessed, rec
+                yield rel_path, guessed, rec, fingerprint
         finally:
             # consumer stopped early or a parse failed: cancel queued
             # work and let in-flight parses finish on their own
@@ -274,10 +325,39 @@ class OfflineDataProvider:
         prefix, files = self._resolve_files()
         balance = extractor.BalanceState()
         batches: List[extractor.EpochBatch] = []
-        for _rel_path, guessed, rec in self._iter_recordings(prefix, files):
+        for _rel_path, guessed, rec, _ in self._iter_recordings(
+            prefix, files
+        ):
             batches.append(self._process_recording(rec, guessed, balance))
         self._batch = extractor.EpochBatch.concatenate(batches)
         return self._batch
+
+    def prepare_fused_run(self, extractor_id: Tuple) -> PreparedRun:
+        """One read pass producing BOTH the feature-cache key and the
+        parsed recordings: every triplet's bytes are read once,
+        digested for the content key, and parsed in the same worker
+        (``_read_recording``). The caller looks the key up first; on a
+        miss it hands ``recordings`` back to
+        :meth:`load_features_device`, which featurizes from memory
+        instead of re-reading — the PR3-review double-read, closed.
+        Missing-sibling files are skipped exactly as :meth:`load`
+        skips them, so the key still fingerprints the run that would
+        actually happen."""
+        prefix, files = self._resolve_files()
+        recordings: List[Tuple[str, int, brainvision.Recording]] = []
+        digests: List[Tuple[str, int, str]] = []
+        for rel_path, guessed, rec, fingerprint in self._iter_recordings(
+            prefix, files, with_digests=True
+        ):
+            recordings.append((rel_path, guessed, rec))
+            digests.append((rel_path, guessed, fingerprint))
+        from . import feature_cache
+
+        key = feature_cache.run_key(
+            digests, self._channel_names, self._pre, self._post,
+            extractor_id,
+        )
+        return PreparedRun(key=key, recordings=recordings)
 
     def content_digests(self) -> List[Tuple[str, int, str]]:
         """Ordered ``(rel_path, guessed, content digest)`` for every
@@ -318,6 +398,9 @@ class OfflineDataProvider:
         skip_samples: int = 175,
         feature_size: int = 16,
         backend: str = "xla",
+        recordings: Optional[
+            Sequence[Tuple[str, int, brainvision.Recording]]
+        ] = None,
     ):
         """TPU fast path: info.txt run -> DWT features without host epochs.
 
@@ -358,7 +441,20 @@ class OfflineDataProvider:
         # lowering error, an OOM) — the pipeline's degradation ladder
         # catches it and steps down a backend
         chaos.maybe_fire("ingest.fused")
-        prefix, files = self._resolve_files()
+        if recordings is None:
+            prefix, files = self._resolve_files()
+            source = (
+                (rel, guessed, rec)
+                for rel, guessed, rec, _ in self._iter_recordings(
+                    prefix, files
+                )
+            )
+        else:
+            # a PreparedRun (prepare_fused_run) already read + parsed
+            # this run's files for the cache key: featurize from
+            # memory — no second read, and a degradation-ladder retry
+            # on another backend re-reads nothing either
+            source = iter(recordings)
         balance = BalanceState()
         if backend == "pallas":
             import os
@@ -402,7 +498,7 @@ class OfflineDataProvider:
         # the ordered parallel parse: while this loop runs one file's
         # staging + fused program dispatch, the pool is already
         # parsing the next files' triplets on the host
-        for rel_path, guessed, rec in self._iter_recordings(prefix, files):
+        for rel_path, guessed, rec in source:
             raw, res, n_samples = device_ingest.stage_raw(
                 rec, self._channel_indices(rec)
             )

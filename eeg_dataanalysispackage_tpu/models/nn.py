@@ -420,6 +420,23 @@ class NeuralNetworkClassifier(base.Classifier):
 
     # -- training ------------------------------------------------------
 
+    def _parse_scalars(self) -> dict:
+        """The required DL4J scalar surface, parsed once — shared by
+        :meth:`_prepare_fit` and :meth:`population_fit` so the two
+        can never disagree about what a config means."""
+        return {
+            "seed": int(self._require("config_seed")),
+            "iterations": int(self._require("config_num_iterations")),
+            "lr": float(self._require("config_learning_rate")),
+            "momentum": float(self._require("config_momentum")),
+            "weight_init": self._require("config_weight_init"),
+            "updater_name": self._require("config_updater"),
+            "algo": self._require("config_optimization_algo").lower(),
+            # Boolean.parseBoolean semantics: "true" (any case) is true
+            "pretrain": self._require("config_pretrain").lower() == "true",
+            "backprop": self._require("config_backprop").lower() == "true",
+        }
+
     def _prepare_fit(self, features: np.ndarray, labels: np.ndarray):
         """The shared front half of training: config parsing, arch
         recording, param init, optimizer/loss construction, and
@@ -427,16 +444,14 @@ class NeuralNetworkClassifier(base.Classifier):
         backprop loop needs, so :meth:`fit` (monolithic scan) and
         :meth:`fit_elastic` (chunked resumable scan) start from the
         identical state."""
-        seed = int(self._require("config_seed"))
-        iterations = int(self._require("config_num_iterations"))
-        lr = float(self._require("config_learning_rate"))
-        momentum = float(self._require("config_momentum"))
-        weight_init = self._require("config_weight_init")
-        updater_name = self._require("config_updater")
-        algo = self._require("config_optimization_algo").lower()
-        # Boolean.parseBoolean semantics: "true" (any case) is true
-        pretrain = self._require("config_pretrain").lower() == "true"
-        backprop = self._require("config_backprop").lower() == "true"
+        c = self._parse_scalars()
+        seed, iterations, lr, momentum = (
+            c["seed"], c["iterations"], c["lr"], c["momentum"]
+        )
+        weight_init, updater_name, algo = (
+            c["weight_init"], c["updater_name"], c["algo"]
+        )
+        pretrain, backprop = c["pretrain"], c["backprop"]
         ltypes, n_outs, acts, drops = self._parse_layers()
 
         x = jnp.asarray(features, dtype=jnp.float32)
@@ -566,6 +581,78 @@ class NeuralNetworkClassifier(base.Classifier):
             probe_on_failure=probe_on_failure,
         )
         self.params = state["params"]
+
+    def population_fit(
+        self,
+        features: np.ndarray,
+        labels: np.ndarray,
+        seeds,
+        learning_rates,
+    ) -> list:
+        """Train P members — one per (init seed, learning rate) pair —
+        as ONE vmapped program (parallel/population.py), returning a
+        list of per-member param pytrees in member order. Each
+        member's trajectory is exactly what :meth:`fit` runs for
+        ``config_seed=seeds[i]`` / ``config_learning_rate=lrs[i]``:
+        same init streams, same dropout keys, same backprop scan body.
+
+        Raises ``PopulationVmapUnsupported`` for configs whose
+        training cannot batch onto a member axis — greedy pretraining
+        (a host-driven layer walk), value_fn-carrying optimizers
+        (lbfgs / line search), or ``backprop=false`` (nothing to
+        scan) — and the population orchestrator falls back to the
+        looped engine for those.
+        """
+        from ..parallel.population import (
+            PopulationVmapUnsupported, train_nn_population,
+        )
+
+        c = self._parse_scalars()
+        _, needs_value_fn = _optimizer(
+            c["algo"], c["updater_name"], c["lr"], c["momentum"]
+        )
+        if c["pretrain"]:
+            raise PopulationVmapUnsupported(
+                "greedy pretraining is a host-driven layer walk; "
+                "population members with config_pretrain=true train "
+                "looped"
+            )
+        if needs_value_fn:
+            raise PopulationVmapUnsupported(
+                f"optimization_algo={c['algo']} carries a value_fn "
+                "closure; population members train looped"
+            )
+        if not c["backprop"]:
+            raise PopulationVmapUnsupported(
+                "config_backprop=false leaves nothing to scan; "
+                "population members train looped"
+            )
+        ltypes, n_outs, acts, drops = self._parse_layers()
+        x = np.asarray(features, dtype=np.float32)
+        t = np.asarray(labels, dtype=np.float32)
+        y = np.stack([t, np.abs(1.0 - t)], axis=1)
+        self._arch = {
+            "layer_types": ltypes,
+            "n_outs": n_outs,
+            "activations": acts,
+            "dropouts": drops,
+            "weight_init": c["weight_init"],
+            "n_in": int(x.shape[-1]),
+        }
+        model = self._build()
+        loss = _loss_fn(self.config.get("config_loss_function", "mse"))
+        momentum = c["momentum"]
+        updater_name = c["updater_name"]
+
+        def make_optimizer(lr):
+            # lr may be a tracer carrying the member axis; every
+            # first-order optax updater scales by it trace-safely
+            return _updater(updater_name, lr, momentum)
+
+        return train_nn_population(
+            model, make_optimizer, loss, x, y,
+            seeds, learning_rates, c["iterations"],
+        )
 
     def _greedy_pretrain(
         self, model, params, x, ltypes, n_outs, acts, drops, weight_init,

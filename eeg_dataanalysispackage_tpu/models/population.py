@@ -1,0 +1,532 @@
+"""Population training: folds x seeds x hyperparameter grid, one program.
+
+The reference pipeline (and our ``train_clf=`` path) evaluates one
+model on one 70/30 split. The comparisons the paper's line of work
+actually runs — wavelet-NN classifiers (arXiv:1307.7897), DWT-feature
+seizure prediction (arXiv:2102.01647) — hinge on training *many*
+variants over the same 48-dim feature rows: cross-validation folds,
+seed ensembles, hyperparameter sweeps. This module is that workload's
+front end: a **population** is the cartesian expansion of
+
+    cross-validation folds (``cv=k``, k-fold or Monte-Carlo)
+  x init/sampling seeds   (``seeds=m`` — base seed, base+1, ...)
+  x a hyperparameter grid (``sweep=lr:0.1,0.03;reg:0.0,0.01``)
+
+trained by the stacked engines in ``parallel/population.py`` (one
+compile + one dispatch for all P members, ``jax.vmap`` over the member
+axis) or by the looped sequential twin (``population_mode=looped`` —
+the bench baseline and the fallback for members vmap cannot express).
+
+Fold semantics: ``cv=1`` IS the reference's seed-1 shuffle + 70/30
+split (not a degenerate 1-fold), so ``cv=1&seeds=1`` with no sweep
+reproduces the plain ``train_clf=`` run exactly. ``cv=k`` k-folds the
+seed-1 shuffled order into contiguous test blocks; ``cv_mode=mc``
+draws k independent shuffle+70/30 splits from seeds 1..k (seed 1
+first, so fold 0 is again the plain split).
+
+Per-member statistics come from the same ``test_features`` path the
+sequential runs use; ``models.stats.PopulationStatistics`` carries the
+per-member table plus the cross-member summary (best member, mean/std
+accuracy) that the run report and ``result_path`` embed.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import logging
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import stats
+from ..utils import java_compat
+
+logger = logging.getLogger(__name__)
+
+#: classifier names whose training is an SGD-family iteration scan —
+#: the ones the population engines can stack onto a member axis.
+#: Tree growers / oracles keep the sequential path (pipeline/builder).
+SGD_FAMILY = ("logreg", "svm", "nn")
+
+#: sweep axes the grammar accepts (lr = step size / learning rate,
+#: reg = L2 regularization — linear family only)
+_SWEEP_AXES = ("lr", "reg")
+
+_QUERY_KEYS = ("cv", "cv_mode", "seeds", "sweep", "population_mode")
+
+
+def parse_sweep(spec: str) -> Tuple[Tuple[str, Tuple[float, ...]], ...]:
+    """``lr:0.1,0.03;reg:0.0,0.01`` -> (("lr", (0.1, 0.03)), ...).
+
+    Axis order is the spec's order; duplicate axes and unknown axis
+    names are errors (a typo'd axis silently training the wrong grid
+    is the worst outcome).
+    """
+    axes: List[Tuple[str, Tuple[float, ...]]] = []
+    seen = set()
+    for part in spec.split(";"):
+        if not part:
+            continue
+        name, sep, values = part.partition(":")
+        name = name.strip()
+        if not sep or name not in _SWEEP_AXES:
+            raise ValueError(
+                f"sweep= axis must be one of {'/'.join(_SWEEP_AXES)} "
+                f"(axis:v1,v2;...), got {part!r}"
+            )
+        if name in seen:
+            raise ValueError(f"sweep= axis {name!r} given twice")
+        seen.add(name)
+        try:
+            vals = tuple(float(v) for v in values.split(",") if v != "")
+        except ValueError:
+            raise ValueError(
+                f"sweep= axis {name!r} has a non-numeric value in "
+                f"{values!r}"
+            )
+        if not vals:
+            raise ValueError(f"sweep= axis {name!r} has no values")
+        if len(set(vals)) != len(vals):
+            # duplicate grid points would train the same member twice
+            # and collide on the member label (last silently wins)
+            raise ValueError(
+                f"sweep= axis {name!r} repeats a value: {values!r}"
+            )
+        axes.append((name, vals))
+    return tuple(axes)
+
+
+@dataclasses.dataclass(frozen=True)
+class PopulationSpec:
+    """The population axes one pipeline run requested."""
+
+    cv: int = 1
+    cv_mode: str = "kfold"  # "kfold" | "mc" (Monte-Carlo splits)
+    seeds: int = 1
+    sweep: Tuple[Tuple[str, Tuple[float, ...]], ...] = ()
+    mode: str = "vmap"  # "vmap" | "looped"
+
+    @classmethod
+    def from_query_map(cls, query_map: Dict[str, str]) -> "PopulationSpec":
+        def _int(name, default):
+            value = query_map.get(name, "")
+            if not value:
+                return default
+            try:
+                return int(value)
+            except ValueError:
+                raise ValueError(
+                    f"query parameter {name}= must be an integer, "
+                    f"got {value!r}"
+                )
+
+        spec = cls(
+            cv=_int("cv", 1),
+            cv_mode=query_map.get("cv_mode", "") or "kfold",
+            seeds=_int("seeds", 1),
+            sweep=parse_sweep(query_map.get("sweep", "")),
+            mode=query_map.get("population_mode", "") or "vmap",
+        )
+        if spec.cv < 1:
+            raise ValueError("cv= must be >= 1")
+        if spec.seeds < 1:
+            raise ValueError("seeds= must be >= 1")
+        if spec.cv_mode not in ("kfold", "mc"):
+            raise ValueError(
+                f"cv_mode= must be kfold or mc, got {spec.cv_mode!r}"
+            )
+        if spec.mode not in ("vmap", "looped"):
+            raise ValueError(
+                f"population_mode= must be vmap or looped, "
+                f"got {spec.mode!r}"
+            )
+        return spec
+
+    @property
+    def active(self) -> bool:
+        """True when the run asked for more than the plain split's
+        single model — the builder routes SGD-family training through
+        the population engine iff this holds."""
+        return self.cv > 1 or self.seeds > 1 or bool(self.sweep)
+
+    def axis_values(self, axis: str) -> Optional[Tuple[float, ...]]:
+        for name, values in self.sweep:
+            if name == axis:
+                return values
+        return None
+
+    def grid_points(self) -> int:
+        points = 1
+        for _, values in self.sweep:
+            points *= len(values)
+        return points
+
+    def describe(self) -> Dict:
+        return {
+            "folds": self.cv,
+            "cv_mode": self.cv_mode if self.cv > 1 else "plain_split",
+            "seeds": self.seeds,
+            "grid": {name: list(values) for name, values in self.sweep},
+            "grid_points": self.grid_points(),
+        }
+
+
+def folds_for(spec: PopulationSpec, n: int) -> List[Tuple[List[int], List[int]]]:
+    """(train_idx, test_idx) per fold, indices into original row order.
+
+    ``cv=1``: the reference's seed-1 shuffle + 70/30 split — the plain
+    ``train_clf=`` fold. ``kfold``: contiguous test blocks over the
+    seed-1 shuffled permutation (every row tests exactly once).
+    ``mc``: ``cv`` independent shuffle+70/30 splits, seeds 1..cv.
+    """
+    def _as_fold(train, test):
+        # int arrays, not lists: population features may be a shared
+        # device buffer (the fan-out's one-transfer satellite), and
+        # jnp rejects list indexing
+        return (
+            np.asarray(train, dtype=np.int64),
+            np.asarray(test, dtype=np.int64),
+        )
+
+    if spec.cv <= 1:
+        return [_as_fold(*java_compat.train_test_split_indices(n, seed=1))]
+    if spec.cv > n:
+        raise ValueError(f"cv={spec.cv} exceeds the {n} available rows")
+    if spec.cv_mode == "mc":
+        return [
+            _as_fold(*java_compat.train_test_split_indices(n, seed=1 + i))
+            for i in range(spec.cv)
+        ]
+    perm = java_compat.java_shuffle_indices(n, seed=1)
+    k = spec.cv
+    bounds = [i * n // k for i in range(k + 1)]
+    return [
+        _as_fold(
+            perm[: bounds[i]] + perm[bounds[i + 1]:],
+            perm[bounds[i]: bounds[i + 1]],
+        )
+        for i in range(k)
+    ]
+
+
+@dataclasses.dataclass(frozen=True)
+class Member:
+    """One population member: a fold, a seed, and grid overrides
+    (None = the classifier config's base value)."""
+
+    fold: int
+    seed: int
+    lr: Optional[float] = None
+    reg: Optional[float] = None
+
+    @property
+    def label(self) -> str:
+        out = f"f{self.fold}.s{self.seed}"
+        if self.lr is not None:
+            out += f".lr{self.lr:g}"
+        if self.reg is not None:
+            out += f".reg{self.reg:g}"
+        return out
+
+
+def expand_members(
+    spec: PopulationSpec,
+    n_folds: int,
+    base_seed: int,
+    supports_reg: bool,
+    name: str = "",
+) -> List[Member]:
+    """The cartesian member list, fold-major then seed then grid —
+    the order every engine and every report preserves. Axes a family
+    cannot express collapse with a log line (the NN has no L2 ``reg``
+    hyperparameter; duplicating its members per reg point would train
+    the same model twice and report it as two)."""
+    lrs: Sequence[Optional[float]] = spec.axis_values("lr") or (None,)
+    regs: Sequence[Optional[float]] = spec.axis_values("reg") or (None,)
+    if not supports_reg and spec.axis_values("reg") is not None:
+        logger.warning(
+            "sweep axis reg does not apply to %s; collapsing %d grid "
+            "points onto the base config", name, len(regs),
+        )
+        regs = (None,)
+    return [
+        Member(fold=f, seed=base_seed + s, lr=lr, reg=reg)
+        for f in range(n_folds)
+        for s in range(spec.seeds)
+        for lr in lrs
+        for reg in regs
+    ]
+
+
+def _fold_masks(
+    members: Sequence[Member],
+    folds: Sequence[Tuple[List[int], List[int]]],
+    n: int,
+) -> np.ndarray:
+    """(P, n) float32 train-row masks — the multi-fold population's
+    uniform-shape formulation (``_run_sgd``'s ``sample_mask`` seam)."""
+    masks = np.zeros((len(members), n), dtype=np.float32)
+    for i, m in enumerate(members):
+        masks[i, folds[m.fold][0]] = 1.0
+    return masks
+
+
+def _null_stage(_name, **_attrs):
+    return contextlib.nullcontext()
+
+
+def run_population(
+    name: str,
+    make_classifier: Callable,
+    config: Dict[str, str],
+    features,
+    targets,
+    spec: PopulationSpec,
+    stage: Optional[Callable] = None,
+) -> Tuple[stats.PopulationStatistics, Dict]:
+    """Train + evaluate one classifier family's population.
+
+    Returns ``(PopulationStatistics, telemetry block)`` — the block is
+    what the run report embeds under ``population`` (member count,
+    axes shape, mode actually used, compiles recorded during training,
+    the per-member accuracy table).
+
+    ``stage`` is the pipeline builder's ``_stage`` context factory so
+    train/test wall time lands in the same StageTimer rows (and the
+    same ``stage.train``/``stage.test`` spans) the sequential paths
+    use; defaults to a no-op for library callers.
+    """
+    from .. import obs
+    from ..obs import events
+    from ..obs.report import CompilationMonitor
+    from ..parallel.population import PopulationVmapUnsupported
+
+    if name not in SGD_FAMILY:
+        raise ValueError(
+            f"population training supports the SGD family "
+            f"({', '.join(SGD_FAMILY)}); {name!r} trains one model "
+            f"per run"
+        )
+    stage = stage or _null_stage
+    targets = np.asarray(targets, dtype=np.float64)
+    n = len(targets)
+    folds = folds_for(spec, n)
+
+    template = make_classifier()
+    template.set_config(config)
+    linear = name in ("logreg", "svm")
+    if linear:
+        base_cfg = template._sgd_config()
+        base_seed = base_cfg.seed
+    else:
+        base_cfg = None
+        base_seed = int(template._require("config_seed"))
+    members = expand_members(
+        spec, len(folds), base_seed, supports_reg=linear, name=name
+    )
+    if linear and spec.seeds > 1 and base_cfg.mini_batch_fraction >= 1.0:
+        # zero-init full-batch SGD has no randomness: the seed only
+        # keys the Bernoulli minibatch sampler, so these seed members
+        # train identical models. Kept (the user asked for the axis,
+        # and the report shows the duplication honestly) but flagged.
+        logger.warning(
+            "seeds=%d is inert for full-batch %s (zero init, "
+            "mini_batch_fraction>=1): seed members will be identical; "
+            "set config_mini_batch_fraction<1 for a live seed axis",
+            spec.seeds, name,
+        )
+        obs.metrics.count("population.degenerate_seed_axis")
+
+    mode_used = spec.mode
+    comp = CompilationMonitor()
+    with comp, stage("train", classifier=name, population=len(members)), \
+            events.span(
+                f"population.{name}", classifier=name,
+                members=len(members), mode=spec.mode,
+            ):
+        if spec.mode == "vmap":
+            try:
+                trained = _train_vmapped(
+                    name, template, features, targets, folds, members,
+                    base_cfg,
+                )
+            except PopulationVmapUnsupported as e:
+                logger.warning(
+                    "population %s falls back to looped training: %s",
+                    name, e,
+                )
+                obs.metrics.count("population.fallback_looped")
+                mode_used = "looped"
+                trained = _train_looped(
+                    name, make_classifier, config, features, targets,
+                    folds, members, base_cfg, template,
+                )
+        else:
+            trained = _train_looped(
+                name, make_classifier, config, features, targets,
+                folds, members, base_cfg, template,
+            )
+    obs.metrics.count("population.members", len(members))
+    obs.metrics.count(f"population.{mode_used}")
+
+    result = stats.PopulationStatistics(
+        shape=spec.describe(), mode=mode_used
+    )
+    with stage("test", classifier=name, population=len(members)):
+        for m, state in zip(members, trained):
+            if linear:
+                template.weights = state
+                template.intercept = 0.0
+                template.margin_threshold = 0.0
+            else:
+                template.params = state
+            _, test_idx = folds[m.fold]
+            with events.span(
+                "population.member", classifier=name, member=m.label,
+                fold=m.fold, seed=m.seed,
+            ):
+                member_stats = template.test_features(
+                    features[test_idx], targets[test_idx]
+                )
+            result[m.label] = member_stats
+
+    snapshot = comp.snapshot()
+    block = {
+        "classifier": name,
+        "members": len(members),
+        "mode": mode_used,
+        "requested_mode": spec.mode,
+        "shape": spec.describe(),
+        "compiles": (
+            snapshot["compilations"] if snapshot["available"] else None
+        ),
+        "accuracy": {
+            label: round(s.calc_accuracy(), 6)
+            for label, s in result.items()
+        },
+        "summary": result.summary(),
+    }
+    return result, block
+
+
+def _train_vmapped(
+    name, template, features, targets, folds, members, base_cfg
+) -> List:
+    """All members in one stacked program (parallel/population.py)."""
+    from ..parallel import population as engines
+    from ..parallel.population import PopulationVmapUnsupported
+
+    if name in ("logreg", "svm"):
+        steps = [
+            m.lr if m.lr is not None else base_cfg.step_size
+            for m in members
+        ]
+        regs = [
+            m.reg if m.reg is not None else base_cfg.reg_param
+            for m in members
+        ]
+        seeds = [m.seed for m in members]
+        if len(folds) == 1:
+            # single-fold: gather the shared train rows once — the
+            # member invocation is then byte-for-byte the train_clf=
+            # invocation, just batched
+            train_idx = folds[0][0]
+            weights = engines.train_linear_population(
+                np.asarray(features)[train_idx], targets[train_idx],
+                base_cfg, steps, regs, seeds, masks=None,
+            )
+        else:
+            masks = _fold_masks(members, folds, len(targets))
+            weights = engines.train_linear_population(
+                features, targets, base_cfg, steps, regs, seeds,
+                masks=masks,
+            )
+        return list(weights)
+
+    # nn: the vmapped engine batches seeds x learning rates over ONE
+    # fold's gathered rows; a multi-fold NN population would need a
+    # masked loss, which the sequential fit has no equivalent of
+    if len(folds) > 1:
+        raise PopulationVmapUnsupported(
+            "multi-fold NN populations train looped (the vmapped NN "
+            "engine shares one gathered train matrix)"
+        )
+    train_idx = folds[0][0]
+    lrs = [
+        m.lr if m.lr is not None
+        else float(template._require("config_learning_rate"))
+        for m in members
+    ]
+    return template.population_fit(
+        np.asarray(features)[train_idx], targets[train_idx],
+        [m.seed for m in members], lrs,
+    )
+
+
+def _train_looped(
+    name, make_classifier, config, features, targets, folds, members,
+    base_cfg, template=None,
+) -> List:
+    """The sequential twin: per member, the same training program the
+    vmapped engine batches, dispatched one member at a time — the
+    bench's ``population_looped`` baseline and the vmap-unsupported
+    fallback. Single-fold linear members are exactly the
+    ``train_clf=`` invocation (gathered train rows); multi-fold
+    linear members run the mask formulation through
+    ``train_linear_population_looped`` so minibatch sample streams
+    (which key off the mask's row count) match the vmapped engine
+    member for member — gathering per fold here would draw different
+    Bernoulli masks and break the vmap==looped parity contract
+    whenever ``mini_batch_fraction < 1``."""
+    import dataclasses as dc
+
+    from . import sgd
+    from ..parallel import population as engines
+
+    trained = []
+    if name in ("logreg", "svm") and len(folds) > 1:
+        weights = engines.train_linear_population_looped(
+            features, targets, base_cfg,
+            [m.lr if m.lr is not None else base_cfg.step_size
+             for m in members],
+            [m.reg if m.reg is not None else base_cfg.reg_param
+             for m in members],
+            [m.seed for m in members],
+            _fold_masks(members, folds, len(targets)),
+        )
+        return list(weights)
+    for m in members:
+        train_idx, _ = folds[m.fold]
+        if name in ("logreg", "svm"):
+            cfg = dc.replace(
+                base_cfg,
+                step_size=(
+                    m.lr if m.lr is not None else base_cfg.step_size
+                ),
+                reg_param=(
+                    m.reg if m.reg is not None else base_cfg.reg_param
+                ),
+                seed=m.seed,
+            )
+            trained.append(
+                sgd.train_linear(
+                    np.asarray(features)[train_idx], targets[train_idx],
+                    cfg,
+                )
+            )
+        else:
+            clf = make_classifier()
+            member_config = dict(config)
+            member_config["config_seed"] = str(m.seed)
+            if m.lr is not None:
+                member_config["config_learning_rate"] = repr(m.lr)
+            clf.set_config(member_config)
+            clf.fit(np.asarray(features)[train_idx], targets[train_idx])
+            if template is not None and template._arch is None:
+                # the evaluation loop predicts through the template;
+                # looped NN training is the one path that never set
+                # its arch (population_fit and fit both do)
+                template._arch = clf._arch
+            trained.append(clf.params)
+    return trained

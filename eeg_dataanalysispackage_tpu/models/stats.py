@@ -122,6 +122,76 @@ class ClassificationStatistics:
         )
 
 
+class PopulationStatistics(dict):
+    """Ordered ``{member label: ClassificationStatistics}`` from a
+    population training run (models/population.py): the cartesian
+    expansion of cross-validation folds x init seeds x a hyperparameter
+    grid, trained as one stacked program (or its looped sequential
+    twin — same members, same statistics).
+
+    A plain dict like :class:`FanOutStatistics`, so callers index
+    per-member statistics directly (``stats["f0.s42"]``); ``shape``
+    records the population axes and ``mode`` whether the members
+    trained vmapped or looped. ``summary()`` is the cross-member
+    digest (best member, mean/std accuracy) the run report and the
+    ``result_path`` text both embed.
+    """
+
+    def __init__(self, shape: dict | None = None, mode: str = "vmap"):
+        super().__init__()
+        #: {"folds": k, "cv_mode": ..., "seeds": m, "grid": {...}}
+        self.shape = dict(shape or {})
+        #: "vmap" | "looped" — how the members actually trained
+        self.mode = mode
+
+    def summary(self) -> dict:
+        accs = {name: s.calc_accuracy() for name, s in self.items()}
+        finite = {
+            n: a for n, a in accs.items() if not math.isnan(a)
+        }
+        if not finite:
+            return {"members": len(self), "best": None,
+                    "best_accuracy": math.nan, "mean_accuracy": math.nan,
+                    "std_accuracy": math.nan}
+        # deterministic best: highest accuracy, first label on ties
+        best = max(sorted(finite), key=lambda n: finite[n])
+        values = np.array([finite[n] for n in sorted(finite)])
+        return {
+            "members": len(self),
+            "best": best,
+            "best_accuracy": float(finite[best]),
+            "mean_accuracy": float(values.mean()),
+            "std_accuracy": float(values.std()),
+        }
+
+    def calc_accuracy(self) -> float:
+        """The population's headline accuracy: its best member's —
+        what a hyperparameter sweep selects."""
+        return self.summary()["best_accuracy"]
+
+    def __str__(self) -> str:
+        # NOTE: deliberately mode-free. The vmapped engine and its
+        # looped twin must render byte-identical reports for the same
+        # member set — that equality (result_path text, the bench
+        # pair's report_sha256) IS the parity contract; the mode lives
+        # in the run report's population block.
+        s = self.summary()
+        header = (
+            f"population: {s['members']} members "
+            f"(folds={self.shape.get('folds', 1)} "
+            f"seeds={self.shape.get('seeds', 1)} "
+            f"grid={self.shape.get('grid_points', 1)})\n"
+            f"best member: {s['best']} "
+            f"(accuracy {s['best_accuracy'] * 100}%)\n"
+            f"mean accuracy: {s['mean_accuracy'] * 100}% "
+            f"(std {s['std_accuracy'] * 100}%)\n"
+        )
+        members = "\n".join(
+            f"member: {name}\n{stats}" for name, stats in self.items()
+        )
+        return header + "\n" + members
+
+
 class FanOutStatistics(dict):
     """Ordered ``{classifier name: ClassificationStatistics}`` from a
     ``classifiers=`` fan-out run (pipeline/builder.py).
@@ -129,7 +199,10 @@ class FanOutStatistics(dict):
     A plain dict, so callers index per-classifier statistics directly
     (``stats["svm"].calc_accuracy()``); ``str()`` renders the
     concatenated per-classifier reports in request order — the form
-    ``result_path`` persists.
+    ``result_path`` persists. When the run carried population axes
+    (``cv=``/``seeds=``/``sweep=``), SGD-family legs hold a
+    :class:`PopulationStatistics` instead of a single
+    ``ClassificationStatistics`` — ``str()`` composes either way.
     """
 
     def __str__(self) -> str:

@@ -188,6 +188,12 @@ class RunTelemetry:
         self.degradation: List[Dict[str, Any]] = []
         #: backend attribution: {"requested": ..., "landed": ...}
         self.backend: Dict[str, Any] = {}
+        #: population-training attribution (models/population.py):
+        #: member count, fold/seed/grid shape, mode, compiles
+        #: recorded, per-member accuracy — one block for train_clf=
+        #: populations, {"legs": {name: block}} for fan-out runs;
+        #: None when the run trained no population
+        self.population: Optional[Dict[str, Any]] = None
 
     @property
     def report_path(self) -> str:
@@ -225,6 +231,7 @@ class RunTelemetry:
             },
             "device": device,
             "backend": dict(self.backend),
+            "population": self.population,
             "degradation": list(self.degradation),
             "stages": timers.as_dict() if timers is not None else {},
             "metrics": metrics.snapshot() if metrics is not None else {},

@@ -1,0 +1,192 @@
+"""Stacked-population training programs: one compile, P members.
+
+The pipeline's ``classifiers=`` fan-out (PR 3) amortized *ingest*
+across models but still trained strictly one model at a time — the
+BENCH_pr3 ``pipeline_e2e_fanout5`` line pays one XLA dispatch (and,
+across processes, one compile) per member. This module is the
+canonical JAX answer for the SGD family: stack the population onto a
+leading axis with ``jax.vmap`` and train every member inside one
+jitted program. Dynamic axes (learning rate, L2 reg, seed, the
+fold's sample mask) ride as batched *array* inputs, so a new grid
+point or fold never retriggers a compile; static axes (iteration
+count, loss, architecture) are shared by construction.
+
+Two engines, both built on the exact per-member programs the
+sequential paths run — ``models/sgd._run_sgd`` and the shared
+backprop step ``models/nn._make_backprop_step`` — so a population
+member's trajectory is the sequential trajectory, just batched:
+
+- :func:`train_linear_population` — logreg/SVM (MLlib-SGD
+  semantics). Single-fold populations share one gathered train
+  matrix (bit-identical invocation to ``train_clf=``); multi-fold
+  populations keep the full feature matrix and carry one ``(n,)``
+  train mask per member (``_run_sgd``'s ``sample_mask`` seam, the
+  same mechanism mesh sharding uses for padding).
+- :func:`train_nn_population` — the flax/optax backprop loop, vmapped
+  over init seeds and learning rates. Init, dropout keys, and the
+  optimizer update all trace with the member axis; first-order
+  updaters only (L-BFGS/line-search carry value_fn closures, and
+  greedy pretraining is a host-driven walk — those members raise
+  :class:`PopulationVmapUnsupported` and the orchestrator falls back
+  to the looped path).
+
+Numerics: vmap batches the member matvecs into matmuls, which XLA may
+reduce in a different lane order — member weights agree with the
+sequential run to float32 roundoff (~1e-7 relative, measured), not
+bit-for-bit. Thresholded *predictions* (and therefore the confusion
+matrices behind ``ClassificationStatistics``) are pinned bit-identical
+to the sequential equivalents in tests/test_population.py; the margin
+safety band on real feature rows is ~3 orders of magnitude wider than
+the roundoff drift.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class PopulationVmapUnsupported(ValueError):
+    """This member set cannot train as one vmapped program (NN
+    pretraining, a value_fn-carrying optimizer, multi-fold NN);
+    callers degrade to the looped engine — same members, same
+    statistics, dispatches not amortized."""
+
+
+def train_linear_population(
+    features: np.ndarray,
+    labels: np.ndarray,
+    config,
+    step_sizes: Sequence[float],
+    reg_params: Sequence[float],
+    seeds: Sequence[int],
+    masks: Optional[np.ndarray],
+) -> np.ndarray:
+    """Train P MLlib-SGD members in one vmapped program.
+
+    ``features``/``labels`` are the shared rows: the gathered train
+    split when ``masks`` is None (single-fold population), else the
+    full matrix with ``masks`` ``(P, n)`` selecting each member's
+    train rows. ``config`` contributes the static/shared scalars
+    (iterations, loss, mini-batch fraction, convergence tol).
+    Returns ``(P, d)`` float32 weights, member order preserved.
+    """
+    from ..models import sgd
+
+    x = jnp.asarray(features, dtype=jnp.float32)
+    y = jnp.asarray(labels, dtype=jnp.float32)
+    full_batch = config.mini_batch_fraction >= 1.0
+    statics = dict(
+        num_iterations=int(config.num_iterations),
+        loss=config.loss,
+        full_batch=full_batch,
+    )
+    frac = float(config.mini_batch_fraction)
+    tol = float(config.convergence_tol)
+
+    def member(step, reg, seed, mask):
+        return sgd._run_sgd(
+            x, y, step, frac, reg, seed, tol,
+            sample_mask=mask, **statics,
+        )
+
+    steps_a = jnp.asarray(list(step_sizes), jnp.float32)
+    regs_a = jnp.asarray(list(reg_params), jnp.float32)
+    seeds_a = jnp.asarray(list(seeds), jnp.int32)
+    if masks is None:
+        masks_a = None
+        in_axes = (0, 0, 0, None)
+    else:
+        masks_a = jnp.asarray(masks, jnp.float32)
+        in_axes = (0, 0, 0, 0)
+    weights = jax.vmap(member, in_axes=in_axes)(
+        steps_a, regs_a, seeds_a, masks_a
+    )
+    return np.asarray(weights)
+
+
+def train_linear_population_looped(
+    features: np.ndarray,
+    labels: np.ndarray,
+    config,
+    step_sizes: Sequence[float],
+    reg_params: Sequence[float],
+    seeds: Sequence[int],
+    masks: Optional[np.ndarray],
+) -> np.ndarray:
+    """The sequential twin of :func:`train_linear_population`: the
+    identical per-member invocation, dispatched one member at a time
+    (the bench's ``population_looped`` baseline and the engine's
+    fallback). Scalars pass as Python weak types, exactly like
+    ``sgd.train_linear`` — a single-fold member here is bit-identical
+    to a ``train_clf=`` run with the same hyperparameters."""
+    from ..models import sgd
+
+    x = jnp.asarray(features, dtype=jnp.float32)
+    y = jnp.asarray(labels, dtype=jnp.float32)
+    statics = dict(
+        num_iterations=int(config.num_iterations),
+        loss=config.loss,
+        full_batch=config.mini_batch_fraction >= 1.0,
+    )
+    frac = float(config.mini_batch_fraction)
+    tol = float(config.convergence_tol)
+    out = []
+    for i in range(len(seeds)):
+        mask = None if masks is None else jnp.asarray(masks[i], jnp.float32)
+        out.append(
+            sgd._run_sgd(
+                x, y, float(step_sizes[i]), frac, float(reg_params[i]),
+                int(seeds[i]), tol, sample_mask=mask, **statics,
+            )
+        )
+    return np.asarray(jnp.stack(out))
+
+
+def train_nn_population(
+    model,
+    make_optimizer,
+    loss_fn,
+    features: np.ndarray,
+    onehot_labels: np.ndarray,
+    seeds: Sequence[int],
+    learning_rates: Sequence[float],
+    iterations: int,
+) -> List:
+    """Train P flax members in one vmapped program.
+
+    ``model`` is the configured ``models.nn._Net``; ``make_optimizer``
+    maps a (possibly traced) learning rate to a first-order optax
+    transformation; ``loss_fn`` the configured loss. Each member
+    inits from its own ``PRNGKey(seed)`` (init AND dropout stream,
+    matching ``fit``) and runs ``iterations`` steps of the shared
+    backprop scan body. Returns a list of P per-member param pytrees.
+    """
+    x = jnp.asarray(features, dtype=jnp.float32)
+    y = jnp.asarray(onehot_labels, dtype=jnp.float32)
+
+    from ..models.nn import _make_backprop_step
+
+    def member(seed, lr):
+        rng = jax.random.PRNGKey(seed)
+        params = model.init(
+            {"params": rng, "dropout": rng}, x[:1], train=False
+        )
+        tx = make_optimizer(lr)
+        opt_state = tx.init(params)
+        step = _make_backprop_step(model, tx, False, loss_fn, rng, x, y)
+        (params, _), _ = jax.lax.scan(
+            step, (params, opt_state), jnp.arange(int(iterations))
+        )
+        return params
+
+    seeds_a = jnp.asarray(list(seeds), jnp.int32)
+    lrs_a = jnp.asarray(list(learning_rates), jnp.float32)
+    stacked = jax.jit(jax.vmap(member))(seeds_a, lrs_a)
+    return [
+        jax.tree_util.tree_map(lambda a, i=i: a[i], stacked)
+        for i in range(len(seeds_a))
+    ]

@@ -66,10 +66,16 @@ class PipelineBuilder:
         # None = route by the input URI scheme (http/gs/file/local) in
         # the provider; an explicit filesystem overrides routing.
         self._fs = filesystem
-        #: ClassificationStatistics, or FanOutStatistics (a dict of
-        #: them, one per name) for classifiers= runs
+        #: ClassificationStatistics; FanOutStatistics (a dict of
+        #: them, one per name) for classifiers= runs; or
+        #: PopulationStatistics (per-member dict + summary) when
+        #: population axes (cv=/seeds=/sweep=) were requested
         self.statistics: Optional[
-            Union[stats.ClassificationStatistics, stats.FanOutStatistics]
+            Union[
+                stats.ClassificationStatistics,
+                stats.FanOutStatistics,
+                stats.PopulationStatistics,
+            ]
         ] = None
         #: per-stage wall times for the run (obs.StageTimer)
         self.timers = obs.StageTimer()
@@ -265,14 +271,22 @@ class PipelineBuilder:
                 else None
             )
             cache_key = None
+            prepared = None
             features = targets = None
             landed = None
             if cache is not None:
                 try:
+                    # ONE read pass: digests (for the content key) and
+                    # parsed recordings come from the same bytes
+                    # (provider.prepare_fused_run), so a cold
+                    # cache-enabled run no longer reads every file
+                    # twice; on a miss the ladder below featurizes the
+                    # already-parsed recordings from memory
                     with self._stage("ingest", phase="cache_lookup"):
-                        cache_key = odp.feature_cache_key(
+                        prepared = odp.prepare_fused_run(
                             provider.fused_extractor_id(wavelet_index)
                         )
+                        cache_key = prepared.key
                         hit = cache.lookup(cache_key)
                 except Exception as e:
                     # an unreadable input surfaces properly from the
@@ -282,7 +296,7 @@ class PipelineBuilder:
                         "feature cache unavailable (%s: %s); running "
                         "uncached", type(e).__name__, e,
                     )
-                    cache = cache_key = hit = None
+                    cache = cache_key = prepared = hit = None
                 if hit is not None:
                     features, targets = hit
                     landed = "cache"
@@ -311,7 +325,12 @@ class PipelineBuilder:
                 try:
                     with self._stage("ingest", backend=rung):
                         features, targets = odp.load_features_device(
-                            wavelet_index=wavelet_index, backend=rung
+                            wavelet_index=wavelet_index,
+                            backend=rung,
+                            recordings=(
+                                None if prepared is None
+                                else prepared.recordings
+                            ),
                         )
                     landed = rung
                     break
@@ -412,6 +431,30 @@ class PipelineBuilder:
         obs.metrics.count("pipeline.epochs_loaded", n)
 
         # 3. classifier (PipelineBuilder.java:151-284)
+        # population axes (models/population.py): cv=/cv_mode=/seeds=/
+        # sweep= expand SGD-family training into a member population
+        # trained as one vmapped program (population_mode=looped runs
+        # the sequential twin — the bench baseline)
+        from ..models import population
+
+        pop_spec = population.PopulationSpec.from_query_map(query_map)
+        if pop_spec.active:
+            if "load_clf" in query_map:
+                raise ValueError(
+                    "population axes (cv=/seeds=/sweep=) train models; "
+                    "they cannot combine with load_clf="
+                )
+            if query_map.get("save_clf") == "true":
+                raise ValueError(
+                    "population runs train many members; save_clf= "
+                    "has no single model to persist"
+                )
+            if query_map.get("elastic") == "true":
+                raise ValueError(
+                    "population training does not support elastic=true; "
+                    "the stacked program has no per-member checkpoints"
+                )
+
         if "classifiers" in query_map:
             # shared-feature fan-out: the expensive-to-produce feature
             # matrix is computed once (above) and every requested
@@ -423,6 +466,23 @@ class PipelineBuilder:
             statistics = self._execute_fanout(
                 query_map,
                 n,
+                features=features if fused else None,
+                targets=targets if fused else None,
+                batch=None if fused else batch,
+                fe=fe,
+                pop_spec=pop_spec,
+            )
+
+        elif "train_clf" in query_map and pop_spec.active:
+            name = query_map["train_clf"]
+            if name not in population.SGD_FAMILY:
+                raise ValueError(
+                    "population axes (cv=/seeds=/sweep=) apply to the "
+                    f"SGD family ({', '.join(population.SGD_FAMILY)}); "
+                    f"{name!r} trains one model per run"
+                )
+            statistics = self._execute_population(
+                query_map, name, pop_spec,
                 features=features if fused else None,
                 targets=targets if fused else None,
                 batch=None if fused else batch,
@@ -539,10 +599,54 @@ class PipelineBuilder:
         self.statistics = statistics
         return statistics
 
+    # -- population training -------------------------------------------
+
+    def _host_features(self, batch, fe):
+        """The host path's full feature matrix: one extraction pass
+        over the whole epoch batch (per-epoch independent, so slicing
+        rows afterwards equals extracting the slices)."""
+        with self._stage("features"):
+            features = np.asarray(
+                fe.extract_batch(np.asarray(batch.epochs, np.float64))
+            )
+        return features, np.asarray(batch.targets, dtype=np.float64)
+
+    def _execute_population(
+        self, query_map, name, pop_spec, features, targets, batch, fe
+    ) -> stats.PopulationStatistics:
+        """``train_clf=<sgd-family>`` with population axes: the member
+        set (folds x seeds x grid) trains through
+        ``models.population.run_population`` — one vmapped program by
+        default — and the run reports per-member statistics plus the
+        cross-member summary."""
+        from ..models import population
+
+        if features is None:
+            features, targets = self._host_features(batch, fe)
+        config = {
+            k: v for k, v in query_map.items() if k.startswith("config_")
+        }
+        statistics, block = population.run_population(
+            name,
+            lambda: clf_registry.create(name),
+            config,
+            features,
+            targets,
+            pop_spec,
+            stage=self._stage,
+        )
+        if self.telemetry is not None:
+            self.telemetry.population = block
+        logger.info(
+            "trained population %s: %d members (%s)",
+            name, block["members"], block["mode"],
+        )
+        return statistics
+
     # -- shared-feature fan-out ----------------------------------------
 
     def _execute_fanout(
-        self, query_map, n, features, targets, batch, fe
+        self, query_map, n, features, targets, batch, fe, pop_spec=None
     ) -> stats.FanOutStatistics:
         """``classifiers=a,b,c``: train + test every named classifier
         against the one feature matrix this run already produced.
@@ -576,36 +680,86 @@ class PipelineBuilder:
                 "classifiers= requires a comma-separated classifier list"
             )
 
+        from ..models import population
+
         if features is None:
-            # host path: one extraction pass over the whole epoch
-            # batch (per-epoch independent, so slicing rows afterwards
-            # equals extracting the slices)
-            with self._stage("features"):
-                features = np.asarray(
-                    fe.extract_batch(np.asarray(batch.epochs, np.float64))
-                )
-            targets = np.asarray(batch.targets, dtype=np.float64)
+            features, targets = self._host_features(batch, fe)
 
         train_idx, test_idx = java_compat.train_test_split_indices(n, seed=1)
+        # the split rows are gathered ONCE and shared by every plain
+        # leg (the old loop re-gathered per leg) ...
+        x_train, x_test = features[train_idx], features[test_idx]
+        y_train, y_test = targets[train_idx], targets[test_idx]
+        x_train_sgd, x_test_sgd = x_train, x_test
+        if getattr(features, "dtype", None) == np.float32:
+            # ... and for the fused float32 path, the SGD-family legs
+            # (which all consume jnp float32) additionally share ONE
+            # staged device buffer: their own jnp.asarray() becomes a
+            # no-op instead of a fresh host->device transfer per leg.
+            # Tree legs keep the numpy slices — handing them device
+            # arrays would turn every numpy op into a tiny compiled
+            # transfer program (measured: +16 XLA compiles on
+            # fanout5) for no gain. Values are bit-identical either
+            # way, pinned by the fanout-vs-single parity tests. The
+            # host float64 path stays numpy throughout: jnp would
+            # downcast it to f32 and change host-path statistics.
+            import jax.numpy as jnp
+
+            x_train_sgd = jnp.asarray(x_train)
+            x_test_sgd = jnp.asarray(x_test)
+
         config = {
             k: v for k, v in query_map.items() if k.startswith("config_")
         }
+        pop_blocks = {}
         statistics = stats.FanOutStatistics()
         for name in names:
             # each fan-out leg is one span (fanout.<name>) wrapping its
             # train+test stages, so a run report separates the shared
             # featurization from the per-classifier cost
             with events.span(f"fanout.{name}", classifier=name):
+                if (
+                    pop_spec is not None
+                    and pop_spec.active
+                    and name in population.SGD_FAMILY
+                ):
+                    # SGD-family legs expand into the population; the
+                    # member axes don't apply to tree growers, whose
+                    # legs keep the sequential plain-split path below
+                    leg_stats, block = population.run_population(
+                        name,
+                        lambda name=name: clf_registry.create(name),
+                        config,
+                        features,
+                        targets,
+                        pop_spec,
+                        stage=self._stage,
+                    )
+                    pop_blocks[name] = block
+                    statistics[name] = leg_stats
+                    obs.metrics.count("pipeline.fanout.classifiers")
+                    continue
+                if pop_spec is not None and pop_spec.active:
+                    logger.warning(
+                        "population axes do not apply to %s; the leg "
+                        "trains once on the plain split", name,
+                    )
+                    obs.metrics.count("population.sequential_legs")
                 classifier = clf_registry.create(name)
                 classifier.set_config(config)
+                sgd_leg = name in population.SGD_FAMILY
                 with self._stage("train", classifier=name):
-                    classifier.fit(features[train_idx], targets[train_idx])
+                    classifier.fit(
+                        x_train_sgd if sgd_leg else x_train, y_train
+                    )
                 logger.info("trained %s", name)
                 with self._stage("test", classifier=name):
                     statistics[name] = classifier.test_features(
-                        features[test_idx], targets[test_idx]
+                        x_test_sgd if sgd_leg else x_test, y_test
                     )
             obs.metrics.count("pipeline.fanout.classifiers")
+        if pop_blocks and self.telemetry is not None:
+            self.telemetry.population = {"legs": pop_blocks}
         return statistics
 
     @staticmethod

@@ -238,6 +238,60 @@ def test_collect_propagates_cache_attribution_fields(monkeypatch):
     assert v["compile_cache"] == "/tmp/cc"
 
 
+def test_population_variants_in_both_tables():
+    """The population pair (ISSUE 5) rides every bench artifact, on
+    TPU and on the CPU fallback, through the pipeline_bench child."""
+    for table in (bench._VARIANTS_TPU, bench._VARIANTS_CPU):
+        assert "population_vmap" in table
+        assert "population_looped" in table
+        # the pair must measure the SAME synthetic session
+        assert table["population_vmap"] == table["population_looped"]
+
+
+def test_collect_propagates_population_field(monkeypatch):
+    """A population line's member table and summary must survive the
+    parent's field whitelist into the published artifact — the
+    vmapped-vs-looped comparison is only auditable from the artifact
+    if both lines carry their stages and population blocks."""
+    pop = {
+        "members": 16,
+        "mode": "vmap",
+        "summary": {"best": "f0.s42.lr1", "best_accuracy": 0.5},
+    }
+    monkeypatch.setattr(
+        bench, "_VARIANTS_CPU",
+        {"einsum": (8, 2), "population_vmap": (800, 2)},
+    )
+    monkeypatch.setattr(
+        bench,
+        "_run_variant",
+        lambda name, platform, n, iters: {
+            "epochs_per_s": 1.0,
+            "bytes_per_epoch": 12000,
+            "n": n,
+            "wall_s": 1.0,
+            "stages": {"train": {"seconds": 0.5, "count": 1}},
+            "report_sha256": "abc",
+            **({"population": pop} if name.startswith("population") else {}),
+        },
+    )
+    v = bench._collect("cpu_fallback")["variants"]["population_vmap"]
+    assert v["population"] == pop
+    assert v["stages"]["train"]["seconds"] == 0.5
+    assert v["report_sha256"] == "abc"
+
+
+def test_pipeline_bench_routes_population_variants():
+    """bench._run_variant must hand population_* to the pipeline
+    child (they time whole query runs), not the kernel bench."""
+    import inspect
+
+    src = inspect.getsource(bench._run_variant)
+    assert '"pipeline_e2e", "population_"' in src or (
+        "population_" in src and "pipeline_bench.py" in src
+    )
+
+
 def test_probe_respects_lock_before_touching_the_tunnel(
     sweep_root, monkeypatch
 ):

@@ -1,11 +1,13 @@
 """The end-to-end pipeline smoke gate (tools/e2e_smoke.py), wired as
 a slow-marked test so tier-1 stays fast while CI can run the full
-cold -> warm -> fan-out ladder. The gates: warm-cache faster than
-cold, cache hit/miss attribution correct, cached-vs-uncached and
-fan-out-vs-single statistics bit-identical, fan-out amortized — and
-every timed run must produce a well-formed ``run_report.json``
-(obs/report.py schema, nonzero stage spans, cache attribution
-matching the bench line)."""
+cold -> warm -> fan-out -> population ladder. The gates: warm-cache
+faster than cold, cache hit/miss attribution correct,
+cached-vs-uncached and fan-out-vs-single statistics bit-identical,
+fan-out amortized, fan-out compiling fewer programs than 5x single,
+the 16-member vmapped population beating its looped twin's train
+stage with byte-identical statistics — and every timed run must
+produce a well-formed ``run_report.json`` (obs/report.py schema,
+nonzero stage spans, cache attribution matching the bench line)."""
 
 import json
 import os
@@ -35,7 +37,10 @@ def test_e2e_smoke_trio():
     summary = json.loads(proc.stdout.strip().splitlines()[-1])
     assert summary["ok"], summary["failures"]
     assert summary["warm_speedup"] > 1.0
-    # the run-report gate ran for all three variants, and the stage
+    # the run-report gate ran for all five variants, and the stage
     # breakdown rode along on the bench lines
-    assert summary["reports_checked"] == 3
+    assert summary["reports_checked"] == 5
     assert summary["cold_stages"]["ingest"] > 0
+    # the population engine's headline: vmapped members trained
+    # faster than the looped twin, on identical statistics
+    assert summary["population_train_speedup"] > 1.0

@@ -1,0 +1,399 @@
+"""Population training engine (models/population.py + the vmapped
+programs in parallel/population.py + pipeline wiring).
+
+The ISSUE-5 contracts:
+
+- ``cv=1&seeds=1`` (no sweep) is statistics-identical to the plain
+  ``train_clf=`` split — the population engine is a strict
+  generalization, not a new code path with new numerics;
+- every member of a single-fold population is statistics-identical to
+  the sequential ``train_clf=`` run with that member's
+  hyperparameters (per-member bit-parity vs sequential runs);
+- the vmapped engine and its looped twin produce byte-identical
+  per-member statistics for the same member set (multi-fold included);
+- sweep axes are DYNAMIC: new grid values retrigger zero compiles;
+- a chaos plan and a population coexist (faults= clamps cleanly and
+  the run stays deterministic);
+- cold cache-enabled runs read each recording file exactly once (the
+  PR3-review double-read, eliminated);
+- the run report carries the population block and population.member
+  spans.
+
+Hermetic throughout (tests/_synthetic.py).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import _synthetic
+from eeg_dataanalysispackage_tpu.io import feature_cache, sources
+from eeg_dataanalysispackage_tpu.models import population, stats
+from eeg_dataanalysispackage_tpu.pipeline import builder
+from eeg_dataanalysispackage_tpu.utils import java_compat
+
+
+def _session(directory, n_files=2, n_markers=50):
+    lines = []
+    for i in range(n_files):
+        name = f"synth_{i:02d}"
+        guessed = 2 + i
+        _synthetic.write_recording(
+            str(directory), name=name, n_markers=n_markers,
+            guessed=guessed, seed=i,
+        )
+        lines.append(f"{name}.eeg {guessed}")
+    info = os.path.join(str(directory), "info.txt")
+    with open(info, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return info
+
+
+@pytest.fixture(scope="module")
+def info(tmp_path_factory):
+    return _session(tmp_path_factory.mktemp("pop_session"))
+
+
+_LINEAR_CONFIG = (
+    "config_num_iterations=12&config_step_size=1.0"
+    "&config_mini_batch_fraction=1.0&config_reg_param=0.01"
+)
+
+_NN_CONFIG = (
+    "config_seed=7&config_num_iterations=8&config_learning_rate=0.1"
+    "&config_momentum=0.9&config_weight_init=xavier"
+    "&config_updater=nesterovs"
+    "&config_optimization_algo=stochastic_gradient_descent"
+    "&config_pretrain=false&config_backprop=true"
+    "&config_loss_function=xent"
+    "&config_layer1_layer_type=dense&config_layer1_n_out=6"
+    "&config_layer1_drop_out=0.0"
+    "&config_layer1_activation_function=relu"
+    "&config_layer2_layer_type=output&config_layer2_n_out=2"
+    "&config_layer2_drop_out=0.0"
+    "&config_layer2_activation_function=softmax"
+)
+
+
+def _q(info, *parts):
+    return "&".join([f"info_file={info}", "fe=dwt-8-fused", *parts])
+
+
+def _run(query):
+    return builder.PipelineBuilder(query).execute()
+
+
+# ------------------------------------------------ parity contracts
+
+
+def test_cv1_seeds1_statistics_identical_to_plain_split(info):
+    plain = _run(_q(info, "train_clf=logreg", _LINEAR_CONFIG))
+    pop = _run(
+        _q(info, "train_clf=logreg", _LINEAR_CONFIG, "cv=1", "seeds=1",
+           "sweep=lr:1.0")
+    )
+    assert isinstance(pop, stats.PopulationStatistics)
+    assert list(pop) == ["f0.s42.lr1"]
+    assert str(pop["f0.s42.lr1"]) == str(plain)
+
+
+def test_members_bit_parity_vs_sequential_train_clf_runs(info):
+    """Every single-fold member == the train_clf= run with that
+    member's hyperparameters (svm: the one linear classifier whose
+    config surface exposes the reg axis)."""
+    pop = _run(
+        _q(info, "train_clf=svm", _LINEAR_CONFIG,
+           "sweep=lr:1.0,0.5;reg:0.0,0.01")
+    )
+    assert len(pop) == 4
+    for lr in (1.0, 0.5):
+        for reg in (0.0, 0.01):
+            label = f"f0.s42.lr{lr:g}.reg{reg:g}"
+            sequential = _run(
+                _q(
+                    info, "train_clf=svm",
+                    "config_num_iterations=12",
+                    f"config_step_size={lr}",
+                    "config_mini_batch_fraction=1.0",
+                    f"config_reg_param={reg}",
+                )
+            )
+            assert str(pop[label]) == str(sequential), label
+
+
+def test_vmapped_equals_looped_multi_fold(info):
+    base = _q(info, "train_clf=logreg", _LINEAR_CONFIG, "cv=3",
+              "seeds=2", "sweep=lr:1.0,0.5")
+    vm = _run(base)
+    lo = _run(base + "&population_mode=looped")
+    assert vm.mode == "vmap" and lo.mode == "looped"
+    assert list(vm) == list(lo)
+    assert len(vm) == 12  # 3 folds x 2 seeds x 2 lr points
+    for label in vm:
+        assert str(vm[label]) == str(lo[label]), label
+    # the rendered report (the result_path artifact) is byte-equal:
+    # mode is deliberately absent from the text
+    assert str(vm) == str(lo)
+
+
+def test_vmapped_equals_looped_multi_fold_minibatch(info):
+    """mini_batch_fraction < 1 makes the seed axis LIVE (per-member
+    Bernoulli sample streams). Both engines must draw the streams
+    from the same mask-shaped formulation — a row-gathering looped
+    path would draw different masks and silently break parity (the
+    review finding this pins)."""
+    base = _q(
+        info, "train_clf=logreg", "config_num_iterations=12",
+        "config_step_size=1.0", "config_mini_batch_fraction=0.5",
+        "cv=2", "seeds=2",
+    )
+    vm = _run(base)
+    lo = _run(base + "&population_mode=looped")
+    assert list(vm) == list(lo) and len(vm) == 4
+    for label in vm:
+        assert str(vm[label]) == str(lo[label]), label
+    # the live seed axis really produces distinct members per fold
+    assert str(vm["f0.s42"]) != str(vm["f0.s43"]) or str(
+        vm["f1.s42"]
+    ) != str(vm["f1.s43"])
+
+
+def test_nn_population_vmap_equals_looped(info):
+    base = _q(info, "train_clf=nn", _NN_CONFIG, "seeds=2",
+              "sweep=lr:0.1,0.05")
+    vm = _run(base)
+    lo = _run(base + "&population_mode=looped")
+    assert vm.mode == "vmap" and lo.mode == "looped"
+    assert list(vm) == list(lo)
+    assert len(vm) == 4
+    for label in vm:
+        assert str(vm[label]) == str(lo[label]), label
+
+
+def test_nn_multi_fold_falls_back_to_looped(info):
+    pop = _run(_q(info, "train_clf=nn", _NN_CONFIG, "cv=2"))
+    assert pop.mode == "looped"  # vmap requested, fallback recorded
+    assert len(pop) == 2
+
+
+# ------------------------------------------------ fold semantics
+
+
+def test_kfold_partitions_every_row_once():
+    spec = population.PopulationSpec(cv=4)
+    folds = population.folds_for(spec, 103)
+    seen = np.concatenate([test for _, test in folds])
+    assert sorted(seen.tolist()) == list(range(103))
+    for train, test in folds:
+        assert len(np.intersect1d(train, test)) == 0
+        assert len(train) + len(test) == 103
+
+
+def test_mc_fold0_is_the_plain_split():
+    spec = population.PopulationSpec(cv=3, cv_mode="mc")
+    folds = population.folds_for(spec, 40)
+    train, test = java_compat.train_test_split_indices(40, seed=1)
+    assert folds[0][0].tolist() == train
+    assert folds[0][1].tolist() == test
+    assert len(folds) == 3
+
+
+def test_cv_larger_than_rows_is_an_error():
+    with pytest.raises(ValueError, match="exceeds"):
+        population.folds_for(population.PopulationSpec(cv=9), 5)
+
+
+# ------------------------------------------------ compile behavior
+
+
+def test_sweep_values_do_not_retrigger_compiles():
+    """The grid axes are dynamic member-axis inputs: after one
+    vmapped run, a second run with DIFFERENT lr/reg values (same
+    cardinality) must compile nothing new."""
+    from eeg_dataanalysispackage_tpu.models import linear
+    from eeg_dataanalysispackage_tpu.obs.report import CompilationMonitor
+
+    rng = np.random.RandomState(0)
+    features = rng.randn(90, 48).astype(np.float32)
+    targets = (rng.rand(90) > 0.5).astype(np.float64)
+
+    def run(lr_a, lr_b, reg):
+        spec = population.PopulationSpec(
+            cv=2, seeds=2,
+            sweep=(("lr", (lr_a, lr_b)), ("reg", (reg,))),
+        )
+        result, block = population.run_population(
+            "logreg", linear.LogisticRegressionClassifier, {},
+            features, targets, spec,
+        )
+        return result, block
+
+    run(1.0, 0.5, 0.0)  # warms the member-shape programs
+    with CompilationMonitor() as monitor:
+        result, block = run(0.9, 0.25, 0.015)
+    snap = monitor.snapshot()
+    if snap["available"]:
+        assert snap["compilations"] == 0, snap
+    assert len(result) == 8
+    assert block["members"] == 8 and block["mode"] == "vmap"
+
+
+# ------------------------------------------------ chaos coexistence
+
+
+def test_population_coexists_with_chaos_plan(info):
+    """A fault plan (which clamps the ingest pool for deterministic
+    replay) plus a population run: the degradation ladder absorbs the
+    injected fused failure and the member statistics stay
+    deterministic across identical runs."""
+    from eeg_dataanalysispackage_tpu import obs
+
+    q = _q(
+        info, "train_clf=logreg", _LINEAR_CONFIG, "cv=2", "seeds=2",
+        "faults=ingest.fused:once@1", "cache=false",
+    )
+    before = obs.metrics.snapshot()["counters"].get(
+        "pipeline.degraded", 0.0
+    )
+    a = _run(q)
+    after = obs.metrics.snapshot()["counters"].get(
+        "pipeline.degraded", 0.0
+    )
+    assert after > before  # the injected failure really degraded a rung
+    b = _run(q)
+    assert str(a) == str(b)
+    assert len(a) == 4
+
+
+# ------------------------------------------------ pipeline wiring
+
+
+def test_population_rejects_conflicts(info):
+    for extra, match in (
+        (("train_clf=logreg", "cv=2", "elastic=true",
+          "checkpoint_path=/tmp/x"), "elastic"),
+        (("train_clf=logreg", "cv=2", "save_clf=true",
+          "save_name=/tmp/x"), "save_clf"),
+        (("load_clf=logreg", "load_name=/tmp/x", "cv=2"), "load_clf"),
+        (("train_clf=dt", "cv=2"), "SGD family"),
+    ):
+        with pytest.raises(ValueError, match=match):
+            _run(_q(info, _LINEAR_CONFIG, *extra))
+
+
+def test_population_param_validation(info):
+    for extra, match in (
+        (("sweep=momentum:0.9",), "sweep= axis"),
+        (("sweep=lr:0.1;lr:0.2",), "twice"),
+        (("sweep=lr:abc",), "non-numeric"),
+        (("sweep=lr:0.5,0.5",), "repeats"),
+        (("cv_mode=bogus", "cv=2"), "cv_mode"),
+        (("population_mode=turbo", "cv=2"), "population_mode"),
+        (("cv=0",), "cv="),
+    ):
+        with pytest.raises(ValueError, match=match):
+            _run(_q(info, "train_clf=logreg", _LINEAR_CONFIG, *extra))
+
+
+def test_fanout_routes_sgd_legs_through_population(info, tmp_path):
+    report_dir = tmp_path / "report"
+    fan = _run(
+        _q(info, "classifiers=logreg,dt", _LINEAR_CONFIG, "cv=2",
+           "config_max_bins=16", "config_impurity=gini",
+           "config_max_depth=4", "config_min_instances_per_node=1",
+           f"report={report_dir}")
+    )
+    assert isinstance(fan["logreg"], stats.PopulationStatistics)
+    assert len(fan["logreg"]) == 2
+    assert isinstance(fan["dt"], stats.ClassificationStatistics)
+    report = json.loads((report_dir / "run_report.json").read_text())
+    legs = report["population"]["legs"]
+    assert set(legs) == {"logreg"}
+    assert legs["logreg"]["members"] == 2
+
+
+def test_run_report_population_block_and_member_spans(info, tmp_path):
+    report_dir = tmp_path / "report"
+    pop = _run(
+        _q(info, "train_clf=logreg", _LINEAR_CONFIG, "cv=2", "seeds=2",
+           f"report={report_dir}")
+    )
+    report = json.loads((report_dir / "run_report.json").read_text())
+    block = report["population"]
+    assert block["members"] == 4 == len(pop)
+    assert block["mode"] == "vmap"
+    assert block["shape"]["folds"] == 2
+    assert len(block["accuracy"]) == 4
+    assert block["summary"]["best"] in block["accuracy"]
+    by_name = report["spans"]["by_name"]
+    assert by_name["population.member"]["count"] == 4
+    assert by_name["population.logreg"]["count"] == 1
+
+
+def test_population_result_path_text(info, tmp_path):
+    result_path = tmp_path / "out.txt"
+    pop = _run(
+        _q(info, "train_clf=logreg", _LINEAR_CONFIG, "cv=2",
+           f"result_path={result_path}")
+    )
+    text = result_path.read_text()
+    assert text == str(pop) + "\n"
+    assert text.startswith("population: 2 members")
+    assert "best member:" in text and "member: f1.s42" in text
+
+
+# ------------------------------------------------ single-read contract
+
+
+class _CountingFS(sources.LocalFileSystem):
+    def __init__(self):
+        self.reads = {}
+
+    def _note(self, path):
+        self.reads[path] = self.reads.get(path, 0) + 1
+
+    def read_bytes(self, path):
+        self._note(path)
+        return super().read_bytes(path)
+
+    def read_text(self, path):
+        self._note(path)
+        return super().read_text(path)
+
+
+def test_cold_cache_run_reads_each_file_exactly_once(
+    tmp_path, monkeypatch
+):
+    """The acceptance criterion: digest + parse share one physical
+    read per file on a cold cache-enabled run (and the warm run's
+    digest pass reads once too), with bit-identical statistics."""
+    from eeg_dataanalysispackage_tpu import obs
+
+    monkeypatch.delenv(feature_cache.ENV_DISABLE, raising=False)
+    monkeypatch.setenv(feature_cache.ENV_DIR, str(tmp_path / "fcache"))
+    feature_cache.reset_stats()
+    info = _session(tmp_path, n_files=2, n_markers=30)
+    q = _q(info, "train_clf=logreg", _LINEAR_CONFIG)
+
+    fs = _CountingFS()
+    before = obs.metrics.snapshot()["counters"].get(
+        "ingest.file_reads", 0.0
+    )
+    cold = builder.PipelineBuilder(q, filesystem=fs).execute()
+    multi = {p: c for p, c in fs.reads.items() if c != 1}
+    assert not multi, f"files read more than once on a cold run: {multi}"
+    # 2 recordings x (vhdr, vmrk, eeg) + info.txt
+    assert len(fs.reads) == 7
+    after = obs.metrics.snapshot()["counters"].get(
+        "ingest.file_reads", 0.0
+    )
+    assert after - before == 6  # the metric counts triplet file reads
+
+    fs_warm = _CountingFS()
+    warm = builder.PipelineBuilder(q, filesystem=fs_warm).execute()
+    multi = {p: c for p, c in fs_warm.reads.items() if c != 1}
+    assert not multi, f"files read more than once on a warm run: {multi}"
+    assert feature_cache.stats()["hits"] >= 1
+    assert str(cold) == str(warm)

@@ -15,8 +15,20 @@ performance contract holds:
 - the 5-classifier fan-out's logreg statistics match the
   single-classifier run's exactly (shared features must not perturb
   any individual classifier);
-- fan-out wall time stays under 3x the single-classifier cold run
-  (ingest+featurization amortized across the five classifiers);
+- fan-out wall time beats running its five classifiers as five
+  single-classifier pipelines (the five singles are measured, not
+  proxied — the old 3x-logreg-cold heuristic got flakier the warmer
+  the machine, because the nn leg's fixed compile cost doesn't
+  shrink with the page cache the way ingest does);
+- the fan-out run compiles FEWER XLA programs than running its five
+  classifiers as five single-classifier pipelines (the run reports'
+  compile counters: fanout < sum of the five singles — the shared
+  feature buffer / one-ingest contract, ISSUE-5 satellite);
+- the 16-member population pair (population_vmap vs
+  population_looped, tools/pipeline_bench.py): the vmapped engine's
+  train stage is FASTER than the looped twin's, the two runs'
+  ClassificationStatistics are byte-identical (report_sha256
+  equality — per-member parity), and both trained all 16 members;
 - every timed run wrote a well-formed ``run_report.json``
   (obs/report.py schema): nonzero stage spans for ingest/train/test,
   a span summary that actually recorded the stage spans, and
@@ -43,13 +55,13 @@ _PIPELINE_BENCH = os.path.join(_REPO, "tools", "pipeline_bench.py")
 
 def _run_variant(variant: str, n_markers: int, n_files: int,
                  data_dir: str, cache_dir: str,
-                 report_dir: str) -> dict:
+                 report_dir: str, extra: list = ()) -> dict:
     proc = subprocess.run(
         [
             sys.executable, _PIPELINE_BENCH, variant,
             str(n_markers), str(n_files),
             f"--data-dir={data_dir}", f"--cache-dir={cache_dir}",
-            f"--report-dir={report_dir}",
+            f"--report-dir={report_dir}", *extra,
         ],
         capture_output=True,
         text=True,
@@ -67,26 +79,27 @@ _REQUIRED_STAGES = ("ingest", "train", "test")
 
 
 def _check_report(tag: str, bench_line: dict, report_dir: str,
-                  failures: list, checked: list) -> None:
+                  failures: list, checked: list) -> dict:
     """The run-report half of the gate: the artifact exists, parses,
     matches the schema, recorded nonzero stage spans, and agrees with
-    the bench line's cache attribution."""
+    the bench line's cache attribution. Returns the parsed report (or
+    {}) so cross-run gates (the fan-out compile counter) can read it."""
     checked.append(tag)
     path = os.path.join(report_dir, "run_report.json")
     if not os.path.exists(path):
         failures.append(f"{tag}: no run_report.json in {report_dir}")
-        return
+        return {}
     try:
         with open(path) as f:
             report = json.load(f)
     except ValueError as e:
         failures.append(f"{tag}: run_report.json unparseable: {e}")
-        return
+        return {}
     if report.get("schema") != "eeg-tpu-run-report/v1":
         failures.append(
             f"{tag}: bad report schema {report.get('schema')!r}"
         )
-        return
+        return {}
     stages = report.get("stages", {})
     for stage in _REQUIRED_STAGES:
         if stages.get(stage, {}).get("seconds", 0.0) <= 0.0:
@@ -118,6 +131,7 @@ def _check_report(tag: str, bench_line: dict, report_dir: str,
             )
     if report.get("outcome") != "ok":
         failures.append(f"{tag}: outcome {report.get('outcome')!r}")
+    return report
 
 
 def run(n_markers: int = 2000, n_files: int = 4) -> dict:
@@ -127,7 +141,7 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
         data_dir = os.path.join(tmp, "data")
         report_dirs = {
             v: os.path.join(tmp, f"report_{v}")
-            for v in ("cold", "warm", "fanout")
+            for v in ("cold", "warm", "fanout", "pop_vmap", "pop_looped")
         }
         cold = _run_variant(
             "pipeline_e2e_cold", n_markers, n_files,
@@ -144,15 +158,57 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
             data_dir, os.path.join(tmp, "cache_fanout"),
             report_dirs["fanout"],
         )
-        _check_report(
+        # the other four legs as their OWN single-classifier cold
+        # runs (fresh process, fresh cache): their reports' compile
+        # counters are the honest "5x single" side of the fan-out
+        # compile-sharing gate — legs are heterogeneous, so 5x the
+        # logreg count would understate what five full runs cost
+        single_compiles = {}
+        single_walls = {}
+        for leg in ("svm", "dt", "rf", "nn"):
+            leg_report_dir = os.path.join(tmp, f"report_single_{leg}")
+            leg_line = _run_variant(
+                "pipeline_e2e_cold", n_markers, n_files,
+                data_dir, os.path.join(tmp, f"cache_single_{leg}"),
+                leg_report_dir, extra=[f"--train-clf={leg}"],
+            )
+            single_walls[leg] = leg_line["wall_s"]
+            try:
+                with open(
+                    os.path.join(leg_report_dir, "run_report.json")
+                ) as f:
+                    single_compiles[leg] = (
+                        json.load(f).get("xla") or {}
+                    ).get("compilations", 0)
+            except (OSError, ValueError):
+                single_compiles[leg] = 0
+        pop_vmap = _run_variant(
+            "population_vmap", n_markers, n_files,
+            data_dir, os.path.join(tmp, "cache_pop"),
+            report_dirs["pop_vmap"],
+        )
+        pop_looped = _run_variant(
+            "population_looped", n_markers, n_files,
+            data_dir, os.path.join(tmp, "cache_pop"),
+            report_dirs["pop_looped"],
+        )
+        cold_report = _check_report(
             "cold", cold, report_dirs["cold"], failures, reports_checked
         )
         _check_report(
             "warm", warm, report_dirs["warm"], failures, reports_checked
         )
-        _check_report(
+        fanout_report = _check_report(
             "fanout", fanout, report_dirs["fanout"], failures,
             reports_checked,
+        )
+        _check_report(
+            "pop_vmap", pop_vmap, report_dirs["pop_vmap"], failures,
+            reports_checked,
+        )
+        _check_report(
+            "pop_looped", pop_looped, report_dirs["pop_looped"],
+            failures, reports_checked,
         )
 
     if not warm["wall_s"] < cold["wall_s"]:
@@ -186,11 +242,63 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
         failures.append(
             f"fan-out did not report 5 classifiers: {fanout.get('accuracy')}"
         )
-    if not fanout["wall_s"] < 3 * cold["wall_s"]:
+    # fan-out amortization, measured against the real alternative:
+    # the five classifiers run as five single-classifier pipelines
+    # (each its own fresh cold process, like the fan-out's)
+    single_walls["logreg"] = cold["wall_s"]
+    singles_wall_sum = round(sum(single_walls.values()), 3)
+    if not fanout["wall_s"] < singles_wall_sum:
         failures.append(
-            f"fan-out not amortized: {fanout['wall_s']}s vs 3x cold "
-            f"{cold['wall_s']}s"
+            f"fan-out not amortized: {fanout['wall_s']}s vs its five "
+            f"singles combined {singles_wall_sum}s ({single_walls})"
         )
+
+    # compile sharing (ISSUE-5 satellite): the fan-out run — five
+    # classifiers against ONE staged feature buffer and one ingest
+    # pass — must compile fewer XLA programs than running its five
+    # classifiers as five single-classifier pipelines
+    single_compiles["logreg"] = (
+        cold_report.get("xla") or {}
+    ).get("compilations", 0)
+    c_singles_sum = sum(single_compiles.values())
+    c_fanout = (fanout_report.get("xla") or {}).get("compilations", 0)
+    if all(single_compiles.values()) and c_fanout:
+        if not c_fanout < c_singles_sum:
+            failures.append(
+                f"fan-out compiled {c_fanout} programs, not fewer than "
+                f"its five singles combined ({c_singles_sum}: "
+                f"{single_compiles})"
+            )
+    else:
+        failures.append(
+            f"compile counters missing from reports: "
+            f"singles={single_compiles} fanout={c_fanout}"
+        )
+
+    # population engine gates: the vmapped 16-member program must beat
+    # the looped twin's train stage, with byte-identical statistics
+    pv_train = pop_vmap.get("stages", {}).get("train", {}).get(
+        "seconds", 0.0
+    )
+    pl_train = pop_looped.get("stages", {}).get("train", {}).get(
+        "seconds", 0.0
+    )
+    if not (pv_train > 0.0 and pv_train < pl_train):
+        failures.append(
+            f"vmapped population train stage not faster than looped: "
+            f"{pv_train}s vs {pl_train}s"
+        )
+    if pop_vmap["report_sha256"] != pop_looped["report_sha256"]:
+        failures.append(
+            "vmapped vs looped population statistics drifted: "
+            f"{pop_vmap['report_sha256']} vs {pop_looped['report_sha256']}"
+        )
+    for tag, line in (("vmap", pop_vmap), ("looped", pop_looped)):
+        members = (line.get("population") or {}).get("members")
+        if members != 16:
+            failures.append(
+                f"population_{tag} trained {members} members, not 16"
+            )
 
     return {
         "ok": not failures,
@@ -200,8 +308,20 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
         "fanout5_wall_s": fanout["wall_s"],
         "warm_speedup": round(cold["wall_s"] / warm["wall_s"], 2),
         "fanout_vs_cold": round(fanout["wall_s"] / cold["wall_s"], 2),
+        "singles_wall_sum_s": singles_wall_sum,
+        "fanout_vs_singles": round(
+            fanout["wall_s"] / singles_wall_sum, 2
+        ),
         "warm_feature_cache": warm["feature_cache"],
         "cold_feature_cache": cold["feature_cache"],
+        "population_vmap_train_s": pv_train,
+        "population_looped_train_s": pl_train,
+        "population_train_speedup": (
+            round(pl_train / pv_train, 2) if pv_train > 0 else None
+        ),
+        "compilations_singles": single_compiles,
+        "compilations_singles_sum": c_singles_sum,
+        "compilations_fanout5": c_fanout,
         "reports_checked": len(reports_checked),
         "cold_stages": {
             k: v["seconds"] for k, v in cold.get("stages", {}).items()

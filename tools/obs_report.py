@@ -57,6 +57,45 @@ def _fmt_stage_table(stages: dict) -> list:
     return out
 
 
+def _fmt_population(block: dict, leg: str = "") -> list:
+    """One population block (obs/report.py ``population`` field):
+    the axes line, the cross-member summary, and the per-member
+    accuracy table sorted best-first."""
+    shape = block.get("shape", {})
+    summary = block.get("summary", {})
+    tag = f"{leg or block.get('classifier', '?')}"
+    out = [
+        f"  {tag}: {block.get('members')} members  "
+        f"(folds={shape.get('folds')} {shape.get('cv_mode')} "
+        f"seeds={shape.get('seeds')} grid={shape.get('grid_points')})  "
+        f"mode={block.get('mode')}"
+        + (
+            f" (requested {block['requested_mode']})"
+            if block.get("requested_mode") not in (None, block.get("mode"))
+            else ""
+        )
+        + (
+            f"  compiles={block['compiles']}"
+            if block.get("compiles") is not None
+            else ""
+        )
+    ]
+    if summary:
+        out.append(
+            f"    best {summary.get('best')} "
+            f"acc={summary.get('best_accuracy')}  "
+            f"mean={summary.get('mean_accuracy')}  "
+            f"std={summary.get('std_accuracy')}"
+        )
+    accuracy = block.get("accuracy") or {}
+    if accuracy:
+        width = max(len(k) for k in accuracy)
+        ranked = sorted(accuracy.items(), key=lambda kv: (-kv[1], kv[0]))
+        for member, acc in ranked:
+            out.append(f"    {member:<{width}}  {acc}")
+    return out
+
+
 def _top_counters(metrics: dict, n: int = 12) -> list:
     counters = (metrics or {}).get("counters", {})
     rows = sorted(counters.items(), key=lambda kv: (-kv[1], kv[0]))[:n]
@@ -88,6 +127,14 @@ def show(path: str) -> None:
     if crash:
         err = data.get("error", {})
         print(f"\nerror: {err.get('type')}: {err.get('message')}")
+    pop = data.get("population")
+    if pop:
+        print("\npopulation:")
+        # train_clf= runs carry one block; fan-out runs one per leg
+        blocks = pop.get("legs", {"": pop}) if isinstance(pop, dict) else {}
+        for leg, block in blocks.items():
+            for line in _fmt_population(block, leg):
+                print(line)
     deg = data.get("degradation") or []
     if deg:
         print("\ndegradation history:")
@@ -154,6 +201,23 @@ def diff(path_a: str, path_b: str) -> None:
     ba, bb = a.get("backend") or {}, b.get("backend") or {}
     if ba != bb:
         print(f"backend: A {ba}  B {bb}")
+
+    def _pop_digest(report):
+        pop = report.get("population")
+        if not pop:
+            return None
+        blocks = pop.get("legs", {"": pop})
+        return {
+            leg or blk.get("classifier", "?"): (
+                blk.get("members"), blk.get("mode"),
+                (blk.get("summary") or {}).get("best_accuracy"),
+            )
+            for leg, blk in blocks.items()
+        }
+
+    pa, pb = _pop_digest(a), _pop_digest(b)
+    if (pa or pb) and pa != pb:
+        print(f"population (members, mode, best acc): A {pa}  B {pb}")
     da, db = a.get("degradation") or [], b.get("degradation") or []
     if len(da) != len(db):
         print(f"degradation steps: A {len(da)}  B {len(db)}")
