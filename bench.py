@@ -42,6 +42,13 @@ train-step variants (tools/ingest_bench.py) with HBM-roofline context:
                   stage delta is the engine's win) and the per-member
                   accuracy table, with report_sha256 equality across
                   the pair proving per-member statistics parity
+  serve_bench     the resident online inference service (serve/):
+                  p50/p99 latency and sustained predictions/sec at
+                  swept concurrency through the micro-batching front
+                  end, with the served-vs-batch parity pin, the
+                  admission-control shed probe, and a chaos soak
+                  (serve.request/serve.batch faults) all recorded in
+                  the line's ``serve`` block (tools/serve_bench.py)
 
 Resilience contract (round-1 BENCH artifact died rc=1 on a single
 ``Unable to initialize backend 'axon': UNAVAILABLE``): the parent
@@ -131,7 +138,7 @@ _VARIANT_TIMEOUTS = {
 # patience — on a warm compile cache everything fits easily; on a
 # cold cache the tail variants may be budget-skipped (recorded as
 # such, artifact intact). BENCH_TOTAL_BUDGET overrides.
-_N_VARIANTS = 16  # asserted against the variant tables below
+_N_VARIANTS = 17  # asserted against the variant tables below
 _TOTAL_BUDGET_S = int(
     os.environ.get(
         "BENCH_TOTAL_BUDGET",
@@ -192,6 +199,9 @@ _VARIANTS_TPU = {
     # SGD members as one vmapped program vs the same members looped
     "population_vmap": (800, 2),
     "population_looped": (800, 2),
+    # online inference service (markers per file, file count):
+    # latency/throughput sweep + parity pin + chaos soak
+    "serve_bench": (2000, 2),
 }
 _VARIANTS_CPU = {
     "einsum": (8192, 5),
@@ -210,6 +220,7 @@ _VARIANTS_CPU = {
     "pipeline_e2e_fanout5": (2000, 4),
     "population_vmap": (800, 2),
     "population_looped": (800, 2),
+    "serve_bench": (400, 2),
 }
 assert len(_VARIANTS_TPU) == len(_VARIANTS_CPU) == _N_VARIANTS
 
@@ -350,13 +361,15 @@ def _run_variant(variant: str, platform: str, n: int, iters: int) -> dict:
     )
     # pipeline_e2e_* and population_* time whole query runs
     # (tools/pipeline_bench.py, where n/iters are markers-per-file/
-    # file-count); everything else is a kernel variant through
-    # tools/ingest_bench.py
-    script = (
-        "pipeline_bench.py"
-        if variant.startswith(("pipeline_e2e", "population_"))
-        else "ingest_bench.py"
-    )
+    # file-count); serve_bench drives the resident inference service
+    # (tools/serve_bench.py, same n/iters meaning); everything else
+    # is a kernel variant through tools/ingest_bench.py
+    if variant.startswith(("pipeline_e2e", "population_")):
+        script = "pipeline_bench.py"
+    elif variant.startswith("serve_"):
+        script = "serve_bench.py"
+    else:
+        script = "ingest_bench.py"
     try:
         proc = subprocess.Popen(
             [
@@ -538,7 +551,7 @@ def _collect(platform: str) -> dict:
             for extra_field in (
                 "plan_cache", "compile_cache", "feature_cache",
                 "wall_s", "classifiers", "accuracy", "report_sha256",
-                "stages", "population",
+                "stages", "population", "serve",
             ):
                 if extra_field in r:
                     variants[name][extra_field] = r[extra_field]

@@ -39,13 +39,39 @@ from flax import serialization
 _STEP_RE = re.compile(r"^step_(\d{8})$")
 
 
+def _fsync_directory(directory: str) -> None:
+    """fsync a directory fd, making a just-completed rename durable.
+
+    Without it the data blocks are safe (the file fd was fsynced) but
+    the *directory entry* may still live only in the page cache: a
+    power loss right after a "successful" atomic write could replay as
+    a zero-length (or missing) artifact. Best-effort — some platforms
+    and filesystems refuse O_RDONLY directory fds; those callers keep
+    the old (weaker) guarantee rather than failing the write.
+    """
+    try:
+        fd = os.open(directory, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 def atomic_write_bytes(path: str, data: bytes) -> None:
     """Crash-safe small-file write: tmp sibling + ``os.replace``.
 
     The byte-level form of the checkpoint store's tmp-then-rename
     discipline, for single-file artifacts (run reports, metrics
     dumps): a crash mid-write leaves the previous content (or
-    nothing), never a truncated file.
+    nothing), never a truncated file. The full durability recipe:
+    fsync the tmp file (data blocks on disk), rename into place, then
+    fsync the directory (the rename itself on disk) — so a crash
+    right after this function returns can no longer surface the
+    artifact as a zero-length file.
     """
     directory = os.path.dirname(path) or "."
     tmp = os.path.join(
@@ -57,6 +83,7 @@ def atomic_write_bytes(path: str, data: bytes) -> None:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
+        _fsync_directory(directory)
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)

@@ -1,0 +1,112 @@
+"""Per-call deadline budgets, threadable through nested I/O layers.
+
+The serving layer (``serve/``) promises every request a bounded
+lifetime: a request admitted with a 2 s deadline must resolve —
+answer, shed, or deadline-exceeded with evidence — within that budget,
+no matter how many retry ladders fire beneath it. The retry machinery
+in :mod:`io.remote` bounds ONE request's cost, but it sleeps through
+its backoff schedule blind to how much time the *caller* has left: a
+request with 50 ms remaining would happily sleep 4 s before its next
+attempt. This module is the missing currency — a monotonic-clock
+:class:`Deadline` plus a thread-local ambient scope, so a layer that
+never heard of serving (an HTTP chunk fetch three frames down) can
+still ask "can I afford this sleep?" before taking it.
+
+Design follows the :mod:`obs.chaos` pattern: installing a scope is a
+context manager, reading it is one thread-local lookup, and code that
+runs outside any scope pays a None-check. Deadlines nest — an inner
+scope may only shrink the budget (the effective deadline is the
+tightest enclosing one), mirroring how gRPC propagates deadlines.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Iterator, Optional
+
+
+class DeadlineExceededError(TimeoutError):
+    """The caller's deadline budget is spent.
+
+    Subclasses ``TimeoutError`` (an ``OSError``), so I/O layers that
+    already treat timeouts as I/O failures handle it unchanged.
+    """
+
+
+class Deadline:
+    """An absolute expiry on the monotonic clock.
+
+    ``clock`` is injectable so tests drive expiry without sleeping.
+    """
+
+    __slots__ = ("_expiry", "_clock", "budget_s")
+
+    def __init__(self, budget_s: float, clock=time.monotonic):
+        self.budget_s = float(budget_s)
+        self._clock = clock
+        self._expiry = clock() + float(budget_s)
+
+    @classmethod
+    def after(cls, budget_s: float, clock=time.monotonic) -> "Deadline":
+        return cls(budget_s, clock=clock)
+
+    def remaining(self) -> float:
+        """Seconds left; never negative."""
+        return max(0.0, self._expiry - self._clock())
+
+    @property
+    def expired(self) -> bool:
+        return self._clock() >= self._expiry
+
+    def can_cover(self, seconds: float) -> bool:
+        """Whether the remaining budget covers ``seconds`` of work —
+        the question a retry loop asks before committing to a backoff
+        sleep it could never wake from in time."""
+        return self.remaining() >= seconds
+
+    def raise_if_expired(self, what: str = "operation") -> None:
+        if self.expired:
+            raise DeadlineExceededError(
+                f"{what}: deadline exceeded "
+                f"(budget {self.budget_s:.3f}s spent)"
+            )
+
+    def __repr__(self) -> str:
+        return (
+            f"Deadline(budget={self.budget_s:.3f}s, "
+            f"remaining={self.remaining():.3f}s)"
+        )
+
+
+_LOCAL = threading.local()
+
+
+def active_deadline() -> Optional[Deadline]:
+    """The calling thread's tightest enclosing deadline, or None."""
+    stack = getattr(_LOCAL, "stack", None)
+    if not stack:
+        return None
+    # nesting only shrinks: the tightest (earliest-expiring) enclosing
+    # deadline governs, whatever order the scopes were opened in
+    return min(stack, key=lambda d: d.remaining())
+
+
+@contextlib.contextmanager
+def deadline_scope(deadline: Optional[Deadline]) -> Iterator[Optional[Deadline]]:
+    """Install ``deadline`` as the calling thread's ambient budget for
+    the block. ``None`` is accepted and is a no-op, so call sites can
+    thread an optional deadline without branching."""
+    if deadline is None:
+        yield None
+        return
+    stack = getattr(_LOCAL, "stack", None)
+    if stack is None:
+        stack = []
+        _LOCAL.stack = stack
+    stack.append(deadline)
+    try:
+        yield deadline
+    finally:
+        stack.pop()

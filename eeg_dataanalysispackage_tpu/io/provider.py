@@ -391,6 +391,38 @@ class OfflineDataProvider:
     # Reference-compatible alias (OffLineDataProvider.loadData).
     load_data = load
 
+    def iter_recordings(self) -> Iterator[Tuple[str, int, "brainvision.Recording"]]:
+        """Public ordered recording stream: ``(rel_path, guessed,
+        recording)`` per resolvable file, parsed through the same
+        bounded pool + order-preserving merge as :meth:`load` — the
+        seam the serving layer (serve/pipeline.py) uses to turn a
+        session into per-epoch requests without re-implementing input
+        handling."""
+        prefix, files = self._resolve_files()
+        for rel, guessed, rec, _ in self._iter_recordings(prefix, files):
+            yield rel, guessed, rec
+
+    @property
+    def pre(self) -> int:
+        """Prestimulus window samples (epoch geometry)."""
+        return self._pre
+
+    @property
+    def post(self) -> int:
+        """Poststimulus window samples (epoch geometry)."""
+        return self._post
+
+    @property
+    def n_channels(self) -> int:
+        """Selected channel count (the feature row's channel axis)."""
+        return len(self._channel_names)
+
+    def channel_indices_for(self, rec: "brainvision.Recording"):
+        """Resolved channel indices for one recording, including the
+        reference's stale-index reuse quirk (:meth:`_channel_indices`);
+        public for the serving layer."""
+        return self._channel_indices(rec)
+
     def load_features_device(
         self,
         wavelet_index: int = 8,

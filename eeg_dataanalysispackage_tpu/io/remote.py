@@ -36,6 +36,7 @@ from typing import Optional, Tuple
 
 from ..obs import chaos, events
 from . import circuit
+from . import deadline as deadline_mod
 
 logger = logging.getLogger(__name__)
 
@@ -56,6 +57,13 @@ class RetryPolicy:
     recovering endpoint spread out instead of synchronizing their
     backoff waves into periodic thundering herds. The default stays
     deterministic so tests and chaos runs replay exactly.
+
+    The budget is additionally **deadline-aware**: when the calling
+    thread carries an ambient :class:`io.deadline.Deadline` (a serving
+    request's budget, installed via ``deadline_scope``), the retry
+    ladder stops — raising with the attempt history — as soon as the
+    remaining budget cannot cover the next backoff sleep. Callers
+    without a deadline scope get the classic fixed-attempts behavior.
     """
 
     max_attempts: int = 4
@@ -164,11 +172,32 @@ class HttpFileSystem:
         whole budget: when consecutive calls have exhausted their
         retries, ``allow()`` fails fast with the aggregated evidence
         instead of stalling through one more full backoff ladder.
+
+        Deadline awareness (io/deadline.py): when the calling thread
+        carries an ambient deadline — a serving request's budget
+        threaded down through ``deadline_scope`` — the retry ladder
+        stops early the moment the remaining budget cannot cover the
+        next backoff sleep, raising with the full attempt history
+        instead of sleeping past a deadline the caller already missed.
+        A deadline-aborted ladder still records a breaker failure: the
+        attempts that did run all failed, and a dead endpoint must not
+        stay invisible to the circuit just because its callers are in
+        a hurry.
         """
         scheme, netloc, req_path = self._split(path)
         breaker = circuit.breaker_for(f"{scheme}://{netloc}")
+        dl = deadline_mod.active_deadline()
+        if dl is not None and dl.expired:
+            # checked BEFORE breaker.allow(): a spent budget must not
+            # claim (and then leak) the breaker's one half-open probe
+            # slot — this caller was never going to probe anything
+            raise RemoteIOError(
+                f"{method} {scheme}://{netloc}{req_path} not attempted: "
+                f"deadline budget ({dl.budget_s:.3f}s) already spent"
+            )
         breaker.allow()
         last_err: Exception | None = None
+        attempt_history: list = []
         for attempt in range(self.retry.max_attempts):
             conn = self._connect(scheme, netloc)
             try:
@@ -191,6 +220,9 @@ class HttpFileSystem:
                 return status, resp_headers, data
             except (OSError, http.client.HTTPException, RemoteIOError) as e:
                 last_err = e
+                attempt_history.append(
+                    f"attempt {attempt + 1}: {type(e).__name__}: {e}"
+                )
                 self._drop(scheme, netloc)
                 logger.warning(
                     "%s %s attempt %d/%d failed: %s",
@@ -211,7 +243,31 @@ class HttpFileSystem:
                     error=f"{type(e).__name__}: {e}",
                 )
                 if attempt + 1 < self.retry.max_attempts:
-                    time.sleep(self.retry.sleep_for(attempt))
+                    wait = self.retry.sleep_for(attempt)
+                    if dl is not None and not dl.can_cover(wait):
+                        # the caller's budget cannot cover the next
+                        # backoff: stop the ladder NOW with the whole
+                        # attempt history, instead of sleeping past a
+                        # deadline the caller has already missed
+                        aborted = RemoteIOError(
+                            f"{method} {scheme}://{netloc}{req_path} "
+                            f"aborted after {attempt + 1}/"
+                            f"{self.retry.max_attempts} attempts: "
+                            f"deadline budget ({dl.remaining():.3f}s "
+                            f"remaining) cannot cover the {wait:.3f}s "
+                            f"backoff; attempts: {attempt_history}"
+                        )
+                        events.event(
+                            "remote.deadline_abort",
+                            method=method,
+                            path=req_path,
+                            attempts=attempt + 1,
+                            remaining_s=round(dl.remaining(), 4),
+                            next_backoff_s=round(wait, 4),
+                        )
+                        breaker.record_failure(aborted)
+                        raise aborted
+                    time.sleep(wait)
         exhausted = RemoteIOError(
             f"{method} {scheme}://{netloc}{req_path} failed after "
             f"{self.retry.max_attempts} attempts: {last_err}"

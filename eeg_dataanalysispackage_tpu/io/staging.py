@@ -71,11 +71,26 @@ def minibatches(
         yield tuple(a[start : start + batch_size] for a in arrays)
 
 
+#: how often the consumer's blocking get wakes to check producer
+#: liveness — the watchdog that keeps a dead producer from hanging a
+#: consumer forever (a producer that dies WITHOUT delivering its
+#: poison sentinel, e.g. killed by a failure inside its own failure
+#: path, would otherwise leave the consumer blocked on an empty queue)
+_WATCHDOG_POLL_S = 1.0
+
+
+class ProducerDiedError(RuntimeError):
+    """The prefetch producer thread died without delivering its
+    end-of-stream or poison sentinel; the consumer fails fast instead
+    of blocking on the queue forever."""
+
+
 def prefetch(
     batches: Iterable[Sequence[np.ndarray]],
     mesh=None,
     buffer_size: Optional[int] = None,
     with_mask: bool = True,
+    watchdog_poll_s: float = _WATCHDOG_POLL_S,
 ) -> Iterator[Tuple[jax.Array, ...]]:
     """Stage host batches onto device(s) ahead of consumption.
 
@@ -177,7 +192,36 @@ def prefetch(
     thread.start()
     try:
         while True:
-            item = buf.get()
+            # timed get + producer-liveness check: the consumer-side
+            # watchdog. A producer that dies without delivering _END
+            # or a _Poison (its own failure path failed) must surface
+            # as an error at the consumer, never as an infinite block.
+            try:
+                item = buf.get(timeout=watchdog_poll_s)
+            except queue.Empty:
+                if thread.is_alive():
+                    continue  # producer is just slow (staging a batch)
+                try:
+                    # close the race where the producer delivered its
+                    # final item between our timeout and the liveness
+                    # check, then exited
+                    item = buf.get_nowait()
+                except queue.Empty:
+                    events.event(
+                        "staging.producer_dead",
+                        thread=thread.name,
+                    )
+                    logger.error(
+                        "prefetch producer thread %s died without "
+                        "delivering end-of-stream; failing the "
+                        "consumer fast", thread.name,
+                    )
+                    raise ProducerDiedError(
+                        "staging producer thread died without "
+                        "delivering end-of-stream or an error; the "
+                        "batch source may have failed outside the "
+                        "producer's own failure handling"
+                    )
             if item is _END:
                 return
             if isinstance(item, _Poison):

@@ -45,6 +45,12 @@ Known points (the contract between specs and the codebase):
                     (io/provider.py) — exercises the degradation ladder
 ``device.step``     one host-level train-step call (parallel/train.py
                     wrappers and the elastic chunk drivers in models/)
+``serve.request``   one admitted serving request inside the batcher
+                    (serve/batcher.py) — the request is retried or
+                    failed with evidence, never silently dropped
+``serve.batch``     one micro-batch execution of the serving
+                    program (serve/batcher.py) — exercises the
+                    deadline-aware batch retry path
 ==================  ====================================================
 """
 

@@ -194,6 +194,12 @@ class RunTelemetry:
         #: populations, {"legs": {name: block}} for fan-out runs;
         #: None when the run trained no population
         self.population: Optional[Dict[str, Any]] = None
+        #: serving attribution (serve/service.py stats block): request
+        #: outcome counters (completed/shed/deadline-exceeded/failed),
+        #: batch coalescing stats, latency percentiles, watchdog and
+        #: drain state — one block for ``serve=true`` runs; None when
+        #: the run served nothing
+        self.serve: Optional[Dict[str, Any]] = None
 
     @property
     def report_path(self) -> str:
@@ -232,6 +238,7 @@ class RunTelemetry:
             "device": device,
             "backend": dict(self.backend),
             "population": self.population,
+            "serve": self.serve,
             "degradation": list(self.degradation),
             "stages": timers.as_dict() if timers is not None else {},
             "metrics": metrics.snapshot() if metrics is not None else {},

@@ -220,12 +220,30 @@ class PipelineBuilder:
         # EEG_TPU_INGEST_WORKERS / EEG_TPU_PREFETCH_DEPTH). The merge
         # is order-preserving, so epoch order and the balance counters
         # are bit-identical at any pool size.
-        odp = provider.OfflineDataProvider(
-            files,
-            filesystem=self._fs,
-            workers=self._int_param(query_map, "ingest_workers"),
-            prefetch_depth=self._int_param(query_map, "prefetch"),
-        )
+        def make_provider():
+            return provider.OfflineDataProvider(
+                files,
+                filesystem=self._fs,
+                workers=self._int_param(query_map, "ingest_workers"),
+                prefetch_depth=self._int_param(query_map, "prefetch"),
+            )
+
+        # serve=true: the online inference mode (serve/pipeline.py) —
+        # the saved classifier loads once, every kept epoch becomes a
+        # deadline-bounded request through the resident micro-batching
+        # service, and the statistics are pinned bit-identical to the
+        # batch load_clf= run on the same inputs (docs/serving.md).
+        if query_map.get("serve") == "true":
+            from ..serve import pipeline as serve_pipeline
+
+            statistics, serve_block = serve_pipeline.run_serve(
+                query_map, make_provider, self._stage
+            )
+            if self.telemetry is not None:
+                self.telemetry.serve = serve_block
+            return self._finish_run(statistics, query_map)
+
+        odp = make_provider()
 
         # 2. feature extraction (PipelineBuilder.java:128-139).
         # fe=dwt-8-fused is the TPU fast-path mode: ingest + DWT run as
@@ -579,6 +597,12 @@ class PipelineBuilder:
         else:
             raise ValueError("Missing classifier argument")
 
+        return self._finish_run(statistics, query_map)
+
+    def _finish_run(self, statistics, query_map):
+        """Shared run tail: logging, the atomic ``result_path`` report,
+        and the statistics hand-off (used by the batch chain and the
+        ``serve=`` mode alike)."""
         logger.info("statistics:\n%s", statistics)
         logger.info("stage timings:\n%s", self.timers.report())
         if chaos.active_plan() is not None:

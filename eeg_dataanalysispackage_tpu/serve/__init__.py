@@ -1,0 +1,38 @@
+"""Online inference serving for the P300 pipeline.
+
+The batch reproduction answers queries by running the whole pipeline
+per invocation; this package is the resident alternative — the
+ROADMAP's "millions of users" subsystem:
+
+- ``engine``   the fused serving program: raw epoch-window bytes ->
+               scaled samples -> baseline-corrected epochs -> DWT
+               features -> prediction, compiled once and shared by
+               every micro-batch size (reuses the batch path's
+               featurizer, which is what makes served predictions
+               bit-identical to the batch pipeline);
+- ``batcher``  the async micro-batching front end: bounded admission
+               queue with explicit load shedding, per-request
+               deadlines threaded through deadline-aware retries, a
+               watchdog that fails requests fast when the batcher
+               wedges, graceful drain;
+- ``service``  the lifecycle wrapper (:class:`InferenceService`):
+               load a saved classifier once, serve until drained,
+               export the ``serve`` telemetry block;
+- ``pipeline`` the ``serve=true`` query mode: drive a batch session
+               through the service epoch-by-epoch, statistics pinned
+               bit-identical to the batch ``load_clf=`` run.
+
+See docs/serving.md for knobs, semantics, and the parity contract.
+"""
+
+from .batcher import (  # noqa: F401
+    RequestFailedError,
+    Result,
+    ServeError,
+    ServeFuture,
+    ServiceClosedError,
+    ServiceWedgedError,
+    ShedError,
+)
+from .engine import ServingEngine, windows_from_recording  # noqa: F401
+from .service import InferenceService, ServeConfig  # noqa: F401

@@ -1,0 +1,361 @@
+"""The resident serving program: raw epoch windows -> predictions.
+
+One micro-batch of requests — each carrying the raw (unscaled int16)
+samples of one stimulus-locked window — is coalesced into a synthetic
+recording stream and run through **the same fused featurizer the
+batch pipeline compiles** (``ops.device_ingest.
+make_device_ingest_featurizer``: resolution scaling, window gather,
+baseline correction, DWT cascade matmul, L2 normalization in one XLA
+program), with the linear-family margin fused onto the end. Reusing
+the batch path's program (not a re-implementation of it) is what makes
+the parity contract structural — with one shape caveat, measured not
+assumed: XLA specializes numerics per compiled shape, and the epoch
+**capacity** (the row count entering the DWT matmul) is part of the
+shape. The batch planner buckets capacity to multiples of 64
+(``plan_ingest(capacity_multiple=64)``), so this engine buckets its
+own capacity to the same multiple: a served window then runs through
+the *same-shaped* program that featurized it in the batch pipeline
+and its features are **bit-identical** for sessions inside one bucket
+(pinned in tests/test_serve.py and tools/serve_bench.py's parity
+block). Across bucket boundaries (a recording with >capacity kept
+epochs) features are tolerance-level identical — the exact contract
+the degradation ladder's rungs already share (~1e-7, decision-
+irrelevant in practice), with predictions still pinned equal.
+
+Shapes are static: the stream is sized for the bucketed ``capacity``,
+positions/mask padded to it, so every micro-batch size from 1 to
+capacity reuses ONE compiled program — no retrace under bursty load. The staged stream buffer is donated to
+the program on accelerator backends (its int16 bytes are dead after
+the scale), mirroring the batch path's donation discipline; on CPU
+donation is skipped (XLA:CPU cannot alias them and would warn per
+call).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..epochs.extractor import BalanceState
+from ..models import linear
+from ..ops import device_ingest
+from ..utils import constants
+
+
+def _donate_argnums() -> tuple:
+    """Donate the staged stream only where the backend can alias it."""
+    return () if jax.default_backend() == "cpu" else (0,)
+
+
+@functools.lru_cache(maxsize=None)
+def _serving_program(
+    wavelet_index: int,
+    epoch_size: int,
+    skip_samples: int,
+    feature_size: int,
+    n_channels: int,
+    pre: int,
+    post: int,
+    with_margin: bool,
+):
+    """The jitted micro-batch program, cached per geometry (shared by
+    every service instance with the same acquisition config).
+
+    ``with_margin=True`` fuses the linear-family margin matvec onto
+    the featurizer — features never round-trip to the host before the
+    decision. Weights ride as a traced argument, so swapping a model
+    recompiles nothing.
+    """
+    featurizer = device_ingest.make_device_ingest_featurizer(
+        wavelet_index=wavelet_index,
+        epoch_size=epoch_size,
+        skip_samples=skip_samples,
+        feature_size=feature_size,
+        channels=tuple(range(1, n_channels + 1)),
+        pre=pre,
+        post=post,
+    )
+    if with_margin:
+
+        def run(raw, resolutions, positions, mask, weights):
+            feats = featurizer(raw, resolutions, positions, mask)
+            return feats, feats @ weights
+
+    else:
+
+        def run(raw, resolutions, positions, mask):
+            return featurizer(raw, resolutions, positions, mask), None
+
+    return jax.jit(run, donate_argnums=_donate_argnums())
+
+
+class ServingEngine:
+    """Executes micro-batches for one loaded classifier.
+
+    ``classifier`` is any registry classifier that has been trained or
+    loaded. The linear family (logreg/svm with native float32 weights)
+    runs fully fused — window bytes to margin in one program; every
+    other classifier gets the fused featurizer plus its own host-side
+    ``predict`` on the resulting rows (the exact call the batch
+    pipeline's ``test_features`` makes, so parity holds there too).
+    """
+
+    def __init__(
+        self,
+        classifier,
+        wavelet_index: int = 8,
+        n_channels: int = constants.USED_CHANNELS,
+        pre: int = constants.PRESTIMULUS_SAMPLES,
+        post: int = constants.POSTSTIMULUS_SAMPLES,
+        epoch_size: int = 512,
+        skip_samples: int = 175,
+        feature_size: int = 16,
+        capacity: int = 64,
+    ):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.classifier = classifier
+        self.n_channels = int(n_channels)
+        self.pre = int(pre)
+        self.post = int(post)
+        self.window_len = self.pre + self.post
+        # bucket to the batch planner's capacity multiple: the program
+        # shape (and therefore its f32 numerics) then MATCHES the
+        # batch path's, which is what makes served features
+        # bit-identical to load_features_device's (module docstring)
+        self.capacity = device_ingest._round_capacity(int(capacity), 64)
+        self.wavelet_index = int(wavelet_index)
+        self._geometry = (
+            int(wavelet_index), int(epoch_size), int(skip_samples),
+            int(feature_size), self.n_channels, self.pre, self.post,
+        )
+        self.epoch_size = int(epoch_size)
+        self.skip_samples = int(skip_samples)
+        self.feature_size = int(feature_size)
+        # the fused-margin fast path: native float32 linear weights
+        # (an imported f64 MLlib model keeps its bit-exact host-f64
+        # predict; fusing would downcast it)
+        self._fused_linear = (
+            isinstance(classifier, linear._LinearClassifier)
+            and classifier.weights is not None
+            and classifier.weights.dtype == np.float32
+        )
+        self._program = _serving_program(
+            *self._geometry, with_margin=self._fused_linear
+        )
+        # the serving arm of the degradation ladder (io/provider's
+        # pallas->block->xla->host contract, collapsed to its two
+        # serving-relevant rungs): the fused device program, with a
+        # host featurize+predict floor. Transient failures are the
+        # batcher's retry job; PERSISTENT fused failures (a backend
+        # that broke mid-residency) step the engine down permanently —
+        # slower, but the service survives, exactly like the batch
+        # ladder. An operator re-promotes by restarting the service.
+        self._rung = "fused"
+        self._consecutive_fused_failures = 0
+        self._degrade_after = 2
+        self._host_fe = None
+        self._warmed = False
+        # static plan for the synthetic stream: window i lives at
+        # [i * window_len, (i + 1) * window_len), so its marker
+        # position is i * window_len + pre — one plan for every batch
+        self._positions = (
+            np.arange(self.capacity, dtype=np.int32) * self.window_len
+            + self.pre
+        )
+
+    # -- execution ------------------------------------------------------
+
+    def execute(
+        self,
+        windows: Sequence[np.ndarray],
+        resolutions: np.ndarray,
+    ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        """Run one micro-batch: ``windows`` is a sequence of
+        ``(n_channels, window_len)`` raw sample arrays (int16 for
+        INT_16 recordings; float32 with unit resolutions otherwise —
+        the ``stage_raw`` convention), all sharing ``resolutions``.
+
+        Returns ``(predictions (B,) float64, margins (B,) or None)``.
+        """
+        n = len(windows)
+        if n == 0:
+            return np.zeros((0,), np.float64), None
+        if n > self.capacity:
+            raise ValueError(
+                f"micro-batch of {n} exceeds engine capacity "
+                f"{self.capacity}"
+            )
+        if self._rung == "host":
+            return self._execute_host(windows, resolutions)
+        try:
+            result = self._execute_fused(windows, resolutions)
+        except ValueError:
+            # shape/validation errors are the caller's bug, not a
+            # backend failure — never a reason to degrade
+            raise
+        except Exception as e:
+            self._consecutive_fused_failures += 1
+            if self._consecutive_fused_failures >= self._degrade_after:
+                from .. import obs
+                from ..obs import events
+                import logging
+
+                self._rung = "host"
+                obs.metrics.count("serve.degraded_to_host")
+                events.event(
+                    "serve.degraded", to="host",
+                    error=f"{type(e).__name__}: {e}",
+                    consecutive_failures=(
+                        self._consecutive_fused_failures
+                    ),
+                )
+                logging.getLogger(__name__).error(
+                    "serve.degrade landed=host after %d consecutive "
+                    "fused failures (%s: %s); serving continues on "
+                    "the host floor",
+                    self._consecutive_fused_failures,
+                    type(e).__name__, e,
+                )
+                return self._execute_host(windows, resolutions)
+            raise
+        self._consecutive_fused_failures = 0
+        return result
+
+    def _execute_fused(self, windows, resolutions):
+        n = len(windows)
+        stream = np.zeros(
+            (self.n_channels, self.capacity * self.window_len),
+            dtype=np.asarray(windows[0]).dtype,
+        )
+        for i, w in enumerate(windows):
+            w = np.asarray(w)
+            if w.shape != (self.n_channels, self.window_len):
+                raise ValueError(
+                    f"window {i} has shape {w.shape}, expected "
+                    f"({self.n_channels}, {self.window_len})"
+                )
+            stream[:, i * self.window_len:(i + 1) * self.window_len] = w
+        mask = np.zeros(self.capacity, dtype=bool)
+        mask[:n] = True
+        # explicit staging so the program can donate the buffer (the
+        # int16 stream is dead after the on-device scale)
+        staged = jax.device_put(stream)
+        res = np.asarray(resolutions, dtype=np.float32)
+        if self._fused_linear:
+            feats, margins = self._program(
+                staged, res, self._positions, mask,
+                self.classifier.weights,
+            )
+            margins = np.asarray(margins[:n]) + self.classifier.intercept
+            predictions = (
+                margins > self.classifier.margin_threshold
+            ).astype(np.float64)
+            return predictions, margins
+        feats, _ = self._program(staged, res, self._positions, mask)
+        predictions = np.asarray(
+            self.classifier.predict(np.asarray(feats)[:n]),
+            dtype=np.float64,
+        )
+        return predictions, None
+
+    def _execute_host(self, windows, resolutions):
+        """The host floor: scale + baseline-correct on the host and
+        run the registry DWT extractor plus the classifier's own
+        predict — the reference-shaped path, device-free. Features are
+        tolerance-level vs the fused rung (the ladder's contract);
+        the service survives a broken device backend."""
+        from ..features import registry as fe_registry
+
+        if self._host_fe is None:
+            self._host_fe = fe_registry.create(
+                f"dwt-{self.wavelet_index}"
+            )
+        res = np.asarray(resolutions, dtype=np.float64)
+        epochs = []
+        for w in windows:
+            w = np.asarray(w)
+            if w.shape != (self.n_channels, self.window_len):
+                raise ValueError(
+                    f"window has shape {w.shape}, expected "
+                    f"({self.n_channels}, {self.window_len})"
+                )
+            scaled = w.astype(np.float64) * res[:, None]
+            base = scaled[:, : self.pre].mean(axis=1)
+            epochs.append((scaled - base[:, None])[:, self.pre:])
+        feats = np.asarray(
+            self._host_fe.extract_batch(np.stack(epochs))
+        )
+        predictions = np.asarray(
+            self.classifier.predict(feats), dtype=np.float64
+        )
+        return predictions, None
+
+    def warmup(self) -> None:
+        """Compile the program before traffic arrives (one dummy
+        batch), so the first real request doesn't pay XLA latency —
+        and, as importantly, so a long cold compile can never happen
+        inside the batcher where the watchdog would read it as a
+        wedge. Idempotent."""
+        if self._warmed:
+            return
+        # both request dtypes the stage_raw convention produces:
+        # int16 (INT_16 recordings) and the float32 fallback — a
+        # non-INT_16 session must not pay its cold trace inside the
+        # batcher either
+        for dtype in (np.int16, np.float32):
+            self.execute(
+                [np.zeros((self.n_channels, self.window_len), dtype)],
+                np.ones(self.n_channels, np.float32),
+            )
+        self._warmed = True
+
+    @property
+    def mode(self) -> str:
+        return "fused-linear" if self._fused_linear else "featurize+host"
+
+    @property
+    def rung(self) -> str:
+        """The degradation rung currently serving: ``fused`` or the
+        ``host`` floor."""
+        return self._rung
+
+
+def windows_from_recording(
+    recording,
+    channel_indices: Sequence[int],
+    guessed: int,
+    pre: int = constants.PRESTIMULUS_SAMPLES,
+    post: int = constants.POSTSTIMULUS_SAMPLES,
+    balance: Optional[BalanceState] = None,
+) -> Tuple[List[np.ndarray], np.ndarray, np.ndarray]:
+    """One recording -> per-epoch serving requests.
+
+    Returns ``(windows, targets, resolutions)``: the kept markers'
+    raw ``(n_channels, pre+post)`` windows (unscaled int16 when the
+    recording is INT_16 — the same bytes ``stage_raw`` ships to the
+    device, sliced per epoch), their 0/1 targets under the shared
+    cross-file ``balance`` state, and the per-channel resolutions.
+    This is the bridge the pipeline's ``serve=`` mode uses to drive a
+    batch session through the service: window content (including the
+    zero padding past the end of the recording) matches the fused
+    batch path's gather exactly, which is what makes served
+    predictions bit-identical to the batch run.
+    """
+    raw, resolutions, n_samples = device_ingest.stage_raw(
+        recording, list(channel_indices)
+    )
+    plan = device_ingest.plan_ingest(
+        recording.markers, guessed, n_samples,
+        pre=pre, post=post, balance=balance,
+    )
+    win = pre + post
+    padded = np.pad(raw, ((0, 0), (0, win)))
+    windows = [
+        padded[:, p - pre:p - pre + win]
+        for p in plan.positions[: plan.n_kept]
+    ]
+    return windows, plan.targets, resolutions

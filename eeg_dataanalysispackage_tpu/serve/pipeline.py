@@ -1,0 +1,187 @@
+"""``serve=true`` pipeline mode: drive a session through the service.
+
+The batch pipeline's ``load_clf=`` mode answers "how does this saved
+model score this session" with one big fused featurization; this mode
+answers the same question through the ONLINE path — every kept epoch
+becomes an individual request (raw int16 window bytes) submitted to a
+resident :class:`serve.service.InferenceService`, micro-batched,
+deadline-bounded, and admission-controlled. The statistics out the
+other end are pinned bit-identical to the batch ``load_clf=`` run on
+the same inputs (tests/test_serve.py) — the parity contract every
+prior subsystem honored, now holding across the batch/online seam.
+
+Query surface (README "Query-string reference")::
+
+    serve=true&load_clf=logreg&load_name=/models/p300
+        &fe=dwt-8-fused&info_file=...
+        [&serve_deadline_ms=2000] [&serve_batch=64] [&serve_queue=256]
+
+``faults=`` specs may target ``serve.request`` / ``serve.batch``; the
+run then proves the no-wedge contract live (requests retry or fail
+with evidence, the drain completes) and the run report's ``serve``
+block records the outcome counters.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+
+import numpy as np
+
+from . import engine as engine_mod
+from . import service as service_mod
+from ..epochs.extractor import BalanceState
+from ..models import registry as clf_registry
+from ..models import stats
+from ..utils import java_compat
+
+logger = logging.getLogger(__name__)
+
+def _conflicting_keys(query_map) -> list:
+    """Keys that actually ENABLE a conflicting mode — judged by the
+    same conditions the batch path uses, so an explicit no-op like
+    ``elastic=false`` or ``cv=1`` does not spuriously reject the run."""
+    from ..models import population
+
+    conflicts = [k for k in ("train_clf", "classifiers") if k in query_map]
+    for flag in ("save_clf", "elastic"):
+        if query_map.get(flag) == "true":
+            conflicts.append(flag)
+    if population.PopulationSpec.from_query_map(query_map).active:
+        conflicts.append("cv=/seeds=/sweep=")
+    return conflicts
+
+
+def _int_knob(query_map, name: str, default: int) -> int:
+    value = query_map.get(name, "")
+    if not value:
+        return default
+    try:
+        return int(value)
+    except ValueError:
+        raise ValueError(
+            f"query parameter {name}= must be an integer, got {value!r}"
+        )
+
+
+def serve_config_from_query(query_map) -> service_mod.ServeConfig:
+    return service_mod.ServeConfig(
+        max_batch=_int_knob(query_map, "serve_batch", 64),
+        queue_depth=_int_knob(query_map, "serve_queue", 256),
+        default_deadline_s=_int_knob(
+            query_map, "serve_deadline_ms", 2000
+        ) / 1000.0,
+    )
+
+
+def run_serve(query_map, provider_factory, stage):
+    """Execute one ``serve=true`` run.
+
+    ``provider_factory`` builds the run's ``OfflineDataProvider``
+    (the builder owns filesystem routing / worker knobs); ``stage`` is
+    the builder's stage context factory (span + StageTimer). Returns
+    ``(ClassificationStatistics, serve_block)``.
+    """
+    conflicts = _conflicting_keys(query_map)
+    if conflicts:
+        raise ValueError(
+            f"serve=true is an inference mode; it cannot combine "
+            f"with {', '.join(conflicts)}"
+        )
+    if "load_clf" not in query_map:
+        raise ValueError(
+            "serve=true requires load_clf= (the model to serve)"
+        )
+    if "load_name" not in query_map:
+        raise ValueError("Classifier location not provided")
+    fused_match = re.fullmatch(
+        r"dwt-(\d+)-fused(-pallas|-block|-xla)?", query_map.get("fe", "")
+    )
+    if fused_match is None:
+        raise ValueError(
+            "serve=true runs the fused bytes->features->predict "
+            "program; fe= must be a dwt-<i>-fused form"
+        )
+    wavelet_index = int(fused_match.group(1))
+
+    classifier = clf_registry.create(query_map["load_clf"])
+    classifier.load(query_map["load_name"])
+
+    odp = provider_factory()
+    config = serve_config_from_query(query_map)
+    # the engine's geometry comes from the provider, not re-derived
+    # from constants: a provider constructed with non-default pre/
+    # post/channels must produce windows the engine accepts
+    service = service_mod.InferenceService(
+        classifier,
+        wavelet_index=wavelet_index,
+        n_channels=odp.n_channels,
+        pre=odp.pre,
+        post=odp.post,
+        config=config,
+    )
+
+    # 1. ingest: parse the session into per-epoch raw windows (the
+    # online analogue of the fused path's plan+stage step; the shared
+    # BalanceState keeps cross-file retention identical to batch)
+    balance = BalanceState()
+    requests = []  # (window, resolutions)
+    targets = []
+    with stage("ingest", mode="serve"):
+        for _rel, guessed, rec in odp.iter_recordings():
+            windows, rec_targets, resolutions = (
+                engine_mod.windows_from_recording(
+                    rec, odp.channel_indices_for(rec), guessed,
+                    pre=odp.pre, post=odp.post, balance=balance,
+                )
+            )
+            requests.extend((w, resolutions) for w in windows)
+            targets.append(rec_targets)
+    targets_arr = (
+        np.concatenate(targets) if targets else np.zeros(0, np.float64)
+    )
+    n = len(requests)
+
+    # 2. serve: the resident service answers every epoch as an online
+    # request — micro-batched, deadline-bounded, shed-don't-stall
+    service.start()  # warms the compiled program before traffic
+    try:
+        with stage("serve", requests=n):
+            # per-recording resolutions may differ; predict_all takes
+            # the per-window vectors and the batcher's coalescing key
+            # keeps each micro-batch homogeneous
+            results = []
+            if n:
+                results = service.predict_all(
+                    [r[0] for r in requests],
+                    [r[1] for r in requests],
+                )
+    finally:
+        drained = service.stop(drain=True)
+
+    predictions = np.array(
+        [r.prediction for r in results], dtype=np.float64
+    )
+
+    # 3. statistics, the load_clf= way: evaluated over the seed-1
+    # shuffled order (permutation-invariant sums, but byte-identical
+    # construction keeps the parity contract auditable)
+    with stage("test", classifier=query_map["load_clf"]):
+        perm = java_compat.java_shuffle_indices(n, seed=1)
+        statistics = stats.ClassificationStatistics.from_arrays(
+            predictions[perm], targets_arr[perm],
+            confusion_only=classifier.confusion_only_stats,
+        )
+
+    block = service.stats_block()
+    block["requests"]["total_epochs"] = n
+    block["drained_cleanly"] = drained
+    logger.info(
+        "served %d epochs: %d completed, %d shed, %d deadline-"
+        "exceeded, %d failed (drained=%s)",
+        n, block["requests"]["completed"], block["requests"]["shed"],
+        block["requests"]["deadline_exceeded"],
+        block["requests"]["failed"], drained,
+    )
+    return statistics, block

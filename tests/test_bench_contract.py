@@ -308,3 +308,48 @@ def test_probe_respects_lock_before_touching_the_tunnel(
 
     monkeypatch.setattr(bench.subprocess, "Popen", boom)
     assert bench._tpu_available() is False
+
+
+def test_serve_variant_in_both_tables():
+    """The serving benchmark (ISSUE 6) rides every bench artifact, on
+    TPU and on the CPU fallback, through the serve_bench child."""
+    for table in (bench._VARIANTS_TPU, bench._VARIANTS_CPU):
+        assert "serve_bench" in table
+
+
+def test_serve_bench_routes_to_serve_child():
+    """bench._run_variant must hand serve_* to the serving child (it
+    drives the resident service), not the kernel bench."""
+    import inspect
+
+    src = inspect.getsource(bench._run_variant)
+    assert "serve_" in src and "serve_bench.py" in src
+
+
+def test_collect_propagates_serve_field(monkeypatch):
+    """The serve line's sweep/parity/chaos block must survive the
+    parent's field whitelist into the published artifact — the p50/p99
+    + predictions/sec acceptance numbers live there."""
+    serve_block = {
+        "sweep": [{"concurrency": 4, "p50_ms": 1.0, "p99_ms": 2.0,
+                   "preds_per_s": 100.0}],
+        "parity": {"bit_identical": True},
+        "chaos": {"chaos_clean": True},
+    }
+    monkeypatch.setattr(
+        bench, "_VARIANTS_CPU",
+        {"einsum": (8, 2), "serve_bench": (400, 2)},
+    )
+    monkeypatch.setattr(
+        bench,
+        "_run_variant",
+        lambda name, platform, n, iters: {
+            "epochs_per_s": 1.0,
+            "bytes_per_epoch": 5100,
+            "n": n,
+            "wall_s": 1.0,
+            **({"serve": serve_block} if name == "serve_bench" else {}),
+        },
+    )
+    v = bench._collect("cpu_fallback")["variants"]["serve_bench"]
+    assert v["serve"] == serve_block

@@ -238,3 +238,46 @@ def test_atomic_write_failure_leaves_previous_content(tmp_path, monkeypatch):
     # the target was never touched, and the tmp file was cleaned up
     assert target.read_text() == "good\n"
     assert [p.name for p in tmp_path.iterdir()] == ["report.txt"]
+
+
+def test_atomic_write_durability_fsyncs_file_then_dir(tmp_path, monkeypatch):
+    """ISSUE-6 satellite: the full durability recipe — fsync the tmp
+    file BEFORE os.replace (data blocks on disk) and fsync the
+    directory AFTER it (the rename on disk), so a crash right after a
+    'successful' atomic write cannot replay as a zero-length
+    artifact."""
+    from eeg_dataanalysispackage_tpu.checkpoint import manager
+
+    sequence = []
+    real_fsync, real_replace = os.fsync, os.replace
+
+    def spy_fsync(fd):
+        sequence.append("fsync_file")
+        real_fsync(fd)
+
+    def spy_replace(src, dst):
+        sequence.append("replace")
+        real_replace(src, dst)
+
+    def spy_fsync_dir(directory):
+        sequence.append(("fsync_dir", directory))
+
+    monkeypatch.setattr(os, "fsync", spy_fsync)
+    monkeypatch.setattr(os, "replace", spy_replace)
+    monkeypatch.setattr(manager, "_fsync_directory", spy_fsync_dir)
+
+    target = tmp_path / "artifact.json"
+    manager.atomic_write_bytes(str(target), b"payload")
+    assert target.read_bytes() == b"payload"
+    assert sequence == [
+        "fsync_file", "replace", ("fsync_dir", str(tmp_path)),
+    ]
+
+
+def test_fsync_directory_survives_unsyncable_dirs(tmp_path):
+    """Best-effort contract: platforms refusing directory fds degrade
+    to the old (weaker) guarantee instead of failing the write."""
+    from eeg_dataanalysispackage_tpu.checkpoint import manager
+
+    manager._fsync_directory(str(tmp_path))  # real dir: no raise
+    manager._fsync_directory(str(tmp_path / "does-not-exist"))

@@ -101,6 +101,83 @@ def test_registry_shares_per_endpoint():
         circuit.reset()
 
 
+# -- half-open behavior under concurrent callers ------------------------
+
+
+def _race_allow(cb, n_threads=8):
+    """Fire ``allow()`` from n threads behind a barrier; returns
+    (admitted, fast_failed) counts."""
+    barrier = threading.Barrier(n_threads)
+    admitted, failed = [], []
+    lock = threading.Lock()
+
+    def caller():
+        barrier.wait()
+        try:
+            cb.allow()
+        except circuit.CircuitOpenError:
+            with lock:
+                failed.append(1)
+        else:
+            with lock:
+                admitted.append(1)
+
+    threads = [threading.Thread(target=caller) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return len(admitted), len(failed)
+
+
+def test_half_open_admits_exactly_one_probe_under_concurrency():
+    """Exactly ONE concurrent caller wins the half-open probe slot;
+    the rest fail fast instead of stampeding the recovering endpoint."""
+    cb, clock = _breaker(threshold=1, cooldown=5.0)
+    cb.record_failure(IOError("down"))
+    clock.now = 5.1
+    admitted, failed = _race_allow(cb, n_threads=8)
+    assert admitted == 1
+    assert failed == 7
+    assert cb.state == circuit.HALF_OPEN
+
+
+def test_concurrent_probe_success_closes_exactly_once():
+    cb, clock = _breaker(threshold=1, cooldown=5.0)
+    cb.record_failure(IOError("down"))
+    clock.now = 5.1
+    admitted, _ = _race_allow(cb)
+    assert admitted == 1
+    before = obs.metrics.snapshot()["counters"].get("circuit.closed", 0.0)
+    cb.record_success()  # the winner's probe came back
+    after = obs.metrics.snapshot()["counters"]["circuit.closed"]
+    assert after - before == 1  # one transition, not one per loser
+    assert cb.state == circuit.CLOSED
+    # closed again: every caller flows
+    admitted, failed = _race_allow(cb)
+    assert (admitted, failed) == (8, 0)
+
+
+def test_concurrent_probe_failure_reopens_exactly_once():
+    cb, clock = _breaker(threshold=1, cooldown=5.0)
+    cb.record_failure(IOError("down"))
+    clock.now = 5.1
+    admitted, _ = _race_allow(cb)
+    assert admitted == 1
+    before = obs.metrics.snapshot()["counters"].get("circuit.opened", 0.0)
+    cb.record_failure(IOError("probe failed"))
+    after = obs.metrics.snapshot()["counters"]["circuit.opened"]
+    assert after - before == 1
+    assert cb.state == circuit.OPEN
+    # the cooldown clock restarted: everyone fails fast again until
+    # the next window, where again exactly one probes
+    admitted, failed = _race_allow(cb)
+    assert (admitted, failed) == (0, 8)
+    clock.now = 10.3
+    admitted, failed = _race_allow(cb)
+    assert (admitted, failed) == (1, 7)
+
+
 # -- integration through HttpFileSystem --------------------------------
 
 

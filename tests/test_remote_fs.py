@@ -482,3 +482,122 @@ def test_retry_policy_full_jitter_opt_in():
     assert len(set(waits)) > 1  # actually random, not a constant
     with pytest.raises(ValueError, match="jitter"):
         remote.RetryPolicy(jitter="half")
+
+
+# -- deadline-aware retries (ISSUE-6 satellite) -------------------------
+
+
+def test_retry_stops_when_deadline_cannot_cover_backoff(server):
+    """With an ambient deadline whose remaining budget cannot cover
+    the next backoff sleep, the ladder aborts NOW — raising with the
+    attempt history — instead of sleeping past the deadline."""
+    from eeg_dataanalysispackage_tpu.io import circuit, deadline
+
+    base, store = server
+    store.files["/dead.bin"] = b"x"
+    store.fail_next = 99
+    circuit.reset()
+    try:
+        fs = remote.HttpFileSystem(
+            base_url=base,
+            # a backoff the 0.2 s budget can never cover: the ladder
+            # must stop after attempt 1 of 4
+            retry=remote.RetryPolicy(
+                max_attempts=4, timeout_s=5.0, backoff_s=30.0
+            ),
+        )
+        n_before = len(store.requests)
+        with deadline.deadline_scope(deadline.Deadline(0.2)):
+            with pytest.raises(
+                remote.RemoteIOError,
+                match=r"aborted after 1/4 attempts.*deadline budget",
+            ) as ei:
+                fs.read_bytes(f"{base}/dead.bin")
+        # the attempt history rides in the error
+        assert "attempt 1: RemoteIOError" in str(ei.value)
+        # exactly one request left the process — no 30 s sleep, no
+        # further attempts
+        assert len(store.requests) - n_before == 1
+    finally:
+        circuit.reset()
+
+
+def test_spent_deadline_refuses_the_first_attempt(server):
+    from eeg_dataanalysispackage_tpu.io import circuit, deadline
+
+    base, store = server
+    store.files["/a.bin"] = b"x"
+    circuit.reset()
+    try:
+        n_before = len(store.requests)
+        with deadline.deadline_scope(deadline.Deadline(0.0)):
+            with pytest.raises(remote.RemoteIOError, match="not attempted"):
+                _fs(base).read_bytes(f"{base}/a.bin")
+        assert len(store.requests) == n_before  # nothing hit the wire
+    finally:
+        circuit.reset()
+
+
+def test_no_deadline_scope_keeps_classic_retry_behavior(server):
+    base, store = server
+    store.files["/flaky.bin"] = b"x" * 10
+    store.fail_next = 2
+    assert _fs(base).read_bytes(f"{base}/flaky.bin") == b"x" * 10
+
+
+def test_deadline_nesting_tightest_wins():
+    from eeg_dataanalysispackage_tpu.io import deadline
+
+    class Clock:
+        now = 0.0
+
+        def __call__(self):
+            return self.now
+
+    clock = Clock()
+    outer = deadline.Deadline(10.0, clock=clock)
+    inner = deadline.Deadline(1.0, clock=clock)
+    assert deadline.active_deadline() is None
+    with deadline.deadline_scope(outer):
+        assert deadline.active_deadline() is outer
+        with deadline.deadline_scope(inner):
+            assert deadline.active_deadline() is inner
+            assert deadline.active_deadline().can_cover(0.5)
+            assert not deadline.active_deadline().can_cover(2.0)
+        assert deadline.active_deadline() is outer
+    assert deadline.active_deadline() is None
+    clock.now = 1.5
+    assert inner.expired and not outer.expired
+    with pytest.raises(deadline.DeadlineExceededError):
+        inner.raise_if_expired("probe")
+
+
+def test_spent_deadline_does_not_leak_the_half_open_probe_slot(server):
+    """Review regression: the spent-budget fast-fail must run BEFORE
+    breaker.allow() — otherwise a hurried caller claims the one
+    half-open probe slot, raises without recording an outcome, and the
+    breaker can never be probed again for the life of the process."""
+    import time as time_mod
+
+    from eeg_dataanalysispackage_tpu.io import circuit, deadline
+
+    base, store = server
+    store.files["/x.bin"] = b"alive"
+    circuit.reset()
+    try:
+        endpoint = base  # authority key used by breaker_for
+        cb = circuit.breaker_for(endpoint)
+        cb.threshold, cb.cooldown_s = 1, 0.05
+        cb.record_failure(IOError("down"))
+        assert cb.state == circuit.OPEN
+        time_mod.sleep(0.06)  # cooldown elapsed: probe window open
+        # a caller with a spent budget must NOT consume the probe slot
+        with deadline.deadline_scope(deadline.Deadline(0.0)):
+            with pytest.raises(remote.RemoteIOError, match="not attempted"):
+                _fs(base).read_bytes(f"{base}/x.bin")
+        # an unhurried caller can still probe, and the probe closes
+        # the circuit
+        assert _fs(base).read_bytes(f"{base}/x.bin") == b"alive"
+        assert cb.state == circuit.CLOSED
+    finally:
+        circuit.reset()

@@ -1,0 +1,506 @@
+"""Online inference service (serve/): parity, micro-batching,
+admission control, deadlines, watchdog, drain, and chaos (ISSUE 6).
+
+The acceptance bar: served predictions are bit-identical to the batch
+pipeline's on the same epochs; under ``serve.request``/``serve.batch``
+faults the service sheds or degrades but never wedges — every request
+resolves (answer, shed, or deadline-exceeded with evidence) and the
+graceful drain completes.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import _synthetic
+from eeg_dataanalysispackage_tpu import obs
+from eeg_dataanalysispackage_tpu.io import deadline as deadline_mod
+from eeg_dataanalysispackage_tpu.io import provider
+from eeg_dataanalysispackage_tpu.models import registry as clf_registry
+from eeg_dataanalysispackage_tpu.obs import chaos
+from eeg_dataanalysispackage_tpu.pipeline import builder
+from eeg_dataanalysispackage_tpu.serve import (
+    InferenceService,
+    RequestFailedError,
+    ServeConfig,
+    ServiceClosedError,
+    ServiceWedgedError,
+    ShedError,
+    engine,
+)
+from eeg_dataanalysispackage_tpu.epochs.extractor import BalanceState
+
+_CONFIG = (
+    "&config_num_iterations=20&config_step_size=1.0"
+    "&config_mini_batch_fraction=1.0"
+)
+
+
+@pytest.fixture(scope="module")
+def session(tmp_path_factory):
+    """One synthetic two-file session + a trained, saved logreg model
+    + the batch pipeline's own predictions for every kept epoch."""
+    tmp = tmp_path_factory.mktemp("serve_session")
+    for i, (name, guessed) in enumerate(
+        (("synth_00", 2), ("synth_01", 5))
+    ):
+        _synthetic.write_recording(
+            str(tmp), name=name, n_markers=90, guessed=guessed, seed=i
+        )
+    info = str(tmp / "info.txt")
+    with open(info, "w") as f:
+        f.write("synth_00.eeg 2\nsynth_01.eeg 5\n")
+    model = str(tmp / "model")
+    builder.PipelineBuilder(
+        f"info_file={info}&fe=dwt-8-fused&train_clf=logreg"
+        f"&save_clf=true&save_name={model}{_CONFIG}"
+    ).execute()
+
+    odp = provider.OfflineDataProvider([info])
+    balance = BalanceState()
+    windows, targets, resolutions = [], [], None
+    for _rel, guessed, rec in odp.iter_recordings():
+        ws, ts, resolutions = engine.windows_from_recording(
+            rec, odp.channel_indices_for(rec), guessed,
+            pre=odp.pre, post=odp.post, balance=balance,
+        )
+        windows.extend(ws)
+        targets.append(ts)
+    features, feat_targets = provider.OfflineDataProvider(
+        [info]
+    ).load_features_device(wavelet_index=8, backend="xla")
+    classifier = clf_registry.create("logreg")
+    classifier.load(model)
+    return {
+        "info": info,
+        "model": model,
+        "windows": windows,
+        "targets": np.concatenate(targets),
+        "resolutions": resolutions,
+        "batch_features": features,
+        "batch_predictions": classifier.predict(features),
+    }
+
+
+def _service(session, **config_kwargs) -> InferenceService:
+    return InferenceService.from_saved(
+        "logreg", session["model"],
+        config=ServeConfig(**config_kwargs) if config_kwargs else None,
+    )
+
+
+_WINDOW = np.zeros((3, 850), np.int16)
+_RES = np.ones(3, np.float32)
+
+
+# -- the parity contract -------------------------------------------------
+
+
+def test_served_predictions_bit_identical_to_batch(session):
+    """The acceptance pin: every epoch served through the online path
+    predicts exactly what the batch fused pipeline predicts for it."""
+    with _service(session) as svc:
+        results = svc.predict_all(
+            session["windows"], session["resolutions"]
+        )
+    served = np.array([r.prediction for r in results])
+    np.testing.assert_array_equal(served, session["batch_predictions"])
+    # the window extraction targeted the same epochs the batch path
+    # featurized (same count, same balance decisions)
+    assert len(session["windows"]) == len(session["batch_features"])
+
+
+def test_serve_pipeline_statistics_identical_to_load_clf(
+    session, tmp_path
+):
+    """serve=true produces byte-identical ClassificationStatistics to
+    the batch load_clf= run on the same inputs, and its run report
+    carries the serve block."""
+    base = (
+        f"info_file={session['info']}&fe=dwt-8-fused"
+        f"&load_clf=logreg&load_name={session['model']}"
+    )
+    batch = builder.PipelineBuilder(base).execute()
+    report_dir = str(tmp_path / "report")
+    pb = builder.PipelineBuilder(
+        base + f"&serve=true&report={report_dir}"
+    )
+    served = pb.execute()
+    assert str(served) == str(batch)
+    with open(os.path.join(report_dir, "run_report.json")) as f:
+        report = json.load(f)
+    block = report["serve"]
+    assert block["requests"]["completed"] == len(session["windows"])
+    assert block["requests"]["shed"] == 0
+    assert block["drained_cleanly"] is True
+    assert block["latency_ms"]["p50"] > 0.0
+    assert block["latency_ms"]["p99"] >= block["latency_ms"]["p50"]
+    # per-request spans + batch spans landed in the span summary
+    by_name = report["spans"]["by_name"]
+    assert by_name["serve.request"]["count"] == len(session["windows"])
+    assert by_name["serve.batch"]["count"] >= 1
+    # and the serve stage is in the timings
+    assert report["stages"]["serve"]["seconds"] > 0.0
+
+
+def test_serve_pipeline_conflicts(session):
+    q = f"info_file={session['info']}&fe=dwt-8-fused&serve=true"
+    with pytest.raises(ValueError, match="cannot combine"):
+        builder.PipelineBuilder(q + "&train_clf=logreg").execute()
+    with pytest.raises(ValueError, match="cannot combine"):
+        builder.PipelineBuilder(
+            q + f"&load_clf=logreg&load_name={session['model']}"
+            "&elastic=true"
+        ).execute()
+    with pytest.raises(ValueError, match="requires load_clf"):
+        builder.PipelineBuilder(q).execute()
+    with pytest.raises(ValueError, match="dwt-<i>-fused"):
+        builder.PipelineBuilder(
+            f"info_file={session['info']}&fe=dwt-8&serve=true"
+            f"&load_clf=logreg&load_name={session['model']}"
+        ).execute()
+    # explicitly-DISABLED knobs are no-ops, not conflicts (review
+    # regression: the check judges enabling conditions, not key
+    # presence)
+    st = builder.PipelineBuilder(
+        q + f"&load_clf=logreg&load_name={session['model']}"
+        "&elastic=false&save_clf=false&cv=1"
+    ).execute()
+    assert st.calc_accuracy() >= 0.0
+
+
+# -- micro-batching ------------------------------------------------------
+
+
+def test_concurrent_submits_coalesce_into_batches(session):
+    """Concurrent requests share compiled-program dispatches: the
+    batch counter stays well below the request counter."""
+    with _service(session, coalesce_s=0.02) as svc:
+        windows = session["windows"]
+        futs = [
+            svc.submit(
+                windows[i % len(windows)], session["resolutions"],
+                block_s=5.0,
+            )
+            for i in range(64)
+        ]
+        results = [f.result(timeout=30.0) for f in futs]
+    block = svc.stats_block()
+    assert block["requests"]["completed"] == 64
+    assert block["batches"] < 64
+    assert any(r.batch_size > 1 for r in results)
+    # coalesced results still match the batch path per-window
+    for i, r in enumerate(results):
+        expected = session["batch_predictions"][i % len(windows)]
+        assert r.prediction == expected
+
+
+def test_single_request_and_full_batch_share_one_program(session):
+    """Static capacity: batch sizes 1 and N reuse one executable (no
+    retrace under bursty load)."""
+    eng = engine.ServingEngine(
+        _loaded_classifier(session), capacity=8
+    )
+    p1, _ = eng.execute([session["windows"][0]], session["resolutions"])
+    p8, _ = eng.execute(session["windows"][:8], session["resolutions"])
+    assert p1.shape == (1,) and p8.shape == (8,)
+    np.testing.assert_array_equal(p8[:1], p1)
+    np.testing.assert_array_equal(
+        p8, session["batch_predictions"][:8]
+    )
+
+
+def _loaded_classifier(session):
+    c = clf_registry.create("logreg")
+    c.load(session["model"])
+    return c
+
+
+# -- admission control ---------------------------------------------------
+
+
+def test_admission_shed_with_evidence(session):
+    with _service(
+        session, max_batch=2, queue_depth=1, coalesce_s=0.2
+    ) as svc:
+        before = obs.metrics.snapshot()["counters"].get(
+            "serve.shed", 0.0
+        )
+        shed = 0
+        for _ in range(16):
+            try:
+                svc.submit(_WINDOW, _RES)
+            except ShedError as e:
+                shed += 1
+                assert "queue at depth 1" in str(e)
+        assert shed > 0
+        after = obs.metrics.snapshot()["counters"]["serve.shed"]
+        assert after - before == shed
+        assert svc.stats_block()["requests"]["shed"] >= shed
+
+
+def test_blocking_submit_cooperates_with_backpressure(session):
+    """block_s turns shedding into bounded waiting: a cooperative
+    producer never sheds while the consumer keeps up."""
+    with _service(session, queue_depth=4) as svc:
+        futs = [
+            svc.submit(
+                session["windows"][i % len(session["windows"])],
+                session["resolutions"], block_s=10.0,
+            )
+            for i in range(32)
+        ]
+        for f in futs:
+            f.result(timeout=30.0)
+    assert svc.stats_block()["requests"]["shed"] == 0
+
+
+# -- deadlines -----------------------------------------------------------
+
+
+def test_deadline_expired_in_queue_fails_fast(session):
+    """A request whose budget dies while queued is failed with the
+    time it waited, not executed into a useless answer."""
+    block = threading.Event()
+    svc = _service(session, watchdog_s=30.0)
+    real_execute = svc.batcher._execute
+    svc.batcher._execute = lambda *a: (block.wait(30), real_execute(*a))[1]
+    svc.start()
+    try:
+        # first request occupies the batcher; the second's 1 ms budget
+        # dies in the queue behind it
+        f1 = svc.submit(_WINDOW, _RES, deadline_s=60.0)
+        f2 = svc.submit(_WINDOW, _RES, deadline_s=0.001)
+        time.sleep(0.1)
+        block.set()
+        f1.result(timeout=30.0)
+        with pytest.raises(
+            deadline_mod.DeadlineExceededError, match="admission queue"
+        ):
+            f2.result(timeout=30.0)
+        assert svc.stats_block()["requests"]["deadline_exceeded"] == 1
+    finally:
+        block.set()
+        svc.stop(drain=False)
+
+
+# -- the watchdog --------------------------------------------------------
+
+
+def test_watchdog_fails_wedged_requests_fast(session):
+    """A wedged batcher costs callers watchdog_s, not forever: every
+    pending request resolves with evidence and new submissions are
+    rejected until restart."""
+    wedge = threading.Event()
+    svc = _service(session, watchdog_s=0.3, drain_timeout_s=0.5)
+    svc.batcher._execute = lambda *a, **k: wedge.wait(60) and None
+    svc.start()
+    try:
+        fut = svc.submit(_WINDOW, _RES)
+        with pytest.raises(ServiceWedgedError, match="heartbeat"):
+            fut.result(timeout=10.0)
+        with pytest.raises(ServiceWedgedError):
+            svc.submit(_WINDOW, _RES)
+        block = svc.stats_block()
+        assert block["watchdog_trips"] == 1
+        assert block["wedged"] is True
+        # a request that lands in the queue AFTER the trip (a
+        # submitter that was blocked in offer at trip time) is still
+        # swept and failed — the watchdog keeps resolving, not
+        # one-shot (review regression)
+        late = batcher_mod_request(svc)
+        svc.batcher.queue.readmit(late)
+        with pytest.raises(ServiceWedgedError, match="tripped earlier"):
+            late.future.result(timeout=5.0)
+    finally:
+        wedge.set()
+        svc.stop(drain=False)
+
+
+def batcher_mod_request(svc):
+    from eeg_dataanalysispackage_tpu.io import deadline as dmod
+    from eeg_dataanalysispackage_tpu.serve import batcher as bmod
+
+    return bmod.Request(
+        window=_WINDOW, resolutions=_RES, deadline=dmod.Deadline(30.0)
+    )
+
+
+# -- graceful drain ------------------------------------------------------
+
+
+def test_graceful_drain_completes_in_flight_rejects_new(session):
+    svc = _service(session)
+    svc.start()
+    futs = [
+        svc.submit(
+            session["windows"][i], session["resolutions"], block_s=5.0
+        )
+        for i in range(16)
+    ]
+    drained = svc.stop(drain=True)
+    assert drained is True
+    # everything admitted before the drain completed with answers
+    for i, f in enumerate(futs):
+        assert f.result(timeout=1.0).prediction == (
+            session["batch_predictions"][i]
+        )
+    with pytest.raises(ServiceClosedError, match="not accepting"):
+        svc.submit(_WINDOW, _RES)
+    assert svc.stats_block()["drained_cleanly"] is True
+
+
+# -- chaos ---------------------------------------------------------------
+
+
+def test_chaos_serve_faults_retry_to_clean_statistics(session):
+    """Deterministic single faults on both serve points are absorbed
+    by the retry machinery: statistics identical to the clean run,
+    firings and retries visible in metrics."""
+    q = (
+        f"info_file={session['info']}&fe=dwt-8-fused&serve=true"
+        f"&load_clf=logreg&load_name={session['model']}"
+    )
+    clean = builder.PipelineBuilder(q).execute()
+    before = obs.metrics.snapshot()["counters"]
+    chaosed = builder.PipelineBuilder(
+        q + "&faults=serve.request:once@5;serve.batch:once@2"
+    ).execute()
+    after = obs.metrics.snapshot()["counters"]
+    assert str(chaosed) == str(clean)
+    assert after["chaos.fired.serve.request"] - before.get(
+        "chaos.fired.serve.request", 0.0
+    ) == 1
+    assert after["chaos.fired.serve.batch"] - before.get(
+        "chaos.fired.serve.batch", 0.0
+    ) == 1
+    assert after["serve.retries"] > before.get("serve.retries", 0.0)
+
+
+def test_chaos_exhausted_retries_fail_with_history_not_wedge(session):
+    """A point that fires on EVERY attempt exhausts the retry budget:
+    the request fails with its attempt history — it never hangs, and
+    the service keeps serving afterwards."""
+    with _service(session, max_attempts=2) as svc:
+        with chaos.faults("serve.request:every@1"):
+            fut = svc.submit(session["windows"][0], session["resolutions"])
+            with pytest.raises(RequestFailedError, match="attempt 2"):
+                fut.result(timeout=10.0)
+        # chaos gone: the same service answers again (no wedge, no
+        # poisoned state)
+        r = svc.predict_window(
+            session["windows"][0], session["resolutions"]
+        )
+        assert r.prediction == session["batch_predictions"][0]
+        assert svc.stats_block()["requests"]["failed"] == 1
+
+
+def test_chaos_soak_every_request_resolves(session):
+    """The no-wedge contract under probabilistic faults: every
+    submitted request resolves one way or another and the drain
+    completes."""
+    resolved = failures = 0
+    with chaos.faults("serve.request:p=0.2;serve.batch:p=0.2;seed=11"):
+        with _service(
+            session, max_attempts=4, retry_backoff_s=0.01
+        ) as svc:
+            futs = []
+            for i in range(40):
+                try:
+                    futs.append(svc.submit(
+                        session["windows"][i % len(session["windows"])],
+                        session["resolutions"],
+                        deadline_s=10.0, block_s=10.0,
+                    ))
+                except ShedError:
+                    resolved += 1
+            for f in futs:
+                try:
+                    f.result(timeout=20.0)
+                    resolved += 1
+                except (RequestFailedError,
+                        deadline_mod.DeadlineExceededError):
+                    resolved += 1
+                    failures += 1
+    assert resolved == 40  # nothing hung, nothing vanished
+    assert svc.stats_block()["drained_cleanly"] is True
+
+
+# -- engine edges --------------------------------------------------------
+
+
+def test_engine_rejects_bad_shapes(session):
+    eng = engine.ServingEngine(_loaded_classifier(session), capacity=4)
+    # capacity buckets up to the batch planner's multiple (64): the
+    # program shape must match the batch path's for bit-parity
+    assert eng.capacity == 64
+    with pytest.raises(ValueError, match="shape"):
+        eng.execute([np.zeros((3, 10), np.int16)], _RES)
+    with pytest.raises(ValueError, match="capacity"):
+        eng.execute([_WINDOW] * 65, _RES)
+    preds, margins = eng.execute([], _RES)
+    assert preds.shape == (0,)
+
+
+def test_engine_degrades_to_host_floor_on_persistent_failure(session):
+    """The serving arm of the degradation ladder: persistent fused-
+    program failures step the engine down to the host featurize+
+    predict floor — the service keeps answering instead of dying,
+    and the step-down is counted and latched."""
+    eng = engine.ServingEngine(_loaded_classifier(session))
+    calls = {"n": 0}
+    real_program = eng._program
+
+    def flaky(*args):
+        calls["n"] += 1
+        raise RuntimeError("device backend broke mid-residency")
+
+    eng._program = flaky
+    before = obs.metrics.snapshot()["counters"].get(
+        "serve.degraded_to_host", 0.0
+    )
+    # failure 1: surfaces (the batcher's retry job)
+    with pytest.raises(RuntimeError, match="mid-residency"):
+        eng.execute(session["windows"][:4], session["resolutions"])
+    # failure 2: crosses the threshold — the engine lands on the host
+    # floor and ANSWERS
+    preds, margins = eng.execute(
+        session["windows"][:4], session["resolutions"]
+    )
+    assert eng.rung == "host"
+    assert margins is None
+    assert preds.shape == (4,)
+    after = obs.metrics.snapshot()["counters"]["serve.degraded_to_host"]
+    assert after - before == 1
+    # host-floor predictions agree with the fused path's on this
+    # session (tolerance-level features, identical decisions)
+    np.testing.assert_array_equal(
+        preds, session["batch_predictions"][:4]
+    )
+    # latched: later batches stay on the floor, no fused re-attempts
+    n_calls = calls["n"]
+    eng.execute(session["windows"][4:8], session["resolutions"])
+    assert calls["n"] == n_calls
+    eng._program = real_program
+
+
+def test_engine_host_fallback_for_non_linear(session, tmp_path):
+    """Non-linear classifiers serve through the fused featurizer plus
+    their own host predict — same parity contract, different mode."""
+    dt = clf_registry.create("dt")
+    dt.set_config({"config_max_depth": "3", "config_max_bins": "8",
+                   "config_impurity": "gini",
+                   "config_min_instances_per_node": "1"})
+    feats = session["batch_features"]
+    dt.fit(feats, session["targets"])
+    eng = engine.ServingEngine(dt, capacity=8)
+    assert eng.mode == "featurize+host"
+    preds, margins = eng.execute(
+        session["windows"][:8], session["resolutions"]
+    )
+    assert margins is None
+    np.testing.assert_array_equal(preds, dt.predict(feats[:8]))

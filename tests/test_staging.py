@@ -93,6 +93,53 @@ def test_prefetch_sharded_feeds_train_step():
     assert np.isfinite(float(loss))
 
 
+def test_prefetch_consumer_watchdog_detects_dead_producer(monkeypatch):
+    """ISSUE-6 satellite regression: a producer thread that dies
+    WITHOUT delivering its poison sentinel (here: its own failure
+    handling fails) must not hang the consumer forever — the timed
+    ``queue.get`` + liveness check fails the consumer fast and emits
+    ``staging.producer_dead``."""
+    from eeg_dataanalysispackage_tpu.obs import events
+
+    recorded = []
+
+    def exploding_event(name, **attrs):
+        recorded.append(name)
+        if name == "staging.producer_error":
+            # kill the producer inside its OWN failure path: the
+            # poison sentinel is never delivered — exactly the class
+            # of death the watchdog exists for
+            raise RuntimeError("failure handling failed too")
+
+    monkeypatch.setattr(events, "event", exploding_event)
+
+    def source():
+        yield (np.ones(2, np.float32),)
+        raise RuntimeError("source died")
+
+    it = staging.prefetch(source(), buffer_size=2, watchdog_poll_s=0.05)
+    next(it)  # batch 1 flows
+    with pytest.raises(staging.ProducerDiedError, match="died without"):
+        next(it)
+    assert "staging.producer_dead" in recorded
+
+
+def test_prefetch_watchdog_tolerates_slow_producer():
+    """The liveness check must not misfire on a producer that is
+    merely slow: a stage taking several poll intervals still
+    delivers."""
+    import time
+
+    def source():
+        yield (np.ones(2, np.float32),)
+        time.sleep(0.3)  # several watchdog polls
+        yield (np.full(2, 2.0, np.float32),)
+
+    got = list(staging.prefetch(source(), watchdog_poll_s=0.05))
+    assert len(got) == 2
+    np.testing.assert_array_equal(np.asarray(got[1][0]), [2.0, 2.0])
+
+
 def test_prefetch_undelivered_producer_error_is_logged(caplog):
     """The silent-loss fix: a producer that dies after the consumer
     walked away can no longer vanish — the stop-aware put gives up

@@ -34,7 +34,16 @@ performance contract holds:
   a span summary that actually recorded the stage spans, and
   feature-cache attribution identical to the bench line's
   ``feature_cache`` field (the report and the bench artifact must
-  tell the same story).
+  tell the same story);
+- the serving layer (serve_smoke, tools/serve_bench.py): every
+  concurrency level recorded p50/p99 latency and sustained
+  predictions/sec, shed requests are COUNTED (the depth-1 burst
+  probe shed and its counter matches), served predictions are
+  bit-identical to the batch pipeline's on the same epochs, the
+  chaos-injected soak (serve.request/serve.batch faults) terminated
+  cleanly with every request resolved and a completed drain, and the
+  ``serve=true`` pipeline run's ``run_report.json`` carries the
+  ``serve`` block.
 
 Usage: python tools/e2e_smoke.py [n_markers_per_file] [n_files]
 
@@ -51,6 +60,77 @@ import tempfile
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _PIPELINE_BENCH = os.path.join(_REPO, "tools", "pipeline_bench.py")
+_SERVE_BENCH = os.path.join(_REPO, "tools", "serve_bench.py")
+
+
+def _run_serve_bench(n_markers: int, n_files: int,
+                     report_dir: str) -> dict:
+    proc = subprocess.run(
+        [
+            sys.executable, _SERVE_BENCH, "serve_bench",
+            str(n_markers), str(n_files), f"--report-dir={report_dir}",
+        ],
+        capture_output=True,
+        text=True,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"serve_bench child failed rc={proc.returncode}\n"
+            f"{proc.stderr[-2000:]}"
+        )
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def _check_serve(line: dict, report_dir: str, failures: list) -> None:
+    """The serve_smoke gate: latency/throughput recorded per level,
+    sheds counted, parity pinned, chaos soak clean, serve block in
+    the run report."""
+    serve = line.get("serve") or {}
+    sweep = serve.get("sweep") or []
+    if not sweep:
+        failures.append("serve: no concurrency sweep recorded")
+    for level in sweep:
+        for key in ("p50_ms", "p99_ms", "preds_per_s"):
+            if not level.get(key, 0.0) > 0.0:
+                failures.append(
+                    f"serve: concurrency {level.get('concurrency')} "
+                    f"did not record {key}: {level}"
+                )
+    probe = serve.get("shed_probe") or {}
+    if not probe.get("ok"):
+        failures.append(
+            f"serve: shed probe failed (sheds must happen AND be "
+            f"counted): {probe}"
+        )
+    parity = serve.get("parity") or {}
+    if not parity.get("bit_identical"):
+        failures.append(
+            f"serve: served predictions drifted from the batch "
+            f"pipeline: {parity}"
+        )
+    chaos_block = serve.get("chaos") or {}
+    if not chaos_block.get("chaos_clean"):
+        failures.append(
+            f"serve: chaos soak did not terminate cleanly: "
+            f"{chaos_block}"
+        )
+    report_path = os.path.join(report_dir, "run_report.json")
+    try:
+        with open(report_path) as f:
+            report = json.load(f)
+    except (OSError, ValueError) as e:
+        failures.append(f"serve: no readable run_report.json: {e}")
+        return
+    block = report.get("serve")
+    if not block or "latency_ms" not in block:
+        failures.append(
+            f"serve: run_report.json has no serve block: {block}"
+        )
+    elif block.get("drained_cleanly") is not True:
+        failures.append(
+            f"serve: report says the drain did not complete: "
+            f"{block.get('drained_cleanly')}"
+        )
 
 
 def _run_variant(variant: str, n_markers: int, n_files: int,
@@ -192,6 +272,11 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
             data_dir, os.path.join(tmp, "cache_pop"),
             report_dirs["pop_looped"],
         )
+        serve_report_dir = os.path.join(tmp, "report_serve")
+        serve_line = _run_serve_bench(
+            min(n_markers, 400), n_files, serve_report_dir
+        )
+        _check_serve(serve_line, serve_report_dir, failures)
         cold_report = _check_report(
             "cold", cold, report_dirs["cold"], failures, reports_checked
         )
@@ -322,6 +407,15 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
         "compilations_singles": single_compiles,
         "compilations_singles_sum": c_singles_sum,
         "compilations_fanout5": c_fanout,
+        "serve_preds_per_s": (serve_line.get("serve") or {}).get(
+            "sweep", [{}]
+        )[-1].get("preds_per_s"),
+        "serve_shed_counted": (serve_line.get("serve") or {}).get(
+            "shed_probe", {}
+        ).get("counted_shed"),
+        "serve_chaos_clean": (serve_line.get("serve") or {}).get(
+            "chaos", {}
+        ).get("chaos_clean"),
         "reports_checked": len(reports_checked),
         "cold_stages": {
             k: v["seconds"] for k, v in cold.get("stages", {}).items()

@@ -135,6 +135,28 @@ def show(path: str) -> None:
         for leg, block in blocks.items():
             for line in _fmt_population(block, leg):
                 print(line)
+    serve = data.get("serve")
+    if serve:
+        print("\nserve:")
+        req = serve.get("requests", {})
+        lat = serve.get("latency_ms", {})
+        print(
+            f"  mode={serve.get('mode')}  batches="
+            f"{serve.get('batches')}  mean_batch="
+            f"{serve.get('mean_batch_size')}"
+        )
+        print(
+            f"  completed={req.get('completed')}  shed="
+            f"{req.get('shed')}  deadline_exceeded="
+            f"{req.get('deadline_exceeded')}  failed="
+            f"{req.get('failed')}  retries={req.get('retries')}"
+        )
+        print(
+            f"  latency p50={lat.get('p50')}ms p99={lat.get('p99')}ms "
+            f"max={lat.get('max')}ms  drained="
+            f"{serve.get('drained_cleanly')}  wedged="
+            f"{serve.get('wedged')}"
+        )
     deg = data.get("degradation") or []
     if deg:
         print("\ndegradation history:")

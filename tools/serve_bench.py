@@ -1,0 +1,346 @@
+"""Serving-path benchmark child (the serve_bench family).
+
+Usage: python tools/serve_bench.py serve_bench <n_markers> <n_files>
+           [--report-dir D]
+
+One hermetic run proves the serving layer's whole contract and prints
+one JSON line in the driver-facing schema (bench.py whitelists the
+``serve`` field through to the artifact):
+
+- **latency/throughput sweep** — a closed-loop load generator drives
+  the resident service at swept concurrency (1/4/16 submitters);
+  each level records p50/p99 latency (ms) and sustained
+  predictions/sec, plus any sheds at that level;
+- **parity pin** — served predictions are compared element-wise
+  against the batch pipeline's (``load_features_device`` features +
+  ``classifier.predict`` on the same epochs); the line records
+  ``bit_identical`` and the driver's smoke gate fails if it is false;
+- **shed probe** — a burst against a depth-1 queue must shed (and
+  count every shed): admission control provably rejects-with-evidence
+  rather than queueing without bound;
+- **chaos soak** — with ``serve.request``/``serve.batch`` faults
+  firing at p=0.1, every submitted request must still RESOLVE
+  (answer, shed, deadline-exceeded, or failure with evidence — no
+  hang) and the graceful drain must complete; ``chaos_clean`` records
+  the verdict.
+
+Everything is fabricated by tests/_synthetic.py; the model is trained
+and saved by the real pipeline in-process before the service loads it.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tests"))
+
+# hermetic: no cross-run feature-cache coupling; serving measures the
+# resident program, not cache luck
+os.environ["EEG_TPU_NO_FEATURE_CACHE"] = "1"
+
+_MARKER_STRIDE = 1000
+#: raw int16 bytes per served window (3 channels x 850 samples x 2 B)
+_BYTES_PER_EPOCH = 3 * 850 * 2
+
+_CONFIG = (
+    "&config_num_iterations=20&config_step_size=1.0"
+    "&config_mini_batch_fraction=1.0"
+)
+
+_SWEEP_CONCURRENCY = (1, 4, 16)
+#: requests per sweep level (windows recycle round-robin);
+#: SERVE_BENCH_REQUESTS overrides (e.g. for a longer chip soak)
+_REQUESTS_PER_LEVEL = int(os.environ.get("SERVE_BENCH_REQUESTS", 400))
+
+
+def _build_session(data_dir: str, n_markers: int, n_files: int) -> str:
+    import _synthetic
+
+    lines = []
+    for i in range(n_files):
+        name = f"synth_{i:02d}"
+        guessed = 2 + (i % 7)
+        _synthetic.write_recording(
+            data_dir, name=name, n_markers=n_markers, guessed=guessed,
+            seed=i, marker_stride=_MARKER_STRIDE,
+        )
+        lines.append(f"{name}.eeg {guessed}")
+    info = os.path.join(data_dir, "info.txt")
+    with open(info, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return info
+
+
+def _drive_level(service, windows, resolutions, concurrency: int,
+                 n_requests: int, deadline_s: float) -> dict:
+    """Closed-loop load at one concurrency level: ``concurrency``
+    submitter threads, each waiting for its own previous result
+    before submitting the next (classic closed-loop load)."""
+    from eeg_dataanalysispackage_tpu.io import deadline as deadline_mod
+    from eeg_dataanalysispackage_tpu.serve import batcher as batcher_mod
+
+    per_thread = max(1, n_requests // concurrency)
+    latencies = []
+    # deadline/shed/failed are RESOLVED outcomes (the service answered
+    # with evidence); unresolved — a future nobody ever resolved — is
+    # the only bad one, and the no-wedge contract says it stays 0
+    outcomes = {
+        "completed": 0, "shed": 0, "deadline": 0, "failed": 0,
+        "unresolved": 0,
+    }
+    lock = threading.Lock()
+
+    def submitter(tid: int) -> None:
+        for i in range(per_thread):
+            w = windows[(tid + i * concurrency) % len(windows)]
+            try:
+                fut = service.submit(
+                    w, resolutions, deadline_s=deadline_s,
+                    block_s=deadline_s,
+                )
+                r = fut.result(timeout=deadline_s + 10.0)
+                with lock:
+                    outcomes["completed"] += 1
+                    latencies.append(r.latency_s)
+            except batcher_mod.ShedError:
+                with lock:
+                    outcomes["shed"] += 1
+            except deadline_mod.DeadlineExceededError:
+                # subclasses TimeoutError but IS a resolution: the
+                # request was failed with deadline evidence
+                with lock:
+                    outcomes["deadline"] += 1
+            except TimeoutError:
+                with lock:
+                    outcomes["unresolved"] += 1
+            except batcher_mod.ServeError:
+                with lock:
+                    outcomes["failed"] += 1
+
+    threads = [
+        threading.Thread(target=submitter, args=(t,), daemon=True)
+        for t in range(concurrency)
+    ]
+    start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - start
+    # the same nearest-rank percentile the service's stats block uses
+    from eeg_dataanalysispackage_tpu.serve.service import _percentile
+
+    lat = sorted(latencies)
+    return {
+        "concurrency": concurrency,
+        "requests": per_thread * concurrency,
+        **outcomes,
+        "wall_s": round(wall, 3),
+        "preds_per_s": round(outcomes["completed"] / wall, 1)
+        if wall > 0 else 0.0,
+        "p50_ms": round(_percentile(lat, 50.0) * 1e3, 3),
+        "p99_ms": round(_percentile(lat, 99.0) * 1e3, 3),
+    }
+
+
+def run(n_markers: int, n_files: int, report_dir=None) -> dict:
+    import numpy as np
+
+    from eeg_dataanalysispackage_tpu.epochs.extractor import BalanceState
+    from eeg_dataanalysispackage_tpu.io import provider
+    from eeg_dataanalysispackage_tpu.models import registry as clf_registry
+    from eeg_dataanalysispackage_tpu.obs import chaos
+    from eeg_dataanalysispackage_tpu.pipeline import builder
+    from eeg_dataanalysispackage_tpu.serve import (
+        InferenceService, ServeConfig, ShedError, engine,
+    )
+
+    t0 = time.perf_counter()
+    tmp = tempfile.mkdtemp(prefix="eeg_tpu_serve_bench_")
+    info = _build_session(tmp, n_markers, n_files)
+    model = os.path.join(tmp, "model")
+
+    # 1. train + save the model with the real pipeline (load-once is
+    # the serving story; training cost is not measured)
+    builder.PipelineBuilder(
+        f"info_file={info}&fe=dwt-8-fused&train_clf=logreg"
+        f"&save_clf=true&save_name={model}&cache=false{_CONFIG}"
+    ).execute()
+
+    # 2. the session as serving requests + the batch-path baseline
+    odp = provider.OfflineDataProvider([info])
+    balance = BalanceState()
+    windows, resolutions = [], None
+    for _rel, guessed, rec in odp.iter_recordings():
+        ws, _targets, resolutions = engine.windows_from_recording(
+            rec, odp.channel_indices_for(rec), guessed,
+            pre=odp.pre, post=odp.post, balance=balance,
+        )
+        windows.extend(ws)
+    classifier = clf_registry.create("logreg")
+    classifier.load(model)
+    batch_features, _ = provider.OfflineDataProvider(
+        [info]
+    ).load_features_device(wavelet_index=8, backend="xla")
+    batch_predictions = classifier.predict(batch_features)
+
+    service = InferenceService.from_saved("logreg", model)
+    service.start()
+    try:
+        # 3. parity: served == batch, element-wise
+        results = service.predict_all(windows, resolutions)
+        served = np.array([r.prediction for r in results])
+        parity = {
+            "n": len(windows),
+            "bit_identical": bool(
+                np.array_equal(served, batch_predictions)
+            ),
+            "mismatches": int((served != batch_predictions).sum()),
+        }
+
+        # 4. the concurrency sweep
+        sweep = [
+            _drive_level(
+                service, windows, resolutions, c,
+                _REQUESTS_PER_LEVEL, deadline_s=5.0,
+            )
+            for c in _SWEEP_CONCURRENCY
+        ]
+    finally:
+        service.stop(drain=True)
+    stats = service.stats_block()
+
+    # 5. shed probe: a burst against a depth-1 queue MUST shed, and
+    # every shed must be counted (never a silent drop)
+    probe = InferenceService(
+        classifier, config=ServeConfig(
+            max_batch=2, queue_depth=1, coalesce_s=0.2,
+        ),
+    )
+    probe.start()
+    shed = 0
+    futs = []
+    for i in range(32):
+        try:
+            futs.append(probe.submit(windows[0], resolutions))
+        except ShedError:
+            shed += 1
+    probe.stop(drain=True)
+    probe_counters = probe.stats_block()["requests"]
+    shed_probe = {
+        "burst": 32,
+        "shed": shed,
+        "counted_shed": probe_counters["shed"],
+        "ok": shed > 0 and probe_counters["shed"] == shed,
+    }
+
+    # 6. chaos soak: with request/batch faults firing, every request
+    # resolves and the drain completes — the no-wedge contract
+    soak = InferenceService(
+        classifier, config=ServeConfig(
+            max_attempts=4, retry_backoff_s=0.01,
+            default_deadline_s=5.0,
+        ),
+    )
+    from eeg_dataanalysispackage_tpu.io import deadline as deadline_mod
+
+    outcomes = {
+        "completed": 0, "shed": 0, "deadline": 0, "failed": 0,
+        "unresolved": 0,
+    }
+    with chaos.faults(
+        "serve.request:p=0.1;serve.batch:p=0.1;seed=7"
+    ):
+        soak.start()
+        futures = []
+        for i in range(min(len(windows) * 2, 400)):
+            try:
+                futures.append(soak.submit(
+                    windows[i % len(windows)], resolutions,
+                    deadline_s=5.0, block_s=5.0,
+                ))
+            except ShedError:
+                outcomes["shed"] += 1
+        for fut in futures:
+            try:
+                fut.result(timeout=20.0)
+                outcomes["completed"] += 1
+            except deadline_mod.DeadlineExceededError:
+                # resolved WITH deadline evidence — a clean outcome
+                # under the no-wedge contract, not an unresolved hang
+                outcomes["deadline"] += 1
+            except TimeoutError:
+                outcomes["unresolved"] += 1
+            except Exception:
+                outcomes["failed"] += 1
+        drained = soak.stop(drain=True)
+    chaos_block = {
+        **outcomes,
+        "drained_cleanly": drained,
+        "chaos_clean": outcomes["unresolved"] == 0 and drained,
+        "soak_counters": soak.stats_block()["requests"],
+    }
+
+    # 7. optional run_report.json with the serve block, via the real
+    # serve=true pipeline mode (the smoke gate cross-checks it)
+    if report_dir:
+        builder.PipelineBuilder(
+            f"info_file={info}&fe=dwt-8-fused&serve=true"
+            f"&load_clf=logreg&load_name={model}&report={report_dir}"
+        ).execute()
+
+    import jax
+
+    from eeg_dataanalysispackage_tpu.io import feature_cache
+    from eeg_dataanalysispackage_tpu.ops import plan_cache
+    from eeg_dataanalysispackage_tpu.utils import compile_cache
+
+    best = max(s["preds_per_s"] for s in sweep)
+    pstats = plan_cache.stats()
+    return {
+        "variant": "serve_bench",
+        "epochs_per_s": best,
+        "n": len(windows),
+        "iters": _REQUESTS_PER_LEVEL,
+        "bytes_per_epoch": _BYTES_PER_EPOCH,
+        "wall_s": round(time.perf_counter() - t0, 3),
+        "n_markers_per_file": n_markers,
+        "n_files": n_files,
+        "platform": jax.devices()[0].platform,
+        "serve": {
+            "sweep": sweep,
+            "parity": parity,
+            "shed_probe": shed_probe,
+            "chaos": chaos_block,
+            "service": stats,
+        },
+        "plan_cache": {
+            "hits": pstats["hits"], "misses": pstats["misses"],
+        },
+        "compile_cache": compile_cache.active_cache_dir(),
+        "feature_cache": feature_cache.stats(),
+    }
+
+
+def main(argv) -> dict:
+    variant = argv[0] if argv else "serve_bench"
+    if variant != "serve_bench":
+        raise SystemExit(f"unknown variant {variant!r}")
+    n_markers = int(argv[1]) if len(argv) > 1 else 400
+    n_files = int(argv[2]) if len(argv) > 2 else 2
+    report_dir = None
+    for arg in argv[3:]:
+        if arg.startswith("--report-dir="):
+            report_dir = arg.split("=", 1)[1]
+        else:
+            raise SystemExit(f"unknown argument {arg!r}")
+    return run(n_markers, n_files, report_dir=report_dir)
+
+
+if __name__ == "__main__":
+    print(json.dumps(main(sys.argv[1:])))
