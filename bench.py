@@ -42,6 +42,13 @@ train-step variants (tools/ingest_bench.py) with HBM-roofline context:
                   stage delta is the engine's win) and the per-member
                   accuracy table, with report_sha256 equality across
                   the pair proving per-member statistics parity
+  seizure_e2e     the continuous-EEG seizure workload (task=seizure,
+                  docs/workloads.md): sliding-window epoching over an
+                  annotated synthetic session, per-subband wavelet
+                  features, cost-sensitive logreg — the line's
+                  ``seizure`` block records windows/sec, the class
+                  ratio, and recall/expected-cost at the configured
+                  asymmetric costs (tools/pipeline_bench.py)
   serve_bench     the resident online inference service (serve/):
                   p50/p99 latency and sustained predictions/sec at
                   swept concurrency through the micro-batching front
@@ -138,7 +145,7 @@ _VARIANT_TIMEOUTS = {
 # patience — on a warm compile cache everything fits easily; on a
 # cold cache the tail variants may be budget-skipped (recorded as
 # such, artifact intact). BENCH_TOTAL_BUDGET overrides.
-_N_VARIANTS = 17  # asserted against the variant tables below
+_N_VARIANTS = 18  # asserted against the variant tables below
 _TOTAL_BUDGET_S = int(
     os.environ.get(
         "BENCH_TOTAL_BUDGET",
@@ -199,6 +206,11 @@ _VARIANTS_TPU = {
     # SGD members as one vmapped program vs the same members looped
     "population_vmap": (800, 2),
     "population_looped": (800, 2),
+    # the continuous-EEG seizure workload (samples per file, file
+    # count — tools/pipeline_bench.py seizure_e2e): sliding windows +
+    # subband features + cost-sensitive training; the line records
+    # windows/sec, class ratio, recall and expected cost
+    "seizure_e2e": (120000, 2),
     # online inference service (markers per file, file count):
     # latency/throughput sweep + parity pin + chaos soak
     "serve_bench": (2000, 2),
@@ -220,6 +232,7 @@ _VARIANTS_CPU = {
     "pipeline_e2e_fanout5": (2000, 4),
     "population_vmap": (800, 2),
     "population_looped": (800, 2),
+    "seizure_e2e": (60000, 2),
     "serve_bench": (400, 2),
 }
 assert len(_VARIANTS_TPU) == len(_VARIANTS_CPU) == _N_VARIANTS
@@ -364,7 +377,7 @@ def _run_variant(variant: str, platform: str, n: int, iters: int) -> dict:
     # file-count); serve_bench drives the resident inference service
     # (tools/serve_bench.py, same n/iters meaning); everything else
     # is a kernel variant through tools/ingest_bench.py
-    if variant.startswith(("pipeline_e2e", "population_")):
+    if variant.startswith(("pipeline_e2e", "population_", "seizure_")):
         script = "pipeline_bench.py"
     elif variant.startswith("serve_"):
         script = "serve_bench.py"
@@ -551,7 +564,7 @@ def _collect(platform: str) -> dict:
             for extra_field in (
                 "plan_cache", "compile_cache", "feature_cache",
                 "wall_s", "classifiers", "accuracy", "report_sha256",
-                "stages", "population", "serve",
+                "stages", "population", "serve", "seizure",
             ):
                 if extra_field in r:
                     variants[name][extra_field] = r[extra_field]

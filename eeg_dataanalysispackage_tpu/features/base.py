@@ -32,3 +32,17 @@ class FeatureExtraction(abc.ABC):
     def extract_features(self, epoch: np.ndarray) -> np.ndarray:
         """Single-epoch adapter matching the reference signature."""
         return np.asarray(self.extract_batch(np.asarray(epoch)[None]))[0]
+
+    def cache_id(self) -> tuple:
+        """The extractor's FULL static configuration as a hashable
+        tuple — the component the content-addressed feature cache
+        (io/feature_cache.py) folds into its key. Every config knob
+        that changes the feature values MUST appear here; a backend
+        choice that only changes where tolerance-identical numerics
+        run must not (the degradation-ladder rung contract). Concrete
+        extractors override; the default refuses rather than risk a
+        cross-config cache hit."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not define a feature-cache "
+            f"config identity"
+        )

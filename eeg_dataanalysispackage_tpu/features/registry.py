@@ -6,6 +6,17 @@ Parity with the reference's hard-coded switch
 ``dwt-8-tpu`` (same math, batched XLA backend) per BASELINE.json's
 north star, plus a generic ``dwt-<n>`` family for the other registry
 indices. Unknown names raise the reference's error message.
+
+Extended grammar (the seizure workload, docs/workloads.md): a plain
+name may carry ``:``-separated options —
+
+    dwt-<family>:level=<L>[:stats=<s1>,<s2>,...]
+
+which selects :class:`features.subband.SubbandWaveletFeatures`
+(pluggable wavelet family / decomposition level / per-subband
+statistic set) instead of the raw-coefficient extractor. Plain names
+(no ``:``) resolve exactly as before — the P300 surface is
+byte-unchanged.
 """
 
 from __future__ import annotations
@@ -22,7 +33,47 @@ def register(name: str, factory: Callable[[], base.FeatureExtraction]) -> None:
     _REGISTRY[name] = factory
 
 
+def _create_subband(base_name: str, opts: list) -> base.FeatureExtraction:
+    """``dwt-<family>:level=<L>[:stats=...]`` -> SubbandWaveletFeatures.
+
+    The options ride the full raw parameter value (the builder
+    re-extracts ``fe=`` verbatim via ``get_raw_param`` — the query
+    map's second-``=`` truncation quirk would otherwise eat
+    ``level=4``)."""
+    from . import subband
+
+    m = re.fullmatch(r"dwt-(\d+)", base_name)
+    if m is None:
+        raise ValueError(
+            "subband options (level=/stats=) apply to the plain "
+            f"dwt-<family> form, got {base_name!r}"
+        )
+    kwargs: Dict = {"name": int(m.group(1))}
+    for opt in opts:
+        key, sep, value = opt.partition("=")
+        if not sep or not value:
+            raise ValueError(
+                f"malformed fe= option {opt!r}; expected level=<n> or "
+                f"stats=<s1>,<s2>"
+            )
+        if key == "level":
+            try:
+                kwargs["level"] = int(value)
+            except ValueError:
+                raise ValueError(f"fe= level must be an integer, got {value!r}")
+        elif key == "stats":
+            kwargs["stats"] = tuple(s for s in value.split(",") if s)
+        else:
+            raise ValueError(
+                f"unknown fe= option {key!r}; supported: level, stats"
+            )
+    return subband.SubbandWaveletFeatures(**kwargs)
+
+
 def create(name: str) -> base.FeatureExtraction:
+    base_name, sep, rest = name.partition(":")
+    if sep:
+        return _create_subband(base_name, rest.split(":"))
     if name in _REGISTRY:
         return _REGISTRY[name]()
     m = re.fullmatch(

@@ -201,6 +201,22 @@ class WaveletTransform(base.FeatureExtraction):
         flat = coeffs.reshape(epochs.shape[0], -1)
         return dwt_host.l2_normalize_seq(flat)
 
+    def cache_id(self) -> tuple:
+        """Full config identity for the feature cache: wavelet family,
+        window geometry, coefficient count, channel set, and the
+        PRECISION CLASS. The backend itself is deliberately absent —
+        the host/xla/pallas f32-or-better backends compute the same
+        features to rung tolerance (io/provider's ladder contract) —
+        but the bf16 backends trade ~2e-3 absolute feature deviation
+        for bandwidth (module docstring), far past that tolerance, so
+        they key separately: a bf16 entry must never satisfy an
+        f32-class request, or vice versa."""
+        precision = "bf16" if "bf16" in self._backend else "f32"
+        return (
+            "dwt", self.name, self.epoch_size, self.skip_samples,
+            self.feature_size, tuple(self.channels), precision,
+        )
+
     # -- config equality (WaveletTransform.java:223-244) ---------------
 
     def __eq__(self, other) -> bool:

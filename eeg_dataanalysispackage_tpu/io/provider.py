@@ -127,6 +127,13 @@ class PreparedRun:
 
     key: str
     recordings: List[Tuple[str, int, "brainvision.Recording"]]
+    #: the ordered (rel_path, guessed, content digest) triples behind
+    #: ``key`` — kept so callers with SEVERAL extractor configs per
+    #: run (the seizure path's fe_sweep=) derive each config's cache
+    #: key from the same single read pass instead of re-digesting
+    digests: List[Tuple[str, int, str]] = dataclasses.field(
+        default_factory=list
+    )
 
 
 class OfflineDataProvider:
@@ -357,7 +364,24 @@ class OfflineDataProvider:
             digests, self._channel_names, self._pre, self._post,
             extractor_id,
         )
-        return PreparedRun(key=key, recordings=recordings)
+        return PreparedRun(key=key, recordings=recordings, digests=digests)
+
+    # the one-read-pass seam is extractor-agnostic (the id tuple is
+    # opaque to the digest); the seizure path reuses it with its own
+    # full extractor-config tuple, so the workloads share the
+    # exactly-once read contract
+    prepare_run = prepare_fused_run
+
+    def run_key_for(self, prepared: PreparedRun, extractor_id: Tuple) -> str:
+        """A further extractor config's cache key over an existing
+        :class:`PreparedRun`'s digests — no re-read, no re-digest
+        (the fe_sweep= path keys one entry per feature config)."""
+        from . import feature_cache
+
+        return feature_cache.run_key(
+            prepared.digests, self._channel_names, self._pre, self._post,
+            extractor_id,
+        )
 
     def content_digests(self) -> List[Tuple[str, int, str]]:
         """Ordered ``(rel_path, guessed, content digest)`` for every
@@ -390,6 +414,32 @@ class OfflineDataProvider:
 
     # Reference-compatible alias (OffLineDataProvider.loadData).
     load_data = load
+
+    def load_sliding(self, config) -> extractor.EpochBatch:
+        """Continuous sliding-window epoching (the seizure workload):
+        every resolvable recording is cut into
+        ``(n, n_channels, window)`` windows labeled from its
+        ``Seizure`` interval annotations (epochs/sliding.py), through
+        the same bounded parse pool + order-preserving merge as
+        :meth:`load`. The manifest's guessed numbers are ignored —
+        labels come from the annotations, not a stimulus match — and
+        there is no balance scan: class imbalance is the workload.
+        ``config`` is an ``epochs.sliding.SlidingConfig``."""
+        prefix, files = self._resolve_files()
+        batches: List[extractor.EpochBatch] = []
+        for _rel, _guessed, rec, _ in self._iter_recordings(prefix, files):
+            batches.append(self.sliding_batch_for(rec, config))
+        self._batch = extractor.EpochBatch.concatenate(batches)
+        return self._batch
+
+    def sliding_batch_for(self, rec, config) -> extractor.EpochBatch:
+        """One recording's sliding-window batch (scaled float64
+        channels -> epochs/sliding.py); public so the serving layer
+        derives byte-identical windows from the same seam."""
+        from ..epochs import sliding
+
+        channels = rec.read_channels(self._channel_indices(rec))
+        return sliding.extract_sliding_epochs(channels, rec.markers, config)
 
     def iter_recordings(self) -> Iterator[Tuple[str, int, "brainvision.Recording"]]:
         """Public ordered recording stream: ``(rel_path, guessed,

@@ -53,6 +53,17 @@ class _LinearClassifier(base.Classifier):
     def _sgd_config(self) -> sgd.SGDConfig:
         raise NotImplementedError
 
+    def _class_weights(self) -> dict:
+        """Cost-sensitive class weights from the opaque config
+        (``config_weight_pos`` / ``config_weight_neg`` — what the
+        pipeline's ``class_weight=`` / ``cost_fp=`` / ``cost_fn=``
+        knobs resolve to; docs/workloads.md). Absent keys mean 1.0,
+        which trains the exact pre-knob program."""
+        return {
+            "weight_pos": float(self.config.get("config_weight_pos", 1.0)),
+            "weight_neg": float(self.config.get("config_weight_neg", 1.0)),
+        }
+
     def fit(self, features: np.ndarray, labels: np.ndarray) -> None:
         self.weights = sgd.train_linear(features, labels, self._sgd_config())
         # training replaces any imported MLlib state: native MLlib-SGD
@@ -268,13 +279,14 @@ class LogisticRegressionClassifier(_LinearClassifier):
                 mini_batch_fraction=float(c["config_mini_batch_fraction"]),
                 reg_param=0.0,
                 loss="logistic",
+                **self._class_weights(),
             )
         # the no-config path runs the default constructor
         # LogisticRegressionWithSGD(1.0, 100, 0.01, 1.0), whose updater
         # is SquaredL2Updater — L2 with regParam 0.01 applies
         return sgd.SGDConfig(
             num_iterations=100, step_size=1.0, mini_batch_fraction=1.0,
-            reg_param=0.01, loss="logistic",
+            reg_param=0.01, loss="logistic", **self._class_weights(),
         )
 
 
@@ -306,9 +318,10 @@ class SVMClassifier(_LinearClassifier):
                 mini_batch_fraction=float(c["config_mini_batch_fraction"]),
                 reg_param=float(c["config_reg_param"]),
                 loss="hinge",
+                **self._class_weights(),
             )
         # MLlib SVMWithSGD().run defaults
         return sgd.SGDConfig(
             num_iterations=100, step_size=1.0, mini_batch_fraction=1.0,
-            reg_param=0.01, loss="hinge",
+            reg_param=0.01, loss="hinge", **self._class_weights(),
         )

@@ -223,7 +223,15 @@ class _Net(linen.Module):
         return x
 
 
-def _loss_fn(name: str):
+def _loss_fn(name: str, weight_pos: float = 1.0, weight_neg: float = 1.0):
+    """The configured loss; with non-unit class weights, the
+    cost-sensitive variant (seizure workload): each sample's loss term
+    scales by its class's weight — positives (``y[..., 0] == 1``, the
+    one-hot pair convention) by ``weight_pos``, negatives by
+    ``weight_neg`` — normalized by the weight sum. Unit weights (the
+    default) return the EXACT pre-knob closures, so P300 training is
+    byte-unchanged."""
+
     def mse(pred, y):
         return jnp.mean((pred - y) ** 2)
 
@@ -235,8 +243,28 @@ def _loss_fn(name: str):
         p = jnp.clip(pred, 1e-7, 1.0)
         return -jnp.mean(jnp.sum(y * jnp.log(p), axis=-1))
 
-    return {"mse": mse, "xent": xent, "squared_loss": mse,
-            "negativeloglikelihood": nll}.get(name, mse)
+    losses = {"mse": mse, "xent": xent, "squared_loss": mse,
+              "negativeloglikelihood": nll}
+    if weight_pos == 1.0 and weight_neg == 1.0:
+        return losses.get(name, mse)
+
+    def per_sample(pred, y):
+        if name == "xent":
+            p = jnp.clip(pred, 1e-7, 1 - 1e-7)
+            return -jnp.mean(
+                y * jnp.log(p) + (1 - y) * jnp.log1p(-p), axis=-1
+            )
+        if name == "negativeloglikelihood":
+            p = jnp.clip(pred, 1e-7, 1.0)
+            return -jnp.sum(y * jnp.log(p), axis=-1)
+        return jnp.mean((pred - y) ** 2, axis=-1)  # mse family
+
+    def weighted(pred, y):
+        t = y[..., 0]  # the [target, 1-target] one-hot convention
+        w = t * weight_pos + (1.0 - t) * weight_neg
+        return jnp.sum(w * per_sample(pred, y)) / jnp.sum(w)
+
+    return weighted
 
 
 def _make_backprop_step(model, tx, needs_value_fn, loss, rng, x, y):
@@ -435,6 +463,10 @@ class NeuralNetworkClassifier(base.Classifier):
             # Boolean.parseBoolean semantics: "true" (any case) is true
             "pretrain": self._require("config_pretrain").lower() == "true",
             "backprop": self._require("config_backprop").lower() == "true",
+            # cost-sensitive class weights (optional; absent = 1.0,
+            # the byte-identical pre-knob loss — docs/workloads.md)
+            "weight_pos": float(self.config.get("config_weight_pos", 1.0)),
+            "weight_neg": float(self.config.get("config_weight_neg", 1.0)),
         }
 
     def _prepare_fit(self, features: np.ndarray, labels: np.ndarray):
@@ -471,7 +503,10 @@ class NeuralNetworkClassifier(base.Classifier):
         rng = jax.random.PRNGKey(seed)
         params = model.init({"params": rng, "dropout": rng}, x[:1], train=False)
         tx, needs_value_fn = _optimizer(algo, updater_name, lr, momentum)
-        loss = _loss_fn(self.config.get("config_loss_function", "mse"))
+        loss = _loss_fn(
+            self.config.get("config_loss_function", "mse"),
+            weight_pos=c["weight_pos"], weight_neg=c["weight_neg"],
+        )
 
         if pretrain:
             params = self._greedy_pretrain(
@@ -640,7 +675,10 @@ class NeuralNetworkClassifier(base.Classifier):
             "n_in": int(x.shape[-1]),
         }
         model = self._build()
-        loss = _loss_fn(self.config.get("config_loss_function", "mse"))
+        loss = _loss_fn(
+            self.config.get("config_loss_function", "mse"),
+            weight_pos=c["weight_pos"], weight_neg=c["weight_neg"],
+        )
         momentum = c["momentum"]
         updater_name = c["updater_name"]
 

@@ -50,10 +50,14 @@ logger = logging.getLogger(__name__)
 SGD_FAMILY = ("logreg", "svm", "nn")
 
 #: sweep axes the grammar accepts (lr = step size / learning rate,
-#: reg = L2 regularization — linear family only)
-_SWEEP_AXES = ("lr", "reg")
+#: reg = L2 regularization — linear family only; cost_fp/cost_fn =
+#: cost-sensitive class weights, the seizure workload's sweep —
+#: cost_fn weights the positive class, cost_fp the negative)
+_SWEEP_AXES = ("lr", "reg", "cost_fp", "cost_fn")
 
-_QUERY_KEYS = ("cv", "cv_mode", "seeds", "sweep", "population_mode")
+_QUERY_KEYS = (
+    "cv", "cv_mode", "seeds", "sweep", "population_mode", "fe_sweep"
+)
 
 
 def parse_sweep(spec: str) -> Tuple[Tuple[str, Tuple[float, ...]], ...]:
@@ -106,6 +110,12 @@ class PopulationSpec:
     seeds: int = 1
     sweep: Tuple[Tuple[str, Tuple[float, ...]], ...] = ()
     mode: str = "vmap"  # "vmap" | "looped"
+    #: feature-config comparison axis (``fe_sweep=cfg1|cfg2`` — full
+    #: fe= grammar strings): every member trains against its config's
+    #: feature matrix, stacked onto the vmapped program's member axis
+    #: (parallel/population.py ``stacked_features``). Seizure
+    #: workload, linear family only (docs/workloads.md).
+    fe_configs: Tuple[str, ...] = ()
 
     @classmethod
     def from_query_map(cls, query_map: Dict[str, str]) -> "PopulationSpec":
@@ -127,7 +137,18 @@ class PopulationSpec:
             seeds=_int("seeds", 1),
             sweep=parse_sweep(query_map.get("sweep", "")),
             mode=query_map.get("population_mode", "") or "vmap",
+            # the builder normalizes fe_sweep= to its raw value (the
+            # configs' level=/stats= '='s survive the query map's
+            # second-'=' truncation quirk)
+            fe_configs=tuple(
+                s for s in query_map.get("fe_sweep", "").split("|") if s
+            ),
         )
+        if len(set(spec.fe_configs)) != len(spec.fe_configs):
+            raise ValueError(
+                "fe_sweep= repeats a feature config; duplicate members "
+                "would train the same model twice"
+            )
         if spec.cv < 1:
             raise ValueError("cv= must be >= 1")
         if spec.seeds < 1:
@@ -148,7 +169,10 @@ class PopulationSpec:
         """True when the run asked for more than the plain split's
         single model — the builder routes SGD-family training through
         the population engine iff this holds."""
-        return self.cv > 1 or self.seeds > 1 or bool(self.sweep)
+        return (
+            self.cv > 1 or self.seeds > 1 or bool(self.sweep)
+            or bool(self.fe_configs)
+        )
 
     def axis_values(self, axis: str) -> Optional[Tuple[float, ...]]:
         for name, values in self.sweep:
@@ -163,13 +187,16 @@ class PopulationSpec:
         return points
 
     def describe(self) -> Dict:
-        return {
+        out = {
             "folds": self.cv,
             "cv_mode": self.cv_mode if self.cv > 1 else "plain_split",
             "seeds": self.seeds,
             "grid": {name: list(values) for name, values in self.sweep},
             "grid_points": self.grid_points(),
         }
+        if self.fe_configs:
+            out["fe_configs"] = list(self.fe_configs)
+        return out
 
 
 def folds_for(spec: PopulationSpec, n: int) -> List[Tuple[List[int], List[int]]]:
@@ -213,20 +240,30 @@ def folds_for(spec: PopulationSpec, n: int) -> List[Tuple[List[int], List[int]]]
 @dataclasses.dataclass(frozen=True)
 class Member:
     """One population member: a fold, a seed, and grid overrides
-    (None = the classifier config's base value)."""
+    (None = the classifier config's base value). ``fe`` indexes the
+    spec's ``fe_configs`` when a feature-config axis rides along."""
 
     fold: int
     seed: int
     lr: Optional[float] = None
     reg: Optional[float] = None
+    cost_fp: Optional[float] = None
+    cost_fn: Optional[float] = None
+    fe: Optional[int] = None
 
     @property
     def label(self) -> str:
         out = f"f{self.fold}.s{self.seed}"
+        if self.fe is not None:
+            out = f"fe{self.fe}." + out
         if self.lr is not None:
             out += f".lr{self.lr:g}"
         if self.reg is not None:
             out += f".reg{self.reg:g}"
+        if self.cost_fp is not None:
+            out += f".cfp{self.cost_fp:g}"
+        if self.cost_fn is not None:
+            out += f".cfn{self.cost_fn:g}"
         return out
 
 
@@ -236,26 +273,50 @@ def expand_members(
     base_seed: int,
     supports_reg: bool,
     name: str = "",
+    supports_cost: bool = True,
 ) -> List[Member]:
-    """The cartesian member list, fold-major then seed then grid —
-    the order every engine and every report preserves. Axes a family
-    cannot express collapse with a log line (the NN has no L2 ``reg``
-    hyperparameter; duplicating its members per reg point would train
-    the same model twice and report it as two)."""
+    """The cartesian member list, feature-config-major, then fold,
+    then seed, then grid — the order every engine and every report
+    preserves. Axes a family cannot express collapse with a log line
+    (the NN has no L2 ``reg`` hyperparameter and its loss closure
+    bakes the class weights, so per-member cost axes cannot batch;
+    duplicating its members per point would train the same model
+    twice and report it as two)."""
     lrs: Sequence[Optional[float]] = spec.axis_values("lr") or (None,)
     regs: Sequence[Optional[float]] = spec.axis_values("reg") or (None,)
+    cfps: Sequence[Optional[float]] = spec.axis_values("cost_fp") or (None,)
+    cfns: Sequence[Optional[float]] = spec.axis_values("cost_fn") or (None,)
     if not supports_reg and spec.axis_values("reg") is not None:
         logger.warning(
             "sweep axis reg does not apply to %s; collapsing %d grid "
             "points onto the base config", name, len(regs),
         )
         regs = (None,)
+    if not supports_cost and (
+        spec.axis_values("cost_fp") is not None
+        or spec.axis_values("cost_fn") is not None
+    ):
+        logger.warning(
+            "sweep axes cost_fp/cost_fn do not apply to %s; collapsing "
+            "%d grid points onto the base config",
+            name, len(cfps) * len(cfns),
+        )
+        cfps = cfns = (None,)
+    fes: Sequence[Optional[int]] = (
+        tuple(range(len(spec.fe_configs))) if spec.fe_configs else (None,)
+    )
     return [
-        Member(fold=f, seed=base_seed + s, lr=lr, reg=reg)
+        Member(
+            fold=f, seed=base_seed + s, lr=lr, reg=reg,
+            cost_fp=cfp, cost_fn=cfn, fe=fe,
+        )
+        for fe in fes
         for f in range(n_folds)
         for s in range(spec.seeds)
         for lr in lrs
         for reg in regs
+        for cfp in cfps
+        for cfn in cfns
     ]
 
 
@@ -284,6 +345,7 @@ def run_population(
     targets,
     spec: PopulationSpec,
     stage: Optional[Callable] = None,
+    feature_sets: Optional[Sequence[Tuple[str, np.ndarray]]] = None,
 ) -> Tuple[stats.PopulationStatistics, Dict]:
     """Train + evaluate one classifier family's population.
 
@@ -296,6 +358,14 @@ def run_population(
     train/test wall time lands in the same StageTimer rows (and the
     same ``stage.train``/``stage.test`` spans) the sequential paths
     use; defaults to a no-op for library callers.
+
+    ``feature_sets`` carries the ``fe_sweep=`` axis: ordered
+    ``(config label, (n, d) feature matrix)`` pairs, one per entry in
+    ``spec.fe_configs``, all over the SAME rows (identical targets).
+    Each member then trains and tests against its config's matrix —
+    stacked onto the vmapped program's member axis, so ≥2 feature
+    pipelines compare inside one compiled program. Linear family
+    only (the NN engine shares one gathered train matrix).
     """
     from .. import obs
     from ..obs import events
@@ -308,6 +378,26 @@ def run_population(
             f"({', '.join(SGD_FAMILY)}); {name!r} trains one model "
             f"per run"
         )
+    linear = name in ("logreg", "svm")
+    if spec.fe_configs and not linear:
+        raise ValueError(
+            "fe_sweep= applies to the linear family (logreg/svm); the "
+            f"{name} engine shares one feature matrix"
+        )
+    if spec.fe_configs:
+        if feature_sets is None or len(feature_sets) != len(spec.fe_configs):
+            raise ValueError(
+                f"fe_sweep= lists {len(spec.fe_configs)} configs but "
+                f"{0 if feature_sets is None else len(feature_sets)} "
+                f"feature matrices were provided"
+            )
+        shapes = {np.asarray(f).shape for _, f in feature_sets}
+        if len(shapes) != 1:
+            raise ValueError(
+                f"fe_sweep= feature configs must agree on the feature "
+                f"matrix shape to share one stacked program; got "
+                f"{sorted(shapes)} — match the level=/stats= sets"
+            )
     stage = stage or _null_stage
     targets = np.asarray(targets, dtype=np.float64)
     n = len(targets)
@@ -315,7 +405,6 @@ def run_population(
 
     template = make_classifier()
     template.set_config(config)
-    linear = name in ("logreg", "svm")
     if linear:
         base_cfg = template._sgd_config()
         base_seed = base_cfg.seed
@@ -323,7 +412,8 @@ def run_population(
         base_cfg = None
         base_seed = int(template._require("config_seed"))
     members = expand_members(
-        spec, len(folds), base_seed, supports_reg=linear, name=name
+        spec, len(folds), base_seed, supports_reg=linear, name=name,
+        supports_cost=linear,
     )
     if linear and spec.seeds > 1 and base_cfg.mini_batch_fraction >= 1.0:
         # zero-init full-batch SGD has no randomness: the seed only
@@ -349,7 +439,7 @@ def run_population(
             try:
                 trained = _train_vmapped(
                     name, template, features, targets, folds, members,
-                    base_cfg,
+                    base_cfg, feature_sets=feature_sets,
                 )
             except PopulationVmapUnsupported as e:
                 logger.warning(
@@ -361,14 +451,24 @@ def run_population(
                 trained = _train_looped(
                     name, make_classifier, config, features, targets,
                     folds, members, base_cfg, template,
+                    feature_sets=feature_sets,
                 )
         else:
             trained = _train_looped(
                 name, make_classifier, config, features, targets,
                 folds, members, base_cfg, template,
+                feature_sets=feature_sets,
             )
     obs.metrics.count("population.members", len(members))
     obs.metrics.count(f"population.{mode_used}")
+
+    def member_features(m):
+        """The rows this member trains/tests against: its fe_sweep
+        config's matrix when the feature axis rides, else the shared
+        one."""
+        if m.fe is None or feature_sets is None:
+            return features
+        return feature_sets[m.fe][1]
 
     result = stats.PopulationStatistics(
         shape=spec.describe(), mode=mode_used
@@ -387,7 +487,7 @@ def run_population(
                 fold=m.fold, seed=m.seed,
             ):
                 member_stats = template.test_features(
-                    features[test_idx], targets[test_idx]
+                    member_features(m)[test_idx], targets[test_idx]
                 )
             result[m.label] = member_stats
 
@@ -410,37 +510,77 @@ def run_population(
     return result, block
 
 
+def _member_axes(members, base_cfg):
+    """The linear family's per-member hyperparameter arrays: steps,
+    regs, seeds, and the cost-sensitive class weights (cost_fn
+    weights the positive class, cost_fp the negative — the expected-
+    cost convention in models/stats.py). Shared by the vmapped and
+    looped engines so the member order and value resolution can never
+    drift between them."""
+    return (
+        [m.lr if m.lr is not None else base_cfg.step_size
+         for m in members],
+        [m.reg if m.reg is not None else base_cfg.reg_param
+         for m in members],
+        [m.seed for m in members],
+        [m.cost_fn if m.cost_fn is not None else base_cfg.weight_pos
+         for m in members],
+        [m.cost_fp if m.cost_fp is not None else base_cfg.weight_neg
+         for m in members],
+    )
+
+
+def _stacked_features(members, feature_sets, row_idx=None):
+    """(P, n, d) float32 member-axis feature stack for an fe_sweep
+    population: member i's matrix is its config's, gathered to
+    ``row_idx`` (the shared single-fold train rows) when given."""
+    mats = []
+    for m in members:
+        f = np.asarray(feature_sets[m.fe][1], dtype=np.float32)
+        mats.append(f if row_idx is None else f[row_idx])
+    return np.stack(mats)
+
+
 def _train_vmapped(
-    name, template, features, targets, folds, members, base_cfg
+    name, template, features, targets, folds, members, base_cfg,
+    feature_sets=None,
 ) -> List:
     """All members in one stacked program (parallel/population.py)."""
     from ..parallel import population as engines
     from ..parallel.population import PopulationVmapUnsupported
 
     if name in ("logreg", "svm"):
-        steps = [
-            m.lr if m.lr is not None else base_cfg.step_size
-            for m in members
-        ]
-        regs = [
-            m.reg if m.reg is not None else base_cfg.reg_param
-            for m in members
-        ]
-        seeds = [m.seed for m in members]
+        steps, regs, seeds, wpos, wneg = _member_axes(members, base_cfg)
+        stacked = feature_sets is not None and any(
+            m.fe is not None for m in members
+        )
         if len(folds) == 1:
             # single-fold: gather the shared train rows once — the
             # member invocation is then byte-for-byte the train_clf=
             # invocation, just batched
             train_idx = folds[0][0]
+            x = (
+                _stacked_features(members, feature_sets, train_idx)
+                if stacked
+                else np.asarray(features)[train_idx]
+            )
             weights = engines.train_linear_population(
-                np.asarray(features)[train_idx], targets[train_idx],
+                x, targets[train_idx],
                 base_cfg, steps, regs, seeds, masks=None,
+                weight_pos=wpos, weight_neg=wneg,
+                stacked_features=stacked,
             )
         else:
             masks = _fold_masks(members, folds, len(targets))
+            x = (
+                _stacked_features(members, feature_sets)
+                if stacked
+                else features
+            )
             weights = engines.train_linear_population(
-                features, targets, base_cfg, steps, regs, seeds,
-                masks=masks,
+                x, targets, base_cfg, steps, regs, seeds,
+                masks=masks, weight_pos=wpos, weight_neg=wneg,
+                stacked_features=stacked,
             )
         return list(weights)
 
@@ -466,7 +606,7 @@ def _train_vmapped(
 
 def _train_looped(
     name, make_classifier, config, features, targets, folds, members,
-    base_cfg, template=None,
+    base_cfg, template=None, feature_sets=None,
 ) -> List:
     """The sequential twin: per member, the same training program the
     vmapped engine batches, dispatched one member at a time — the
@@ -485,20 +625,37 @@ def _train_looped(
     from ..parallel import population as engines
 
     trained = []
-    if name in ("logreg", "svm") and len(folds) > 1:
+    linear = name in ("logreg", "svm")
+    stacked = (
+        linear and feature_sets is not None
+        and any(m.fe is not None for m in members)
+    )
+    if linear and (len(folds) > 1 or stacked):
+        # the mask/stacked formulation through the looped engine: the
+        # per-member invocation (and therefore the Bernoulli sample
+        # stream and the weighted static) matches the vmapped engine
+        # member for member — the parity contract
+        steps, regs, seeds, wpos, wneg = _member_axes(members, base_cfg)
+        if len(folds) > 1:
+            masks = _fold_masks(members, folds, len(targets))
+            x = (
+                _stacked_features(members, feature_sets)
+                if stacked else features
+            )
+            y = targets
+        else:
+            train_idx = folds[0][0]
+            masks = None
+            x = _stacked_features(members, feature_sets, train_idx)
+            y = targets[train_idx]
         weights = engines.train_linear_population_looped(
-            features, targets, base_cfg,
-            [m.lr if m.lr is not None else base_cfg.step_size
-             for m in members],
-            [m.reg if m.reg is not None else base_cfg.reg_param
-             for m in members],
-            [m.seed for m in members],
-            _fold_masks(members, folds, len(targets)),
+            x, y, base_cfg, steps, regs, seeds, masks,
+            weight_pos=wpos, weight_neg=wneg, stacked_features=stacked,
         )
         return list(weights)
     for m in members:
         train_idx, _ = folds[m.fold]
-        if name in ("logreg", "svm"):
+        if linear:
             cfg = dc.replace(
                 base_cfg,
                 step_size=(
@@ -508,6 +665,14 @@ def _train_looped(
                     m.reg if m.reg is not None else base_cfg.reg_param
                 ),
                 seed=m.seed,
+                weight_pos=(
+                    m.cost_fn if m.cost_fn is not None
+                    else base_cfg.weight_pos
+                ),
+                weight_neg=(
+                    m.cost_fp if m.cost_fp is not None
+                    else base_cfg.weight_neg
+                ),
             )
             trained.append(
                 sgd.train_linear(

@@ -56,16 +56,42 @@ class SGDConfig:
     seed: int = 42
     # MLlib GradientDescent default; 0.0 disables the early stop
     convergence_tol: float = 0.001
+    # cost-sensitive class weights (the seizure workload,
+    # docs/workloads.md): each sample's gradient contribution scales
+    # by its class's weight — positives by ``weight_pos`` (the
+    # false-negative cost), negatives by ``weight_neg`` (the
+    # false-positive cost). Both 1.0 (the default) takes a code path
+    # with the IDENTICAL XLA program as before the knobs existed
+    # (``weighted`` is a static argument), so P300 trajectories are
+    # bit-unchanged.
+    weight_pos: float = 1.0
+    weight_neg: float = 1.0
+
+    @property
+    def weighted(self) -> bool:
+        return self.weight_pos != 1.0 or self.weight_neg != 1.0
 
 
 def _make_scan_step(
     x, y, ones, step_size, mini_batch_fraction, reg_param, seed,
-    convergence_tol, loss, full_batch,
+    convergence_tol, loss, full_batch, weighted=False,
+    weight_pos=1.0, weight_neg=1.0,
 ):
     """The per-iteration MLlib-SGD scan body, shared by the monolithic
     engine (:func:`_run_sgd`) and the chunked resumable engine
-    (:func:`_run_sgd_chunk`) so the two can never drift."""
+    (:func:`_run_sgd_chunk`) so the two can never drift.
+
+    ``weighted`` is STATIC: False builds the exact pre-cost-knob
+    program (bit-identical P300 trajectories); True scales each
+    sample's gradient by its class weight (``weight_pos``/
+    ``weight_neg`` ride as traced scalars, so a cost sweep never
+    recompiles). The gradient average stays over the *sampled count*
+    — MLlib's normalization — not the weight sum, so weights shift
+    the decision boundary without rescaling the effective step size.
+    """
     n = x.shape[0]
+    if weighted:
+        class_w = y * weight_pos + (1.0 - y) * weight_neg
 
     def gradient_sum(w, mask):
         margin = x @ w  # (n,)
@@ -75,8 +101,10 @@ def _make_scan_step(
             y_signed = 2.0 * y - 1.0
             active = (y_signed * margin) < 1.0
             mult = jnp.where(active, -y_signed, 0.0)
-        weighted = mult * mask
-        return x.T @ weighted  # (d,) — lowers to MXU matmul + all-reduce
+        if weighted:
+            mult = mult * class_w
+        weighted_mult = mult * mask
+        return x.T @ weighted_mult  # (d,) — lowers to MXU matmul + all-reduce
 
     def step(carry, t):
         # t is 1-based iteration index
@@ -111,7 +139,10 @@ def _make_scan_step(
     return step
 
 
-@partial(jax.jit, static_argnames=("num_iterations", "loss", "full_batch"))
+@partial(
+    jax.jit,
+    static_argnames=("num_iterations", "loss", "full_batch", "weighted"),
+)
 def _run_sgd(
     features: jnp.ndarray,
     labels: jnp.ndarray,
@@ -124,13 +155,17 @@ def _run_sgd(
     loss: str,
     full_batch: bool,
     sample_mask: jnp.ndarray | None = None,
+    weighted: bool = False,
+    weight_pos=1.0,
+    weight_neg=1.0,
 ):
     x = features
     y = labels
     ones = jnp.ones_like(y) if sample_mask is None else sample_mask
     step = _make_scan_step(
         x, y, ones, step_size, mini_batch_fraction, reg_param, seed,
-        convergence_tol, loss, full_batch,
+        convergence_tol, loss, full_batch, weighted=weighted,
+        weight_pos=weight_pos, weight_neg=weight_neg,
     )
     w0 = jnp.zeros((x.shape[1],), dtype=x.dtype)
     carry0 = (w0, jnp.asarray(False), jnp.asarray(0, jnp.int32))
@@ -140,7 +175,10 @@ def _run_sgd(
     return w_final
 
 
-@partial(jax.jit, static_argnames=("n_iterations", "loss", "full_batch"))
+@partial(
+    jax.jit,
+    static_argnames=("n_iterations", "loss", "full_batch", "weighted"),
+)
 def _run_sgd_chunk(
     carry,
     t_start,
@@ -155,6 +193,9 @@ def _run_sgd_chunk(
     loss: str,
     full_batch: bool,
     sample_mask: jnp.ndarray | None = None,
+    weighted: bool = False,
+    weight_pos=1.0,
+    weight_neg=1.0,
 ):
     """Iterations ``t_start+1 .. t_start+n_iterations`` of the same
     scan :func:`_run_sgd` runs monolithically, resuming from ``carry``
@@ -168,7 +209,8 @@ def _run_sgd_chunk(
     ones = jnp.ones_like(y) if sample_mask is None else sample_mask
     step = _make_scan_step(
         x, y, ones, step_size, mini_batch_fraction, reg_param, seed,
-        convergence_tol, loss, full_batch,
+        convergence_tol, loss, full_batch, weighted=weighted,
+        weight_pos=weight_pos, weight_neg=weight_neg,
     )
     carry, _ = jax.lax.scan(
         step, carry, t_start + jnp.arange(1, n_iterations + 1)
@@ -197,6 +239,17 @@ def sgd_invocation(x_arr, y_arr, config: SGDConfig, sample_mask=None):
         full_batch=config.mini_batch_fraction >= 1.0,
         sample_mask=sample_mask,
     )
+    if config.weighted:
+        # unweighted calls omit these kwargs (Python binds the same
+        # defaults either way): with the static ``weighted=False`` the
+        # scan body contains NO weight arithmetic, so unweighted
+        # trajectories are bit-identical to the pre-knob engine
+        # (pinned in tests/test_seizure_pipeline.py)
+        kwargs.update(
+            weighted=True,
+            weight_pos=float(config.weight_pos),
+            weight_neg=float(config.weight_neg),
+        )
     return _run_sgd, args, kwargs
 
 
@@ -279,6 +332,19 @@ def train_linear_elastic(
             "n_updates": jnp.asarray(0, jnp.int32),
         }
 
+    # the sgd_invocation discipline: weight kwargs ride only on
+    # weighted configs, so the unweighted elastic call reads exactly
+    # like the unweighted monolithic one
+    weight_kwargs = (
+        dict(
+            weighted=True,
+            weight_pos=float(config.weight_pos),
+            weight_neg=float(config.weight_neg),
+        )
+        if config.weighted
+        else {}
+    )
+
     def chunk_step(state, t0, n):
         from ..obs import events
 
@@ -302,6 +368,7 @@ def train_linear_elastic(
             loss=config.loss,
             full_batch=full_batch,
             sample_mask=sample_mask,
+            **weight_kwargs,
         )
         new = {"w": w, "converged": converged, "n_updates": n_updates}
         # the weight norm is the sentinel's loss stream: divergence

@@ -10,8 +10,25 @@ confusion matrix = 4-way bincount).
 from __future__ import annotations
 
 import math
+from typing import Optional
 
 import numpy as np
+
+
+def mark_extended(statistics, cost_fp: float = 1.0,
+                  cost_fn: float = 1.0) -> None:
+    """Opt ``statistics`` into the extended imbalanced-class report
+    (precision/recall/F1/balanced accuracy/expected cost) with the
+    run's misclassification costs. Recurses through the dict-shaped
+    containers (population / fan-out), so every member's ``__str__``
+    — and therefore the ``result_path`` text — carries the block."""
+    if isinstance(statistics, dict):
+        for member in statistics.values():
+            mark_extended(member, cost_fp, cost_fn)
+        return
+    statistics.extended_report = True
+    statistics.cost_fp = float(cost_fp)
+    statistics.cost_fn = float(cost_fn)
 
 
 def _java_round(x: float) -> int:
@@ -28,6 +45,15 @@ class ClassificationStatistics:
         self.mse = 0.0
         self.class1_sum = 0.0  # sum of real outputs on expected-0 patterns
         self.class2_sum = 0.0  # sum of real outputs on expected-1 patterns
+        # the seizure workload's reporting surface (imbalanced-class
+        # metrics + an expected-cost summary). OFF by default:
+        # ``__str__`` must stay BYTE-IDENTICAL for every P300 run —
+        # the extended block renders only when a workload opts in
+        # (pipeline/builder.py task=seizure; pinned in
+        # tests/test_stats_metrics.py).
+        self.extended_report = False
+        self.cost_fp = 1.0  # cost of one false positive
+        self.cost_fn = 1.0  # cost of one false negative
 
     def add(self, real_output: float, expected_output: float) -> None:
         """Incremental accumulation (ClassificationStatistics.java:68-83)."""
@@ -106,10 +132,70 @@ class ClassificationStatistics:
             return math.nan
         return (self.true_positives + self.true_negatives) / self.num_patterns
 
+    # -- imbalanced-class metrics (the seizure workload) ----------------
+    # All 0/0 cases return NaN, the accuracy convention above: a run
+    # with no positive patterns has no defined recall, and pretending
+    # 0.0 or 1.0 would mislead the cost sweep that reads these.
+
+    def precision(self) -> float:
+        denom = self.true_positives + self.false_positives
+        return math.nan if denom == 0 else self.true_positives / denom
+
+    def recall(self) -> float:
+        denom = self.true_positives + self.false_negatives
+        return math.nan if denom == 0 else self.true_positives / denom
+
+    def specificity(self) -> float:
+        denom = self.true_negatives + self.false_positives
+        return math.nan if denom == 0 else self.true_negatives / denom
+
+    def f1(self) -> float:
+        p, r = self.precision(), self.recall()
+        if math.isnan(p) or math.isnan(r) or (p + r) == 0:
+            return math.nan
+        return 2.0 * p * r / (p + r)
+
+    def balanced_accuracy(self) -> float:
+        r, s = self.recall(), self.specificity()
+        if math.isnan(r) or math.isnan(s):
+            return math.nan
+        return (r + s) / 2.0
+
+    def expected_cost(self, cost_fp: Optional[float] = None,
+                      cost_fn: Optional[float] = None) -> float:
+        """Mean per-pattern misclassification cost: each false
+        positive bills ``cost_fp``, each false negative ``cost_fn``
+        (defaults: the costs the run was configured with). THE
+        seizure-detection headline — accuracy rewards predicting
+        'no seizure' always; this is what the cost-sensitive knobs
+        are tuned against."""
+        cfp = self.cost_fp if cost_fp is None else float(cost_fp)
+        cfn = self.cost_fn if cost_fn is None else float(cost_fn)
+        if self.num_patterns == 0:
+            return math.nan
+        return (
+            cfp * self.false_positives + cfn * self.false_negatives
+        ) / self.num_patterns
+
+    def extended_summary(self) -> dict:
+        """The imbalanced-class metric block (run_report.json's
+        ``classification`` field for extended-report runs)."""
+        return {
+            "accuracy": self.calc_accuracy(),
+            "precision": self.precision(),
+            "recall": self.recall(),
+            "specificity": self.specificity(),
+            "f1": self.f1(),
+            "balanced_accuracy": self.balanced_accuracy(),
+            "cost_fp": self.cost_fp,
+            "cost_fn": self.cost_fn,
+            "expected_cost": self.expected_cost(),
+        }
+
     def __str__(self) -> str:
         # Field order and wording match ClassificationStatistics.java:86-96.
         mse = math.nan if self.num_patterns == 0 else self.mse / self.num_patterns
-        return (
+        base = (
             f"Number of patterns: {self.num_patterns}\n"
             f"True positives: {self.true_positives}\n"
             f"True negatives: {self.true_negatives}\n"
@@ -119,6 +205,17 @@ class ClassificationStatistics:
             f"MSE: {mse}\n"
             f"Non-targets: {self.class1_sum}\n"
             f"Targets: {self.class2_sum}\n"
+        )
+        if not self.extended_report:
+            # the P300 surface: byte-identical to the reference format
+            return base
+        return base + (
+            f"Precision: {self.precision()}\n"
+            f"Recall: {self.recall()}\n"
+            f"F1: {self.f1()}\n"
+            f"Balanced accuracy: {self.balanced_accuracy()}\n"
+            f"Expected cost (fp={self.cost_fp}, fn={self.cost_fn}): "
+            f"{self.expected_cost()}\n"
         )
 
 

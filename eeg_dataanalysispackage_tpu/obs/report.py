@@ -200,6 +200,11 @@ class RunTelemetry:
         #: drain state — one block for ``serve=true`` runs; None when
         #: the run served nothing
         self.serve: Optional[Dict[str, Any]] = None
+        #: workload attribution (pipeline/builder.py ``task=`` modes):
+        #: the seizure runs record their epoching geometry (window/
+        #: stride/label_overlap), class balance, and cost knobs here;
+        #: None for the default P300 workload
+        self.workload: Optional[Dict[str, Any]] = None
 
     @property
     def report_path(self) -> str:
@@ -239,6 +244,7 @@ class RunTelemetry:
             "backend": dict(self.backend),
             "population": self.population,
             "serve": self.serve,
+            "workload": self.workload,
             "degradation": list(self.degradation),
             "stages": timers.as_dict() if timers is not None else {},
             "metrics": metrics.snapshot() if metrics is not None else {},
@@ -278,6 +284,7 @@ class RunTelemetry:
                 str(statistics).encode()
             ).hexdigest(),
             "accuracy": _accuracy_of(statistics),
+            "classification": _classification_of(statistics),
         }
         _atomic_json(self.report_path, payload)
         # a stale crash artifact from an earlier failed run into the
@@ -324,6 +331,33 @@ class RunTelemetry:
             self.crash_path, type(error).__name__, error,
         )
         return self.crash_path
+
+
+def _classification_of(statistics) -> Any:
+    """The extended imbalanced-class metric block (models/stats.py
+    ``extended_summary``) for runs that opted into it (the seizure
+    workload); None for plain-report runs. Dict-shaped statistics
+    (population / fan-out) report per-member blocks."""
+    try:
+        if hasattr(statistics, "items") and not hasattr(
+            statistics, "extended_report"
+        ):
+            members = {
+                name: _classification_of(s)
+                for name, s in statistics.items()
+            }
+            if any(v is not None for v in members.values()):
+                return members
+            return None
+        if getattr(statistics, "extended_report", False):
+            summary = statistics.extended_summary()
+            return {
+                k: (round(v, 6) if isinstance(v, float) else v)
+                for k, v in summary.items()
+            }
+        return None
+    except Exception:  # pragma: no cover - defensive, like _accuracy_of
+        return None
 
 
 def _accuracy_of(statistics) -> Any:

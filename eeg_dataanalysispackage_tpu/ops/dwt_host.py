@@ -22,6 +22,35 @@ def _seq_dot(block: np.ndarray, f: np.ndarray) -> np.ndarray:
     return np.cumsum(block * f, axis=-1)[..., -1]
 
 
+def fwt_subbands(
+    signal: np.ndarray,
+    h: np.ndarray,
+    g: np.ndarray,
+    max_levels: int | None = None,
+):
+    """The cascade itself: ``(approximation, [d_1, d_2, ..., d_K])``
+    over the last axis — the ONE implementation of the eegdsp
+    boundary/accumulation convention, shared by the full-layout
+    transform below and the per-subband statistics family
+    (``features/subband.py``), so the two can never drift.
+
+    ``max_levels`` bounds the depth (None = decompose while the
+    current length >= len(h), eegdsp's own stop rule).
+    """
+    a = np.array(signal, dtype=np.float64, copy=True)
+    n = a.shape[-1]
+    L = len(h)
+    details = []
+    while n >= L and (max_levels is None or len(details) < max_levels):
+        half = n // 2
+        idx = (2 * np.arange(half)[:, None] + np.arange(L)[None, :]) % n
+        block = a[..., idx]  # (..., half, L)
+        details.append(_seq_dot(block, g))
+        a = _seq_dot(block, h)
+        n = half
+    return a, details
+
+
 def fwt_periodic(signal: np.ndarray, h: np.ndarray, g: np.ndarray) -> np.ndarray:
     """Full FWT over the last axis in the eegdsp coefficient layout.
 
@@ -34,17 +63,7 @@ def fwt_periodic(signal: np.ndarray, h: np.ndarray, g: np.ndarray) -> np.ndarray
     same convention as the conv formulation in ``ops/dwt.py``, and
     m < n.
     """
-    a = np.array(signal, dtype=np.float64, copy=True)
-    n = a.shape[-1]
-    L = len(h)
-    details = []
-    while n >= L:
-        half = n // 2
-        idx = (2 * np.arange(half)[:, None] + np.arange(L)[None, :]) % n
-        block = a[..., idx]  # (..., half, L)
-        details.append(_seq_dot(block, g))
-        a = _seq_dot(block, h)
-        n = half
+    a, details = fwt_subbands(signal, h, g)
     return np.concatenate([a] + details[::-1], axis=-1)
 
 

@@ -56,6 +56,26 @@ class PopulationVmapUnsupported(ValueError):
     statistics, dispatches not amortized."""
 
 
+def _weight_arrays(config, n_members, weight_pos, weight_neg):
+    """Per-member class-weight lists + the engine-wide ``weighted``
+    static. One cost axis anywhere makes EVERY member run the
+    weighted program (weights ride as traced member-axis scalars, so
+    new cost sweep values recompile nothing); an all-unit population
+    keeps the exact pre-knob program."""
+    wp = (
+        [float(w) for w in weight_pos]
+        if weight_pos is not None
+        else [float(config.weight_pos)] * n_members
+    )
+    wn = (
+        [float(w) for w in weight_neg]
+        if weight_neg is not None
+        else [float(config.weight_neg)] * n_members
+    )
+    weighted = any(w != 1.0 for w in wp + wn)
+    return wp, wn, weighted
+
+
 def train_linear_population(
     features: np.ndarray,
     labels: np.ndarray,
@@ -64,6 +84,9 @@ def train_linear_population(
     reg_params: Sequence[float],
     seeds: Sequence[int],
     masks: Optional[np.ndarray],
+    weight_pos: Optional[Sequence[float]] = None,
+    weight_neg: Optional[Sequence[float]] = None,
+    stacked_features: bool = False,
 ) -> np.ndarray:
     """Train P MLlib-SGD members in one vmapped program.
 
@@ -73,6 +96,15 @@ def train_linear_population(
     train rows. ``config`` contributes the static/shared scalars
     (iterations, loss, mini-batch fraction, convergence tol).
     Returns ``(P, d)`` float32 weights, member order preserved.
+
+    Seizure-workload axes: ``weight_pos``/``weight_neg`` are
+    per-member cost-sensitive class weights (the ``cost_fp``/
+    ``cost_fn`` sweep axes — traced scalars on the member axis);
+    ``stacked_features=True`` marks ``features`` as carrying a
+    LEADING member axis ``(P, n, d)`` — one feature matrix per member,
+    the ``fe_sweep=`` feature-config comparison. Both ride as batched
+    array inputs, so new sweep points (costs or feature configs of
+    the same cardinality) retrigger zero compiles.
     """
     from ..models import sgd
 
@@ -86,24 +118,35 @@ def train_linear_population(
     )
     frac = float(config.mini_batch_fraction)
     tol = float(config.convergence_tol)
+    wp, wn, weighted = _weight_arrays(
+        config, len(list(seeds)), weight_pos, weight_neg
+    )
 
-    def member(step, reg, seed, mask):
+    def member(xm, step, reg, seed, mask, w_pos, w_neg):
+        kwargs = (
+            dict(weighted=True, weight_pos=w_pos, weight_neg=w_neg)
+            if weighted
+            else {}
+        )
         return sgd._run_sgd(
-            x, y, step, frac, reg, seed, tol,
-            sample_mask=mask, **statics,
+            xm, y, step, frac, reg, seed, tol,
+            sample_mask=mask, **statics, **kwargs,
         )
 
     steps_a = jnp.asarray(list(step_sizes), jnp.float32)
     regs_a = jnp.asarray(list(reg_params), jnp.float32)
     seeds_a = jnp.asarray(list(seeds), jnp.int32)
+    wp_a = jnp.asarray(wp, jnp.float32)
+    wn_a = jnp.asarray(wn, jnp.float32)
+    x_axis = 0 if stacked_features else None
     if masks is None:
         masks_a = None
-        in_axes = (0, 0, 0, None)
+        in_axes = (x_axis, 0, 0, 0, None, 0, 0)
     else:
         masks_a = jnp.asarray(masks, jnp.float32)
-        in_axes = (0, 0, 0, 0)
+        in_axes = (x_axis, 0, 0, 0, 0, 0, 0)
     weights = jax.vmap(member, in_axes=in_axes)(
-        steps_a, regs_a, seeds_a, masks_a
+        x, steps_a, regs_a, seeds_a, masks_a, wp_a, wn_a
     )
     return np.asarray(weights)
 
@@ -116,16 +159,21 @@ def train_linear_population_looped(
     reg_params: Sequence[float],
     seeds: Sequence[int],
     masks: Optional[np.ndarray],
+    weight_pos: Optional[Sequence[float]] = None,
+    weight_neg: Optional[Sequence[float]] = None,
+    stacked_features: bool = False,
 ) -> np.ndarray:
     """The sequential twin of :func:`train_linear_population`: the
     identical per-member invocation, dispatched one member at a time
     (the bench's ``population_looped`` baseline and the engine's
     fallback). Scalars pass as Python weak types, exactly like
     ``sgd.train_linear`` — a single-fold member here is bit-identical
-    to a ``train_clf=`` run with the same hyperparameters."""
+    to a ``train_clf=`` run with the same hyperparameters. The
+    ``weighted`` static follows the same any-member rule as the
+    vmapped engine, so the two dispatch the same per-member program
+    even at unit weights inside a costed population."""
     from ..models import sgd
 
-    x = jnp.asarray(features, dtype=jnp.float32)
     y = jnp.asarray(labels, dtype=jnp.float32)
     statics = dict(
         num_iterations=int(config.num_iterations),
@@ -134,13 +182,28 @@ def train_linear_population_looped(
     )
     frac = float(config.mini_batch_fraction)
     tol = float(config.convergence_tol)
+    wp, wn, weighted = _weight_arrays(
+        config, len(list(seeds)), weight_pos, weight_neg
+    )
+    if not stacked_features:
+        x_shared = jnp.asarray(features, dtype=jnp.float32)
     out = []
     for i in range(len(seeds)):
+        x = (
+            jnp.asarray(features[i], jnp.float32)
+            if stacked_features
+            else x_shared
+        )
         mask = None if masks is None else jnp.asarray(masks[i], jnp.float32)
+        kwargs = (
+            dict(weighted=True, weight_pos=wp[i], weight_neg=wn[i])
+            if weighted
+            else {}
+        )
         out.append(
             sgd._run_sgd(
                 x, y, float(step_sizes[i]), frac, float(reg_params[i]),
-                int(seeds[i]), tol, sample_mask=mask, **statics,
+                int(seeds[i]), tol, sample_mask=mask, **statics, **kwargs,
             )
         )
     return np.asarray(jnp.stack(out))

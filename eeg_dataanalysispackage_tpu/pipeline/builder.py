@@ -99,6 +99,16 @@ class PipelineBuilder:
         self,
     ) -> Union[stats.ClassificationStatistics, stats.FanOutStatistics]:
         query_map = get_query_map(self.query)
+        # the extended fe= grammar (dwt-4:level=4:stats=energy) carries
+        # '='s of its own; re-extract those parameters verbatim so the
+        # reference's second-'=' truncation quirk (get_query_map) does
+        # not eat the options. Values without an embedded '=' — every
+        # P300 query ever written — come back byte-identical.
+        for key in ("fe", "fe_sweep"):
+            if key in query_map:
+                raw = get_raw_param(self.query, key)
+                if raw is not None:
+                    query_map[key] = raw
         logger.info("query: %s", query_map)
 
         # persistent XLA compilation cache before any device work:
@@ -226,6 +236,39 @@ class PipelineBuilder:
                 filesystem=self._fs,
                 workers=self._int_param(query_map, "ingest_workers"),
                 prefetch_depth=self._int_param(query_map, "prefetch"),
+            )
+
+        # task=seizure: the continuous-EEG seizure workload
+        # (docs/workloads.md) — sliding-window epoching over interval
+        # annotations, pluggable subband features, cost-sensitive
+        # training, imbalanced-class statistics. The default (absent /
+        # task=p300) is the reference's marker-locked path, untouched.
+        task = query_map.get("task", "")
+        if task and task not in ("p300", "seizure"):
+            raise ValueError(
+                f"unknown task {task!r}; supported: p300 (default), "
+                f"seizure"
+            )
+        if task == "seizure":
+            if query_map.get("serve") == "true":
+                from ..serve import pipeline as serve_pipeline
+
+                statistics, serve_block, workload = (
+                    serve_pipeline.run_serve_seizure(
+                        query_map, make_provider, self._stage
+                    )
+                )
+                if self.telemetry is not None:
+                    self.telemetry.serve = serve_block
+                    self.telemetry.workload = workload
+                return self._finish_run(statistics, query_map)
+            return self._finish_run(
+                self._execute_seizure(query_map, make_provider), query_map
+            )
+        if query_map.get("fe_sweep"):
+            raise ValueError(
+                "fe_sweep= compares feature configs over the seizure "
+                "workload; it requires task=seizure"
             )
 
         # serve=true: the online inference mode (serve/pipeline.py) —
@@ -623,6 +666,339 @@ class PipelineBuilder:
         self.statistics = statistics
         return statistics
 
+    # -- the seizure workload ------------------------------------------
+
+    @staticmethod
+    def _seizure_classifier(name):
+        """Registry classifier with the TRUE confusion matrix. The
+        MLlib-path classifiers swap fp/fn in their reports — a pinned
+        reference bug-as-behavior (models/stats.from_arrays) the P300
+        surface must reproduce. The seizure workload's precision/
+        recall/expected-cost are computed FROM fp/fn, so it opts out:
+        its statistics label the matrix correctly (documented in
+        docs/workloads.md)."""
+        clf = clf_registry.create(name)
+        clf.confusion_only_stats = False
+        return clf
+
+    @staticmethod
+    def seizure_weights(query_map, targets) -> tuple:
+        """Resolve the cost-sensitive knobs to (weight_pos, weight_neg,
+        cost_fp, cost_fn).
+
+        ``class_weight=balanced`` weights positives by the run's
+        negative/positive ratio (computed over the FULL row set before
+        any split — deterministic and shared by every population
+        member); ``class_weight=<float>`` sets the positive weight
+        directly; otherwise the misclassification costs double as the
+        training weights (``weight_pos = cost_fn``: missing a seizure
+        costs ``cost_fn``, so positives push the boundary that hard).
+        The costs always parameterize the expected-cost statistic,
+        whatever trained the model.
+        """
+        cost_fp = float(query_map.get("cost_fp") or 1.0)
+        cost_fn = float(query_map.get("cost_fn") or 1.0)
+        if cost_fp <= 0 or cost_fn <= 0:
+            raise ValueError(
+                f"cost_fp=/cost_fn= must be > 0, got "
+                f"{cost_fp}/{cost_fn}"
+            )
+        cw = query_map.get("class_weight", "")
+        if cw == "balanced":
+            n_pos = float(np.sum(np.asarray(targets) == 1.0))
+            n_neg = float(len(targets) - n_pos)
+            wp = (n_neg / n_pos) if n_pos > 0 else 1.0
+            wn = 1.0
+        elif cw:
+            try:
+                wp = float(cw)
+            except ValueError:
+                raise ValueError(
+                    f"class_weight= must be 'balanced' or a float, "
+                    f"got {cw!r}"
+                )
+            if wp <= 0:
+                raise ValueError(f"class_weight= must be > 0, got {wp}")
+            wn = 1.0
+        else:
+            wp, wn = cost_fn, cost_fp
+        return wp, wn, cost_fp, cost_fn
+
+    def _seizure_features(self, query_map, make_provider, slide_cfg,
+                          fe_names):
+        """The seizure ingest+featurize front half: ONE read pass
+        (provider.prepare_run), a per-feature-config content-addressed
+        cache lookup (the key folds the FULL extractor config —
+        family/level/stats — plus the epoching geometry, so no entry
+        can cross configs), sliding-window epoching plus extraction
+        for the misses. Returns ``(feature_sets, targets)`` with
+        ``feature_sets`` ordered like ``fe_names``."""
+        from ..io import feature_cache
+
+        odp = make_provider()
+        extractors = [
+            (name, fe_registry.create(name)) for name in fe_names
+        ]
+
+        def extractor_tuple(fe):
+            return (
+                "seizure", fe.cache_id(), slide_cfg.window,
+                slide_cfg.stride, slide_cfg.label_overlap,
+            )
+
+        cache = (
+            feature_cache.open_cache()
+            if query_map.get("cache", "true") != "false"
+            else None
+        )
+        prepared = None
+        keys = {}
+        hits = {}
+        if cache is not None:
+            try:
+                with self._stage("ingest", phase="cache_lookup",
+                                 task="seizure"):
+                    prepared = odp.prepare_run(
+                        extractor_tuple(extractors[0][1])
+                    )
+                    keys[fe_names[0]] = prepared.key
+                    for name, fe in extractors[1:]:
+                        keys[name] = odp.run_key_for(
+                            prepared, extractor_tuple(fe)
+                        )
+                    for name, _ in extractors:
+                        hit = cache.lookup(keys[name])
+                        if hit is not None:
+                            hits[name] = hit
+            except Exception as e:
+                logger.warning(
+                    "feature cache unavailable (%s: %s); running "
+                    "uncached", type(e).__name__, e,
+                )
+                cache = None
+                prepared = None
+                keys, hits = {}, {}
+
+        targets = None
+        missing = [nf for nf in extractors if nf[0] not in hits]
+        if missing:
+            with self._stage("ingest", task="seizure"):
+                if prepared is not None:
+                    # featurize the recordings the key pass already
+                    # parsed — no second read
+                    from ..epochs.extractor import EpochBatch
+
+                    batch = EpochBatch.concatenate([
+                        odp.sliding_batch_for(rec, slide_cfg)
+                        for _rel, _guessed, rec in prepared.recordings
+                    ])
+                else:
+                    batch = odp.load_sliding(slide_cfg)
+            targets = np.asarray(batch.targets, dtype=np.float64)
+            with self._stage("features", task="seizure"):
+                for name, fe in missing:
+                    hits[name] = (
+                        np.asarray(fe.extract_batch(batch.epochs)),
+                        targets,
+                    )
+                    if cache is not None and name in keys:
+                        cache.store(keys[name], *hits[name])
+        if targets is None:
+            targets = np.asarray(hits[fe_names[0]][1], dtype=np.float64)
+        feature_sets = [(name, hits[name][0]) for name, _ in extractors]
+        return feature_sets, targets
+
+    def _execute_seizure(self, query_map, make_provider):
+        """``task=seizure``: sliding windows -> configurable subband
+        features -> cost-sensitive training -> imbalanced-class
+        statistics (docs/workloads.md). The first non-P300 path
+        through the pipeline; it shares the split/population/fan-out
+        machinery and the statistics seam with the reference path."""
+        from ..epochs import sliding
+        from ..models import population
+
+        window = self._int_param(query_map, "window") or 512
+        stride = self._int_param(query_map, "stride") or max(
+            1, window // 2
+        )
+        overlap = float(query_map.get("label_overlap") or 0.5)
+        slide_cfg = sliding.SlidingConfig(
+            window=window, stride=stride, label_overlap=overlap
+        )
+
+        pop_spec = population.PopulationSpec.from_query_map(query_map)
+        if pop_spec.active:
+            # the P300 path's population conflict contract, kept: a
+            # silently-ignored axis (fe_sweep= evaluating one config,
+            # save_clf= saving nothing) is worse than an error
+            if "load_clf" in query_map:
+                raise ValueError(
+                    "population axes (cv=/seeds=/sweep=/fe_sweep=) "
+                    "train models; they cannot combine with load_clf="
+                )
+            if query_map.get("save_clf") == "true":
+                raise ValueError(
+                    "population runs train many members; save_clf= "
+                    "has no single model to persist"
+                )
+            if query_map.get("elastic") == "true":
+                raise ValueError(
+                    "population training does not support elastic=true; "
+                    "the stacked program has no per-member checkpoints"
+                )
+        fe_value = query_map.get("fe", "")
+        if pop_spec.fe_configs:
+            if "classifiers" in query_map:
+                raise ValueError(
+                    "fe_sweep= expands the train_clf= population; it "
+                    "cannot combine with classifiers="
+                )
+            fe_names = list(pop_spec.fe_configs)
+        else:
+            if not fe_value:
+                raise ValueError("Missing the feature extraction argument")
+            fe_names = [fe_value]
+        for name in fe_names:
+            if "-fused" in name:
+                raise ValueError(
+                    "task=seizure extracts features on the host; fe= "
+                    "must be a registry form (e.g. "
+                    "dwt-4:level=4:stats=energy), not a -fused mode"
+                )
+
+        feature_sets, targets = self._seizure_features(
+            query_map, make_provider, slide_cfg, fe_names
+        )
+        features = feature_sets[0][1]
+        n = len(targets)
+        if n == 0:
+            raise ValueError(
+                f"no sliding windows: every recording is shorter than "
+                f"window={window}"
+            )
+        obs.metrics.count("pipeline.epochs_loaded", n)
+        n_pos = int(np.sum(targets == 1.0))
+
+        wp, wn, cost_fp, cost_fn = self.seizure_weights(
+            query_map, targets
+        )
+        if self.telemetry is not None:
+            self.telemetry.workload = {
+                "task": "seizure",
+                "window": window,
+                "stride": stride,
+                "label_overlap": overlap,
+                "windows": n,
+                "positives": n_pos,
+                "class_ratio": round(n_pos / n, 6),
+                "weight_pos": round(wp, 6),
+                "weight_neg": round(wn, 6),
+                "cost_fp": cost_fp,
+                "cost_fn": cost_fn,
+                "fe": fe_names if len(fe_names) > 1 else fe_names[0],
+            }
+
+        config = {
+            k: v for k, v in query_map.items() if k.startswith("config_")
+        }
+        if wp != 1.0 or wn != 1.0:
+            config["config_weight_pos"] = repr(wp)
+            config["config_weight_neg"] = repr(wn)
+
+        if "classifiers" in query_map:
+            # the fan-out derives its config_* map from the query map
+            # itself — inject the RESOLVED class weights so every leg
+            # trains with them (class_weight=balanced has no config_
+            # spelling of its own)
+            fanout_qm = dict(query_map)
+            fanout_qm.update({
+                k: v for k, v in config.items()
+                if k.startswith("config_weight_")
+            })
+            statistics = self._execute_fanout(
+                fanout_qm, n, features=features, targets=targets,
+                batch=None, fe=None, pop_spec=pop_spec,
+                classifier_factory=self._seizure_classifier,
+            )
+        elif "train_clf" in query_map and pop_spec.active:
+            name = query_map["train_clf"]
+            if name not in population.SGD_FAMILY:
+                raise ValueError(
+                    "population axes (cv=/seeds=/sweep=/fe_sweep=) "
+                    f"apply to the SGD family "
+                    f"({', '.join(population.SGD_FAMILY)}); {name!r} "
+                    f"trains one model per run"
+                )
+            statistics, block = population.run_population(
+                name,
+                lambda: self._seizure_classifier(name),
+                config,
+                features,
+                targets,
+                pop_spec,
+                stage=self._stage,
+                feature_sets=(
+                    feature_sets if pop_spec.fe_configs else None
+                ),
+            )
+            if self.telemetry is not None:
+                self.telemetry.population = block
+        elif "train_clf" in query_map:
+            classifier = self._seizure_classifier(query_map["train_clf"])
+            classifier.set_config(config)
+            train_idx, test_idx = java_compat.train_test_split_indices(
+                n, seed=1
+            )
+            elastic_kwargs = self._elastic_kwargs(query_map)
+            with self._stage(
+                "train",
+                classifier=query_map["train_clf"],
+                task="seizure",
+                elastic=elastic_kwargs is not None,
+            ):
+                if elastic_kwargs is None:
+                    classifier.fit(features[train_idx], targets[train_idx])
+                else:
+                    classifier.fit_elastic(
+                        features[train_idx], targets[train_idx],
+                        **elastic_kwargs,
+                    )
+            if elastic_kwargs is not None:
+                elastic_kwargs["manager"].clear()
+            logger.info("trained %s (seizure)", query_map["train_clf"])
+            if query_map.get("save_clf") == "true":
+                if "save_name" not in query_map:
+                    raise ValueError(
+                        "Please provide a location to save a classifier "
+                        "within the save_name query parameter"
+                    )
+                classifier.save(query_map["save_name"])
+            with self._stage(
+                "test", classifier=query_map["train_clf"], task="seizure"
+            ):
+                statistics = classifier.test_features(
+                    features[test_idx], targets[test_idx]
+                )
+        elif "load_clf" in query_map:
+            classifier = self._seizure_classifier(query_map["load_clf"])
+            if "load_name" not in query_map:
+                raise ValueError("Classifier location not provided")
+            classifier.load(query_map["load_name"])
+            perm = java_compat.java_shuffle_indices(n, seed=1)
+            with self._stage(
+                "test", classifier=query_map["load_clf"], task="seizure"
+            ):
+                statistics = classifier.test_features(
+                    features[perm], targets[perm]
+                )
+        else:
+            raise ValueError("Missing classifier argument")
+
+        # every seizure report carries the imbalanced-class block: the
+        # workload's headline is expected cost / recall, not accuracy
+        stats.mark_extended(statistics, cost_fp=cost_fp, cost_fn=cost_fn)
+        return statistics
+
     # -- population training -------------------------------------------
 
     def _host_features(self, batch, fe):
@@ -670,7 +1046,8 @@ class PipelineBuilder:
     # -- shared-feature fan-out ----------------------------------------
 
     def _execute_fanout(
-        self, query_map, n, features, targets, batch, fe, pop_spec=None
+        self, query_map, n, features, targets, batch, fe, pop_spec=None,
+        classifier_factory=None,
     ) -> stats.FanOutStatistics:
         """``classifiers=a,b,c``: train + test every named classifier
         against the one feature matrix this run already produced.
@@ -703,6 +1080,10 @@ class PipelineBuilder:
             raise ValueError(
                 "classifiers= requires a comma-separated classifier list"
             )
+        # the default factory is the registry itself; the seizure path
+        # substitutes its true-confusion-matrix variant
+        if classifier_factory is None:
+            classifier_factory = clf_registry.create
 
         from ..models import population
 
@@ -752,7 +1133,7 @@ class PipelineBuilder:
                     # legs keep the sequential plain-split path below
                     leg_stats, block = population.run_population(
                         name,
-                        lambda name=name: clf_registry.create(name),
+                        lambda name=name: classifier_factory(name),
                         config,
                         features,
                         targets,
@@ -769,7 +1150,7 @@ class PipelineBuilder:
                         "trains once on the plain split", name,
                     )
                     obs.metrics.count("population.sequential_legs")
-                classifier = clf_registry.create(name)
+                classifier = classifier_factory(name)
                 classifier.set_config(config)
                 sgd_leg = name in population.SGD_FAMILY
                 with self._stage("train", classifier=name):

@@ -114,7 +114,18 @@ class ServingEngine:
         skip_samples: int = 175,
         feature_size: int = 16,
         capacity: int = 64,
+        host_extractor=None,
     ):
+        """``pre``/``post`` parameterize the window length from the
+        workload's config — the engine no longer assumes the P300
+        path's fixed geometry (the seizure service runs ``pre=0,
+        post=<window>`` windows). ``host_extractor`` pins the engine
+        to the host rung with the given registry feature extractor
+        instead of compiling the fused P300 program — the seizure
+        workload's serving mode, whose subband features have no fused
+        twin; requests then take the exact host featurize+predict
+        path the batch run takes, which is what makes served
+        statistics identical to it."""
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.classifier = classifier
@@ -135,6 +146,19 @@ class ServingEngine:
         self.epoch_size = int(epoch_size)
         self.skip_samples = int(skip_samples)
         self.feature_size = int(feature_size)
+        if host_extractor is not None:
+            # host-extractor mode: no fused program exists for this
+            # feature family — the host floor IS the serving path,
+            # not a degradation (rung reads "host" from the start)
+            self._fused_linear = False
+            self._program = None
+            self._rung = "host"
+            self._host_fe = host_extractor
+            self._consecutive_fused_failures = 0
+            self._degrade_after = 2
+            self._warmed = False
+            self._positions = np.zeros((0,), np.int32)
+            return
         # the fused-margin fast path: native float32 linear weights
         # (an imported f64 MLlib model keeps its bit-exact host-f64
         # predict; fusing would downcast it)
@@ -284,8 +308,13 @@ class ServingEngine:
                     f"({self.n_channels}, {self.window_len})"
                 )
             scaled = w.astype(np.float64) * res[:, None]
-            base = scaled[:, : self.pre].mean(axis=1)
-            epochs.append((scaled - base[:, None])[:, self.pre:])
+            if self.pre:
+                base = scaled[:, : self.pre].mean(axis=1)
+                epochs.append((scaled - base[:, None])[:, self.pre:])
+            else:
+                # continuous windows (pre=0, the seizure geometry)
+                # have no prestimulus segment to correct against
+                epochs.append(scaled)
         feats = np.asarray(
             self._host_fe.extract_batch(np.stack(epochs))
         )
@@ -302,6 +331,11 @@ class ServingEngine:
         wedge. Idempotent."""
         if self._warmed:
             return
+        if self._program is None:
+            # host-extractor mode: pure numpy featurization — there
+            # is no XLA program to compile ahead of traffic
+            self._warmed = True
+            return
         # both request dtypes the stage_raw convention produces:
         # int16 (INT_16 recordings) and the float32 fallback — a
         # non-INT_16 session must not pay its cold trace inside the
@@ -315,6 +349,8 @@ class ServingEngine:
 
     @property
     def mode(self) -> str:
+        if self._program is None:
+            return "host-extractor"
         return "fused-linear" if self._fused_linear else "featurize+host"
 
     @property

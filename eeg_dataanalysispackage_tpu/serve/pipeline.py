@@ -107,6 +107,9 @@ def run_serve(query_map, provider_factory, stage):
 
     classifier = clf_registry.create(query_map["load_clf"])
     classifier.load(query_map["load_name"])
+    # the recall-tuning margin knob (docs/serving.md); absent = the
+    # model's own threshold, which is what the batch-parity pin runs
+    threshold = resolve_serve_threshold(query_map, classifier)
 
     odp = provider_factory()
     config = serve_config_from_query(query_map)
@@ -177,6 +180,8 @@ def run_serve(query_map, provider_factory, stage):
     block = service.stats_block()
     block["requests"]["total_epochs"] = n
     block["drained_cleanly"] = drained
+    if threshold is not None:
+        block["serve_threshold"] = threshold
     logger.info(
         "served %d epochs: %d completed, %d shed, %d deadline-"
         "exceeded, %d failed (drained=%s)",
@@ -185,3 +190,180 @@ def run_serve(query_map, provider_factory, stage):
         block["requests"]["failed"], drained,
     )
     return statistics, block
+
+
+def resolve_serve_threshold(query_map, classifier):
+    """``serve_threshold=<margin>``: the recall-tuning decision knob
+    for seizure serving (docs/serving.md). Applied to the loaded
+    linear model's margin threshold — a lower threshold trades false
+    positives for recall without retraining. Linear family only: the
+    other classifiers emit hard labels with no margin to re-threshold.
+    Returns the float applied, or None when the knob is absent."""
+    from ..models import linear as linear_mod
+
+    value = query_map.get("serve_threshold", "")
+    if not value:
+        return None
+    try:
+        threshold = float(value)
+    except ValueError:
+        raise ValueError(
+            f"serve_threshold= must be a float margin, got {value!r}"
+        )
+    if not isinstance(classifier, linear_mod._LinearClassifier):
+        raise ValueError(
+            "serve_threshold= re-thresholds a linear margin; "
+            f"{type(classifier).__name__} has none"
+        )
+    classifier.margin_threshold = threshold
+    return threshold
+
+
+def run_serve_seizure(query_map, provider_factory, stage):
+    """``task=seizure&serve=true``: stream continuous sliding windows
+    through the resident service.
+
+    The engine runs in host-extractor mode (serve/engine.py): the
+    seizure subband features have no fused device twin, so every
+    request takes the exact featurize+predict path the batch
+    ``task=seizure&load_clf=`` run takes — which is what pins served
+    statistics identical to the batch run (tests/test_seizure_
+    pipeline.py). Windows are the SAME float64 scaled slices the
+    batch epocher cuts (provider.sliding_batch_for), shipped with
+    unit resolutions so the engine's scaling is exact. The
+    ``serve_threshold=`` knob re-thresholds the linear margin for
+    recall-tuned serving (with it set, statistics intentionally
+    diverge from the default-threshold batch run).
+
+    Returns ``(ClassificationStatistics, serve block, workload
+    block)``.
+    """
+    from ..epochs.sliding import SlidingConfig
+    from ..pipeline.builder import PipelineBuilder
+
+    conflicts = _conflicting_keys(query_map)
+    if conflicts:
+        raise ValueError(
+            f"serve=true is an inference mode; it cannot combine "
+            f"with {', '.join(conflicts)}"
+        )
+    if "load_clf" not in query_map:
+        raise ValueError(
+            "serve=true requires load_clf= (the model to serve)"
+        )
+    if "load_name" not in query_map:
+        raise ValueError("Classifier location not provided")
+    fe_value = query_map.get("fe", "")
+    if not fe_value:
+        raise ValueError("Missing the feature extraction argument")
+    if "-fused" in fe_value:
+        raise ValueError(
+            "task=seizure serves host-extracted features; fe= must be "
+            "a registry form, not a -fused mode"
+        )
+    from ..features import registry as fe_registry
+
+    window = int(query_map.get("window") or 512)
+    stride = int(query_map.get("stride") or max(1, window // 2))
+    overlap = float(query_map.get("label_overlap") or 0.5)
+    slide_cfg = SlidingConfig(
+        window=window, stride=stride, label_overlap=overlap
+    )
+    fe = fe_registry.create(fe_value)
+
+    classifier = clf_registry.create(query_map["load_clf"])
+    classifier.load(query_map["load_name"])
+    threshold = resolve_serve_threshold(query_map, classifier)
+
+    odp = provider_factory()
+    config = serve_config_from_query(query_map)
+    # the workload config parameterizes the engine's window length:
+    # continuous windows have no prestimulus segment (pre=0)
+    service = service_mod.InferenceService(
+        classifier,
+        n_channels=odp.n_channels,
+        pre=0,
+        post=window,
+        config=config,
+        host_extractor=fe,
+    )
+
+    # 1. ingest: the SAME sliding batches the batch run cuts — float64
+    # scaled windows, unit resolutions (scale-by-1.0 is exact, so the
+    # served feature rows are byte-identical to the batch run's)
+    requests = []
+    targets = []
+    unit_res = np.ones(odp.n_channels, dtype=np.float32)
+    with stage("ingest", mode="serve", task="seizure"):
+        for _rel, _guessed, rec in odp.iter_recordings():
+            batch = odp.sliding_batch_for(rec, slide_cfg)
+            requests.extend(
+                (np.asarray(w), unit_res) for w in batch.epochs
+            )
+            targets.append(batch.targets)
+    targets_arr = (
+        np.concatenate(targets) if targets else np.zeros(0, np.float64)
+    )
+    n = len(requests)
+
+    # 2. serve: micro-batched, deadline-bounded, shed-don't-stall —
+    # the same front end the P300 service runs
+    service.start()
+    try:
+        with stage("serve", requests=n, task="seizure"):
+            results = []
+            if n:
+                results = service.predict_all(
+                    [r[0] for r in requests],
+                    [r[1] for r in requests],
+                )
+    finally:
+        drained = service.stop(drain=True)
+
+    predictions = np.array(
+        [r.prediction for r in results], dtype=np.float64
+    )
+
+    # 3. statistics, the batch load_clf= way (seed-1 shuffled order;
+    # confusion_only=False — the seizure workload reports the TRUE
+    # confusion matrix, the builder's _seizure_classifier contract)
+    with stage("test", classifier=query_map["load_clf"], task="seizure"):
+        perm = java_compat.java_shuffle_indices(n, seed=1)
+        statistics = stats.ClassificationStatistics.from_arrays(
+            predictions[perm], targets_arr[perm],
+            confusion_only=False,
+        )
+    wp, wn, cost_fp, cost_fn = PipelineBuilder.seizure_weights(
+        query_map, targets_arr
+    )
+    stats.mark_extended(statistics, cost_fp=cost_fp, cost_fn=cost_fn)
+
+    block = service.stats_block()
+    block["requests"]["total_epochs"] = n
+    block["drained_cleanly"] = drained
+    if threshold is not None:
+        block["serve_threshold"] = threshold
+    n_pos = int(np.sum(targets_arr == 1.0))
+    workload = {
+        "task": "seizure",
+        "window": window,
+        "stride": stride,
+        "label_overlap": overlap,
+        "windows": n,
+        "positives": n_pos,
+        "class_ratio": round(n_pos / n, 6) if n else 0.0,
+        "weight_pos": round(wp, 6),
+        "weight_neg": round(wn, 6),
+        "cost_fp": cost_fp,
+        "cost_fn": cost_fn,
+        "fe": fe_value,
+        "serve_threshold": threshold,
+    }
+    logger.info(
+        "served %d seizure windows: %d completed, %d shed, %d "
+        "deadline-exceeded, %d failed (drained=%s)",
+        n, block["requests"]["completed"], block["requests"]["shed"],
+        block["requests"]["deadline_exceeded"],
+        block["requests"]["failed"], drained,
+    )
+    return statistics, block, workload

@@ -81,6 +81,7 @@ class InferenceService:
         pre: int = constants.PRESTIMULUS_SAMPLES,
         post: int = constants.POSTSTIMULUS_SAMPLES,
         config: Optional[ServeConfig] = None,
+        host_extractor=None,
     ):
         self.config = config or ServeConfig()
         self.engine = engine_mod.ServingEngine(
@@ -90,6 +91,7 @@ class InferenceService:
             pre=pre,
             post=post,
             capacity=self.config.max_batch,
+            host_extractor=host_extractor,
         )
         self.batcher = batcher_mod.MicroBatcher(
             self.engine.execute,

@@ -71,3 +71,109 @@ def write_session(directory: str, guessed: int = 2, **kwargs) -> str:
     with open(info, "w") as f:
         f.write(f"synth_01.eeg {guessed}\n")
     return info
+
+
+def write_continuous_recording(
+    directory: str,
+    name: str = "seiz_01",
+    n_samples: int = 60000,
+    seizure_intervals=((12000, 16000), (38000, 41000)),
+    seed: int = 0,
+    base_amplitude: int = 600,
+    seizure_gain: float = 2.5,
+):
+    """Write a continuous recording with annotated seizure intervals.
+
+    The signal is broadband noise; inside each annotated interval the
+    amplitude scales by ``seizure_gain`` and a low-frequency
+    oscillation rides on top — enough structure that per-subband
+    energy features separate the classes *imperfectly* (the
+    cost-sensitive training knobs need an actual precision/recall
+    trade-off to act on, not a separable toy). Intervals land in the
+    .vmrk as ``Seizure,on`` / ``Seizure,off`` marker pairs
+    (epochs/sliding.py's annotation convention). Returns the .eeg
+    path.
+    """
+    rng = np.random.RandomState(seed)
+    n_ch = len(CHANNELS)
+    sig = rng.randn(n_samples, n_ch) * base_amplitude
+    t = np.arange(n_samples, dtype=np.float64)
+    for lo, hi in seizure_intervals:
+        burst = rng.randn(hi - lo, n_ch) * base_amplitude * seizure_gain
+        wave = (
+            0.8 * base_amplitude * seizure_gain
+            * np.sin(2 * np.pi * t[lo:hi] / 180.0)
+        )
+        sig[lo:hi] = burst + wave[:, None]
+    raw = np.clip(sig, -32000, 32000).astype("<i2")
+    eeg = os.path.join(directory, name + ".eeg")
+    with open(eeg, "wb") as f:
+        f.write(raw.tobytes())
+
+    vhdr = [
+        "Brain Vision Data Exchange Header File Version 1.0",
+        "[Common Infos]",
+        f"DataFile={name}.eeg",
+        f"MarkerFile={name}.vmrk",
+        "DataFormat=BINARY",
+        "DataOrientation=MULTIPLEXED",
+        f"NumberOfChannels={n_ch}",
+        "SamplingInterval=1000",
+        "[Binary Infos]",
+        "BinaryFormat=INT_16",
+        "[Channel Infos]",
+    ] + [
+        f"Ch{i + 1}={ch},,{RESOLUTION},uV" for i, ch in enumerate(CHANNELS)
+    ]
+    with open(os.path.join(directory, name + ".vhdr"), "w") as f:
+        f.write("\n".join(vhdr) + "\n")
+
+    vmrk = ["Brain Vision Data Exchange Marker File, Version 1.0",
+            "[Marker Infos]"]
+    mk = 1
+    vmrk.append(f"Mk{mk}=New Segment,,0,1,0")
+    mk += 1
+    for lo, hi in seizure_intervals:
+        vmrk.append(f"Mk{mk}=Seizure,on,{lo},1,0")
+        mk += 1
+        vmrk.append(f"Mk{mk}=Seizure,off,{hi},1,0")
+        mk += 1
+    with open(os.path.join(directory, name + ".vmrk"), "w") as f:
+        f.write("\n".join(vmrk) + "\n")
+    return eeg
+
+
+def write_seizure_session(
+    directory: str,
+    n_files: int = 1,
+    n_samples: int = 60000,
+    seed: int = 0,
+    **kwargs,
+) -> str:
+    """An ``n_files``-recording continuous session with annotated
+    seizure intervals; returns the info.txt path. The info.txt guessed
+    number is irrelevant to the seizure task (labels come from the
+    interval annotations) but keeps the manifest format identical to
+    the P300 one, so one provider reads both workloads."""
+    lines = []
+    explicit_intervals = kwargs.pop("seizure_intervals", None)
+    for i in range(n_files):
+        name = f"seiz_{i:02d}"
+        span = n_samples
+        intervals = explicit_intervals or (
+            (int(span * 0.2), int(span * 0.27)),
+            (int(span * 0.63), int(span * 0.68)),
+        )
+        write_continuous_recording(
+            directory,
+            name=name,
+            n_samples=n_samples,
+            seizure_intervals=intervals,
+            seed=seed + i,
+            **kwargs,
+        )
+        lines.append(f"{name}.eeg 1")
+    info = os.path.join(directory, "info.txt")
+    with open(info, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return info

@@ -1,0 +1,378 @@
+"""The ``task=seizure`` workload end to end (docs/workloads.md).
+
+Sliding windows -> configurable subband features -> cost-sensitive
+training -> imbalanced-class statistics, plus the satellites: the
+cross-config feature-cache poisoning pin, the fe_sweep= stacked
+population (0 recompiles on new sweep points, vmap==looped parity),
+the serve=true parity pin with the window-parameterized engine, and
+the serve_threshold= knob.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import _synthetic
+from eeg_dataanalysispackage_tpu.models import stats
+from eeg_dataanalysispackage_tpu.pipeline import builder
+
+_LINEAR_CONFIG = (
+    "config_num_iterations=60&config_step_size=1.0"
+    "&config_mini_batch_fraction=1.0"
+)
+
+
+@pytest.fixture(scope="module")
+def info(tmp_path_factory):
+    d = tmp_path_factory.mktemp("seizure_session")
+    return _synthetic.write_seizure_session(
+        str(d), n_files=2, n_samples=40000
+    )
+
+
+def _q(info, *parts):
+    return "&".join([f"info_file={info}", "task=seizure"] + list(parts))
+
+
+def _run(query):
+    pb = builder.PipelineBuilder(query)
+    return pb, pb.execute()
+
+
+# ------------------------------------------------ end to end
+
+
+def test_seizure_end_to_end_train(info, tmp_path):
+    result = tmp_path / "res.txt"
+    report_dir = tmp_path / "report"
+    _, st = _run(_q(
+        info, "fe=dwt-4:level=4:stats=energy,std", "window=512",
+        "stride=256", "train_clf=logreg", _LINEAR_CONFIG,
+        "cost_fp=1", "cost_fn=8", f"result_path={result}",
+        f"report={report_dir}",
+    ))
+    # extended statistics rendered into result_path
+    text = result.read_text()
+    assert "Precision: " in text and "Recall: " in text
+    assert "Expected cost (fp=1.0, fn=8.0): " in text
+    assert st.extended_report and st.cost_fn == 8.0
+    # the run report carries workload + classification blocks
+    import json
+
+    with open(report_dir / "run_report.json") as f:
+        report = json.load(f)
+    workload = report["workload"]
+    assert workload["task"] == "seizure"
+    assert workload["window"] == 512 and workload["stride"] == 256
+    assert 0.0 < workload["class_ratio"] < 0.35
+    assert workload["weight_pos"] == 8.0
+    block = report["classification"]
+    assert "expected_cost" in block and "recall" in block
+
+
+def test_cost_sensitive_beats_unweighted_on_expected_cost(info):
+    base = _q(
+        info, "fe=dwt-4:level=4:stats=energy,std", "window=512",
+        "stride=256", "train_clf=logreg", _LINEAR_CONFIG, "cache=false",
+    )
+    _, unweighted = _run(base)
+    _, weighted = _run(base + "&cost_fp=1&cost_fn=8")
+    assert weighted.expected_cost(1, 8) < unweighted.expected_cost(1, 8)
+    assert weighted.recall() > (
+        0.0 if np.isnan(unweighted.recall()) else unweighted.recall()
+    )
+
+
+def test_class_weight_balanced_and_errors(info):
+    base = _q(
+        info, "fe=dwt-4:level=2", "window=512", "stride=512",
+        "train_clf=logreg", _LINEAR_CONFIG, "cache=false",
+    )
+    pb, st = _run(base + "&class_weight=balanced&report=false")
+    assert st.extended_report
+    with pytest.raises(ValueError, match="class_weight"):
+        _run(base + "&class_weight=zap")
+    with pytest.raises(ValueError, match="cost_fp=/cost_fn="):
+        _run(base + "&cost_fp=-1")
+    with pytest.raises(ValueError, match="unknown task"):
+        _run(f"info_file={info}&task=zap&fe=dwt-8&train_clf=logreg")
+    with pytest.raises(ValueError, match="-fused"):
+        _run(_q(info, "fe=dwt-8-fused", "train_clf=logreg",
+                _LINEAR_CONFIG))
+
+
+def test_true_confusion_matrix_not_the_mllib_swap(info):
+    """The seizure statistics must label fp/fn correctly — the MLlib
+    report swap (a pinned P300 bug-as-behavior) would corrupt the
+    recall/cost the workload is tuned against. With heavily
+    pos-weighted training the model over-predicts positives: real
+    false POSITIVES, zero/few false negatives."""
+    _, st = _run(_q(
+        info, "fe=dwt-4:level=4:stats=energy,std", "window=512",
+        "stride=256", "train_clf=logreg", _LINEAR_CONFIG,
+        "class_weight=50", "cache=false",
+    ))
+    # over-prediction lands on the fp side of the TRUE matrix
+    assert st.false_positives >= st.false_negatives
+    assert st.recall() >= 0.9
+    # and the incremental sums are filled (confusion_only=False)
+    assert st.class1_sum + st.class2_sum > 0
+
+
+def test_fanout_legs_train_with_resolved_weights(info):
+    """classifiers= fan-out re-derives its config from the query map;
+    the resolved class weights must reach every leg (regression: the
+    legs once trained unweighted and recall collapsed to 0)."""
+    _, st = _run(_q(
+        info, "fe=dwt-4:level=4:stats=energy,std", "window=512",
+        "stride=256", "classifiers=logreg,svm", _LINEAR_CONFIG,
+        "cost_fp=1", "cost_fn=8", "cache=false",
+    ))
+    assert set(st) == {"logreg", "svm"}
+    for name, leg in st.items():
+        assert leg.extended_report, name
+        assert leg.recall() >= 0.9, (name, leg.recall())
+
+
+# ------------------------------------------------ feature cache
+
+
+def test_cache_hit_is_statistics_identical(info, tmp_path, monkeypatch):
+    monkeypatch.delenv("EEG_TPU_NO_FEATURE_CACHE", raising=False)
+    monkeypatch.setenv("EEG_TPU_FEATURE_CACHE_DIR", str(tmp_path / "fc"))
+    from eeg_dataanalysispackage_tpu.io import feature_cache
+
+    q = _q(
+        info, "fe=dwt-4:level=4:stats=energy", "window=512",
+        "stride=256", "train_clf=logreg", _LINEAR_CONFIG,
+    )
+    feature_cache.reset_stats()
+    _, cold = _run(q)
+    assert feature_cache.stats()["misses"] == 1
+    _, warm = _run(q)
+    assert feature_cache.stats()["hits"] == 1
+    assert str(cold) == str(warm)
+
+
+def test_cross_config_poisoning(info, tmp_path, monkeypatch):
+    """A cached entry for one extractor config must NEVER satisfy a
+    request for another: the key folds the full wavelet family /
+    level / stat set (and the epoching geometry), so a ``dwt-8``
+    entry cannot poison a ``dwt-4:level=4:stats=energy`` request."""
+    monkeypatch.delenv("EEG_TPU_NO_FEATURE_CACHE", raising=False)
+    monkeypatch.setenv("EEG_TPU_FEATURE_CACHE_DIR", str(tmp_path / "fc"))
+    from eeg_dataanalysispackage_tpu.io import feature_cache
+
+    def run_cfg(fe, window="window=512", stride="stride=256"):
+        return _run(_q(
+            info, f"fe={fe}", window, stride, "train_clf=logreg",
+            _LINEAR_CONFIG,
+        ))
+
+    feature_cache.reset_stats()
+    run_cfg("dwt-8:level=4:stats=energy")
+    # every other config must MISS (different family, level, stats,
+    # window, stride), never reuse the first entry
+    run_cfg("dwt-4:level=4:stats=energy")
+    run_cfg("dwt-8:level=3:stats=energy")
+    run_cfg("dwt-8:level=4:stats=energy,std")
+    run_cfg("dwt-8:level=4:stats=energy", window="window=768")
+    run_cfg("dwt-8:level=4:stats=energy", stride="stride=128")
+    s = feature_cache.stats()
+    assert s["hits"] == 0 and s["misses"] == 6, s
+    # and the keys really differ on disk (6 distinct entries)
+    entries = os.listdir(str(tmp_path / "fc"))
+    assert len([e for e in entries if e.endswith(".npz")]) == 6
+
+
+def test_fused_key_and_seizure_key_never_collide(tmp_path):
+    """The P300 fused path's extractor tuple and the seizure path's
+    share the run_key scheme; their id tuples are structurally
+    disjoint ('dwt-fused' vs 'seizure' heads)."""
+    from eeg_dataanalysispackage_tpu.features import registry
+    from eeg_dataanalysispackage_tpu.io import feature_cache, provider
+
+    digests = [("a.eeg", 2, "d" * 64)]
+    fused = feature_cache.run_key(
+        digests, ("fz", "cz", "pz"), 100, 750,
+        provider.fused_extractor_id(8),
+    )
+    fe = registry.create("dwt-8:level=4:stats=energy")
+    seizure = feature_cache.run_key(
+        digests, ("fz", "cz", "pz"), 100, 750,
+        ("seizure", fe.cache_id(), 512, 256, 0.5),
+    )
+    assert fused != seizure
+
+
+# ------------------------------------------------ populations
+
+
+def test_fe_sweep_population_vmap_equals_looped(info):
+    axes = (
+        "fe_sweep=dwt-4:level=4:stats=energy,std"
+        "|dwt-8:level=4:stats=energy,std"
+    )
+    base = _q(
+        info, axes, "window=512", "stride=256", "train_clf=logreg",
+        "sweep=cost_fn:1,8", _LINEAR_CONFIG, "cache=false",
+    )
+    _, vmapped = _run(base)
+    _, looped = _run(base + "&population_mode=looped")
+    assert len(vmapped) == 4  # 2 fe configs x 2 costs
+    assert sorted(vmapped) == sorted(looped)
+    assert str(vmapped) == str(looped)  # per-member byte parity
+    assert vmapped.mode == "vmap" and looped.mode == "looped"
+    # member statistics carry the extended block
+    assert all(s.extended_report for s in vmapped.values())
+
+
+def test_fe_sweep_zero_recompiles_on_new_sweep_points(info):
+    """Feature matrices and costs are member-axis INPUTS: a second
+    run with different fe configs and cost values (same cardinality)
+    compiles nothing new."""
+    from eeg_dataanalysispackage_tpu.obs.report import CompilationMonitor
+
+    def run(fes, costs):
+        return _run(_q(
+            info, f"fe_sweep={fes}", "window=512", "stride=256",
+            "train_clf=logreg", f"sweep=cost_fn:{costs}",
+            _LINEAR_CONFIG, "cache=false", "report=false",
+        ))
+
+    run("dwt-4:level=4:stats=energy,std|dwt-8:level=4:stats=energy,std",
+        "1,8")
+    with CompilationMonitor() as monitor:
+        run(
+            "dwt-6:level=4:stats=energy,std"
+            "|dwt-8:level=4:stats=energy,std",
+            "2,16",
+        )
+    snap = monitor.snapshot()
+    if snap["available"]:
+        assert snap["compilations"] == 0, snap
+
+
+def test_fe_sweep_mismatched_shapes_error(info):
+    with pytest.raises(ValueError, match="agree on the feature"):
+        _run(_q(
+            info,
+            "fe_sweep=dwt-4:level=4:stats=energy"
+            "|dwt-4:level=4:stats=energy,std",
+            "window=512", "stride=256", "train_clf=logreg",
+            _LINEAR_CONFIG, "cache=false",
+        ))
+
+
+def test_fe_sweep_conflicts(info):
+    with pytest.raises(ValueError, match="requires task=seizure"):
+        _run(
+            f"info_file={info}&fe_sweep=dwt-4:level=2|dwt-8:level=2"
+            f"&fe=dwt-8&train_clf=logreg"
+        )
+    with pytest.raises(ValueError, match="linear family"):
+        _run(_q(
+            info, "fe_sweep=dwt-4:level=2|dwt-8:level=2",
+            "window=512", "train_clf=nn", "cache=false",
+        ))
+
+
+# ------------------------------------------------ serving
+
+
+@pytest.fixture(scope="module")
+def saved_model(info, tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("seizure_model") / "model")
+    _run(_q(
+        info, "fe=dwt-4:level=4:stats=energy,std", "window=512",
+        "stride=256", "train_clf=logreg", _LINEAR_CONFIG,
+        "cost_fp=1", "cost_fn=8", "save_clf=true",
+        f"save_name={path}", "cache=false",
+    ))
+    return path
+
+
+def _load_q(info, saved_model, *parts):
+    return _q(
+        info, "fe=dwt-4:level=4:stats=energy,std", "window=512",
+        "stride=256", "load_clf=logreg", f"load_name={saved_model}",
+        "cache=false", *parts,
+    )
+
+
+def test_serve_statistics_identical_to_batch(info, saved_model):
+    _, batch = _run(_load_q(info, saved_model))
+    pb, served = _run(_load_q(info, saved_model, "serve=true"))
+    assert str(served) == str(batch)  # byte-identical report
+    assert served.extended_report
+
+
+def test_serve_threshold_tunes_recall(info, saved_model):
+    _, default = _run(_load_q(info, saved_model, "serve=true"))
+    _, tuned = _run(_load_q(
+        info, saved_model, "serve=true", "serve_threshold=-5.0"
+    ))
+    # a deeply negative margin threshold predicts positive more often:
+    # recall can only go up (and here the stats must actually move)
+    assert tuned.recall() >= default.recall()
+    assert (
+        tuned.true_positives + tuned.false_positives
+        >= default.true_positives + default.false_positives
+    )
+    with pytest.raises(ValueError, match="must be a float"):
+        _run(_load_q(
+            info, saved_model, "serve=true", "serve_threshold=zap"
+        ))
+
+
+def test_serve_report_blocks(info, saved_model, tmp_path):
+    report_dir = tmp_path / "serve_report"
+    _run(_load_q(
+        info, saved_model, "serve=true", f"report={report_dir}"
+    ))
+    import json
+
+    with open(report_dir / "run_report.json") as f:
+        report = json.load(f)
+    assert report["workload"]["task"] == "seizure"
+    assert report["serve"]["requests"]["completed"] > 0
+    assert report["serve"]["mode"] == "host-extractor"
+    assert report["serve"]["drained_cleanly"] is True
+    assert report["classification"]["recall"] is not None
+
+
+# ------------------------------------------------ P300 byte-stability
+
+
+def test_p300_path_untouched_by_weight_knobs(tmp_path):
+    """A P300 query (no task=) trains through the exact pre-knob
+    program: weights default to 1.0 and the statistics text carries
+    no extended block."""
+    d = tmp_path / "p300"
+    d.mkdir()
+    info = _synthetic.write_session(str(d), n_markers=30)
+    q = (
+        f"info_file={info}&fe=dwt-8&train_clf=logreg"
+        f"&{_LINEAR_CONFIG}"
+    )
+    st = builder.PipelineBuilder(q).execute()
+    text = str(st)
+    assert "Precision" not in text and "Expected cost" not in text
+    assert st.extended_report is False
+    # and the weighted engine at unit weights is bit-identical to the
+    # unweighted program (the parity story behind the static flag)
+    from eeg_dataanalysispackage_tpu.models import sgd
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(40, 8).astype(np.float32)
+    y = (rng.rand(40) > 0.5).astype(np.float32)
+    cfg_plain = sgd.SGDConfig(num_iterations=30)
+    cfg_unit = sgd.SGDConfig(
+        num_iterations=30, weight_pos=1.0, weight_neg=1.0
+    )
+    assert not cfg_unit.weighted
+    np.testing.assert_array_equal(
+        sgd.train_linear(x, y, cfg_plain),
+        sgd.train_linear(x, y, cfg_unit),
+    )

@@ -1,0 +1,165 @@
+"""The extended ClassificationStatistics metrics (models/stats.py).
+
+Hand-computed confusion-matrix fixtures, degenerate cases, and the
+byte-stability pin for the P300 report surface: an extended-metrics
+refactor that perturbs one byte of the reference-format ``__str__``
+breaks report parity for every existing query string.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from eeg_dataanalysispackage_tpu.models import stats
+
+
+def make(tp, tn, fp, fn):
+    return stats.ClassificationStatistics(tp=tp, tn=tn, fp=fp, fn=fn)
+
+
+# ------------------------------------------------ hand-computed fixtures
+
+
+def test_hand_computed_confusion_matrix():
+    # tp=6, tn=80, fp=4, fn=10 -> worked by hand
+    s = make(6, 80, 4, 10)
+    assert s.num_patterns == 100
+    assert s.calc_accuracy() == pytest.approx(0.86)
+    assert s.precision() == pytest.approx(6 / 10)
+    assert s.recall() == pytest.approx(6 / 16)
+    assert s.specificity() == pytest.approx(80 / 84)
+    p, r = 0.6, 0.375
+    assert s.f1() == pytest.approx(2 * p * r / (p + r))
+    assert s.balanced_accuracy() == pytest.approx((6 / 16 + 80 / 84) / 2)
+
+
+def test_expected_cost_hand_computed():
+    s = make(6, 80, 4, 10)
+    # unit costs: (4 + 10) / 100
+    assert s.expected_cost() == pytest.approx(0.14)
+    # asymmetric: fp=1, fn=8 -> (4*1 + 10*8) / 100
+    assert s.expected_cost(1.0, 8.0) == pytest.approx(0.84)
+    # configured costs are the defaults
+    s.cost_fp, s.cost_fn = 2.0, 5.0
+    assert s.expected_cost() == pytest.approx((4 * 2 + 10 * 5) / 100)
+
+
+def test_from_arrays_extended_metrics_match_incremental():
+    rng = np.random.RandomState(3)
+    real = (rng.rand(200) > 0.6).astype(np.float64)
+    exp = (rng.rand(200) > 0.8).astype(np.float64)
+    batched = stats.ClassificationStatistics.from_arrays(real, exp)
+    inc = stats.ClassificationStatistics()
+    for r, e in zip(real, exp):
+        inc.add(r, e)
+    for metric in ("precision", "recall", "f1", "balanced_accuracy"):
+        assert getattr(batched, metric)() == getattr(inc, metric)()
+
+
+# ------------------------------------------------ degenerate cases
+
+
+def test_no_positives_at_all():
+    """No positive patterns and none predicted: recall/precision/F1
+    are undefined (NaN, the accuracy convention) — not 0, not 1."""
+    s = make(0, 50, 0, 0)
+    assert math.isnan(s.precision())
+    assert math.isnan(s.recall())
+    assert math.isnan(s.f1())
+    assert math.isnan(s.balanced_accuracy())
+    assert s.specificity() == 1.0
+    assert s.expected_cost() == 0.0
+
+
+def test_all_positives():
+    s = make(30, 0, 0, 0)
+    assert s.precision() == 1.0
+    assert s.recall() == 1.0
+    assert s.f1() == 1.0
+    assert math.isnan(s.specificity())
+    assert math.isnan(s.balanced_accuracy())
+    assert s.expected_cost(3.0, 7.0) == 0.0
+
+
+def test_all_missed_positives():
+    s = make(0, 0, 0, 10)
+    assert s.recall() == 0.0
+    assert math.isnan(s.precision())  # predicted none positive
+    assert math.isnan(s.f1())  # p + r undefined
+    assert s.expected_cost(1.0, 8.0) == pytest.approx(8.0)
+
+
+def test_empty_statistics():
+    s = make(0, 0, 0, 0)
+    assert math.isnan(s.calc_accuracy())
+    assert math.isnan(s.expected_cost())
+    assert math.isnan(s.precision())
+
+
+# ------------------------------------------------ report byte-stability
+
+
+#: the EXACT reference-format report for tp=2 tn=3 fp=1 fn=1 with
+#: incremental sums — byte-pinned: the P300 surface must not move
+_P300_REPORT = (
+    "Number of patterns: 7\n"
+    "True positives: 2\n"
+    "True negatives: 3\n"
+    "False positives: 1\n"
+    "False negatives: 1\n"
+    "Accuracy: 71.42857142857143%\n"
+    "MSE: 0.2857142857142857\n"
+    "Non-targets: 1.0\n"
+    "Targets: 2.0\n"
+)
+
+
+def test_p300_report_text_is_byte_unchanged():
+    """The default (non-extended) ``__str__`` must render the exact
+    reference format — no extended lines, no reordering, no
+    whitespace drift. This is the string every existing P300
+    ``result_path`` report and report_sha256 pin is built from."""
+    real = np.array([1.0, 1.0, 0.0, 0.0, 0.0, 1.0, 0.0])
+    exp = np.array([1.0, 1.0, 0.0, 0.0, 0.0, 0.0, 1.0])
+    s = stats.ClassificationStatistics.from_arrays(real, exp)
+    assert str(s) == _P300_REPORT
+    assert s.extended_report is False
+
+
+def test_extended_report_appends_only():
+    """The extended block strictly APPENDS to the reference format:
+    the leading reference-format lines stay byte-identical."""
+    real = np.array([1.0, 1.0, 0.0, 0.0, 0.0, 1.0, 0.0])
+    exp = np.array([1.0, 1.0, 0.0, 0.0, 0.0, 0.0, 1.0])
+    s = stats.ClassificationStatistics.from_arrays(real, exp)
+    stats.mark_extended(s, cost_fp=1.0, cost_fn=8.0)
+    text = str(s)
+    assert text.startswith(_P300_REPORT)
+    assert "Precision: " in text
+    assert "Recall: " in text
+    assert "Expected cost (fp=1.0, fn=8.0): " in text
+
+
+def test_mark_extended_recurses_containers():
+    fan = stats.FanOutStatistics()
+    fan["logreg"] = make(1, 2, 3, 4)
+    pop = stats.PopulationStatistics()
+    pop["f0.s1"] = make(4, 3, 2, 1)
+    fan_and_pop = stats.FanOutStatistics()
+    fan_and_pop["svm"] = pop
+    stats.mark_extended(fan, cost_fp=2.0, cost_fn=3.0)
+    stats.mark_extended(fan_and_pop, cost_fp=2.0, cost_fn=3.0)
+    assert fan["logreg"].extended_report
+    assert fan["logreg"].cost_fn == 3.0
+    assert fan_and_pop["svm"]["f0.s1"].extended_report
+    assert "Precision: " in str(fan_and_pop)
+
+
+def test_extended_summary_block():
+    s = make(6, 80, 4, 10)
+    stats.mark_extended(s, cost_fp=1.0, cost_fn=8.0)
+    block = s.extended_summary()
+    assert block["expected_cost"] == pytest.approx(0.84)
+    assert block["recall"] == pytest.approx(0.375)
+    assert block["cost_fn"] == 8.0

@@ -1,0 +1,126 @@
+"""Pluggable subband wavelet features (features/subband.py) + the
+extended ``fe=`` grammar (features/registry.py)."""
+
+import numpy as np
+import pytest
+
+from eeg_dataanalysispackage_tpu.features import registry, subband, wavelet
+from eeg_dataanalysispackage_tpu.ops import dwt_host, eegdsp_compat
+
+
+# ------------------------------------------------ grammar
+
+
+def test_plain_names_resolve_exactly_as_before():
+    fe = registry.create("dwt-8")
+    assert isinstance(fe, wavelet.WaveletTransform)
+    assert (fe.name, fe.epoch_size, fe.skip_samples, fe.feature_size) == (
+        8, 512, 175, 16
+    )
+    assert isinstance(registry.create("dwt-4-tpu"), wavelet.WaveletTransform)
+    with pytest.raises(ValueError, match="Unsupported feature extraction"):
+        registry.create("nope")
+
+
+def test_extended_grammar_builds_subband_extractor():
+    fe = registry.create("dwt-4:level=4:stats=energy,std")
+    assert isinstance(fe, subband.SubbandWaveletFeatures)
+    assert fe.name == 4 and fe.level == 4
+    assert fe.stats == ("energy", "std")
+    # stats defaults to energy
+    fe2 = registry.create("dwt-8:level=3")
+    assert fe2.stats == ("energy",)
+
+
+def test_grammar_errors():
+    with pytest.raises(ValueError, match="level must be an integer"):
+        registry.create("dwt-4:level=x")
+    with pytest.raises(ValueError, match="unknown fe= option"):
+        registry.create("dwt-4:depth=3")
+    with pytest.raises(ValueError, match="malformed fe= option"):
+        registry.create("dwt-4:level=")
+    with pytest.raises(ValueError, match="plain dwt-<family> form"):
+        registry.create("dwt-4-tpu:level=3")
+    with pytest.raises(ValueError, match="unknown subband stat"):
+        registry.create("dwt-4:stats=zap")
+    with pytest.raises(ValueError, match="repeats an entry"):
+        registry.create("dwt-4:stats=energy,energy")
+    with pytest.raises(ValueError, match="Wavelet Name"):
+        registry.create("dwt-99:level=2")
+
+
+# ------------------------------------------------ extraction semantics
+
+
+def test_feature_dimension_and_shape():
+    fe = subband.SubbandWaveletFeatures(name=4, level=4,
+                                        stats=("energy", "mean", "std"))
+    assert fe.feature_dimension == 3 * 5 * 3
+    x = np.random.RandomState(0).randn(6, 3, 512)
+    out = fe.extract_batch(x)
+    assert out.shape == (6, fe.feature_dimension)
+    # the final vector is L2-normalized
+    np.testing.assert_allclose(np.linalg.norm(out, axis=1), 1.0)
+
+
+def test_subband_energies_match_full_cascade_prefix():
+    """Level-L subband coefficients must be the same numbers the full
+    eegdsp cascade produces (the a_L prefix of fwt_periodic's
+    layout): the subband extractor is a re-grouping of the pinned
+    transform, not a new transform."""
+    rng = np.random.RandomState(1)
+    sig = rng.randn(512)
+    h, g = eegdsp_compat.filter_pair(8)
+    full = dwt_host.fwt_periodic(sig, h, g)  # 6 levels for 10 taps
+    fe = subband.SubbandWaveletFeatures(name=8, level=6,
+                                        stats=("energy",), channels=(1,))
+    bands = fe._decompose(sig[None, None, :])
+    # [a6 | d6 | d5 | ... | d1] is exactly the full-cascade layout
+    flat = np.concatenate([b[0, 0] for b in bands])
+    np.testing.assert_allclose(flat, full, rtol=0, atol=0)
+
+
+def test_deterministic_and_dtype():
+    x = np.random.RandomState(2).randn(4, 3, 512)
+    fe = registry.create("dwt-4:level=3:stats=energy,mean")
+    a = fe.extract_batch(x)
+    b = fe.extract_batch(x)
+    np.testing.assert_array_equal(a, b)
+    assert a.dtype == np.float64
+
+
+def test_level_too_deep_raises():
+    fe = subband.SubbandWaveletFeatures(name=8, level=9)
+    with pytest.raises(ValueError, match="supports only"):
+        fe.extract_batch(np.zeros((1, 3, 512)))
+
+
+def test_stat_values_hand_checked():
+    """Constant signal through the Daubechies scaling filter: detail
+    coefficients vanish, so detail-band energies are ~0 and the
+    approximation band carries everything."""
+    x = np.ones((1, 1, 64))
+    fe = subband.SubbandWaveletFeatures(name=8, level=2,
+                                        stats=("energy",), channels=(1,))
+    out = fe.extract_batch(x)[0]  # [a2, d2, d1] energies, normalized
+    assert out[0] == pytest.approx(1.0, abs=1e-10)
+    assert abs(out[1]) < 1e-10 and abs(out[2]) < 1e-10
+
+
+# ------------------------------------------------ cache identity
+
+
+def test_cache_ids_are_config_complete():
+    a = registry.create("dwt-4:level=4:stats=energy")
+    b = registry.create("dwt-4:level=4:stats=energy,std")
+    c = registry.create("dwt-4:level=3:stats=energy")
+    d = registry.create("dwt-8:level=4:stats=energy")
+    ids = {a.cache_id(), b.cache_id(), c.cache_id(), d.cache_id()}
+    assert len(ids) == 4  # family, level, stat set all distinguish
+    # the raw-coefficient extractor is distinct too
+    assert registry.create("dwt-8").cache_id() not in ids
+    # backend does NOT distinguish (the rung contract)
+    assert (
+        registry.create("dwt-8").cache_id()
+        == registry.create("dwt-8-tpu").cache_id()
+    )

@@ -43,7 +43,16 @@ performance contract holds:
   chaos-injected soak (serve.request/serve.batch faults) terminated
   cleanly with every request resolved and a completed drain, and the
   ``serve=true`` pipeline run's ``run_report.json`` carries the
-  ``serve`` block.
+  ``serve`` block;
+- the seizure workload (seizure_e2e, tools/pipeline_bench.py): one
+  cost-swept population run (sweep=cost_fn:1,8 — the unit-weight
+  member IS the unweighted baseline, trained in the same vmapped
+  program); the synthetic continuous set is genuinely imbalanced,
+  and the cost-sensitive member BEATS its unweighted twin on
+  expected cost at the configured asymmetric costs (higher recall
+  too) — the cost-sensitive knobs must buy what they claim on the
+  workload they exist for; the run's ``run_report.json`` carries the
+  ``workload`` and per-member ``classification`` blocks.
 
 Usage: python tools/e2e_smoke.py [n_markers_per_file] [n_files]
 
@@ -156,6 +165,67 @@ def _run_variant(variant: str, n_markers: int, n_files: int,
 
 #: stages a timed pipeline run must have spent real time in
 _REQUIRED_STAGES = ("ingest", "train", "test")
+
+
+def _check_seizure(line: dict, report_dir: str,
+                   failures: list) -> None:
+    """The seizure-workload gate: an imbalanced synthetic set, the
+    cost-swept population's weighted member beating its unweighted
+    twin (same vmapped program, same rows) on expected cost AND
+    recall at the same asymmetric costs, and a run report carrying
+    the workload + per-member classification blocks."""
+    block = line.get("seizure") or {}
+    w = block.get("weighted") or {}
+    u = block.get("unweighted") or {}
+    if not w or not u:
+        failures.append(
+            f"seizure: missing weighted/unweighted members: {block}"
+        )
+        return
+    ratio = block.get("class_ratio", 1.0)
+    if not 0.0 < ratio < 0.35:
+        failures.append(
+            f"seizure: synthetic set not imbalanced (class_ratio="
+            f"{ratio})"
+        )
+    if not w.get("expected_cost", 1e9) < u.get("expected_cost", 0.0):
+        failures.append(
+            f"seizure: cost-sensitive member did not beat the "
+            f"unweighted twin on expected cost: "
+            f"{w.get('expected_cost')} vs {u.get('expected_cost')}"
+        )
+    if not w.get("recall", 0.0) > (u.get("recall") or 0.0):
+        failures.append(
+            f"seizure: cost-sensitive member did not raise recall: "
+            f"{w.get('recall')} vs {u.get('recall')}"
+        )
+    if not block.get("windows_per_s", 0.0) > 0.0:
+        failures.append(
+            f"seizure: no windows/sec recorded: {block}"
+        )
+    report_path = os.path.join(report_dir, "run_report.json")
+    try:
+        with open(report_path) as f:
+            report = json.load(f)
+    except (OSError, ValueError) as e:
+        failures.append(f"seizure: no readable run_report.json: {e}")
+        return
+    workload = report.get("workload") or {}
+    if workload.get("task") != "seizure" or not workload.get("windows"):
+        failures.append(
+            f"seizure: run_report.json workload block missing/empty: "
+            f"{workload}"
+        )
+    classification = report.get("classification") or {}
+    # a population run's classification block is per-member
+    if not any(
+        isinstance(v, dict) and "expected_cost" in v
+        for v in classification.values()
+    ):
+        failures.append(
+            f"seizure: run_report.json classification block missing "
+            f"per-member expected_cost: {classification}"
+        )
 
 
 def _check_report(tag: str, bench_line: dict, report_dir: str,
@@ -277,6 +347,18 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
             min(n_markers, 400), n_files, serve_report_dir
         )
         _check_serve(serve_line, serve_report_dir, failures)
+        # the seizure workload: one cost-swept population run over a
+        # continuous annotated session (its own data dir — the
+        # manifest points at continuous recordings); the swept member
+        # set contains BOTH the cost-sensitive model and its
+        # unweighted twin, trained in one vmapped program
+        seizure_data = os.path.join(tmp, "seizure_data")
+        seizure_report_dir = os.path.join(tmp, "report_seizure")
+        seizure_line = _run_variant(
+            "seizure_e2e", 40000, 2, seizure_data,
+            os.path.join(tmp, "cache_seizure"), seizure_report_dir,
+        )
+        _check_seizure(seizure_line, seizure_report_dir, failures)
         cold_report = _check_report(
             "cold", cold, report_dirs["cold"], failures, reports_checked
         )
@@ -416,6 +498,21 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
         "serve_chaos_clean": (serve_line.get("serve") or {}).get(
             "chaos", {}
         ).get("chaos_clean"),
+        "seizure_class_ratio": (seizure_line.get("seizure") or {}).get(
+            "class_ratio"
+        ),
+        "seizure_weighted_cost": (
+            (seizure_line.get("seizure") or {}).get("weighted") or {}
+        ).get("expected_cost"),
+        "seizure_unweighted_cost": (
+            (seizure_line.get("seizure") or {}).get("unweighted") or {}
+        ).get("expected_cost"),
+        "seizure_weighted_recall": (
+            (seizure_line.get("seizure") or {}).get("weighted") or {}
+        ).get("recall"),
+        "seizure_windows_per_s": (seizure_line.get("seizure") or {}).get(
+            "windows_per_s"
+        ),
         "reports_checked": len(reports_checked),
         "cold_stages": {
             k: v["seconds"] for k, v in cold.get("stages", {}).items()

@@ -127,6 +127,43 @@ def show(path: str) -> None:
     if crash:
         err = data.get("error", {})
         print(f"\nerror: {err.get('type')}: {err.get('message')}")
+    workload = data.get("workload")
+    if workload:
+        print("\nworkload:")
+        print(
+            f"  task={workload.get('task')}  window="
+            f"{workload.get('window')}  stride={workload.get('stride')}"
+            f"  label_overlap={workload.get('label_overlap')}"
+        )
+        print(
+            f"  windows={workload.get('windows')}  positives="
+            f"{workload.get('positives')}  class_ratio="
+            f"{workload.get('class_ratio')}"
+        )
+        print(
+            f"  weight_pos={workload.get('weight_pos')}  weight_neg="
+            f"{workload.get('weight_neg')}  cost_fp="
+            f"{workload.get('cost_fp')}  cost_fn={workload.get('cost_fn')}"
+        )
+    classification = data.get("classification")
+    if classification:
+        print("\nclassification (extended metrics):")
+        blocks = (
+            classification
+            if all(isinstance(v, dict) for v in classification.values())
+            else {"": classification}
+        )
+        for member, block in blocks.items():
+            if block is None:
+                continue
+            prefix = f"  {member}: " if member else "  "
+            print(
+                f"{prefix}precision={block.get('precision')} "
+                f"recall={block.get('recall')} f1={block.get('f1')} "
+                f"balanced_acc={block.get('balanced_accuracy')} "
+                f"expected_cost={block.get('expected_cost')} "
+                f"(fp={block.get('cost_fp')}, fn={block.get('cost_fn')})"
+            )
     pop = data.get("population")
     if pop:
         print("\npopulation:")

@@ -28,6 +28,18 @@ Variants:
                         statistics (report_sha256 equality) are the
                         parity contract; the ``stages.train`` delta is
                         the engine's win
+  seizure_e2e           the continuous-EEG seizure workload
+                        (task=seizure, docs/workloads.md): sliding-
+                        window epoching over a synthetic annotated
+                        continuous session, subband features, and a
+                        COST-SWEPT population — sweep=cost_fn:1,8
+                        trains the unit-weight member and the
+                        8x-positive-weight member in one vmapped
+                        program. The line records windows/sec, the
+                        class ratio, and per-member recall/expected-
+                        cost at the swept costs; the smoke gate
+                        compares the weighted member against its
+                        unweighted twin from the SAME line
   populate              internal: run the cold query to fill
                         --cache-dir, print nothing (the warm variant's
                         helper child)
@@ -105,6 +117,41 @@ _FANOUT_CLASSIFIERS = "logreg,svm,dt,rf,nn"
 _POPULATION_AXES = "cv=4&sweep=lr:1.0,0.5;reg:0.0,0.01&cache=false"
 _POPULATION_ITERS = 6000
 _POPULATION_FRACTION = 1.0
+
+#: the seizure_e2e family's fixed geometry: for this variant n_markers
+#: means SAMPLES PER FILE (a continuous recording has no markers) and
+#: n_files the recording count. sweep=cost_fn:1,8 trains BOTH the
+#: unit-weight member (the cost-blind baseline) and the
+#: 8x-positive-weight member in one vmapped program; expected_cost is
+#: evaluated for every member at the run's cost_fp=1/cost_fn=8 (a
+#: missed seizure bills 8x a false alarm), so the pair is directly
+#: comparable from one line.
+_SEIZURE_FE = "dwt-4:level=4:stats=energy,std"
+_SEIZURE_WINDOW = 512
+_SEIZURE_STRIDE = 256
+_SEIZURE_COST_FN = 8.0
+_SEIZURE_ITERS = 200
+
+
+def build_seizure_query(info: str) -> str:
+    return (
+        f"info_file={info}&task=seizure&fe={_SEIZURE_FE}"
+        f"&window={_SEIZURE_WINDOW}&stride={_SEIZURE_STRIDE}"
+        f"&train_clf=logreg&cache=false"
+        f"&sweep=cost_fn:1,{_SEIZURE_COST_FN:g}"
+        f"&config_num_iterations={_SEIZURE_ITERS}&config_step_size=1.0"
+        f"&config_mini_batch_fraction=1.0"
+        f"&cost_fp=1&cost_fn={_SEIZURE_COST_FN:g}"
+    )
+
+
+def write_seizure_session(directory: str, n_samples: int,
+                          n_files: int) -> str:
+    import _synthetic
+
+    return _synthetic.write_seizure_session(
+        directory, n_files=n_files, n_samples=n_samples
+    )
 
 #: scratch dir this invocation created itself (cleaned on exit)
 _OWNED_TMP = None
@@ -202,7 +249,7 @@ def main(argv) -> dict:
             raise SystemExit(f"unknown argument {arg!r}")
     if variant not in (
         "pipeline_e2e_cold", "pipeline_e2e_warm", "pipeline_e2e_fanout5",
-        "population_vmap", "population_looped",
+        "population_vmap", "population_looped", "seizure_e2e",
         "populate",
     ):
         raise SystemExit(f"unknown variant {variant!r}")
@@ -216,7 +263,13 @@ def main(argv) -> dict:
     os.makedirs(cache_dir, exist_ok=True)
     info = os.path.join(data_dir, "info.txt")
     if not os.path.exists(info):
-        info = write_session(data_dir, n_markers, n_files)
+        if variant == "seizure_e2e":
+            # continuous annotated recordings: n_markers means
+            # samples-per-file here (a continuous session has no
+            # marker count to size by)
+            info = write_seizure_session(data_dir, n_markers, n_files)
+        else:
+            info = write_session(data_dir, n_markers, n_files)
 
     # the feature cache must be live in this child regardless of the
     # hermetic-test default, and must point at the per-run directory
@@ -251,6 +304,8 @@ def main(argv) -> dict:
     if variant.startswith("population_"):
         mode = "vmap" if variant == "population_vmap" else "looped"
         query = build_population_query(info, mode)
+    elif variant == "seizure_e2e":
+        query = build_seizure_query(info)
     else:
         query = build_query(
             info, fanout=variant == "pipeline_e2e_fanout5",
@@ -292,6 +347,41 @@ def main(argv) -> dict:
             name: round(s.calc_accuracy(), 6)
             for name, s in statistics.items()
         }
+    elif variant == "seizure_e2e":
+        # windows/sec rides the epochs_per_s field (one window = one
+        # epoch through the shared counter). statistics is the
+        # cost-swept PopulationStatistics: one member per swept
+        # cost_fn value; the member with cost_fn == 1 IS the
+        # unweighted baseline, trained in the same vmapped program,
+        # so weighted-vs-unweighted is comparable from this one line.
+        def member_block(s):
+            return {
+                "recall": round(s.recall(), 6),
+                "precision": round(s.precision(), 6),
+                "f1": round(s.f1(), 6),
+                "balanced_accuracy": round(s.balanced_accuracy(), 6),
+                "expected_cost": round(s.expected_cost(), 6),
+                "accuracy": round(s.calc_accuracy(), 6),
+            }
+
+        members = {label: member_block(s) for label, s in
+                   statistics.items()}
+        any_member = next(iter(statistics.values()))
+        unweighted = statistics["f0.s42.cfn1"]
+        weighted = statistics[f"f0.s42.cfn{_SEIZURE_COST_FN:g}"]
+        payload["seizure"] = {
+            "windows_per_s": payload["epochs_per_s"],
+            "class_ratio": round(
+                (any_member.true_positives + any_member.false_negatives)
+                / max(1, any_member.num_patterns), 6
+            ),
+            "cost_fp": any_member.cost_fp,
+            "cost_fn": any_member.cost_fn,
+            "members": members,
+            "unweighted": member_block(unweighted),
+            "weighted": member_block(weighted),
+        }
+        payload["accuracy"] = round(statistics.calc_accuracy(), 6)
     elif variant.startswith("population_"):
         # the per-member table plus the cross-member digest: the
         # artifact alone shows what the 16 members scored, and the
