@@ -17,6 +17,13 @@ train-step variants (tools/ingest_bench.py) with HBM-roofline context:
   block_ingest    fused int16 ingest, irregular markers -> features
                   via tile-row gathers + the 128-variant operator
                   bank (XLA-only; no element gather)
+  decode_ingest   fused int16 ingest, irregular markers -> features
+                  via the decode rung (ops/decode_ingest.py): windows
+                  cut by dynamic slices in split tiled scans (CPU) or
+                  the bank128 VMEM kernel (accelerators); the line's
+                  ``gather_baseline`` block records the same-machine
+                  element-gather throughput and the decode/gather
+                  ratio — the irregular-ingest-gap headline
   train_step      f32 epochs -> features -> MLP fwd/bwd/update
   train_step_512  the train step over compact-resident (B, C, 512)
                   epochs (honest 6144 B/epoch)
@@ -34,6 +41,14 @@ train-step variants (tools/ingest_bench.py) with HBM-roofline context:
                   — the end-to-end numbers the kernel epochs/s lines
                   never captured, meaningful even on cpu_fallback
                   (the wins are host-side)
+  pipeline_e2e_overlap / _bf16
+                  the cold query's two knobs, each isolating one
+                  variable against pipeline_e2e_cold: overlap=true
+                  (double-buffered ingest/compute — report_sha256
+                  equality is the bit-identical-statistics pin) and
+                  precision=bf16 (the accuracy-gated bfloat16 feature
+                  path — the line's ``precision`` block records the
+                  gate decision)
   population_vmap / population_looped
                   a 16-member population (cv=4 x a 2x2 lr/reg grid,
                   models/population.py) trained as one vmapped
@@ -137,6 +152,9 @@ _VARIANT_TIMEOUTS = {
     "regular_ingest": _SLOW_COMPILE_TIMEOUT_S,
     "train_step_raw": _SLOW_COMPILE_TIMEOUT_S,
     "pallas_ingest": _SLOW_COMPILE_TIMEOUT_S,
+    # decode routes to the bank128 Pallas kernel on accelerators —
+    # same fresh-compile class as pallas_ingest
+    "decode_ingest": _SLOW_COMPILE_TIMEOUT_S,
 }
 # Total wall budget for the variant loop: the headline always runs;
 # a further variant starts only if it could finish inside the budget
@@ -145,7 +163,7 @@ _VARIANT_TIMEOUTS = {
 # patience — on a warm compile cache everything fits easily; on a
 # cold cache the tail variants may be budget-skipped (recorded as
 # such, artifact intact). BENCH_TOTAL_BUDGET overrides.
-_N_VARIANTS = 18  # asserted against the variant tables below
+_N_VARIANTS = 21  # asserted against the variant tables below
 _TOTAL_BUDGET_S = int(
     os.environ.get(
         "BENCH_TOTAL_BUDGET",
@@ -186,6 +204,9 @@ _VARIANTS_TPU = {
     ),
     "regular_ingest": (262144, 20),
     "block_ingest": (32768, 10),
+    # the decode rung (bank128 routing on chip); its line also times
+    # the element-gather rung on the same data for the ratio
+    "decode_ingest": (131072, 20),
     "train_step": (131072, 20),
     # the compact train twin at the headline batch (honest 6144
     # B/epoch step read)
@@ -202,6 +223,10 @@ _VARIANTS_TPU = {
     "pipeline_e2e_cold": (2000, 4),
     "pipeline_e2e_warm": (2000, 4),
     "pipeline_e2e_fanout5": (2000, 4),
+    # the cold query's overlap=true / precision=bf16 twins (each
+    # isolates one knob against pipeline_e2e_cold)
+    "pipeline_e2e_overlap": (2000, 4),
+    "pipeline_e2e_bf16": (2000, 4),
     # population training engine (markers per file, file count): 16
     # SGD members as one vmapped program vs the same members looped
     "population_vmap": (800, 2),
@@ -222,6 +247,7 @@ _VARIANTS_CPU = {
     "einsum_512_bf16": (8192, 3),
     "regular_ingest": (8192, 3),
     "block_ingest": (2048, 2),
+    "decode_ingest": (8192, 5),
     "train_step": (8192, 3),
     "train_step_512": (8192, 3),
     "train_step_raw": (4096, 2),
@@ -230,6 +256,8 @@ _VARIANTS_CPU = {
     "pipeline_e2e_cold": (2000, 4),
     "pipeline_e2e_warm": (2000, 4),
     "pipeline_e2e_fanout5": (2000, 4),
+    "pipeline_e2e_overlap": (2000, 4),
+    "pipeline_e2e_bf16": (2000, 4),
     "population_vmap": (800, 2),
     "population_looped": (800, 2),
     "seizure_e2e": (60000, 2),
@@ -565,6 +593,13 @@ def _collect(platform: str) -> dict:
                 "plan_cache", "compile_cache", "feature_cache",
                 "wall_s", "classifiers", "accuracy", "report_sha256",
                 "stages", "population", "serve", "seizure",
+                # PR 8 attribution: bandwidth + h2d transfer bytes on
+                # every ingest/pipeline line, the decode line's
+                # gather-baseline ratio block, the bf16 gate decision,
+                # the overlap flag, and the kernel parity deviation
+                "bytes_per_s", "h2d_bytes", "gather_baseline",
+                "precision", "overlap", "parity_max_abs_dev",
+                "plateau",
             ):
                 if extra_field in r:
                     variants[name][extra_field] = r[extra_field]
@@ -585,6 +620,28 @@ def _collect(platform: str) -> dict:
     if "epochs_per_s" not in variants.get("einsum", {}):
         raise RuntimeError(f"headline variant failed: {variants}")
     eps = variants["einsum"]["epochs_per_s"]
+    # machine-normalized plateau: the cold child embedded the
+    # committed BENCH_pr5 reference values; dividing both cold
+    # numbers by their artifact's einsum headline removes machine
+    # speed from the comparison (this box's load swings 2-4x between
+    # runs — a raw-eps plateau claim would measure the weather, not
+    # the code; tools/e2e_smoke.py gates the same normalized form)
+    cold = variants.get("pipeline_e2e_cold", {})
+    plateau = cold.get("plateau")
+    if plateau and plateau.get("pr5_einsum_eps") and eps:
+        # the artifact-level headline as extra context; the child's
+        # own ADJACENT einsum probe (tools/pipeline_bench.py) is the
+        # authoritative normalization and is never overwritten here
+        plateau["einsum_eps_now"] = eps
+        ratio_pr5 = plateau["pr5_cold_eps"] / plateau["pr5_einsum_eps"]
+        plateau.setdefault(
+            "normalized_ratio", round(cold["epochs_per_s"] / eps, 5)
+        )
+        plateau.setdefault("pr5_normalized_ratio", round(ratio_pr5, 5))
+        plateau.setdefault(
+            "beats_pr5_plateau_normalized",
+            bool(plateau["normalized_ratio"] > ratio_pr5),
+        )
     payload = {
         "metric": (
             "epochs/sec (3ch×1000samp) through dwt-8 feature extraction"

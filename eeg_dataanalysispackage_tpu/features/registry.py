@@ -36,10 +36,10 @@ def register(name: str, factory: Callable[[], base.FeatureExtraction]) -> None:
 def _create_subband(base_name: str, opts: list) -> base.FeatureExtraction:
     """``dwt-<family>:level=<L>[:stats=...]`` -> SubbandWaveletFeatures.
 
-    The options ride the full raw parameter value (the builder
-    re-extracts ``fe=`` verbatim via ``get_raw_param`` — the query
-    map's second-``=`` truncation quirk would otherwise eat
-    ``level=4``)."""
+    The options arrive verbatim: the query parser splits at the FIRST
+    ``=`` only (pipeline/builder.get_query_map), so ``level=4`` and
+    friends survive without the per-key re-extraction the truncating
+    parser used to force."""
     from . import subband
 
     m = re.fullmatch(r"dwt-(\d+)", base_name)

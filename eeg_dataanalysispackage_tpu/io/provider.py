@@ -58,19 +58,35 @@ def default_ingest_workers() -> int:
     return min(_DEFAULT_INGEST_WORKERS, os.cpu_count() or 1)
 
 #: the backend degradation ladder for fused device ingest, fastest
-#: first: Pallas kernel -> block (alignment-classed matmul) -> XLA
-#: element gather -> host epochs + registry extractor. Each rung
-#: produces the same features (tolerance-level numerics), so stepping
-#: down trades speed for survival, never correctness.
-FUSED_DEGRADATION_LADDER = ("pallas", "block", "xla", "host")
+#: first: decode (slice-scan window cut on CPU / VMEM bank kernel on
+#: accelerators — ops/decode_ingest.py) -> Pallas kernel -> block
+#: (alignment-classed matmul) -> XLA element gather -> host epochs +
+#: registry extractor. Each rung produces the same features
+#: (tolerance-level numerics), so stepping down trades speed for
+#: survival, never correctness.
+FUSED_DEGRADATION_LADDER = ("decode", "pallas", "block", "xla", "host")
+
+#: env opt-in for double-buffered ingest/compute overlap: the fused
+#: featurization of recording K+1 runs on a staging producer thread
+#: (io/staging.prefetch with a featurize ``stage_fn``) while the
+#: consumer collects recording K — bit-identical epoch order and
+#: statistics, overlap-on vs off (pinned). The ``overlap=`` query
+#: parameter overrides per run.
+ENV_OVERLAP = "EEG_TPU_OVERLAP"
+
+
+def default_overlap() -> bool:
+    """``EEG_TPU_OVERLAP=1`` turns the overlapped fused-ingest path on
+    process-wide (a per-run ``overlap=`` query wins either way)."""
+    return os.environ.get(ENV_OVERLAP) == "1"
 
 
 def degradation_ladder(backend: str):
     """Backends to try, in order, starting from ``backend``.
 
-    ``pallas`` -> ``["pallas", "block", "xla", "host"]``; ``xla`` ->
-    ``["xla", "host"]``. The terminal ``"host"`` rung is not a
-    ``load_features_device`` backend — it signals the caller
+    ``decode`` -> ``["decode", "pallas", "block", "xla", "host"]``;
+    ``xla`` -> ``["xla", "host"]``. The terminal ``"host"`` rung is
+    not a ``load_features_device`` backend — it signals the caller
     (pipeline/builder.py) to fall back to host epoch loading plus the
     registry feature extractor.
     """
@@ -81,12 +97,19 @@ def degradation_ladder(backend: str):
     )
 
 
-def fused_extractor_id(wavelet_index: int) -> Tuple:
+def fused_extractor_id(wavelet_index: int, precision: str = "f32") -> Tuple:
     """The fused path's static extractor id/config tuple (feature-
     cache key component), derived from
     :meth:`OfflineDataProvider.load_features_device`'s own parameter
     defaults — so the key can never drift from the geometry the
-    computation actually runs with."""
+    computation actually runs with.
+
+    ``precision`` folds the numeric class into the key: the f32 tuple
+    is byte-unchanged from PR 3 (warm caches survive this PR), while
+    the bf16 path keys its own entries — a bf16 feature matrix can
+    never serve an f32-class request or vice versa (the
+    WaveletTransform.cache_id precision-class rule from PR 7, applied
+    to the fused family)."""
     import inspect
 
     defaults = {
@@ -96,13 +119,16 @@ def fused_extractor_id(wavelet_index: int) -> Tuple:
         ).parameters.items()
         if p.default is not inspect.Parameter.empty
     }
-    return (
+    base = (
         "dwt-fused",
         int(wavelet_index),
         defaults["epoch_size"],
         defaults["skip_samples"],
         defaults["feature_size"],
     )
+    if precision == "f32":
+        return base
+    return base + (str(precision),)
 
 
 @dataclasses.dataclass
@@ -483,6 +509,8 @@ class OfflineDataProvider:
         recordings: Optional[
             Sequence[Tuple[str, int, brainvision.Recording]]
         ] = None,
+        precision: str = "f32",
+        overlap: Optional[bool] = None,
     ):
         """TPU fast path: info.txt run -> DWT features without host epochs.
 
@@ -492,8 +520,11 @@ class OfflineDataProvider:
         state. Returns (features (n, C*feature_size) float32,
         targets (n,) float64).
 
-        ``backend``: "xla" (ops/device_ingest.py — gather + einsum),
-        "block" (ops/device_ingest.make_classed_block_ingest_featurizer
+        ``backend``: "decode" (ops/decode_ingest.py — windows cut by
+        dynamic slices in a tiled scan on CPU, by the VMEM bank128
+        kernel on accelerators; no XLA gather anywhere), "xla"
+        (ops/device_ingest.py — gather + einsum), "block"
+        (ops/device_ingest.make_classed_block_ingest_featurizer
         — tile-row gathers with windows batched by alignment class, so
         each class contracts as one matmul; the host gather plan is
         memoized in ops/plan_cache, and re-ingesting an unchanged
@@ -501,17 +532,35 @@ class OfflineDataProvider:
         (ops/ingest_pallas.py — the fully fused VMEM-chunked kernel;
         interpret mode off-TPU).
 
+        ``precision="bf16"`` computes the cascade matmul in bfloat16
+        with f32 accumulation — supported on the decode rung only, and
+        meant to run behind the per-run accuracy gate
+        (:meth:`bf16_gate_check` / pipeline/builder.py).
+
+        ``overlap`` (None -> ``EEG_TPU_OVERLAP``) runs each
+        recording's staging + fused-program dispatch on a background
+        staging thread (io/staging.prefetch) so recording K+1's
+        decode+featurize overlaps the consumer's handling of
+        recording K — order-preserving, so features/targets are
+        bit-identical to the serial path (pinned).
+
         Numerics follow the float32 device path (tolerance-level vs
         the bit-exact host path) — use :meth:`load` + a host-backend
         WaveletTransform when bit parity with the Java reference is
         required.
         """
+        from .. import obs
         from ..epochs.extractor import BalanceState
         from ..obs import chaos, events
         from ..ops import device_ingest
 
-        if backend not in ("xla", "block", "pallas"):
+        if backend not in ("decode", "xla", "block", "pallas"):
             raise ValueError(f"unknown device-ingest backend {backend!r}")
+        if precision != "f32" and backend != "decode":
+            raise ValueError(
+                f"precision={precision!r} is a decode-rung feature; "
+                f"backend {backend!r} computes f32"
+            )
         # telemetry: record which fused rung this attempt runs — the
         # builder's ladder may call several times before one lands
         events.event(
@@ -538,6 +587,7 @@ class OfflineDataProvider:
             # on another backend re-reads nothing either
             source = iter(recordings)
         balance = BalanceState()
+        pallas_featurizer = featurizer = None
         if backend == "pallas":
             import os
 
@@ -554,7 +604,18 @@ class OfflineDataProvider:
                 # EEG_PALLAS_MODE overrides
                 mode=os.environ.get("EEG_PALLAS_MODE") or None,
             )
-        if backend == "block":
+        elif backend == "decode":
+            from ..ops import decode_ingest
+
+            featurizer = decode_ingest.make_decode_ingest_featurizer(
+                wavelet_index=wavelet_index,
+                epoch_size=epoch_size,
+                skip_samples=skip_samples,
+                feature_size=feature_size,
+                pre=self._pre,
+                precision=precision,
+            )
+        elif backend == "block":
             # the host-planned alignment-classed form: positions here
             # are always concrete IngestPlan metadata, so the plan
             # cache applies and the 128-variant bank's MACs don't
@@ -575,12 +636,15 @@ class OfflineDataProvider:
                 pre=self._pre,
                 post=self._post,
             )
-        feats: List[np.ndarray] = []
-        targets: List[np.ndarray] = []
-        # the ordered parallel parse: while this loop runs one file's
-        # staging + fused program dispatch, the pool is already
-        # parsing the next files' triplets on the host
-        for rel_path, guessed, rec in source:
+
+        def featurize_one(item):
+            """One recording's staging + plan + fused dispatch ->
+            (device features, mask-or-None, targets). Shared verbatim
+            by the serial loop and the overlap producer, so the two
+            paths cannot drift; runs single-threaded in either case
+            (the balance scan and the stale-channel-index reuse are
+            order-dependent state)."""
+            _rel_path, guessed, rec = item
             raw, res, n_samples = device_ingest.stage_raw(
                 rec, self._channel_indices(rec)
             )
@@ -592,17 +656,53 @@ class OfflineDataProvider:
                 post=self._post,
                 balance=balance,
             )
+            # host->device transfer accounting (bench attribution):
+            # the staged stream + plan metadata bytes this recording
+            # ships, whatever the rung
+            obs.metrics.count(
+                "ingest.h2d_bytes",
+                int(raw.nbytes) + int(res.nbytes)
+                + int(plan.positions.nbytes) + int(plan.mask.nbytes),
+            )
             # async dispatch: keep the device array; the next file's
             # host parse/stage overlaps this file's device compute
             if backend == "pallas":
                 kept = plan.positions[plan.mask]
-                feats.append((pallas_featurizer(raw, res, kept), None))
-            else:
-                feats.append(
-                    (featurizer(raw, res, plan.positions, plan.mask),
-                     plan.mask)
-                )
-            targets.append(plan.targets)
+                return pallas_featurizer(raw, res, kept), None, plan.targets
+            return (
+                featurizer(raw, res, plan.positions, plan.mask),
+                plan.mask,
+                plan.targets,
+            )
+
+        feats: List[np.ndarray] = []
+        targets: List[np.ndarray] = []
+        use_overlap = default_overlap() if overlap is None else bool(overlap)
+        if use_overlap:
+            # double-buffered ingest/compute overlap: the staging
+            # producer thread runs recording K+1's featurize_one
+            # (stage + plan + program dispatch) while this consumer
+            # handles recording K. staging.prefetch's bounded buffer,
+            # poison/stop semantics, consumer watchdog, and the
+            # staging.producer chaos point all apply unchanged; the
+            # queue is FIFO, so epoch order is bit-identical to the
+            # serial loop at any prefetch depth (pinned).
+            from . import staging
+
+            obs.metrics.count("ingest.overlap_runs")
+            for out, mask, tgt in staging.prefetch(
+                source, stage_fn=featurize_one
+            ):
+                feats.append((out, mask))
+                targets.append(tgt)
+        else:
+            # the ordered parallel parse: while this loop runs one
+            # file's staging + fused program dispatch, the pool is
+            # already parsing the next files' triplets on the host
+            for item in source:
+                out, mask, tgt = featurize_one(item)
+                feats.append((out, mask))
+                targets.append(tgt)
         n_feat = len(self._channel_names) * feature_size
         if not feats:
             return (
@@ -617,6 +717,54 @@ class OfflineDataProvider:
                 ]
             ),
             np.concatenate(targets),
+        )
+
+    def bf16_gate_check(
+        self,
+        recordings: Sequence[Tuple[str, int, "brainvision.Recording"]],
+        wavelet_index: int = 8,
+        max_rows: int = 64,
+    ) -> dict:
+        """The per-run bf16 accuracy gate: the first recording's first
+        ``max_rows`` kept markers are featurized through the decode
+        rung in BOTH precisions and the rows compared against the
+        documented bf16 tolerance (ops/decode_ingest.BF16_GATE_TOL).
+        Returns the gate record (max_abs_dev / tolerance / ok /
+        rows_checked) the builder embeds in run_report.json. The
+        reference pass runs on a 64-capacity plan, so its extra f32
+        program is the smallest compile the rung has."""
+        from ..ops import decode_ingest, device_ingest
+
+        if not recordings:
+            return decode_ingest.bf16_feature_gate(
+                np.zeros((0, 1), np.float32), np.zeros((0, 1), np.float32)
+            )
+        _rel, guessed, rec = recordings[0]
+        raw, res, n_samples = device_ingest.stage_raw(
+            rec, self._channel_indices(rec)
+        )
+        # fresh BalanceState: the gate compares feature VALUES for
+        # identical windows — retention differences against the real
+        # run are irrelevant, and the real run's balance state must
+        # not be perturbed
+        plan = device_ingest.plan_ingest(
+            rec.markers, guessed, n_samples,
+            pre=self._pre, post=self._post,
+        )
+        cap = min(max_rows, plan.capacity)
+        positions, mask = plan.positions[:cap], plan.mask[:cap]
+        kwargs = dict(
+            wavelet_index=wavelet_index, pre=self._pre
+        )
+        f32_rows = decode_ingest.make_decode_ingest_featurizer(
+            precision="f32", **kwargs
+        )(raw, res, positions, mask)
+        bf16_rows = decode_ingest.make_decode_ingest_featurizer(
+            precision="bf16", **kwargs
+        )(raw, res, positions, mask)
+        real = np.asarray(mask, dtype=bool)
+        return decode_ingest.bf16_feature_gate(
+            np.asarray(bf16_rows)[real], np.asarray(f32_rows)[real]
         )
 
     def feature_cache_key(self, extractor: Tuple) -> str:

@@ -91,6 +91,7 @@ def prefetch(
     buffer_size: Optional[int] = None,
     with_mask: bool = True,
     watchdog_poll_s: float = _WATCHDOG_POLL_S,
+    stage_fn=None,
 ) -> Iterator[Tuple[jax.Array, ...]]:
     """Stage host batches onto device(s) ahead of consumption.
 
@@ -99,6 +100,17 @@ def prefetch(
     is None, or padded + sharded over the mesh's data axis (with a
     trailing validity mask appended when ``with_mask``, the
     ``mesh.shard_batch_with_mask`` convention) otherwise.
+
+    ``stage_fn`` replaces the built-in device_put staging entirely:
+    the producer thread calls ``stage_fn(item)`` per source item and
+    yields its result — the seam the double-buffered ingest/compute
+    overlap rides (the fn stages AND dispatches recording K+1's
+    decode+featurize program while the consumer runs recording K's
+    step). Everything else — the bounded buffer, poison/stop
+    semantics, the consumer watchdog, and the ``staging.producer``
+    chaos point — applies to a ``stage_fn`` producer unchanged, which
+    is exactly why overlap is built on this function instead of a
+    second thread loop.
 
     ``buffer_size`` bounds how many staged batches may be in flight;
     None resolves ``EEG_TPU_PREFETCH_DEPTH`` (default 2 = classic
@@ -111,6 +123,8 @@ def prefetch(
         raise ValueError(f"buffer_size must be >= 1, got {buffer_size}")
 
     def stage(batch: Sequence[np.ndarray]) -> Tuple[jax.Array, ...]:
+        if stage_fn is not None:
+            return stage_fn(batch)
         if mesh is None:
             return tuple(jax.device_put(np.asarray(a)) for a in batch)
         if with_mask:

@@ -55,6 +55,10 @@ _ENV_KNOBS = (
     "EEG_TPU_CIRCUIT_COOLDOWN",
     "EEG_TPU_FAULTS",
     "EEG_TPU_RUN_REPORT_DIR",
+    "EEG_TPU_OVERLAP",
+    "EEG_TPU_PRECISION",
+    "EEG_TPU_BF16_GATE_TOL",
+    "EEG_TPU_DECODE_FORMULATION",
     "EEG_PALLAS_MODE",
     "JAX_PLATFORMS",
 )
@@ -205,6 +209,16 @@ class RunTelemetry:
         #: stride/label_overlap), class balance, and cost knobs here;
         #: None for the default P300 workload
         self.workload: Optional[Dict[str, Any]] = None
+        #: bf16 feature-path attribution: {"requested", "used",
+        #: "gate": {max_abs_dev, tolerance, ok, rows_checked}} when
+        #: the run asked for precision=bf16 — the auto-disable
+        #: decision lives HERE, never only in a log line; None for
+        #: f32 runs (the default, schema-stable)
+        self.precision: Optional[Dict[str, Any]] = None
+        #: whether the fused ingest ran the double-buffered
+        #: ingest/compute overlap (io/staging.prefetch stage_fn path);
+        #: None when the run never reached the fused ingest
+        self.overlap: Optional[bool] = None
 
     @property
     def report_path(self) -> str:
@@ -245,6 +259,8 @@ class RunTelemetry:
             "population": self.population,
             "serve": self.serve,
             "workload": self.workload,
+            "precision": self.precision,
+            "overlap": self.overlap,
             "degradation": list(self.degradation),
             "stages": timers.as_dict() if timers is not None else {},
             "metrics": metrics.snapshot() if metrics is not None else {},

@@ -1,0 +1,461 @@
+"""On-device decode+window ingest: int16 stream -> features, no gather.
+
+The irregular-ingest gap this module closes (ROADMAP item 4): the
+fused hot path's math runs at ~1M eps on this machine (``einsum_512``)
+while every irregular-marker ingest rung sits 10-60x below it —
+``block_ingest`` ~17k eps, the XLA element gather ~32k — because XLA
+lowers the marker-window gather to per-ELEMENT loads (~5 ns/element on
+CPU regardless of row width; measured while building this module, see
+docs/performance.md "roofline" section). The math was never the
+ceiling; the window *cut* was.
+
+This module is the ``decode`` rung of the fused degradation ladder
+(io/provider.FUSED_DEGRADATION_LADDER): raw unscaled int16 samples are
+staged once and ONE jitted program decodes (int16 -> f32 resolution
+scale), windows, baseline-corrects, and featurizes every kept marker —
+no host float64 epoch ever materializes, and no XLA gather runs. Two
+formulations share the contract:
+
+- ``slice`` (the classed-block XLA twin, CPU/interpreter default):
+  windows are cut by ``lax.dynamic_slice`` inside a ``lax.scan`` over
+  small tiles — each window is a real memcpy instead of 612x3 scalar
+  gathers — and each tile's windows contract against the cascade
+  operator as one flattened 2-D matmul (the ``_ingest_reshape``
+  layout trick). Measured on the 2-core CPU fallback: ~280k eps
+  steady state vs 32k for the element gather and 17k for the classed
+  block formulation, and the program compiles ~3.5x faster (the e2e
+  cold lever). Tile size ``DEFAULT_TILE`` amortizes scan-step
+  overhead; larger tiles regress (the stack materialization stops
+  fitting cache).
+- ``bank128`` (accelerators): the chip-proven Pallas kernel
+  (ops/ingest_pallas.py) — windows cut in VMEM by dynamic sublane
+  slabs, the 128-variant operator bank absorbing the in-row shift.
+  ``precision="bf16"`` routes to its ``bank128_bf16`` twin.
+
+Numerics: the slice formulation is subtract-first (explicit pre-mean
+baseline before the contraction), the same shape as the XLA gather
+rung — parity measured at ~6e-7 (inside the ladder's ~1e-7-class
+contract; pinned in tests/test_decode_ingest.py). The bf16 path
+carries its own documented gate (``BF16_GATE_TOL``): features are
+compared against an f32 reference per run and the path auto-disables
+above the gate (pipeline/builder.py records the decision).
+
+Host planning (clip + tile packing) is trivial but real work per
+marker layout; it is memoized in ``ops/plan_cache`` under
+``decode_window_plan`` so steady-state re-ingest of an unchanged
+recording re-plans nothing (and the bench's ``plan_cache`` field can
+attribute warm-plan speedups).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..utils import constants
+from . import device_ingest
+from . import dwt as dwt_xla
+
+#: windows per scan step for the slice formulation. CPU-tuned: TB=4
+#: was the best of {2, 4, 8, 16} on the 2-core fallback (280k eps;
+#: TB=16 halves throughput — the stacked tile stops fitting cache).
+DEFAULT_TILE = 4
+
+
+def default_splits() -> int:
+    """How many independent scans the slice program splits its tiles
+    across. A single ``lax.scan`` is inherently serial; XLA:CPU runs
+    INDEPENDENT scan thunks concurrently, so splitting the tile axis
+    puts the idle cores to work (measured on the 2-core fallback:
+    1 split 266k eps, 2 splits 376k — 1.41x; 4 splits plateaued).
+    Always a power of two capped at 4: the planner's geometric
+    capacity bucket (64*2^k) makes power-of-two tile counts, so a
+    non-power split (3 on a 3-core host) would never divide them and
+    _slice_program would silently fall back to one serial scan."""
+    import os
+
+    cores = os.cpu_count() or 1
+    if cores >= 4:
+        return 4
+    return 2 if cores >= 2 else 1
+
+#: the decode formulation family (single source for the library, the
+#: bench, and tests).
+DECODE_FORMULATIONS = ("slice", "bank128")
+
+#: env override for the platform-resolved formulation.
+ENV_FORMULATION = "EEG_TPU_DECODE_FORMULATION"
+
+#: bf16 feature gate: max abs deviation of bf16-path features vs the
+#: f32 reference on the SAME rows before the path auto-disables. The
+#: bound is the bf16 feature tier's envelope (einsum_bf16 measured
+#: ~2e-3 typical, 1.7e-3 worst-case under full-range DC in the bank
+#: kernel's r4 analysis; L2-normalized rows keep deviations O(2^-8)
+#: relative). Distinct from — and three orders looser than — the f32
+#: ladder-rung contract (~1e-7), which bf16 deliberately does not
+#: promise. Override for experiments via EEG_TPU_BF16_GATE_TOL.
+BF16_GATE_TOL = 5e-3
+
+
+def default_formulation() -> str:
+    """Platform default: ``slice`` on CPU (scan+dynamic_slice — the
+    memcpy window cut XLA:CPU needs), ``bank128`` on accelerators
+    (windows cut in VMEM; the only formulation proven to compile
+    through the axon remote helper). ``EEG_TPU_DECODE_FORMULATION``
+    overrides."""
+    import os
+
+    forced = os.environ.get(ENV_FORMULATION)
+    if forced:
+        if forced not in DECODE_FORMULATIONS:
+            raise ValueError(
+                f"unknown decode formulation {forced!r} in "
+                f"{ENV_FORMULATION}; supported: {DECODE_FORMULATIONS}"
+            )
+        return forced
+    return "slice" if jax.devices()[0].platform == "cpu" else "bank128"
+
+
+def bucket_capacity(cap: int) -> int:
+    """Pad a plan capacity up to 64 x a power of two (64, 128, 256,
+    512, ...). ``plan_ingest`` buckets to 64-MULTIPLES, which still
+    gives every recording of a multi-file session its own jit shape
+    (448 vs 512 vs ...) — and the cold e2e number is compile-bound,
+    so per-recording recompiles of the decode program were its
+    dominant ingest cost. Geometric bucketing bounds the padded
+    compute below 2x (the kernel is cheap; the compile is not) and
+    collapses a session's recordings onto one compiled shape."""
+    b = 64
+    while b < cap:
+        b *= 2
+    return b
+
+
+def plan_decode_windows(
+    positions: np.ndarray,
+    mask: np.ndarray,
+    n_samples: int,
+    pre: int = constants.PRESTIMULUS_SAMPLES,
+    window: int = 787,
+    tile: int = DEFAULT_TILE,
+) -> np.ndarray:
+    """Host tile plan for the slice formulation: clipped window starts
+    padded to the geometric capacity bucket (:func:`bucket_capacity`)
+    and reshaped to ``(n_tiles, tile)`` int32 — padded rows start at 0
+    and are masked/sliced off downstream. Memoized in
+    ``ops/plan_cache`` keyed on the layout digest + geometry — the
+    same zero-re-planning contract the block and Pallas planners
+    carry."""
+    from . import plan_cache as _pc
+
+    positions = np.asarray(positions)
+    mask = np.asarray(mask, dtype=bool)
+    key = _pc.digest(
+        positions,
+        mask,
+        extra=("decode_window", int(n_samples), pre, window, tile),
+    )
+
+    def build():
+        cap = positions.shape[0]
+        if cap % tile:
+            raise ValueError(
+                f"decode plan needs capacity % tile == 0; got "
+                f"{cap} % {tile} (plan_ingest's 64-multiple bucketing "
+                f"satisfies any tile that divides 64)"
+            )
+        # the same clip the gather/block rungs apply, so all rungs cut
+        # identical windows (overhang past the end reads the zero pad
+        # — Java copyOfRange semantics)
+        starts = np.clip(
+            positions.astype(np.int64) - pre, 0, int(n_samples)
+        ).astype(np.int32)
+        starts = starts * mask  # padded rows slice at offset 0
+        bucket = bucket_capacity(cap)
+        if bucket != cap:
+            starts = np.pad(starts, (0, bucket - cap))
+        return starts.reshape(bucket // tile, tile)
+
+    return _pc.cache("decode_window_plan").get_or_build(key, build)
+
+
+@functools.lru_cache(maxsize=None)
+def _slice_program(
+    wavelet_index: int,
+    epoch_size: int,
+    skip_samples: int,
+    feature_size: int,
+    pre: int,
+    tile: int,
+    bf16: bool,
+    donate_stream: bool,
+    splits: int = 1,
+):
+    """The jitted slice-formulation program, cached per geometry.
+
+    (raw int16 (C, S_pad), resolutions (C,), start tiles (nt, tile),
+    mask (cap,)) -> (cap, C*K) float32 masked features. The scan body
+    cuts ``tile`` windows as dynamic slices (memcpys), stacks them,
+    and contracts the live columns as ONE flattened (tile*C, 512)
+    matmul — the layout every CPU/TPU backend keeps on the fast GEMM
+    path (the ``_ingest_reshape`` finding). The tile axis is divided
+    over ``splits`` INDEPENDENT scans so XLA:CPU's concurrent thunk
+    execution spreads them across cores (:func:`default_splits`);
+    results concatenate in tile order, so the output is identical for
+    any split count. ``bf16`` casts the centered operand and the
+    operator to bfloat16 with f32 accumulation: mean-centering
+    happens in f32 FIRST, so the cast rounds residual-scale values,
+    not int16-range DC (the bank-kernel ordering argument).
+    """
+    win = pre + skip_samples + epoch_size
+    W_np = np.asarray(
+        dwt_xla.cascade_matrix(wavelet_index, epoch_size, feature_size),
+        np.float32,
+    )
+
+    @functools.partial(
+        jax.jit, donate_argnums=(0,) if donate_stream else ()
+    )
+    def run(raw_i16, resolutions, start_tiles, mask):
+        C = raw_i16.shape[0]
+        K = feature_size
+        nt, tb = start_tiles.shape
+        # NO in-program pad: a jnp.pad of the whole stream would copy
+        # the 10s-of-MB int16 block on EVERY call (measured ~4x the
+        # program's entire compute). The host wrapper guarantees every
+        # slice exists (see featurize()'s conditional tail pad).
+        W = jnp.asarray(W_np, jnp.bfloat16 if bf16 else jnp.float32)
+
+        def body(_, srow):
+            segs = [
+                lax.dynamic_slice(raw_i16, (0, srow[t]), (C, win))
+                for t in range(tb)
+            ]
+            seg = (
+                jnp.stack(segs).astype(jnp.float32)
+                * resolutions[None, :, None]
+            )  # (tile, C, win) f32, scaled
+            # explicit subtract-first baseline (Baseline.java:29-57):
+            # folding it into W cancels catastrophically on real EEG
+            # DC offsets (the ingest_matrix fold_baseline analysis)
+            base = jnp.mean(seg[:, :, :pre], axis=2)
+            z = seg[:, :, pre + skip_samples:] - base[..., None]
+            zt = z.reshape(tb * C, epoch_size)
+            if bf16:
+                y = lax.dot_general(
+                    zt.astype(jnp.bfloat16), W,
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            else:
+                y = lax.dot_general(
+                    zt, W, (((1,), (0,)), ((), ())),
+                    precision=lax.Precision.HIGHEST,
+                )
+            return _, y.reshape(tb, C * K)
+
+        ns = splits if nt % splits == 0 else 1
+        # unroll the scan body only when the per-scan step count is
+        # large: +20% steady-state on the CPU fallback (step dispatch
+        # amortized), but the duplicated body inflates compile time —
+        # which dominates the COLD pipeline number at its small
+        # per-recording step counts, where unrolling would give back
+        # the compile win that moves it
+        u = 4 if (nt // ns) >= 256 else 1
+
+        def one_scan(tiles):
+            _, ys = lax.scan(body, 0, tiles, unroll=u)
+            return ys
+
+        if ns > 1:
+            grouped = start_tiles.reshape(ns, nt // ns, tb)
+            ys = jnp.concatenate(
+                [one_scan(grouped[i]) for i in range(ns)], axis=0
+            )
+        else:
+            ys = one_scan(start_tiles)
+        feats = dwt_xla.safe_l2_normalize(
+            ys.reshape(nt * tb, C * K)
+        )
+        return feats * mask[:, None].astype(feats.dtype)
+
+    return run
+
+
+def make_decode_ingest_featurizer(
+    wavelet_index: int = 8,
+    epoch_size: int = 512,
+    skip_samples: int = 175,
+    feature_size: int = 16,
+    pre: int = constants.PRESTIMULUS_SAMPLES,
+    tile: int = DEFAULT_TILE,
+    formulation: str | None = None,
+    precision: str = "f32",
+    donate_stream: bool = False,
+):
+    """Callable (raw int16 (C, S), resolutions, positions, mask) ->
+    (capacity, C*K) float32 features — the ``decode`` rung's plug-in
+    counterpart of ``make_classed_block_ingest_featurizer`` (same
+    contract: concrete IngestPlan positions/mask, padded rows zeroed).
+
+    ``formulation`` None resolves per call via
+    :func:`default_formulation` (never cached — the
+    'auto'-resolution staleness class device_ingest documents).
+    ``precision="bf16"`` computes the cascade matmul in bfloat16 with
+    f32 accumulation; callers gate it per run
+    (:func:`bf16_feature_gate` / pipeline/builder.py).
+    ``donate_stream`` donates the staged int16 stream buffer to the
+    program (the overlap path's ping/pong staging — the stream is
+    dead after the on-device scale); skipped on CPU, where XLA cannot
+    alias it and would warn per call.
+    """
+    if precision not in ("f32", "bf16"):
+        raise ValueError(
+            f"unknown precision {precision!r}; use 'f32' or 'bf16'"
+        )
+    if 64 % tile:
+        raise ValueError(
+            f"tile {tile} must divide the planner's 64-row capacity "
+            f"bucket"
+        )
+    win = pre + skip_samples + epoch_size
+
+    def featurize(raw_i16, resolutions, positions, mask):
+        form = formulation or default_formulation()
+        positions = np.asarray(positions)
+        mask = np.asarray(mask, dtype=bool)
+        if form == "bank128":
+            return _bank_featurize(
+                raw_i16, resolutions, positions, mask,
+                wavelet_index, epoch_size, skip_samples, feature_size,
+                pre, precision,
+            )
+        donate = donate_stream and jax.default_backend() != "cpu"
+        run = _slice_program(
+            wavelet_index, epoch_size, skip_samples, feature_size,
+            pre, tile, precision == "bf16", donate,
+            splits=default_splits(),
+        )
+        S = int(raw_i16.shape[1])
+        tiles = plan_decode_windows(
+            positions, mask, S, pre=pre, window=win, tile=tile,
+        )
+        cap = mask.shape[0]
+        bucket = tiles.size
+        mask_b = (
+            mask if bucket == cap else np.pad(mask, (0, bucket - cap))
+        )
+        raw_dev = jnp.asarray(raw_i16)
+        if tiles.size and int(tiles.max()) + win > S:
+            # rare: the staged tail slack (stage_raw's 16384-sample
+            # bucketing) is thinner than one window — extend with
+            # zeros so an overhanging window reads zeros (Java
+            # copyOfRange semantics) instead of dynamic_slice's clamp
+            # silently SHIFTING it. Host-side and per recording: the
+            # in-program jnp.pad alternative copies the whole stream
+            # every call (measured ~4x the program's compute).
+            raw_dev = jnp.pad(
+                raw_dev, ((0, 0), (0, int(tiles.max()) + win - S))
+            )
+        out = run(
+            raw_dev,
+            jnp.asarray(resolutions, jnp.float32),
+            jnp.asarray(tiles),
+            jnp.asarray(mask_b),
+        )
+        # bucket padding never leaves this wrapper: callers see the
+        # plan's own capacity, like every other rung
+        return out if bucket == cap else out[:cap]
+
+    featurize.tile = tile
+    featurize.precision = precision
+    return featurize
+
+
+def _bank_featurize(
+    raw_i16, resolutions, positions, mask,
+    wavelet_index, epoch_size, skip_samples, feature_size, pre,
+    precision,
+):
+    """The accelerator formulation: kept markers through the
+    chip-proven bank128 Pallas kernel (windows cut in VMEM), scattered
+    back into the capacity rows so the decode rung's contract matches
+    the slice twin's exactly. ``precision="bf16"`` ships the operator
+    bank pre-cast (the kernel's ``bank128_bf16`` twin)."""
+    from . import ingest_pallas
+
+    kept = positions[mask]
+    C = np.asarray(raw_i16).shape[0]
+    K = feature_size
+    cap = positions.shape[0]
+    if kept.size == 0:
+        return jnp.zeros((cap, C * K), jnp.float32)
+    feats = ingest_pallas.ingest_features_pallas(
+        np.asarray(raw_i16),
+        np.asarray(resolutions, np.float32),
+        kept,
+        wavelet_index=wavelet_index,
+        epoch_size=epoch_size,
+        skip_samples=skip_samples,
+        feature_size=feature_size,
+        pre=pre,
+        mode="bank128_bf16" if precision == "bf16" else "bank128",
+    )  # (n_kept, C*K), marker order
+    out = jnp.zeros((cap, C * K), feats.dtype)
+    return out.at[np.nonzero(mask)[0]].set(feats)
+
+
+def bf16_gate_tolerance() -> float:
+    """The documented bf16 feature gate (``BF16_GATE_TOL``), with the
+    experiment override ``EEG_TPU_BF16_GATE_TOL``. An unparseable
+    override is LOGGED before falling back — the gate's whole policy
+    is "recorded, never silent", and an ignored typo'd experiment
+    knob judging against the default would be exactly that."""
+    import logging
+    import os
+
+    raw = os.environ.get("EEG_TPU_BF16_GATE_TOL")
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            logging.getLogger(__name__).warning(
+                "EEG_TPU_BF16_GATE_TOL=%r is not a float; using the "
+                "default gate %g", raw, BF16_GATE_TOL,
+            )
+    return BF16_GATE_TOL
+
+
+def bf16_feature_gate(
+    bf16_rows: np.ndarray,
+    f32_rows: np.ndarray,
+    tolerance: float | None = None,
+) -> dict:
+    """The per-run accuracy gate: max abs deviation of the bf16 path's
+    feature rows against the f32 reference rows, judged against the
+    documented gate. Returns the decision record the run report
+    embeds: ``{"max_abs_dev", "tolerance", "ok", "rows_checked"}``.
+    """
+    tol = bf16_gate_tolerance() if tolerance is None else float(tolerance)
+    bf16_rows = np.asarray(bf16_rows, np.float32)
+    f32_rows = np.asarray(f32_rows, np.float32)
+    if bf16_rows.shape != f32_rows.shape:
+        raise ValueError(
+            f"gate rows misaligned: {bf16_rows.shape} vs "
+            f"{f32_rows.shape}"
+        )
+    dev = (
+        float(np.max(np.abs(bf16_rows - f32_rows)))
+        if bf16_rows.size
+        else 0.0
+    )
+    return {
+        "max_abs_dev": dev,
+        "tolerance": tol,
+        "ok": bool(dev <= tol),
+        "rows_checked": int(bf16_rows.shape[0]),
+    }

@@ -189,6 +189,7 @@ def make_device_ingest_featurizer(
     channels: Sequence[int] = (1, 2, 3),
     pre: int = constants.PRESTIMULUS_SAMPLES,
     post: int = constants.POSTSTIMULUS_SAMPLES,
+    precision: str = "f32",
 ):
     """Fused jitted (raw int16, resolutions, positions, mask) ->
     (cap, n_channels*feature_size) float32 L2-normalized features.
@@ -198,9 +199,21 @@ def make_device_ingest_featurizer(
     all fuse — no epoch tensor ever materializes in HBM. ``channels``
     are 1-based positions within the already-gathered channel rows
     (the WaveletTransform convention).
+
+    ``precision="bf16"`` runs the cascade contraction on bfloat16
+    epochs (the ``einsum_bf16`` stream-dtype rule — half the HBM
+    bytes on the dominant read): the baseline correction still happens
+    in f32 FIRST, so the cast rounds residual-scale values, not
+    int16-range DC. Callers own the accuracy gate
+    (ops/decode_ingest.bf16_feature_gate; the serving engine gates at
+    warmup) — the ~1e-7 ladder contract is f32-only.
     """
     from . import dwt as dwt_xla
 
+    if precision not in ("f32", "bf16"):
+        raise ValueError(
+            f"unknown precision {precision!r}; use 'f32' or 'bf16'"
+        )
     epocher = make_device_epocher(pre, post)
     extract = dwt_xla.make_batched_extractor(
         wavelet_index=wavelet_index,
@@ -208,12 +221,13 @@ def make_device_ingest_featurizer(
         skip_samples=skip_samples,
         feature_size=feature_size,
         channels=channels,
+        dtype=jnp.bfloat16 if precision == "bf16" else jnp.float32,
     )
 
     @jax.jit
     def ingest_features(raw, resolutions, positions, mask):
         epochs = epocher(raw, resolutions, positions, mask)
-        feats = extract(epochs)
+        feats = extract(epochs).astype(jnp.float32)
         return feats * mask[:, None].astype(feats.dtype)
 
     return ingest_features
@@ -282,10 +296,14 @@ def default_fused_backend() -> str:
     """Platform default for the irregular fused-ingest backend
     (``fe=dwt-<i>-fused`` with no explicit suffix): accelerators get
     ``block`` — on the r4 chip it ran 1.15M epochs/s = 21x the XLA
-    element gather's 54.8k (tools/sweep_results/r4, parity 3e-7) —
-    while CPU keeps ``xla``, where the element gather is cheap and
-    the 128-variant bank is pure overhead (docs/ingest_kernel.md)."""
-    return "xla" if jax.devices()[0].platform == "cpu" else "block"
+    element gather's 54.8k (tools/sweep_results/r4, parity 3e-7;
+    the decode rung's bank128 routing stays opt-in there until its
+    chip timing lands) — while CPU gets ``decode``
+    (ops/decode_ingest.py): XLA:CPU lowers the element gather to
+    ~5 ns/element scalar loads, and the decode rung's slice-scan cut
+    measured ~8.6x the gather rung's throughput with a ~3.5x faster
+    compile (docs/performance.md)."""
+    return "decode" if jax.devices()[0].platform == "cpu" else "block"
 
 
 def resolve_regular_formulation(formulation: str, stride: int) -> str:

@@ -436,6 +436,104 @@ def make_irregular_bank_train_step(
     return init_state, _chaos_step(step)
 
 
+def make_decode_feature_stage(
+    wavelet_index: int = 8,
+    epoch_size: int = 512,
+    skip_samples: int = 175,
+    feature_size: int = 16,
+    donate_stream: bool = True,
+):
+    """The overlap path's per-recording staging function: ``(raw_i16,
+    resolutions, positions, mask, labels) -> (features, labels,
+    mask)`` with the decode+featurize program dispatched inside the
+    call — the ``stage_fn`` handed to ``io.staging.prefetch`` so
+    recording K+1's decode+featurize runs on the producer thread while
+    recording K's train step runs on the consumer.
+
+    ``donate_stream`` (default on) donates the freshly staged int16
+    stream buffer to the fused program — with the prefetch buffer
+    bounded at 2 (classic double buffering) the staged streams become
+    ping/pong buffers reused in place instead of accumulating one HBM
+    block per in-flight recording. Donation is skipped on CPU, where
+    XLA cannot alias the buffer (ops/decode_ingest.py). ``labels``
+    must be padded to the plan's capacity, like
+    :func:`make_irregular_train_step`'s.
+    """
+    from ..ops import decode_ingest
+
+    featurize = decode_ingest.make_decode_ingest_featurizer(
+        wavelet_index=wavelet_index,
+        epoch_size=epoch_size,
+        skip_samples=skip_samples,
+        feature_size=feature_size,
+        donate_stream=donate_stream,
+    )
+
+    def stage_one(item):
+        raw, resolutions, positions, mask, labels = item
+        # explicit staging first, so the featurizer's donation has a
+        # committed device buffer to consume (a numpy argument would
+        # transfer inside the call and leave nothing to donate)
+        staged = jax.device_put(np.asarray(raw))
+        feats = featurize(staged, resolutions, positions, mask)
+        return (
+            feats,
+            jnp.asarray(np.asarray(labels, np.float32)),
+            jnp.asarray(np.asarray(mask, np.float32)),
+        )
+
+    return stage_one
+
+
+def train_over_recordings(
+    state,
+    step,
+    recordings,
+    wavelet_index: int = 8,
+    feature_size: int = 16,
+    buffer_size=None,
+    overlap: bool = True,
+    donate_stream: bool = True,
+):
+    """Double-buffered ingest/compute overlap for irregular-marker
+    raw-stream training: recording K+1's decode+featurize executes on
+    the staging producer thread (``io.staging.prefetch`` with a
+    featurize ``stage_fn``) while recording K's train step runs here.
+
+    ``recordings`` yields host tuples ``(raw_i16 (C, S), resolutions,
+    positions, mask, labels)`` — an IngestPlan's static-capacity
+    metadata plus capacity-padded labels. ``step`` is a
+    ``make_feature_train_step`` step. Returns ``(state, losses)``.
+
+    ``overlap=False`` runs the identical staging function serially —
+    the parity twin the tests pin (same epochs, same order, same
+    losses at any ``buffer_size``). Poison/stop semantics, the
+    consumer watchdog (``ProducerDiedError``), and the
+    ``staging.producer`` chaos point ride along from ``prefetch``
+    unchanged.
+    """
+    from ..io import staging
+
+    stage_one = make_decode_feature_stage(
+        wavelet_index=wavelet_index,
+        feature_size=feature_size,
+        donate_stream=donate_stream and overlap,
+    )
+    source = iter(recordings)
+    stream = (
+        staging.prefetch(
+            source, stage_fn=stage_one, buffer_size=buffer_size
+        )
+        if overlap
+        else (stage_one(item) for item in source)
+    )
+    losses = []
+    for feats, labels, mask_f in stream:
+        state, loss = step(state, feats, labels, mask_f)
+        losses.append(float(loss))
+    return state, losses
+
+
 def stage_batch(
     epochs: np.ndarray, labels: np.ndarray, mesh
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import contextlib
 import logging
+import os
 import re
 import time
 from typing import Dict, Optional, Union
@@ -32,23 +33,34 @@ logger = logging.getLogger(__name__)
 
 
 def get_query_map(query: str) -> Dict[str, str]:
-    """k=v&k=v parse; empty values tolerated (PipelineBuilder.java:49-68)."""
+    """k=v&k=v parse; empty values tolerated (PipelineBuilder.java:49-68).
+
+    Values split at the FIRST ``=`` only: the reference's quirk of
+    truncating a value at its second ``=`` (``split('=')[1]``) ate the
+    option grammar of every parameter that legitimately carries ``=``
+    — ``fe=dwt-4:level=4:stats=energy``, ``fe_sweep=``, the
+    ``faults=remote.request:p=0.2`` chaos spec — and forced per-key
+    re-extraction workarounds downstream (the PR 7 builder note).
+    Fixed at the parser, so option values with embedded ``=`` survive
+    everywhere; values without one — every reference query ever
+    written — parse byte-identically (round-trips pinned in
+    tests/test_pipeline.py).
+    """
     out: Dict[str, str] = {}
     for param in query.split("&"):
-        parts = param.split("=")
-        name = parts[0]
-        value = parts[1] if len(parts) > 1 else ""
-        out[name] = value
+        name, sep, value = param.partition("=")
+        out[name] = value if sep else ""
     return out
 
 
 def get_raw_param(query: str, name: str) -> Optional[str]:
-    """The full (first-'='-to-end) value of one query parameter.
+    """The full (first-'='-to-end) value of one query parameter, or
+    None when absent.
 
-    :func:`get_query_map` keeps the reference's quirk of truncating a
-    value at its second ``=`` (``split('=')[1]``). Parameters whose
-    grammar legitimately contains ``=`` — the ``faults=`` chaos spec
-    (``remote.request:p=0.2;...``) — are re-extracted here verbatim.
+    Since :func:`get_query_map` stopped truncating at the second
+    ``=``, this agrees with the map for every present parameter; it
+    remains the seam for distinguishing a missing parameter from an
+    empty one without building the whole map.
     """
     for param in query.split("&"):
         if param.startswith(name + "="):
@@ -86,6 +98,13 @@ class PipelineBuilder:
         self.run_metrics: Optional[obs.Metrics] = None
         #: degradation-ladder history of the last run, oldest first
         self.degradation_history: list = []
+        #: bf16 feature-path resolution of the last fused run
+        #: ({"requested", "used", "gate"}); None for f32 runs. Set
+        #: whether or not telemetry is on — bench lines read it here.
+        self.precision_resolved: Optional[dict] = None
+        #: whether the last fused run's ingest overlapped (the
+        #: double-buffered staging path); None before any fused run
+        self.overlap_resolved: Optional[bool] = None
 
     @contextlib.contextmanager
     def _stage(self, name: str, **attrs):
@@ -99,16 +118,6 @@ class PipelineBuilder:
         self,
     ) -> Union[stats.ClassificationStatistics, stats.FanOutStatistics]:
         query_map = get_query_map(self.query)
-        # the extended fe= grammar (dwt-4:level=4:stats=energy) carries
-        # '='s of its own; re-extract those parameters verbatim so the
-        # reference's second-'=' truncation quirk (get_query_map) does
-        # not eat the options. Values without an embedded '=' — every
-        # P300 query ever written — come back byte-identical.
-        for key in ("fe", "fe_sweep"):
-            if key in query_map:
-                raw = get_raw_param(self.query, key)
-                if raw is not None:
-                    query_map[key] = raw
         logger.info("query: %s", query_map)
 
         # persistent XLA compilation cache before any device work:
@@ -126,8 +135,10 @@ class PipelineBuilder:
         # chaos fault plan: faults=<spec> (or EEG_TPU_FAULTS) installs
         # deterministic fault injection for the run, scoped so nested /
         # subsequent runs in the process are unaffected (docs/
-        # resilience.md). faults_seed= seeds the p= directives.
-        spec = get_raw_param(self.query, "faults") or chaos.plan_from_env()
+        # resilience.md). faults_seed= seeds the p= directives. The
+        # spec's embedded '='s survive get_query_map since the
+        # first-'='-split fix.
+        spec = query_map.get("faults") or chaos.plan_from_env()
         fault_scope = (
             chaos.faults(spec, seed=int(query_map.get("faults_seed", 0) or 0))
             if spec
@@ -146,6 +157,8 @@ class PipelineBuilder:
 
         self.telemetry = None
         self.degradation_history = []
+        self.precision_resolved = None
+        self.overlap_resolved = None
         # fresh per run, like the metrics scope below: a reused
         # builder must not report run 1's stage seconds under run 2
         self.timers = obs.StageTimer()
@@ -300,23 +313,72 @@ class PipelineBuilder:
         # (device_ingest.make_block_ingest_featurizer). Any registry
         # wavelet index works, like the host fe= family.
         fused_match = re.fullmatch(
-            r"dwt-(\d+)-fused(-pallas|-block|-xla)?",
+            r"dwt-(\d+)-fused(-pallas|-block|-xla|-decode)?",
             query_map.get("fe", ""),
         )
         fused = fused_match is not None
+        # precision=bf16 computes the fused DWT matmul in bfloat16
+        # behind a per-run f32-reference accuracy gate (the decode
+        # rung's feature — ops/decode_ingest.py); EEG_TPU_PRECISION
+        # sets the process default, the query wins per run. f32 is
+        # and stays the default: the ~1e-7 ladder contract is an f32
+        # contract.
+        precision = (
+            query_map.get("precision")
+            or os.environ.get("EEG_TPU_PRECISION")
+            or "f32"
+        )
+        if precision not in ("f32", "bf16"):
+            raise ValueError(
+                f"precision= must be f32 or bf16, got {precision!r}"
+            )
+        if precision == "bf16" and not fused:
+            raise ValueError(
+                "precision=bf16 applies to the fused fe= modes "
+                "(fe=dwt-<i>-fused[-decode]); host-path features are "
+                "the bit-parity reference and stay f64"
+            )
+        # overlap= toggles the double-buffered ingest/compute overlap
+        # (io/staging.prefetch with a featurize stage_fn); absent, the
+        # EEG_TPU_OVERLAP env decides in the provider. Statistics are
+        # bit-identical either way (pinned) — overlap reschedules
+        # work, never changes it.
+        overlap_value = query_map.get("overlap", "")
+        if overlap_value not in ("", "true", "false"):
+            raise ValueError(
+                f"overlap= must be true or false, got {overlap_value!r}"
+            )
+        overlap = None if not overlap_value else overlap_value == "true"
         if fused:
             from ..ops import device_ingest
 
             wavelet_index = int(fused_match.group(1))
             # bare -fused resolves per platform (block on
             # accelerators - 21x the element gather on the r4 chip -
-            # xla on CPU); explicit suffixes always win
-            backend = {
-                None: device_ingest.default_fused_backend(),
-                "-pallas": "pallas",
-                "-block": "block",
-                "-xla": "xla",
-            }[fused_match.group(2)]
+            # decode on CPU, where the slice-scan cut beats the
+            # element gather ~8.6x); explicit suffixes always win. A
+            # bf16 request resolves to decode — the rung that carries
+            # the bf16 twin.
+            suffix = fused_match.group(2)
+            if suffix is None:
+                backend = (
+                    "decode"
+                    if precision == "bf16"
+                    else device_ingest.default_fused_backend()
+                )
+            else:
+                backend = {
+                    "-pallas": "pallas",
+                    "-block": "block",
+                    "-xla": "xla",
+                    "-decode": "decode",
+                }[suffix]
+                if precision == "bf16" and backend != "decode":
+                    raise ValueError(
+                        "precision=bf16 rides the decode rung; it "
+                        f"cannot combine with the explicit "
+                        f"fe=...-fused{suffix} backend"
+                    )
             # content-addressed feature cache (io/feature_cache.py):
             # keyed on the triplet bytes + channel set + window +
             # extractor geometry — deliberately NOT the backend rung
@@ -335,6 +397,10 @@ class PipelineBuilder:
             prepared = None
             features = targets = None
             landed = None
+            #: the run's resolved numeric class; may drop to f32 when
+            #: the bf16 gate trips or a non-decode rung lands
+            precision_used = precision
+            gate_record = None
             if cache is not None:
                 try:
                     # ONE read pass: digests (for the content key) and
@@ -345,7 +411,9 @@ class PipelineBuilder:
                     # already-parsed recordings from memory
                     with self._stage("ingest", phase="cache_lookup"):
                         prepared = odp.prepare_fused_run(
-                            provider.fused_extractor_id(wavelet_index)
+                            provider.fused_extractor_id(
+                                wavelet_index, precision
+                            )
                         )
                         cache_key = prepared.key
                         hit = cache.lookup(cache_key)
@@ -361,14 +429,70 @@ class PipelineBuilder:
                 if hit is not None:
                     features, targets = hit
                     landed = "cache"
+                    if precision == "bf16":
+                        # the entry was gated when it was computed and
+                        # stored (keys carry the precision class — a
+                        # bf16 entry can only have passed its gate)
+                        gate_record = {"source": "cache"}
                     logger.info(
                         "feature cache hit (%d rows): ingest + "
                         "featurization skipped", len(targets),
                     )
+            if landed is None and precision == "bf16":
+                if prepared is None:
+                    # cache=false still needs the parsed recordings
+                    # for the f32 reference check; the ladder below
+                    # then featurizes them from memory — the gate
+                    # never costs a second read
+                    with self._stage("ingest", phase="cache_lookup"):
+                        prepared = odp.prepare_fused_run(
+                            provider.fused_extractor_id(
+                                wavelet_index, precision
+                            )
+                        )
+                # the per-run accuracy gate: bf16 vs f32 feature rows
+                # on the first recording, judged against the
+                # documented bf16 tolerance (ops/decode_ingest.
+                # BF16_GATE_TOL). Above the gate the run computes f32
+                # — recorded, never silent.
+                with self._stage("ingest", phase="bf16_gate"):
+                    gate_record = odp.bf16_gate_check(
+                        prepared.recordings, wavelet_index
+                    )
+                events.event("pipeline.bf16_gate", **gate_record)
+                if not gate_record["ok"]:
+                    precision_used = "f32"
+                    obs.metrics.count("pipeline.bf16_gate_disabled")
+                    logger.warning(
+                        "pipeline.bf16_gate auto-disable: max abs dev "
+                        "%.3e > gate %.3e; the run computes f32",
+                        gate_record["max_abs_dev"],
+                        gate_record["tolerance"],
+                    )
+                    # a gated-off run IS an f32 run: re-key from the
+                    # same read pass and give the f32 cache a chance
+                    # before featurizing
+                    if cache is not None:
+                        cache_key = odp.run_key_for(
+                            prepared,
+                            provider.fused_extractor_id(
+                                wavelet_index, "f32"
+                            ),
+                        )
+                        hit = cache.lookup(cache_key)
+                        if hit is not None:
+                            features, targets = hit
+                            landed = "cache"
+                            logger.info(
+                                "feature cache hit (%d rows, f32 "
+                                "fallback): ingest + featurization "
+                                "skipped", len(targets),
+                            )
             # backend degradation ladder (io/provider.py): a fused
             # backend that fails to lower, OOMs, or sits on unhealthy
-            # devices degrades pallas -> block -> xla -> host epochs +
-            # registry extractor instead of killing the run. Same
+            # devices degrades decode -> pallas -> block -> xla ->
+            # host epochs + registry extractor instead of killing the
+            # run. Same
             # ClassificationStatistics out the other end, every step
             # down counted in obs.metrics. degrade=false opts out
             # (fail fast on the requested backend).
@@ -392,6 +516,15 @@ class PipelineBuilder:
                                 None if prepared is None
                                 else prepared.recordings
                             ),
+                            # bf16 is the decode rung's feature: a
+                            # lower rung landing means the run
+                            # computes f32 (recorded below)
+                            precision=(
+                                precision_used
+                                if rung == "decode"
+                                else "f32"
+                            ),
+                            overlap=overlap,
                         )
                     landed = rung
                     break
@@ -446,10 +579,41 @@ class PipelineBuilder:
                 events.event(
                     "pipeline.rung_landed", requested=backend, landed=landed
                 )
+                if precision_used == "bf16" and landed not in (
+                    "decode", "cache"
+                ):
+                    # the decode rung failed and a lower (f32) rung
+                    # landed: the run's features are f32 — the cache
+                    # entry must carry the f32 key, and the report the
+                    # true numeric class
+                    precision_used = "f32"
+                    if cache is not None and prepared is not None:
+                        cache_key = odp.run_key_for(
+                            prepared,
+                            provider.fused_extractor_id(
+                                wavelet_index, "f32"
+                            ),
+                        )
+                self.overlap_resolved = (
+                    provider.default_overlap()
+                    if overlap is None
+                    else overlap
+                )
+                self.precision_resolved = (
+                    {
+                        "requested": precision,
+                        "used": precision_used,
+                        "gate": gate_record,
+                    }
+                    if precision == "bf16"
+                    else None
+                )
                 if self.telemetry is not None:
                     self.telemetry.backend = {
                         "requested": backend, "landed": landed,
                     }
+                    self.telemetry.overlap = self.overlap_resolved
+                    self.telemetry.precision = self.precision_resolved
                 if (
                     landed != "cache"
                     and cache is not None
@@ -473,10 +637,24 @@ class PipelineBuilder:
                 self.degradation_history.append(
                     {"from": backend, "to": "host"}
                 )
+                # the host floor is the f64 bit-parity path; the
+                # requested bf16 never ran. Set on the builder whether
+                # or not telemetry is on (the bench-attribution
+                # contract precision_resolved documents).
+                self.precision_resolved = (
+                    {
+                        "requested": precision,
+                        "used": "host-f64",
+                        "gate": gate_record,
+                    }
+                    if precision == "bf16"
+                    else None
+                )
                 if self.telemetry is not None:
                     self.telemetry.backend = {
                         "requested": backend, "landed": "host",
                     }
+                    self.telemetry.precision = self.precision_resolved
                 fused = False
                 fe = fe_registry.create(f"dwt-{wavelet_index}")
                 with self._stage("ingest", backend="host"):

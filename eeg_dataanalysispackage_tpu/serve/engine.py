@@ -60,6 +60,7 @@ def _serving_program(
     pre: int,
     post: int,
     with_margin: bool,
+    precision: str = "f32",
 ):
     """The jitted micro-batch program, cached per geometry (shared by
     every service instance with the same acquisition config).
@@ -67,7 +68,9 @@ def _serving_program(
     ``with_margin=True`` fuses the linear-family margin matvec onto
     the featurizer — features never round-trip to the host before the
     decision. Weights ride as a traced argument, so swapping a model
-    recompiles nothing.
+    recompiles nothing. ``precision="bf16"`` runs the featurizer's
+    cascade contraction on bfloat16 epochs (the engine gates it at
+    warmup and falls back to the f32 program above its tolerance).
     """
     featurizer = device_ingest.make_device_ingest_featurizer(
         wavelet_index=wavelet_index,
@@ -77,6 +80,7 @@ def _serving_program(
         channels=tuple(range(1, n_channels + 1)),
         pre=pre,
         post=post,
+        precision=precision,
     )
     if with_margin:
 
@@ -115,6 +119,7 @@ class ServingEngine:
         feature_size: int = 16,
         capacity: int = 64,
         host_extractor=None,
+        precision: str = "f32",
     ):
         """``pre``/``post`` parameterize the window length from the
         workload's config — the engine no longer assumes the P300
@@ -128,6 +133,14 @@ class ServingEngine:
         statistics identical to it."""
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
+        if precision not in ("f32", "bf16"):
+            raise ValueError(
+                f"unknown precision {precision!r}; use 'f32' or 'bf16'"
+            )
+        #: bf16 request + its warmup gate decision; None for plain f32
+        #: engines (schema-stable in the serve stats block)
+        self.precision_record = None
+        self._precision = precision
         self.classifier = classifier
         self.n_channels = int(n_channels)
         self.pre = int(pre)
@@ -168,7 +181,9 @@ class ServingEngine:
             and classifier.weights.dtype == np.float32
         )
         self._program = _serving_program(
-            *self._geometry, with_margin=self._fused_linear
+            *self._geometry,
+            with_margin=self._fused_linear,
+            precision=precision,
         )
         # the serving arm of the degradation ladder (io/provider's
         # pallas->block->xla->host contract, collapsed to its two
@@ -328,7 +343,11 @@ class ServingEngine:
         batch), so the first real request doesn't pay XLA latency —
         and, as importantly, so a long cold compile can never happen
         inside the batcher where the watchdog would read it as a
-        wedge. Idempotent."""
+        wedge. A ``precision="bf16"`` engine additionally runs its
+        accuracy gate here (:meth:`_bf16_warmup_gate`) — above the
+        documented tolerance the engine swaps to the f32 program
+        before a single request is served, and the decision lands in
+        the serve stats block. Idempotent."""
         if self._warmed:
             return
         if self._program is None:
@@ -336,6 +355,8 @@ class ServingEngine:
             # is no XLA program to compile ahead of traffic
             self._warmed = True
             return
+        if self._precision == "bf16":
+            self._bf16_warmup_gate()
         # both request dtypes the stage_raw convention produces:
         # int16 (INT_16 recordings) and the float32 fallback — a
         # non-INT_16 session must not pay its cold trace inside the
@@ -346,6 +367,68 @@ class ServingEngine:
                 np.ones(self.n_channels, np.float32),
             )
         self._warmed = True
+
+    def _bf16_warmup_gate(self) -> None:
+        """The serving arm of the bf16 accuracy gate: deterministic
+        synthetic int16 windows — full-amplitude signal over a large
+        DC offset, the cancellation-stressing shape the f32-safety
+        analysis worries about — featurized through both programs,
+        judged against ops/decode_ingest's documented tolerance.
+        Above it, the engine serves f32 (recorded, never silent)."""
+        from ..ops import decode_ingest
+
+        rng = np.random.RandomState(0)
+        n = min(16, self.capacity)
+        stream = np.zeros(
+            (self.n_channels, self.capacity * self.window_len), np.int16
+        )
+        body = (
+            rng.randint(-3000, 3000,
+                        size=(self.n_channels, n * self.window_len))
+            + np.asarray([15000, -12000, 9000] * 40)[
+                : self.n_channels, None
+            ]
+        ).astype(np.int16)
+        stream[:, : n * self.window_len] = body
+        mask = np.zeros(self.capacity, bool)
+        mask[:n] = True
+        res = np.full(self.n_channels, 0.1, np.float32)
+        f32_program = _serving_program(
+            *self._geometry,
+            with_margin=self._fused_linear,
+            precision="f32",
+        )
+        # device_put per call: both programs may donate their stream
+        bf16_feats, _ = self._program(
+            jax.device_put(stream), res, self._positions, mask,
+            *( [self.classifier.weights] if self._fused_linear else [] ),
+        )
+        f32_feats, _ = f32_program(
+            jax.device_put(stream), res, self._positions, mask,
+            *( [self.classifier.weights] if self._fused_linear else [] ),
+        )
+        real = mask
+        gate = decode_ingest.bf16_feature_gate(
+            np.asarray(bf16_feats)[real], np.asarray(f32_feats)[real]
+        )
+        self.precision_record = {
+            "requested": "bf16",
+            "used": "bf16" if gate["ok"] else "f32",
+            "gate": gate,
+        }
+        if not gate["ok"]:
+            from .. import obs
+            from ..obs import events
+            import logging
+
+            self._program = f32_program
+            obs.metrics.count("serve.bf16_gate_disabled")
+            events.event("serve.bf16_gate", **gate)
+            logging.getLogger(__name__).warning(
+                "serve.bf16_gate auto-disable: max abs dev %.3e > "
+                "gate %.3e; serving f32",
+                gate["max_abs_dev"], gate["tolerance"],
+            )
 
     @property
     def mode(self) -> str:

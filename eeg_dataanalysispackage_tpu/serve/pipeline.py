@@ -25,6 +25,7 @@ block records the outcome counters.
 from __future__ import annotations
 
 import logging
+import os
 import re
 
 import numpy as np
@@ -96,7 +97,8 @@ def run_serve(query_map, provider_factory, stage):
     if "load_name" not in query_map:
         raise ValueError("Classifier location not provided")
     fused_match = re.fullmatch(
-        r"dwt-(\d+)-fused(-pallas|-block|-xla)?", query_map.get("fe", "")
+        r"dwt-(\d+)-fused(-pallas|-block|-xla|-decode)?",
+        query_map.get("fe", ""),
     )
     if fused_match is None:
         raise ValueError(
@@ -104,6 +106,18 @@ def run_serve(query_map, provider_factory, stage):
             "program; fe= must be a dwt-<i>-fused form"
         )
     wavelet_index = int(fused_match.group(1))
+    # precision=bf16 serves through the bf16 featurizer behind the
+    # engine's warmup accuracy gate (serve/engine.py); the decision is
+    # recorded in the serve block's ``precision`` entry
+    precision = (
+        query_map.get("precision")
+        or os.environ.get("EEG_TPU_PRECISION")
+        or "f32"
+    )
+    if precision not in ("f32", "bf16"):
+        raise ValueError(
+            f"precision= must be f32 or bf16, got {precision!r}"
+        )
 
     classifier = clf_registry.create(query_map["load_clf"])
     classifier.load(query_map["load_name"])
@@ -123,6 +137,7 @@ def run_serve(query_map, provider_factory, stage):
         pre=odp.pre,
         post=odp.post,
         config=config,
+        precision=precision,
     )
 
     # 1. ingest: parse the session into per-epoch raw windows (the

@@ -82,6 +82,7 @@ class InferenceService:
         post: int = constants.POSTSTIMULUS_SAMPLES,
         config: Optional[ServeConfig] = None,
         host_extractor=None,
+        precision: str = "f32",
     ):
         self.config = config or ServeConfig()
         self.engine = engine_mod.ServingEngine(
@@ -92,6 +93,7 @@ class InferenceService:
             post=post,
             capacity=self.config.max_batch,
             host_extractor=host_extractor,
+            precision=precision,
         )
         self.batcher = batcher_mod.MicroBatcher(
             self.engine.execute,
@@ -316,6 +318,9 @@ class InferenceService:
         return {
             "mode": self.engine.mode,
             "rung": self.engine.rung,
+            # bf16 serving attribution: the warmup gate's decision
+            # (requested/used/max_abs_dev); None for f32 engines
+            "precision": self.engine.precision_record,
             "max_batch": self.config.max_batch,
             "queue_depth": self.config.queue_depth,
             "requests": {

@@ -392,7 +392,12 @@ def test_chaos_parity_end_to_end(http_dir, tmp_path):
 
 def test_get_raw_param_keeps_equals_signs():
     q = "a=1&faults=remote.request:p=0.2;x:once@1&b=2"
-    assert builder.get_query_map(q)["faults"] == "remote.request:p"
+    # the parser no longer truncates at the second '=': the map and
+    # the raw extraction agree on the full chaos spec
+    assert (
+        builder.get_query_map(q)["faults"]
+        == "remote.request:p=0.2;x:once@1"
+    )
     assert (
         builder.get_raw_param(q, "faults")
         == "remote.request:p=0.2;x:once@1"

@@ -1,0 +1,304 @@
+"""The decode rung (ops/decode_ingest.py): the gather-free
+decode+window kernel, its ladder position, its plan-cache reuse, and
+the accuracy-gated bf16 feature path.
+
+Parity contract: the slice formulation is subtract-first like the XLA
+element gather, so the two rungs agree to the f32 ladder tolerance;
+the bf16 twin carries its own documented gate (BF16_GATE_TOL) and is
+never silently on — the pipeline records every decision.
+"""
+
+import numpy as np
+import pytest
+
+from eeg_dataanalysispackage_tpu.io import provider
+from eeg_dataanalysispackage_tpu.ops import decode_ingest, device_ingest
+from eeg_dataanalysispackage_tpu.ops import plan_cache
+
+import _synthetic  # noqa: E402  (tests/ is on sys.path via conftest)
+from eeg_dataanalysispackage_tpu.pipeline import builder
+
+
+def _irregular_case(n=300, stride=750, seed=0, dc=0):
+    rng = np.random.RandomState(seed)
+    S = 200 + n * stride + 1000
+    raw = (
+        rng.randint(-3000, 3000, size=(3, S)) + dc
+    ).astype(np.int16)
+    positions = np.clip(
+        np.arange(n, dtype=np.int64) * stride + 200
+        + rng.randint(-200, 200, size=n),
+        100, S - 800,
+    )
+    cap = ((n + 63) // 64) * 64
+    pos = np.zeros(cap, np.int32)
+    pos[:n] = positions
+    mask = np.zeros(cap, bool)
+    mask[:n] = True
+    res = np.array([0.1, 0.1, 0.2], np.float32)
+    return raw, res, pos, mask, n
+
+
+def test_slice_parity_with_gather_rung():
+    raw, res, pos, mask, n = _irregular_case()
+    got = np.asarray(
+        decode_ingest.make_decode_ingest_featurizer(
+            formulation="slice"
+        )(raw, res, pos, mask)
+    )
+    want = np.asarray(
+        device_ingest.make_device_ingest_featurizer()(
+            raw, res, pos, mask
+        )
+    )
+    # subtract-first on both sides: f32-tolerance-class agreement
+    assert np.max(np.abs(got[:n] - want[:n])) < 5e-6
+    # padded rows zeroed (the mask contract every rung shares)
+    assert np.all(got[n:] == 0.0)
+
+
+def test_splits_do_not_change_output():
+    """The split-scans CPU parallelization is scheduling only: any
+    split count produces bitwise-identical features."""
+    raw, res, pos, mask, n = _irregular_case(n=128)
+    pre, win = 100, 787
+    tiles = decode_ingest.plan_decode_windows(
+        pos, mask, raw.shape[1], pre=pre, window=win,
+        tile=decode_ingest.DEFAULT_TILE,
+    )
+    outs = []
+    for splits in (1, 2, 4):
+        run = decode_ingest._slice_program(
+            8, 512, 175, 16, pre, decode_ingest.DEFAULT_TILE,
+            False, False, splits=splits,
+        )
+        outs.append(np.asarray(run(raw, res, tiles, mask)))
+    assert np.array_equal(outs[0], outs[1])
+    assert np.array_equal(outs[0], outs[2])
+
+
+def test_window_overhang_reads_zeros_not_shifted():
+    """A marker whose window runs past the end of the recording must
+    read zeros (Java copyOfRange), never be silently SHIFTED by
+    dynamic_slice's clamp — the host wrapper pads the staged tail
+    when the bucket slack is thinner than a window."""
+    # recording sized so the last marker's window overhangs: S chosen
+    # with < 787 samples of slack past the final position
+    S = 2048
+    rng = np.random.RandomState(1)
+    raw = rng.randint(-3000, 3000, size=(3, S)).astype(np.int16)
+    pos = np.zeros(64, np.int32)
+    pos[:2] = [500, S - 50]  # second window overhangs by 637 samples
+    mask = np.zeros(64, bool)
+    mask[:2] = True
+    res = np.array([0.1, 0.1, 0.2], np.float32)
+    got = np.asarray(
+        decode_ingest.make_decode_ingest_featurizer(
+            formulation="slice"
+        )(raw, res, pos, mask)
+    )
+    want = np.asarray(
+        device_ingest.make_device_ingest_featurizer()(
+            np.pad(raw, ((0, 0), (0, 1000))), res, pos, mask
+        )
+    )
+    assert np.max(np.abs(got[:2] - want[:2])) < 5e-6
+
+
+def test_plan_cache_reuse():
+    """Re-planning an unchanged layout is a cache hit (the
+    zero-re-planning contract the block/Pallas planners carry)."""
+    raw, res, pos, mask, _ = _irregular_case(n=64, seed=3)
+    before = plan_cache.stats()
+    t1 = decode_ingest.plan_decode_windows(pos, mask, raw.shape[1])
+    mid = plan_cache.stats()
+    t2 = decode_ingest.plan_decode_windows(pos, mask, raw.shape[1])
+    after = plan_cache.stats()
+    assert mid["misses"] >= before["misses"]  # first call may miss
+    assert after["hits"] == mid["hits"] + 1
+    assert np.array_equal(t1, t2)
+
+
+def test_degradation_ladder_starts_at_decode():
+    assert provider.degradation_ladder("decode") == [
+        "decode", "pallas", "block", "xla", "host"
+    ]
+    # existing entry points unchanged
+    assert provider.degradation_ladder("pallas") == [
+        "pallas", "block", "xla", "host"
+    ]
+
+
+def test_fused_extractor_id_precision_class():
+    """The f32 key tuple is byte-unchanged from PR 3 (warm caches
+    survive); bf16 keys its own entries — the precision-class rule."""
+    f32 = provider.fused_extractor_id(8)
+    assert f32 == ("dwt-fused", 8, 512, 175, 16)
+    assert provider.fused_extractor_id(8, "f32") == f32
+    bf16 = provider.fused_extractor_id(8, "bf16")
+    assert bf16 == f32 + ("bf16",)
+
+
+def test_bf16_within_gate_on_dc_offset_signal():
+    """The bf16 twin on the cancellation-stressing shape (full-range
+    DC offsets): deviations stay inside the documented gate because
+    mean-centering happens in f32 before the cast."""
+    raw, res, pos, mask, n = _irregular_case(n=128, dc=15000)
+    f32 = np.asarray(
+        decode_ingest.make_decode_ingest_featurizer(
+            formulation="slice", precision="f32"
+        )(raw, res, pos, mask)
+    )
+    bf16 = np.asarray(
+        decode_ingest.make_decode_ingest_featurizer(
+            formulation="slice", precision="bf16"
+        )(raw, res, pos, mask)
+    )
+    gate = decode_ingest.bf16_feature_gate(bf16[:n], f32[:n])
+    assert gate["ok"], gate
+    assert gate["max_abs_dev"] <= decode_ingest.BF16_GATE_TOL
+    # and it genuinely differs from f32 (the path actually ran bf16)
+    assert gate["max_abs_dev"] > 1e-6
+
+
+def test_bf16_gate_judges_against_tolerance():
+    rows = np.ones((4, 48), np.float32)
+    drifted = rows + 1e-2
+    bad = decode_ingest.bf16_feature_gate(drifted, rows)
+    assert not bad["ok"] and bad["rows_checked"] == 4
+    good = decode_ingest.bf16_feature_gate(rows, rows)
+    assert good["ok"] and good["max_abs_dev"] == 0.0
+    with pytest.raises(ValueError, match="misaligned"):
+        decode_ingest.bf16_feature_gate(rows[:2], rows)
+
+
+def test_precision_validation():
+    with pytest.raises(ValueError, match="precision"):
+        decode_ingest.make_decode_ingest_featurizer(precision="f16")
+    with pytest.raises(ValueError, match="decode-rung"):
+        odp = provider.OfflineDataProvider(["x.txt"])
+        odp.load_features_device(backend="block", precision="bf16")
+
+
+# -- pipeline integration ----------------------------------------------
+
+
+@pytest.fixture()
+def session(tmp_path):
+    return _synthetic.write_session(str(tmp_path), n_markers=90)
+
+
+def _query(info, extra=""):
+    return (
+        f"info_file={info}&train_clf=logreg&cache=false"
+        "&config_step_size=1.0&config_num_iterations=40"
+        "&config_mini_batch_fraction=1.0" + extra
+    )
+
+
+def test_decode_backend_statistics_match_other_rungs(session):
+    s_decode = builder.PipelineBuilder(
+        _query(session, "&fe=dwt-8-fused-decode")
+    ).execute()
+    s_xla = builder.PipelineBuilder(
+        _query(session, "&fe=dwt-8-fused-xla")
+    ).execute()
+    assert str(s_decode) == str(s_xla)
+
+
+def test_bf16_with_explicit_other_backend_is_an_error(session):
+    with pytest.raises(ValueError, match="decode rung"):
+        builder.PipelineBuilder(
+            _query(session, "&fe=dwt-8-fused-block&precision=bf16")
+        ).execute()
+    with pytest.raises(ValueError, match="f32 or bf16"):
+        builder.PipelineBuilder(
+            _query(session, "&fe=dwt-8-fused&precision=f16")
+        ).execute()
+    with pytest.raises(ValueError, match="fused"):
+        builder.PipelineBuilder(
+            _query(session, "&fe=dwt-8&precision=bf16")
+        ).execute()
+
+
+def test_bf16_gate_auto_disable_pins_f32_statistics(
+    session, monkeypatch
+):
+    """The gated-off path IS the f32 path: with an impossible
+    tolerance the run auto-disables and produces byte-identical
+    statistics — and records the decision on the builder."""
+    pb_f32 = builder.PipelineBuilder(
+        _query(session, "&fe=dwt-8-fused-decode")
+    )
+    s_f32 = pb_f32.execute()
+    assert pb_f32.precision_resolved is None
+
+    monkeypatch.setenv("EEG_TPU_BF16_GATE_TOL", "0")
+    pb_off = builder.PipelineBuilder(
+        _query(session, "&fe=dwt-8-fused&precision=bf16")
+    )
+    s_off = pb_off.execute()
+    assert str(s_off) == str(s_f32)
+    rec = pb_off.precision_resolved
+    assert rec["requested"] == "bf16" and rec["used"] == "f32"
+    assert rec["gate"]["ok"] is False
+
+    monkeypatch.delenv("EEG_TPU_BF16_GATE_TOL")
+    pb_on = builder.PipelineBuilder(
+        _query(session, "&fe=dwt-8-fused&precision=bf16")
+    )
+    pb_on.execute()
+    rec = pb_on.precision_resolved
+    assert rec["used"] == "bf16" and rec["gate"]["ok"] is True
+    assert rec["gate"]["max_abs_dev"] <= rec["gate"]["tolerance"]
+
+
+def test_bf16_cache_entries_key_separately(session, tmp_path,
+                                           monkeypatch):
+    """A bf16 run's cached features can never serve an f32 request:
+    the extractor id carries the precision class, so the second run
+    below must MISS (and vice versa would too)."""
+    from eeg_dataanalysispackage_tpu.io import feature_cache
+
+    monkeypatch.setenv(
+        "EEG_TPU_FEATURE_CACHE_DIR", str(tmp_path / "fc")
+    )
+    monkeypatch.delenv("EEG_TPU_NO_FEATURE_CACHE", raising=False)
+    q_bf16 = _query(session, "&fe=dwt-8-fused&precision=bf16").replace(
+        "&cache=false", ""
+    )
+    q_f32 = _query(session, "&fe=dwt-8-fused-decode").replace(
+        "&cache=false", ""
+    )
+    builder.PipelineBuilder(q_bf16).execute()
+    before = feature_cache.stats()
+    builder.PipelineBuilder(q_f32).execute()
+    after = feature_cache.stats()
+    assert after["misses"] == before["misses"] + 1
+    assert after["hits"] == before["hits"]
+    # and each precision class hits its OWN entry on a re-run
+    before = feature_cache.stats()
+    builder.PipelineBuilder(q_bf16).execute()
+    builder.PipelineBuilder(q_f32).execute()
+    after = feature_cache.stats()
+    assert after["hits"] == before["hits"] + 2
+
+
+@pytest.mark.slow
+def test_bank128_formulation_parity_interpret():
+    """The accelerator formulation (bank128 routing) against the
+    slice twin — interpret mode, so CPU-hermetic but slow; the
+    block-class two-term correction's 5e-5 envelope applies."""
+    raw, res, pos, mask, n = _irregular_case(n=48)
+    slice_rows = np.asarray(
+        decode_ingest.make_decode_ingest_featurizer(
+            formulation="slice"
+        )(raw, res, pos, mask)
+    )
+    bank_rows = np.asarray(
+        decode_ingest.make_decode_ingest_featurizer(
+            formulation="bank128"
+        )(raw, res, pos, mask)
+    )
+    assert np.max(np.abs(slice_rows[:n] - bank_rows[:n])) < 5e-5
+    assert np.all(bank_rows[n:] == 0.0)

@@ -224,7 +224,8 @@ def test_fused_pipeline_query_mode(fixture_dir, tmp_path):
 
 def test_default_fused_backend_is_platform_aware(monkeypatch):
     """Bare -fused resolves per platform: block on accelerators (21x
-    the element gather on the r4 chip), xla on CPU."""
+    the element gather on the r4 chip), decode on CPU (the slice-scan
+    window cut — ~8.6x the element gather there)."""
 
     class _Dev:
         def __init__(self, platform):
@@ -233,7 +234,7 @@ def test_default_fused_backend_is_platform_aware(monkeypatch):
     monkeypatch.setattr(
         device_ingest.jax, "devices", lambda: [_Dev("cpu")]
     )
-    assert device_ingest.default_fused_backend() == "xla"
+    assert device_ingest.default_fused_backend() == "decode"
     monkeypatch.setattr(
         device_ingest.jax, "devices", lambda: [_Dev("tpu")]
     )

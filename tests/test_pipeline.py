@@ -15,8 +15,30 @@ def test_query_map_parse():
     q = builder.get_query_map("a=1&b=&c=x=y&d")
     assert q["a"] == "1"
     assert q["b"] == ""
-    assert q["c"] == "x"  # split('=')[1], like the reference
+    # first-'='-split: option values with embedded '=' survive (the
+    # reference's split('=')[1] truncation quirk is fixed at the
+    # parser — PR 7's per-key re-extraction workaround is gone)
+    assert q["c"] == "x=y"
     assert q["d"] == ""
+
+
+def test_query_map_embedded_equals_round_trips():
+    """The option grammars that legitimately carry '=' must survive
+    the parser everywhere — fe=, fe_sweep=, sweep=, faults= — and
+    agree with the raw-param extraction."""
+    q = (
+        "fe=dwt-4:level=4:stats=energy,std"
+        "&fe_sweep=dwt-4:level=2|dwt-8:stats=mean"
+        "&sweep=lr:1.0,0.5;reg:0.0,0.01"
+        "&faults=remote.request:p=0.2;staging.producer:once@2"
+    )
+    m = builder.get_query_map(q)
+    assert m["fe"] == "dwt-4:level=4:stats=energy,std"
+    assert m["fe_sweep"] == "dwt-4:level=2|dwt-8:stats=mean"
+    assert m["sweep"] == "lr:1.0,0.5;reg:0.0,0.01"
+    assert m["faults"] == "remote.request:p=0.2;staging.producer:once@2"
+    for key, want in m.items():
+        assert builder.get_raw_param(q, key) == want
 
 
 def test_logreg_train_pipeline(fixture_dir, tmp_path):

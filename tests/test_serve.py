@@ -504,3 +504,56 @@ def test_engine_host_fallback_for_non_linear(session, tmp_path):
     )
     assert margins is None
     np.testing.assert_array_equal(preds, dt.predict(feats[:8]))
+
+
+# -- the accuracy-gated bf16 serving path (PR 8) -------------------------
+
+
+def test_engine_bf16_warmup_gate_passes_and_serves(session):
+    """precision=bf16 gates at warmup (synthetic DC-stressed windows
+    vs the f32 program) and, inside the documented tolerance, serves
+    through the bf16 featurizer with predictions matching the batch
+    pipeline's on the fixture epochs."""
+    eng = engine.ServingEngine(
+        _loaded_classifier(session), capacity=8, precision="bf16"
+    )
+    eng.warmup()
+    rec = eng.precision_record
+    assert rec is not None and rec["requested"] == "bf16"
+    assert rec["used"] == "bf16" and rec["gate"]["ok"]
+    assert rec["gate"]["max_abs_dev"] <= rec["gate"]["tolerance"]
+    preds, _ = eng.execute(
+        session["windows"][:8], session["resolutions"]
+    )
+    # integer decisions survive the bf16 feature deviation
+    np.testing.assert_array_equal(
+        preds, session["batch_predictions"][:8]
+    )
+
+
+def test_engine_bf16_gate_auto_disables(session, monkeypatch):
+    """Above the gate the engine swaps to the f32 program BEFORE any
+    traffic — served predictions are then the f32 path's exactly, and
+    the serve stats block records the decision."""
+    monkeypatch.setenv("EEG_TPU_BF16_GATE_TOL", "0")
+    svc = InferenceService.from_saved(
+        "logreg", session["model"], precision="bf16",
+        config=ServeConfig(max_batch=8),
+    )
+    rec = svc.engine.precision_record
+    assert rec["used"] == "f32" and rec["gate"]["ok"] is False
+    with svc:
+        fut = svc.submit(
+            session["windows"][0], session["resolutions"]
+        )
+        assert fut.result(timeout=5.0).prediction == (
+            session["batch_predictions"][0]
+        )
+    assert svc.stats_block()["precision"]["used"] == "f32"
+
+
+def test_engine_precision_validation(session):
+    with pytest.raises(ValueError, match="precision"):
+        engine.ServingEngine(
+            _loaded_classifier(session), precision="f16"
+        )

@@ -54,6 +54,17 @@ performance contract holds:
   workload they exist for; the run's ``run_report.json`` carries the
   ``workload`` and per-member ``classification`` blocks.
 
+- the PR 8 ingest gates: the overlap=true cold twin produces
+  byte-identical statistics to the serial cold run (double-buffered
+  ingest reschedules work, never changes it); the precision=bf16 twin
+  records its accuracy-gate decision and, when the gate passed, ran
+  inside the documented tolerance; a forced-gate-off bf16 run
+  (EEG_TPU_BF16_GATE_TOL=0) auto-disables AND produces statistics
+  byte-identical to the f32 cold run; and pipeline_e2e_cold beats the
+  BENCH_pr5 plateau in machine-normalized form (cold eps / einsum eps
+  measured now vs the same ratio from the committed artifact — raw
+  eps would gate on this box's 2x load swings, not on the code).
+
 Usage: python tools/e2e_smoke.py [n_markers_per_file] [n_files]
 
 Prints a JSON summary line; exit 0 iff every gate passed. Wired into
@@ -144,7 +155,11 @@ def _check_serve(line: dict, report_dir: str, failures: list) -> None:
 
 def _run_variant(variant: str, n_markers: int, n_files: int,
                  data_dir: str, cache_dir: str,
-                 report_dir: str, extra: list = ()) -> dict:
+                 report_dir: str, extra: list = (),
+                 env_extra: dict = None) -> dict:
+    env = dict(os.environ)
+    if env_extra:
+        env.update(env_extra)
     proc = subprocess.run(
         [
             sys.executable, _PIPELINE_BENCH, variant,
@@ -154,6 +169,7 @@ def _run_variant(variant: str, n_markers: int, n_files: int,
         ],
         capture_output=True,
         text=True,
+        env=env,
     )
     if proc.returncode != 0:
         raise RuntimeError(
@@ -161,6 +177,74 @@ def _run_variant(variant: str, n_markers: int, n_files: int,
             f"{proc.stderr[-2000:]}"
         )
     return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def _einsum_eps_now() -> float:
+    """A quick same-machine compute probe (the einsum headline at a
+    small batch) — the denominator that makes cross-artifact e2e
+    comparisons machine-speed-normalized (this box's load swings 2x
+    between runs; raw eps comparisons would gate on the weather)."""
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(_REPO, "tools", "ingest_bench.py"),
+            "einsum", "8192", "3",
+        ],
+        capture_output=True,
+        text=True,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"einsum probe failed rc={proc.returncode}\n"
+            f"{proc.stderr[-1000:]}"
+        )
+    return float(
+        json.loads(proc.stdout.strip().splitlines()[-1])["epochs_per_s"]
+    )
+
+
+def _check_plateau(cold: dict, failures: list) -> dict:
+    """The ISSUE 8 acceptance gate: the pipeline_e2e_cold number must
+    move past the BENCH_pr5 plateau, machine-normalized (cold eps /
+    einsum eps vs the same ratio from the committed BENCH_pr5.json).
+    The authoritative ratio is the one the cold CHILD embedded —
+    its einsum probe ran in-process immediately after the timed query
+    (tools/pipeline_bench._einsum_probe_eps), and this box's load
+    swings 2-4x between smoke variants, so a probe run HERE (after
+    the fan-out/population/serve/seizure children) would re-import
+    exactly the noise normalization removes. The subprocess probe is
+    only the fallback for a cold line that carries no normalized
+    ratio (e.g. a BENCH_pr5.json without an einsum value)."""
+    plateau = cold.get("plateau") or {}
+    pr5_cold = plateau.get("pr5_cold_eps")
+    pr5_einsum = plateau.get("pr5_einsum_eps")
+    if not pr5_cold or not pr5_einsum:
+        failures.append(
+            f"plateau: BENCH_pr5 reference missing from the cold "
+            f"line: {plateau}"
+        )
+        return {}
+    ratio_pr5 = pr5_cold / pr5_einsum
+    if "normalized_ratio" in plateau:
+        einsum_now = plateau.get("einsum_probe_eps")
+        ratio_now = plateau["normalized_ratio"]
+    else:
+        einsum_now = _einsum_eps_now()
+        ratio_now = cold["epochs_per_s"] / einsum_now
+    if not ratio_now > ratio_pr5:
+        failures.append(
+            f"plateau: cold e2e did not beat the BENCH_pr5 plateau "
+            f"(machine-normalized {ratio_now:.5f} vs pr5 "
+            f"{ratio_pr5:.5f}; cold {cold['epochs_per_s']} eps, "
+            f"einsum probe {einsum_now})"
+        )
+    return {
+        "cold_eps": cold["epochs_per_s"],
+        "einsum_eps_now": einsum_now,
+        "normalized_ratio": round(ratio_now, 5),
+        "pr5_normalized_ratio": round(ratio_pr5, 5),
+        "beats_pr5_plateau": ratio_now > ratio_pr5,
+    }
 
 
 #: stages a timed pipeline run must have spent real time in
@@ -308,6 +392,28 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
             data_dir, os.path.join(tmp, "cache_fanout"),
             report_dirs["fanout"],
         )
+        # PR 8 gates: the overlap twin (bit-identical statistics), the
+        # bf16 twin (gate decision recorded, statistics within the
+        # documented envelope), and a forced-gate-off bf16 run (pinned
+        # statistics-identical to the f32 cold run)
+        overlap_line = _run_variant(
+            "pipeline_e2e_overlap", n_markers, n_files,
+            data_dir, os.path.join(tmp, "cache_overlap"),
+            os.path.join(tmp, "report_overlap"),
+        )
+        bf16_line = _run_variant(
+            "pipeline_e2e_bf16", n_markers, n_files,
+            data_dir, os.path.join(tmp, "cache_bf16"),
+            os.path.join(tmp, "report_bf16"),
+        )
+        bf16_off_line = _run_variant(
+            "pipeline_e2e_bf16", n_markers, n_files,
+            data_dir, os.path.join(tmp, "cache_bf16_off"),
+            os.path.join(tmp, "report_bf16_off"),
+            # an impossible tolerance forces the auto-disable path:
+            # the gated-off run must compute (and report) f32
+            env_extra={"EEG_TPU_BF16_GATE_TOL": "0"},
+        )
         # the other four legs as their OWN single-classifier cold
         # runs (fresh process, fresh cache): their reports' compile
         # counters are the honest "5x single" side of the fan-out
@@ -399,6 +505,51 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
             "cached vs uncached statistics drifted: "
             f"{cold['report_sha256']} vs {warm['report_sha256']}"
         )
+    # overlap-on vs overlap-off: scheduling only, never results
+    if overlap_line.get("overlap") is not True:
+        failures.append(
+            f"overlap line did not run overlapped: "
+            f"{overlap_line.get('overlap')}"
+        )
+    if overlap_line["report_sha256"] != cold["report_sha256"]:
+        failures.append(
+            "overlap-on statistics drifted from the serial cold run: "
+            f"{overlap_line['report_sha256']} vs "
+            f"{cold['report_sha256']}"
+        )
+    # the bf16 twin: a decision must be recorded, and when the gate
+    # passed (used=bf16) its measured deviation must sit inside the
+    # documented tolerance; statistics stay within the decision
+    # envelope (integer confusion counts — in practice identical)
+    prec = bf16_line.get("precision") or {}
+    gate = prec.get("gate") or {}
+    if prec.get("requested") != "bf16" or "used" not in prec:
+        failures.append(f"bf16 line recorded no gate decision: {prec}")
+    elif prec["used"] == "bf16":
+        if not (gate.get("ok") and
+                gate.get("max_abs_dev", 1.0) <= gate.get("tolerance", 0.0)):
+            failures.append(
+                f"bf16 ran outside its gate: {gate}"
+            )
+        if abs(bf16_line["accuracy"] - cold["accuracy"]) > 0.02:
+            failures.append(
+                f"bf16 statistics outside the envelope: accuracy "
+                f"{bf16_line['accuracy']} vs f32 {cold['accuracy']}"
+            )
+    # the forced-gate-off run: auto-disable recorded AND the run's
+    # statistics byte-identical to the f32 cold run
+    prec_off = bf16_off_line.get("precision") or {}
+    if prec_off.get("used") != "f32":
+        failures.append(
+            f"forced bf16 gate-off did not auto-disable: {prec_off}"
+        )
+    if bf16_off_line["report_sha256"] != cold["report_sha256"]:
+        failures.append(
+            "gated-off bf16 run drifted from the f32 cold run: "
+            f"{bf16_off_line['report_sha256']} vs "
+            f"{cold['report_sha256']}"
+        )
+    plateau_summary = _check_plateau(cold, failures)
     if fanout["accuracy"].get("logreg") != cold["accuracy"]:
         failures.append(
             "fan-out logreg accuracy drifted from the single-"
@@ -513,6 +664,15 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
         "seizure_windows_per_s": (seizure_line.get("seizure") or {}).get(
             "windows_per_s"
         ),
+        "overlap_wall_s": overlap_line["wall_s"],
+        "overlap_statistics_identical": (
+            overlap_line["report_sha256"] == cold["report_sha256"]
+        ),
+        "bf16_precision": bf16_line.get("precision"),
+        "bf16_gate_off_identical_to_f32": (
+            bf16_off_line["report_sha256"] == cold["report_sha256"]
+        ),
+        "plateau": plateau_summary,
         "reports_checked": len(reports_checked),
         "cold_stages": {
             k: v["seconds"] for k, v in cold.get("stages", {}).items()

@@ -36,6 +36,15 @@ Variants:
                   — one matmul per shift class instead of the
                   128-variant bank; host plan cached in ops/plan_cache)
                   — the XLA-only replacement for the element gather
+  decode_ingest   int16 raw + irregular markers -> features via the
+                  decode rung (ops/decode_ingest.py): windows cut by
+                  dynamic slices in a tiled scan (CPU) or the bank128
+                  VMEM kernel (accelerators) — NO XLA gather. The
+                  line additionally times the element-gather rung on
+                  the same data in the same process and records the
+                  ratio (``gather_baseline``), so the
+                  vs-gather-baseline claim is auditable from the
+                  artifact alone
   pallas_ingest   int16 raw + irregular markers -> features via the
                   fused Pallas kernel (ops/ingest_pallas.py)
   pallas_dwt      f32 epochs resident -> features via the Pallas
@@ -590,6 +599,162 @@ def run(variant: str, n: int, iters: int) -> dict:
 
             arg = args
 
+    elif variant == "decode_ingest":
+        from eeg_dataanalysispackage_tpu.ops import decode_ingest, device_ingest
+
+        S = 200 + n * STRIDE + 1000
+        raw = rng.randint(-3000, 3000, size=(3, S), dtype=np.int16)
+        base = np.arange(n, dtype=np.int64) * STRIDE + 200
+        jitter = rng.randint(-200, 200, size=n)
+        positions = np.clip(base + jitter, 100, S - 800)
+        bytes_per_epoch = 3 * STRIDE * 2
+        cap = ((n + 63) // 64) * 64
+        pos_pad = np.zeros(cap, np.int32)
+        pos_pad[:n] = positions
+        mask = np.zeros(cap, bool)
+        mask[:n] = True
+        raw_p = np.pad(raw, ((0, 0), (0, 900)))
+
+        formulation = (
+            os.environ.get("BENCH_DECODE_FORMULATION")
+            or decode_ingest.default_formulation()
+        )
+        feat = decode_ingest.make_decode_ingest_featurizer(
+            formulation=formulation
+        )
+        # on-device parity spot check before timing (the block/pallas
+        # contract): the first markers must match the gather
+        # formulation. slice is subtract-first like the gather rung
+        # (~6e-7 floor); bank128 carries the block-class two-term
+        # correction's 5e-5 envelope.
+        spot = positions[:64]
+        raw_spot = np.pad(
+            raw[:, : int(spot.max()) + 2048], ((0, 0), (0, 2048))
+        )
+        want, spot_pos, spot_mask = _gather_reference_rows(
+            raw_spot, res, spot
+        )
+        got = np.asarray(
+            feat(jnp.asarray(raw_spot), jnp.asarray(res),
+                 spot_pos, spot_mask)
+        )[: len(spot)]
+        decode_parity = _check_parity(
+            got, want, 5e-6 if formulation == "slice" else 5e-5,
+            f"decode[{formulation}]/gather",
+        )
+
+        # the same-machine gather baseline: SAME data, SAME epoch
+        # count, SAME best-of-2 discipline as the decode measurement
+        # below, taken back-to-back — this box's load swings 2-4x
+        # between minutes, so a ratio of two timings from different
+        # moments (or different batch sizes: the gather's per-element
+        # cost drops when the output fits cache) measures the
+        # weather, not the kernels. The decode line's headline claim
+        # is this ratio; the historical 54.8k eps chip figure rides
+        # along as a second reference.
+        def _best_eps(fn, reps=2):
+            fn()  # warmup (everything is compiled by now)
+            best = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                fn()
+                best = min(best, time.perf_counter() - t0)
+            return n * iters / best
+
+        gather_feat = device_ingest.make_device_ingest_featurizer()
+        gather_args = (
+            jnp.asarray(raw_p), jnp.asarray(res),
+            jnp.asarray(pos_pad), jnp.asarray(mask),
+        )
+
+        def _gather_pass():
+            for _ in range(iters):
+                jax.block_until_ready(gather_feat(*gather_args))
+
+        if formulation == "slice":
+            # host tile plan once (cached in ops/plan_cache), then the
+            # timed loop drives the inner jitted program — planning is
+            # per-layout metadata work, not per-step (the block_ingest
+            # policy)
+            pre = 100
+            win = pre + 175 + 512
+            tiles = decode_ingest.plan_decode_windows(
+                pos_pad, mask, raw_p.shape[1], pre=pre, window=win,
+                tile=decode_ingest.DEFAULT_TILE,
+            )
+            run_prog = decode_ingest._slice_program(
+                8, 512, 175, 16, pre, decode_ingest.DEFAULT_TILE,
+                False, False,
+                splits=decode_ingest.default_splits(),
+            )
+            # the plan pads capacities up to the geometric bucket;
+            # driving the inner program directly means padding the
+            # mask the same way the library wrapper does (a cap that
+            # is not 64*2^k would otherwise shape-mismatch)
+            mask_b = (
+                mask if tiles.size == mask.shape[0]
+                else np.pad(mask, (0, tiles.size - mask.shape[0]))
+            )
+            args = (
+                jnp.asarray(raw_p), jnp.asarray(res),
+                jnp.asarray(tiles), jnp.asarray(mask_b),
+            )
+
+            # direct dispatch per iteration, NOT an outer jitted scan:
+            # the slice program parallelizes its split scans across
+            # cores only as a top-level computation — wrapped in an
+            # outer scan body XLA:CPU executes them serially (measured
+            # ~1.5x slower). The scan-loop discipline exists for the
+            # axon tunnel's missing block_until_ready, and the slice
+            # formulation never runs there (accelerators route decode
+            # to bank128).
+            def _decode_pass():
+                for _ in range(iters):
+                    jax.block_until_ready(run_prog(*args))
+
+            # the ratio pair, measured back-to-back (see the
+            # gather-baseline comment above)
+            decode_eps_best = _best_eps(_decode_pass)
+            gather_eps = _best_eps(_gather_pass)
+
+            def loop(raw_a, res_a, tiles_a, mask_a):
+                acc = 0.0
+                for _ in range(iters):
+                    acc += float(
+                        np.asarray(
+                            run_prog(raw_a, res_a, tiles_a, mask_a)
+                        ).sum()
+                    )
+                return acc
+
+            arg = args
+        else:
+            # bank128 routing: time the featurizer whole (host plan is
+            # plan_cache-warm after the first call) — the kernel loop
+            # shape lives in the pallas_ingest variant; here the
+            # decode rung is measured as shipped
+            args = (
+                jnp.asarray(raw_p), jnp.asarray(res), pos_pad, mask,
+            )
+            jax.block_until_ready(feat(*args))  # compile + plan
+
+            def _decode_pass():
+                for _ in range(iters):
+                    jax.block_until_ready(feat(*args))
+
+            decode_eps_best = _best_eps(_decode_pass)
+            gather_eps = _best_eps(_gather_pass)
+
+            def loop(raw_a, res_a, pos_a, mask_a):
+                acc = 0.0
+                for _ in range(iters):
+                    acc += float(
+                        np.asarray(feat(raw_a, res_a, pos_a, mask_a)).sum()
+                    )
+                return acc
+
+            arg = args
+
     elif variant == "regular_ingest":
         from eeg_dataanalysispackage_tpu.ops import device_ingest
 
@@ -896,6 +1061,18 @@ def run(variant: str, n: int, iters: int) -> dict:
         "iters": iters,
         "elapsed_s": round(elapsed, 3),
         "bytes_per_epoch": bytes_per_epoch,
+        # the same number in bytes/sec (bench attribution: every
+        # ingest line is auditable as a bandwidth, not only a rate)
+        "bytes_per_s": round(eps * bytes_per_epoch, 1),
+        # host->device transfer bytes the timed loop staged (the
+        # device-resident argument set; the loop itself re-reads them
+        # from device memory)
+        "h2d_bytes": int(
+            sum(
+                int(getattr(a, "nbytes", 0))
+                for a in (arg if isinstance(arg, tuple) else (arg,))
+            )
+        ),
         "achieved_GBps": round(gbps, 1),
         "platform": platform,
     }
@@ -928,6 +1105,21 @@ def run(variant: str, n: int, iters: int) -> dict:
         payload["mode"] = mode  # the RESOLVED mode, not the env default
     elif variant == "block_ingest":
         payload["parity_max_abs_dev"] = block_parity
+    elif variant == "decode_ingest":
+        payload["parity_max_abs_dev"] = decode_parity
+        payload["formulation"] = formulation
+        # the headline ratio: decode and the element-gather rung,
+        # same data, same epoch count, same best-of-2 discipline,
+        # measured back-to-back — plus the historical chip figure.
+        # The ">=10x the gather baseline" claim in one auditable
+        # block.
+        payload["gather_baseline"] = {
+            "same_machine_eps": round(gather_eps, 1),
+            "decode_eps_best": round(decode_eps_best, 1),
+            "vs_same_machine": round(decode_eps_best / gather_eps, 2),
+            "chip_r05_eps": 54800.0,
+            "vs_chip_r05": round(decode_eps_best / 54800.0, 2),
+        }
     if variant in ("regular_ingest", "train_step_raw"):
         from eeg_dataanalysispackage_tpu.ops import device_ingest
 

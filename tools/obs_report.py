@@ -124,6 +124,17 @@ def show(path: str) -> None:
             f"  backend  requested={backend.get('requested')} "
             f"landed={backend.get('landed')}"
         )
+    precision = data.get("precision")
+    if precision:
+        gate = precision.get("gate") or {}
+        print(
+            f"  precision requested={precision.get('requested')} "
+            f"used={precision.get('used')} "
+            f"gate_dev={gate.get('max_abs_dev')} "
+            f"tol={gate.get('tolerance')}"
+        )
+    if data.get("overlap") is not None:
+        print(f"  overlap  {data.get('overlap')}")
     if crash:
         err = data.get("error", {})
         print(f"\nerror: {err.get('type')}: {err.get('message')}")
