@@ -57,6 +57,22 @@ train-step variants (tools/ingest_bench.py) with HBM-roofline context:
                   stage delta is the engine's win) and the per-member
                   accuracy table, with report_sha256 equality across
                   the pair proving per-member statistics parity
+  population_sharded
+                  the identical member set with the MEMBER axis
+                  sharded over a device mesh (devices=8 through the
+                  pipeline's mesh family; a virtual 8-device host
+                  platform on the CPU fallback) — the line's ``mesh``
+                  block records rung/shape/per-device member counts
+                  and ``members_per_s`` the member-axis rate;
+                  population_vmap from the same run is its
+                  same-machine single-device twin and the
+                  report_sha256 pair pins sharded==vmap statistics
+  sharded_ingest  fused int16 ingest with the recording time-sharded
+                  over an (up to) 8-device mesh
+                  (parallel/sharded_ingest.py ring-halo epoching);
+                  the line's ``mesh`` block records the compiled
+                  collective-permute count and the same-machine
+                  single-device twin eps + ratio
   seizure_e2e     the continuous-EEG seizure workload (task=seizure,
                   docs/workloads.md): sliding-window epoching over an
                   annotated synthetic session, per-subband wavelet
@@ -163,7 +179,7 @@ _VARIANT_TIMEOUTS = {
 # patience — on a warm compile cache everything fits easily; on a
 # cold cache the tail variants may be budget-skipped (recorded as
 # such, artifact intact). BENCH_TOTAL_BUDGET overrides.
-_N_VARIANTS = 21  # asserted against the variant tables below
+_N_VARIANTS = 23  # asserted against the variant tables below
 _TOTAL_BUDGET_S = int(
     os.environ.get(
         "BENCH_TOTAL_BUDGET",
@@ -228,9 +244,14 @@ _VARIANTS_TPU = {
     "pipeline_e2e_overlap": (2000, 4),
     "pipeline_e2e_bf16": (2000, 4),
     # population training engine (markers per file, file count): 16
-    # SGD members as one vmapped program vs the same members looped
+    # SGD members as one vmapped program vs the same members looped,
+    # plus the member axis sharded over the device mesh
     "population_vmap": (800, 2),
     "population_looped": (800, 2),
+    "population_sharded": (800, 2),
+    # time-sharded fused ingest over the mesh (epochs, iters) with
+    # its same-machine single-device twin on the line
+    "sharded_ingest": (32768, 10),
     # the continuous-EEG seizure workload (samples per file, file
     # count — tools/pipeline_bench.py seizure_e2e): sliding windows +
     # subband features + cost-sensitive training; the line records
@@ -260,6 +281,8 @@ _VARIANTS_CPU = {
     "pipeline_e2e_bf16": (2000, 4),
     "population_vmap": (800, 2),
     "population_looped": (800, 2),
+    "population_sharded": (800, 2),
+    "sharded_ingest": (2048, 2),
     "seizure_e2e": (60000, 2),
     "serve_bench": (400, 2),
 }
@@ -600,6 +623,10 @@ def _collect(platform: str) -> dict:
                 "bytes_per_s", "h2d_bytes", "gather_baseline",
                 "precision", "overlap", "parity_max_abs_dev",
                 "plateau",
+                # multi-device scale-out attribution: the mesh block
+                # (rung, shape, per-device member counts, the
+                # sharded_ingest twin ratio) and the member-axis rate
+                "mesh", "members_per_s",
             ):
                 if extra_field in r:
                     variants[name][extra_field] = r[extra_field]

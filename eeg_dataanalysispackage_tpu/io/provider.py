@@ -511,6 +511,8 @@ class OfflineDataProvider:
         ] = None,
         precision: str = "f32",
         overlap: Optional[bool] = None,
+        mesh=None,
+        mesh_axis: Optional[str] = None,
     ):
         """TPU fast path: info.txt run -> DWT features without host epochs.
 
@@ -543,6 +545,24 @@ class OfflineDataProvider:
         decode+featurize overlaps the consumer's handling of
         recording K — order-preserving, so features/targets are
         bit-identical to the serial path (pinned).
+
+        ``mesh`` (a ``jax.sharding.Mesh`` with >= 2 devices on its
+        ingest axis) shards each recording's epoch batch over the
+        device mesh through ``parallel/sharded_ingest.py``: the raw
+        int16 stream stages time-sharded (one contiguous block per
+        device, padded to the shard grid — validity judged against
+        the true length), each device cuts + featurizes the windows
+        starting in its block (ring-halo for boundary straddlers),
+        and the staged stream buffer is donated per shard on
+        accelerator backends. Recordings the sharded path cannot
+        express (non-INT16 sources, any per-recording failure) fall
+        back to the requested ``backend``'s featurizer with a logged
+        ``ingest.sharded_fallback`` count — the features are
+        rung-tolerance-identical either way (the ladder contract). A
+        single-device mesh is ignored here (the unsharded rung IS the
+        degenerate case, byte-identical by construction).
+        ``mesh_axis`` overrides the ingest axis (default: ``time``
+        when the mesh has one, else its last axis).
 
         Numerics follow the float32 device path (tolerance-level vs
         the bit-exact host path) — use :meth:`load` + a host-backend
@@ -587,6 +607,33 @@ class OfflineDataProvider:
             # on another backend re-reads nothing either
             source = iter(recordings)
         balance = BalanceState()
+        sharded_extract = None
+        sharded_axis = None
+        if mesh is not None:
+            from ..parallel import mesh as pmesh, sharded_ingest
+
+            sharded_axis = mesh_axis or (
+                pmesh.TIME_AXIS
+                if pmesh.TIME_AXIS in mesh.axis_names
+                else mesh.axis_names[-1]
+            )
+            if int(mesh.shape[sharded_axis]) >= 2:
+                import jax
+
+                # one extractor per run (the per-recording loop below
+                # reuses it; shard capacities bucket like every rung)
+                sharded_extract = sharded_ingest.make_sharded_ingest(
+                    mesh,
+                    wavelet_index=wavelet_index,
+                    epoch_size=epoch_size,
+                    skip_samples=skip_samples,
+                    feature_size=feature_size,
+                    pre=self._pre,
+                    axis=sharded_axis,
+                    # dead after the on-device scale; CPU cannot alias
+                    # and would warn per call (the decode-rung policy)
+                    donate_stream=jax.default_backend() != "cpu",
+                )
         pallas_featurizer = featurizer = None
         if backend == "pallas":
             import os
@@ -637,6 +684,69 @@ class OfflineDataProvider:
                 post=self._post,
             )
 
+        def featurize_sharded(item):
+            """One recording through the mesh-sharded ingest: pad the
+            int16 stream to the shard grid, plan shard assignment
+            (validity on the TRUE length), stage time-sharded, and
+            run the halo'd per-shard featurizer. Returns the same
+            (rows, mask, targets) triple as the pallas path (rows
+            already kept-only). Raises for recordings the sharded
+            path cannot express — the caller falls back to the
+            requested rung per recording."""
+            from ..parallel import sharded_ingest
+
+            _rel_path, guessed, rec = item
+            if rec.header.binary_format != "INT_16":
+                # float32-source recordings would truncate through the
+                # int16 staging seam; checked BEFORE stage_raw so the
+                # fallback rung's own staging is the only full-stream
+                # copy this recording pays
+                raise ValueError(
+                    "sharded ingest stages raw int16 streams; this "
+                    f"recording is {rec.header.binary_format}"
+                )
+            raw, res, n_true = device_ingest.stage_raw(
+                rec, self._channel_indices(rec)
+            )
+            if raw.dtype != np.int16:  # stage_raw's own fallback fired
+                raise ValueError(
+                    "sharded ingest stages raw int16 streams; this "
+                    "recording decoded to float32"
+                )
+            n_shards = int(mesh.shape[sharded_axis])
+            block = sharded_ingest.shard_block_for(
+                raw.shape[1], n_shards
+            )
+            total = n_shards * block
+            if total > raw.shape[1]:
+                raw = np.pad(raw, ((0, 0), (0, total - raw.shape[1])))
+            plan = sharded_ingest.plan_sharded_ingest(
+                rec.markers,
+                guessed,
+                total,
+                n_shards,
+                block,
+                pre=self._pre,
+                balance=balance,
+                valid_n_samples=n_true,
+            )
+            staged = sharded_ingest.stage_recording_int16(
+                raw, mesh, sharded_axis
+            )
+            rows = sharded_extract(staged, res, plan)
+            # counted AFTER the extract lands: a failed attempt falls
+            # back to the rung featurizer, which bills its own
+            # h2d_bytes — counting up front would double-bill the
+            # recording and record a sharded ingest that never happened
+            obs.metrics.count(
+                "ingest.h2d_bytes",
+                int(raw.nbytes) + int(res.nbytes)
+                + int(plan.local_positions.nbytes)
+                + int(plan.mask.nbytes),
+            )
+            obs.metrics.count("ingest.sharded_recordings")
+            return rows, None, plan.targets
+
         def featurize_one(item):
             """One recording's staging + plan + fused dispatch ->
             (device features, mask-or-None, targets). Shared verbatim
@@ -644,6 +754,21 @@ class OfflineDataProvider:
             paths cannot drift; runs single-threaded in either case
             (the balance scan and the stale-channel-index reuse are
             order-dependent state)."""
+            if sharded_extract is not None:
+                # the balance scan is order-dependent run state; a
+                # sharded attempt that fails after scanning must not
+                # let the fallback rung double-count this recording
+                saved = (balance.n_targets, balance.n_nontargets)
+                try:
+                    return featurize_sharded(item)
+                except Exception as e:
+                    balance.n_targets, balance.n_nontargets = saved
+                    logger.warning(
+                        "sharded ingest fell back to the %s rung for "
+                        "%s (%s: %s)", backend, item[0],
+                        type(e).__name__, e,
+                    )
+                    obs.metrics.count("ingest.sharded_fallback")
             _rel_path, guessed, rec = item
             raw, res, n_samples = device_ingest.stage_raw(
                 rec, self._channel_indices(rec)

@@ -337,6 +337,20 @@ def _null_stage(_name, **_attrs):
     return contextlib.nullcontext()
 
 
+def member_mesh_axis(mesh) -> str:
+    """The mesh axis the member axis shards over: ``data`` when the
+    mesh has one (the population IS data parallelism over members),
+    else the mesh's first axis — one rule shared by the engine
+    dispatch and the telemetry so they can never disagree."""
+    from ..parallel import mesh as pmesh
+
+    return (
+        pmesh.DATA_AXIS
+        if pmesh.DATA_AXIS in mesh.axis_names
+        else mesh.axis_names[0]
+    )
+
+
 def run_population(
     name: str,
     make_classifier: Callable,
@@ -346,6 +360,7 @@ def run_population(
     spec: PopulationSpec,
     stage: Optional[Callable] = None,
     feature_sets: Optional[Sequence[Tuple[str, np.ndarray]]] = None,
+    mesh=None,
 ) -> Tuple[stats.PopulationStatistics, Dict]:
     """Train + evaluate one classifier family's population.
 
@@ -358,6 +373,16 @@ def run_population(
     train/test wall time lands in the same StageTimer rows (and the
     same ``stage.train``/``stage.test`` spans) the sequential paths
     use; defaults to a no-op for library callers.
+
+    ``mesh`` (a ``jax.sharding.Mesh``) shards the MEMBER axis over the
+    mesh's data axis for linear-family vmap-mode populations
+    (``parallel/population.train_linear_population_sharded`` — members
+    padded to a mesh multiple with inert zero-mask members), so the
+    population trains on every device of the mesh. Any sharded-engine
+    failure degrades to the single-device vmapped engine — recorded in
+    the block's ``mesh`` sub-block (``rung``/``error``), counted as
+    ``population.mesh_fallback`` — and NN populations always train
+    single-device (logged; the NN engine has no sharded formulation).
 
     ``feature_sets`` carries the ``fe_sweep=`` axis: ordered
     ``(config label, (n, d) feature matrix)`` pairs, one per entry in
@@ -429,13 +454,66 @@ def run_population(
         obs.metrics.count("population.degenerate_seed_axis")
 
     mode_used = spec.mode
+    mesh_block = None
+    if mesh is not None:
+        axis = member_mesh_axis(mesh)
+        mesh_block = {
+            "rung": "single_device",
+            "axis": axis,
+            "shape": {k: int(v) for k, v in mesh.shape.items()},
+            "devices": int(mesh.devices.size),
+        }
+        if not linear:
+            logger.warning(
+                "population mesh sharding applies to the linear family "
+                "(logreg/svm); %s trains single-device", name,
+            )
+            obs.metrics.count("population.mesh_unsupported_family")
+        elif spec.mode != "vmap":
+            # the looped twin is the bench baseline — sharding it
+            # would measure the mesh, not the engine
+            logger.warning(
+                "population_mode=looped trains single-device; the mesh "
+                "applies to the vmapped engine"
+            )
     comp = CompilationMonitor()
     with comp, stage("train", classifier=name, population=len(members)), \
             events.span(
                 f"population.{name}", classifier=name,
                 members=len(members), mode=spec.mode,
             ):
-        if spec.mode == "vmap":
+        trained = None
+        if (
+            mesh is not None and linear and spec.mode == "vmap"
+        ):
+            try:
+                trained = _train_sharded(
+                    template, features, targets, folds, members,
+                    base_cfg, mesh, feature_sets=feature_sets,
+                )
+                mode_used = "sharded"
+                from ..parallel import population as engines
+
+                n_shards = int(mesh.shape[mesh_block["axis"]])
+                padded = engines.pad_members(len(members), n_shards)
+                mesh_block.update(
+                    rung="mesh",
+                    members_per_device=padded // n_shards,
+                    padded_members=padded - len(members),
+                )
+                obs.metrics.count("population.sharded_members",
+                                  len(members))
+            except Exception as e:  # mesh rung -> single-device rung
+                evidence = f"{type(e).__name__}: {e}"
+                logger.warning(
+                    "population %s mesh training failed; degrading to "
+                    "the single-device engine: %s", name, evidence,
+                )
+                obs.metrics.count("population.mesh_fallback")
+                events.event("population.mesh_fallback", error=evidence)
+                mesh_block["error"] = evidence
+                trained = None
+        if trained is None and spec.mode == "vmap":
             try:
                 trained = _train_vmapped(
                     name, template, features, targets, folds, members,
@@ -453,7 +531,7 @@ def run_population(
                     folds, members, base_cfg, template,
                     feature_sets=feature_sets,
                 )
-        else:
+        elif trained is None:
             trained = _train_looped(
                 name, make_classifier, config, features, targets,
                 folds, members, base_cfg, template,
@@ -497,6 +575,7 @@ def run_population(
         "members": len(members),
         "mode": mode_used,
         "requested_mode": spec.mode,
+        "mesh": mesh_block,
         "shape": spec.describe(),
         "compiles": (
             snapshot["compilations"] if snapshot["available"] else None
@@ -602,6 +681,51 @@ def _train_vmapped(
         np.asarray(features)[train_idx], targets[train_idx],
         [m.seed for m in members], lrs,
     )
+
+
+def _train_sharded(
+    template, features, targets, folds, members, base_cfg, mesh,
+    feature_sets=None,
+) -> List:
+    """The linear family's member set over a device mesh: the SAME
+    fold/feature dispatch as :func:`_train_vmapped`, handed to
+    ``train_linear_population_sharded`` so the per-member invocation
+    (and therefore the statistics contract) cannot drift between the
+    single-device and sharded engines."""
+    from ..parallel import population as engines
+
+    axis = member_mesh_axis(mesh)
+    steps, regs, seeds, wpos, wneg = _member_axes(members, base_cfg)
+    stacked = feature_sets is not None and any(
+        m.fe is not None for m in members
+    )
+    if len(folds) == 1:
+        train_idx = folds[0][0]
+        x = (
+            _stacked_features(members, feature_sets, train_idx)
+            if stacked
+            else np.asarray(features)[train_idx]
+        )
+        weights = engines.train_linear_population_sharded(
+            x, np.asarray(targets)[train_idx],
+            base_cfg, steps, regs, seeds, masks=None, mesh=mesh,
+            weight_pos=wpos, weight_neg=wneg,
+            stacked_features=stacked, axis=axis,
+        )
+    else:
+        masks = _fold_masks(members, folds, len(targets))
+        x = (
+            _stacked_features(members, feature_sets)
+            if stacked
+            else features
+        )
+        weights = engines.train_linear_population_sharded(
+            x, targets, base_cfg, steps, regs, seeds,
+            masks=masks, mesh=mesh,
+            weight_pos=wpos, weight_neg=wneg,
+            stacked_features=stacked, axis=axis,
+        )
+    return list(weights)
 
 
 def _train_looped(

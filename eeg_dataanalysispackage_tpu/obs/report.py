@@ -219,6 +219,16 @@ class RunTelemetry:
         #: ingest/compute overlap (io/staging.prefetch stage_fn path);
         #: None when the run never reached the fused ingest
         self.overlap: Optional[bool] = None
+        #: multi-device mesh attribution ({"requested", "rung",
+        #: "shape", "devices", "population": {...}, "error"}) when the
+        #: run asked for devices=/mesh_axes= — the rung actually used
+        #: (mesh | single_device), the mesh shape, and the population
+        #: engine's per-device member counts live HERE, never only in
+        #: a log line; None for unmeshed runs (the default,
+        #: schema-stable). The builder shares the dict with its
+        #: ``mesh_resolved`` attribute, so late updates (a population
+        #: fallback) land in the written report.
+        self.mesh: Optional[Dict[str, Any]] = None
 
     @property
     def report_path(self) -> str:
@@ -261,6 +271,7 @@ class RunTelemetry:
             "workload": self.workload,
             "precision": self.precision,
             "overlap": self.overlap,
+            "mesh": self.mesh,
             "degradation": list(self.degradation),
             "stages": timers.as_dict() if timers is not None else {},
             "metrics": metrics.snapshot() if metrics is not None else {},

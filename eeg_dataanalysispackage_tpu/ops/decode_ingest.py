@@ -101,6 +101,127 @@ ENV_FORMULATION = "EEG_TPU_DECODE_FORMULATION"
 BF16_GATE_TOL = 5e-3
 
 
+#: the standing r4 chip evidence the accelerator default is judged
+#: against (tools/sweep_results/r4): the classed-block rung measured
+#: 1.15M eps on the v5e chip = 21x the 54.8k element gather, so block
+#: holds the accelerator default until the bank128 kernel's own chip
+#: timing lands and beats it by the pre-registered margin.
+CHIP_BLOCK_EPS = 1_151_915.7  # tools/sweep_results/r4/block_ingest.json
+CHIP_GATHER_EPS = 54_841.8  # tools/sweep_results/r4/xla_ingest.json
+
+#: the pre-registered flip threshold (docs/chip_playbook.md, r4b
+#: decision table): bank128 must beat block by >= this ratio on chip
+#: before the accelerator `-fused` default routes to the decode rung.
+BANK128_FLIP_RATIO = 2.0
+
+#: sweep-artifact filename stems that carry a bank128 chip timing
+#: (tools/collect_chip_runs_r4b.sh writes bank128_*.json; the r4-era
+#: list wrote pallas_ingest.json, which defaults to the bank kernel).
+_BANK128_ARTIFACTS = ("bank128_*.json", "pallas_ingest*.json")
+
+
+def _sweep_results_root() -> str:
+    """Where the chip-run artifacts live; ``EEG_TPU_SWEEP_RESULTS``
+    overrides (tests point it at fabricated trees)."""
+    import os
+
+    override = os.environ.get("EEG_TPU_SWEEP_RESULTS")
+    if override:
+        return override
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        ))),
+        "tools", "sweep_results",
+    )
+
+
+def accelerator_decision(root: str | None = None) -> dict:
+    """The decode rung's accelerator decision path, as DATA: harvest
+    the best on-chip bank128 timing from the staged sweep artifacts
+    and judge it against the block rung's standing chip number at the
+    pre-registered threshold (docs/chip_playbook.md). Returns the
+    record ``{"backend", "bank128_eps", "source", "block_eps",
+    "threshold_eps", "reason"}`` — ``backend`` is what a bare
+    ``fe=dwt-<i>-fused`` resolves to on accelerators
+    (``device_ingest.default_fused_backend`` consults this), and the
+    whole record is auditable: the flip happens when (and only when) a
+    measured-silicon artifact says the bank kernel earns it, never
+    from a hardcoded guess. With no bank128 chip artifact on disk
+    (the r4b collection never landed — the tunnel died first), the
+    decision is ``block`` with that absence as the recorded reason.
+    """
+    import glob
+    import json
+    import os
+
+    base = root or _sweep_results_root()
+    best_eps = None
+    best_src = None
+    for pattern in _BANK128_ARTIFACTS:
+        for path in glob.glob(os.path.join(base, "*", pattern)):
+            try:
+                if os.path.getsize(path) == 0:
+                    continue
+                with open(path) as f:
+                    rec = json.loads(f.read().strip().splitlines()[-1])
+            except (OSError, ValueError, IndexError):
+                continue
+            if rec.get("platform") not in ("tpu", "axon"):
+                continue
+            eps = rec.get("epochs_per_s")
+            if not isinstance(eps, (int, float)) or eps <= 0:
+                continue
+            if best_eps is None or eps > best_eps:
+                best_eps, best_src = float(eps), path
+    threshold = BANK128_FLIP_RATIO * CHIP_BLOCK_EPS
+    decision = {
+        "bank128_eps": best_eps,
+        "source": (
+            os.path.relpath(best_src, os.path.dirname(base))
+            if best_src
+            else None
+        ),
+        "block_eps": CHIP_BLOCK_EPS,
+        "threshold_eps": threshold,
+    }
+    if best_eps is None:
+        decision.update(
+            backend="block",
+            reason=(
+                "no on-chip bank128 timing in sweep artifacts; the "
+                "block rung's measured 21x-gather chip figure stands"
+            ),
+        )
+    elif best_eps >= threshold:
+        decision.update(
+            backend="decode",
+            reason=(
+                f"bank128 measured {best_eps:.0f} eps on chip >= "
+                f"{BANK128_FLIP_RATIO:g}x block ({threshold:.0f}); "
+                f"the decode rung (bank128 routing) takes the default"
+            ),
+        )
+    else:
+        decision.update(
+            backend="block",
+            reason=(
+                f"bank128 measured {best_eps:.0f} eps on chip < "
+                f"{BANK128_FLIP_RATIO:g}x block ({threshold:.0f}); "
+                f"block stands"
+            ),
+        )
+    return decision
+
+
+@functools.lru_cache(maxsize=None)
+def default_accelerator_backend() -> str:
+    """The cached accelerator resolution of :func:`accelerator_decision`
+    (one artifact walk per process; the decision itself is cheap but
+    globs the sweep tree)."""
+    return accelerator_decision()["backend"]
+
+
 def default_formulation() -> str:
     """Platform default: ``slice`` on CPU (scan+dynamic_slice — the
     memcpy window cut XLA:CPU needs), ``bank128`` on accelerators

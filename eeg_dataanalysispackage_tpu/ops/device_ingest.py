@@ -294,16 +294,24 @@ def _phase_group(stride: int) -> int:
 
 def default_fused_backend() -> str:
     """Platform default for the irregular fused-ingest backend
-    (``fe=dwt-<i>-fused`` with no explicit suffix): accelerators get
-    ``block`` — on the r4 chip it ran 1.15M epochs/s = 21x the XLA
-    element gather's 54.8k (tools/sweep_results/r4, parity 3e-7;
-    the decode rung's bank128 routing stays opt-in there until its
-    chip timing lands) — while CPU gets ``decode``
-    (ops/decode_ingest.py): XLA:CPU lowers the element gather to
-    ~5 ns/element scalar loads, and the decode rung's slice-scan cut
-    measured ~8.6x the gather rung's throughput with a ~3.5x faster
-    compile (docs/performance.md)."""
-    return "decode" if jax.devices()[0].platform == "cpu" else "block"
+    (``fe=dwt-<i>-fused`` with no explicit suffix). CPU gets
+    ``decode`` (ops/decode_ingest.py): XLA:CPU lowers the element
+    gather to ~5 ns/element scalar loads, and the decode rung's
+    slice-scan cut measured ~8.6x the gather rung's throughput with a
+    ~3.5x faster compile (docs/performance.md). Accelerators resolve
+    through the RECORDED decision path
+    (``decode_ingest.accelerator_decision``): ``block`` — 1.15M eps =
+    21x the element gather on the r4 chip (tools/sweep_results/r4,
+    parity 3e-7) — until a staged sweep lands an on-chip bank128
+    timing beating block by the pre-registered 2x
+    (docs/chip_playbook.md), at which point the same artifacts flip
+    the default to ``decode`` (the rung that routes to the bank128
+    VMEM kernel) with the evidence in the decision record."""
+    if jax.devices()[0].platform == "cpu":
+        return "decode"
+    from . import decode_ingest
+
+    return decode_ingest.default_accelerator_backend()
 
 
 def resolve_regular_formulation(formulation: str, stride: int) -> str:

@@ -47,6 +47,21 @@ def make_mesh(
         shape = (n,) if len(axes) == 1 else None
     if shape is None:
         raise ValueError("shape required for multi-axis meshes")
+    shape = tuple(int(s) for s in shape)
+    if len(shape) != len(axes):
+        raise ValueError(
+            f"mesh shape {shape} names {len(shape)} axes but "
+            f"axes={axes} names {len(axes)}; give one extent per axis"
+        )
+    product = int(np.prod(shape)) if shape else 1
+    if product != n:
+        # a bare numpy reshape ValueError here read as an internal
+        # bug; the real error is the caller's axis arithmetic
+        raise ValueError(
+            f"mesh shape {shape} covers {product} devices but "
+            f"{n} device(s) were requested; the axis extents must "
+            f"multiply to the device count"
+        )
     return Mesh(devs.reshape(shape), axes)
 
 

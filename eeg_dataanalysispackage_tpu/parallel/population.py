@@ -42,6 +42,7 @@ the roundoff drift.
 
 from __future__ import annotations
 
+import functools
 from typing import List, Optional, Sequence
 
 import jax
@@ -207,6 +208,161 @@ def train_linear_population_looped(
             )
         )
     return np.asarray(jnp.stack(out))
+
+
+def pad_members(n_members: int, n_shards: int) -> int:
+    """Member-axis padding for an ``n_shards``-way mesh: the smallest
+    multiple of ``n_shards`` >= ``n_members``. The single source for
+    the padded cardinality, shared by the engine and its telemetry
+    (per-device member counts in the run report / bench lines)."""
+    return -(-int(n_members) // int(n_shards)) * int(n_shards)
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_linear_program(
+    mesh, axis, num_iterations, loss, full_batch, frac, tol, weighted,
+    stacked,
+):
+    """(train, replicate) jitted pair for one mesh/config geometry.
+
+    ``train`` is the vmapped per-member program of
+    :func:`train_linear_population` wrapped in ``shard_map`` over the
+    mesh's ``axis``: each device runs the SAME member invocation on
+    its local member block, so the program contains no cross-device
+    traffic at all — member training is embarrassingly parallel.
+    ``replicate`` gathers the tiny (P, d) weight block back to every
+    device (the one collective of the path — an all-gather for real
+    meshes, asserted in the MULTICHIP dryrun), so the host fetch
+    works on multi-host runs where the sharded array spans
+    non-addressable devices. lru-cached per (mesh, statics): repeat
+    runs over the same mesh re-jit nothing.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..models import sgd
+    from .shardmap_compat import shard_map
+
+    def member(xm, y, step, reg, seed, mask, w_pos, w_neg):
+        kwargs = (
+            dict(weighted=True, weight_pos=w_pos, weight_neg=w_neg)
+            if weighted
+            else {}
+        )
+        return sgd._run_sgd(
+            xm, y, step, frac, reg, seed, tol,
+            sample_mask=mask, num_iterations=num_iterations, loss=loss,
+            full_batch=full_batch, **kwargs,
+        )
+
+    vmapped = jax.vmap(member, in_axes=(0 if stacked else None, None,
+                                        0, 0, 0, 0, 0, 0))
+    x_spec = P(axis, None, None) if stacked else P()
+    member_spec = P(axis)
+    train = jax.jit(
+        shard_map(
+            vmapped,
+            mesh=mesh,
+            in_specs=(
+                x_spec, P(), member_spec, member_spec, member_spec,
+                P(axis, None), member_spec, member_spec,
+            ),
+            out_specs=P(axis, None),
+        )
+    )
+    replicate = jax.jit(lambda w: w, out_shardings=NamedSharding(mesh, P()))
+    return train, replicate
+
+
+def train_linear_population_sharded(
+    features: np.ndarray,
+    labels: np.ndarray,
+    config,
+    step_sizes: Sequence[float],
+    reg_params: Sequence[float],
+    seeds: Sequence[int],
+    masks: Optional[np.ndarray],
+    mesh,
+    weight_pos: Optional[Sequence[float]] = None,
+    weight_neg: Optional[Sequence[float]] = None,
+    stacked_features: bool = False,
+    axis: Optional[str] = None,
+) -> np.ndarray:
+    """:func:`train_linear_population` with the MEMBER axis sharded
+    over ``mesh`` — P members train on N devices in ~P/N-member local
+    blocks, one device-parallel program (the ROADMAP item-2 shape:
+    a 16-member CV x sweep population on N chips in ~1/N wall time).
+
+    Same argument contract as the vmapped engine. Members are padded
+    up to a mesh multiple (:func:`pad_members`) with INERT members:
+    an all-zero sample mask makes ``_run_sgd``'s per-iteration sampled
+    count 0, so every padded member's update is skipped and its
+    weights stay exactly zero — the identical masking seam
+    ``shard_map``'s batch padding (:func:`shard_batch_with_mask`)
+    already uses. Padded rows are sliced off before returning, so the
+    caller sees (P, d) weights in member order, like the other
+    engines. Real members therefore run the same per-member program
+    as the vmapped engine (an explicit all-ones mask equals the
+    engine's implicit one value-for-value), and the 1-device mesh is
+    the degenerate case: statistics downstream are pinned byte-equal
+    to the vmapped engine's (tests/test_sharded_population.py), the
+    same margin-band contract that pins vmap==looped.
+    """
+    axis = axis or mesh.axis_names[0]
+    n_shards = int(mesh.shape[axis])
+    n_members = len(list(seeds))
+    padded = pad_members(n_members, n_shards)
+    pad = padded - n_members
+
+    y = np.asarray(labels, np.float32)
+    n = y.shape[0]
+    wp, wn, weighted = _weight_arrays(config, n_members, weight_pos,
+                                      weight_neg)
+
+    def member_axis(values, dtype):
+        a = np.asarray(list(values), dtype)
+        if pad:
+            # padded members reuse member 0's traced hyperparameters
+            # (any finite value works — their zero mask makes the
+            # program inert); what matters is the shape
+            a = np.concatenate([a, np.repeat(a[:1], pad, axis=0)])
+        return a
+
+    masks_arr = (
+        np.ones((n_members, n), np.float32)
+        if masks is None
+        else np.asarray(masks, np.float32)
+    )
+    if pad:
+        masks_arr = np.concatenate(
+            [masks_arr, np.zeros((pad, n), np.float32)]
+        )
+    if stacked_features:
+        x = np.asarray(features, np.float32)
+        if pad:
+            x = np.concatenate([x, np.repeat(x[:1], pad, axis=0)])
+    else:
+        x = np.asarray(features, np.float32)
+
+    train, replicate = _sharded_linear_program(
+        mesh, axis,
+        int(config.num_iterations), config.loss,
+        config.mini_batch_fraction >= 1.0,
+        float(config.mini_batch_fraction),
+        float(config.convergence_tol),
+        weighted, bool(stacked_features),
+    )
+    w_sharded = train(
+        jnp.asarray(x),
+        jnp.asarray(y),
+        jnp.asarray(member_axis(step_sizes, np.float32)),
+        jnp.asarray(member_axis(reg_params, np.float32)),
+        jnp.asarray(member_axis([int(s) for s in seeds], np.int32)),
+        jnp.asarray(masks_arr),
+        jnp.asarray(member_axis(wp, np.float32)),
+        jnp.asarray(member_axis(wn, np.float32)),
+    )
+    weights = np.asarray(replicate(w_sharded))
+    return weights[:n_members]
 
 
 def train_nn_population(

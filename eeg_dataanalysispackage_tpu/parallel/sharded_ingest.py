@@ -66,14 +66,24 @@ def plan_sharded_ingest(
     pre: int = constants.PRESTIMULUS_SAMPLES,
     balance: Optional[BalanceState] = None,
     capacity_multiple: int = 8,
+    valid_n_samples: Optional[int] = None,
 ) -> ShardedIngestPlan:
     """Assign each kept marker to the shard containing its window
     start; reference validity + balance semantics come from
-    :func:`device_ingest.plan_ingest` (same host scan)."""
+    :func:`device_ingest.plan_ingest` (same host scan).
+
+    ``valid_n_samples`` decouples window VALIDITY from the staged
+    geometry: the provider pads a recording's sample axis up to the
+    shard grid (``n_shards * block``), and the padding must stay
+    semantically free — marker validity is judged against the true
+    recording length, exactly like ``device_ingest.stage_raw``'s
+    bucketing — while shard assignment and the extract-time geometry
+    check use the padded length actually staged.
+    """
     base = device_ingest.plan_ingest(
         markers,
         guessed_number,
-        n_samples,
+        valid_n_samples if valid_n_samples is not None else n_samples,
         pre=pre,
         balance=balance,
         capacity_multiple=1,
@@ -115,6 +125,7 @@ def make_sharded_ingest(
     feature_size: int = 16,
     pre: int = constants.PRESTIMULUS_SAMPLES,
     axis: str = pmesh.TIME_AXIS,
+    donate_stream: bool = False,
 ):
     """Build ``extract(raw_sharded, resolutions, plan) -> features``.
 
@@ -122,6 +133,14 @@ def make_sharded_ingest(
     ``axis`` (T divisible by the mesh axis size; per-shard block must
     be >= the 1024-sample halo). Returns the (n_kept, C*K) float32
     feature rows in original kept-marker order.
+
+    ``donate_stream`` donates the staged recording buffer to the
+    program — each shard's int16 block is dead after the on-device
+    scale, so the pipeline's per-recording staging (one fresh buffer
+    per file) frees it at dispatch instead of at the next GC. Skipped
+    on CPU by the caller (io/provider.py), where XLA cannot alias it
+    and would warn per call — the decode rung's ``donate_stream``
+    policy.
     """
     n_shards = mesh.shape[axis]
     featurize = device_ingest.make_block_ingest_featurizer(
@@ -153,7 +172,8 @@ def make_sharded_ingest(
             mesh=mesh,
             in_specs=(P(None, axis), P(), P(axis, None), P(axis, None)),
             out_specs=P(axis, None, None),
-        )
+        ),
+        donate_argnums=(0,) if donate_stream else (),
     )
     # feature rows are tiny; allgather them to every host (a sharded
     # global array spans non-addressable devices on multi-host runs,
@@ -201,6 +221,20 @@ def make_sharded_ingest(
     # collective-permute)
     extract._sharded_jit = sharded
     return extract
+
+
+def shard_block_for(n_samples: int, n_shards: int,
+                    quantum: int = 2048) -> int:
+    """Per-shard block length for staging an ``n_samples`` recording
+    over ``n_shards`` devices: at least the halo slab, covers the
+    whole recording, and bucketed up to a ``quantum`` multiple so
+    recordings of similar length land on one compiled shard shape
+    (``device_ingest.stage_raw``'s bucketing policy, applied to the
+    shard grid). The staged length is ``n_shards * block``; padding
+    beyond the true length is semantically free (see
+    :func:`plan_sharded_ingest`'s ``valid_n_samples``)."""
+    block = max(_SLAB, -(-int(n_samples) // int(n_shards)))
+    return -(-block // int(quantum)) * int(quantum)
 
 
 def stage_recording_int16(

@@ -105,6 +105,11 @@ class PipelineBuilder:
         #: whether the last fused run's ingest overlapped (the
         #: double-buffered staging path); None before any fused run
         self.overlap_resolved: Optional[bool] = None
+        #: mesh resolution of the last run ({"requested", "rung",
+        #: "shape", ...}); None when the run asked for no mesh
+        #: (devices=/mesh_axes= absent). Set whether or not telemetry
+        #: is on — bench lines read it here, like precision_resolved.
+        self.mesh_resolved: Optional[dict] = None
 
     @contextlib.contextmanager
     def _stage(self, name: str, **attrs):
@@ -159,6 +164,7 @@ class PipelineBuilder:
         self.degradation_history = []
         self.precision_resolved = None
         self.overlap_resolved = None
+        self.mesh_resolved = None
         # fresh per run, like the metrics scope below: a reused
         # builder must not report run 1's stage seconds under run 2
         self.timers = obs.StageTimer()
@@ -251,6 +257,19 @@ class PipelineBuilder:
                 prefetch_depth=self._int_param(query_map, "prefetch"),
             )
 
+        # devices=/mesh_axes=: the multi-device scale-out family
+        # (ROADMAP item 2). A requested mesh threads into the fused
+        # ingest (parallel/sharded_ingest — the epoch batch sharded
+        # over devices) and the population engine (the member axis
+        # sharded, parallel/population). Mesh-unavailable/unhealthy
+        # is the ladder's new TOP rung: the run degrades to the
+        # single-device path (recorded — rung, shape, evidence in
+        # run_report.json and on the bench line), which can itself
+        # degrade to host exactly as before. Absent both parameters,
+        # this resolves to None and the path is byte-identical to
+        # every query ever written.
+        mesh = self._resolve_mesh(query_map)
+
         # task=seizure: the continuous-EEG seizure workload
         # (docs/workloads.md) — sliding-window epoching over interval
         # annotations, pluggable subband features, cost-sensitive
@@ -276,7 +295,8 @@ class PipelineBuilder:
                     self.telemetry.workload = workload
                 return self._finish_run(statistics, query_map)
             return self._finish_run(
-                self._execute_seizure(query_map, make_provider), query_map
+                self._execute_seizure(query_map, make_provider, mesh),
+                query_map,
             )
         if query_map.get("fe_sweep"):
             raise ValueError(
@@ -512,6 +532,7 @@ class PipelineBuilder:
                         features, targets = odp.load_features_device(
                             wavelet_index=wavelet_index,
                             backend=rung,
+                            mesh=mesh,
                             recordings=(
                                 None if prepared is None
                                 else prepared.recordings
@@ -710,6 +731,7 @@ class PipelineBuilder:
                 batch=None if fused else batch,
                 fe=fe,
                 pop_spec=pop_spec,
+                mesh=mesh,
             )
 
         elif "train_clf" in query_map and pop_spec.active:
@@ -726,6 +748,7 @@ class PipelineBuilder:
                 targets=targets if fused else None,
                 batch=None if fused else batch,
                 fe=fe,
+                mesh=mesh,
             )
 
         elif "train_clf" in query_map:
@@ -986,7 +1009,7 @@ class PipelineBuilder:
         feature_sets = [(name, hits[name][0]) for name, _ in extractors]
         return feature_sets, targets
 
-    def _execute_seizure(self, query_map, make_provider):
+    def _execute_seizure(self, query_map, make_provider, mesh=None):
         """``task=seizure``: sliding windows -> configurable subband
         features -> cost-sensitive training -> imbalanced-class
         statistics (docs/workloads.md). The first non-P300 path
@@ -1097,6 +1120,7 @@ class PipelineBuilder:
                 fanout_qm, n, features=features, targets=targets,
                 batch=None, fe=None, pop_spec=pop_spec,
                 classifier_factory=self._seizure_classifier,
+                mesh=mesh,
             )
         elif "train_clf" in query_map and pop_spec.active:
             name = query_map["train_clf"]
@@ -1118,7 +1142,9 @@ class PipelineBuilder:
                 feature_sets=(
                     feature_sets if pop_spec.fe_configs else None
                 ),
+                mesh=mesh,
             )
+            self._note_population_mesh(block)
             if self.telemetry is not None:
                 self.telemetry.population = block
         elif "train_clf" in query_map:
@@ -1190,7 +1216,8 @@ class PipelineBuilder:
         return features, np.asarray(batch.targets, dtype=np.float64)
 
     def _execute_population(
-        self, query_map, name, pop_spec, features, targets, batch, fe
+        self, query_map, name, pop_spec, features, targets, batch, fe,
+        mesh=None,
     ) -> stats.PopulationStatistics:
         """``train_clf=<sgd-family>`` with population axes: the member
         set (folds x seeds x grid) trains through
@@ -1212,7 +1239,9 @@ class PipelineBuilder:
             targets,
             pop_spec,
             stage=self._stage,
+            mesh=mesh,
         )
+        self._note_population_mesh(block)
         if self.telemetry is not None:
             self.telemetry.population = block
         logger.info(
@@ -1225,7 +1254,7 @@ class PipelineBuilder:
 
     def _execute_fanout(
         self, query_map, n, features, targets, batch, fe, pop_spec=None,
-        classifier_factory=None,
+        classifier_factory=None, mesh=None,
     ) -> stats.FanOutStatistics:
         """``classifiers=a,b,c``: train + test every named classifier
         against the one feature matrix this run already produced.
@@ -1317,7 +1346,9 @@ class PipelineBuilder:
                         targets,
                         pop_spec,
                         stage=self._stage,
+                        mesh=mesh,
                     )
+                    self._note_population_mesh(block)
                     pop_blocks[name] = block
                     statistics[name] = leg_stats
                     obs.metrics.count("pipeline.fanout.classifiers")
@@ -1358,6 +1389,149 @@ class PipelineBuilder:
             raise ValueError(
                 f"query parameter {name}= must be an integer, "
                 f"got {value!r}"
+            )
+
+    # -- multi-device mesh resolution ----------------------------------
+
+    def _resolve_mesh(self, query_map):
+        """``devices=``/``mesh_axes=`` -> a ``jax.sharding.Mesh`` or
+        None (no mesh requested — today's single-device path, byte-
+        untouched).
+
+        Grammar: ``devices=N`` builds an N-device 1-D ``data`` mesh;
+        ``mesh_axes=<name>[,<name>...]`` names the axes, with
+        per-axis extents for multi-axis layouts
+        (``mesh_axes=data:2,time:4``). Grammar errors raise (a typo'd
+        axis silently training unmeshed is the worst outcome — the
+        sweep-parser rule); AVAILABILITY failures degrade: a mesh the
+        machine cannot build (more devices than present, unhealthy
+        backend) drops to the single-device rung with the evidence in
+        the degradation history, the run-report ``mesh`` block, and
+        ``pipeline.mesh_unavailable`` — the ladder's new top rung.
+        """
+        devices_param = self._int_param(query_map, "devices")
+        axes_value = query_map.get("mesh_axes", "")
+        if devices_param is None and not axes_value:
+            return None
+        if query_map.get("serve") == "true":
+            raise ValueError(
+                "devices=/mesh_axes= shard the batch pipeline; they "
+                "cannot combine with serve=true (the serving engine "
+                "is resident single-device)"
+            )
+        from ..parallel import mesh as pmesh
+
+        axes = []
+        sizes = []
+        if axes_value:
+            for part in axes_value.split(","):
+                name, sep, size = part.partition(":")
+                name = name.strip()
+                if not name:
+                    raise ValueError(
+                        f"mesh_axes= has an empty axis name in "
+                        f"{axes_value!r}"
+                    )
+                axes.append(name)
+                if sep:
+                    try:
+                        sizes.append(int(size))
+                    except ValueError:
+                        raise ValueError(
+                            f"mesh_axes= axis {name!r} has a "
+                            f"non-integer extent {size!r}"
+                        )
+            if len(set(axes)) != len(axes):
+                raise ValueError("mesh_axes= repeats an axis name")
+            if sizes and len(sizes) != len(axes):
+                raise ValueError(
+                    "mesh_axes= extents must be given for every axis "
+                    "or for none (e.g. mesh_axes=data:2,time:4)"
+                )
+            if len(axes) > 1 and not sizes:
+                raise ValueError(
+                    "multi-axis mesh_axes= needs explicit extents "
+                    "(e.g. mesh_axes=data:2,time:4)"
+                )
+        if not axes:
+            axes = [pmesh.DATA_AXIS]
+        if devices_param is not None and devices_param < 1:
+            raise ValueError("devices= must be >= 1")
+        product = int(np.prod(sizes)) if sizes else None
+        if (
+            product is not None
+            and devices_param is not None
+            and product != devices_param
+        ):
+            raise ValueError(
+                f"mesh_axes= extents cover {product} devices but "
+                f"devices={devices_param}; drop one or make them agree"
+            )
+        requested = {
+            "devices": devices_param or product,
+            "axes": list(axes),
+            "shape": list(sizes) or None,
+        }
+        self.mesh_resolved = {
+            "requested": requested,
+            "rung": "single_device",
+            "shape": None,
+        }
+        if self.telemetry is not None:
+            self.telemetry.mesh = self.mesh_resolved
+        try:
+            import jax
+
+            n = requested["devices"] or len(jax.devices())
+            mesh = pmesh.make_mesh(
+                n,
+                axes=tuple(axes),
+                shape=tuple(sizes) if sizes else None,
+            )
+        except Exception as e:
+            # the ladder's top rung: mesh unavailable -> single-device
+            evidence = f"{type(e).__name__}: {e}"
+            logger.warning(
+                "pipeline.mesh unavailable (requested %s): %s; "
+                "degrading to the single-device path",
+                requested, evidence,
+            )
+            obs.metrics.count("pipeline.mesh_unavailable")
+            events.event("pipeline.mesh_unavailable", error=evidence)
+            self.degradation_history.append(
+                {"from": "mesh", "error": evidence}
+            )
+            self.mesh_resolved["error"] = evidence
+            return None
+        self.mesh_resolved.update(
+            rung="mesh",
+            shape={k: int(v) for k, v in mesh.shape.items()},
+            devices=int(mesh.devices.size),
+        )
+        events.event(
+            "pipeline.mesh_built",
+            devices=int(mesh.devices.size),
+            axes=",".join(mesh.axis_names),
+        )
+        return mesh
+
+    def _note_population_mesh(self, block):
+        """Fold the population engine's mesh outcome (the rung it
+        actually trained on, per-device member counts, fallback
+        evidence) into the run-level mesh block, so run_report.json
+        and the bench line tell one story. An engine that degraded
+        mid-run (population.mesh_fallback) drops the run's recorded
+        rung to single_device with its evidence in the degradation
+        history — the same bookkeeping the fused-backend ladder keeps.
+        """
+        mesh_block = (block or {}).get("mesh")
+        if not mesh_block or self.mesh_resolved is None:
+            return
+        self.mesh_resolved["population"] = mesh_block
+        if mesh_block.get("rung") != "mesh" and "error" in mesh_block:
+            self.mesh_resolved["rung"] = "single_device"
+            self.degradation_history.append(
+                {"from": "mesh", "error": mesh_block["error"]}
             )
 
     # -- resilience plumbing -------------------------------------------

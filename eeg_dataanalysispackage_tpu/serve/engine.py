@@ -352,7 +352,17 @@ class ServingEngine:
             return
         if self._program is None:
             # host-extractor mode: pure numpy featurization — there
-            # is no XLA program to compile ahead of traffic
+            # is no XLA program to compile ahead of traffic. A bf16
+            # request still gets a RECORDED decision (the gate
+            # policy's "recorded, never silent"): the host extractor
+            # computes f64, exactly like the batch pipeline's host
+            # floor records used=host-f64.
+            if self._precision == "bf16":
+                self.precision_record = {
+                    "requested": "bf16",
+                    "used": "host-f64",
+                    "gate": None,
+                }
             self._warmed = True
             return
         if self._precision == "bf16":

@@ -302,3 +302,69 @@ def test_bank128_formulation_parity_interpret():
     )
     assert np.max(np.abs(slice_rows[:n] - bank_rows[:n])) < 5e-5
     assert np.all(bank_rows[n:] == 0.0)
+
+
+# ------------------------------------------------ accelerator decision
+
+
+def _write_artifact(root, rnd, name, payload):
+    import json
+    import os
+
+    d = os.path.join(str(root), rnd)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, name), "w") as f:
+        f.write(json.dumps(payload) + "\n")
+
+
+def test_accelerator_decision_without_chip_timing(tmp_path):
+    """No bank128 chip artifact -> block stands, with the absence as
+    the recorded reason (the PR 8 remainder: the default can only
+    flip on measured silicon)."""
+    decision = decode_ingest.accelerator_decision(root=str(tmp_path))
+    assert decision["backend"] == "block"
+    assert decision["bank128_eps"] is None
+    assert "no on-chip bank128 timing" in decision["reason"]
+
+
+def test_accelerator_decision_flips_on_chip_evidence(tmp_path):
+    """A measured bank128 timing >= the pre-registered 2x block
+    threshold flips the accelerator default to the decode rung; below
+    it, block stands — both with the evidence in the record."""
+    _write_artifact(
+        tmp_path, "r9", "bank128_131k.json",
+        {"variant": "pallas_ingest", "epochs_per_s": 3.0e6,
+         "platform": "tpu"},
+    )
+    decision = decode_ingest.accelerator_decision(root=str(tmp_path))
+    assert decision["backend"] == "decode"
+    assert decision["bank128_eps"] == 3.0e6
+    assert decision["source"].endswith("bank128_131k.json")
+    # sub-threshold: block stands
+    _write_artifact(
+        tmp_path, "r9", "bank128_131k.json",
+        {"variant": "pallas_ingest",
+         "epochs_per_s": decode_ingest.CHIP_BLOCK_EPS * 1.5,
+         "platform": "tpu"},
+    )
+    assert (
+        decode_ingest.accelerator_decision(root=str(tmp_path))["backend"]
+        == "block"
+    )
+
+
+def test_accelerator_decision_ignores_cpu_and_corrupt(tmp_path):
+    """cpu_fallback payloads and unparseable artifacts never decide
+    an accelerator default."""
+    _write_artifact(
+        tmp_path, "r9", "bank128_32k.json",
+        {"epochs_per_s": 9.9e6, "platform": "cpu"},
+    )
+    import os
+
+    d = os.path.join(str(tmp_path), "r9")
+    with open(os.path.join(d, "pallas_ingest.json"), "w") as f:
+        f.write("")  # the real r4 artifact: empty (helper crash)
+    decision = decode_ingest.accelerator_decision(root=str(tmp_path))
+    assert decision["backend"] == "block"
+    assert decision["bank128_eps"] is None

@@ -27,6 +27,35 @@ def test_mesh_construction(mesh8):
     assert mesh8.shape == {"data": 8}
 
 
+def test_mesh_construction_2d(mesh8):
+    mesh2d = pmesh.make_mesh(
+        8, axes=(pmesh.DATA_AXIS, pmesh.TIME_AXIS), shape=(2, 4)
+    )
+    assert mesh2d.shape == {"data": 2, "time": 4}
+    assert mesh2d.axis_names == (pmesh.DATA_AXIS, pmesh.TIME_AXIS)
+
+
+def test_mesh_rejects_too_many_devices():
+    with pytest.raises(ValueError, match="only .* present"):
+        pmesh.make_mesh(len(jax.devices()) + 1)
+
+
+def test_mesh_rejects_shape_device_mismatch(mesh8):
+    """A multi-axis shape whose product != the device count is a
+    clear error naming the arithmetic, not a bare reshape
+    ValueError."""
+    with pytest.raises(ValueError, match="multiply to the device count"):
+        pmesh.make_mesh(
+            8, axes=(pmesh.DATA_AXIS, pmesh.TIME_AXIS), shape=(3, 2)
+        )
+    with pytest.raises(ValueError, match="one extent per axis"):
+        pmesh.make_mesh(
+            8, axes=(pmesh.DATA_AXIS, pmesh.TIME_AXIS), shape=(8,)
+        )
+    with pytest.raises(ValueError, match="shape required"):
+        pmesh.make_mesh(8, axes=(pmesh.DATA_AXIS, pmesh.TIME_AXIS))
+
+
 def test_pad_to_multiple():
     x = np.ones((11, 3))
     padded, n = pmesh.pad_to_multiple(x, 8)

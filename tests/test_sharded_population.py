@@ -1,0 +1,339 @@
+"""Multi-device scale-out (ISSUE 9): the population member axis and
+the ingest batch sharded over a device mesh, end to end.
+
+Tier-1 exercises the REAL multi-device code on every run via the
+conftest-forced 8-device virtual CPU platform (the same
+``--xla_force_host_platform_device_count`` mechanism the MULTICHIP
+dryrun and the bench children use), plus one explicit subprocess pin
+that sets the flag itself. Contracts:
+
+- the sharded linear-population engine matches the vmapped
+  single-device engine member for member (weights to float32
+  roundoff; thresholded statistics byte-equal — the established
+  vmap==looped margin-band contract, extended to the mesh);
+- member padding is INERT: a member count that does not divide the
+  mesh pads with zero-mask members whose updates never fire, and the
+  padded rows never reach the caller;
+- pipeline-level ``devices=N`` produces ClassificationStatistics
+  byte-identical to the unmeshed run, with the mesh rung/shape/
+  per-device member counts in ``run_report.json``;
+- ``devices=1`` is the degenerate mesh — byte-identical to today's
+  path;
+- mesh-unavailable degrades to the single-device rung (the ladder's
+  new top rung), recorded, never fatal;
+- the mesh-sharded fused ingest produces the same targets and
+  rung-tolerance-identical features as the unsharded rung.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+import _synthetic
+from eeg_dataanalysispackage_tpu.models import sgd
+from eeg_dataanalysispackage_tpu.parallel import (
+    mesh as pmesh,
+    population as engines,
+)
+from eeg_dataanalysispackage_tpu.pipeline import builder
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 virtual devices")
+    return pmesh.make_mesh(8)
+
+
+def _session(directory, n_files=2, n_markers=60):
+    lines = []
+    for i in range(n_files):
+        name = f"synth_{i:02d}"
+        guessed = 2 + i
+        _synthetic.write_recording(
+            str(directory), name=name, n_markers=n_markers,
+            guessed=guessed, seed=i,
+        )
+        lines.append(f"{name}.eeg {guessed}")
+    info = os.path.join(str(directory), "info.txt")
+    with open(info, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return info
+
+
+@pytest.fixture(scope="module")
+def info(tmp_path_factory):
+    return _session(tmp_path_factory.mktemp("mesh_session"))
+
+
+_POP_QUERY = (
+    "train_clf=logreg&cv=2&sweep=lr:1.0,0.5&cache=false"
+    "&config_num_iterations=12&config_step_size=1.0"
+    "&config_mini_batch_fraction=1.0"
+)
+
+
+def _q(info, *parts):
+    return "&".join([f"info_file={info}", "fe=dwt-8-fused", *parts])
+
+
+def _toy(P, n=48, d=10, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, d).astype(np.float32)
+    y = (rng.rand(n) > 0.5).astype(np.float32)
+    steps = [1.0 + 0.05 * i for i in range(P)]
+    regs = [0.0, 0.01] * (P // 2) + [0.0] * (P % 2)
+    seeds = list(range(P))
+    return x, y, steps, regs, seeds
+
+
+# ------------------------------------------------ engine parity
+
+
+def test_sharded_engine_matches_vmapped_with_padding(mesh8):
+    """P=11 members over 8 devices: 5 inert padded members, real
+    members bit-for-bit the vmapped engine's trajectories (full-batch
+    is deterministic, so the weights agree exactly here)."""
+    x, y, steps, regs, seeds = _toy(11)
+    cfg = sgd.SGDConfig(num_iterations=8)
+    got = engines.train_linear_population_sharded(
+        x, y, cfg, steps, regs, seeds, masks=None, mesh=mesh8
+    )
+    want = engines.train_linear_population(
+        x, y, cfg, steps, regs, seeds, masks=None
+    )
+    assert got.shape == np.asarray(want).shape == (11, 10)
+    np.testing.assert_allclose(got, np.asarray(want), rtol=0, atol=5e-6)
+
+
+def test_sharded_engine_matches_vmapped_multi_fold_minibatch(mesh8):
+    """Fold masks + Bernoulli minibatch sampling: the mask formulation
+    (and therefore the per-member sample stream) matches the vmapped
+    engine member for member."""
+    x, y, steps, regs, seeds = _toy(6, n=40)
+    masks = (np.random.RandomState(3).rand(6, 40) > 0.3).astype(
+        np.float32
+    )
+    cfg = sgd.SGDConfig(num_iterations=6, mini_batch_fraction=0.7)
+    got = engines.train_linear_population_sharded(
+        x, y, cfg, steps, regs, seeds, masks=masks, mesh=mesh8
+    )
+    want = engines.train_linear_population(
+        x, y, cfg, steps, regs, seeds, masks=masks
+    )
+    np.testing.assert_allclose(got, np.asarray(want), rtol=0, atol=5e-6)
+
+
+def test_padded_member_masks_are_inert(mesh8):
+    """The padding seam itself: an all-zero sample mask freezes a
+    member at zero weights (``_run_sgd``'s empty-sample rule), which
+    is exactly what the engine pads with — so padding can never leak
+    signal, and the sliced result is unchanged by the pad width."""
+    x, y, steps, regs, seeds = _toy(3)
+    cfg = sgd.SGDConfig(num_iterations=5)
+    # engine-level: P=3 on an 8-way mesh pads 5 inert members
+    assert engines.pad_members(3, 8) == 8
+    got = engines.train_linear_population_sharded(
+        x, y, cfg, steps, regs, seeds, masks=None, mesh=mesh8
+    )
+    want = engines.train_linear_population(
+        x, y, cfg, steps, regs, seeds, masks=None
+    )
+    np.testing.assert_allclose(got, np.asarray(want), rtol=0, atol=5e-6)
+    # the mask semantics the pad relies on, pinned directly
+    import jax.numpy as jnp
+
+    w = sgd._run_sgd(
+        jnp.asarray(x), jnp.asarray(y), 1.0, 1.0, 0.0, 1, 0.001,
+        sample_mask=jnp.zeros_like(jnp.asarray(y)),
+        num_iterations=5, loss="logistic", full_batch=True,
+    )
+    assert float(np.abs(np.asarray(w)).sum()) == 0.0
+
+
+# ------------------------------------------------ pipeline-level
+
+
+def test_pipeline_devices8_statistics_byte_identical(info, tmp_path):
+    report_dir = tmp_path / "report"
+    unmeshed = builder.PipelineBuilder(_q(info, _POP_QUERY)).execute()
+    pb = builder.PipelineBuilder(
+        _q(info, _POP_QUERY, "devices=8", f"report={report_dir}")
+    )
+    meshed = pb.execute()
+    assert str(meshed) == str(unmeshed)
+    resolved = pb.mesh_resolved
+    assert resolved["rung"] == "mesh"
+    assert resolved["shape"] == {"data": 8}
+    pop_block = resolved["population"]
+    assert pop_block["rung"] == "mesh"
+    # cv=2 x 2 lr values = 4 members, padded to the 8-way mesh
+    assert pop_block["members_per_device"] == 1
+    assert pop_block["padded_members"] == 4
+    with open(report_dir / "run_report.json") as f:
+        report = json.load(f)
+    assert report["mesh"]["rung"] == "mesh"
+    assert report["mesh"]["shape"] == {"data": 8}
+    assert (
+        report["mesh"]["population"]["members_per_device"] == 1
+    )
+    assert report["population"]["mode"] == "sharded"
+
+
+def test_pipeline_devices1_degenerate_byte_identical(info):
+    unmeshed = builder.PipelineBuilder(_q(info, _POP_QUERY)).execute()
+    pb = builder.PipelineBuilder(_q(info, _POP_QUERY, "devices=1"))
+    meshed = pb.execute()
+    assert str(meshed) == str(unmeshed)
+    assert pb.mesh_resolved["rung"] == "mesh"
+    assert pb.mesh_resolved["shape"] == {"data": 1}
+
+
+def test_mesh_unavailable_degrades_to_single_device(info):
+    from eeg_dataanalysispackage_tpu import obs
+
+    unmeshed = builder.PipelineBuilder(_q(info, _POP_QUERY)).execute()
+    before = obs.metrics.snapshot()["counters"].get(
+        "pipeline.mesh_unavailable", 0.0
+    )
+    pb = builder.PipelineBuilder(_q(info, _POP_QUERY, "devices=64"))
+    statistics = pb.execute()
+    after = obs.metrics.snapshot()["counters"].get(
+        "pipeline.mesh_unavailable", 0.0
+    )
+    assert str(statistics) == str(unmeshed)  # the run survived, same result
+    assert pb.mesh_resolved["rung"] == "single_device"
+    assert "only" in pb.mesh_resolved["error"]
+    assert after == before + 1
+    assert {"from": "mesh"}.items() <= pb.degradation_history[0].items() \
+        or pb.degradation_history[0]["from"] == "mesh"
+
+
+def test_mesh_grammar_errors(info):
+    for bad in (
+        "devices=0",
+        "mesh_axes=data:x",
+        "mesh_axes=data,data",
+        "mesh_axes=data,time",  # multi-axis needs extents
+        "mesh_axes=data:2,time:2&devices=8",  # extents disagree
+        "devices=2&serve=true",
+    ):
+        with pytest.raises(ValueError):
+            builder.PipelineBuilder(_q(info, _POP_QUERY, bad)).execute()
+
+
+def test_mesh_axes_2d_layout(info):
+    """A 2-D data x time mesh: population shards over data, ingest
+    over time — statistics still byte-identical."""
+    if jax.device_count() < 4:
+        pytest.skip("needs 4 virtual devices")
+    unmeshed = builder.PipelineBuilder(_q(info, _POP_QUERY)).execute()
+    pb = builder.PipelineBuilder(
+        _q(info, _POP_QUERY, "mesh_axes=data:2,time:2")
+    )
+    meshed = pb.execute()
+    assert str(meshed) == str(unmeshed)
+    assert pb.mesh_resolved["rung"] == "mesh"
+    assert pb.mesh_resolved["shape"] == {"data": 2, "time": 2}
+    assert pb.mesh_resolved["population"]["axis"] == "data"
+
+
+# ------------------------------------------------ sharded ingest
+
+
+def test_fused_ingest_mesh_sharded_matches_unsharded(info, mesh8):
+    from eeg_dataanalysispackage_tpu import obs
+    from eeg_dataanalysispackage_tpu.io import provider
+
+    f0, t0 = provider.OfflineDataProvider([info]).load_features_device(
+        backend="decode"
+    )
+    before = obs.metrics.snapshot()["counters"]
+    f1, t1 = provider.OfflineDataProvider([info]).load_features_device(
+        backend="decode", mesh=mesh8
+    )
+    after = obs.metrics.snapshot()["counters"]
+    assert np.array_equal(t0, t1)
+    assert f1.shape == f0.shape
+    # rung-tolerance-identical features (the ladder's f32 contract)
+    assert float(np.max(np.abs(f0 - f1))) <= 1e-5
+    assert (
+        after.get("ingest.sharded_recordings", 0)
+        - before.get("ingest.sharded_recordings", 0)
+    ) == 2
+    assert (
+        after.get("ingest.sharded_fallback", 0)
+        - before.get("ingest.sharded_fallback", 0)
+    ) == 0
+
+
+# ------------------------------------------------ subprocess pin
+
+
+def test_forced_host_device_subprocess_parity(tmp_path):
+    """The forced-host-device harness itself: a fresh interpreter with
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=8`` set by THIS
+    test (not conftest) pins sharded-vs-single-device statistics byte
+    equality end to end, plus the padded-member mask semantics."""
+    script = tmp_path / "worker.py"
+    script.write_text(
+        """
+import json, os, sys
+sys.path.insert(0, {repo!r})
+sys.path.insert(0, os.path.join({repo!r}, "tests"))
+import numpy as np
+import _synthetic
+from eeg_dataanalysispackage_tpu.pipeline import builder
+
+tmp = {tmp!r}
+_synthetic.write_recording(tmp, name="w0", n_markers=60, guessed=3,
+                           seed=0)
+with open(os.path.join(tmp, "info.txt"), "w") as f:
+    f.write("w0.eeg 3\\n")
+q = ("info_file=" + os.path.join(tmp, "info.txt")
+     + "&fe=dwt-8-fused&train_clf=logreg&cv=2&sweep=lr:1.0,0.5"
+     + "&cache=false&config_num_iterations=10&config_step_size=1.0"
+     + "&config_mini_batch_fraction=1.0")
+unmeshed = builder.PipelineBuilder(q).execute()
+pb = builder.PipelineBuilder(q + "&devices=8")
+meshed = pb.execute()
+
+from eeg_dataanalysispackage_tpu.models import sgd
+from eeg_dataanalysispackage_tpu.parallel import population as engines
+import jax.numpy as jnp
+import jax
+x = np.random.RandomState(0).randn(32, 6).astype(np.float32)
+y = (np.random.RandomState(1).rand(32) > 0.5).astype(np.float32)
+w = sgd._run_sgd(jnp.asarray(x), jnp.asarray(y), 1.0, 1.0, 0.0, 1,
+                 0.001, sample_mask=jnp.zeros(32, jnp.float32),
+                 num_iterations=4, loss="logistic", full_batch=True)
+print(json.dumps({{
+    "device_count": jax.device_count(),
+    "identical": str(meshed) == str(unmeshed),
+    "rung": pb.mesh_resolved["rung"],
+    "shape": pb.mesh_resolved["shape"],
+    "zero_mask_weights_sum": float(np.abs(np.asarray(w)).sum()),
+}}))
+""".format(repo=os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), tmp=str(tmp_path))
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["EEG_TPU_NO_FEATURE_CACHE"] = "1"
+    proc = subprocess.run(
+        [sys.executable, str(script)],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["device_count"] == 8
+    assert out["identical"] is True
+    assert out["rung"] == "mesh"
+    assert out["shape"] == {"data": 8}
+    assert out["zero_mask_weights_sum"] == 0.0

@@ -31,6 +31,14 @@ run einsum_bf16_flat 600 python tools/ingest_bench.py einsum_bf16_flat 262144 50
 run einsum_bf16_131k 600 python tools/ingest_bench.py einsum_bf16 131072 50
 run einsum_bf16_524k 600 python tools/ingest_bench.py einsum_bf16 524288 50
 run train_step    600 python tools/ingest_bench.py train_step 131072 20
+# multi-device scale-out rows (ROADMAP item 2): the time-sharded
+# ingest's mesh block (collective-permute count + single-device twin
+# ratio) and the member-axis sharded population vs its vmapped twin.
+# On a 1-chip terminal both honestly record the degenerate mesh; on a
+# pod slice they are the 1/N-wall-time evidence.
+run sharded_ingest 900 python tools/ingest_bench.py sharded_ingest 32768 10
+run population_sharded 900 python tools/pipeline_bench.py population_sharded 800 2
+run population_vmap_twin 900 python tools/pipeline_bench.py population_vmap 800 2
 # outer timeout must exceed bench.py's worst case (probe 420 +
 # variant budget 1800 + one variant overrun 420 = 2640 < 3600) so the
 # caller never SIGTERMs bench mid-variant; 1800 gives all 8 variants

@@ -29,6 +29,14 @@ performance contract holds:
   train stage is FASTER than the looped twin's, the two runs'
   ClassificationStatistics are byte-identical (report_sha256
   equality — per-member parity), and both trained all 16 members;
+- the mesh gate (population_sharded, tools/pipeline_bench.py): the
+  devices=1 degenerate-mesh run is report_sha256-IDENTICAL to the
+  unmeshed vmapped run (the single-device mesh is byte-for-byte
+  today's path), the forced-8-device CPU run is statistics-identical
+  too with the mesh block present (rung=mesh, shape data:8,
+  per-device member counts) in both the bench line and its
+  run_report.json, and tools/obs_report.py renders + diffs the mesh
+  block from the artifacts;
 - every timed run wrote a well-formed ``run_report.json``
   (obs/report.py schema): nonzero stage spans for ingest/train/test,
   a span summary that actually recorded the stage spans, and
@@ -251,6 +259,76 @@ def _check_plateau(cold: dict, failures: list) -> dict:
 _REQUIRED_STAGES = ("ingest", "train", "test")
 
 
+def _check_mesh(sharded: dict, sharded1: dict, vmap_line: dict,
+                sharded_report_dir: str, vmap_report_dir: str,
+                failures: list) -> None:
+    """The multi-device mesh gate: devices=1 report_sha256-identical
+    to the unmeshed run, the forced-8-device run statistics-identical
+    with the mesh block present (bench line AND run report), and
+    tools/obs_report.py rendering/diffing the block."""
+    if sharded1["report_sha256"] != vmap_line["report_sha256"]:
+        failures.append(
+            "mesh: devices=1 degenerate run drifted from the unmeshed "
+            f"run: {sharded1['report_sha256']} vs "
+            f"{vmap_line['report_sha256']}"
+        )
+    if sharded["report_sha256"] != vmap_line["report_sha256"]:
+        failures.append(
+            "mesh: 8-device sharded statistics drifted from the "
+            f"single-device run: {sharded['report_sha256']} vs "
+            f"{vmap_line['report_sha256']}"
+        )
+    mesh = sharded.get("mesh") or {}
+    pop_mesh = mesh.get("population") or {}
+    if mesh.get("rung") != "mesh" or mesh.get("shape") != {"data": 8}:
+        failures.append(
+            f"mesh: 8-device line did not land on the mesh rung: {mesh}"
+        )
+    if pop_mesh.get("rung") != "mesh" or not pop_mesh.get(
+        "members_per_device"
+    ):
+        failures.append(
+            f"mesh: per-device member counts missing from the line: "
+            f"{pop_mesh}"
+        )
+    report_path = os.path.join(sharded_report_dir, "run_report.json")
+    try:
+        with open(report_path) as f:
+            report = json.load(f)
+    except (OSError, ValueError) as e:
+        failures.append(f"mesh: no readable run_report.json: {e}")
+        return
+    if (report.get("mesh") or {}).get("rung") != "mesh":
+        failures.append(
+            f"mesh: run_report.json mesh block missing/degraded: "
+            f"{report.get('mesh')}"
+        )
+    # the artifacts must be renderable + diffable with the mesh block
+    # visible (tools/obs_report.py is the operator's lens)
+    obs_report = os.path.join(_REPO, "tools", "obs_report.py")
+    show = subprocess.run(
+        [sys.executable, obs_report, "show", report_path],
+        capture_output=True, text=True,
+    )
+    if show.returncode != 0 or "mesh" not in show.stdout:
+        failures.append(
+            f"mesh: obs_report.py show did not render the mesh block "
+            f"(rc={show.returncode})"
+        )
+    diff = subprocess.run(
+        [
+            sys.executable, obs_report, "diff", report_path,
+            os.path.join(vmap_report_dir, "run_report.json"),
+        ],
+        capture_output=True, text=True,
+    )
+    if diff.returncode != 0 or "mesh" not in diff.stdout:
+        failures.append(
+            f"mesh: obs_report.py diff did not surface the mesh drift "
+            f"(rc={diff.returncode})"
+        )
+
+
 def _check_seizure(line: dict, report_dir: str,
                    failures: list) -> None:
     """The seizure-workload gate: an imbalanced synthetic set, the
@@ -375,7 +453,8 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
         data_dir = os.path.join(tmp, "data")
         report_dirs = {
             v: os.path.join(tmp, f"report_{v}")
-            for v in ("cold", "warm", "fanout", "pop_vmap", "pop_looped")
+            for v in ("cold", "warm", "fanout", "pop_vmap", "pop_looped",
+                      "pop_sharded", "pop_sharded1")
         }
         cold = _run_variant(
             "pipeline_e2e_cold", n_markers, n_files,
@@ -448,6 +527,18 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
             data_dir, os.path.join(tmp, "cache_pop"),
             report_dirs["pop_looped"],
         )
+        # the mesh gate: the same member set over a forced-8-device
+        # CPU mesh, and the devices=1 degenerate mesh
+        pop_sharded = _run_variant(
+            "population_sharded", n_markers, n_files,
+            data_dir, os.path.join(tmp, "cache_pop"),
+            report_dirs["pop_sharded"],
+        )
+        pop_sharded1 = _run_variant(
+            "population_sharded", n_markers, n_files,
+            data_dir, os.path.join(tmp, "cache_pop"),
+            report_dirs["pop_sharded1"], extra=["--devices=1"],
+        )
         serve_report_dir = os.path.join(tmp, "report_serve")
         serve_line = _run_serve_bench(
             min(n_markers, 400), n_files, serve_report_dir
@@ -482,6 +573,15 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
         _check_report(
             "pop_looped", pop_looped, report_dirs["pop_looped"],
             failures, reports_checked,
+        )
+        _check_report(
+            "pop_sharded", pop_sharded, report_dirs["pop_sharded"],
+            failures, reports_checked,
+        )
+        _check_mesh(
+            pop_sharded, pop_sharded1, pop_vmap,
+            report_dirs["pop_sharded"], report_dirs["pop_vmap"],
+            failures,
         )
 
     if not warm["wall_s"] < cold["wall_s"]:
@@ -637,6 +737,20 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
         "population_train_speedup": (
             round(pl_train / pv_train, 2) if pv_train > 0 else None
         ),
+        "mesh_devices1_identical": (
+            pop_sharded1["report_sha256"] == pop_vmap["report_sha256"]
+        ),
+        "mesh_sharded_identical": (
+            pop_sharded["report_sha256"] == pop_vmap["report_sha256"]
+        ),
+        "mesh_rung": (pop_sharded.get("mesh") or {}).get("rung"),
+        "mesh_members_per_device": (
+            (pop_sharded.get("mesh") or {}).get("population") or {}
+        ).get("members_per_device"),
+        "population_sharded_members_per_s": pop_sharded.get(
+            "members_per_s"
+        ),
+        "population_vmap_members_per_s": pop_vmap.get("members_per_s"),
         "compilations_singles": single_compiles,
         "compilations_singles_sum": c_singles_sum,
         "compilations_fanout5": c_fanout,

@@ -135,6 +135,25 @@ def show(path: str) -> None:
         )
     if data.get("overlap") is not None:
         print(f"  overlap  {data.get('overlap')}")
+    mesh = data.get("mesh")
+    if mesh:
+        req = mesh.get("requested") or {}
+        line = (
+            f"  mesh     rung={mesh.get('rung')} "
+            f"shape={mesh.get('shape')} "
+            f"requested={req.get('devices')} "
+            f"axes={','.join(req.get('axes') or [])}"
+        )
+        if mesh.get("error"):
+            line += f"  error={mesh['error']}"
+        print(line)
+        pop_mesh = mesh.get("population") or {}
+        if pop_mesh:
+            print(
+                f"           population rung={pop_mesh.get('rung')} "
+                f"members/device={pop_mesh.get('members_per_device')} "
+                f"padded={pop_mesh.get('padded_members')}"
+            )
     if crash:
         err = data.get("error", {})
         print(f"\nerror: {err.get('type')}: {err.get('message')}")
@@ -271,6 +290,21 @@ def diff(path_a: str, path_b: str) -> None:
     ba, bb = a.get("backend") or {}, b.get("backend") or {}
     if ba != bb:
         print(f"backend: A {ba}  B {bb}")
+
+    def _mesh_digest(report):
+        mesh = report.get("mesh")
+        if not mesh:
+            return None
+        pop = mesh.get("population") or {}
+        return {
+            "rung": mesh.get("rung"),
+            "shape": mesh.get("shape"),
+            "members_per_device": pop.get("members_per_device"),
+        }
+
+    ma, mb = _mesh_digest(a), _mesh_digest(b)
+    if (ma or mb) and ma != mb:
+        print(f"mesh (rung, shape, members/device): A {ma}  B {mb}")
 
     def _pop_digest(report):
         pop = report.get("population")
