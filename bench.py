@@ -179,7 +179,7 @@ _VARIANT_TIMEOUTS = {
 # patience — on a warm compile cache everything fits easily; on a
 # cold cache the tail variants may be budget-skipped (recorded as
 # such, artifact intact). BENCH_TOTAL_BUDGET overrides.
-_N_VARIANTS = 23  # asserted against the variant tables below
+_N_VARIANTS = 24  # asserted against the variant tables below
 _TOTAL_BUDGET_S = int(
     os.environ.get(
         "BENCH_TOTAL_BUDGET",
@@ -260,6 +260,11 @@ _VARIANTS_TPU = {
     # online inference service (markers per file, file count):
     # latency/throughput sweep + parity pin + chaos soak
     "serve_bench": (2000, 2),
+    # the multi-tenant plan executor (markers per file, file count —
+    # tools/pipeline_bench.py scheduler_multi): 4 plans sequential vs
+    # concurrent over shared caches, per-plan isolated attribution,
+    # the single-flight store pin, and the kill-and-resume scenario
+    "scheduler_multi": (2000, 4),
 }
 _VARIANTS_CPU = {
     "einsum": (8192, 5),
@@ -285,6 +290,7 @@ _VARIANTS_CPU = {
     "sharded_ingest": (2048, 2),
     "seizure_e2e": (60000, 2),
     "serve_bench": (400, 2),
+    "scheduler_multi": (2000, 4),
 }
 assert len(_VARIANTS_TPU) == len(_VARIANTS_CPU) == _N_VARIANTS
 
@@ -428,7 +434,9 @@ def _run_variant(variant: str, platform: str, n: int, iters: int) -> dict:
     # file-count); serve_bench drives the resident inference service
     # (tools/serve_bench.py, same n/iters meaning); everything else
     # is a kernel variant through tools/ingest_bench.py
-    if variant.startswith(("pipeline_e2e", "population_", "seizure_")):
+    if variant.startswith(
+        ("pipeline_e2e", "population_", "seizure_", "scheduler_")
+    ):
         script = "pipeline_bench.py"
     elif variant.startswith("serve_"):
         script = "serve_bench.py"
@@ -627,6 +635,10 @@ def _collect(platform: str) -> dict:
                 # (rung, shape, per-device member counts, the
                 # sharded_ingest twin ratio) and the member-axis rate
                 "mesh", "members_per_s",
+                # the multi-tenant executor line: sequential-vs-
+                # concurrent walls, per-plan cache attribution, the
+                # single-flight and crash-recovery pins
+                "scheduler",
             ):
                 if extra_field in r:
                     variants[name][extra_field] = r[extra_field]

@@ -177,14 +177,47 @@ class CircuitBreaker:
                     prior_failures=self._total_failures,
                 )
 
+    def snapshot(self) -> Dict[str, object]:
+        """The breaker's state as report evidence: state, failure
+        counts, the recent (plan-tagged) evidence strings, and the
+        contributing plan ids — what a run/crash report embeds so a
+        tenant fast-failed by a breaker some OTHER plan opened can see
+        whose requests opened it (docs/resilience.md)."""
+        with self._lock:
+            contributors = sorted({
+                e.split("]", 1)[0][6:]
+                for e in self._evidence
+                if e.startswith("[plan ")
+            })
+            return {
+                "state": self._state,
+                "consecutive_failures": self._consecutive_failures,
+                "total_failures": self._total_failures,
+                "evidence": list(self._evidence),
+                "contributing_plans": contributors,
+            }
+
     def record_failure(self, error: Exception) -> None:
-        """One exhausted retry budget against the endpoint."""
+        """One exhausted retry budget against the endpoint.
+
+        Breakers are process-global per endpoint authority BY DESIGN:
+        under the multi-tenant executor, plan B fast-fails on an
+        endpoint plan A's exhausted budgets opened — shared failure
+        evidence is the intended cross-tenant protection (one dead
+        gateway must not charge every tenant the full backoff ladder).
+        Each evidence entry is therefore tagged with the plan that
+        contributed it, so both plans' reports can name the opener.
+        """
         if self.threshold <= 0:
             return
+        from ..obs import domain as run_domain
+
+        plan_id = run_domain.current_plan_id()
+        tag = "" if plan_id is None else f"[plan {plan_id}] "
         with self._lock:
             self._consecutive_failures += 1
             self._total_failures += 1
-            self._evidence.append(f"{type(error).__name__}: {error}")
+            self._evidence.append(f"{tag}{type(error).__name__}: {error}")
             half_open_probe_failed = self._state == HALF_OPEN
             self._probe_in_flight = False
             if (
@@ -248,6 +281,19 @@ def breaker_for(endpoint: str) -> CircuitBreaker:
             )
             _REGISTRY[endpoint] = breaker
         return breaker
+
+
+def snapshot() -> Dict[str, Dict[str, object]]:
+    """Every registered breaker's :meth:`CircuitBreaker.snapshot`,
+    keyed by endpoint — the ``circuit`` block obs/report.py embeds in
+    run and crash reports ({} when no remote endpoint was ever
+    dialed, schema-stable)."""
+    with _REGISTRY_LOCK:
+        breakers = dict(_REGISTRY)
+    return {
+        endpoint: breaker.snapshot()
+        for endpoint, breaker in breakers.items()
+    }
 
 
 def reset() -> None:

@@ -74,6 +74,42 @@ _hits = 0
 _misses = 0
 _corrupt = 0
 
+# -- single-flight rebuild guard ----------------------------------------
+# Two pipeline runs (two plans under the multi-tenant executor, or two
+# threads in one process) that MISS the same entry would both pay the
+# full ingest+featurize rebuild and then race the atomic rename — the
+# loser's identical bytes win the os.replace, but an entire rebuild
+# was wasted. The guard serializes rebuilds per (directory, key): the
+# first builder through proceeds; concurrent builders of the SAME key
+# block until it finishes, then revalidate (lookup again) and hit the
+# entry the leader stored. Process-local by design — cross-process
+# racers still converge through the atomic rename, same as before.
+_flight_cond = threading.Condition(_lock)
+_flights: set = set()
+
+
+class BuildSlot:
+    """The single-flight token for one entry rebuild. ``waited`` is
+    True when another builder held the key while we arrived — the
+    signal to revalidate before rebuilding. Release exactly once, in
+    a ``finally``: a leader that died without releasing would block
+    every waiter forever."""
+
+    __slots__ = ("_token", "waited", "_released")
+
+    def __init__(self, token, waited: bool):
+        self._token = token
+        self.waited = waited
+        self._released = False
+
+    def release(self) -> None:
+        if self._released:
+            return
+        self._released = True
+        with _flight_cond:
+            _flights.discard(self._token)
+            _flight_cond.notify_all()
+
 
 def default_cache_dir() -> str:
     """Per-user scratch default (XDG-style), sibling of the persistent
@@ -190,6 +226,59 @@ class FeatureCache:
             return None
         _count("hit")
         return features, targets
+
+    def begin_build(self, key: str) -> BuildSlot:
+        """Enter the single-flight section for ``key``'s rebuild:
+        returns immediately for the first builder, blocks while
+        another in-process builder holds the key. When the returned
+        slot says ``waited``, the caller should revalidate with
+        :meth:`lookup` before rebuilding — the leader almost certainly
+        stored the entry (counted as ``feature_cache.single_flight_wait``).
+        Pair with ``slot.release()`` in a ``finally``.
+
+        The wait honours the ambient :mod:`~.deadline` scope: a
+        deadline-bearing plan queued behind another tenant's long
+        rebuild fails fast with :class:`~.deadline.DeadlineExceededError`
+        instead of blocking past its budget (the wait re-checks in
+        short slices — the scheduler's deadline contract would
+        otherwise stop at attempt boundaries)."""
+        from .. import obs
+        from . import deadline as deadline_mod
+
+        token = (self.directory, key)
+        waited = False
+        with _flight_cond:
+            while token in _flights:
+                waited = True
+                ambient = deadline_mod.active_deadline()
+                if ambient is None:
+                    _flight_cond.wait()
+                else:
+                    ambient.raise_if_expired(
+                        f"single-flight wait for feature cache "
+                        f"entry {key}"
+                    )
+                    _flight_cond.wait(
+                        timeout=min(0.1, ambient.remaining())
+                    )
+            _flights.add(token)
+        if waited:
+            obs.metrics.count("feature_cache.single_flight_wait")
+        return BuildSlot(token, waited)
+
+    def try_begin_build(self, key: str) -> Optional[BuildSlot]:
+        """Non-blocking :meth:`begin_build`: the slot, or None when
+        another in-process builder holds the key. For store-only
+        callers whose features are already computed — waiting would
+        buy nothing (the holder is building this same
+        content-addressed entry), and a deadline-bearing plan must
+        not die queued behind a store it can simply skip."""
+        token = (self.directory, key)
+        with _flight_cond:
+            if token in _flights:
+                return None
+            _flights.add(token)
+        return BuildSlot(token, False)
 
     def store(self, key: str, features: np.ndarray,
               targets: np.ndarray) -> Optional[str]:

@@ -312,11 +312,21 @@ class OfflineDataProvider:
 
         obs.metrics.gauge("ingest.parallel_workers", workers)
 
+        # pool threads adopt the consumer's per-plan fault domain so
+        # their reads/spans/metrics (and any remote.request chaos
+        # firing inside a pooled fetch) attribute to the right plan
+        # under the multi-tenant executor
+        from ..obs import domain as run_domain
+
+        domain = run_domain.capture()
+
         def _parse_one(path: str, rel: str):
             # runs on a pool thread: the span's parent falls back to
             # the recorder's run root (per-thread stacks keep the
             # consumer's span nesting uncorrupted)
-            with events.span("ingest.parse", file=rel, pooled=True):
+            with run_domain.adopt(domain), events.span(
+                "ingest.parse", file=rel, pooled=True
+            ):
                 return self._read_recording(path, digest=with_digests)
 
         depth = workers + self._prefetch_depth

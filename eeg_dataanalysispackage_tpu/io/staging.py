@@ -30,7 +30,7 @@ import numpy as np
 
 from . import ENV_PREFETCH_DEPTH  # noqa: F401  (re-export: the knob's name)
 from . import default_prefetch_depth
-from ..obs import chaos, events
+from ..obs import chaos, domain as run_domain, events
 from ..parallel import mesh as pmesh
 
 logger = logging.getLogger(__name__)
@@ -152,6 +152,12 @@ def prefetch(
                 continue
         return False
 
+    # the spawner's per-plan fault domain, adopted by the producer
+    # thread: the staging.producer chaos point and every span/metric
+    # the producer records stay inside the RIGHT plan when a
+    # multi-tenant executor runs several plans at once
+    domain = run_domain.capture()
+
     def producer() -> None:
         staged_n = 0
         # telemetry: the producer thread's lifetime is one span
@@ -159,7 +165,9 @@ def prefetch(
         # lands as an attribute, and the error event is emitted
         # INSIDE the span so the flight recorder attributes the
         # failure to staging.producer, not the run root
-        with events.span("staging.producer") as _span_rec:
+        with run_domain.adopt(domain), events.span(
+            "staging.producer"
+        ) as _span_rec:
             try:
                 for batch in batches:
                     if stop.is_set():

@@ -30,6 +30,8 @@ import time
 from collections import defaultdict
 from typing import Dict, Iterator, Optional
 
+from . import domain as _domain
+
 
 class StageTimer:
     """Accumulates wall time per named stage; reentrant-safe per name.
@@ -122,6 +124,12 @@ class Metrics:
         self._gauges: Dict[str, float] = {}
         self._scopes: list = []
         self._lock = threading.Lock()
+        #: set on the process-wide default sink only: counts recorded
+        #: there additionally mirror into the active RunDomain's
+        #: per-plan child (obs/domain.py), so two concurrent plans'
+        #: counters never cross — the scope() fan-out alone cannot
+        #: tell the plans apart (it receives EVERY thread's counts)
+        self._route_domains = False
 
     def count(self, name: str, value: float = 1.0) -> None:
         with self._lock:
@@ -129,6 +137,10 @@ class Metrics:
             scopes = list(self._scopes)
         for scope in scopes:
             scope.count(name, value)
+        if self._route_domains:
+            d = _domain.current()
+            if d is not None and d.metrics is not None:
+                d.metrics.count(name, value)
 
     def gauge(self, name: str, value: float) -> None:
         with self._lock:
@@ -136,6 +148,10 @@ class Metrics:
             scopes = list(self._scopes)
         for scope in scopes:
             scope.gauge(name, value)
+        if self._route_domains:
+            d = _domain.current()
+            if d is not None and d.metrics is not None:
+                d.metrics.gauge(name, value)
 
     def snapshot(self) -> Dict[str, Dict[str, float]]:
         with self._lock:
@@ -171,6 +187,10 @@ class Metrics:
 
 #: process-wide default registry (modules may also build their own)
 metrics = Metrics()
+# only the default sink routes into per-plan domains: a domain's own
+# child registry (or any other private Metrics) must not re-route,
+# which would double-count
+metrics._route_domains = True
 
 
 @contextlib.contextmanager

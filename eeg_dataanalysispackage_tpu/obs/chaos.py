@@ -51,7 +51,23 @@ Known points (the contract between specs and the codebase):
 ``serve.batch``     one micro-batch execution of the serving
                     program (serve/batcher.py) — exercises the
                     deadline-aware batch retry path
+``scheduler.plan``  one execution attempt of a submitted plan inside
+                    the multi-tenant executor (scheduler/runtime.py) —
+                    the executor's per-plan retry budget absorbs it
+``scheduler.journal``  one write-ahead journal write
+                    (scheduler/journal.py) — the journal retries once,
+                    then degrades to unjournaled (counted) rather than
+                    failing the plan it records
 ==================  ====================================================
+
+Fault domains: a plan executed by the multi-tenant scheduler carries
+its ``faults=`` spec in its own :class:`obs.domain.RunDomain`, so
+:func:`active_plan` (and therefore every injection point) resolves the
+*calling thread's plan's* fault plan first and falls back to the
+process-global installation only outside any domain — plan A's chaos
+cannot fire inside plan B (tests/test_scheduler.py). Worker threads a
+plan spawns adopt its domain (io/staging, io/provider, serve/batcher),
+so injection points on those threads stay inside the right domain.
 """
 
 from __future__ import annotations
@@ -63,6 +79,8 @@ import re
 import threading
 from contextlib import contextmanager
 from typing import Dict, Iterator, Optional
+
+from . import domain as _domain
 
 logger = logging.getLogger(__name__)
 
@@ -191,6 +209,16 @@ _PLAN: Optional[FaultPlan] = None
 
 
 def active_plan() -> Optional[FaultPlan]:
+    """The fault plan governing the CALLING thread: its run domain's
+    plan when the thread executes (or adopted) a scheduled plan that
+    carries one, else the process-global installation. A domain
+    without a chaos plan of its own does not shield the global — a
+    test installing ``chaos.faults(...)`` around a plain pipeline run
+    keeps injecting exactly as before. Chaos-off cost is one
+    thread-local read plus the global check."""
+    d = _domain.current()
+    if d is not None and d.chaos is not None:
+        return d.chaos
     return _PLAN
 
 
@@ -231,13 +259,14 @@ def plan_from_env() -> Optional[str]:
 
 def maybe_fire(point: str, exc_type: type = ChaosInjectedError) -> None:
     """The injection-point call. No plan installed -> immediate return
-    (one global read — the zero-overhead contract). When the plan's
+    (one thread-local read + the global check — the cheap-when-off
+    contract). When the plan's
     rule for ``point`` fires, the firing is counted in ``obs.metrics``
     (``chaos.fired.<point>``) and ``exc_type`` is raised — sites pass
     the exception class their retry/degradation machinery already
     handles (e.g. ``RemoteIOError`` for ``remote.request``).
     """
-    plan = _PLAN
+    plan = active_plan()
     if plan is None:
         return
     if plan.should_fire(point):

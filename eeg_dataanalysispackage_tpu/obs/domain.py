@@ -1,0 +1,121 @@
+"""Per-plan fault domains: the ambient execution context of ONE plan.
+
+The observability/chaos layers were built process-global — one active
+chaos plan (obs/chaos.py), one active span recorder (obs/events.py),
+and run-scoped metrics implemented as a global fan-out
+(obs.Metrics.scope) — which is exactly right for the reference's shape
+(one query, one process, PipelineBuilder.java:94-295) and exactly
+wrong for a resident executor running N plans concurrently: plan A's
+``faults=`` spec would fire inside plan B, A's chaos firings would
+count into B's per-run metrics, and both runs' spans would interleave
+in one trace.
+
+A :class:`RunDomain` is the fix: one small record carrying everything
+that must be *per plan* —
+
+- ``plan_id``   — the scheduler's identity for the plan (tags circuit
+  -breaker evidence, run reports, logs);
+- ``chaos``     — the plan's own parsed ``FaultPlan`` (or None);
+- ``recorder``  — the plan's own ``SpanRecorder`` (or None);
+- ``metrics``   — the plan's own ``obs.Metrics`` child (or None);
+
+installed on the executing thread with :func:`activate` and *adopted*
+by every worker thread a plan spawns (the staging producer, the ingest
+parse pool, the serving batcher/watchdog) via :func:`capture` +
+:func:`adopt`. Resolution in chaos/events/metrics is domain-first with
+the process-global singleton as the fallback, so every pre-domain call
+site — tests installing a global plan around a run, a bare recorder —
+behaves byte-identically; the domain only *adds* isolation when a plan
+carries its own state.
+
+This module deliberately imports nothing from the rest of the package
+(thread-local plumbing only), so chaos/events/metrics can all consult
+it without import cycles.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Iterator, Optional
+
+
+class RunDomain:
+    """The ambient per-plan context; immutable after construction in
+    spirit (the executor builds one per plan execution)."""
+
+    __slots__ = ("plan_id", "chaos", "recorder", "metrics")
+
+    def __init__(
+        self,
+        plan_id: Optional[str] = None,
+        chaos: Optional[Any] = None,
+        recorder: Optional[Any] = None,
+        metrics: Optional[Any] = None,
+    ):
+        self.plan_id = plan_id
+        self.chaos = chaos
+        self.recorder = recorder
+        self.metrics = metrics
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (
+            f"RunDomain(plan_id={self.plan_id!r}, "
+            f"chaos={'on' if self.chaos is not None else 'off'}, "
+            f"recorder={'on' if self.recorder is not None else 'off'}, "
+            f"metrics={'on' if self.metrics is not None else 'off'})"
+        )
+
+
+_TLS = threading.local()
+
+
+def current() -> Optional[RunDomain]:
+    """The calling thread's innermost active domain, or None."""
+    stack = getattr(_TLS, "stack", None)
+    if not stack:
+        return None
+    return stack[-1]
+
+
+def current_plan_id() -> Optional[str]:
+    """The active domain's plan id, or None — the tag circuit-breaker
+    evidence and log lines use to attribute a failure to its tenant."""
+    d = current()
+    return None if d is None else d.plan_id
+
+
+@contextlib.contextmanager
+def activate(domain: Optional[RunDomain]) -> Iterator[Optional[RunDomain]]:
+    """Install ``domain`` as the calling thread's ambient context for
+    the block; nests (the innermost domain wins). ``None`` is a no-op
+    so call sites can thread an optional domain without branching —
+    which is also what lets worker threads *adopt* a captured domain
+    unconditionally (:func:`capture` returns None outside any domain).
+    """
+    if domain is None:
+        yield None
+        return
+    stack = getattr(_TLS, "stack", None)
+    if stack is None:
+        stack = []
+        _TLS.stack = stack
+    stack.append(domain)
+    try:
+        yield domain
+    finally:
+        stack.pop()
+
+
+def capture() -> Optional[RunDomain]:
+    """The domain a to-be-spawned worker thread should adopt: the
+    spawner's current domain (None outside any plan). Call on the
+    PARENT thread, hand the result to the child, and wrap the child's
+    body in :func:`adopt`."""
+    return current()
+
+
+#: adoption is installation — a separate name only so thread bodies
+#: read as what they are ("adopt the spawner's domain"), and so a
+#: future divergence (e.g. read-only adoption) has a seam
+adopt = activate

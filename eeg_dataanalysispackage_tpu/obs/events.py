@@ -46,6 +46,8 @@ import threading
 import time
 from typing import Any, Dict, Iterator, List, Optional
 
+from . import domain as _domain
+
 #: finished spans kept in memory per recorder; beyond this, spans are
 #: still counted (and written to the JSONL sink) but not retained
 DEFAULT_MAX_SPANS = 10_000
@@ -286,6 +288,19 @@ _RECORDER: Optional[SpanRecorder] = None
 
 
 def active_recorder() -> Optional[SpanRecorder]:
+    """The recorder observing the CALLING thread: its run domain's
+    recorder when the thread executes (or adopted) a scheduled plan
+    with telemetry, else the process-global installation — so two
+    concurrent plans' spans land in two traces, while the single-run
+    ``recording(...)`` path behaves exactly as before.
+
+    Telemetry-off cost is one thread-local read plus the global
+    check (was: one global read before fault domains existed) —
+    still O(1) and allocation-free, the contract hot-path
+    instrumentation relies on."""
+    d = _domain.current()
+    if d is not None and d.recorder is not None:
+        return d.recorder
     return _RECORDER
 
 
@@ -317,9 +332,10 @@ def recording(recorder: SpanRecorder) -> Iterator[SpanRecorder]:
 @contextlib.contextmanager
 def span(name: str, **attrs: Any) -> Iterator[Optional[Dict[str, Any]]]:
     """Module-level span entry point; yields the live span record (or
-    None when telemetry is off — a single global read and an empty
-    context, the zero-overhead contract instrumented code relies on)."""
-    rec = _RECORDER
+    None when telemetry is off — a thread-local read plus a global
+    check and an empty context, the cheap-when-off contract
+    instrumented code relies on)."""
+    rec = active_recorder()
     if rec is None:
         yield None
         return
@@ -329,7 +345,7 @@ def span(name: str, **attrs: Any) -> Iterator[Optional[Dict[str, Any]]]:
 
 def event(name: str, **attrs: Any) -> None:
     """Module-level event entry point; no-op without a recorder."""
-    rec = _RECORDER
+    rec = active_recorder()
     if rec is not None:
         rec.event(name, **attrs)
 
@@ -337,6 +353,6 @@ def event(name: str, **attrs: Any) -> None:
 def set_attr(name: str, value: Any) -> None:
     """Attach an attribute to the current span; no-op without a
     recorder."""
-    rec = _RECORDER
+    rec = active_recorder()
     if rec is not None:
         rec.set_attr(name, value)

@@ -30,6 +30,7 @@ import threading
 import traceback
 from typing import Any, Dict, List, Optional
 
+from . import domain as _domain
 from . import events
 
 logger = logging.getLogger(__name__)
@@ -99,8 +100,16 @@ def _on_duration(event_name: str, duration: float, **_kwargs) -> None:
         return
     with _monitor_lock:
         monitors = list(_active_monitors)
+    # per-plan attribution: XLA compiles fire on the dispatching
+    # thread, which under the multi-tenant executor carries its
+    # plan's fault domain — a monitor owned by plan A must not count
+    # plan B's compiles into A's run report. Ownerless monitors
+    # (solo runs, direct construction in tests) keep the pre-domain
+    # fan-out: every event, byte-identically.
+    pid = _domain.current_plan_id()
     for m in monitors:
-        m._record(event_name, duration)
+        if m.owner_plan_id is None or m.owner_plan_id == pid:
+            m._record(event_name, duration)
 
 
 def _ensure_listener() -> bool:
@@ -123,15 +132,23 @@ def _ensure_listener() -> bool:
 
 
 class CompilationMonitor:
-    """Counts XLA compilations and their seconds for one run scope."""
+    """Counts XLA compilations and their seconds for one run scope.
+
+    ``owner_plan_id`` is captured from the active fault domain at
+    scope entry: under the multi-tenant executor each plan's monitor
+    only records compiles dispatched from that plan's (adopted)
+    threads. Entered outside any domain, the monitor is ownerless and
+    records every compile — the solo-run behavior."""
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._durations: Dict[str, float] = {}
         self._counts: Dict[str, int] = {}
+        self.owner_plan_id: Optional[str] = None
         self.available = _ensure_listener()
 
     def __enter__(self) -> "CompilationMonitor":
+        self.owner_plan_id = _domain.current_plan_id()
         with _monitor_lock:
             _active_monitors.append(self)
         return self
@@ -188,6 +205,12 @@ class RunTelemetry:
             jsonl_path=os.path.join(directory, "spans.jsonl"),
         )
         self.compilation = CompilationMonitor()
+        #: the scheduler's plan id when the run executed under the
+        #: multi-tenant PlanExecutor (scheduler/executor.py) — ties
+        #: the artifact to its journal record and to the plan-tagged
+        #: circuit evidence; None for direct single-query runs
+        #: (schema-stable)
+        self.plan_id: Optional[str] = None
         #: builder-appended: one entry per degradation-ladder step
         self.degradation: List[Dict[str, Any]] = []
         #: backend attribution: {"requested": ..., "landed": ...}
@@ -241,7 +264,7 @@ class RunTelemetry:
     # -- shared payload pieces -----------------------------------------
 
     def _common(self, timers, metrics) -> Dict[str, Any]:
-        from ..io import feature_cache
+        from ..io import circuit, feature_cache
         from ..ops import plan_cache
         from ..utils import compile_cache
         from . import chaos
@@ -261,6 +284,13 @@ class RunTelemetry:
         return {
             "query": self.query,
             "query_map": self.query_map,
+            "plan_id": self.plan_id,
+            # the shared circuit-breaker state at report time: which
+            # endpoints are open/half-open, the plan-tagged evidence,
+            # and the contributing plan ids — so a run fast-failed by
+            # a breaker ANOTHER tenant opened carries the opener's
+            # identity in its own artifact (docs/resilience.md)
+            "circuit": circuit.snapshot(),
             "env": {
                 k: os.environ[k] for k in _ENV_KNOBS if k in os.environ
             },

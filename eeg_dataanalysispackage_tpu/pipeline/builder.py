@@ -16,13 +16,13 @@ import contextlib
 import logging
 import os
 import re
-import time
 from typing import Dict, Optional, Union
 
 import numpy as np
 
 from .. import obs
 from ..features import registry as fe_registry
+from ..io import deadline as deadline_mod
 from ..io import provider, sources
 from ..models import registry as clf_registry
 from ..models import stats
@@ -122,121 +122,27 @@ class PipelineBuilder:
     def execute(
         self,
     ) -> Union[stats.ClassificationStatistics, stats.FanOutStatistics]:
-        query_map = get_query_map(self.query)
-        logger.info("query: %s", query_map)
+        """Parse the query into its :class:`~.plan.ExecutionPlan` IR
+        and run it through the scheduler's single-plan path — the thin
+        shim the monolithic orchestration body collapsed into when the
+        run machinery moved to ``scheduler/runtime.py`` (ROADMAP item
+        5). Every query string that ever worked routes through the IR
+        and produces bit-identical statistics; multi-plan callers use
+        ``scheduler.PlanExecutor`` directly and get per-plan fault
+        domains, admission control, deadlines, and the crash-only
+        journal on top of this exact code path."""
+        from ..scheduler import runtime
+        from .plan import ExecutionPlan
 
-        # persistent XLA compilation cache before any device work:
-        # fresh-chip compiles of the fused variants ran 10-14 min in
-        # the r4 sweep, and a repeat run of the same query must read
-        # the serialized executable instead (utils/compile_cache;
-        # EEG_TPU_COMPILE_CACHE_DIR overrides, EEG_TPU_NO_COMPILE_CACHE
-        # disables, failures degrade to plain compiles)
-        from ..utils import compile_cache
-
-        cache_dir = compile_cache.enable_persistent_cache()
-        if cache_dir:
-            logger.info("persistent compile cache: %s", cache_dir)
-
-        # chaos fault plan: faults=<spec> (or EEG_TPU_FAULTS) installs
-        # deterministic fault injection for the run, scoped so nested /
-        # subsequent runs in the process are unaffected (docs/
-        # resilience.md). faults_seed= seeds the p= directives. The
-        # spec's embedded '='s survive get_query_map since the
-        # first-'='-split fix.
-        spec = query_map.get("faults") or chaos.plan_from_env()
-        fault_scope = (
-            chaos.faults(spec, seed=int(query_map.get("faults_seed", 0) or 0))
-            if spec
-            else contextlib.nullcontext()
-        )
-
-        # structured run telemetry (obs/events.py + obs/report.py):
-        # report=<dir> (or EEG_TPU_RUN_REPORT_DIR) installs a span
-        # recorder for the run and writes one atomic run_report.json on
-        # success — or crash_report.json (the flight recorder's recent
-        # -event ring + metrics + chaos plan + degradation history) on
-        # any unhandled pipeline exception, CircuitOpenError included.
-        # Telemetry observes, never steers: statistics are bit-identical
-        # with it on or off (tests/test_telemetry.py).
-        from ..obs import report as run_report
-
-        self.telemetry = None
-        self.degradation_history = []
-        self.precision_resolved = None
-        self.overlap_resolved = None
-        self.mesh_resolved = None
-        # fresh per run, like the metrics scope below: a reused
-        # builder must not report run 1's stage seconds under run 2
-        self.timers = obs.StageTimer()
-        report_dir = run_report.resolve_report_dir(query_map)
-        if report_dir:
-            try:
-                self.telemetry = run_report.RunTelemetry(
-                    self.query, query_map, report_dir
-                )
-                # the builder appends rung drops as they happen; the
-                # report reads this shared list
-                self.telemetry.degradation = self.degradation_history
-            except OSError as e:
-                logger.warning(
-                    "run telemetry unavailable (%s: %s); running "
-                    "unreported", type(e).__name__, e,
-                )
-        telemetry = self.telemetry
-        telem_scope = (
-            events.recording(telemetry.recorder)
-            if telemetry is not None
-            else contextlib.nullcontext()
-        )
-        comp_scope = (
-            telemetry.compilation
-            if telemetry is not None
-            else contextlib.nullcontext()
-        )
-
-        start = time.perf_counter()
-        # per-run metrics scope: the run report gets THIS run's
-        # counters, not the process's whole history (the global
-        # registry keeps accumulating as the default sink)
-        with obs.metrics.scope() as run_metrics:
-            self.run_metrics = run_metrics
-            with comp_scope, telem_scope, fault_scope:
-                try:
-                    # net-new observability: trace_path=<dir> wraps the
-                    # run in a jax.profiler trace (device + annotated
-                    # host activity), viewable in TensorBoard/Perfetto
-                    if query_map.get("trace_path"):
-                        with obs.trace(query_map["trace_path"]):
-                            statistics = self._execute(query_map)
-                    else:
-                        statistics = self._execute(query_map)
-                except Exception as e:
-                    # flight recorder: dumped INSIDE the fault scope so
-                    # the crash artifact carries the active chaos plan
-                    # with its per-rule firing counts
-                    if telemetry is not None:
-                        telemetry.dump_crash(e, self.timers, run_metrics)
-                    raise
-                if telemetry is not None:
-                    # written inside the fault scope too, so a
-                    # SUCCESSFUL chaos run's report still records the
-                    # plan's per-rule call/firing accounting; and
-                    # guarded — a telemetry write failure must never
-                    # fail the run it observed
-                    try:
-                        telemetry.write_report(
-                            statistics, self.timers, run_metrics,
-                            wall_s=time.perf_counter() - start,
-                        )
-                    except OSError as e:
-                        logger.error("run report write failed: %s", e)
-        return statistics
+        return runtime.execute_plan(ExecutionPlan.parse(self.query), self)
 
     def _execute(
-        self, query_map
+        self, plan
     ) -> Union[stats.ClassificationStatistics, stats.FanOutStatistics]:
+        query_map = plan.query_map
 
-        # 1. input (PipelineBuilder.java:104-113)
+        # 1. input (PipelineBuilder.java:104-113; the IR validated
+        # presence — this re-derivation keeps the provider contract)
         if "info_file" in query_map:
             files = [query_map["info_file"]]
         elif "eeg_file" in query_map and "guessed_num" in query_map:
@@ -268,7 +174,7 @@ class PipelineBuilder:
         # degrade to host exactly as before. Absent both parameters,
         # this resolves to None and the path is byte-identical to
         # every query ever written.
-        mesh = self._resolve_mesh(query_map)
+        mesh = self._resolve_mesh(plan.mesh)
 
         # task=seizure: the continuous-EEG seizure workload
         # (docs/workloads.md) — sliding-window epoching over interval
@@ -416,271 +322,323 @@ class PipelineBuilder:
             cache_key = None
             prepared = None
             features = targets = None
+            build_slot = None
             landed = None
             #: the run's resolved numeric class; may drop to f32 when
             #: the bf16 gate trips or a non-decode rung lands
             precision_used = precision
             gate_record = None
-            if cache is not None:
-                try:
-                    # ONE read pass: digests (for the content key) and
-                    # parsed recordings come from the same bytes
-                    # (provider.prepare_fused_run), so a cold
-                    # cache-enabled run no longer reads every file
-                    # twice; on a miss the ladder below featurizes the
-                    # already-parsed recordings from memory
-                    with self._stage("ingest", phase="cache_lookup"):
-                        prepared = odp.prepare_fused_run(
-                            provider.fused_extractor_id(
-                                wavelet_index, precision
+            try:
+                if cache is not None:
+                    try:
+                        # ONE read pass: digests (for the content key) and
+                        # parsed recordings come from the same bytes
+                        # (provider.prepare_fused_run), so a cold
+                        # cache-enabled run no longer reads every file
+                        # twice; on a miss the ladder below featurizes the
+                        # already-parsed recordings from memory
+                        with self._stage("ingest", phase="cache_lookup"):
+                            prepared = odp.prepare_fused_run(
+                                provider.fused_extractor_id(
+                                    wavelet_index, precision
+                                )
                             )
-                        )
-                        cache_key = prepared.key
-                        hit = cache.lookup(cache_key)
-                except Exception as e:
-                    # an unreadable input surfaces properly from the
-                    # compute path below; a broken cache dir must not
-                    # kill a run the uncached path can finish
-                    logger.warning(
-                        "feature cache unavailable (%s: %s); running "
-                        "uncached", type(e).__name__, e,
-                    )
-                    cache = cache_key = prepared = hit = None
-                if hit is not None:
-                    features, targets = hit
-                    landed = "cache"
-                    if precision == "bf16":
-                        # the entry was gated when it was computed and
-                        # stored (keys carry the precision class — a
-                        # bf16 entry can only have passed its gate)
-                        gate_record = {"source": "cache"}
-                    logger.info(
-                        "feature cache hit (%d rows): ingest + "
-                        "featurization skipped", len(targets),
-                    )
-            if landed is None and precision == "bf16":
-                if prepared is None:
-                    # cache=false still needs the parsed recordings
-                    # for the f32 reference check; the ladder below
-                    # then featurizes them from memory — the gate
-                    # never costs a second read
-                    with self._stage("ingest", phase="cache_lookup"):
-                        prepared = odp.prepare_fused_run(
-                            provider.fused_extractor_id(
-                                wavelet_index, precision
-                            )
-                        )
-                # the per-run accuracy gate: bf16 vs f32 feature rows
-                # on the first recording, judged against the
-                # documented bf16 tolerance (ops/decode_ingest.
-                # BF16_GATE_TOL). Above the gate the run computes f32
-                # — recorded, never silent.
-                with self._stage("ingest", phase="bf16_gate"):
-                    gate_record = odp.bf16_gate_check(
-                        prepared.recordings, wavelet_index
-                    )
-                events.event("pipeline.bf16_gate", **gate_record)
-                if not gate_record["ok"]:
-                    precision_used = "f32"
-                    obs.metrics.count("pipeline.bf16_gate_disabled")
-                    logger.warning(
-                        "pipeline.bf16_gate auto-disable: max abs dev "
-                        "%.3e > gate %.3e; the run computes f32",
-                        gate_record["max_abs_dev"],
-                        gate_record["tolerance"],
-                    )
-                    # a gated-off run IS an f32 run: re-key from the
-                    # same read pass and give the f32 cache a chance
-                    # before featurizing
-                    if cache is not None:
-                        cache_key = odp.run_key_for(
-                            prepared,
-                            provider.fused_extractor_id(
-                                wavelet_index, "f32"
-                            ),
-                        )
-                        hit = cache.lookup(cache_key)
-                        if hit is not None:
-                            features, targets = hit
-                            landed = "cache"
-                            logger.info(
-                                "feature cache hit (%d rows, f32 "
-                                "fallback): ingest + featurization "
-                                "skipped", len(targets),
-                            )
-            # backend degradation ladder (io/provider.py): a fused
-            # backend that fails to lower, OOMs, or sits on unhealthy
-            # devices degrades decode -> pallas -> block -> xla ->
-            # host epochs + registry extractor instead of killing the
-            # run. Same
-            # ClassificationStatistics out the other end, every step
-            # down counted in obs.metrics. degrade=false opts out
-            # (fail fast on the requested backend).
-            degrade = query_map.get("degrade", "true") != "false"
-            ladder = (
-                provider.degradation_ladder(backend)
-                if degrade
-                else [backend]
-            )
-            if landed is not None:
-                ladder = []
-            for rung in ladder:
-                if rung == "host":
-                    break
-                try:
-                    with self._stage("ingest", backend=rung):
-                        features, targets = odp.load_features_device(
-                            wavelet_index=wavelet_index,
-                            backend=rung,
-                            mesh=mesh,
-                            recordings=(
-                                None if prepared is None
-                                else prepared.recordings
-                            ),
-                            # bf16 is the decode rung's feature: a
-                            # lower rung landing means the run
-                            # computes f32 (recorded below)
-                            precision=(
-                                precision_used
-                                if rung == "decode"
-                                else "f32"
-                            ),
-                            overlap=overlap,
-                        )
-                    landed = rung
-                    break
-                except OSError:
-                    # input/IO errors (missing or unreadable recording,
-                    # a remote endpoint that already exhausted its
-                    # retries + circuit): every rung would re-read the
-                    # same input and fail identically — surface the
-                    # root cause at once instead of masking it under
-                    # three backend attempts and a device probe.
-                    # ValueError stays degradable: backend-capability
-                    # limits (the block slab bound, the Pallas
-                    # window<=chunk/2 constraint) are ValueErrors the
-                    # next rung may not share.
-                    raise
-                except Exception as e:
-                    if len(ladder) == 1:
+                            cache_key = prepared.key
+                            # single-flight (io/feature_cache.py):
+                            # the first run to reach this key
+                            # proceeds; a concurrent run missing the
+                            # SAME entry blocks here until the leader
+                            # stores it, then its lookup below hits —
+                            # exactly one rebuild is kept
+                            # (tests/test_feature_cache.py,
+                            # tests/test_scheduler.py)
+                            build_slot = cache.begin_build(cache_key)
+                            hit = cache.lookup(cache_key)
+                    except deadline_mod.DeadlineExceededError:
+                        # the plan's budget died waiting on another
+                        # tenant's rebuild — fail fast; "degrade to
+                        # uncached" would run the very rebuild the
+                        # deadline can't cover
                         raise
-                    evidence = f"{type(e).__name__}: {e}"
-                    logger.error(
-                        "pipeline.degrade rung_failed backend=%s "
-                        "requested=%s evidence=%s",
-                        rung, backend, evidence,
-                    )
-                    obs.metrics.count("pipeline.degraded")
-                    obs.metrics.count(f"pipeline.degraded.from.{rung}")
+                    except Exception as e:
+                        # an unreadable input surfaces properly from the
+                        # compute path below; a broken cache dir must not
+                        # kill a run the uncached path can finish. This
+                        # run will never store the entry, so the
+                        # single-flight slot is released NOW — holding
+                        # it would stall concurrent same-key plans for
+                        # the whole uncached run, for nothing.
+                        logger.warning(
+                            "feature cache unavailable (%s: %s); running "
+                            "uncached", type(e).__name__, e,
+                        )
+                        if build_slot is not None:
+                            build_slot.release()
+                            build_slot = None
+                        cache = cache_key = prepared = hit = None
+                    if hit is not None:
+                        features, targets = hit
+                        landed = "cache"
+                        if precision == "bf16":
+                            # the entry was gated when it was computed and
+                            # stored (keys carry the precision class — a
+                            # bf16 entry can only have passed its gate)
+                            gate_record = {"source": "cache"}
+                        logger.info(
+                            "feature cache hit (%d rows): ingest + "
+                            "featurization skipped", len(targets),
+                        )
+                if landed is None and precision == "bf16":
+                    if prepared is None:
+                        # cache=false still needs the parsed recordings
+                        # for the f32 reference check; the ladder below
+                        # then featurizes them from memory — the gate
+                        # never costs a second read
+                        with self._stage("ingest", phase="cache_lookup"):
+                            prepared = odp.prepare_fused_run(
+                                provider.fused_extractor_id(
+                                    wavelet_index, precision
+                                )
+                            )
+                    # the per-run accuracy gate: bf16 vs f32 feature rows
+                    # on the first recording, judged against the
+                    # documented bf16 tolerance (ops/decode_ingest.
+                    # BF16_GATE_TOL). Above the gate the run computes f32
+                    # — recorded, never silent.
+                    with self._stage("ingest", phase="bf16_gate"):
+                        gate_record = odp.bf16_gate_check(
+                            prepared.recordings, wavelet_index
+                        )
+                    events.event("pipeline.bf16_gate", **gate_record)
+                    if not gate_record["ok"]:
+                        precision_used = "f32"
+                        obs.metrics.count("pipeline.bf16_gate_disabled")
+                        logger.warning(
+                            "pipeline.bf16_gate auto-disable: max abs dev "
+                            "%.3e > gate %.3e; the run computes f32",
+                            gate_record["max_abs_dev"],
+                            gate_record["tolerance"],
+                        )
+                        # a gated-off run IS an f32 run: re-key from the
+                        # same read pass and give the f32 cache a chance
+                        # before featurizing. The single-flight slot
+                        # moves to the NEW key — holding the bf16 key
+                        # while building the f32 entry would let a
+                        # concurrent f32 run of the same content race
+                        # the rebuild the guard exists to serialize.
+                        if cache is not None:
+                            cache_key = odp.run_key_for(
+                                prepared,
+                                provider.fused_extractor_id(
+                                    wavelet_index, "f32"
+                                ),
+                            )
+                            if build_slot is not None:
+                                build_slot.release()
+                            build_slot = cache.begin_build(cache_key)
+                            hit = cache.lookup(cache_key)
+                            if hit is not None:
+                                features, targets = hit
+                                landed = "cache"
+                                logger.info(
+                                    "feature cache hit (%d rows, f32 "
+                                    "fallback): ingest + featurization "
+                                    "skipped", len(targets),
+                                )
+                # backend degradation ladder (io/provider.py): a fused
+                # backend that fails to lower, OOMs, or sits on unhealthy
+                # devices degrades decode -> pallas -> block -> xla ->
+                # host epochs + registry extractor instead of killing the
+                # run. Same
+                # ClassificationStatistics out the other end, every step
+                # down counted in obs.metrics. degrade=false opts out
+                # (fail fast on the requested backend).
+                degrade = query_map.get("degrade", "true") != "false"
+                ladder = (
+                    provider.degradation_ladder(backend)
+                    if degrade
+                    else [backend]
+                )
+                if landed is not None:
+                    ladder = []
+                for rung in ladder:
+                    if rung == "host":
+                        break
+                    try:
+                        with self._stage("ingest", backend=rung):
+                            features, targets = odp.load_features_device(
+                                wavelet_index=wavelet_index,
+                                backend=rung,
+                                mesh=mesh,
+                                recordings=(
+                                    None if prepared is None
+                                    else prepared.recordings
+                                ),
+                                # bf16 is the decode rung's feature: a
+                                # lower rung landing means the run
+                                # computes f32 (recorded below)
+                                precision=(
+                                    precision_used
+                                    if rung == "decode"
+                                    else "f32"
+                                ),
+                                overlap=overlap,
+                            )
+                        landed = rung
+                        break
+                    except OSError:
+                        # input/IO errors (missing or unreadable recording,
+                        # a remote endpoint that already exhausted its
+                        # retries + circuit): every rung would re-read the
+                        # same input and fail identically — surface the
+                        # root cause at once instead of masking it under
+                        # three backend attempts and a device probe.
+                        # ValueError stays degradable: backend-capability
+                        # limits (the block slab bound, the Pallas
+                        # window<=chunk/2 constraint) are ValueErrors the
+                        # next rung may not share.
+                        raise
+                    except Exception as e:
+                        if len(ladder) == 1:
+                            raise
+                        evidence = f"{type(e).__name__}: {e}"
+                        logger.error(
+                            "pipeline.degrade rung_failed backend=%s "
+                            "requested=%s evidence=%s",
+                            rung, backend, evidence,
+                        )
+                        obs.metrics.count("pipeline.degraded")
+                        obs.metrics.count(f"pipeline.degraded.from.{rung}")
+                        events.event(
+                            "pipeline.degraded", rung=rung, error=evidence
+                        )
+                        self.degradation_history.append(
+                            {"from": rung, "error": evidence}
+                        )
+                        if self._devices_unhealthy():
+                            # dead hardware fails every device rung the
+                            # same way — jump straight to the host floor
+                            obs.metrics.count(
+                                "pipeline.degraded.unhealthy_devices"
+                            )
+                            logger.error(
+                                "pipeline.degrade unhealthy_devices=true: "
+                                "skipping remaining device backends"
+                            )
+                            events.event("pipeline.degraded.unhealthy_devices")
+                            break
+                if landed is not None:
+                    if landed != backend and landed != "cache":
+                        logger.warning(
+                            "pipeline.degrade landed requested=%s landed=%s "
+                            "steps=%d",
+                            backend, landed, len(self.degradation_history),
+                        )
                     events.event(
-                        "pipeline.degraded", rung=rung, error=evidence
+                        "pipeline.rung_landed", requested=backend, landed=landed
+                    )
+                    if precision_used == "bf16" and landed not in (
+                        "decode", "cache"
+                    ):
+                        # the decode rung failed and a lower (f32) rung
+                        # landed: the run's features are f32 — the cache
+                        # entry must carry the f32 key, and the report the
+                        # true numeric class. The single-flight slot moves
+                        # to the f32 key before the store below — but
+                        # NON-blocking: the features are already in
+                        # memory, so when another tenant holds the f32
+                        # key mid-rebuild of this same content-addressed
+                        # entry, waiting (or dying on a deadline) for a
+                        # store the holder is about to make is pure
+                        # waste — skip it instead.
+                        precision_used = "f32"
+                        if cache is not None and prepared is not None:
+                            cache_key = odp.run_key_for(
+                                prepared,
+                                provider.fused_extractor_id(
+                                    wavelet_index, "f32"
+                                ),
+                            )
+                            if build_slot is not None:
+                                build_slot.release()
+                            build_slot = cache.try_begin_build(cache_key)
+                            if build_slot is None:
+                                cache_key = None
+                    self.overlap_resolved = (
+                        provider.default_overlap()
+                        if overlap is None
+                        else overlap
+                    )
+                    self.precision_resolved = (
+                        {
+                            "requested": precision,
+                            "used": precision_used,
+                            "gate": gate_record,
+                        }
+                        if precision == "bf16"
+                        else None
+                    )
+                    if self.telemetry is not None:
+                        self.telemetry.backend = {
+                            "requested": backend, "landed": landed,
+                        }
+                        self.telemetry.overlap = self.overlap_resolved
+                        self.telemetry.precision = self.precision_resolved
+                    if (
+                        landed != "cache"
+                        and cache is not None
+                        and cache_key is not None
+                    ):
+                        cache.store(cache_key, features, targets)
+                    fe = None
+                    n = len(targets)
+                else:
+                    # the host floor of the ladder: reference-shaped epoch
+                    # loading plus the registry extractor — slower, but the
+                    # run survives and the statistics contract holds. This
+                    # path never stores the entry, so holding the
+                    # single-flight slot through the slow host load would
+                    # only block a neighbour that could rebuild and store.
+                    if build_slot is not None:
+                        build_slot.release()
+                        build_slot = None
+                    logger.error(
+                        "pipeline.degrade landed requested=%s landed=host "
+                        "(epochs + registry dwt-%d)", backend, wavelet_index
+                    )
+                    obs.metrics.count("pipeline.degraded.to_host")
+                    events.event(
+                        "pipeline.rung_landed", requested=backend, landed="host"
                     )
                     self.degradation_history.append(
-                        {"from": rung, "error": evidence}
+                        {"from": backend, "to": "host"}
                     )
-                    if self._devices_unhealthy():
-                        # dead hardware fails every device rung the
-                        # same way — jump straight to the host floor
-                        obs.metrics.count(
-                            "pipeline.degraded.unhealthy_devices"
-                        )
-                        logger.error(
-                            "pipeline.degrade unhealthy_devices=true: "
-                            "skipping remaining device backends"
-                        )
-                        events.event("pipeline.degraded.unhealthy_devices")
-                        break
-            if landed is not None:
-                if landed != backend and landed != "cache":
-                    logger.warning(
-                        "pipeline.degrade landed requested=%s landed=%s "
-                        "steps=%d",
-                        backend, landed, len(self.degradation_history),
+                    # the host floor is the f64 bit-parity path; the
+                    # requested bf16 never ran. Set on the builder whether
+                    # or not telemetry is on (the bench-attribution
+                    # contract precision_resolved documents).
+                    self.precision_resolved = (
+                        {
+                            "requested": precision,
+                            "used": "host-f64",
+                            "gate": gate_record,
+                        }
+                        if precision == "bf16"
+                        else None
                     )
-                events.event(
-                    "pipeline.rung_landed", requested=backend, landed=landed
-                )
-                if precision_used == "bf16" and landed not in (
-                    "decode", "cache"
-                ):
-                    # the decode rung failed and a lower (f32) rung
-                    # landed: the run's features are f32 — the cache
-                    # entry must carry the f32 key, and the report the
-                    # true numeric class
-                    precision_used = "f32"
-                    if cache is not None and prepared is not None:
-                        cache_key = odp.run_key_for(
-                            prepared,
-                            provider.fused_extractor_id(
-                                wavelet_index, "f32"
-                            ),
-                        )
-                self.overlap_resolved = (
-                    provider.default_overlap()
-                    if overlap is None
-                    else overlap
-                )
-                self.precision_resolved = (
-                    {
-                        "requested": precision,
-                        "used": precision_used,
-                        "gate": gate_record,
-                    }
-                    if precision == "bf16"
-                    else None
-                )
-                if self.telemetry is not None:
-                    self.telemetry.backend = {
-                        "requested": backend, "landed": landed,
-                    }
-                    self.telemetry.overlap = self.overlap_resolved
-                    self.telemetry.precision = self.precision_resolved
-                if (
-                    landed != "cache"
-                    and cache is not None
-                    and cache_key is not None
-                ):
-                    cache.store(cache_key, features, targets)
-                fe = None
-                n = len(targets)
-            else:
-                # the host floor of the ladder: reference-shaped epoch
-                # loading plus the registry extractor — slower, but the
-                # run survives and the statistics contract holds
-                logger.error(
-                    "pipeline.degrade landed requested=%s landed=host "
-                    "(epochs + registry dwt-%d)", backend, wavelet_index
-                )
-                obs.metrics.count("pipeline.degraded.to_host")
-                events.event(
-                    "pipeline.rung_landed", requested=backend, landed="host"
-                )
-                self.degradation_history.append(
-                    {"from": backend, "to": "host"}
-                )
-                # the host floor is the f64 bit-parity path; the
-                # requested bf16 never ran. Set on the builder whether
-                # or not telemetry is on (the bench-attribution
-                # contract precision_resolved documents).
-                self.precision_resolved = (
-                    {
-                        "requested": precision,
-                        "used": "host-f64",
-                        "gate": gate_record,
-                    }
-                    if precision == "bf16"
-                    else None
-                )
-                if self.telemetry is not None:
-                    self.telemetry.backend = {
-                        "requested": backend, "landed": "host",
-                    }
-                    self.telemetry.precision = self.precision_resolved
-                fused = False
-                fe = fe_registry.create(f"dwt-{wavelet_index}")
-                with self._stage("ingest", backend="host"):
-                    batch = odp.load()
-                n = len(batch)
+                    if self.telemetry is not None:
+                        self.telemetry.backend = {
+                            "requested": backend, "landed": "host",
+                        }
+                        self.telemetry.precision = self.precision_resolved
+                    fused = False
+                    fe = fe_registry.create(f"dwt-{wavelet_index}")
+                    with self._stage("ingest", backend="host"):
+                        batch = odp.load()
+                    n = len(batch)
+            finally:
+                if build_slot is not None:
+                    build_slot.release()
         else:
             with self._stage("ingest"):
                 batch = odp.load()
@@ -1379,96 +1337,36 @@ class PipelineBuilder:
     @staticmethod
     def _int_param(query_map, name: str) -> Optional[int]:
         """An optional integer query parameter (None when absent or
-        empty)."""
-        value = query_map.get(name, "")
-        if not value:
-            return None
-        try:
-            return int(value)
-        except ValueError:
-            raise ValueError(
-                f"query parameter {name}= must be an integer, "
-                f"got {value!r}"
-            )
+        empty). Delegates to the IR's parser — one implementation of
+        the contract, one message (PlanValidationError IS a
+        ValueError, so legacy matchers hold)."""
+        from .plan import _int_param
+        return _int_param(query_map, name)
 
     # -- multi-device mesh resolution ----------------------------------
 
-    def _resolve_mesh(self, query_map):
-        """``devices=``/``mesh_axes=`` -> a ``jax.sharding.Mesh`` or
-        None (no mesh requested — today's single-device path, byte-
-        untouched).
+    def _resolve_mesh(self, request):
+        """A grammar-validated :class:`~.plan.MeshRequest` (the IR is
+        the single source of the ``devices=``/``mesh_axes=`` grammar
+        and its errors — a typo'd axis raises at parse, never silently
+        trains unmeshed) -> a built ``jax.sharding.Mesh`` or None.
 
-        Grammar: ``devices=N`` builds an N-device 1-D ``data`` mesh;
-        ``mesh_axes=<name>[,<name>...]`` names the axes, with
-        per-axis extents for multi-axis layouts
-        (``mesh_axes=data:2,time:4``). Grammar errors raise (a typo'd
-        axis silently training unmeshed is the worst outcome — the
-        sweep-parser rule); AVAILABILITY failures degrade: a mesh the
+        None in = no mesh requested — today's single-device path,
+        byte-untouched. AVAILABILITY failures degrade: a mesh the
         machine cannot build (more devices than present, unhealthy
         backend) drops to the single-device rung with the evidence in
         the degradation history, the run-report ``mesh`` block, and
-        ``pipeline.mesh_unavailable`` — the ladder's new top rung.
+        ``pipeline.mesh_unavailable`` — the ladder's top rung.
         """
-        devices_param = self._int_param(query_map, "devices")
-        axes_value = query_map.get("mesh_axes", "")
-        if devices_param is None and not axes_value:
+        if request is None:
             return None
-        if query_map.get("serve") == "true":
-            raise ValueError(
-                "devices=/mesh_axes= shard the batch pipeline; they "
-                "cannot combine with serve=true (the serving engine "
-                "is resident single-device)"
-            )
         from ..parallel import mesh as pmesh
 
-        axes = []
-        sizes = []
-        if axes_value:
-            for part in axes_value.split(","):
-                name, sep, size = part.partition(":")
-                name = name.strip()
-                if not name:
-                    raise ValueError(
-                        f"mesh_axes= has an empty axis name in "
-                        f"{axes_value!r}"
-                    )
-                axes.append(name)
-                if sep:
-                    try:
-                        sizes.append(int(size))
-                    except ValueError:
-                        raise ValueError(
-                            f"mesh_axes= axis {name!r} has a "
-                            f"non-integer extent {size!r}"
-                        )
-            if len(set(axes)) != len(axes):
-                raise ValueError("mesh_axes= repeats an axis name")
-            if sizes and len(sizes) != len(axes):
-                raise ValueError(
-                    "mesh_axes= extents must be given for every axis "
-                    "or for none (e.g. mesh_axes=data:2,time:4)"
-                )
-            if len(axes) > 1 and not sizes:
-                raise ValueError(
-                    "multi-axis mesh_axes= needs explicit extents "
-                    "(e.g. mesh_axes=data:2,time:4)"
-                )
-        if not axes:
-            axes = [pmesh.DATA_AXIS]
-        if devices_param is not None and devices_param < 1:
-            raise ValueError("devices= must be >= 1")
+        axes = list(request.axes)
+        sizes = list(request.shape or ())
         product = int(np.prod(sizes)) if sizes else None
-        if (
-            product is not None
-            and devices_param is not None
-            and product != devices_param
-        ):
-            raise ValueError(
-                f"mesh_axes= extents cover {product} devices but "
-                f"devices={devices_param}; drop one or make them agree"
-            )
         requested = {
-            "devices": devices_param or product,
+            "devices": request.devices or product,
             "axes": list(axes),
             "shape": list(sizes) or None,
         }

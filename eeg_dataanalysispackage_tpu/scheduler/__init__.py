@@ -1,0 +1,26 @@
+"""Multi-tenant plan scheduling: the execution half of the
+``ExecutionPlan`` IR split (ROADMAP item 5).
+
+- :mod:`scheduler.runtime`  — ``execute_plan``: one plan executed
+  inside its own fault domain (chaos plan, metrics scope, span root,
+  degradation state, ``run_report.json`` — all per plan);
+- :mod:`scheduler.journal`  — the write-ahead plan journal that makes
+  the executor crash-only (``kill -9`` mid-batch, restart, resume);
+- :mod:`scheduler.executor` — the resident :class:`PlanExecutor`:
+  bounded admission with shed-with-evidence, N worker threads over
+  the shared plan/feature/compile caches, per-plan deadlines and
+  retry budgets, and :meth:`PlanExecutor.recover`.
+
+See docs/architecture.md for the IR schema, the executor lifecycle,
+and the crash-recovery contract.
+"""
+
+from .executor import (  # noqa: F401
+    PlanExecutor,
+    PlanFailedError,
+    PlanHandle,
+    PlanResult,
+    PlanShedError,
+)
+from .journal import PlanJournal  # noqa: F401
+from .runtime import execute_plan  # noqa: F401

@@ -1,0 +1,647 @@
+"""The resident multi-tenant :class:`PlanExecutor`.
+
+One process, N plans in flight, shared plan/feature/compile caches,
+per-plan fault domains (scheduler/runtime.py), and a write-ahead
+journal (scheduler/journal.py) that makes the whole thing crash-only.
+
+Admission control deliberately reuses the serving layer's machinery
+(serve/batcher.py): the same bounded :class:`AdmissionQueue` with
+shed-with-evidence (a burst past ``queue_depth`` is refused with
+:class:`PlanShedError` carrying the depth and the oldest queued plan's
+age — never an unbounded queue, never a silent drop) and the same
+resolve-once :class:`ServeFuture` behind every handle. A plan is a
+bigger unit of work than a serving request, but the failure modes at
+the door are identical, and two bounded queues with two shed stories
+would be one too many.
+
+Per-plan budgets:
+
+- **deadline** — ``submit(deadline_s=...)`` threads an
+  :class:`io.deadline.Deadline` through the whole execution
+  (``deadline_scope``), so retry ladders underneath — io/remote
+  backoff included — stop instead of sleeping past it; a plan whose
+  budget died in the queue fails fast with the time it waited;
+- **retries** — a failed execution attempt (a chaos injection at
+  ``scheduler.plan``, a transient backend error) re-runs up to
+  ``max_attempts`` with backoff; the parsed fault plan persists
+  across attempts (one set of rule call counters — a ``once@N`` fault
+  absorbed by attempt 1 stays absorbed). Exhaustion fails the handle
+  with :class:`PlanFailedError` carrying the full attempt history and
+  writes a terminal ``failed`` journal record.
+
+Crash-only recovery: construct a fresh executor over the same
+``journal_dir`` after a crash and call :meth:`PlanExecutor.recover` —
+completed plans are returned as records (never re-run, their journal
+files untouched), unfinished plans are re-submitted under their
+original ids and produce statistics byte-identical to an uninterrupted
+run (the pipeline is deterministic end to end; elastic plans re-enter
+through their training checkpoints). Pinned in tests/test_scheduler.py
+with a real ``SIGKILL`` mid-batch.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .. import obs
+from ..io import deadline as deadline_mod
+from ..obs import chaos, domain as run_domain, events
+from ..serve.batcher import (
+    AdmissionQueue,
+    ServeFuture,
+    ServiceClosedError,
+    ShedError,
+)
+from . import journal as journal_mod
+from . import runtime
+
+logger = logging.getLogger(__name__)
+
+
+class PlanShedError(ShedError):
+    """Admission control refused the plan (queue full); the message
+    carries the shed evidence — depth, limit, oldest queued age — and
+    ``plan_id`` names the journal record the shed wrote, so a caller
+    retrying after backpressure can resubmit under the same id
+    instead of minting a fresh terminal record per retry."""
+
+    def __init__(self, message: str, plan_id: Optional[str] = None):
+        super().__init__(message)
+        self.plan_id = plan_id
+
+
+class PlanFailedError(RuntimeError):
+    """The plan exhausted its retry/deadline budget; the message
+    carries the per-attempt history."""
+
+
+class PlanResult:
+    """A completed plan, with its execution provenance."""
+
+    __slots__ = ("plan_id", "statistics", "builder", "attempts",
+                 "report_dir", "recovered")
+
+    def __init__(self, plan_id, statistics, builder, attempts,
+                 report_dir, recovered=False):
+        self.plan_id = plan_id
+        self.statistics = statistics
+        #: the PipelineBuilder that executed the plan — its per-run
+        #: attributes (timers, run_metrics, degradation_history,
+        #: mesh/precision/overlap resolution, telemetry) are the
+        #: plan's isolated observability surface
+        self.builder = builder
+        self.attempts = attempts
+        self.report_dir = report_dir
+        #: True when this result came from journal recovery (a re-run
+        #: of a plan some dead process left unfinished)
+        self.recovered = recovered
+
+    def __repr__(self) -> str:
+        return (
+            f"PlanResult({self.plan_id}, attempts={self.attempts}, "
+            f"recovered={self.recovered})"
+        )
+
+
+class _PlanTicket:
+    """One admitted plan riding the (reused) AdmissionQueue."""
+
+    __slots__ = ("plan", "plan_id", "deadline", "future",
+                 "submitted_at", "attempts", "history", "fault_plan",
+                 "report_dir", "recovered")
+
+    def __init__(self, plan, plan_id, deadline, fault_plan, report_dir,
+                 recovered=False):
+        self.plan = plan
+        self.plan_id = plan_id
+        self.deadline: Optional[deadline_mod.Deadline] = deadline
+        self.future = ServeFuture()
+        self.submitted_at = time.monotonic()
+        self.attempts = 0
+        self.history: List[str] = []
+        self.fault_plan = fault_plan
+        self.report_dir = report_dir
+        self.recovered = recovered
+
+    def batch_key(self):
+        # plans never coalesce: every ticket is its own micro-batch
+        # (the queue's collect(max_batch=1) pops exactly one)
+        return self.plan_id
+
+
+class PlanHandle:
+    """The submitter's side of one plan: a resolve-once future."""
+
+    __slots__ = ("plan_id", "query", "_ticket")
+
+    def __init__(self, ticket: _PlanTicket):
+        self.plan_id = ticket.plan_id
+        self.query = ticket.plan.query
+        self._ticket = ticket
+
+    @property
+    def done(self) -> bool:
+        return self._ticket.future.done
+
+    def result(self, timeout: Optional[float] = None) -> PlanResult:
+        """Block for the outcome; raises the plan's failure
+        (PlanFailedError / DeadlineExceededError / the terminal
+        execution error) if it lost."""
+        return self._ticket.future.result(timeout)
+
+
+class PlanExecutor:
+    """N worker threads draining a bounded admission queue of plans.
+
+    ``max_concurrent`` bounds the plans in flight (each on its own
+    worker thread, each in its own fault domain); ``queue_depth``
+    bounds the backlog past which submissions shed. All plans share
+    the process's plan/feature/compile caches — that sharing is the
+    multi-tenancy dividend, and the feature cache's single-flight
+    guard (io/feature_cache.py) keeps two plans missing the same entry
+    from rebuilding it twice.
+    """
+
+    def __init__(
+        self,
+        max_concurrent: int = 2,
+        queue_depth: int = 16,
+        journal_dir: Optional[str] = None,
+        filesystem=None,
+        report_root: Optional[str] = None,
+        max_attempts: int = 3,
+        retry_backoff_s: float = 0.05,
+        name: str = "plans",
+    ):
+        if max_concurrent < 1:
+            raise ValueError(
+                f"max_concurrent must be >= 1, got {max_concurrent}"
+            )
+        self.max_concurrent = int(max_concurrent)
+        self.queue = AdmissionQueue(queue_depth)
+        self.journal = (
+            journal_mod.PlanJournal(journal_dir)
+            if journal_dir
+            else None
+        )
+        self._fs = filesystem
+        self.report_root = report_root
+        self.max_attempts = int(max_attempts)
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.name = name
+        # ids are seeded PAST anything already in the journal: a new
+        # executor over a dead process's journal_dir must not mint the
+        # dead process's ids and overwrite its records — submitting
+        # before recover() would otherwise clobber a completed plan's
+        # exactly-once record
+        self._ids = itertools.count(self._seed_id() + 1)
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._started = False
+        self._lock = threading.Lock()
+        self._submit_lock = threading.Lock()
+
+    def _seed_id(self) -> int:
+        if self.journal is None:
+            return 0
+        max_seen = 0
+        for entry in self.journal.entries():
+            pid = str(entry.get("plan_id", ""))
+            if pid.startswith("p"):
+                try:
+                    max_seen = max(max_seen, int(pid[1:]))
+                except ValueError:
+                    pass
+        return max_seen
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        with self._lock:
+            if self._started:
+                return
+            self._started = True
+            for i in range(self.max_concurrent):
+                t = threading.Thread(
+                    target=self._worker,
+                    name=f"eeg-tpu-{self.name}-worker-{i}",
+                    daemon=True,
+                )
+                t.start()
+                self._threads.append(t)
+
+    def close(self, join_timeout_s: float = 10.0) -> None:
+        """Stop the workers after the plan each has already popped;
+        queued-but-unstarted plans stay journaled as submitted
+        (recovery's job, by design) and their HANDLES are failed with
+        :class:`ServiceClosedError` — an abandoned future that blocks
+        its caller forever is the one outcome admission control
+        exists to prevent."""
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=join_timeout_s)
+        # the drain and every admission share _submit_lock: a submit
+        # racing close() either sees _stop under the lock and refuses,
+        # or lands its ticket before this drain runs — no window where
+        # an admitted future is left unresolved
+        with self._submit_lock:
+            pending = self.queue.drain_pending()
+        for ticket in pending:
+            ticket.future.fail(ServiceClosedError(
+                f"plan {ticket.plan_id} abandoned by executor close()"
+                + (
+                    "; its journal record stays 'submitted' — a new "
+                    "executor's recover() will resume it"
+                    if self.journal is not None
+                    else "; unjournaled, the plan is lost"
+                )
+            ))
+
+    def __enter__(self) -> "PlanExecutor":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- submission ------------------------------------------------------
+
+    def _next_id(self) -> str:
+        return f"p{next(self._ids):04d}"
+
+    def submit(
+        self,
+        query_or_plan,
+        deadline_s: Optional[float] = None,
+        plan_id: Optional[str] = None,
+        _recovered: bool = False,
+    ) -> PlanHandle:
+        """Validate, journal, and enqueue one plan; returns its
+        handle. Sheds with :class:`PlanShedError` (evidence included)
+        when the queue is full — parse/validation errors raise
+        *before* anything is journaled or queued, so an invalid query
+        costs nothing and recovery never sees it."""
+        from ..pipeline.plan import ExecutionPlan
+
+        if self._stop.is_set():
+            # the workers are gone: a silently queued plan would leave
+            # its handle blocked forever (same contract as the
+            # serving layer's drain)
+            raise ServiceClosedError(
+                "executor is closed; no new plan admissions"
+            )
+        self.start()
+        plan = (
+            query_or_plan
+            if isinstance(query_or_plan, ExecutionPlan)
+            else ExecutionPlan.parse(query_or_plan)
+        )
+        plan_id = plan_id or self._next_id()
+        # one fault plan per submission, shared across retry attempts
+        # (runtime.execute_plan would otherwise parse a fresh one per
+        # attempt and deterministically replay the same firings)
+        spec = plan.faults or chaos.plan_from_env()
+        fault_plan = (
+            chaos.parse_fault_spec(spec, seed=plan.faults_seed)
+            if spec
+            else None
+        )
+        report_dir = (
+            None
+            if self.report_root is None
+            else f"{self.report_root.rstrip('/')}/{plan_id}"
+        )
+        deadline = (
+            deadline_mod.Deadline(deadline_s)
+            if deadline_s is not None
+            else None
+        )
+        ticket = _PlanTicket(
+            plan, plan_id, deadline, fault_plan, report_dir,
+            recovered=_recovered,
+        )
+        with self._submit_lock:
+            # checked under the same lock close() drains under: a
+            # submit racing close() either refuses here or lands its
+            # ticket before the drain — never an abandoned future.
+            # The journal write sits under the SAME check: refusing
+            # after record_submitted would strand a 'submitted'
+            # record for a plan the caller was told was never
+            # admitted — recover() would silently re-run it alongside
+            # the caller's resubmission.
+            if self._stop.is_set():
+                raise ServiceClosedError(
+                    "executor is closed; no new plan admissions"
+                )
+            if self.journal is not None:
+                # journal writes belong to the plan's fault domain:
+                # its scheduler.journal chaos rules govern them, and
+                # ONLY its (the submit-side record rides a minimal
+                # domain — no recorder/metrics child exists yet)
+                with run_domain.activate(run_domain.RunDomain(
+                    plan_id=plan_id, chaos=fault_plan
+                )):
+                    self.journal.record_submitted(
+                        plan_id, plan.query,
+                        meta={
+                            "deadline_s": deadline_s,
+                            "report_dir": report_dir,
+                            "recovered": _recovered,
+                        },
+                    )
+            if _recovered:
+                # journal recovery must NEVER shed: these plans were
+                # admitted once by the dead process, and a shed here
+                # would write a terminal record for work that never
+                # ran — permanent loss. Same rule as the batcher's
+                # retry re-admission (the bound is the journal's own
+                # size).
+                self.queue.readmit(ticket)
+                admitted = True
+            else:
+                # the offer and its evidence read are one atomic
+                # decision under the lock: two threads shedding
+                # concurrently must each journal THEIR OWN evidence,
+                # not the other's
+                admitted = self.queue.offer(ticket, block_s=0.0)
+                evidence = (
+                    "" if admitted else self.queue.last_shed_evidence
+                )
+        if not admitted:
+            # same invariant as every other journal write: the shed
+            # record (and its counter) belongs to THIS plan's fault
+            # domain — a submit() called from inside another tenant's
+            # domain must not charge the shed to that tenant's chaos
+            # rules or metrics child
+            with run_domain.activate(run_domain.RunDomain(
+                plan_id=plan_id, chaos=fault_plan
+            )):
+                obs.metrics.count("scheduler.shed")
+                if self.journal is not None:
+                    self.journal.record_failed(
+                        plan_id, plan.query,
+                        error=f"shed at admission: {evidence}",
+                        attempts=0,
+                    )
+            raise PlanShedError(
+                f"plan {plan_id} shed at admission: {evidence}",
+                plan_id=plan_id,
+            )
+        # same domain rule as the shed branch: submission accounting
+        # belongs to the NEW plan, not to whatever tenant's domain is
+        # ambient on the submitting thread
+        with run_domain.activate(run_domain.RunDomain(
+            plan_id=plan_id, chaos=fault_plan
+        )):
+            obs.metrics.count("scheduler.submitted")
+            events.event("scheduler.submitted", plan=plan_id)
+        return PlanHandle(ticket)
+
+    def run(
+        self, queries, deadline_s: Optional[float] = None,
+        timeout_s: Optional[float] = None,
+    ) -> List[PlanResult]:
+        """Submit every query and block for all results, in order —
+        the batch-driver convenience over the async surface.
+
+        A shed mid-batch is BACKPRESSURE here, not loss: raising out
+        of the submit loop would abandon the already-admitted handles
+        (their plans keep running, journaling results the caller can
+        no longer reach). Instead the batch waits for one of its own
+        in-flight plans — whose worker pop freed queue space — and
+        retries UNDER THE SHED PLAN'S ID, so the journal converges to
+        one record per logical plan (the transient shed's 'failed'
+        record is overwritten by the retry's write-ahead record)
+        instead of accumulating a terminal failure per backpressure
+        bounce. Only with none of its own plans in flight is a shed
+        genuine (other tenants own the depth) and re-raised — its
+        failed record then stands as the evidence."""
+        handles: List[PlanHandle] = []
+        for q in queries:
+            retry_id: Optional[str] = None
+            while True:
+                try:
+                    handles.append(self.submit(
+                        q, deadline_s=deadline_s, plan_id=retry_id,
+                    ))
+                    break
+                except PlanShedError as shed:
+                    retry_id = shed.plan_id or retry_id
+                    in_flight = next(
+                        (h for h in handles if not h.done), None
+                    )
+                    if in_flight is None:
+                        raise
+                    try:
+                        in_flight.result(timeout=timeout_s)
+                    except Exception:
+                        # resolved-with-error still freed its slot
+                        # (the error resurfaces from the collection
+                        # below — and a plan's own
+                        # DeadlineExceededError is a resolution, not
+                        # our wait expiring). An UNresolved handle
+                        # means the wait itself timed out: re-raise
+                        # rather than busy-loop on a queue another
+                        # tenant is holding full.
+                        if not in_flight.done:
+                            raise
+        return [h.result(timeout=timeout_s) for h in handles]
+
+    # -- crash-only recovery ---------------------------------------------
+
+    def recover(self) -> Dict[str, Any]:
+        """Resume a journaled workload after a crash: every unfinished
+        record is re-submitted under its ORIGINAL plan id (handles
+        returned for the caller to await), every terminal record is
+        returned untouched — completed plans are exactly-once by
+        construction. Requires a ``journal_dir``."""
+        if self.journal is None:
+            raise ValueError(
+                "recover() needs a journal_dir — an unjournaled "
+                "executor has nothing to recover from"
+            )
+        resumed: List[PlanHandle] = []
+        completed: List[Dict[str, Any]] = []
+        failed: List[Dict[str, Any]] = []
+        for entry in self.journal.entries():
+            state = entry.get("state")
+            if state == journal_mod.COMPLETED:
+                completed.append(entry)
+            elif state == journal_mod.FAILED:
+                failed.append(entry)
+            elif state == journal_mod.SUBMITTED:
+                meta = entry.get("meta") or {}
+                resumed.append(self.submit(
+                    entry["query"],
+                    deadline_s=meta.get("deadline_s"),
+                    plan_id=entry["plan_id"],
+                    _recovered=True,
+                ))
+        # fresh ids already start past the dead process's (the
+        # constructor seeds the counter from the journal)
+        obs.metrics.count("scheduler.recovered_plans", len(resumed))
+        logger.info(
+            "journal recovery: %d completed (kept), %d failed (kept), "
+            "%d unfinished re-submitted",
+            len(completed), len(failed), len(resumed),
+        )
+        return {
+            "resumed": resumed,
+            "completed": completed,
+            "failed": failed,
+        }
+
+    # -- the worker loop -------------------------------------------------
+
+    def _worker(self) -> None:
+        while not self._stop.is_set():
+            batch = self.queue.collect(
+                max_batch=1, wait_s=0.05, coalesce_s=0.0
+            )
+            if not batch:
+                continue
+            self._execute_ticket(batch[0])
+
+    def _execute_ticket(self, ticket: _PlanTicket) -> None:
+        from ..pipeline.builder import PipelineBuilder
+
+        while True:
+            if ticket.deadline is not None and ticket.deadline.expired:
+                # attempts == 0: the budget died in the admission
+                # queue. attempts > 0: it died during the retry
+                # backoff sleep (can_cover guarded the sleep itself,
+                # not the attempt after it) — either way, building a
+                # fresh PipelineBuilder and telemetry dir for an
+                # attempt that fails at its first deadline checkpoint
+                # is pure waste: fail fast here.
+                waited = time.monotonic() - ticket.submitted_at
+                obs.metrics.count("scheduler.deadline_exceeded")
+                if ticket.attempts == 0:
+                    msg = (
+                        f"deadline ({ticket.deadline.budget_s:.3f}s "
+                        f"budget) exceeded after {waited:.3f}s in the "
+                        f"admission queue; plan was never executed"
+                    )
+                else:
+                    msg = (
+                        f"deadline ({ticket.deadline.budget_s:.3f}s "
+                        f"budget) expired during retry backoff after "
+                        f"{ticket.attempts} failed; attempts: "
+                        f"{ticket.history}"
+                    )
+                self._record_failed(ticket, msg)
+                ticket.future.fail(deadline_mod.DeadlineExceededError(
+                    f"plan {ticket.plan_id}: {msg}"
+                ))
+                return
+            builder = PipelineBuilder(
+                ticket.plan.query, filesystem=self._fs
+            )
+            try:
+                with deadline_mod.deadline_scope(ticket.deadline):
+                    statistics = runtime.execute_plan(
+                        ticket.plan,
+                        builder,
+                        plan_id=ticket.plan_id,
+                        fault_plan=ticket.fault_plan,
+                        default_report_dir=ticket.report_dir,
+                    )
+            except Exception as e:
+                ticket.attempts += 1
+                ticket.history.append(
+                    f"attempt {ticket.attempts}: "
+                    f"{type(e).__name__}: {e}"
+                )
+                obs.metrics.count("scheduler.attempt_failures")
+                events.event(
+                    "scheduler.attempt_failed",
+                    plan=ticket.plan_id, attempt=ticket.attempts,
+                    error=f"{type(e).__name__}: {e}",
+                )
+                if isinstance(e, ValueError):
+                    # caller bugs (conflicting knobs, bad grammar the
+                    # IR could not see statically) fail identically on
+                    # every attempt — surface NOW with the real error
+                    self._record_failed(ticket, ticket.history[-1])
+                    ticket.future.fail(e)
+                    return
+                if ticket.attempts >= self.max_attempts:
+                    self._record_failed(
+                        ticket,
+                        f"retry budget ({self.max_attempts}) "
+                        f"exhausted; attempts: {ticket.history}",
+                    )
+                    ticket.future.fail(PlanFailedError(
+                        f"plan {ticket.plan_id} failed after "
+                        f"{ticket.attempts} attempts (budget "
+                        f"{self.max_attempts}); attempts: "
+                        f"{ticket.history}"
+                    ))
+                    return
+                if (
+                    ticket.deadline is not None
+                    and not ticket.deadline.can_cover(
+                        self.retry_backoff_s
+                    )
+                ):
+                    obs.metrics.count("scheduler.deadline_exceeded")
+                    self._record_failed(
+                        ticket,
+                        f"deadline cannot cover another attempt "
+                        f"after {ticket.attempts} failed; attempts: "
+                        f"{ticket.history}",
+                    )
+                    ticket.future.fail(
+                        deadline_mod.DeadlineExceededError(
+                            f"plan {ticket.plan_id}: deadline "
+                            f"({ticket.deadline.budget_s:.3f}s "
+                            f"budget) cannot cover another attempt "
+                            f"after {ticket.attempts} failed; "
+                            f"attempts: {ticket.history}"
+                        )
+                    )
+                    return
+                obs.metrics.count("scheduler.retries")
+                time.sleep(self.retry_backoff_s)
+                continue
+            ticket.attempts += 1
+            if self.journal is not None:
+                # same fault-domain rule as the submit-side record
+                with run_domain.activate(run_domain.RunDomain(
+                    plan_id=ticket.plan_id, chaos=ticket.fault_plan
+                )):
+                    self.journal.record_completed(
+                        ticket.plan_id, ticket.plan.query,
+                        str(statistics),
+                        attempts=ticket.attempts,
+                        meta={"recovered": ticket.recovered},
+                    )
+            obs.metrics.count("scheduler.completed")
+            events.event(
+                "scheduler.completed", plan=ticket.plan_id,
+                attempts=ticket.attempts,
+            )
+            ticket.future.resolve(PlanResult(
+                plan_id=ticket.plan_id,
+                statistics=statistics,
+                builder=builder,
+                attempts=ticket.attempts,
+                report_dir=ticket.report_dir,
+                recovered=ticket.recovered,
+            ))
+            return
+
+    def _record_failed(self, ticket: _PlanTicket, error: str) -> None:
+        obs.metrics.count("scheduler.failed")
+        if self.journal is not None:
+            with run_domain.activate(run_domain.RunDomain(
+                plan_id=ticket.plan_id, chaos=ticket.fault_plan
+            )):
+                self.journal.record_failed(
+                    ticket.plan_id, ticket.plan.query, error,
+                    attempts=ticket.attempts,
+                )

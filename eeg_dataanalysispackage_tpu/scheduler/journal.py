@@ -1,0 +1,210 @@
+"""Write-ahead plan journal: the crash-only half of the executor.
+
+The reference driver is one query → one process
+(PipelineBuilder.java:94-295): a crash loses the run and nobody
+notices, because nobody submitted more than one. A resident executor
+running ten plans owes its callers a different contract — the process
+dying mid-batch must lose *nothing*: on restart every unfinished plan
+resumes, every finished plan's record survives, and nothing runs
+twice.
+
+The journal is deliberately boring, because boring is what survives
+``kill -9``:
+
+- one JSON file per plan (``plan-<id>.json``) under the journal
+  directory — no index file to corrupt, no compaction, directory scan
+  IS recovery;
+- every write goes through the checkpoint store's atomic
+  tmp+``os.replace``+fsync discipline
+  (``checkpoint.manager.atomic_write_bytes``), so a file is always
+  either the previous record or the new one, never a truncation;
+- two durable states: ``submitted`` (written BEFORE execution starts
+  — the write-ahead half) and a terminal ``completed``/``failed``
+  (written after, carrying the statistics text and its sha256). A
+  crash between them leaves ``submitted``, which is exactly the
+  signal recovery needs: re-execute. The pipeline underneath is
+  deterministic (every stage is pinned bit-identical across reruns),
+  so a resumed plan's statistics are byte-identical to an
+  uninterrupted twin — and an elastic plan (``elastic=true`` +
+  ``checkpoint_path=``) re-enters through its own training
+  checkpoints, resuming mid-scan instead of from step 0.
+
+Completion records are exactly-once by construction: recovery skips
+every terminal record without touching it (the file's content and
+mtime survive recovery byte-identical), so a completed plan is never
+re-run and never re-recorded.
+
+Chaos: every journal write passes the ``scheduler.journal`` injection
+point (obs/chaos.py grammar). A failing write retries once, then
+**degrades to unjournaled** — counted (``scheduler.journal_write_failed``)
+and logged, never raised: the journal records the run, it must not be
+able to kill it. The cost is honest: a plan whose *completion* write
+was lost re-runs on recovery (at-least-once, still byte-identical); a
+plan whose *submission* write was lost is invisible to recovery.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+SCHEMA = "eeg-tpu-plan-journal/v1"
+
+SUBMITTED = "submitted"
+COMPLETED = "completed"
+FAILED = "failed"
+
+
+class PlanJournal:
+    """One directory of per-plan journal records."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, plan_id: str) -> str:
+        return os.path.join(self.directory, f"plan-{plan_id}.json")
+
+    # -- writes ----------------------------------------------------------
+
+    def _write(self, plan_id: str, payload: Dict[str, Any]) -> bool:
+        """One atomic record write through the chaos point; True when
+        the record landed. A journal failure degrades the guarantee,
+        never the plan (see module docstring)."""
+        from .. import obs
+        from ..checkpoint.manager import atomic_write_text
+        from ..obs import chaos, events
+
+        payload = {"schema": SCHEMA, **payload}
+        text = json.dumps(payload, indent=2, sort_keys=True) + "\n"
+        last_error: Optional[Exception] = None
+        for attempt in (1, 2):
+            try:
+                chaos.maybe_fire("scheduler.journal")
+                atomic_write_text(self._path(plan_id), text)
+                return True
+            except Exception as e:
+                last_error = e
+        obs.metrics.count("scheduler.journal_write_failed")
+        events.event(
+            "scheduler.journal_write_failed",
+            plan=plan_id,
+            error=f"{type(last_error).__name__}: {last_error}",
+        )
+        logger.error(
+            "plan journal write failed for %s (%s: %s); continuing "
+            "unjournaled — a crash before completion will re-run this "
+            "plan (or lose its completion record)",
+            plan_id, type(last_error).__name__, last_error,
+        )
+        return False
+
+    def record_submitted(
+        self, plan_id: str, query: str,
+        meta: Optional[Dict[str, Any]] = None,
+    ) -> bool:
+        """The write-ahead record: MUST land before execution starts
+        for the plan to be recoverable."""
+        return self._write(plan_id, {
+            "plan_id": plan_id,
+            "state": SUBMITTED,
+            "query": query,
+            "submitted_utc": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+            ),
+            "meta": meta or {},
+        })
+
+    def record_completed(
+        self, plan_id: str, query: str, statistics_text: str,
+        attempts: int = 1,
+        meta: Optional[Dict[str, Any]] = None,
+    ) -> bool:
+        """The exactly-once completion record."""
+        return self._write(plan_id, {
+            "plan_id": plan_id,
+            "state": COMPLETED,
+            "query": query,
+            "completed_utc": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+            ),
+            "attempts": attempts,
+            "statistics": statistics_text,
+            "statistics_sha256": hashlib.sha256(
+                statistics_text.encode()
+            ).hexdigest(),
+            "meta": meta or {},
+        })
+
+    def record_failed(
+        self, plan_id: str, query: str, error: str,
+        attempts: int = 1,
+    ) -> bool:
+        """Terminal failure (retry budget exhausted / deadline spent):
+        recovery does NOT re-run it — a deterministic failure would
+        fail identically, and the record carries the evidence."""
+        return self._write(plan_id, {
+            "plan_id": plan_id,
+            "state": FAILED,
+            "query": query,
+            "failed_utc": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+            ),
+            "attempts": attempts,
+            "error": error,
+        })
+
+    # -- reads -----------------------------------------------------------
+
+    def entries(self) -> List[Dict[str, Any]]:
+        """Every readable record, sorted by plan id (submission order
+        — executor ids are zero-padded counters). Unreadable files are
+        skipped with a warning: recovery must survive a journal a
+        crash half-wrote by some OTHER writer (atomic writes make this
+        impossible for our own)."""
+        out = []
+        try:
+            # numeric-aware sort: executor ids are zero-padded to 4
+            # digits, but a journal past 9999 submissions grows a
+            # digit and 'plan-p10000' would sort lexicographically
+            # before 'plan-p9999'
+            def _order(name: str):
+                stem = name[len("plan-"):-len(".json")]
+                if stem.startswith("p") and stem[1:].isdigit():
+                    return (0, int(stem[1:]), name)
+                return (1, 0, name)
+
+            names = sorted(os.listdir(self.directory), key=_order)
+        except OSError:
+            return []
+        for name in names:
+            if not (name.startswith("plan-") and name.endswith(".json")):
+                continue
+            path = os.path.join(self.directory, name)
+            try:
+                with open(path) as f:
+                    out.append(json.load(f))
+            except (OSError, ValueError) as e:
+                logger.warning(
+                    "skipping unreadable journal record %s (%s: %s)",
+                    path, type(e).__name__, e,
+                )
+        return out
+
+    def unfinished(self) -> List[Dict[str, Any]]:
+        """The records recovery re-executes: submitted, never
+        terminal."""
+        return [e for e in self.entries() if e.get("state") == SUBMITTED]
+
+    def entry(self, plan_id: str) -> Optional[Dict[str, Any]]:
+        try:
+            with open(self._path(plan_id)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None

@@ -1,0 +1,191 @@
+"""``execute_plan``: one validated plan, executed in its own fault
+domain.
+
+This is the run-orchestration half that used to live inline in
+``PipelineBuilder.execute`` — persistent compile cache, chaos plan,
+telemetry, per-run metrics, the crash flight recorder, the report
+write — lifted out so the multi-tenant executor and the legacy
+single-query entry point share ONE code path (the parity contract:
+``PipelineBuilder.execute`` is now a thin shim over
+``ExecutionPlan.parse`` + this function, and every statistic it
+produced before the split it produces after, byte-identical).
+
+The per-plan **fault domain** (obs/domain.py) is what changed shape:
+the chaos plan, the span recorder, and the per-run metrics child are
+no longer process-global installations but fields of a
+:class:`~eeg_dataanalysispackage_tpu.obs.domain.RunDomain` activated
+on the executing thread and adopted by every worker thread the plan
+spawns. Two plans running concurrently therefore cannot see each
+other's ``faults=`` spec, cannot count into each other's metrics
+scope, and write two disjoint span trees and ``run_report.json``
+artifacts — the fault-isolation pin in tests/test_scheduler.py.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import time
+from typing import Optional
+
+from .. import obs
+from ..obs import chaos, domain as run_domain
+
+logger = logging.getLogger(__name__)
+
+#: "no fault plan was passed — resolve one from the plan/env" (the
+#: executor passes an explicit plan, possibly None, so retries share
+#: one plan and its call accounting across attempts)
+_RESOLVE = object()
+
+
+def execute_plan(
+    plan,
+    builder,
+    plan_id: Optional[str] = None,
+    fault_plan=_RESOLVE,
+    default_report_dir: Optional[str] = None,
+):
+    """Run ``plan`` through ``builder`` inside a fresh fault domain;
+    returns the statistics (and leaves the builder's per-run
+    attributes — timers, telemetry, run_metrics, degradation history,
+    precision/overlap/mesh resolution — populated exactly as the
+    monolithic ``execute`` did).
+
+    ``fault_plan`` — the parsed chaos plan governing this execution.
+    Defaults to resolving ``plan.faults`` (or ``EEG_TPU_FAULTS``)
+    fresh; the executor resolves once per submission and passes it in,
+    so a retried plan keeps ONE set of rule call counters (a
+    ``once@N`` fault absorbed by attempt 1 stays absorbed, a ``p=``
+    stream keeps advancing instead of deterministically re-firing).
+
+    ``default_report_dir`` — where the run's telemetry goes when the
+    query itself didn't say (the executor assigns each plan its own
+    directory under its report root); an explicit ``report=`` in the
+    query — including ``report=false`` — always wins.
+    """
+    query_map = plan.query_map
+    logger.info("query: %s", query_map)
+
+    # persistent XLA compilation cache before any device work:
+    # fresh-chip compiles of the fused variants ran 10-14 min in the
+    # r4 sweep, and a repeat run of the same query must read the
+    # serialized executable instead (utils/compile_cache)
+    from ..utils import compile_cache
+
+    cache_dir = compile_cache.enable_persistent_cache()
+    if cache_dir:
+        logger.info("persistent compile cache: %s", cache_dir)
+
+    if fault_plan is _RESOLVE:
+        spec = plan.faults or chaos.plan_from_env()
+        fault_plan = (
+            chaos.parse_fault_spec(spec, seed=plan.faults_seed)
+            if spec
+            else None
+        )
+
+    # structured run telemetry (obs/events.py + obs/report.py): the
+    # report dir resolves from the query (report= / result_path /
+    # EEG_TPU_RUN_REPORT_DIR) exactly as before; the executor's
+    # per-plan default fills in only when the query said nothing.
+    from ..obs import report as run_report
+
+    builder.telemetry = None
+    builder.degradation_history = []
+    builder.precision_resolved = None
+    builder.overlap_resolved = None
+    builder.mesh_resolved = None
+    # fresh per run, like the metrics scope below: a reused builder
+    # must not report run 1's stage seconds under run 2
+    builder.timers = obs.StageTimer()
+    report_dir = run_report.resolve_report_dir(query_map)
+    if (
+        report_dir is not None
+        and plan_id is not None
+        and not query_map.get("report", "")
+    ):
+        # the dir came from EEG_TPU_RUN_REPORT_DIR (no report= in the
+        # query) and this is an executor-identified plan: N concurrent
+        # tenants resolving the ambient env var to ONE directory would
+        # clobber each other's run_report.json/spans.jsonl (last
+        # atomic write wins) — each gets its plan's subdirectory, the
+        # same per-plan tree an executor report root builds. A solo
+        # run (no plan id) keeps the env dir itself, byte-identically.
+        report_dir = os.path.join(report_dir, plan_id)
+    if (
+        report_dir is None
+        and default_report_dir
+        and query_map.get("report", "") != "false"
+    ):
+        report_dir = default_report_dir
+    if report_dir:
+        try:
+            builder.telemetry = run_report.RunTelemetry(
+                plan.query, query_map, report_dir
+            )
+            builder.telemetry.plan_id = plan_id
+            # the builder appends rung drops as they happen; the
+            # report reads this shared list
+            builder.telemetry.degradation = builder.degradation_history
+        except OSError as e:
+            logger.warning(
+                "run telemetry unavailable (%s: %s); running "
+                "unreported", type(e).__name__, e,
+            )
+    telemetry = builder.telemetry
+    comp_scope = (
+        telemetry.compilation
+        if telemetry is not None
+        else contextlib.nullcontext()
+    )
+
+    # the plan's fault domain: chaos spec, span recorder, and metrics
+    # child all scoped to THIS plan's threads (worker threads adopt it
+    # — io/staging, io/provider, serve/batcher)
+    run_metrics = obs.Metrics()
+    domain = run_domain.RunDomain(
+        plan_id=plan_id,
+        chaos=fault_plan,
+        recorder=None if telemetry is None else telemetry.recorder,
+        metrics=run_metrics,
+    )
+    builder.run_metrics = run_metrics
+
+    start = time.perf_counter()
+    with run_domain.activate(domain), comp_scope:
+        try:
+            # the scheduler's own injection point: one execution
+            # attempt of a submitted plan (fires only when the
+            # governing fault plan carries a scheduler.plan rule; the
+            # executor's per-plan retry budget absorbs it)
+            chaos.maybe_fire("scheduler.plan")
+            # net-new observability: trace_path=<dir> wraps the run
+            # in a jax.profiler trace (device + annotated host
+            # activity), viewable in TensorBoard/Perfetto
+            if plan.trace_path:
+                with obs.trace(plan.trace_path):
+                    statistics = builder._execute(plan)
+            else:
+                statistics = builder._execute(plan)
+        except Exception as e:
+            # flight recorder: dumped INSIDE the fault domain so the
+            # crash artifact carries the active chaos plan with its
+            # per-rule firing counts — and this plan's counters only
+            if telemetry is not None:
+                telemetry.dump_crash(e, builder.timers, run_metrics)
+            raise
+        if telemetry is not None:
+            # written inside the domain too, so a SUCCESSFUL chaos
+            # run's report still records the plan's per-rule
+            # call/firing accounting; and guarded — a telemetry write
+            # failure must never fail the run it observed
+            try:
+                telemetry.write_report(
+                    statistics, builder.timers, run_metrics,
+                    wall_s=time.perf_counter() - start,
+                )
+            except OSError as e:
+                logger.error("run report write failed: %s", e)
+    return statistics

@@ -42,7 +42,7 @@ from typing import Any, Callable, List, Optional
 
 from .. import obs
 from ..io import deadline as deadline_mod
-from ..obs import chaos, events
+from ..obs import chaos, domain as run_domain, events
 
 
 class ServeError(RuntimeError):
@@ -188,6 +188,14 @@ class AdmissionQueue:
         with self._lock:
             return len(self._items)
 
+    @property
+    def last_shed_evidence(self) -> str:
+        """Human-readable evidence for the most recent shed decision
+        (the plan executor embeds it in :class:`ShedError` subclasses
+        too — the shed-with-evidence contract is shared machinery)."""
+        with self._lock:
+            return self._last_shed_evidence
+
     def offer(self, request: Request, block_s: float = 0.0) -> bool:
         """Admit one request; False = full (the caller sheds). With
         ``block_s`` the caller cooperates with backpressure by waiting
@@ -312,16 +320,27 @@ class MicroBatcher:
     # -- lifecycle ------------------------------------------------------
 
     def start(self) -> None:
+        # both service threads adopt the starter's per-plan fault
+        # domain: the serve.request/serve.batch chaos points and the
+        # batcher's counters/spans stay inside the plan that owns this
+        # service when the multi-tenant executor runs several at once
+        domain = run_domain.capture()
         self._thread = threading.Thread(
-            target=self._run, name=f"eeg-tpu-{self.name}-batcher",
+            target=lambda: self._adopted(domain, self._run),
+            name=f"eeg-tpu-{self.name}-batcher",
             daemon=True,
         )
         self._thread.start()
         self._watchdog_thread = threading.Thread(
-            target=self._watchdog_run,
+            target=lambda: self._adopted(domain, self._watchdog_run),
             name=f"eeg-tpu-{self.name}-watchdog", daemon=True,
         )
         self._watchdog_thread.start()
+
+    @staticmethod
+    def _adopted(domain, body) -> None:
+        with run_domain.adopt(domain):
+            body()
 
     def stop(self, join_timeout_s: float = 5.0) -> None:
         self._stop.set()

@@ -252,3 +252,39 @@ def test_breaker_wraps_http_filesystem(flaky_server, monkeypatch):
         assert fs.read_bytes(f"{base}/x.bin") == b"alive"
     finally:
         circuit.reset()
+
+
+# -- plan-tagged evidence + snapshot (ISSUE 10 satellite) --------------
+
+
+def test_evidence_is_plan_tagged_inside_a_domain():
+    """Failures recorded while a plan's fault domain is active carry
+    the plan id; snapshot() aggregates the contributors — the
+    cross-tenant attribution both plans' reports embed
+    (docs/resilience.md)."""
+    from eeg_dataanalysispackage_tpu.obs import domain as run_domain
+
+    b = circuit.CircuitBreaker("http://snap.example:1", threshold=2)
+    with run_domain.activate(run_domain.RunDomain(plan_id="pA")):
+        b.record_failure(IOError("boom 1"))
+    b.record_failure(IOError("boom 2"))  # outside any domain: untagged
+    snap = b.snapshot()
+    assert snap["state"] == "open"
+    assert snap["consecutive_failures"] == 2
+    assert snap["evidence"][0] == "[plan pA] OSError: boom 1"
+    assert snap["evidence"][1] == "OSError: boom 2"
+    assert snap["contributing_plans"] == ["pA"]
+    # the fast-fail message a SECOND tenant sees carries the tag too
+    with pytest.raises(circuit.CircuitOpenError, match=r"\[plan pA\]"):
+        b.allow()
+
+
+def test_registry_snapshot_is_schema_stable():
+    circuit.reset()
+    assert circuit.snapshot() == {}
+    b = circuit.breaker_for("http://reg.example:9870")
+    b.record_failure(IOError("x"))
+    snap = circuit.snapshot()
+    assert set(snap) == {"http://reg.example:9870"}
+    assert snap["http://reg.example:9870"]["total_failures"] == 1
+    circuit.reset()

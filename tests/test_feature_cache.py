@@ -228,3 +228,123 @@ def test_fanout_unknown_classifier_uses_reference_error(tmp_path):
         builder.PipelineBuilder(
             _query(info, classifier="classifiers=nosuch")
         ).execute()
+
+
+# -- single-flight rebuild guard (ISSUE 10 satellite) ------------------
+
+
+def test_single_flight_one_rebuild_kept(tmp_path):
+    """Two threads racing the same missing key: the leader rebuilds
+    and stores; the follower blocks in begin_build, its post-wait
+    lookup hits the leader's entry, and exactly one rebuild is KEPT —
+    deterministic interleaving via events, no sleeps on the assert
+    path."""
+    import threading
+
+    cache = feature_cache.FeatureCache(str(tmp_path / "fc"))
+    key = "a" * 40
+    features = np.ones((4, 3), np.float32)
+    targets = np.zeros(4, np.float64)
+
+    leader_building = threading.Event()
+    leader_may_store = threading.Event()
+    builds, results, waited_flags = [], {}, {}
+
+    def leader():
+        slot = cache.begin_build(key)
+        try:
+            assert cache.lookup(key) is None  # genuine miss
+            leader_building.set()
+            assert leader_may_store.wait(10)
+            builds.append("leader")
+            cache.store(key, features, targets)
+            results["leader"] = (features, targets)
+        finally:
+            slot.release()
+        waited_flags["leader"] = slot.waited
+
+    def follower():
+        assert leader_building.wait(10)
+        leader_may_store.set()
+        # blocks until the leader releases; the entry exists by then
+        slot = cache.begin_build(key)
+        try:
+            hit = cache.lookup(key)
+            assert hit is not None, "follower must revalidate-hit"
+            results["follower"] = hit
+        finally:
+            slot.release()
+        waited_flags["follower"] = slot.waited
+
+    t1 = threading.Thread(target=leader)
+    t2 = threading.Thread(target=follower)
+    t1.start()
+    t2.start()
+    t1.join(timeout=15)
+    t2.join(timeout=15)
+    assert not t1.is_alive() and not t2.is_alive()
+
+    assert builds == ["leader"]  # exactly one rebuild kept
+    assert waited_flags == {"leader": False, "follower": True}
+    np.testing.assert_array_equal(results["follower"][0], features)
+    np.testing.assert_array_equal(results["follower"][1], targets)
+
+
+def test_single_flight_release_is_idempotent_and_unblocks(tmp_path):
+    cache = feature_cache.FeatureCache(str(tmp_path / "fc"))
+    slot = cache.begin_build("k" * 40)
+    slot.release()
+    slot.release()  # double release must not corrupt the flight set
+    # the key is free again: a fresh acquisition does not wait
+    slot2 = cache.begin_build("k" * 40)
+    assert not slot2.waited
+    slot2.release()
+
+
+def test_single_flight_wait_honours_ambient_deadline(tmp_path):
+    """A deadline-bearing plan queued behind another tenant's rebuild
+    fails fast: begin_build's wait re-checks the ambient deadline
+    scope instead of blocking unboundedly past the budget."""
+    import threading
+
+    from eeg_dataanalysispackage_tpu.io import deadline as deadline_mod
+
+    cache = feature_cache.FeatureCache(str(tmp_path / "fc"))
+    key = "d" * 40
+    leader_slot = cache.begin_build(key)
+    outcome = {}
+
+    def waiter():
+        with deadline_mod.deadline_scope(deadline_mod.Deadline(0.2)):
+            try:
+                slot = cache.begin_build(key)
+            except deadline_mod.DeadlineExceededError as e:
+                outcome["error"] = e
+            else:  # pragma: no cover - the failure mode under test
+                slot.release()
+                outcome["error"] = None
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive(), "waiter blocked past its deadline"
+    assert isinstance(outcome["error"], deadline_mod.DeadlineExceededError)
+    leader_slot.release()
+    # the key is free again for deadline-free builders
+    slot = cache.begin_build(key)
+    assert not slot.waited
+    slot.release()
+
+
+def test_try_begin_build_nonblocking(tmp_path):
+    """try_begin_build: None while another builder holds the key (the
+    store-only caller skips instead of queuing), a real slot when
+    free."""
+    cache = feature_cache.FeatureCache(str(tmp_path / "fc"))
+    key = "t" * 40
+    held = cache.begin_build(key)
+    assert cache.try_begin_build(key) is None  # no wait, no slot
+    held.release()
+    slot = cache.try_begin_build(key)
+    assert slot is not None and not slot.waited
+    slot.release()

@@ -1,0 +1,723 @@
+"""Multi-tenant plan executor suite (ISSUE 10).
+
+The acceptance pins:
+
+- **IR parity** — a query run through ``ExecutionPlan`` +
+  ``PlanExecutor`` produces statistics byte-identical to the (now
+  shimmed) ``PipelineBuilder.execute`` path;
+- **fault isolation** — a ``faults=``-injected failing plan and a
+  forced mesh-unavailable plan run concurrently with a clean plan
+  whose statistics, metrics scope, and run report are identical to a
+  solo run;
+- **crash-only** — SIGKILL mid-batch, restart, journal recovery
+  resumes every unfinished plan to byte-identical statistics and
+  never re-runs a completed one;
+- **admission** — bounded queue, shed-with-evidence, queued-deadline
+  fail-fast (the serve/batcher machinery, reused);
+- **chaos** — the new ``scheduler.plan``/``scheduler.journal`` points:
+  a p=0.2 soak over 8 concurrent plans resolves every plan with
+  clean-twin statistics;
+- **cross-tenant circuit evidence** — plan B fast-fails on an endpoint
+  plan A opened, and both plans' crash reports name A as the
+  contributor.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+import _synthetic
+from eeg_dataanalysispackage_tpu import obs
+from eeg_dataanalysispackage_tpu.io import circuit, deadline as deadline_mod
+from eeg_dataanalysispackage_tpu.io import remote
+from eeg_dataanalysispackage_tpu.obs import chaos, domain as run_domain
+from eeg_dataanalysispackage_tpu.pipeline import builder
+from eeg_dataanalysispackage_tpu.scheduler import (
+    PlanExecutor,
+    PlanFailedError,
+    PlanShedError,
+)
+from eeg_dataanalysispackage_tpu.scheduler import runtime as runtime_mod
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_ambient():
+    """No leaked chaos plan or fault domain across tests — the same
+    hygiene contract test_chaos pins for the global plan, extended to
+    the domain stack."""
+    assert chaos.active_plan() is None
+    assert run_domain.current() is None
+    yield
+    chaos.uninstall()
+    assert run_domain.current() is None
+
+
+@pytest.fixture()
+def session(tmp_path):
+    return _synthetic.write_session(str(tmp_path), n_markers=60)
+
+
+def _q(info, extra="", clf="logreg"):
+    return (
+        f"info_file={info}&fe=dwt-8&train_clf={clf}"
+        "&config_step_size=1.0&config_num_iterations=20"
+        "&config_mini_batch_fraction=1.0" + extra
+    )
+
+
+def _counters(result):
+    """The plan's ISOLATED per-run counters (its domain's metrics
+    child)."""
+    return result.builder.run_metrics.snapshot()["counters"]
+
+
+# -- IR parity ---------------------------------------------------------
+
+
+def test_executor_matches_direct_builder(session, tmp_path):
+    direct = builder.PipelineBuilder(_q(session)).execute()
+    with PlanExecutor(max_concurrent=2) as ex:
+        result = ex.submit(_q(session)).result(timeout=300)
+    assert str(result.statistics) == str(direct)
+    assert result.plan_id == "p0001"
+    assert result.attempts == 1
+    assert not result.recovered
+
+
+def test_executor_fused_parity(session):
+    fused_q = _q(session).replace("fe=dwt-8", "fe=dwt-8-fused")
+    direct = builder.PipelineBuilder(fused_q).execute()
+    with PlanExecutor() as ex:
+        result = ex.submit(fused_q).result(timeout=300)
+    assert str(result.statistics) == str(direct)
+
+
+def test_invalid_query_rejected_before_journal(session, tmp_path):
+    """Parse/validation errors surface at submit() and never touch
+    the journal or the queue."""
+    with PlanExecutor(journal_dir=str(tmp_path / "j")) as ex:
+        with pytest.raises(ValueError, match="Missing classifier"):
+            ex.submit(f"info_file={session}&fe=dwt-8")
+        assert ex.journal.entries() == []
+
+
+# -- admission control (the reused serve/batcher machinery) ------------
+
+
+def test_shed_with_evidence(monkeypatch, session, tmp_path):
+    release = threading.Event()
+
+    def blocked_execute(plan, builder_, plan_id=None, fault_plan=None,
+                        default_report_dir=None):
+        assert release.wait(30), "test never released the worker"
+        return f"done-{plan_id}"
+
+    monkeypatch.setattr(runtime_mod, "execute_plan", blocked_execute)
+    ex = PlanExecutor(
+        max_concurrent=1, queue_depth=1,
+        journal_dir=str(tmp_path / "j"),
+    )
+    try:
+        h1 = ex.submit(_q(session))
+        # the single worker pops h1 and blocks; h2 fills the depth-1
+        # queue; h3 must shed AT THE DOOR with evidence
+        deadline = time.monotonic() + 5.0
+        while len(ex.queue) != 0 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        h2 = ex.submit(_q(session))
+        with pytest.raises(
+            PlanShedError, match="shed at admission.*depth 1"
+        ):
+            ex.submit(_q(session))
+        # the shed is journaled as terminal evidence, never queued
+        entry = ex.journal.entry("p0003")
+        assert entry["state"] == "failed"
+        assert "shed at admission" in entry["error"]
+        release.set()
+        assert h1.result(timeout=30).statistics == "done-p0001"
+        assert h2.result(timeout=30).statistics == "done-p0002"
+    finally:
+        release.set()
+        ex.close()
+
+
+def test_queued_deadline_fails_fast(monkeypatch, session):
+    release = threading.Event()
+
+    def blocked_execute(plan, builder_, plan_id=None, fault_plan=None,
+                        default_report_dir=None):
+        assert release.wait(30)
+        return f"done-{plan_id}"
+
+    monkeypatch.setattr(runtime_mod, "execute_plan", blocked_execute)
+    ex = PlanExecutor(max_concurrent=1, queue_depth=4)
+    try:
+        ex.submit(_q(session))  # blocks the worker
+        h2 = ex.submit(_q(session), deadline_s=0.05)
+        time.sleep(0.2)  # h2's budget dies in the queue
+        release.set()
+        with pytest.raises(
+            deadline_mod.DeadlineExceededError, match="never executed"
+        ):
+            h2.result(timeout=30)
+    finally:
+        release.set()
+        ex.close()
+
+
+# -- retries + the scheduler.plan chaos point --------------------------
+
+
+def test_scheduler_plan_chaos_absorbed_by_retry(session):
+    clean = builder.PipelineBuilder(_q(session)).execute()
+    before = obs.metrics.snapshot()["counters"]
+    with PlanExecutor(max_attempts=3) as ex:
+        result = ex.submit(
+            _q(session, "&faults=scheduler.plan:once@1")
+        ).result(timeout=300)
+    assert str(result.statistics) == str(clean)
+    assert result.attempts == 2  # attempt 1 chaos-failed, 2 clean
+    after = obs.metrics.snapshot()["counters"]
+    assert (
+        after.get("chaos.fired.scheduler.plan", 0)
+        - before.get("chaos.fired.scheduler.plan", 0)
+    ) == 1
+    assert (
+        after.get("scheduler.retries", 0)
+        - before.get("scheduler.retries", 0)
+    ) == 1
+
+
+def test_retry_budget_exhaustion_fails_with_history(session, tmp_path):
+    with PlanExecutor(
+        max_attempts=2, journal_dir=str(tmp_path / "j")
+    ) as ex:
+        h = ex.submit(_q(session, "&faults=scheduler.plan:every@1"))
+        with pytest.raises(PlanFailedError, match="attempt 2"):
+            h.result(timeout=300)
+        entry = ex.journal.entry(h.plan_id)
+    assert entry["state"] == "failed"
+    assert entry["attempts"] == 2
+    assert "retry budget" in entry["error"]
+
+
+def test_journal_chaos_degrades_to_unjournaled(session, tmp_path):
+    """scheduler.journal faults on EVERY write (both the in-journal
+    retry attempts): the plan still completes with clean statistics —
+    the journal records the run, it cannot kill it."""
+    clean = builder.PipelineBuilder(_q(session)).execute()
+    before = obs.metrics.snapshot()["counters"]
+    with PlanExecutor(journal_dir=str(tmp_path / "j")) as ex:
+        result = ex.submit(
+            _q(session, "&faults=scheduler.journal:every@1")
+        ).result(timeout=300)
+        assert ex.journal.entries() == []  # every write degraded
+    assert str(result.statistics) == str(clean)
+    after = obs.metrics.snapshot()["counters"]
+    assert (
+        after.get("scheduler.journal_write_failed", 0)
+        - before.get("scheduler.journal_write_failed", 0)
+    ) >= 2
+
+
+# -- the fault-isolation pin -------------------------------------------
+
+
+def test_concurrent_fault_domains_are_isolated(session, tmp_path):
+    """A chaos-degraded plan and a mesh-unavailable plan run
+    concurrently with a clean plan; the clean plan's statistics,
+    per-plan metrics scope, degradation history, and run report are
+    identical to its solo run — fault domains don't leak."""
+    clean_q = _q(session).replace("fe=dwt-8", "fe=dwt-8-fused-block")
+    faulted_q = clean_q + "&faults=ingest.fused:once@1"
+    # more devices than any host here has: mesh-unavailable -> the
+    # ladder's top rung degrades to single-device, recorded
+    mesh_q = _q(session, "&devices=64")
+
+    with PlanExecutor(
+        max_concurrent=3, report_root=str(tmp_path / "solo")
+    ) as ex:
+        solo = ex.submit(clean_q).result(timeout=300)
+    solo_report = json.load(
+        open(tmp_path / "solo" / solo.plan_id / "run_report.json")
+    )
+
+    with PlanExecutor(
+        max_concurrent=3, report_root=str(tmp_path / "multi")
+    ) as ex:
+        h_clean = ex.submit(clean_q)
+        h_fault = ex.submit(faulted_q)
+        h_mesh = ex.submit(mesh_q)
+        clean = h_clean.result(timeout=300)
+        faulted = h_fault.result(timeout=300)
+        meshed = h_mesh.result(timeout=300)
+
+    # every plan resolved with the SAME statistics (chaos absorbed by
+    # the ladder, mesh-unavailable degraded to the single-device path)
+    assert str(clean.statistics) == str(solo.statistics)
+    assert str(faulted.statistics) == str(solo.statistics)
+    host_clean = builder.PipelineBuilder(_q(session)).execute()
+    assert str(meshed.statistics) == str(host_clean)
+
+    # the clean plan's ISOLATED telemetry shows no trace of its
+    # neighbours' faults
+    cc = _counters(clean)
+    assert cc.get("pipeline.degraded", 0) == 0
+    assert cc.get("pipeline.mesh_unavailable", 0) == 0
+    assert not any(k.startswith("chaos.fired") for k in cc)
+    assert clean.builder.degradation_history == []
+    assert clean.builder.mesh_resolved is None
+
+    # the faulted plan degraded INSIDE its own domain
+    fc = _counters(faulted)
+    assert fc.get("pipeline.degraded", 0) == 1
+    assert fc.get("chaos.fired.ingest.fused", 0) == 1
+    assert faulted.builder.degradation_history
+
+    # the mesh plan degraded its mesh rung without touching anyone
+    mc = _counters(meshed)
+    assert mc.get("pipeline.mesh_unavailable", 0) == 1
+    assert meshed.builder.mesh_resolved["rung"] == "single_device"
+    assert "error" in meshed.builder.mesh_resolved
+    assert clean.builder.run_metrics is not faulted.builder.run_metrics
+
+    # run-report pin: the concurrent clean report tells the solo story
+    clean_report = json.load(
+        open(tmp_path / "multi" / clean.plan_id / "run_report.json")
+    )
+    assert (
+        clean_report["statistics_sha256"]
+        == solo_report["statistics_sha256"]
+    )
+    assert clean_report["degradation"] == []
+    assert clean_report["chaos"] is None
+    assert clean_report["mesh"] is None
+    assert clean_report["plan_id"] == clean.plan_id
+    # and the faulted neighbour's report carries ITS chaos accounting
+    fault_report = json.load(
+        open(tmp_path / "multi" / faulted.plan_id / "run_report.json")
+    )
+    assert fault_report["chaos"]["rules"]["ingest.fused"]["fired"] == 1
+    assert fault_report["degradation"]
+
+
+# -- the concurrent-plan chaos soak (satellite) ------------------------
+
+
+@pytest.mark.chaos
+def test_chaos_soak_eight_concurrent_plans(session):
+    """p=0.2 scheduler.plan + scheduler.journal faults on 8 concurrent
+    plans: every plan resolves and every plan's statistics equal the
+    clean twin's."""
+    clean = builder.PipelineBuilder(_q(session)).execute()
+    with PlanExecutor(max_concurrent=4, max_attempts=6) as ex:
+        handles = [
+            ex.submit(_q(
+                session,
+                "&faults=scheduler.plan:p=0.2;scheduler.journal:p=0.2"
+                f"&faults_seed={i}",
+            ))
+            for i in range(8)
+        ]
+        results = [h.result(timeout=600) for h in handles]
+    assert len(results) == 8
+    for r in results:
+        assert str(r.statistics) == str(clean)
+    # the soak genuinely injected (deterministic seeds; seed sweep
+    # chosen so at least one plan retried)
+    assert any(r.attempts > 1 for r in results)
+
+
+# -- crash-only recovery (SIGKILL) -------------------------------------
+
+_CRASH_CHILD = """
+import os, signal, sys
+
+sys.path.insert(0, {repo!r})
+from eeg_dataanalysispackage_tpu.scheduler import PlanExecutor
+
+journal_dir, qa, qb, qc = sys.argv[1:5]
+ex = PlanExecutor(max_concurrent=1, journal_dir=journal_dir)
+ex.submit(qa).result(timeout=600)   # plan 1 COMPLETES before the kill
+ex.submit(qb)                        # plan 2: mid-batch or queued
+ex.submit(qc)                        # plan 3: queued
+os.kill(os.getpid(), signal.SIGKILL)
+"""
+
+
+@pytest.mark.chaos
+def test_sigkill_recovery_resumes_unfinished_exactly_once(
+    session, tmp_path
+):
+    """kill -9 mid-batch -> restart -> the journal resumes every
+    unfinished plan to statistics byte-identical to uninterrupted
+    twins; the completed plan's record is untouched and it is not
+    re-run."""
+    journal_dir = str(tmp_path / "journal")
+    qa = _q(session)
+
+    # B and C train long enough (fresh compile at the new static
+    # iteration count + ~1.5e5 steps) that the child CANNOT finish
+    # them in the instants between submit and SIGKILL — the kill is
+    # genuinely mid-batch
+    def _slow(step):
+        return (
+            f"info_file={session}&fe=dwt-8&train_clf=logreg"
+            f"&config_step_size={step}&config_num_iterations=150000"
+            "&config_mini_batch_fraction=1.0"
+        )
+
+    qb, qc = _slow("0.5"), _slow("0.25")
+
+    child = tmp_path / "crash_child.py"
+    child.write_text(_CRASH_CHILD.format(repo=_REPO))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, str(child), journal_dir, qa, qb, qc],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == -signal.SIGKILL, proc.stderr[-2000:]
+
+    # the write-ahead journal survived the kill: 1 completed, 2
+    # unfinished
+    ex = PlanExecutor(max_concurrent=2, journal_dir=journal_dir)
+    states = {
+        e["plan_id"]: e["state"] for e in ex.journal.entries()
+    }
+    assert states["p0001"] == "completed"
+    assert states["p0002"] == "submitted"
+    assert states["p0003"] == "submitted"
+    completed_record_before = open(
+        os.path.join(journal_dir, "plan-p0001.json")
+    ).read()
+
+    # uninterrupted twins, run directly in THIS process
+    twins = {
+        q: str(builder.PipelineBuilder(q).execute())
+        for q in (qa, qb, qc)
+    }
+
+    recovery = ex.recover()
+    try:
+        assert [e["plan_id"] for e in recovery["completed"]] == ["p0001"]
+        assert recovery["failed"] == []
+        resumed = {
+            h.query: h.result(timeout=600)
+            for h in recovery["resumed"]
+        }
+    finally:
+        ex.close()
+    assert set(resumed) == {qb, qc}
+    for q, result in resumed.items():
+        assert str(result.statistics) == twins[q], q
+        assert result.recovered
+
+    # exactly-once completion: the dead process's completed record is
+    # byte-untouched (never re-run, never re-recorded) and carries the
+    # twin statistics
+    assert open(
+        os.path.join(journal_dir, "plan-p0001.json")
+    ).read() == completed_record_before
+    assert recovery["completed"][0]["statistics"] == twins[qa]
+    # the journal is now fully terminal
+    ex2 = PlanExecutor(journal_dir=journal_dir)
+    assert ex2.journal.unfinished() == []
+    ex2.close()
+
+
+# -- shared-cache single flight across plans (satellite) ---------------
+
+
+def test_concurrent_plans_single_flight_feature_cache(
+    session, tmp_path, monkeypatch
+):
+    """Two plans missing the same feature-cache entry: exactly one
+    rebuild is KEPT (one store), the loser blocks on the single-flight
+    guard and hits, and both plans' statistics are identical."""
+    monkeypatch.delenv("EEG_TPU_NO_FEATURE_CACHE", raising=False)
+    monkeypatch.setenv(
+        "EEG_TPU_FEATURE_CACHE_DIR", str(tmp_path / "fc")
+    )
+    q = _q(session).replace("fe=dwt-8", "fe=dwt-8-fused")
+    before = obs.metrics.snapshot()["counters"]
+    with PlanExecutor(max_concurrent=2) as ex:
+        h1 = ex.submit(q)
+        h2 = ex.submit(q)
+        r1 = h1.result(timeout=300)
+        r2 = h2.result(timeout=300)
+    after = obs.metrics.snapshot()["counters"]
+    assert str(r1.statistics) == str(r2.statistics)
+    assert (
+        after.get("feature_cache.store", 0)
+        - before.get("feature_cache.store", 0)
+    ) == 1
+    assert (
+        after.get("feature_cache.hit", 0)
+        - before.get("feature_cache.hit", 0)
+    ) >= 1
+
+
+# -- cross-tenant circuit-breaker evidence (satellite) -----------------
+
+
+@pytest.mark.chaos
+def test_circuit_evidence_names_the_opening_plan(tmp_path, monkeypatch):
+    """io/circuit state is process-global per endpoint BY DESIGN: plan
+    B fast-fails on an endpoint plan A opened. Pinned here: B's
+    failure (and both crash reports) name plan A's id as the
+    contributor of the opening evidence."""
+    monkeypatch.setenv("EEG_TPU_CIRCUIT_THRESHOLD", "1")
+    monkeypatch.setenv("EEG_TPU_CIRCUIT_COOLDOWN", "600")
+    circuit.reset()
+    # a port nothing listens on: connection refused, fast
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    fs = remote.HttpFileSystem(
+        retry=remote.RetryPolicy(
+            max_attempts=2, timeout_s=2.0, backoff_s=0.01
+        )
+    )
+    dead = f"http://127.0.0.1:{port}/info.txt"
+    q = f"info_file={dead}&fe=dwt-8&train_clf=logreg"
+    try:
+        with PlanExecutor(
+            max_concurrent=1, max_attempts=1, filesystem=fs,
+            report_root=str(tmp_path / "reports"),
+        ) as ex:
+            ha = ex.submit(q)
+            with pytest.raises(PlanFailedError):
+                ha.result(timeout=120)
+            hb = ex.submit(q)
+            with pytest.raises(PlanFailedError) as excinfo:
+                hb.result(timeout=120)
+        # B's fast-fail carries A's tagged evidence
+        assert "circuit open" in str(excinfo.value)
+        assert "[plan p0001]" in str(excinfo.value)
+        snap = circuit.snapshot()
+        entry = next(iter(snap.values()))
+        assert entry["state"] == "open"
+        assert entry["contributing_plans"] == ["p0001"]
+        # both tenants' crash reports embed the circuit block naming A
+        for plan_id in ("p0001", "p0002"):
+            crash = json.load(open(
+                tmp_path / "reports" / plan_id / "crash_report.json"
+            ))
+            block = next(iter(crash["circuit"].values()))
+            assert block["contributing_plans"] == ["p0001"]
+    finally:
+        circuit.reset()
+
+
+# -- review-round regressions ------------------------------------------
+
+
+def test_recovery_never_sheds(session, tmp_path):
+    """Journal recovery re-admits past the depth check (the batcher's
+    readmit rule): a backlog bigger than queue_depth must resume
+    every unfinished plan, not mark the overflow terminally failed."""
+    from eeg_dataanalysispackage_tpu.scheduler import PlanJournal
+
+    journal_dir = str(tmp_path / "j")
+    journal = PlanJournal(journal_dir)
+    queries = {
+        f"p{i:04d}": _q(session, f"&config_step_size={1.0 / i}")
+        for i in range(1, 5)
+    }
+    for pid, q in queries.items():
+        journal.record_submitted(pid, q)
+    ex = PlanExecutor(
+        max_concurrent=1, queue_depth=1, journal_dir=journal_dir
+    )
+    try:
+        recovery = ex.recover()
+        assert len(recovery["resumed"]) == 4  # depth 1 did not shed
+        results = {
+            h.plan_id: h.result(timeout=300)
+            for h in recovery["resumed"]
+        }
+    finally:
+        ex.close()
+    twins = {
+        pid: str(builder.PipelineBuilder(q).execute())
+        for pid, q in queries.items()
+    }
+    for pid, r in results.items():
+        assert str(r.statistics) == twins[pid]
+    assert ex.journal.unfinished() == []
+
+
+def test_closed_executor_refuses_submissions(session):
+    from eeg_dataanalysispackage_tpu.serve.batcher import (
+        ServiceClosedError,
+    )
+
+    ex = PlanExecutor(max_concurrent=1)
+    ex.start()
+    ex.close()
+    with pytest.raises(ServiceClosedError, match="closed"):
+        ex.submit(_q(session))
+
+
+def test_new_executor_ids_never_clobber_a_journal(session, tmp_path):
+    """A fresh executor over an existing journal seeds its id counter
+    PAST the journal's records: submitting before (or without)
+    recover() cannot mint a dead process's id and overwrite its
+    exactly-once completion record."""
+    journal_dir = str(tmp_path / "j")
+    with PlanExecutor(journal_dir=journal_dir) as ex1:
+        r1 = ex1.submit(_q(session)).result(timeout=300)
+    record_before = open(
+        os.path.join(journal_dir, f"plan-{r1.plan_id}.json")
+    ).read()
+    with PlanExecutor(journal_dir=journal_dir) as ex2:
+        r2 = ex2.submit(_q(session)).result(timeout=300)
+    assert r2.plan_id != r1.plan_id
+    assert open(
+        os.path.join(journal_dir, f"plan-{r1.plan_id}.json")
+    ).read() == record_before
+
+
+def test_close_fails_abandoned_queued_handles(monkeypatch, session):
+    """close() must resolve every admitted future: a queued plan the
+    workers never popped fails with ServiceClosedError instead of
+    blocking its caller forever."""
+    from eeg_dataanalysispackage_tpu.serve.batcher import (
+        ServiceClosedError,
+    )
+
+    release = threading.Event()
+
+    def blocked_execute(plan, builder_, plan_id=None, fault_plan=None,
+                        default_report_dir=None):
+        assert release.wait(30)
+        return f"done-{plan_id}"
+
+    monkeypatch.setattr(runtime_mod, "execute_plan", blocked_execute)
+    ex = PlanExecutor(max_concurrent=1, queue_depth=4)
+    h1 = ex.submit(_q(session))  # blocks the worker
+    h2 = ex.submit(_q(session))  # queued, never popped
+    # stop BEFORE releasing: the worker finishes h1 and exits at the
+    # next loop check without ever popping h2 — deterministic
+    ex._stop.set()
+    release.set()
+    ex.close()
+    assert h1.result(timeout=30).statistics == "done-p0001"
+    with pytest.raises(ServiceClosedError, match="abandoned"):
+        h2.result(timeout=30)
+
+
+def test_journal_entries_numeric_order_past_9999(tmp_path):
+    """entries() sorts by the NUMERIC plan id: once the zero-padded
+    counter outgrows 4 digits, 'plan-p10000' must not sort before
+    'plan-p9999' (recovery resumes in submission order)."""
+    from eeg_dataanalysispackage_tpu.scheduler import PlanJournal
+
+    journal = PlanJournal(str(tmp_path / "j"))
+    for pid in ("p10000", "p0002", "p9999", "p0010"):
+        journal.record_submitted(pid, f"query-{pid}")
+    ids = [e["plan_id"] for e in journal.entries()]
+    assert ids == ["p0002", "p0010", "p9999", "p10000"]
+
+
+def test_closed_executor_never_strands_a_submitted_record(
+    session, tmp_path
+):
+    """A submit refused because the executor closed must leave NO
+    'submitted' journal record: the caller was told the plan was
+    never admitted, so a later recover() must not silently re-run
+    it alongside the caller's resubmission."""
+    from eeg_dataanalysispackage_tpu.serve.batcher import (
+        ServiceClosedError,
+    )
+
+    journal_dir = str(tmp_path / "j")
+    ex = PlanExecutor(max_concurrent=1, journal_dir=journal_dir)
+    ex.start()
+    ex.close()
+    with pytest.raises(ServiceClosedError, match="closed"):
+        ex.submit(_q(session))
+    assert ex.journal.entries() == []
+
+
+def test_run_backpressures_past_queue_depth(session):
+    """run(): a batch bigger than queue_depth completes EVERY plan —
+    a shed mid-batch is backpressure (wait for our own in-flight,
+    retry), never silent loss of the already-admitted handles."""
+    ex = PlanExecutor(max_concurrent=1, queue_depth=1)
+    queries = [
+        _q(session, f"&config_step_size={1.0 / i}") for i in range(1, 6)
+    ]
+    try:
+        results = ex.run(queries, timeout_s=300)
+    finally:
+        ex.close()
+    assert len(results) == 5
+    twins = [str(builder.PipelineBuilder(q).execute()) for q in queries]
+    assert [str(r.statistics) for r in results] == twins
+
+
+def test_env_report_dir_is_per_plan_under_executor(
+    session, tmp_path, monkeypatch
+):
+    """EEG_TPU_RUN_REPORT_DIR under the executor: each plan writes to
+    its OWN <env_dir>/<plan_id>/ subtree — N tenants resolving the
+    ambient env var to one directory would clobber each other's
+    run_report.json (last atomic write wins). A solo run (no plan id)
+    keeps the env dir itself."""
+    from eeg_dataanalysispackage_tpu.obs import report as obs_report
+
+    env_dir = tmp_path / "reports"
+    monkeypatch.setenv(obs_report.ENV_REPORT_DIR, str(env_dir))
+    ex = PlanExecutor(max_concurrent=2)
+    try:
+        handles = [ex.submit(_q(session)) for _ in range(2)]
+        for h in handles:
+            h.result(timeout=300)
+    finally:
+        ex.close()
+    for h in handles:
+        per_plan = env_dir / h.plan_id / "run_report.json"
+        assert per_plan.exists(), f"missing {per_plan}"
+    assert not (env_dir / "run_report.json").exists()
+    # solo path unchanged: no plan id -> the env dir itself
+    builder.PipelineBuilder(_q(session)).execute()
+    assert (env_dir / "run_report.json").exists()
+
+
+def test_compilation_monitor_attributes_by_plan_domain():
+    """The process-wide jax.monitoring fan-out routes a compile event
+    only into the monitor owned by the dispatching thread's plan
+    domain; ownerless monitors (solo runs, bare construction) keep
+    recording everything."""
+    from eeg_dataanalysispackage_tpu.obs import domain as run_domain
+    from eeg_dataanalysispackage_tpu.obs import report as obs_report
+
+    event = obs_report._BACKEND_COMPILE_EVENT
+    with run_domain.activate(run_domain.RunDomain(plan_id="pA")):
+        mon_a = obs_report.CompilationMonitor().__enter__()
+    with run_domain.activate(run_domain.RunDomain(plan_id="pB")):
+        mon_b = obs_report.CompilationMonitor().__enter__()
+    mon_free = obs_report.CompilationMonitor().__enter__()
+    try:
+        with run_domain.activate(run_domain.RunDomain(plan_id="pA")):
+            obs_report._on_duration(event, 1.5)
+    finally:
+        mon_a.__exit__()
+        mon_b.__exit__()
+        mon_free.__exit__()
+    assert mon_a.snapshot()["compilations"] == 1
+    assert mon_b.snapshot()["compilations"] == 0  # not B's compile
+    assert mon_free.snapshot()["compilations"] == 1  # ownerless: all

@@ -62,6 +62,17 @@ performance contract holds:
   workload they exist for; the run's ``run_report.json`` carries the
   ``workload`` and per-member ``classification`` blocks.
 
+- the multi-tenant plan executor (scheduler_multi,
+  tools/pipeline_bench.py — ISSUE 10): 4 plans run concurrently are
+  no slower than the same 4 sequential (>= within a 5% scheduling
+  -noise floor) with byte-identical statistics across the phases;
+  every plan wrote its OWN intact run_report.json (plan_id +
+  statistics sha cross-checked); the shared feature cache kept
+  exactly one rebuild under concurrency (the single-flight guard);
+  and a SIGKILLed child's journal recovers every unfinished plan to
+  statistics identical to uninterrupted twins without re-running the
+  completed one;
+
 - the PR 8 ingest gates: the overlap=true cold twin produces
   byte-identical statistics to the serial cold run (double-buffered
   ingest reschedules work, never changes it); the precision=bf16 twin
@@ -168,12 +179,19 @@ def _run_variant(variant: str, n_markers: int, n_files: int,
     env = dict(os.environ)
     if env_extra:
         env.update(env_extra)
+    # report_dir=None: the child manages its own report layout (the
+    # scheduler_multi variant writes one run_report.json PER PLAN
+    # under its executor's report root — a single shared dir would
+    # make the tenants clobber each other's artifact)
+    report_args = (
+        [] if report_dir is None else [f"--report-dir={report_dir}"]
+    )
     proc = subprocess.run(
         [
             sys.executable, _PIPELINE_BENCH, variant,
             str(n_markers), str(n_files),
             f"--data-dir={data_dir}", f"--cache-dir={cache_dir}",
-            f"--report-dir={report_dir}", *extra,
+            *report_args, *extra,
         ],
         capture_output=True,
         text=True,
@@ -390,6 +408,57 @@ def _check_seizure(line: dict, report_dir: str,
         )
 
 
+def _check_scheduler(line: dict, failures: list) -> None:
+    """The multi-tenant executor gate (ISSUE 10): N concurrent plans
+    must not run slower than the same N sequential (>= within a 5%
+    scheduling-noise floor), both phases must produce identical
+    statistics, every plan must have written its own intact
+    run_report.json, the shared feature cache must have kept exactly
+    ONE rebuild under concurrency (single-flight), and the
+    kill-and-resume scenario must have recovered every unfinished
+    plan to twin-identical statistics without re-running the
+    completed one."""
+    sched = line.get("scheduler") or {}
+    if not sched:
+        failures.append("scheduler: no scheduler block on the line")
+        return
+    speedup = sched.get("concurrent_speedup", 0.0)
+    if not speedup >= 0.95:
+        failures.append(
+            f"scheduler: concurrent throughput below sequential "
+            f"(speedup {speedup}; walls "
+            f"{sched.get('wall_concurrent_s')}s vs "
+            f"{sched.get('wall_sequential_s')}s)"
+        )
+    if not sched.get("parity_sequential_vs_concurrent"):
+        failures.append(
+            "scheduler: concurrent statistics drifted from the "
+            "sequential twins"
+        )
+    for phase in ("sequential", "concurrent"):
+        block = sched.get(phase) or {}
+        if not block.get("reports_ok"):
+            failures.append(
+                f"scheduler: {phase} per-plan run_report.json "
+                f"integrity failed"
+            )
+        if block.get("stores") != 1:
+            failures.append(
+                f"scheduler: {phase} phase kept {block.get('stores')} "
+                f"feature rebuilds, not exactly 1 (single-flight)"
+            )
+    crash = sched.get("crash_recovery") or {}
+    if not (
+        crash.get("killed")
+        and crash.get("completed_kept") == 1
+        and crash.get("resumed", 0) >= 1
+        and crash.get("identical")
+    ):
+        failures.append(
+            f"scheduler: kill-and-resume pin failed: {crash}"
+        )
+
+
 def _check_report(tag: str, bench_line: dict, report_dir: str,
                   failures: list, checked: list) -> dict:
     """The run-report half of the gate: the artifact exists, parses,
@@ -556,6 +625,16 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
             os.path.join(tmp, "cache_seizure"), seizure_report_dir,
         )
         _check_seizure(seizure_line, seizure_report_dir, failures)
+        # the multi-tenant executor (ISSUE 10): concurrent >=
+        # sequential, per-plan report integrity, the single-flight
+        # store pin, and the SIGKILL kill-and-resume scenario — all
+        # measured inside the scheduler_multi child over its own
+        # per-phase caches and per-plan report tree
+        scheduler_line = _run_variant(
+            "scheduler_multi", n_markers, n_files,
+            data_dir, os.path.join(tmp, "cache_scheduler"), None,
+        )
+        _check_scheduler(scheduler_line, failures)
         cold_report = _check_report(
             "cold", cold, report_dirs["cold"], failures, reports_checked
         )
@@ -787,6 +866,15 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
             bf16_off_line["report_sha256"] == cold["report_sha256"]
         ),
         "plateau": plateau_summary,
+        "scheduler_concurrent_speedup": (
+            scheduler_line.get("scheduler") or {}
+        ).get("concurrent_speedup"),
+        "scheduler_parity": (
+            scheduler_line.get("scheduler") or {}
+        ).get("parity_sequential_vs_concurrent"),
+        "scheduler_crash_recovery": (
+            scheduler_line.get("scheduler") or {}
+        ).get("crash_recovery"),
         "reports_checked": len(reports_checked),
         "cold_stages": {
             k: v["seconds"] for k, v in cold.get("stages", {}).items()

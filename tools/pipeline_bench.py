@@ -63,6 +63,24 @@ Variants:
                         cost at the swept costs; the smoke gate
                         compares the weighted member against its
                         unweighted twin from the SAME line
+  scheduler_multi       the multi-tenant plan executor
+                        (scheduler/executor.py): N=4 plans sharing one
+                        synthetic session run SEQUENTIALLY (one worker,
+                        fresh cache) and then CONCURRENTLY (4 workers,
+                        fresh cache) after a jit warmup — the line
+                        records the wall-clock pair and ratio
+                        (``concurrent_speedup``), per-plan feature-
+                        cache hit attribution from each plan's
+                        ISOLATED metrics scope, the single-flight
+                        store count (exactly one rebuild kept under
+                        concurrency), per-plan run_report.json
+                        integrity, and a kill-and-resume scenario
+                        (a SIGKILLed child of this script + journal
+                        recovery, statistics pinned identical to
+                        uninterrupted twins)
+  scheduler_suicide     internal: the kill-and-resume child — submits
+                        1 fast + 2 slow plans against --journal-dir,
+                        lets the first complete, SIGKILLs itself
   populate              internal: run the cold query to fill
                         --cache-dir, print nothing (the warm variant's
                         helper child)
@@ -298,6 +316,218 @@ def build_population_query(info: str, mode: str,
     )
 
 
+#: the scheduler_multi member plans: four tenants over ONE session —
+#: distinct classifier configs (so the executor genuinely multi-
+#: tenants) that all share the same fused feature build through the
+#: content-addressed cache + its single-flight guard. Training is
+#: deliberately HEAVY (raised iteration count): the shared feature
+#: build is serialized by design (single-flight — one rebuild kept),
+#: so the concurrency dividend the variant measures is the per-plan
+#: TRAIN stages overlapping (XLA CPU executions release the GIL);
+#: trivially-light plans would measure executor overhead + noise.
+_SCHEDULER_ITERS = 4000
+_SCHEDULER_PLANS = (
+    ("logreg", "&config_step_size=1.0"),
+    ("svm", "&config_reg_param=0.01"),
+    ("logreg", "&config_step_size=0.5"),
+    ("svm", "&config_reg_param=0.1"),
+)
+
+
+def scheduler_queries(info: str):
+    return [
+        build_query(
+            info, fanout=False, train_clf=clf,
+            extra=extra + f"&config_num_iterations={_SCHEDULER_ITERS}",
+        )
+        for clf, extra in _SCHEDULER_PLANS
+    ]
+
+
+def scheduler_suicide_queries(info: str):
+    """The kill-and-resume trio: one fast plan that COMPLETES before
+    the SIGKILL, two slow ones (fresh compile at a big static
+    iteration count) the kill provably interrupts. Host fe= path: no
+    feature cache in play, so the resumed twins are a pure
+    determinism pin."""
+    qa = build_query(info, fanout=False, fe="dwt-8")
+    slow = "&config_num_iterations=150000"
+    qb = build_query(
+        info, fanout=False, fe="dwt-8",
+        extra=slow + "&config_step_size=0.5",
+    )
+    qc = build_query(
+        info, fanout=False, fe="dwt-8",
+        extra=slow + "&config_step_size=0.25",
+    )
+    return qa, qb, qc
+
+
+def run_scheduler_multi(info: str, scratch: str) -> dict:
+    """The scheduler_multi measurement: N plans sequential vs the same
+    N concurrent (each phase against its own FRESH feature cache, both
+    after a jit warmup), per-plan isolated cache attribution, the
+    single-flight store pin, per-plan report integrity, and the
+    kill-and-resume scenario."""
+    import hashlib as _hashlib
+    import signal as _signal
+
+    from eeg_dataanalysispackage_tpu import obs
+    from eeg_dataanalysispackage_tpu.pipeline import builder as _builder
+    from eeg_dataanalysispackage_tpu.scheduler import PlanExecutor
+
+    queries = scheduler_queries(info)
+    report_root = os.path.join(scratch, "scheduler_reports")
+
+    # jit warmup OUTSIDE both timed phases (cache=false: full builds,
+    # so the fused featurizer AND both classifier programs compile
+    # now, not inside whichever phase runs first)
+    for q in (queries[0], queries[1]):
+        run_query(q + "&cache=false")
+
+    phases = {}
+    for phase, workers in (("sequential", 1), ("concurrent", 4)):
+        os.environ["EEG_TPU_FEATURE_CACHE_DIR"] = os.path.join(
+            scratch, f"fc_{phase}"
+        )
+        before = obs.metrics.snapshot()["counters"]
+        start = time.perf_counter()
+        with PlanExecutor(
+            max_concurrent=workers,
+            report_root=os.path.join(report_root, phase),
+        ) as ex:
+            handles = [ex.submit(q) for q in queries]
+            results = [h.result(timeout=600) for h in handles]
+        wall = time.perf_counter() - start
+        after = obs.metrics.snapshot()["counters"]
+
+        def _delta(name):
+            return int(after.get(name, 0.0) - before.get(name, 0.0))
+
+        per_plan = {}
+        for (clf, extra), r in zip(_SCHEDULER_PLANS, results):
+            counters = r.builder.run_metrics.snapshot()["counters"]
+            per_plan[r.plan_id] = {
+                "classifier": clf + extra,
+                "feature_cache": {
+                    "hits": int(counters.get("feature_cache.hit", 0)),
+                    "misses": int(
+                        counters.get("feature_cache.miss", 0)
+                    ),
+                },
+                "statistics_sha256": _hashlib.sha256(
+                    str(r.statistics).encode()
+                ).hexdigest(),
+            }
+        reports_ok = True
+        for r in results:
+            path = os.path.join(
+                report_root, phase, r.plan_id, "run_report.json"
+            )
+            try:
+                with open(path) as f:
+                    rep = json.load(f)
+                reports_ok = reports_ok and (
+                    rep["plan_id"] == r.plan_id
+                    and rep["statistics_sha256"]
+                    == per_plan[r.plan_id]["statistics_sha256"]
+                    and rep["outcome"] == "ok"
+                )
+            except (OSError, ValueError, KeyError):
+                reports_ok = False
+        phases[phase] = {
+            "wall_s": round(wall, 3),
+            "epochs": _delta("pipeline.epochs_loaded"),
+            "stores": _delta("feature_cache.store"),
+            "single_flight_waits": _delta(
+                "feature_cache.single_flight_wait"
+            ),
+            "per_plan": per_plan,
+            "reports_ok": reports_ok,
+            "statistics_sha256": _hashlib.sha256(
+                "".join(sorted(
+                    v["statistics_sha256"] for v in per_plan.values()
+                )).encode()
+            ).hexdigest(),
+        }
+
+    # kill-and-resume: a SIGKILLed child of this script leaves 1
+    # completed + 2 unfinished journal records; recovery resumes the
+    # unfinished pair to statistics identical to uninterrupted twins
+    journal_dir = os.path.join(scratch, "journal")
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.abspath(__file__),
+            "scheduler_suicide", "0", "0",
+            f"--data-dir={os.path.dirname(info)}",
+            # scratch-rooted: the child SIGKILLs itself by design, so
+            # its own cleanup never runs — without an explicit
+            # cache dir it would mkdtemp an _OWNED_TMP and leak it
+            # every run
+            f"--cache-dir={os.path.join(scratch, 'suicide_cache')}",
+            f"--journal-dir={journal_dir}",
+        ],
+        capture_output=True, text=True,
+    )
+    killed = proc.returncode == -_signal.SIGKILL
+    ex = PlanExecutor(max_concurrent=2, journal_dir=journal_dir)
+    recovery = ex.recover()
+    resumed = [
+        (h.query, h.result(timeout=600))
+        for h in recovery["resumed"]
+    ]
+    ex.close()
+    twin_queries = {q for q, _ in resumed} | {
+        e["query"] for e in recovery["completed"]
+    }
+    twins = {
+        q: str(_builder.PipelineBuilder(q).execute())
+        for q in twin_queries
+    }
+    identical = all(
+        str(r.statistics) == twins[q] for q, r in resumed
+    ) and all(
+        e["statistics"] == twins[e["query"]]
+        for e in recovery["completed"]
+    )
+    crash_block = {
+        "killed": killed,
+        "completed_kept": len(recovery["completed"]),
+        "resumed": len(resumed),
+        "identical": bool(identical and resumed),
+    }
+
+    seq, conc = phases["sequential"], phases["concurrent"]
+    return {
+        "wall_s": conc["wall_s"],
+        "epochs": conc["epochs"],
+        "scheduler": {
+            "plans": len(queries),
+            "wall_sequential_s": seq["wall_s"],
+            "wall_concurrent_s": conc["wall_s"],
+            "concurrent_speedup": round(
+                seq["wall_s"] / conc["wall_s"], 3
+            ) if conc["wall_s"] > 0 else 0.0,
+            "parity_sequential_vs_concurrent": (
+                seq["statistics_sha256"] == conc["statistics_sha256"]
+            ),
+            "sequential": {
+                k: seq[k] for k in (
+                    "wall_s", "stores", "single_flight_waits",
+                    "per_plan", "reports_ok",
+                )
+            },
+            "concurrent": {
+                k: conc[k] for k in (
+                    "wall_s", "stores", "single_flight_waits",
+                    "per_plan", "reports_ok",
+                )
+            },
+            "crash_recovery": crash_block,
+        },
+    }
+
+
 def run_query(query: str):
     """(statistics, wall_s, n_epochs, stage dict, extras) for one
     pipeline execution. The stage dict is the builder's StageTimer
@@ -343,7 +573,7 @@ def main(argv) -> dict:
     variant = argv[0]
     n_markers = int(argv[1]) if len(argv) > 1 else 240
     n_files = int(argv[2]) if len(argv) > 2 else 3
-    data_dir = cache_dir = report_dir = None
+    data_dir = cache_dir = report_dir = journal_dir = None
     train_clf = "logreg"
     fe = "dwt-8-fused"
     devices = 8
@@ -369,13 +599,18 @@ def main(argv) -> dict:
             # the pre-decode rung), so the decode rung's e2e win is
             # measured against its own alternative on this machine
             fe = arg.split("=", 1)[1]
+        elif arg.startswith("--journal-dir="):
+            # scheduler_suicide's write-ahead journal location (the
+            # parent scheduler_multi run recovers from it)
+            journal_dir = arg.split("=", 1)[1]
         else:
             raise SystemExit(f"unknown argument {arg!r}")
     if variant not in (
         "pipeline_e2e_cold", "pipeline_e2e_warm", "pipeline_e2e_fanout5",
         "pipeline_e2e_overlap", "pipeline_e2e_bf16",
         "population_vmap", "population_looped", "population_sharded",
-        "seizure_e2e", "populate",
+        "seizure_e2e", "scheduler_multi", "scheduler_suicide",
+        "populate",
     ):
         raise SystemExit(f"unknown variant {variant!r}")
 
@@ -427,6 +662,63 @@ def main(argv) -> dict:
     if variant == "populate":
         run_query(build_query(info, fanout=False))
         return {}
+
+    if variant == "scheduler_suicide":
+        # the kill-and-resume child: 1 fast plan completes, 2 slow
+        # plans are journaled (one likely mid-run) when the SIGKILL
+        # lands — the parent recovers from --journal-dir
+        import signal as _signal
+
+        from eeg_dataanalysispackage_tpu.scheduler import PlanExecutor
+
+        qa, qb, qc = scheduler_suicide_queries(info)
+        ex = PlanExecutor(max_concurrent=1, journal_dir=journal_dir)
+        ex.submit(qa).result(timeout=600)
+        ex.submit(qb)
+        ex.submit(qc)
+        os.kill(os.getpid(), _signal.SIGKILL)
+
+    if variant == "scheduler_multi":
+        scratch = _OWNED_TMP or cache_dir
+        result = run_scheduler_multi(info, scratch)
+        import jax
+
+        from eeg_dataanalysispackage_tpu.io import feature_cache
+        from eeg_dataanalysispackage_tpu.ops import plan_cache
+        from eeg_dataanalysispackage_tpu.utils import compile_cache
+
+        pstats = plan_cache.stats()
+        sched = result["scheduler"]
+        wall = result["wall_s"]
+        n_epochs = result["epochs"]
+        return {
+            "variant": variant,
+            # the headline rate is the CONCURRENT phase's: epochs
+            # through the executor per wall second with 4 tenants in
+            # flight (the sequential twin's wall is in the scheduler
+            # block for the ratio)
+            "epochs_per_s": round(n_epochs / wall, 1) if wall else 0.0,
+            "n": n_epochs,
+            "iters": 1,
+            "wall_s": wall,
+            "elapsed_s": wall,
+            "bytes_per_epoch": _BYTES_PER_EPOCH,
+            "bytes_per_s": round(
+                (n_epochs / wall) * _BYTES_PER_EPOCH, 1
+            ) if wall else 0.0,
+            "n_markers_per_file": n_markers,
+            "n_files": n_files,
+            "platform": jax.devices()[0].platform,
+            "feature_cache": feature_cache.stats(),
+            "plan_cache": {
+                "hits": pstats["hits"], "misses": pstats["misses"],
+            },
+            "compile_cache": compile_cache.active_cache_dir(),
+            "scheduler": sched,
+            "report_sha256": sched["concurrent"]["per_plan"][
+                min(sched["concurrent"]["per_plan"])
+            ]["statistics_sha256"],
+        }
 
     if variant == "pipeline_e2e_warm":
         # populate from a separate process so the timed run's jit/
