@@ -179,7 +179,7 @@ _VARIANT_TIMEOUTS = {
 # patience — on a warm compile cache everything fits easily; on a
 # cold cache the tail variants may be budget-skipped (recorded as
 # such, artifact intact). BENCH_TOTAL_BUDGET overrides.
-_N_VARIANTS = 24  # asserted against the variant tables below
+_N_VARIANTS = 25  # asserted against the variant tables below
 _TOTAL_BUDGET_S = int(
     os.environ.get(
         "BENCH_TOTAL_BUDGET",
@@ -265,6 +265,11 @@ _VARIANTS_TPU = {
     # concurrent over shared caches, per-plan isolated attribution,
     # the single-flight store pin, and the kill-and-resume scenario
     "scheduler_multi": (2000, 4),
+    # the networked plan service (tools/pipeline_bench.py
+    # plan_service): shared-prefix pair over loopback HTTP (one
+    # prefix build, statistics byte-identical to solo), idempotent
+    # re-submit replay, many-client chaos soak with submits/sec
+    "plan_service": (2000, 4),
 }
 _VARIANTS_CPU = {
     "einsum": (8192, 5),
@@ -291,6 +296,7 @@ _VARIANTS_CPU = {
     "seizure_e2e": (60000, 2),
     "serve_bench": (400, 2),
     "scheduler_multi": (2000, 4),
+    "plan_service": (2000, 4),
 }
 assert len(_VARIANTS_TPU) == len(_VARIANTS_CPU) == _N_VARIANTS
 
@@ -435,7 +441,8 @@ def _run_variant(variant: str, platform: str, n: int, iters: int) -> dict:
     # (tools/serve_bench.py, same n/iters meaning); everything else
     # is a kernel variant through tools/ingest_bench.py
     if variant.startswith(
-        ("pipeline_e2e", "population_", "seizure_", "scheduler_")
+        ("pipeline_e2e", "population_", "seizure_", "scheduler_",
+         "plan_service")
     ):
         script = "pipeline_bench.py"
     elif variant.startswith("serve_"):
@@ -639,6 +646,10 @@ def _collect(platform: str) -> dict:
                 # concurrent walls, per-plan cache attribution, the
                 # single-flight and crash-recovery pins
                 "scheduler",
+                # the networked plan service line: the HTTP dedup
+                # pair, the idempotent-resubmit replay, and the
+                # many-client soak (submits/sec, hit ratio, isolation)
+                "plan_service",
             ):
                 if extra_field in r:
                     variants[name][extra_field] = r[extra_field]

@@ -1,0 +1,32 @@
+"""The networked plan service: an HTTP front door over the
+multi-tenant :class:`~eeg_dataanalysispackage_tpu.scheduler.PlanExecutor`.
+
+ROADMAP item 1 — the "millions of users" front door. One thin,
+dependency-free HTTP server (stdlib ``ThreadingHTTPServer``) exposes
+the executor's whole contract over loopback/LAN:
+
+- ``POST /plans``            — submit a query string, get a plan id
+  (idempotent under the ``X-Idempotency-Key`` header; shed-with-
+  evidence becomes HTTP 429);
+- ``GET /plans/<id>``        — queued/running/terminal status with the
+  attempt history;
+- ``GET /plans/<id>/report`` — the finished statistics text + the
+  plan's ``run_report.json``;
+- ``DELETE /plans/<id>``     — cancel-if-queued;
+- ``GET /plans`` / ``GET /stats`` / ``GET /healthz`` — the operator
+  surface (tools/plan_admin.py).
+
+The write-ahead journal already makes a killed server resumable:
+:class:`GatewayServer` runs ``recover()`` at startup, and submissions
+carry client idempotency keys journaled with the plan record — a
+retried submit after a crash or timeout returns the original plan id
+instead of double-running. Cross-tenant plan-prefix dedup
+(scheduler/dedup.py) runs underneath, so tenants whose plans share an
+ingest+featurize prefix compute it once.
+
+``python -m eeg_dataanalysispackage_tpu.gateway`` serves from the
+command line (``--port`` / ``EEG_TPU_GATEWAY_PORT``); see README
+"Plan service" for curl examples.
+"""
+
+from .server import GatewayServer  # noqa: F401

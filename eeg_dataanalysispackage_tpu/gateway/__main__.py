@@ -1,0 +1,91 @@
+"""``python -m eeg_dataanalysispackage_tpu.gateway`` — serve the plan
+service from the command line.
+
+Example::
+
+    python -m eeg_dataanalysispackage_tpu.gateway \\
+        --port 8321 --journal-dir /var/lib/eeg-tpu/journal \\
+        --report-root /var/lib/eeg-tpu/reports --max-concurrent 4
+
+The journal directory makes the server crash-only: kill it mid-plan,
+restart with the same ``--journal-dir``, and recovery resumes every
+unfinished plan under its original id (idempotency-keyed clients
+rejoin them transparently). ``EEG_TPU_GATEWAY_PORT`` sets the default
+port; ``--port 0`` binds an ephemeral one (printed at startup).
+"""
+
+import argparse
+import logging
+import os
+import sys
+import time
+
+from .server import ENV_PORT, GatewayServer
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="eeg_dataanalysispackage_tpu.gateway",
+        description="HTTP front door over the multi-tenant PlanExecutor",
+    )
+    parser.add_argument(
+        "--host", default="127.0.0.1",
+        help="bind address (default 127.0.0.1 — loopback only)",
+    )
+    parser.add_argument(
+        "--port", type=int,
+        default=int(os.environ.get(ENV_PORT, "8321") or 8321),
+        help=f"bind port (default ${ENV_PORT} or 8321; 0 = ephemeral)",
+    )
+    parser.add_argument(
+        "--journal-dir", default=None,
+        help="write-ahead journal directory (enables crash recovery "
+        "and idempotent re-submits across restarts)",
+    )
+    parser.add_argument(
+        "--report-root", default=None,
+        help="per-plan run_report.json tree (<root>/<plan_id>/)",
+    )
+    parser.add_argument("--max-concurrent", type=int, default=2)
+    parser.add_argument("--queue-depth", type=int, default=16)
+    parser.add_argument("--max-attempts", type=int, default=3)
+    parser.add_argument(
+        "--no-recover", action="store_true",
+        help="skip journal recovery at startup (diagnostics only)",
+    )
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    server = GatewayServer(
+        host=args.host,
+        port=args.port,
+        journal_dir=args.journal_dir,
+        report_root=args.report_root,
+        max_concurrent=args.max_concurrent,
+        queue_depth=args.queue_depth,
+        max_attempts=args.max_attempts,
+        recover=not args.no_recover,
+    )
+    host, port = server.start()
+    if server.recovery is not None:
+        print(
+            f"recovered journal: "
+            f"{len(server.recovery['completed'])} completed kept, "
+            f"{len(server.recovery['resumed'])} unfinished resumed",
+            file=sys.stderr,
+        )
+    print(f"plan service listening on http://{host}:{port}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        print("shutting down", file=sys.stderr)
+        server.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

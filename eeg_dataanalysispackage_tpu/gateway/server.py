@@ -1,0 +1,442 @@
+"""The gateway's HTTP server and its executor plumbing.
+
+Design constraints, in order:
+
+- **Thin.** The gateway adds a wire format, never semantics: admission
+  is the executor's shed-with-evidence queue, deadlines are the
+  executor's per-plan budgets, idempotency rides the write-ahead
+  journal, recovery is ``recover()`` at startup. Every behavior here
+  is testable without HTTP by calling the executor directly; the
+  handler only maps outcomes onto status codes.
+- **Stdlib only.** ``http.server.ThreadingHTTPServer`` (one thread per
+  connection, daemonic) is plenty for a front door whose unit of work
+  is a whole pipeline plan; request handling does no device work —
+  submit returns the instant the plan is journaled+queued.
+- **Crash-only.** The server holds no state the journal doesn't: kill
+  it mid-plan, restart over the same ``journal_dir``, and ``recover()``
+  resumes every unfinished plan under its original id while
+  idempotency-keyed re-submits rejoin them (pinned in
+  tests/test_gateway.py with a real SIGKILL).
+
+Wire contract (all JSON):
+
+====== ========================== ===========================================
+method path                       outcome
+====== ========================== ===========================================
+POST   /plans                     201 {plan_id, state} — body is the query
+                                  string, percent-escapes decoded
+                                  (``pipeline.builder.decode_percent_query``);
+                                  200 when ``X-Idempotency-Key`` replayed an
+                                  existing plan; 400 invalid; 429 shed (with
+                                  evidence + the journaled plan id); 503
+                                  closed
+GET    /plans                     200 {plans: [...]} — journal + live states
+GET    /plans/<id>                200 status; 404 unknown
+GET    /plans/<id>/report         200 {statistics, statistics_sha256, error,
+                                  run_report}; 409 while non-terminal
+DELETE /plans/<id>                200 {cancelled: true}; 409 not-queued
+GET    /stats                     200 {dedup, queue_depth, scheduler counters}
+GET    /healthz                   200 {ok: true, ...}
+====== ========================== ===========================================
+
+Headers on POST /plans: ``X-Idempotency-Key`` (client retry token,
+journaled with the plan record), ``X-Plan-Deadline-S`` (float; the
+executor's per-plan deadline budget).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+from .. import obs
+from ..scheduler import dedup as dedup_mod
+from ..scheduler.executor import (
+    IdempotencyConflictError,
+    PlanExecutor,
+    PlanShedError,
+)
+from ..serve.batcher import ServiceClosedError
+
+logger = logging.getLogger(__name__)
+
+#: default port when none is given (0 = ephemeral, the test default)
+ENV_PORT = "EEG_TPU_GATEWAY_PORT"
+
+_PLAN_PATH = re.compile(r"^/plans/([A-Za-z0-9_.-]+)(/report)?$")
+
+
+class GatewayServer:
+    """One HTTP front door over one :class:`PlanExecutor`.
+
+    Pass an ``executor`` to front an existing one, or let the gateway
+    own a fresh executor built from the keyword knobs (closed with the
+    gateway). ``recover=True`` (default) replays the journal at
+    :meth:`start` — the crash-only restart path.
+    """
+
+    def __init__(
+        self,
+        executor: Optional[PlanExecutor] = None,
+        host: str = "127.0.0.1",
+        port: Optional[int] = None,
+        journal_dir: Optional[str] = None,
+        report_root: Optional[str] = None,
+        max_concurrent: int = 2,
+        queue_depth: int = 16,
+        max_attempts: int = 3,
+        recover: bool = True,
+    ):
+        if port is None:
+            port = int(os.environ.get(ENV_PORT, "0") or 0)
+        self.host = host
+        self._requested_port = int(port)
+        self._owns_executor = executor is None
+        self.executor = executor or PlanExecutor(
+            max_concurrent=max_concurrent,
+            queue_depth=queue_depth,
+            journal_dir=journal_dir,
+            report_root=report_root,
+            max_attempts=max_attempts,
+            name="gateway",
+        )
+        self._recover = recover
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        #: plan_id -> PlanHandle, retained ONLY when the executor has
+        #: no journal (the handle is then the sole route to a
+        #: finished plan's statistics). With a journal, nothing is
+        #: retained here: the journal is the durable record and a
+        #: held handle would pin every completed plan's result (and
+        #: its whole PipelineBuilder) for the server's lifetime.
+        self._handles: Dict[str, Any] = {}
+        self.recovery: Optional[Dict[str, Any]] = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> Tuple[str, int]:
+        """Recover the journal, start the executor workers, bind and
+        serve. Returns (host, port) — port is the bound one when an
+        ephemeral 0 was requested."""
+        self.executor.start()
+        if self._recover and self.executor.journal is not None:
+            # resumed handles are NOT copied into _handles: the
+            # journal (which recovery just replayed) serves their
+            # status and outcome
+            self.recovery = self.executor.recover()
+        server = self
+
+        class _Handler(_GatewayHandler):
+            gateway = server
+
+        self._httpd = ThreadingHTTPServer(
+            (self.host, self._requested_port), _Handler
+        )
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="eeg-tpu-gateway-http",
+            daemon=True,
+        )
+        self._thread.start()
+        logger.info("gateway serving on %s:%d", self.host, self.port)
+        return self.host, self.port
+
+    @property
+    def port(self) -> int:
+        if self._httpd is None:
+            return self._requested_port
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def close(self, join_timeout_s: float = 10.0) -> None:
+        """Stop accepting, shut the HTTP loop down, and (when the
+        gateway owns its executor) close it — queued journaled plans
+        stay 'submitted' for the next recover(), exactly like a
+        direct executor close."""
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=join_timeout_s)
+            self._thread = None
+        if self._owns_executor:
+            self.executor.close(join_timeout_s=join_timeout_s)
+
+    def __enter__(self) -> "GatewayServer":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- the endpoint bodies (HTTP-free, directly testable) --------------
+
+    def submit_query(
+        self,
+        raw_body: str,
+        deadline_s: Optional[float] = None,
+        idempotency_key: Optional[str] = None,
+        client: Optional[str] = None,
+    ) -> Tuple[int, Dict[str, Any]]:
+        from ..pipeline.builder import decode_percent_query
+
+        try:
+            query = decode_percent_query(raw_body.strip())
+        except ValueError as e:
+            return 400, {"error": str(e)}
+        if not query:
+            return 400, {"error": "empty request body; POST the query string"}
+        gateway_block = {"via": "http"}
+        if idempotency_key:
+            gateway_block["idempotency_key"] = idempotency_key
+        if client:
+            gateway_block["client"] = client
+        try:
+            handle = self.executor.submit(
+                query,
+                deadline_s=deadline_s,
+                idempotency_key=idempotency_key,
+                gateway=gateway_block,
+            )
+        except PlanShedError as e:
+            # backpressure, with the evidence and the journaled id —
+            # the client backs off and retries (the idempotency key
+            # was deliberately not burned)
+            return 429, {
+                "error": str(e), "shed": True, "plan_id": e.plan_id,
+            }
+        except ServiceClosedError as e:
+            return 503, {"error": str(e)}
+        except IdempotencyConflictError as e:
+            # key reused with a different body: neither replaying the
+            # old outcome nor running the new body would be honest
+            return 409, {"error": str(e), "idempotency_conflict": True}
+        except ValueError as e:
+            # PlanValidationError included: the query is the bug
+            return 400, {"error": str(e)}
+        replayed = bool(getattr(handle, "replayed", False))
+        if not replayed and self.executor.journal is None:
+            with self._lock:
+                self._handles[handle.plan_id] = handle
+        return (200 if replayed else 201), {
+            "plan_id": handle.plan_id,
+            "state": handle.state,
+            "idempotent_replay": replayed,
+        }
+
+    def status_payload(self, plan_id: str) -> Tuple[int, Dict[str, Any]]:
+        status = self.executor.status(plan_id)
+        if status is None:
+            return 404, {"error": f"unknown plan {plan_id}"}
+        return 200, status
+
+    def report_payload(self, plan_id: str) -> Tuple[int, Dict[str, Any]]:
+        """The finished plan's artifacts: statistics text (journal
+        first — it survives restarts — the live handle as fallback),
+        the terminal error if it failed, and the per-plan
+        run_report.json when one was written."""
+        status = self.executor.status(plan_id)
+        if status is None:
+            return 404, {"error": f"unknown plan {plan_id}"}
+        if status["state"] not in ("completed", "failed", "cancelled"):
+            return 409, {
+                "error": f"plan {plan_id} is {status['state']}; "
+                f"not terminal yet",
+                "state": status["state"],
+            }
+        payload: Dict[str, Any] = {
+            "plan_id": plan_id,
+            "state": status["state"],
+            "attempts": status.get("attempts", 0),
+            "statistics": None,
+            "statistics_sha256": status.get("statistics_sha256"),
+            "error": status.get("error"),
+            "run_report": None,
+        }
+        journal = self.executor.journal
+        entry = journal.entry(plan_id) if journal is not None else None
+        if entry is not None:
+            payload["statistics"] = entry.get("statistics")
+            payload["statistics_sha256"] = entry.get("statistics_sha256")
+            payload["error"] = entry.get("error", payload["error"])
+        if payload["statistics"] is None:
+            # journal-less gateways retain their own handles; a
+            # journaled gateway whose completion WRITE degraded falls
+            # back to the executor's live ticket — kept precisely
+            # because the journal lost the outcome
+            handle = (
+                self._handles.get(plan_id)
+                or self.executor.handle(plan_id)
+            )
+            if handle is not None and handle.done:
+                try:
+                    import hashlib
+
+                    text = str(handle.result(timeout=0).statistics)
+                    payload["statistics"] = text
+                    payload["statistics_sha256"] = hashlib.sha256(
+                        text.encode()
+                    ).hexdigest()
+                except Exception as e:
+                    payload["error"] = payload["error"] or (
+                        f"{type(e).__name__}: {e}"
+                    )
+        report_dir = status.get("report_dir")
+        if report_dir:
+            try:
+                with open(
+                    os.path.join(report_dir, "run_report.json")
+                ) as f:
+                    payload["run_report"] = json.load(f)
+            except (OSError, ValueError):
+                pass
+        return 200, payload
+
+    def cancel_payload(self, plan_id: str) -> Tuple[int, Dict[str, Any]]:
+        status = self.executor.status(plan_id)
+        if status is None:
+            return 404, {"error": f"unknown plan {plan_id}"}
+        if self.executor.cancel(plan_id):
+            return 200, {"plan_id": plan_id, "cancelled": True}
+        return 409, {
+            "plan_id": plan_id,
+            "cancelled": False,
+            "state": self.executor.status(plan_id)["state"],
+            "error": "plan is not queued (already running or terminal)",
+        }
+
+    def list_payload(self) -> Tuple[int, Dict[str, Any]]:
+        plans: Dict[str, Dict[str, Any]] = {}
+        journal = self.executor.journal
+        if journal is not None:
+            for entry in journal.entries():
+                meta = entry.get("meta") or {}
+                plans[entry["plan_id"]] = {
+                    "plan_id": entry["plan_id"],
+                    "state": (
+                        "cancelled" if meta.get("cancelled")
+                        else entry.get("state")
+                    ),
+                    "attempts": int(entry.get("attempts", 0) or 0),
+                    "query": entry.get("query", ""),
+                }
+        # live tickets override journal snapshots (a 'submitted'
+        # record whose plan is mid-run shows as running)
+        live = set(self.executor.live_ids())
+        live.update(self._handles)
+        for plan_id in live:
+            status = self.executor.status(plan_id)
+            if status is not None:
+                plans[plan_id] = {
+                    k: status.get(k)
+                    for k in ("plan_id", "state", "attempts", "query")
+                }
+        return 200, {"plans": [plans[k] for k in sorted(plans)]}
+
+    def stats_payload(self) -> Tuple[int, Dict[str, Any]]:
+        counters = obs.metrics.snapshot()["counters"]
+        return 200, {
+            "dedup": dedup_mod.stats(),
+            "queue_depth": len(self.executor.queue),
+            "scheduler": {
+                k: v for k, v in sorted(counters.items())
+                if k.startswith("scheduler.")
+            },
+        }
+
+    def health_payload(self) -> Tuple[int, Dict[str, Any]]:
+        return 200, {
+            "ok": True,
+            "queued": len(self.executor.queue),
+            "journal": self.executor.journal is not None,
+        }
+
+
+class _GatewayHandler(BaseHTTPRequestHandler):
+    """Routes HTTP onto the gateway's endpoint bodies; every response
+    is one JSON object."""
+
+    #: bound by GatewayServer.start()'s subclass
+    gateway: GatewayServer = None  # type: ignore[assignment]
+    protocol_version = "HTTP/1.1"
+
+    # -- plumbing --------------------------------------------------------
+
+    def log_message(self, fmt, *args):  # quiet by default
+        logger.debug("gateway http: " + fmt, *args)
+
+    def _send(self, code: int, payload: Dict[str, Any]) -> None:
+        body = (json.dumps(payload) + "\n").encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self) -> str:
+        length = int(self.headers.get("Content-Length", "0") or 0)
+        return self.rfile.read(length).decode("utf-8", "replace")
+
+    # -- methods ---------------------------------------------------------
+
+    def do_POST(self) -> None:
+        if self.path.rstrip("/") != "/plans":
+            self._send(404, {"error": f"no such endpoint {self.path}"})
+            return
+        deadline_s: Optional[float] = None
+        raw_deadline = self.headers.get("X-Plan-Deadline-S")
+        if raw_deadline:
+            try:
+                deadline_s = float(raw_deadline)
+            except ValueError:
+                self._send(400, {
+                    "error": f"X-Plan-Deadline-S must be a float, got "
+                    f"{raw_deadline!r}"
+                })
+                return
+        code, payload = self.gateway.submit_query(
+            self._body(),
+            deadline_s=deadline_s,
+            idempotency_key=self.headers.get("X-Idempotency-Key"),
+            client=self.client_address[0],
+        )
+        self._send(code, payload)
+
+    def do_GET(self) -> None:
+        path = self.path.split("?", 1)[0]
+        if path == "/healthz":
+            self._send(*self.gateway.health_payload())
+            return
+        if path == "/stats":
+            self._send(*self.gateway.stats_payload())
+            return
+        if path.rstrip("/") == "/plans":
+            self._send(*self.gateway.list_payload())
+            return
+        match = _PLAN_PATH.match(path)
+        if match is None:
+            self._send(404, {"error": f"no such endpoint {path}"})
+            return
+        plan_id, want_report = match.group(1), match.group(2)
+        if want_report:
+            self._send(*self.gateway.report_payload(plan_id))
+        else:
+            self._send(*self.gateway.status_payload(plan_id))
+
+    def do_DELETE(self) -> None:
+        match = _PLAN_PATH.match(self.path)
+        if match is None or match.group(2):
+            self._send(404, {"error": f"no such endpoint {self.path}"})
+            return
+        self._send(*self.gateway.cancel_payload(match.group(1)))

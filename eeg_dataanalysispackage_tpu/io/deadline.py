@@ -80,6 +80,25 @@ class Deadline:
         )
 
 
+def cond_wait(cond: "threading.Condition", predicate, what: str,
+              slice_s: float = 0.1) -> None:
+    """Wait on ``cond`` (whose lock the caller must hold) until
+    ``predicate()`` is true, honouring the ambient deadline scope:
+    outside any scope this is a plain ``cond.wait()`` loop; inside
+    one, the wait re-checks in short slices and raises
+    :class:`DeadlineExceededError` the moment the budget is spent —
+    the shape every cross-tenant wait in this codebase needs (the
+    feature cache's single-flight guard, the prefix-dedup registry),
+    extracted here so no two of them can drift."""
+    while not predicate():
+        ambient = active_deadline()
+        if ambient is None:
+            cond.wait()
+        else:
+            ambient.raise_if_expired(what)
+            cond.wait(timeout=min(slice_s, ambient.remaining()))
+
+
 _LOCAL = threading.local()
 
 

@@ -236,31 +236,26 @@ class FeatureCache:
         stored the entry (counted as ``feature_cache.single_flight_wait``).
         Pair with ``slot.release()`` in a ``finally``.
 
-        The wait honours the ambient :mod:`~.deadline` scope: a
-        deadline-bearing plan queued behind another tenant's long
-        rebuild fails fast with :class:`~.deadline.DeadlineExceededError`
-        instead of blocking past its budget (the wait re-checks in
-        short slices — the scheduler's deadline contract would
-        otherwise stop at attempt boundaries)."""
+        The wait honours the ambient :mod:`~.deadline` scope via
+        :func:`~.deadline.cond_wait`: a deadline-bearing plan queued
+        behind another tenant's long rebuild fails fast with
+        :class:`~.deadline.DeadlineExceededError` instead of blocking
+        past its budget (the wait re-checks in short slices — the
+        scheduler's deadline contract would otherwise stop at attempt
+        boundaries)."""
         from .. import obs
         from . import deadline as deadline_mod
 
         token = (self.directory, key)
         waited = False
         with _flight_cond:
-            while token in _flights:
+            if token in _flights:
                 waited = True
-                ambient = deadline_mod.active_deadline()
-                if ambient is None:
-                    _flight_cond.wait()
-                else:
-                    ambient.raise_if_expired(
-                        f"single-flight wait for feature cache "
-                        f"entry {key}"
-                    )
-                    _flight_cond.wait(
-                        timeout=min(0.1, ambient.remaining())
-                    )
+                deadline_mod.cond_wait(
+                    _flight_cond,
+                    lambda: token not in _flights,
+                    f"single-flight wait for feature cache entry {key}",
+                )
             _flights.add(token)
         if waited:
             obs.metrics.count("feature_cache.single_flight_wait")

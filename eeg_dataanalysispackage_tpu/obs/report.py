@@ -252,6 +252,17 @@ class RunTelemetry:
         #: ``mesh_resolved`` attribute, so late updates (a population
         #: fallback) land in the written report.
         self.mesh: Optional[Dict[str, Any]] = None
+        #: cross-tenant prefix-dedup attribution (scheduler/dedup.py):
+        #: {"role": "leader"|"follower", "prefix_key", "rows", and
+        #: leader build_seconds / follower leader_plan + bytes_saved +
+        #: seconds_saved} — who led and who drafted lives HERE, never
+        #: only in a log line; None when the run shared no prefix
+        #: work (the default, schema-stable)
+        self.dedup: Optional[Dict[str, Any]] = None
+        #: networked-submission attribution (gateway/): {"via",
+        #: "idempotency_key", "client"} when the plan arrived through
+        #: the HTTP front door; None for in-process submissions
+        self.gateway: Optional[Dict[str, Any]] = None
 
     @property
     def report_path(self) -> str:
@@ -302,6 +313,8 @@ class RunTelemetry:
             "precision": self.precision,
             "overlap": self.overlap,
             "mesh": self.mesh,
+            "dedup": self.dedup,
+            "gateway": self.gateway,
             "degradation": list(self.degradation),
             "stages": timers.as_dict() if timers is not None else {},
             "metrics": metrics.snapshot() if metrics is not None else {},

@@ -68,6 +68,40 @@ def get_raw_param(query: str, name: str) -> Optional[str]:
     return None
 
 
+def decode_percent_query(query: str) -> str:
+    """Percent-decode a network-submitted query string, pairwise.
+
+    Network submissions (``gateway/``) URL-encode option values whose
+    grammar carries reserved characters —
+    ``fe=dwt-8%3Alevel%3D5%3Astats%3Denergy%2Cmean`` — while the
+    journal/IR currency is the decoded string. Decoding must happen
+    pair by pair (split on ``&`` and the FIRST ``=`` first, THEN
+    unquote), or a decoded ``=``/``&`` would be re-parsed as query
+    structure. A decoded value containing a literal ``&`` (or a
+    decoded name containing ``&``/``=``) cannot be represented in the
+    ``k=v&k=v`` surface at all and is rejected loudly rather than
+    silently re-split. Strings without ``%`` pass through
+    byte-identically — every query ever written is unchanged
+    (round-trips pinned in tests/test_pipeline.py).
+    """
+    if "%" not in query:
+        return query
+    from urllib.parse import unquote
+
+    parts = []
+    for param in query.split("&"):
+        name, sep, value = param.partition("=")
+        name = unquote(name)
+        value = unquote(value)
+        if "&" in name or "=" in name or "&" in value:
+            raise ValueError(
+                f"percent-decoded query parameter {param!r} contains a "
+                f"reserved '&'/'=' the k=v&k=v surface cannot represent"
+            )
+        parts.append(name + sep + value)
+    return "&".join(parts)
+
+
 class PipelineBuilder:
     def __init__(
         self,
@@ -110,6 +144,12 @@ class PipelineBuilder:
         #: (devices=/mesh_axes= absent). Set whether or not telemetry
         #: is on — bench lines read it here, like precision_resolved.
         self.mesh_resolved: Optional[dict] = None
+        #: prefix-dedup attribution of the last run ({"role",
+        #: "prefix_key", and leader build_seconds / follower
+        #: leader_plan + bytes/seconds saved} — scheduler/dedup.py);
+        #: None when the run shared no prefix work. Set whether or not
+        #: telemetry is on, like precision_resolved.
+        self.dedup_resolved: Optional[dict] = None
 
     @contextlib.contextmanager
     def _stage(self, name: str, **attrs):
@@ -201,7 +241,9 @@ class PipelineBuilder:
                     self.telemetry.workload = workload
                 return self._finish_run(statistics, query_map)
             return self._finish_run(
-                self._execute_seizure(query_map, make_provider, mesh),
+                self._execute_seizure(
+                    query_map, make_provider, mesh, plan
+                ),
                 query_map,
             )
         if query_map.get("fe_sweep"):
@@ -328,8 +370,42 @@ class PipelineBuilder:
             #: the bf16 gate trips or a non-decode rung lands
             precision_used = precision
             gate_record = None
+            # cross-tenant plan-prefix dedup (scheduler/dedup.py): the
+            # plan's canonical ingest+featurize prefix is claimed
+            # BEFORE any I/O — a follower whose leader already built
+            # this prefix reuses the in-memory result and never reads
+            # a byte; a leader computes exactly as an undeduped run
+            # and publishes at the end. dedup=false opts a run out.
+            from ..scheduler import dedup as dedup_mod
+
+            dedup_claim = None
+            if dedup_mod.eligible(plan):
+                with self._stage("ingest", phase="prefix_dedup"):
+                    dedup_claim = dedup_mod.acquire_for(plan)
             try:
-                if cache is not None:
+                if (
+                    dedup_claim is not None
+                    and dedup_claim.role == "follower"
+                ):
+                    features, targets = dedup_claim.value
+                    landed = "dedup"
+                    if precision == "bf16":
+                        # the leader resolved the gate for this exact
+                        # prefix; the follower inherits its decision
+                        precision_used = dedup_claim.meta.get(
+                            "precision_used", "bf16"
+                        )
+                        gate_record = {
+                            "source": "dedup",
+                            "leader_plan": dedup_claim.leader_plan,
+                        }
+                    self._note_dedup(dedup_claim, rows=len(targets))
+                    logger.info(
+                        "prefix dedup hit (%d rows, leader %s): ingest "
+                        "+ featurization skipped",
+                        len(targets), dedup_claim.leader_plan,
+                    )
+                if landed is None and cache is not None:
                     try:
                         # ONE read pass: digests (for the content key) and
                         # parsed recordings come from the same bytes
@@ -528,7 +604,7 @@ class PipelineBuilder:
                             events.event("pipeline.degraded.unhealthy_devices")
                             break
                 if landed is not None:
-                    if landed != backend and landed != "cache":
+                    if landed not in (backend, "cache", "dedup"):
                         logger.warning(
                             "pipeline.degrade landed requested=%s landed=%s "
                             "steps=%d",
@@ -538,7 +614,7 @@ class PipelineBuilder:
                         "pipeline.rung_landed", requested=backend, landed=landed
                     )
                     if precision_used == "bf16" and landed not in (
-                        "decode", "cache"
+                        "decode", "cache", "dedup"
                     ):
                         # the decode rung failed and a lower (f32) rung
                         # landed: the run's features are f32 — the cache
@@ -590,6 +666,20 @@ class PipelineBuilder:
                         and cache_key is not None
                     ):
                         cache.store(cache_key, features, targets)
+                    if (
+                        dedup_claim is not None
+                        and dedup_claim.role == "leader"
+                    ):
+                        # publish whatever the run actually landed on
+                        # (disk-cache hits included — the in-memory
+                        # copy spares followers even the read+digest
+                        # pass); the resolved precision rides along so
+                        # bf16 followers inherit the gate decision
+                        dedup_claim.publish(
+                            (features, targets),
+                            meta={"precision_used": precision_used},
+                        )
+                        self._note_dedup(dedup_claim, rows=len(targets))
                     fe = None
                     n = len(targets)
                 else:
@@ -639,6 +729,13 @@ class PipelineBuilder:
             finally:
                 if build_slot is not None:
                     build_slot.release()
+                if dedup_claim is not None:
+                    # an unpublished leader (host floor, ladder
+                    # exhaustion, any raise) abandons: the first
+                    # waiting follower is promoted and computes its
+                    # own prefix — leader chaos costs followers time,
+                    # never correctness
+                    dedup_claim.settle()
         else:
             with self._stage("ingest"):
                 batch = odp.load()
@@ -967,7 +1064,8 @@ class PipelineBuilder:
         feature_sets = [(name, hits[name][0]) for name, _ in extractors]
         return feature_sets, targets
 
-    def _execute_seizure(self, query_map, make_provider, mesh=None):
+    def _execute_seizure(self, query_map, make_provider, mesh=None,
+                         plan=None):
         """``task=seizure``: sliding windows -> configurable subband
         features -> cost-sensitive training -> imbalanced-class
         statistics (docs/workloads.md). The first non-P300 path
@@ -1025,9 +1123,38 @@ class PipelineBuilder:
                     "dwt-4:level=4:stats=energy), not a -fused mode"
                 )
 
-        feature_sets, targets = self._seizure_features(
-            query_map, make_provider, slide_cfg, fe_names
-        )
+        # cross-tenant plan-prefix dedup, seizure flavor: the sliding
+        # epoching + per-config subband extraction IS this workload's
+        # ingest+featurize prefix — two tenants sweeping costs over
+        # the same session and feature configs share one build
+        from ..scheduler import dedup as dedup_mod
+
+        dedup_claim = None
+        if plan is not None and dedup_mod.eligible(plan):
+            with self._stage(
+                "ingest", phase="prefix_dedup", task="seizure"
+            ):
+                dedup_claim = dedup_mod.acquire_for(plan)
+        try:
+            if dedup_claim is not None and dedup_claim.role == "follower":
+                feature_sets, targets = dedup_claim.value
+                feature_sets = list(feature_sets)
+                self._note_dedup(dedup_claim, rows=len(targets))
+                logger.info(
+                    "prefix dedup hit (%d windows, leader %s): seizure "
+                    "ingest + featurization skipped",
+                    len(targets), dedup_claim.leader_plan,
+                )
+            else:
+                feature_sets, targets = self._seizure_features(
+                    query_map, make_provider, slide_cfg, fe_names
+                )
+                if dedup_claim is not None:
+                    dedup_claim.publish((tuple(feature_sets), targets))
+                    self._note_dedup(dedup_claim, rows=len(targets))
+        finally:
+            if dedup_claim is not None:
+                dedup_claim.settle()
         features = feature_sets[0][1]
         n = len(targets)
         if n == 0:
@@ -1160,6 +1287,31 @@ class PipelineBuilder:
         # workload's headline is expected cost / recall, not accuracy
         stats.mark_extended(statistics, cost_fp=cost_fp, cost_fn=cost_fn)
         return statistics
+
+    def _note_dedup(self, claim, rows: int) -> None:
+        """Per-plan attribution of shared prefix work — who led, who
+        drafted behind them, bytes/seconds saved — on the builder (the
+        bench-attribution contract, like ``precision_resolved``) and
+        in run_report.json's ``dedup`` block."""
+        block = {
+            "role": claim.role,
+            "prefix_key": claim.key,
+            "rows": int(rows),
+        }
+        if claim.role == "leader":
+            block["build_seconds"] = round(claim.build_seconds, 6)
+            if claim.leader_failed:
+                # promoted after another tenant's abandoned build —
+                # the fallback path, recorded so an operator can see
+                # a flapping leader from the artifact alone
+                block["promoted_after_leader_failure"] = True
+        else:
+            block["leader_plan"] = claim.leader_plan
+            block["bytes_saved"] = int(claim.bytes_saved)
+            block["seconds_saved"] = round(claim.build_seconds, 6)
+        self.dedup_resolved = block
+        if self.telemetry is not None:
+            self.telemetry.dedup = block
 
     # -- population training -------------------------------------------
 

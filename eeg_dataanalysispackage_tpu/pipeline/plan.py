@@ -33,7 +33,8 @@ keeps its own checks as defense in depth.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Mapping, Optional, Tuple
+import hashlib
+from typing import Any, Dict, Mapping, Optional, Tuple
 
 
 class PlanValidationError(ValueError):
@@ -104,6 +105,9 @@ class ExecutionPlan:
     overlap: Optional[bool]
     cache: bool
     degrade: bool
+    #: whether this plan participates in cross-tenant plan-prefix
+    #: dedup (scheduler/dedup.py); ``dedup=false`` opts one plan out
+    dedup: bool
 
     # -- classifier action ----------------------------------------------
     train_clf: Optional[str]
@@ -146,6 +150,113 @@ class ExecutionPlan:
     @property
     def population_active(self) -> bool:
         return self.population is not None and self.population.active
+
+    # -- canonicalization ------------------------------------------------
+    #
+    # The query-optimizer half of the IR: two queries that MEAN the
+    # same run must canonicalize to the same key, whatever order their
+    # parameters were spelled in. The keys are built from the TYPED
+    # fields only — never the raw query string — so `a=1&b=2` and
+    # `b=2&a=1` collapse, and they are env-knob-free by construction:
+    # an environment-resolved knob (EEG_TPU_PRECISION, EEG_TPU_FAULTS,
+    # report dirs) never reaches a typed field (the parse-purity
+    # contract above), so the same key means the same plan in any
+    # process with any environment.
+
+    #: fields excluded from canonicalization — observability and
+    #: scheduling knobs that are pinned to never change statistics
+    #: (ingest_workers/prefetch/overlap are bit-identical at any
+    #: value; faults are absorbed by the resilience machinery by
+    #: contract; result/trace/report paths are artifact locations)
+    _NON_SEMANTIC = (
+        "query", "query_map", "ingest_workers", "prefetch", "overlap",
+        "faults", "faults_seed", "result_path", "trace_path", "report",
+    )
+
+    def canonical_fields(self) -> Dict[str, Any]:
+        """The plan's semantic fields in hashable canonical form,
+        keyed by field name (sorted at hash time — parameter order
+        cannot leak in)."""
+        out: Dict[str, Any] = {}
+        for field in dataclasses.fields(self):
+            if field.name in self._NON_SEMANTIC:
+                continue
+            value = getattr(self, field.name)
+            if field.name == "population":
+                value = None if value is None else (
+                    value.cv, value.cv_mode, value.seeds, value.sweep,
+                    value.mode, value.fe_configs,
+                )
+            elif field.name == "mesh":
+                value = None if value is None else (
+                    value.devices, value.axes, value.shape,
+                )
+            elif field.name == "config":
+                value = tuple(sorted(value.items()))
+            elif isinstance(value, tuple):
+                value = tuple(value)
+            out[field.name] = value
+        return out
+
+    def canonical_key(self) -> str:
+        """Order-insensitive, env-knob-free digest of the whole plan:
+        the identity a plan-level result cache or audit trail would
+        key on."""
+        return self._digest(
+            b"eeg-tpu-plan-canonical-v1", self.canonical_fields()
+        )
+
+    def prefix_fields(self) -> Optional[Dict[str, Any]]:
+        """The ingest+featurize half of the plan — the fields that
+        determine the ``(features, targets)`` matrix BEFORE any
+        classifier runs — or None when the plan has no dedupable
+        prefix (serve mode streams requests; it never materializes
+        the batch feature matrix).
+
+        Deliberately excluded: the classifier action (train/load/
+        fan-out/population grid/costs — the suffix), ``cache``/
+        ``degrade``/``dedup`` (they change where features come from,
+        never their bytes), and everything in ``_NON_SEMANTIC``. The
+        fused backend IS included: rungs are only tolerance-identical
+        across backends, and the prefix-dedup contract is
+        byte-identity."""
+        if self.serve:
+            return None
+        return {
+            "input_files": tuple(self.input_files),
+            "task": self.task,
+            "fe": self.fe,
+            "fe_configs": (
+                tuple(self.population.fe_configs)
+                if self.population is not None
+                and self.population.fe_configs
+                else ()
+            ),
+            "fused": self.fused,
+            "fused_wavelet": self.fused_wavelet,
+            "fused_backend": self.fused_backend,
+            "precision": self.precision,
+            "window": self.window,
+            "stride": self.stride,
+            "label_overlap": self.label_overlap,
+        }
+
+    def prefix_key(self) -> Optional[str]:
+        """Digest of :meth:`prefix_fields` — the shared-work identity
+        two tenants' plans are compared on (scheduler/dedup.py), or
+        None when the plan has no dedupable prefix."""
+        fields = self.prefix_fields()
+        if fields is None:
+            return None
+        return self._digest(b"eeg-tpu-plan-prefix-v1", fields)
+
+    @staticmethod
+    def _digest(tag: bytes, fields: Mapping[str, Any]) -> str:
+        h = hashlib.blake2b(digest_size=20)
+        h.update(tag)
+        for name in sorted(fields):
+            h.update(repr((name, fields[name])).encode())
+        return h.hexdigest()
 
     @classmethod
     def parse(cls, query: str) -> "ExecutionPlan":
@@ -352,6 +463,7 @@ class ExecutionPlan:
             overlap=overlap,
             cache=query_map.get("cache", "true") != "false",
             degrade=query_map.get("degrade", "true") != "false",
+            dedup=query_map.get("dedup", "true") != "false",
             train_clf=train_clf,
             load_clf=load_clf,
             classifiers=classifiers,

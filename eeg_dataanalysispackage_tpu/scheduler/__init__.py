@@ -9,13 +9,23 @@
 - :mod:`scheduler.executor` — the resident :class:`PlanExecutor`:
   bounded admission with shed-with-evidence, N worker threads over
   the shared plan/feature/compile caches, per-plan deadlines and
-  retry budgets, and :meth:`PlanExecutor.recover`.
+  retry budgets, idempotency-keyed submission, cancel-if-queued, and
+  :meth:`PlanExecutor.recover`;
+- :mod:`scheduler.dedup`    — cross-tenant plan-prefix dedup: two
+  tenants whose plans share a canonical ingest+featurize prefix
+  (``ExecutionPlan.prefix_key``) compute it once, with per-plan
+  leader/follower attribution.
+
+The HTTP front door over all of this lives in ``gateway/``.
 
 See docs/architecture.md for the IR schema, the executor lifecycle,
-and the crash-recovery contract.
+the dedup semantics, and the crash-recovery contract.
 """
 
+from .dedup import PrefixClaim, PrefixRegistry  # noqa: F401
 from .executor import (  # noqa: F401
+    IdempotencyConflictError,
+    PlanCancelledError,
     PlanExecutor,
     PlanFailedError,
     PlanHandle,

@@ -79,31 +79,50 @@ class PlanFailedError(RuntimeError):
     carries the per-attempt history."""
 
 
+class PlanCancelledError(RuntimeError):
+    """The plan was cancelled by its client while still queued (the
+    gateway's DELETE); it never executed."""
+
+
+class IdempotencyConflictError(ValueError):
+    """An idempotency key was reused with a DIFFERENT query body.
+    Replaying the original plan's outcome would silently hand the
+    caller statistics computed for a query it did not send, and
+    running the new body would break the key's exactly-once meaning —
+    so the reuse is rejected loudly (the gateway maps it to 409)."""
+
+
 class PlanResult:
     """A completed plan, with its execution provenance."""
 
     __slots__ = ("plan_id", "statistics", "builder", "attempts",
-                 "report_dir", "recovered")
+                 "report_dir", "recovered", "replayed")
 
     def __init__(self, plan_id, statistics, builder, attempts,
-                 report_dir, recovered=False):
+                 report_dir, recovered=False, replayed=False):
         self.plan_id = plan_id
         self.statistics = statistics
         #: the PipelineBuilder that executed the plan — its per-run
         #: attributes (timers, run_metrics, degradation_history,
         #: mesh/precision/overlap resolution, telemetry) are the
-        #: plan's isolated observability surface
+        #: plan's isolated observability surface. None for a replayed
+        #: result (the outcome came from the journal; ``statistics``
+        #: is then the journaled text, equal under ``str()``).
         self.builder = builder
         self.attempts = attempts
         self.report_dir = report_dir
         #: True when this result came from journal recovery (a re-run
         #: of a plan some dead process left unfinished)
         self.recovered = recovered
+        #: True when this result was REPLAYED from a terminal journal
+        #: record (an idempotency-keyed re-submit of a completed plan:
+        #: exactly-once, nothing re-executed)
+        self.replayed = replayed
 
     def __repr__(self) -> str:
         return (
             f"PlanResult({self.plan_id}, attempts={self.attempts}, "
-            f"recovered={self.recovered})"
+            f"recovered={self.recovered}, replayed={self.replayed})"
         )
 
 
@@ -112,10 +131,11 @@ class _PlanTicket:
 
     __slots__ = ("plan", "plan_id", "deadline", "future",
                  "submitted_at", "attempts", "history", "fault_plan",
-                 "report_dir", "recovered")
+                 "report_dir", "recovered", "state",
+                 "idempotency_key", "gateway")
 
     def __init__(self, plan, plan_id, deadline, fault_plan, report_dir,
-                 recovered=False):
+                 recovered=False, idempotency_key=None, gateway=None):
         self.plan = plan
         self.plan_id = plan_id
         self.deadline: Optional[deadline_mod.Deadline] = deadline
@@ -126,6 +146,14 @@ class _PlanTicket:
         self.fault_plan = fault_plan
         self.report_dir = report_dir
         self.recovered = recovered
+        #: the gateway's status surface: queued -> running ->
+        #: completed | failed | cancelled (transitions written by the
+        #: submit/worker/cancel paths that own each edge)
+        self.state = "queued"
+        self.idempotency_key = idempotency_key
+        #: networked-submission attribution (gateway/), echoed into
+        #: the plan's run report; None for in-process submissions
+        self.gateway = gateway
 
     def batch_key(self):
         # plans never coalesce: every ticket is its own micro-batch
@@ -133,19 +161,77 @@ class _PlanTicket:
         return self.plan_id
 
 
+class _ReplayTicket:
+    """A terminal journal record wearing the ticket interface: the
+    resolved handle an idempotency-keyed re-submit of a finished plan
+    gets back — nothing is re-executed, the journaled outcome IS the
+    outcome (exactly-once made client-visible)."""
+
+    __slots__ = ("plan_id", "query", "future", "state", "attempts",
+                 "history", "recovered", "idempotency_key", "gateway")
+
+    def __init__(self, entry: Dict[str, Any]):
+        meta = entry.get("meta") or {}
+        self.plan_id = entry["plan_id"]
+        self.query = entry.get("query", "")
+        self.future = ServeFuture()
+        self.attempts = int(entry.get("attempts", 1) or 0)
+        self.history: List[str] = []
+        self.recovered = bool(meta.get("recovered"))
+        self.idempotency_key = meta.get("idempotency_key")
+        self.gateway = meta.get("gateway")
+        if entry.get("state") == journal_mod.COMPLETED:
+            self.state = "completed"
+            self.future.resolve(PlanResult(
+                plan_id=self.plan_id,
+                statistics=entry.get("statistics", ""),
+                builder=None,
+                attempts=self.attempts,
+                report_dir=meta.get("report_dir"),
+                recovered=self.recovered,
+                replayed=True,
+            ))
+        else:
+            self.state = "failed"
+            self.future.fail(PlanFailedError(
+                f"plan {self.plan_id} failed (journaled outcome, not "
+                f"re-executed): {entry.get('error', '')}"
+            ))
+
+
 class PlanHandle:
     """The submitter's side of one plan: a resolve-once future."""
 
-    __slots__ = ("plan_id", "query", "_ticket")
+    __slots__ = ("plan_id", "query", "_ticket", "replayed")
 
-    def __init__(self, ticket: _PlanTicket):
+    def __init__(self, ticket, replayed: bool = False):
         self.plan_id = ticket.plan_id
-        self.query = ticket.plan.query
+        self.query = (
+            ticket.query if isinstance(ticket, _ReplayTicket)
+            else ticket.plan.query
+        )
         self._ticket = ticket
+        #: True when this handle resolves a prior submission's outcome
+        #: (an idempotency-keyed re-submit): the plan id is the
+        #: ORIGINAL one and nothing was enqueued for this call
+        self.replayed = replayed
 
     @property
     def done(self) -> bool:
         return self._ticket.future.done
+
+    @property
+    def state(self) -> str:
+        """queued | running | completed | failed | cancelled."""
+        return self._ticket.state
+
+    @property
+    def attempts(self) -> int:
+        return self._ticket.attempts
+
+    @property
+    def history(self) -> List[str]:
+        return list(self._ticket.history)
 
     def result(self, timeout: Optional[float] = None) -> PlanResult:
         """Block for the outcome; raises the plan's failure
@@ -204,6 +290,20 @@ class PlanExecutor:
         self._started = False
         self._lock = threading.Lock()
         self._submit_lock = threading.Lock()
+        #: every live ticket this executor admitted, by plan id — the
+        #: status/cancel/idempotent-rejoin surface. Once a TERMINAL
+        #: journal record has LANDED the ticket is evicted (a
+        #: completed result pins its whole PipelineBuilder; failed/
+        #: cancelled tickets pin their plan + fault plan) —
+        #: status()/keyed re-submits fall back to the journal — so a
+        #: resident executor's memory stays bounded by its queue, not
+        #: its history. A degraded journal write keeps the ticket:
+        #: the live copy is then the only record. Unjournaled
+        #: executors keep everything (the in-process result surface).
+        self._tickets: Dict[str, Any] = {}
+        #: idempotency key -> plan id, seeded from the journal so a
+        #: retried submit after a crash resolves to the ORIGINAL plan
+        self._idempotency: Dict[str, str] = self._seed_idempotency()
 
     def _seed_id(self) -> int:
         if self.journal is None:
@@ -217,6 +317,16 @@ class PlanExecutor:
                 except ValueError:
                     pass
         return max_seen
+
+    def _seed_idempotency(self) -> Dict[str, str]:
+        out: Dict[str, str] = {}
+        if self.journal is None:
+            return out
+        for entry in self.journal.entries():
+            key = (entry.get("meta") or {}).get("idempotency_key")
+            if key:
+                out[str(key)] = entry["plan_id"]
+        return out
 
     # -- lifecycle -------------------------------------------------------
 
@@ -251,6 +361,7 @@ class PlanExecutor:
         with self._submit_lock:
             pending = self.queue.drain_pending()
         for ticket in pending:
+            ticket.state = "failed"
             ticket.future.fail(ServiceClosedError(
                 f"plan {ticket.plan_id} abandoned by executor close()"
                 + (
@@ -279,12 +390,28 @@ class PlanExecutor:
         deadline_s: Optional[float] = None,
         plan_id: Optional[str] = None,
         _recovered: bool = False,
+        idempotency_key: Optional[str] = None,
+        gateway: Optional[Dict[str, Any]] = None,
     ) -> PlanHandle:
         """Validate, journal, and enqueue one plan; returns its
         handle. Sheds with :class:`PlanShedError` (evidence included)
         when the queue is full — parse/validation errors raise
         *before* anything is journaled or queued, so an invalid query
-        costs nothing and recovery never sees it."""
+        costs nothing and recovery never sees it.
+
+        ``idempotency_key`` makes the submission retry-safe across
+        crashes and timeouts: the key is journaled with the plan
+        record, and a re-submit carrying the same key returns the
+        ORIGINAL plan's handle — the live ticket while it runs, the
+        journaled outcome once it is terminal (completed plans are
+        never re-executed), a recovery re-admission when a dead
+        process left only the write-ahead record. A shed never burns
+        the key (backpressure must stay retryable), and neither does
+        a client cancel.
+
+        ``gateway`` is networked-submission attribution ({"via",
+        "idempotency_key", "client"}), journaled and echoed into the
+        plan's run report."""
         from ..pipeline.plan import ExecutionPlan
 
         if self._stop.is_set():
@@ -300,7 +427,6 @@ class PlanExecutor:
             if isinstance(query_or_plan, ExecutionPlan)
             else ExecutionPlan.parse(query_or_plan)
         )
-        plan_id = plan_id or self._next_id()
         # one fault plan per submission, shared across retry attempts
         # (runtime.execute_plan would otherwise parse a fresh one per
         # attempt and deterministically replay the same firings)
@@ -310,19 +436,10 @@ class PlanExecutor:
             if spec
             else None
         )
-        report_dir = (
-            None
-            if self.report_root is None
-            else f"{self.report_root.rstrip('/')}/{plan_id}"
-        )
         deadline = (
             deadline_mod.Deadline(deadline_s)
             if deadline_s is not None
             else None
-        )
-        ticket = _PlanTicket(
-            plan, plan_id, deadline, fault_plan, report_dir,
-            recovered=_recovered,
         )
         with self._submit_lock:
             # checked under the same lock close() drains under: a
@@ -337,6 +454,84 @@ class PlanExecutor:
                 raise ServiceClosedError(
                     "executor is closed; no new plan admissions"
                 )
+            if _recovered and plan_id is not None:
+                live = self._tickets.get(plan_id)
+                if live is not None:
+                    # an idempotency-keyed re-submit raced recover()
+                    # and already re-admitted this journal record
+                    # under its original id — one ticket, one
+                    # execution (re-admitting again would run the
+                    # same plan twice into the same report_dir)
+                    return PlanHandle(live, replayed=True)
+            if idempotency_key and not _recovered:
+                # the check and the (later) registration share this
+                # lock: two concurrent submits with one key resolve to
+                # exactly one execution
+                existing = self._idempotency.get(idempotency_key)
+                if existing is not None:
+                    live = self._tickets.get(existing)
+                    entry = (
+                        self.journal.entry(existing)
+                        if self.journal is not None and live is None
+                        else None
+                    )
+                    # the key's original query — replaying a DIFFERENT
+                    # body's outcome (or running a new body under the
+                    # old id) would both be silent lies
+                    original = (
+                        live.plan.query if live is not None
+                        else entry.get("query") if entry is not None
+                        else None
+                    )
+                    if original is not None and original != plan.query:
+                        raise IdempotencyConflictError(
+                            f"idempotency key {idempotency_key!r} was "
+                            f"already used for a different query "
+                            f"(plan {existing}); retry with the "
+                            f"original body or a fresh key"
+                        )
+                    if live is not None:
+                        obs.metrics.count("scheduler.idempotent_rejoin")
+                        events.event(
+                            "scheduler.idempotent_rejoin", plan=existing
+                        )
+                        return PlanHandle(live, replayed=True)
+                    if entry is not None and entry.get("state") in (
+                        journal_mod.COMPLETED, journal_mod.FAILED
+                    ):
+                        # terminal: replay the journaled outcome —
+                        # exactly-once, nothing enqueued
+                        obs.metrics.count("scheduler.idempotent_replay")
+                        events.event(
+                            "scheduler.idempotent_replay", plan=existing
+                        )
+                        return PlanHandle(
+                            _ReplayTicket(entry), replayed=True
+                        )
+                    if entry is not None:
+                        # a dead process's write-ahead record that
+                        # recover() has not resumed: re-admit under
+                        # the ORIGINAL id — never shed, it was
+                        # admitted once
+                        plan_id = existing
+                        _recovered = True
+                    # else: the mapping points at a record a degraded
+                    # journal lost — fall through as a fresh submit
+            if plan_id is None:
+                # minted only once the idempotency checks are past: a
+                # replayed/rejoined submit consumes no id (ids in the
+                # journal stay gapless under replay-heavy clients)
+                plan_id = self._next_id()
+            report_dir = (
+                None
+                if self.report_root is None
+                else f"{self.report_root.rstrip('/')}/{plan_id}"
+            )
+            ticket = _PlanTicket(
+                plan, plan_id, deadline, fault_plan, report_dir,
+                recovered=_recovered, idempotency_key=idempotency_key,
+                gateway=gateway,
+            )
             if self.journal is not None:
                 # journal writes belong to the plan's fault domain:
                 # its scheduler.journal chaos rules govern them, and
@@ -351,6 +546,8 @@ class PlanExecutor:
                             "deadline_s": deadline_s,
                             "report_dir": report_dir,
                             "recovered": _recovered,
+                            "idempotency_key": idempotency_key,
+                            "gateway": gateway,
                         },
                     )
             if _recovered:
@@ -371,6 +568,13 @@ class PlanExecutor:
                 evidence = (
                     "" if admitted else self.queue.last_shed_evidence
                 )
+            if admitted:
+                # registered under the same lock as the idempotency
+                # check above — a racing same-key submit sees either
+                # nothing (and runs) or this ticket (and rejoins)
+                self._tickets[plan_id] = ticket
+                if idempotency_key:
+                    self._idempotency[idempotency_key] = plan_id
         if not admitted:
             # same invariant as every other journal write: the shed
             # record (and its counter) belongs to THIS plan's fault
@@ -451,6 +655,105 @@ class PlanExecutor:
                             raise
         return [h.result(timeout=timeout_s) for h in handles]
 
+    # -- the gateway's status/cancel surface ------------------------------
+
+    def status(self, plan_id: str) -> Optional[Dict[str, Any]]:
+        """One plan's client-visible status — the live ticket's state
+        machine (queued | running | completed | failed | cancelled)
+        with its attempt history, falling back to the journal record
+        (completed | failed | submitted) for plans this executor never
+        admitted; None for an unknown id."""
+        ticket = self._tickets.get(plan_id)
+        if ticket is not None:
+            return {
+                "plan_id": plan_id,
+                "state": ticket.state,
+                "attempts": ticket.attempts,
+                "history": list(ticket.history),
+                "query": ticket.plan.query,
+                "recovered": ticket.recovered,
+                "report_dir": ticket.report_dir,
+            }
+        if self.journal is not None:
+            entry = self.journal.entry(plan_id)
+            if entry is not None:
+                meta = entry.get("meta") or {}
+                return {
+                    "plan_id": plan_id,
+                    # a cancel journals as a failure record (with the
+                    # evidence) but the client-visible state machine
+                    # keeps the distinction
+                    "state": (
+                        "cancelled" if meta.get("cancelled")
+                        else entry.get("state")
+                    ),
+                    "attempts": int(entry.get("attempts", 0) or 0),
+                    "history": [],
+                    "query": entry.get("query", ""),
+                    "error": entry.get("error"),
+                    "statistics_sha256": entry.get("statistics_sha256"),
+                    "report_dir": meta.get("report_dir"),
+                }
+        return None
+
+    def cancel(self, plan_id: str) -> bool:
+        """Cancel-if-queued (the gateway's DELETE): withdraw a plan
+        the workers have not popped yet. True = cancelled (its handle
+        fails with :class:`PlanCancelledError`, a terminal journal
+        record carries the evidence); False = already running or
+        terminal — an executing plan is not torn down mid-flight (its
+        fault domain owns cleanup), the client awaits it instead.
+
+        A cancel releases the plan's idempotency key: cancelling is a
+        client decision, not a deterministic outcome, so a re-submit
+        with the same key runs fresh."""
+        ticket = self._tickets.get(plan_id)
+        if ticket is None or not isinstance(ticket, _PlanTicket):
+            return False
+        if not self.queue.remove(ticket):
+            # the pop path shares the queue lock: losing this race
+            # means a worker owns the plan now
+            return False
+        ticket.state = "cancelled"
+        with self._submit_lock:
+            key = ticket.idempotency_key
+            if key and self._idempotency.get(key) == plan_id:
+                del self._idempotency[key]
+        journaled = False
+        with run_domain.activate(run_domain.RunDomain(
+            plan_id=plan_id, chaos=ticket.fault_plan
+        )):
+            obs.metrics.count("scheduler.cancelled")
+            events.event("scheduler.cancelled", plan=plan_id)
+            if self.journal is not None:
+                # no idempotency key in the meta — see above
+                journaled = self.journal.record_failed(
+                    plan_id, ticket.plan.query,
+                    "cancelled by client while queued; never executed",
+                    attempts=0,
+                    meta={"cancelled": True, "gateway": ticket.gateway},
+                )
+        ticket.future.fail(PlanCancelledError(
+            f"plan {plan_id} cancelled while queued; never executed"
+        ))
+        if journaled:
+            # terminal-and-journaled, like every other eviction; the
+            # journal fallback reports state 'cancelled' via the
+            # record's meta
+            self._tickets.pop(plan_id, None)
+        return True
+
+    def handle(self, plan_id: str) -> Optional[PlanHandle]:
+        """The handle for a live (this-process) plan id, or None."""
+        ticket = self._tickets.get(plan_id)
+        return None if ticket is None else PlanHandle(ticket)
+
+    def live_ids(self) -> List[str]:
+        """Plan ids with a live ticket (queued/running, plus any
+        terminal plan whose journal write degraded) — the set whose
+        state the journal does not yet know."""
+        return list(self._tickets)
+
     # -- crash-only recovery ---------------------------------------------
 
     def recover(self) -> Dict[str, Any]:
@@ -480,6 +783,8 @@ class PlanExecutor:
                     deadline_s=meta.get("deadline_s"),
                     plan_id=entry["plan_id"],
                     _recovered=True,
+                    idempotency_key=meta.get("idempotency_key"),
+                    gateway=meta.get("gateway"),
                 ))
         # fresh ids already start past the dead process's (the
         # constructor seeds the counter from the journal)
@@ -509,6 +814,7 @@ class PlanExecutor:
     def _execute_ticket(self, ticket: _PlanTicket) -> None:
         from ..pipeline.builder import PipelineBuilder
 
+        ticket.state = "running"
         while True:
             if ticket.deadline is not None and ticket.deadline.expired:
                 # attempts == 0: the budget died in the admission
@@ -549,6 +855,7 @@ class PlanExecutor:
                         plan_id=ticket.plan_id,
                         fault_plan=ticket.fault_plan,
                         default_report_dir=ticket.report_dir,
+                        gateway=ticket.gateway,
                     )
             except Exception as e:
                 ticket.attempts += 1
@@ -609,22 +916,29 @@ class PlanExecutor:
                 time.sleep(self.retry_backoff_s)
                 continue
             ticket.attempts += 1
+            journaled = False
             if self.journal is not None:
                 # same fault-domain rule as the submit-side record
                 with run_domain.activate(run_domain.RunDomain(
                     plan_id=ticket.plan_id, chaos=ticket.fault_plan
                 )):
-                    self.journal.record_completed(
+                    journaled = self.journal.record_completed(
                         ticket.plan_id, ticket.plan.query,
                         str(statistics),
                         attempts=ticket.attempts,
-                        meta={"recovered": ticket.recovered},
+                        meta={
+                            "recovered": ticket.recovered,
+                            "idempotency_key": ticket.idempotency_key,
+                            "gateway": ticket.gateway,
+                            "report_dir": ticket.report_dir,
+                        },
                     )
             obs.metrics.count("scheduler.completed")
             events.event(
                 "scheduler.completed", plan=ticket.plan_id,
                 attempts=ticket.attempts,
             )
+            ticket.state = "completed"
             ticket.future.resolve(PlanResult(
                 plan_id=ticket.plan_id,
                 statistics=statistics,
@@ -633,15 +947,36 @@ class PlanExecutor:
                 report_dir=ticket.report_dir,
                 recovered=ticket.recovered,
             ))
+            if journaled:
+                # the durable record has LANDED: evict the live
+                # ticket so its result (which pins the whole
+                # PipelineBuilder) can be collected once the caller
+                # drops the handle — status() and keyed re-submits
+                # fall back to the journal (a degraded journal write
+                # keeps the ticket instead: the live copy is then the
+                # only record)
+                self._tickets.pop(ticket.plan_id, None)
             return
 
     def _record_failed(self, ticket: _PlanTicket, error: str) -> None:
+        ticket.state = "failed"
         obs.metrics.count("scheduler.failed")
         if self.journal is not None:
             with run_domain.activate(run_domain.RunDomain(
                 plan_id=ticket.plan_id, chaos=ticket.fault_plan
             )):
-                self.journal.record_failed(
+                journaled = self.journal.record_failed(
                     ticket.plan_id, ticket.plan.query, error,
                     attempts=ticket.attempts,
+                    meta={
+                        "idempotency_key": ticket.idempotency_key,
+                        "gateway": ticket.gateway,
+                        "report_dir": ticket.report_dir,
+                    },
                 )
+            if journaled:
+                # same bound as the completed path: the journal now
+                # holds the terminal record (error + attempts), so
+                # the live ticket — its ExecutionPlan, fault plan,
+                # deadline — need not outlive it
+                self._tickets.pop(ticket.plan_id, None)

@@ -145,10 +145,16 @@ class PlanJournal:
     def record_failed(
         self, plan_id: str, query: str, error: str,
         attempts: int = 1,
+        meta: Optional[Dict[str, Any]] = None,
     ) -> bool:
         """Terminal failure (retry budget exhausted / deadline spent):
         recovery does NOT re-run it — a deterministic failure would
-        fail identically, and the record carries the evidence."""
+        fail identically, and the record carries the evidence. ``meta``
+        carries the same submission metadata as the other records
+        (notably the idempotency key, so a keyed re-submit of a failed
+        plan replays the journaled outcome instead of re-running a
+        deterministic failure; the shed branch deliberately omits the
+        key — backpressure must stay retryable)."""
         return self._write(plan_id, {
             "plan_id": plan_id,
             "state": FAILED,
@@ -158,6 +164,7 @@ class PlanJournal:
             ),
             "attempts": attempts,
             "error": error,
+            "meta": meta or {},
         })
 
     # -- reads -----------------------------------------------------------

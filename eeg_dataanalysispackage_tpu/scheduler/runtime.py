@@ -46,6 +46,7 @@ def execute_plan(
     plan_id: Optional[str] = None,
     fault_plan=_RESOLVE,
     default_report_dir: Optional[str] = None,
+    gateway: Optional[dict] = None,
 ):
     """Run ``plan`` through ``builder`` inside a fresh fault domain;
     returns the statistics (and leaves the builder's per-run
@@ -64,6 +65,10 @@ def execute_plan(
     query itself didn't say (the executor assigns each plan its own
     directory under its report root); an explicit ``report=`` in the
     query — including ``report=false`` — always wins.
+
+    ``gateway`` — networked-submission attribution (the HTTP front
+    door's {"via", "idempotency_key", "client"} block) echoed into
+    run_report.json, so an artifact names how its plan arrived.
     """
     query_map = plan.query_map
     logger.info("query: %s", query_map)
@@ -97,6 +102,7 @@ def execute_plan(
     builder.precision_resolved = None
     builder.overlap_resolved = None
     builder.mesh_resolved = None
+    builder.dedup_resolved = None
     # fresh per run, like the metrics scope below: a reused builder
     # must not report run 1's stage seconds under run 2
     builder.timers = obs.StageTimer()
@@ -126,6 +132,7 @@ def execute_plan(
                 plan.query, query_map, report_dir
             )
             builder.telemetry.plan_id = plan_id
+            builder.telemetry.gateway = gateway
             # the builder appends rung drops as they happen; the
             # report reads this shared list
             builder.telemetry.degradation = builder.degradation_history

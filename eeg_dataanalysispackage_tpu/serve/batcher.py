@@ -267,6 +267,20 @@ class AdmissionQueue:
                 self._not_full.notify(len(batch))
         return batch
 
+    def remove(self, request: Request) -> bool:
+        """Withdraw one still-queued request (the plan executor's
+        cancel-if-queued). True = it was queued and is now gone; False
+        = a worker already popped it (or it was never here). The pop
+        path and this share one lock, so a request is removed XOR
+        collected — never both."""
+        with self._lock:
+            try:
+                self._items.remove(request)
+            except ValueError:
+                return False
+            self._not_full.notify()
+            return True
+
     def drain_pending(self) -> List[Request]:
         """Remove and return everything queued (watchdog / shutdown)."""
         with self._lock:

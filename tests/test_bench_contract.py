@@ -470,3 +470,59 @@ def test_collect_normalizes_the_plateau_block(monkeypatch):
         1393.4 / 180386.0, 5
     )
     assert plateau["beats_pr5_plateau_normalized"] is True
+
+
+def test_plan_service_variant_in_both_tables_and_routing():
+    """The networked plan service (ISSUE 11) rides every bench
+    artifact, sized identically on TPU and the CPU fallback, through
+    the pipeline_bench child."""
+    import inspect
+
+    for table in (bench._VARIANTS_TPU, bench._VARIANTS_CPU):
+        assert "plan_service" in table
+        # same synthetic session shape as the executor line it
+        # fronts — the pair is directly comparable from one artifact
+        assert table["plan_service"] == table["scheduler_multi"]
+    src = inspect.getsource(bench._run_variant)
+    assert '"plan_service"' in src and "pipeline_bench.py" in src
+
+
+def test_collect_propagates_plan_service_field(monkeypatch):
+    """The plan_service line's dedup-pair / idempotency / soak block
+    must survive the parent's field whitelist into the published
+    artifact — the exactly-once and common-subplan claims are only
+    auditable from the artifact if the block rides the line."""
+    block = {
+        "pair": {
+            "stores": 1,
+            "dedup": {"leads": 1, "hits": 1, "hit_ratio": 0.5},
+            "statistics_identical_to_solo": True,
+            "idempotent_resubmit": {
+                "http": 200, "same_plan_id": True, "replayed": True,
+            },
+        },
+        "soak": {
+            "submits_per_s": 42.0, "all_resolved": True,
+            "statistics_identical": True, "sheds": 0,
+        },
+    }
+    monkeypatch.setattr(
+        bench, "_VARIANTS_CPU",
+        {"einsum": (8, 2), "plan_service": (2000, 4)},
+    )
+    monkeypatch.setattr(
+        bench,
+        "_run_variant",
+        lambda name, platform, n, iters: {
+            "epochs_per_s": 1.0,
+            "bytes_per_epoch": 6000,
+            "n": n,
+            "wall_s": 1.0,
+            "report_sha256": "abc",
+            **({"plan_service": block}
+               if name == "plan_service" else {}),
+        },
+    )
+    v = bench._collect("cpu_fallback")["variants"]["plan_service"]
+    assert v["plan_service"] == block
+    assert v["report_sha256"] == "abc"

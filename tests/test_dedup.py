@@ -1,0 +1,498 @@
+"""Cross-tenant plan-prefix dedup (ISSUE 11).
+
+The acceptance pins:
+
+- **canonicalization** — ``ExecutionPlan.canonical_key`` is
+  order-insensitive over the typed fields and blind to every
+  non-semantic knob (faults, worker counts, artifact paths);
+  ``prefix_key`` names only the ingest+featurize half, so classifier
+  suffix changes share it and feature-config changes split it;
+- **single-flight value sharing** — two tenants whose plans share a
+  canonical prefix compute it ONCE (one feature-cache store, one read
+  pass), with per-plan leader/follower attribution in each plan's
+  isolated metrics and run report, and BOTH plans' statistics
+  byte-identical to their solo unshared runs;
+- **isolation under leader failure** — chaos in the leader's fault
+  domain abandons the entry; the follower is promoted, computes its
+  own prefix, and lands clean-twin statistics (time, never
+  correctness);
+- **opt-outs** — ``dedup=false`` / ``EEG_TPU_NO_PREFIX_DEDUP=1``
+  restore fully independent builds.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import _synthetic
+from eeg_dataanalysispackage_tpu import obs
+from eeg_dataanalysispackage_tpu.io import deadline as deadline_mod
+from eeg_dataanalysispackage_tpu.obs import chaos, domain as run_domain
+from eeg_dataanalysispackage_tpu.pipeline import builder
+from eeg_dataanalysispackage_tpu.pipeline.plan import ExecutionPlan
+from eeg_dataanalysispackage_tpu.scheduler import PlanExecutor
+from eeg_dataanalysispackage_tpu.scheduler import dedup as dedup_mod
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    """Process-global registry: every test starts and ends empty."""
+    dedup_mod.reset()
+    assert chaos.active_plan() is None
+    assert run_domain.current() is None
+    yield
+    dedup_mod.reset()
+    chaos.uninstall()
+
+
+@pytest.fixture()
+def session(tmp_path):
+    return _synthetic.write_session(str(tmp_path), n_markers=60)
+
+
+def _q(info, extra="", clf="logreg", fe="dwt-8-fused"):
+    return (
+        f"info_file={info}&fe={fe}&train_clf={clf}"
+        "&config_step_size=1.0&config_num_iterations=20"
+        "&config_mini_batch_fraction=1.0" + extra
+    )
+
+
+# -- canonicalization --------------------------------------------------
+
+
+def test_canonical_key_is_order_insensitive(session):
+    a = ExecutionPlan.parse(_q(session))
+    b = ExecutionPlan.parse(
+        f"train_clf=logreg&fe=dwt-8-fused&info_file={session}"
+        "&config_num_iterations=20&config_step_size=1.0"
+        "&config_mini_batch_fraction=1.0"
+    )
+    assert a.canonical_key() == b.canonical_key()
+    assert a.prefix_key() == b.prefix_key()
+
+
+def test_canonical_key_ignores_non_semantic_knobs(session):
+    base = ExecutionPlan.parse(_q(session))
+    for extra in (
+        "&ingest_workers=4", "&prefetch=7",
+        "&faults=ingest.fused:once@1", "&report=false",
+        "&overlap=true",
+    ):
+        assert ExecutionPlan.parse(
+            _q(session, extra)
+        ).canonical_key() == base.canonical_key(), extra
+
+
+def test_canonical_key_splits_on_semantic_knobs(session):
+    base = ExecutionPlan.parse(_q(session))
+    for extra in (
+        "&config_step_size=0.5", "&precision=bf16", "&cache=false",
+    ):
+        assert ExecutionPlan.parse(
+            _q(session, extra)
+        ).canonical_key() != base.canonical_key(), extra
+    assert ExecutionPlan.parse(
+        _q(session, clf="svm")
+    ).canonical_key() != base.canonical_key()
+
+
+def test_prefix_key_shared_across_classifier_suffixes(session):
+    a = ExecutionPlan.parse(_q(session, clf="logreg"))
+    b = ExecutionPlan.parse(
+        _q(session, "&config_reg_param=0.1", clf="svm")
+    )
+    assert a.canonical_key() != b.canonical_key()
+    assert a.prefix_key() == b.prefix_key()
+
+
+def test_prefix_key_splits_on_featurize_knobs(session, tmp_path):
+    base = ExecutionPlan.parse(_q(session))
+    for extra, fe in (
+        ("", "dwt-8-fused-block"),
+        ("&precision=bf16", "dwt-8-fused"),
+    ):
+        other = ExecutionPlan.parse(_q(session, extra, fe=fe))
+        assert other.prefix_key() != base.prefix_key(), (extra, fe)
+    import os as _os
+
+    _os.makedirs(str(tmp_path / "other"))
+    other_session = _synthetic.write_session(
+        str(tmp_path / "other"), n_markers=60
+    )
+    assert ExecutionPlan.parse(
+        _q(other_session)
+    ).prefix_key() != base.prefix_key()
+
+
+def test_serve_plans_have_no_prefix(session):
+    plan = ExecutionPlan.parse(
+        f"info_file={session}&fe=dwt-8&serve=true&load_clf=logreg"
+        "&result_path=/tmp/x"
+    )
+    assert plan.prefix_key() is None
+    assert not dedup_mod.eligible(plan)
+
+
+def test_host_p300_path_not_deduped(session):
+    # fe=dwt-8 (host epoch-batch path) never materializes the fused
+    # feature matrix the registry shares
+    assert not dedup_mod.eligible(ExecutionPlan.parse(_q(session, fe="dwt-8")))
+    assert dedup_mod.eligible(ExecutionPlan.parse(_q(session)))
+
+
+def test_opt_outs(session, monkeypatch):
+    plan = ExecutionPlan.parse(_q(session, "&dedup=false"))
+    assert not plan.dedup
+    assert not dedup_mod.eligible(plan)
+    monkeypatch.setenv(dedup_mod.ENV_DISABLE, "1")
+    assert not dedup_mod.eligible(ExecutionPlan.parse(_q(session)))
+
+
+# -- the registry protocol ---------------------------------------------
+
+
+def test_leader_follower_value_sharing():
+    registry = dedup_mod.PrefixRegistry()
+    value = (np.ones((4, 2)), np.zeros(4))
+    leader = registry.acquire("k1", "pA")
+    assert leader.role == "leader"
+    got = {}
+
+    def follow():
+        claim = registry.acquire("k1", "pB")
+        got["claim"] = claim
+
+    t = threading.Thread(target=follow)
+    t.start()
+    time.sleep(0.05)  # follower parked on the building entry
+    leader.publish(value, meta={"precision_used": "f32"})
+    t.join(timeout=10)
+    claim = got["claim"]
+    assert claim.role == "follower"
+    assert claim.leader_plan == "pA"
+    assert claim.meta == {"precision_used": "f32"}
+    assert claim.bytes_saved == value[0].nbytes + value[1].nbytes
+    np.testing.assert_array_equal(claim.value[0], value[0])
+    # published arrays are frozen: no tenant can mutate another's
+    with pytest.raises(ValueError):
+        claim.value[0][0, 0] = 5.0
+    stats = registry.stats()
+    assert stats["leads"] == 1 and stats["hits"] == 1
+    assert stats["hit_ratio"] == 0.5
+
+
+def test_abandoned_leader_promotes_follower():
+    registry = dedup_mod.PrefixRegistry()
+    leader = registry.acquire("k1", "pA")
+    got = {}
+
+    def follow():
+        got["claim"] = registry.acquire("k1", "pB")
+
+    t = threading.Thread(target=follow)
+    t.start()
+    time.sleep(0.05)
+    leader.settle()  # unpublished leader in a finally: abandons
+    t.join(timeout=10)
+    claim = got["claim"]
+    assert claim.role == "leader"
+    assert claim.leader_failed
+    assert registry.stats()["leader_failures"] == 1
+
+
+def test_follower_wait_honours_deadline():
+    registry = dedup_mod.PrefixRegistry()
+    registry.acquire("k1", "pA")  # building, never published
+    with deadline_mod.deadline_scope(deadline_mod.Deadline(0.15)):
+        with pytest.raises(deadline_mod.DeadlineExceededError):
+            registry.acquire("k1", "pB")
+
+
+def test_ready_entries_are_lru_bounded():
+    registry = dedup_mod.PrefixRegistry(capacity=2)
+    for i in range(3):
+        claim = registry.acquire(f"k{i}", f"p{i}")
+        claim.publish((np.zeros(1),))
+    stats = registry.stats()
+    assert stats["entries"] == 2 and stats["evictions"] == 1
+    # k0 (oldest) evicted: a new claim on it leads again
+    assert registry.acquire("k0", "pX").role == "leader"
+    assert registry.acquire("k1", "pY").role == "follower"
+
+
+# -- end to end through the executor -----------------------------------
+
+
+def _sha(statistics):
+    import hashlib
+
+    return hashlib.sha256(str(statistics).encode()).hexdigest()
+
+
+def test_shared_prefix_pair_computes_once(session, tmp_path):
+    """The acceptance pin: a shared-prefix pair computes the
+    ingest+featurize prefix exactly once (store==1, the follower a
+    dedup hit) and BOTH plans' statistics are byte-identical to their
+    solo unshared runs."""
+    pre_solo = obs.metrics.snapshot()["counters"]
+    solo_a = builder.PipelineBuilder(
+        _q(session, "&dedup=false")
+    ).execute()
+    # reads one solo build costs (3 per recording: .eeg/.vhdr/.vmrk)
+    reads_per_build = int(
+        obs.metrics.snapshot()["counters"].get("ingest.file_reads", 0)
+        - pre_solo.get("ingest.file_reads", 0)
+    )
+    solo_b = builder.PipelineBuilder(
+        _q(session, "&config_reg_param=0.1&dedup=false", clf="svm")
+    ).execute()
+
+    dedup_mod.reset()
+    before = obs.metrics.snapshot()["counters"]
+    with PlanExecutor(
+        max_concurrent=2, report_root=str(tmp_path / "reports")
+    ) as ex:
+        h_a = ex.submit(_q(session))
+        h_b = ex.submit(
+            _q(session, "&config_reg_param=0.1", clf="svm")
+        )
+        r_a = h_a.result(timeout=300)
+        r_b = h_b.result(timeout=300)
+    after = obs.metrics.snapshot()["counters"]
+
+    assert _sha(r_a.statistics) == _sha(solo_a)
+    assert _sha(r_b.statistics) == _sha(solo_b)
+    stats = dedup_mod.stats()
+    assert stats["leads"] == 1 and stats["hits"] == 1
+    # exactly one read+featurize pass between the two plans: the
+    # deduped pair read precisely what ONE solo build reads
+    assert reads_per_build > 0
+    assert int(
+        after.get("ingest.file_reads", 0)
+        - before.get("ingest.file_reads", 0)
+    ) == reads_per_build
+
+    # per-plan attribution: one leader block, one follower block
+    # naming the leader, in the plans' OWN reports
+    blocks = {}
+    for r in (r_a, r_b):
+        report = json.load(open(
+            tmp_path / "reports" / r.plan_id / "run_report.json"
+        ))
+        blocks[r.plan_id] = report["dedup"]
+        assert report["dedup"] is not None
+    roles = {b["role"] for b in blocks.values()}
+    assert roles == {"leader", "follower"}
+    follower = next(
+        b for b in blocks.values() if b["role"] == "follower"
+    )
+    leader = next(b for b in blocks.values() if b["role"] == "leader")
+    assert follower["leader_plan"] in blocks
+    assert blocks[follower["leader_plan"]]["role"] == "leader"
+    assert follower["bytes_saved"] > 0
+    assert follower["seconds_saved"] >= 0
+    assert leader["build_seconds"] > 0
+    assert follower["prefix_key"] == leader["prefix_key"]
+
+
+def test_dedup_false_builds_independently(session):
+    dedup_mod.reset()
+    with PlanExecutor(max_concurrent=2) as ex:
+        h1 = ex.submit(_q(session, "&dedup=false"))
+        h2 = ex.submit(_q(session, "&dedup=false"))
+        r1 = h1.result(timeout=300)
+        r2 = h2.result(timeout=300)
+    assert str(r1.statistics) == str(r2.statistics)
+    stats = dedup_mod.stats()
+    assert stats["leads"] == 0 and stats["hits"] == 0
+    assert r1.builder.dedup_resolved is None
+
+
+def test_leader_failure_promotes_follower_end_to_end(session):
+    """Leader failure must cost the follower time, never correctness:
+    with the prefix claim held by a doomed leader, a clean plan parks,
+    is promoted when the leader abandons, computes its OWN prefix, and
+    lands clean-twin statistics — with the promotion recorded in its
+    dedup block. Deterministic: the test itself plays the doomed
+    leader (holding the claim through the real registry), so the
+    interleaving cannot race."""
+    solo = builder.PipelineBuilder(_q(session, "&dedup=false")).execute()
+    dedup_mod.reset()
+    key = ExecutionPlan.parse(_q(session)).prefix_key()
+    waits_before = obs.metrics.snapshot()["counters"].get(
+        "dedup.wait", 0
+    )
+    doomed = dedup_mod.registry().acquire(key, "pDOOMED")
+    assert doomed.role == "leader"
+    with PlanExecutor(max_concurrent=1) as ex:
+        h = ex.submit(_q(session))
+        # the clean plan must be parked behind the building entry
+        # before the leader dies (delta: the counter is cumulative
+        # across the process)
+        deadline = time.monotonic() + 30
+        while (
+            obs.metrics.snapshot()["counters"].get("dedup.wait", 0)
+            <= waits_before
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+        assert not h.done
+        doomed.settle()  # the leader's finally: abandon
+        r = h.result(timeout=300)
+    assert _sha(r.statistics) == _sha(solo)
+    stats = dedup_mod.stats()
+    assert stats["leads"] == 2  # the doomed claim + the promotion
+    assert stats["leader_failures"] == 1
+    assert r.builder.dedup_resolved["role"] == "leader"
+    assert r.builder.dedup_resolved.get("promoted_after_leader_failure")
+
+
+def test_leader_chaos_failure_never_corrupts_follower(session):
+    """The chaos flavor, end to end through the executor: a
+    faults=-killed leader plan (degrade=false, so the fused failure is
+    terminal) and a clean plan race for one prefix; whatever the
+    interleaving, the clean plan's statistics are byte-identical to
+    solo and nothing corrupt was shared (no publish from the failed
+    build)."""
+    solo = builder.PipelineBuilder(_q(session, "&dedup=false")).execute()
+    dedup_mod.reset()
+    with PlanExecutor(max_concurrent=2, max_attempts=1) as ex:
+        h_leader = ex.submit(
+            _q(session, "&faults=ingest.fused:every@1&degrade=false")
+        )
+        # the chaos plan claims first (else the clean plan could lead
+        # and the chaos plan FOLLOW — absorbing its own fault by never
+        # reaching the ingest it fires in)
+        deadline = time.monotonic() + 30
+        while (
+            dedup_mod.stats()["leads"] == 0
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+        h_follower = ex.submit(_q(session))
+        with pytest.raises(Exception):
+            h_leader.result(timeout=300)
+        r = h_follower.result(timeout=300)
+    assert _sha(r.statistics) == _sha(solo)
+    # the failed build never published: every lead was a fresh build
+    stats = dedup_mod.stats()
+    assert stats["hits"] == 0
+
+
+def test_seizure_prefix_dedup(tmp_path):
+    """The seizure workload's sliding+subband prefix dedups the same
+    way: two cost points over one session share one featurize pass."""
+    import os as _os
+
+    _os.makedirs(str(tmp_path / "seiz"))
+    info = _synthetic.write_seizure_session(str(tmp_path / "seiz"))
+    q = (
+        f"info_file={info}&task=seizure&fe=dwt-4:level=3:stats=energy"
+        "&window=512&stride=256&train_clf=logreg"
+        "&config_num_iterations=20&config_step_size=1.0"
+        "&config_mini_batch_fraction=1.0&cost_fp=1"
+    )
+    solo_a = builder.PipelineBuilder(
+        q + "&cost_fn=1&dedup=false"
+    ).execute()
+    solo_b = builder.PipelineBuilder(
+        q + "&cost_fn=8&dedup=false"
+    ).execute()
+    dedup_mod.reset()
+    with PlanExecutor(max_concurrent=2) as ex:
+        h_a = ex.submit(q + "&cost_fn=1")
+        h_b = ex.submit(q + "&cost_fn=8")
+        r_a = h_a.result(timeout=300)
+        r_b = h_b.result(timeout=300)
+    assert _sha(r_a.statistics) == _sha(solo_a)
+    assert _sha(r_b.statistics) == _sha(solo_b)
+    stats = dedup_mod.stats()
+    assert stats["leads"] == 1 and stats["hits"] == 1
+
+
+def test_dedup_sits_above_the_feature_cache(session, tmp_path,
+                                            monkeypatch):
+    """A follower never reaches the feature cache at all: with the
+    cache live, the pair keeps ONE store and the follower records
+    neither a cache hit nor a miss in its isolated scope."""
+    monkeypatch.delenv("EEG_TPU_NO_FEATURE_CACHE", raising=False)
+    monkeypatch.setenv(
+        "EEG_TPU_FEATURE_CACHE_DIR", str(tmp_path / "fc")
+    )
+    dedup_mod.reset()
+    before = obs.metrics.snapshot()["counters"]
+    with PlanExecutor(max_concurrent=2) as ex:
+        h1 = ex.submit(_q(session))
+        h2 = ex.submit(
+            _q(session, "&config_reg_param=0.1", clf="svm")
+        )
+        r1 = h1.result(timeout=300)
+        r2 = h2.result(timeout=300)
+    after = obs.metrics.snapshot()["counters"]
+    assert int(
+        after.get("feature_cache.store", 0)
+        - before.get("feature_cache.store", 0)
+    ) == 1
+    follower = next(
+        r for r in (r1, r2)
+        if r.builder.dedup_resolved["role"] == "follower"
+    )
+    counters = follower.builder.run_metrics.snapshot()["counters"]
+    assert counters.get("feature_cache.hit", 0) == 0
+    assert counters.get("feature_cache.miss", 0) == 0
+    assert counters.get("dedup.hit") == 1
+
+
+def test_obs_report_renders_and_diffs_dedup_blocks(tmp_path, capsys):
+    """tools/obs_report.py surfaces the new blocks: show prints the
+    leader/follower attribution and the gateway provenance; diff
+    flags a dedup-role difference between two reports."""
+    import importlib.util
+    import os as _os
+
+    spec = importlib.util.spec_from_file_location(
+        "obs_report_tool",
+        _os.path.join(
+            _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
+            "tools", "obs_report.py",
+        ),
+    )
+    obs_report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(obs_report)
+
+    base = {
+        "schema": "eeg-tpu-run-report/1", "plan_id": "p0001",
+        "query": "q",
+        "outcome": "ok", "stages": {}, "metrics": {},
+        "statistics_sha256": "s",
+    }
+    leader = dict(base, dedup={
+        "role": "leader", "prefix_key": "abc123", "rows": 60,
+        "build_seconds": 0.5,
+    }, gateway={"via": "http", "idempotency_key": "k1",
+                "client": "127.0.0.1"})
+    follower = dict(base, plan_id="p0002", dedup={
+        "role": "follower", "prefix_key": "abc123", "rows": 60,
+        "leader_plan": "p0001", "bytes_saved": 9000,
+        "seconds_saved": 0.5,
+    })
+    pa = tmp_path / "a.json"
+    pb = tmp_path / "b.json"
+    pa.write_text(json.dumps(leader))
+    pb.write_text(json.dumps(follower))
+
+    obs_report.show(str(pa))
+    out = capsys.readouterr().out
+    assert "role=leader" in out and "build_s=0.5" in out
+    assert "via=http" in out and "idempotency_key=k1" in out
+    obs_report.show(str(pb))
+    out = capsys.readouterr().out
+    assert "role=follower" in out and "leader=p0001" in out
+    assert "bytes_saved=9000" in out
+    obs_report.diff(str(pa), str(pb))
+    out = capsys.readouterr().out
+    assert "dedup" in out

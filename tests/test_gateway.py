@@ -1,0 +1,636 @@
+"""The networked plan service (gateway/, ISSUE 11).
+
+The acceptance pins:
+
+- **wire contract** — POST a query string, get a plan id; GET status
+  through the queued/running/terminal state machine with attempt
+  history; GET the finished statistics + run_report.json; DELETE
+  cancels-if-queued; shed-with-evidence is HTTP 429; percent-encoded
+  query values round-trip through the decode shim;
+- **idempotency** — a submission carrying ``X-Idempotency-Key`` is
+  retry-safe: a re-submit while the plan runs REJOINS it (same plan
+  id, nothing enqueued), a re-submit after it finished REPLAYS the
+  journaled outcome (completed plans exactly-once, failed plans
+  return the journaled failure), and a cancel releases the key;
+- **crash-only** — a REAL SIGKILL mid-plan: restart the gateway over
+  the same journal, recovery resumes the unfinished plan under its
+  original id, keyed re-submits return the original ids, the
+  completed plan's record is byte-untouched, and the resumed plan's
+  statistics are byte-identical to an uninterrupted twin;
+- **mixed journal states** — recover() over completed + failed +
+  unfinished records re-runs ONLY the unfinished one; keyed
+  re-submits of each class return the journaled outcome.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import _synthetic
+from eeg_dataanalysispackage_tpu.gateway import GatewayServer
+from eeg_dataanalysispackage_tpu.obs import chaos, domain as run_domain
+from eeg_dataanalysispackage_tpu.pipeline import builder
+from eeg_dataanalysispackage_tpu.scheduler import (
+    PlanCancelledError,
+    PlanExecutor,
+    dedup as dedup_mod,
+)
+from eeg_dataanalysispackage_tpu.scheduler import runtime as runtime_mod
+from eeg_dataanalysispackage_tpu.scheduler.journal import PlanJournal
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_ambient():
+    assert chaos.active_plan() is None
+    assert run_domain.current() is None
+    dedup_mod.reset()
+    yield
+    dedup_mod.reset()
+    chaos.uninstall()
+    assert run_domain.current() is None
+
+
+@pytest.fixture()
+def session(tmp_path):
+    return _synthetic.write_session(str(tmp_path), n_markers=60)
+
+
+def _q(info, extra="", clf="logreg", fe="dwt-8"):
+    return (
+        f"info_file={info}&fe={fe}&train_clf={clf}"
+        "&config_step_size=1.0&config_num_iterations=20"
+        "&config_mini_batch_fraction=1.0" + extra
+    )
+
+
+def _request(url, body=None, method="GET", headers=None, timeout=60):
+    req = urllib.request.Request(
+        url,
+        data=body.encode() if body is not None else None,
+        method=method, headers=headers or {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _await(base, plan_id, deadline_s=300):
+    start = time.monotonic()
+    while True:
+        _, status = _request(f"{base}/plans/{plan_id}")
+        if status.get("state") in ("completed", "failed", "cancelled"):
+            return status
+        assert time.monotonic() - start < deadline_s, status
+        time.sleep(0.05)
+
+
+def _sha(text):
+    import hashlib
+
+    return hashlib.sha256(str(text).encode()).hexdigest()
+
+
+# -- the wire contract -------------------------------------------------
+
+
+def test_http_lifecycle_end_to_end(session, tmp_path):
+    """POST -> status -> report over real loopback HTTP, statistics
+    byte-identical to the direct builder run; the operator surface
+    (list/stats/healthz) sees the plan."""
+    direct = builder.PipelineBuilder(_q(session)).execute()
+    with GatewayServer(
+        journal_dir=str(tmp_path / "journal"),
+        report_root=str(tmp_path / "reports"),
+    ) as gw:
+        code, health = _request(f"{gw.url}/healthz")
+        assert code == 200 and health["ok"] and health["journal"]
+
+        code, payload = _request(
+            f"{gw.url}/plans", body=_q(session), method="POST",
+        )
+        assert code == 201
+        plan_id = payload["plan_id"]
+        final = _await(gw.url, plan_id)
+        assert final["state"] == "completed"
+        assert final["attempts"] == 1
+        assert final["query"] == _q(session)
+
+        code, report = _request(f"{gw.url}/plans/{plan_id}/report")
+        assert code == 200
+        assert report["statistics"] == str(direct)
+        assert report["statistics_sha256"] == _sha(direct)
+        # the per-plan run_report.json rides the payload
+        assert report["run_report"]["plan_id"] == plan_id
+        assert report["run_report"]["gateway"]["via"] == "http"
+
+        code, listing = _request(f"{gw.url}/plans")
+        assert code == 200
+        assert [p["plan_id"] for p in listing["plans"]] == [plan_id]
+        code, stats = _request(f"{gw.url}/stats")
+        assert code == 200
+        assert "dedup" in stats and "scheduler" in stats
+
+        assert _request(f"{gw.url}/plans/nope")[0] == 404
+        assert _request(f"{gw.url}/nothing")[0] == 404
+
+
+def test_invalid_query_is_400_and_never_journaled(session, tmp_path):
+    with GatewayServer(journal_dir=str(tmp_path / "journal")) as gw:
+        code, payload = _request(
+            f"{gw.url}/plans",
+            body="fe=dwt-8&train_clf=logreg",  # no input files
+            method="POST",
+        )
+        assert code == 400
+        assert "error" in payload
+        assert _request(f"{gw.url}/plans")[1]["plans"] == []
+        assert _request(f"{gw.url}/plans", body="", method="POST")[0] \
+            == 400
+
+
+def test_percent_encoded_query_roundtrips_over_http(tmp_path):
+    """A network-submitted seizure query with %3A/%3D/%2C escapes in
+    its fe= value decodes at the front door and runs identically to
+    the decoded query submitted in-process."""
+    os.makedirs(str(tmp_path / "seiz"))
+    info = _synthetic.write_seizure_session(str(tmp_path / "seiz"))
+    decoded_fe = "dwt-4:level=3:stats=energy,std"
+    encoded_fe = "dwt-4%3Alevel%3D3%3Astats%3Denergy%2Cstd"
+    suffix = (
+        "&window=512&stride=256&train_clf=logreg"
+        "&config_num_iterations=20&config_step_size=1.0"
+        "&config_mini_batch_fraction=1.0"
+    )
+    direct = builder.PipelineBuilder(
+        f"info_file={info}&task=seizure&fe={decoded_fe}" + suffix
+    ).execute()
+    with GatewayServer(journal_dir=str(tmp_path / "journal")) as gw:
+        code, payload = _request(
+            f"{gw.url}/plans",
+            body=f"info_file={info}&task=seizure&fe={encoded_fe}"
+            + suffix,
+            method="POST",
+        )
+        assert code == 201
+        final = _await(gw.url, payload["plan_id"])
+        assert final["state"] == "completed"
+        # the journal/IR currency is the DECODED string
+        assert f"fe={decoded_fe}" in final["query"]
+        _, report = _request(
+            f"{gw.url}/plans/{payload['plan_id']}/report"
+        )
+        assert report["statistics"] == str(direct)
+
+
+# -- admission, cancel, idempotency (deterministic worker stubs) -------
+
+
+@pytest.fixture()
+def blocked_runtime(monkeypatch):
+    """Replace plan execution with an event-gated stub so queue/state
+    interleavings are deterministic."""
+    release = threading.Event()
+    started = threading.Event()
+
+    def blocked_execute(plan, builder_, plan_id=None, fault_plan=None,
+                        default_report_dir=None, gateway=None):
+        started.set()
+        assert release.wait(60), "test never released the worker"
+        return f"done-{plan_id}"
+
+    monkeypatch.setattr(runtime_mod, "execute_plan", blocked_execute)
+    yield started, release
+    release.set()
+
+
+def test_shed_is_429_with_evidence(session, tmp_path, blocked_runtime):
+    started, release = blocked_runtime
+    with GatewayServer(
+        journal_dir=str(tmp_path / "journal"),
+        max_concurrent=1, queue_depth=1,
+    ) as gw:
+        _request(f"{gw.url}/plans", body=_q(session), method="POST")
+        assert started.wait(30)
+        _, queued = _request(
+            f"{gw.url}/plans", body=_q(session), method="POST"
+        )
+        code, payload = _request(
+            f"{gw.url}/plans", body=_q(session), method="POST",
+            headers={"X-Idempotency-Key": "shed-key"},
+        )
+        assert code == 429
+        assert payload["shed"] and "depth" in payload["error"]
+        shed_id = payload["plan_id"]
+        # the shed is journaled as terminal failure, with evidence
+        _, status = _request(f"{gw.url}/plans/{shed_id}")
+        assert status["state"] == "failed"
+        release.set()
+        # drain the queued plan so the retry below races nothing —
+        # its terminal state means the worker popped it and the
+        # queue has room again
+        _await(gw.url, queued["plan_id"])
+        # the key was NOT burned by the shed: a retry runs fresh
+        code, retry = _request(
+            f"{gw.url}/plans", body=_q(session), method="POST",
+            headers={"X-Idempotency-Key": "shed-key"},
+        )
+        assert code == 201
+        assert retry["plan_id"] != shed_id
+        _await(gw.url, retry["plan_id"])
+
+
+def test_idempotent_rejoin_while_running(session, tmp_path,
+                                         blocked_runtime):
+    started, release = blocked_runtime
+    with GatewayServer(
+        journal_dir=str(tmp_path / "journal"), max_concurrent=1,
+    ) as gw:
+        code1, p1 = _request(
+            f"{gw.url}/plans", body=_q(session), method="POST",
+            headers={"X-Idempotency-Key": "k-live"},
+        )
+        assert code1 == 201
+        assert started.wait(30)
+        # same key while running: REJOIN — 200, original id, nothing
+        # enqueued
+        code2, p2 = _request(
+            f"{gw.url}/plans", body=_q(session), method="POST",
+            headers={"X-Idempotency-Key": "k-live"},
+        )
+        assert code2 == 200
+        assert p2["plan_id"] == p1["plan_id"]
+        assert p2["idempotent_replay"]
+        release.set()
+        final = _await(gw.url, p1["plan_id"])
+        assert final["state"] == "completed"
+        # after completion: REPLAY from the journal, still the
+        # original id, attempts untouched (nothing re-ran)
+        code3, p3 = _request(
+            f"{gw.url}/plans", body=_q(session), method="POST",
+            headers={"X-Idempotency-Key": "k-live"},
+        )
+        assert code3 == 200
+        assert p3["plan_id"] == p1["plan_id"]
+        journal = PlanJournal(str(tmp_path / "journal"))
+        assert journal.entry(p1["plan_id"])["state"] == "completed"
+        assert len(journal.entries()) == 1
+
+
+def test_cancel_if_queued(session, tmp_path, blocked_runtime):
+    started, release = blocked_runtime
+    with GatewayServer(
+        journal_dir=str(tmp_path / "journal"),
+        max_concurrent=1, queue_depth=4,
+    ) as gw:
+        _, running = _request(
+            f"{gw.url}/plans", body=_q(session), method="POST",
+        )
+        assert started.wait(30)
+        _, queued = _request(
+            f"{gw.url}/plans", body=_q(session), method="POST",
+            headers={"X-Idempotency-Key": "k-cancel"},
+        )
+        # held from admission, like a real submitter's handle (the
+        # cancelled ticket itself is evicted once journaled)
+        handle = gw.executor.handle(queued["plan_id"])
+        code, payload = _request(
+            f"{gw.url}/plans/{queued['plan_id']}", method="DELETE",
+        )
+        assert code == 200 and payload["cancelled"]
+        _, status = _request(f"{gw.url}/plans/{queued['plan_id']}")
+        assert status["state"] == "cancelled"
+        # a running plan is NOT torn down
+        code, payload = _request(
+            f"{gw.url}/plans/{running['plan_id']}", method="DELETE",
+        )
+        assert code == 409 and not payload["cancelled"]
+        # the cancel released the key: a re-submit runs FRESH
+        code, fresh = _request(
+            f"{gw.url}/plans", body=_q(session), method="POST",
+            headers={"X-Idempotency-Key": "k-cancel"},
+        )
+        assert code == 201
+        assert fresh["plan_id"] != queued["plan_id"]
+        release.set()
+        assert _await(gw.url, running["plan_id"])["state"] == "completed"
+        assert _await(gw.url, fresh["plan_id"])["state"] == "completed"
+        # the handle held from admission carries the typed error
+        with pytest.raises(PlanCancelledError):
+            handle.result(timeout=1)
+
+
+# -- recovery ----------------------------------------------------------
+
+
+def test_restart_with_mixed_journal_states(session, tmp_path):
+    """recover() at startup over completed + failed + unfinished
+    records: only the unfinished plan re-runs; an idempotency-keyed
+    re-submit of each class returns the journaled outcome."""
+    journal_dir = str(tmp_path / "journal")
+    q_ok = _q(session)
+    q_fail = _q(session, "&faults=scheduler.plan:every@1")
+    q_unfinished = _q(session, clf="svm")
+
+    with GatewayServer(
+        journal_dir=journal_dir, max_concurrent=1, max_attempts=1,
+    ) as gw:
+        _, ok = _request(
+            f"{gw.url}/plans", body=q_ok, method="POST",
+            headers={"X-Idempotency-Key": "k-ok"},
+        )
+        assert _await(gw.url, ok["plan_id"])["state"] == "completed"
+        _, failed = _request(
+            f"{gw.url}/plans", body=q_fail, method="POST",
+            headers={"X-Idempotency-Key": "k-fail"},
+        )
+        assert _await(gw.url, failed["plan_id"])["state"] == "failed"
+    # a dead process's write-ahead record: submitted, never finished
+    PlanJournal(journal_dir).record_submitted(
+        "p0099", q_unfinished,
+        meta={"idempotency_key": "k-unfinished"},
+    )
+    ok_record = open(
+        os.path.join(journal_dir, f"plan-{ok['plan_id']}.json")
+    ).read()
+    failed_record = open(
+        os.path.join(journal_dir, f"plan-{failed['plan_id']}.json")
+    ).read()
+    twin = builder.PipelineBuilder(q_unfinished).execute()
+
+    with GatewayServer(journal_dir=journal_dir, max_concurrent=1) as gw:
+        # recovery resumed ONLY the unfinished record, original id
+        assert [
+            h.plan_id for h in gw.recovery["resumed"]
+        ] == ["p0099"]
+        assert [
+            e["plan_id"] for e in gw.recovery["completed"]
+        ] == [ok["plan_id"]]
+        # keyed re-submit of each class
+        code, r_ok = _request(
+            f"{gw.url}/plans", body=q_ok, method="POST",
+            headers={"X-Idempotency-Key": "k-ok"},
+        )
+        assert (code, r_ok["plan_id"]) == (200, ok["plan_id"])
+        assert r_ok["state"] == "completed"
+        code, r_fail = _request(
+            f"{gw.url}/plans", body=q_fail, method="POST",
+            headers={"X-Idempotency-Key": "k-fail"},
+        )
+        assert (code, r_fail["plan_id"]) == (200, failed["plan_id"])
+        assert r_fail["state"] == "failed"
+        _, fail_report = _request(
+            f"{gw.url}/plans/{failed['plan_id']}/report"
+        )
+        assert "chaos" in (fail_report["error"] or "")
+        code, r_unf = _request(
+            f"{gw.url}/plans", body=q_unfinished, method="POST",
+            headers={"X-Idempotency-Key": "k-unfinished"},
+        )
+        assert (code, r_unf["plan_id"]) == (200, "p0099")
+        final = _await(gw.url, "p0099")
+        assert final["state"] == "completed"
+        _, report = _request(f"{gw.url}/plans/p0099/report")
+        assert report["statistics"] == str(twin)
+
+    # terminal records byte-untouched: completed exactly-once, failed
+    # never re-run
+    assert open(
+        os.path.join(journal_dir, f"plan-{ok['plan_id']}.json")
+    ).read() == ok_record
+    assert open(
+        os.path.join(journal_dir, f"plan-{failed['plan_id']}.json")
+    ).read() == failed_record
+
+
+def test_plan_admin_cli_audits_journal_and_gateway(session, tmp_path):
+    """tools/plan_admin.py: list renders the journal table (offline
+    and against a live gateway), show prints one plan's journaled
+    statistics, tail exits after the requested record count."""
+    journal_dir = str(tmp_path / "journal")
+    admin = os.path.join(_REPO, "tools", "plan_admin.py")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    def run_admin(*args):
+        proc = subprocess.run(
+            [sys.executable, admin, *args],
+            env=env, capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        return proc.stdout
+
+    with GatewayServer(journal_dir=journal_dir) as gw:
+        _, payload = _request(
+            f"{gw.url}/plans", body=_q(session), method="POST",
+            headers={"X-Idempotency-Key": "k-admin"},
+        )
+        plan_id = payload["plan_id"]
+        _await(gw.url, plan_id)
+        live = run_admin("list", "--gateway", gw.url)
+        assert plan_id in live and "completed" in live
+    out = run_admin("list", "--journal", journal_dir)
+    assert plan_id in out and "completed" in out and "k-admin" in out
+    out = run_admin("show", plan_id, "--journal", journal_dir)
+    assert "state    completed" in out
+    assert "idempotency_key k-admin" in out
+    assert "statistics" in out
+    out = run_admin(
+        "tail", "--journal", journal_dir, "--count", "1",
+        "--interval", "0.1",
+    )
+    assert plan_id in out
+
+
+_KILL_CHILD = """
+import json, os, signal, sys, time, urllib.request
+
+sys.path.insert(0, {repo!r})
+from eeg_dataanalysispackage_tpu.gateway import GatewayServer
+
+journal_dir, qa, qb = sys.argv[1:4]
+gw = GatewayServer(journal_dir=journal_dir, max_concurrent=1)
+gw.start()
+
+
+def post(body, key):
+    req = urllib.request.Request(
+        gw.url + "/plans", data=body.encode(), method="POST",
+        headers={{"X-Idempotency-Key": key}},
+    )
+    return json.loads(urllib.request.urlopen(req).read())
+
+
+pa = post(qa, "key-a")["plan_id"]
+while True:
+    with urllib.request.urlopen(gw.url + "/plans/" + pa) as r:
+        if json.loads(r.read())["state"] == "completed":
+            break
+    time.sleep(0.05)
+post(qb, "key-b")
+os.kill(os.getpid(), signal.SIGKILL)
+"""
+
+
+@pytest.mark.chaos
+def test_sigkilled_gateway_honors_idempotency_keys(session, tmp_path):
+    """The acceptance pin: SIGKILL the gateway mid-plan, restart it
+    over the same journal — keyed re-submits return the ORIGINAL plan
+    ids, the completed plan is exactly-once (record byte-untouched,
+    nothing re-run), and the resumed plan's statistics are
+    byte-identical to an uninterrupted twin."""
+    journal_dir = str(tmp_path / "journal")
+    qa = _q(session)
+    # fresh compile at a big static iteration count: the kill lands
+    # provably mid-plan (same sizing as the executor's SIGKILL pin)
+    qb = (
+        f"info_file={session}&fe=dwt-8&train_clf=logreg"
+        "&config_step_size=0.5&config_num_iterations=150000"
+        "&config_mini_batch_fraction=1.0"
+    )
+    child = tmp_path / "kill_child.py"
+    child.write_text(_KILL_CHILD.format(repo=_REPO))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, str(child), journal_dir, qa, qb],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == -signal.SIGKILL, proc.stderr[-2000:]
+
+    journal = PlanJournal(journal_dir)
+    states = {e["plan_id"]: e["state"] for e in journal.entries()}
+    assert states == {"p0001": "completed", "p0002": "submitted"}
+    completed_before = open(
+        os.path.join(journal_dir, "plan-p0001.json")
+    ).read()
+    twins = {
+        q: str(builder.PipelineBuilder(q).execute()) for q in (qa, qb)
+    }
+
+    with GatewayServer(journal_dir=journal_dir, max_concurrent=1) as gw:
+        assert [h.plan_id for h in gw.recovery["resumed"]] == ["p0002"]
+        # retried submits with the clients' keys: original ids back
+        code, ra = _request(
+            f"{gw.url}/plans", body=qa, method="POST",
+            headers={"X-Idempotency-Key": "key-a"},
+        )
+        assert (code, ra["plan_id"]) == (200, "p0001")
+        assert ra["idempotent_replay"]
+        code, rb = _request(
+            f"{gw.url}/plans", body=qb, method="POST",
+            headers={"X-Idempotency-Key": "key-b"},
+        )
+        assert (code, rb["plan_id"]) == (200, "p0002")
+        assert _await(gw.url, "p0002", deadline_s=600)["state"] \
+            == "completed"
+        _, report_a = _request(f"{gw.url}/plans/p0001/report")
+        _, report_b = _request(f"{gw.url}/plans/p0002/report")
+    assert report_a["statistics"] == twins[qa]
+    assert report_b["statistics"] == twins[qb]
+    # exactly-once: the dead gateway's completed record is
+    # byte-untouched
+    assert open(
+        os.path.join(journal_dir, "plan-p0001.json")
+    ).read() == completed_before
+
+
+def test_idempotency_key_reuse_with_different_query_is_409(
+        session, tmp_path, blocked_runtime):
+    started, release = blocked_runtime
+    with GatewayServer(
+        journal_dir=str(tmp_path / "journal"), max_concurrent=1,
+    ) as gw:
+        code, p1 = _request(
+            f"{gw.url}/plans", body=_q(session), method="POST",
+            headers={"X-Idempotency-Key": "k-conflict"},
+        )
+        assert code == 201
+        assert started.wait(30)
+        # live ticket, DIFFERENT body under the same key: conflict,
+        # not a silent rejoin to a plan the client did not send
+        code, err = _request(
+            f"{gw.url}/plans", body=_q(session, clf="svm"),
+            method="POST", headers={"X-Idempotency-Key": "k-conflict"},
+        )
+        assert code == 409
+        assert err["idempotency_conflict"]
+        release.set()
+        _await(gw.url, p1["plan_id"])
+        # journaled terminal record, different body: still 409
+        code, err = _request(
+            f"{gw.url}/plans", body=_q(session, clf="svm"),
+            method="POST", headers={"X-Idempotency-Key": "k-conflict"},
+        )
+        assert code == 409
+        assert err["idempotency_conflict"]
+        # the ORIGINAL body replays the journaled outcome
+        code, p2 = _request(
+            f"{gw.url}/plans", body=_q(session), method="POST",
+            headers={"X-Idempotency-Key": "k-conflict"},
+        )
+        assert (code, p2["plan_id"]) == (200, p1["plan_id"])
+
+
+def test_keyed_resubmit_racing_recover_runs_once(
+        session, tmp_path, monkeypatch):
+    # a dead process's write-ahead record, key journaled with it
+    jdir = str(tmp_path / "journal")
+    PlanJournal(jdir).record_submitted(
+        "p0001", _q(session), meta={"idempotency_key": "k-race"},
+    )
+    runs = []
+    release = threading.Event()
+
+    def counting_execute(plan, builder_, plan_id=None, fault_plan=None,
+                         default_report_dir=None, gateway=None):
+        runs.append(plan_id)
+        assert release.wait(60)
+        return f"done-{plan_id}"
+
+    monkeypatch.setattr(runtime_mod, "execute_plan", counting_execute)
+    with PlanExecutor(max_concurrent=1, journal_dir=jdir) as ex:
+        # the client's retry lands BEFORE the operator's recover():
+        # re-admitted under the ORIGINAL id
+        h1 = ex.submit(_q(session), idempotency_key="k-race")
+        assert h1.plan_id == "p0001"
+        # recover() must NOT re-admit the same record a second time
+        recovery = ex.recover()
+        assert [h.plan_id for h in recovery["resumed"]] == ["p0001"]
+        assert recovery["resumed"][0].replayed
+        release.set()
+        assert h1.result(60).plan_id == "p0001"
+        recovery["resumed"][0].result(60)
+    assert runs == ["p0001"]  # one ticket, one execution
+
+
+def test_completed_tickets_evicted_once_journaled(session, tmp_path):
+    with PlanExecutor(
+        max_concurrent=1, journal_dir=str(tmp_path / "journal"),
+    ) as ex:
+        h = ex.submit(_q(session), idempotency_key="k-evict")
+        stats = str(h.result(300).statistics)
+        # eviction happens just after the future resolves
+        deadline = time.monotonic() + 10
+        while h.plan_id in ex.live_ids():
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        # the journal still serves status and the keyed replay —
+        # nothing re-executes, the outcome is byte-identical
+        assert ex.status(h.plan_id)["state"] == "completed"
+        h2 = ex.submit(_q(session), idempotency_key="k-evict")
+        assert h2.replayed and h2.plan_id == h.plan_id
+        assert str(h2.result(10).statistics) == stats

@@ -41,6 +41,47 @@ def test_query_map_embedded_equals_round_trips():
         assert builder.get_raw_param(q, key) == want
 
 
+def test_percent_decode_roundtrips_escaped_option_values():
+    """Network-submitted query strings arrive URL-encoded (gateway/):
+    the decode shim must round-trip the '='/':'/','-bearing option
+    grammars through %3A/%3D/%2C escapes into exactly the string
+    get_query_map already parses."""
+    from urllib.parse import quote
+
+    decoded = (
+        "fe=dwt-8:level=5:stats=energy,mean"
+        "&sweep=lr:1.0,0.5;reg:0.0,0.01"
+        "&faults=remote.request:p=0.2;seed=3"
+    )
+    encoded = "&".join(
+        f"{name}={quote(value, safe='')}"
+        for name, value in (
+            param.split("=", 1) for param in decoded.split("&")
+        )
+    )
+    assert "%3A" in encoded and "%3D" in encoded and "%2C" in encoded
+    assert builder.decode_percent_query(encoded) == decoded
+    m = builder.get_query_map(builder.decode_percent_query(encoded))
+    assert m["fe"] == "dwt-8:level=5:stats=energy,mean"
+    assert m["sweep"] == "lr:1.0,0.5;reg:0.0,0.01"
+    assert m["faults"] == "remote.request:p=0.2;seed=3"
+
+
+def test_percent_decode_passthrough_and_rejection():
+    # no '%': byte-identical passthrough — every query ever written
+    # is unchanged
+    q = "info_file=/a/b.txt&fe=dwt-8&train_clf=logreg"
+    assert builder.decode_percent_query(q) is q
+    # literal '%' that is not an escape survives unquote unchanged
+    assert builder.decode_percent_query("a=50%25") == "a=50%"
+    # a decoded '&' (or '=' in a name) cannot be represented in the
+    # k=v&k=v surface: loud error, never a silent re-split
+    with pytest.raises(ValueError):
+        builder.decode_percent_query("a=x%26y=1")
+    with pytest.raises(ValueError):
+        builder.decode_percent_query("a%3Db=1")
+
+
 def test_logreg_train_pipeline(fixture_dir, tmp_path):
     result = str(tmp_path / "result.txt")
     stats = builder.PipelineBuilder(

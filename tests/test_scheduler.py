@@ -117,7 +117,7 @@ def test_shed_with_evidence(monkeypatch, session, tmp_path):
     release = threading.Event()
 
     def blocked_execute(plan, builder_, plan_id=None, fault_plan=None,
-                        default_report_dir=None):
+                        default_report_dir=None, gateway=None):
         assert release.wait(30), "test never released the worker"
         return f"done-{plan_id}"
 
@@ -154,7 +154,7 @@ def test_queued_deadline_fails_fast(monkeypatch, session):
     release = threading.Event()
 
     def blocked_execute(plan, builder_, plan_id=None, fault_plan=None,
-                        default_report_dir=None):
+                        default_report_dir=None, gateway=None):
         assert release.wait(30)
         return f"done-{plan_id}"
 
@@ -238,7 +238,11 @@ def test_concurrent_fault_domains_are_isolated(session, tmp_path):
     per-plan metrics scope, degradation history, and run report are
     identical to its solo run — fault domains don't leak."""
     clean_q = _q(session).replace("fe=dwt-8", "fe=dwt-8-fused-block")
-    faulted_q = clean_q + "&faults=ingest.fused:once@1"
+    # dedup=false: this pin exercises the chaos firing INSIDE the
+    # faulted plan's own ingest — prefix dedup would (correctly) let
+    # it follow the clean plan's build and absorb the fault by never
+    # reaching it (that interplay is pinned in tests/test_dedup.py)
+    faulted_q = clean_q + "&faults=ingest.fused:once@1&dedup=false"
     # more devices than any host here has: mesh-unavailable -> the
     # ladder's top rung degrades to single-device, recorded
     mesh_q = _q(session, "&devices=64")
@@ -449,7 +453,11 @@ def test_concurrent_plans_single_flight_feature_cache(
     monkeypatch.setenv(
         "EEG_TPU_FEATURE_CACHE_DIR", str(tmp_path / "fc")
     )
-    q = _q(session).replace("fe=dwt-8", "fe=dwt-8-fused")
+    # dedup=false: prefix dedup sits ABOVE the feature cache and
+    # would satisfy the second plan before it ever looks the entry up
+    # (pinned in tests/test_dedup.py); this pin is about the cache's
+    # own single-flight seam
+    q = _q(session).replace("fe=dwt-8", "fe=dwt-8-fused") + "&dedup=false"
     before = obs.metrics.snapshot()["counters"]
     with PlanExecutor(max_concurrent=2) as ex:
         h1 = ex.submit(q)
@@ -601,7 +609,7 @@ def test_close_fails_abandoned_queued_handles(monkeypatch, session):
     release = threading.Event()
 
     def blocked_execute(plan, builder_, plan_id=None, fault_plan=None,
-                        default_report_dir=None):
+                        default_report_dir=None, gateway=None):
         assert release.wait(30)
         return f"done-{plan_id}"
 

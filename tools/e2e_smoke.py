@@ -73,6 +73,18 @@ performance contract holds:
   statistics identical to uninterrupted twins without re-running the
   completed one;
 
+- the networked plan service (plan_service,
+  tools/pipeline_bench.py — ISSUE 11): a shared-prefix pair of tenant
+  plans submitted over loopback HTTP computes the ingest+featurize
+  prefix exactly once (one feature-cache store; the follower a dedup
+  hit with leader/bytes-saved attribution in its own run report) with
+  BOTH plans' statistics byte-identical to their solo dedup=false
+  runs; an idempotency-keyed re-submit of the completed leader
+  replays the ORIGINAL plan id over HTTP 200 without re-executing;
+  and a many-client chaos soak (clean + faults=scheduler.plan
+  clients interleaved) resolves every plan with clean-twin
+  statistics and a recorded submits/sec;
+
 - the PR 8 ingest gates: the overlap=true cold twin produces
   byte-identical statistics to the serial cold run (double-buffered
   ingest reschedules work, never changes it); the precision=bf16 twin
@@ -459,6 +471,70 @@ def _check_scheduler(line: dict, failures: list) -> None:
         )
 
 
+def _check_plan_service(line: dict, failures: list) -> None:
+    """The networked plan service gate (ISSUE 11): the shared-prefix
+    tenant pair over loopback HTTP computed its ingest+featurize
+    prefix exactly once (one feature-cache store, the follower a
+    dedup hit with leader attribution), BOTH deduped statistics are
+    byte-identical to the solo dedup=false twins, an idempotency-keyed
+    re-submit of the completed leader replayed the ORIGINAL plan id
+    without re-executing, and the many-client chaos soak resolved
+    every plan with clean-twin statistics while recording a nonzero
+    submits/sec at the front door."""
+    ps = line.get("plan_service") or {}
+    if not ps:
+        failures.append("plan_service: no plan_service block on the line")
+        return
+    pair = ps.get("pair") or {}
+    dedup = pair.get("dedup") or {}
+    if not dedup.get("hit_ratio", 0) > 0 or dedup.get("hits", 0) < 1:
+        failures.append(
+            f"plan_service: shared-prefix pair recorded no dedup hit: "
+            f"{dedup}"
+        )
+    if pair.get("stores") != 1:
+        failures.append(
+            f"plan_service: pair kept {pair.get('stores')} prefix "
+            f"builds, not exactly 1"
+        )
+    if not pair.get("statistics_identical_to_solo"):
+        failures.append(
+            "plan_service: deduped statistics drifted from the solo "
+            "unshared runs"
+        )
+    attribution = pair.get("follower_attribution") or {}
+    if not (
+        attribution.get("role") == "follower"
+        and attribution.get("leader_plan")
+        and attribution.get("bytes_saved", 0) > 0
+    ):
+        failures.append(
+            f"plan_service: follower attribution missing from the "
+            f"follower's run report: {attribution}"
+        )
+    resubmit = pair.get("idempotent_resubmit") or {}
+    if not (
+        resubmit.get("http") == 200
+        and resubmit.get("same_plan_id")
+        and resubmit.get("replayed")
+    ):
+        failures.append(
+            f"plan_service: idempotent re-submit did not replay the "
+            f"original plan id: {resubmit}"
+        )
+    soak = ps.get("soak") or {}
+    if not (soak.get("all_resolved") and soak.get("statistics_identical")):
+        failures.append(
+            f"plan_service: chaos soak not clean: resolved="
+            f"{soak.get('all_resolved')} identical="
+            f"{soak.get('statistics_identical')}"
+        )
+    if not soak.get("submits_per_s", 0) > 0:
+        failures.append(
+            f"plan_service: no submits/sec recorded: {soak}"
+        )
+
+
 def _check_report(tag: str, bench_line: dict, report_dir: str,
                   failures: list, checked: list) -> dict:
     """The run-report half of the gate: the artifact exists, parses,
@@ -635,6 +711,16 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
             data_dir, os.path.join(tmp, "cache_scheduler"), None,
         )
         _check_scheduler(scheduler_line, failures)
+        # the networked plan service (ISSUE 11): the HTTP dedup pair,
+        # the idempotent-resubmit replay, and the many-client chaos
+        # soak — all measured inside the plan_service child over its
+        # own per-phase caches (report_dir=None: the child's gateway
+        # owns a per-plan report tree)
+        plan_service_line = _run_variant(
+            "plan_service", n_markers, n_files,
+            data_dir, os.path.join(tmp, "cache_plan_service"), None,
+        )
+        _check_plan_service(plan_service_line, failures)
         cold_report = _check_report(
             "cold", cold, report_dirs["cold"], failures, reports_checked
         )
@@ -875,6 +961,20 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
         "scheduler_crash_recovery": (
             scheduler_line.get("scheduler") or {}
         ).get("crash_recovery"),
+        "plan_service_dedup_hit_ratio": (
+            ((plan_service_line.get("plan_service") or {}).get("pair")
+             or {}).get("dedup") or {}
+        ).get("hit_ratio"),
+        "plan_service_submits_per_s": (
+            (plan_service_line.get("plan_service") or {}).get("soak")
+            or {}
+        ).get("submits_per_s"),
+        "plan_service_soak_clean": bool(
+            ((plan_service_line.get("plan_service") or {}).get("soak")
+             or {}).get("all_resolved")
+            and ((plan_service_line.get("plan_service") or {}).get(
+                "soak") or {}).get("statistics_identical")
+        ),
         "reports_checked": len(reports_checked),
         "cold_stages": {
             k: v["seconds"] for k, v in cold.get("stages", {}).items()

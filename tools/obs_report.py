@@ -135,6 +135,31 @@ def show(path: str) -> None:
         )
     if data.get("overlap") is not None:
         print(f"  overlap  {data.get('overlap')}")
+    dedup = data.get("dedup")
+    if dedup:
+        line = (
+            f"  dedup    role={dedup.get('role')} "
+            f"prefix={str(dedup.get('prefix_key'))[:16]}… "
+            f"rows={dedup.get('rows')}"
+        )
+        if dedup.get("role") == "leader":
+            line += f" build_s={dedup.get('build_seconds')}"
+            if dedup.get("promoted_after_leader_failure"):
+                line += " (promoted after leader failure)"
+        else:
+            line += (
+                f" leader={dedup.get('leader_plan')} "
+                f"bytes_saved={dedup.get('bytes_saved')} "
+                f"seconds_saved={dedup.get('seconds_saved')}"
+            )
+        print(line)
+    gateway = data.get("gateway")
+    if gateway:
+        print(
+            f"  gateway  via={gateway.get('via')} "
+            f"idempotency_key={gateway.get('idempotency_key')} "
+            f"client={gateway.get('client')}"
+        )
     mesh = data.get("mesh")
     if mesh:
         req = mesh.get("requested") or {}
@@ -305,6 +330,27 @@ def diff(path_a: str, path_b: str) -> None:
     ma, mb = _mesh_digest(a), _mesh_digest(b)
     if (ma or mb) and ma != mb:
         print(f"mesh (rung, shape, members/device): A {ma}  B {mb}")
+
+    def _dedup_digest(report):
+        dedup = report.get("dedup")
+        if not dedup:
+            return None
+        return {
+            "role": dedup.get("role"),
+            "prefix": str(dedup.get("prefix_key"))[:16],
+            "leader": dedup.get("leader_plan"),
+            "bytes_saved": dedup.get("bytes_saved"),
+            "seconds_saved": dedup.get(
+                "seconds_saved", dedup.get("build_seconds")
+            ),
+        }
+
+    dda, ddb = _dedup_digest(a), _dedup_digest(b)
+    if (dda or ddb) and dda != ddb:
+        print(f"dedup (role, prefix, leader, saved): A {dda}  B {ddb}")
+    ga, gb = a.get("gateway") or {}, b.get("gateway") or {}
+    if (ga or gb) and ga != gb:
+        print(f"gateway: A {ga}  B {gb}")
 
     def _pop_digest(report):
         pop = report.get("population")

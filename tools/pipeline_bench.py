@@ -81,6 +81,22 @@ Variants:
   scheduler_suicide     internal: the kill-and-resume child — submits
                         1 fast + 2 slow plans against --journal-dir,
                         lets the first complete, SIGKILLs itself
+  plan_service          the networked plan service (gateway/ over
+                        scheduler/executor.py): a shared-prefix tenant
+                        pair submitted over loopback HTTP computes its
+                        ingest+featurize prefix exactly once (one
+                        feature-cache store, the follower a dedup hit)
+                        with BOTH plans' statistics byte-identical to
+                        their solo dedup=false twins; an idempotency-
+                        keyed re-submit of the completed leader
+                        replays the original plan id (HTTP 200, no
+                        re-execution); and a many-client soak — N
+                        client threads POSTing clean and chaos-bearing
+                        (faults=scheduler.plan) plans concurrently —
+                        records submits/sec at the front door, the
+                        dedup hit ratio, and the isolation verdict
+                        (every plan resolves; every clean statistics
+                        byte-equal to solo)
   populate              internal: run the cold query to fill
                         --cache-dir, print nothing (the warm variant's
                         helper child)
@@ -335,10 +351,15 @@ _SCHEDULER_PLANS = (
 
 
 def scheduler_queries(info: str):
+    # dedup=false: this variant measures the feature cache's
+    # single-flight seam and the executor's train-stage concurrency —
+    # prefix dedup (the plan_service variant's subject) sits above
+    # both and would (correctly) let every plan skip them
     return [
         build_query(
             info, fanout=False, train_clf=clf,
-            extra=extra + f"&config_num_iterations={_SCHEDULER_ITERS}",
+            extra=extra + f"&config_num_iterations={_SCHEDULER_ITERS}"
+            "&dedup=false",
         )
         for clf, extra in _SCHEDULER_PLANS
     ]
@@ -528,6 +549,266 @@ def run_scheduler_multi(info: str, scratch: str) -> dict:
     }
 
 
+#: the plan_service tenant pair: identical ingest+featurize prefix
+#: (same session, same fused fe=), distinct classifier suffixes — the
+#: common-subplan case the dedup registry exists for
+_PLAN_SERVICE_TENANTS = (
+    ("logreg", ""),
+    ("svm", "&config_reg_param=0.1"),
+)
+#: soak shape: clients x plans-per-client, every other client
+#: chaos-bearing (faults=scheduler.plan:p=0.3 — absorbed by executor
+#: retries inside that plan's own fault domain)
+_PLAN_SERVICE_CLIENTS = 6
+_PLAN_SERVICE_PLANS_PER_CLIENT = 3
+_PLAN_SERVICE_SOAK_ATTEMPTS = 8
+
+
+def _http_json(url: str, body: str = None, method: str = "GET",
+               headers: dict = None, timeout: float = 60.0):
+    """(status, payload) for one JSON request against the gateway."""
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        url,
+        data=body.encode() if body is not None else None,
+        method=method, headers=headers or {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _await_plan(base: str, plan_id: str, deadline_s: float = 600.0):
+    """Poll GET /plans/<id> until terminal; returns the final state."""
+    start = time.monotonic()
+    while True:
+        _, status = _http_json(f"{base}/plans/{plan_id}")
+        if status.get("state") in ("completed", "failed", "cancelled"):
+            return status["state"]
+        if time.monotonic() - start > deadline_s:
+            return f"timeout in state {status.get('state')}"
+        time.sleep(0.05)
+
+
+def run_plan_service(info: str, scratch: str) -> dict:
+    """The plan_service measurement: the shared-prefix dedup pair over
+    HTTP (exactly one prefix build, both statistics byte-identical to
+    solo), the idempotent re-submit replay, and the many-client chaos
+    soak with submits/sec at the loopback front door."""
+    import hashlib as _hashlib
+    import threading as _threading
+
+    from eeg_dataanalysispackage_tpu import obs
+    from eeg_dataanalysispackage_tpu.gateway import GatewayServer
+    from eeg_dataanalysispackage_tpu.scheduler import dedup as dedup_mod
+
+    def tenant_query(clf, extra):
+        return build_query(info, fanout=False, train_clf=clf,
+                           extra=extra)
+
+    def sha(text):
+        return _hashlib.sha256(str(text).encode()).hexdigest()
+
+    # -- solo twins (dedup=false, in-process): the unshared baseline
+    # statistics AND the jit warmup, so the timed phases below measure
+    # the service, not XLA compiles
+    os.environ["EEG_TPU_FEATURE_CACHE_DIR"] = os.path.join(
+        scratch, "fc_solo"
+    )
+    solo_sha = {}
+    for clf, extra in _PLAN_SERVICE_TENANTS:
+        statistics, _, _, _, _ = run_query(
+            tenant_query(clf, extra) + "&dedup=false&cache=false"
+        )
+        solo_sha[clf] = sha(statistics)
+
+    # -- phase 1: the shared-prefix pair over HTTP ----------------------
+    os.environ["EEG_TPU_FEATURE_CACHE_DIR"] = os.path.join(
+        scratch, "fc_pair"
+    )
+    dedup_mod.reset()
+    before = obs.metrics.snapshot()["counters"]
+    pair_start = time.perf_counter()
+    with GatewayServer(
+        journal_dir=os.path.join(scratch, "journal_pair"),
+        report_root=os.path.join(scratch, "reports_pair"),
+        max_concurrent=2, queue_depth=8,
+    ) as gw:
+        base = gw.url
+        submitted = []
+        for clf, extra in _PLAN_SERVICE_TENANTS:
+            code, payload = _http_json(
+                f"{base}/plans", body=tenant_query(clf, extra),
+                method="POST",
+                headers={"X-Idempotency-Key": f"bench-{clf}"},
+            )
+            submitted.append((clf, code, payload))
+        states = {
+            payload["plan_id"]: _await_plan(base, payload["plan_id"])
+            for _, _, payload in submitted
+        }
+        _, dedup_stats = _http_json(f"{base}/stats")
+        pair_wall = time.perf_counter() - pair_start
+        reports = {}
+        for clf, _, payload in submitted:
+            _, rep = _http_json(
+                f"{base}/plans/{payload['plan_id']}/report"
+            )
+            reports[clf] = rep
+        # idempotent re-submit of the COMPLETED leader: same key, same
+        # body -> HTTP 200, the original plan id, nothing re-executed
+        leader_clf, _, leader_payload = submitted[0]
+        recode, repayload = _http_json(
+            f"{base}/plans",
+            body=tenant_query(*_PLAN_SERVICE_TENANTS[0]),
+            method="POST",
+            headers={"X-Idempotency-Key": f"bench-{leader_clf}"},
+        )
+    after = obs.metrics.snapshot()["counters"]
+    pair_epochs = int(
+        after.get("pipeline.epochs_loaded", 0.0)
+        - before.get("pipeline.epochs_loaded", 0.0)
+    )
+    # either tenant may have won the lead (two workers pop nearly
+    # simultaneously) — attribute from whichever report FOLLOWED
+    follower_report = next(
+        (
+            blk
+            for clf, _, _ in submitted
+            if (blk := (reports[clf].get("run_report") or {})
+                .get("dedup") or {}).get("role") == "follower"
+        ),
+        {},
+    )
+    pair_block = {
+        "submitted": [
+            {"tenant": clf, "http": code, "plan_id": p.get("plan_id")}
+            for clf, code, p in submitted
+        ],
+        "states": states,
+        "stores": int(
+            after.get("feature_cache.store", 0.0)
+            - before.get("feature_cache.store", 0.0)
+        ),
+        "dedup": dedup_stats.get("dedup", {}),
+        "statistics_identical_to_solo": all(
+            reports[clf].get("statistics_sha256") == solo_sha[clf]
+            for clf, _ in _PLAN_SERVICE_TENANTS
+        ),
+        # the follower's own run report carries the attribution: who
+        # led, bytes/seconds it never spent
+        "follower_attribution": {
+            k: follower_report.get(k)
+            for k in ("role", "leader_plan", "bytes_saved",
+                      "seconds_saved")
+        },
+        "idempotent_resubmit": {
+            "http": recode,
+            "same_plan_id": (
+                repayload.get("plan_id") == leader_payload["plan_id"]
+            ),
+            "replayed": bool(repayload.get("idempotent_replay")),
+        },
+        "wall_s": round(pair_wall, 3),
+    }
+
+    # -- phase 2: the many-client chaos soak ----------------------------
+    os.environ["EEG_TPU_FEATURE_CACHE_DIR"] = os.path.join(
+        scratch, "fc_soak"
+    )
+    dedup_mod.reset()
+    before = obs.metrics.snapshot()["counters"]
+    clean_q = tenant_query(*_PLAN_SERVICE_TENANTS[0])
+    with GatewayServer(
+        journal_dir=os.path.join(scratch, "journal_soak"),
+        max_concurrent=4,
+        queue_depth=2 * _PLAN_SERVICE_CLIENTS
+        * _PLAN_SERVICE_PLANS_PER_CLIENT,
+        max_attempts=_PLAN_SERVICE_SOAK_ATTEMPTS,
+    ) as gw:
+        base = gw.url
+        results = [None] * _PLAN_SERVICE_CLIENTS
+
+        def client(idx):
+            # every other client chaos-bearing: its OWN plans absorb
+            # scheduler.plan faults through executor retries; its
+            # neighbours must never notice
+            chaos = (
+                f"&faults=scheduler.plan:p=0.3;seed={idx}"
+                if idx % 2 else ""
+            )
+            out = []
+            for j in range(_PLAN_SERVICE_PLANS_PER_CLIENT):
+                code, payload = _http_json(
+                    f"{base}/plans", body=clean_q + chaos,
+                    method="POST",
+                )
+                out.append((code, payload))
+            results[idx] = out
+
+        soak_start = time.perf_counter()
+        threads = [
+            _threading.Thread(target=client, args=(i,))
+            for i in range(_PLAN_SERVICE_CLIENTS)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        submit_wall = time.perf_counter() - soak_start
+        flat = [item for out in results for item in (out or [])]
+        sheds = sum(1 for code, _ in flat if code == 429)
+        admitted = [p["plan_id"] for code, p in flat if code == 201]
+        final = {pid: _await_plan(base, pid) for pid in admitted}
+        soak_wall = time.perf_counter() - soak_start
+        shas = {}
+        for pid in admitted:
+            _, rep = _http_json(f"{base}/plans/{pid}/report")
+            shas[pid] = rep.get("statistics_sha256")
+        _, soak_stats = _http_json(f"{base}/stats")
+    after = obs.metrics.snapshot()["counters"]
+    soak_epochs = int(
+        after.get("pipeline.epochs_loaded", 0.0)
+        - before.get("pipeline.epochs_loaded", 0.0)
+    )
+    expected = solo_sha[_PLAN_SERVICE_TENANTS[0][0]]
+    soak_block = {
+        "clients": _PLAN_SERVICE_CLIENTS,
+        "submissions": len(flat),
+        "submits_per_s": round(len(flat) / submit_wall, 1)
+        if submit_wall > 0 else 0.0,
+        "sheds": sheds,
+        "all_resolved": all(
+            state == "completed" for state in final.values()
+        ),
+        "statistics_identical": all(
+            s == expected for s in shas.values()
+        ),
+        "chaos_fired": int(
+            after.get("chaos.fired.scheduler.plan", 0.0)
+            - before.get("chaos.fired.scheduler.plan", 0.0)
+        ),
+        "dedup": soak_stats.get("dedup", {}),
+        "wall_s": round(soak_wall, 3),
+    }
+    return {
+        "epochs": pair_epochs + soak_epochs,
+        "wall_s": round(pair_wall + soak_block["wall_s"], 3),
+        "plan_service": {
+            "pair": pair_block,
+            "soak": soak_block,
+            "solo_sha256": solo_sha,
+        },
+        "report_sha256": reports[
+            _PLAN_SERVICE_TENANTS[0][0]
+        ].get("statistics_sha256") or "",
+    }
+
+
 def run_query(query: str):
     """(statistics, wall_s, n_epochs, stage dict, extras) for one
     pipeline execution. The stage dict is the builder's StageTimer
@@ -610,7 +891,7 @@ def main(argv) -> dict:
         "pipeline_e2e_overlap", "pipeline_e2e_bf16",
         "population_vmap", "population_looped", "population_sharded",
         "seizure_e2e", "scheduler_multi", "scheduler_suicide",
-        "populate",
+        "plan_service", "populate",
     ):
         raise SystemExit(f"unknown variant {variant!r}")
 
@@ -718,6 +999,47 @@ def main(argv) -> dict:
             "report_sha256": sched["concurrent"]["per_plan"][
                 min(sched["concurrent"]["per_plan"])
             ]["statistics_sha256"],
+        }
+
+    if variant == "plan_service":
+        scratch = _OWNED_TMP or cache_dir
+        result = run_plan_service(info, scratch)
+        import jax
+
+        from eeg_dataanalysispackage_tpu.io import feature_cache
+        from eeg_dataanalysispackage_tpu.ops import plan_cache
+        from eeg_dataanalysispackage_tpu.utils import compile_cache
+
+        pstats = plan_cache.stats()
+        wall = result["wall_s"]
+        n_epochs = result["epochs"]
+        return {
+            "variant": variant,
+            # the headline rate is epochs through the SERVICE per wall
+            # second across both timed phases — deliberately counting
+            # only what was actually loaded: dedup means followers
+            # load nothing, so this rate RISES with the hit ratio (the
+            # interesting front-door rate, submits/sec, is in the
+            # plan_service.soak block)
+            "epochs_per_s": round(n_epochs / wall, 1) if wall else 0.0,
+            "n": n_epochs,
+            "iters": 1,
+            "wall_s": wall,
+            "elapsed_s": wall,
+            "bytes_per_epoch": _BYTES_PER_EPOCH,
+            "bytes_per_s": round(
+                (n_epochs / wall) * _BYTES_PER_EPOCH, 1
+            ) if wall else 0.0,
+            "n_markers_per_file": n_markers,
+            "n_files": n_files,
+            "platform": jax.devices()[0].platform,
+            "feature_cache": feature_cache.stats(),
+            "plan_cache": {
+                "hits": pstats["hits"], "misses": pstats["misses"],
+            },
+            "compile_cache": compile_cache.active_cache_dir(),
+            "plan_service": result["plan_service"],
+            "report_sha256": result["report_sha256"],
         }
 
     if variant == "pipeline_e2e_warm":

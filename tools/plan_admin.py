@@ -1,0 +1,302 @@
+"""Operator CLI for the plan service: audit journals and plan reports
+without reading JSON by hand.
+
+Usage:
+    python tools/plan_admin.py list  (--journal DIR | --gateway URL)
+    python tools/plan_admin.py show <plan_id>
+            (--journal DIR [--reports DIR] | --gateway URL)
+    python tools/plan_admin.py tail --journal DIR
+            [--interval S] [--count N]
+
+``list`` renders every plan record as an aligned table — id, state,
+attempts, timestamp, idempotency key, query — against either a journal
+directory (offline: a dead server's journal audits fine) or a running
+gateway (``--gateway http://host:port``, the live view including
+queued/running states).
+
+``show`` prints one plan's full record: the journaled statistics text
+(the exactly-once evidence — byte-for-byte what the client was
+served), the failure error + attempt history, and, when the per-plan
+report tree is reachable (``--reports DIR``, or the record's own
+``report_dir``, or the gateway's report endpoint), the rendered
+``run_report.json`` via tools/obs_report.py — one rendering code path,
+not two.
+
+``tail`` follows a journal directory and prints records as they land
+or change state — the exactly-once behavior is auditable live:
+``submitted`` appears before execution, exactly one terminal record
+replaces it, and an idempotent re-submit changes nothing.
+
+Stdlib only, like every tool in this repo.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import obs_report  # noqa: E402  (tools/obs_report.py, the renderer)
+
+
+def _http(url: str):
+    try:
+        with urllib.request.urlopen(url, timeout=30) as r:
+            return json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        try:
+            return json.loads(e.read())
+        except ValueError:
+            raise SystemExit(f"{url}: HTTP {e.code}")
+    except (urllib.error.URLError, OSError) as e:
+        raise SystemExit(f"{url}: {e}")
+
+
+def _journal_entries(journal_dir: str):
+    from eeg_dataanalysispackage_tpu.scheduler.journal import PlanJournal
+
+    if not os.path.isdir(journal_dir):
+        raise SystemExit(f"no such journal directory: {journal_dir}")
+    return PlanJournal(journal_dir).entries()
+
+
+def _rows_from_entries(entries):
+    rows = []
+    for e in entries:
+        meta = e.get("meta") or {}
+        rows.append({
+            "plan_id": e.get("plan_id", "?"),
+            "state": e.get("state", "?"),
+            "attempts": e.get("attempts", 0),
+            "utc": e.get("completed_utc") or e.get("failed_utc")
+            or e.get("submitted_utc") or "",
+            "key": meta.get("idempotency_key") or "",
+            "query": e.get("query", ""),
+        })
+    return rows
+
+
+def _rows_from_gateway(url: str):
+    payload = _http(url.rstrip("/") + "/plans")
+    return [
+        {
+            "plan_id": p.get("plan_id", "?"),
+            "state": p.get("state", "?"),
+            "attempts": p.get("attempts", 0),
+            "utc": "",
+            "key": "",
+            "query": p.get("query", ""),
+        }
+        for p in payload.get("plans", [])
+    ]
+
+
+def cmd_list(args) -> int:
+    rows = (
+        _rows_from_gateway(args.gateway)
+        if args.gateway
+        else _rows_from_entries(_journal_entries(args.journal))
+    )
+    if not rows:
+        print("(no plan records)")
+        return 0
+    widths = {
+        k: max(len(k), *(len(str(r[k])) for r in rows))
+        for k in ("plan_id", "state", "attempts", "utc", "key")
+    }
+    header = (
+        f"{'plan_id':<{widths['plan_id']}}  {'state':<{widths['state']}}  "
+        f"{'attempts':>{widths['attempts']}}  {'utc':<{widths['utc']}}  "
+        f"{'key':<{widths['key']}}  query"
+    )
+    print(header)
+    for r in rows:
+        query = r["query"]
+        if len(query) > 80:
+            query = query[:77] + "..."
+        print(
+            f"{r['plan_id']:<{widths['plan_id']}}  "
+            f"{r['state']:<{widths['state']}}  "
+            f"{str(r['attempts']):>{widths['attempts']}}  "
+            f"{r['utc']:<{widths['utc']}}  "
+            f"{str(r['key']):<{widths['key']}}  {query}"
+        )
+    states = {}
+    for r in rows:
+        states[r["state"]] = states.get(r["state"], 0) + 1
+    print(
+        f"\n{len(rows)} plans: "
+        + "  ".join(f"{k}={v}" for k, v in sorted(states.items()))
+    )
+    return 0
+
+
+def _show_entry(entry, report_dir=None):
+    meta = entry.get("meta") or {}
+    print(f"plan     {entry.get('plan_id')}")
+    print(f"state    {entry.get('state')}")
+    print(f"query    {entry.get('query')}")
+    for field in ("submitted_utc", "completed_utc", "failed_utc"):
+        if entry.get(field):
+            print(f"{field.split('_')[0]:<10}{entry[field]}")
+    if entry.get("attempts"):
+        print(f"attempts {entry['attempts']}")
+    if meta.get("idempotency_key"):
+        print(f"idempotency_key {meta['idempotency_key']}")
+    if meta.get("gateway"):
+        print(f"gateway  {meta['gateway']}")
+    if meta.get("recovered"):
+        print("recovered: resumed from a prior process's journal")
+    if entry.get("error"):
+        print(f"\nerror: {entry['error']}")
+    if entry.get("statistics"):
+        print(
+            f"\nstatistics (sha256 "
+            f"{entry.get('statistics_sha256', '')[:16]}…):"
+        )
+        print(entry["statistics"].rstrip("\n"))
+    report_dir = report_dir or meta.get("report_dir")
+    if report_dir:
+        path = os.path.join(report_dir, "run_report.json")
+        crash = os.path.join(report_dir, "crash_report.json")
+        if os.path.exists(path):
+            print(f"\n--- run report ({path}) ---")
+            obs_report.show(path)
+        elif os.path.exists(crash):
+            print(f"\n--- crash report ({crash}) ---")
+            obs_report.show(crash)
+        else:
+            print(f"\n(no report artifact under {report_dir})")
+
+
+def cmd_show(args) -> int:
+    if args.gateway:
+        base = args.gateway.rstrip("/")
+        status = _http(f"{base}/plans/{args.plan_id}")
+        if "error" in status and "state" not in status:
+            print(status["error"])
+            return 1
+        print(json.dumps(status, indent=2, sort_keys=True))
+        if status.get("state") in ("completed", "failed", "cancelled"):
+            report = _http(f"{base}/plans/{args.plan_id}/report")
+            if report.get("statistics"):
+                print(
+                    f"\nstatistics (sha256 "
+                    f"{(report.get('statistics_sha256') or '')[:16]}…):"
+                )
+                print(report["statistics"].rstrip("\n"))
+            if report.get("error"):
+                print(f"\nerror: {report['error']}")
+            run_report = report.get("run_report")
+            if run_report is not None:
+                # reuse the obs_report renderer on a temp copy — one
+                # rendering path for local and remote artifacts
+                import tempfile
+
+                with tempfile.NamedTemporaryFile(
+                    "w", suffix=".json", delete=False
+                ) as f:
+                    json.dump(run_report, f)
+                    tmp = f.name
+                try:
+                    print("\n--- run report (via gateway) ---")
+                    obs_report.show(tmp)
+                finally:
+                    os.unlink(tmp)
+        return 0
+    from eeg_dataanalysispackage_tpu.scheduler.journal import PlanJournal
+
+    entry = PlanJournal(args.journal).entry(args.plan_id)
+    if entry is None:
+        print(f"no journal record for {args.plan_id} in {args.journal}")
+        return 1
+    report_dir = (
+        os.path.join(args.reports, args.plan_id) if args.reports else None
+    )
+    _show_entry(entry, report_dir=report_dir)
+    return 0
+
+
+def cmd_tail(args) -> int:
+    """Follow the journal: print each record when it first appears and
+    again on every state change (the submitted -> terminal transition
+    is the exactly-once audit trail)."""
+    seen = {}
+    printed = 0
+    while True:
+        for entry in _journal_entries(args.journal):
+            pid = entry.get("plan_id")
+            state = entry.get("state")
+            if seen.get(pid) == state:
+                continue
+            seen[pid] = state
+            stamp = (
+                entry.get("completed_utc") or entry.get("failed_utc")
+                or entry.get("submitted_utc") or ""
+            )
+            line = f"{stamp}  {pid:<8} {state:<10}"
+            if state == "completed":
+                line += (
+                    f" attempts={entry.get('attempts')} sha256="
+                    f"{(entry.get('statistics_sha256') or '')[:12]}…"
+                )
+            elif state == "failed":
+                line += f" {str(entry.get('error', ''))[:100]}"
+            else:
+                line += f" {entry.get('query', '')[:80]}"
+            print(line, flush=True)
+            printed += 1
+            if args.count and printed >= args.count:
+                return 0
+        if args.count and printed >= args.count:
+            return 0
+        time.sleep(args.interval)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="plan_admin", description=__doc__.split("\n\n")[0],
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_list = sub.add_parser("list", help="table of all plan records")
+    p_show = sub.add_parser("show", help="one plan's full record + report")
+    p_show.add_argument("plan_id")
+    p_tail = sub.add_parser("tail", help="follow a journal directory")
+    for p in (p_list, p_show):
+        p.add_argument("--journal", help="journal directory")
+        p.add_argument("--gateway", help="running gateway URL")
+    p_show.add_argument(
+        "--reports",
+        help="per-plan report root (<root>/<plan_id>/run_report.json)",
+    )
+    p_tail.add_argument("--journal", required=True)
+    p_tail.add_argument("--interval", type=float, default=1.0)
+    p_tail.add_argument(
+        "--count", type=int, default=0,
+        help="exit after N printed records (0 = follow forever)",
+    )
+    args = parser.parse_args(argv)
+    if args.command in ("list", "show"):
+        if bool(args.journal) == bool(args.gateway):
+            parser.error("pass exactly one of --journal / --gateway")
+    if args.command == "list":
+        return cmd_list(args)
+    if args.command == "show":
+        return cmd_show(args)
+    return cmd_tail(args)
+
+
+if __name__ == "__main__":
+    # the repo root, so the journal reader imports without installation
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    try:
+        sys.exit(main())
+    except KeyboardInterrupt:
+        sys.exit(130)
