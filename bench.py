@@ -86,7 +86,22 @@ train-step variants (tools/ingest_bench.py) with HBM-roofline context:
                   end, with the served-vs-batch parity pin, the
                   admission-control shed probe, and a chaos soak
                   (serve.request/serve.batch faults) all recorded in
-                  the line's ``serve`` block (tools/serve_bench.py)
+                  the line's ``serve`` block (tools/serve_bench.py);
+                  every sweep level carries its engine rung and its
+                  own mean_batch_size
+  serve_mega      the serve-path megakernel family (ops/serve_mega.py
+                  via tools/serve_bench.py): mega vs fused swept
+                  back-to-back in ONE process at concurrency 1/4/16 —
+                  per-level preds/sec + p99 pairs with rung
+                  attribution, the mega-vs-fused and mega-vs-batch
+                  prediction parity pins, the within-bucket margin
+                  bit-identity pin, the engine's mega warmup-gate
+                  record, and the int8 rung's gate decision
+  pipeline_e2e_int8
+                  the cold query with precision=int8 (per-subband
+                  feature quantization behind the per-run gate — the
+                  rung below bf16; the line's ``precision`` block
+                  records the decision + gate_seconds)
 
 Resilience contract (round-1 BENCH artifact died rc=1 on a single
 ``Unable to initialize backend 'axon': UNAVAILABLE``): the parent
@@ -171,6 +186,9 @@ _VARIANT_TIMEOUTS = {
     # decode routes to the bank128 Pallas kernel on accelerators —
     # same fresh-compile class as pallas_ingest
     "decode_ingest": _SLOW_COMPILE_TIMEOUT_S,
+    # the serve megakernel compiles through Mosaic on accelerators —
+    # same fresh-compile class
+    "serve_mega": _SLOW_COMPILE_TIMEOUT_S,
 }
 # Total wall budget for the variant loop: the headline always runs;
 # a further variant starts only if it could finish inside the budget
@@ -179,7 +197,7 @@ _VARIANT_TIMEOUTS = {
 # patience — on a warm compile cache everything fits easily; on a
 # cold cache the tail variants may be budget-skipped (recorded as
 # such, artifact intact). BENCH_TOTAL_BUDGET overrides.
-_N_VARIANTS = 25  # asserted against the variant tables below
+_N_VARIANTS = 27  # asserted against the variant tables below
 _TOTAL_BUDGET_S = int(
     os.environ.get(
         "BENCH_TOTAL_BUDGET",
@@ -243,6 +261,9 @@ _VARIANTS_TPU = {
     # isolates one knob against pipeline_e2e_cold)
     "pipeline_e2e_overlap": (2000, 4),
     "pipeline_e2e_bf16": (2000, 4),
+    # the int8 precision rung's cold twin (per-subband feature
+    # quantization behind the per-run gate)
+    "pipeline_e2e_int8": (2000, 4),
     # population training engine (markers per file, file count): 16
     # SGD members as one vmapped program vs the same members looped,
     # plus the member axis sharded over the device mesh
@@ -260,6 +281,9 @@ _VARIANTS_TPU = {
     # online inference service (markers per file, file count):
     # latency/throughput sweep + parity pin + chaos soak
     "serve_bench": (2000, 2),
+    # the serve-path megakernel vs its fused twin, back-to-back in
+    # one process (per-level rung attribution + parity pins)
+    "serve_mega": (2000, 2),
     # the multi-tenant plan executor (markers per file, file count —
     # tools/pipeline_bench.py scheduler_multi): 4 plans sequential vs
     # concurrent over shared caches, per-plan isolated attribution,
@@ -289,12 +313,14 @@ _VARIANTS_CPU = {
     "pipeline_e2e_fanout5": (2000, 4),
     "pipeline_e2e_overlap": (2000, 4),
     "pipeline_e2e_bf16": (2000, 4),
+    "pipeline_e2e_int8": (2000, 4),
     "population_vmap": (800, 2),
     "population_looped": (800, 2),
     "population_sharded": (800, 2),
     "sharded_ingest": (2048, 2),
     "seizure_e2e": (60000, 2),
     "serve_bench": (400, 2),
+    "serve_mega": (400, 2),
     "scheduler_multi": (2000, 4),
     "plan_service": (2000, 4),
 }
@@ -731,7 +757,13 @@ def main() -> None:
             payload = _collect("cpu")
     else:
         payload = _collect("cpu")
-    print(json.dumps(payload))
+    # strict JSON at the artifact boundary: children already sanitize
+    # their own lines, but the published payload must never carry a
+    # bare NaN/Infinity token either (utils/strict_json — non-finite
+    # floats serialize as null; pinned in tests/test_bench_contract.py)
+    from eeg_dataanalysispackage_tpu.utils import strict_json
+
+    print(strict_json.dumps(payload))
 
 
 if __name__ == "__main__":

@@ -27,6 +27,7 @@ import dataclasses
 import hashlib
 import logging
 import os
+import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
@@ -95,6 +96,42 @@ def degradation_ladder(backend: str):
     return list(
         FUSED_DEGRADATION_LADDER[FUSED_DEGRADATION_LADDER.index(backend):]
     )
+
+
+# -- precision-gate memo -------------------------------------------------
+# The gate decision is pure (content bytes x geometry x resolved
+# tolerance -> record), but the double-featurize behind it costs two
+# extra compiled programs + a featurize pass — measured as the bulk of
+# pipeline_e2e_bf16's deficit vs the f32 cold run (BENCH_pr8: 685 vs
+# 949 eps). Memoizing per content digest hoists that cost off every
+# re-gating of the same session in one process (warm re-runs, the
+# multi-tenant executor's N plans over one recording set). Bounded
+# LRU; thread-safe (the executor gates from worker threads).
+_GATE_MEMO: "collections.OrderedDict" = collections.OrderedDict()
+_GATE_MEMO_CAP = 32
+_GATE_MEMO_LOCK = threading.Lock()
+
+
+def _gate_memo_get(key):
+    with _GATE_MEMO_LOCK:
+        record = _GATE_MEMO.get(key)
+        if record is not None:
+            _GATE_MEMO.move_to_end(key)
+        return record
+
+
+def _gate_memo_put(key, record) -> None:
+    with _GATE_MEMO_LOCK:
+        _GATE_MEMO[key] = dict(record)
+        _GATE_MEMO.move_to_end(key)
+        while len(_GATE_MEMO) > _GATE_MEMO_CAP:
+            _GATE_MEMO.popitem(last=False)
+
+
+def reset_gate_memo() -> None:
+    """Drop the memoized gate decisions (test isolation)."""
+    with _GATE_MEMO_LOCK:
+        _GATE_MEMO.clear()
 
 
 def fused_extractor_id(wavelet_index: int, precision: str = "f32") -> Tuple:
@@ -854,52 +891,112 @@ class OfflineDataProvider:
             np.concatenate(targets),
         )
 
+    def precision_gate_check(
+        self,
+        recordings: Sequence[Tuple[str, int, "brainvision.Recording"]],
+        wavelet_index: int = 8,
+        precision: str = "bf16",
+        max_rows: int = 64,
+        content_key: Optional[str] = None,
+    ) -> dict:
+        """The per-run precision accuracy gate (bf16 and int8 share
+        it): the first recording's first ``max_rows`` kept markers are
+        featurized through the decode rung in BOTH the requested
+        precision and f32, and the rows compared against that rung's
+        documented tolerance (ops/decode_ingest.feature_precision_
+        gate). Returns the gate record (max_abs_dev / tolerance / ok /
+        rows_checked, plus ``gate_seconds`` — the double-featurize
+        cost, so reports can separate gate overhead from steady-state
+        throughput — and ``cached``) the builder embeds in
+        run_report.json. The reference pass runs on a 64-capacity
+        plan, so its extra f32 program is the smallest compile the
+        rung has.
+
+        ``content_key`` (the first recording's content digest) hoists
+        the double-featurize off the hot path where it re-runs: the
+        decision is pure — a function of the bytes, the geometry, and
+        the resolved tolerance — so a process re-gating the same
+        content (warm re-runs, multi-tenant plans over one session)
+        replays the memoized record with ``cached=True`` and
+        ``gate_seconds=0.0`` instead of paying the two programs again.
+        """
+        import time as _time
+
+        from ..ops import decode_ingest, device_ingest
+
+        tol = decode_ingest.precision_gate_tolerance(precision)
+        memo_key = None
+        if content_key is not None:
+            memo_key = (
+                str(content_key), int(wavelet_index), str(precision),
+                int(max_rows), float(tol), self._pre, self._post,
+                tuple(self._channel_names),
+                # the decode formulation is resolved per call and never
+                # cached elsewhere (the 'auto'-resolution staleness
+                # class) — a formulation flip between runs must re-gate,
+                # not replay the other formulation's deviation
+                decode_ingest.default_formulation(),
+            )
+            cached = _gate_memo_get(memo_key)
+            if cached is not None:
+                record = dict(cached)
+                record["cached"] = True
+                record["gate_seconds"] = 0.0
+                return record
+        t0 = _time.perf_counter()
+        if not recordings:
+            gate = decode_ingest.feature_precision_gate(
+                np.zeros((0, 1), np.float32),
+                np.zeros((0, 1), np.float32),
+                precision=precision,
+            )
+        else:
+            _rel, guessed, rec = recordings[0]
+            raw, res, n_samples = device_ingest.stage_raw(
+                rec, self._channel_indices(rec)
+            )
+            # fresh BalanceState: the gate compares feature VALUES for
+            # identical windows — retention differences against the
+            # real run are irrelevant, and the real run's balance
+            # state must not be perturbed
+            plan = device_ingest.plan_ingest(
+                rec.markers, guessed, n_samples,
+                pre=self._pre, post=self._post,
+            )
+            cap = min(max_rows, plan.capacity)
+            positions, mask = plan.positions[:cap], plan.mask[:cap]
+            kwargs = dict(
+                wavelet_index=wavelet_index, pre=self._pre
+            )
+            f32_rows = decode_ingest.make_decode_ingest_featurizer(
+                precision="f32", **kwargs
+            )(raw, res, positions, mask)
+            rung_rows = decode_ingest.make_decode_ingest_featurizer(
+                precision=precision, **kwargs
+            )(raw, res, positions, mask)
+            real = np.asarray(mask, dtype=bool)
+            gate = decode_ingest.feature_precision_gate(
+                np.asarray(rung_rows)[real],
+                np.asarray(f32_rows)[real],
+                precision=precision,
+            )
+        gate["gate_seconds"] = round(_time.perf_counter() - t0, 6)
+        gate["cached"] = False
+        if memo_key is not None:
+            _gate_memo_put(memo_key, gate)
+        return gate
+
     def bf16_gate_check(
         self,
         recordings: Sequence[Tuple[str, int, "brainvision.Recording"]],
         wavelet_index: int = 8,
         max_rows: int = 64,
     ) -> dict:
-        """The per-run bf16 accuracy gate: the first recording's first
-        ``max_rows`` kept markers are featurized through the decode
-        rung in BOTH precisions and the rows compared against the
-        documented bf16 tolerance (ops/decode_ingest.BF16_GATE_TOL).
-        Returns the gate record (max_abs_dev / tolerance / ok /
-        rows_checked) the builder embeds in run_report.json. The
-        reference pass runs on a 64-capacity plan, so its extra f32
-        program is the smallest compile the rung has."""
-        from ..ops import decode_ingest, device_ingest
-
-        if not recordings:
-            return decode_ingest.bf16_feature_gate(
-                np.zeros((0, 1), np.float32), np.zeros((0, 1), np.float32)
-            )
-        _rel, guessed, rec = recordings[0]
-        raw, res, n_samples = device_ingest.stage_raw(
-            rec, self._channel_indices(rec)
-        )
-        # fresh BalanceState: the gate compares feature VALUES for
-        # identical windows — retention differences against the real
-        # run are irrelevant, and the real run's balance state must
-        # not be perturbed
-        plan = device_ingest.plan_ingest(
-            rec.markers, guessed, n_samples,
-            pre=self._pre, post=self._post,
-        )
-        cap = min(max_rows, plan.capacity)
-        positions, mask = plan.positions[:cap], plan.mask[:cap]
-        kwargs = dict(
-            wavelet_index=wavelet_index, pre=self._pre
-        )
-        f32_rows = decode_ingest.make_decode_ingest_featurizer(
-            precision="f32", **kwargs
-        )(raw, res, positions, mask)
-        bf16_rows = decode_ingest.make_decode_ingest_featurizer(
-            precision="bf16", **kwargs
-        )(raw, res, positions, mask)
-        real = np.asarray(mask, dtype=bool)
-        return decode_ingest.bf16_feature_gate(
-            np.asarray(bf16_rows)[real], np.asarray(f32_rows)[real]
+        """The bf16 spelling of :meth:`precision_gate_check` (the PR 8
+        surface, kept for its callers and pins)."""
+        return self.precision_gate_check(
+            recordings, wavelet_index=wavelet_index,
+            precision="bf16", max_rows=max_rows,
         )
 
     def feature_cache_key(self, extractor: Tuple) -> str:

@@ -59,6 +59,9 @@ _ENV_KNOBS = (
     "EEG_TPU_OVERLAP",
     "EEG_TPU_PRECISION",
     "EEG_TPU_BF16_GATE_TOL",
+    "EEG_TPU_INT8_GATE_TOL",
+    "EEG_TPU_MEGA_GATE_TOL",
+    "EEG_TPU_SERVE_FLUSH_US",
     "EEG_TPU_DECODE_FORMULATION",
     "EEG_PALLAS_MODE",
     "JAX_PLATFORMS",

@@ -87,6 +87,14 @@ def default_splits() -> int:
 #: bench, and tests).
 DECODE_FORMULATIONS = ("slice", "bank128")
 
+#: the feature precision ladder, loosest last (single source for the
+#: builder, the IR, the serving engine, and this module's validation):
+#: f32 is the ~1e-7 ladder-rung contract; bf16 computes the cascade
+#: contraction on bfloat16 operands; int8 quantizes the finished f32
+#: feature rows per subband. Every non-f32 rung runs behind a per-run
+#: measured-deviation gate with per-run auto-disable.
+PRECISIONS = ("f32", "bf16", "int8")
+
 #: env override for the platform-resolved formulation.
 ENV_FORMULATION = "EEG_TPU_DECODE_FORMULATION"
 
@@ -99,6 +107,18 @@ ENV_FORMULATION = "EEG_TPU_DECODE_FORMULATION"
 #: ladder-rung contract (~1e-7), which bf16 deliberately does not
 #: promise. Override for experiments via EEG_TPU_BF16_GATE_TOL.
 BF16_GATE_TOL = 5e-3
+
+#: int8 feature gate: max abs deviation of the int8-quantized feature
+#: rows vs the f32 reference on the SAME rows before the rung
+#: auto-disables. The bound follows from the quantizer itself:
+#: symmetric per-(channel, subband) scales put the worst rounding
+#: error at scale/2 = group_max/254, and L2-normalized rows keep
+#: group_max <= 1, so the arithmetic envelope is ~4e-3; 2e-2 leaves
+#: the same headroom-over-envelope factor the bf16 gate carries
+#: (energy-subband classifiers — arXiv:1307.7897 — are the workload
+#: this aggressive rung is plausibly safe for; the gate decides per
+#: run). Override for experiments via EEG_TPU_INT8_GATE_TOL.
+INT8_GATE_TOL = 2e-2
 
 
 #: the standing r4 chip evidence the accelerator default is judged
@@ -427,16 +447,19 @@ def make_decode_ingest_featurizer(
     :func:`default_formulation` (never cached — the
     'auto'-resolution staleness class device_ingest documents).
     ``precision="bf16"`` computes the cascade matmul in bfloat16 with
-    f32 accumulation; callers gate it per run
-    (:func:`bf16_feature_gate` / pipeline/builder.py).
+    f32 accumulation; ``precision="int8"`` computes f32 features and
+    quantizes the finished rows per subband
+    (:func:`quantize_dequantize_int8` — the rung below bf16). Callers
+    gate every non-f32 rung per run (:func:`feature_precision_gate` /
+    pipeline/builder.py).
     ``donate_stream`` donates the staged int16 stream buffer to the
     program (the overlap path's ping/pong staging — the stream is
     dead after the on-device scale); skipped on CPU, where XLA cannot
     alias it and would warn per call.
     """
-    if precision not in ("f32", "bf16"):
+    if precision not in PRECISIONS:
         raise ValueError(
-            f"unknown precision {precision!r}; use 'f32' or 'bf16'"
+            f"unknown precision {precision!r}; use one of {PRECISIONS}"
         )
     if 64 % tile:
         raise ValueError(
@@ -450,11 +473,16 @@ def make_decode_ingest_featurizer(
         positions = np.asarray(positions)
         mask = np.asarray(mask, dtype=bool)
         if form == "bank128":
-            return _bank_featurize(
+            out = _bank_featurize(
                 raw_i16, resolutions, positions, mask,
                 wavelet_index, epoch_size, skip_samples, feature_size,
-                pre, precision,
+                # int8 quantizes FINISHED f32 rows; the kernel itself
+                # runs the f32 formulation (bf16 keeps its twin)
+                pre, "bf16" if precision == "bf16" else "f32",
             )
+            if precision == "int8":
+                out = int8_feature_path(out, feature_size)
+            return out
         donate = donate_stream and jax.default_backend() != "cpu"
         run = _slice_program(
             wavelet_index, epoch_size, skip_samples, feature_size,
@@ -490,7 +518,12 @@ def make_decode_ingest_featurizer(
         )
         # bucket padding never leaves this wrapper: callers see the
         # plan's own capacity, like every other rung
-        return out if bucket == cap else out[:cap]
+        out = out if bucket == cap else out[:cap]
+        if precision == "int8":
+            # quantize the finished rows (padded/masked rows are zero
+            # and stay zero — abs-max scales never see them as peaks)
+            out = int8_feature_path(out, feature_size)
+        return out
 
     featurize.tile = tile
     featurize.precision = precision
@@ -530,6 +563,124 @@ def _bank_featurize(
     return out.at[np.nonzero(mask)[0]].set(feats)
 
 
+def subband_group_bounds(feature_size: int):
+    """The per-subband column groups of one channel's ``feature_size``
+    DWT coefficients, as ``((lo, hi), ...)`` half-open bounds.
+
+    The eegdsp cascade layout is ``[aK | dK | ... | d1]``: the
+    approximation coefficient first, then detail bands of doubling
+    width — for the shipped K=16 that is groups (0,1), (1,2), (2,4),
+    (4,8), (8,16). Subbands carry very different energy (the
+    1/f-shaped EEG spectrum), which is why the int8 rung scales each
+    group independently instead of one scale per row: a coarse
+    approximation coefficient near 1.0 would otherwise eat the whole
+    int8 range and crush the fine detail bands to zero.
+    """
+    if feature_size < 1:
+        raise ValueError(f"feature_size must be >= 1, got {feature_size}")
+    bounds = [(0, 1)]
+    lo = 1
+    while lo < feature_size:
+        hi = min(feature_size, lo * 2)
+        bounds.append((lo, hi))
+        lo = hi
+    return tuple(bounds)
+
+
+def quantize_dequantize_int8(rows, feature_size: int):
+    """The int8 feature-quantization rung's core (traceable): symmetric
+    per-(row, channel, subband) scales, round-to-nearest into int8,
+    immediate dequantization back to f32.
+
+    ``rows`` is the fused path's ``(n, C*K)`` float32 feature matrix
+    (channel-major, K = ``feature_size``). Returns ``(dequantized
+    rows (n, C*K) f32, scales (n_groups, n, C) f32)``. The round trip
+    IS the rung: downstream consumers (classifiers, the margin) keep
+    their f32 contract while every value has passed through 8 bits —
+    the representation a quantized serving deployment would ship.
+    Scales are per ROW deliberately: a batch-wide max would couple one
+    request's quantization grid to whatever rides in its micro-batch
+    (a served window's features — and a margin near the decision
+    threshold — would change with concurrent traffic), so each row
+    quantizes against its own subband peaks and the output is
+    row-independent: bit-identical whatever batch it rides in, the
+    same contract the mega rung carries. Deterministic (no stochastic
+    rounding — a re-run of the same content must produce
+    byte-identical features, the cache contract), and zero rows stay
+    exactly zero (an all-zero group's clamped scale just divides
+    zeros).
+    """
+    import jax.numpy as jnp
+
+    n = rows.shape[0]
+    K = int(feature_size)
+    C = rows.shape[1] // K
+    x = rows.reshape(n, C, K)
+    outs = []
+    scales = []
+    for lo, hi in subband_group_bounds(K):
+        g = x[:, :, lo:hi]
+        s = jnp.max(jnp.abs(g), axis=2) / 127.0  # (n, C)
+        s = jnp.maximum(s, 1e-30)  # all-zero group: 0/s stays 0
+        q = jnp.clip(jnp.round(g / s[..., None]), -127.0, 127.0)
+        outs.append(q.astype(jnp.int8).astype(jnp.float32)
+                    * s[..., None])
+        scales.append(s)
+    return (
+        jnp.concatenate(outs, axis=2).reshape(n, C * K),
+        jnp.stack(scales),
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _int8_path_program(feature_size: int):
+    @jax.jit
+    def run(rows):
+        dq, _ = quantize_dequantize_int8(rows, feature_size)
+        return dq
+
+    return run
+
+
+def int8_feature_path(rows, feature_size: int):
+    """Jitted quantize→dequantize pass over finished feature rows —
+    the int8 rung the decode featurizer (and the serving engine's
+    int8 program) applies after the f32 math."""
+    return _int8_path_program(int(feature_size))(rows)
+
+
+def int8_gate_tolerance() -> float:
+    """The documented int8 feature gate (``INT8_GATE_TOL``), with the
+    experiment override ``EEG_TPU_INT8_GATE_TOL`` — same logged-never-
+    silent fallback policy as :func:`bf16_gate_tolerance`."""
+    import logging
+    import os
+
+    raw = os.environ.get("EEG_TPU_INT8_GATE_TOL")
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            logging.getLogger(__name__).warning(
+                "EEG_TPU_INT8_GATE_TOL=%r is not a float; using the "
+                "default gate %g", raw, INT8_GATE_TOL,
+            )
+    return INT8_GATE_TOL
+
+
+def precision_gate_tolerance(precision: str) -> float:
+    """The measured-deviation gate for one non-f32 precision rung
+    (env-overridable per rung)."""
+    if precision == "bf16":
+        return bf16_gate_tolerance()
+    if precision == "int8":
+        return int8_gate_tolerance()
+    raise ValueError(
+        f"precision {precision!r} has no accuracy gate (f32 IS the "
+        f"reference)"
+    )
+
+
 def bf16_gate_tolerance() -> float:
     """The documented bf16 feature gate (``BF16_GATE_TOL``), with the
     experiment override ``EEG_TPU_BF16_GATE_TOL``. An unparseable
@@ -551,32 +702,51 @@ def bf16_gate_tolerance() -> float:
     return BF16_GATE_TOL
 
 
+def feature_precision_gate(
+    rows: np.ndarray,
+    f32_rows: np.ndarray,
+    precision: str = "bf16",
+    tolerance: float | None = None,
+) -> dict:
+    """The per-run accuracy gate shared by every non-f32 precision
+    rung: max abs deviation of the rung's feature rows against the
+    f32 reference rows on the SAME windows, judged against that rung's
+    documented tolerance. Returns the decision record the run report
+    embeds: ``{"precision", "max_abs_dev", "tolerance", "ok",
+    "rows_checked"}``.
+    """
+    tol = (
+        precision_gate_tolerance(precision)
+        if tolerance is None
+        else float(tolerance)
+    )
+    rows = np.asarray(rows, np.float32)
+    f32_rows = np.asarray(f32_rows, np.float32)
+    if rows.shape != f32_rows.shape:
+        raise ValueError(
+            f"gate rows misaligned: {rows.shape} vs {f32_rows.shape}"
+        )
+    dev = (
+        float(np.max(np.abs(rows - f32_rows)))
+        if rows.size
+        else 0.0
+    )
+    return {
+        "precision": str(precision),
+        "max_abs_dev": dev,
+        "tolerance": tol,
+        "ok": bool(dev <= tol),
+        "rows_checked": int(rows.shape[0]),
+    }
+
+
 def bf16_feature_gate(
     bf16_rows: np.ndarray,
     f32_rows: np.ndarray,
     tolerance: float | None = None,
 ) -> dict:
-    """The per-run accuracy gate: max abs deviation of the bf16 path's
-    feature rows against the f32 reference rows, judged against the
-    documented gate. Returns the decision record the run report
-    embeds: ``{"max_abs_dev", "tolerance", "ok", "rows_checked"}``.
-    """
-    tol = bf16_gate_tolerance() if tolerance is None else float(tolerance)
-    bf16_rows = np.asarray(bf16_rows, np.float32)
-    f32_rows = np.asarray(f32_rows, np.float32)
-    if bf16_rows.shape != f32_rows.shape:
-        raise ValueError(
-            f"gate rows misaligned: {bf16_rows.shape} vs "
-            f"{f32_rows.shape}"
-        )
-    dev = (
-        float(np.max(np.abs(bf16_rows - f32_rows)))
-        if bf16_rows.size
-        else 0.0
+    """The bf16 spelling of :func:`feature_precision_gate` (the PR 8
+    surface, kept verbatim for its callers and pins)."""
+    return feature_precision_gate(
+        bf16_rows, f32_rows, precision="bf16", tolerance=tolerance
     )
-    return {
-        "max_abs_dev": dev,
-        "tolerance": tol,
-        "ok": bool(dev <= tol),
-        "rows_checked": int(bf16_rows.shape[0]),
-    }

@@ -1,0 +1,485 @@
+"""The serve-path megakernel: raw window bytes -> margin, one pass.
+
+The serving engine's fused program (serve/engine.py) reuses the batch
+path's ``make_device_ingest_featurizer`` — correct by construction,
+but built for IRREGULAR marker layouts: it cuts windows with the XLA
+element gather the roofline analysis measured at ~5 ns/ELEMENT on CPU
+and far below roofline on chip (docs/performance.md). The serving
+stream has none of that irregularity: the engine LAYS OUT the
+micro-batch itself, so window ``i`` can live at a known static offset.
+This module exploits exactly that — the whole serving hot path
+
+    int16 decode -> window cut -> f32 pre-stimulus mean subtract ->
+    Db cascade contraction -> 48-dim L2-normalized feature -> linear
+    margin
+
+runs as ONE kernel over the staged stream, and neither epochs nor
+feature rows ever materialize in HBM: the program's only output is the
+``(capacity,)`` margin vector (4 bytes/request out against ~5 KB of
+int16 window bytes in).
+
+Two lowerings share the contract (the interpret-mode/XLA twin pattern
+``ops/ingest_pallas.py`` established):
+
+- ``pallas``: a Pallas TPU kernel. The stream is laid out at a
+  128-lane-padded window stride and viewed as rows-of-128 (the
+  bank128 kernel's chip-proven layout), so each grid step's BlockSpec
+  fetch is whole aligned rows — standard pipelined DMA, which Pallas
+  DOUBLE-BUFFERS automatically: step i+1's window block streams into
+  VMEM while step i computes. Window cuts are STATIC slices (the
+  stream is regular by construction — no dynamic lane slice, the
+  remote-compile crasher class), the cascade contraction is one MXU
+  dot against the zero-padded window operator
+  (``device_ingest.ingest_matrix(fold_baseline=False)`` — explicit
+  subtract-first baseline, the f32-safety shape every kernel here
+  uses), the L2 normalize runs on the VPU, and the margin is one more
+  MXU dot against the weight vector padded to a 128-lane matrix.
+  Interpret mode runs the same kernel on CPU for hermetic tier-1
+  parity pins; on TPU it compiles to Mosaic.
+- ``xla``: the compiled twin for hosts where Mosaic is unavailable —
+  the SAME regular layout collapses the window cut to a free reshape
+  (``(C, cap*Wp) -> (C, cap, Wp)``), i.e. the gather-free einsum
+  family the chip table clocks at 45.1M eps vs the fused engine
+  program's gather formulation. On CPU this twin is the mega rung's
+  production lowering (and genuinely faster than the fused program:
+  it never pays the scalar-load gather), so the rung, its warmup
+  gate, and the parity pins all run in tier-1.
+
+Accelerator default follows the PR 9 decision path: the engine's
+``auto`` rung resolves through :func:`accelerator_decision`, which
+harvests staged ``serve_mega`` sweep artifacts
+(tools/collect_chip_runs.sh) and flips the accelerator default from
+``fused`` to ``mega`` iff a measured-silicon line shows the mega rung
+beating the fused twin at concurrency 16 by the pre-registered
+margin — artifact lands, default flips, zero code change. CPU hosts
+default to ``mega`` outright: the XLA twin's gather-free win is
+measured locally by the serve_mega bench/smoke gate.
+
+Numerics: subtract-first baseline, ``Precision.HIGHEST`` contractions
+with f32 accumulation, the shared ``safe_l2_normalize`` — the same
+ladder-rung class as every fused formulation (~1e-6 on margins; the
+engine pins it at warmup against the fused program and refuses the
+rung above :func:`mega_gate_tolerance`).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..utils import constants
+from . import device_ingest
+from . import dwt as dwt_xla
+
+#: the engine-rung decision surface (single source for the engine,
+#: the bench, and tests).
+LOWERINGS = ("pallas", "xla")
+
+#: windows per Pallas grid step; divides the 64-multiple capacity grid.
+MEGA_TILE = 8
+
+#: warmup parity gate: max abs deviation of mega margins vs the fused
+#: program's margins on the same synthetic windows before the engine
+#: refuses the rung. Margins are (unit-norm feature row) . (model
+#: weights); the rungs' feature deviation sits in the established
+#: ~1e-7..1e-6 ladder class (docs/performance.md), so 5e-5 is that
+#: envelope with the weight-norm factor of a trained linear model —
+#: three orders tighter than any decision threshold gap observed on
+#: real margins. Override for experiments via EEG_TPU_MEGA_GATE_TOL.
+MEGA_GATE_TOL = 5e-5
+
+#: the pre-registered accelerator flip margin (the PR 9 decision-path
+#: pattern): a staged chip artifact must show the mega rung's
+#: concurrency-16 predictions/sec beating the fused twin's by >= this
+#: ratio before the accelerator ``auto`` rung resolves to mega.
+MEGA_FLIP_RATIO = 1.1
+
+#: sweep-artifact filename stems that carry a serve_mega chip sweep.
+_MEGA_ARTIFACTS = ("serve_mega*.json",)
+
+
+def mega_gate_tolerance() -> float:
+    """The documented mega warmup gate (``MEGA_GATE_TOL``), with the
+    experiment override ``EEG_TPU_MEGA_GATE_TOL`` (logged, never
+    silent, on an unparseable value — the decode-rung gate policy)."""
+    import logging
+    import os
+
+    raw = os.environ.get("EEG_TPU_MEGA_GATE_TOL")
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            logging.getLogger(__name__).warning(
+                "EEG_TPU_MEGA_GATE_TOL=%r is not a float; using the "
+                "default gate %g", raw, MEGA_GATE_TOL,
+            )
+    return MEGA_GATE_TOL
+
+
+def padded_stride(pre: int, post: int) -> int:
+    """The serve stream's per-window stride: the live window (pre +
+    post samples) rounded up to whole 128-lane rows, so every window
+    starts on a lane-tile boundary and the Pallas block fetches are
+    aligned whole rows. The pad columns are zeros the operator's zero
+    rows never read."""
+    win = int(pre) + int(post)
+    return -(-win // 128) * 128
+
+
+def default_lowering() -> str:
+    """``pallas`` where Mosaic compiles (real TPU, or axon with the
+    remote-compile hook), the ``xla`` twin everywhere else — resolved
+    per call, never cached (the 'auto'-resolution staleness class
+    device_ingest documents)."""
+    from . import pallas_support
+
+    return "xla" if pallas_support.default_interpret() else "pallas"
+
+
+def _sweep_results_root() -> str:
+    from . import decode_ingest
+
+    return decode_ingest._sweep_results_root()
+
+
+def accelerator_decision(root: str | None = None) -> dict:
+    """The mega rung's accelerator decision path, as DATA (the PR 9
+    pattern): harvest the best on-chip ``serve_mega`` sweep line and
+    judge its concurrency-16 mega-vs-fused ratio against the
+    pre-registered :data:`MEGA_FLIP_RATIO`. Returns ``{"rung",
+    "mega_preds_per_s", "fused_preds_per_s", "ratio", "source",
+    "threshold_ratio", "reason"}`` — the flip happens when (and only
+    when) measured silicon says the megakernel earns it. With no chip
+    artifact on disk, the decision is ``fused`` with that absence as
+    the recorded reason."""
+    import glob
+    import json
+    import os
+
+    base = root or _sweep_results_root()
+    best = None
+    best_src = None
+    for pattern in _MEGA_ARTIFACTS:
+        for path in glob.glob(os.path.join(base, "*", pattern)):
+            try:
+                if os.path.getsize(path) == 0:
+                    continue
+                with open(path) as f:
+                    rec = json.loads(f.read().strip().splitlines()[-1])
+            except (OSError, ValueError, IndexError):
+                continue
+            if rec.get("platform") not in ("tpu", "axon"):
+                continue
+            sweep = (
+                (rec.get("serve") or {}).get("mega_vs_fused") or {}
+            ).get("sweep") or []
+            for level in sweep:
+                if level.get("concurrency") != 16:
+                    continue
+                mega = (level.get("mega") or {}).get("preds_per_s")
+                fused = (level.get("fused") or {}).get("preds_per_s")
+                if not (
+                    isinstance(mega, (int, float))
+                    and isinstance(fused, (int, float))
+                    and mega > 0 and fused > 0
+                ):
+                    continue
+                if best is None or mega / fused > best[0]:
+                    best, best_src = (mega / fused, mega, fused), path
+    decision = {
+        "threshold_ratio": MEGA_FLIP_RATIO,
+        "source": (
+            os.path.relpath(best_src, os.path.dirname(base))
+            if best_src
+            else None
+        ),
+    }
+    if best is None:
+        decision.update(
+            rung="fused",
+            mega_preds_per_s=None,
+            fused_preds_per_s=None,
+            ratio=None,
+            reason=(
+                "no on-chip serve_mega sweep in the staged artifacts; "
+                "the fused engine program stands"
+            ),
+        )
+        return decision
+    ratio, mega, fused = best
+    decision.update(
+        mega_preds_per_s=mega,
+        fused_preds_per_s=fused,
+        ratio=round(ratio, 4),
+    )
+    if ratio >= MEGA_FLIP_RATIO:
+        decision.update(
+            rung="mega",
+            reason=(
+                f"serve_mega measured {mega:.0f} preds/s on chip at "
+                f"concurrency 16 >= {MEGA_FLIP_RATIO:g}x the fused "
+                f"twin ({fused:.0f}); the megakernel takes the "
+                f"accelerator default"
+            ),
+        )
+    else:
+        decision.update(
+            rung="fused",
+            reason=(
+                f"serve_mega measured {mega:.0f} preds/s on chip at "
+                f"concurrency 16 < {MEGA_FLIP_RATIO:g}x the fused "
+                f"twin ({fused:.0f}); fused stands"
+            ),
+        )
+    return decision
+
+
+@functools.lru_cache(maxsize=None)
+def _cached_accelerator_rung() -> str:
+    return accelerator_decision()["rung"]
+
+
+def default_engine_rung() -> str:
+    """What the serving engine's ``engine_rung="auto"`` resolves to:
+    ``mega`` on CPU hosts (the XLA twin never pays the gather — the
+    win this module exists for, and the warmup gate still guards the
+    numerics), the recorded chip decision on accelerators."""
+    if jax.devices()[0].platform == "cpu":
+        return "mega"
+    return _cached_accelerator_rung()
+
+
+def _make_mega_kernel(n_channels: int, tile_b: int, stride: int,
+                      pre: int, feature_size: int):
+    """The Pallas kernel body: one grid step = ``tile_b`` windows.
+
+    ``a_ref`` is the step's stream block in the rows-of-128 layout
+    (automatically double-buffered by the BlockSpec pipeline); every
+    construct here is from the bank128 kernel's chip-proven set —
+    lane-contiguous reshapes, STATIC lane slices (offsets are
+    ``e * stride`` with ``stride % 128 == 0``), MXU dots with f32
+    accumulation, VPU reductions."""
+    C = n_channels
+    K = feature_size
+
+    def kernel(a_ref, res_ref, e_ref, wm_ref, o_ref, xa_ref):
+        # decode: int16 (or staged f32) block -> scaled f32, once
+        x = (
+            a_ref[:].astype(jnp.float32).reshape(C, tile_b * stride)
+            * res_ref[:]
+        )
+        for e in range(tile_b):
+            seg = x[:, e * stride:(e + 1) * stride]
+            # explicit f32 pre-stimulus baseline (Baseline.java:29-57;
+            # subtract-first — folding it into the operator cancels
+            # catastrophically on real EEG DC offsets)
+            base = jnp.mean(seg[:, :pre], axis=1, keepdims=True)
+            xa_ref[e * C:(e + 1) * C, :] = seg - base
+        y = lax.dot_general(
+            xa_ref[:], e_ref[:], (((1,), (0,)), ((), ())),
+            precision=lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        )  # (tile_b*C, K)
+        feats = dwt_xla.safe_l2_normalize(y.reshape(tile_b, C * K))
+        # margin: one more MXU dot against the weights padded to a
+        # 128-lane matrix (column 0 carries the model; features never
+        # leave VMEM)
+        o_ref[:] = lax.dot_general(
+            feats, wm_ref[:], (((1,), (0,)), ((), ())),
+            precision=lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        )
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=None)
+def _mega_program(
+    wavelet_index: int,
+    epoch_size: int,
+    skip_samples: int,
+    feature_size: int,
+    n_channels: int,
+    pre: int,
+    post: int,
+    capacity: int,
+    lowering: str,
+    interpret: bool,
+    donate: bool,
+    tile_b: int = MEGA_TILE,
+):
+    """The jitted megakernel program, cached per geometry/capacity:
+    ``(stream (C, capacity*Wp), resolutions (C,), weights (C*K,)) ->
+    margins (capacity,) float32`` (pre-intercept, like the fused
+    program's fused matvec). One compiled program serves every batch
+    size 1..capacity — padded windows are zero, each window's compute
+    is row-independent, so a window's margin is BIT-IDENTICAL whatever
+    batch it rides in (pinned in tests/test_serve_mega.py)."""
+    if capacity % tile_b:
+        raise ValueError(
+            f"mega capacity {capacity} must be a multiple of the "
+            f"{tile_b}-window kernel tile (the engine's 64-multiple "
+            f"bucketing satisfies it)"
+        )
+    if pre < 1:
+        raise ValueError(
+            "the megakernel's baseline subtract needs pre >= 1 "
+            "(pre=0 geometries serve through the host-extractor mode)"
+        )
+    C = int(n_channels)
+    K = int(feature_size)
+    Wp = padded_stride(pre, post)
+    live = pre + skip_samples + epoch_size
+    if live > Wp:
+        raise ValueError(
+            f"window geometry (pre {pre} + skip {skip_samples} + "
+            f"epoch {epoch_size} = {live}) exceeds the padded stride "
+            f"{Wp} (= pre+post rounded to 128)"
+        )
+    E_np = device_ingest.ingest_matrix(
+        wavelet_index, epoch_size, skip_samples, feature_size, pre,
+        window_len=Wp, fold_baseline=False,
+    )
+    donate_args = (0,) if donate else ()
+
+    if lowering == "xla":
+        # the compiled twin: the regular layout makes the window cut a
+        # reshape, and only the columns the math consumes are ever
+        # scaled (the _ingest_reshape idiom — pre head for the
+        # baseline, live analysis window for the contraction)
+        W_np = E_np[pre + skip_samples: pre + skip_samples + epoch_size]
+
+        @functools.partial(jax.jit, donate_argnums=donate_args)
+        def run(stream, resolutions, weights):
+            W = jnp.asarray(W_np)
+            rows = stream.reshape(C, capacity, Wp)
+            scale = resolutions[:, None, None]
+            pre_f = rows[:, :, :pre].astype(jnp.float32) * scale
+            live_f = rows[
+                :, :, pre + skip_samples: pre + skip_samples + epoch_size
+            ].astype(jnp.float32) * scale
+            base = jnp.mean(pre_f, axis=2, keepdims=True)
+            z = (live_f - base).reshape(C * capacity, epoch_size)
+            y = lax.dot_general(
+                z, W, (((1,), (0,)), ((), ())),
+                precision=lax.Precision.HIGHEST,
+            )
+            feats = jnp.transpose(
+                y.reshape(C, capacity, K), (1, 0, 2)
+            ).reshape(capacity, C * K)
+            feats = dwt_xla.safe_l2_normalize(feats)
+            return jnp.dot(
+                feats, weights.astype(jnp.float32),
+                precision=lax.Precision.HIGHEST,
+            )
+
+        return run
+
+    if lowering != "pallas":
+        raise ValueError(
+            f"unknown mega lowering {lowering!r}; use one of {LOWERINGS}"
+        )
+
+    rpw = Wp // 128
+    kernel = _make_mega_kernel(C, tile_b, Wp, pre, K)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=0,
+        grid=(capacity // tile_b,),
+        in_specs=[
+            pl.BlockSpec(
+                (C, tile_b * rpw, 128), lambda i: (0, i, 0)
+            ),
+            pl.BlockSpec((C, 1), lambda i: (0, 0)),
+            pl.BlockSpec((Wp, K), lambda i: (0, 0)),
+            pl.BlockSpec((C * K, 128), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((tile_b, 128), lambda i: (i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((tile_b * C, Wp), jnp.float32),
+        ],
+    )
+
+    @functools.partial(jax.jit, donate_argnums=donate_args)
+    def run(stream, resolutions, weights):
+        wm = jnp.zeros((C * K, 128), jnp.float32).at[:, 0].set(
+            weights.astype(jnp.float32)
+        )
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((capacity, 128), jnp.float32),
+            interpret=interpret,
+        )(
+            stream.reshape(C, capacity * rpw, 128),
+            resolutions.astype(jnp.float32)[:, None],
+            jnp.asarray(E_np),
+            wm,
+        )
+        return out[:, 0]
+
+    return run
+
+
+def make_serve_mega_program(
+    wavelet_index: int = 8,
+    epoch_size: int = 512,
+    skip_samples: int = 175,
+    feature_size: int = 16,
+    n_channels: int = constants.USED_CHANNELS,
+    pre: int = constants.PRESTIMULUS_SAMPLES,
+    post: int = constants.POSTSTIMULUS_SAMPLES,
+    capacity: int = 64,
+    lowering: str | None = None,
+    interpret: bool | None = None,
+    donate: bool | None = None,
+):
+    """Build (or fetch cached) the megakernel program for one serving
+    geometry. ``lowering`` None resolves per platform
+    (:func:`default_lowering`); ``interpret`` None follows
+    ``pallas_support.default_interpret`` (tests force
+    ``lowering="pallas", interpret=True`` for hermetic kernel parity);
+    ``donate`` None donates the staged stream on accelerator backends
+    only (the engine's established donation policy — XLA:CPU cannot
+    alias it and would warn per call)."""
+    from . import pallas_support
+
+    if lowering is None:
+        lowering = default_lowering()
+    if interpret is None:
+        interpret = pallas_support.default_interpret()
+    if donate is None:
+        donate = jax.default_backend() != "cpu"
+    return _mega_program(
+        int(wavelet_index), int(epoch_size), int(skip_samples),
+        int(feature_size), int(n_channels), int(pre), int(post),
+        int(capacity), str(lowering), bool(interpret), bool(donate),
+    )
+
+
+def stage_mega_stream(
+    windows, n_channels: int, window_len: int, stride: int,
+    capacity: int, dtype=None,
+) -> np.ndarray:
+    """Lay a micro-batch out at the padded stride: window ``i``'s raw
+    samples at columns ``[i*stride, i*stride + window_len)``, pad
+    columns and unused capacity rows zero. The megakernel's host-side
+    staging counterpart of the engine's fused-stream packing."""
+    if dtype is None:
+        dtype = np.asarray(windows[0]).dtype
+    stream = np.zeros((n_channels, capacity * stride), dtype=dtype)
+    for i, w in enumerate(windows):
+        w = np.asarray(w)
+        if w.shape != (n_channels, window_len):
+            raise ValueError(
+                f"window {i} has shape {w.shape}, expected "
+                f"({n_channels}, {window_len})"
+            )
+        stream[:, i * stride:i * stride + window_len] = w
+    return stream

@@ -285,24 +285,28 @@ class PipelineBuilder:
             query_map.get("fe", ""),
         )
         fused = fused_match is not None
-        # precision=bf16 computes the fused DWT matmul in bfloat16
-        # behind a per-run f32-reference accuracy gate (the decode
-        # rung's feature — ops/decode_ingest.py); EEG_TPU_PRECISION
-        # sets the process default, the query wins per run. f32 is
-        # and stays the default: the ~1e-7 ladder contract is an f32
-        # contract.
+        # precision=bf16 computes the fused DWT matmul in bfloat16;
+        # precision=int8 quantizes the finished f32 feature rows per
+        # subband — both behind a per-run f32-reference accuracy gate
+        # (the decode rung's feature — ops/decode_ingest.py);
+        # EEG_TPU_PRECISION sets the process default, the query wins
+        # per run. f32 is and stays the default: the ~1e-7 ladder
+        # contract is an f32 contract.
+        from ..ops import decode_ingest as _decode_ingest
+
         precision = (
             query_map.get("precision")
             or os.environ.get("EEG_TPU_PRECISION")
             or "f32"
         )
-        if precision not in ("f32", "bf16"):
+        if precision not in _decode_ingest.PRECISIONS:
             raise ValueError(
-                f"precision= must be f32 or bf16, got {precision!r}"
+                f"precision= must be f32, bf16, or int8, got "
+                f"{precision!r}"
             )
-        if precision == "bf16" and not fused:
+        if precision != "f32" and not fused:
             raise ValueError(
-                "precision=bf16 applies to the fused fe= modes "
+                f"precision={precision} applies to the fused fe= modes "
                 "(fe=dwt-<i>-fused[-decode]); host-path features are "
                 "the bit-parity reference and stay f64"
             )
@@ -325,13 +329,13 @@ class PipelineBuilder:
             # accelerators - 21x the element gather on the r4 chip -
             # decode on CPU, where the slice-scan cut beats the
             # element gather ~8.6x); explicit suffixes always win. A
-            # bf16 request resolves to decode — the rung that carries
-            # the bf16 twin.
+            # non-f32 precision request resolves to decode — the rung
+            # that carries the reduced-precision twins.
             suffix = fused_match.group(2)
             if suffix is None:
                 backend = (
                     "decode"
-                    if precision == "bf16"
+                    if precision != "f32"
                     else device_ingest.default_fused_backend()
                 )
             else:
@@ -341,10 +345,10 @@ class PipelineBuilder:
                     "-xla": "xla",
                     "-decode": "decode",
                 }[suffix]
-                if precision == "bf16" and backend != "decode":
+                if precision != "f32" and backend != "decode":
                     raise ValueError(
-                        "precision=bf16 rides the decode rung; it "
-                        f"cannot combine with the explicit "
+                        f"precision={precision} rides the decode rung; "
+                        f"it cannot combine with the explicit "
                         f"fe=...-fused{suffix} backend"
                     )
             # content-addressed feature cache (io/feature_cache.py):
@@ -389,11 +393,11 @@ class PipelineBuilder:
                 ):
                     features, targets = dedup_claim.value
                     landed = "dedup"
-                    if precision == "bf16":
+                    if precision != "f32":
                         # the leader resolved the gate for this exact
                         # prefix; the follower inherits its decision
                         precision_used = dedup_claim.meta.get(
-                            "precision_used", "bf16"
+                            "precision_used", precision
                         )
                         gate_record = {
                             "source": "dedup",
@@ -455,16 +459,16 @@ class PipelineBuilder:
                     if hit is not None:
                         features, targets = hit
                         landed = "cache"
-                        if precision == "bf16":
+                        if precision != "f32":
                             # the entry was gated when it was computed and
                             # stored (keys carry the precision class — a
-                            # bf16 entry can only have passed its gate)
+                            # non-f32 entry can only have passed its gate)
                             gate_record = {"source": "cache"}
                         logger.info(
                             "feature cache hit (%d rows): ingest + "
                             "featurization skipped", len(targets),
                         )
-                if landed is None and precision == "bf16":
+                if landed is None and precision != "f32":
                     if prepared is None:
                         # cache=false still needs the parsed recordings
                         # for the f32 reference check; the ladder below
@@ -476,29 +480,46 @@ class PipelineBuilder:
                                     wavelet_index, precision
                                 )
                             )
-                    # the per-run accuracy gate: bf16 vs f32 feature rows
-                    # on the first recording, judged against the
-                    # documented bf16 tolerance (ops/decode_ingest.
-                    # BF16_GATE_TOL). Above the gate the run computes f32
-                    # — recorded, never silent.
-                    with self._stage("ingest", phase="bf16_gate"):
-                        gate_record = odp.bf16_gate_check(
-                            prepared.recordings, wavelet_index
+                    # the per-run accuracy gate: the rung's feature
+                    # rows vs f32 on the first recording, judged
+                    # against the rung's documented tolerance (ops/
+                    # decode_ingest). Above the gate the run computes
+                    # f32 — recorded, never silent. The content digest
+                    # keys the gate memo (a repeated in-process gating
+                    # of the same session replays the decision instead
+                    # of re-paying the double featurize), and the
+                    # record's gate_seconds separates gate overhead
+                    # from steady-state throughput in the report.
+                    with self._stage(
+                        "ingest", phase=f"{precision}_gate"
+                    ):
+                        gate_record = odp.precision_gate_check(
+                            prepared.recordings, wavelet_index,
+                            precision=precision,
+                            content_key=(
+                                prepared.digests[0][2]
+                                if prepared.digests else None
+                            ),
                         )
-                    events.event("pipeline.bf16_gate", **gate_record)
+                    events.event(
+                        f"pipeline.{precision}_gate", **gate_record
+                    )
                     if not gate_record["ok"]:
                         precision_used = "f32"
-                        obs.metrics.count("pipeline.bf16_gate_disabled")
+                        obs.metrics.count(
+                            f"pipeline.{precision}_gate_disabled"
+                        )
                         logger.warning(
-                            "pipeline.bf16_gate auto-disable: max abs dev "
+                            "pipeline.%s_gate auto-disable: max abs dev "
                             "%.3e > gate %.3e; the run computes f32",
+                            precision,
                             gate_record["max_abs_dev"],
                             gate_record["tolerance"],
                         )
                         # a gated-off run IS an f32 run: re-key from the
                         # same read pass and give the f32 cache a chance
                         # before featurizing. The single-flight slot
-                        # moves to the NEW key — holding the bf16 key
+                        # moves to the NEW key — holding the non-f32 key
                         # while building the f32 entry would let a
                         # concurrent f32 run of the same content race
                         # the rebuild the guard exists to serialize.
@@ -613,7 +634,7 @@ class PipelineBuilder:
                     events.event(
                         "pipeline.rung_landed", requested=backend, landed=landed
                     )
-                    if precision_used == "bf16" and landed not in (
+                    if precision_used != "f32" and landed not in (
                         "decode", "cache", "dedup"
                     ):
                         # the decode rung failed and a lower (f32) rung
@@ -651,7 +672,7 @@ class PipelineBuilder:
                             "used": precision_used,
                             "gate": gate_record,
                         }
-                        if precision == "bf16"
+                        if precision != "f32"
                         else None
                     )
                     if self.telemetry is not None:
@@ -704,16 +725,17 @@ class PipelineBuilder:
                         {"from": backend, "to": "host"}
                     )
                     # the host floor is the f64 bit-parity path; the
-                    # requested bf16 never ran. Set on the builder whether
-                    # or not telemetry is on (the bench-attribution
-                    # contract precision_resolved documents).
+                    # requested non-f32 rung never ran. Set on the
+                    # builder whether or not telemetry is on (the
+                    # bench-attribution contract precision_resolved
+                    # documents).
                     self.precision_resolved = (
                         {
                             "requested": precision,
                             "used": "host-f64",
                             "gate": gate_record,
                         }
-                        if precision == "bf16"
+                        if precision != "f32"
                         else None
                     )
                     if self.telemetry is not None:

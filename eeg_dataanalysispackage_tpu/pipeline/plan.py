@@ -332,10 +332,11 @@ class ExecutionPlan:
                     f"got {overlap_value!r}"
                 )
             if precision is not None and precision not in (
-                "f32", "bf16"
+                "f32", "bf16", "int8"
             ):
                 _raise(
-                    f"precision= must be f32 or bf16, got {precision!r}"
+                    f"precision= must be f32, bf16, or int8, got "
+                    f"{precision!r}"
                 )
             import re
 
@@ -349,18 +350,19 @@ class ExecutionPlan:
                 suffix = fused_match.group(2)
                 if suffix is not None:
                     fused_backend = suffix[1:]
-            if precision == "bf16":
+            if precision in ("bf16", "int8"):
                 if not fused:
                     _raise(
-                        "precision=bf16 applies to the fused fe= modes "
+                        f"precision={precision} applies to the fused "
+                        "fe= modes "
                         "(fe=dwt-<i>-fused[-decode]); host-path "
                         "features are the bit-parity reference and "
                         "stay f64"
                     )
                 if fused_backend is not None and fused_backend != "decode":
                     _raise(
-                        "precision=bf16 rides the decode rung; it "
-                        f"cannot combine with the explicit "
+                        f"precision={precision} rides the decode rung; "
+                        f"it cannot combine with the explicit "
                         f"fe=...-fused-{fused_backend} backend"
                     )
             if fe is None:

@@ -29,6 +29,20 @@ the program on accelerator backends (its int16 bytes are dead after
 the scale), mirroring the batch path's donation discipline; on CPU
 donation is skipped (XLA:CPU cannot alias them and would warn per
 call).
+
+Above the fused program sits the **mega rung** (ops/serve_mega.py):
+the whole request path — int16 decode, window cut, baseline, DWT
+cascade, feature normalize, linear margin — as ONE kernel over the
+regular serving layout, whose only HBM output is the margin vector.
+The engine ladder is mega → fused → host: the mega rung is promoted
+at warmup only after a margin-parity pin against the fused program
+(the ladder-rung tolerance class), a persistently failing mega
+program steps down to fused without dropping the in-flight batch,
+and the PR 6 fused→host latch below it is unchanged. Within one
+capacity bucket a window's mega margin is bit-identical whatever
+batch it rides in (row-independent compute, one compiled program),
+which is what keeps served statistics byte-identical to the batch
+path across the rung change.
 """
 
 from __future__ import annotations
@@ -69,9 +83,14 @@ def _serving_program(
     the featurizer — features never round-trip to the host before the
     decision. Weights ride as a traced argument, so swapping a model
     recompiles nothing. ``precision="bf16"`` runs the featurizer's
-    cascade contraction on bfloat16 epochs (the engine gates it at
-    warmup and falls back to the f32 program above its tolerance).
+    cascade contraction on bfloat16 epochs; ``precision="int8"``
+    computes f32 features and quantizes the finished rows per subband
+    (ops/decode_ingest.quantize_dequantize_int8) before the margin —
+    both gate at warmup and fall back to the f32 program above their
+    documented tolerance.
     """
+    from ..ops import decode_ingest
+
     featurizer = device_ingest.make_device_ingest_featurizer(
         wavelet_index=wavelet_index,
         epoch_size=epoch_size,
@@ -80,18 +99,27 @@ def _serving_program(
         channels=tuple(range(1, n_channels + 1)),
         pre=pre,
         post=post,
-        precision=precision,
+        precision="bf16" if precision == "bf16" else "f32",
     )
+
+    def features_of(raw, resolutions, positions, mask):
+        feats = featurizer(raw, resolutions, positions, mask)
+        if precision == "int8":
+            feats, _ = decode_ingest.quantize_dequantize_int8(
+                feats, feature_size
+            )
+        return feats
+
     if with_margin:
 
         def run(raw, resolutions, positions, mask, weights):
-            feats = featurizer(raw, resolutions, positions, mask)
+            feats = features_of(raw, resolutions, positions, mask)
             return feats, feats @ weights
 
     else:
 
         def run(raw, resolutions, positions, mask):
-            return featurizer(raw, resolutions, positions, mask), None
+            return features_of(raw, resolutions, positions, mask), None
 
     return jax.jit(run, donate_argnums=_donate_argnums())
 
@@ -120,6 +148,7 @@ class ServingEngine:
         capacity: int = 64,
         host_extractor=None,
         precision: str = "f32",
+        engine_rung: str = "auto",
     ):
         """``pre``/``post`` parameterize the window length from the
         workload's config — the engine no longer assumes the P300
@@ -130,16 +159,42 @@ class ServingEngine:
         workload's serving mode, whose subband features have no fused
         twin; requests then take the exact host featurize+predict
         path the batch run takes, which is what makes served
-        statistics identical to it."""
+        statistics identical to it.
+
+        ``engine_rung`` picks the top of the serving ladder:
+        ``"auto"`` resolves per platform through the mega decision
+        path (ops/serve_mega.default_engine_rung — mega on CPU, the
+        recorded chip decision on accelerators), ``"mega"`` forces
+        the megakernel attempt, ``"fused"`` pins the engine to the
+        PR 6 fused program (the bench's same-process twin). Whatever
+        is requested, the mega rung only ever serves after its warmup
+        parity gate passes against the fused program."""
+        from ..ops import decode_ingest
+
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
-        if precision not in ("f32", "bf16"):
+        if precision not in decode_ingest.PRECISIONS:
             raise ValueError(
-                f"unknown precision {precision!r}; use 'f32' or 'bf16'"
+                f"unknown precision {precision!r}; use one of "
+                f"{decode_ingest.PRECISIONS}"
             )
-        #: bf16 request + its warmup gate decision; None for plain f32
-        #: engines (schema-stable in the serve stats block)
+        if engine_rung not in ("auto", "mega", "fused"):
+            raise ValueError(
+                f"unknown engine_rung {engine_rung!r}; use 'auto', "
+                f"'mega', or 'fused'"
+            )
+        #: non-f32 precision request + its warmup gate decision; None
+        #: for plain f32 engines (schema-stable in the serve stats
+        #: block)
         self.precision_record = None
+        #: mega-rung resolution + its warmup parity gate; None when
+        #: the rung was never a candidate (host-extractor mode,
+        #: non-linear classifiers, non-f32 precision, pre=0 geometry)
+        self.mega_record = None
+        self._engine_rung_requested = engine_rung
+        self._mega_program = None
+        self._mega_stride = None
+        self._consecutive_mega_failures = 0
         self._precision = precision
         self.classifier = classifier
         self.n_channels = int(n_channels)
@@ -230,6 +285,48 @@ class ServingEngine:
             )
         if self._rung == "host":
             return self._execute_host(windows, resolutions)
+        if self._rung == "mega":
+            try:
+                result = self._execute_mega(windows, resolutions)
+            except ValueError:
+                # shape/validation errors are the caller's bug, not a
+                # backend failure — never a reason to degrade
+                raise
+            except Exception as e:
+                self._consecutive_mega_failures += 1
+                if self._consecutive_mega_failures < self._degrade_after:
+                    raise
+                # the mega rung broke mid-residency: step down to the
+                # fused program and serve THIS batch through it — the
+                # ladder degrades, the request is never dropped
+                from .. import obs
+                from ..obs import events
+                import logging
+
+                self._rung = "fused"
+                if self.mega_record is not None:
+                    self.mega_record["used"] = "fused"
+                    self.mega_record["error"] = (
+                        f"{type(e).__name__}: {e}"
+                    )
+                obs.metrics.count("serve.mega_degraded_to_fused")
+                events.event(
+                    "serve.mega_degraded", to="fused",
+                    error=f"{type(e).__name__}: {e}",
+                    consecutive_failures=(
+                        self._consecutive_mega_failures
+                    ),
+                )
+                logging.getLogger(__name__).error(
+                    "serve.degrade landed=fused after %d consecutive "
+                    "mega failures (%s: %s); serving continues on the "
+                    "fused program",
+                    self._consecutive_mega_failures,
+                    type(e).__name__, e,
+                )
+            else:
+                self._consecutive_mega_failures = 0
+                return result
         try:
             result = self._execute_fused(windows, resolutions)
         except ValueError:
@@ -301,6 +398,31 @@ class ServingEngine:
         )
         return predictions, None
 
+    def _execute_mega(self, windows, resolutions):
+        """The megakernel rung: the micro-batch laid out at the
+        128-padded window stride and run through ONE program — decode,
+        window cut, baseline, cascade, normalize, margin — whose only
+        output is the margin vector (ops/serve_mega.py). Features
+        never materialize; each window's compute is row-independent,
+        so its margin is bit-identical whatever batch it rides in
+        (one compiled program per bucket, like the fused rung)."""
+        from ..ops import serve_mega
+
+        n = len(windows)
+        stream = serve_mega.stage_mega_stream(
+            windows, self.n_channels, self.window_len,
+            self._mega_stride, self.capacity,
+        )
+        staged = jax.device_put(stream)
+        res = np.asarray(resolutions, dtype=np.float32)
+        margins = np.asarray(
+            self._mega_program(staged, res, self.classifier.weights)
+        )[:n] + self.classifier.intercept
+        predictions = (
+            margins > self.classifier.margin_threshold
+        ).astype(np.float64)
+        return predictions, margins
+
     def _execute_host(self, windows, resolutions):
         """The host floor: scale + baseline-correct on the host and
         run the registry DWT extractor plus the classifier's own
@@ -343,34 +465,39 @@ class ServingEngine:
         batch), so the first real request doesn't pay XLA latency —
         and, as importantly, so a long cold compile can never happen
         inside the batcher where the watchdog would read it as a
-        wedge. A ``precision="bf16"`` engine additionally runs its
-        accuracy gate here (:meth:`_bf16_warmup_gate`) — above the
-        documented tolerance the engine swaps to the f32 program
-        before a single request is served, and the decision lands in
-        the serve stats block. Idempotent."""
+        wedge. A non-f32 engine additionally runs its accuracy gate
+        here (:meth:`_precision_warmup_gate`) — above the documented
+        tolerance the engine swaps to the f32 program before a single
+        request is served — and an f32 fused-linear engine resolves
+        its mega rung (:meth:`_mega_warmup`: the megakernel is built,
+        parity-pinned against the fused program, and only promoted
+        when the pin holds). Every decision lands in the serve stats
+        block. Idempotent."""
         if self._warmed:
             return
         if self._program is None:
             # host-extractor mode: pure numpy featurization — there
-            # is no XLA program to compile ahead of traffic. A bf16
+            # is no XLA program to compile ahead of traffic. A non-f32
             # request still gets a RECORDED decision (the gate
             # policy's "recorded, never silent"): the host extractor
             # computes f64, exactly like the batch pipeline's host
             # floor records used=host-f64.
-            if self._precision == "bf16":
+            if self._precision != "f32":
                 self.precision_record = {
-                    "requested": "bf16",
+                    "requested": self._precision,
                     "used": "host-f64",
                     "gate": None,
                 }
             self._warmed = True
             return
-        if self._precision == "bf16":
-            self._bf16_warmup_gate()
+        if self._precision != "f32":
+            self._precision_warmup_gate()
+        self._mega_warmup()
         # both request dtypes the stage_raw convention produces:
         # int16 (INT_16 recordings) and the float32 fallback — a
         # non-INT_16 session must not pay its cold trace inside the
-        # batcher either
+        # batcher either (and with the mega rung landed, this is also
+        # its compile-before-traffic warmup)
         for dtype in (np.int16, np.float32):
             self.execute(
                 [np.zeros((self.n_channels, self.window_len), dtype)],
@@ -378,20 +505,15 @@ class ServingEngine:
             )
         self._warmed = True
 
-    def _bf16_warmup_gate(self) -> None:
-        """The serving arm of the bf16 accuracy gate: deterministic
-        synthetic int16 windows — full-amplitude signal over a large
-        DC offset, the cancellation-stressing shape the f32-safety
-        analysis worries about — featurized through both programs,
-        judged against ops/decode_ingest's documented tolerance.
-        Above it, the engine serves f32 (recorded, never silent)."""
-        from ..ops import decode_ingest
-
+    def _gate_windows(self):
+        """Deterministic synthetic int16 gate windows — full-amplitude
+        signal over a large DC offset, the cancellation-stressing
+        shape the f32-safety analysis worries about — shared by the
+        precision gate and the mega parity pin (same bytes, so the two
+        gates judge the same stimulus). Returns ``(windows,
+        resolutions)``."""
         rng = np.random.RandomState(0)
         n = min(16, self.capacity)
-        stream = np.zeros(
-            (self.n_channels, self.capacity * self.window_len), np.int16
-        )
         body = (
             rng.randint(-3000, 3000,
                         size=(self.n_channels, n * self.window_len))
@@ -399,31 +521,60 @@ class ServingEngine:
                 : self.n_channels, None
             ]
         ).astype(np.int16)
-        stream[:, : n * self.window_len] = body
+        windows = [
+            body[:, i * self.window_len:(i + 1) * self.window_len]
+            for i in range(n)
+        ]
+        return windows, np.full(self.n_channels, 0.1, np.float32)
+
+    def _fused_gate_margins(self, program, windows, res):
+        """Run the fused-shape program on the gate windows; returns
+        ``(features, margins-or-None)`` numpy rows for the live
+        windows."""
+        n = len(windows)
+        stream = np.zeros(
+            (self.n_channels, self.capacity * self.window_len), np.int16
+        )
+        for i, w in enumerate(windows):
+            stream[:, i * self.window_len:(i + 1) * self.window_len] = w
         mask = np.zeros(self.capacity, bool)
         mask[:n] = True
-        res = np.full(self.n_channels, 0.1, np.float32)
+        # device_put per call: the program may donate its stream
+        feats, margins = program(
+            jax.device_put(stream), res, self._positions, mask,
+            *([self.classifier.weights] if self._fused_linear else []),
+        )
+        return (
+            np.asarray(feats)[:n],
+            None if margins is None else np.asarray(margins)[:n],
+        )
+
+    def _precision_warmup_gate(self) -> None:
+        """The serving arm of the precision accuracy gate (bf16 and
+        int8 share it): the gate windows featurized through both the
+        requested-precision and the f32 programs, judged against
+        ops/decode_ingest's documented per-rung tolerance. Above it,
+        the engine serves f32 (recorded, never silent)."""
+        from ..ops import decode_ingest
+
+        windows, res = self._gate_windows()
         f32_program = _serving_program(
             *self._geometry,
             with_margin=self._fused_linear,
             precision="f32",
         )
-        # device_put per call: both programs may donate their stream
-        bf16_feats, _ = self._program(
-            jax.device_put(stream), res, self._positions, mask,
-            *( [self.classifier.weights] if self._fused_linear else [] ),
+        rung_feats, _ = self._fused_gate_margins(
+            self._program, windows, res
         )
-        f32_feats, _ = f32_program(
-            jax.device_put(stream), res, self._positions, mask,
-            *( [self.classifier.weights] if self._fused_linear else [] ),
+        f32_feats, _ = self._fused_gate_margins(
+            f32_program, windows, res
         )
-        real = mask
-        gate = decode_ingest.bf16_feature_gate(
-            np.asarray(bf16_feats)[real], np.asarray(f32_feats)[real]
+        gate = decode_ingest.feature_precision_gate(
+            rung_feats, f32_feats, precision=self._precision
         )
         self.precision_record = {
-            "requested": "bf16",
-            "used": "bf16" if gate["ok"] else "f32",
+            "requested": self._precision,
+            "used": self._precision if gate["ok"] else "f32",
             "gate": gate,
         }
         if not gate["ok"]:
@@ -432,13 +583,121 @@ class ServingEngine:
             import logging
 
             self._program = f32_program
-            obs.metrics.count("serve.bf16_gate_disabled")
-            events.event("serve.bf16_gate", **gate)
+            obs.metrics.count(
+                f"serve.{self._precision}_gate_disabled"
+            )
+            events.event(f"serve.{self._precision}_gate", **gate)
             logging.getLogger(__name__).warning(
-                "serve.bf16_gate auto-disable: max abs dev %.3e > "
+                "serve.%s_gate auto-disable: max abs dev %.3e > "
                 "gate %.3e; serving f32",
+                self._precision, gate["max_abs_dev"], gate["tolerance"],
+            )
+
+    def _mega_warmup(self) -> None:
+        """Resolve and (when earned) promote the mega rung: build the
+        megakernel program for this geometry/bucket, pin its margins
+        against the fused program on the shared gate windows at the
+        documented tolerance, and only then make it the serving rung.
+        A build/compile failure or a gate miss leaves the engine on
+        the fused program with the evidence recorded — the ladder's
+        contract: stepping down is survival, never silence."""
+        from ..ops import serve_mega
+
+        if (
+            self._host_fe is not None
+            or not self._fused_linear
+            or self._precision != "f32"
+            or self.pre < 1
+        ):
+            return
+        requested = self._engine_rung_requested
+        if requested == "fused":
+            return
+        resolved = (
+            serve_mega.default_engine_rung()
+            if requested == "auto"
+            else requested
+        )
+        record = {
+            "requested": requested,
+            "resolved": resolved,
+            "used": "fused",
+            "lowering": None,
+            "gate": None,
+        }
+        self.mega_record = record
+        if resolved != "mega":
+            # the accelerator decision path said fused stands (no chip
+            # artifact yet, or one that shows mega losing) — recorded,
+            # zero code change when the artifact lands and flips it
+            return
+        from .. import obs
+        from ..obs import events
+        import logging
+
+        try:
+            lowering = serve_mega.default_lowering()
+            record["lowering"] = lowering
+            program = serve_mega.make_serve_mega_program(
+                wavelet_index=self.wavelet_index,
+                epoch_size=self.epoch_size,
+                skip_samples=self.skip_samples,
+                feature_size=self.feature_size,
+                n_channels=self.n_channels,
+                pre=self.pre,
+                post=self.post,
+                capacity=self.capacity,
+                lowering=lowering,
+            )
+            stride = serve_mega.padded_stride(self.pre, self.post)
+            windows, res = self._gate_windows()
+            mega_stream = serve_mega.stage_mega_stream(
+                windows, self.n_channels, self.window_len, stride,
+                self.capacity,
+            )
+            mega_margins = np.asarray(program(
+                jax.device_put(mega_stream), res,
+                self.classifier.weights,
+            ))[: len(windows)]
+            _, fused_margins = self._fused_gate_margins(
+                self._program, windows, res
+            )
+            tol = serve_mega.mega_gate_tolerance()
+            dev = float(
+                np.max(np.abs(mega_margins - fused_margins))
+                if len(windows)
+                else 0.0
+            )
+            gate = {
+                "max_abs_dev": dev,
+                "tolerance": tol,
+                "ok": bool(dev <= tol),
+                "rows_checked": len(windows),
+            }
+        except Exception as e:
+            record["error"] = f"{type(e).__name__}: {e}"
+            obs.metrics.count("serve.mega_unavailable")
+            events.event("serve.mega_unavailable", error=record["error"])
+            logging.getLogger(__name__).warning(
+                "serve.mega unavailable (%s); serving the fused "
+                "program", record["error"],
+            )
+            return
+        record["gate"] = gate
+        if not gate["ok"]:
+            obs.metrics.count("serve.mega_gate_disabled")
+            events.event("serve.mega_gate", **gate)
+            logging.getLogger(__name__).warning(
+                "serve.mega_gate refused the rung: max abs margin dev "
+                "%.3e > gate %.3e; serving the fused program",
                 gate["max_abs_dev"], gate["tolerance"],
             )
+            return
+        self._mega_program = program
+        self._mega_stride = stride
+        self._rung = "mega"
+        record["used"] = "mega"
+        events.event("serve.mega_promoted", lowering=record["lowering"])
 
     @property
     def mode(self) -> str:
@@ -448,8 +707,9 @@ class ServingEngine:
 
     @property
     def rung(self) -> str:
-        """The degradation rung currently serving: ``fused`` or the
-        ``host`` floor."""
+        """The engine rung currently serving: the ``mega`` kernel
+        (ops/serve_mega.py — promoted at warmup behind its parity
+        gate), the ``fused`` program, or the ``host`` floor."""
         return self._rung
 
 

@@ -66,10 +66,32 @@ def _int_knob(query_map, name: str, default: int) -> int:
         )
 
 
+#: process default for the bounded batch-fill window (microseconds);
+#: a per-run ``serve_flush_us=`` query value wins.
+ENV_SERVE_FLUSH_US = "EEG_TPU_SERVE_FLUSH_US"
+
+
+def default_flush_us() -> int:
+    raw = os.environ.get(ENV_SERVE_FLUSH_US, "")
+    if not raw:
+        return 0
+    try:
+        return int(raw)
+    except ValueError:
+        logger.warning(
+            "%s=%r is not an integer; using 0 (no flush window)",
+            ENV_SERVE_FLUSH_US, raw,
+        )
+        return 0
+
+
 def serve_config_from_query(query_map) -> service_mod.ServeConfig:
     return service_mod.ServeConfig(
         max_batch=_int_knob(query_map, "serve_batch", 64),
         queue_depth=_int_knob(query_map, "serve_queue", 256),
+        flush_us=_int_knob(
+            query_map, "serve_flush_us", default_flush_us()
+        ),
         default_deadline_s=_int_knob(
             query_map, "serve_deadline_ms", 2000
         ) / 1000.0,
@@ -105,18 +127,20 @@ def run_serve(query_map, provider_factory, stage):
             "serve=true runs the fused bytes->features->predict "
             "program; fe= must be a dwt-<i>-fused form"
         )
+    from ..ops import decode_ingest
+
     wavelet_index = int(fused_match.group(1))
-    # precision=bf16 serves through the bf16 featurizer behind the
-    # engine's warmup accuracy gate (serve/engine.py); the decision is
-    # recorded in the serve block's ``precision`` entry
+    # precision=bf16/int8 serve through the reduced-precision feature
+    # path behind the engine's warmup accuracy gate (serve/engine.py);
+    # the decision is recorded in the serve block's ``precision`` entry
     precision = (
         query_map.get("precision")
         or os.environ.get("EEG_TPU_PRECISION")
         or "f32"
     )
-    if precision not in ("f32", "bf16"):
+    if precision not in decode_ingest.PRECISIONS:
         raise ValueError(
-            f"precision= must be f32 or bf16, got {precision!r}"
+            f"precision= must be f32, bf16, or int8, got {precision!r}"
         )
 
     classifier = clf_registry.create(query_map["load_clf"])

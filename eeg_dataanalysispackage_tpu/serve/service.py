@@ -52,6 +52,17 @@ class ServeConfig:
     max_batch: int = 64
     queue_depth: int = 256
     coalesce_s: float = 0.002
+    #: bounded coalescing window (microseconds): with a request
+    #: already waiting, the batcher holds dispatch up to this long for
+    #: queued compatible requests to FILL the bucket
+    #: (serve/batcher.py). 0 (the default) is byte-identically
+    #: yesterday's behavior; under closed-loop load at concurrency 16
+    #: the default dispatch races the submitters and mean batch size
+    #: settles near 2-3 — a few hundred microseconds here trades that
+    #: latency for full buckets (serve_flush_us= /
+    #: EEG_TPU_SERVE_FLUSH_US; measured per level in serve_bench's
+    #: mean_batch_size).
+    flush_us: int = 0
     default_deadline_s: float = 2.0
     max_attempts: int = 3
     retry_backoff_s: float = 0.05
@@ -83,6 +94,7 @@ class InferenceService:
         config: Optional[ServeConfig] = None,
         host_extractor=None,
         precision: str = "f32",
+        engine_rung: str = "auto",
     ):
         self.config = config or ServeConfig()
         self.engine = engine_mod.ServingEngine(
@@ -94,12 +106,14 @@ class InferenceService:
             capacity=self.config.max_batch,
             host_extractor=host_extractor,
             precision=precision,
+            engine_rung=engine_rung,
         )
         self.batcher = batcher_mod.MicroBatcher(
             self.engine.execute,
             max_batch=self.config.max_batch,
             queue_depth=self.config.queue_depth,
             coalesce_s=self.config.coalesce_s,
+            flush_us=self.config.flush_us,
             max_attempts=self.config.max_attempts,
             retry_backoff_s=self.config.retry_backoff_s,
             watchdog_s=self.config.watchdog_s,
@@ -318,11 +332,16 @@ class InferenceService:
         return {
             "mode": self.engine.mode,
             "rung": self.engine.rung,
-            # bf16 serving attribution: the warmup gate's decision
+            # non-f32 serving attribution: the warmup gate's decision
             # (requested/used/max_abs_dev); None for f32 engines
             "precision": self.engine.precision_record,
+            # mega-rung attribution: resolution + warmup parity gate
+            # (ops/serve_mega.py); None when the rung was never a
+            # candidate (schema-stable)
+            "mega": self.engine.mega_record,
             "max_batch": self.config.max_batch,
             "queue_depth": self.config.queue_depth,
+            "flush_us": self.config.flush_us,
             "requests": {
                 "submitted": counters.get("submitted", 0),
                 "completed": counters.get("completed", 0),

@@ -1,0 +1,48 @@
+"""Strict-JSON serialization for bench/report artifacts.
+
+``json.dumps`` happily emits bare ``NaN``/``Infinity`` tokens (a
+Python extension, not JSON), and the seizure bench line proved the
+failure mode for real: a degenerate confusion matrix makes
+``precision``/``f1`` NaN, the artifact records them verbatim, and any
+strict consumer downstream (``json.loads`` with default-rejecting
+``parse_constant``, jq, a browser, BigQuery) chokes on the whole line
+(BENCH_pr8.json's seizure members). Every artifact writer routes its
+final ``dumps`` through here instead: non-finite floats serialize as
+``null`` — the honest JSON spelling of "this metric has no value" —
+and ``allow_nan=False`` backstops the sanitizer, so a non-finite
+value can never reach the artifact unsanitized again (pinned in
+tests/test_bench_contract.py).
+
+Deliberately dependency-free (no jax, no numpy): ``bench.py``'s
+parent process never imports jax (its resilience contract), and numpy
+scalars arrive here already rounded to Python floats by the bench
+children.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from typing import Any
+
+
+def sanitize(obj: Any) -> Any:
+    """Deep-copy ``obj`` with every non-finite float replaced by
+    ``None`` (dicts/lists/tuples recursed; tuples become lists, which
+    is what JSON would do to them anyway)."""
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else None
+    if isinstance(obj, dict):
+        return {k: sanitize(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [sanitize(v) for v in obj]
+    return obj
+
+
+def dumps(obj: Any, **kwargs: Any) -> str:
+    """``json.dumps`` over the sanitized payload, with
+    ``allow_nan=False`` so any non-finite value that somehow survives
+    :func:`sanitize` raises here — at the writer, where the bug is —
+    instead of poisoning the artifact for every consumer."""
+    kwargs.setdefault("allow_nan", False)
+    return json.dumps(sanitize(obj), **kwargs)

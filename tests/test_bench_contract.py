@@ -472,6 +472,114 @@ def test_collect_normalizes_the_plateau_block(monkeypatch):
     assert plateau["beats_pr5_plateau_normalized"] is True
 
 
+def test_strict_json_sanitizes_non_finite_floats():
+    """The PR 12 artifact contract: bare NaN/Infinity tokens (a
+    Python json extension, not JSON) must never reach a bench line —
+    BENCH_pr8's seizure precision/f1 members choked every strict
+    consumer. Non-finite floats serialize as null, round-trip under a
+    constant-rejecting parser, and the allow_nan=False backstop
+    raises at the writer if one ever slips the sanitizer."""
+    from eeg_dataanalysispackage_tpu.utils import strict_json
+
+    payload = {
+        "seizure": {
+            "members": [
+                {"precision": float("nan"), "f1": float("inf"),
+                 "recall": 0.5},
+            ],
+            "tuple": (float("-inf"), 1.0),
+        },
+        "ok": 1.25,
+    }
+    clean = strict_json.sanitize(payload)
+    assert clean["seizure"]["members"][0]["precision"] is None
+    assert clean["seizure"]["members"][0]["f1"] is None
+    assert clean["seizure"]["members"][0]["recall"] == 0.5
+    assert clean["seizure"]["tuple"] == [None, 1.0]
+
+    def boom(token):  # pragma: no cover - the assertion
+        raise AssertionError(f"non-strict token {token!r} in output")
+
+    line = strict_json.dumps(payload)
+    parsed = json.loads(line, parse_constant=boom)
+    assert parsed["seizure"]["members"][0]["precision"] is None
+    assert parsed["ok"] == 1.25
+    # ints and strings pass through untouched
+    assert strict_json.sanitize({"n": 3, "s": "NaN"}) == {
+        "n": 3, "s": "NaN"
+    }
+
+
+def test_artifact_writers_route_through_strict_json():
+    """Every artifact-emitting entry point dumps through
+    utils/strict_json — the seizure-NaN class cannot regress by a
+    writer forgetting to sanitize."""
+    import inspect
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    assert "strict_json" in inspect.getsource(bench.main)
+    for tool in ("pipeline_bench.py", "serve_bench.py"):
+        with open(os.path.join(repo, "tools", tool)) as f:
+            src = f.read()
+        assert "strict_json.dumps" in src, tool
+
+
+def test_serve_mega_and_int8_variants_in_both_tables_and_routing():
+    """The megakernel family (PR 12) rides every bench artifact: the
+    serve_mega mega-vs-fused sweep through the serve child, the int8
+    cold twin through the pipeline child."""
+    import inspect
+
+    for table in (bench._VARIANTS_TPU, bench._VARIANTS_CPU):
+        assert "serve_mega" in table
+        assert "pipeline_e2e_int8" in table
+        # the mega family measures the same session as serve_bench —
+        # the pair is directly comparable from one artifact
+        assert table["serve_mega"] == table["serve_bench"]
+        assert table["pipeline_e2e_int8"] == table["pipeline_e2e_bf16"]
+    src = inspect.getsource(bench._run_variant)
+    assert "serve_" in src and "serve_bench.py" in src
+    # serve_mega compiles through Mosaic on chip: slow-compile class
+    assert "serve_mega" in bench._VARIANT_TIMEOUTS
+
+
+def test_collect_propagates_serve_mega_field(monkeypatch):
+    """The serve_mega line's mega_vs_fused sweep + parity + int8-gate
+    block must survive the parent's field whitelist into the
+    published artifact — the mega/fused attribution the acceptance
+    criteria read."""
+    serve_block = {
+        "mega_vs_fused": {
+            "sweep": [{"concurrency": 16,
+                       "mega": {"preds_per_s": 200.0, "p99_ms": 5.0},
+                       "fused": {"preds_per_s": 100.0, "p99_ms": 9.0},
+                       "preds_speedup": 2.0}],
+            "parity": {"bit_identical": True,
+                       "vs_batch_bit_identical": True},
+            "bucket_identical": True,
+            "mega_rung": "mega",
+        },
+        "int8_gate": {"requested": "int8", "used": "int8"},
+    }
+    monkeypatch.setattr(
+        bench, "_VARIANTS_CPU",
+        {"einsum": (8, 2), "serve_mega": (400, 2)},
+    )
+    monkeypatch.setattr(
+        bench,
+        "_run_variant",
+        lambda name, platform, n, iters: {
+            "epochs_per_s": 1.0,
+            "bytes_per_epoch": 5100,
+            "n": n,
+            "wall_s": 1.0,
+            **({"serve": serve_block} if name == "serve_mega" else {}),
+        },
+    )
+    v = bench._collect("cpu_fallback")["variants"]["serve_mega"]
+    assert v["serve"] == serve_block
+
+
 def test_plan_service_variant_in_both_tables_and_routing():
     """The networked plan service (ISSUE 11) rides every bench
     artifact, sized identically on TPU and the CPU fallback, through

@@ -211,7 +211,7 @@ def test_bf16_with_explicit_other_backend_is_an_error(session):
         builder.PipelineBuilder(
             _query(session, "&fe=dwt-8-fused-block&precision=bf16")
         ).execute()
-    with pytest.raises(ValueError, match="f32 or bf16"):
+    with pytest.raises(ValueError, match="f32, bf16, or int8"):
         builder.PipelineBuilder(
             _query(session, "&fe=dwt-8-fused&precision=f16")
         ).execute()

@@ -1,0 +1,708 @@
+"""The serve-path megakernel + the int8 precision rung (PR 12).
+
+The acceptance bar: the mega rung's served predictions are
+bit-identical to the fused twin's (and the batch pipeline's) on the
+same epochs, a window's margin is bit-identical whatever batch it
+rides in (within one capacity bucket), a failing mega program
+degrades to fused without dropping requests, and the int8 rung ships
+gate-protected — a forced-zero-tolerance run auto-disables and pins
+byte-identical-to-f32 statistics, and int8 cache entries can never
+serve an f32/bf16-class request.
+"""
+
+import numpy as np
+import pytest
+
+import _synthetic
+from eeg_dataanalysispackage_tpu.io import feature_cache, provider
+from eeg_dataanalysispackage_tpu.models import registry as clf_registry
+from eeg_dataanalysispackage_tpu.obs import chaos
+from eeg_dataanalysispackage_tpu.ops import (
+    decode_ingest,
+    device_ingest,
+    serve_mega,
+)
+from eeg_dataanalysispackage_tpu.pipeline import builder
+from eeg_dataanalysispackage_tpu.serve import (
+    InferenceService,
+    ServeConfig,
+    engine,
+)
+
+_CONFIG = (
+    "&config_num_iterations=20&config_step_size=1.0"
+    "&config_mini_batch_fraction=1.0"
+)
+
+_C, _PRE, _POST = 3, 100, 750
+_WIN = _PRE + _POST
+
+
+@pytest.fixture(scope="module")
+def session(tmp_path_factory):
+    """One synthetic two-file session + a trained, saved logreg model
+    (the serve test fixture's shape)."""
+    tmp = tmp_path_factory.mktemp("serve_mega_session")
+    for i, (name, guessed) in enumerate(
+        (("synth_00", 2), ("synth_01", 5))
+    ):
+        _synthetic.write_recording(
+            str(tmp), name=name, n_markers=90, guessed=guessed, seed=i
+        )
+    info = str(tmp / "info.txt")
+    with open(info, "w") as f:
+        f.write("synth_00.eeg 2\nsynth_01.eeg 5\n")
+    model = str(tmp / "model")
+    builder.PipelineBuilder(
+        f"info_file={info}&fe=dwt-8-fused&train_clf=logreg"
+        f"&save_clf=true&save_name={model}&cache=false{_CONFIG}"
+    ).execute()
+    classifier = clf_registry.create("logreg")
+    classifier.load(model)
+    return {"info": info, "model": model, "classifier": classifier}
+
+
+def _windows(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return [
+        (
+            rng.randint(-3000, 3000, size=(_C, _WIN))
+            + np.asarray([12000, -9000, 6000])[:, None]
+        ).astype(np.int16)
+        for _ in range(n)
+    ]
+
+
+_RES = np.full(_C, 0.1, np.float32)
+
+
+def _fused_margins(windows, weights, capacity):
+    """Reference margins through the engine's fused featurizer on the
+    engine's own stream layout."""
+    featurizer = device_ingest.make_device_ingest_featurizer(
+        wavelet_index=8, epoch_size=512, skip_samples=175,
+        feature_size=16, channels=(1, 2, 3), pre=_PRE, post=_POST,
+    )
+    stream = np.zeros((_C, capacity * _WIN), np.int16)
+    for i, w in enumerate(windows):
+        stream[:, i * _WIN:(i + 1) * _WIN] = w
+    positions = np.arange(capacity, dtype=np.int32) * _WIN + _PRE
+    mask = np.zeros(capacity, bool)
+    mask[: len(windows)] = True
+    feats = np.asarray(featurizer(stream, _RES, positions, mask))
+    return feats[: len(windows)] @ weights
+
+
+def _mega_margins(windows, weights, capacity, lowering):
+    import jax
+
+    prog = serve_mega.make_serve_mega_program(
+        n_channels=_C, pre=_PRE, post=_POST, capacity=capacity,
+        lowering=lowering, interpret=True, donate=False,
+    )
+    stride = serve_mega.padded_stride(_PRE, _POST)
+    stream = serve_mega.stage_mega_stream(
+        windows, _C, _WIN, stride, capacity
+    )
+    weights = np.asarray(weights, np.float32)
+    return np.asarray(prog(jax.device_put(stream), _RES, weights))
+
+
+# -- kernel parity -------------------------------------------------------
+
+
+@pytest.mark.parametrize("lowering", ["xla", "pallas"])
+@pytest.mark.parametrize("capacity", [64, 128])
+def test_mega_margins_match_fused_across_buckets(lowering, capacity):
+    """Both lowerings' margins sit inside the documented gate against
+    the fused program's, for every capacity bucket — the ladder-rung
+    parity class the warmup gate enforces."""
+    rng = np.random.RandomState(1)
+    weights = rng.randn(_C * 16).astype(np.float32)
+    for n in (1, 3, capacity):
+        windows = _windows(n, seed=n)
+        ref = _fused_margins(windows, weights, capacity)
+        got = _mega_margins(windows, weights, capacity, lowering)
+        dev = float(np.max(np.abs(got[:n] - ref)))
+        assert dev <= serve_mega.MEGA_GATE_TOL, (lowering, capacity, n, dev)
+        # padded capacity rows are exactly zero (zero stream, guarded
+        # normalize) — nothing leaks across requests
+        assert np.all(got[n:] == 0.0)
+
+
+@pytest.mark.parametrize("lowering", ["xla", "pallas"])
+def test_mega_bit_identical_within_bucket(lowering):
+    """One window's margin is BYTE-equal whatever batch it rides in:
+    row-independent compute through one compiled program per bucket —
+    the contract that keeps served statistics byte-identical to the
+    batch path across batch-size jitter."""
+    rng = np.random.RandomState(2)
+    weights = rng.randn(_C * 16).astype(np.float32)
+    windows = _windows(7, seed=7)
+    batch = _mega_margins(windows, weights, 64, lowering)
+    for i, w in enumerate(windows):
+        solo = _mega_margins([w], weights, 64, lowering)
+        assert solo[0] == batch[i]
+
+
+def test_mega_program_rejects_bad_geometry():
+    with pytest.raises(ValueError, match="pre >= 1"):
+        serve_mega.make_serve_mega_program(
+            n_channels=_C, pre=0, post=512, capacity=64,
+            lowering="xla", interpret=True, donate=False,
+        )
+    with pytest.raises(ValueError, match="multiple"):
+        serve_mega._mega_program(
+            8, 512, 175, 16, _C, _PRE, _POST, 60, "xla", True, False
+        )
+    with pytest.raises(ValueError, match="lowering"):
+        serve_mega.make_serve_mega_program(
+            n_channels=_C, pre=_PRE, post=_POST, capacity=64,
+            lowering="cuda", interpret=True, donate=False,
+        )
+
+
+# -- the engine rung ladder ----------------------------------------------
+
+
+def test_engine_promotes_mega_and_matches_fused(session):
+    """On CPU the auto rung resolves to mega (the XLA twin), the
+    warmup parity gate passes, and served predictions are
+    bit-identical to a fused-pinned twin service's."""
+    windows = _windows(12, seed=3)
+    with InferenceService(
+        session["classifier"], engine_rung="auto"
+    ) as mega_svc:
+        assert mega_svc.engine.rung == "mega"
+        record = mega_svc.engine.mega_record
+        assert record["used"] == "mega" and record["gate"]["ok"]
+        mega = [
+            r.prediction
+            for r in mega_svc.predict_all(windows, _RES)
+        ]
+    with InferenceService(
+        session["classifier"], engine_rung="fused"
+    ) as fused_svc:
+        assert fused_svc.engine.rung == "fused"
+        # a fused-pinned engine records no mega candidacy
+        assert fused_svc.engine.mega_record is None
+        fused = [
+            r.prediction
+            for r in fused_svc.predict_all(windows, _RES)
+        ]
+    assert mega == fused
+    # the stats block carries the rung + the mega record
+    block = mega_svc.stats_block()
+    assert block["rung"] == "mega"
+    assert block["mega"]["used"] == "mega"
+
+
+def test_engine_mega_gate_refusal_serves_fused(session, monkeypatch):
+    """A forced-impossible tolerance refuses the rung at warmup: the
+    engine serves the fused program with the gate evidence recorded —
+    never a silent numerics change."""
+    monkeypatch.setenv("EEG_TPU_MEGA_GATE_TOL", "0")
+    svc = InferenceService(session["classifier"], engine_rung="mega")
+    svc.start()
+    try:
+        assert svc.engine.rung == "fused"
+        record = svc.engine.mega_record
+        assert record["used"] == "fused"
+        assert record["gate"] is not None and not record["gate"]["ok"]
+        r = svc.predict_window(_windows(1)[0], _RES)
+        assert r.prediction in (0.0, 1.0)
+    finally:
+        svc.stop(drain=True)
+
+
+def test_engine_mega_failure_degrades_to_fused_without_drop(session):
+    """A mega program that breaks mid-residency steps the engine down
+    to fused and the triggering batch is still answered — the ladder
+    degrades, requests never drop."""
+    eng = engine.ServingEngine(
+        session["classifier"], capacity=8, engine_rung="mega"
+    )
+    eng.warmup()
+    assert eng.rung == "mega"
+
+    calls = {"n": 0}
+
+    def broken(*a, **k):
+        calls["n"] += 1
+        raise RuntimeError("mega backend broke")
+
+    eng._mega_program = broken
+    eng._degrade_after = 1  # first failure latches (deterministic)
+    windows = _windows(3, seed=5)
+    predictions, margins = eng.execute(windows, _RES)
+    assert calls["n"] == 1
+    assert eng.rung == "fused"
+    assert len(predictions) == 3 and margins is not None
+    assert eng.mega_record["used"] == "fused"
+    assert "error" in eng.mega_record
+    # and the fused rung keeps serving
+    predictions2, _ = eng.execute(windows, _RES)
+    np.testing.assert_array_equal(predictions, predictions2)
+
+
+def test_engine_rung_validation(session):
+    with pytest.raises(ValueError, match="engine_rung"):
+        engine.ServingEngine(
+            session["classifier"], engine_rung="turbo"
+        )
+
+
+def test_chaos_soak_clean_on_mega_rung(session):
+    """faults=serve.batch against a mega-rung service: every request
+    resolves and the drain completes (the no-wedge contract holds on
+    the new rung)."""
+    windows = _windows(10, seed=9)
+    svc = InferenceService(
+        session["classifier"], engine_rung="mega",
+        config=ServeConfig(max_attempts=4, retry_backoff_s=0.01),
+    )
+    with chaos.faults("serve.batch:p=0.2;serve.request:p=0.1;seed=3"):
+        svc.start()
+        assert svc.engine.rung == "mega"
+        futures = [
+            svc.submit(windows[i % len(windows)], _RES, deadline_s=10.0,
+                       block_s=10.0)
+            for i in range(40)
+        ]
+        outcomes = []
+        for fut in futures:
+            try:
+                outcomes.append(fut.result(timeout=30.0).prediction)
+            except Exception as e:  # resolution-with-evidence is clean
+                outcomes.append(type(e).__name__)
+        drained = svc.stop(drain=True)
+    assert len(outcomes) == 40
+    assert drained
+    # chaos is absorbed by retries, not by a rung change
+    assert svc.engine.rung == "mega"
+
+
+def test_accelerator_decision_paths(tmp_path):
+    """No artifact -> fused with the absence recorded; a chip sweep
+    beating the pre-registered ratio -> mega; cpu_fallback artifacts
+    are skipped (the PR 9 decision-path pattern)."""
+    import json
+
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    d = serve_mega.accelerator_decision(root=str(empty))
+    assert d["rung"] == "fused" and "no on-chip" in d["reason"]
+
+    def write(root, platform, mega, fused):
+        rd = root / "r9"
+        rd.mkdir(parents=True, exist_ok=True)
+        (rd / "serve_mega.json").write_text(json.dumps({
+            "platform": platform,
+            "serve": {"mega_vs_fused": {"sweep": [
+                {"concurrency": 16,
+                 "mega": {"preds_per_s": mega},
+                 "fused": {"preds_per_s": fused}},
+            ]}},
+        }) + "\n")
+
+    chip = tmp_path / "chip"
+    write(chip, "tpu", 3000.0, 1000.0)
+    d = serve_mega.accelerator_decision(root=str(chip))
+    assert d["rung"] == "mega" and d["ratio"] == 3.0
+
+    slow = tmp_path / "slow"
+    write(slow, "tpu", 1000.0, 990.0)
+    d = serve_mega.accelerator_decision(root=str(slow))
+    assert d["rung"] == "fused"
+
+    cpu = tmp_path / "cpu"
+    write(cpu, "cpu_fallback", 9000.0, 1.0)
+    d = serve_mega.accelerator_decision(root=str(cpu))
+    assert d["rung"] == "fused" and d["source"] is None
+
+
+# -- the int8 precision rung ---------------------------------------------
+
+
+def test_int8_quantize_roundtrip_properties():
+    """Per-(row, channel, subband) scales, the arithmetic error bound,
+    exact zero preservation, and determinism."""
+    rng = np.random.RandomState(0)
+    rows = rng.randn(32, 48).astype(np.float32)
+    rows /= np.linalg.norm(rows, axis=1, keepdims=True)
+    rows[5] = 0.0  # a masked/padded row
+    dq, scales = decode_ingest.quantize_dequantize_int8(rows, 16)
+    dq = np.asarray(dq)
+    scales = np.asarray(scales)
+    n_groups = len(decode_ingest.subband_group_bounds(16))
+    assert scales.shape == (n_groups, 32, 3)
+    # worst-case rounding error is scale/2 per group
+    x = rows.reshape(32, 3, 16)
+    d = np.abs(np.asarray(dq).reshape(32, 3, 16) - x)
+    for gi, (lo, hi) in enumerate(decode_ingest.subband_group_bounds(16)):
+        bound = scales[gi][:, :, None] / 2 + 1e-7
+        assert np.all(d[:, :, lo:hi] <= bound)
+    assert np.all(dq[5] == 0.0)
+    dq2, _ = decode_ingest.quantize_dequantize_int8(rows, 16)
+    np.testing.assert_array_equal(dq, np.asarray(dq2))
+
+
+def test_int8_quantize_is_row_independent():
+    """Scales are per ROW: a row's dequantized features are byte-equal
+    whatever batch it rides in — a served request's int8 margin can
+    never depend on concurrent traffic (the mega rung's within-bucket
+    contract, held by the int8 rung too)."""
+    rng = np.random.RandomState(1)
+    rows = rng.randn(8, 48).astype(np.float32)
+    # a LOUD neighbour: 100x amplitude — under batch-wide scales this
+    # row would stretch everyone's quantization grid
+    rows[3] *= 100.0
+    dq_batch, _ = decode_ingest.quantize_dequantize_int8(rows, 16)
+    dq_batch = np.asarray(dq_batch)
+    for i in range(8):
+        dq_solo, _ = decode_ingest.quantize_dequantize_int8(
+            rows[i:i + 1], 16
+        )
+        np.testing.assert_array_equal(
+            np.asarray(dq_solo)[0], dq_batch[i]
+        )
+
+
+def test_subband_group_bounds():
+    assert decode_ingest.subband_group_bounds(16) == (
+        (0, 1), (1, 2), (2, 4), (4, 8), (8, 16)
+    )
+    assert decode_ingest.subband_group_bounds(1) == ((0, 1),)
+    with pytest.raises(ValueError):
+        decode_ingest.subband_group_bounds(0)
+
+
+def test_int8_decode_featurizer_within_gate():
+    """The int8 decode rung's rows deviate from f32 by less than the
+    documented gate on realistic DC-offset signal, and the gate record
+    says so."""
+    rng = np.random.RandomState(3)
+    S = 16384
+    raw = (
+        rng.randint(-3000, 3000, size=(3, S))
+        + np.asarray([15000, -12000, 9000])[:, None]
+    ).astype(np.int16)
+    res = np.full(3, 0.1, np.float32)
+    positions = (np.arange(24, dtype=np.int64) * 600 + _PRE)
+    cap = 64
+    pos = np.zeros(cap, np.int32)
+    pos[:24] = positions
+    mask = np.zeros(cap, bool)
+    mask[:24] = True
+    f32 = decode_ingest.make_decode_ingest_featurizer(precision="f32")(
+        raw, res, pos, mask
+    )
+    i8 = decode_ingest.make_decode_ingest_featurizer(precision="int8")(
+        raw, res, pos, mask
+    )
+    gate = decode_ingest.feature_precision_gate(
+        np.asarray(i8)[mask], np.asarray(f32)[mask], precision="int8"
+    )
+    assert gate["ok"], gate
+    assert 0.0 < gate["max_abs_dev"] <= decode_ingest.INT8_GATE_TOL
+    assert gate["precision"] == "int8"
+
+
+def test_int8_gate_tolerance_env(monkeypatch):
+    monkeypatch.setenv("EEG_TPU_INT8_GATE_TOL", "0.5")
+    assert decode_ingest.precision_gate_tolerance("int8") == 0.5
+    monkeypatch.setenv("EEG_TPU_INT8_GATE_TOL", "zero")
+    assert (
+        decode_ingest.precision_gate_tolerance("int8")
+        == decode_ingest.INT8_GATE_TOL
+    )
+    with pytest.raises(ValueError, match="no accuracy gate"):
+        decode_ingest.precision_gate_tolerance("f32")
+
+
+def test_int8_extractor_id_and_cache_class_separation(
+    session, tmp_path, monkeypatch
+):
+    """int8 keys its own cache entries: an f32 entry can never serve
+    an int8 request, a bf16 entry can never serve int8, and vice
+    versa — the cross-class miss matrix extended to the new rung."""
+    assert provider.fused_extractor_id(8, "int8") == (
+        provider.fused_extractor_id(8) + ("int8",)
+    )
+    ids = {
+        p: provider.fused_extractor_id(8, p)
+        for p in ("f32", "bf16", "int8")
+    }
+    assert len(set(ids.values())) == 3
+
+    monkeypatch.delenv("EEG_TPU_NO_FEATURE_CACHE", raising=False)
+    monkeypatch.setenv(
+        "EEG_TPU_FEATURE_CACHE_DIR", str(tmp_path / "fc")
+    )
+    odp = provider.OfflineDataProvider([session["info"]])
+    keys = {
+        p: odp.prepare_fused_run(ids[p]).key
+        for p in ("f32", "bf16", "int8")
+    }
+    assert len(set(keys.values())) == 3
+    cache = feature_cache.open_cache()
+    cache.store(
+        keys["f32"], np.ones((4, 48), np.float32), np.zeros(4)
+    )
+    # the f32 entry hits only its own class
+    assert cache.lookup(keys["f32"]) is not None
+    assert cache.lookup(keys["int8"]) is None
+    assert cache.lookup(keys["bf16"]) is None
+    cache.store(
+        keys["int8"], np.full((4, 48), 2.0, np.float32), np.zeros(4)
+    )
+    hit = cache.lookup(keys["int8"])
+    assert hit is not None and float(hit[0][0, 0]) == 2.0
+    # and the int8 entry never leaks into the f32 class
+    f32_hit = cache.lookup(keys["f32"])
+    assert f32_hit is not None and float(f32_hit[0][0, 0]) == 1.0
+
+
+def test_int8_pipeline_auto_disable_pins_f32_statistics(
+    session, monkeypatch
+):
+    """The acceptance pin: a forced-zero-tolerance int8 run
+    auto-disables and produces statistics byte-identical to the f32
+    run; an un-forced run records its gate decision (with the
+    gate_seconds attribution)."""
+    q = (
+        f"info_file={session['info']}&train_clf=logreg&cache=false"
+        f"{_CONFIG}"
+    )
+    pb_f32 = builder.PipelineBuilder(q + "&fe=dwt-8-fused-decode")
+    s_f32 = pb_f32.execute()
+
+    provider.reset_gate_memo()
+    pb_i8 = builder.PipelineBuilder(
+        q + "&fe=dwt-8-fused&precision=int8"
+    )
+    s_i8 = pb_i8.execute()
+    rec = pb_i8.precision_resolved
+    assert rec["requested"] == "int8" and rec["used"] == "int8"
+    assert rec["gate"]["ok"] and rec["gate"]["gate_seconds"] > 0.0
+
+    monkeypatch.setenv("EEG_TPU_INT8_GATE_TOL", "0")
+    pb_off = builder.PipelineBuilder(
+        q + "&fe=dwt-8-fused&precision=int8"
+    )
+    s_off = pb_off.execute()
+    assert pb_off.precision_resolved["used"] == "f32"
+    assert not pb_off.precision_resolved["gate"]["ok"]
+    assert str(s_off) == str(s_f32)
+    del s_i8  # gate-passing statistics live in their own class
+
+
+def test_precision_gate_memo_replays(session):
+    """The hoisted gate: re-gating the same content in one process
+    replays the memoized decision (cached=True, gate_seconds=0) —
+    the double-featurize runs once."""
+    provider.reset_gate_memo()
+    odp = provider.OfflineDataProvider([session["info"]])
+    prepared = odp.prepare_fused_run(
+        provider.fused_extractor_id(8, "bf16")
+    )
+    digest = prepared.digests[0][2]
+    first = odp.precision_gate_check(
+        prepared.recordings, 8, precision="bf16", content_key=digest
+    )
+    assert first["cached"] is False and first["gate_seconds"] > 0.0
+    second = odp.precision_gate_check(
+        prepared.recordings, 8, precision="bf16", content_key=digest
+    )
+    assert second["cached"] is True and second["gate_seconds"] == 0.0
+    assert second["max_abs_dev"] == first["max_abs_dev"]
+    # no content key (or a tolerance change) never replays stale
+    third = odp.precision_gate_check(
+        prepared.recordings, 8, precision="bf16"
+    )
+    assert third["cached"] is False
+
+
+def test_engine_int8_warmup_gate_records(session):
+    """The serving engine's int8 rung gates at warmup like bf16: the
+    decision (and auto-disable under a forced-zero tolerance) lands
+    in the precision record."""
+    svc = InferenceService(
+        session["classifier"], precision="int8",
+        config=ServeConfig(max_batch=16),
+    )
+    svc.start()
+    try:
+        rec = svc.engine.precision_record
+        assert rec["requested"] == "int8"
+        assert rec["used"] == "int8" and rec["gate"]["ok"]
+        r = svc.predict_window(_windows(1)[0], _RES)
+        assert r.prediction in (0.0, 1.0)
+    finally:
+        svc.stop(drain=True)
+
+
+def test_engine_int8_gate_auto_disables(session, monkeypatch):
+    monkeypatch.setenv("EEG_TPU_INT8_GATE_TOL", "0")
+    svc = InferenceService(
+        session["classifier"], precision="int8",
+        config=ServeConfig(max_batch=16),
+    )
+    svc.start()
+    try:
+        rec = svc.engine.precision_record
+        assert rec["used"] == "f32" and not rec["gate"]["ok"]
+        # a gated-off int8 engine never takes the mega rung either
+        # (mega is f32-only by request, not by resolution)
+        assert svc.engine.rung == "fused"
+    finally:
+        svc.stop(drain=True)
+    assert svc.stats_block()["precision"]["used"] == "f32"
+
+
+# -- the serve_flush_us coalescing window --------------------------------
+
+
+class _CountingExecutor:
+    def __init__(self):
+        self.batches = []
+
+    def __call__(self, windows, resolutions):
+        self.batches.append(len(windows))
+        return np.zeros(len(windows)), None
+
+
+def test_flush_window_fills_buckets():
+    """With serve_flush_us set, queued compatible requests fill the
+    bucket before dispatch: 8 near-simultaneous requests land in ONE
+    batch instead of racing the dispatcher."""
+    from eeg_dataanalysispackage_tpu.serve import batcher as batcher_mod
+
+    ex = _CountingExecutor()
+    mb = batcher_mod.MicroBatcher(
+        ex, max_batch=8, queue_depth=32, coalesce_s=0.0,
+        flush_us=300_000,
+    )
+    reqs = [
+        batcher_mod.Request(
+            np.zeros((3, 850), np.int16), _RES,
+            __import__(
+                "eeg_dataanalysispackage_tpu.io.deadline",
+                fromlist=["Deadline"],
+            ).Deadline(10.0),
+        )
+        for _ in range(8)
+    ]
+    for r in reqs:
+        mb.queue.offer(r)
+    mb.start()
+    try:
+        for r in reqs:
+            r.future.result(timeout=5.0)
+    finally:
+        mb.stop()
+    assert ex.batches == [8]
+
+
+def test_flush_window_stops_at_key_boundary():
+    """The fill predicate counts the head-key RUN, not raw queue
+    length: a full queue of mixed keys must not satisfy (or starve)
+    the window — the pop stops at the key boundary anyway, so the
+    wait ends the moment the HEAD run fills."""
+    from eeg_dataanalysispackage_tpu.io.deadline import Deadline
+    from eeg_dataanalysispackage_tpu.serve import batcher as batcher_mod
+
+    ex = _CountingExecutor()
+    mb = batcher_mod.MicroBatcher(
+        ex, max_batch=4, queue_depth=32, coalesce_s=0.0,
+        flush_us=150_000,
+    )
+    res_b = np.full(3, 2.0, np.float32)
+    # 4 head-key requests interleaved with 4 of another key: the head
+    # run fills to max_batch=4, so the first dispatch is the full
+    # head-key bucket and the second the full other-key bucket
+    reqs = []
+    for i in range(8):
+        reqs.append(batcher_mod.Request(
+            np.zeros((3, 850), np.int16),
+            _RES if i % 2 == 0 else res_b,
+            Deadline(10.0),
+        ))
+    # queue them head-key-run-hostile: alternating keys
+    for r in reqs:
+        mb.queue.offer(r)
+    mb.start()
+    try:
+        for r in reqs:
+            r.future.result(timeout=5.0)
+    finally:
+        mb.stop()
+    # alternating keys mean singleton head runs: every dispatch is a
+    # 1-batch, and crucially the flush window did NOT treat the full
+    # mixed queue as a filled bucket nor hang waiting on it
+    assert ex.batches == [1] * 8
+
+
+def test_flush_default_zero_is_todays_behavior():
+    """flush_us=0 (the default) never enters the fill-wait path: the
+    batcher pops whatever is queued the moment it looks — exactly the
+    pre-knob dispatch."""
+    from eeg_dataanalysispackage_tpu.serve import batcher as batcher_mod
+
+    mb = batcher_mod.MicroBatcher(
+        _CountingExecutor(), max_batch=8, queue_depth=32
+    )
+    assert mb.flush_s == 0.0
+    with pytest.raises(ValueError, match="flush_us"):
+        batcher_mod.MicroBatcher(
+            _CountingExecutor(), max_batch=8, queue_depth=32,
+            flush_us=-1,
+        )
+
+
+def test_serve_flush_query_knob(session, monkeypatch):
+    """serve_flush_us= reaches the ServeConfig (query wins over env;
+    env sets the process default), and the serve stats block records
+    it."""
+    from eeg_dataanalysispackage_tpu.serve import (
+        pipeline as serve_pipeline,
+    )
+
+    cfg = serve_pipeline.serve_config_from_query(
+        {"serve_flush_us": "500"}
+    )
+    assert cfg.flush_us == 500
+    monkeypatch.setenv("EEG_TPU_SERVE_FLUSH_US", "250")
+    cfg = serve_pipeline.serve_config_from_query({})
+    assert cfg.flush_us == 250
+    cfg = serve_pipeline.serve_config_from_query(
+        {"serve_flush_us": "0"}
+    )
+    assert cfg.flush_us == 0
+    monkeypatch.setenv("EEG_TPU_SERVE_FLUSH_US", "junk")
+    assert serve_pipeline.default_flush_us() == 0
+
+    svc = InferenceService(
+        session["classifier"], config=ServeConfig(flush_us=200)
+    )
+    svc.start()
+    try:
+        svc.predict_window(_windows(1)[0], _RES)
+    finally:
+        svc.stop(drain=True)
+    assert svc.stats_block()["flush_us"] == 200
+
+
+def test_serve_pipeline_statistics_identical_with_flush(session):
+    """serve=true with a flush window produces byte-identical
+    statistics to the batch load_clf= run — the knob reschedules
+    dispatch, never results."""
+    base = (
+        f"info_file={session['info']}&fe=dwt-8-fused"
+        f"&load_clf=logreg&load_name={session['model']}"
+    )
+    batch = builder.PipelineBuilder(base).execute()
+    served = builder.PipelineBuilder(
+        base + "&serve=true&serve_flush_us=2000"
+    ).execute()
+    assert str(served) == str(batch)

@@ -39,6 +39,9 @@ run train_step    600 python tools/ingest_bench.py train_step 131072 20
 run sharded_ingest 900 python tools/ingest_bench.py sharded_ingest 32768 10
 run population_sharded 900 python tools/pipeline_bench.py population_sharded 800 2
 run population_vmap_twin 900 python tools/pipeline_bench.py population_vmap 800 2
+# the int8 precision rung's gate decision on chip (the precision
+# block + gate_seconds ride the line)
+run pipeline_int8 900 python tools/pipeline_bench.py pipeline_e2e_int8 2000 4
 # outer timeout must exceed bench.py's worst case (probe 420 +
 # variant budget 1800 + one variant overrun 420 = 2640 < 3600) so the
 # caller never SIGTERMs bench mid-variant; 1800 gives all 8 variants
@@ -60,5 +63,12 @@ run pallas_dwt    900 python tools/ingest_bench.py pallas_dwt 131072 20
 # tile group, small compile), then the full-scale 3-group program.
 run pallas_bank_32k 1200 python tools/ingest_bench.py pallas_ingest 32768 10
 run pallas_ingest 1800 python tools/ingest_bench.py pallas_ingest 131072 20
+# the serve megakernel vs its fused twin, back-to-back on chip: this
+# artifact IS the accelerator decision path's input
+# (ops/serve_mega.accelerator_decision — a conc-16 mega/fused ratio
+# >= 1.1 flips the accelerator engine default to mega, zero code
+# change). Mosaic-compiled kernel, so it sits with the Pallas rows —
+# a remote-compile crash here must not cost the core numbers above.
+run serve_mega 1200 python tools/serve_bench.py serve_mega 2000 2
 run pallas_bisect 900 python tools/pallas_compile_bisect.py
 run sublane_probe 900 python tools/pallas_sublane_probe.py

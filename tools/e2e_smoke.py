@@ -88,13 +88,32 @@ performance contract holds:
 - the PR 8 ingest gates: the overlap=true cold twin produces
   byte-identical statistics to the serial cold run (double-buffered
   ingest reschedules work, never changes it); the precision=bf16 twin
-  records its accuracy-gate decision and, when the gate passed, ran
-  inside the documented tolerance; a forced-gate-off bf16 run
-  (EEG_TPU_BF16_GATE_TOL=0) auto-disables AND produces statistics
-  byte-identical to the f32 cold run; and pipeline_e2e_cold beats the
-  BENCH_pr5 plateau in machine-normalized form (cold eps / einsum eps
-  measured now vs the same ratio from the committed artifact — raw
-  eps would gate on this box's 2x load swings, not on the code).
+  records its accuracy-gate decision (now carrying ``gate_seconds`` —
+  the gate's double-featurize cost, attributed instead of hidden in
+  the wall) and, when the gate passed, ran inside the documented
+  tolerance; a forced-gate-off bf16 run (EEG_TPU_BF16_GATE_TOL=0)
+  auto-disables AND produces statistics byte-identical to the f32
+  cold run; and pipeline_e2e_cold beats the BENCH_pr5 plateau in
+  machine-normalized form (cold eps / einsum eps measured now vs the
+  same ratio from the committed artifact — raw eps would gate on this
+  box's 2x load swings, not on the code).
+
+- the serve megakernel (serve_mega, tools/serve_bench.py — the PR 12
+  tentpole): the mega rung actually promoted (warmup parity gate
+  passed against the fused program), served predictions bit-identical
+  to the fused twin AND the batch pipeline, one window's margin
+  bit-identical whatever batch it rides in (the within-bucket pin),
+  and at concurrency 16 the mega rung's predictions/sec and p99 are
+  no worse than the same-process fused twin's (a small scheduling-
+  noise floor applied — the rungs are measured back-to-back seconds
+  apart, but this is still a shared box);
+
+- the int8 precision rung (pipeline_e2e_int8 + the serve_mega line's
+  int8_gate): the gate decision is recorded (used=int8 inside the
+  documented tolerance, or the auto-disable), a forced-gate-off int8
+  run (EEG_TPU_INT8_GATE_TOL=0) auto-disables AND produces statistics
+  byte-identical to the f32 cold run, and the serving engine's int8
+  warmup gate decision rides the serve_mega line.
 
 Usage: python tools/e2e_smoke.py [n_markers_per_file] [n_files]
 
@@ -115,18 +134,20 @@ _SERVE_BENCH = os.path.join(_REPO, "tools", "serve_bench.py")
 
 
 def _run_serve_bench(n_markers: int, n_files: int,
-                     report_dir: str) -> dict:
+                     report_dir: str = None,
+                     variant: str = "serve_bench") -> dict:
     proc = subprocess.run(
         [
-            sys.executable, _SERVE_BENCH, "serve_bench",
-            str(n_markers), str(n_files), f"--report-dir={report_dir}",
+            sys.executable, _SERVE_BENCH, variant,
+            str(n_markers), str(n_files),
+            *([f"--report-dir={report_dir}"] if report_dir else []),
         ],
         capture_output=True,
         text=True,
     )
     if proc.returncode != 0:
         raise RuntimeError(
-            f"serve_bench child failed rc={proc.returncode}\n"
+            f"{variant} child failed rc={proc.returncode}\n"
             f"{proc.stderr[-2000:]}"
         )
     return json.loads(proc.stdout.strip().splitlines()[-1])
@@ -181,6 +202,70 @@ def _check_serve(line: dict, report_dir: str, failures: list) -> None:
         failures.append(
             f"serve: report says the drain did not complete: "
             f"{block.get('drained_cleanly')}"
+        )
+
+
+def _check_serve_mega(line: dict, failures: list) -> None:
+    """The megakernel gate (the PR 12 tentpole's acceptance): the
+    mega rung promoted behind its warmup parity pin, served
+    predictions bit-identical to the fused twin and the batch path,
+    the within-bucket margin bit-identity, and the concurrency-16
+    throughput/latency no worse than the same-process fused twin
+    (0.9x preds / 1.25x p99 noise floors — the pair is measured
+    back-to-back, but the box is shared)."""
+    mv = (line.get("serve") or {}).get("mega_vs_fused") or {}
+    if not mv:
+        failures.append("serve_mega: no mega_vs_fused block on the line")
+        return
+    if mv.get("mega_rung") != "mega":
+        failures.append(
+            f"serve_mega: the mega rung did not serve (rung "
+            f"{mv.get('mega_rung')}; engine record "
+            f"{(line.get('serve') or {}).get('engine')})"
+        )
+    parity = mv.get("parity") or {}
+    if not (
+        parity.get("bit_identical")
+        and parity.get("vs_batch_bit_identical")
+    ):
+        failures.append(
+            f"serve_mega: served predictions drifted (vs fused/batch): "
+            f"{parity}"
+        )
+    if mv.get("bucket_identical") is not True:
+        failures.append(
+            "serve_mega: a window's margin changed with its batch "
+            "(within-bucket bit-identity broken)"
+        )
+    level16 = next(
+        (lv for lv in mv.get("sweep") or []
+         if lv.get("concurrency") == 16),
+        None,
+    )
+    if level16 is None:
+        failures.append("serve_mega: no concurrency-16 sweep level")
+    else:
+        mega, fused = level16.get("mega") or {}, level16.get("fused") or {}
+        if not mega.get("preds_per_s", 0.0) >= 0.9 * fused.get(
+            "preds_per_s", 0.0
+        ):
+            failures.append(
+                f"serve_mega: mega preds/sec worse than the fused twin "
+                f"at concurrency 16: {mega.get('preds_per_s')} vs "
+                f"{fused.get('preds_per_s')}"
+            )
+        if not mega.get("p99_ms", 1e9) <= 1.25 * fused.get(
+            "p99_ms", 0.0
+        ):
+            failures.append(
+                f"serve_mega: mega p99 worse than the fused twin at "
+                f"concurrency 16: {mega.get('p99_ms')}ms vs "
+                f"{fused.get('p99_ms')}ms"
+            )
+    int8_gate = (line.get("serve") or {}).get("int8_gate") or {}
+    if int8_gate.get("requested") != "int8" or "used" not in int8_gate:
+        failures.append(
+            f"serve_mega: no int8 gate decision recorded: {int8_gate}"
         )
 
 
@@ -638,6 +723,19 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
             # the gated-off run must compute (and report) f32
             env_extra={"EEG_TPU_BF16_GATE_TOL": "0"},
         )
+        # the int8 precision rung (PR 12): gate decision recorded, and
+        # the forced-gate-off twin pinned byte-identical to f32
+        int8_line = _run_variant(
+            "pipeline_e2e_int8", n_markers, n_files,
+            data_dir, os.path.join(tmp, "cache_int8"),
+            os.path.join(tmp, "report_int8"),
+        )
+        int8_off_line = _run_variant(
+            "pipeline_e2e_int8", n_markers, n_files,
+            data_dir, os.path.join(tmp, "cache_int8_off"),
+            os.path.join(tmp, "report_int8_off"),
+            env_extra={"EEG_TPU_INT8_GATE_TOL": "0"},
+        )
         # the other four legs as their OWN single-classifier cold
         # runs (fresh process, fresh cache): their reports' compile
         # counters are the honest "5x single" side of the fan-out
@@ -689,6 +787,13 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
             min(n_markers, 400), n_files, serve_report_dir
         )
         _check_serve(serve_line, serve_report_dir, failures)
+        # the serve megakernel (PR 12 tentpole): mega vs fused
+        # back-to-back in one child process, parity + rung + int8-gate
+        # attribution all on one line
+        serve_mega_line = _run_serve_bench(
+            min(n_markers, 400), n_files, variant="serve_mega"
+        )
+        _check_serve_mega(serve_mega_line, failures)
         # the seizure workload: one cost-swept population run over a
         # continuous annotated session (its own data dir — the
         # manifest points at continuous recordings); the swept member
@@ -801,6 +906,13 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
                 f"bf16 statistics outside the envelope: accuracy "
                 f"{bf16_line['accuracy']} vs f32 {cold['accuracy']}"
             )
+    # the gate's double-featurize cost is attributed, not hidden: the
+    # bf16 line's gate record must carry gate_seconds (satellite of
+    # the bf16-slower-than-f32 investigation)
+    if prec.get("used") == "bf16" and "gate_seconds" not in gate:
+        failures.append(
+            f"bf16 gate record carries no gate_seconds: {gate}"
+        )
     # the forced-gate-off run: auto-disable recorded AND the run's
     # statistics byte-identical to the f32 cold run
     prec_off = bf16_off_line.get("precision") or {}
@@ -812,6 +924,32 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
         failures.append(
             "gated-off bf16 run drifted from the f32 cold run: "
             f"{bf16_off_line['report_sha256']} vs "
+            f"{cold['report_sha256']}"
+        )
+    # the int8 rung: a decision recorded, inside the documented
+    # tolerance when it ran, and the forced-gate-off twin byte-
+    # identical to the f32 cold run
+    prec_i8 = int8_line.get("precision") or {}
+    gate_i8 = prec_i8.get("gate") or {}
+    if prec_i8.get("requested") != "int8" or "used" not in prec_i8:
+        failures.append(
+            f"int8 line recorded no gate decision: {prec_i8}"
+        )
+    elif prec_i8["used"] == "int8" and not (
+        gate_i8.get("ok")
+        and gate_i8.get("max_abs_dev", 1.0)
+        <= gate_i8.get("tolerance", 0.0)
+    ):
+        failures.append(f"int8 ran outside its gate: {gate_i8}")
+    prec_i8_off = int8_off_line.get("precision") or {}
+    if prec_i8_off.get("used") != "f32":
+        failures.append(
+            f"forced int8 gate-off did not auto-disable: {prec_i8_off}"
+        )
+    if int8_off_line["report_sha256"] != cold["report_sha256"]:
+        failures.append(
+            "gated-off int8 run drifted from the f32 cold run: "
+            f"{int8_off_line['report_sha256']} vs "
             f"{cold['report_sha256']}"
         )
     plateau_summary = _check_plateau(cold, failures)
@@ -951,6 +1089,27 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
         "bf16_gate_off_identical_to_f32": (
             bf16_off_line["report_sha256"] == cold["report_sha256"]
         ),
+        "int8_precision": int8_line.get("precision"),
+        "int8_gate_off_identical_to_f32": (
+            int8_off_line["report_sha256"] == cold["report_sha256"]
+        ),
+        "serve_mega": {
+            "mega_rung": (
+                (serve_mega_line.get("serve") or {})
+                .get("mega_vs_fused") or {}
+            ).get("mega_rung"),
+            "parity": (
+                (serve_mega_line.get("serve") or {})
+                .get("mega_vs_fused") or {}
+            ).get("parity"),
+            "sweep": (
+                (serve_mega_line.get("serve") or {})
+                .get("mega_vs_fused") or {}
+            ).get("sweep"),
+            "int8_gate": (serve_mega_line.get("serve") or {}).get(
+                "int8_gate"
+            ),
+        },
         "plateau": plateau_summary,
         "scheduler_concurrent_speedup": (
             scheduler_line.get("scheduler") or {}
@@ -986,10 +1145,13 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
 
 
 def main(argv) -> int:
+    sys.path.insert(0, _REPO)
+    from eeg_dataanalysispackage_tpu.utils import strict_json
+
     n_markers = int(argv[0]) if argv else 2000
     n_files = int(argv[1]) if len(argv) > 1 else 4
     summary = run(n_markers, n_files)
-    print(json.dumps(summary))
+    print(strict_json.dumps(summary))
     return 0 if summary["ok"] else 1
 
 

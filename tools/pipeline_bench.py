@@ -27,7 +27,16 @@ Variants:
                         matmul in bfloat16 behind the per-run f32
                         reference gate — the line's ``precision``
                         block records the gate decision (used=bf16
-                        within tolerance, or the auto-disable)
+                        within tolerance, or the auto-disable) plus
+                        the gate's own double-featurize cost
+                        (``gate_seconds`` — so the line separates
+                        gate overhead from steady-state throughput)
+  pipeline_e2e_int8     the cold query with precision=int8: finished
+                        f32 feature rows quantized per subband
+                        (ops/decode_ingest.quantize_dequantize_int8)
+                        behind the same per-run gate machinery — the
+                        rung below bf16, same ``precision`` block
+                        attribution
   population_vmap       a 16-member population (cv=4 folds x a 2x2
                         lr/reg grid, models/population.py) trained
                         as ONE vmapped program — the compile- and
@@ -889,6 +898,7 @@ def main(argv) -> dict:
     if variant not in (
         "pipeline_e2e_cold", "pipeline_e2e_warm", "pipeline_e2e_fanout5",
         "pipeline_e2e_overlap", "pipeline_e2e_bf16",
+        "pipeline_e2e_int8",
         "population_vmap", "population_looped", "population_sharded",
         "seizure_e2e", "scheduler_multi", "scheduler_suicide",
         "plan_service", "populate",
@@ -1071,6 +1081,7 @@ def main(argv) -> dict:
         extra = {
             "pipeline_e2e_overlap": "&overlap=true",
             "pipeline_e2e_bf16": "&precision=bf16",
+            "pipeline_e2e_int8": "&precision=int8",
         }.get(variant, "")
         query = build_query(
             info, fanout=variant == "pipeline_e2e_fanout5",
@@ -1195,9 +1206,15 @@ def main(argv) -> dict:
 
 
 if __name__ == "__main__":
+    from eeg_dataanalysispackage_tpu.utils import strict_json
+
     payload = main(sys.argv[1:])
     if payload:
-        print(json.dumps(payload))
+        # strict JSON at the source: a degenerate confusion matrix
+        # makes the seizure members' precision/f1 NaN, and a bare NaN
+        # token breaks every strict consumer of the artifact —
+        # non-finite floats serialize as null instead
+        print(strict_json.dumps(payload))
     # drop this invocation's own scratch (synthetic session + cache);
     # caller-provided --data-dir/--cache-dir are the caller's to keep
     if _OWNED_TMP:

@@ -2,6 +2,7 @@
 
 Usage: python tools/serve_bench.py serve_bench <n_markers> <n_files>
            [--report-dir D]
+       python tools/serve_bench.py serve_mega <n_markers> <n_files>
 
 One hermetic run proves the serving layer's whole contract and prints
 one JSON line in the driver-facing schema (bench.py whitelists the
@@ -9,8 +10,9 @@ one JSON line in the driver-facing schema (bench.py whitelists the
 
 - **latency/throughput sweep** — a closed-loop load generator drives
   the resident service at swept concurrency (1/4/16 submitters);
-  each level records p50/p99 latency (ms) and sustained
-  predictions/sec, plus any sheds at that level;
+  each level records p50/p99 latency (ms), sustained
+  predictions/sec, the engine rung that served it, and the level's
+  own mean batch size (completed/batches deltas), plus any sheds;
 - **parity pin** — served predictions are compared element-wise
   against the batch pipeline's (``load_features_device`` features +
   ``classifier.predict`` on the same epochs); the line records
@@ -23,6 +25,21 @@ one JSON line in the driver-facing schema (bench.py whitelists the
   (answer, shed, deadline-exceeded, or failure with evidence — no
   hang) and the graceful drain must complete; ``chaos_clean`` records
   the verdict.
+
+The ``serve_mega`` variant is the megakernel family
+(ops/serve_mega.py): TWO resident services over one loaded model —
+one pinned to the PR 6 fused program (``engine_rung="fused"``), one
+on the mega rung — swept back-to-back in ONE process at each
+concurrency level (temporal adjacency: this box's load swings 2-4x
+between runs, so the mega/fused ratio is only meaningful measured
+seconds apart). The line records per-level preds/sec + p99 pairs
+with rung attribution, the mega-vs-fused AND mega-vs-batch
+prediction parity pins, the within-bucket bit-identity pin (one
+window's margin is byte-equal whatever batch it rides in), the
+engine's mega warmup-gate record, and the int8 precision rung's
+warmup gate decision — the driver-facing evidence the accelerator
+decision path (serve_mega.accelerator_decision) harvests from staged
+chip runs.
 
 Everything is fabricated by tests/_synthetic.py; the model is trained
 and saved by the real pipeline in-process before the service loads it.
@@ -80,10 +97,15 @@ def _drive_level(service, windows, resolutions, concurrency: int,
                  n_requests: int, deadline_s: float) -> dict:
     """Closed-loop load at one concurrency level: ``concurrency``
     submitter threads, each waiting for its own previous result
-    before submitting the next (classic closed-loop load)."""
+    before submitting the next (classic closed-loop load). The level
+    dict carries its own batch-formation attribution
+    (``mean_batch_size`` from the completed/batches counter deltas —
+    the ``serve_flush_us`` knob's measurement surface) and the engine
+    rung that served it."""
     from eeg_dataanalysispackage_tpu.io import deadline as deadline_mod
     from eeg_dataanalysispackage_tpu.serve import batcher as batcher_mod
 
+    counters_before, _ = service.batcher.snapshot()
     per_thread = max(1, n_requests // concurrency)
     latencies = []
     # deadline/shed/failed are RESOLVED outcomes (the service answered
@@ -136,6 +158,15 @@ def _drive_level(service, windows, resolutions, concurrency: int,
     from eeg_dataanalysispackage_tpu.serve.service import _percentile
 
     lat = sorted(latencies)
+    counters_after, _ = service.batcher.snapshot()
+    d_completed = (
+        counters_after.get("completed", 0)
+        - counters_before.get("completed", 0)
+    )
+    d_batches = (
+        counters_after.get("batches", 0)
+        - counters_before.get("batches", 0)
+    )
     return {
         "concurrency": concurrency,
         "requests": per_thread * concurrency,
@@ -145,34 +176,34 @@ def _drive_level(service, windows, resolutions, concurrency: int,
         if wall > 0 else 0.0,
         "p50_ms": round(_percentile(lat, 50.0) * 1e3, 3),
         "p99_ms": round(_percentile(lat, 99.0) * 1e3, 3),
+        # batch-formation attribution for THIS level (the global
+        # stats block mixes all levels): how full the buckets ran
+        "mean_batch_size": round(d_completed / max(1, d_batches), 3),
+        "rung": service.engine.rung,
     }
 
 
-def run(n_markers: int, n_files: int, report_dir=None) -> dict:
-    import numpy as np
-
+def _prepare(tmp: str, n_markers: int, n_files: int):
+    """One hermetic session + trained/saved model + the serving
+    windows and the batch-path prediction baseline — the setup both
+    variants share."""
     from eeg_dataanalysispackage_tpu.epochs.extractor import BalanceState
     from eeg_dataanalysispackage_tpu.io import provider
     from eeg_dataanalysispackage_tpu.models import registry as clf_registry
-    from eeg_dataanalysispackage_tpu.obs import chaos
     from eeg_dataanalysispackage_tpu.pipeline import builder
-    from eeg_dataanalysispackage_tpu.serve import (
-        InferenceService, ServeConfig, ShedError, engine,
-    )
+    from eeg_dataanalysispackage_tpu.serve import engine
 
-    t0 = time.perf_counter()
-    tmp = tempfile.mkdtemp(prefix="eeg_tpu_serve_bench_")
     info = _build_session(tmp, n_markers, n_files)
     model = os.path.join(tmp, "model")
 
-    # 1. train + save the model with the real pipeline (load-once is
+    # train + save the model with the real pipeline (load-once is
     # the serving story; training cost is not measured)
     builder.PipelineBuilder(
         f"info_file={info}&fe=dwt-8-fused&train_clf=logreg"
         f"&save_clf=true&save_name={model}&cache=false{_CONFIG}"
     ).execute()
 
-    # 2. the session as serving requests + the batch-path baseline
+    # the session as serving requests + the batch-path baseline
     odp = provider.OfflineDataProvider([info])
     balance = BalanceState()
     windows, resolutions = [], None
@@ -188,6 +219,24 @@ def run(n_markers: int, n_files: int, report_dir=None) -> dict:
         [info]
     ).load_features_device(wavelet_index=8, backend="xla")
     batch_predictions = classifier.predict(batch_features)
+    return info, model, windows, resolutions, classifier, batch_predictions
+
+
+def run(n_markers: int, n_files: int, report_dir=None) -> dict:
+    import numpy as np
+
+    from eeg_dataanalysispackage_tpu.obs import chaos
+    from eeg_dataanalysispackage_tpu.pipeline import builder
+    from eeg_dataanalysispackage_tpu.serve import (
+        InferenceService, ServeConfig, ShedError,
+    )
+
+    t0 = time.perf_counter()
+    tmp = tempfile.mkdtemp(prefix="eeg_tpu_serve_bench_")
+    (
+        info, model, windows, resolutions, classifier,
+        batch_predictions,
+    ) = _prepare(tmp, n_markers, n_files)
 
     service = InferenceService.from_saved("logreg", model)
     service.start()
@@ -327,9 +376,141 @@ def run(n_markers: int, n_files: int, report_dir=None) -> dict:
     }
 
 
+def run_mega(n_markers: int, n_files: int) -> dict:
+    """The serve_mega measurement: mega vs fused back-to-back in one
+    process (see the module docstring)."""
+    import numpy as np
+
+    from eeg_dataanalysispackage_tpu.serve import (
+        InferenceService, ServeConfig,
+    )
+
+    t0 = time.perf_counter()
+    tmp = tempfile.mkdtemp(prefix="eeg_tpu_serve_mega_")
+    (
+        info, model, windows, resolutions, classifier,
+        batch_predictions,
+    ) = _prepare(tmp, n_markers, n_files)
+
+    fused_svc = InferenceService(
+        classifier, config=ServeConfig(), engine_rung="fused"
+    )
+    mega_svc = InferenceService(
+        classifier, config=ServeConfig(), engine_rung="mega"
+    )
+    fused_svc.start()
+    mega_svc.start()
+    try:
+        # 1. parity: the mega rung's served predictions vs the fused
+        # twin's AND vs the batch pipeline's, element-wise
+        mega_served = np.array([
+            r.prediction
+            for r in mega_svc.predict_all(windows, resolutions)
+        ])
+        fused_served = np.array([
+            r.prediction
+            for r in fused_svc.predict_all(windows, resolutions)
+        ])
+        parity = {
+            "n": len(windows),
+            "bit_identical": bool(
+                np.array_equal(mega_served, fused_served)
+            ),
+            "vs_batch_bit_identical": bool(
+                np.array_equal(mega_served, batch_predictions)
+            ),
+            "mismatches": int((mega_served != fused_served).sum()),
+        }
+
+        # 2. within-bucket bit-identity: one window's mega MARGIN is
+        # byte-equal whether it rides alone or in a full batch (one
+        # compiled program per bucket, row-independent compute)
+        probe = windows[: min(8, len(windows))]
+        _, margins_batch = mega_svc.engine.execute(probe, resolutions)
+        solo = [
+            mega_svc.engine.execute([w], resolutions)[1][0]
+            for w in probe
+        ]
+        bucket_identical = bool(
+            np.array_equal(np.asarray(solo), margins_batch)
+        )
+
+        # 3. the back-to-back sweep: fused then mega at EACH level —
+        # temporal adjacency keeps this box's load swings out of the
+        # per-level ratio
+        sweep = []
+        for c in _SWEEP_CONCURRENCY:
+            fused_level = _drive_level(
+                fused_svc, windows, resolutions, c,
+                _REQUESTS_PER_LEVEL, deadline_s=5.0,
+            )
+            mega_level = _drive_level(
+                mega_svc, windows, resolutions, c,
+                _REQUESTS_PER_LEVEL, deadline_s=5.0,
+            )
+            sweep.append({
+                "concurrency": c,
+                "fused": fused_level,
+                "mega": mega_level,
+                "preds_speedup": round(
+                    mega_level["preds_per_s"]
+                    / max(1e-9, fused_level["preds_per_s"]), 3
+                ),
+                "p99_ratio": round(
+                    mega_level["p99_ms"]
+                    / max(1e-9, fused_level["p99_ms"]), 3
+                ),
+            })
+    finally:
+        mega_drained = mega_svc.stop(drain=True)
+        fused_svc.stop(drain=True)
+
+    # 4. the int8 precision rung's warmup gate decision, recorded on
+    # the same line (the smoke gate reads it here)
+    int8_svc = InferenceService(
+        classifier, config=ServeConfig(max_batch=16),
+        precision="int8",
+    )
+    int8_svc.start()
+    int8_svc.predict_window(windows[0], resolutions)
+    int8_svc.stop(drain=True)
+
+    import jax
+
+    from eeg_dataanalysispackage_tpu.ops import serve_mega as mega_mod
+
+    best_mega = max(level["mega"]["preds_per_s"] for level in sweep)
+    return {
+        "variant": "serve_mega",
+        "epochs_per_s": best_mega,
+        "n": len(windows),
+        "iters": _REQUESTS_PER_LEVEL,
+        "bytes_per_epoch": _BYTES_PER_EPOCH,
+        "wall_s": round(time.perf_counter() - t0, 3),
+        "n_markers_per_file": n_markers,
+        "n_files": n_files,
+        "platform": jax.devices()[0].platform,
+        "serve": {
+            "mega_vs_fused": {
+                "sweep": sweep,
+                "parity": parity,
+                "bucket_identical": bucket_identical,
+                "mega_rung": mega_svc.engine.rung,
+                "fused_rung": fused_svc.engine.rung,
+                "drained_cleanly": mega_drained,
+            },
+            "engine": {
+                "mega": mega_svc.engine.mega_record,
+                "accelerator_decision": mega_mod.accelerator_decision(),
+            },
+            "int8_gate": int8_svc.engine.precision_record,
+        },
+    }
+
+
 def main(argv) -> dict:
     variant = argv[0] if argv else "serve_bench"
-    if variant != "serve_bench":
+    if variant not in ("serve_bench", "serve_mega"):
         raise SystemExit(f"unknown variant {variant!r}")
     n_markers = int(argv[1]) if len(argv) > 1 else 400
     n_files = int(argv[2]) if len(argv) > 2 else 2
@@ -339,8 +520,14 @@ def main(argv) -> dict:
             report_dir = arg.split("=", 1)[1]
         else:
             raise SystemExit(f"unknown argument {arg!r}")
+    if variant == "serve_mega":
+        return run_mega(n_markers, n_files)
     return run(n_markers, n_files, report_dir=report_dir)
 
 
 if __name__ == "__main__":
-    print(json.dumps(main(sys.argv[1:])))
+    from eeg_dataanalysispackage_tpu.utils import strict_json
+
+    # strict JSON at the source: a degenerate metric (NaN percentile,
+    # an empty sweep) must serialize as null, never a bare NaN token
+    print(strict_json.dumps(main(sys.argv[1:])))
