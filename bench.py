@@ -67,6 +67,17 @@ train-step variants (tools/ingest_bench.py) with HBM-roofline context:
                   population_vmap from the same run is its
                   same-machine single-device twin and the
                   report_sha256 pair pins sharded==vmap statistics
+  population_multiproc
+                  the identical member set as a 2-PROCESS loopback
+                  pod (tools/pipeline_bench.py: processes=2 over a
+                  gloo coordinator — per-host partitioned ingest
+                  feeding the global member axis over the DCN
+                  stand-in) vs its single-process twin in an equally
+                  fresh process; the line's ``multiproc`` block
+                  carries both members/sec rates, the statistics-
+                  parity sha verdict, the pod mesh block
+                  ({processes, process_id, coordinator, dcn_shape}),
+                  and the degraded-coordinator run's rung + parity
   sharded_ingest  fused int16 ingest with the recording time-sharded
                   over an (up to) 8-device mesh
                   (parallel/sharded_ingest.py ring-halo epoching);
@@ -189,6 +200,9 @@ _VARIANT_TIMEOUTS = {
     # the serve megakernel compiles through Mosaic on accelerators —
     # same fresh-compile class
     "serve_mega": _SLOW_COMPILE_TIMEOUT_S,
+    # four fresh pipeline processes (2 pod workers + twin + degraded
+    # run) in one child — the wall is ~4 population_vmap runs
+    "population_multiproc": _SLOW_COMPILE_TIMEOUT_S,
 }
 # Total wall budget for the variant loop: the headline always runs;
 # a further variant starts only if it could finish inside the budget
@@ -197,7 +211,7 @@ _VARIANT_TIMEOUTS = {
 # patience — on a warm compile cache everything fits easily; on a
 # cold cache the tail variants may be budget-skipped (recorded as
 # such, artifact intact). BENCH_TOTAL_BUDGET overrides.
-_N_VARIANTS = 27  # asserted against the variant tables below
+_N_VARIANTS = 28  # asserted against the variant tables below
 _TOTAL_BUDGET_S = int(
     os.environ.get(
         "BENCH_TOTAL_BUDGET",
@@ -270,6 +284,11 @@ _VARIANTS_TPU = {
     "population_vmap": (800, 2),
     "population_looped": (800, 2),
     "population_sharded": (800, 2),
+    # the 2-process loopback pod vs its single-process twin
+    # (tools/pipeline_bench.py population_multiproc): per-host
+    # partitioned ingest feeding the global member axis, parity sha +
+    # members/sec ratio + the degraded-coordinator run on the line
+    "population_multiproc": (800, 2),
     # time-sharded fused ingest over the mesh (epochs, iters) with
     # its same-machine single-device twin on the line
     "sharded_ingest": (32768, 10),
@@ -317,6 +336,7 @@ _VARIANTS_CPU = {
     "population_vmap": (800, 2),
     "population_looped": (800, 2),
     "population_sharded": (800, 2),
+    "population_multiproc": (800, 2),
     "sharded_ingest": (2048, 2),
     "seizure_e2e": (60000, 2),
     "serve_bench": (400, 2),
@@ -668,6 +688,10 @@ def _collect(platform: str) -> dict:
                 # (rung, shape, per-device member counts, the
                 # sharded_ingest twin ratio) and the member-axis rate
                 "mesh", "members_per_s",
+                # the pod family's block: 2-process parity verdict,
+                # members/sec vs the single-process twin, and the
+                # degraded-coordinator evidence
+                "multiproc",
                 # the multi-tenant executor line: sequential-vs-
                 # concurrent walls, per-plan cache attribution, the
                 # single-flight and crash-recovery pins

@@ -56,11 +56,17 @@ import io
 import logging
 import os
 import threading
+import time
 from typing import Dict, Optional, Tuple
 
 import numpy as np
 
 logger = logging.getLogger(__name__)
+
+#: sentinel from ``FeatureCache._acquire_fs_lock``: a live foreign
+#: process holds the key (non-blocking callers treat it like an
+#: in-process holder)
+_FOREIGN_HELD = object()
 
 #: cache directory override (explicit argument wins over it).
 ENV_DIR = "EEG_TPU_FEATURE_CACHE_DIR"
@@ -73,6 +79,7 @@ _lock = threading.Lock()
 _hits = 0
 _misses = 0
 _corrupt = 0
+_cross_process_waits = 0
 
 # -- single-flight rebuild guard ----------------------------------------
 # Two pipeline runs (two plans under the multi-tenant executor, or two
@@ -82,10 +89,39 @@ _corrupt = 0
 # was wasted. The guard serializes rebuilds per (directory, key): the
 # first builder through proceeds; concurrent builders of the SAME key
 # block until it finishes, then revalidate (lookup again) and hit the
-# entry the leader stored. Process-local by design — cross-process
-# racers still converge through the atomic rename, same as before.
+# entry the leader stored. The in-process half is a condition
+# variable; ACROSS processes (N local pipeline processes cold-starting
+# the same session — the pod harness, N gateways on one box) a
+# best-effort O_EXCL lock file beside the entry extends the same
+# single-flight: foreign-process waiters poll until the lock clears or
+# the entry lands (counted as ``feature_cache.cross_process_waits``),
+# with a deadline-aware timeout fallback — a stale lock (dead holder)
+# or a spent budget stops the wait and the caller proceeds lock-free,
+# because the lock only ever saves redundant work; correctness was
+# always the atomic rename's.
 _flight_cond = threading.Condition(_lock)
 _flights: set = set()
+
+#: max seconds a cross-process waiter polls a foreign lock, and the
+#: age past which a lock file is presumed abandoned (its holder died
+#: without the ``finally`` that unlinks it)
+ENV_LOCK_TIMEOUT = "EEG_TPU_CACHE_LOCK_TIMEOUT_S"
+_DEFAULT_LOCK_TIMEOUT_S = 30.0
+_LOCK_POLL_S = 0.05
+
+
+def lock_timeout() -> float:
+    value = os.environ.get(ENV_LOCK_TIMEOUT)
+    if not value:
+        return _DEFAULT_LOCK_TIMEOUT_S
+    try:
+        return float(value)
+    except ValueError:
+        logger.warning(
+            "unparseable %s=%r; using the default %.0fs",
+            ENV_LOCK_TIMEOUT, value, _DEFAULT_LOCK_TIMEOUT_S,
+        )
+        return _DEFAULT_LOCK_TIMEOUT_S
 
 
 class BuildSlot:
@@ -93,19 +129,33 @@ class BuildSlot:
     True when another builder held the key while we arrived — the
     signal to revalidate before rebuilding. Release exactly once, in
     a ``finally``: a leader that died without releasing would block
-    every waiter forever."""
+    every waiter forever (the in-process half; the on-disk half
+    self-heals via the stale-lock age)."""
 
-    __slots__ = ("_token", "waited", "_released")
+    __slots__ = ("_token", "waited", "_released", "_lock_path")
 
-    def __init__(self, token, waited: bool):
+    def __init__(self, token, waited: bool, lock_path=None):
         self._token = token
         self.waited = waited
         self._released = False
+        self._lock_path = lock_path
 
     def release(self) -> None:
         if self._released:
             return
         self._released = True
+        if self._lock_path is not None:
+            # unlink only OUR lock: a build that outlived the stale
+            # age may have had its lock broken and re-taken by
+            # another process (whose pid is now in the file) —
+            # deleting that live lock would invite a third rebuild
+            try:
+                with open(self._lock_path) as f:
+                    owner = f.read().strip()
+                if owner == str(os.getpid()):
+                    os.unlink(self._lock_path)
+            except OSError:
+                pass
         with _flight_cond:
             _flights.discard(self._token)
             _flight_cond.notify_all()
@@ -135,14 +185,25 @@ def stats() -> Dict[str, int]:
     ``feature_cache`` payload field (schema-stable zeros when the
     cache never ran, like ``plan_cache.stats``)."""
     with _lock:
-        return {"hits": _hits, "misses": _misses, "corrupt": _corrupt}
+        return {
+            "hits": _hits,
+            "misses": _misses,
+            "corrupt": _corrupt,
+            "cross_process_waits": _cross_process_waits,
+        }
 
 
 def reset_stats() -> None:
     """Zero the counters (test/bench isolation)."""
-    global _hits, _misses, _corrupt
+    global _hits, _misses, _corrupt, _cross_process_waits
     with _lock:
-        _hits = _misses = _corrupt = 0
+        _hits = _misses = _corrupt = _cross_process_waits = 0
+
+
+def _count_cross_process_wait() -> None:
+    global _cross_process_waits
+    with _lock:
+        _cross_process_waits += 1
 
 
 def _count(kind: str) -> None:
@@ -242,7 +303,18 @@ class FeatureCache:
         :class:`~.deadline.DeadlineExceededError` instead of blocking
         past its budget (the wait re-checks in short slices — the
         scheduler's deadline contract would otherwise stop at attempt
-        boundaries)."""
+        boundaries).
+
+        Cross-process, the same single-flight extends via a
+        best-effort ``<key>.npz.lock`` O_EXCL file: a foreign
+        process's rebuild makes this builder poll (counted —
+        ``feature_cache.cross_process_waits``) until the lock clears
+        or the entry lands, then revalidate like an in-process
+        waiter. The fallback ladder keeps it strictly best-effort —
+        stale lock (holder died), spent deadline budget, or the
+        ``EEG_TPU_CACHE_LOCK_TIMEOUT_S`` ceiling all stop the wait
+        and proceed lock-free (N redundant builds converge through
+        the atomic rename exactly as before the lock existed)."""
         from .. import obs
         from . import deadline as deadline_mod
 
@@ -259,21 +331,127 @@ class FeatureCache:
             _flights.add(token)
         if waited:
             obs.metrics.count("feature_cache.single_flight_wait")
-        return BuildSlot(token, waited)
+        try:
+            lock_path = self._acquire_fs_lock(key, blocking=True)
+        except BaseException:
+            with _flight_cond:
+                _flights.discard(token)
+                _flight_cond.notify_all()
+            raise
+        return BuildSlot(token, waited, lock_path=lock_path)
 
     def try_begin_build(self, key: str) -> Optional[BuildSlot]:
         """Non-blocking :meth:`begin_build`: the slot, or None when
-        another in-process builder holds the key. For store-only
-        callers whose features are already computed — waiting would
-        buy nothing (the holder is building this same
-        content-addressed entry), and a deadline-bearing plan must
-        not die queued behind a store it can simply skip."""
+        another builder — in this process or, via a fresh foreign
+        lock file, in another — holds the key. For store-only callers
+        whose features are already computed — waiting would buy
+        nothing (the holder is building this same content-addressed
+        entry), and a deadline-bearing plan must not die queued
+        behind a store it can simply skip."""
         token = (self.directory, key)
         with _flight_cond:
             if token in _flights:
                 return None
             _flights.add(token)
-        return BuildSlot(token, False)
+        try:
+            lock_path = self._acquire_fs_lock(key, blocking=False)
+        except BaseException:
+            with _flight_cond:
+                _flights.discard(token)
+                _flight_cond.notify_all()
+            raise
+        if lock_path is _FOREIGN_HELD:
+            with _flight_cond:
+                _flights.discard(token)
+                _flight_cond.notify_all()
+            return None
+        return BuildSlot(token, False, lock_path=lock_path)
+
+    def _lock_path_for(self, key: str) -> str:
+        return self._entry_path(key) + ".lock"
+
+    def _try_create_lock(self, path: str):
+        """O_EXCL create: True = acquired, False = a live foreign
+        holder, None = locking unavailable here (unwritable dir —
+        best-effort means proceed without)."""
+        try:
+            os.makedirs(self.directory, exist_ok=True)
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return False
+        except OSError:
+            return None
+        try:
+            os.write(fd, str(os.getpid()).encode())
+        finally:
+            os.close(fd)
+        return True
+
+    def _lock_is_stale(self, path: str) -> bool:
+        try:
+            age = max(0.0, time.time() - os.path.getmtime(path))
+        except OSError:
+            return False  # gone already — the caller re-checks
+        return age > lock_timeout()
+
+    def _acquire_fs_lock(self, key: str, blocking: bool):
+        """The cross-process half of the single-flight. Returns the
+        owned lock path; None to proceed lock-free (locking
+        unavailable, timeout/deadline fallback, or the entry landed
+        while waiting — the caller's revalidating lookup will hit);
+        or ``_FOREIGN_HELD`` (non-blocking callers only — a live
+        foreign builder holds the key)."""
+        from .. import obs
+        from . import deadline as deadline_mod
+
+        path = self._lock_path_for(key)
+        created = self._try_create_lock(path)
+        if created is True:
+            return path
+        if created is None:
+            return None
+        if not blocking:
+            if self._lock_is_stale(path):
+                # break the dead holder's lock so the NEXT builder is
+                # not fooled too, then take it if we win the race
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                return path if self._try_create_lock(path) is True else None
+            return _FOREIGN_HELD
+        obs.metrics.count("feature_cache.cross_process_waits")
+        _count_cross_process_wait()
+        wait_deadline = time.time() + lock_timeout()
+        while True:
+            if os.path.exists(self._entry_path(key)):
+                # the foreign builder stored the entry: stop waiting —
+                # the caller's revalidating lookup hits it
+                return None
+            if self._lock_is_stale(path):
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+            created = self._try_create_lock(path)
+            if created is True:
+                return path
+            if created is None:
+                return None
+            ambient = deadline_mod.active_deadline()
+            if ambient is not None and ambient.expired:
+                # deadline-aware fallback: a budget-bearing plan must
+                # not die polling a lock that only saves redundant
+                # work — proceed lock-free; its own work (or the
+                # scope's next check) spends the budget honestly
+                return None
+            if time.time() >= wait_deadline:
+                logger.warning(
+                    "feature cache lock %s still held after %.0fs; "
+                    "proceeding without it", path, lock_timeout(),
+                )
+                return None
+            time.sleep(_LOCK_POLL_S)
 
     def store(self, key: str, features: np.ndarray,
               targets: np.ndarray) -> Optional[str]:

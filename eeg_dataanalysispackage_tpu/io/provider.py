@@ -560,6 +560,7 @@ class OfflineDataProvider:
         overlap: Optional[bool] = None,
         mesh=None,
         mesh_axis: Optional[str] = None,
+        pod=None,
     ):
         """TPU fast path: info.txt run -> DWT features without host epochs.
 
@@ -610,6 +611,17 @@ class OfflineDataProvider:
         degenerate case, byte-identical by construction).
         ``mesh_axis`` overrides the ingest axis (default: ``time``
         when the mesh has one, else its last axis).
+
+        ``pod`` (a ``parallel.pod.PodRuntime`` with >= 2 processes)
+        routes the whole run through the pod-partitioned ingest: the
+        global metadata pass plans every recording identically on
+        every process, this process reads + featurizes only its
+        contiguous recording block (same rung program, globally
+        planned positions/mask), and one DCN all-gather assembles the
+        global ``(features, targets)`` — bit-identical rows to the
+        unpartitioned run. ``mesh`` sharding, ``overlap``, and
+        ``recordings`` reuse do not apply on that path (a pod run
+        bypasses the feature cache, so there is no PreparedRun).
 
         Numerics follow the float32 device path (tolerance-level vs
         the bit-exact host path) — use :meth:`load` + a host-backend
@@ -681,54 +693,29 @@ class OfflineDataProvider:
                     # and would warn per call (the decode-rung policy)
                     donate_stream=jax.default_backend() != "cpu",
                 )
-        pallas_featurizer = featurizer = None
-        if backend == "pallas":
-            import os
+        program = self._build_fused_featurizer(
+            backend, wavelet_index, epoch_size, skip_samples,
+            feature_size, precision,
+        )
+        pallas_featurizer = program if backend == "pallas" else None
+        featurizer = None if backend == "pallas" else program
 
-            from ..ops import ingest_pallas
+        if pod is not None and int(pod.num_processes) > 1:
+            # pod-partitioned ingest (parallel/pod.py): this process
+            # reads + featurizes only its contiguous recording block
+            # with the SAME per-recording rung program as above,
+            # driven by the globally planned positions/mask, and the
+            # one DCN collective assembles the global matrix. The
+            # local ladder semantics are the caller's, unchanged — a
+            # rung failure here degrades exactly like a single-host
+            # failure of the same rung.
+            from ..parallel import pod as pod_mod
 
-            pallas_featurizer = ingest_pallas.make_pallas_ingest_featurizer(
-                wavelet_index=wavelet_index,
-                epoch_size=epoch_size,
-                skip_samples=skip_samples,
-                feature_size=feature_size,
-                pre=self._pre,
-                # None -> the library's platform default (bank128 on
-                # compiled Mosaic, exact on interpreter platforms);
-                # EEG_PALLAS_MODE overrides
-                mode=os.environ.get("EEG_PALLAS_MODE") or None,
-            )
-        elif backend == "decode":
-            from ..ops import decode_ingest
-
-            featurizer = decode_ingest.make_decode_ingest_featurizer(
-                wavelet_index=wavelet_index,
-                epoch_size=epoch_size,
-                skip_samples=skip_samples,
-                feature_size=feature_size,
-                pre=self._pre,
-                precision=precision,
-            )
-        elif backend == "block":
-            # the host-planned alignment-classed form: positions here
-            # are always concrete IngestPlan metadata, so the plan
-            # cache applies and the 128-variant bank's MACs don't
-            featurizer = device_ingest.make_classed_block_ingest_featurizer(
-                wavelet_index=wavelet_index,
-                epoch_size=epoch_size,
-                skip_samples=skip_samples,
-                feature_size=feature_size,
-                pre=self._pre,
-            )
-        elif backend == "xla":
-            featurizer = device_ingest.make_device_ingest_featurizer(
-                wavelet_index=wavelet_index,
-                epoch_size=epoch_size,
-                skip_samples=skip_samples,
-                feature_size=feature_size,
-                channels=tuple(range(1, len(self._channel_names) + 1)),
-                pre=self._pre,
-                post=self._post,
+            return pod_mod.pod_features(
+                pod,
+                self,
+                self._planned_entry_featurizer(program, backend),
+                n_feat=len(self._channel_names) * feature_size,
             )
 
         def featurize_sharded(item):
@@ -777,7 +764,13 @@ class OfflineDataProvider:
                 balance=balance,
                 valid_n_samples=n_true,
             )
-            staged = sharded_ingest.stage_recording_int16(
+            # staged through the multi-host entry point: on every
+            # single-process (and host-local) mesh this is exactly the
+            # old device_put, and a fully-addressable pod submesh
+            # takes the same fast path (distributed.stage_local) — so
+            # the ring-halo seam's staging is multi-host-ready without
+            # a second code path
+            staged = sharded_ingest.stage_recording_local_int16(
                 raw, mesh, sharded_axis
             )
             rows = sharded_extract(staged, res, plan)
@@ -889,6 +882,136 @@ class OfflineDataProvider:
                 ]
             ),
             np.concatenate(targets),
+        )
+
+    def _build_fused_featurizer(
+        self,
+        backend: str,
+        wavelet_index: int,
+        epoch_size: int,
+        skip_samples: int,
+        feature_size: int,
+        precision: str,
+    ):
+        """The per-rung fused program, one construction shared by
+        :meth:`load_features_device` and the pod path's
+        :meth:`planned_featurizer` so the two can never drift.
+        Returns the callable; the pallas form takes kept positions,
+        every other form ``(raw, res, positions, mask)``."""
+        from ..ops import device_ingest
+
+        if backend == "pallas":
+            import os
+
+            from ..ops import ingest_pallas
+
+            return ingest_pallas.make_pallas_ingest_featurizer(
+                wavelet_index=wavelet_index,
+                epoch_size=epoch_size,
+                skip_samples=skip_samples,
+                feature_size=feature_size,
+                pre=self._pre,
+                # None -> the library's platform default (bank128 on
+                # compiled Mosaic, exact on interpreter platforms);
+                # EEG_PALLAS_MODE overrides
+                mode=os.environ.get("EEG_PALLAS_MODE") or None,
+            )
+        if backend == "decode":
+            from ..ops import decode_ingest
+
+            return decode_ingest.make_decode_ingest_featurizer(
+                wavelet_index=wavelet_index,
+                epoch_size=epoch_size,
+                skip_samples=skip_samples,
+                feature_size=feature_size,
+                pre=self._pre,
+                precision=precision,
+            )
+        if backend == "block":
+            # the host-planned alignment-classed form: positions here
+            # are always concrete IngestPlan metadata, so the plan
+            # cache applies and the 128-variant bank's MACs don't
+            return device_ingest.make_classed_block_ingest_featurizer(
+                wavelet_index=wavelet_index,
+                epoch_size=epoch_size,
+                skip_samples=skip_samples,
+                feature_size=feature_size,
+                pre=self._pre,
+            )
+        return device_ingest.make_device_ingest_featurizer(
+            wavelet_index=wavelet_index,
+            epoch_size=epoch_size,
+            skip_samples=skip_samples,
+            feature_size=feature_size,
+            channels=tuple(range(1, len(self._channel_names) + 1)),
+            pre=self._pre,
+            post=self._post,
+        )
+
+    def _planned_entry_featurizer(self, program, backend: str):
+        """Closure featurizing ONE pod-plan entry (parallel/pod.py
+        ``PodRecording``) through an already-built rung ``program``:
+        read the owned waveform, stage it, and run the globally
+        planned positions/mask. Returns the recording's kept feature
+        rows."""
+
+        def featurize_entry(entry):
+            from .. import obs
+            from ..ops import device_ingest
+            from . import brainvision as bv
+
+            blob = self._fs.read_bytes(entry.eeg_path)
+            obs.metrics.count("ingest.file_reads", 1)
+            rec = bv._recording_from_blob(
+                entry.header, entry.markers, blob
+            )
+            raw, res, n_samples = device_ingest.stage_raw(
+                rec, entry.channel_indices
+            )
+            if n_samples != entry.n_samples:
+                # the metadata pass sized this recording from its byte
+                # count; a disagreement means the file changed between
+                # the global plan and this read — the plan (and the
+                # balance state behind every later recording) is stale
+                raise ValueError(
+                    f"{entry.rel_path}: {n_samples} samples on read "
+                    f"vs {entry.n_samples} at plan time; recording "
+                    f"changed mid-run"
+                )
+            iplan = entry.plan
+            obs.metrics.count(
+                "ingest.h2d_bytes",
+                int(raw.nbytes) + int(res.nbytes)
+                + int(iplan.positions.nbytes) + int(iplan.mask.nbytes),
+            )
+            if backend == "pallas":
+                return np.asarray(
+                    program(raw, res, iplan.positions[iplan.mask])
+                )
+            return np.asarray(
+                program(raw, res, iplan.positions, iplan.mask)
+            )[iplan.mask]
+
+        return featurize_entry
+
+    def planned_featurizer(
+        self,
+        backend: str = "decode",
+        wavelet_index: int = 8,
+        epoch_size: int = 512,
+        skip_samples: int = 175,
+        feature_size: int = 16,
+        precision: str = "f32",
+    ):
+        """Public pod-path seam: an entry-featurizing closure over a
+        freshly built rung program (tests drive the partitioned
+        ingest through this without a live multi-process runtime)."""
+        return self._planned_entry_featurizer(
+            self._build_fused_featurizer(
+                backend, wavelet_index, epoch_size, skip_samples,
+                feature_size, precision,
+            ),
+            backend,
         )
 
     def precision_gate_check(
@@ -1015,9 +1138,16 @@ class OfflineDataProvider:
         )
 
     def _channel_indices(self, rec: brainvision.Recording) -> List[int]:
+        return self._channel_indices_for_header(rec.header)
+
+    def _channel_indices_for_header(self, header) -> List[int]:
+        """Channel resolution from the header alone (the pod metadata
+        pass resolves every recording's indices without reading its
+        waveform), including the reference's stale-index reuse quirk —
+        which is exactly why this must advance in global load order."""
         indices = []
         for name in self._channel_names:
-            idx = rec.header.channel_index(name)
+            idx = header.channel_index(name)
             if idx is None:
                 idx = self._last_indices[name]
                 logger.warning(

@@ -43,6 +43,13 @@ class LocalFileSystem:
     def exists(self, path: str) -> bool:
         return os.path.exists(self._strip(path))
 
+    def size(self, path: str) -> int:
+        """Byte length by stat — the pod metadata pass sizes every
+        recording's .eeg without reading it (parallel/pod.py); the
+        protocol method is optional (``pod.file_size`` falls back to
+        ``len(read_bytes())`` for filesystems without it)."""
+        return os.path.getsize(self._strip(path))
+
     def read_bytes(self, path: str) -> bytes:
         with open(self._strip(path), "rb") as f:
             return f.read()
@@ -66,6 +73,9 @@ class InMemoryFileSystem:
 
     def exists(self, path: str) -> bool:
         return path in self.files
+
+    def size(self, path: str) -> int:
+        return len(self.files[path])
 
     def read_bytes(self, path: str) -> bytes:
         return self.files[path]

@@ -337,13 +337,21 @@ def _null_stage(_name, **_attrs):
     return contextlib.nullcontext()
 
 
-def member_mesh_axis(mesh) -> str:
-    """The mesh axis the member axis shards over: ``data`` when the
-    mesh has one (the population IS data parallelism over members),
-    else the mesh's first axis — one rule shared by the engine
-    dispatch and the telemetry so they can never disagree."""
-    from ..parallel import mesh as pmesh
+def member_mesh_axis(mesh):
+    """The mesh axis (or axes) the member axis shards over: on a pod's
+    hybrid mesh (a ``hosts`` DCN axis outermost —
+    parallel/distributed.hybrid_mesh) EVERY axis, hosts first, so the
+    members span every device of every host; on a single-host mesh,
+    ``data`` when present (the population IS data parallelism over
+    members), else the mesh's first axis — one rule shared by the
+    engine dispatch and the telemetry so they can never disagree.
+    Returns a string for one axis, a tuple for several."""
+    from ..parallel import distributed, mesh as pmesh
 
+    if distributed.DCN_AXIS in mesh.axis_names:
+        return (distributed.DCN_AXIS,) + tuple(
+            a for a in mesh.axis_names if a != distributed.DCN_AXIS
+        )
     return (
         pmesh.DATA_AXIS
         if pmesh.DATA_AXIS in mesh.axis_names
@@ -455,11 +463,17 @@ def run_population(
 
     mode_used = spec.mode
     mesh_block = None
+    n_shards = 1
     if mesh is not None:
         axis = member_mesh_axis(mesh)
+        axis_names = (axis,) if isinstance(axis, str) else tuple(axis)
+        for a in axis_names:
+            n_shards *= int(mesh.shape[a])
         mesh_block = {
             "rung": "single_device",
-            "axis": axis,
+            # one axis renders as itself; the pod's multi-axis member
+            # spec renders joined ("hosts,data") — JSON-stable either way
+            "axis": axis if isinstance(axis, str) else ",".join(axis),
             "shape": {k: int(v) for k, v in mesh.shape.items()},
             "devices": int(mesh.devices.size),
         }
@@ -494,7 +508,6 @@ def run_population(
                 mode_used = "sharded"
                 from ..parallel import population as engines
 
-                n_shards = int(mesh.shape[mesh_block["axis"]])
                 padded = engines.pad_members(len(members), n_shards)
                 mesh_block.update(
                     rung="mesh",

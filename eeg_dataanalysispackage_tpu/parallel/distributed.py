@@ -28,6 +28,8 @@ from __future__ import annotations
 
 import logging
 import os
+import socket
+import time
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -40,7 +42,47 @@ logger = logging.getLogger(__name__)
 
 DCN_AXIS = "hosts"
 
+#: bootstrap deadline (seconds) for the preflight rendezvous; the
+#: query/env resolution in pipeline/builder passes it through. XLA's
+#: own ``initialization_timeout`` is NOT a substitute — past it the
+#: coordination client calls LOG(FATAL) and aborts the process, which
+#: is exactly what the degradation ladder must never let happen.
+ENV_BOOTSTRAP_TIMEOUT = "EEG_TPU_POD_TIMEOUT_S"
+_DEFAULT_BOOTSTRAP_TIMEOUT_S = 60.0
+
+#: set to "1" to skip the preflight rendezvous (real pods whose
+#: launcher already guarantees the cluster, or whose coordinator
+#: port + 1 is not usable)
+ENV_NO_PREFLIGHT = "EEG_TPU_POD_NO_PREFLIGHT"
+
 _initialized = False
+#: the (coordinator, num_processes, process_id) actually used by the
+#: live bootstrap — what :func:`initialize` returns on repeat calls,
+#: so the run report records what ran, not what was asked for
+_resolution: Optional[Tuple[Optional[str], int, int]] = None
+
+
+class PodBootstrapError(ConnectionError):
+    """The multi-process bootstrap could not assemble the pod within
+    its deadline (coordinator unreachable, a peer host missing).
+    Raised BEFORE ``jax.distributed.initialize`` ever runs — past that
+    point a bootstrap failure is a fatal abort inside XLA's
+    coordination client, not an exception — so the pipeline's
+    degradation ladder can catch it and drop pod -> single host."""
+
+
+def default_bootstrap_timeout() -> float:
+    value = os.environ.get(ENV_BOOTSTRAP_TIMEOUT)
+    if not value:
+        return _DEFAULT_BOOTSTRAP_TIMEOUT_S
+    try:
+        return float(value)
+    except ValueError:
+        logger.warning(
+            "unparseable %s=%r; using the default %.0fs",
+            ENV_BOOTSTRAP_TIMEOUT, value, _DEFAULT_BOOTSTRAP_TIMEOUT_S,
+        )
+        return _DEFAULT_BOOTSTRAP_TIMEOUT_S
 
 
 def _env_int(name: str) -> Optional[int]:
@@ -48,33 +90,190 @@ def _env_int(name: str) -> Optional[int]:
     return int(value) if value is not None else None
 
 
+def resolve_env_knobs(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> Tuple[Optional[str], Optional[int], Optional[int]]:
+    """Fill None knobs from the env twins ``JAX_COORDINATOR_ADDRESS``
+    (or ``JAX_COORDINATOR``) / ``JAX_NUM_PROCESSES`` /
+    ``JAX_PROCESS_ID`` — the ONE query-over-env resolution shared by
+    :func:`initialize` and the pipeline's ``_resolve_pod``, so what
+    the builder records as requested can never diverge from what the
+    bootstrap resolves."""
+    if coordinator_address is None:
+        coordinator_address = (
+            os.environ.get("JAX_COORDINATOR_ADDRESS")
+            or os.environ.get("JAX_COORDINATOR")
+            or None
+        )
+    if num_processes is None:
+        num_processes = _env_int("JAX_NUM_PROCESSES")
+    if process_id is None:
+        process_id = _env_int("JAX_PROCESS_ID")
+    return coordinator_address, num_processes, process_id
+
+
+def _split_host_port(coordinator_address: str) -> Tuple[str, int]:
+    host, sep, port = coordinator_address.rpartition(":")
+    if not sep or not host:
+        raise ValueError(
+            f"coordinator address {coordinator_address!r} is not "
+            f"host:port"
+        )
+    return host, int(port)
+
+
+def _preflight_rendezvous(
+    coordinator_address: str,
+    num_processes: int,
+    process_id: int,
+    timeout_s: float,
+) -> None:
+    """Plain-TCP barrier on ``coordinator port + 1`` before the real
+    bootstrap.
+
+    ``jax.distributed.initialize`` past its timeout does not raise —
+    XLA's coordination client LOG(FATAL)s the process — so the
+    degradable failure modes (coordinator host down, a peer host that
+    never arrives) must be detected *before* it runs. Process 0
+    listens; every other process connects, sends its id, and blocks on
+    the ack process 0 sends only once all peers have arrived. Success
+    means every process is alive and about to enter the real bootstrap
+    together; failure raises :class:`PodBootstrapError` within
+    ``timeout_s`` on every process, so the whole pod degrades to
+    single-host rather than half of it aborting.
+    """
+    host, port = _split_host_port(coordinator_address)
+    deadline = time.monotonic() + timeout_s
+    rendezvous_port = port + 1
+    if process_id == 0:
+        try:
+            server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            server.bind(("", rendezvous_port))
+            server.listen(num_processes)
+        except OSError as e:
+            raise PodBootstrapError(
+                f"preflight rendezvous could not listen on port "
+                f"{rendezvous_port}: {e}"
+            )
+        peers: dict = {}  # peer process id -> live connection
+        stray = []
+        try:
+            while len(peers) < num_processes - 1:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise PodBootstrapError(
+                        f"preflight rendezvous timed out after "
+                        f"{timeout_s:.0f}s with {len(peers)}/"
+                        f"{num_processes - 1} peer processes arrived"
+                    )
+                server.settimeout(min(remaining, 1.0))
+                try:
+                    conn, _addr = server.accept()
+                except socket.timeout:
+                    continue
+                conn.settimeout(max(deadline - time.monotonic(), 0.1))
+                # an arrived PEER sends its decimal process id; a port
+                # scanner / health probe connecting and closing sends
+                # nothing (recv -> b"", not an OSError) and must not
+                # count toward the barrier. Duplicate ids (a peer's
+                # retry after a dropped ack wait) replace the stale
+                # connection rather than double-counting.
+                try:
+                    data = conn.recv(16)
+                except OSError:
+                    conn.close()
+                    continue
+                text = data.decode("ascii", errors="replace").strip()
+                if not text.isdigit() or not (
+                    1 <= int(text) <= num_processes - 1
+                ):
+                    conn.close()
+                    continue
+                pid = int(text)
+                if pid in peers:
+                    stray.append(peers.pop(pid))
+                peers[pid] = conn
+            for conn in peers.values():
+                try:
+                    conn.sendall(b"ok")
+                except OSError:
+                    pass
+        finally:
+            for conn in list(peers.values()) + stray:
+                conn.close()
+            server.close()
+        return
+    # non-coordinator processes: connect-with-retry until the deadline
+    last_error: Optional[Exception] = None
+    while time.monotonic() < deadline:
+        try:
+            with socket.create_connection(
+                (host, rendezvous_port),
+                timeout=max(min(deadline - time.monotonic(), 2.0), 0.1),
+            ) as conn:
+                conn.sendall(str(process_id).encode())
+                conn.settimeout(max(deadline - time.monotonic(), 0.1))
+                # read until the 2-byte ack or EOF — a TCP short read
+                # is not a failed rendezvous
+                ack = b""
+                while len(ack) < 2:
+                    chunk = conn.recv(2 - len(ack))
+                    if not chunk:
+                        break
+                    ack += chunk
+                if ack == b"ok":
+                    return
+                last_error = ConnectionError("rendezvous closed early")
+        except OSError as e:
+            last_error = e
+            time.sleep(min(0.2, max(deadline - time.monotonic(), 0.0)))
+    raise PodBootstrapError(
+        f"coordinator {coordinator_address} unreachable within "
+        f"{timeout_s:.0f}s (preflight): {last_error}"
+    )
+
+
 def initialize(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
-) -> None:
+    timeout_s: Optional[float] = None,
+) -> Tuple[Optional[str], int, int]:
     """Bootstrap the multi-process JAX runtime (idempotent).
 
     Single-process runs (no coordinator configured anywhere) are a
-    no-op. Arguments default to the ``JAX_COORDINATOR_ADDRESS`` /
-    ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID`` env vars, falling back
-    to the cluster auto-detection built into
-    ``jax.distributed.initialize`` (SLURM/OMPI/TPU metadata).
+    no-op. Arguments default to the ``JAX_COORDINATOR_ADDRESS`` (or
+    its ``JAX_COORDINATOR`` twin) / ``JAX_NUM_PROCESSES`` /
+    ``JAX_PROCESS_ID`` env vars, falling back to the cluster
+    auto-detection built into ``jax.distributed.initialize``
+    (SLURM/OMPI/TPU metadata).
+
+    Returns the RESOLVED ``(coordinator, num_processes, process_id)``
+    — what the bootstrap actually used, which is what the run report
+    records (``(None, 1, 0)`` for the single-process no-op; repeat
+    calls return the live bootstrap's resolution unchanged).
+
+    Failure modes that must degrade rather than kill — coordinator
+    unreachable, a peer host missing at bootstrap — raise
+    :class:`PodBootstrapError` within ``timeout_s`` (default
+    ``EEG_TPU_POD_TIMEOUT_S``, 60s) from the plain-TCP preflight
+    rendezvous that runs before ``jax.distributed.initialize`` (which
+    on timeout aborts the process instead of raising).
 
     Must run before anything touches a JAX backend — this function
     deliberately makes no backend-initializing JAX calls on the way to
     the bootstrap.
     """
-    global _initialized
+    global _initialized, _resolution
     if _initialized:
-        return
-    coordinator_address = coordinator_address or os.environ.get(
-        "JAX_COORDINATOR_ADDRESS"
+        assert _resolution is not None
+        return _resolution
+    coordinator_address, num_processes, process_id = resolve_env_knobs(
+        coordinator_address, num_processes, process_id
     )
-    if num_processes is None:
-        num_processes = _env_int("JAX_NUM_PROCESSES")
-    if process_id is None:
-        process_id = _env_int("JAX_PROCESS_ID")
     if coordinator_address is None and num_processes is None:
         if process_id is not None:
             raise ValueError(
@@ -83,7 +282,33 @@ def initialize(
                 "— refusing to run as single-process with a partial "
                 "multi-host setup"
             )
-        return  # single process; nothing to bootstrap
+        _resolution = (None, 1, 0)
+        return _resolution  # single process; nothing to bootstrap
+    if timeout_s is None:
+        timeout_s = default_bootstrap_timeout()
+    if (
+        coordinator_address is not None
+        and num_processes is not None
+        and num_processes > 1
+        and os.environ.get(ENV_NO_PREFLIGHT) != "1"
+    ):
+        if process_id is None:
+            # without a rank the preflight cannot run, and past it
+            # jax's bootstrap failure mode is a process abort — raise
+            # the catchable error here so the ladder degrades (real
+            # cluster launchers that auto-detect ranks don't pass an
+            # explicit coordinator+count pair, or set
+            # EEG_TPU_POD_NO_PREFLIGHT=1)
+            raise PodBootstrapError(
+                "process_id unresolved for an explicit "
+                f"coordinator={coordinator_address} num_processes="
+                f"{num_processes} bootstrap; set process_id=/"
+                "JAX_PROCESS_ID (or EEG_TPU_POD_NO_PREFLIGHT=1 for a "
+                "launcher-managed cluster)"
+            )
+        _preflight_rendezvous(
+            coordinator_address, num_processes, process_id, timeout_s
+        )
     kwargs = {}
     if coordinator_address is not None:
         kwargs["coordinator_address"] = coordinator_address
@@ -91,8 +316,50 @@ def initialize(
         kwargs["num_processes"] = num_processes
     if process_id is not None:
         kwargs["process_id"] = process_id
-    jax.distributed.initialize(**kwargs)
+    # CPU pods (the CI/loopback harness, CPU clusters) need the gloo
+    # collectives implementation, and the flag must be set BEFORE the
+    # backend initializes — but only once a distributed client will
+    # actually exist: with the flag set and no client, CPU backend
+    # creation itself fails, which is why this lives after the
+    # preflight (a degraded bootstrap leaves the config untouched and
+    # the single-host run initializes normally).
+    collectives_set = False
+    prev_collectives = None
+    if (num_processes or 0) > 1 or num_processes is None:
+        try:
+            prev_collectives = jax.config.read(
+                "jax_cpu_collectives_implementation"
+            )
+            if prev_collectives in (None, "none"):
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo"
+                )
+                collectives_set = True
+        except Exception:  # pragma: no cover - config surface drift
+            pass
+    try:
+        try:
+            jax.distributed.initialize(
+                initialization_timeout=max(int(timeout_s), 1), **kwargs
+            )
+        except TypeError:  # pragma: no cover - older jax without kwarg
+            jax.distributed.initialize(**kwargs)
+    except Exception:
+        if collectives_set:
+            try:
+                jax.config.update(
+                    "jax_cpu_collectives_implementation",
+                    prev_collectives,
+                )
+            except Exception:  # pragma: no cover
+                pass
+        raise
     _initialized = True
+    _resolution = (
+        coordinator_address,
+        int(jax.process_count()),
+        int(jax.process_index()),
+    )
     logger.info(
         "distributed runtime up: process %d/%d, %d local / %d global devices",
         jax.process_index(),
@@ -100,6 +367,31 @@ def initialize(
         jax.local_device_count(),
         jax.device_count(),
     )
+    return _resolution
+
+
+def shutdown() -> None:
+    """Tear down the multi-process runtime and reset the bootstrap
+    latch, so :func:`initialize` can run again in this process.
+
+    The latch used to be one-way: a test harness (or a resident
+    gateway restarted in-process) that shut the cluster down could
+    never re-bootstrap, because ``_initialized`` stayed True forever.
+    Safe to call when nothing was ever initialized (no-op)."""
+    global _initialized, _resolution
+    if _initialized:
+        try:
+            jax.distributed.shutdown()
+        except Exception as e:  # pragma: no cover - defensive teardown
+            logger.warning("jax.distributed.shutdown failed: %s", e)
+    _initialized = False
+    _resolution = None
+
+
+def is_initialized() -> bool:
+    """True while a multi-process bootstrap from :func:`initialize`
+    is live (the latch :func:`shutdown` resets)."""
+    return _initialized
 
 
 def hybrid_mesh(
@@ -163,13 +455,15 @@ def batch_spec(mesh: Mesh, dcn_axis: str = DCN_AXIS) -> P:
 def stage_local(sharding: NamedSharding, local: np.ndarray) -> jax.Array:
     """Per-process host data -> one global array under ``sharding``.
 
-    The single dispatch point for multi-host staging: single-process
-    runs are a plain ``device_put`` (no intermediate default-device
-    commit), multi-process runs assemble the global array from each
-    process's addressable shards.
+    The single dispatch point for multi-host staging: fully
+    addressable shardings — every single-process run, and host-LOCAL
+    meshes on a pod (each host's ICI submesh doing per-host work) —
+    are a plain ``device_put`` (no intermediate default-device
+    commit); shardings spanning other processes' devices assemble the
+    global array from each process's addressable shards.
     """
     local = np.asarray(local)
-    if jax.process_count() == 1:
+    if jax.process_count() == 1 or sharding.is_fully_addressable:
         return jax.device_put(local, sharding)
     return jax.make_array_from_process_local_data(sharding, local)
 

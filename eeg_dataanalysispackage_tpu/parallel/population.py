@@ -218,24 +218,47 @@ def pad_members(n_members: int, n_shards: int) -> int:
     return -(-int(n_members) // int(n_shards)) * int(n_shards)
 
 
+def _member_axes_tuple(mesh, axis) -> tuple:
+    """Normalize the member-sharding ``axis`` argument — a single
+    mesh-axis name, a tuple of names (the pod's ``(hosts, data)``
+    spec), or None (the mesh's first axis) — to a tuple of names."""
+    if axis is None:
+        return (mesh.axis_names[0],)
+    if isinstance(axis, str):
+        return (axis,)
+    return tuple(axis)
+
+
+def _member_spec_entry(axes: tuple):
+    """The PartitionSpec entry sharding one array dimension over
+    ``axes``: the bare name for one axis, the tuple for several
+    (``P(("hosts", "data"))`` splits the member axis over hosts
+    outermost, then each host's devices — contiguous per host, which
+    is what the multi-process staging slices on)."""
+    return axes[0] if len(axes) == 1 else axes
+
+
 @functools.lru_cache(maxsize=None)
 def _sharded_linear_program(
-    mesh, axis, num_iterations, loss, full_batch, frac, tol, weighted,
+    mesh, axes, num_iterations, loss, full_batch, frac, tol, weighted,
     stacked,
 ):
     """(train, replicate) jitted pair for one mesh/config geometry.
 
     ``train`` is the vmapped per-member program of
     :func:`train_linear_population` wrapped in ``shard_map`` over the
-    mesh's ``axis``: each device runs the SAME member invocation on
-    its local member block, so the program contains no cross-device
-    traffic at all — member training is embarrassingly parallel.
-    ``replicate`` gathers the tiny (P, d) weight block back to every
-    device (the one collective of the path — an all-gather for real
-    meshes, asserted in the MULTICHIP dryrun), so the host fetch
-    works on multi-host runs where the sharded array spans
-    non-addressable devices. lru-cached per (mesh, statics): repeat
-    runs over the same mesh re-jit nothing.
+    mesh's member ``axes`` (one name on a single-host mesh; the
+    ``(hosts, data)`` pair on a pod's hybrid mesh, so the member axis
+    spans every device of every host): each device runs the SAME
+    member invocation on its local member block, so the program
+    contains no cross-device traffic at all — member training is
+    embarrassingly parallel. ``replicate`` gathers the tiny (P, d)
+    weight block back to every device (the one collective of the path
+    — an all-gather for real meshes, asserted in the MULTICHIP dryrun
+    and, for the DCN-crossing pod form, in tests/_pod_worker.py), so
+    the host fetch works on multi-host runs where the sharded array
+    spans non-addressable devices. lru-cached per (mesh, statics):
+    repeat runs over the same mesh re-jit nothing.
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -256,17 +279,18 @@ def _sharded_linear_program(
 
     vmapped = jax.vmap(member, in_axes=(0 if stacked else None, None,
                                         0, 0, 0, 0, 0, 0))
-    x_spec = P(axis, None, None) if stacked else P()
-    member_spec = P(axis)
+    entry = _member_spec_entry(axes)
+    x_spec = P(entry, None, None) if stacked else P()
+    member_spec = P(entry)
     train = jax.jit(
         shard_map(
             vmapped,
             mesh=mesh,
             in_specs=(
                 x_spec, P(), member_spec, member_spec, member_spec,
-                P(axis, None), member_spec, member_spec,
+                P(entry, None), member_spec, member_spec,
             ),
-            out_specs=P(axis, None),
+            out_specs=P(entry, None),
         )
     )
     replicate = jax.jit(lambda w: w, out_shardings=NamedSharding(mesh, P()))
@@ -292,7 +316,17 @@ def train_linear_population_sharded(
     blocks, one device-parallel program (the ROADMAP item-2 shape:
     a 16-member CV x sweep population on N chips in ~1/N wall time).
 
-    Same argument contract as the vmapped engine. Members are padded
+    Same argument contract as the vmapped engine. ``axis`` names the
+    mesh axis (or, on a pod's hybrid mesh, the tuple of axes — hosts
+    outermost) the member axis shards over; on multi-process meshes
+    every input is staged globally — the shared rows replicate across
+    hosts once (``distributed.replicate_across_hosts``) and each
+    process stages only its own contiguous member shard of the
+    per-member arrays (``distributed.stage_local``, the
+    ``stage_global_batch`` path), so no host materializes device
+    arrays for members it does not own and the final weight
+    all-gather is the run's one cross-DCN collective.
+    Members are padded
     up to a mesh multiple (:func:`pad_members`) with INERT members:
     an all-zero sample mask makes ``_run_sgd``'s per-iteration sampled
     count 0, so every padded member's update is skipped and its
@@ -307,8 +341,10 @@ def train_linear_population_sharded(
     to the vmapped engine's (tests/test_sharded_population.py), the
     same margin-band contract that pins vmap==looped.
     """
-    axis = axis or mesh.axis_names[0]
-    n_shards = int(mesh.shape[axis])
+    axes = _member_axes_tuple(mesh, axis)
+    n_shards = 1
+    for a in axes:
+        n_shards *= int(mesh.shape[a])
     n_members = len(list(seeds))
     padded = pad_members(n_members, n_shards)
     pad = padded - n_members
@@ -344,22 +380,64 @@ def train_linear_population_sharded(
         x = np.asarray(features, np.float32)
 
     train, replicate = _sharded_linear_program(
-        mesh, axis,
+        mesh, axes,
         int(config.num_iterations), config.loss,
         config.mini_batch_fraction >= 1.0,
         float(config.mini_batch_fraction),
         float(config.convergence_tol),
         weighted, bool(stacked_features),
     )
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    # single-host meshes stage as before (plain host arrays; jit
+    # commits them); a mesh spanning other processes' devices needs
+    # GLOBAL arrays — shared rows replicate across hosts, per-member
+    # arrays stage each process's contiguous member shard only
+    multiproc = not NamedSharding(mesh, P()).is_fully_addressable
+    if multiproc:
+        from . import distributed as _dist
+
+        if axes[0] != _dist.DCN_AXIS:
+            # the per-host member slice below is contiguous only when
+            # hosts shard the member axis outermost (hybrid_mesh's
+            # layout); anything else would stage the wrong members
+            raise ValueError(
+                f"multi-process member sharding needs the "
+                f"{_dist.DCN_AXIS!r} axis outermost, got {axes}"
+            )
+
+    def stage_member(a):
+        if not multiproc:
+            return jnp.asarray(a)
+        from . import distributed
+
+        pid = jax.process_index()
+        per_host = padded // jax.process_count()
+        spec = P(
+            _member_spec_entry(axes), *([None] * (a.ndim - 1))
+        )
+        return distributed.stage_local(
+            NamedSharding(mesh, spec),
+            a[pid * per_host : (pid + 1) * per_host],
+        )
+
+    def stage_shared(a):
+        if not multiproc:
+            return jnp.asarray(a)
+        from . import distributed
+
+        return distributed.replicate_across_hosts(np.asarray(a), mesh)
+
     w_sharded = train(
-        jnp.asarray(x),
-        jnp.asarray(y),
-        jnp.asarray(member_axis(step_sizes, np.float32)),
-        jnp.asarray(member_axis(reg_params, np.float32)),
-        jnp.asarray(member_axis([int(s) for s in seeds], np.int32)),
-        jnp.asarray(masks_arr),
-        jnp.asarray(member_axis(wp, np.float32)),
-        jnp.asarray(member_axis(wn, np.float32)),
+        stage_member(x) if stacked_features else stage_shared(x),
+        stage_shared(y),
+        stage_member(member_axis(step_sizes, np.float32)),
+        stage_member(member_axis(reg_params, np.float32)),
+        stage_member(member_axis([int(s) for s in seeds], np.int32)),
+        stage_member(masks_arr),
+        stage_member(member_axis(wp, np.float32)),
+        stage_member(member_axis(wn, np.float32)),
     )
     weights = np.asarray(replicate(w_sharded))
     return weights[:n_members]

@@ -150,6 +150,9 @@ class PipelineBuilder:
         #: None when the run shared no prefix work. Set whether or not
         #: telemetry is on, like precision_resolved.
         self.dedup_resolved: Optional[dict] = None
+        #: a requested-but-not-live pod's record (processes=1, or a
+        #: degraded bootstrap) pending its fold into mesh_resolved
+        self._pod_block: Optional[dict] = None
 
     @contextlib.contextmanager
     def _stage(self, name: str, **attrs):
@@ -203,6 +206,17 @@ class PipelineBuilder:
                 prefetch_depth=self._int_param(query_map, "prefetch"),
             )
 
+        # processes=/coordinator=/process_id= (env twins
+        # JAX_NUM_PROCESSES/JAX_COORDINATOR/JAX_PROCESS_ID): the
+        # pod-scale multi-process family (ROADMAP item 2's last leg).
+        # Bootstrap runs FIRST — jax.distributed must initialize
+        # before anything touches a backend, and _resolve_mesh's
+        # jax.devices() is a backend touch. A live pod supersedes
+        # devices=/mesh_axes= with the hybrid DCN x ICI mesh; a
+        # bootstrap failure (coordinator unreachable, a peer host
+        # missing) degrades pod -> single-host mesh -> single device
+        # -> host, recorded like every other rung drop.
+        #
         # devices=/mesh_axes=: the multi-device scale-out family
         # (ROADMAP item 2). A requested mesh threads into the fused
         # ingest (parallel/sharded_ingest — the epoch batch sharded
@@ -211,10 +225,20 @@ class PipelineBuilder:
         # is the ladder's new TOP rung: the run degrades to the
         # single-device path (recorded — rung, shape, evidence in
         # run_report.json and on the bench line), which can itself
-        # degrade to host exactly as before. Absent both parameters,
-        # this resolves to None and the path is byte-identical to
-        # every query ever written.
-        mesh = self._resolve_mesh(plan.mesh)
+        # degrade to host exactly as before. Absent both parameter
+        # families, this resolves to None and the path is
+        # byte-identical to every query ever written.
+        pod_runtime = self._resolve_pod(plan.pod)
+        if pod_runtime is not None:
+            mesh = pod_runtime.mesh
+            if plan.mesh is not None:
+                logger.info(
+                    "pod bootstrap succeeded: the hybrid DCN x ICI "
+                    "mesh supersedes devices=/mesh_axes="
+                )
+        else:
+            mesh = self._resolve_mesh(plan.mesh)
+            self._note_pod_block()
 
         # task=seizure: the continuous-EEG seizure workload
         # (docs/workloads.md) — sliding-window epoching over interval
@@ -365,6 +389,25 @@ class PipelineBuilder:
                 if query_map.get("cache", "true") != "false"
                 else None
             )
+            if pod_runtime is not None:
+                # the pod path IS its own cache story: each host reads
+                # 1/N of the waveform bytes, and a content key would
+                # need every process to digest bytes it deliberately
+                # never reads. The gated precision rungs need the f32
+                # reference recording in memory for the same reason —
+                # refuse loudly rather than serve ungated numerics.
+                if precision != "f32":
+                    raise ValueError(
+                        f"precision={precision} runs behind a per-run "
+                        "f32 reference gate the pod-partitioned "
+                        "ingest cannot stage; pod runs compute f32"
+                    )
+                if cache is not None:
+                    logger.info(
+                        "pod run: feature cache bypassed (partitioned "
+                        "ingest reads 1/N of the bytes instead)"
+                    )
+                    cache = None
             cache_key = None
             prepared = None
             features = targets = None
@@ -383,7 +426,7 @@ class PipelineBuilder:
             from ..scheduler import dedup as dedup_mod
 
             dedup_claim = None
-            if dedup_mod.eligible(plan):
+            if pod_runtime is None and dedup_mod.eligible(plan):
                 with self._stage("ingest", phase="prefix_dedup"):
                     dedup_claim = dedup_mod.acquire_for(plan)
             try:
@@ -556,6 +599,20 @@ class PipelineBuilder:
                     if degrade
                     else [backend]
                 )
+                if pod_runtime is not None:
+                    # pod runs fail FAST on rung errors: per-host
+                    # mid-run degradation cannot be coordinated — a
+                    # host that silently walks down the ladder (or
+                    # lands the collective-free host floor) while its
+                    # peers sit inside the feature all-gather would
+                    # strand them in a collective that never
+                    # completes. A loud failure ends this process's
+                    # plan instead, and the coordination service's
+                    # peer-failure propagation (or the resident
+                    # executor's retry) takes it from there; the pod
+                    # DEGRADES only at the bootstrap rung, before any
+                    # collective exists.
+                    ladder = [backend]
                 if landed is not None:
                     ladder = []
                 for rung in ladder:
@@ -566,7 +623,13 @@ class PipelineBuilder:
                             features, targets = odp.load_features_device(
                                 wavelet_index=wavelet_index,
                                 backend=rung,
-                                mesh=mesh,
+                                # a live pod partitions whole
+                                # recordings per host; the hybrid
+                                # mesh is the POPULATION's to shard —
+                                # per-recording time sharding stays a
+                                # single-host mesh feature
+                                mesh=None if pod_runtime else mesh,
+                                pod=pod_runtime,
                                 recordings=(
                                     None if prepared is None
                                     else prepared.recordings
@@ -1586,6 +1649,153 @@ class PipelineBuilder:
             axes=",".join(mesh.axis_names),
         )
         return mesh
+
+    # -- multi-process (pod) resolution --------------------------------
+
+    @staticmethod
+    def _resolve_pod_knobs(request):
+        """Query-over-env resolution of the pod family; returns
+        ``(processes, coordinator, process_id)`` with Nones where
+        nothing (query or environment) configured a value. The env
+        half delegates to ``distributed.resolve_env_knobs`` — the one
+        resolution the bootstrap itself uses, so the recorded
+        'requested' block cannot diverge from what ran."""
+        from ..parallel import distributed
+
+        processes = coordinator = process_id = None
+        if request is not None:
+            processes = request.processes
+            coordinator = request.coordinator
+            process_id = request.process_id
+        coordinator, processes, process_id = (
+            distributed.resolve_env_knobs(
+                coordinator, processes, process_id
+            )
+        )
+        return processes, coordinator, process_id
+
+    def _resolve_pod(self, request):
+        """``processes=``/``coordinator=``/``process_id=`` (or their
+        env twins) -> a live :class:`~..parallel.pod.PodRuntime` over
+        the hybrid DCN x ICI mesh, or None.
+
+        None in AND no env pod config = today's path, byte-untouched.
+        ``processes=1`` records the request and runs the unchanged
+        single-process path (pinned byte-identical). A bootstrap that
+        cannot assemble the pod within its deadline (coordinator
+        unreachable, peer host missing — distributed.initialize's
+        preflight turns both into a catchable
+        :class:`~..parallel.distributed.PodBootstrapError`) DEGRADES:
+        pod -> single-host mesh -> single device -> host, with the
+        evidence in the degradation history, the run report's mesh
+        block, and ``pipeline.pod_unavailable``.
+        """
+        self._pod_block = None
+        processes, coordinator, process_id = self._resolve_pod_knobs(
+            request
+        )
+        if processes is None and coordinator is None:
+            if process_id is not None:
+                # the bootstrap's own partial-setup refusal, raised
+                # here too — returning None would silently train
+                # single-host on a pod whose launcher lost/typo'd the
+                # count and coordinator exports
+                raise ValueError(
+                    "JAX_PROCESS_ID/process_id is set but neither a "
+                    "coordinator address nor a process count is "
+                    "configured — refusing to run as single-process "
+                    "with a partial multi-host setup"
+                )
+            return None
+        requested = {
+            "processes": processes,
+            "coordinator": coordinator,
+            "process_id": process_id,
+        }
+        if processes is not None and processes <= 1:
+            # the degenerate pod: exactly today's single-process path
+            # (pinned byte-identical); only the record changes
+            self._pod_block = dict(requested, rung="single_host")
+            return None
+        from ..parallel import distributed, pod as pod_mod
+
+        try:
+            coordinator_used, n_proc, pid = distributed.initialize(
+                coordinator, processes, process_id
+            )
+            if n_proc <= 1:
+                self._pod_block = dict(requested, rung="single_host")
+                return None
+            hmesh = distributed.hybrid_mesh()
+        except Exception as e:
+            evidence = f"{type(e).__name__}: {e}"
+            logger.warning(
+                "pipeline.pod unavailable (requested %s): %s; "
+                "degrading to the single-host rung",
+                requested, evidence,
+            )
+            obs.metrics.count("pipeline.pod_unavailable")
+            events.event("pipeline.pod_unavailable", error=evidence)
+            self.degradation_history.append(
+                {"from": "pod", "error": evidence}
+            )
+            self._pod_block = dict(
+                requested, rung="single_host", error=evidence
+            )
+            # a half-assembled bootstrap must not wedge the latch —
+            # the next run (or the retry) gets a clean slate
+            from ..parallel import distributed as _dist
+
+            _dist.shutdown()
+            return None
+        dcn_shape = {distributed.DCN_AXIS: n_proc}
+        self.mesh_resolved = {
+            "requested": requested,
+            "rung": "pod",
+            "shape": {k: int(v) for k, v in hmesh.shape.items()},
+            "devices": int(hmesh.devices.size),
+            "processes": int(n_proc),
+            "process_id": int(pid),
+            "coordinator": coordinator_used,
+            "dcn_shape": dcn_shape,
+        }
+        if self.telemetry is not None:
+            self.telemetry.mesh = self.mesh_resolved
+        events.event(
+            "pipeline.pod_up",
+            processes=int(n_proc),
+            process_id=int(pid),
+            devices=int(hmesh.devices.size),
+        )
+        obs.metrics.count("pipeline.pod_runs")
+        return pod_mod.PodRuntime(
+            mesh=hmesh,
+            num_processes=int(n_proc),
+            process_id=int(pid),
+            coordinator=coordinator_used,
+        )
+
+    def _note_pod_block(self):
+        """Fold a requested-but-not-live pod (``processes=1``, or a
+        degraded bootstrap) into the run's mesh block so the report
+        and the bench line carry the evidence — the same bookkeeping
+        ``_note_population_mesh`` does for the population engine."""
+        block = getattr(self, "_pod_block", None)
+        if block is None:
+            return
+        if self.mesh_resolved is None:
+            self.mesh_resolved = {
+                "requested": {
+                    "processes": block.get("processes"),
+                    "coordinator": block.get("coordinator"),
+                    "process_id": block.get("process_id"),
+                },
+                "rung": "single_device",
+                "shape": None,
+            }
+        self.mesh_resolved["pod"] = block
+        if self.telemetry is not None:
+            self.telemetry.mesh = self.mesh_resolved
 
     def _note_population_mesh(self, block):
         """Fold the population engine's mesh outcome (the rung it

@@ -76,6 +76,22 @@ class MeshRequest:
 
 
 @dataclasses.dataclass(frozen=True)
+class PodRequest:
+    """The ``processes=``/``coordinator=``/``process_id=`` grammar,
+    validated — the multi-process (pod) knob family, env twins
+    ``JAX_NUM_PROCESSES``/``JAX_COORDINATOR``(``_ADDRESS``)/
+    ``JAX_PROCESS_ID``. Whether the pod can actually BOOTSTRAP
+    (coordinator reachable, peers alive) is an availability question
+    the executor answers: bootstrap failure is the ladder's pod rung
+    degrading to single-host, never a parse error. Fields left None
+    resolve from the environment at execution time (parse purity)."""
+
+    processes: Optional[int]
+    coordinator: Optional[str]
+    process_id: Optional[int]
+
+
+@dataclasses.dataclass(frozen=True)
 class ExecutionPlan:
     """One validated pipeline run. Frozen: a plan is a value — the
     scheduler journals it, retries it, and replays it after a crash
@@ -129,6 +145,9 @@ class ExecutionPlan:
 
     # -- multi-device ----------------------------------------------------
     mesh: Optional[MeshRequest]
+
+    # -- multi-process (pod) ---------------------------------------------
+    pod: Optional[PodRequest]
 
     # -- seizure workload ------------------------------------------------
     window: Optional[int]
@@ -190,6 +209,10 @@ class ExecutionPlan:
             elif field.name == "mesh":
                 value = None if value is None else (
                     value.devices, value.axes, value.shape,
+                )
+            elif field.name == "pod":
+                value = None if value is None else (
+                    value.processes, value.coordinator, value.process_id,
                 )
             elif field.name == "config":
                 value = tuple(sorted(value.items()))
@@ -281,8 +304,10 @@ class ExecutionPlan:
 
         # 2. mesh grammar (the availability half stays with the
         # executor; order matches the monolith — mesh grammar is
-        # checked before the task routing)
+        # checked before the task routing), then the multi-process
+        # (pod) grammar that sits above it on the ladder
         mesh = cls._parse_mesh(query_map, serve)
+        pod = cls._parse_pod(query_map, serve)
 
         # 3. task
         task = query_map.get("task", "") or "p300"
@@ -480,6 +505,7 @@ class ExecutionPlan:
             },
             population=population,
             mesh=mesh,
+            pod=pod,
             window=window,
             stride=stride,
             label_overlap=label_overlap,
@@ -567,6 +593,88 @@ class ExecutionPlan:
             devices=devices_param,
             axes=tuple(axes),
             shape=tuple(sizes) if sizes else None,
+        )
+
+    @staticmethod
+    def _parse_pod(
+        query_map: Mapping[str, str], serve: bool
+    ) -> Optional[PodRequest]:
+        """The ``processes=``/``coordinator=``/``process_id=`` grammar.
+        Statically decidable errors only — reachability degrades at
+        execution; a typo'd knob must never silently train
+        single-host."""
+        processes = _int_param(query_map, "processes")
+        process_id = _int_param(query_map, "process_id")
+        coordinator = query_map.get("coordinator") or None
+        if processes is None and process_id is None and coordinator is None:
+            return None
+        if serve:
+            _raise(
+                "processes=/coordinator=/process_id= configure the "
+                "multi-process batch pipeline; they cannot combine "
+                "with serve=true (the serving engine is resident "
+                "single-process)"
+            )
+        if (query_map.get("task", "") or "p300") == "seizure":
+            # the seizure ingest (sliding windows over host-extracted
+            # subband features) has no partitioned pod path yet —
+            # every process would redo 100% of the work while the
+            # mesh block claimed the pod rung; refuse loudly
+            _raise(
+                "processes=/coordinator=/process_id= partition the "
+                "fused P300 ingest; task=seizure has no pod path yet "
+                "— run it single-host (devices= still shards the "
+                "member axis)"
+            )
+        if query_map.get("precision") in ("bf16", "int8"):
+            # statically decidable half of the builder's runtime
+            # check (an env-resolved EEG_TPU_PRECISION still lands on
+            # the execution-time guard): the reduced-precision gate
+            # needs the f32 reference recording in memory, which the
+            # partitioned ingest deliberately never stages
+            _raise(
+                f"precision={query_map.get('precision')} runs behind "
+                "a per-run f32 reference gate the pod-partitioned "
+                "ingest cannot stage; pod runs compute f32"
+            )
+        if processes is not None and processes < 1:
+            _raise("processes= must be >= 1")
+        if coordinator is not None:
+            host, sep, port = coordinator.rpartition(":")
+            if not sep or not host:
+                _raise(
+                    f"coordinator= must be host:port, "
+                    f"got {coordinator!r}"
+                )
+            try:
+                port_n = int(port)
+            except ValueError:
+                _raise(
+                    f"coordinator= port must be an integer, "
+                    f"got {port!r}"
+                )
+            if not 0 < port_n < 65536:
+                _raise(
+                    f"coordinator= port must be in (0, 65536), "
+                    f"got {port_n}"
+                )
+        if process_id is not None:
+            if process_id < 0:
+                _raise("process_id= must be >= 0")
+            if processes is None:
+                _raise(
+                    "process_id= identifies this process within "
+                    "processes=N; pass both"
+                )
+            if process_id >= processes:
+                _raise(
+                    f"process_id= must be < processes="
+                    f"{processes}, got {process_id}"
+                )
+        return PodRequest(
+            processes=processes,
+            coordinator=coordinator,
+            process_id=process_id,
         )
 
     @staticmethod

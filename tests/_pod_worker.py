@@ -1,0 +1,80 @@
+"""Worker process for the two-process pod-pipeline test.
+
+Launched by tests/test_pod_pipeline.py with a full pipeline query on
+argv (carrying ``processes=2&coordinator=...&process_id=N``). Runs the
+REAL pipeline path — ``PipelineBuilder.execute`` bootstraps the pod
+inside ``_resolve_pod``, partitions the recordings, exchanges features
+over the loopback-DCN, and trains the population member axis over the
+hybrid mesh — then prints one JSON line: the statistics sha256, the
+mesh block, and the compiled-HLO collective assertions (the PR 9
+pattern: the cross-process all-gathers must exist in the compiled
+programs, not just in intent).
+"""
+
+import hashlib
+import json
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+# no gloo config here, deliberately: distributed.initialize sets the
+# CPU collectives implementation itself once the preflight passes, so
+# the pipeline works on CPU pods without per-caller jax.config setup
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    query = sys.argv[1]
+    from eeg_dataanalysispackage_tpu.pipeline import builder
+
+    pb = builder.PipelineBuilder(query)
+    statistics = pb.execute()
+    out = {
+        "sha": hashlib.sha256(str(statistics).encode()).hexdigest(),
+        "mesh": pb.mesh_resolved,
+        "procs": int(jax.process_count()),
+        "devices": int(jax.device_count()),
+        "degradation": pb.degradation_history,
+    }
+    if (pb.mesh_resolved or {}).get("rung") == "pod":
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from eeg_dataanalysispackage_tpu.parallel import (
+            distributed,
+            mesh as pmesh,
+            pod as pod_mod,
+        )
+
+        mesh = distributed.hybrid_mesh()
+        # the feature exchange's replicate program: its all-gather is
+        # THE collective that ships each host's rows over DCN
+        out["exchange_allgather"] = "all-gather" in (
+            pod_mod.exchange_collective_hlo(mesh, 64, 48)
+        )
+        # the population weight all-gather over the pod member spec
+        # ((hosts, data) — hosts outermost): lowered on the same mesh
+        # and sharding the pipeline's sharded engine used
+        rep = jax.jit(
+            lambda w: w, out_shardings=NamedSharding(mesh, P())
+        )
+        txt = rep.lower(
+            jax.ShapeDtypeStruct(
+                (4, 48),
+                jnp.float32,
+                sharding=NamedSharding(
+                    mesh, P((distributed.DCN_AXIS, pmesh.DATA_AXIS), None)
+                ),
+            )
+        ).compile().as_text()
+        out["weight_allgather"] = "all-gather" in txt
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

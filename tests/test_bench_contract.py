@@ -634,3 +634,50 @@ def test_collect_propagates_plan_service_field(monkeypatch):
     v = bench._collect("cpu_fallback")["variants"]["plan_service"]
     assert v["plan_service"] == block
     assert v["report_sha256"] == "abc"
+
+
+def test_multiproc_variant_in_both_tables_and_whitelist(monkeypatch):
+    """The pod variant (ISSUE 14) rides both tables, and its
+    multiproc block (parity verdict, members/sec ratio, degraded-
+    coordinator evidence) survives the parent's field whitelist into
+    the artifact."""
+    for table in (bench._VARIANTS_TPU, bench._VARIANTS_CPU):
+        assert "population_multiproc" in table
+        # the pod run and its single-process twin measure the same
+        # synthetic session as the population pair
+        assert table["population_multiproc"] == table["population_vmap"]
+
+    block = {
+        "processes": 2,
+        "parity_sha_ok": True,
+        "members_per_s": 10.0,
+        "twin_members_per_s": 12.0,
+        "degraded_coordinator": {
+            "rung": "single_host", "error_present": True,
+            "parity_ok": True,
+        },
+    }
+    monkeypatch.setattr(
+        bench, "_VARIANTS_CPU",
+        {"einsum": (8, 2), "population_multiproc": (800, 2)},
+    )
+    monkeypatch.setattr(
+        bench,
+        "_run_variant",
+        lambda name, platform, n, iters: {
+            "epochs_per_s": 1.0,
+            "bytes_per_epoch": 12000,
+            "n": n,
+            "wall_s": 1.0,
+            "report_sha256": "abc",
+            **(
+                {"multiproc": block, "mesh": {"rung": "pod"},
+                 "members_per_s": 10.0}
+                if name == "population_multiproc" else {}
+            ),
+        },
+    )
+    v = bench._collect("cpu_fallback")["variants"]["population_multiproc"]
+    assert v["multiproc"] == block
+    assert v["mesh"] == {"rung": "pod"}
+    assert v["members_per_s"] == 10.0

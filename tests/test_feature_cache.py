@@ -139,7 +139,9 @@ def test_cache_false_opts_a_run_out(tmp_path, cache_env):
     info = _session(tmp_path)
     builder.PipelineBuilder(_query(info, cache="false")).execute()
     st = feature_cache.stats()
-    assert st == {"hits": 0, "misses": 0, "corrupt": 0}
+    assert st == {
+        "hits": 0, "misses": 0, "corrupt": 0, "cross_process_waits": 0,
+    }
     assert not glob.glob(str(cache_env / "*.npz"))
 
 
@@ -348,3 +350,127 @@ def test_try_begin_build_nonblocking(tmp_path):
     slot = cache.try_begin_build(key)
     assert slot is not None and not slot.waited
     slot.release()
+
+
+# ------------------------------------------------ cross-process lock
+# (ISSUE 14 satellite: N local processes cold-starting the same
+# session must not each pay the same rebuild — begin_build's
+# single-flight extends across processes via a best-effort O_EXCL
+# lock file; a foreign process is simulated by creating the lock out
+# of band.)
+
+
+def test_begin_build_waits_on_foreign_lock_then_proceeds(tmp_path):
+    import threading
+    import time as _time
+
+    feature_cache.reset_stats()
+    cache = feature_cache.FeatureCache(str(tmp_path / "fc"))
+    key = "x" * 40
+    os.makedirs(cache.directory, exist_ok=True)
+    lock = cache._lock_path_for(key)
+    with open(lock, "w") as f:
+        f.write("99999")  # a live foreign builder
+
+    got = {}
+
+    def builder_thread():
+        slot = cache.begin_build(key)
+        got["t"] = _time.monotonic()
+        slot.release()
+
+    t = threading.Thread(target=builder_thread)
+    t0 = _time.monotonic()
+    t.start()
+    _time.sleep(0.3)
+    assert "t" not in got, "did not wait on the foreign lock"
+    os.unlink(lock)  # the foreign builder finishes
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert got["t"] - t0 >= 0.3
+    assert feature_cache.stats()["cross_process_waits"] == 1
+    # released cleanly: our own lock is gone too
+    assert not os.path.exists(lock)
+
+
+def test_begin_build_stops_waiting_when_entry_lands(tmp_path):
+    """The foreign builder stored the entry: the waiter stops polling
+    and its revalidating lookup hits — no rebuild, lock still
+    foreign-held."""
+    feature_cache.reset_stats()
+    cache = feature_cache.FeatureCache(str(tmp_path / "fc"))
+    features = np.ones((4, 8), np.float32)
+    targets = np.zeros(4, np.float64)
+    key = "y" * 40
+    lock = cache._lock_path_for(key)
+    os.makedirs(cache.directory, exist_ok=True)
+    with open(lock, "w") as f:
+        f.write("99999")
+    cache.store(key, features, targets)  # the foreign store lands
+    slot = cache.begin_build(key)  # returns promptly, lock-free
+    hit = cache.lookup(key)
+    assert hit is not None
+    slot.release()
+    assert os.path.exists(lock)  # not ours to break
+    os.unlink(lock)
+
+
+def test_stale_foreign_lock_is_broken(tmp_path, monkeypatch):
+    """A dead holder's lock (older than the timeout) is broken and
+    taken over instead of stalling every later run."""
+    monkeypatch.setenv(feature_cache.ENV_LOCK_TIMEOUT, "0.2")
+    cache = feature_cache.FeatureCache(str(tmp_path / "fc"))
+    key = "z" * 40
+    lock = cache._lock_path_for(key)
+    os.makedirs(cache.directory, exist_ok=True)
+    with open(lock, "w") as f:
+        f.write("99999")
+    old = os.path.getmtime(lock) - 5.0
+    os.utime(lock, (old, old))
+    slot = cache.begin_build(key)  # breaks the stale lock, owns a new one
+    assert os.path.exists(lock)
+    with open(lock) as f:
+        assert f.read() == str(os.getpid())
+    slot.release()
+    assert not os.path.exists(lock)
+
+
+def test_try_begin_build_respects_fresh_foreign_lock(tmp_path, monkeypatch):
+    monkeypatch.setenv(feature_cache.ENV_LOCK_TIMEOUT, "30")
+    cache = feature_cache.FeatureCache(str(tmp_path / "fc"))
+    key = "w" * 40
+    lock = cache._lock_path_for(key)
+    os.makedirs(cache.directory, exist_ok=True)
+    with open(lock, "w") as f:
+        f.write("99999")
+    assert cache.try_begin_build(key) is None  # fresh foreign holder
+    old = os.path.getmtime(lock) - 60.0
+    os.utime(lock, (old, old))
+    slot = cache.try_begin_build(key)  # stale -> broken and taken
+    assert slot is not None
+    slot.release()
+
+
+def test_foreign_lock_wait_deadline_fallback(tmp_path, monkeypatch):
+    """A budget-bearing plan polling a foreign lock proceeds lock-free
+    the moment its ambient deadline expires — the lock only saves
+    redundant work, so dying on it would be worse than rebuilding."""
+    import time as _time
+
+    from eeg_dataanalysispackage_tpu.io import deadline as deadline_mod
+
+    monkeypatch.setenv(feature_cache.ENV_LOCK_TIMEOUT, "30")
+    cache = feature_cache.FeatureCache(str(tmp_path / "fc"))
+    key = "v" * 40
+    lock = cache._lock_path_for(key)
+    os.makedirs(cache.directory, exist_ok=True)
+    with open(lock, "w") as f:
+        f.write("99999")
+    t0 = _time.monotonic()
+    with deadline_mod.deadline_scope(deadline_mod.Deadline(0.3)):
+        slot = cache.begin_build(key)
+    assert _time.monotonic() - t0 < 5.0
+    assert slot._lock_path is None  # proceeding without the lock
+    slot.release()
+    assert os.path.exists(lock)  # the foreign lock was left alone
+    os.unlink(lock)

@@ -179,3 +179,100 @@ def test_non_batch_routes_ignore_overlap_precision_values():
         "&load_name=m&overlap=junk&precision=fp8"
     )
     assert serve_plan.serve
+
+
+# ------------------------------------------------ pod (multi-process)
+
+
+def test_pod_typed_fields_round_trip():
+    plan = ExecutionPlan.parse(
+        "info_file=i.txt&fe=dwt-8&train_clf=logreg"
+        "&processes=4&coordinator=10.0.0.1:1234&process_id=2"
+    )
+    assert plan.pod is not None
+    assert plan.pod.processes == 4
+    assert plan.pod.coordinator == "10.0.0.1:1234"
+    assert plan.pod.process_id == 2
+    # absent entirely -> None, the byte-identical default path
+    assert ExecutionPlan.parse(
+        "info_file=i.txt&fe=dwt-8&train_clf=logreg"
+    ).pod is None
+    # partial: processes alone parses (coordinator/process_id resolve
+    # from the env twins at execution — parse purity)
+    partial = ExecutionPlan.parse(
+        "info_file=i.txt&fe=dwt-8&train_clf=logreg&processes=2"
+    )
+    assert partial.pod.processes == 2
+    assert partial.pod.coordinator is None
+    assert partial.pod.process_id is None
+
+
+def test_pod_canonical_key_covers_the_family():
+    base = ExecutionPlan.parse(
+        "info_file=i.txt&fe=dwt-8&train_clf=logreg"
+    )
+    podded = ExecutionPlan.parse(
+        "info_file=i.txt&fe=dwt-8&train_clf=logreg"
+        "&processes=2&coordinator=c:1&process_id=0"
+    )
+    assert base.canonical_key() != podded.canonical_key()
+    reordered = ExecutionPlan.parse(
+        "processes=2&coordinator=c:1&process_id=0"
+        "&train_clf=logreg&fe=dwt-8&info_file=i.txt"
+    )
+    assert reordered.canonical_key() == podded.canonical_key()
+
+
+@pytest.mark.parametrize(
+    "knobs,match",
+    [
+        ("processes=0", "processes= must be >= 1"),
+        ("processes=x", "must be an integer"),
+        ("process_id=1", "identifies this process within"),
+        ("processes=2&process_id=2", "must be < processes"),
+        ("processes=2&process_id=-1", "process_id= must be >= 0"),
+        ("processes=2&coordinator=nocolon", "must be host:port"),
+        ("processes=2&coordinator=h:xyz", "port must be an integer"),
+        ("processes=2&coordinator=h:99999", "port must be in"),
+    ],
+)
+def test_pod_grammar_errors(knobs, match):
+    with pytest.raises(PlanValidationError, match=match):
+        ExecutionPlan.parse(
+            f"info_file=i.txt&fe=dwt-8&train_clf=logreg&{knobs}"
+        )
+
+
+def test_pod_conflicts_with_serve():
+    """processes= with serve=true is a loud error — the resident
+    serving engine is single-process; silently ignoring the pod
+    family would be worse."""
+    with pytest.raises(
+        PlanValidationError, match="cannot combine with serve=true"
+    ):
+        ExecutionPlan.parse(
+            "info_file=i.txt&serve=true&fe=dwt-8-fused&load_clf=logreg"
+            "&load_name=m&processes=2"
+        )
+
+
+def test_pod_conflicts_with_seizure_and_precision():
+    """Statically decidable pod conflicts: the seizure workload has
+    no partitioned pod path (every process would redo the full
+    ingest under a rung that claims otherwise), and reduced
+    precision needs an f32 reference the partitioned ingest never
+    stages — both refuse at parse, not after a full pod assembly."""
+    with pytest.raises(
+        PlanValidationError, match="no pod path yet"
+    ):
+        ExecutionPlan.parse(
+            "info_file=i.txt&task=seizure&fe=dwt-4&train_clf=logreg"
+            "&processes=2&coordinator=c:1&process_id=0"
+        )
+    with pytest.raises(
+        PlanValidationError, match="pod runs compute f32"
+    ):
+        ExecutionPlan.parse(
+            "info_file=i.txt&fe=dwt-8-fused-decode&train_clf=logreg"
+            "&precision=bf16&processes=2&coordinator=c:1&process_id=0"
+        )

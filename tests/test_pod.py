@@ -1,0 +1,338 @@
+"""Pod-scale multi-process execution (ISSUE 14), hermetic half.
+
+What is testable without real peer processes: the deterministic
+recording partition and its parity contract (the partitioned ingest's
+rows concatenate to the single-process run's rows, bit for bit — the
+balance scan, stale-channel-index reuse, and epoch order all survive
+partitioning because the metadata pass is global), the bootstrap
+latch/reset seam, the resolved-values return, and the pipeline-level
+degradation: a pod that cannot assemble (coordinator unreachable, peer
+host missing — the preflight turns both into a catchable error before
+XLA's fatal path) lands the single-host rung with the evidence in the
+mesh block, and ``processes=1`` is byte-identical to today. The live
+two-process half is tests/test_pod_pipeline.py.
+"""
+
+import os
+import socket
+
+import numpy as np
+import pytest
+
+import _synthetic
+from eeg_dataanalysispackage_tpu import obs
+from eeg_dataanalysispackage_tpu.io import provider
+from eeg_dataanalysispackage_tpu.parallel import distributed, pod
+from eeg_dataanalysispackage_tpu.pipeline import builder
+
+
+def _session(directory, n_files=3, n_markers=40):
+    lines = []
+    for i in range(n_files):
+        name = f"pod_{i:02d}"
+        guessed = 2 + i
+        _synthetic.write_recording(
+            str(directory), name=name, n_markers=n_markers,
+            guessed=guessed, seed=i,
+        )
+        lines.append(f"{name}.eeg {guessed}")
+    info = os.path.join(str(directory), "info.txt")
+    with open(info, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return info
+
+
+@pytest.fixture(scope="module")
+def info(tmp_path_factory):
+    return _session(tmp_path_factory.mktemp("pod_session"))
+
+
+_POP_QUERY = (
+    "fe=dwt-8-fused&train_clf=logreg&cv=2&sweep=lr:1.0,0.5&cache=false"
+    "&config_num_iterations=12&config_step_size=1.0"
+    "&config_mini_batch_fraction=1.0"
+)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ------------------------------------------------ partition
+
+
+def test_partition_disjoint_exhaustive_order_stable():
+    for n in range(0, 14):
+        for procs in range(1, 8):
+            ranges = partitioned = pod.partition(n, procs)
+            assert len(ranges) == procs
+            flat = [
+                i for lo, hi in partitioned for i in range(lo, hi)
+            ]
+            # exhaustive + order-stable: concatenating the blocks in
+            # process order reproduces the original index order
+            assert flat == list(range(n))
+            # disjoint + contiguous
+            assert all(lo <= hi for lo, hi in ranges)
+            assert all(
+                ranges[p][1] == ranges[p + 1][0]
+                for p in range(procs - 1)
+            )
+            # balanced: block sizes differ by at most one
+            sizes = [hi - lo for lo, hi in ranges]
+            assert max(sizes) - min(sizes) <= 1
+
+
+def test_partition_empty_host_edge():
+    # more processes than recordings: trailing hosts own nothing
+    ranges = pod.partition(2, 5)
+    sizes = [hi - lo for lo, hi in ranges]
+    assert sizes == [1, 1, 0, 0, 0]
+    with pytest.raises(ValueError, match=">= 1"):
+        pod.partition(3, 0)
+
+
+# ------------------------------------------------ bootstrap seam
+
+
+def test_initialize_returns_resolved_single_process_noop():
+    assert distributed.initialize() == (None, 1, 0)
+    assert not distributed.is_initialized()
+
+
+def test_shutdown_resets_the_latch():
+    """The one-way latch, fixed: a (simulated) live bootstrap can be
+    shut down and the process can initialize again — what a test
+    harness or a restarted resident gateway needs."""
+    assert not distributed.is_initialized()
+    distributed._initialized = True
+    distributed._resolution = ("127.0.0.1:1", 2, 0)
+    try:
+        # the latched resolution is what repeat initialize reports
+        assert distributed.initialize() == ("127.0.0.1:1", 2, 0)
+        distributed.shutdown()
+        assert not distributed.is_initialized()
+        # and the no-op path works again after the reset
+        assert distributed.initialize() == (None, 1, 0)
+    finally:
+        distributed._initialized = False
+        distributed._resolution = None
+
+
+def test_preflight_unreachable_coordinator_raises_catchably():
+    port = _free_port()
+    with pytest.raises(distributed.PodBootstrapError, match="unreachable"):
+        distributed._preflight_rendezvous(
+            f"127.0.0.1:{port}", 2, 1, timeout_s=1.0
+        )
+
+
+def test_preflight_missing_peer_raises_catchably():
+    port = _free_port()
+    with pytest.raises(distributed.PodBootstrapError, match="peer"):
+        distributed._preflight_rendezvous(
+            f"127.0.0.1:{port - 1}", 2, 0, timeout_s=1.0
+        )
+
+
+# ------------------------------------------------ partitioned ingest parity
+
+
+def _partitioned_rows(info, num_processes):
+    """Simulate every host of an N-process pod sequentially in this
+    process: the global metadata pass + each host's owned-block
+    featurize, concatenated in process order."""
+    parts = []
+    plan = None
+    for pid in range(num_processes):
+        odp = provider.OfflineDataProvider([info])
+        plan = pod.plan_pod_ingest(odp)
+        local = pod.local_features(
+            odp, plan, num_processes, pid,
+            odp.planned_featurizer(backend="decode"),
+            n_feat=48,
+        )
+        parts.append(local)
+    return np.concatenate(parts), plan
+
+
+def test_partitioned_ingest_bit_identical_to_single_process(info):
+    f_ref, t_ref = provider.OfflineDataProvider(
+        [info]
+    ).load_features_device(backend="decode")
+    for procs in (1, 2, 3):
+        rows, plan = _partitioned_rows(info, procs)
+        # bit-for-bit: the same per-recording program ran with the
+        # same globally planned positions/mask, whoever owned the file
+        assert np.array_equal(rows, f_ref), f"procs={procs}"
+        assert np.array_equal(plan.targets, t_ref)
+
+
+def test_partitioned_ingest_empty_host_contributes_zero_rows(info):
+    # 5 processes over 3 recordings: hosts 3 and 4 own nothing
+    rows, plan = _partitioned_rows(info, 5)
+    f_ref, _ = provider.OfflineDataProvider(
+        [info]
+    ).load_features_device(backend="decode")
+    assert np.array_equal(rows, f_ref)
+    counts = plan.host_row_counts(5)
+    assert counts[3] == counts[4] == 0
+    assert sum(counts) == len(f_ref)
+
+
+def test_pod_plan_balance_and_order_survive_partitioning(info):
+    """The metadata pass IS the single-process plan: per-recording
+    kept counts, targets, and the global row order all match the
+    unpartitioned run (the balance scan ran over every recording's
+    markers in load order, on every simulated host)."""
+    odp = provider.OfflineDataProvider([info])
+    plan = pod.plan_pod_ingest(odp)
+    batch = provider.OfflineDataProvider([info]).load()
+    assert int(sum(plan.row_counts())) == len(batch)
+    assert np.array_equal(plan.targets, np.asarray(batch.targets))
+
+
+def test_host_row_counts_match_partition(info):
+    odp = provider.OfflineDataProvider([info])
+    plan = pod.plan_pod_ingest(odp)
+    per_rec = plan.row_counts()
+    for procs in (1, 2, 4):
+        counts = plan.host_row_counts(procs)
+        assert sum(counts) == sum(per_rec)
+        for (lo, hi), c in zip(pod.partition(len(per_rec), procs), counts):
+            assert c == sum(per_rec[lo:hi])
+
+
+# ------------------------------------------------ pipeline degradation
+
+
+def _q(info, *parts):
+    return "&".join([f"info_file={info}", _POP_QUERY, *parts])
+
+
+def test_processes1_byte_identical_with_pod_block(info):
+    baseline = builder.PipelineBuilder(_q(info)).execute()
+    pb = builder.PipelineBuilder(_q(info, "processes=1"))
+    got = pb.execute()
+    assert str(got) == str(baseline)
+    assert pb.mesh_resolved["pod"]["processes"] == 1
+    assert pb.mesh_resolved["pod"]["rung"] == "single_host"
+    assert pb.degradation_history == []
+
+
+def test_unreachable_coordinator_degrades_to_single_host(info, monkeypatch):
+    """The acceptance scenario, client side: the coordinator host
+    never answers, the preflight times out within the bootstrap
+    budget, and the plan completes on the single-host rung with the
+    evidence in the mesh block — it does not fail, and it does not
+    hit XLA's fatal-abort path."""
+    monkeypatch.setenv(distributed.ENV_BOOTSTRAP_TIMEOUT, "1.5")
+    baseline = builder.PipelineBuilder(_q(info)).execute()
+    before = obs.metrics.snapshot()["counters"].get(
+        "pipeline.pod_unavailable", 0.0
+    )
+    port = _free_port()
+    pb = builder.PipelineBuilder(
+        _q(
+            info,
+            f"processes=2&coordinator=127.0.0.1:{port}&process_id=1",
+        )
+    )
+    got = pb.execute()
+    after = obs.metrics.snapshot()["counters"].get(
+        "pipeline.pod_unavailable", 0.0
+    )
+    assert str(got) == str(baseline)
+    assert after == before + 1
+    block = pb.mesh_resolved["pod"]
+    assert block["processes"] == 2
+    assert block["rung"] == "single_host"
+    assert "unreachable" in block["error"]
+    assert pb.mesh_resolved["rung"] == "single_device"
+    assert pb.degradation_history[0]["from"] == "pod"
+
+
+def test_missing_peer_degrades_coordinator_side(info, monkeypatch):
+    """The acceptance scenario, coordinator side: process 0 is alive
+    but its peer never arrives; the preflight barrier times out and
+    the run degrades instead of aborting inside the coordination
+    service."""
+    monkeypatch.setenv(distributed.ENV_BOOTSTRAP_TIMEOUT, "1.5")
+    baseline = builder.PipelineBuilder(_q(info)).execute()
+    port = _free_port()
+    pb = builder.PipelineBuilder(
+        _q(
+            info,
+            f"processes=2&coordinator=127.0.0.1:{port}&process_id=0",
+        )
+    )
+    got = pb.execute()
+    assert str(got) == str(baseline)
+    assert "peer" in pb.mesh_resolved["pod"]["error"]
+
+
+def test_pod_degradation_falls_to_devices_mesh(info, monkeypatch):
+    """The ladder's middle rung: pod fails, devices= still shards the
+    run over the single-host mesh (pod -> single-host mesh), and both
+    records land in one mesh block."""
+    monkeypatch.setenv(distributed.ENV_BOOTSTRAP_TIMEOUT, "1.5")
+    baseline = builder.PipelineBuilder(_q(info)).execute()
+    port = _free_port()
+    pb = builder.PipelineBuilder(
+        _q(
+            info,
+            "devices=8",
+            f"processes=2&coordinator=127.0.0.1:{port}&process_id=1",
+        )
+    )
+    got = pb.execute()
+    assert str(got) == str(baseline)
+    assert pb.mesh_resolved["rung"] == "mesh"  # the single-host mesh
+    assert pb.mesh_resolved["shape"] == {"data": 8}
+    assert pb.mesh_resolved["pod"]["rung"] == "single_host"
+    assert "error" in pb.mesh_resolved["pod"]
+
+
+def test_pod_grammar_errors(info):
+    for bad in (
+        "processes=0",
+        "process_id=1",  # without processes=
+        "processes=2&process_id=2",
+        "processes=2&coordinator=nocolon",
+        "processes=2&coordinator=host:notaport",
+        "processes=2&serve=true",
+    ):
+        with pytest.raises(ValueError):
+            builder.PipelineBuilder(_q(info, bad)).execute()
+
+
+def test_precision_refused_on_pod_runs(info):
+    """Non-f32 precision rides a per-run f32-reference gate the
+    partitioned ingest cannot stage; the conflict is loud, not a
+    silently ungated rung."""
+    from eeg_dataanalysispackage_tpu.parallel import pod as pod_mod
+
+    pb = builder.PipelineBuilder(
+        _q(info).replace("fe=dwt-8-fused", "fe=dwt-8-fused-decode")
+        + "&precision=bf16"
+    )
+    fake = pod_mod.PodRuntime(mesh=None, num_processes=2, process_id=0)
+    monkey_resolved = {"called": False}
+
+    original = builder.PipelineBuilder._resolve_pod
+
+    def fake_resolve(self, request):
+        monkey_resolved["called"] = True
+        return fake
+
+    builder.PipelineBuilder._resolve_pod = fake_resolve
+    try:
+        with pytest.raises(ValueError, match="pod runs compute f32"):
+            pb.execute()
+    finally:
+        builder.PipelineBuilder._resolve_pod = original
+    assert monkey_resolved["called"]

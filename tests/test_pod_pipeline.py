@@ -1,0 +1,116 @@
+"""Pod-scale multi-process execution (ISSUE 14), live half.
+
+Two OS processes over a loopback coordinator run ONE population query
+through the real pipeline: ``_resolve_pod`` bootstraps via the
+preflight + ``jax.distributed.initialize``, each process ingests its
+disjoint recording block, the feature exchange all-gathers the global
+matrix over the gloo-backed DCN stand-in, and
+``train_linear_population_sharded`` trains the member axis over the
+hybrid (hosts x data) mesh. The pinned contract: both processes'
+``ClassificationStatistics`` are byte-identical to the single-process
+run of the same query, the mesh block records
+{processes, process_id, coordinator, dcn_shape}, and the compiled HLO
+of both the exchange and the weight gather carries the cross-process
+all-gather (asserted inside the workers, where the multi-process
+programs exist).
+"""
+
+import hashlib
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+import _synthetic
+from eeg_dataanalysispackage_tpu.pipeline import builder
+
+_POP_QUERY = (
+    "fe=dwt-8-fused-decode&train_clf=logreg&cv=2&sweep=lr:1.0,0.5"
+    "&cache=false&dedup=false&config_num_iterations=12"
+    "&config_step_size=1.0&config_mini_batch_fraction=1.0"
+)
+
+
+@pytest.fixture(scope="module")
+def info(tmp_path_factory):
+    directory = tmp_path_factory.mktemp("pod_pipe")
+    lines = []
+    for i in range(2):
+        name = f"podp_{i}"
+        guessed = 2 + i
+        _synthetic.write_recording(
+            str(directory), name=name, n_markers=60, guessed=guessed,
+            seed=i,
+        )
+        lines.append(f"{name}.eeg {guessed}")
+    info = os.path.join(str(directory), "info.txt")
+    with open(info, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return info
+
+
+def test_two_process_pipeline_statistics_byte_identical(info):
+    baseline = builder.PipelineBuilder(
+        f"info_file={info}&{_POP_QUERY}"
+    ).execute()
+    baseline_sha = hashlib.sha256(str(baseline).encode()).hexdigest()
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    worker = os.path.join(os.path.dirname(__file__), "_pod_worker.py")
+    procs = []
+    for pid in range(2):
+        query = (
+            f"info_file={info}&{_POP_QUERY}"
+            f"&processes=2&coordinator=127.0.0.1:{port}"
+            f"&process_id={pid}"
+        )
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        env["EEG_TPU_NO_FEATURE_CACHE"] = "1"
+        env.pop("EEG_TPU_FAULTS", None)
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, worker, query],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
+            outs.append(json.loads(out.strip().splitlines()[-1]))
+    finally:
+        for p in procs:  # reap stragglers if a peer failed or hung
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+    for pid, o in enumerate(outs):
+        # the pinned byte-identity: 2-process == single-process
+        assert o["sha"] == baseline_sha, o
+        assert o["procs"] == 2 and o["devices"] == 4
+        mesh = o["mesh"]
+        assert mesh["rung"] == "pod"
+        assert mesh["processes"] == 2
+        assert mesh["process_id"] == pid
+        assert mesh["coordinator"] == f"127.0.0.1:{port}"
+        assert mesh["dcn_shape"] == {"hosts": 2}
+        assert mesh["shape"] == {"hosts": 2, "data": 2}
+        # the population trained SHARDED over the pod's member axis
+        assert mesh["population"]["rung"] == "mesh"
+        assert mesh["population"]["axis"] == "hosts,data"
+        assert o["degradation"] == []
+        # the cross-process collectives exist in the compiled HLO
+        assert o["exchange_allgather"] is True
+        assert o["weight_allgather"] is True

@@ -39,6 +39,13 @@ run train_step    600 python tools/ingest_bench.py train_step 131072 20
 run sharded_ingest 900 python tools/ingest_bench.py sharded_ingest 32768 10
 run population_sharded 900 python tools/pipeline_bench.py population_sharded 800 2
 run population_vmap_twin 900 python tools/pipeline_bench.py population_vmap 800 2
+# pod-scale rows (ISSUE 14): the 2-process loopback harness measures
+# the multi-process machinery on this host (parity + degraded rung);
+# on a REAL pod slice, run the same population query with the
+# launcher's JAX_COORDINATOR/JAX_NUM_PROCESSES/JAX_PROCESS_ID env on
+# every host instead — those rows are the ~1/N wall-time evidence the
+# PR 9 decision path consumes (artifact lands -> default flips)
+run population_multiproc 1800 python tools/pipeline_bench.py population_multiproc 800 2
 # the int8 precision rung's gate decision on chip (the precision
 # block + gate_seconds ride the line)
 run pipeline_int8 900 python tools/pipeline_bench.py pipeline_e2e_int8 2000 4

@@ -444,6 +444,49 @@ def _check_mesh(sharded: dict, sharded1: dict, vmap_line: dict,
         )
 
 
+def _check_multiproc(line: dict, failures: list) -> None:
+    """The pod-scale gate (ISSUE 14): the 2-process loopback run is
+    byte-identical to its single-process twin (parity sha), the mesh
+    block carries the pod coordinates and the SHARDED population
+    rung, and the degraded-coordinator run lands the single-host rung
+    with its evidence — without failing."""
+    block = line.get("multiproc") or {}
+    if not block.get("parity_sha_ok"):
+        failures.append(
+            f"multiproc: 2-process statistics drifted from the "
+            f"single-process twin: {block}"
+        )
+    mesh = block.get("mesh") or {}
+    if mesh.get("rung") != "pod":
+        failures.append(
+            f"multiproc: run did not land the pod rung: {mesh}"
+        )
+    if mesh.get("dcn_shape") != {"hosts": 2} or mesh.get(
+        "processes"
+    ) != 2 or not mesh.get("coordinator"):
+        failures.append(
+            f"multiproc: pod coordinates missing from the mesh "
+            f"block: {mesh}"
+        )
+    pop = mesh.get("population") or {}
+    if pop.get("rung") != "mesh" or not pop.get("members_per_device"):
+        failures.append(
+            f"multiproc: population did not shard over the pod: {pop}"
+        )
+    if not block.get("members_per_s"):
+        failures.append(f"multiproc: no members/sec recorded: {block}")
+    degraded = block.get("degraded_coordinator") or {}
+    if (
+        degraded.get("rung") != "single_host"
+        or not degraded.get("error_present")
+        or not degraded.get("parity_ok")
+    ):
+        failures.append(
+            f"multiproc: degraded-coordinator run did not land the "
+            f"single-host rung with evidence + parity: {degraded}"
+        )
+
+
 def _check_seizure(line: dict, report_dir: str,
                    failures: list) -> None:
     """The seizure-workload gate: an imbalanced synthetic set, the
@@ -782,6 +825,15 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
             data_dir, os.path.join(tmp, "cache_pop"),
             report_dirs["pop_sharded1"], extra=["--devices=1"],
         )
+        # the pod gate (ISSUE 14): 2-process loopback pod vs its
+        # single-process twin + the degraded-coordinator run, all
+        # spawned inside the child (report_dir=None — the workers are
+        # their own processes)
+        multiproc_line = _run_variant(
+            "population_multiproc", n_markers, n_files,
+            data_dir, os.path.join(tmp, "cache_multiproc"), None,
+        )
+        _check_multiproc(multiproc_line, failures)
         serve_report_dir = os.path.join(tmp, "report_serve")
         serve_line = _run_serve_bench(
             min(n_markers, 400), n_files, serve_report_dir
@@ -1021,6 +1073,7 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
                 f"population_{tag} trained {members} members, not 16"
             )
 
+    multiproc_block = multiproc_line.get("multiproc") or {}
     return {
         "ok": not failures,
         "failures": failures,
@@ -1111,6 +1164,14 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
             ),
         },
         "plateau": plateau_summary,
+        "multiproc_parity_ok": multiproc_block.get("parity_sha_ok"),
+        "multiproc_members_per_s": multiproc_block.get("members_per_s"),
+        "multiproc_twin_members_per_s": multiproc_block.get(
+            "twin_members_per_s"
+        ),
+        "multiproc_degraded_rung": (
+            multiproc_block.get("degraded_coordinator") or {}
+        ).get("rung"),
         "scheduler_concurrent_speedup": (
             scheduler_line.get("scheduler") or {}
         ).get("concurrent_speedup"),

@@ -172,6 +172,20 @@ def show(path: str) -> None:
         if mesh.get("error"):
             line += f"  error={mesh['error']}"
         print(line)
+        # pod coordinates: live (top-level fields on the pod rung) or
+        # requested-but-degraded (the pod sub-block with its evidence)
+        pod = mesh.get("pod") or {}
+        if mesh.get("rung") == "pod" or pod:
+            src = pod or mesh
+            pod_line = (
+                f"           pod processes={src.get('processes')} "
+                f"process_id={src.get('process_id')} "
+                f"coordinator={src.get('coordinator')} "
+                f"dcn_shape={mesh.get('dcn_shape')}"
+            )
+            if pod.get("error"):
+                pod_line += f"  error={pod['error']}"
+            print(pod_line)
         pop_mesh = mesh.get("population") or {}
         if pop_mesh:
             print(
@@ -325,6 +339,8 @@ def diff(path_a: str, path_b: str) -> None:
             "rung": mesh.get("rung"),
             "shape": mesh.get("shape"),
             "members_per_device": pop.get("members_per_device"),
+            "processes": mesh.get("processes")
+            or (mesh.get("pod") or {}).get("processes"),
         }
 
     ma, mb = _mesh_digest(a), _mesh_digest(b)

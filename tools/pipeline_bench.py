@@ -60,6 +60,19 @@ Variants:
                         run is its same-machine single-device twin,
                         and report_sha256 equality across the pair is
                         the sharded==vmap statistics contract
+  population_multiproc  the same member set as a 2-PROCESS loopback
+                        pod (processes=2 over a gloo coordinator;
+                        each process ingests its disjoint recording
+                        half, the member axis spans both processes'
+                        virtual devices) vs its single-process twin
+                        in an equally fresh process — the multiproc
+                        block carries members/sec for both, the
+                        statistics-parity sha verdict, the pod mesh
+                        block, and the degraded-coordinator run
+                        (unreachable coordinator -> single-host rung,
+                        parity held). On one box the ratio measures
+                        harness overhead; on a pod slice the staged
+                        chip rows are the ~1/N evidence
   seizure_e2e           the continuous-EEG seizure workload
                         (task=seizure, docs/workloads.md): sliding-
                         window epoching over a synthetic annotated
@@ -603,6 +616,157 @@ def _await_plan(base: str, plan_id: str, deadline_s: float = 600.0):
         time.sleep(0.05)
 
 
+def _spawn_multiproc_worker(query: str, timeout_s: str = "60"):
+    """One fresh pipeline process for the population_multiproc family:
+    2 virtual CPU devices, gloo collectives (set by the worker branch
+    before the backend initializes), feature cache off (the pod path
+    bypasses it anyway — the twin must match)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["EEG_TPU_NO_FEATURE_CACHE"] = "1"
+    env["EEG_TPU_POD_TIMEOUT_S"] = timeout_s
+    env.pop("EEG_TPU_FAULTS", None)
+    env.pop("EEG_TPU_RUN_REPORT_DIR", None)
+    # the query alone decides each worker's pod membership: a pod
+    # launcher's exported env twins must not leak into the twin or
+    # the degraded worker (they would resolve a pod the variant never
+    # asked for and burn the bootstrap timeout)
+    for var in (
+        "JAX_NUM_PROCESSES", "JAX_COORDINATOR",
+        "JAX_COORDINATOR_ADDRESS", "JAX_PROCESS_ID",
+    ):
+        env.pop(var, None)
+    return subprocess.Popen(
+        [
+            sys.executable, os.path.abspath(__file__),
+            "multiproc_worker", "0", "0", f"--query={query}",
+        ],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+
+
+def _reap_worker(proc, timeout=600) -> dict:
+    out, err = proc.communicate(timeout=timeout)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"multiproc worker failed (rc {proc.returncode}): "
+            f"{err[-1500:]}"
+        )
+    return json.loads(out.strip().splitlines()[-1])
+
+
+def run_population_multiproc(info: str) -> dict:
+    """The pod-scale measurement (ISSUE 14): the population_vmap
+    member set run as a 2-process loopback pod (per-host partitioned
+    ingest feeding the global member axis over the gloo DCN stand-in)
+    against its single-process twin on the SAME data in an equally
+    fresh process — members/sec ratio and the statistics-parity sha
+    ride the line, plus the degraded-coordinator run (unreachable
+    coordinator -> single-host rung, plan completes, parity holds).
+
+    On a one-host box both pod processes share the machine, so the
+    ratio measures harness overhead honestly (expect ~1x or below);
+    on a real pod slice each process owns its chips and the same rows
+    are the ~1/N evidence (tools/collect_chip_runs.sh stages them).
+    """
+    import socket as _socket
+
+    base_query = build_population_query(info, "vmap") + "&dedup=false"
+
+    def _free_port_pair() -> int:
+        """A port whose NEIGHBOR is also bindable — the preflight
+        rendezvouses on coordinator port + 1, so both must be free.
+        (Still a close-then-use window, but probing the pair removes
+        the common collision: an ephemeral port whose neighbor is a
+        listening service.)"""
+        for _ in range(16):
+            s = _socket.socket()
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            try:
+                s2 = _socket.socket()
+                try:
+                    s2.bind(("", port + 1))
+                except OSError:
+                    continue
+                s2.close()
+                return port
+            finally:
+                s.close()
+        raise RuntimeError("no free coordinator port pair found")
+
+    port = _free_port_pair()
+
+    workers = [
+        _spawn_multiproc_worker(
+            base_query
+            + f"&processes=2&coordinator=127.0.0.1:{port}"
+            + f"&process_id={pid}"
+        )
+        for pid in range(2)
+    ]
+    twin_proc = _spawn_multiproc_worker(base_query)
+    results = [_reap_worker(p) for p in workers]
+    twin = _reap_worker(twin_proc)
+
+    # the degraded-coordinator run: nobody listens on a fresh port,
+    # the preflight times out inside the bootstrap budget, the run
+    # lands the single-host rung and still matches the twin
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_port = s.getsockname()[1]
+    s.close()
+    degraded = _reap_worker(
+        _spawn_multiproc_worker(
+            base_query
+            + f"&processes=2&coordinator=127.0.0.1:{dead_port}"
+            + "&process_id=1",
+            timeout_s="3",
+        )
+    )
+
+    members = int(results[0].get("members") or 0)
+    pod_train_s = max(r.get("train_s") or 0.0 for r in results)
+    twin_train_s = twin.get("train_s") or 0.0
+    deg_pod = (degraded.get("mesh") or {}).get("pod") or {}
+    block = {
+        "processes": 2,
+        "members": members,
+        "parity_sha_ok": bool(
+            results[0]["sha"] == results[1]["sha"] == twin["sha"]
+        ),
+        "members_per_s": (
+            round(members / pod_train_s, 2) if pod_train_s > 0 else 0.0
+        ),
+        "twin_members_per_s": (
+            round(members / twin_train_s, 2) if twin_train_s > 0 else 0.0
+        ),
+        "speedup_vs_twin": (
+            round(twin_train_s / pod_train_s, 3)
+            if pod_train_s > 0 and twin_train_s > 0
+            else None
+        ),
+        "mesh": results[0].get("mesh"),
+        "degraded_coordinator": {
+            "rung": deg_pod.get("rung"),
+            "error_present": bool(deg_pod.get("error")),
+            "parity_ok": bool(degraded["sha"] == twin["sha"]),
+        },
+    }
+    return {
+        "workers": results,
+        "twin": twin,
+        "multiproc": block,
+        "wall_s": max(r["wall_s"] for r in results),
+        "epochs": int(results[0].get("epochs") or 0),
+        "report_sha256": twin["sha"],
+    }
+
+
 def run_plan_service(info: str, scratch: str) -> dict:
     """The plan_service measurement: the shared-prefix dedup pair over
     HTTP (exactly one prefix build, both statistics byte-identical to
@@ -864,6 +1028,7 @@ def main(argv) -> dict:
     n_markers = int(argv[1]) if len(argv) > 1 else 240
     n_files = int(argv[2]) if len(argv) > 2 else 3
     data_dir = cache_dir = report_dir = journal_dir = None
+    worker_query = None
     train_clf = "logreg"
     fe = "dwt-8-fused"
     devices = 8
@@ -893,6 +1058,10 @@ def main(argv) -> dict:
             # scheduler_suicide's write-ahead journal location (the
             # parent scheduler_multi run recovers from it)
             journal_dir = arg.split("=", 1)[1]
+        elif arg.startswith("--query="):
+            # multiproc_worker's full pipeline query (spawned by
+            # population_multiproc with the pod knobs composed in)
+            worker_query = arg.split("=", 1)[1]
         else:
             raise SystemExit(f"unknown argument {arg!r}")
     if variant not in (
@@ -900,10 +1069,35 @@ def main(argv) -> dict:
         "pipeline_e2e_overlap", "pipeline_e2e_bf16",
         "pipeline_e2e_int8",
         "population_vmap", "population_looped", "population_sharded",
+        "population_multiproc", "multiproc_worker",
         "seizure_e2e", "scheduler_multi", "scheduler_suicide",
         "plan_service", "populate",
     ):
         raise SystemExit(f"unknown variant {variant!r}")
+
+    if variant == "multiproc_worker":
+        # one pod (or twin) process: the query's own processes= knobs
+        # drive the bootstrap inside the builder, which configures
+        # the gloo CPU collectives itself once the preflight passes —
+        # so the twin and the degraded-coordinator runs initialize a
+        # plain single-process backend
+        statistics, wall, n_epochs, stages, extras = run_query(
+            worker_query
+        )
+        try:
+            members = len(statistics)
+        except TypeError:
+            members = 1
+        return {
+            "sha": hashlib.sha256(
+                str(statistics).encode()
+            ).hexdigest(),
+            "wall_s": round(wall, 3),
+            "train_s": stages.get("train", {}).get("seconds", 0.0),
+            "epochs": n_epochs,
+            "members": members,
+            "mesh": extras.get("mesh"),
+        }
 
     if variant == "population_sharded" and "jax" not in sys.modules:
         # the real multi-device program needs real devices: on the CPU
@@ -1009,6 +1203,46 @@ def main(argv) -> dict:
             "report_sha256": sched["concurrent"]["per_plan"][
                 min(sched["concurrent"]["per_plan"])
             ]["statistics_sha256"],
+        }
+
+    if variant == "population_multiproc":
+        result = run_population_multiproc(info)
+        import jax
+
+        from eeg_dataanalysispackage_tpu.io import feature_cache
+        from eeg_dataanalysispackage_tpu.ops import plan_cache
+        from eeg_dataanalysispackage_tpu.utils import compile_cache
+
+        pstats = plan_cache.stats()
+        wall = result["wall_s"]
+        n_epochs = result["epochs"]
+        return {
+            "variant": variant,
+            # the headline rate is the POD run's: epochs through the
+            # 2-process partitioned ingest per wall second (each
+            # process read half the bytes; the twin's rate and the
+            # members/sec ratio are in the multiproc block)
+            "epochs_per_s": round(n_epochs / wall, 1) if wall else 0.0,
+            "n": n_epochs,
+            "iters": 1,
+            "wall_s": wall,
+            "elapsed_s": wall,
+            "bytes_per_epoch": _BYTES_PER_EPOCH,
+            "bytes_per_s": round(
+                (n_epochs / wall) * _BYTES_PER_EPOCH, 1
+            ) if wall else 0.0,
+            "n_markers_per_file": n_markers,
+            "n_files": n_files,
+            "platform": jax.devices()[0].platform,
+            "feature_cache": feature_cache.stats(),
+            "plan_cache": {
+                "hits": pstats["hits"], "misses": pstats["misses"],
+            },
+            "compile_cache": compile_cache.active_cache_dir(),
+            "mesh": result["multiproc"].get("mesh"),
+            "members_per_s": result["multiproc"]["members_per_s"],
+            "multiproc": result["multiproc"],
+            "report_sha256": result["report_sha256"],
         }
 
     if variant == "plan_service":
