@@ -108,6 +108,14 @@ train-step variants (tools/ingest_bench.py) with HBM-roofline context:
                   prediction parity pins, the within-bucket margin
                   bit-identity pin, the engine's mega warmup-gate
                   record, and the int8 rung's gate decision
+  serve_lifecycle the model lifecycle manager (serve/lifecycle.py
+                  via tools/serve_bench.py): each concurrency level
+                  swept steady-state then again with a feedback feeder
+                  racing it (partial-fit chunks + a gated promotion
+                  land mid-traffic) — per-level p50/p99 + preds/sec
+                  pairs with the across-promotion p99 ratio, the
+                  no-swap and promoted==batch parity pins, and the
+                  serve.swap/serve.adapt chaos soak
   pipeline_e2e_int8
                   the cold query with precision=int8 (per-subband
                   feature quantization behind the per-run gate — the
@@ -200,6 +208,10 @@ _VARIANT_TIMEOUTS = {
     # the serve megakernel compiles through Mosaic on accelerators —
     # same fresh-compile class
     "serve_mega": _SLOW_COMPILE_TIMEOUT_S,
+    # the lifecycle child warms FOUR services (each compiling the
+    # fused program cold) plus the partial-fit chunk program and a
+    # full adapt pipeline run — same fresh-compile class
+    "serve_lifecycle": _SLOW_COMPILE_TIMEOUT_S,
     # four fresh pipeline processes (2 pod workers + twin + degraded
     # run) in one child — the wall is ~4 population_vmap runs
     "population_multiproc": _SLOW_COMPILE_TIMEOUT_S,
@@ -211,7 +223,7 @@ _VARIANT_TIMEOUTS = {
 # patience — on a warm compile cache everything fits easily; on a
 # cold cache the tail variants may be budget-skipped (recorded as
 # such, artifact intact). BENCH_TOTAL_BUDGET overrides.
-_N_VARIANTS = 28  # asserted against the variant tables below
+_N_VARIANTS = 29  # asserted against the variant tables below
 _TOTAL_BUDGET_S = int(
     os.environ.get(
         "BENCH_TOTAL_BUDGET",
@@ -303,6 +315,11 @@ _VARIANTS_TPU = {
     # the serve-path megakernel vs its fused twin, back-to-back in
     # one process (per-level rung attribution + parity pins)
     "serve_mega": (2000, 2),
+    # the model lifecycle manager (serve/lifecycle.py): swap under
+    # load (steady vs under-adapt p50/p99 per level, swaps counted on
+    # the line), the no-swap + promoted==batch parity pins, and the
+    # serve.swap/serve.adapt chaos soak
+    "serve_lifecycle": (2000, 2),
     # the multi-tenant plan executor (markers per file, file count —
     # tools/pipeline_bench.py scheduler_multi): 4 plans sequential vs
     # concurrent over shared caches, per-plan isolated attribution,
@@ -341,6 +358,7 @@ _VARIANTS_CPU = {
     "seizure_e2e": (60000, 2),
     "serve_bench": (400, 2),
     "serve_mega": (400, 2),
+    "serve_lifecycle": (400, 2),
     "scheduler_multi": (2000, 4),
     "plan_service": (2000, 4),
 }

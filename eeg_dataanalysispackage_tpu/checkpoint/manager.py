@@ -202,6 +202,21 @@ class CheckpointManager:
         state = jax.tree_util.tree_map(_restage, template, host_state)
         return state, metadata
 
+    def read_metadata(self, step: Optional[int] = None) -> Dict:
+        """The ``metadata.json`` payload for ``step`` (default:
+        latest) WITHOUT deserializing the state — callers that need a
+        shape or a counter out of ``extra`` before they can build a
+        restore template (the serving lifecycle's candidate buffers)
+        read it here."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoints under {self.directory}"
+                )
+        with open(os.path.join(self._step_dir(step), "metadata.json")) as f:
+            return json.load(f)
+
     def clear(self) -> None:
         """Delete every checkpoint under the directory.
 

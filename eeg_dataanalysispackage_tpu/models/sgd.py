@@ -218,6 +218,77 @@ def _run_sgd_chunk(
     return carry
 
 
+def partial_fit_carry(n_features: int, weights=None):
+    """A fresh ``(w, converged, n_updates)`` chunk carry for the
+    streaming partial-fit surface: zero weights by default, or a warm
+    start from an existing float32 weight vector (the serving
+    lifecycle stages its candidate from the live model's weights)."""
+    w = (
+        jnp.zeros((int(n_features),), jnp.float32)
+        if weights is None
+        else jnp.asarray(weights, jnp.float32)
+    )
+    return (w, jnp.asarray(False), jnp.asarray(0, jnp.int32))
+
+
+def partial_fit_linear(
+    carry,
+    t_start: int,
+    features,
+    labels,
+    config: SGDConfig,
+    n_iterations: int,
+    sample_mask=None,
+):
+    """One streaming partial-fit chunk: iterations ``t_start+1 ..
+    t_start+n_iterations`` of the MLlib-SGD scan over the CURRENT
+    (bounded) feedback matrix, resuming from ``carry``.
+
+    This is the serving lifecycle's training seam (serve/lifecycle.py)
+    over :func:`_run_sgd_chunk`: absolute iteration indices keep the
+    per-iteration step sizes and Bernoulli keys on the one true
+    trajectory, so a SIGKILL'd adapter that restores its checkpointed
+    carry and replays the remaining chunks produces byte-identical
+    weights. ``features`` has a STATIC row capacity with
+    ``sample_mask`` marking the live rows (the population engine's
+    inert-member seam), so a growing feedback buffer retriggers zero
+    recompiles; ``t_start`` rides traced for the same reason. The
+    ``sgd_invocation`` kwargs discipline applies: unweighted configs
+    omit the weight kwargs, building the byte-identical pre-knob
+    program.
+
+    Returns the new ``(w, converged, n_updates)`` carry.
+    """
+    weight_kwargs = (
+        dict(
+            weighted=True,
+            weight_pos=float(config.weight_pos),
+            weight_neg=float(config.weight_neg),
+        )
+        if config.weighted
+        else {}
+    )
+    return _run_sgd_chunk(
+        carry,
+        jnp.asarray(t_start, jnp.int32),
+        jnp.asarray(features, jnp.float32),
+        jnp.asarray(labels, jnp.float32),
+        float(config.step_size),
+        float(config.mini_batch_fraction),
+        float(config.reg_param),
+        int(config.seed),
+        float(config.convergence_tol),
+        n_iterations=int(n_iterations),
+        loss=config.loss,
+        full_batch=config.mini_batch_fraction >= 1.0,
+        sample_mask=(
+            None if sample_mask is None
+            else jnp.asarray(sample_mask, jnp.float32)
+        ),
+        **weight_kwargs,
+    )
+
+
 def sgd_invocation(x_arr, y_arr, config: SGDConfig, sample_mask=None):
     """(jitted, args, kwargs) for the engine exactly as
     :func:`train_linear` invokes it — the single source of the

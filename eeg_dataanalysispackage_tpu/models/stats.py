@@ -10,6 +10,8 @@ confusion matrix = 4-way bincount).
 from __future__ import annotations
 
 import math
+import threading as _threading
+from collections import deque as _deque
 from typing import Optional
 
 import numpy as np
@@ -217,6 +219,103 @@ class ClassificationStatistics:
             f"Expected cost (fp={self.cost_fp}, fn={self.cost_fn}): "
             f"{self.expected_cost()}\n"
         )
+
+
+class WindowedStatistics:
+    """Bounded sliding window of served (prediction, label) outcomes.
+
+    The serving lifecycle's gate/drift currency (serve/lifecycle.py):
+    expected cost and recall over the most recent ``window`` labeled
+    outcomes, so a drifting electrode montage shows up in the window
+    while a week-old baseline cannot dilute it. Purely host-side and
+    deterministic — the same outcome stream produces the same windowed
+    numbers in any process, which is what makes the promotion gate and
+    the drift signal replayable evidence rather than a mood. Reads and
+    writes are lock-guarded: the serving adapter thread appends while
+    monitors snapshot a live service's stats block.
+    """
+
+    def __init__(self, window: int, cost_fp: float = 1.0,
+                 cost_fn: float = 1.0):
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        self.window = int(window)
+        self.cost_fp = float(cost_fp)
+        self.cost_fn = float(cost_fn)
+        #: (prediction, label) pairs, oldest first, len <= window
+        self._outcomes: "deque" = _deque(maxlen=self.window)
+        self._lock = _threading.Lock()
+        #: total outcomes ever added (the window position — drift
+        #: firing is rate-limited against this, not wall time)
+        self.seen = 0
+
+    def add(self, prediction: float, label: float) -> None:
+        with self._lock:
+            self._outcomes.append(
+                (_java_round(float(prediction)),
+                 _java_round(float(label)))
+            )
+            self.seen += 1
+
+    def reset(self) -> None:
+        """Forget the window (a model swap starts a fresh record —
+        the new model must earn its own numbers)."""
+        with self._lock:
+            self._outcomes.clear()
+
+    @property
+    def n(self) -> int:
+        with self._lock:
+            return len(self._outcomes)
+
+    @property
+    def full(self) -> bool:
+        return self.n >= self.window
+
+    def counts(self) -> tuple:
+        """(tp, tn, fp, fn) over the window."""
+        with self._lock:
+            outcomes = list(self._outcomes)
+        tp = tn = fp = fn = 0
+        for r, e in outcomes:
+            if e == 1:
+                if r == 1:
+                    tp += 1
+                else:
+                    fn += 1
+            else:
+                if r == 0:
+                    tn += 1
+                else:
+                    fp += 1
+        return tp, tn, fp, fn
+
+    def expected_cost(self) -> float:
+        tp, tn, fp, fn = self.counts()
+        total = tp + tn + fp + fn
+        if total == 0:
+            return math.nan
+        return (self.cost_fp * fp + self.cost_fn * fn) / total
+
+    def recall(self) -> float:
+        tp, _tn, _fp, fn = self.counts()
+        denom = tp + fn
+        return math.nan if denom == 0 else tp / denom
+
+    def summary(self) -> dict:
+        tp, tn, fp, fn = self.counts()
+        cost = self.expected_cost()
+        recall = self.recall()
+        return {
+            "window": self.window,
+            "n": self.n,
+            "seen": self.seen,
+            "tp": tp, "tn": tn, "fp": fp, "fn": fn,
+            "expected_cost": (
+                None if math.isnan(cost) else round(cost, 6)
+            ),
+            "recall": None if math.isnan(recall) else round(recall, 6),
+        }
 
 
 class PopulationStatistics(dict):

@@ -51,6 +51,14 @@ Known points (the contract between specs and the codebase):
 ``serve.batch``     one micro-batch execution of the serving
                     program (serve/batcher.py) — exercises the
                     deadline-aware batch retry path
+``serve.adapt``     one partial-fit chunk of the serving lifecycle's
+                    adapter (serve/lifecycle.py) — the chunk retries
+                    (bounded) then drops, counted; the request path
+                    is untouched
+``serve.swap``      one promotion attempt of a staged candidate
+                    (serve/lifecycle.py) — a failed swap leaves the
+                    live model untouched and the candidate retained
+                    (the gate retries after the next batch)
 ``scheduler.plan``  one execution attempt of a submitted plan inside
                     the multi-tenant executor (scheduler/runtime.py) —
                     the executor's per-plan retry budget absorbs it

@@ -230,6 +230,13 @@ class RunTelemetry:
         #: drain state — one block for ``serve=true`` runs; None when
         #: the run served nothing
         self.serve: Optional[Dict[str, Any]] = None
+        #: model-lifecycle attribution (serve/lifecycle.py): feedback
+        #: and partial-fit counters, the candidate's shadow window,
+        #: swap-gate decisions, swaps/rollbacks/drift events, and the
+        #: checkpoint/promoted-artifact state — one block for
+        #: ``adapt=true`` serve runs; None when the run had no
+        #: lifecycle manager (the default, schema-stable)
+        self.lifecycle: Optional[Dict[str, Any]] = None
         #: workload attribution (pipeline/builder.py ``task=`` modes):
         #: the seizure runs record their epoching geometry (window/
         #: stride/label_overlap), class balance, and cost knobs here;
@@ -312,6 +319,7 @@ class RunTelemetry:
             "backend": dict(self.backend),
             "population": self.population,
             "serve": self.serve,
+            "lifecycle": self.lifecycle,
             "workload": self.workload,
             "precision": self.precision,
             "overlap": self.overlap,

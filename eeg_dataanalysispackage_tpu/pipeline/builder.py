@@ -261,6 +261,12 @@ class PipelineBuilder:
                     )
                 )
                 if self.telemetry is not None:
+                    # the lifecycle block is a top-level report field;
+                    # popped so the serve block doesn't carry a
+                    # second copy of the same dict
+                    self.telemetry.lifecycle = serve_block.pop(
+                        "lifecycle", None
+                    )
                     self.telemetry.serve = serve_block
                     self.telemetry.workload = workload
                 return self._finish_run(statistics, query_map)
@@ -288,6 +294,10 @@ class PipelineBuilder:
                 query_map, make_provider, self._stage
             )
             if self.telemetry is not None:
+                # one copy in the report: lifecycle is its own block
+                self.telemetry.lifecycle = serve_block.pop(
+                    "lifecycle", None
+                )
                 self.telemetry.serve = serve_block
             return self._finish_run(statistics, query_map)
 

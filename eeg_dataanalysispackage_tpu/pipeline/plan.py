@@ -108,6 +108,17 @@ class ExecutionPlan:
     task: str  # "p300" | "seizure"
     serve: bool
 
+    # -- serving lifecycle (serve/lifecycle.py) --------------------------
+    #: ``adapt=true``: stream labeled feedback through the resident
+    #: service's lifecycle manager (partial-fit + shadow swap + drift)
+    adapt: bool
+    #: the ``swap_gate=`` promotion policy string, grammar-validated
+    #: ("off" | "cost[:<ratio>]"), or None (the default cost gate)
+    swap_gate: Optional[str]
+    #: windowed-statistics size for the gate/drift windows, or None
+    #: (the lifecycle default)
+    drift_window: Optional[int]
+
     # -- features --------------------------------------------------------
     fe: Optional[str]
     fused: bool
@@ -302,6 +313,54 @@ class ExecutionPlan:
 
         serve = query_map.get("serve") == "true"
 
+        # 1b. the serving-lifecycle knob family (serve/lifecycle.py):
+        # grammar here, behavior in the executor — a typo'd gate must
+        # never silently promote (or silently fail to)
+        adapt_value = query_map.get("adapt", "")
+        if adapt_value not in ("", "true", "false"):
+            _raise(
+                f"adapt= must be true or false, got {adapt_value!r}"
+            )
+        adapt = adapt_value == "true"
+        if adapt and not serve:
+            _raise(
+                "adapt=true streams labeled feedback through the "
+                "resident serving service; it requires serve=true"
+            )
+        swap_gate = query_map.get("swap_gate") or None
+        if swap_gate is not None:
+            if not adapt:
+                _raise(
+                    "swap_gate= gates lifecycle promotions; it "
+                    "requires adapt=true"
+                )
+            from ..serve import lifecycle as _lifecycle
+
+            try:
+                _lifecycle.parse_swap_gate(swap_gate)
+            except ValueError as e:
+                _raise(str(e))
+        drift_window = _int_param(query_map, "drift_window")
+        if drift_window is not None:
+            if not adapt:
+                _raise(
+                    "drift_window= sizes the lifecycle's windowed "
+                    "statistics; it requires adapt=true"
+                )
+            if drift_window < 1:
+                _raise(
+                    f"drift_window= must be >= 1, got {drift_window}"
+                )
+        for knob in ("adapt_batch", "adapt_iters"):
+            if _int_param(query_map, knob) is not None and not adapt:
+                # the whole knob family is loud without adapt=true —
+                # a forgotten adapt= must never silently serve
+                # without adaptation
+                _raise(
+                    f"{knob}= tunes the lifecycle's partial-fit "
+                    "batches; it requires adapt=true"
+                )
+
         # 2. mesh grammar (the availability half stays with the
         # executor; order matches the monolith — mesh grammar is
         # checked before the task routing), then the multi-process
@@ -482,6 +541,9 @@ class ExecutionPlan:
             input_files=input_files,
             task=task,
             serve=serve,
+            adapt=adapt,
+            swap_gate=swap_gate,
+            drift_window=drift_window,
             fe=fe,
             fused=fused,
             fused_wavelet=fused_wavelet,

@@ -15,9 +15,15 @@ ROADMAP's "millions of users" subsystem:
                deadlines threaded through deadline-aware retries, a
                watchdog that fails requests fast when the batcher
                wedges, graceful drain;
-- ``service``  the lifecycle wrapper (:class:`InferenceService`):
+- ``service``  the resident wrapper (:class:`InferenceService`):
                load a saved classifier once, serve until drained,
                export the ``serve`` telemetry block;
+- ``lifecycle`` the model lifecycle manager: streaming partial-fit
+               over labeled feedback (``submit(..., label=)`` /
+               ``feedback()``), a shadow-scored candidate promoted
+               behind a windowed-statistics gate and rolled back on
+               regression (zero-recompile hot swap), and windowed
+               drift detection (``serve.drift``);
 - ``pipeline`` the ``serve=true`` query mode: drive a batch session
                through the service epoch-by-epoch, statistics pinned
                bit-identical to the batch ``load_clf=`` run.
@@ -35,4 +41,9 @@ from .batcher import (  # noqa: F401
     ShedError,
 )
 from .engine import ServingEngine, windows_from_recording  # noqa: F401
+from .lifecycle import (  # noqa: F401
+    LifecycleConfig,
+    LifecycleManager,
+    parse_swap_gate,
+)
 from .service import InferenceService, ServeConfig  # noqa: F401

@@ -363,6 +363,41 @@ class ServingEngine:
 
     def _execute_fused(self, windows, resolutions):
         n = len(windows)
+        # one snapshot per batch: a lifecycle hot swap
+        # (serve/lifecycle.py) replaces the classifier object between
+        # batches — reading it once makes the batch wholly-old or
+        # wholly-new, never weights from one model with the intercept
+        # of another
+        clf = self.classifier
+        stream, mask = self._stage_fused_stream(windows)
+        # explicit staging so the program can donate the buffer (the
+        # int16 stream is dead after the on-device scale)
+        staged = jax.device_put(stream)
+        res = np.asarray(resolutions, dtype=np.float32)
+        if self._fused_linear:
+            feats, margins = self._program(
+                staged, res, self._positions, mask,
+                clf.weights,
+            )
+            margins = np.asarray(margins[:n]) + clf.intercept
+            predictions = (
+                margins > clf.margin_threshold
+            ).astype(np.float64)
+            return predictions, margins
+        feats, _ = self._program(staged, res, self._positions, mask)
+        predictions = np.asarray(
+            clf.predict(np.asarray(feats)[:n]),
+            dtype=np.float64,
+        )
+        return predictions, None
+
+    def _stage_fused_stream(self, windows):
+        """Lay a micro-batch out as the fused program's synthetic
+        stream: ``(stream, mask)`` with window i at
+        ``[i*window_len, (i+1)*window_len)`` and the mask marking the
+        live rows — the one staging layout shared by execution,
+        :meth:`featurize`, and the warmup gates."""
+        n = len(windows)
         stream = np.zeros(
             (self.n_channels, self.capacity * self.window_len),
             dtype=np.asarray(windows[0]).dtype,
@@ -377,26 +412,7 @@ class ServingEngine:
             stream[:, i * self.window_len:(i + 1) * self.window_len] = w
         mask = np.zeros(self.capacity, dtype=bool)
         mask[:n] = True
-        # explicit staging so the program can donate the buffer (the
-        # int16 stream is dead after the on-device scale)
-        staged = jax.device_put(stream)
-        res = np.asarray(resolutions, dtype=np.float32)
-        if self._fused_linear:
-            feats, margins = self._program(
-                staged, res, self._positions, mask,
-                self.classifier.weights,
-            )
-            margins = np.asarray(margins[:n]) + self.classifier.intercept
-            predictions = (
-                margins > self.classifier.margin_threshold
-            ).astype(np.float64)
-            return predictions, margins
-        feats, _ = self._program(staged, res, self._positions, mask)
-        predictions = np.asarray(
-            self.classifier.predict(np.asarray(feats)[:n]),
-            dtype=np.float64,
-        )
-        return predictions, None
+        return stream, mask
 
     def _execute_mega(self, windows, resolutions):
         """The megakernel rung: the micro-batch laid out at the
@@ -409,6 +425,9 @@ class ServingEngine:
         from ..ops import serve_mega
 
         n = len(windows)
+        # one classifier snapshot per batch (the hot-swap tear guard
+        # _execute_fused documents)
+        clf = self.classifier
         stream = serve_mega.stage_mega_stream(
             windows, self.n_channels, self.window_len,
             self._mega_stride, self.capacity,
@@ -416,10 +435,10 @@ class ServingEngine:
         staged = jax.device_put(stream)
         res = np.asarray(resolutions, dtype=np.float32)
         margins = np.asarray(
-            self._mega_program(staged, res, self.classifier.weights)
-        )[:n] + self.classifier.intercept
+            self._mega_program(staged, res, clf.weights)
+        )[:n] + clf.intercept
         predictions = (
-            margins > self.classifier.margin_threshold
+            margins > clf.margin_threshold
         ).astype(np.float64)
         return predictions, margins
 
@@ -429,6 +448,17 @@ class ServingEngine:
         predict — the reference-shaped path, device-free. Features are
         tolerance-level vs the fused rung (the ladder's contract);
         the service survives a broken device backend."""
+        clf = self.classifier  # the hot-swap tear guard
+        feats = self._host_features(windows, resolutions)
+        predictions = np.asarray(
+            clf.predict(feats), dtype=np.float64
+        )
+        return predictions, None
+
+    def _host_features(self, windows, resolutions) -> np.ndarray:
+        """Host-floor featurization: scale + baseline-correct and run
+        the registry extractor (the reference-shaped path, shared by
+        :meth:`_execute_host` and :meth:`featurize` in host mode)."""
         from ..features import registry as fe_registry
 
         if self._host_fe is None:
@@ -452,13 +482,78 @@ class ServingEngine:
                 # continuous windows (pre=0, the seizure geometry)
                 # have no prestimulus segment to correct against
                 epochs.append(scaled)
-        feats = np.asarray(
+        return np.asarray(
             self._host_fe.extract_batch(np.stack(epochs))
         )
-        predictions = np.asarray(
-            self.classifier.predict(feats), dtype=np.float64
-        )
-        return predictions, None
+
+    def featurize(
+        self,
+        windows: Sequence[np.ndarray],
+        resolutions: np.ndarray,
+    ) -> np.ndarray:
+        """Feature rows for ``windows`` through the engine's OWN path
+        — the fused program where one exists (margins discarded), the
+        host extractor otherwise. The lifecycle's partial-fit seam
+        (serve/lifecycle.py): feedback rows come from the same
+        computation that features served traffic, so a candidate
+        trains on exactly what its shadow scoring judges. Batches
+        larger than the capacity bucket are featurized in capacity-
+        sized slices."""
+        n = len(windows)
+        if n == 0:
+            d = self.n_channels * self.feature_size
+            return np.zeros((0, d), np.float32)
+        if n > self.capacity:
+            parts = [
+                self.featurize(windows[i:i + self.capacity], resolutions)
+                for i in range(0, n, self.capacity)
+            ]
+            return np.concatenate(parts, axis=0)
+        if self._program is None or self._rung == "host":
+            return np.asarray(
+                self._host_features(windows, resolutions), np.float32
+            )
+        stream, mask = self._stage_fused_stream(windows)
+        res = np.asarray(resolutions, dtype=np.float32)
+        args = [jax.device_put(stream), res, self._positions, mask]
+        if self._fused_linear:
+            args.append(self.classifier.weights)
+        feats, _ = self._program(*args)
+        return np.asarray(feats)[:n].astype(np.float32, copy=False)
+
+    def swap_model(self, classifier):
+        """Hot-swap the served model; returns the displaced one.
+
+        The zero-recompile contract: on the fused-linear path the
+        weights ride as a TRACED argument of the compiled program
+        (module docstring), so a replacement with float32 weights of
+        the same shape re-executes the existing executable — the swap
+        is one attribute assignment, an in-flight batch reads the
+        classifier once (:meth:`_execute_fused`) and is served wholly
+        by the old or wholly by the new model, and nothing is dropped.
+        A shape/dtype mismatch is refused loudly: it would retrace
+        inside the batcher, where the watchdog reads a long compile as
+        a wedge."""
+        old = self.classifier
+        if self._fused_linear:
+            w = getattr(classifier, "weights", None)
+            if (
+                w is None
+                or w.dtype != np.float32
+                or w.shape != old.weights.shape
+            ):
+                raise ValueError(
+                    "hot swap requires float32 linear weights of the "
+                    f"live shape {old.weights.shape} (the "
+                    "zero-recompile contract); got "
+                    f"{None if w is None else (w.dtype, w.shape)}"
+                )
+        elif getattr(classifier, "predict", None) is None:
+            raise ValueError(
+                "hot swap requires a classifier with a predict surface"
+            )
+        self.classifier = classifier
+        return old
 
     def warmup(self) -> None:
         """Compile the program before traffic arrives (one dummy
@@ -532,13 +627,7 @@ class ServingEngine:
         ``(features, margins-or-None)`` numpy rows for the live
         windows."""
         n = len(windows)
-        stream = np.zeros(
-            (self.n_channels, self.capacity * self.window_len), np.int16
-        )
-        for i, w in enumerate(windows):
-            stream[:, i * self.window_len:(i + 1) * self.window_len] = w
-        mask = np.zeros(self.capacity, bool)
-        mask[:n] = True
+        stream, mask = self._stage_fused_stream(windows)
         # device_put per call: the program may donate its stream
         feats, margins = program(
             jax.device_put(stream), res, self._positions, mask,

@@ -1,0 +1,982 @@
+"""Model lifecycle manager for the resident serving service.
+
+Both served workloads are non-stationary — electrodes drift and
+subjects fatigue in the P300 speller, and seizure prediction is a
+concept-drift problem by definition — yet the service used to load a
+classifier once and serve it forever. This module closes the
+train/serve loop (ROADMAP item 4) with three cooperating pieces, all
+running OFF the request path on one adapter thread:
+
+- **Streaming partial-fit.** Labeled feedback from served requests
+  (the speller *knows* the true target after each trial;
+  ``InferenceService.submit(..., label=)`` / ``feedback()``)
+  accumulates into bounded batches. Each full batch is featurized
+  through the engine's own program and trains a **candidate** via the
+  resumable elastic chunked-SGD seam (``models/sgd.partial_fit_linear``
+  over ``_run_sgd_chunk`` with absolute iteration indices), warm-
+  started from the live weights. The feedback matrix lives in a
+  fixed-capacity ring with a sample mask (the population engine's
+  inert-row seam), so a growing buffer retriggers **zero recompiles**.
+  Every chunk's carry — weights AND the buffers it trained on —
+  checkpoints through ``checkpoint/manager``, so a SIGKILL'd adapter
+  restores the latest carry and replays the remaining feedback to
+  **byte-identical** candidate weights.
+
+- **Shadow-scored hot swap with rollback.** The candidate is staged
+  next to the live model and shadow-scored on the same labeled
+  traffic (both models' decisions over each feedback batch feed
+  per-model :class:`models.stats.WindowedStatistics`). Promotion is
+  gated: only when the candidate's windowed expected cost beats the
+  live model's under the ``swap_gate=`` policy does
+  :meth:`ServingEngine.swap_model` install it — weights ride as a
+  traced argument (serve/engine.py), so a linear-family swap
+  retriggers **0 compiles** and an in-flight micro-batch is served
+  wholly by the old or wholly by the new model, never dropped or
+  double-served. The displaced model is retained; if the promoted
+  model's windowed cost regresses past the pre-swap record, it is
+  **rolled back** with the evidence counted and event-logged. A
+  candidate that never passes the gate leaves live serving
+  byte-identical to a service that never staged one — the rollback
+  pin (tests/test_lifecycle.py).
+
+- **Drift detection.** The live model's windowed expected cost is
+  judged against the baseline earned by its first full window; a
+  window that degrades past ``drift_factor`` emits a ``serve.drift``
+  event + metric (rate-limited to once per window span) — the signal
+  an operator (or a future auto-recalibration) keys on. Everything
+  lands in the ``lifecycle`` block of ``run_report.json`` and the
+  serve bench lines.
+
+Chaos points ``serve.adapt`` (one partial-fit chunk) and
+``serve.swap`` (one promotion attempt) land in the adapter's retry
+machinery: a failed chunk retries (then drops, counted) and a failed
+swap leaves the live model untouched with the candidate retained —
+under ``faults=serve.swap:p=0.2;serve.adapt:p=0.2`` every request
+still resolves (docs/resilience.md).
+
+State machine (docs/serving.md): ``live`` —feedback→ ``adapting``
+(candidate staged + shadow-scored) —gate pass→ promoted (previous
+model retained) —regression→ rolled back; a wedged adapter step
+(watchdog) discards the candidate and live serving continues.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import logging
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from .batcher import ServiceClosedError
+from ..models import stats as stats_mod
+
+logger = logging.getLogger(__name__)
+
+
+def parse_swap_gate(value: str):
+    """``swap_gate=`` grammar -> ``(mode, ratio)``.
+
+    ``off`` disables promotion (shadow-score only — the no-swap
+    byte-identity mode); ``cost`` promotes when the candidate's
+    windowed expected cost is <= the live model's; ``cost:<ratio>``
+    scales the bar (ratio > 1 is permissive, < 1 strict). Raises
+    ``ValueError`` on anything else — a typo'd gate must never
+    silently promote."""
+    if value == "off":
+        return ("off", None)
+    head, sep, tail = value.partition(":")
+    if head != "cost":
+        raise ValueError(
+            f"swap_gate= must be 'off' or 'cost[:<ratio>]', "
+            f"got {value!r}"
+        )
+    if not sep:
+        return ("cost", 1.0)
+    try:
+        ratio = float(tail)
+    except ValueError:
+        raise ValueError(
+            f"swap_gate= ratio must be a float, got {tail!r}"
+        )
+    if not ratio > 0.0:
+        raise ValueError(
+            f"swap_gate= ratio must be > 0, got {ratio}"
+        )
+    return ("cost", ratio)
+
+
+@dataclasses.dataclass(frozen=True)
+class LifecycleConfig:
+    """Lifecycle knobs; all bounded, all recorded in the block."""
+
+    #: feedback items per partial-fit batch (one chunk per batch)
+    adapt_batch: int = 16
+    #: SGD iterations per chunk (absolute indices continue across
+    #: chunks — the resumable-trajectory seam)
+    adapt_iters: int = 20
+    #: static row capacity of the feedback ring (oldest rows are
+    #: overwritten; one compiled chunk program for the residency)
+    capacity: int = 1024
+    #: outcomes per windowed-statistics window (gate + drift currency)
+    drift_window: int = 64
+    #: parsed ``swap_gate=`` policy
+    gate_mode: str = "cost"
+    gate_ratio: Optional[float] = 1.0
+    #: windowed-cost degradation factor that fires ``serve.drift``
+    drift_factor: float = 1.5
+    #: candidate checkpoint/promotion artifact directory (None =
+    #: in-memory only, no resume)
+    checkpoint_dir: Optional[str] = None
+    #: misclassification costs for the windowed statistics
+    cost_fp: float = 1.0
+    cost_fn: float = 1.0
+    #: adapter-step wedge detector (an adapter that stops beating for
+    #: this long while busy is declared wedged; the candidate is
+    #: discarded and live serving continues untouched). The default
+    #: clears the first chunk's cold XLA compile on real chips (the
+    #: repo's documented ~20-40 s window) with headroom — a cold
+    #: compile must read as slow, never as a wedge
+    watchdog_s: float = 120.0
+    #: retry budget for a chaos/transiently-failed partial-fit chunk
+    max_attempts: int = 3
+    #: bounded feedback queue (oldest dropped + counted past it — the
+    #: adapter must never become an unbounded memory leak)
+    queue_depth: int = 4096
+    #: roll a promoted model back when its windowed cost regresses
+    #: past the pre-swap record
+    rollback: bool = True
+
+    @classmethod
+    def from_query_map(cls, query_map, cost_fp: float = 1.0,
+                       cost_fn: float = 1.0) -> "LifecycleConfig":
+        """The ``adapt=``/``swap_gate=``/``drift_window=`` family (plus
+        ``checkpoint_path=`` for the adapter's resume directory and
+        the tuning knobs ``adapt_batch=``/``adapt_iters=``), validated
+        with the IR's messages (pipeline/plan.py re-runs the grammar
+        at parse time — defense in depth, same errors)."""
+
+        def _int(name, default, floor=1):
+            value = query_map.get(name, "")
+            if not value:
+                return default
+            try:
+                n = int(value)
+            except ValueError:
+                raise ValueError(
+                    f"query parameter {name}= must be an integer, "
+                    f"got {value!r}"
+                )
+            if n < floor:
+                raise ValueError(f"{name}= must be >= {floor}, got {n}")
+            return n
+
+        gate_mode, gate_ratio = parse_swap_gate(
+            query_map.get("swap_gate") or "cost"
+        )
+        return cls(
+            adapt_batch=_int("adapt_batch", 16),
+            adapt_iters=_int("adapt_iters", 20),
+            drift_window=_int("drift_window", 64),
+            gate_mode=gate_mode,
+            gate_ratio=gate_ratio,
+            checkpoint_dir=query_map.get("checkpoint_path") or None,
+            cost_fp=float(cost_fp),
+            cost_fn=float(cost_fn),
+        )
+
+
+class _Candidate:
+    """One staged candidate generation: the chunk carry, the bounded
+    feedback ring it trains on, and its shadow window."""
+
+    def __init__(self, d: int, config: LifecycleConfig, live_weights,
+                 generation: int):
+        from ..models import sgd
+
+        self.d = int(d)
+        self.generation = int(generation)
+        w, converged, n_updates = sgd.partial_fit_carry(
+            d, weights=live_weights
+        )
+        self.w = np.asarray(w, np.float32)
+        self.converged = bool(converged)
+        self.n_updates = int(n_updates)
+        #: absolute iteration index (the trajectory position)
+        self.t = 0
+        self.features = np.zeros((config.capacity, d), np.float32)
+        self.labels = np.zeros((config.capacity,), np.float32)
+        self.mask = np.zeros((config.capacity,), np.float32)
+        self.rows_seen = 0
+        self.batches = 0
+        self.window = stats_mod.WindowedStatistics(
+            config.drift_window, cost_fp=config.cost_fp,
+            cost_fn=config.cost_fn,
+        )
+
+    # -- checkpoint pytree ------------------------------------------------
+
+    def state(self) -> dict:
+        return {
+            "w": self.w,
+            "converged": np.asarray(self.converged),
+            "n_updates": np.asarray(self.n_updates, np.int32),
+            "t": np.asarray(self.t, np.int64),
+            "features": self.features,
+            "labels": self.labels,
+            "mask": self.mask,
+            "rows_seen": np.asarray(self.rows_seen, np.int64),
+        }
+
+    def adopt(self, state: dict, batches: int, generation: int) -> None:
+        self.w = np.asarray(state["w"], np.float32)
+        self.converged = bool(state["converged"])
+        self.n_updates = int(state["n_updates"])
+        self.t = int(state["t"])
+        self.features = np.asarray(state["features"], np.float32)
+        self.labels = np.asarray(state["labels"], np.float32)
+        self.mask = np.asarray(state["mask"], np.float32)
+        self.rows_seen = int(state["rows_seen"])
+        self.batches = int(batches)
+        self.generation = int(generation)
+
+    def block(self) -> dict:
+        return {
+            "generation": self.generation,
+            "batches": self.batches,
+            "t": self.t,
+            "rows": min(self.rows_seen, len(self.mask)),
+            "window": self.window.summary(),
+        }
+
+
+class LifecycleManager:
+    """Streaming partial-fit + shadow-scored hot swap + drift
+    detection for one :class:`~serve.engine.ServingEngine`.
+
+    ``featurize`` defaults to the engine's own
+    :meth:`~serve.engine.ServingEngine.featurize` (the same program
+    that serves traffic — feedback rows cannot drift from served
+    rows); tests and the SIGKILL worker inject a pure function.
+    """
+
+    def __init__(
+        self,
+        engine,
+        config: Optional[LifecycleConfig] = None,
+        featurize: Optional[Callable] = None,
+    ):
+        from ..models import linear
+
+        self.engine = engine
+        self.config = config or LifecycleConfig()
+        self._featurize = featurize or (
+            engine.featurize if engine is not None else None
+        )
+        if self._featurize is None:
+            raise ValueError(
+                "lifecycle needs an engine or an explicit featurize "
+                "callable"
+            )
+        live = engine.classifier if engine is not None else None
+        if live is not None and not isinstance(
+            live, linear._LinearClassifier
+        ):
+            raise ValueError(
+                "lifecycle adaptation trains the linear family "
+                "(logreg/svm); "
+                f"{type(live).__name__} has no partial-fit surface"
+            )
+        self._sgd_config = self._resolve_sgd_config(live)
+        self._queue: "collections.deque" = collections.deque()
+        self._cond = threading.Condition()
+        self._pending = None  # (items, attempts) — a retrying batch
+        self._processing = False
+        self._stop = threading.Event()
+        self._flush_requested = threading.Event()
+        self.wedged = threading.Event()
+        self._closed = False
+        self._heartbeat = time.monotonic()
+        self._thread: Optional[threading.Thread] = None
+        self._watchdog_thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+        self.counters = collections.Counter()
+        self.generation = 0
+        self.candidate: Optional[_Candidate] = None
+        self.live_window = stats_mod.WindowedStatistics(
+            self.config.drift_window, cost_fp=self.config.cost_fp,
+            cost_fn=self.config.cost_fn,
+        )
+        #: the original model's first-full-window cost — the drift
+        #: reference for the residency
+        self.baseline_cost: Optional[float] = None
+        self._last_drift_at = 0
+        self.last_gate: Optional[dict] = None
+        #: (classifier, pre-swap windowed cost) retained for rollback
+        self._previous = None
+        self.promoted_path: Optional[str] = None
+        self._manager = None
+        if self.config.checkpoint_dir:
+            from ..checkpoint.manager import CheckpointManager
+
+            self._manager = CheckpointManager(
+                os.path.join(self.config.checkpoint_dir, "candidate"),
+                max_to_keep=2,
+            )
+            self._try_resume()
+
+    @staticmethod
+    def _resolve_sgd_config(live):
+        """The candidate's chunk config: the live model's own
+        hyperparameters with the convergence early-stop DISABLED — a
+        carried ``converged`` flag would freeze the candidate on its
+        first quiet window and it could never adapt again."""
+        import dataclasses as dc
+
+        from ..models import sgd
+
+        base = (
+            live._sgd_config() if live is not None else sgd.SGDConfig()
+        )
+        return dc.replace(base, convergence_tol=0.0)
+
+    # -- resume -----------------------------------------------------------
+
+    def _try_resume(self) -> None:
+        """Adopt the latest checkpointed candidate trajectory (a
+        SIGKILL'd adapter resumes mid-trajectory; tests pin the
+        resumed weights byte-identical to an uninterrupted run)."""
+        step = self._manager.latest_step()
+        if step is None:
+            return
+        meta = self._manager.read_metadata(step)
+        extra = meta.get("extra", {})
+        d = int(extra["d"])
+        cand = _Candidate(
+            d, self.config, None, int(extra.get("generation", 0))
+        )
+        state, _ = self._manager.restore(cand.state(), step=step)
+        cand.adopt(
+            state, batches=int(extra.get("batches", step)),
+            generation=int(extra.get("generation", 0)),
+        )
+        self.candidate = cand
+        self.generation = cand.generation
+        logger.info(
+            "lifecycle resumed candidate g%d at t=%d (%d batches) "
+            "from %s", cand.generation, cand.t, cand.batches,
+            self._manager.directory,
+        )
+
+    @property
+    def batches_trained(self) -> int:
+        return self.candidate.batches if self.candidate else 0
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> "LifecycleManager":
+        from ..obs import domain as run_domain
+
+        if self._thread is not None:
+            return self
+        domain = run_domain.capture()
+
+        def adopted(body):
+            def run():
+                with run_domain.adopt(domain):
+                    body()
+            return run
+
+        self._thread = threading.Thread(
+            target=adopted(self._run), name="eeg-tpu-serve-adapter",
+            daemon=True,
+        )
+        self._thread.start()
+        self._watchdog_thread = threading.Thread(
+            target=adopted(self._watchdog_run),
+            name="eeg-tpu-serve-adapter-watchdog", daemon=True,
+        )
+        self._watchdog_thread.start()
+        return self
+
+    def close(self, flush: bool = True, timeout_s: float = 10.0) -> None:
+        """Stop adapting. With ``flush`` the remaining feedback queue
+        (including a final partial batch) is processed first, bounded
+        by ``timeout_s``. Idempotent; feedback after close raises
+        :class:`~serve.batcher.ServiceClosedError`."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        if flush and not self.wedged.is_set():
+            self.flush(timeout_s=timeout_s)
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+        for t in (self._thread, self._watchdog_thread):
+            if t is not None:
+                t.join(timeout=5.0)
+
+    # -- feedback ---------------------------------------------------------
+
+    def feedback(self, window, resolutions, label) -> bool:
+        """One labeled served outcome. Returns True when queued;
+        False when dropped (wedged adapter or a full queue — counted,
+        never silent). Raises after :meth:`close`."""
+        if self._closed:
+            raise ServiceClosedError(
+                "lifecycle is closed; feedback is not accepted "
+                "(draining or stopped)"
+            )
+        self._count("feedback")
+        if self.wedged.is_set():
+            self._count("feedback_dropped")
+            return False
+        item = (
+            np.array(window, copy=True),
+            np.asarray(resolutions, np.float32).copy(),
+            float(label),
+        )
+        with self._cond:
+            if len(self._queue) >= self.config.queue_depth:
+                self._queue.popleft()
+                self._count("feedback_dropped")
+            self._queue.append(item)
+            self._cond.notify()
+        return True
+
+    def flush(self, timeout_s: float = 10.0) -> bool:
+        """Block until every queued feedback item (including a final
+        partial batch) has been processed. True = idle; False = the
+        timeout (or a wedge) cut the wait."""
+        self._flush_requested.set()
+        with self._cond:
+            self._cond.notify_all()
+        deadline = time.monotonic() + timeout_s
+        try:
+            while time.monotonic() < deadline:
+                if self.wedged.is_set():
+                    return False
+                with self._cond:
+                    idle = (
+                        not self._queue
+                        and self._pending is None
+                        and not self._processing
+                    )
+                if idle:
+                    return True
+                time.sleep(0.005)
+            return False
+        finally:
+            self._flush_requested.clear()
+
+    # -- the adapter loop -------------------------------------------------
+
+    def _count(self, key: str, n: int = 1) -> None:
+        from .. import obs
+
+        with self._lock:
+            self.counters[key] += n
+        obs.metrics.count(f"serve.{key}", n)
+
+    def _next_batch(self, wait_s: float):
+        """Pop the next batch: a retrying pending batch first, else a
+        full ``adapt_batch`` run, else (under flush) the remainder."""
+        with self._cond:
+            if self._pending is not None:
+                items, attempts = self._pending
+                self._pending = None
+                self._processing = True
+                return items, attempts
+            want = self.config.adapt_batch
+            if len(self._queue) < want and not (
+                self._flush_requested.is_set() and self._queue
+            ):
+                self._cond.wait(wait_s)
+            if not self._queue:
+                return None, 0
+            if len(self._queue) < want and not self._flush_requested.is_set():
+                return None, 0
+            take = min(want, len(self._queue))
+            items = [self._queue.popleft() for _ in range(take)]
+            self._processing = True
+            return items, 0
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._heartbeat = time.monotonic()
+            items, attempts = self._next_batch(wait_s=0.05)
+            if items is None:
+                with self._cond:
+                    self._processing = False
+                continue
+            try:
+                self._process_batch(items, attempts)
+            finally:
+                with self._cond:
+                    self._processing = False
+                if self.wedged.is_set():
+                    return
+
+    def _process_batch(self, items, attempts: int) -> None:
+        """One partial-fit chunk over one feedback batch: chaos gate,
+        featurize, train (all-or-nothing commit), checkpoint, then
+        score/gate/drift. A failure before commit retries the SAME
+        batch (bounded), so the candidate trajectory is identical when
+        the retry lands — chaos costs time, never a fork."""
+        from ..obs import chaos, events
+
+        self._heartbeat = time.monotonic()
+        try:
+            # one partial-fit chunk == one chaos opportunity
+            chaos.maybe_fire("serve.adapt")
+            feats = self._featurize_batch(items)
+            labels = np.asarray([y for _w, _res, y in items], np.float32)
+            live = self.engine.classifier if self.engine else None
+            cand = self.candidate
+            if cand is None:
+                cand = _Candidate(
+                    feats.shape[1], self.config,
+                    live.weights if live is not None else None,
+                    self.generation,
+                )
+            if feats.shape[1] != cand.d:
+                raise ValueError(
+                    f"feedback features are {feats.shape[1]}-d but the "
+                    f"candidate trains {cand.d}-d rows"
+                )
+            # shadow decisions BEFORE this batch trains (honest
+            # scoring: the candidate is judged on data it has not
+            # seen) — captured as locals, committed only on success
+            cand_w_before = cand.w
+            new_state = self._train_chunk(cand, feats, labels)
+        except Exception as e:
+            self._count("adapt_failures")
+            events.event(
+                "serve.adapt_failed", attempt=attempts + 1,
+                error=f"{type(e).__name__}: {e}",
+            )
+            if attempts + 1 >= self.config.max_attempts:
+                self._count("adapt_dropped")
+                logger.error(
+                    "lifecycle dropped a feedback batch after %d "
+                    "attempts (%s: %s)", attempts + 1,
+                    type(e).__name__, e,
+                )
+                return
+            with self._cond:
+                self._pending = (items, attempts + 1)
+            return
+        if self.wedged.is_set():
+            # the watchdog declared this adapter dead while the chunk
+            # stalled: a late wake-up must not re-commit (or
+            # checkpoint, or roll back with) a candidate the watchdog
+            # already discarded
+            return
+        # commit: the candidate (possibly fresh) adopts the trained
+        # state; everything after this point is side-effect machinery
+        # that never needs a retry
+        self.candidate = cand
+        cand.adopt(
+            new_state, batches=cand.batches + 1,
+            generation=cand.generation,
+        )
+        self._count("adapt_batches")
+        events.event(
+            "serve.adapt_chunk", t=cand.t, batch=cand.batches,
+            generation=cand.generation, rows=len(items),
+        )
+        self._checkpoint(cand)
+        self._score(feats, labels, cand_w_before)
+        # rollback is judged BEFORE promotion: a promoted model that
+        # regressed must be restored before any new candidate is
+        # allowed on top of it
+        self._maybe_rollback()
+        self._maybe_promote()
+        self._maybe_drift()
+
+    def _featurize_batch(self, items) -> np.ndarray:
+        """Featurize one feedback batch, split into runs of equal
+        per-channel resolutions (a batch may straddle a recording
+        boundary; the featurizer scales one resolution vector per
+        call, the batcher's coalescing-key rule)."""
+        rows = []
+        start = 0
+        while start < len(items):
+            res = items[start][1]
+            end = start
+            while end < len(items) and np.array_equal(
+                items[end][1], res
+            ):
+                end += 1
+            rows.append(np.asarray(
+                self._featurize(
+                    [w for w, _res, _y in items[start:end]], res
+                ),
+                np.float32,
+            ))
+            start = end
+        return np.concatenate(rows, axis=0)
+
+    def _train_chunk(self, cand: _Candidate, feats, labels) -> dict:
+        """Ingest the batch into a COPY of the candidate's ring and
+        run one chunk; returns the would-be state (the caller commits
+        it). Absolute iteration indices + static buffer shapes: the
+        one compiled program replays the one true trajectory."""
+        from ..models import sgd
+
+        features = cand.features.copy()
+        lab = cand.labels.copy()
+        mask = cand.mask.copy()
+        rows_seen = cand.rows_seen
+        cap = features.shape[0]
+        for i in range(feats.shape[0]):
+            slot = rows_seen % cap
+            features[slot] = feats[i]
+            lab[slot] = labels[i]
+            mask[slot] = 1.0
+            rows_seen += 1
+        carry = (
+            cand.w,
+            np.asarray(cand.converged),
+            np.asarray(cand.n_updates, np.int32),
+        )
+        w, converged, n_updates = sgd.partial_fit_linear(
+            carry, cand.t, features, lab, self._sgd_config,
+            self.config.adapt_iters, sample_mask=mask,
+        )
+        self._count("adapt_chunks")
+        return {
+            "w": np.asarray(w, np.float32),
+            "converged": np.asarray(bool(converged)),
+            "n_updates": np.asarray(int(n_updates), np.int32),
+            "t": np.asarray(cand.t + self.config.adapt_iters, np.int64),
+            "features": features,
+            "labels": lab,
+            "mask": mask,
+            "rows_seen": np.asarray(rows_seen, np.int64),
+        }
+
+    def _checkpoint(self, cand: _Candidate) -> None:
+        if self._manager is None:
+            return
+        try:
+            self._manager.save(
+                cand.batches, cand.state(),
+                extra={
+                    "d": cand.d,
+                    "batches": cand.batches,
+                    "generation": cand.generation,
+                },
+            )
+        except OSError as e:
+            # a full disk must degrade resume, never adaptation
+            self._count("checkpoint_failures")
+            logger.warning("lifecycle checkpoint failed: %s", e)
+
+    # -- scoring / gate / drift -------------------------------------------
+
+    def _decide(self, feats, weights, intercept, threshold):
+        margins = feats @ np.asarray(weights, np.float32) + intercept
+        return (margins > threshold).astype(np.float64)
+
+    def _score(self, feats, labels, cand_w_before) -> None:
+        live = self.engine.classifier if self.engine else None
+        if live is not None and live.weights is not None:
+            live_preds = self._decide(
+                feats, live.weights, live.intercept,
+                live.margin_threshold,
+            )
+            for p, y in zip(live_preds, labels):
+                self.live_window.add(p, y)
+        cand = self.candidate
+        if cand is not None:
+            threshold = live.margin_threshold if live is not None else 0.0
+            cand_preds = self._decide(
+                feats, cand_w_before, 0.0, threshold
+            )
+            for p, y in zip(cand_preds, labels):
+                cand.window.add(p, y)
+
+    def _maybe_promote(self) -> None:
+        cand = self.candidate
+        if (
+            cand is None
+            or self.config.gate_mode == "off"
+            or self.engine is None
+            or self.wedged.is_set()
+        ):
+            return
+        if not (cand.window.full and self.live_window.full):
+            return
+        live_cost = self.live_window.expected_cost()
+        cand_cost = cand.window.expected_cost()
+        import math
+
+        ok = (
+            not math.isnan(live_cost)
+            and not math.isnan(cand_cost)
+            and cand_cost <= live_cost * self.config.gate_ratio
+        )
+        self.last_gate = {
+            "candidate_cost": round(cand_cost, 6),
+            "live_cost": round(live_cost, 6),
+            "ratio": self.config.gate_ratio,
+            "promote": bool(ok),
+            "generation": cand.generation,
+        }
+        if not ok:
+            return
+        self._attempt_swap(cand)
+
+    def _attempt_swap(self, cand: _Candidate) -> None:
+        """One promotion attempt (the ``serve.swap`` chaos point). A
+        failure leaves the LIVE MODEL UNTOUCHED and the candidate
+        retained — the gate simply retries after the next batch."""
+        from ..obs import chaos, events
+
+        live = self.engine.classifier
+        try:
+            chaos.maybe_fire("serve.swap")
+            clone = self._clone_with_weights(
+                live, cand.w, live.margin_threshold
+            )
+            promoted_path = None
+            if self.config.checkpoint_dir:
+                promoted_path = os.path.join(
+                    self.config.checkpoint_dir, "promoted"
+                )
+                # the batch-parity artifact: load_clf= of this file
+                # predicts byte-identically to the swapped service
+                clone.save(promoted_path)
+            previous = self.engine.swap_model(clone)
+        except Exception as e:
+            self._count("swap_failures")
+            events.event(
+                "serve.swap_failed", generation=cand.generation,
+                error=f"{type(e).__name__}: {e}",
+            )
+            logger.warning(
+                "lifecycle promotion attempt failed (%s: %s); live "
+                "model untouched, candidate retained",
+                type(e).__name__, e,
+            )
+            return
+        pre_swap_cost = self.live_window.expected_cost()
+        self._previous = (previous, pre_swap_cost)
+        self.promoted_path = (
+            promoted_path + ".npz" if promoted_path else None
+        )
+        self._count("swaps")
+        events.event(
+            "serve.promoted", generation=cand.generation,
+            candidate_cost=self.last_gate["candidate_cost"],
+            live_cost=self.last_gate["live_cost"],
+        )
+        logger.info(
+            "lifecycle promoted candidate g%d (windowed cost %.4f vs "
+            "live %.4f)", cand.generation,
+            self.last_gate["candidate_cost"],
+            self.last_gate["live_cost"],
+        )
+        # bounded retention: the promoted trajectory's checkpoints are
+        # superseded — the disk footprint is the live+candidate pair,
+        # never the swap history (the PR 2 elastic clear() contract)
+        if self._manager is not None:
+            self._manager.clear()
+        self.generation += 1
+        self.candidate = None
+        # the promoted model must earn its own windowed record
+        self.live_window.reset()
+
+    @staticmethod
+    def _clone_with_weights(live, weights, margin_threshold):
+        """A fresh classifier of the live model's class carrying the
+        candidate weights: natively-trained linear semantics
+        (interceptless) with the operator's serving threshold carried
+        over, so a recall-tuned service stays tuned across a swap."""
+        clone = type(live)()
+        clone.set_config(dict(live.config))
+        clone.weights = np.asarray(weights, np.float32)
+        clone.intercept = 0.0
+        clone.margin_threshold = float(margin_threshold)
+        return clone
+
+    def _maybe_rollback(self) -> None:
+        if (
+            self._previous is None
+            or not self.config.rollback
+            or self.engine is None
+            or self.wedged.is_set()
+        ):
+            return
+        if not self.live_window.full:
+            return
+        import math
+
+        previous, pre_swap_cost = self._previous
+        cost = self.live_window.expected_cost()
+        if math.isnan(cost) or math.isnan(pre_swap_cost):
+            return
+        if cost <= pre_swap_cost * (self.config.gate_ratio or 1.0):
+            # the promoted model held its gate promise over a full
+            # post-swap window: the rollback arm disarms
+            self._previous = None
+            return
+        from ..obs import events
+
+        self.engine.swap_model(previous)
+        self._previous = None
+        self._count("rollbacks")
+        events.event(
+            "serve.rollback",
+            post_swap_cost=round(cost, 6),
+            pre_swap_cost=round(pre_swap_cost, 6),
+        )
+        logger.warning(
+            "lifecycle ROLLED BACK the promoted model: windowed cost "
+            "%.4f regressed past the pre-swap record %.4f",
+            cost, pre_swap_cost,
+        )
+        self.live_window.reset()
+
+    def _maybe_drift(self) -> None:
+        if not self.live_window.full:
+            return
+        import math
+
+        cost = self.live_window.expected_cost()
+        if math.isnan(cost):
+            return
+        if self.baseline_cost is None:
+            self.baseline_cost = cost
+            return
+        if (
+            self.live_window.seen - self._last_drift_at
+            < self.config.drift_window
+        ):
+            return  # at most one firing per window span
+        bar = max(
+            self.baseline_cost * self.config.drift_factor,
+            self.baseline_cost + 0.01,
+        )
+        if cost <= bar:
+            return
+        from ..obs import events
+
+        self._last_drift_at = self.live_window.seen
+        self._count("drift")
+        events.event(
+            "serve.drift", cost=round(cost, 6),
+            baseline=round(self.baseline_cost, 6),
+            window=self.config.drift_window,
+        )
+        logger.warning(
+            "serve.drift: windowed expected cost %.4f exceeds the "
+            "baseline %.4f (factor %.2f over window %d) — "
+            "recalibration advised", cost, self.baseline_cost,
+            self.config.drift_factor, self.config.drift_window,
+        )
+
+    # -- the adapter watchdog ---------------------------------------------
+
+    def _watchdog_run(self) -> None:
+        poll = max(0.01, self.config.watchdog_s / 4.0)
+        while not self._stop.is_set():
+            # stop-interruptible sleep: close() must not pay a poll
+            # interval (or its join timeout) waiting this thread out
+            if self._stop.wait(poll):
+                return
+            with self._cond:
+                busy = self._processing
+            age = time.monotonic() - self._heartbeat
+            if busy and age > self.config.watchdog_s:
+                self.wedged.set()
+                self._count("lifecycle_wedged")
+                from ..obs import events
+
+                events.event(
+                    "serve.lifecycle_wedged",
+                    heartbeat_age_s=round(age, 2),
+                )
+                logger.error(
+                    "lifecycle adapter wedged (heartbeat %.1fs old); "
+                    "candidate discarded, live serving continues",
+                    age,
+                )
+                # the wedged thread may never return: the candidate is
+                # discarded HERE so a later wake-up cannot promote a
+                # model trained by a half-dead adapter
+                self.candidate = None
+                with self._cond:
+                    self._queue.clear()
+                return
+
+    # -- observability ----------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        if self.wedged.is_set():
+            return "wedged"
+        if self._closed:
+            return "closed"
+        if self.candidate is not None:
+            return "adapting"
+        return "live"
+
+    def block(self) -> dict:
+        """The ``lifecycle`` block for run reports and bench lines."""
+        with self._lock:
+            counters = dict(self.counters)
+        # one snapshot: the adapter thread clears self.candidate on
+        # promotion — a monitor reading mid-swap must not None-deref
+        cand = self.candidate
+        return {
+            "enabled": True,
+            "state": self.state,
+            "generation": self.generation,
+            "config": {
+                "adapt_batch": self.config.adapt_batch,
+                "adapt_iters": self.config.adapt_iters,
+                "capacity": self.config.capacity,
+                "drift_window": self.config.drift_window,
+                "swap_gate": (
+                    "off" if self.config.gate_mode == "off"
+                    else f"cost:{self.config.gate_ratio}"
+                ),
+                "drift_factor": self.config.drift_factor,
+            },
+            "feedback": {
+                "received": counters.get("feedback", 0),
+                "dropped": counters.get("feedback_dropped", 0),
+                "batches": counters.get("adapt_batches", 0),
+                "chunks": counters.get("adapt_chunks", 0),
+                "failures": counters.get("adapt_failures", 0),
+                "dropped_batches": counters.get("adapt_dropped", 0),
+            },
+            "candidate": None if cand is None else cand.block(),
+            "live_window": self.live_window.summary(),
+            "baseline_cost": (
+                None if self.baseline_cost is None
+                else round(self.baseline_cost, 6)
+            ),
+            "gate": self.last_gate,
+            "swaps": counters.get("swaps", 0),
+            "swap_failures": counters.get("swap_failures", 0),
+            "rollbacks": counters.get("rollbacks", 0),
+            "rollback_armed": self._previous is not None,
+            "drift_events": counters.get("drift", 0),
+            "promoted_path": self.promoted_path,
+            "checkpoint": (
+                None if self._manager is None else {
+                    "dir": self._manager.directory,
+                    "steps": len(self._manager.all_steps()),
+                }
+            ),
+            "wedged": self.wedged.is_set(),
+        }

@@ -98,6 +98,42 @@ def serve_config_from_query(query_map) -> service_mod.ServeConfig:
     )
 
 
+def lifecycle_config_from_query(
+    query_map, cost_fp: float = 1.0, cost_fn: float = 1.0
+):
+    """The ``adapt=`` family -> a LifecycleConfig, or None when the
+    run did not opt in (the lifecycle is strictly additive: without
+    ``adapt=true`` the service is byte-identically the pre-lifecycle
+    one)."""
+    from . import lifecycle as lifecycle_mod
+
+    if query_map.get("adapt") != "true":
+        return None
+    return lifecycle_mod.LifecycleConfig.from_query_map(
+        query_map, cost_fp=cost_fp, cost_fn=cost_fn
+    )
+
+
+def _adapt_feedback(service, stage, requests, targets_arr) -> None:
+    """Close the train/serve loop for one pipeline session: every
+    served window's true target (the speller KNOWS it after the
+    trial) feeds the lifecycle manager in submission order, then the
+    adapter flushes — partial-fit chunks, shadow scoring, gate
+    decisions, and (behind the gate) a promotion all happen here,
+    AFTER the session's own predictions were served, so the run's
+    statistics are untouched by its own adaptation (the promoted
+    model serves the NEXT session; byte-identity pinned in
+    tests/test_lifecycle.py)."""
+    if service.lifecycle is None or not requests:
+        return
+    with stage("adapt", requests=len(requests)):
+        for (window, resolutions), label in zip(requests, targets_arr):
+            service.feedback(window, resolutions, float(label))
+        service.lifecycle.flush(
+            timeout_s=service.config.drain_timeout_s
+        )
+
+
 def run_serve(query_map, provider_factory, stage):
     """Execute one ``serve=true`` run.
 
@@ -162,6 +198,7 @@ def run_serve(query_map, provider_factory, stage):
         post=odp.post,
         config=config,
         precision=precision,
+        lifecycle=lifecycle_config_from_query(query_map),
     )
 
     # 1. ingest: parse the session into per-epoch raw windows (the
@@ -199,6 +236,10 @@ def run_serve(query_map, provider_factory, stage):
                     [r[0] for r in requests],
                     [r[1] for r in requests],
                 )
+        # 2b. adapt=true: the session's labeled outcomes feed the
+        # lifecycle manager (streaming partial-fit + shadow-scored
+        # swap + drift) after its predictions were served
+        _adapt_feedback(service, stage, requests, targets_arr)
     finally:
         drained = service.stop(drain=True)
 
@@ -325,6 +366,14 @@ def run_serve_seizure(query_map, provider_factory, stage):
         post=window,
         config=config,
         host_extractor=fe,
+        # lifecycle windows judge on the run's misclassification
+        # costs (the explicit knobs; class_weight=balanced resolves
+        # training weights, not scoring costs)
+        lifecycle=lifecycle_config_from_query(
+            query_map,
+            cost_fp=float(query_map.get("cost_fp") or 1.0),
+            cost_fn=float(query_map.get("cost_fn") or 1.0),
+        ),
     )
 
     # 1. ingest: the SAME sliding batches the batch run cuts — float64
@@ -356,6 +405,7 @@ def run_serve_seizure(query_map, provider_factory, stage):
                     [r[0] for r in requests],
                     [r[1] for r in requests],
                 )
+        _adapt_feedback(service, stage, requests, targets_arr)
     finally:
         drained = service.stop(drain=True)
 

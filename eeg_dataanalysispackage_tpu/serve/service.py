@@ -33,6 +33,7 @@ import numpy as np
 
 from . import batcher as batcher_mod
 from . import engine as engine_mod
+from . import lifecycle as lifecycle_mod
 from ..io import deadline as deadline_mod
 from ..models import registry as clf_registry
 from ..obs import events
@@ -95,6 +96,7 @@ class InferenceService:
         host_extractor=None,
         precision: str = "f32",
         engine_rung: str = "auto",
+        lifecycle: Optional[lifecycle_mod.LifecycleConfig] = None,
     ):
         self.config = config or ServeConfig()
         self.engine = engine_mod.ServingEngine(
@@ -107,6 +109,14 @@ class InferenceService:
             host_extractor=host_extractor,
             precision=precision,
             engine_rung=engine_rung,
+        )
+        #: the model lifecycle manager (serve/lifecycle.py): streaming
+        #: partial-fit over labeled feedback, shadow-scored hot swap,
+        #: drift detection — None unless the service was built with a
+        #: LifecycleConfig (``adapt=true`` in pipeline terms)
+        self.lifecycle = (
+            None if lifecycle is None
+            else lifecycle_mod.LifecycleManager(self.engine, lifecycle)
         )
         self.batcher = batcher_mod.MicroBatcher(
             self.engine.execute,
@@ -154,6 +164,8 @@ class InferenceService:
             if self._started:
                 return self
             self.batcher.start()
+            if self.lifecycle is not None:
+                self.lifecycle.start()
             self._accepting = True
             self._started = True
         events.event("serve.started")
@@ -194,6 +206,16 @@ class InferenceService:
             req.future.fail(batcher_mod.ServiceClosedError(
                 "service stopped before the request could complete"
             ))
+        if self.lifecycle is not None:
+            # a clean drain also flushes queued feedback (the last
+            # trials of a session still adapt); stop(drain=False) and
+            # a failed drain skip straight to shutdown — an abort must
+            # not train (or promote) its way through the backlog, and
+            # the adapter must not outlive the service it feeds
+            self.lifecycle.close(
+                flush=drain and drained,
+                timeout_s=self.config.drain_timeout_s,
+            )
         with self._lock:
             self._started = False
         self._drained_cleanly = drained
@@ -214,8 +236,16 @@ class InferenceService:
         resolutions: np.ndarray,
         deadline_s: Optional[float] = None,
         block_s: float = 0.0,
+        label: Optional[float] = None,
     ) -> batcher_mod.ServeFuture:
         """Admit one request; returns its future.
+
+        ``label`` (requires a lifecycle-enabled service) is the
+        request's known true target — the speller's post-trial ground
+        truth — forwarded as feedback to the lifecycle manager
+        (serve/lifecycle.py) for streaming partial-fit and shadow
+        scoring; when the label only becomes known later, call
+        :meth:`feedback` instead.
 
         Raises :class:`ShedError` when the bounded queue is full (pass
         ``block_s`` to cooperate with backpressure instead),
@@ -225,6 +255,11 @@ class InferenceService:
         failures are loud and immediate, never a queued request that
         nobody will ever serve.
         """
+        if label is not None and self.lifecycle is None:
+            raise ValueError(
+                "submit(label=) needs a lifecycle-enabled service "
+                "(adapt=true); this one has no adapter to feed"
+            )
         self.batcher._count("submitted")
         if not self._accepting:
             self.batcher._count("rejected_closed")
@@ -264,7 +299,41 @@ class InferenceService:
                 "service stopped while the request was being admitted"
             )):
                 self.batcher._count("rejected_closed")
+        if label is not None:
+            try:
+                self.lifecycle.feedback(window, resolutions, label)
+            except batcher_mod.ServiceClosedError:
+                # stop() raced the admission between the accepting
+                # check and this forward: the request itself was
+                # admitted, so its future is still owed to the caller
+                # — the label is dropped (the adapter is closing), not
+                # the answer
+                pass
         return req.future
+
+    def feedback(
+        self,
+        window: np.ndarray,
+        resolutions: np.ndarray,
+        label: float,
+    ) -> bool:
+        """One labeled served outcome for the lifecycle manager — the
+        speller's post-trial ground truth, the seizure line's
+        confirmed annotation. Returns True when queued for the
+        adapter (False = dropped with a counted reason); raises
+        :class:`ServiceClosedError` once the service is draining or
+        stopped, mirroring :meth:`submit`."""
+        if self.lifecycle is None:
+            raise ValueError(
+                "feedback() needs a lifecycle-enabled service "
+                "(adapt=true); this one has no adapter to feed"
+            )
+        if not self._accepting:
+            raise batcher_mod.ServiceClosedError(
+                "service is not accepting feedback "
+                "(draining or stopped)"
+            )
+        return self.lifecycle.feedback(window, resolutions, label)
 
     def _result_timeout(self, budget: float) -> float:
         """Caller-side wait bound: the watchdog guarantees resolution;
@@ -367,4 +436,12 @@ class InferenceService:
             "watchdog_trips": counters.get("watchdog_trips", 0),
             "wedged": self.batcher.wedged.is_set(),
             "drained_cleanly": self._drained_cleanly,
+            # model lifecycle attribution (serve/lifecycle.py):
+            # feedback/partial-fit counters, the candidate's shadow
+            # window, gate decisions, swaps/rollbacks/drift — None for
+            # services without a lifecycle manager (schema-stable)
+            "lifecycle": (
+                None if self.lifecycle is None
+                else self.lifecycle.block()
+            ),
         }

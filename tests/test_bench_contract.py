@@ -580,6 +580,64 @@ def test_collect_propagates_serve_mega_field(monkeypatch):
     assert v["serve"] == serve_block
 
 
+def test_serve_lifecycle_variant_in_both_tables_and_routing():
+    """The model lifecycle manager (ISSUE 15) rides every bench
+    artifact: the serve_lifecycle swap-under-load sweep + parity pins
+    through the serve child, sized like the serve_bench line it
+    extends (the pair is directly comparable from one artifact)."""
+    import inspect
+
+    for table in (bench._VARIANTS_TPU, bench._VARIANTS_CPU):
+        assert "serve_lifecycle" in table
+        assert table["serve_lifecycle"] == table["serve_bench"]
+    src = inspect.getsource(bench._run_variant)
+    assert "serve_" in src and "serve_bench.py" in src
+
+
+def test_collect_propagates_serve_lifecycle_field(monkeypatch):
+    """The serve_lifecycle line's sweep + parity pins + lifecycle
+    block must survive the parent's field whitelist into the
+    published artifact — the no-swap/promoted-parity and
+    swap/rollback/drift attribution the acceptance criteria read."""
+    serve_block = {
+        "sweep": [{
+            "concurrency": 16,
+            "steady": {"preds_per_s": 100.0, "p99_ms": 5.0},
+            "under_adapt": {"preds_per_s": 90.0, "p99_ms": 6.0},
+            "swaps_during": 2,
+            "p99_ratio": 1.2,
+        }],
+        "no_swap_parity": {"bit_identical": True, "swaps": 0},
+        "promoted_parity": {"swapped": True, "bit_identical": True},
+        "lifecycle": {
+            "swaps": 2, "rollbacks": 0, "drift_events": 0,
+            "state": "live",
+        },
+        "chaos": {"chaos_clean": True,
+                  "live_untouched_on_failed_swap": True},
+    }
+    monkeypatch.setattr(
+        bench, "_VARIANTS_CPU",
+        {"einsum": (8, 2), "serve_lifecycle": (400, 2)},
+    )
+    monkeypatch.setattr(
+        bench,
+        "_run_variant",
+        lambda name, platform, n, iters: {
+            "epochs_per_s": 1.0,
+            "bytes_per_epoch": 5100,
+            "n": n,
+            "wall_s": 1.0,
+            **(
+                {"serve": serve_block}
+                if name == "serve_lifecycle" else {}
+            ),
+        },
+    )
+    v = bench._collect("cpu_fallback")["variants"]["serve_lifecycle"]
+    assert v["serve"] == serve_block
+
+
 def test_plan_service_variant_in_both_tables_and_routing():
     """The networked plan service (ISSUE 11) rides every bench
     artifact, sized identically on TPU and the CPU fallback, through

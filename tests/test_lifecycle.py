@@ -1,0 +1,625 @@
+"""Model lifecycle manager (serve/lifecycle.py): streaming
+partial-fit, shadow-scored hot swap with rollback, drift detection
+(ISSUE 15).
+
+The acceptance bar: a service that stages a candidate, shadow-scores
+it, and never promotes (gate off / gate reject) emits
+ClassificationStatistics BYTE-IDENTICAL to a service that never had a
+lifecycle at all — including under serve.swap/serve.adapt chaos; a
+promoted candidate served online is byte-identical to the batch run
+of its ``promoted.npz`` checkpoint; a SIGKILL'd adapter resumes its
+checkpointed trajectory to byte-identical candidate weights; a failed
+swap leaves the live model untouched; a wedged adapter discards its
+candidate while live serving continues.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import _lifecycle_worker
+import _synthetic
+from eeg_dataanalysispackage_tpu import obs
+from eeg_dataanalysispackage_tpu.epochs.extractor import BalanceState
+from eeg_dataanalysispackage_tpu.io import provider
+from eeg_dataanalysispackage_tpu.models import registry as clf_registry
+from eeg_dataanalysispackage_tpu.models import stats
+from eeg_dataanalysispackage_tpu.obs import chaos
+from eeg_dataanalysispackage_tpu.pipeline import builder
+from eeg_dataanalysispackage_tpu.pipeline.plan import (
+    ExecutionPlan,
+    PlanValidationError,
+)
+from eeg_dataanalysispackage_tpu.serve import (
+    InferenceService,
+    LifecycleConfig,
+    ServeConfig,
+    ServiceClosedError,
+    engine,
+    lifecycle as lifecycle_mod,
+)
+
+_CONFIG = (
+    "&config_num_iterations=20&config_step_size=1.0"
+    "&config_mini_batch_fraction=1.0"
+)
+
+
+@pytest.fixture(scope="module")
+def session(tmp_path_factory):
+    """One synthetic session + a trained, saved logreg model + the
+    batch pipeline's features/predictions — the test_serve fixture
+    shape, reused for the lifecycle pins."""
+    tmp = tmp_path_factory.mktemp("lifecycle_session")
+    for i, (name, guessed) in enumerate(
+        (("synth_00", 2), ("synth_01", 5))
+    ):
+        _synthetic.write_recording(
+            str(tmp), name=name, n_markers=90, guessed=guessed, seed=i
+        )
+    info = str(tmp / "info.txt")
+    with open(info, "w") as f:
+        f.write("synth_00.eeg 2\nsynth_01.eeg 5\n")
+    model = str(tmp / "model")
+    builder.PipelineBuilder(
+        f"info_file={info}&fe=dwt-8-fused&train_clf=logreg"
+        f"&save_clf=true&save_name={model}{_CONFIG}"
+    ).execute()
+
+    odp = provider.OfflineDataProvider([info])
+    balance = BalanceState()
+    windows, targets, resolutions = [], [], None
+    for _rel, guessed, rec in odp.iter_recordings():
+        ws, ts, resolutions = engine.windows_from_recording(
+            rec, odp.channel_indices_for(rec), guessed,
+            pre=odp.pre, post=odp.post, balance=balance,
+        )
+        windows.extend(ws)
+        targets.append(ts)
+    features, _t = provider.OfflineDataProvider(
+        [info]
+    ).load_features_device(wavelet_index=8, backend="xla")
+    classifier = clf_registry.create("logreg")
+    classifier.load(model)
+    return {
+        "info": info,
+        "model": model,
+        "windows": windows,
+        "targets": np.concatenate(targets),
+        "resolutions": resolutions,
+        "batch_features": features,
+        "batch_predictions": classifier.predict(features),
+    }
+
+
+def _feed_session(svc, session, repeats=1, flush=True):
+    for _ in range(repeats):
+        for w, y in zip(session["windows"], session["targets"]):
+            svc.feedback(w, session["resolutions"], float(y))
+    if flush:
+        assert svc.lifecycle.flush(timeout_s=60.0)
+
+
+# -- windowed statistics -------------------------------------------------
+
+
+def test_windowed_statistics_cost_recall_and_window_bound():
+    w = stats.WindowedStatistics(4, cost_fp=1.0, cost_fn=8.0)
+    assert np.isnan(w.expected_cost()) and not w.full
+    for pred, label in ((1, 1), (0, 0), (1, 0), (0, 1)):
+        w.add(pred, label)
+    assert w.full and w.counts() == (1, 1, 1, 1)
+    assert w.expected_cost() == pytest.approx((1.0 + 8.0) / 4)
+    assert w.recall() == pytest.approx(0.5)
+    # sliding: four perfect outcomes push the errors out entirely
+    for _ in range(4):
+        w.add(1, 1)
+    assert w.expected_cost() == 0.0 and w.recall() == 1.0
+    assert w.seen == 8
+    w.reset()
+    assert w.n == 0 and w.seen == 8  # seen survives (drift pacing)
+
+
+def test_parse_swap_gate_grammar():
+    assert lifecycle_mod.parse_swap_gate("off") == ("off", None)
+    assert lifecycle_mod.parse_swap_gate("cost") == ("cost", 1.0)
+    assert lifecycle_mod.parse_swap_gate("cost:2.5") == ("cost", 2.5)
+    for bad in ("banana", "cost:x", "cost:0", "cost:-1"):
+        with pytest.raises(ValueError, match="swap_gate"):
+            lifecycle_mod.parse_swap_gate(bad)
+
+
+def test_plan_ir_lifecycle_knob_grammar():
+    base = "info_file=i.txt&fe=dwt-8-fused&load_clf=logreg&load_name=m"
+    plan = ExecutionPlan.parse(
+        base + "&serve=true&adapt=true&swap_gate=cost:1.5"
+        "&drift_window=32"
+    )
+    assert plan.adapt and plan.swap_gate == "cost:1.5"
+    assert plan.drift_window == 32
+    cases = (
+        (base + "&serve=true&adapt=yes", "adapt= must be true or false"),
+        (base + "&adapt=true", "requires serve=true"),
+        (base + "&serve=true&swap_gate=cost", "requires adapt=true"),
+        (base + "&serve=true&adapt=true&swap_gate=nope", "swap_gate"),
+        (base + "&serve=true&adapt=true&drift_window=0", ">= 1"),
+        (base + "&serve=true&drift_window=9", "requires adapt=true"),
+    )
+    for query, match in cases:
+        with pytest.raises(PlanValidationError, match=match):
+            ExecutionPlan.parse(query)
+    # the knobs are semantic: an adapt plan is not the plain plan
+    assert ExecutionPlan.parse(
+        base + "&serve=true&adapt=true"
+    ).canonical_key() != ExecutionPlan.parse(
+        base + "&serve=true"
+    ).canonical_key()
+
+
+# -- the rollback pin (never-promoted == never-staged) -------------------
+
+
+def test_adapt_no_swap_statistics_byte_identical(session, tmp_path):
+    """The core pin: serve=true&adapt=true&swap_gate=off stages and
+    shadow-scores a candidate on every trial yet emits statistics
+    byte-identical to the plain serve run — and its run report
+    carries the lifecycle block."""
+    base = (
+        f"info_file={session['info']}&fe=dwt-8-fused&serve=true"
+        f"&load_clf=logreg&load_name={session['model']}"
+    )
+    plain = builder.PipelineBuilder(base).execute()
+    report_dir = str(tmp_path / "report")
+    adapted = builder.PipelineBuilder(
+        base + "&adapt=true&swap_gate=off&drift_window=16"
+        f"&adapt_batch=8&report={report_dir}"
+    ).execute()
+    assert str(adapted) == str(plain)
+    with open(os.path.join(report_dir, "run_report.json")) as f:
+        report = json.load(f)
+    block = report["lifecycle"]
+    assert block["enabled"] and block["swaps"] == 0
+    assert block["feedback"]["received"] == len(session["windows"])
+    assert block["feedback"]["batches"] >= 1
+    assert block["candidate"] is not None  # staged + shadow-scored
+    assert block["config"]["swap_gate"] == "off"
+    # the lifecycle block lives at the top level ONLY — the serve
+    # block does not carry a second copy of the same dict
+    assert "lifecycle" not in report["serve"]
+    # the adapt stage was timed
+    assert report["stages"]["adapt"]["seconds"] > 0.0
+
+
+def test_adapt_chaos_statistics_byte_identical(session):
+    """The rollback pin under chaos: deterministic and probabilistic
+    serve.adapt/serve.swap faults never touch the served statistics
+    (the adapter retries; the request path is not involved)."""
+    base = (
+        f"info_file={session['info']}&fe=dwt-8-fused&serve=true"
+        f"&load_clf=logreg&load_name={session['model']}"
+        "&adapt=true&swap_gate=off&adapt_batch=8"
+    )
+    clean = builder.PipelineBuilder(base).execute()
+    before = obs.metrics.snapshot()["counters"]
+    chaosed = builder.PipelineBuilder(
+        base + "&faults=serve.adapt:once@1"
+    ).execute()
+    after = obs.metrics.snapshot()["counters"]
+    assert str(chaosed) == str(clean)
+    assert after["chaos.fired.serve.adapt"] - before.get(
+        "chaos.fired.serve.adapt", 0.0
+    ) == 1
+    # the failed chunk retried rather than forking the trajectory
+    assert after["serve.adapt_failures"] - before.get(
+        "serve.adapt_failures", 0.0
+    ) == 1
+    soaked = builder.PipelineBuilder(
+        base + "&faults=serve.swap:p=0.2;serve.adapt:p=0.2"
+    ).execute()
+    assert str(soaked) == str(clean)
+
+
+# -- promotion + the batch-parity pin ------------------------------------
+
+
+def test_promotion_parity_and_bounded_retention(session, tmp_path):
+    """A permissive gate promotes the candidate; the service then
+    serves predictions byte-identical to the batch run of the
+    promoted checkpoint — and promotion cleared the superseded
+    candidate checkpoints (disk bounded by the live+candidate pair)."""
+    ckpt = str(tmp_path / "lc")
+    svc = InferenceService.from_saved(
+        "logreg", session["model"],
+        lifecycle=LifecycleConfig(
+            adapt_batch=8, adapt_iters=10, drift_window=16,
+            gate_mode="cost", gate_ratio=100.0, checkpoint_dir=ckpt,
+            rollback=False,
+        ),
+    )
+    before = obs.metrics.snapshot()["counters"].get("serve.swaps", 0.0)
+    svc.start()
+    try:
+        _feed_session(svc, session, repeats=2)
+        block = svc.lifecycle.block()
+        assert block["swaps"] >= 1
+        assert block["generation"] == block["swaps"]
+        promoted_path = block["promoted_path"]
+        assert promoted_path and os.path.exists(promoted_path)
+        # bounded retention: each promotion cleared its superseded
+        # trajectory (manager max_to_keep bounds the live candidate)
+        assert block["checkpoint"]["steps"] <= 2
+        assert sorted(os.listdir(ckpt)) == ["candidate", "promoted.npz"]
+        results = svc.predict_all(
+            session["windows"], session["resolutions"]
+        )
+    finally:
+        svc.stop(drain=True)
+    assert obs.metrics.snapshot()["counters"]["serve.swaps"] > before
+    served = np.array([r.prediction for r in results])
+    promoted = clf_registry.create("logreg")
+    promoted.load(promoted_path)
+    batch_preds = promoted.predict(session["batch_features"])
+    np.testing.assert_array_equal(served, batch_preds)
+    # statistics built the load_clf= way are therefore byte-identical
+    s_served = stats.ClassificationStatistics.from_arrays(
+        served, session["targets"], confusion_only=True
+    )
+    s_batch = stats.ClassificationStatistics.from_arrays(
+        batch_preds, session["targets"], confusion_only=True
+    )
+    assert str(s_served) == str(s_batch)
+    # the swap retriggered no serving recompile: the engine still
+    # holds its original compiled program (weights are traced args)
+    assert svc.engine.classifier.weights.dtype == np.float32
+
+
+def test_failed_swap_leaves_live_model_untouched(session):
+    """serve.swap chaos on every attempt: promotions keep failing,
+    the live classifier OBJECT stays installed, the candidate is
+    retained for the next gate pass, and the evidence is counted."""
+    svc = InferenceService.from_saved(
+        "logreg", session["model"],
+        lifecycle=LifecycleConfig(
+            adapt_batch=8, adapt_iters=10, drift_window=16,
+            gate_mode="cost", gate_ratio=100.0,
+        ),
+    )
+    live = svc.engine.classifier
+    before = obs.metrics.snapshot()["counters"]
+    svc.start()
+    try:
+        with chaos.faults("serve.swap:every@1"):
+            _feed_session(svc, session, repeats=2)
+        block = svc.lifecycle.block()
+        assert block["swaps"] == 0
+        assert block["swap_failures"] >= 1
+        assert block["candidate"] is not None  # retained, not burned
+        assert svc.engine.classifier is live
+        # live serving unaffected
+        r = svc.predict_window(
+            session["windows"][0], session["resolutions"]
+        )
+        assert r.prediction == session["batch_predictions"][0]
+    finally:
+        svc.stop(drain=True)
+    after = obs.metrics.snapshot()["counters"]
+    assert after["serve.swap_failures"] > before.get(
+        "serve.swap_failures", 0.0
+    )
+    assert after["chaos.fired.serve.swap"] > before.get(
+        "chaos.fired.serve.swap", 0.0
+    )
+
+
+def test_rollback_on_regression_restores_previous_model(session):
+    """A promoted model whose windowed cost regresses past the
+    pre-swap record is rolled back: the previous classifier object is
+    re-installed, the rollback is counted and event-visible."""
+    svc = InferenceService.from_saved(
+        "logreg", session["model"],
+        lifecycle=LifecycleConfig(
+            adapt_batch=8, adapt_iters=5, drift_window=8,
+            gate_mode="off", gate_ratio=None,
+        ),
+    )
+    before = obs.metrics.snapshot()["counters"].get(
+        "serve.rollbacks", 0.0
+    )
+    svc.start()
+    try:
+        lc = svc.lifecycle
+        original = svc.engine.classifier
+        # stage a promotion by hand: a deliberately-broken model
+        # (negated weights) with a perfect pre-swap record
+        bad = lc._clone_with_weights(
+            original, -np.asarray(original.weights), 0.0
+        )
+        previous = svc.engine.swap_model(bad)
+        lc._previous = (previous, 0.0)
+        assert svc.engine.classifier is bad
+        _feed_session(svc, session)
+        assert svc.engine.classifier is previous
+        block = lc.block()
+        assert block["rollbacks"] == 1
+        assert block["rollback_armed"] is False
+        # serving continues on the restored model
+        r = svc.predict_window(
+            session["windows"][0], session["resolutions"]
+        )
+        assert r.prediction == session["batch_predictions"][0]
+    finally:
+        svc.stop(drain=True)
+    assert obs.metrics.snapshot()["counters"]["serve.rollbacks"] > before
+
+
+def test_drift_detection_fires_on_windowed_regression(session):
+    """Windowed expected cost past the baseline factor emits
+    serve.drift (rate-limited to once per window span): label flips
+    simulate electrode drift against the trained model."""
+    svc = InferenceService.from_saved(
+        "logreg", session["model"],
+        lifecycle=LifecycleConfig(
+            adapt_batch=8, adapt_iters=5, drift_window=8,
+            gate_mode="off", gate_ratio=None, drift_factor=1.5,
+        ),
+    )
+    before = obs.metrics.snapshot()["counters"].get("serve.drift", 0.0)
+    svc.start()
+    try:
+        # first window: the true labels establish the baseline
+        _feed_session(svc, session)
+        assert svc.lifecycle.baseline_cost is not None
+        # then the world shifts: flipped labels make every live
+        # decision wrong — windowed cost -> ~1.0
+        for w, y in zip(session["windows"], session["targets"]):
+            svc.feedback(w, session["resolutions"], 1.0 - float(y))
+        assert svc.lifecycle.flush(timeout_s=60.0)
+        block = svc.lifecycle.block()
+        assert block["drift_events"] >= 1
+        assert block["live_window"]["expected_cost"] > (
+            block["baseline_cost"]
+        )
+    finally:
+        svc.stop(drain=True)
+    assert obs.metrics.snapshot()["counters"]["serve.drift"] > before
+
+
+# -- drain/wedge/shutdown races ------------------------------------------
+
+
+def test_feedback_after_drain_raises_closed(session):
+    svc = InferenceService.from_saved(
+        "logreg", session["model"],
+        lifecycle=LifecycleConfig(adapt_batch=8),
+    )
+    svc.start()
+    svc.feedback(
+        session["windows"][0], session["resolutions"],
+        float(session["targets"][0]),
+    )
+    svc.stop(drain=True)
+    with pytest.raises(ServiceClosedError, match="not accepting"):
+        svc.feedback(
+            session["windows"][0], session["resolutions"], 1.0
+        )
+    assert svc.lifecycle.state == "closed"
+
+
+def test_submit_label_requires_lifecycle(session):
+    with InferenceService.from_saved("logreg", session["model"]) as svc:
+        with pytest.raises(ValueError, match="adapt=true"):
+            svc.submit(
+                session["windows"][0], session["resolutions"],
+                label=1.0,
+            )
+        with pytest.raises(ValueError, match="adapt=true"):
+            svc.feedback(
+                session["windows"][0], session["resolutions"], 1.0
+            )
+
+
+def test_submit_label_feeds_the_adapter(session):
+    svc = InferenceService.from_saved(
+        "logreg", session["model"],
+        lifecycle=LifecycleConfig(
+            adapt_batch=4, gate_mode="off", gate_ratio=None
+        ),
+    )
+    svc.start()
+    try:
+        futs = [
+            svc.submit(
+                session["windows"][i], session["resolutions"],
+                block_s=5.0, label=float(session["targets"][i]),
+            )
+            for i in range(8)
+        ]
+        for f in futs:
+            f.result(timeout=10.0)
+        assert svc.lifecycle.flush(timeout_s=30.0)
+        block = svc.lifecycle.block()
+        assert block["feedback"]["received"] == 8
+        assert block["feedback"]["batches"] >= 2
+    finally:
+        svc.stop(drain=True)
+
+
+def test_stop_during_adaptation_no_deadlock(session):
+    """The swap-vs-drain race: stop(drain=True) lands while feedback
+    is queued and a promotion is imminent — the drain must complete
+    (bounded), the adapter close cleanly, and the service end in a
+    consistent state."""
+    svc = InferenceService.from_saved(
+        "logreg", session["model"],
+        config=ServeConfig(drain_timeout_s=30.0),
+        lifecycle=LifecycleConfig(
+            adapt_batch=8, adapt_iters=10, drift_window=16,
+            gate_mode="cost", gate_ratio=100.0, rollback=False,
+        ),
+    )
+    svc.start()
+    _feed_session(svc, session, repeats=2, flush=False)
+    t0 = time.monotonic()
+    drained = svc.stop(drain=True)
+    assert drained is True
+    assert time.monotonic() - t0 < 60.0
+    assert svc.lifecycle.state == "closed"
+    # whatever the shutdown/swap interleaving, the installed model is
+    # a servable linear classifier of the live shape
+    clf = svc.engine.classifier
+    assert clf.weights is not None and clf.weights.dtype == np.float32
+
+
+def test_wedged_adapter_discards_candidate_live_serving_continues(
+    session,
+):
+    """The engine-wedge-mid-shadow race: a featurize call that never
+    returns trips the lifecycle watchdog — the candidate is
+    discarded, feedback drops (counted) instead of queueing forever,
+    and the REQUEST path keeps answering untouched."""
+    svc = InferenceService.from_saved(
+        "logreg", session["model"],
+        lifecycle=LifecycleConfig(
+            adapt_batch=4, watchdog_s=0.3, gate_mode="off",
+            gate_ratio=None,
+        ),
+    )
+    release = threading.Event()
+
+    def wedging_featurize(windows, _res):
+        release.wait(30.0)
+        return np.zeros((len(windows), 48), np.float32)
+
+    before = obs.metrics.snapshot()["counters"].get(
+        "serve.lifecycle_wedged", 0.0
+    )
+    svc.start()
+    try:
+        # first a healthy batch, so there is a real candidate to lose
+        for i in range(4):
+            svc.feedback(
+                session["windows"][i], session["resolutions"],
+                float(session["targets"][i]),
+            )
+        assert svc.lifecycle.flush(timeout_s=30.0)
+        assert svc.lifecycle.block()["candidate"] is not None
+        # then the wedge
+        svc.lifecycle._featurize = wedging_featurize
+        for i in range(4):
+            svc.feedback(
+                session["windows"][i], session["resolutions"],
+                float(session["targets"][i]),
+            )
+        deadline = time.monotonic() + 10.0
+        while (
+            not svc.lifecycle.wedged.is_set()
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.02)
+        assert svc.lifecycle.wedged.is_set()
+        block = svc.lifecycle.block()
+        assert block["wedged"] and block["state"] == "wedged"
+        assert block["candidate"] is None  # discarded
+        # live serving continues on the untouched model
+        r = svc.predict_window(
+            session["windows"][0], session["resolutions"]
+        )
+        assert r.prediction == session["batch_predictions"][0]
+        # feedback now drops with evidence instead of queueing
+        assert svc.feedback(
+            session["windows"][0], session["resolutions"], 1.0
+        ) is False
+        assert svc.lifecycle.block()["feedback"]["dropped"] >= 1
+    finally:
+        release.set()
+        svc.stop(drain=True)
+    after = obs.metrics.snapshot()["counters"]
+    assert after["serve.lifecycle_wedged"] > before
+
+
+# -- SIGKILL mid-partial-fit + resume ------------------------------------
+
+
+def _run_worker(ckpt_dir, n_batches, kill_after=None):
+    worker = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "_lifecycle_worker.py",
+    )
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.Popen(
+        [sys.executable, worker, ckpt_dir, str(n_batches)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    if kill_after is None:
+        out, err = proc.communicate(timeout=240)
+        assert proc.returncode == 0, err[-2000:]
+        return out
+    seen = 0
+    for line in proc.stdout:
+        if line.startswith("CKPT"):
+            seen += 1
+            if seen >= kill_after:
+                break
+    os.kill(proc.pid, signal.SIGKILL)
+    proc.wait(timeout=60)
+    proc.stdout.close()
+    proc.stderr.close()
+    return None
+
+
+def test_sigkill_mid_partial_fit_resumes_byte_identical(tmp_path):
+    """The resume pin: a SIGKILL'd adapter restores its checkpointed
+    carry+buffers and replays the remaining feedback to candidate
+    weights BYTE-IDENTICAL to an uninterrupted run (absolute
+    iteration indices — the one true trajectory)."""
+    n_batches = 6
+    # the uninterrupted twin
+    twin_out = _run_worker(str(tmp_path / "twin"), n_batches)
+    twin_w = [
+        line for line in twin_out.splitlines() if line.startswith("W ")
+    ][-1]
+    # the victim: SIGKILLed after its 3rd checkpoint, mid-stream
+    killed_dir = str(tmp_path / "killed")
+    _run_worker(killed_dir, n_batches, kill_after=3)
+    # a checkpoint survived the kill
+    steps = os.listdir(os.path.join(killed_dir, "candidate"))
+    assert any(s.startswith("step_") for s in steps)
+    # resume over the same directory: restores + replays the rest
+    resumed_out = _run_worker(killed_dir, n_batches)
+    resumed_w = [
+        line for line in resumed_out.splitlines()
+        if line.startswith("W ")
+    ][-1]
+    assert resumed_w == twin_w
+    assert twin_w != "W none"
+
+
+def test_partial_fit_surface_matches_monolithic_trajectory():
+    """models/sgd.partial_fit_linear over chunks replays the exact
+    monolithic _run_sgd trajectory on a fixed matrix (the absolute-
+    iteration-index seam the lifecycle builds on)."""
+    from eeg_dataanalysispackage_tpu.models import sgd
+
+    rng = np.random.RandomState(3)
+    x = rng.randn(32, 8).astype(np.float32)
+    y = (rng.rand(32) > 0.5).astype(np.float32)
+    config = sgd.SGDConfig(
+        num_iterations=30, step_size=0.5, convergence_tol=0.0
+    )
+    whole = sgd.train_linear(x, y, config)
+    carry = sgd.partial_fit_carry(8)
+    mask = np.ones(32, np.float32)
+    for t0 in range(0, 30, 10):
+        carry = sgd.partial_fit_linear(
+            carry, t0, x, y, config, 10, sample_mask=mask
+        )
+    np.testing.assert_array_equal(np.asarray(carry[0]), whole)

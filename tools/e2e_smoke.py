@@ -115,6 +115,18 @@ performance contract holds:
   byte-identical to the f32 cold run, and the serving engine's int8
   warmup gate decision rides the serve_mega line.
 
+- the model lifecycle manager (serve_lifecycle, tools/serve_bench.py
+  — the ISSUE 15 tentpole): a gate-off lifecycle service's served
+  predictions bit-identical to the batch pipeline (staging + shadow-
+  scoring a candidate never touches the live path), at least one
+  promotion landing DURING closed-loop load with the across-promotion
+  p99 inside the noise floor of the steady-state pass, the promoted
+  candidate served online bit-identical to its ``promoted.npz``
+  checkpoint's batch predictions, the serve.swap/serve.adapt p=0.2
+  chaos soak resolving every request with a failed swap leaving the
+  live model untouched, and the ``lifecycle`` block present in the
+  adapt run's run_report.json.
+
 Usage: python tools/e2e_smoke.py [n_markers_per_file] [n_files]
 
 Prints a JSON summary line; exit 0 iff every gate passed. Wired into
@@ -266,6 +278,87 @@ def _check_serve_mega(line: dict, failures: list) -> None:
     if int8_gate.get("requested") != "int8" or "used" not in int8_gate:
         failures.append(
             f"serve_mega: no int8 gate decision recorded: {int8_gate}"
+        )
+
+
+def _check_lifecycle(line: dict, report_dir: str,
+                     failures: list) -> None:
+    """The model-lifecycle gate (the ISSUE 15 acceptance): the
+    no-swap byte-identity pin (a lifecycle-enabled gate-off service
+    serves exactly the batch predictions), the promoted==batch parity
+    pin (the swapped-in candidate served online equals its checkpoint
+    run over the batch features), the p99 across a promotion within
+    the noise floor of steady state (10x — promotions race full
+    closed-loop load on a shared box), the serve.swap/serve.adapt
+    chaos soak clean with a failed swap provably leaving the live
+    model untouched, and the ``lifecycle`` block in the adapt run's
+    run_report.json."""
+    serve = line.get("serve") or {}
+    no_swap = serve.get("no_swap_parity") or {}
+    if not no_swap.get("bit_identical") or no_swap.get("swaps") != 0:
+        failures.append(
+            f"lifecycle: the no-swap byte-identity pin broke (a "
+            f"gate-off lifecycle must not touch the live path): "
+            f"{no_swap}"
+        )
+    promoted = serve.get("promoted_parity") or {}
+    if not promoted.get("swapped"):
+        failures.append(
+            "lifecycle: no promotion happened under the permissive "
+            f"gate: {serve.get('lifecycle')}"
+        )
+    elif not promoted.get("bit_identical"):
+        failures.append(
+            f"lifecycle: promoted-candidate served predictions "
+            f"drifted from its checkpoint's batch run: {promoted}"
+        )
+    swaps_seen = 0
+    for level in serve.get("sweep") or []:
+        swaps_seen += level.get("swaps_during", 0)
+        if not level.get("p99_ratio", 0.0) > 0.0:
+            failures.append(
+                f"lifecycle: concurrency {level.get('concurrency')} "
+                f"recorded no p99 ratio: {level}"
+            )
+        elif level.get("swaps_during", 0) and level["p99_ratio"] > 10.0:
+            failures.append(
+                f"lifecycle: p99 across a promotion left the noise "
+                f"floor at concurrency {level.get('concurrency')}: "
+                f"{level['p99_ratio']}x steady state"
+            )
+    if swaps_seen < 1:
+        failures.append(
+            "lifecycle: no swap landed during any load level "
+            "(swap-under-load unmeasured)"
+        )
+    chaos_block = serve.get("chaos") or {}
+    if not chaos_block.get("chaos_clean"):
+        failures.append(
+            f"lifecycle: serve.swap/serve.adapt soak did not "
+            f"terminate cleanly: {chaos_block}"
+        )
+    if not chaos_block.get("live_untouched_on_failed_swap"):
+        failures.append(
+            f"lifecycle: a failed swap touched the live model: "
+            f"{chaos_block}"
+        )
+    report_path = os.path.join(report_dir, "run_report.json")
+    try:
+        with open(report_path) as f:
+            report = json.load(f)
+    except (OSError, ValueError) as e:
+        failures.append(f"lifecycle: no readable run_report.json: {e}")
+        return
+    block = report.get("lifecycle")
+    if not block or not block.get("enabled"):
+        failures.append(
+            f"lifecycle: run_report.json has no lifecycle block: "
+            f"{block}"
+        )
+    elif block.get("feedback", {}).get("received", 0) < 1:
+        failures.append(
+            f"lifecycle: the adapt run's report recorded no feedback: "
+            f"{block.get('feedback')}"
         )
 
 
@@ -846,6 +939,16 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
             min(n_markers, 400), n_files, variant="serve_mega"
         )
         _check_serve_mega(serve_mega_line, failures)
+        # the model lifecycle manager (ISSUE 15 tentpole): no-swap
+        # byte-identity, swap-under-load, promoted==batch parity,
+        # serve.swap/serve.adapt chaos soak, and the lifecycle block
+        # in the adapt run's report — all on one line
+        lifecycle_report_dir = os.path.join(tmp, "report_lifecycle")
+        lifecycle_line = _run_serve_bench(
+            min(n_markers, 400), n_files, lifecycle_report_dir,
+            variant="serve_lifecycle",
+        )
+        _check_lifecycle(lifecycle_line, lifecycle_report_dir, failures)
         # the seizure workload: one cost-swept population run over a
         # continuous annotated session (its own data dir — the
         # manifest points at continuous recordings); the swept member
@@ -1146,6 +1249,29 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
         "int8_gate_off_identical_to_f32": (
             int8_off_line["report_sha256"] == cold["report_sha256"]
         ),
+        "serve_lifecycle": {
+            "no_swap_parity": (
+                (lifecycle_line.get("serve") or {})
+                .get("no_swap_parity")
+            ),
+            "promoted_parity": (
+                (lifecycle_line.get("serve") or {})
+                .get("promoted_parity")
+            ),
+            "swaps": (
+                (lifecycle_line.get("serve") or {})
+                .get("lifecycle") or {}
+            ).get("swaps"),
+            "rollbacks": (
+                (lifecycle_line.get("serve") or {})
+                .get("lifecycle") or {}
+            ).get("rollbacks"),
+            "drift_events": (
+                (lifecycle_line.get("serve") or {})
+                .get("lifecycle") or {}
+            ).get("drift_events"),
+            "chaos": (lifecycle_line.get("serve") or {}).get("chaos"),
+        },
         "serve_mega": {
             "mega_rung": (
                 (serve_mega_line.get("serve") or {})

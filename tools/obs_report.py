@@ -263,6 +263,55 @@ def show(path: str) -> None:
             f"{serve.get('drained_cleanly')}  wedged="
             f"{serve.get('wedged')}"
         )
+    lifecycle = data.get("lifecycle")
+    if lifecycle:
+        print("\nlifecycle:")
+        fb = lifecycle.get("feedback") or {}
+        print(
+            f"  state={lifecycle.get('state')}  generation="
+            f"{lifecycle.get('generation')}  swaps="
+            f"{lifecycle.get('swaps')}  rollbacks="
+            f"{lifecycle.get('rollbacks')}  drift="
+            f"{lifecycle.get('drift_events')}  wedged="
+            f"{lifecycle.get('wedged')}"
+        )
+        print(
+            f"  feedback received={fb.get('received')} dropped="
+            f"{fb.get('dropped')}  batches={fb.get('batches')} "
+            f"chunks={fb.get('chunks')} failures={fb.get('failures')}"
+        )
+        lw = lifecycle.get("live_window") or {}
+        print(
+            f"  live window n={lw.get('n')}/{lw.get('window')}  "
+            f"expected_cost={lw.get('expected_cost')}  recall="
+            f"{lw.get('recall')}  baseline_cost="
+            f"{lifecycle.get('baseline_cost')}"
+        )
+        cand = lifecycle.get("candidate")
+        if cand:
+            cw = cand.get("window") or {}
+            print(
+                f"  candidate g{cand.get('generation')} "
+                f"batches={cand.get('batches')} t={cand.get('t')} "
+                f"rows={cand.get('rows')}  shadow cost="
+                f"{cw.get('expected_cost')} recall={cw.get('recall')}"
+            )
+        gate = lifecycle.get("gate")
+        if gate:
+            print(
+                f"  gate {lifecycle.get('config', {}).get('swap_gate')}:"
+                f" candidate_cost={gate.get('candidate_cost')} "
+                f"live_cost={gate.get('live_cost')} "
+                f"promote={gate.get('promote')}"
+            )
+        if lifecycle.get("promoted_path"):
+            print(f"  promoted  {lifecycle.get('promoted_path')}")
+        ckpt = lifecycle.get("checkpoint")
+        if ckpt:
+            print(
+                f"  checkpoint {ckpt.get('dir')} "
+                f"(steps retained: {ckpt.get('steps')})"
+            )
     deg = data.get("degradation") or []
     if deg:
         print("\ndegradation history:")
@@ -364,6 +413,29 @@ def diff(path_a: str, path_b: str) -> None:
     dda, ddb = _dedup_digest(a), _dedup_digest(b)
     if (dda or ddb) and dda != ddb:
         print(f"dedup (role, prefix, leader, saved): A {dda}  B {ddb}")
+
+    def _lifecycle_digest(report):
+        lc = report.get("lifecycle")
+        if not lc:
+            return None
+        return {
+            "state": lc.get("state"),
+            "generation": lc.get("generation"),
+            "swaps": lc.get("swaps"),
+            "rollbacks": lc.get("rollbacks"),
+            "drift": lc.get("drift_events"),
+            "batches": (lc.get("feedback") or {}).get("batches"),
+            "live_cost": (lc.get("live_window") or {}).get(
+                "expected_cost"
+            ),
+        }
+
+    la, lb = _lifecycle_digest(a), _lifecycle_digest(b)
+    if (la or lb) and la != lb:
+        print(
+            f"lifecycle (state, gen, swaps, rollbacks, drift): "
+            f"A {la}  B {lb}"
+        )
     ga, gb = a.get("gateway") or {}, b.get("gateway") or {}
     if (ga or gb) and ga != gb:
         print(f"gateway: A {ga}  B {gb}")

@@ -204,22 +204,29 @@ def _prepare(tmp: str, n_markers: int, n_files: int):
     ).execute()
 
     # the session as serving requests + the batch-path baseline
+    import numpy as np
+
     odp = provider.OfflineDataProvider([info])
     balance = BalanceState()
-    windows, resolutions = [], None
+    windows, targets, resolutions = [], [], None
     for _rel, guessed, rec in odp.iter_recordings():
-        ws, _targets, resolutions = engine.windows_from_recording(
+        ws, rec_targets, resolutions = engine.windows_from_recording(
             rec, odp.channel_indices_for(rec), guessed,
             pre=odp.pre, post=odp.post, balance=balance,
         )
         windows.extend(ws)
+        targets.append(rec_targets)
+    targets = np.concatenate(targets)
     classifier = clf_registry.create("logreg")
     classifier.load(model)
     batch_features, _ = provider.OfflineDataProvider(
         [info]
     ).load_features_device(wavelet_index=8, backend="xla")
     batch_predictions = classifier.predict(batch_features)
-    return info, model, windows, resolutions, classifier, batch_predictions
+    return (
+        info, model, windows, targets, resolutions, classifier,
+        batch_features, batch_predictions,
+    )
 
 
 def run(n_markers: int, n_files: int, report_dir=None) -> dict:
@@ -234,8 +241,8 @@ def run(n_markers: int, n_files: int, report_dir=None) -> dict:
     t0 = time.perf_counter()
     tmp = tempfile.mkdtemp(prefix="eeg_tpu_serve_bench_")
     (
-        info, model, windows, resolutions, classifier,
-        batch_predictions,
+        info, model, windows, _targets, resolutions, classifier,
+        _batch_features, batch_predictions,
     ) = _prepare(tmp, n_markers, n_files)
 
     service = InferenceService.from_saved("logreg", model)
@@ -388,8 +395,8 @@ def run_mega(n_markers: int, n_files: int) -> dict:
     t0 = time.perf_counter()
     tmp = tempfile.mkdtemp(prefix="eeg_tpu_serve_mega_")
     (
-        info, model, windows, resolutions, classifier,
-        batch_predictions,
+        info, model, windows, _targets, resolutions, classifier,
+        _batch_features, batch_predictions,
     ) = _prepare(tmp, n_markers, n_files)
 
     fused_svc = InferenceService(
@@ -508,9 +515,291 @@ def run_mega(n_markers: int, n_files: int) -> dict:
     }
 
 
+def run_lifecycle(n_markers: int, n_files: int, report_dir=None) -> dict:
+    """The serve_lifecycle measurement: the model lifecycle manager
+    (serve/lifecycle.py) under load.
+
+    Four pieces on one line:
+
+    - **no-swap byte-identity** — a lifecycle-enabled service with
+      ``swap_gate=off`` serves the session (feedback fed for every
+      window) and its predictions must be bit-identical to the batch
+      pipeline's: staging + shadow-scoring a candidate provably never
+      touches the live path;
+    - **swap under load** — a permissive-gate service is swept at each
+      concurrency level twice, back-to-back: a steady-state pass, then
+      a pass with a feedback feeder thread running so partial-fit
+      chunks, gate checks, and (behind the gate) a promotion land
+      DURING the traffic; per-level p50/p99 + preds/sec pairs and the
+      across-promotion p99 ratio are the line's headline, with
+      swaps/rollbacks/drift counted from the lifecycle block;
+    - **promoted==batch parity** — after the promotion, the session is
+      re-served and compared element-wise against a fresh classifier
+      loaded from the promoted checkpoint (``promoted.npz``) run over
+      the batch features;
+    - **chaos soak** — with ``serve.swap``/``serve.adapt`` firing at
+      p=0.2, every submitted request still resolves, the drain
+      completes, and a failed swap leaves the live model untouched
+      (swap_failures counted; the live-model identity is asserted
+      in-process and recorded).
+    """
+    import numpy as np
+
+    from eeg_dataanalysispackage_tpu.models import (
+        registry as clf_registry,
+    )
+    from eeg_dataanalysispackage_tpu.obs import chaos
+    from eeg_dataanalysispackage_tpu.pipeline import builder
+    from eeg_dataanalysispackage_tpu.serve import (
+        InferenceService, LifecycleConfig, ServeConfig,
+    )
+
+    t0 = time.perf_counter()
+    tmp = tempfile.mkdtemp(prefix="eeg_tpu_serve_lifecycle_")
+    (
+        info, model, windows, targets, resolutions, classifier,
+        batch_features, batch_predictions,
+    ) = _prepare(tmp, n_markers, n_files)
+
+    # 1. no-swap byte-identity: gate off, full feedback, predictions
+    # bit-identical to batch
+    no_swap = InferenceService.from_saved(
+        "logreg", model,
+        lifecycle=LifecycleConfig(
+            adapt_batch=16, adapt_iters=10, drift_window=32,
+            gate_mode="off", gate_ratio=None,
+        ),
+    )
+    no_swap.start()
+    try:
+        results = no_swap.predict_all(windows, resolutions)
+        for w, y in zip(windows, targets):
+            no_swap.feedback(w, resolutions, float(y))
+        no_swap.lifecycle.flush(timeout_s=60.0)
+    finally:
+        no_swap.stop(drain=True)
+    no_swap_served = np.array([r.prediction for r in results])
+    no_swap_block = no_swap.stats_block()["lifecycle"]
+    no_swap_parity = {
+        "n": len(windows),
+        "bit_identical": bool(
+            np.array_equal(no_swap_served, batch_predictions)
+        ),
+        "swaps": no_swap_block["swaps"],
+        "batches": no_swap_block["feedback"]["batches"],
+    }
+
+    # 2. swap under load: steady-state level, then the same level with
+    # the feedback feeder (and therefore a promotion) racing it
+    ckpt = os.path.join(tmp, "lifecycle")
+    svc = InferenceService.from_saved(
+        "logreg", model,
+        lifecycle=LifecycleConfig(
+            adapt_batch=16, adapt_iters=10, drift_window=32,
+            gate_mode="cost", gate_ratio=100.0, checkpoint_dir=ckpt,
+        ),
+    )
+    svc.start()
+    stop_feeder = threading.Event()
+
+    def feeder():
+        i = 0
+        while not stop_feeder.is_set():
+            try:
+                svc.feedback(
+                    windows[i % len(windows)], resolutions,
+                    float(targets[i % len(windows)]),
+                )
+            except Exception:
+                return
+            i += 1
+            if i % 64 == 0:
+                time.sleep(0.001)
+
+    sweep = []
+    try:
+        for c in _SWEEP_CONCURRENCY:
+            steady = _drive_level(
+                svc, windows, resolutions, c, _REQUESTS_PER_LEVEL,
+                deadline_s=5.0,
+            )
+            swaps_before = svc.lifecycle.block()["swaps"]
+            feeder_thread = threading.Thread(target=feeder, daemon=True)
+            stop_feeder.clear()
+            feeder_thread.start()
+            under_adapt = _drive_level(
+                svc, windows, resolutions, c, _REQUESTS_PER_LEVEL,
+                deadline_s=5.0,
+            )
+            stop_feeder.set()
+            feeder_thread.join(timeout=10.0)
+            svc.lifecycle.flush(timeout_s=30.0)
+            sweep.append({
+                "concurrency": c,
+                "steady": steady,
+                "under_adapt": under_adapt,
+                "swaps_during": (
+                    svc.lifecycle.block()["swaps"] - swaps_before
+                ),
+                "p99_ratio": round(
+                    under_adapt["p99_ms"]
+                    / max(1e-9, steady["p99_ms"]), 3
+                ),
+                "preds_ratio": round(
+                    under_adapt["preds_per_s"]
+                    / max(1e-9, steady["preds_per_s"]), 3
+                ),
+            })
+        lifecycle_block = svc.lifecycle.block()
+
+        # 3. promoted==batch parity: re-serve through the (promoted)
+        # service and compare against the promoted checkpoint's batch
+        # predictions
+        promoted_parity = {"swapped": lifecycle_block["swaps"] >= 1}
+        if lifecycle_block["swaps"] >= 1:
+            served = np.array([
+                r.prediction
+                for r in svc.predict_all(windows, resolutions)
+            ])
+            promoted = clf_registry.create("logreg")
+            promoted.load(lifecycle_block["promoted_path"])
+            # the batch feature matrix was computed once in _prepare;
+            # re-featurizing inside the timed child would bill device
+            # ingest against the bench wall for no new information
+            promoted_batch = promoted.predict(batch_features)
+            promoted_parity.update({
+                "n": len(windows),
+                "bit_identical": bool(
+                    np.array_equal(served, promoted_batch)
+                ),
+                "mismatches": int((served != promoted_batch).sum()),
+            })
+    finally:
+        stop_feeder.set()
+        svc.stop(drain=True)
+
+    # 4. chaos soak on the lifecycle points: every request resolves,
+    # a failed swap leaves the live model untouched
+    soak = InferenceService.from_saved(
+        "logreg", model,
+        config=ServeConfig(max_attempts=4, retry_backoff_s=0.01),
+        lifecycle=LifecycleConfig(
+            adapt_batch=16, adapt_iters=10, drift_window=32,
+            gate_mode="cost", gate_ratio=100.0,
+        ),
+    )
+    from eeg_dataanalysispackage_tpu.io import deadline as deadline_mod
+
+    outcomes = {
+        "completed": 0, "shed": 0, "deadline": 0, "failed": 0,
+        "unresolved": 0,
+    }
+    from eeg_dataanalysispackage_tpu.serve import batcher as batcher_mod
+
+    with chaos.faults("serve.swap:p=0.2;serve.adapt:p=0.2;seed=13"):
+        soak.start()
+        futures = []
+        for i in range(min(len(windows) * 2, 400)):
+            w = windows[i % len(windows)]
+            try:
+                futures.append(soak.submit(
+                    w, resolutions, deadline_s=5.0, block_s=5.0,
+                    label=float(targets[i % len(windows)]),
+                ))
+            except batcher_mod.ShedError:
+                # a shed IS a resolution (rejected with evidence at
+                # the door) — counted, never a crashed variant
+                outcomes["shed"] += 1
+        for fut in futures:
+            try:
+                fut.result(timeout=20.0)
+                outcomes["completed"] += 1
+            except deadline_mod.DeadlineExceededError:
+                outcomes["deadline"] += 1
+            except TimeoutError:
+                outcomes["unresolved"] += 1
+            except Exception:
+                outcomes["failed"] += 1
+        soak.lifecycle.flush(timeout_s=30.0)
+        soak_block = soak.lifecycle.block()
+        drained = soak.stop(drain=True)
+
+    # the failed-swap identity probe: with EVERY promotion attempt
+    # chaos-failed, the live classifier OBJECT must survive untouched
+    # and the candidate stay staged — measured directly, not inferred
+    # from a soak where a successful swap legitimately changes the
+    # model
+    probe = InferenceService.from_saved(
+        "logreg", model,
+        lifecycle=LifecycleConfig(
+            adapt_batch=16, adapt_iters=10, drift_window=32,
+            gate_mode="cost", gate_ratio=100.0,
+        ),
+    )
+    probe_live = probe.engine.classifier
+    with chaos.faults("serve.swap:every@1"):
+        probe.start()
+        for i in range(len(windows)):
+            probe.feedback(
+                windows[i], resolutions, float(targets[i])
+            )
+        probe.lifecycle.flush(timeout_s=30.0)
+        probe_block = probe.lifecycle.block()
+        probe.stop(drain=True)
+    live_untouched_ok = (
+        probe_block["swap_failures"] >= 1
+        and probe_block["swaps"] == 0
+        and probe.engine.classifier is probe_live
+    )
+    chaos_block = {
+        **outcomes,
+        "drained_cleanly": drained,
+        "chaos_clean": outcomes["unresolved"] == 0 and drained,
+        "swaps": soak_block["swaps"],
+        "swap_failures": soak_block["swap_failures"],
+        "adapt_failures": soak_block["feedback"]["failures"],
+        "probe_swap_failures": probe_block["swap_failures"],
+        "live_untouched_on_failed_swap": bool(live_untouched_ok),
+    }
+
+    # 5. optional run_report.json with the lifecycle block, via the
+    # real serve=true&adapt=true pipeline mode (the smoke gate
+    # cross-checks it)
+    if report_dir:
+        builder.PipelineBuilder(
+            f"info_file={info}&fe=dwt-8-fused&serve=true"
+            f"&load_clf=logreg&load_name={model}&adapt=true"
+            f"&swap_gate=off&drift_window=32&report={report_dir}"
+        ).execute()
+
+    import jax
+
+    best = max(
+        level["under_adapt"]["preds_per_s"] for level in sweep
+    )
+    return {
+        "variant": "serve_lifecycle",
+        "epochs_per_s": best,
+        "n": len(windows),
+        "iters": _REQUESTS_PER_LEVEL,
+        "bytes_per_epoch": _BYTES_PER_EPOCH,
+        "wall_s": round(time.perf_counter() - t0, 3),
+        "n_markers_per_file": n_markers,
+        "n_files": n_files,
+        "platform": jax.devices()[0].platform,
+        "serve": {
+            "sweep": sweep,
+            "no_swap_parity": no_swap_parity,
+            "promoted_parity": promoted_parity,
+            "lifecycle": lifecycle_block,
+            "chaos": chaos_block,
+        },
+    }
+
+
 def main(argv) -> dict:
     variant = argv[0] if argv else "serve_bench"
-    if variant not in ("serve_bench", "serve_mega"):
+    if variant not in ("serve_bench", "serve_mega", "serve_lifecycle"):
         raise SystemExit(f"unknown variant {variant!r}")
     n_markers = int(argv[1]) if len(argv) > 1 else 400
     n_files = int(argv[2]) if len(argv) > 2 else 2
@@ -522,6 +811,8 @@ def main(argv) -> dict:
             raise SystemExit(f"unknown argument {arg!r}")
     if variant == "serve_mega":
         return run_mega(n_markers, n_files)
+    if variant == "serve_lifecycle":
+        return run_lifecycle(n_markers, n_files, report_dir=report_dir)
     return run(n_markers, n_files, report_dir=report_dir)
 
 
