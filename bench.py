@@ -116,6 +116,17 @@ train-step variants (tools/ingest_bench.py) with HBM-roofline context:
                   pairs with the across-promotion p99 ratio, the
                   no-swap and promoted==batch parity pins, and the
                   serve.swap/serve.adapt chaos soak
+  serve_multitenant
+                  the multiplexed multi-tenant engine
+                  (serve/multiplex.py via tools/serve_bench.py): at
+                  each tenant level 1/4/16, ONE resident service
+                  carrying N tenant models vs a fleet of N solo
+                  services over the same models, back-to-back at
+                  concurrency 16 — per-level preds/sec + p50/p99
+                  pairs with the ratio, the per-tenant
+                  multiplexed-vs-solo parity pin, the 0-compile
+                  scaling and hot-swap pins, and the resident weight
+                  bytes (one stacked matrix vs N engines)
   pipeline_e2e_int8
                   the cold query with precision=int8 (per-subband
                   feature quantization behind the per-run gate — the
@@ -212,6 +223,10 @@ _VARIANT_TIMEOUTS = {
     # fused program cold) plus the partial-fit chunk program and a
     # full adapt pipeline run — same fresh-compile class
     "serve_lifecycle": _SLOW_COMPILE_TIMEOUT_S,
+    # the multitenant child compiles the multi-tenant fused AND mega
+    # programs cold, then drives six sweeps (multiplexed + fleet at
+    # three tenant levels) — same fresh-compile class
+    "serve_multitenant": _SLOW_COMPILE_TIMEOUT_S,
     # four fresh pipeline processes (2 pod workers + twin + degraded
     # run) in one child — the wall is ~4 population_vmap runs
     "population_multiproc": _SLOW_COMPILE_TIMEOUT_S,
@@ -223,7 +238,7 @@ _VARIANT_TIMEOUTS = {
 # patience — on a warm compile cache everything fits easily; on a
 # cold cache the tail variants may be budget-skipped (recorded as
 # such, artifact intact). BENCH_TOTAL_BUDGET overrides.
-_N_VARIANTS = 29  # asserted against the variant tables below
+_N_VARIANTS = 30  # asserted against the variant tables below
 _TOTAL_BUDGET_S = int(
     os.environ.get(
         "BENCH_TOTAL_BUDGET",
@@ -320,6 +335,11 @@ _VARIANTS_TPU = {
     # the line), the no-swap + promoted==batch parity pins, and the
     # serve.swap/serve.adapt chaos soak
     "serve_lifecycle": (2000, 2),
+    # the multiplexed multi-tenant engine vs the solo fleet it
+    # replaces, per tenant level (parity + 0-compile pins on the
+    # line; multiplex.accelerator_decision harvests the 16-tenant
+    # level from staged runs)
+    "serve_multitenant": (2000, 2),
     # the multi-tenant plan executor (markers per file, file count —
     # tools/pipeline_bench.py scheduler_multi): 4 plans sequential vs
     # concurrent over shared caches, per-plan isolated attribution,
@@ -359,6 +379,7 @@ _VARIANTS_CPU = {
     "serve_bench": (400, 2),
     "serve_mega": (400, 2),
     "serve_lifecycle": (400, 2),
+    "serve_multitenant": (400, 2),
     "scheduler_multi": (2000, 4),
     "plan_service": (2000, 4),
 }

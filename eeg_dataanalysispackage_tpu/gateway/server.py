@@ -30,12 +30,30 @@ POST   /plans                     201 {plan_id, state} — body is the query
                                   existing plan; 400 invalid; 429 shed (with
                                   evidence + the journaled plan id); 503
                                   closed
+POST   /predict                   the serving HOT PATH (requires a
+                                  ``predict_service`` — a multiplexed
+                                  inference service attached at
+                                  construction): body is JSON {tenant,
+                                  window, resolutions[, deadline_s]};
+                                  200 {tenant, prediction, margin,
+                                  latency_ms, batch_size}; replayed
+                                  ``X-Idempotency-Key`` returns the cached
+                                  answer, reused with a different body 409;
+                                  400 invalid/unknown tenant; 429 shed with
+                                  the per-tenant evidence body (tenant depth,
+                                  quota, queue depth, oldest-age — the
+                                  admission queue's structured record); 503
+                                  no service/draining/wedged
 GET    /plans                     200 {plans: [...]} — journal + live states
 GET    /plans/<id>                200 status; 404 unknown
 GET    /plans/<id>/report         200 {statistics, statistics_sha256, error,
                                   run_report}; 409 while non-terminal
 DELETE /plans/<id>                200 {cancelled: true}; 409 not-queued
-GET    /stats                     200 {dedup, queue_depth, scheduler counters}
+GET    /stats                     200 {dedup, queue_depth, scheduler
+                                  counters}; with a ``predict_service``
+                                  attached also ``serve`` — the full serve
+                                  block including the per-tenant attribution
+                                  sub-block (serve/multiplex.py)
 GET    /healthz                   200 {ok: true, ...}
 ====== ========================== ===========================================
 
@@ -61,9 +79,18 @@ from ..scheduler.executor import (
     PlanExecutor,
     PlanShedError,
 )
-from ..serve.batcher import ServiceClosedError
+from ..serve.batcher import (
+    ServeError,
+    ServiceClosedError,
+    ServiceWedgedError,
+    ShedError,
+)
 
 logger = logging.getLogger(__name__)
+
+#: bound on the /predict idempotency replay cache (answers are small —
+#: one prediction each — but the cache must not grow with traffic)
+_PREDICT_CACHE_LIMIT = 4096
 
 #: default port when none is given (0 = ephemeral, the test default)
 ENV_PORT = "EEG_TPU_GATEWAY_PORT"
@@ -91,6 +118,7 @@ class GatewayServer:
         queue_depth: int = 16,
         max_attempts: int = 3,
         recover: bool = True,
+        predict_service=None,
     ):
         if port is None:
             port = int(os.environ.get(ENV_PORT, "0") or 0)
@@ -117,6 +145,19 @@ class GatewayServer:
         #: its whole PipelineBuilder) for the server's lifetime.
         self._handles: Dict[str, Any] = {}
         self.recovery: Optional[Dict[str, Any]] = None
+        #: the serving hot path's back end (serve/multiplex.py's
+        #: MultiplexedService — or any service whose predict_window
+        #: takes tenant=): attached by the operator, NOT owned; its
+        #: start/stop lifecycle stays with whoever built it. None
+        #: (the default) keeps the gateway the pure plan front door
+        #: and POST /predict answers 503.
+        self.predict_service = predict_service
+        #: idempotency replay cache for /predict: key -> (body sha,
+        #: status code, payload). Only successful answers are cached —
+        #: a shed or error response must stay retryable under the
+        #: same key (the /plans convention: the key is not burned).
+        self._predict_cache: Dict[str, Tuple[str, int, Dict[str, Any]]] = {}
+        self._predict_cache_lock = threading.Lock()
 
     # -- lifecycle -------------------------------------------------------
 
@@ -235,6 +276,125 @@ class GatewayServer:
             "idempotent_replay": replayed,
         }
 
+    def predict_payload(
+        self,
+        raw_body: str,
+        idempotency_key: Optional[str] = None,
+    ) -> Tuple[int, Dict[str, Any]]:
+        """The serving hot path: one tenant-keyed prediction request
+        against the attached multiplexed service.
+
+        Body: ``{"tenant": str, "window": [[...]] (int16 raw samples,
+        channels x window_len), "resolutions": [...], "deadline_s":
+        float?}``. An ``X-Idempotency-Key`` replays the cached answer
+        byte-identically (409 when the key is reused with a different
+        body); a shed maps to 429 carrying the admission queue's
+        structured per-tenant evidence."""
+        import hashlib
+
+        import numpy as np
+
+        if self.predict_service is None:
+            return 503, {
+                "error": "no prediction service attached to this "
+                "gateway (predict_service=)",
+            }
+        body_sha = hashlib.sha256(raw_body.encode()).hexdigest()
+        if idempotency_key:
+            with self._predict_cache_lock:
+                cached = self._predict_cache.get(idempotency_key)
+            if cached is not None:
+                prior_sha, code, payload = cached
+                if prior_sha != body_sha:
+                    return 409, {
+                        "error": (
+                            f"idempotency key {idempotency_key!r} was "
+                            f"already used with a different request "
+                            f"body"
+                        ),
+                        "idempotency_conflict": True,
+                    }
+                replay = dict(payload)
+                replay["idempotent_replay"] = True
+                return code, replay
+        try:
+            request = json.loads(raw_body)
+        except ValueError as e:
+            return 400, {"error": f"request body is not JSON: {e}"}
+        if not isinstance(request, dict):
+            return 400, {"error": "request body must be a JSON object"}
+        tenant = request.get("tenant")
+        if not isinstance(tenant, str) or not tenant:
+            return 400, {"error": "tenant must be a non-empty string"}
+        deadline_s = request.get("deadline_s")
+        if deadline_s is not None and not isinstance(
+            deadline_s, (int, float)
+        ):
+            return 400, {"error": "deadline_s must be a number"}
+        try:
+            window = np.asarray(request["window"], dtype=np.int16)
+            resolutions = np.asarray(
+                request["resolutions"], dtype=np.float32
+            )
+        except KeyError as e:
+            return 400, {"error": f"missing field {e.args[0]!r}"}
+        except (TypeError, ValueError) as e:
+            return 400, {"error": f"malformed window/resolutions: {e}"}
+        try:
+            result = self.predict_service.predict_window(
+                window, resolutions, tenant=tenant,
+                deadline_s=deadline_s,
+            )
+        except ShedError as e:
+            # per-tenant backpressure, with the admission queue's
+            # structured evidence (tenant depth + quota + oldest-age)
+            # in the body — NOT cached: the retry must get a fresh
+            # admission attempt under the same key
+            return 429, {
+                "error": str(e),
+                "shed": True,
+                "tenant": tenant,
+                "evidence": e.evidence,
+            }
+        except (ServiceClosedError, ServiceWedgedError) as e:
+            return 503, {"error": str(e), "tenant": tenant}
+        except ValueError as e:
+            # unknown tenant / wrong window geometry: the request is
+            # the bug
+            return 400, {"error": str(e), "tenant": tenant}
+        except ServeError as e:
+            # deadline-exceeded and exhausted-retry outcomes: the
+            # request was admitted but could not be answered in budget
+            return 504, {
+                "error": str(e),
+                "tenant": tenant,
+                "failed": True,
+            }
+        payload = {
+            "tenant": tenant,
+            "prediction": float(result.prediction),
+            "margin": (
+                None if result.margin is None
+                else float(result.margin)
+            ),
+            "latency_ms": round(result.latency_s * 1e3, 3),
+            "batch_size": result.batch_size,
+            "attempts": result.attempts,
+            "idempotent_replay": False,
+        }
+        if idempotency_key:
+            with self._predict_cache_lock:
+                if len(self._predict_cache) >= _PREDICT_CACHE_LIMIT:
+                    # bounded FIFO: drop the oldest key (dicts
+                    # preserve insertion order)
+                    self._predict_cache.pop(
+                        next(iter(self._predict_cache))
+                    )
+                self._predict_cache[idempotency_key] = (
+                    body_sha, 200, payload,
+                )
+        return 200, payload
+
     def status_payload(self, plan_id: str) -> Tuple[int, Dict[str, Any]]:
         status = self.executor.status(plan_id)
         if status is None:
@@ -346,7 +506,7 @@ class GatewayServer:
 
     def stats_payload(self) -> Tuple[int, Dict[str, Any]]:
         counters = obs.metrics.snapshot()["counters"]
-        return 200, {
+        payload = {
             "dedup": dedup_mod.stats(),
             "queue_depth": len(self.executor.queue),
             "scheduler": {
@@ -354,6 +514,12 @@ class GatewayServer:
                 if k.startswith("scheduler.")
             },
         }
+        if self.predict_service is not None:
+            # the serving block, per-tenant attribution included
+            # (serve/multiplex.py stats_block; tools/plan_admin.py
+            # --tenant filters it client-side)
+            payload["serve"] = self.predict_service.stats_block()
+        return 200, payload
 
     def health_payload(self) -> Tuple[int, Dict[str, Any]]:
         return 200, {
@@ -391,6 +557,13 @@ class _GatewayHandler(BaseHTTPRequestHandler):
     # -- methods ---------------------------------------------------------
 
     def do_POST(self) -> None:
+        if self.path.rstrip("/") == "/predict":
+            code, payload = self.gateway.predict_payload(
+                self._body(),
+                idempotency_key=self.headers.get("X-Idempotency-Key"),
+            )
+            self._send(code, payload)
+            return
         if self.path.rstrip("/") != "/plans":
             self._send(404, {"error": f"no such endpoint {self.path}"})
             return

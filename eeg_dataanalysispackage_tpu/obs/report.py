@@ -228,7 +228,13 @@ class RunTelemetry:
         #: outcome counters (completed/shed/deadline-exceeded/failed),
         #: batch coalescing stats, latency percentiles, watchdog and
         #: drain state — one block for ``serve=true`` runs; None when
-        #: the run served nothing
+        #: the run served nothing. Multiplexed services
+        #: (serve/multiplex.py) additionally carry a ``tenants``
+        #: sub-block — per tenant: lane, swap generation, outcome
+        #: counters (submitted/completed/shed/deadline-exceeded/
+        #: failed/retries), latency p50/p99, lifecycle state — plus
+        #: ``tenant_quota`` and ``resident_weight_bytes``
+        #: (tools/obs_report.py renders and diffs it)
         self.serve: Optional[Dict[str, Any]] = None
         #: model-lifecycle attribution (serve/lifecycle.py): feedback
         #: and partial-fit counters, the candidate's shadow window,

@@ -427,6 +427,181 @@ def _mega_program(
     return run
 
 
+@functools.lru_cache(maxsize=None)
+def _mega_multi_program(
+    wavelet_index: int,
+    epoch_size: int,
+    skip_samples: int,
+    feature_size: int,
+    n_channels: int,
+    pre: int,
+    post: int,
+    capacity: int,
+    lowering: str,
+    interpret: bool,
+    donate: bool,
+    tile_b: int = MEGA_TILE,
+):
+    """The tenant-stacked megakernel: ``(stream, resolutions,
+    weight_matrix (C*K, 128), tenant_lanes (capacity,) int32) ->
+    margins (capacity,)``, one compiled program for every tenant mix
+    (serve/multiplex.py).
+
+    The solo kernel ALREADY computes the full ``(tile_b, 128)`` margin
+    matrix against a 128-lane weight matrix and discards 127 columns;
+    the multi-tenant pallas lowering simply passes the filled tenant
+    stack as that matrix and gathers each row's tenant column OUTSIDE
+    the kernel (no in-kernel dynamic lane slice — the remote-compile
+    crasher class). Column position is reduction-invariant in the MXU
+    dot (and measured so on the XLA interpret path), so a tenant's
+    margin matches the solo kernel's column 0 bit-for-bit. The XLA
+    twin mirrors the fused multi program's discipline instead: 128
+    unrolled HIGHEST matvecs — each byte-identical to the solo twin's
+    margin dot — then the per-row column pick (a plain matmul column
+    drifts ~3e-5 from the matvec; measured, not assumed). Both
+    lowerings sit behind the engine's warmup margin-parity gate
+    exactly like the solo program."""
+    if capacity % tile_b:
+        raise ValueError(
+            f"mega capacity {capacity} must be a multiple of the "
+            f"{tile_b}-window kernel tile (the engine's 64-multiple "
+            f"bucketing satisfies it)"
+        )
+    if pre < 1:
+        raise ValueError(
+            "the megakernel's baseline subtract needs pre >= 1 "
+            "(pre=0 geometries serve through the host-extractor mode)"
+        )
+    C = int(n_channels)
+    K = int(feature_size)
+    Wp = padded_stride(pre, post)
+    live = pre + skip_samples + epoch_size
+    if live > Wp:
+        raise ValueError(
+            f"window geometry (pre {pre} + skip {skip_samples} + "
+            f"epoch {epoch_size} = {live}) exceeds the padded stride "
+            f"{Wp} (= pre+post rounded to 128)"
+        )
+    E_np = device_ingest.ingest_matrix(
+        wavelet_index, epoch_size, skip_samples, feature_size, pre,
+        window_len=Wp, fold_baseline=False,
+    )
+    donate_args = (0,) if donate else ()
+
+    if lowering == "xla":
+        W_np = E_np[pre + skip_samples: pre + skip_samples + epoch_size]
+
+        @functools.partial(jax.jit, donate_argnums=donate_args)
+        def run(stream, resolutions, weight_matrix, tenant_lanes):
+            W = jnp.asarray(W_np)
+            rows = stream.reshape(C, capacity, Wp)
+            scale = resolutions[:, None, None]
+            pre_f = rows[:, :, :pre].astype(jnp.float32) * scale
+            live_f = rows[
+                :, :, pre + skip_samples: pre + skip_samples + epoch_size
+            ].astype(jnp.float32) * scale
+            base = jnp.mean(pre_f, axis=2, keepdims=True)
+            z = (live_f - base).reshape(C * capacity, epoch_size)
+            y = lax.dot_general(
+                z, W, (((1,), (0,)), ((), ())),
+                precision=lax.Precision.HIGHEST,
+            )
+            feats = jnp.transpose(
+                y.reshape(C, capacity, K), (1, 0, 2)
+            ).reshape(capacity, C * K)
+            feats = dwt_xla.safe_l2_normalize(feats)
+            # one (capacity, 128) HIGHEST-precision matmul, then a
+            # row-indexed gather. Under Precision.HIGHEST a matmul
+            # column is bitwise the solo twin's matvec on XLA:CPU
+            # (measured; NOT true at default precision, which is why
+            # the fused multi program unrolls per-column matvecs
+            # instead — each formulation copies its solo twin's
+            # primitive exactly)
+            columns = jnp.dot(
+                feats, weight_matrix.astype(jnp.float32),
+                precision=lax.Precision.HIGHEST,
+            )
+            return jnp.take_along_axis(
+                columns, tenant_lanes[:, None], axis=1
+            )[:, 0]
+
+        return run
+
+    if lowering != "pallas":
+        raise ValueError(
+            f"unknown mega lowering {lowering!r}; use one of {LOWERINGS}"
+        )
+
+    rpw = Wp // 128
+    kernel = _make_mega_kernel(C, tile_b, Wp, pre, K)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=0,
+        grid=(capacity // tile_b,),
+        in_specs=[
+            pl.BlockSpec(
+                (C, tile_b * rpw, 128), lambda i: (0, i, 0)
+            ),
+            pl.BlockSpec((C, 1), lambda i: (0, 0)),
+            pl.BlockSpec((Wp, K), lambda i: (0, 0)),
+            pl.BlockSpec((C * K, 128), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((tile_b, 128), lambda i: (i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((tile_b * C, Wp), jnp.float32),
+        ],
+    )
+
+    @functools.partial(jax.jit, donate_argnums=donate_args)
+    def run(stream, resolutions, weight_matrix, tenant_lanes):
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((capacity, 128), jnp.float32),
+            interpret=interpret,
+        )(
+            stream.reshape(C, capacity * rpw, 128),
+            resolutions.astype(jnp.float32)[:, None],
+            jnp.asarray(E_np),
+            weight_matrix.astype(jnp.float32),
+        )
+        return jnp.take_along_axis(
+            out, tenant_lanes[:, None], axis=1
+        )[:, 0]
+
+    return run
+
+
+def make_serve_mega_multi_program(
+    wavelet_index: int = 8,
+    epoch_size: int = 512,
+    skip_samples: int = 175,
+    feature_size: int = 16,
+    n_channels: int = constants.USED_CHANNELS,
+    pre: int = constants.PRESTIMULUS_SAMPLES,
+    post: int = constants.POSTSTIMULUS_SAMPLES,
+    capacity: int = 64,
+    lowering: str | None = None,
+    interpret: bool | None = None,
+    donate: bool | None = None,
+):
+    """Build (or fetch cached) the tenant-stacked megakernel program
+    for one serving geometry — the multi-tenant twin of
+    :func:`make_serve_mega_program`, same resolution rules."""
+    from . import pallas_support
+
+    if lowering is None:
+        lowering = default_lowering()
+    if interpret is None:
+        interpret = pallas_support.default_interpret()
+    if donate is None:
+        donate = jax.default_backend() != "cpu"
+    return _mega_multi_program(
+        int(wavelet_index), int(epoch_size), int(skip_samples),
+        int(feature_size), int(n_channels), int(pre), int(post),
+        int(capacity), str(lowering), bool(interpret), bool(donate),
+    )
+
+
 def make_serve_mega_program(
     wavelet_index: int = 8,
     epoch_size: int = 512,

@@ -26,7 +26,14 @@ ROADMAP's "millions of users" subsystem:
                drift detection (``serve.drift``);
 - ``pipeline`` the ``serve=true`` query mode: drive a batch session
                through the service epoch-by-epoch, statistics pinned
-               bit-identical to the batch ``load_clf=`` run.
+               bit-identical to the batch ``load_clf=`` run;
+- ``multiplex`` multi-tenant serving: N tenants' weight vectors
+               stacked into the columns of ONE resident 128-lane
+               matrix, served by ONE compiled program that gathers
+               each row's tenant column by index — mixed-tenant
+               micro-batches, zero-recompile tenant add/swap, per-
+               tenant quotas and attribution, per-batch snapshot
+               isolation.
 
 See docs/serving.md for knobs, semantics, and the parity contract.
 """
@@ -45,5 +52,10 @@ from .lifecycle import (  # noqa: F401
     LifecycleConfig,
     LifecycleManager,
     parse_swap_gate,
+)
+from .multiplex import (  # noqa: F401
+    MultiplexedEngine,
+    MultiplexedService,
+    TenantStack,
 )
 from .service import InferenceService, ServeConfig  # noqa: F401

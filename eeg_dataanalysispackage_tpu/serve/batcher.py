@@ -50,7 +50,16 @@ class ServeError(RuntimeError):
 
 
 class ShedError(ServeError):
-    """Admission control rejected the request (queue full)."""
+    """Admission control rejected the request (queue full).
+
+    ``evidence`` carries the structured shed record when one exists —
+    for a multi-tenant service the per-tenant depth/quota/oldest-age
+    the 429 body surfaces (gateway/server.py) — alongside the
+    human-readable message."""
+
+    def __init__(self, message: str, evidence: Optional[dict] = None):
+        super().__init__(message)
+        self.evidence = evidence or {}
 
 
 class ServiceClosedError(ServeError):
@@ -123,10 +132,10 @@ class Request:
 
     __slots__ = (
         "window", "resolutions", "deadline", "future", "submitted_at",
-        "attempts", "history",
+        "attempts", "history", "tenant",
     )
 
-    def __init__(self, window, resolutions, deadline):
+    def __init__(self, window, resolutions, deadline, tenant=None):
         self.window = window
         self.resolutions = resolutions
         self.deadline: deadline_mod.Deadline = deadline
@@ -134,10 +143,18 @@ class Request:
         self.submitted_at = time.monotonic()
         self.attempts = 0
         self.history: List[str] = []
+        #: owning tenant (multiplexed services, serve/multiplex.py);
+        #: None for single-model services. Deliberately NOT part of
+        #: batch_key: mixed-tenant requests must coalesce into ONE
+        #: bucket — the whole point of the multiplexed engine is that
+        #: serve_flush_us fills buckets ACROSS tenants
+        self.tenant: Optional[str] = tenant
 
     def batch_key(self):
         """Requests coalesce only when the program can run them as one
-        stream: same dtype, same per-channel resolutions."""
+        stream: same dtype, same per-channel resolutions. The tenant
+        is NOT here — the multiplexed program gathers each row's
+        tenant weights by index, so a bucket mixes tenants freely."""
         res = self.resolutions
         return (self.window.dtype.str, res.tobytes())
 
@@ -173,16 +190,30 @@ class AdmissionQueue:
     access, so this is a small purpose-built structure.
     """
 
-    def __init__(self, depth: int):
+    def __init__(self, depth: int, tenant_quota: Optional[int] = None):
         if depth < 1:
             raise ValueError(f"queue depth must be >= 1, got {depth}")
+        if tenant_quota is not None and tenant_quota < 1:
+            raise ValueError(
+                f"tenant_quota must be >= 1, got {tenant_quota}"
+            )
         self.depth = int(depth)
+        #: per-tenant queued-request cap (multiplexed services): one
+        #: noisy tenant fills its quota and sheds — with ITS evidence —
+        #: while the shared queue keeps admitting everyone else. None
+        #: (single-model services) checks only the global depth.
+        self.tenant_quota = (
+            None if tenant_quota is None else int(tenant_quota)
+        )
         self._items: "collections.deque" = collections.deque()
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
         self._not_full = threading.Condition(self._lock)
         #: human-readable evidence for the most recent shed decision
         self._last_shed_evidence = ""
+        #: structured twin of the evidence line (tenant, depths, ages)
+        #: — what a multi-tenant 429 body carries
+        self._last_shed_details: dict = {}
 
     def __len__(self) -> int:
         with self._lock:
@@ -196,22 +227,88 @@ class AdmissionQueue:
         with self._lock:
             return self._last_shed_evidence
 
+    @property
+    def last_shed_details(self) -> dict:
+        """Structured evidence for the most recent shed decision —
+        ``{"reason", "queue_depth", "depth_limit", "oldest_age_s"}``
+        plus ``{"tenant", "tenant_depth", "tenant_quota"}`` when a
+        per-tenant quota did the shedding."""
+        with self._lock:
+            return dict(self._last_shed_details)
+
+    def _tenant_depth(self, tenant) -> int:
+        """Queued requests owned by ``tenant`` (caller holds the
+        lock)."""
+        return sum(1 for item in self._items if item.tenant == tenant)
+
     def offer(self, request: Request, block_s: float = 0.0) -> bool:
         """Admit one request; False = full (the caller sheds). With
         ``block_s`` the caller cooperates with backpressure by waiting
-        (on the pop-notified condition — no polling) for space."""
+        (on the pop-notified condition — no polling) for space.
+
+        With a ``tenant_quota`` configured, a tenant-owned request is
+        additionally refused while that tenant already has ``quota``
+        requests queued — the noisy-neighbor guard: tenant A's burst
+        sheds against A's OWN quota (with A's depth and oldest-age as
+        evidence) while the rest of the queue keeps admitting B.
+        """
         deadline = time.monotonic() + block_s
+
+        def admissible() -> bool:
+            if len(self._items) >= self.depth:
+                return False
+            if (
+                self.tenant_quota is not None
+                and request.tenant is not None
+                and self._tenant_depth(request.tenant)
+                >= self.tenant_quota
+            ):
+                return False
+            return True
+
         with self._not_full:
-            while len(self._items) >= self.depth:
+            while not admissible():
                 remaining = deadline - time.monotonic()
                 if remaining <= 0.0:
-                    oldest_age = (
-                        time.monotonic() - self._items[0].submitted_at
-                    )
-                    self._last_shed_evidence = (
-                        f"queue at depth {self.depth}, oldest queued "
-                        f"request is {oldest_age:.3f}s old"
-                    )
+                    now = time.monotonic()
+                    if len(self._items) >= self.depth:
+                        oldest_age = now - self._items[0].submitted_at
+                        self._last_shed_details = {
+                            "reason": "queue_full",
+                            "queue_depth": len(self._items),
+                            "depth_limit": self.depth,
+                            "oldest_age_s": round(oldest_age, 3),
+                        }
+                        self._last_shed_evidence = (
+                            f"queue at depth {self.depth}, oldest "
+                            f"queued request is {oldest_age:.3f}s old"
+                        )
+                    else:
+                        tenant = request.tenant
+                        tenant_items = [
+                            item for item in self._items
+                            if item.tenant == tenant
+                        ]
+                        oldest_age = (
+                            now - tenant_items[0].submitted_at
+                            if tenant_items else 0.0
+                        )
+                        self._last_shed_details = {
+                            "reason": "tenant_quota",
+                            "tenant": tenant,
+                            "tenant_depth": len(tenant_items),
+                            "tenant_quota": self.tenant_quota,
+                            "queue_depth": len(self._items),
+                            "depth_limit": self.depth,
+                            "oldest_age_s": round(oldest_age, 3),
+                        }
+                        self._last_shed_evidence = (
+                            f"tenant {tenant!r} at its quota of "
+                            f"{self.tenant_quota} queued requests "
+                            f"(queue holds {len(self._items)}/"
+                            f"{self.depth}); {tenant!r}'s oldest "
+                            f"queued request is {oldest_age:.3f}s old"
+                        )
                     return False
                 self._not_full.wait(remaining)
             self._items.append(request)
@@ -334,7 +431,11 @@ class MicroBatcher:
 
     ``execute(windows, resolutions) -> (predictions, margins)`` is the
     engine seam (injectable for tests — a wedged executor is how the
-    watchdog is proven).
+    watchdog is proven). A ``tenant_aware`` batcher (multiplexed
+    services) calls ``execute(windows, resolutions, tenants)`` instead
+    — the per-request tenant names ride to the engine, which gathers
+    each row's tenant weights by index — and keeps per-tenant outcome
+    counters plus a per-tenant latency reservoir.
     """
 
     def __init__(
@@ -348,12 +449,15 @@ class MicroBatcher:
         retry_backoff_s: float = 0.05,
         watchdog_s: float = 5.0,
         name: str = "serve",
+        tenant_aware: bool = False,
+        tenant_quota: Optional[int] = None,
     ):
         if flush_us < 0:
             raise ValueError(f"flush_us must be >= 0, got {flush_us}")
         self._execute = execute
+        self.tenant_aware = bool(tenant_aware)
         self.max_batch = int(max_batch)
-        self.queue = AdmissionQueue(queue_depth)
+        self.queue = AdmissionQueue(queue_depth, tenant_quota=tenant_quota)
         self.coalesce_s = float(coalesce_s)
         #: the bounded batch-fill window in seconds (serve_flush_us=;
         #: 0 = dispatch races the submitters, the pre-knob behavior)
@@ -373,6 +477,9 @@ class MicroBatcher:
         self.latencies: "collections.deque" = collections.deque(
             maxlen=8192
         )
+        #: per-tenant latency reservoirs (tenant-aware batchers only;
+        #: bounded like the global one, guarded by the counters lock)
+        self.tenant_latencies: dict = {}
         self.counters = collections.Counter()
         self._counters_lock = threading.Lock()
 
@@ -426,12 +533,41 @@ class MicroBatcher:
             self.counters[key] += n
         obs.metrics.count(f"serve.{key}", n)
 
+    def _count_tenant(self, tenant, key: str, n: int = 1) -> None:
+        """Per-tenant attribution counter (``tenant.<name>.<key>``) —
+        local to the batcher's counters (the global ``serve.*`` metric
+        already counted the event; per-tenant keys are bounded by the
+        128-lane tenant cap, not by traffic)."""
+        if tenant is None:
+            return
+        with self._counters_lock:
+            self.counters[f"tenant.{tenant}.{key}"] += n
+
+    def _tenant_latency(self, tenant, latency: float) -> None:
+        if tenant is None:
+            return
+        with self._counters_lock:
+            reservoir = self.tenant_latencies.get(tenant)
+            if reservoir is None:
+                reservoir = collections.deque(maxlen=8192)
+                self.tenant_latencies[tenant] = reservoir
+            reservoir.append(latency)
+
     def snapshot(self):
         """(counters copy, latency list) under the lock — the safe
         read surface for a LIVE service's stats (the batcher thread
         keeps appending while monitors read)."""
         with self._counters_lock:
             return dict(self.counters), list(self.latencies)
+
+    def tenant_latency_snapshot(self) -> dict:
+        """Per-tenant latency reservoir copies under the lock (empty
+        for tenant-unaware batchers)."""
+        with self._counters_lock:
+            return {
+                tenant: list(reservoir)
+                for tenant, reservoir in self.tenant_latencies.items()
+            }
 
     # -- the batcher loop ----------------------------------------------
 
@@ -469,6 +605,7 @@ class MicroBatcher:
             if req.deadline.expired:
                 waited = time.monotonic() - req.submitted_at
                 self._count("deadline_exceeded")
+                self._count_tenant(req.tenant, "deadline_exceeded")
                 events.event(
                     "serve.deadline_exceeded", queued_s=round(waited, 4)
                 )
@@ -489,6 +626,33 @@ class MicroBatcher:
             live.append(req)
         if not live:
             return
+        # 2b. tenant-scoped batch chaos (multiplexed services): the
+        # point ``serve.batch.tenant.<name>`` fails ONE tenant's rows
+        # out of the mixed bucket — they retry or fail with evidence
+        # individually — while every other tenant's rows execute
+        # untouched. This is the isolation contract made testable: a
+        # fault plan scoped to tenant A must leave tenant B's batch
+        # statistics pinned identical to a B-only run
+        # (tests/test_multitenant.py).
+        if self.tenant_aware:
+            failed_tenants = {}
+            for tenant in {r.tenant for r in live if r.tenant}:
+                try:
+                    chaos.maybe_fire(f"serve.batch.tenant.{tenant}")
+                except Exception as e:
+                    failed_tenants[tenant] = e
+            if failed_tenants:
+                survivors = []
+                for req in live:
+                    if req.tenant in failed_tenants:
+                        self._retry_or_fail(
+                            req, failed_tenants[req.tenant]
+                        )
+                    else:
+                        survivors.append(req)
+                live = survivors
+                if not live:
+                    return
         # 3. execute, with deadline-aware retries: the scope threads
         # the batch's tightest budget through everything underneath
         # (io/remote backoff ladders included)
@@ -501,10 +665,17 @@ class MicroBatcher:
                         "serve.batch", size=len(live),
                     ) as span_rec:
                         chaos.maybe_fire("serve.batch")
-                        predictions, margins = self._execute(
-                            [r.window for r in live],
-                            live[0].resolutions,
-                        )
+                        if self.tenant_aware:
+                            predictions, margins = self._execute(
+                                [r.window for r in live],
+                                live[0].resolutions,
+                                [r.tenant for r in live],
+                            )
+                        else:
+                            predictions, margins = self._execute(
+                                [r.window for r in live],
+                                live[0].resolutions,
+                            )
                         if span_rec is not None:
                             span_rec["attrs"]["attempt"] = (
                                 live[0].attempts + 1
@@ -554,6 +725,8 @@ class MicroBatcher:
                     # the latency reservoir
                     continue
                 delivered += 1
+                self._count_tenant(req.tenant, "completed")
+                self._tenant_latency(req.tenant, latency)
                 with self._counters_lock:
                     # appended under the lock so a live stats_block()
                     # can snapshot the reservoir without racing the
@@ -589,11 +762,13 @@ class MicroBatcher:
             self._fail_deadline(req)
         else:
             self._count("retries")
+            self._count_tenant(req.tenant, "retries")
             events.event("serve.retry", attempts=req.attempts)
             self.queue.readmit(req)
 
     def _fail_exhausted(self, req: Request, error: Exception) -> None:
         self._count("failed")
+        self._count_tenant(req.tenant, "failed")
         req.future.fail(RequestFailedError(
             f"request failed after {req.attempts} attempts "
             f"(budget {self.max_attempts}); attempts: {req.history}"
@@ -601,6 +776,7 @@ class MicroBatcher:
 
     def _fail_deadline(self, req: Request) -> None:
         self._count("deadline_exceeded")
+        self._count_tenant(req.tenant, "deadline_exceeded")
         req.future.fail(deadline_mod.DeadlineExceededError(
             f"deadline ({req.deadline.budget_s:.3f}s budget) cannot "
             f"cover another attempt after {req.attempts} failed; "

@@ -124,6 +124,73 @@ def _serving_program(
     return jax.jit(run, donate_argnums=_donate_argnums())
 
 
+#: lane width of the multi-tenant weight stack (serve/multiplex.py):
+#: per-tenant weight vectors live in the columns of one ``(d, 128)``
+#: matrix — the same 128-lane padding the mega kernel's weight matrix
+#: already carries, so one compiled program serves any tenant mix and
+#: a tenant add/swap rewrites one column (0 recompiles).
+MAX_TENANTS = 128
+
+
+@functools.lru_cache(maxsize=None)
+def _multi_serving_program(
+    wavelet_index: int,
+    epoch_size: int,
+    skip_samples: int,
+    feature_size: int,
+    n_channels: int,
+    pre: int,
+    post: int,
+    precision: str = "f32",
+):
+    """The tenant-stacked twin of :func:`_serving_program`: one jitted
+    program ``(raw, resolutions, positions, mask, weight_matrix
+    (d, 128), tenant_lanes (capacity,) int32) -> (feats, margins)``
+    serving every tenant mix.
+
+    Bit-identity is the load-bearing constraint: row ``i``'s margin
+    must be byte-identical to what the SOLO program computes for
+    tenant ``tenant_lanes[i]`` (the multiplex parity contract,
+    tests/test_multitenant.py). A single ``feats @ weight_matrix``
+    followed by a column gather is NOT that — XLA's matmul tiles the
+    reduction differently from its matvec (measured: ~3e-5 margin
+    drift on CPU) — so the program unrolls the stack into 128 matvecs,
+    each the byte-identical primitive the solo program runs, and
+    gathers the requested column per row. Same flops as the matmul
+    (the gather is free), one compile, still zero-recompile on swap:
+    the weight matrix rides as a traced argument exactly like the solo
+    weights vector.
+    """
+    import jax.numpy as jnp
+
+    featurizer = device_ingest.make_device_ingest_featurizer(
+        wavelet_index=wavelet_index,
+        epoch_size=epoch_size,
+        skip_samples=skip_samples,
+        feature_size=feature_size,
+        channels=tuple(range(1, n_channels + 1)),
+        pre=pre,
+        post=post,
+        precision="bf16" if precision == "bf16" else "f32",
+    )
+
+    def run(raw, resolutions, positions, mask, weight_matrix,
+            tenant_lanes):
+        feats = featurizer(raw, resolutions, positions, mask)
+        # 128 unrolled matvecs — each bitwise the solo program's
+        # ``feats @ weights`` — then a per-row column pick
+        columns = jnp.stack(
+            [feats @ weight_matrix[:, t] for t in range(MAX_TENANTS)],
+            axis=1,
+        )
+        margins = jnp.take_along_axis(
+            columns, tenant_lanes[:, None], axis=1
+        )[:, 0]
+        return feats, margins
+
+    return jax.jit(run, donate_argnums=_donate_argnums())
+
+
 class ServingEngine:
     """Executes micro-batches for one loaded classifier.
 
@@ -521,8 +588,13 @@ class ServingEngine:
         feats, _ = self._program(*args)
         return np.asarray(feats)[:n].astype(np.float32, copy=False)
 
-    def swap_model(self, classifier):
+    def swap_model(self, classifier, tenant=None):
         """Hot-swap the served model; returns the displaced one.
+
+        ``tenant`` is the multiplexed engine's keyed-swap surface
+        (serve/multiplex.py rewrites one column of the tenant stack);
+        this single-model engine refuses it loudly rather than
+        silently swapping the wrong tenant's traffic.
 
         The zero-recompile contract: on the fused-linear path the
         weights ride as a TRACED argument of the compiled program
@@ -534,6 +606,12 @@ class ServingEngine:
         A shape/dtype mismatch is refused loudly: it would retrace
         inside the batcher, where the watchdog reads a long compile as
         a wedge."""
+        if tenant is not None:
+            raise ValueError(
+                f"this engine serves one model; a tenant-keyed swap "
+                f"(tenant={tenant!r}) needs the MultiplexedEngine "
+                f"(serve/multiplex.py)"
+            )
         old = self.classifier
         if self._fused_linear:
             w = getattr(classifier, "weights", None)

@@ -1,0 +1,990 @@
+"""Multiplexed multi-tenant serving: N models, ONE resident engine.
+
+The single-model service (serve/service.py) gives every tenant their
+own compiled program, warmup, batcher, and queue — N tenants cost N
+resident engines even when most are idle, the opposite fleet shape
+from the ROADMAP's "millions of users" north star. But the engine's
+zero-recompile swap contract already proves the weights are DATA, not
+program: they ride as a traced argument. This module stacks N tenants'
+weight vectors into the columns of one ``(d, 128)`` matrix — the same
+128-lane padding the mega kernel's weight matrix has always carried
+(ops/serve_mega.py puts the solo model in column 0 and wastes the
+other 127) — and serves every tenant through ONE compiled program:
+
+- each admitted request carries a tenant id; the batcher coalesces
+  mixed-tenant requests into one bucket (tenant is deliberately NOT in
+  the batch key), so ``serve_flush_us`` fills buckets ACROSS tenants;
+- the fused/mega multi programs gather each row's tenant weight column
+  by index (``engine._multi_serving_program`` /
+  ``serve_mega.make_serve_mega_multi_program``) — margins are
+  byte-identical to a solo engine serving that tenant alone, pinned in
+  tests/test_multitenant.py;
+- adding or swapping a tenant rewrites ONE column of the host mirror
+  and re-stages the (tiny — 48x128 f32 = 24 KB) stack with
+  ``jax.device_put``: no jitted scatter, no trace, 0 XLA compiles
+  (pinned via the report's CompilationMonitor).
+
+**Isolation contract.** The single-model engine's per-batch classifier
+snapshot generalizes: :meth:`MultiplexedEngine.execute` reads the
+immutable :class:`TenantStack` ONCE per batch, so tenant A's
+``swap_model``/``remove_tenant`` (or a fault plan scoped to A — the
+``serve.batch.tenant.<name>`` chaos point) can never tear tenant B's
+in-flight batch — B's rows are served wholly by the stack that was
+live when the batch started, and B's statistics are pinned identical
+to a B-only run under A-scoped chaos. A per-tenant admission quota
+(``ServeConfig.tenant_quota``) sheds one noisy tenant's burst against
+its OWN budget — with per-tenant depth + oldest-age evidence in the
+``ShedError`` (and the gateway's 429 body) — while the rest of the
+queue keeps admitting everyone else.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from . import batcher as batcher_mod
+from . import engine as engine_mod
+from . import service as service_mod
+from ..io import deadline as deadline_mod
+from ..models import linear
+from ..obs import events
+from ..utils import constants
+
+logger = logging.getLogger(__name__)
+
+#: lane width of the tenant stack — one column per tenant in the
+#: (d, 128) weight matrix, the mega kernel's native layout
+MAX_TENANTS = engine_mod.MAX_TENANTS
+
+#: the pre-registered accelerator consolidation margin (the PR 9/12
+#: decision-path pattern): a staged ``serve_multitenant`` chip
+#: artifact must show the multiplexed engine's 16-tenant
+#: concurrency-16 predictions/sec at >= this ratio of the solo fleet's
+#: before operators consolidate per-tenant engines onto one
+#: multiplexed engine on that platform. 1.0: the multiplexed engine
+#: must at least MATCH the fleet it replaces — its win is resident
+#: footprint (1 program vs N) and cross-tenant batch fill, not a raw
+#: throughput regression traded away silently.
+MULTIPLEX_FLIP_RATIO = 1.0
+
+#: sweep-artifact filename stems carrying a serve_multitenant chip run
+_MULTITENANT_ARTIFACTS = ("serve_multitenant*.json",)
+
+
+def accelerator_decision(root: str | None = None) -> dict:
+    """The multiplexed engine's accelerator decision, as DATA: harvest
+    the best on-chip ``serve_multitenant`` sweep (staged by
+    tools/collect_chip_runs.sh) and judge its 16-tenant
+    multiplexed-vs-solo-fleet throughput ratio against the
+    pre-registered :data:`MULTIPLEX_FLIP_RATIO`. Returns
+    ``{"consolidate", "multiplexed_preds_per_s", "fleet_preds_per_s",
+    "ratio", "source", "threshold_ratio", "reason"}`` — artifact
+    lands, the consolidation call flips, zero code change."""
+    import glob
+    import json
+    import os
+
+    from ..ops import serve_mega
+
+    base = root or serve_mega._sweep_results_root()
+    best = None
+    best_src = None
+    for pattern in _MULTITENANT_ARTIFACTS:
+        for path in glob.glob(os.path.join(base, "*", pattern)):
+            try:
+                if os.path.getsize(path) == 0:
+                    continue
+                with open(path) as f:
+                    rec = json.loads(f.read().strip().splitlines()[-1])
+            except (OSError, ValueError, IndexError):
+                continue
+            if rec.get("platform") not in ("tpu", "axon"):
+                continue
+            levels = (
+                (rec.get("serve") or {}).get("multitenant") or {}
+            ).get("levels") or []
+            for level in levels:
+                if level.get("tenants") != 16:
+                    continue
+                mult = (
+                    level.get("multiplexed") or {}
+                ).get("preds_per_s")
+                fleet = (
+                    level.get("solo_fleet") or {}
+                ).get("preds_per_s")
+                if not (
+                    isinstance(mult, (int, float))
+                    and isinstance(fleet, (int, float))
+                    and mult > 0 and fleet > 0
+                ):
+                    continue
+                if best is None or mult / fleet > best[0]:
+                    best, best_src = (mult / fleet, mult, fleet), path
+    decision = {
+        "threshold_ratio": MULTIPLEX_FLIP_RATIO,
+        "source": (
+            os.path.relpath(best_src, os.path.dirname(base))
+            if best_src
+            else None
+        ),
+    }
+    if best is None:
+        decision.update(
+            consolidate=False,
+            multiplexed_preds_per_s=None,
+            fleet_preds_per_s=None,
+            ratio=None,
+            reason=(
+                "no on-chip serve_multitenant sweep in the staged "
+                "artifacts; per-tenant engines stand"
+            ),
+        )
+        return decision
+    ratio, mult, fleet = best
+    decision.update(
+        multiplexed_preds_per_s=mult,
+        fleet_preds_per_s=fleet,
+        ratio=round(ratio, 4),
+    )
+    if ratio >= MULTIPLEX_FLIP_RATIO:
+        decision.update(
+            consolidate=True,
+            reason=(
+                f"serve_multitenant measured {mult:.0f} preds/s on "
+                f"chip at 16 tenants >= {MULTIPLEX_FLIP_RATIO:g}x the "
+                f"solo fleet ({fleet:.0f}); consolidate onto the "
+                f"multiplexed engine"
+            ),
+        )
+    else:
+        decision.update(
+            consolidate=False,
+            reason=(
+                f"serve_multitenant measured {mult:.0f} preds/s on "
+                f"chip at 16 tenants < {MULTIPLEX_FLIP_RATIO:g}x the "
+                f"solo fleet ({fleet:.0f}); per-tenant engines stand"
+            ),
+        )
+    return decision
+
+
+class TenantStack(NamedTuple):
+    """One immutable snapshot of the stacked tenant state — the unit
+    the engine reads ONCE per batch (the tear-free isolation seam).
+
+    ``weights`` is the device-resident ``(d, 128)`` f32 matrix (tenant
+    t's weight vector in column ``lane[t]``, unregistered lanes zero);
+    ``intercepts``/``thresholds`` are per-lane PYTHON floats — applied
+    per tenant group host-side with exactly the scalar numpy semantics
+    the solo engine uses, which is what keeps the post-intercept
+    margins byte-identical; ``classifiers`` carries each lane's live
+    classifier object for the host rung's per-tenant ``predict``;
+    ``generations`` counts swaps per lane (attribution)."""
+
+    weights: object            # jax.Array (d, 128) float32, resident
+    intercepts: tuple          # 128 python floats
+    thresholds: tuple          # 128 python floats
+    classifiers: tuple         # 128 entries: classifier or None
+    generations: tuple         # 128 ints
+
+
+class MultiplexedEngine(engine_mod.ServingEngine):
+    """One resident compiled program serving N tenants' models.
+
+    ``tenants`` maps tenant name -> trained/loaded classifier; every
+    tenant must be the fused-linear family (float32 linear weights of
+    one shared shape — the stacked-matrix contract). The engine keeps
+    the solo engine's whole ladder — mega -> fused -> host with the
+    same warmup margin-parity gate and degradation bookkeeping — but
+    every execute path is tenant-stacked: the batch carries one lane
+    index per row and the program gathers that row's weight column.
+    """
+
+    def __init__(
+        self,
+        tenants,
+        wavelet_index: int = 8,
+        n_channels: int = constants.USED_CHANNELS,
+        pre: int = constants.PRESTIMULUS_SAMPLES,
+        post: int = constants.POSTSTIMULUS_SAMPLES,
+        epoch_size: int = 512,
+        skip_samples: int = 175,
+        feature_size: int = 16,
+        capacity: int = 64,
+        engine_rung: str = "auto",
+    ):
+        items = list(
+            tenants.items() if isinstance(tenants, dict) else tenants
+        )
+        if not items:
+            raise ValueError(
+                "a multiplexed engine needs at least one tenant"
+            )
+        if len(items) > MAX_TENANTS:
+            raise ValueError(
+                f"{len(items)} tenants exceed the {MAX_TENANTS}-lane "
+                f"stack (the weight matrix's 128-lane width)"
+            )
+        first_name, first_clf = items[0]
+        self._require_fused_linear(first_name, first_clf)
+        super().__init__(
+            first_clf,
+            wavelet_index=wavelet_index,
+            n_channels=n_channels,
+            pre=pre,
+            post=post,
+            epoch_size=epoch_size,
+            skip_samples=skip_samples,
+            feature_size=feature_size,
+            capacity=capacity,
+            precision="f32",
+            engine_rung=engine_rung,
+        )
+        assert self._fused_linear  # _require_fused_linear guaranteed it
+        self._weight_shape = first_clf.weights.shape
+        self._multi_program = engine_mod._multi_serving_program(
+            *self._geometry, precision="f32",
+        )
+        # tenant registry: name -> lane (a column of the stack). All
+        # mutation happens under the lock and ends in _publish(); the
+        # hot path never takes it — execute() reads the published
+        # stack snapshot once per batch.
+        self._tenant_lock = threading.RLock()
+        self._lanes: Dict[str, int] = {}
+        self._w_host = np.zeros(
+            (int(np.prod(self._weight_shape)), MAX_TENANTS), np.float32
+        )
+        self._intercepts = [0.0] * MAX_TENANTS
+        self._thresholds = [0.0] * MAX_TENANTS
+        self._classifiers: List[object] = [None] * MAX_TENANTS
+        self._generations = [0] * MAX_TENANTS
+        self._stack: Optional[TenantStack] = None
+        #: per-batch stash (set by execute, read by the _execute_*
+        #: overrides the inherited ladder dispatches to) — the engine
+        #: is driven by ONE batcher thread, like the solo engine
+        self._batch_lanes: Optional[np.ndarray] = None
+        self._batch_stack: Optional[TenantStack] = None
+        for name, clf in items:
+            self._admit(name, clf)
+        self._publish()
+
+    # -- tenant registry ------------------------------------------------
+
+    @staticmethod
+    def _require_fused_linear(name: str, classifier) -> None:
+        w = getattr(classifier, "weights", None)
+        if (
+            not isinstance(classifier, linear._LinearClassifier)
+            or w is None
+            or w.dtype != np.float32
+        ):
+            raise ValueError(
+                f"tenant {name!r} is not multiplexable: the stacked "
+                f"engine needs the fused-linear family (trained "
+                f"float32 linear weights); got "
+                f"{type(classifier).__name__} with weights="
+                f"{None if w is None else (w.dtype, w.shape)}"
+            )
+
+    def _admit(self, name: str, classifier) -> int:
+        """Register one tenant into a free lane (caller publishes)."""
+        self._require_fused_linear(name, classifier)
+        if classifier.weights.shape != self._weight_shape:
+            raise ValueError(
+                f"tenant {name!r} has weights of shape "
+                f"{classifier.weights.shape}; the stack serves "
+                f"{self._weight_shape} (one compiled geometry)"
+            )
+        if name in self._lanes:
+            raise ValueError(f"tenant {name!r} is already registered")
+        lane = next(
+            (
+                i for i in range(MAX_TENANTS)
+                if self._classifiers[i] is None
+            ),
+            None,
+        )
+        if lane is None:
+            raise ValueError(
+                f"tenant stack is full ({MAX_TENANTS} lanes)"
+            )
+        self._lanes[name] = lane
+        self._w_host[:, lane] = np.asarray(
+            classifier.weights, np.float32
+        ).reshape(-1)
+        self._intercepts[lane] = float(classifier.intercept)
+        self._thresholds[lane] = float(classifier.margin_threshold)
+        self._classifiers[lane] = classifier
+        return lane
+
+    def _publish(self) -> None:
+        """Stage the host mirror and publish a fresh immutable stack.
+
+        ``device_put`` (NOT a jitted scatter) keeps the add/swap path
+        off the compiler entirely — the 0-recompile pin is structural.
+        Publication is one attribute assignment: an in-flight batch
+        holds the previous snapshot and is served wholly by it."""
+        self._stack = TenantStack(
+            weights=jax.device_put(self._w_host),
+            intercepts=tuple(self._intercepts),
+            thresholds=tuple(self._thresholds),
+            classifiers=tuple(self._classifiers),
+            generations=tuple(self._generations),
+        )
+
+    @property
+    def tenants(self) -> Tuple[str, ...]:
+        """Registered tenant names, lane order."""
+        with self._tenant_lock:
+            return tuple(
+                sorted(self._lanes, key=self._lanes.__getitem__)
+            )
+
+    def tenant_info(self, name: str) -> dict:
+        """One tenant's registry record: lane + swap generation."""
+        with self._tenant_lock:
+            if name not in self._lanes:
+                raise ValueError(f"unknown tenant {name!r}")
+            lane = self._lanes[name]
+            return {
+                "lane": lane,
+                "generation": self._generations[lane],
+            }
+
+    @property
+    def resident_weight_bytes(self) -> int:
+        """Bytes of the device-resident stacked weight matrix — the
+        whole per-tenant model footprint of the multiplexed engine
+        (one matrix serves all 128 lanes)."""
+        return int(self._w_host.nbytes)
+
+    def add_tenant(self, name: str, classifier) -> int:
+        """Register a new tenant at runtime; returns its lane. One
+        column write + device_put — 0 recompiles, and every other
+        tenant's in-flight traffic is untouched (snapshot seam)."""
+        with self._tenant_lock:
+            lane = self._admit(name, classifier)
+            self._publish()
+        events.event("serve.tenant_added", tenant=name, lane=lane)
+        return lane
+
+    def remove_tenant(self, name: str):
+        """Unregister a tenant; returns its displaced classifier. The
+        lane's column is zeroed and freed for reuse. Requests already
+        in flight for this tenant ride the pre-removal snapshot (the
+        isolation contract); NEW submissions for it are refused by the
+        service's registry check."""
+        with self._tenant_lock:
+            if name not in self._lanes:
+                raise ValueError(f"unknown tenant {name!r}")
+            if len(self._lanes) == 1:
+                raise ValueError(
+                    f"cannot remove {name!r}: a multiplexed engine "
+                    f"serves at least one tenant"
+                )
+            lane = self._lanes.pop(name)
+            displaced = self._classifiers[lane]
+            self._classifiers[lane] = None
+            self._w_host[:, lane] = 0.0
+            self._intercepts[lane] = 0.0
+            self._thresholds[lane] = 0.0
+            self._generations[lane] += 1
+            self._publish()
+        events.event("serve.tenant_removed", tenant=name, lane=lane)
+        return displaced
+
+    def swap_model(self, classifier, tenant: Optional[str] = None):
+        """Hot-swap ONE tenant's model; returns the displaced one.
+
+        The solo engine's zero-recompile contract, per lane: the
+        replacement must be float32 linear weights of the stack's
+        shape (refused loudly otherwise — and a refused swap leaves
+        the published stack untouched, so no other tenant can be torn
+        by a failed swap). ``tenant`` may be omitted only while
+        exactly one tenant is registered."""
+        with self._tenant_lock:
+            if tenant is None:
+                if len(self._lanes) != 1:
+                    raise ValueError(
+                        f"{len(self._lanes)} tenants are registered; "
+                        f"swap_model needs tenant= to pick one"
+                    )
+                tenant = next(iter(self._lanes))
+            if tenant not in self._lanes:
+                raise ValueError(f"unknown tenant {tenant!r}")
+            self._require_fused_linear(tenant, classifier)
+            if classifier.weights.shape != self._weight_shape:
+                raise ValueError(
+                    f"hot swap for tenant {tenant!r} requires float32 "
+                    f"linear weights of the stack shape "
+                    f"{self._weight_shape} (the zero-recompile "
+                    f"contract); got {classifier.weights.shape}"
+                )
+            lane = self._lanes[tenant]
+            displaced = self._classifiers[lane]
+            self._w_host[:, lane] = np.asarray(
+                classifier.weights, np.float32
+            ).reshape(-1)
+            self._intercepts[lane] = float(classifier.intercept)
+            self._thresholds[lane] = float(classifier.margin_threshold)
+            self._classifiers[lane] = classifier
+            self._generations[lane] += 1
+            self._publish()
+        events.event("serve.tenant_swapped", tenant=tenant, lane=lane)
+        return displaced
+
+    # -- execution ------------------------------------------------------
+
+    def execute(
+        self,
+        windows: Sequence[np.ndarray],
+        resolutions: np.ndarray,
+        tenants: Optional[Sequence[Optional[str]]] = None,
+    ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        """Run one mixed-tenant micro-batch: ``tenants[i]`` names the
+        model serving window ``i`` (None rows — and a None sequence —
+        fall to the oldest registered tenant, the warmup convention).
+        The stack snapshot and the name->lane mapping are both read
+        ONCE here; the inherited ladder (mega -> fused -> host, with
+        the solo engine's degradation bookkeeping) then dispatches to
+        the tenant-stacked overrides below."""
+        n = len(windows)
+        stack, lanes = self._resolve(tenants, n)
+        self._batch_stack = stack
+        self._batch_lanes = lanes
+        try:
+            return super().execute(windows, resolutions)
+        finally:
+            self._batch_stack = None
+            self._batch_lanes = None
+
+    def _resolve(self, tenants, n: int):
+        with self._tenant_lock:
+            stack = self._stack
+            if tenants is None:
+                default_lane = min(self._lanes.values())
+                return stack, np.full(n, default_lane, np.int32)
+            if len(tenants) != n:
+                raise ValueError(
+                    f"{len(tenants)} tenant ids for {n} windows"
+                )
+            default_lane = min(self._lanes.values())
+            lanes = np.empty(n, np.int32)
+            for i, name in enumerate(tenants):
+                if name is None:
+                    lanes[i] = default_lane
+                elif name in self._lanes:
+                    lanes[i] = self._lanes[name]
+                else:
+                    raise ValueError(f"unknown tenant {name!r}")
+            return stack, lanes
+
+    def _postprocess(self, margins: np.ndarray, lanes, stack):
+        """Intercept + threshold per TENANT GROUP with python-float
+        scalars — the exact numpy scalar semantics the solo engine's
+        ``margins + clf.intercept`` uses, so a tenant's post-intercept
+        margins (and therefore predictions) stay byte-identical to its
+        solo service."""
+        n = len(margins)
+        out_margins = np.empty(n, margins.dtype)
+        predictions = np.empty(n, np.float64)
+        for lane in np.unique(lanes):
+            rows = lanes == lane
+            m = margins[rows] + stack.intercepts[lane]
+            out_margins[rows] = m
+            predictions[rows] = (
+                m > stack.thresholds[lane]
+            ).astype(np.float64)
+        return predictions, out_margins
+
+    def _execute_fused(self, windows, resolutions):
+        n = len(windows)
+        stack = self._batch_stack
+        lanes = self._batch_lanes
+        stream, mask = self._stage_fused_stream(windows)
+        staged = jax.device_put(stream)
+        res = np.asarray(resolutions, dtype=np.float32)
+        tids = np.zeros(self.capacity, np.int32)
+        tids[:n] = lanes
+        _feats, margins = self._multi_program(
+            staged, res, self._positions, mask, stack.weights, tids,
+        )
+        return self._postprocess(
+            np.asarray(margins[:n]), np.asarray(lanes), stack
+        )
+
+    def _execute_mega(self, windows, resolutions):
+        from ..ops import serve_mega
+
+        n = len(windows)
+        stack = self._batch_stack
+        lanes = self._batch_lanes
+        stream = serve_mega.stage_mega_stream(
+            windows, self.n_channels, self.window_len,
+            self._mega_stride, self.capacity,
+        )
+        staged = jax.device_put(stream)
+        res = np.asarray(resolutions, dtype=np.float32)
+        tids = np.zeros(self.capacity, np.int32)
+        tids[:n] = lanes
+        margins = np.asarray(
+            self._mega_program(staged, res, stack.weights, tids)
+        )[:n]
+        return self._postprocess(margins, np.asarray(lanes), stack)
+
+    def _execute_host(self, windows, resolutions):
+        """The host floor, per tenant group: one shared featurization
+        (row-independent, like the fused stream) and each group's rows
+        through its OWN classifier's ``predict`` — the same call a
+        solo host-rung service makes for that tenant."""
+        stack = self._batch_stack
+        lanes = (
+            np.asarray(self._batch_lanes)
+            if self._batch_lanes is not None
+            else np.zeros(len(windows), np.int32)
+        )
+        feats = self._host_features(windows, resolutions)
+        predictions = np.empty(len(windows), np.float64)
+        for lane in np.unique(lanes):
+            rows = lanes == lane
+            clf = stack.classifiers[lane]
+            predictions[rows] = np.asarray(
+                clf.predict(feats[rows]), dtype=np.float64
+            )
+        return predictions, None
+
+    # -- warmup ---------------------------------------------------------
+
+    def warmup(self) -> None:
+        """Compile the multi-tenant program(s) before traffic, resolve
+        the mega rung behind the SAME margin-parity gate the solo
+        engine uses (multi-mega vs multi-fused on the shared gate
+        windows, tenant lanes cycling over the registered tenants so
+        the gather path itself is what's judged), then trace both
+        request dtypes. Idempotent."""
+        if self._warmed:
+            return
+        self._mega_multi_warmup()
+        names = self.tenants
+        for dtype in (np.int16, np.float32):
+            self.execute(
+                [np.zeros((self.n_channels, self.window_len), dtype)],
+                np.ones(self.n_channels, np.float32),
+                [names[0]],
+            )
+        self._warmed = True
+
+    def _multi_gate_margins(self, windows, res, tids):
+        """The fused multi program on the gate windows (pre-intercept
+        margins for the live rows) — the parity gate's reference."""
+        n = len(windows)
+        stream, mask = self._stage_fused_stream(windows)
+        padded_tids = np.zeros(self.capacity, np.int32)
+        padded_tids[:n] = tids
+        _feats, margins = self._multi_program(
+            jax.device_put(stream), res, self._positions, mask,
+            self._stack.weights, padded_tids,
+        )
+        return np.asarray(margins)[:n]
+
+    def _mega_multi_warmup(self) -> None:
+        from ..ops import serve_mega
+        from .. import obs
+
+        if self.pre < 1:
+            return
+        requested = self._engine_rung_requested
+        if requested == "fused":
+            return
+        resolved = (
+            serve_mega.default_engine_rung()
+            if requested == "auto"
+            else requested
+        )
+        record = {
+            "requested": requested,
+            "resolved": resolved,
+            "used": "fused",
+            "lowering": None,
+            "gate": None,
+        }
+        self.mega_record = record
+        if resolved != "mega":
+            return
+        try:
+            lowering = serve_mega.default_lowering()
+            record["lowering"] = lowering
+            program = serve_mega.make_serve_mega_multi_program(
+                wavelet_index=self.wavelet_index,
+                epoch_size=self.epoch_size,
+                skip_samples=self.skip_samples,
+                feature_size=self.feature_size,
+                n_channels=self.n_channels,
+                pre=self.pre,
+                post=self.post,
+                capacity=self.capacity,
+                lowering=lowering,
+            )
+            stride = serve_mega.padded_stride(self.pre, self.post)
+            windows, res = self._gate_windows()
+            # gate lanes cycle over the REGISTERED tenants: the gather
+            # itself — not just lane 0 — is what the pin judges
+            with self._tenant_lock:
+                lanes = sorted(self._lanes.values())
+            tids = np.asarray(
+                [lanes[i % len(lanes)] for i in range(len(windows))],
+                np.int32,
+            )
+            padded_tids = np.zeros(self.capacity, np.int32)
+            padded_tids[: len(windows)] = tids
+            mega_stream = serve_mega.stage_mega_stream(
+                windows, self.n_channels, self.window_len, stride,
+                self.capacity,
+            )
+            mega_margins = np.asarray(program(
+                jax.device_put(mega_stream), res,
+                self._stack.weights, padded_tids,
+            ))[: len(windows)]
+            fused_margins = self._multi_gate_margins(windows, res, tids)
+            tol = serve_mega.mega_gate_tolerance()
+            dev = float(
+                np.max(np.abs(mega_margins - fused_margins))
+                if len(windows)
+                else 0.0
+            )
+            gate = {
+                "max_abs_dev": dev,
+                "tolerance": tol,
+                "ok": bool(dev <= tol),
+                "rows_checked": len(windows),
+            }
+        except Exception as e:
+            record["error"] = f"{type(e).__name__}: {e}"
+            obs.metrics.count("serve.mega_unavailable")
+            events.event(
+                "serve.mega_unavailable", error=record["error"]
+            )
+            logger.warning(
+                "serve.mega (multi-tenant) unavailable (%s); serving "
+                "the fused multi program", record["error"],
+            )
+            return
+        record["gate"] = gate
+        if not gate["ok"]:
+            obs.metrics.count("serve.mega_gate_disabled")
+            events.event("serve.mega_gate", **gate)
+            logger.warning(
+                "serve.mega_gate refused the multi-tenant rung: max "
+                "abs margin dev %.3e > gate %.3e; serving the fused "
+                "multi program",
+                gate["max_abs_dev"], gate["tolerance"],
+            )
+            return
+        self._mega_program = program
+        self._mega_stride = stride
+        self._rung = "mega"
+        record["used"] = "mega"
+        events.event(
+            "serve.mega_promoted", lowering=record["lowering"],
+            tenants=len(self.tenants),
+        )
+
+
+class MultiplexedService(service_mod.InferenceService):
+    """N tenants' models behind one engine, one batcher, one queue.
+
+    The single-model service's lifecycle (start/drain/stop, watchdog,
+    stats) unchanged; what multiplexing adds is the tenant key on
+    every request, runtime tenant administration
+    (:meth:`add_tenant` / :meth:`remove_tenant` / :meth:`swap_tenant`
+    — all 0-recompile), per-tenant attribution in the stats block, and
+    the per-tenant admission quota (``ServeConfig.tenant_quota``)."""
+
+    def __init__(
+        self,
+        tenants,
+        wavelet_index: int = 8,
+        n_channels: int = constants.USED_CHANNELS,
+        pre: int = constants.PRESTIMULUS_SAMPLES,
+        post: int = constants.POSTSTIMULUS_SAMPLES,
+        config: Optional[service_mod.ServeConfig] = None,
+        engine_rung: str = "auto",
+    ):
+        self.config = config or service_mod.ServeConfig()
+        self.engine = MultiplexedEngine(
+            tenants,
+            wavelet_index=wavelet_index,
+            n_channels=n_channels,
+            pre=pre,
+            post=post,
+            capacity=self.config.max_batch,
+            engine_rung=engine_rung,
+        )
+        #: multiplexed services have no (single) lifecycle manager;
+        #: per-tenant model state is the stack's swap generations
+        self.lifecycle = None
+        self.batcher = batcher_mod.MicroBatcher(
+            self.engine.execute,
+            max_batch=self.config.max_batch,
+            queue_depth=self.config.queue_depth,
+            coalesce_s=self.config.coalesce_s,
+            flush_us=self.config.flush_us,
+            max_attempts=self.config.max_attempts,
+            retry_backoff_s=self.config.retry_backoff_s,
+            watchdog_s=self.config.watchdog_s,
+            tenant_aware=True,
+            tenant_quota=self.config.tenant_quota,
+        )
+        self._accepting = False
+        self._started = False
+        self._drained_cleanly: Optional[bool] = None
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_saved(
+        cls,
+        tenants: Dict[str, Tuple[str, str]],
+        warmup: bool = True,
+        **kwargs,
+    ) -> "MultiplexedService":
+        """Load each tenant's saved model exactly once and build the
+        multiplexed service around the stack: ``tenants`` maps tenant
+        name -> ``(classifier_name, model_path)`` (io/modelfiles
+        routing, like the solo ``from_saved``)."""
+        from ..models import registry as clf_registry
+
+        loaded = {}
+        for name, (classifier_name, model_path) in tenants.items():
+            classifier = clf_registry.create(classifier_name)
+            classifier.load(model_path)
+            loaded[name] = classifier
+        service = cls(loaded, **kwargs)
+        if warmup:
+            service.engine.warmup()
+        return service
+
+    # -- tenant administration ------------------------------------------
+
+    @property
+    def tenants(self) -> Tuple[str, ...]:
+        return self.engine.tenants
+
+    def add_tenant(self, name: str, classifier) -> int:
+        """Register a tenant at runtime (0 recompiles); returns its
+        lane."""
+        lane = self.engine.add_tenant(name, classifier)
+        self.batcher._count("tenant_adds")
+        return lane
+
+    def add_tenant_from_saved(
+        self, name: str, classifier_name: str, model_path: str
+    ) -> int:
+        """Load a saved model and register it as ``name`` — the
+        runtime tenant-onboarding path (gateway/operator surface)."""
+        from ..models import registry as clf_registry
+
+        classifier = clf_registry.create(classifier_name)
+        classifier.load(model_path)
+        return self.add_tenant(name, classifier)
+
+    def remove_tenant(self, name: str):
+        """Unregister a tenant; in-flight requests ride the
+        pre-removal snapshot, new submissions for it are refused."""
+        displaced = self.engine.remove_tenant(name)
+        self.batcher._count("tenant_removes")
+        return displaced
+
+    def swap_tenant(self, name: str, classifier):
+        """Hot-swap one tenant's model (0 recompiles, tear-free for
+        every other tenant); returns the displaced classifier."""
+        displaced = self.engine.swap_model(classifier, tenant=name)
+        self.batcher._count("tenant_swaps")
+        self.batcher._count_tenant(name, "swaps")
+        return displaced
+
+    # -- request path ---------------------------------------------------
+
+    def submit(
+        self,
+        window: np.ndarray,
+        resolutions: np.ndarray,
+        tenant: Optional[str] = None,
+        deadline_s: Optional[float] = None,
+        block_s: float = 0.0,
+        label: Optional[float] = None,
+    ) -> batcher_mod.ServeFuture:
+        """Admit one tenant-keyed request; returns its future. An
+        unknown tenant is a ``ValueError`` at the door (never a queued
+        request the engine will refuse later); a quota/queue shed
+        raises :class:`ShedError` with the structured per-tenant
+        evidence on ``.evidence`` (depth, quota, oldest-age — the
+        gateway's 429 body)."""
+        if label is not None:
+            raise ValueError(
+                "multiplexed services have no lifecycle manager; "
+                "submit(label=) is the solo service's surface"
+            )
+        if tenant is None:
+            raise ValueError(
+                "a multiplexed service needs tenant= on every "
+                "request (the tenant keys the weight column)"
+            )
+        if tenant not in self.engine.tenants:
+            raise ValueError(
+                f"unknown tenant {tenant!r}; registered: "
+                f"{list(self.engine.tenants)}"
+            )
+        self.batcher._count("submitted")
+        self.batcher._count_tenant(tenant, "submitted")
+        if not self._accepting:
+            self.batcher._count("rejected_closed")
+            raise batcher_mod.ServiceClosedError(
+                "service is not accepting requests "
+                "(draining or stopped)"
+            )
+        if self.batcher.wedged.is_set():
+            self.batcher._count("rejected_wedged")
+            raise batcher_mod.ServiceWedgedError(
+                "service wedged (watchdog tripped); restart the "
+                "service"
+            )
+        req = batcher_mod.Request(
+            window=np.asarray(window),
+            resolutions=np.asarray(resolutions, np.float32),
+            deadline=deadline_mod.Deadline(
+                deadline_s if deadline_s is not None
+                else self.config.default_deadline_s
+            ),
+            tenant=tenant,
+        )
+        if not self.batcher.queue.offer(req, block_s=block_s):
+            self.batcher._count("shed")
+            self.batcher._count_tenant(tenant, "shed")
+            details = self.batcher.queue.last_shed_details
+            details.setdefault("tenant", tenant)
+            events.event(
+                "serve.shed", queue_depth=self.batcher.queue.depth,
+                tenant=tenant,
+            )
+            raise batcher_mod.ShedError(
+                f"request shed by admission control: "
+                f"{self.batcher.queue._last_shed_evidence}",
+                evidence=details,
+            )
+        if not self._accepting:
+            if req.future.fail(batcher_mod.ServiceClosedError(
+                "service stopped while the request was being admitted"
+            )):
+                self.batcher._count("rejected_closed")
+        return req.future
+
+    def predict_window(
+        self,
+        window: np.ndarray,
+        resolutions: np.ndarray,
+        tenant: Optional[str] = None,
+        deadline_s: Optional[float] = None,
+    ) -> batcher_mod.Result:
+        """Blocking convenience: tenant-keyed submit + wait."""
+        budget = (
+            deadline_s if deadline_s is not None
+            else self.config.default_deadline_s
+        )
+        fut = self.submit(
+            window, resolutions, tenant=tenant, deadline_s=budget
+        )
+        return fut.result(timeout=self._result_timeout(budget))
+
+    def predict_all(
+        self,
+        windows: Sequence[np.ndarray],
+        resolutions,
+        tenants,
+        deadline_s: Optional[float] = None,
+    ) -> List[batcher_mod.Result]:
+        """Drive a window set through the service with backpressure,
+        results in input order. ``tenants`` is one tenant name (all
+        windows) or a per-window sequence — a mixed sequence is the
+        multiplexed fill path: consecutive compatible windows coalesce
+        into shared buckets regardless of tenant."""
+        budget = (
+            deadline_s if deadline_s is not None
+            else self.config.default_deadline_s
+        )
+        if isinstance(tenants, str):
+            tenants = [tenants] * len(windows)
+        if len(tenants) != len(windows):
+            raise ValueError(
+                f"{len(tenants)} tenant ids for {len(windows)} windows"
+            )
+        res_arr = np.asarray(resolutions, dtype=np.float32)
+        per_window = res_arr.ndim == 2
+        if per_window and len(res_arr) != len(windows):
+            raise ValueError(
+                f"{len(res_arr)} resolution vectors for "
+                f"{len(windows)} windows"
+            )
+        futures = []
+        for i, w in enumerate(windows):
+            futures.append(
+                self.submit(
+                    w, res_arr[i] if per_window else res_arr,
+                    tenant=tenants[i], deadline_s=budget,
+                    block_s=budget,
+                )
+            )
+        timeout = self._result_timeout(budget)
+        return [f.result(timeout=timeout) for f in futures]
+
+    # -- observability --------------------------------------------------
+
+    def stats_block(self) -> dict:
+        """The solo service's ``serve`` block plus the per-tenant
+        attribution sub-block: per tenant, outcome counters, latency
+        percentiles, the lane, and the swap generation (the tenant's
+        model-state record; multiplexed services carry no lifecycle
+        manager). Safe on a live service — every read is a snapshot
+        under the batcher's lock."""
+        block = super().stats_block()
+        counters, _ = self.batcher.snapshot()
+        tenant_lat = self.batcher.tenant_latency_snapshot()
+        tenants_block = {}
+        for name in self.engine.tenants:
+            lat = sorted(tenant_lat.get(name, []))
+            info = self.engine.tenant_info(name)
+            tenants_block[name] = {
+                "lane": info["lane"],
+                "generation": info["generation"],
+                "swaps": counters.get(f"tenant.{name}.swaps", 0),
+                "requests": {
+                    key: counters.get(f"tenant.{name}.{key}", 0)
+                    for key in (
+                        "submitted", "completed", "shed",
+                        "deadline_exceeded", "failed", "retries",
+                    )
+                },
+                "latency_ms": {
+                    "p50": round(
+                        service_mod._percentile(lat, 50.0) * 1e3, 3
+                    ),
+                    "p99": round(
+                        service_mod._percentile(lat, 99.0) * 1e3, 3
+                    ),
+                    "n": len(lat),
+                },
+                # per-tenant model-lifecycle attribution: None —
+                # schema-stable with the solo block; the stack's swap
+                # generation above is the multiplexed model state
+                "lifecycle": None,
+            }
+        block["tenants"] = tenants_block
+        block["tenant_quota"] = self.config.tenant_quota
+        block["resident_weight_bytes"] = (
+            self.engine.resident_weight_bytes
+        )
+        return block

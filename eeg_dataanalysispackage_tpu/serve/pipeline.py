@@ -86,16 +86,71 @@ def default_flush_us() -> int:
 
 
 def serve_config_from_query(query_map) -> service_mod.ServeConfig:
+    tenant_quota = _int_knob(query_map, "serve_tenant_quota", 0)
     return service_mod.ServeConfig(
         max_batch=_int_knob(query_map, "serve_batch", 64),
         queue_depth=_int_knob(query_map, "serve_queue", 256),
         flush_us=_int_knob(
             query_map, "serve_flush_us", default_flush_us()
         ),
+        # 0 / absent = no per-tenant budget (single-model services
+        # never have one; serve/multiplex.py documents the knob)
+        tenant_quota=tenant_quota if tenant_quota > 0 else None,
         default_deadline_s=_int_knob(
             query_map, "serve_deadline_ms", 2000
         ) / 1000.0,
     )
+
+
+def parse_tenant_spec(spec: str) -> dict:
+    """Parse a multi-tenant model spec into ``{tenant: (classifier,
+    path)}``.
+
+    The spec is the operator's one-line tenant registry —
+    ``name=classifier@path`` entries joined by commas::
+
+        alice=logreg@/models/alice,bob=logreg@/models/bob
+
+    Order is preserved (the first tenant anchors the engine's
+    geometry). Raises ``ValueError`` with the offending entry on any
+    malformed piece — a fleet bootstrap must fail loudly, not serve a
+    partial registry."""
+    tenants = {}
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        name, sep, rest = entry.partition("=")
+        classifier_name, sep2, path = rest.partition("@")
+        if not (name.strip() and sep and classifier_name.strip()
+                and sep2 and path.strip()):
+            raise ValueError(
+                f"malformed tenant entry {entry!r}; expected "
+                f"name=classifier@path"
+            )
+        name = name.strip()
+        if name in tenants:
+            raise ValueError(f"duplicate tenant {name!r} in spec")
+        tenants[name] = (classifier_name.strip(), path.strip())
+    if not tenants:
+        raise ValueError(
+            "tenant spec is empty; expected name=classifier@path[,...]"
+        )
+    return tenants
+
+
+def load_tenants(spec: str) -> dict:
+    """Load every tenant named by :func:`parse_tenant_spec` into
+    ``{tenant: classifier}`` — the runtime registry a
+    :class:`serve.multiplex.MultiplexedService` (or a running one's
+    ``add_tenant``) is built from. Each model loads exactly once
+    through the io/modelfiles routing."""
+    loaded = {}
+    for name, (classifier_name, path) in parse_tenant_spec(spec).items():
+        classifier = clf_registry.create(classifier_name)
+        classifier.load(path)
+        loaded[name] = classifier
+    return loaded
 
 
 def lifecycle_config_from_query(

@@ -64,6 +64,13 @@ class ServeConfig:
     #: EEG_TPU_SERVE_FLUSH_US; measured per level in serve_bench's
     #: mean_batch_size).
     flush_us: int = 0
+    #: per-tenant admission budget for multiplexed services
+    #: (serve/multiplex.py): at most this many of one tenant's
+    #: requests queued at once, so one noisy tenant sheds against its
+    #: OWN budget instead of starving the shared queue. None (the
+    #: default, and the only meaningful value for single-model
+    #: services) disables the per-tenant check.
+    tenant_quota: Optional[int] = None
     default_deadline_s: float = 2.0
     max_attempts: int = 3
     retry_backoff_s: float = 0.05

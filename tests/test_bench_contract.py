@@ -638,6 +638,63 @@ def test_collect_propagates_serve_lifecycle_field(monkeypatch):
     assert v["serve"] == serve_block
 
 
+def test_serve_multitenant_variant_in_both_tables_and_routing():
+    """The multiplexed multi-tenant engine (ISSUE 16) rides every
+    bench artifact through the serve child, sized like the
+    serve_bench line it extends (the pair is directly comparable
+    from one artifact) and in the slow-compile timeout class (it
+    warms the multi-tenant fused AND mega programs cold)."""
+    import inspect
+
+    for table in (bench._VARIANTS_TPU, bench._VARIANTS_CPU):
+        assert "serve_multitenant" in table
+        assert table["serve_multitenant"] == table["serve_bench"]
+    src = inspect.getsource(bench._run_variant)
+    assert "serve_" in src and "serve_bench.py" in src
+    assert "serve_multitenant" in bench._VARIANT_TIMEOUTS
+
+
+def test_collect_propagates_serve_multitenant_field(monkeypatch):
+    """The serve_multitenant line's levels + parity + compile pins
+    must survive the parent's field whitelist into the published
+    artifact — the exact block multiplex.accelerator_decision
+    harvests from staged chip runs."""
+    serve_block = {
+        "multitenant": {
+            "levels": [{
+                "tenants": 16,
+                "multiplexed": {"preds_per_s": 5200.0, "p99_ms": 4.0},
+                "solo_fleet": {"preds_per_s": 4100.0, "p99_ms": 6.0},
+                "ratio": 1.268,
+            }],
+            "parity": {"bit_identical": True, "mismatches": 0},
+            "compiles": {"scaling": 0, "scaling_zero_ok": True},
+            "swap": {"compiles": 0, "generation": 1},
+            "resident": {"multiplexed_bytes": 24576},
+        },
+    }
+    monkeypatch.setattr(
+        bench, "_VARIANTS_CPU",
+        {"einsum": (8, 2), "serve_multitenant": (400, 2)},
+    )
+    monkeypatch.setattr(
+        bench,
+        "_run_variant",
+        lambda name, platform, n, iters: {
+            "epochs_per_s": 1.0,
+            "bytes_per_epoch": 5100,
+            "n": n,
+            "wall_s": 1.0,
+            **(
+                {"serve": serve_block}
+                if name == "serve_multitenant" else {}
+            ),
+        },
+    )
+    v = bench._collect("cpu_fallback")["variants"]["serve_multitenant"]
+    assert v["serve"] == serve_block
+
+
 def test_plan_service_variant_in_both_tables_and_routing():
     """The networked plan service (ISSUE 11) rides every bench
     artifact, sized identically on TPU and the CPU fallback, through

@@ -1,0 +1,705 @@
+"""Multiplexed multi-tenant serving (serve/multiplex.py, ISSUE 16).
+
+The acceptance bar: ONE resident compiled program serves any tenant
+mix — each tenant's multiplexed predictions byte-identical to a solo
+``InferenceService`` serving the same classifier (fused, mega, and
+host rungs); adding or swapping a tenant triggers 0 XLA compiles;
+tenant A's faults or failed swaps can never tear tenant B's traffic
+(per-batch snapshot isolation, pinned under tenant-scoped chaos);
+per-tenant quota sheds carry structured evidence into the gateway's
+429 body.
+"""
+
+import numpy as np
+import pytest
+
+import _synthetic
+from eeg_dataanalysispackage_tpu import obs
+from eeg_dataanalysispackage_tpu.epochs.extractor import BalanceState
+from eeg_dataanalysispackage_tpu.gateway.server import GatewayServer
+from eeg_dataanalysispackage_tpu.io import provider
+from eeg_dataanalysispackage_tpu.models import registry as clf_registry
+from eeg_dataanalysispackage_tpu.obs import chaos
+from eeg_dataanalysispackage_tpu.obs.report import CompilationMonitor
+from eeg_dataanalysispackage_tpu.pipeline import builder
+from eeg_dataanalysispackage_tpu.serve import (
+    InferenceService,
+    MultiplexedEngine,
+    MultiplexedService,
+    ServeConfig,
+    ShedError,
+    engine,
+)
+from eeg_dataanalysispackage_tpu.serve import batcher as batcher_mod
+from eeg_dataanalysispackage_tpu.serve import multiplex
+from eeg_dataanalysispackage_tpu.serve import pipeline as serve_pipeline
+from eeg_dataanalysispackage_tpu.serve.engine import ServingEngine
+
+_CONFIG = (
+    "&config_num_iterations=20&config_step_size=1.0"
+    "&config_mini_batch_fraction=1.0"
+)
+
+_NAMES = ("alice", "bob", "carol")
+
+
+@pytest.fixture(scope="module")
+def session(tmp_path_factory):
+    """One synthetic session + one trained saved logreg + the kept
+    epochs' raw windows — the shared substrate every tenant's model
+    derives from."""
+    tmp = tmp_path_factory.mktemp("multitenant_session")
+    for i, (name, guessed) in enumerate(
+        (("synth_00", 2), ("synth_01", 5))
+    ):
+        _synthetic.write_recording(
+            str(tmp), name=name, n_markers=90, guessed=guessed, seed=i
+        )
+    info = str(tmp / "info.txt")
+    with open(info, "w") as f:
+        f.write("synth_00.eeg 2\nsynth_01.eeg 5\n")
+    model = str(tmp / "model")
+    builder.PipelineBuilder(
+        f"info_file={info}&fe=dwt-8-fused&train_clf=logreg"
+        f"&save_clf=true&save_name={model}{_CONFIG}"
+    ).execute()
+    odp = provider.OfflineDataProvider([info])
+    balance = BalanceState()
+    windows, resolutions = [], None
+    for _rel, guessed, rec in odp.iter_recordings():
+        ws, _ts, resolutions = engine.windows_from_recording(
+            rec, odp.channel_indices_for(rec), guessed,
+            pre=odp.pre, post=odp.post, balance=balance,
+        )
+        windows.extend(ws)
+    return {
+        "info": info,
+        "model": model,
+        "windows": windows,
+        "resolutions": resolutions,
+    }
+
+
+def _tenant_clf(session, seed):
+    """One tenant's model: the trained classifier, perturbed
+    deterministically per tenant so every tenant has genuinely
+    different weights (distinct margins make cross-tenant mixups
+    visible)."""
+    clf = clf_registry.create("logreg")
+    clf.load(session["model"])
+    if seed:
+        r = np.random.default_rng(seed)
+        clf.weights = (
+            clf.weights
+            + r.standard_normal(clf.weights.shape).astype(np.float32)
+            * 0.05
+        ).astype(np.float32)
+        clf.intercept = float(r.standard_normal() * 0.01)
+    return clf
+
+
+@pytest.fixture(scope="module")
+def tenants(session):
+    return {
+        name: _tenant_clf(session, seed)
+        for seed, name in enumerate(_NAMES)
+    }
+
+
+def _mix(session):
+    """A deterministic mixed-tenant assignment over the session's
+    windows."""
+    return [_NAMES[i % len(_NAMES)] for i in range(len(session["windows"]))]
+
+
+# -- the per-tenant parity pin -------------------------------------------
+
+
+@pytest.mark.parametrize("rung", ["auto", "fused"])
+def test_multiplexed_parity_fused_and_mega(session, tenants, rung):
+    """Each tenant's rows out of a mixed-tenant batch are byte-
+    identical (predictions AND margins) to a solo engine serving that
+    tenant alone — on the mega rung (auto resolves to mega on CPU)
+    and the pinned fused rung."""
+    mix = _mix(session)
+    multi = MultiplexedEngine(tenants, capacity=64, engine_rung=rung)
+    multi.warmup()
+    if rung == "auto":
+        assert multi.rung == "mega"
+        assert multi.mega_record["used"] == "mega"
+        assert multi.mega_record["gate"]["ok"] is True
+    else:
+        assert multi.rung == "fused"
+    mp, mm = multi.execute(
+        session["windows"], session["resolutions"], mix
+    )
+    for name, clf in tenants.items():
+        solo = ServingEngine(clf, capacity=64, engine_rung=rung)
+        solo.warmup()
+        sp, sm = solo.execute(session["windows"], session["resolutions"])
+        rows = [i for i, t in enumerate(mix) if t == name]
+        np.testing.assert_array_equal(mp[rows], sp[rows])
+        np.testing.assert_array_equal(mm[rows], sm[rows])
+
+
+def test_multiplexed_parity_host_rung(session, tenants):
+    """The host floor: per-tenant groups through each tenant's own
+    ``predict`` produce exactly the solo host-rung answers."""
+    mix = _mix(session)
+    multi = MultiplexedEngine(tenants, capacity=64)
+    multi._rung = "host"  # pin the floor (the post-degradation state)
+    mp, mm = multi.execute(
+        session["windows"], session["resolutions"], mix
+    )
+    assert mm is None
+    for name, clf in tenants.items():
+        solo = ServingEngine(clf, capacity=64)
+        solo._rung = "host"
+        sp, _ = solo.execute(session["windows"], session["resolutions"])
+        rows = [i for i, t in enumerate(mix) if t == name]
+        np.testing.assert_array_equal(mp[rows], sp[rows])
+
+
+def test_within_bucket_identity_across_tenant_mixes(session, tenants):
+    """A tenant's rows are bit-identical whatever tenant mix rides the
+    bucket with them — the row-independence contract extended to the
+    gathered weight columns."""
+    multi = MultiplexedEngine(tenants, capacity=64)
+    multi.warmup()
+    windows = session["windows"][:12]
+    res = session["resolutions"]
+    mix = [_NAMES[i % 3] for i in range(12)]
+    _, mixed_margins = multi.execute(windows, res, mix)
+    for name in _NAMES:
+        _, solo_margins = multi.execute(windows, res, [name] * 12)
+        rows = [i for i, t in enumerate(mix) if t == name]
+        np.testing.assert_array_equal(
+            mixed_margins[rows], solo_margins[rows]
+        )
+
+
+def test_multiplexed_service_parity_with_solo_services(session, tenants):
+    """Service-level end-to-end: the multiplexed service's per-tenant
+    answers equal each tenant's solo InferenceService on the same
+    windows."""
+    mix = _mix(session)
+    svc = MultiplexedService(tenants, config=ServeConfig(max_batch=64))
+    svc.engine.warmup()
+    with svc:
+        results = svc.predict_all(
+            session["windows"], session["resolutions"], mix
+        )
+    served = np.array([r.prediction for r in results])
+    for name, clf in tenants.items():
+        solo = InferenceService(clf, config=ServeConfig(max_batch=64))
+        with solo:
+            solo_results = solo.predict_all(
+                session["windows"], session["resolutions"]
+            )
+        solo_preds = np.array([r.prediction for r in solo_results])
+        rows = [i for i, t in enumerate(mix) if t == name]
+        np.testing.assert_array_equal(served[rows], solo_preds[rows])
+
+
+# -- zero-recompile tenant administration --------------------------------
+
+
+def test_add_and_swap_tenant_trigger_zero_compiles(session, tenants):
+    """The tentpole's economic pin: once warm, adding a tenant,
+    swapping a tenant's weights, and serving any tenant mix all run
+    on the one resident program — 0 XLA compiles, measured."""
+    multi = MultiplexedEngine(tenants, capacity=64)
+    multi.warmup()
+    windows = session["windows"][:9]
+    res = session["resolutions"]
+    multi.execute(windows, res, [_NAMES[i % 3] for i in range(9)])
+    newcomer = _tenant_clf(session, 77)
+    replacement = _tenant_clf(session, 78)
+    with CompilationMonitor() as monitor:
+        lane = multi.add_tenant("dave", newcomer)
+        displaced = multi.swap_model(replacement, tenant="bob")
+        multi.execute(windows, res, ["dave", "bob", "alice"] * 3)
+    snap = monitor.snapshot()
+    if snap["available"]:
+        assert snap["compilations"] == 0
+    assert lane == 3
+    assert displaced is tenants["bob"]
+    assert multi.tenant_info("bob")["generation"] == 1
+    # the swap landed: bob now serves the replacement's predictions
+    solo = ServingEngine(replacement, capacity=64)
+    solo.warmup()
+    sp, _ = solo.execute(windows, res)
+    mp, _ = multi.execute(windows, res, ["bob"] * 9)
+    np.testing.assert_array_equal(mp, sp)
+
+
+def test_remove_tenant_frees_lane_and_refuses_traffic(session, tenants):
+    multi = MultiplexedEngine(tenants, capacity=64)
+    displaced = multi.remove_tenant("bob")
+    assert displaced is tenants["bob"]
+    assert "bob" not in multi.tenants
+    with pytest.raises(ValueError, match="unknown tenant 'bob'"):
+        multi.execute(
+            session["windows"][:1], session["resolutions"], ["bob"]
+        )
+    # the freed lane is reused by the next admission
+    assert multi.add_tenant("erin", _tenant_clf(session, 79)) == 1
+    # the last tenant cannot be removed
+    multi.remove_tenant("erin")
+    multi.remove_tenant("carol")
+    with pytest.raises(ValueError, match="at least one tenant"):
+        multi.remove_tenant("alice")
+
+
+def test_solo_engine_refuses_tenant_keyed_swap(session, tenants):
+    solo = ServingEngine(tenants["alice"], capacity=64)
+    with pytest.raises(ValueError, match="MultiplexedEngine"):
+        solo.swap_model(tenants["bob"], tenant="bob")
+
+
+def test_multiplex_requires_fused_linear_family(session, tenants):
+    f64 = _tenant_clf(session, 0)
+    f64.weights = f64.weights.astype(np.float64)
+    with pytest.raises(ValueError, match="not multiplexable"):
+        MultiplexedEngine({"alice": f64}, capacity=64)
+    with pytest.raises(ValueError, match="at least one tenant"):
+        MultiplexedEngine({}, capacity=64)
+
+
+# -- the isolation contract ----------------------------------------------
+
+
+def test_tenant_scoped_chaos_leaves_other_tenants_pinned(
+    session, tenants
+):
+    """``serve.batch.tenant.alice:p=0.2``: alice's rows retry or fail
+    individually; bob's answers stay byte-identical to a bob-only
+    solo service and bob's failure counters stay zero — the isolation
+    contract under live fault injection."""
+    solo = InferenceService(
+        tenants["bob"], config=ServeConfig(max_batch=16)
+    )
+    with solo:
+        baseline = np.array([
+            r.prediction
+            for r in solo.predict_all(
+                session["windows"], session["resolutions"]
+            )
+        ])
+    mix = [
+        "alice" if i % 2 == 0 else "bob"
+        for i in range(len(session["windows"]))
+    ]
+    # small batches: the tenant-scoped point is sampled once per
+    # distinct tenant per batch, so many batches = enough draws for
+    # seed 11 to fire (first firing lands on the 4th call)
+    svc = MultiplexedService(
+        {"alice": tenants["alice"], "bob": tenants["bob"]},
+        config=ServeConfig(
+            max_batch=4, max_attempts=6, retry_backoff_s=0.01
+        ),
+    )
+    svc.engine.warmup()
+    before = obs.metrics.snapshot()["counters"].get(
+        "chaos.fired.serve.batch.tenant.alice", 0.0
+    )
+    bob_results = {}
+    alice_outcomes = 0
+    with chaos.faults("serve.batch.tenant.alice:p=0.2;seed=11"):
+        with svc:
+            futures = [
+                (i, svc.submit(
+                    w, session["resolutions"], tenant=mix[i],
+                    deadline_s=30.0, block_s=30.0,
+                ))
+                for i, w in enumerate(session["windows"])
+            ]
+            for i, fut in futures:
+                try:
+                    result = fut.result(timeout=60.0)
+                    if mix[i] == "bob":
+                        bob_results[i] = result.prediction
+                    else:
+                        alice_outcomes += 1
+                except batcher_mod.RequestFailedError:
+                    # only alice's rows may fail (exhausted retries)
+                    assert mix[i] == "alice"
+                    alice_outcomes += 1
+    fired = obs.metrics.snapshot()["counters"].get(
+        "chaos.fired.serve.batch.tenant.alice", 0.0
+    ) - before
+    assert fired > 0  # the fault plan actually exercised the seam
+    # every bob answer is byte-identical to the bob-only run
+    assert len(bob_results) == sum(1 for t in mix if t == "bob")
+    for i, prediction in bob_results.items():
+        assert prediction == baseline[i]
+    # every alice request resolved (answer or evidence — no hang)
+    assert alice_outcomes == sum(1 for t in mix if t == "alice")
+    block = svc.stats_block()
+    assert block["tenants"]["bob"]["requests"]["failed"] == 0
+    assert block["tenants"]["bob"]["requests"]["shed"] == 0
+    assert block["tenants"]["bob"]["requests"]["completed"] == len(
+        bob_results
+    )
+
+
+def test_failed_swap_on_one_tenant_tears_nothing(session, tenants):
+    """A refused hot swap (wrong dtype/shape — the zero-recompile
+    contract) on alice leaves the published stack untouched: bob's
+    answers before and after are byte-identical, alice still serves
+    her ORIGINAL model, and no generation advanced."""
+    svc = MultiplexedService(
+        {"alice": tenants["alice"], "bob": tenants["bob"]},
+        config=ServeConfig(max_batch=16),
+    )
+    svc.engine.warmup()
+    windows = session["windows"][:8]
+    res = session["resolutions"]
+    with svc:
+        before_bob = [
+            r.prediction
+            for r in svc.predict_all(windows, res, "bob")
+        ]
+        before_alice = [
+            r.prediction
+            for r in svc.predict_all(windows, res, "alice")
+        ]
+        bad = _tenant_clf(session, 5)
+        bad.weights = bad.weights.astype(np.float64)
+        with pytest.raises(ValueError, match="not multiplexable"):
+            svc.swap_tenant("alice", bad)
+        wrong_shape = clf_registry.create("logreg")
+        wrong_shape.weights = np.zeros(7, np.float32)
+        with pytest.raises(ValueError, match="zero-recompile"):
+            svc.swap_tenant("alice", wrong_shape)
+        after_bob = [
+            r.prediction
+            for r in svc.predict_all(windows, res, "bob")
+        ]
+        after_alice = [
+            r.prediction
+            for r in svc.predict_all(windows, res, "alice")
+        ]
+    assert after_bob == before_bob
+    assert after_alice == before_alice
+    assert svc.engine.tenant_info("alice")["generation"] == 0
+
+
+def test_tenant_quota_sheds_with_structured_evidence(session, tenants):
+    """The noisy-neighbor guard: alice's burst sheds against HER
+    quota — with her depth and oldest-age in the evidence — while bob
+    still admits into the shared queue."""
+    svc = MultiplexedService(
+        {"alice": tenants["alice"], "bob": tenants["bob"]},
+        config=ServeConfig(
+            max_batch=16, queue_depth=64, tenant_quota=2
+        ),
+    )
+    # admission without the serving loop: requests queue, nothing
+    # drains — the quota boundary is exact and deterministic
+    svc._accepting = True
+    window, res = session["windows"][0], session["resolutions"]
+    svc.submit(window, res, tenant="alice")
+    svc.submit(window, res, tenant="alice")
+    with pytest.raises(ShedError) as err:
+        svc.submit(window, res, tenant="alice")
+    evidence = err.value.evidence
+    assert evidence["reason"] == "tenant_quota"
+    assert evidence["tenant"] == "alice"
+    assert evidence["tenant_depth"] == 2
+    assert evidence["tenant_quota"] == 2
+    assert evidence["oldest_age_s"] >= 0.0
+    assert "alice" in str(err.value)
+    # bob is untouched by alice's quota
+    svc.submit(window, res, tenant="bob")
+    block = svc.stats_block()
+    assert block["tenants"]["alice"]["requests"]["shed"] == 1
+    assert block["tenants"]["bob"]["requests"]["shed"] == 0
+
+
+def test_mixed_tenants_share_one_batch_key(session):
+    """Tenant is deliberately NOT in the coalescing key: compatible
+    windows from different tenants fill ONE bucket (the cross-tenant
+    fill economics of the tentpole)."""
+    from eeg_dataanalysispackage_tpu.io import deadline as deadline_mod
+
+    w, res = session["windows"][0], session["resolutions"]
+    a = batcher_mod.Request(
+        w, res, deadline_mod.Deadline(5.0), tenant="alice"
+    )
+    b = batcher_mod.Request(
+        w, res, deadline_mod.Deadline(5.0), tenant="bob"
+    )
+    assert a.batch_key() == b.batch_key()
+
+
+def test_mixed_tenant_requests_coalesce_into_shared_batches(
+    session, tenants
+):
+    """Live proof: with a flush window, interleaved two-tenant traffic
+    lands in shared buckets (mean batch size > 1)."""
+    svc = MultiplexedService(
+        {"alice": tenants["alice"], "bob": tenants["bob"]},
+        config=ServeConfig(max_batch=16, flush_us=2000),
+    )
+    svc.engine.warmup()
+    mix = [
+        "alice" if i % 2 == 0 else "bob"
+        for i in range(len(session["windows"]))
+    ]
+    with svc:
+        svc.predict_all(session["windows"], session["resolutions"], mix)
+    block = svc.stats_block()
+    assert block["mean_batch_size"] > 1.0
+
+
+# -- gateway hot path ----------------------------------------------------
+
+
+@pytest.fixture()
+def predict_gateway(session, tenants):
+    svc = MultiplexedService(
+        {"alice": tenants["alice"], "bob": tenants["bob"]},
+        config=ServeConfig(max_batch=16, tenant_quota=2),
+    )
+    svc.engine.warmup()
+    svc.start()
+    gateway = GatewayServer(journal_dir=None, predict_service=svc)
+    try:
+        yield gateway, svc
+    finally:
+        svc.stop()
+
+
+def _predict_body(session, tenant="alice"):
+    import json
+
+    return json.dumps({
+        "tenant": tenant,
+        "window": np.asarray(session["windows"][0]).tolist(),
+        "resolutions": np.asarray(session["resolutions"]).tolist(),
+    })
+
+
+def test_gateway_predict_happy_path_and_stats(
+    session, tenants, predict_gateway
+):
+    gateway, svc = predict_gateway
+    code, payload = gateway.predict_payload(_predict_body(session))
+    assert code == 200
+    assert payload["tenant"] == "alice"
+    assert payload["prediction"] in (0.0, 1.0)
+    assert payload["margin"] is not None
+    assert payload["batch_size"] >= 1
+    # the served answer is the engine's answer
+    solo = ServingEngine(tenants["alice"], capacity=16)
+    solo.warmup()
+    sp, _ = solo.execute(
+        [session["windows"][0]], session["resolutions"]
+    )
+    assert payload["prediction"] == float(sp[0])
+    code, stats = gateway.stats_payload()
+    assert code == 200
+    serve_block = stats["serve"]
+    assert set(serve_block["tenants"]) == {"alice", "bob"}
+    alice = serve_block["tenants"]["alice"]
+    assert alice["requests"]["submitted"] >= 1
+    assert alice["requests"]["completed"] >= 1
+    assert {"lane", "generation", "requests", "latency_ms",
+            "lifecycle"} <= set(alice)
+
+
+def test_gateway_predict_idempotent_replay_and_conflict(
+    session, predict_gateway
+):
+    gateway, _svc = predict_gateway
+    body = _predict_body(session)
+    code1, first = gateway.predict_payload(body, idempotency_key="k1")
+    assert code1 == 200 and first["idempotent_replay"] is False
+    code2, replay = gateway.predict_payload(body, idempotency_key="k1")
+    assert code2 == 200 and replay["idempotent_replay"] is True
+    assert replay["prediction"] == first["prediction"]
+    assert replay["margin"] == first["margin"]
+    # same key, different body: refused — honesty over convenience
+    other = _predict_body(session, tenant="bob")
+    code3, conflict = gateway.predict_payload(
+        other, idempotency_key="k1"
+    )
+    assert code3 == 409
+    assert conflict["idempotency_conflict"] is True
+
+
+def test_gateway_predict_rejects_bad_requests(session, predict_gateway):
+    gateway, _svc = predict_gateway
+    code, payload = gateway.predict_payload("not json")
+    assert code == 400 and "not JSON" in payload["error"]
+    code, payload = gateway.predict_payload(
+        _predict_body(session, tenant="ghost")
+    )
+    assert code == 400 and "unknown tenant" in payload["error"]
+    code, payload = gateway.predict_payload('{"tenant": "alice"}')
+    assert code == 400 and "window" in payload["error"]
+    # no service attached: the gateway stays the pure plan front door
+    bare = GatewayServer(journal_dir=None)
+    code, payload = bare.predict_payload(_predict_body(session))
+    assert code == 503
+
+
+def test_gateway_predict_shed_carries_tenant_evidence(session, tenants):
+    """429 body: the admission queue's structured per-tenant evidence
+    (depth, quota, oldest-age), straight from the ShedError."""
+    svc = MultiplexedService(
+        {"alice": tenants["alice"]},
+        config=ServeConfig(max_batch=16, queue_depth=64, tenant_quota=1),
+    )
+    svc._accepting = True  # queue admits, nothing drains
+    gateway = GatewayServer(journal_dir=None, predict_service=svc)
+    svc.submit(
+        session["windows"][0], session["resolutions"], tenant="alice"
+    )
+    code, payload = gateway.predict_payload(_predict_body(session))
+    assert code == 429
+    assert payload["shed"] is True
+    assert payload["tenant"] == "alice"
+    evidence = payload["evidence"]
+    assert evidence["reason"] == "tenant_quota"
+    assert evidence["tenant_depth"] == 1
+    assert evidence["tenant_quota"] == 1
+    assert "oldest_age_s" in evidence
+
+
+# -- tenant registry loading ---------------------------------------------
+
+
+def test_parse_tenant_spec():
+    spec = "alice=logreg@/m/a, bob=svm@/m/b"
+    parsed = serve_pipeline.parse_tenant_spec(spec)
+    assert parsed == {
+        "alice": ("logreg", "/m/a"), "bob": ("svm", "/m/b"),
+    }
+    assert list(parsed) == ["alice", "bob"]  # order preserved
+    for bad in (
+        "", "alice", "alice=logreg", "alice@/m/a",
+        "alice=logreg@/m/a,alice=svm@/m/b",
+    ):
+        with pytest.raises(ValueError):
+            serve_pipeline.parse_tenant_spec(bad)
+
+
+def test_load_tenants_and_from_saved(session):
+    spec = (
+        f"alice=logreg@{session['model']},"
+        f"bob=logreg@{session['model']}"
+    )
+    loaded = serve_pipeline.load_tenants(spec)
+    assert set(loaded) == {"alice", "bob"}
+    assert loaded["alice"] is not loaded["bob"]
+    np.testing.assert_array_equal(
+        loaded["alice"].weights, loaded["bob"].weights
+    )
+    svc = MultiplexedService.from_saved(
+        {
+            "alice": ("logreg", session["model"]),
+            "bob": ("logreg", session["model"]),
+        },
+        config=ServeConfig(max_batch=16),
+    )
+    with svc:
+        r = svc.predict_window(
+            session["windows"][0], session["resolutions"],
+            tenant="bob",
+        )
+    assert r.prediction in (0.0, 1.0)
+
+
+def test_runtime_tenant_onboarding_from_saved(session, tenants):
+    svc = MultiplexedService(
+        {"alice": tenants["alice"]}, config=ServeConfig(max_batch=16)
+    )
+    svc.engine.warmup()
+    with svc:
+        lane = svc.add_tenant_from_saved(
+            "frank", "logreg", session["model"]
+        )
+        assert lane == 1
+        r = svc.predict_window(
+            session["windows"][0], session["resolutions"],
+            tenant="frank",
+        )
+        assert r.prediction in (0.0, 1.0)
+        svc.remove_tenant("frank")
+        with pytest.raises(ValueError, match="unknown tenant"):
+            svc.submit(
+                session["windows"][0], session["resolutions"],
+                tenant="frank",
+            )
+
+
+def test_serve_config_tenant_quota_from_query():
+    config = serve_pipeline.serve_config_from_query(
+        {"serve_tenant_quota": "8"}
+    )
+    assert config.tenant_quota == 8
+    assert serve_pipeline.serve_config_from_query({}).tenant_quota is None
+
+
+# -- stats & decision path -----------------------------------------------
+
+
+def test_stats_block_schema(session, tenants):
+    svc = MultiplexedService(tenants, config=ServeConfig(max_batch=16))
+    svc.engine.warmup()
+    with svc:
+        svc.predict_all(
+            session["windows"][:6], session["resolutions"],
+            [_NAMES[i % 3] for i in range(6)],
+        )
+        block = svc.stats_block()
+    # the solo block's schema survives unchanged...
+    for key in ("mode", "rung", "mega", "requests", "latency_ms",
+                "lifecycle"):
+        assert key in block
+    # ...plus the per-tenant attribution sub-block
+    assert set(block["tenants"]) == set(_NAMES)
+    assert block["resident_weight_bytes"] == 48 * 128 * 4
+    for name in _NAMES:
+        t = block["tenants"][name]
+        assert t["requests"]["completed"] == 2
+        assert t["latency_ms"]["n"] == 2
+        assert t["latency_ms"]["p99"] >= t["latency_ms"]["p50"] >= 0
+        assert t["lifecycle"] is None
+
+
+def test_multiplex_accelerator_decision_harvest(tmp_path):
+    """The pre-registered consolidation gate: no artifact -> per-
+    tenant engines stand; a 16-tenant chip line at >= the flip ratio
+    -> consolidate (data flips the decision, not code)."""
+    import json
+
+    root = tmp_path / "sweeps"
+    decision = multiplex.accelerator_decision(str(root))
+    assert decision["consolidate"] is False
+    assert decision["ratio"] is None
+    run = root / "20260101T000000Z"
+    run.mkdir(parents=True)
+    record = {
+        "platform": "tpu",
+        "serve": {"multitenant": {"levels": [
+            {
+                "tenants": 16,
+                "multiplexed": {"preds_per_s": 5200.0},
+                "solo_fleet": {"preds_per_s": 4100.0},
+            },
+        ]}},
+    }
+    (run / "serve_multitenant.json").write_text(json.dumps(record))
+    decision = multiplex.accelerator_decision(str(root))
+    assert decision["consolidate"] is True
+    assert decision["ratio"] == round(5200.0 / 4100.0, 4)
+    assert decision["threshold_ratio"] == multiplex.MULTIPLEX_FLIP_RATIO
+    # below the flip ratio: the fleet stands
+    record["serve"]["multitenant"]["levels"][0]["multiplexed"][
+        "preds_per_s"
+    ] = 3000.0
+    (run / "serve_multitenant.json").write_text(json.dumps(record))
+    assert multiplex.accelerator_decision(str(root))["consolidate"] is False

@@ -77,5 +77,12 @@ run pallas_ingest 1800 python tools/ingest_bench.py pallas_ingest 131072 20
 # change). Mosaic-compiled kernel, so it sits with the Pallas rows —
 # a remote-compile crash here must not cost the core numbers above.
 run serve_mega 1200 python tools/serve_bench.py serve_mega 2000 2
+# the multiplexed multi-tenant engine vs the N-engine solo fleet, per
+# tenant level on chip: this artifact IS the consolidation decision
+# path's input (serve/multiplex.accelerator_decision — a 16-tenant
+# conc-16 multiplexed/fleet ratio >= 1.0, pre-registered as
+# MULTIPLEX_FLIP_RATIO, flips the consolidation call, zero code
+# change). Same mega program family as serve_mega, so it sits here.
+run serve_multitenant 1200 python tools/serve_bench.py serve_multitenant 2000 2
 run pallas_bisect 900 python tools/pallas_compile_bisect.py
 run sublane_probe 900 python tools/pallas_sublane_probe.py

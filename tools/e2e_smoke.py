@@ -127,6 +127,15 @@ performance contract holds:
   live model untouched, and the ``lifecycle`` block present in the
   adapt run's run_report.json.
 
+- the multiplexed multi-tenant engine (serve_multitenant,
+  tools/serve_bench.py — the ISSUE 16 tentpole): every tenant's
+  predictions out of the mixed-tenant stream bit-identical to that
+  tenant's solo engine, tenant scaling 1→16 and a hot tenant swap at
+  0 XLA compiles (the one resident program serves any tenant mix),
+  and the 16-tenant multiplexed throughput at concurrency 16 no
+  worse than the 16-engine solo fleet it replaces (0.9x noise
+  floor, back-to-back on a shared box).
+
 Usage: python tools/e2e_smoke.py [n_markers_per_file] [n_files]
 
 Prints a JSON summary line; exit 0 iff every gate passed. Wired into
@@ -360,6 +369,64 @@ def _check_lifecycle(line: dict, report_dir: str,
             f"lifecycle: the adapt run's report recorded no feedback: "
             f"{block.get('feedback')}"
         )
+
+
+def _check_multitenant(line: dict, failures: list) -> None:
+    """The multiplexed multi-tenant gate (the ISSUE 16 acceptance):
+    every tenant's multiplexed predictions bit-identical to its solo
+    engine, tenant scaling 1→16 and a hot swap at 0 XLA compiles
+    (one compile serves any tenant mix), and the 16-tenant
+    multiplexed throughput at concurrency 16 no worse than the
+    16-engine solo fleet (0.9x noise floor — the pair is measured
+    back-to-back, but the box is shared)."""
+    mt = (line.get("serve") or {}).get("multitenant") or {}
+    if not mt:
+        failures.append(
+            "serve_multitenant: no multitenant block on the line"
+        )
+        return
+    parity = mt.get("parity") or {}
+    if not parity.get("bit_identical"):
+        failures.append(
+            f"serve_multitenant: a tenant's served predictions "
+            f"drifted from its solo engine: {parity}"
+        )
+    compiles = mt.get("compiles") or {}
+    if compiles.get("available") and compiles.get("scaling", 0) != 0:
+        failures.append(
+            f"serve_multitenant: scaling 1→16 tenants recompiled "
+            f"({compiles.get('scaling')} compiles; the resident "
+            f"program must serve any tenant mix)"
+        )
+    swap = mt.get("swap") or {}
+    if compiles.get("available") and swap.get("compiles", 0) != 0:
+        failures.append(
+            f"serve_multitenant: a hot tenant swap recompiled: {swap}"
+        )
+    level16 = next(
+        (lv for lv in mt.get("levels") or []
+         if lv.get("tenants") == 16),
+        None,
+    )
+    if level16 is None:
+        failures.append("serve_multitenant: no 16-tenant level")
+    else:
+        mult = (level16.get("multiplexed") or {}).get(
+            "preds_per_s", 0.0
+        )
+        fleet = (level16.get("solo_fleet") or {}).get(
+            "preds_per_s", 0.0
+        )
+        if not mult >= 0.9 * fleet:
+            failures.append(
+                f"serve_multitenant: multiplexed worse than the solo "
+                f"fleet at 16 tenants: {mult} vs {fleet} preds/s"
+            )
+        if (level16.get("multiplexed") or {}).get("unresolved"):
+            failures.append(
+                f"serve_multitenant: unresolved requests at the "
+                f"16-tenant level: {level16.get('multiplexed')}"
+            )
 
 
 def _run_variant(variant: str, n_markers: int, n_files: int,
@@ -949,6 +1016,14 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
             variant="serve_lifecycle",
         )
         _check_lifecycle(lifecycle_line, lifecycle_report_dir, failures)
+        # the multiplexed multi-tenant engine (ISSUE 16 tentpole):
+        # per-tenant parity, the 0-compile scaling + hot-swap pins,
+        # and multiplexed >= solo-fleet at 16 tenants — all on one
+        # line
+        multitenant_line = _run_serve_bench(
+            min(n_markers, 400), n_files, variant="serve_multitenant"
+        )
+        _check_multitenant(multitenant_line, failures)
         # the seizure workload: one cost-swept population run over a
         # continuous annotated session (its own data dir — the
         # manifest points at continuous recordings); the swept member
@@ -1271,6 +1346,24 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
                 .get("lifecycle") or {}
             ).get("drift_events"),
             "chaos": (lifecycle_line.get("serve") or {}).get("chaos"),
+        },
+        "serve_multitenant": {
+            "parity": (
+                (multitenant_line.get("serve") or {})
+                .get("multitenant") or {}
+            ).get("parity"),
+            "compiles": (
+                (multitenant_line.get("serve") or {})
+                .get("multitenant") or {}
+            ).get("compiles"),
+            "swap": (
+                (multitenant_line.get("serve") or {})
+                .get("multitenant") or {}
+            ).get("swap"),
+            "levels": (
+                (multitenant_line.get("serve") or {})
+                .get("multitenant") or {}
+            ).get("levels"),
         },
         "serve_mega": {
             "mega_rung": (

@@ -263,6 +263,26 @@ def show(path: str) -> None:
             f"{serve.get('drained_cleanly')}  wedged="
             f"{serve.get('wedged')}"
         )
+        tenants = serve.get("tenants") or {}
+        if tenants:
+            print(
+                f"  tenants={len(tenants)}  quota="
+                f"{serve.get('tenant_quota')}  resident_bytes="
+                f"{serve.get('resident_weight_bytes')}"
+            )
+            width = max(len(name) for name in tenants)
+            for name in sorted(tenants):
+                t = tenants[name]
+                treq = t.get("requests", {})
+                tlat = t.get("latency_ms", {})
+                print(
+                    f"    {name:<{width}}  lane={t.get('lane')} "
+                    f"gen={t.get('generation')}  completed="
+                    f"{treq.get('completed')} shed={treq.get('shed')} "
+                    f"deadline={treq.get('deadline_exceeded')} "
+                    f"failed={treq.get('failed')}  p50="
+                    f"{tlat.get('p50')}ms p99={tlat.get('p99')}ms"
+                )
     lifecycle = data.get("lifecycle")
     if lifecycle:
         print("\nlifecycle:")
@@ -439,6 +459,26 @@ def diff(path_a: str, path_b: str) -> None:
     ga, gb = a.get("gateway") or {}, b.get("gateway") or {}
     if (ga or gb) and ga != gb:
         print(f"gateway: A {ga}  B {gb}")
+
+    def _tenant_digest(report):
+        tenants = (report.get("serve") or {}).get("tenants")
+        if not tenants:
+            return None
+        return {
+            name: (
+                t.get("lane"), t.get("generation"),
+                (t.get("requests") or {}).get("completed"),
+                (t.get("requests") or {}).get("shed"),
+            )
+            for name, t in tenants.items()
+        }
+
+    ta, tb = _tenant_digest(a), _tenant_digest(b)
+    if (ta or tb) and ta != tb:
+        print(
+            f"serve tenants (lane, gen, completed, shed): "
+            f"A {ta}  B {tb}"
+        )
 
     def _pop_digest(report):
         pop = report.get("population")

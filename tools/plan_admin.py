@@ -3,8 +3,10 @@ without reading JSON by hand.
 
 Usage:
     python tools/plan_admin.py list  (--journal DIR | --gateway URL)
+            [--tenant NAME]
     python tools/plan_admin.py show <plan_id>
             (--journal DIR [--reports DIR] | --gateway URL)
+    python tools/plan_admin.py stats --gateway URL [--tenant NAME]
     python tools/plan_admin.py tail --journal DIR
             [--interval S] [--count N]
 
@@ -21,6 +23,13 @@ report tree is reachable (``--reports DIR``, or the record's own
 ``report_dir``, or the gateway's report endpoint), the rendered
 ``run_report.json`` via tools/obs_report.py — one rendering code path,
 not two.
+
+``stats`` pulls a running gateway's ``/stats`` payload; with
+``--tenant`` it prints just that tenant's serve attribution (lane,
+swap generation, outcome counters, latency percentiles — the
+multiplexed serving block, serve/multiplex.py) instead of the whole
+payload. ``list --tenant`` narrows the plan table to queries
+mentioning that tenant.
 
 ``tail`` follows a journal directory and prints records as they land
 or change state — the exactly-once behavior is auditable live:
@@ -101,8 +110,17 @@ def cmd_list(args) -> int:
         if args.gateway
         else _rows_from_entries(_journal_entries(args.journal))
     )
+    tenant = getattr(args, "tenant", None)
+    if tenant:
+        # a tenant-keyed plan names its tenant in the query string
+        # (tenant=<name> or a tenants= spec entry) — substring match
+        # keeps both forms findable without a schema change
+        rows = [r for r in rows if tenant in r["query"]]
     if not rows:
-        print("(no plan records)")
+        print(
+            f"(no plan records mentioning tenant {tenant!r})"
+            if tenant else "(no plan records)"
+        )
         return 0
     widths = {
         k: max(len(k), *(len(str(r[k])) for r in rows))
@@ -221,6 +239,41 @@ def cmd_show(args) -> int:
     return 0
 
 
+def cmd_stats(args) -> int:
+    """The gateway's /stats payload; ``--tenant`` narrows it to one
+    tenant's serve attribution — the operator's single-tenant view
+    without scraping the full payload."""
+    payload = _http(args.gateway.rstrip("/") + "/stats")
+    if not args.tenant:
+        print(json.dumps(payload, indent=2, sort_keys=True))
+        return 0
+    serve = payload.get("serve")
+    if not serve:
+        print(
+            "gateway has no serve block (no prediction service "
+            "attached)"
+        )
+        return 1
+    tenants = serve.get("tenants") or {}
+    block = tenants.get(args.tenant)
+    if block is None:
+        print(
+            f"unknown tenant {args.tenant!r}; registered: "
+            f"{sorted(tenants)}"
+        )
+        return 1
+    print(json.dumps(
+        {
+            "tenant": args.tenant,
+            **block,
+            "tenant_quota": serve.get("tenant_quota"),
+            "rung": serve.get("rung"),
+        },
+        indent=2, sort_keys=True,
+    ))
+    return 0
+
+
 def cmd_tail(args) -> int:
     """Follow the journal: print each record when it first appears and
     again on every state change (the submitted -> terminal transition
@@ -266,10 +319,22 @@ def main(argv=None) -> int:
     p_list = sub.add_parser("list", help="table of all plan records")
     p_show = sub.add_parser("show", help="one plan's full record + report")
     p_show.add_argument("plan_id")
+    p_stats = sub.add_parser(
+        "stats", help="gateway /stats (optionally one tenant's block)"
+    )
+    p_stats.add_argument("--gateway", required=True)
+    p_stats.add_argument(
+        "--tenant",
+        help="print only this tenant's serve attribution",
+    )
     p_tail = sub.add_parser("tail", help="follow a journal directory")
     for p in (p_list, p_show):
         p.add_argument("--journal", help="journal directory")
         p.add_argument("--gateway", help="running gateway URL")
+    p_list.add_argument(
+        "--tenant",
+        help="only plans whose query mentions this tenant",
+    )
     p_show.add_argument(
         "--reports",
         help="per-plan report root (<root>/<plan_id>/run_report.json)",
@@ -288,6 +353,8 @@ def main(argv=None) -> int:
         return cmd_list(args)
     if args.command == "show":
         return cmd_show(args)
+    if args.command == "stats":
+        return cmd_stats(args)
     return cmd_tail(args)
 
 

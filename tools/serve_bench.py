@@ -3,6 +3,8 @@
 Usage: python tools/serve_bench.py serve_bench <n_markers> <n_files>
            [--report-dir D]
        python tools/serve_bench.py serve_mega <n_markers> <n_files>
+       python tools/serve_bench.py serve_multitenant <n_markers>
+           <n_files>
 
 One hermetic run proves the serving layer's whole contract and prints
 one JSON line in the driver-facing schema (bench.py whitelists the
@@ -40,6 +42,19 @@ engine's mega warmup-gate record, and the int8 precision rung's
 warmup gate decision — the driver-facing evidence the accelerator
 decision path (serve_mega.accelerator_decision) harvests from staged
 chip runs.
+
+The ``serve_multitenant`` variant is the multiplexed engine
+(serve/multiplex.py): at each tenant level N in 1/4/16, ONE resident
+multiplexed service carrying N tenant models is driven at concurrency
+16 back-to-back against a fleet of N solo services over the same
+models (temporal adjacency again). The line records per-level
+preds/sec + p50/p99 pairs for both sides with their ratio, the
+per-tenant multiplexed-vs-solo prediction parity pin, the XLA compile
+counts for scaling 1→16 tenants and for a hot swap (both pinned at 0
+— one compile serves any tenant mix), and the resident weight bytes
+(one stacked matrix vs N engines). The accelerator decision path
+(multiplex.accelerator_decision) harvests the 16-tenant level from
+staged chip runs of this variant.
 
 Everything is fabricated by tests/_synthetic.py; the model is trained
 and saved by the real pipeline in-process before the service loads it.
@@ -94,14 +109,18 @@ def _build_session(data_dir: str, n_markers: int, n_files: int) -> str:
 
 
 def _drive_level(service, windows, resolutions, concurrency: int,
-                 n_requests: int, deadline_s: float) -> dict:
+                 n_requests: int, deadline_s: float,
+                 tenants=None) -> dict:
     """Closed-loop load at one concurrency level: ``concurrency``
     submitter threads, each waiting for its own previous result
     before submitting the next (classic closed-loop load). The level
     dict carries its own batch-formation attribution
     (``mean_batch_size`` from the completed/batches counter deltas —
     the ``serve_flush_us`` knob's measurement surface) and the engine
-    rung that served it."""
+    rung that served it. ``tenants`` (a name list) makes the drive
+    multiplexed-service-aware: submitters spread requests round-robin
+    across the tenant set, so every bucket the batcher forms is a
+    mixed-tenant bucket."""
     from eeg_dataanalysispackage_tpu.io import deadline as deadline_mod
     from eeg_dataanalysispackage_tpu.serve import batcher as batcher_mod
 
@@ -120,10 +139,15 @@ def _drive_level(service, windows, resolutions, concurrency: int,
     def submitter(tid: int) -> None:
         for i in range(per_thread):
             w = windows[(tid + i * concurrency) % len(windows)]
+            kwargs = {}
+            if tenants:
+                kwargs["tenant"] = tenants[
+                    (tid + i * concurrency) % len(tenants)
+                ]
             try:
                 fut = service.submit(
                     w, resolutions, deadline_s=deadline_s,
-                    block_s=deadline_s,
+                    block_s=deadline_s, **kwargs,
                 )
                 r = fut.result(timeout=deadline_s + 10.0)
                 with lock:
@@ -180,6 +204,87 @@ def _drive_level(service, windows, resolutions, concurrency: int,
         # stats block mixes all levels): how full the buckets ran
         "mean_batch_size": round(d_completed / max(1, d_batches), 3),
         "rung": service.engine.rung,
+    }
+
+
+def _drive_fleet(services, windows, resolutions, concurrency: int,
+                 n_requests: int, deadline_s: float) -> dict:
+    """The solo-fleet twin of :func:`_drive_level`: the same closed
+    loop and total concurrency, but submitter thread ``t`` drives
+    ``services[t % N]`` — N independent resident engines sharing the
+    box, the deployment the multiplexed engine replaces. Aggregate
+    preds/sec over one shared wall clock; ``mean_batch_size`` from
+    the fleet-summed counter deltas (each engine only ever sees its
+    own tenant's traffic, so its buckets fill from one stream)."""
+    from eeg_dataanalysispackage_tpu.io import deadline as deadline_mod
+    from eeg_dataanalysispackage_tpu.serve import batcher as batcher_mod
+    from eeg_dataanalysispackage_tpu.serve.service import _percentile
+
+    counters_before = [s.batcher.snapshot()[0] for s in services]
+    per_thread = max(1, n_requests // concurrency)
+    latencies = []
+    outcomes = {
+        "completed": 0, "shed": 0, "deadline": 0, "failed": 0,
+        "unresolved": 0,
+    }
+    lock = threading.Lock()
+
+    def submitter(tid: int) -> None:
+        service = services[tid % len(services)]
+        for i in range(per_thread):
+            w = windows[(tid + i * concurrency) % len(windows)]
+            try:
+                fut = service.submit(
+                    w, resolutions, deadline_s=deadline_s,
+                    block_s=deadline_s,
+                )
+                r = fut.result(timeout=deadline_s + 10.0)
+                with lock:
+                    outcomes["completed"] += 1
+                    latencies.append(r.latency_s)
+            except batcher_mod.ShedError:
+                with lock:
+                    outcomes["shed"] += 1
+            except deadline_mod.DeadlineExceededError:
+                with lock:
+                    outcomes["deadline"] += 1
+            except TimeoutError:
+                with lock:
+                    outcomes["unresolved"] += 1
+            except batcher_mod.ServeError:
+                with lock:
+                    outcomes["failed"] += 1
+
+    threads = [
+        threading.Thread(target=submitter, args=(t,), daemon=True)
+        for t in range(concurrency)
+    ]
+    start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - start
+    lat = sorted(latencies)
+    d_completed = d_batches = 0
+    for service, before in zip(services, counters_before):
+        after, _ = service.batcher.snapshot()
+        d_completed += after.get("completed", 0) - before.get(
+            "completed", 0
+        )
+        d_batches += after.get("batches", 0) - before.get("batches", 0)
+    return {
+        "concurrency": concurrency,
+        "engines": len(services),
+        "requests": per_thread * concurrency,
+        **outcomes,
+        "wall_s": round(wall, 3),
+        "preds_per_s": round(outcomes["completed"] / wall, 1)
+        if wall > 0 else 0.0,
+        "p50_ms": round(_percentile(lat, 50.0) * 1e3, 3),
+        "p99_ms": round(_percentile(lat, 99.0) * 1e3, 3),
+        "mean_batch_size": round(d_completed / max(1, d_batches), 3),
+        "rung": services[0].engine.rung,
     }
 
 
@@ -797,9 +902,230 @@ def run_lifecycle(n_markers: int, n_files: int, report_dir=None) -> dict:
     }
 
 
+#: tenant counts swept by serve_multitenant (the 16-tenant level is
+#: the one multiplex.accelerator_decision harvests from chip runs)
+_TENANT_LEVELS = (1, 4, 16)
+
+
+def _clone_tenants(model: str, n: int) -> dict:
+    """N tenant models from one saved checkpoint: tenant 0 is the
+    checkpoint verbatim; the rest are deterministically perturbed
+    clones — genuinely distinct weights (a cross-tenant mixup would
+    show as a parity break), zero extra training cost."""
+    import numpy as np
+
+    from eeg_dataanalysispackage_tpu.models import (
+        registry as clf_registry,
+    )
+
+    tenants = {}
+    for i in range(n):
+        clf = clf_registry.create("logreg")
+        clf.load(model)
+        if i:
+            r = np.random.default_rng(1000 + i)
+            clf.weights = (
+                clf.weights
+                * (1.0 + 0.02 * r.standard_normal(clf.weights.shape))
+            ).astype(np.float32)
+            clf.intercept = float(
+                clf.intercept + 0.01 * r.standard_normal()
+            )
+        tenants[f"t{i:02d}"] = clf
+    return tenants
+
+
+def run_multitenant(n_markers: int, n_files: int) -> dict:
+    """The serve_multitenant measurement: one multiplexed engine vs
+    the solo fleet it replaces, back-to-back per tenant level (see
+    the module docstring)."""
+    import numpy as np
+
+    from eeg_dataanalysispackage_tpu.obs.report import (
+        CompilationMonitor,
+    )
+    from eeg_dataanalysispackage_tpu.serve import (
+        InferenceService, MultiplexedService, ServeConfig,
+    )
+    from eeg_dataanalysispackage_tpu.serve import multiplex
+    from eeg_dataanalysispackage_tpu.serve.engine import ServingEngine
+
+    t0 = time.perf_counter()
+    tmp = tempfile.mkdtemp(prefix="eeg_tpu_serve_multitenant_")
+    (
+        _info, model, windows, _targets, resolutions, _classifier,
+        _batch_features, _batch_predictions,
+    ) = _prepare(tmp, n_markers, n_files)
+
+    max_tenants = max(_TENANT_LEVELS)
+    tenant_models = _clone_tenants(model, max_tenants)
+    names = list(tenant_models)
+
+    # ONE multiplexed service, built at 1 tenant and SCALED in place
+    # to 16 — the add_tenant path is the measurement, not a per-level
+    # rebuild. Warmup compiles are attributed separately from the
+    # scaling compiles (the latter are the 0-recompile pin).
+    with CompilationMonitor() as warm_mon:
+        service = MultiplexedService(
+            {names[0]: tenant_models[names[0]]},
+            config=ServeConfig(),
+        )
+        service.engine.warmup()
+    warmup = warm_mon.snapshot()
+    counters_available = bool(warmup.get("available"))
+    scaling_compiles = 0
+    service.start()
+    levels = []
+    try:
+        for n_tenants in _TENANT_LEVELS:
+            with CompilationMonitor() as grow_mon:
+                for name in names[len(service.tenants):n_tenants]:
+                    service.add_tenant(name, tenant_models[name])
+            grown = grow_mon.snapshot()
+            if grown.get("available"):
+                scaling_compiles += grown["compilations"]
+            active = names[:n_tenants]
+
+            multiplexed = _drive_level(
+                service, windows, resolutions, 16,
+                _REQUESTS_PER_LEVEL, deadline_s=5.0, tenants=active,
+            )
+            # the solo fleet over the SAME models, seconds later
+            fleet = [
+                InferenceService(
+                    tenant_models[name], config=ServeConfig(),
+                )
+                for name in active
+            ]
+            for svc in fleet:
+                svc.start()
+            try:
+                solo_fleet = _drive_fleet(
+                    fleet, windows, resolutions, 16,
+                    _REQUESTS_PER_LEVEL, deadline_s=5.0,
+                )
+            finally:
+                for svc in fleet:
+                    svc.stop(drain=True)
+            levels.append({
+                "tenants": n_tenants,
+                "multiplexed": multiplexed,
+                "solo_fleet": solo_fleet,
+                "ratio": round(
+                    multiplexed["preds_per_s"]
+                    / max(1e-9, solo_fleet["preds_per_s"]), 3
+                ),
+            })
+
+        # per-tenant parity at the full tenant level: every tenant's
+        # rows out of a 16-way mixed stream vs that tenant's solo
+        # engine, element-wise
+        mix = [names[i % max_tenants] for i in range(len(windows))]
+        served = np.array([
+            r.prediction
+            for r in service.predict_all(windows, resolutions, mix)
+        ])
+        mismatches = 0
+        for name in names:
+            solo = ServingEngine(tenant_models[name], capacity=64)
+            solo.warmup()
+            sp = np.concatenate([
+                solo.execute(windows[i:i + 64], resolutions)[0]
+                for i in range(0, len(windows), 64)
+            ])
+            rows = [i for i, t in enumerate(mix) if t == name]
+            mismatches += int((served[rows] != sp[rows]).sum())
+        parity = {
+            "n": len(windows),
+            "tenants": max_tenants,
+            "bit_identical": mismatches == 0,
+            "mismatches": mismatches,
+        }
+
+        # the hot-swap pin: rewrite one tenant's column and serve —
+        # 0 compiles, and the swapped tenant serves the new model
+        replacement = _clone_tenants(model, 2)[names[1]]
+        with CompilationMonitor() as swap_mon:
+            service.swap_tenant(names[0], replacement)
+            swap_result = service.predict_window(
+                windows[0], resolutions, tenant=names[0],
+            )
+        swapped = swap_mon.snapshot()
+        swap_compiles = (
+            swapped["compilations"] if swapped.get("available") else 0
+        )
+        swap_block = {
+            "compiles": swap_compiles,
+            "served_after_swap": swap_result.prediction in (0.0, 1.0),
+            "generation": service.engine.tenant_info(
+                names[0]
+            )["generation"],
+        }
+        stats = service.stats_block()
+    finally:
+        drained = service.stop(drain=True)
+
+    import jax
+
+    per_engine_bytes = int(
+        tenant_models[names[0]].weights.nbytes
+    )
+    best = max(
+        level["multiplexed"]["preds_per_s"] for level in levels
+    )
+    return {
+        "variant": "serve_multitenant",
+        "epochs_per_s": best,
+        "n": len(windows),
+        "iters": _REQUESTS_PER_LEVEL,
+        "bytes_per_epoch": _BYTES_PER_EPOCH,
+        "wall_s": round(time.perf_counter() - t0, 3),
+        "n_markers_per_file": n_markers,
+        "n_files": n_files,
+        "platform": jax.devices()[0].platform,
+        "serve": {
+            "multitenant": {
+                "levels": levels,
+                "parity": parity,
+                "compiles": {
+                    "available": counters_available,
+                    "warmup": warmup.get("compilations"),
+                    # 1 -> 16 tenants on the resident program: the
+                    # 0-recompile scaling pin (one compile serves any
+                    # tenant mix)
+                    "scaling": scaling_compiles,
+                    "scaling_zero_ok": (
+                        not counters_available
+                        or scaling_compiles == 0
+                    ),
+                },
+                "swap": swap_block,
+                "resident": {
+                    # one stacked (d, 128) matrix, whatever N is...
+                    "multiplexed_bytes": (
+                        service.engine.resident_weight_bytes
+                    ),
+                    # ...vs one weight vector per fleet engine
+                    "fleet_bytes_per_engine": per_engine_bytes,
+                    "fleet_bytes_16": 16 * per_engine_bytes,
+                },
+                "rung": service.engine.rung,
+                "drained_cleanly": drained,
+                "service": stats,
+                "accelerator_decision": (
+                    multiplex.accelerator_decision()
+                ),
+            },
+        },
+    }
+
+
 def main(argv) -> dict:
     variant = argv[0] if argv else "serve_bench"
-    if variant not in ("serve_bench", "serve_mega", "serve_lifecycle"):
+    if variant not in (
+        "serve_bench", "serve_mega", "serve_lifecycle",
+        "serve_multitenant",
+    ):
         raise SystemExit(f"unknown variant {variant!r}")
     n_markers = int(argv[1]) if len(argv) > 1 else 400
     n_files = int(argv[2]) if len(argv) > 2 else 2
@@ -813,6 +1139,8 @@ def main(argv) -> dict:
         return run_mega(n_markers, n_files)
     if variant == "serve_lifecycle":
         return run_lifecycle(n_markers, n_files, report_dir=report_dir)
+    if variant == "serve_multitenant":
+        return run_multitenant(n_markers, n_files)
     return run(n_markers, n_files, report_dir=report_dir)
 
 
