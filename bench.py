@@ -230,6 +230,10 @@ _VARIANT_TIMEOUTS = {
     # four fresh pipeline processes (2 pod workers + twin + degraded
     # run) in one child — the wall is ~4 population_vmap runs
     "population_multiproc": _SLOW_COMPILE_TIMEOUT_S,
+    # five fresh processes (3 gateway replicas + 2 twins), each
+    # compiling cold, plus the lease-timeout failover wait — same
+    # fresh-compile class
+    "gateway_fleet": _SLOW_COMPILE_TIMEOUT_S,
 }
 # Total wall budget for the variant loop: the headline always runs;
 # a further variant starts only if it could finish inside the budget
@@ -238,7 +242,7 @@ _VARIANT_TIMEOUTS = {
 # patience — on a warm compile cache everything fits easily; on a
 # cold cache the tail variants may be budget-skipped (recorded as
 # such, artifact intact). BENCH_TOTAL_BUDGET overrides.
-_N_VARIANTS = 30  # asserted against the variant tables below
+_N_VARIANTS = 31  # asserted against the variant tables below
 _TOTAL_BUDGET_S = int(
     os.environ.get(
         "BENCH_TOTAL_BUDGET",
@@ -350,6 +354,17 @@ _VARIANTS_TPU = {
     # prefix build, statistics byte-identical to solo), idempotent
     # re-submit replay, many-client chaos soak with submits/sec
     "plan_service": (2000, 4),
+    # the replicated gateway fleet (tools/pipeline_bench.py
+    # gateway_fleet): 3 real replica processes over one shared
+    # journal, SIGKILL the in-flight holder, takeover sha pinned
+    # byte-identical to an uninterrupted twin, zero-double-execution
+    # audit, SIGTERM drain of the survivors (all CPU-forced children
+    # — the line measures failover, not chip throughput). Small
+    # session on purpose: per-SGD-iteration cost scales with the
+    # session, and the heavy plan's kill window is sized in
+    # iterations — a big session turns the twin + takeover re-run
+    # into minutes without sharpening any failover pin
+    "gateway_fleet": (400, 2),
 }
 _VARIANTS_CPU = {
     "einsum": (8192, 5),
@@ -382,6 +397,7 @@ _VARIANTS_CPU = {
     "serve_multitenant": (400, 2),
     "scheduler_multi": (2000, 4),
     "plan_service": (2000, 4),
+    "gateway_fleet": (400, 2),
 }
 assert len(_VARIANTS_TPU) == len(_VARIANTS_CPU) == _N_VARIANTS
 
@@ -527,7 +543,7 @@ def _run_variant(variant: str, platform: str, n: int, iters: int) -> dict:
     # is a kernel variant through tools/ingest_bench.py
     if variant.startswith(
         ("pipeline_e2e", "population_", "seizure_", "scheduler_",
-         "plan_service")
+         "plan_service", "gateway_")
     ):
         script = "pipeline_bench.py"
     elif variant.startswith("serve_"):
@@ -739,6 +755,10 @@ def _collect(platform: str) -> dict:
                 # pair, the idempotent-resubmit replay, and the
                 # many-client soak (submits/sec, hit ratio, isolation)
                 "plan_service",
+                # the replicated fleet line: takeover attribution +
+                # sha parity vs the uninterrupted twin, the journal
+                # exactly-once audit, and the survivors' drain codes
+                "fleet",
             ):
                 if extra_field in r:
                     variants[name][extra_field] = r[extra_field]

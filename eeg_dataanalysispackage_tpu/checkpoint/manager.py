@@ -39,7 +39,7 @@ from flax import serialization
 _STEP_RE = re.compile(r"^step_(\d{8})$")
 
 
-def _fsync_directory(directory: str) -> None:
+def _fsync_directory(directory: str) -> bool:
     """fsync a directory fd, making a just-completed rename durable.
 
     Without it the data blocks are safe (the file fd was fsynced) but
@@ -47,18 +47,22 @@ def _fsync_directory(directory: str) -> None:
     power loss right after a "successful" atomic write could replay as
     a zero-length (or missing) artifact. Best-effort — some platforms
     and filesystems refuse O_RDONLY directory fds; those callers keep
-    the old (weaker) guarantee rather than failing the write.
+    the old (weaker) guarantee rather than failing the write. Returns
+    False on refusal so durability-critical callers (the plan journal,
+    whose lost terminal record a fleet peer would re-run) can count
+    the degraded guarantee.
     """
     try:
         fd = os.open(directory, os.O_RDONLY)
     except OSError:
-        return
+        return False
     try:
         os.fsync(fd)
     except OSError:
-        pass
+        return False
     finally:
         os.close(fd)
+    return True
 
 
 def atomic_write_bytes(path: str, data: bytes) -> None:

@@ -24,9 +24,21 @@ instead of double-running. Cross-tenant plan-prefix dedup
 (scheduler/dedup.py) runs underneath, so tenants whose plans share an
 ingest+featurize prefix compute it once.
 
+``gateway/fleet.py`` replicates the front door (ROADMAP item 4): N
+:class:`FleetReplica` processes over ONE shared journal directory,
+lease-claiming plans (scheduler/lease.py) so any replica accepts, any
+replica finishes, and a SIGKILLed replica's in-flight plans complete
+on a surviving peer under their original ids with byte-identical
+statistics. ``/readyz`` is the fleet's routability check (writable
+journal + accepting executor, vs ``/healthz``'s pure liveness), and
+SIGTERM drains gracefully — queued leases released for immediate peer
+takeover, in-flight plans finished.
+
 ``python -m eeg_dataanalysispackage_tpu.gateway`` serves from the
-command line (``--port`` / ``EEG_TPU_GATEWAY_PORT``); see README
-"Plan service" for curl examples.
+command line (``--port`` / ``EEG_TPU_GATEWAY_PORT``; ``--fleet
+--replica-id`` for a fleet member); see README "Plan service" for
+curl examples.
 """
 
+from .fleet import FleetReplica  # noqa: F401
 from .server import GatewayServer  # noqa: F401

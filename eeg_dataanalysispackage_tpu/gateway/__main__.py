@@ -1,25 +1,38 @@
 """``python -m eeg_dataanalysispackage_tpu.gateway`` — serve the plan
 service from the command line.
 
-Example::
+Example (one replica of a three-replica fleet)::
 
     python -m eeg_dataanalysispackage_tpu.gateway \\
         --port 8321 --journal-dir /var/lib/eeg-tpu/journal \\
-        --report-root /var/lib/eeg-tpu/reports --max-concurrent 4
+        --report-root /var/lib/eeg-tpu/reports --max-concurrent 4 \\
+        --fleet --replica-id gw-a
 
 The journal directory makes the server crash-only: kill it mid-plan,
 restart with the same ``--journal-dir``, and recovery resumes every
 unfinished plan under its original id (idempotency-keyed clients
-rejoin them transparently). ``EEG_TPU_GATEWAY_PORT`` sets the default
-port; ``--port 0`` binds an ephemeral one (printed at startup).
+rejoin them transparently). ``--fleet`` promotes that to fleet scope:
+N processes over ONE ``--journal-dir`` lease-claim plans from the
+shared journal (gateway/fleet.py), so any replica accepts, any replica
+finishes, and a killed replica's in-flight plans complete on a peer.
+
+Signals: **SIGTERM drains gracefully** — stop accepting (503 +
+/readyz not-ready), release still-queued leases so peers take over
+immediately, finish in-flight plans, exit 0. SIGKILL is the crash
+path the journal + lease timeout already cover.
+
+``EEG_TPU_GATEWAY_PORT`` sets the default port; ``--port 0`` binds an
+ephemeral one (printed at startup).
 """
 
 import argparse
 import logging
 import os
+import signal
 import sys
-import time
+import threading
 
+from .fleet import FleetReplica
 from .server import ENV_PORT, GatewayServer
 
 
@@ -40,7 +53,8 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--journal-dir", default=None,
         help="write-ahead journal directory (enables crash recovery "
-        "and idempotent re-submits across restarts)",
+        "and idempotent re-submits across restarts; shared by every "
+        "replica of a --fleet)",
     )
     parser.add_argument(
         "--report-root", default=None,
@@ -53,12 +67,32 @@ def main(argv=None) -> int:
         "--no-recover", action="store_true",
         help="skip journal recovery at startup (diagnostics only)",
     )
+    parser.add_argument(
+        "--fleet", action="store_true",
+        help="run as a replica of a shared-journal fleet: lease-claim "
+        "plans from --journal-dir (requires it), take over dead "
+        "peers' unfinished records, heartbeat held leases "
+        "(gateway/fleet.py)",
+    )
+    parser.add_argument(
+        "--replica-id", default=None,
+        help="this replica's fleet identity (default gw-<pid>); "
+        "written into lease files and run reports",
+    )
+    parser.add_argument(
+        "--drain-timeout-s", type=float, default=60.0,
+        help="max seconds a SIGTERM drain waits for in-flight plans "
+        "before abandoning them to peer takeover (default 60)",
+    )
     args = parser.parse_args(argv)
 
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
+    if args.fleet and not args.journal_dir:
+        parser.error("--fleet requires --journal-dir (the shared "
+                     "journal directory IS the fleet)")
     server = GatewayServer(
         host=args.host,
         port=args.port,
@@ -68,8 +102,13 @@ def main(argv=None) -> int:
         queue_depth=args.queue_depth,
         max_attempts=args.max_attempts,
         recover=not args.no_recover,
+        replica_id=args.replica_id,
     )
-    host, port = server.start()
+    replica = FleetReplica(server=server) if args.fleet else None
+    if replica is not None:
+        host, port = replica.start()
+    else:
+        host, port = server.start()
     if server.recovery is not None:
         print(
             f"recovered journal: "
@@ -77,13 +116,48 @@ def main(argv=None) -> int:
             f"{len(server.recovery['resumed'])} unfinished resumed",
             file=sys.stderr,
         )
-    print(f"plan service listening on http://{host}:{port}")
+    print(
+        f"plan service listening on http://{host}:{port}"
+        + (f" (fleet replica {server.replica_id})" if replica else ""),
+        flush=True,
+    )
+
+    # graceful SIGTERM drain: stop accepting, hand queued leases back
+    # to the fleet, finish in-flight plans, exit 0. The event dance
+    # (instead of draining inside the handler) keeps the drain's
+    # journal/lease I/O out of signal context.
+    stop = threading.Event()
+
+    def _on_sigterm(signum, frame):
+        server.draining = True  # refuse new work instantly
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
     try:
-        while True:
-            time.sleep(3600)
+        stop.wait()
     except KeyboardInterrupt:
         print("shutting down", file=sys.stderr)
+        if replica is not None:
+            replica.close()
+        else:
+            server.close()
+        return 0
+    if replica is not None:
+        outcome = replica.drain(timeout_s=args.drain_timeout_s)
+    else:
+        server.draining = True
+        drained = (
+            server.executor.drain_queued()
+            if server.executor.journal is not None else []
+        )
+        outcome = {"released": drained, "finished": [], "abandoned": []}
         server.close()
+    print(
+        f"drained: {len(outcome['released'])} released to peers, "
+        f"{len(outcome['finished'])} finished in-flight, "
+        f"{len(outcome['abandoned'])} abandoned",
+        file=sys.stderr,
+    )
     return 0
 
 

@@ -1,0 +1,313 @@
+"""The replicated gateway fleet: N front doors over ONE shared journal.
+
+ROADMAP item 4. A single :class:`~eeg_dataanalysispackage_tpu.gateway.server.GatewayServer`
+is both the throughput ceiling and the single point of failure of the
+whole plan service. This module removes both without inventing any new
+durability machinery: the write-ahead journal (one atomic file per
+plan) is already the source of truth, recovery and idempotent replay
+already exist per process — what a fleet needs on top is exactly one
+primitive, *who executes this record*, and that is
+``scheduler/lease.py``'s ``plan-<id>.lease`` file (the feature cache's
+cross-process ``O_EXCL`` single-flight, hardened with heartbeats and
+the break-only-the-provably-dead rule).
+
+One :class:`FleetReplica` wraps one gateway over the shared
+``journal_dir``:
+
+- **accept anywhere** — a submission to any replica lease-claims its
+  plan *before* the write-ahead record lands (scheduler/executor.py),
+  so peers scanning the journal never see an unleased record for work
+  a live replica owns;
+- **finish anywhere** — the scan loop polls ``PlanJournal.unfinished()``
+  for submitted-but-unleased (or stale-leased) records and claims them
+  through :meth:`PlanExecutor.claim_and_run`: the journaled query
+  re-parses, idempotency keys and report dirs ride the record's meta,
+  and the completion record lands under the ORIGINAL plan id — a
+  SIGKILLed replica's in-flight plans complete on a surviving peer
+  with byte-identical statistics (the deterministic pipeline is what
+  makes takeover invisible to the caller);
+- **leave gracefully** — :meth:`drain` (the SIGTERM path in
+  ``gateway/__main__.py``) flips the replica to 503/not-ready,
+  releases every still-queued plan's lease so peers take over
+  immediately, finishes what is already running, then exits.
+
+The scan loop doubles as fleet-scope recovery: a replica starting over
+a journal with unfinished records claims and resumes them exactly as
+it claims a dead peer's — so :class:`FleetReplica` runs its gateway
+with ``recover=False`` and there is ONE takeover code path, not two.
+
+Split-brain non-goals (docs/architecture.md): replicas share one
+journal *directory* (one filesystem), and holder-death is checked by
+pid — this is a same-host/shared-mount fleet, not a consensus
+protocol. A partitioned filesystem is outside the contract.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..scheduler import lease as lease_mod
+from .server import GatewayServer
+
+logger = logging.getLogger(__name__)
+
+#: how often a replica scans the shared journal for claimable records
+ENV_SCAN_INTERVAL = "EEG_TPU_FLEET_SCAN_INTERVAL_S"
+_DEFAULT_SCAN_INTERVAL_S = 0.25
+
+
+def scan_interval() -> float:
+    value = os.environ.get(ENV_SCAN_INTERVAL)
+    if not value:
+        return _DEFAULT_SCAN_INTERVAL_S
+    try:
+        return float(value)
+    except ValueError:
+        logger.warning(
+            "unparseable %s=%r; using the default %.2fs",
+            ENV_SCAN_INTERVAL, value, _DEFAULT_SCAN_INTERVAL_S,
+        )
+        return _DEFAULT_SCAN_INTERVAL_S
+
+
+class FleetReplica:
+    """One gateway replica participating in a shared-journal fleet.
+
+    Wraps (and owns the fleet lifecycle of) a :class:`GatewayServer`
+    whose executor has a ``journal_dir`` — pass an existing server, or
+    let the replica build one from the keyword knobs. ``start()``
+    attaches the lease directory, starts the HTTP front door WITHOUT
+    the single-process ``recover()`` (the scan loop IS recovery at
+    fleet scope), and spawns the scan + heartbeat threads.
+    """
+
+    def __init__(
+        self,
+        server: Optional[GatewayServer] = None,
+        replica_id: Optional[str] = None,
+        scan_interval_s: Optional[float] = None,
+        heartbeat_interval_s: Optional[float] = None,
+        **gateway_kwargs: Any,
+    ):
+        if server is None:
+            gateway_kwargs.setdefault("recover", False)
+            server = GatewayServer(
+                replica_id=replica_id, **gateway_kwargs
+            )
+        self.server = server
+        self.executor = server.executor
+        if self.executor.journal is None:
+            raise ValueError(
+                "a fleet replica needs a journal_dir — the shared "
+                "journal directory IS the fleet"
+            )
+        if replica_id:
+            server.replica_id = replica_id
+        self.replica_id = server.replica_id
+        # fleet-scope recovery is the scan loop (one takeover path);
+        # the single-process recover() would race peers for unleased
+        # records without the lease claim
+        server._recover = False
+        self.leases = lease_mod.LeaseDir(
+            self.executor.journal.directory, holder=self.replica_id
+        )
+        self.executor.leases = self.leases
+        self._scan_interval_s = (
+            scan_interval_s if scan_interval_s is not None
+            else scan_interval()
+        )
+        self._heartbeat_interval_s = (
+            heartbeat_interval_s if heartbeat_interval_s is not None
+            else min(2.0, max(0.05, lease_mod.lease_timeout() / 4.0))
+        )
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._started = False
+        #: plan ids this replica claimed from the scan loop (takeovers
+        #: + fleet-scope recovery), for the operator surface
+        self.claimed: List[str] = []
+        self._claimed_lock = threading.Lock()
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self):
+        """Start the gateway and the fleet threads; returns
+        (host, port)."""
+        if self._started:
+            return self.server.host, self.server.port
+        self._started = True
+        addr = self.server.start()
+        for name, target in (
+            ("scan", self._scan_loop),
+            ("heartbeat", self._heartbeat_loop),
+        ):
+            t = threading.Thread(
+                target=target,
+                name=f"eeg-tpu-fleet-{name}-{self.replica_id}",
+                daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+        logger.info(
+            "fleet replica %s serving on %s:%d over journal %s",
+            self.replica_id, addr[0], addr[1],
+            self.executor.journal.directory,
+        )
+        return addr
+
+    def close(self, join_timeout_s: float = 10.0) -> None:
+        """Hard stop (the crash-adjacent path): stop the fleet
+        threads, close the gateway, release our leases. Queued
+        journaled plans stay 'submitted' — peers take over."""
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=join_timeout_s)
+        self._threads = []
+        self.server.close(join_timeout_s=join_timeout_s)
+        self.leases.release_all()
+
+    def drain(
+        self, timeout_s: float = 60.0, poll_s: float = 0.05,
+    ) -> Dict[str, Any]:
+        """Graceful SIGTERM drain: stop accepting (503 + not-ready),
+        release every still-queued plan's lease so peers take over
+        immediately, finish what is already running, then stop.
+        Returns {released, finished, abandoned} plan-id lists —
+        ``abandoned`` is nonempty only when ``timeout_s`` expired with
+        plans still running (their journal records stay 'submitted';
+        a peer breaks our stale lease once we exit)."""
+        from .. import obs
+
+        self.server.draining = True
+        obs.metrics.count("fleet.drains")
+        # claimable the instant the lease vanishes — no timeout wait
+        released = self.executor.drain_queued()
+        # snapshot what is still ours to finish NOW: a completed plan's
+        # ticket is evicted once its journal record lands, so a later
+        # live_ids() delta would under-report — status() falls back to
+        # the journal and keeps reading the terminal state
+        tracked = list(self.executor.live_ids())
+        deadline = time.monotonic() + timeout_s
+        finished: List[str] = []
+        while True:
+            states = {
+                plan_id: (
+                    self.executor.status(plan_id) or {}
+                ).get("state")
+                for plan_id in tracked
+            }
+            running = [
+                plan_id for plan_id, state in states.items()
+                if state in ("queued", "running")
+            ]
+            finished = sorted(
+                plan_id for plan_id, state in states.items()
+                if state in ("completed", "failed", "cancelled")
+            )
+            if not running:
+                break
+            if time.monotonic() >= deadline:
+                logger.warning(
+                    "drain timeout with %d plans still running: %s "
+                    "(their journal records stay 'submitted')",
+                    len(running), running,
+                )
+                self.close()
+                return {
+                    "released": released,
+                    "finished": finished,
+                    "abandoned": running,
+                }
+            time.sleep(poll_s)
+        self.close()
+        return {
+            "released": released, "finished": finished, "abandoned": [],
+        }
+
+    def __enter__(self) -> "FleetReplica":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- the scan loop (takeover + fleet-scope recovery) -----------------
+
+    def scan_once(self) -> List[str]:
+        """One pass over the shared journal: claim every unfinished
+        record nobody (live) holds. Returns the plan ids claimed this
+        pass. Public for tests and for the admin tooling — the loop
+        just calls it on an interval."""
+        claimed: List[str] = []
+        for entry in self.executor.journal.unfinished():
+            if self._stop.is_set() or self.server.draining:
+                break
+            plan_id = entry.get("plan_id")
+            if not plan_id:
+                continue
+            try:
+                handle = self.executor.claim_and_run(entry)
+            except Exception as e:
+                # one bad record (or a transient claim error) must not
+                # wedge the scan — the whole fleet runs this loop
+                logger.error(
+                    "fleet claim of %s failed (%s: %s); will rescan",
+                    plan_id, type(e).__name__, e,
+                )
+                continue
+            if handle is not None:
+                claimed.append(plan_id)
+                logger.info(
+                    "replica %s claimed %s (takeover)",
+                    self.replica_id, plan_id,
+                )
+        if claimed:
+            with self._claimed_lock:
+                self.claimed.extend(claimed)
+        return claimed
+
+    def _scan_loop(self) -> None:
+        while not self._stop.wait(self._scan_interval_s):
+            if self.server.draining:
+                continue
+            try:
+                self.scan_once()
+            except Exception as e:  # pragma: no cover - defensive
+                logger.error(
+                    "fleet scan pass failed (%s: %s); continuing",
+                    type(e).__name__, e,
+                )
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self._heartbeat_interval_s):
+            try:
+                self.leases.heartbeat_all()
+            except Exception as e:  # pragma: no cover - defensive
+                logger.error(
+                    "fleet heartbeat pass failed (%s: %s); continuing",
+                    type(e).__name__, e,
+                )
+
+    # -- the operator surface --------------------------------------------
+
+    def fleet_view(self) -> Dict[str, Any]:
+        """The replica's own fleet snapshot (plan_admin's ``fleet``
+        subcommand renders the same shape straight off the shared
+        directory for out-of-process observers)."""
+        with self._claimed_lock:
+            claimed = list(self.claimed)
+        return {
+            "replica": self.replica_id,
+            "draining": self.server.draining,
+            "journal_dir": self.executor.journal.directory,
+            "held": [
+                lease.plan_id for lease in self.leases.held_leases()
+            ],
+            "claimed": claimed,
+            "leases": self.leases.scan(),
+            "counters": lease_mod.stats(),
+        }

@@ -53,8 +53,17 @@ GET    /stats                     200 {dedup, queue_depth, scheduler
                                   counters}; with a ``predict_service``
                                   attached also ``serve`` — the full serve
                                   block including the per-tenant attribution
-                                  sub-block (serve/multiplex.py)
-GET    /healthz                   200 {ok: true, ...}
+                                  sub-block (serve/multiplex.py); in a
+                                  replica fleet also ``fleet`` — replica id
+                                  + lease claim/takeover/break counters
+GET    /healthz                   200 {ok: true, ...} — pure LIVENESS: the
+                                  process answers; never checks disk
+GET    /readyz                    READINESS: 200 {ready: true} only when
+                                  the journal dir is writable, the executor
+                                  is accepting, and the replica is not
+                                  draining; 503 {ready: false, reasons}
+                                  otherwise — what a fleet's load balancer
+                                  (and the fleet bench) waits on
 ====== ========================== ===========================================
 
 Headers on POST /plans: ``X-Idempotency-Key`` (client retry token,
@@ -77,6 +86,7 @@ from ..scheduler import dedup as dedup_mod
 from ..scheduler.executor import (
     IdempotencyConflictError,
     PlanExecutor,
+    PlanOwnedElsewhereError,
     PlanShedError,
 )
 from ..serve.batcher import (
@@ -119,10 +129,19 @@ class GatewayServer:
         max_attempts: int = 3,
         recover: bool = True,
         predict_service=None,
+        replica_id: Optional[str] = None,
     ):
         if port is None:
             port = int(os.environ.get(ENV_PORT, "0") or 0)
         self.host = host
+        #: this front door's identity in a replica fleet (lease files
+        #: carry it; run reports echo it). Defaults to a pid-derived
+        #: id so even a solo gateway is addressable.
+        self.replica_id = replica_id or f"gw-{os.getpid()}"
+        #: True while a graceful SIGTERM drain is in progress: new
+        #: submissions answer 503, /readyz reports not-ready, and
+        #: in-flight plans run to completion (gateway/fleet.py)
+        self.draining = False
         self._requested_port = int(port)
         self._owns_executor = executor is None
         self.executor = executor or PlanExecutor(
@@ -232,6 +251,13 @@ class GatewayServer:
     ) -> Tuple[int, Dict[str, Any]]:
         from ..pipeline.builder import decode_percent_query
 
+        if self.draining:
+            return 503, {
+                "error": f"replica {self.replica_id} is draining; "
+                f"submit to a peer",
+                "draining": True,
+                "replica": self.replica_id,
+            }
         try:
             query = decode_percent_query(raw_body.strip())
         except ValueError as e:
@@ -263,6 +289,19 @@ class GatewayServer:
             # key reused with a different body: neither replaying the
             # old outcome nor running the new body would be honest
             return 409, {"error": str(e), "idempotency_conflict": True}
+        except PlanOwnedElsewhereError as e:
+            # a keyed re-submit of a plan a live fleet peer is
+            # executing: the original plan id IS the answer (the
+            # exactly-once contract at fleet scope) — with the 307-
+            # style owner hint so the client can follow the plan there
+            status = self.executor.status(e.plan_id) or {}
+            return 200, {
+                "plan_id": e.plan_id,
+                "state": status.get("state", "submitted"),
+                "idempotent_replay": True,
+                "owner": e.holder,
+                "replica": self.replica_id,
+            }
         except ValueError as e:
             # PlanValidationError included: the query is the bug
             return 400, {"error": str(e)}
@@ -395,10 +434,28 @@ class GatewayServer:
                 )
         return 200, payload
 
+    def _lease_owner(self, plan_id: str) -> Optional[str]:
+        """The lease-holding replica's id when it is NOT this one —
+        the peer-ownership hint for status/list payloads."""
+        leases = self.executor.leases
+        if leases is None:
+            return None
+        info = leases.holder_info(plan_id)
+        if info is None or info["holder"] == self.replica_id:
+            return None
+        return info["holder"]
+
     def status_payload(self, plan_id: str) -> Tuple[int, Dict[str, Any]]:
         status = self.executor.status(plan_id)
         if status is None:
             return 404, {"error": f"unknown plan {plan_id}"}
+        owner = self._lease_owner(plan_id)
+        if owner is not None:
+            # 307-style hint: any replica answers from the shared
+            # journal, but THIS plan's live state machine (running /
+            # attempt history) is on the lease holder
+            status = dict(status)
+            status["owner"] = owner
         return 200, status
 
     def report_payload(self, plan_id: str) -> Tuple[int, Dict[str, Any]]:
@@ -502,6 +559,14 @@ class GatewayServer:
                     k: status.get(k)
                     for k in ("plan_id", "state", "attempts", "query")
                 }
+        # peer-aware: a 'submitted' record another replica lease-holds
+        # is IN FLIGHT over there, not waiting — say so (and name the
+        # holder) instead of letting the journal snapshot read as idle
+        if self.executor.leases is not None:
+            for plan_id, row in plans.items():
+                owner = self._lease_owner(plan_id)
+                if owner is not None:
+                    row["owner"] = owner
         return 200, {"plans": [plans[k] for k in sorted(plans)]}
 
     def stats_payload(self) -> Tuple[int, Dict[str, Any]]:
@@ -519,14 +584,70 @@ class GatewayServer:
             # (serve/multiplex.py stats_block; tools/plan_admin.py
             # --tenant filters it client-side)
             payload["serve"] = self.predict_service.stats_block()
+        if self.executor.leases is not None:
+            from ..scheduler import lease as lease_mod
+
+            payload["fleet"] = {
+                "replica": self.replica_id,
+                "draining": self.draining,
+                "held_leases": len(self.executor.leases.held_leases()),
+                **lease_mod.stats(),
+            }
         return 200, payload
 
     def health_payload(self) -> Tuple[int, Dict[str, Any]]:
+        """LIVENESS only — the process answers. Deliberately touches
+        no disk: a replica with a read-only journal is alive (don't
+        restart it into a crash loop) but not READY (don't route plans
+        at it) — that split is exactly why /readyz exists."""
         return 200, {
             "ok": True,
+            "replica": self.replica_id,
             "queued": len(self.executor.queue),
             "journal": self.executor.journal is not None,
         }
+
+    def ready_payload(self) -> Tuple[int, Dict[str, Any]]:
+        """READINESS: may this replica be routed new plans? Checks
+        what accepting a plan actually needs — a writable journal
+        directory (the write-ahead record and the lease claim both
+        land there; accept-and-degrade on a read-only journal would
+        silently trade away the crash-only contract) and an executor
+        that is started, not closed, and not draining."""
+        reasons = []
+        journal = self.executor.journal
+        if journal is not None:
+            probe = os.path.join(
+                journal.directory,
+                f".readyz-{self.replica_id}-{os.getpid()}",
+            )
+            try:
+                fd = os.open(
+                    probe, os.O_CREAT | os.O_EXCL | os.O_WRONLY
+                )
+                os.close(fd)
+                os.unlink(probe)
+            except OSError as e:
+                reasons.append(
+                    f"journal dir {journal.directory} is not "
+                    f"writable ({type(e).__name__}: {e})"
+                )
+        if self.draining:
+            reasons.append("draining (SIGTERM received)")
+        if self.executor._stop.is_set():
+            reasons.append("executor is closed")
+        elif not self.executor._started:
+            reasons.append("executor workers not started")
+        payload = {
+            "ready": not reasons,
+            "replica": self.replica_id,
+            "queued": len(self.executor.queue),
+            "capacity": self.executor.max_concurrent,
+        }
+        if reasons:
+            payload["reasons"] = reasons
+            return 503, payload
+        return 200, payload
 
 
 class _GatewayHandler(BaseHTTPRequestHandler):
@@ -590,6 +711,9 @@ class _GatewayHandler(BaseHTTPRequestHandler):
         path = self.path.split("?", 1)[0]
         if path == "/healthz":
             self._send(*self.gateway.health_payload())
+            return
+        if path == "/readyz":
+            self._send(*self.gateway.ready_payload())
             return
         if path == "/stats":
             self._send(*self.gateway.stats_payload())

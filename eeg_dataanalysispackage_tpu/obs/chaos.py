@@ -66,6 +66,15 @@ Known points (the contract between specs and the codebase):
                     (scheduler/journal.py) — the journal retries once,
                     then degrades to unjournaled (counted) rather than
                     failing the plan it records
+``fleet.lease``     one lease-claim attempt (scheduler/lease.py) —
+                    injected as ``OSError`` so it lands in the claim's
+                    own degraded path: a failed claim is simply not a
+                    claim (counted ``fleet.lease_claim_failures``);
+                    the fleet scan loop retries next round
+``fleet.heartbeat`` one lease heartbeat touch (scheduler/lease.py) —
+                    injected as ``OSError``: the beat is skipped
+                    (counted), the lease ages toward breakability —
+                    exactly what a wedged holder would look like
 ==================  ====================================================
 
 Fault domains: a plan executed by the multi-tenant scheduler carries

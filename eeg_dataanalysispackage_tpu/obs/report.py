@@ -279,6 +279,13 @@ class RunTelemetry:
         #: "idempotency_key", "client"} when the plan arrived through
         #: the HTTP front door; None for in-process submissions
         self.gateway: Optional[Dict[str, Any]] = None
+        #: replica-fleet attribution (gateway/fleet.py +
+        #: scheduler/lease.py): {"replica": the executing replica's
+        #: id, "takeover": True when a peer's lease-claimed journal
+        #: record was re-run here} — which front door actually
+        #: executed the plan lives HERE, never only in a log line;
+        #: None outside a replica fleet (the default, schema-stable)
+        self.fleet: Optional[Dict[str, Any]] = None
 
     @property
     def report_path(self) -> str:
@@ -332,6 +339,7 @@ class RunTelemetry:
             "mesh": self.mesh,
             "dedup": self.dedup,
             "gateway": self.gateway,
+            "fleet": self.fleet,
             "degradation": list(self.degradation),
             "stages": timers.as_dict() if timers is not None else {},
             "metrics": metrics.snapshot() if metrics is not None else {},

@@ -14,7 +14,12 @@
 - :mod:`scheduler.dedup`    — cross-tenant plan-prefix dedup: two
   tenants whose plans share a canonical ingest+featurize prefix
   (``ExecutionPlan.prefix_key``) compute it once, with per-plan
-  leader/follower attribution.
+  leader/follower attribution;
+- :mod:`scheduler.lease`    — the fleet's cross-process plan-claiming
+  primitive: ``plan-<id>.lease`` files beside the journal records
+  (O_EXCL claim, heartbeat mtime, break-only-the-provably-dead), so
+  N gateway replicas over ONE journal directory execute each plan
+  exactly once (gateway/fleet.py).
 
 The HTTP front door over all of this lives in ``gateway/``.
 
@@ -29,8 +34,10 @@ from .executor import (  # noqa: F401
     PlanExecutor,
     PlanFailedError,
     PlanHandle,
+    PlanOwnedElsewhereError,
     PlanResult,
     PlanShedError,
 )
 from .journal import PlanJournal  # noqa: F401
+from .lease import LeaseDir, PlanLease  # noqa: F401
 from .runtime import execute_plan  # noqa: F401

@@ -57,6 +57,7 @@ from ..serve.batcher import (
     ShedError,
 )
 from . import journal as journal_mod
+from . import lease as lease_mod
 from . import runtime
 
 logger = logging.getLogger(__name__)
@@ -82,6 +83,18 @@ class PlanFailedError(RuntimeError):
 class PlanCancelledError(RuntimeError):
     """The plan was cancelled by its client while still queued (the
     gateway's DELETE); it never executed."""
+
+
+class PlanOwnedElsewhereError(RuntimeError):
+    """A lease-holding fleet peer owns this plan's execution: this
+    executor must not run it (doing so would double-execute). The
+    holder id rides along so the gateway can answer with the owner
+    hint instead of an error."""
+
+    def __init__(self, message: str, plan_id: str, holder: Optional[str]):
+        super().__init__(message)
+        self.plan_id = plan_id
+        self.holder = holder
 
 
 class IdempotencyConflictError(ValueError):
@@ -132,10 +145,11 @@ class _PlanTicket:
     __slots__ = ("plan", "plan_id", "deadline", "future",
                  "submitted_at", "attempts", "history", "fault_plan",
                  "report_dir", "recovered", "state",
-                 "idempotency_key", "gateway")
+                 "idempotency_key", "gateway", "fleet")
 
     def __init__(self, plan, plan_id, deadline, fault_plan, report_dir,
-                 recovered=False, idempotency_key=None, gateway=None):
+                 recovered=False, idempotency_key=None, gateway=None,
+                 fleet=None):
         self.plan = plan
         self.plan_id = plan_id
         self.deadline: Optional[deadline_mod.Deadline] = deadline
@@ -154,6 +168,9 @@ class _PlanTicket:
         #: networked-submission attribution (gateway/), echoed into
         #: the plan's run report; None for in-process submissions
         self.gateway = gateway
+        #: fleet attribution ({"replica", "takeover"}), echoed into
+        #: the plan's run report; None outside a replica fleet
+        self.fleet = fleet
 
     def batch_key(self):
         # plans never coalesce: every ticket is its own micro-batch
@@ -275,6 +292,14 @@ class PlanExecutor:
             else None
         )
         self._fs = filesystem
+        #: the fleet's lease directory (scheduler/lease.py LeaseDir),
+        #: attached by gateway/fleet.py BEFORE any submission. With it
+        #: set, every admitted plan is lease-claimed atomically with
+        #: its write-ahead record (a peer replica scanning the shared
+        #: journal can never see an unleased record for a plan a live
+        #: replica is executing) and released when the plan's terminal
+        #: record lands. None (the default) = no fleet, no leases.
+        self.leases: Optional[lease_mod.LeaseDir] = None
         self.report_root = report_root
         self.max_attempts = int(max_attempts)
         self.retry_backoff_s = float(retry_backoff_s)
@@ -362,6 +387,10 @@ class PlanExecutor:
             pending = self.queue.drain_pending()
         for ticket in pending:
             ticket.state = "failed"
+            # the journal record stays 'submitted'; releasing the
+            # lease is what lets a fleet peer claim it NOW instead of
+            # waiting out the stale-break timeout on a dead holder
+            self._release_lease(ticket.plan_id)
             ticket.future.fail(ServiceClosedError(
                 f"plan {ticket.plan_id} abandoned by executor close()"
                 + (
@@ -392,6 +421,7 @@ class PlanExecutor:
         _recovered: bool = False,
         idempotency_key: Optional[str] = None,
         gateway: Optional[Dict[str, Any]] = None,
+        fleet: Optional[Dict[str, Any]] = None,
     ) -> PlanHandle:
         """Validate, journal, and enqueue one plan; returns its
         handle. Sheds with :class:`PlanShedError` (evidence included)
@@ -411,7 +441,14 @@ class PlanExecutor:
 
         ``gateway`` is networked-submission attribution ({"via",
         "idempotency_key", "client"}), journaled and echoed into the
-        plan's run report."""
+        plan's run report. ``fleet`` is replica attribution
+        ({"replica", "takeover"}) — defaulted from the attached lease
+        directory when one exists.
+
+        With a lease directory attached (a fleet replica), admission
+        claims the plan's lease BEFORE the write-ahead record lands;
+        a plan whose lease a live peer holds raises
+        :class:`PlanOwnedElsewhereError` instead of double-executing."""
         from ..pipeline.plan import ExecutionPlan
 
         if self._stop.is_set():
@@ -468,6 +505,15 @@ class PlanExecutor:
                 # lock: two concurrent submits with one key resolve to
                 # exactly one execution
                 existing = self._idempotency.get(idempotency_key)
+                if existing is None and self.leases is not None:
+                    # fleet: peers journal keys after this replica
+                    # seeded its map, so the shared journal — not the
+                    # in-memory cache — is the authoritative key
+                    # index. Re-seed on miss (setdefault: live local
+                    # mappings always win) before minting a duplicate.
+                    for k, v in self._seed_idempotency().items():
+                        self._idempotency.setdefault(k, v)
+                    existing = self._idempotency.get(idempotency_key)
                 if existing is not None:
                     live = self._tickets.get(existing)
                     entry = (
@@ -517,11 +563,63 @@ class PlanExecutor:
                         _recovered = True
                     # else: the mapping points at a record a degraded
                     # journal lost — fall through as a fresh submit
-            if plan_id is None:
+            fresh = plan_id is None
+            if fresh:
                 # minted only once the idempotency checks are past: a
                 # replayed/rejoined submit consumes no id (ids in the
                 # journal stay gapless under replay-heavy clients)
                 plan_id = self._next_id()
+            if self.leases is not None:
+                # the lease is claimed BEFORE the write-ahead record:
+                # a fleet peer scanning the shared journal therefore
+                # never sees an unleased 'submitted' record for a plan
+                # a live replica owns — the window that would double-
+                # execute.
+                if fresh:
+                    # the lease is ALSO the fleet's cross-process id
+                    # allocator: every replica mints from its own
+                    # local counter, so two replicas over one journal
+                    # WILL collide — a foreign-held fresh id is simply
+                    # taken, mint the next. A claim that succeeds on
+                    # an id whose journal record already exists found
+                    # a peer's finished plan (terminal records hold no
+                    # lease): release and move on — overwriting it
+                    # would erase a served result. The peer's write
+                    # happened-before its release happened-before our
+                    # claim, so the under-lease record check is final.
+                    while True:
+                        claim = self.leases.try_claim(plan_id)
+                        if claim is lease_mod.FOREIGN_HELD:
+                            plan_id = self._next_id()
+                            continue
+                        if (
+                            claim is not None
+                            and self.journal is not None
+                            and self.journal.entry(plan_id) is not None
+                        ):
+                            self.leases.release(plan_id)
+                            plan_id = self._next_id()
+                            continue
+                        break
+                elif self.leases.held(plan_id) is None:
+                    claim = self.leases.try_claim(plan_id)
+                    if claim is lease_mod.FOREIGN_HELD:
+                        info = self.leases.holder_info(plan_id)
+                        holder = info["holder"] if info else None
+                        raise PlanOwnedElsewhereError(
+                            f"plan {plan_id} is lease-held by replica "
+                            f"{holder!r}; this replica will not "
+                            f"double-execute it",
+                            plan_id=plan_id, holder=holder,
+                        )
+                    # claim may be None (locking unavailable): proceed
+                    # leaseless — the journal dir is degraded anyway
+                    # and /readyz reports it
+                if fleet is None:
+                    fleet = {
+                        "replica": self.leases.holder,
+                        "takeover": False,
+                    }
             report_dir = (
                 None
                 if self.report_root is None
@@ -530,7 +628,7 @@ class PlanExecutor:
             ticket = _PlanTicket(
                 plan, plan_id, deadline, fault_plan, report_dir,
                 recovered=_recovered, idempotency_key=idempotency_key,
-                gateway=gateway,
+                gateway=gateway, fleet=fleet,
             )
             if self.journal is not None:
                 # journal writes belong to the plan's fault domain:
@@ -548,6 +646,7 @@ class PlanExecutor:
                             "recovered": _recovered,
                             "idempotency_key": idempotency_key,
                             "gateway": gateway,
+                            "fleet": fleet,
                         },
                     )
             if _recovered:
@@ -591,6 +690,7 @@ class PlanExecutor:
                         error=f"shed at admission: {evidence}",
                         attempts=0,
                     )
+            self._release_lease(plan_id)
             raise PlanShedError(
                 f"plan {plan_id} shed at admission: {evidence}",
                 plan_id=plan_id,
@@ -673,6 +773,7 @@ class PlanExecutor:
                 "query": ticket.plan.query,
                 "recovered": ticket.recovered,
                 "report_dir": ticket.report_dir,
+                "fleet": getattr(ticket, "fleet", None),
             }
         if self.journal is not None:
             entry = self.journal.entry(plan_id)
@@ -693,6 +794,7 @@ class PlanExecutor:
                     "error": entry.get("error"),
                     "statistics_sha256": entry.get("statistics_sha256"),
                     "report_dir": meta.get("report_dir"),
+                    "fleet": meta.get("fleet"),
                 }
         return None
 
@@ -733,6 +835,7 @@ class PlanExecutor:
                     attempts=0,
                     meta={"cancelled": True, "gateway": ticket.gateway},
                 )
+        self._release_lease(plan_id)
         ticket.future.fail(PlanCancelledError(
             f"plan {plan_id} cancelled while queued; never executed"
         ))
@@ -778,14 +881,20 @@ class PlanExecutor:
                 failed.append(entry)
             elif state == journal_mod.SUBMITTED:
                 meta = entry.get("meta") or {}
-                resumed.append(self.submit(
-                    entry["query"],
-                    deadline_s=meta.get("deadline_s"),
-                    plan_id=entry["plan_id"],
-                    _recovered=True,
-                    idempotency_key=meta.get("idempotency_key"),
-                    gateway=meta.get("gateway"),
-                ))
+                try:
+                    resumed.append(self.submit(
+                        entry["query"],
+                        deadline_s=meta.get("deadline_s"),
+                        plan_id=entry["plan_id"],
+                        _recovered=True,
+                        idempotency_key=meta.get("idempotency_key"),
+                        gateway=meta.get("gateway"),
+                    ))
+                except PlanOwnedElsewhereError:
+                    # a fleet peer lease-holds this record: recovery
+                    # on this replica must leave it to them (the scan
+                    # loop re-checks if their lease ever goes stale)
+                    continue
         # fresh ids already start past the dead process's (the
         # constructor seeds the counter from the journal)
         obs.metrics.count("scheduler.recovered_plans", len(resumed))
@@ -799,6 +908,113 @@ class PlanExecutor:
             "completed": completed,
             "failed": failed,
         }
+
+    # -- fleet takeover (gateway/fleet.py's scan loop) --------------------
+
+    def claim_and_run(
+        self,
+        entry: Dict[str, Any],
+        fleet: Optional[Dict[str, Any]] = None,
+        takeover: bool = True,
+    ) -> Optional[PlanHandle]:
+        """Lease-claim one unfinished journal record and re-admit it
+        under its ORIGINAL plan id — the fleet's takeover entry point.
+
+        Returns the handle when this executor won the claim; None when
+        it lost (a live peer holds the lease, the record is already
+        live here, or claiming is unavailable this round — the scan
+        loop simply retries later). Everything downstream composes
+        unchanged: the journaled query re-parses, idempotency keys and
+        report dirs ride the record's meta, ``_recovered=True``
+        re-admission never sheds, and the completion record lands
+        under the original id — so the taken-over plan's statistics
+        are byte-identical to an uninterrupted run (the PR 10
+        crash-only pin, at fleet scope)."""
+        if self.journal is None or self.leases is None:
+            raise ValueError(
+                "claim_and_run() needs a journal_dir and an attached "
+                "lease directory (gateway/fleet.py)"
+            )
+        plan_id = entry["plan_id"]
+        if plan_id in self._tickets:
+            return None
+        already_held = self.leases.held(plan_id) is not None
+        claim = self.leases.try_claim(plan_id, takeover=takeover)
+        if not isinstance(claim, lease_mod.PlanLease):
+            return None
+        # re-read UNDER the lease: between the caller's unfinished()
+        # scan and this claim, the holder may have finished the plan
+        # and released — re-admitting now would overwrite a terminal
+        # record with 'submitted' and re-run completed work. While we
+        # hold the lease no peer can write this plan's records, so
+        # this check is race-free.
+        current = self.journal.entry(plan_id)
+        if current is None or current.get("state") != journal_mod.SUBMITTED:
+            if not already_held:
+                self._release_lease(plan_id)
+            return None
+        meta = entry.get("meta") or {}
+        if fleet is None:
+            fleet = {
+                "replica": self.leases.holder,
+                "takeover": takeover,
+            }
+        try:
+            return self.submit(
+                entry["query"],
+                deadline_s=meta.get("deadline_s"),
+                plan_id=plan_id,
+                _recovered=True,
+                idempotency_key=meta.get("idempotency_key"),
+                gateway=meta.get("gateway"),
+                fleet=fleet,
+            )
+        except Exception:
+            # a claim this call took must not outlive its failure —
+            # a lease held for a plan nobody is running would stall
+            # every peer until the stale-break timeout
+            if not already_held:
+                self._release_lease(plan_id)
+            raise
+
+    def _release_lease(self, plan_id: str) -> None:
+        if self.leases is not None:
+            self.leases.release(plan_id)
+
+    def drain_queued(self) -> List[str]:
+        """Withdraw every still-queued plan WITHOUT a terminal record
+        — the hand-back half of a fleet replica's graceful SIGTERM
+        drain. Each withdrawn ticket's journal record stays
+        'submitted', its lease is released so a peer claims it
+        IMMEDIATELY (no stale-break timeout to wait out), and its
+        local handle fails with :class:`ServiceClosedError`. Running
+        plans are untouched — the drain finishes them. Returns the
+        released plan ids."""
+        with self._submit_lock:
+            queued = [
+                t for t in self._tickets.values()
+                if isinstance(t, _PlanTicket) and t.state == "queued"
+            ]
+        released: List[str] = []
+        for ticket in queued:
+            if not self.queue.remove(ticket):
+                # a worker popped it while we looked: it is running
+                # now, and the drain's wait loop will see it finish
+                continue
+            ticket.state = "failed"
+            with self._submit_lock:
+                self._tickets.pop(ticket.plan_id, None)
+            self._release_lease(ticket.plan_id)
+            obs.metrics.count("scheduler.drain_released")
+            events.event(
+                "scheduler.drain_released", plan=ticket.plan_id
+            )
+            ticket.future.fail(ServiceClosedError(
+                f"plan {ticket.plan_id} released for peer takeover "
+                f"during drain; its journal record stays 'submitted'"
+            ))
+            released.append(ticket.plan_id)
+        return released
 
     # -- the worker loop -------------------------------------------------
 
@@ -847,6 +1063,10 @@ class PlanExecutor:
             builder = PipelineBuilder(
                 ticket.plan.query, filesystem=self._fs
             )
+            # fleet attribution rides as a kwarg only when set: solo
+            # executors keep the pre-fleet call signature, which test
+            # doubles for execute_plan rely on
+            extra = {"fleet": ticket.fleet} if ticket.fleet else {}
             try:
                 with deadline_mod.deadline_scope(ticket.deadline):
                     statistics = runtime.execute_plan(
@@ -856,6 +1076,7 @@ class PlanExecutor:
                         fault_plan=ticket.fault_plan,
                         default_report_dir=ticket.report_dir,
                         gateway=ticket.gateway,
+                        **extra,
                     )
             except Exception as e:
                 ticket.attempts += 1
@@ -930,9 +1151,14 @@ class PlanExecutor:
                             "recovered": ticket.recovered,
                             "idempotency_key": ticket.idempotency_key,
                             "gateway": ticket.gateway,
+                            "fleet": ticket.fleet,
                             "report_dir": ticket.report_dir,
                         },
                     )
+            # terminal record landed (or degraded): either way this
+            # replica is done executing — the lease has served its
+            # purpose and holding it would only delay a peer's view
+            self._release_lease(ticket.plan_id)
             obs.metrics.count("scheduler.completed")
             events.event(
                 "scheduler.completed", plan=ticket.plan_id,
@@ -960,6 +1186,7 @@ class PlanExecutor:
 
     def _record_failed(self, ticket: _PlanTicket, error: str) -> None:
         ticket.state = "failed"
+        self._release_lease(ticket.plan_id)
         obs.metrics.count("scheduler.failed")
         if self.journal is not None:
             with run_domain.activate(run_domain.RunDomain(
@@ -971,6 +1198,7 @@ class PlanExecutor:
                     meta={
                         "idempotency_key": ticket.idempotency_key,
                         "gateway": ticket.gateway,
+                        "fleet": ticket.fleet,
                         "report_dir": ticket.report_dir,
                     },
                 )

@@ -78,7 +78,7 @@ class PlanJournal:
         the record landed. A journal failure degrades the guarantee,
         never the plan (see module docstring)."""
         from .. import obs
-        from ..checkpoint.manager import atomic_write_text
+        from ..checkpoint.manager import _fsync_directory, atomic_write_text
         from ..obs import chaos, events
 
         payload = {"schema": SCHEMA, **payload}
@@ -88,6 +88,18 @@ class PlanJournal:
             try:
                 chaos.maybe_fire("scheduler.journal")
                 atomic_write_text(self._path(plan_id), text)
+                # the rename itself must be on disk before a caller
+                # (or a fleet peer scanning this directory) may rely
+                # on the record: a host crash that replays the rename
+                # away would resurface a terminal plan as 'submitted'
+                # and a surviving replica would re-run it. Counted,
+                # never raised — platforms that refuse directory fds
+                # keep the page-cache guarantee (atomic_write_bytes's
+                # own best-effort fsync already tried once; this
+                # second, journal-owned call is what makes the refusal
+                # observable).
+                if not _fsync_directory(self.directory):
+                    obs.metrics.count("scheduler.journal_dir_fsync_failed")
                 return True
             except Exception as e:
                 last_error = e
@@ -169,12 +181,45 @@ class PlanJournal:
 
     # -- reads -----------------------------------------------------------
 
+    def _quarantine(self, path: str, error: Exception) -> None:
+        """Move an unparseable record aside as ``<name>.corrupt`` and
+        count it. A truncated/garbled record (a half-write by some
+        non-atomic foreign writer, a disk error) must never wedge a
+        scan — under a replica fleet EVERY replica runs the same scan
+        loop over the shared directory, so one bad file raising would
+        take the whole fleet's claim loop down at once. Quarantining
+        (not deleting) keeps the bytes for diagnosis, and renaming off
+        the ``.json`` suffix makes the next scan skip it for free."""
+        from .. import obs
+        from ..obs import events
+
+        obs.metrics.count("scheduler.journal_corrupt")
+        events.event(
+            "scheduler.journal_corrupt",
+            path=path,
+            error=f"{type(error).__name__}: {error}",
+        )
+        try:
+            os.replace(path, path + ".corrupt")
+            logger.error(
+                "quarantined corrupt journal record %s -> %s.corrupt "
+                "(%s: %s)", path, path, type(error).__name__, error,
+            )
+        except OSError as move_error:
+            logger.error(
+                "corrupt journal record %s (%s: %s) could not be "
+                "quarantined (%s); skipping it",
+                path, type(error).__name__, error, move_error,
+            )
+
     def entries(self) -> List[Dict[str, Any]]:
         """Every readable record, sorted by plan id (submission order
-        — executor ids are zero-padded counters). Unreadable files are
-        skipped with a warning: recovery must survive a journal a
-        crash half-wrote by some OTHER writer (atomic writes make this
-        impossible for our own)."""
+        — executor ids are zero-padded counters). An unparseable file
+        is quarantined to ``plan-<id>.json.corrupt`` and counted
+        (``scheduler.journal_corrupt``), never a crash: recovery and
+        the fleet scan loop must survive a journal a crash half-wrote
+        by some OTHER writer (atomic writes make this impossible for
+        our own)."""
         out = []
         try:
             # numeric-aware sort: executor ids are zero-padded to 4
@@ -197,7 +242,9 @@ class PlanJournal:
             try:
                 with open(path) as f:
                     out.append(json.load(f))
-            except (OSError, ValueError) as e:
+            except ValueError as e:
+                self._quarantine(path, e)
+            except OSError as e:
                 logger.warning(
                     "skipping unreadable journal record %s (%s: %s)",
                     path, type(e).__name__, e,
@@ -213,5 +260,8 @@ class PlanJournal:
         try:
             with open(self._path(plan_id)) as f:
                 return json.load(f)
-        except (OSError, ValueError):
+        except ValueError as e:
+            self._quarantine(self._path(plan_id), e)
+            return None
+        except OSError:
             return None

@@ -1,0 +1,370 @@
+"""Plan leases: the fleet's cross-process claiming primitive.
+
+N gateway replicas over ONE shared journal directory (gateway/fleet.py)
+need exactly one answer to "who executes plan p0007?" — the journal
+record itself cannot say, because any replica may scan it. The answer
+is a lease file beside the record::
+
+    <journal_dir>/plan-<id>.lease     "<holder-id>\\n<pid>\\n"
+
+taken with the same cross-process ``O_CREAT|O_EXCL`` single-flight the
+feature cache's :class:`~eeg_dataanalysispackage_tpu.io.feature_cache.BuildSlot`
+proved (PR 13): creation is the claim, the file's **content** names the
+holder, and its **mtime is the heartbeat** — the holding replica
+touches it periodically, so a fresh mtime means a live owner even when
+the observer cannot see the owner's pid.
+
+The two rules that make this safe where the cache's lock (which only
+ever saved redundant work) did not have to be:
+
+- **Break only the provably dead.** A stale lease is broken ONLY when
+  its heartbeat age exceeds ``EEG_TPU_LEASE_TIMEOUT_S`` *and* the
+  recorded holder pid no longer exists (``os.kill(pid, 0)`` →
+  ``ProcessLookupError``). A live-but-slow holder keeps its claim: a
+  double execution costs more than a late one (statistics stay
+  byte-identical either way — the pipeline is deterministic — but the
+  journal's exactly-once completion story should not depend on it).
+- **Unlink only your own lease** (the ``BuildSlot.release`` rule): a
+  holder that outlived the stale age may have had its lease broken and
+  re-taken by a peer whose id is now in the file — deleting that live
+  lease would invite a third executor.
+
+Chaos points: ``fleet.lease`` fires inside one claim attempt and
+``fleet.heartbeat`` inside one heartbeat touch (both injected as
+``OSError`` so they land in the code's own degraded paths: a failed
+claim is simply not a claim, a failed beat is a skipped beat — both
+counted, neither fatal).
+
+Process-wide counters (:func:`stats`) feed the bench's ``fleet`` block
+and ``obs.metrics`` (``fleet.*``); per-replica attribution lands in
+``run_report.json`` via the executor's ``fleet`` meta.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+#: seconds a lease's heartbeat may go un-touched before it is
+#: *eligible* for breaking (the holder must ALSO be provably dead)
+ENV_LEASE_TIMEOUT = "EEG_TPU_LEASE_TIMEOUT_S"
+_DEFAULT_LEASE_TIMEOUT_S = 30.0
+
+#: sentinel from :meth:`LeaseDir.try_claim`: a live foreign replica
+#: holds the plan — the caller must not execute it
+FOREIGN_HELD = object()
+
+_lock = threading.Lock()
+_claims = 0
+_takeovers = 0
+_breaks = 0
+_heartbeats = 0
+_heartbeat_failures = 0
+_claim_failures = 0
+
+
+def lease_timeout() -> float:
+    value = os.environ.get(ENV_LEASE_TIMEOUT)
+    if not value:
+        return _DEFAULT_LEASE_TIMEOUT_S
+    try:
+        return float(value)
+    except ValueError:
+        logger.warning(
+            "unparseable %s=%r; using the default %.0fs",
+            ENV_LEASE_TIMEOUT, value, _DEFAULT_LEASE_TIMEOUT_S,
+        )
+        return _DEFAULT_LEASE_TIMEOUT_S
+
+
+def stats() -> Dict[str, int]:
+    """Process-wide lease counters — the bench/e2e ``fleet`` payload
+    field (schema-stable zeros when no fleet ever ran)."""
+    with _lock:
+        return {
+            "claims": _claims,
+            "takeovers": _takeovers,
+            "breaks": _breaks,
+            "heartbeats": _heartbeats,
+            "heartbeat_failures": _heartbeat_failures,
+            "claim_failures": _claim_failures,
+        }
+
+
+def reset_stats() -> None:
+    """Zero the counters (test/bench isolation)."""
+    global _claims, _takeovers, _breaks
+    global _heartbeats, _heartbeat_failures, _claim_failures
+    with _lock:
+        _claims = _takeovers = _breaks = 0
+        _heartbeats = _heartbeat_failures = _claim_failures = 0
+
+
+def _count(name: str) -> None:
+    from .. import obs
+
+    global _claims, _takeovers, _breaks
+    global _heartbeats, _heartbeat_failures, _claim_failures
+    with _lock:
+        if name == "claims":
+            _claims += 1
+        elif name == "takeovers":
+            _takeovers += 1
+        elif name == "breaks":
+            _breaks += 1
+        elif name == "heartbeats":
+            _heartbeats += 1
+        elif name == "heartbeat_failures":
+            _heartbeat_failures += 1
+        elif name == "claim_failures":
+            _claim_failures += 1
+    obs.metrics.count(f"fleet.lease_{name}")
+
+
+def _pid_dead(pid: Optional[int]) -> bool:
+    """True only when the pid PROVABLY no longer exists. Unknown,
+    unparseable, or permission-denied pids read as alive: breaking a
+    lease on uncertainty is the one mistake this module must not
+    make."""
+    if pid is None:
+        return False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return True
+    except OSError:
+        return False
+    return False
+
+
+class PlanLease:
+    """One owned lease. Heartbeat from the holding replica's beat
+    thread; release exactly once when the plan reaches a terminal
+    journal record (or when a draining replica hands the plan back)."""
+
+    __slots__ = ("plan_id", "path", "holder", "acquired_at", "_released")
+
+    def __init__(self, plan_id: str, path: str, holder: str):
+        self.plan_id = plan_id
+        self.path = path
+        self.holder = holder
+        self.acquired_at = time.time()
+        self._released = False
+
+    def heartbeat(self) -> bool:
+        """Touch the lease mtime; False (counted) when the beat could
+        not land — the lease then ages toward breakability, which is
+        the honest signal a wedged holder should emit."""
+        from ..obs import chaos
+
+        if self._released:
+            return False
+        try:
+            chaos.maybe_fire("fleet.heartbeat", OSError)
+            os.utime(self.path, None)
+        except OSError as e:
+            _count("heartbeat_failures")
+            logger.warning(
+                "lease heartbeat failed for %s (%s: %s)",
+                self.plan_id, type(e).__name__, e,
+            )
+            return False
+        _count("heartbeats")
+        return True
+
+    def release(self) -> None:
+        """Unlink only OUR lease (the ``BuildSlot.release`` rule): a
+        lease broken and re-taken by a peer carries the peer's id now
+        — deleting it would invite a third executor."""
+        if self._released:
+            return
+        self._released = True
+        try:
+            with open(self.path) as f:
+                owner = f.readline().strip()
+            if owner == self.holder:
+                os.unlink(self.path)
+        except OSError:
+            pass
+
+    @property
+    def released(self) -> bool:
+        return self._released
+
+
+class LeaseDir:
+    """The lease files of one shared journal directory, as seen (and
+    held) by one replica."""
+
+    def __init__(self, directory: str, holder: str):
+        self.directory = directory
+        self.holder = holder
+        self._held: Dict[str, PlanLease] = {}
+        self._held_lock = threading.Lock()
+
+    def _path(self, plan_id: str) -> str:
+        return os.path.join(self.directory, f"plan-{plan_id}.lease")
+
+    # -- claiming --------------------------------------------------------
+
+    def _try_create(self, path: str) -> Optional[bool]:
+        """O_EXCL create with our holder id + pid: True = claimed,
+        False = a holder exists, None = locking unavailable here
+        (unwritable dir, chaos)."""
+        from ..obs import chaos
+
+        try:
+            chaos.maybe_fire("fleet.lease", OSError)
+            os.makedirs(self.directory, exist_ok=True)
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return False
+        except OSError:
+            return None
+        try:
+            os.write(fd, f"{self.holder}\n{os.getpid()}\n".encode())
+        finally:
+            os.close(fd)
+        return True
+
+    def try_claim(self, plan_id: str, takeover: bool = False):
+        """One non-blocking claim attempt. Returns the owned
+        :class:`PlanLease`; :data:`FOREIGN_HELD` when another replica
+        holds the plan (live, or dead-but-not-yet-breakable); or None
+        when locking is unavailable (the claim failed without telling
+        us anything about ownership — counted, retry next scan).
+
+        ``takeover=True`` marks a claim of another replica's journal
+        record (the fleet scan loop) for the counters; a stale lease is
+        broken first — only past :func:`lease_timeout` AND only when
+        the recorded holder pid is provably dead."""
+        path = self._path(plan_id)
+        with self._held_lock:
+            held = self._held.get(plan_id)
+        if held is not None and not held.released:
+            return held
+        created = self._try_create(path)
+        if created is False:
+            info = self.holder_info(plan_id)
+            if info is not None and info["holder"] == self.holder:
+                # OUR lease, raced from two of our own threads (a
+                # keyed re-submit racing the scan loop): hand back the
+                # held object rather than reading ourselves as foreign
+                with self._held_lock:
+                    held = self._held.get(plan_id)
+                if held is not None and not held.released:
+                    return held
+            if info is None:
+                # released between the create and the read: one retry
+                created = self._try_create(path)
+            elif info["stale"]:
+                _count("breaks")
+                from ..obs import events
+
+                events.event(
+                    "fleet.lease_break", plan=plan_id,
+                    holder=info["holder"], age_s=round(info["age_s"], 3),
+                )
+                logger.warning(
+                    "breaking stale lease for %s (holder %s pid %s "
+                    "dead, heartbeat %.1fs old > %.0fs timeout)",
+                    plan_id, info["holder"], info["pid"],
+                    info["age_s"], lease_timeout(),
+                )
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                created = self._try_create(path)
+            else:
+                return FOREIGN_HELD
+        if created is not True:
+            if created is False:
+                return FOREIGN_HELD
+            _count("claim_failures")
+            return None
+        lease = PlanLease(plan_id, path, self.holder)
+        with self._held_lock:
+            self._held[plan_id] = lease
+        _count("claims")
+        if takeover:
+            _count("takeovers")
+        return lease
+
+    # -- the holder's surface --------------------------------------------
+
+    def held(self, plan_id: str) -> Optional[PlanLease]:
+        with self._held_lock:
+            lease = self._held.get(plan_id)
+        return None if lease is None or lease.released else lease
+
+    def held_leases(self) -> List[PlanLease]:
+        with self._held_lock:
+            return [l for l in self._held.values() if not l.released]
+
+    def heartbeat_all(self) -> int:
+        """One beat across every held lease; returns beats landed."""
+        return sum(1 for l in self.held_leases() if l.heartbeat())
+
+    def release(self, plan_id: str) -> None:
+        with self._held_lock:
+            lease = self._held.pop(plan_id, None)
+        if lease is not None:
+            lease.release()
+
+    def release_all(self) -> None:
+        with self._held_lock:
+            leases = list(self._held.values())
+            self._held.clear()
+        for lease in leases:
+            lease.release()
+
+    # -- observation (any replica, plan_admin) ---------------------------
+
+    def holder_info(self, plan_id: str) -> Optional[Dict[str, Any]]:
+        """Who holds ``plan_id`` — {holder, pid, age_s, pid_dead,
+        stale}; None when unleased."""
+        path = self._path(plan_id)
+        try:
+            mtime = os.path.getmtime(path)
+            with open(path) as f:
+                lines = f.read().splitlines()
+        except OSError:
+            return None
+        holder = lines[0].strip() if lines else ""
+        pid: Optional[int] = None
+        if len(lines) > 1:
+            try:
+                pid = int(lines[1].strip())
+            except ValueError:
+                pid = None
+        age_s = max(0.0, time.time() - mtime)
+        dead = _pid_dead(pid)
+        return {
+            "plan_id": plan_id,
+            "holder": holder,
+            "pid": pid,
+            "age_s": age_s,
+            "pid_dead": dead,
+            "stale": age_s > lease_timeout() and dead,
+        }
+
+    def scan(self) -> List[Dict[str, Any]]:
+        """Every lease in the directory (plan_admin's fleet view)."""
+        try:
+            names = sorted(os.listdir(self.directory))
+        except OSError:
+            return []
+        out = []
+        for name in names:
+            if not (name.startswith("plan-") and name.endswith(".lease")):
+                continue
+            info = self.holder_info(name[len("plan-"):-len(".lease")])
+            if info is not None:
+                out.append(info)
+        return out

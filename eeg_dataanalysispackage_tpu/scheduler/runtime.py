@@ -47,6 +47,7 @@ def execute_plan(
     fault_plan=_RESOLVE,
     default_report_dir: Optional[str] = None,
     gateway: Optional[dict] = None,
+    fleet: Optional[dict] = None,
 ):
     """Run ``plan`` through ``builder`` inside a fresh fault domain;
     returns the statistics (and leaves the builder's per-run
@@ -69,6 +70,11 @@ def execute_plan(
     ``gateway`` — networked-submission attribution (the HTTP front
     door's {"via", "idempotency_key", "client"} block) echoed into
     run_report.json, so an artifact names how its plan arrived.
+
+    ``fleet`` — replica-fleet attribution (gateway/fleet.py's
+    {"replica", "takeover"} block, plus the process's lease counters
+    at execution time) echoed into run_report.json, so an artifact
+    names WHICH replica executed its plan and whether by takeover.
     """
     query_map = plan.query_map
     logger.info("query: %s", query_map)
@@ -133,6 +139,7 @@ def execute_plan(
             )
             builder.telemetry.plan_id = plan_id
             builder.telemetry.gateway = gateway
+            builder.telemetry.fleet = fleet
             # the builder appends rung drops as they happen; the
             # report reads this shared list
             builder.telemetry.degradation = builder.degradation_history

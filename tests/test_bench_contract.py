@@ -796,3 +796,62 @@ def test_multiproc_variant_in_both_tables_and_whitelist(monkeypatch):
     assert v["multiproc"] == block
     assert v["mesh"] == {"rung": "pod"}
     assert v["members_per_s"] == 10.0
+
+
+def test_gateway_fleet_in_both_tables_and_routing():
+    """The replicated-fleet benchmark (ISSUE 17) rides every bench
+    artifact, on TPU and the CPU fallback — the replicas are
+    CPU-forced child processes either way — through the pipeline
+    child."""
+    import inspect
+
+    for table in (bench._VARIANTS_TPU, bench._VARIANTS_CPU):
+        assert "gateway_fleet" in table
+        # deliberately small on BOTH tables: the line pins failover
+        # (takeover sha, exactly-once audit, drain), and the heavy
+        # plan's kill window is sized in iterations whose unit cost
+        # scales with the session — a plan_service-sized session
+        # would stretch the twin and takeover re-run into minutes
+        assert table["gateway_fleet"] == (400, 2)
+    src = inspect.getsource(bench._run_variant)
+    assert '"gateway_"' in src and "pipeline_bench.py" in src
+
+
+def test_collect_propagates_fleet_field(monkeypatch):
+    """The gateway_fleet line's failover block (takeover sha parity,
+    zero-double-execution audit, drain exit codes) must survive the
+    parent's field whitelist into the published artifact — the
+    crash-only failover claim is audited from it."""
+    block = {
+        "replicas": 3,
+        "takeover": {
+            "plan_id": "p0001",
+            "completed_by": "gw-b",
+            "takeover_recorded": True,
+            "sha_identical_to_twin": True,
+        },
+        "journal_audit": {
+            "terminal_records": 4, "corrupt": 0, "leftover_leases": 0,
+        },
+        "zero_double_executions": True,
+        "drain_exit_codes": [0, 0],
+    }
+    monkeypatch.setattr(
+        bench, "_VARIANTS_CPU",
+        {"einsum": (8, 2), "gateway_fleet": (2000, 4)},
+    )
+    monkeypatch.setattr(
+        bench,
+        "_run_variant",
+        lambda name, platform, n, iters: {
+            "epochs_per_s": 1.0,
+            "bytes_per_epoch": 6000,
+            "n": n,
+            "wall_s": 1.0,
+            "report_sha256": "abc",
+            **({"fleet": block} if name == "gateway_fleet" else {}),
+        },
+    )
+    v = bench._collect("cpu_fallback")["variants"]["gateway_fleet"]
+    assert v["fleet"] == block
+    assert v["report_sha256"] == "abc"

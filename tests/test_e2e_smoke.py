@@ -29,9 +29,10 @@ def test_e2e_smoke_trio():
         [sys.executable, _SMOKE],  # tool defaults: 2000 markers x 4 files
         capture_output=True,
         text=True,
-        # the ladder grew the serve_mega + int8 children in PR 12;
-        # headroom over the measured full-run wall, not a schedule
-        timeout=1800,
+        # the ladder grew the serve_mega + int8 children in PR 12 and
+        # the 3-replica gateway_fleet child in ISSUE 17; headroom over
+        # the measured full-run wall, not a schedule
+        timeout=2100,
     )
     assert proc.returncode == 0, (
         f"smoke gate failed:\n{proc.stdout}\n{proc.stderr[-2000:]}"

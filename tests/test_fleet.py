@@ -1,0 +1,742 @@
+"""Replicated gateway fleet suite (gateway/fleet.py +
+scheduler/lease.py).
+
+The acceptance pins:
+
+- **lease-race matrix** — two replicas race one record and exactly one
+  claims; a stale lease is broken ONLY past the timeout AND with a
+  provably dead holder pid; a replica unlinks only its OWN lease;
+- **journal hardening** — corrupt records are quarantined to
+  ``plan-<id>.json.corrupt`` (counted), a refused directory fsync is
+  counted;
+- **crash-only failover** — three REAL replica processes over one
+  shared journal; the in-flight holder is SIGKILLed and a survivor
+  completes its plan under the original id with byte-identical
+  statistics, exactly once;
+- **graceful drain** — a real SIGTERM makes a replica stop accepting
+  (503), hand queued leases back to the fleet, finish in-flight work,
+  and exit 0.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import _synthetic
+from eeg_dataanalysispackage_tpu import obs
+from eeg_dataanalysispackage_tpu.gateway import FleetReplica
+from eeg_dataanalysispackage_tpu.obs import chaos, domain as run_domain
+from eeg_dataanalysispackage_tpu.pipeline import builder
+from eeg_dataanalysispackage_tpu.scheduler import lease as lease_mod
+from eeg_dataanalysispackage_tpu.scheduler.journal import PlanJournal
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_ambient():
+    assert chaos.active_plan() is None
+    assert run_domain.current() is None
+    yield
+    chaos.uninstall()
+    assert run_domain.current() is None
+
+
+@pytest.fixture(autouse=True)
+def _fast_lease(monkeypatch):
+    """A 1s break threshold so staleness is testable; individual tests
+    that need a different value override the env themselves."""
+    monkeypatch.setenv(lease_mod.ENV_LEASE_TIMEOUT, "1")
+
+
+@pytest.fixture()
+def session(tmp_path):
+    return _synthetic.write_session(str(tmp_path), n_markers=60)
+
+
+def _q(info, extra="", clf="logreg"):
+    return (
+        f"info_file={info}&fe=dwt-8&train_clf={clf}"
+        "&config_step_size=1.0&config_num_iterations=20"
+        "&config_mini_batch_fraction=1.0" + extra
+    )
+
+
+def _request(url, body=None, method="GET", headers=None, timeout=60):
+    req = urllib.request.Request(
+        url, data=body.encode() if body is not None else None,
+        method=method, headers=headers or {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _await(base, plan_id, deadline_s=300):
+    start = time.monotonic()
+    while time.monotonic() - start < deadline_s:
+        _, payload = _request(f"{base}/plans/{plan_id}")
+        if payload.get("state") in ("completed", "failed", "cancelled"):
+            return payload["state"]
+        time.sleep(0.05)
+    raise AssertionError(f"{plan_id} never reached a terminal state")
+
+
+def _stale_lease(journal_dir, plan_id, holder="gw-dead", pid=999999,
+                 age_s=100.0):
+    """A dead replica's lease: unknown pid, heartbeat long past the
+    break threshold."""
+    os.makedirs(journal_dir, exist_ok=True)
+    path = os.path.join(journal_dir, f"plan-{plan_id}.lease")
+    with open(path, "w") as f:
+        f.write(f"{holder}\n{pid}\n")
+    old = time.time() - age_s
+    os.utime(path, (old, old))
+    return path
+
+
+# -- lease-race matrix -------------------------------------------------
+
+
+def test_two_replicas_race_exactly_one_claims(tmp_path):
+    """N threads across two replica identities hammer one plan id:
+    exactly one PlanLease is ever granted; every loser reads
+    FOREIGN_HELD (never None, never a second lease)."""
+    a = lease_mod.LeaseDir(str(tmp_path), holder="gw-a")
+    b = lease_mod.LeaseDir(str(tmp_path), holder="gw-b")
+    outcomes = []
+    barrier = threading.Barrier(8)
+
+    def race(directory):
+        barrier.wait()
+        outcomes.append(directory.try_claim("p0001"))
+
+    threads = [
+        threading.Thread(target=race, args=(d,))
+        for d in (a, b) for _ in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wins = [o for o in outcomes if isinstance(o, lease_mod.PlanLease)]
+    # one replica won; its OWN extra threads may share the held object
+    # (same-process re-claim), the OTHER replica always reads foreign
+    assert wins
+    assert len({id(w) for w in wins}) == 1
+    assert len({w.holder for w in wins}) == 1
+    losses = [o for o in outcomes if not isinstance(o, lease_mod.PlanLease)]
+    assert all(o is lease_mod.FOREIGN_HELD for o in losses)
+    # at LEAST every thread of the losing replica lost (a winning-side
+    # thread racing the claim registration may also read foreign)
+    assert len(losses) >= 4
+    # exactly one lease file, naming the winner
+    with open(os.path.join(str(tmp_path), "plan-p0001.lease")) as f:
+        assert f.readline().strip() == wins[0].holder
+
+
+def test_stale_break_needs_timeout_and_dead_pid(tmp_path):
+    """The break matrix: (old heartbeat, live pid) and (fresh
+    heartbeat, dead pid) both stay FOREIGN_HELD; only (old heartbeat,
+    dead pid) is broken and re-claimed."""
+    d = lease_mod.LeaseDir(str(tmp_path), holder="gw-b")
+
+    # live pid (this process), heartbeat far past the threshold
+    _stale_lease(str(tmp_path), "p0001", holder="gw-a", pid=os.getpid())
+    assert d.try_claim("p0001") is lease_mod.FOREIGN_HELD
+
+    # dead pid, fresh heartbeat
+    _stale_lease(str(tmp_path), "p0002", age_s=0.0)
+    assert d.try_claim("p0002") is lease_mod.FOREIGN_HELD
+
+    # dead pid AND old heartbeat: broken, claimed, counted
+    before = lease_mod.stats()
+    _stale_lease(str(tmp_path), "p0003")
+    lease = d.try_claim("p0003", takeover=True)
+    assert isinstance(lease, lease_mod.PlanLease)
+    assert lease.holder == "gw-b"
+    after = lease_mod.stats()
+    assert after["breaks"] == before["breaks"] + 1
+    assert after["takeovers"] == before["takeovers"] + 1
+
+
+def test_release_unlinks_only_own_lease(tmp_path):
+    """A holder whose lease was broken and re-taken by a peer must NOT
+    unlink the peer's live claim (the BuildSlot.release rule)."""
+    a = lease_mod.LeaseDir(str(tmp_path), holder="gw-a")
+    lease = a.try_claim("p0001")
+    assert isinstance(lease, lease_mod.PlanLease)
+    # a peer broke the (by then stale) lease and re-claimed
+    with open(lease.path, "w") as f:
+        f.write(f"gw-b\n{os.getpid()}\n")
+    a.release("p0001")
+    assert os.path.exists(lease.path)
+    with open(lease.path) as f:
+        assert f.readline().strip() == "gw-b"
+    # ... while releasing an owned lease does unlink it
+    lease2 = a.try_claim("p0002")
+    a.release("p0002")
+    assert not os.path.exists(lease2.path)
+
+
+def test_own_reclaim_returns_held_object(tmp_path):
+    """Two threads of ONE replica claiming the same id share the held
+    lease — a replica must never read itself as foreign."""
+    d = lease_mod.LeaseDir(str(tmp_path), holder="gw-a")
+    first = d.try_claim("p0001")
+    second = d.try_claim("p0001")
+    assert first is second
+
+
+def test_heartbeat_failure_counted_not_fatal(tmp_path):
+    """fleet.heartbeat chaos: the beat is skipped and counted; the
+    lease simply ages toward breakability."""
+    d = lease_mod.LeaseDir(str(tmp_path), holder="gw-a")
+    lease = d.try_claim("p0001")
+    before = lease_mod.stats()
+    with chaos.faults("fleet.heartbeat:p=1.0"):
+        assert lease.heartbeat() is False
+    after = lease_mod.stats()
+    assert after["heartbeat_failures"] == before["heartbeat_failures"] + 1
+    assert lease.heartbeat() is True
+
+
+def test_lease_claim_chaos_counted_not_fatal(tmp_path):
+    """fleet.lease chaos: the claim attempt fails without telling the
+    caller anything about ownership (None, counted) — the scan loop
+    just retries next tick."""
+    d = lease_mod.LeaseDir(str(tmp_path), holder="gw-a")
+    before = lease_mod.stats()
+    with chaos.faults("fleet.lease:p=1.0"):
+        assert d.try_claim("p0001") is None
+    after = lease_mod.stats()
+    assert after["claim_failures"] == before["claim_failures"] + 1
+    assert isinstance(d.try_claim("p0001"), lease_mod.PlanLease)
+
+
+# -- journal hardening (satellites 1 + 2) ------------------------------
+
+
+def test_corrupt_journal_record_quarantined(tmp_path):
+    """A corrupt record must not wedge the scan loop: it is moved
+    aside to plan-<id>.json.corrupt, counted, and entries() keeps
+    going."""
+    journal = PlanJournal(str(tmp_path))
+    journal.record_submitted("p0001", "q1", meta={})
+    with open(os.path.join(str(tmp_path), "plan-p0002.json"), "w") as f:
+        f.write("{ not json")
+    before = obs.metrics.snapshot()["counters"].get(
+        "scheduler.journal_corrupt", 0
+    )
+    entries = journal.entries()
+    assert [e["plan_id"] for e in entries] == ["p0001"]
+    assert os.path.exists(
+        os.path.join(str(tmp_path), "plan-p0002.json.corrupt")
+    )
+    assert not os.path.exists(
+        os.path.join(str(tmp_path), "plan-p0002.json")
+    )
+    after = obs.metrics.snapshot()["counters"].get(
+        "scheduler.journal_corrupt", 0
+    )
+    assert after == before + 1
+    # entry() takes the same path
+    with open(os.path.join(str(tmp_path), "plan-p0003.json"), "w") as f:
+        f.write("also not json")
+    assert journal.entry("p0003") is None
+    assert os.path.exists(
+        os.path.join(str(tmp_path), "plan-p0003.json.corrupt")
+    )
+
+
+def test_journal_dir_fsync_refusal_counted(tmp_path, monkeypatch):
+    """A directory fsync the filesystem refuses is counted — the
+    durability gap is visible, not silent."""
+    journal = PlanJournal(str(tmp_path))
+    monkeypatch.setattr(
+        "eeg_dataanalysispackage_tpu.checkpoint.manager._fsync_directory",
+        lambda directory: False,
+    )
+    before = obs.metrics.snapshot()["counters"].get(
+        "scheduler.journal_dir_fsync_failed", 0
+    )
+    journal.record_submitted("p0001", "q", meta={})
+    after = obs.metrics.snapshot()["counters"].get(
+        "scheduler.journal_dir_fsync_failed", 0
+    )
+    assert after == before + 1
+    # the record itself still landed (fsync is belt-and-braces)
+    assert journal.entry("p0001")["state"] == "submitted"
+
+
+# -- in-process fleet semantics ----------------------------------------
+
+
+def test_takeover_executes_orphan_byte_identical(session, tmp_path):
+    """A dead replica's write-ahead record (stale lease, dead pid) is
+    claimed by a peer's scan loop and executed to completion under the
+    ORIGINAL id with statistics byte-identical to a direct run, with
+    the takeover attributed in the journal meta."""
+    journal_dir = str(tmp_path / "journal")
+    query = _q(session)
+    twin = str(builder.PipelineBuilder(query).execute())
+
+    journal = PlanJournal(journal_dir)
+    journal.record_submitted(
+        "p0001", query, meta={"idempotency_key": "k1"}
+    )
+    _stale_lease(journal_dir, "p0001")
+
+    replica = FleetReplica(
+        journal_dir=journal_dir, replica_id="gw-b",
+        scan_interval_s=0.05,
+    )
+    replica.start()
+    try:
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            entry = journal.entry("p0001")
+            if entry and entry["state"] != "submitted":
+                break
+            time.sleep(0.05)
+        assert entry["state"] == "completed"
+        assert entry["statistics"] == twin
+        assert entry["meta"]["fleet"] == {
+            "replica": "gw-b", "takeover": True,
+        }
+        # keyed re-submit rejoins/replays the original id — the key
+        # was journaled by the dead process, not by this replica
+        code, payload = replica.server.submit_query(
+            query, idempotency_key="k1"
+        )
+        assert code == 200
+        assert payload["plan_id"] == "p0001"
+        assert payload["idempotent_replay"] is True
+    finally:
+        replica.close()
+    assert not os.path.exists(
+        os.path.join(journal_dir, "plan-p0001.lease")
+    )
+
+
+def test_fresh_ids_never_collide_across_replicas(session, tmp_path):
+    """Two replicas over one journal mint from identical local
+    counters; the lease doubles as the cross-process id allocator, so
+    both submissions land distinct ids and both complete."""
+    journal_dir = str(tmp_path / "journal")
+    a = FleetReplica(journal_dir=journal_dir, replica_id="gw-a",
+                     scan_interval_s=5.0)
+    b = FleetReplica(journal_dir=journal_dir, replica_id="gw-b",
+                     scan_interval_s=5.0)
+    a.start()
+    b.start()
+    try:
+        _, pa = a.server.submit_query(_q(session) + "&dedup=false")
+        _, pb = b.server.submit_query(_q(session) + "&dedup=false")
+        assert pa["plan_id"] != pb["plan_id"]
+        journal = PlanJournal(journal_dir)
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            states = {
+                e["plan_id"]: e["state"] for e in journal.entries()
+            }
+            if len(states) == 2 and all(
+                s != "submitted" for s in states.values()
+            ):
+                break
+            time.sleep(0.05)
+        assert states == {
+            pa["plan_id"]: "completed", pb["plan_id"]: "completed",
+        }
+        # each completed by its accepting replica, no takeover
+        for pid, rid in ((pa["plan_id"], "gw-a"), (pb["plan_id"], "gw-b")):
+            meta = journal.entry(pid)["meta"]["fleet"]
+            assert meta == {"replica": rid, "takeover": False}
+    finally:
+        a.close()
+        b.close()
+
+
+def test_keyed_resubmit_of_peer_held_plan_names_owner(session, tmp_path):
+    """A keyed re-submit of a plan a LIVE peer holds must not
+    double-execute: the gateway answers 200 with the original id and
+    the owner hint."""
+    journal_dir = str(tmp_path / "journal")
+    query = _q(session)
+    journal = PlanJournal(journal_dir)
+    journal.record_submitted(
+        "p0001", query, meta={"idempotency_key": "k1"}
+    )
+    # a LIVE peer's lease (this process's pid, fresh heartbeat)
+    _stale_lease(journal_dir, "p0001", holder="gw-a",
+                 pid=os.getpid(), age_s=0.0)
+
+    replica = FleetReplica(
+        journal_dir=journal_dir, replica_id="gw-b",
+        scan_interval_s=0.05,
+    )
+    replica.start()
+    try:
+        code, payload = replica.server.submit_query(
+            query, idempotency_key="k1"
+        )
+        assert code == 200
+        assert payload["plan_id"] == "p0001"
+        assert payload["idempotent_replay"] is True
+        assert payload["owner"] == "gw-a"
+        # the scan loop must also have refused it (live holder)
+        assert journal.entry("p0001")["state"] == "submitted"
+    finally:
+        replica.close()
+    # gw-b never owned the lease, so it must still be gw-a's
+    with open(os.path.join(journal_dir, "plan-p0001.lease")) as f:
+        assert f.readline().strip() == "gw-a"
+
+
+def test_drain_releases_queued_finishes_inflight(session, tmp_path):
+    """drain(): new submissions 503, queued plans handed back to the
+    fleet (journal 'submitted', lease gone), the in-flight plan
+    finished — and a peer then completes the released plan."""
+    journal_dir = str(tmp_path / "journal")
+    slow = (
+        f"info_file={session}&fe=dwt-8&train_clf=logreg"
+        "&config_step_size=0.5&config_num_iterations=1500000"
+        "&config_mini_batch_fraction=1.0"
+    )
+    a = FleetReplica(
+        journal_dir=journal_dir, replica_id="gw-a",
+        scan_interval_s=5.0, max_concurrent=1,
+    )
+    a.start()
+    _, inflight = a.server.submit_query(slow)
+    _, queued = a.server.submit_query(_q(session))
+    outcome = {}
+
+    def _drain():
+        outcome.update(a.drain(timeout_s=300.0))
+
+    t = threading.Thread(target=_drain)
+    t.start()
+    try:
+        while not a.server.draining:
+            time.sleep(0.01)
+        code, payload = a.server.submit_query(_q(session))
+        assert code == 503
+        assert payload["draining"] is True
+    finally:
+        t.join(timeout=300)
+    assert not t.is_alive()
+    assert outcome["finished"] == [inflight["plan_id"]]
+    assert outcome["released"] == [queued["plan_id"]]
+    journal = PlanJournal(journal_dir)
+    assert journal.entry(inflight["plan_id"])["state"] == "completed"
+    assert journal.entry(queued["plan_id"])["state"] == "submitted"
+    assert not os.path.exists(
+        os.path.join(journal_dir, f"plan-{queued['plan_id']}.lease")
+    )
+    # a peer picks the released plan up without any staleness wait
+    b = FleetReplica(
+        journal_dir=journal_dir, replica_id="gw-b",
+        scan_interval_s=0.05,
+    )
+    b.start()
+    try:
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            entry = journal.entry(queued["plan_id"])
+            if entry["state"] != "submitted":
+                break
+            time.sleep(0.05)
+        assert entry["state"] == "completed"
+        assert entry["meta"]["fleet"]["replica"] == "gw-b"
+    finally:
+        b.close()
+
+
+def test_healthz_liveness_vs_readyz_readiness(session, tmp_path):
+    """/healthz answers 200 whenever the process is alive; /readyz
+    turns 503 the moment the journal directory stops being writable —
+    the alive-but-unroutable split."""
+    journal_dir = str(tmp_path / "journal")
+    replica = FleetReplica(
+        journal_dir=journal_dir, replica_id="gw-a",
+        scan_interval_s=5.0,
+    )
+    host, port = replica.start()
+    base = f"http://{host}:{port}"
+    try:
+        code, payload = _request(f"{base}/healthz")
+        assert code == 200 and payload["ok"] is True
+        code, payload = _request(f"{base}/readyz")
+        assert code == 200 and payload["ready"] is True
+        assert payload["replica"] == "gw-a"
+
+        # break the journal dir out from under the replica (a regular
+        # file where the directory was — the probe's O_EXCL create
+        # fails even for root, unlike a chmod)
+        os.rename(journal_dir, journal_dir + ".gone")
+        with open(journal_dir, "w") as f:
+            f.write("not a directory")
+        try:
+            code, payload = _request(f"{base}/readyz")
+            assert code == 503
+            assert payload["ready"] is False
+            assert any(
+                "journal" in r for r in payload["reasons"]
+            )
+            # still ALIVE — a restart loop would be the wrong fix
+            code, _ = _request(f"{base}/healthz")
+            assert code == 200
+        finally:
+            os.unlink(journal_dir)
+            os.rename(journal_dir + ".gone", journal_dir)
+        code, _ = _request(f"{base}/readyz")
+        assert code == 200
+    finally:
+        replica.close()
+
+
+def test_stats_and_list_carry_fleet_attribution(session, tmp_path):
+    """/stats grows the fleet block (replica id, lease counters) and
+    /plans rows name a peer owner for peer-held records."""
+    journal_dir = str(tmp_path / "journal")
+    journal = PlanJournal(journal_dir)
+    journal.record_submitted("p0777", _q(session), meta={})
+    _stale_lease(journal_dir, "p0777", holder="gw-peer",
+                 pid=os.getpid(), age_s=0.0)
+    replica = FleetReplica(
+        journal_dir=journal_dir, replica_id="gw-a",
+        scan_interval_s=5.0,
+    )
+    host, port = replica.start()
+    base = f"http://{host}:{port}"
+    try:
+        _, stats = _request(f"{base}/stats")
+        fleet = stats["fleet"]
+        assert fleet["replica"] == "gw-a"
+        assert fleet["draining"] is False
+        assert set(fleet) >= {
+            "claims", "takeovers", "breaks", "heartbeats",
+            "heartbeat_failures", "claim_failures", "held_leases",
+        }
+        _, listing = _request(f"{base}/plans")
+        row = next(
+            p for p in listing["plans"] if p["plan_id"] == "p0777"
+        )
+        assert row["owner"] == "gw-peer"
+        _, status = _request(f"{base}/plans/p0777")
+        assert status["owner"] == "gw-peer"
+    finally:
+        replica.close()
+
+
+def test_plan_admin_fleet_view(session, tmp_path, capsys):
+    """tools/plan_admin.py fleet: leases joined to records, staleness
+    and unleased-submitted rows called out."""
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    try:
+        import plan_admin
+    finally:
+        sys.path.pop(0)
+    journal_dir = str(tmp_path / "journal")
+    journal = PlanJournal(journal_dir)
+    journal.record_submitted("p0001", _q(session), meta={})
+    _stale_lease(journal_dir, "p0001")  # dead holder, old heartbeat
+    journal.record_submitted("p0002", _q(session), meta={})
+
+    rc = plan_admin.main(["fleet", "--journal", journal_dir])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "p0001" in out and "STALE" in out and "gw-dead" in out
+    assert "p0002" in out and "unleased" in out
+    assert "1 stale" in out and "1 unleased" in out
+
+
+# -- the real-process acceptance pins ----------------------------------
+
+
+def _spawn_replica(replica_id, journal_dir, env):
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m",
+            "eeg_dataanalysispackage_tpu.gateway",
+            "--port", "0", "--journal-dir", journal_dir,
+            "--max-concurrent", "1", "--drain-timeout-s", "300",
+            "--fleet", "--replica-id", replica_id,
+        ],
+        env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True,
+    )
+    line = proc.stdout.readline()
+    assert "listening on " in line, line
+    return proc, line.split("listening on ", 1)[1].split()[0]
+
+
+def _fleet_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["EEG_TPU_LEASE_TIMEOUT_S"] = "1"
+    env["EEG_TPU_FLEET_SCAN_INTERVAL_S"] = "0.1"
+    env.pop("EEG_TPU_FAULTS", None)
+    env.pop("EEG_TPU_RUN_REPORT_DIR", None)
+    return env
+
+
+@pytest.mark.chaos
+def test_kill_one_of_three_replicas_peer_completes(session, tmp_path):
+    """THE fleet acceptance pin: 3 real replica processes over one
+    journal; SIGKILL the one executing a plan; a survivor breaks the
+    dead lease, completes the plan under its original id with
+    statistics byte-identical to an uninterrupted twin, exactly once;
+    a keyed re-submit to the third replica replays it; the survivors
+    then drain cleanly on real SIGTERM."""
+    journal_dir = str(tmp_path / "journal")
+    heavy = (
+        f"info_file={session}&fe=dwt-8&train_clf=logreg"
+        "&config_step_size=0.5&config_num_iterations=1500000"
+        "&config_mini_batch_fraction=1.0"
+    )
+    twin = str(builder.PipelineBuilder(heavy).execute())
+    env = _fleet_env()
+
+    procs, urls = [], []
+    try:
+        for rid in ("gw-a", "gw-b", "gw-c"):
+            proc, url = _spawn_replica(rid, journal_dir, env)
+            procs.append(proc)
+            urls.append(url)
+        for url in urls:
+            deadline = time.monotonic() + 120
+            while True:
+                code, _ = _request(f"{url}/readyz", timeout=5)
+                if code == 200:
+                    break
+                assert time.monotonic() < deadline, f"{url} not ready"
+                time.sleep(0.1)
+
+        code, payload = _request(
+            f"{urls[0]}/plans", body=heavy, method="POST",
+            headers={"X-Idempotency-Key": "fleet-pin"},
+        )
+        assert code == 201, payload
+        plan_id = payload["plan_id"]
+
+        # kill the holder provably mid-execution
+        deadline = time.monotonic() + 240
+        while True:
+            _, status = _request(f"{urls[0]}/plans/{plan_id}")
+            if status.get("state") == "running":
+                break
+            assert status.get("state") not in ("completed", "failed"), (
+                "plan finished before the kill — raise the iteration "
+                "count"
+            )
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        procs[0].kill()
+        assert procs[0].wait(timeout=60) == -signal.SIGKILL
+
+        # a survivor completes it under the ORIGINAL id
+        assert _await(urls[1], plan_id, deadline_s=300) == "completed"
+        entry = PlanJournal(journal_dir).entry(plan_id)
+        assert entry["statistics"] == twin
+        fleet_meta = entry["meta"]["fleet"]
+        assert fleet_meta["takeover"] is True
+        assert fleet_meta["replica"] in ("gw-b", "gw-c")
+
+        # exactly-once across the fleet: one terminal record, and the
+        # survivors' own completion counters sum to exactly this one
+        # execution
+        entries = PlanJournal(journal_dir).entries()
+        assert [e["plan_id"] for e in entries] == [plan_id]
+        completed = 0
+        for url in urls[1:]:
+            _, stats = _request(f"{url}/stats")
+            completed += int(
+                stats["scheduler"].get("scheduler.completed", 0)
+            )
+            assert stats["fleet"]["replica"] in ("gw-b", "gw-c")
+        assert completed == 1
+
+        # keyed re-submit to the OTHER survivor: replayed, original id
+        code, payload = _request(
+            f"{urls[2]}/plans", body=heavy, method="POST",
+            headers={"X-Idempotency-Key": "fleet-pin"},
+        )
+        assert code == 200
+        assert payload["plan_id"] == plan_id
+        assert payload["idempotent_replay"] is True
+
+        # graceful close-out: REAL SIGTERM, both survivors exit 0
+        for proc in procs[1:]:
+            proc.send_signal(signal.SIGTERM)
+        for proc in procs[1:]:
+            assert proc.wait(timeout=120) == 0
+        assert not [
+            n for n in os.listdir(journal_dir)
+            if n.endswith(".lease")
+        ]
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+
+
+@pytest.mark.chaos
+def test_sigterm_drain_real_process(session, tmp_path):
+    """The drain satellite against a real process: SIGTERM mid-plan →
+    the in-flight plan FINISHES, the queued plan is handed back
+    (journal 'submitted', lease released), exit code 0."""
+    journal_dir = str(tmp_path / "journal")
+    slow = (
+        f"info_file={session}&fe=dwt-8&train_clf=logreg"
+        "&config_step_size=0.5&config_num_iterations=1500000"
+        "&config_mini_batch_fraction=1.0"
+    )
+    proc, url = _spawn_replica("gw-a", journal_dir, _fleet_env())
+    try:
+        deadline = time.monotonic() + 120
+        while True:
+            code, _ = _request(f"{url}/readyz", timeout=5)
+            if code == 200:
+                break
+            assert time.monotonic() < deadline
+            time.sleep(0.1)
+        _, inflight = _request(
+            f"{url}/plans", body=slow, method="POST"
+        )
+        _, queued = _request(
+            f"{url}/plans", body=_q(session), method="POST"
+        )
+        # SIGTERM once the slow plan is genuinely running
+        deadline = time.monotonic() + 240
+        while True:
+            _, status = _request(
+                f"{url}/plans/{inflight['plan_id']}"
+            )
+            if status.get("state") == "running":
+                break
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=300) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    journal = PlanJournal(journal_dir)
+    assert journal.entry(inflight["plan_id"])["state"] == "completed"
+    assert journal.entry(queued["plan_id"])["state"] == "submitted"
+    assert not os.path.exists(
+        os.path.join(journal_dir, f"plan-{queued['plan_id']}.lease")
+    )

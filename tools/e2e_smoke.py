@@ -85,6 +85,17 @@ performance contract holds:
   clients interleaved) resolves every plan with clean-twin
   statistics and a recorded submits/sec;
 
+- the replicated gateway fleet (gateway_fleet,
+  tools/pipeline_bench.py — ISSUE 17): three real replica processes
+  over ONE shared journal directory; the replica executing the heavy
+  plan is SIGKILLed mid-run and a SURVIVOR completes the plan under
+  its original id with statistics byte-identical to an uninterrupted
+  fresh-process twin, exactly once (one terminal record per plan,
+  zero corrupt quarantines, zero leftover leases, and the survivors'
+  ``scheduler.completed`` sum equals the expected execution count);
+  a keyed re-submit after the takeover replays the original id; and
+  the surviving replicas drain to exit 0 on a real SIGTERM;
+
 - the PR 8 ingest gates: the overlap=true cold twin produces
   byte-identical statistics to the serial cold run (double-buffered
   ingest reschedules work, never changes it); the precision=bf16 twin
@@ -823,6 +834,68 @@ def _check_plan_service(line: dict, failures: list) -> None:
         )
 
 
+def _check_fleet(line: dict, failures: list) -> None:
+    """The replicated-fleet gate (ISSUE 17): three real replica
+    processes over one shared journal; the replica executing the heavy
+    plan is SIGKILLed mid-run and a survivor must complete it under
+    the ORIGINAL plan id with statistics byte-identical to an
+    uninterrupted twin — exactly once (journal audit + the survivors'
+    completion-counter sum), with the keyed re-submit replaying the
+    takeover's outcome and the surviving replicas draining to exit 0
+    on a real SIGTERM."""
+    fleet = line.get("fleet") or {}
+    if not fleet:
+        failures.append("fleet: no fleet block on the line")
+        return
+    if not (fleet.get("all_terminal") and fleet.get("all_completed")):
+        failures.append(
+            f"fleet: not every plan completed after the kill: "
+            f"{(fleet.get('plans') or {}).get('states')}"
+        )
+    takeover = fleet.get("takeover") or {}
+    if not (
+        takeover.get("sha_identical_to_twin")
+        and takeover.get("takeover_recorded")
+        and takeover.get("not_victim")
+    ):
+        failures.append(
+            f"fleet: takeover did not reproduce the victim's plan "
+            f"byte-identically on a surviving peer: {takeover}"
+        )
+    if not fleet.get("quick_sha_identical"):
+        failures.append(
+            "fleet: quick plans' statistics drifted from the "
+            "fresh-process twin"
+        )
+    resubmit = fleet.get("resubmit_after_takeover") or {}
+    if not (
+        resubmit.get("http") == 200
+        and resubmit.get("same_plan_id")
+        and resubmit.get("replayed")
+    ):
+        failures.append(
+            f"fleet: keyed re-submit after the takeover did not "
+            f"replay the original plan id: {resubmit}"
+        )
+    audit = fleet.get("journal_audit") or {}
+    if not (
+        audit.get("corrupt_quarantined") == 0
+        and audit.get("leftover_leases") == 0
+        and audit.get("terminal_records") == audit.get("expected_records")
+    ):
+        failures.append(f"fleet: journal audit failed: {audit}")
+    if not fleet.get("zero_double_executions"):
+        failures.append(
+            f"fleet: double execution detected: survivor completed "
+            f"counts {fleet.get('survivor_completed_counts')}"
+        )
+    if not fleet.get("drained_cleanly"):
+        failures.append(
+            f"fleet: SIGTERM drain exit codes "
+            f"{fleet.get('drain_exit_codes')} (expected all 0)"
+        )
+
+
 def _check_report(tag: str, bench_line: dict, report_dir: str,
                   failures: list, checked: list) -> dict:
     """The run-report half of the gate: the artifact exists, parses,
@@ -1056,6 +1129,19 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
             data_dir, os.path.join(tmp, "cache_plan_service"), None,
         )
         _check_plan_service(plan_service_line, failures)
+        # the replicated fleet (ISSUE 17): 3 real replica processes
+        # over one shared journal, SIGKILL the in-flight holder, a
+        # survivor completes the plan byte-identically exactly once,
+        # survivors drain to exit 0 on real SIGTERM. Own small
+        # session (not the ladder's): the heavy plan's kill window
+        # is sized in iterations whose unit cost scales with the
+        # session — failover pins don't sharpen with data size
+        fleet_line = _run_variant(
+            "gateway_fleet", 400, 2,
+            os.path.join(tmp, "data_fleet"),
+            os.path.join(tmp, "cache_fleet"), None,
+        )
+        _check_fleet(fleet_line, failures)
         cold_report = _check_report(
             "cold", cold, report_dirs["cold"], failures, reports_checked
         )
@@ -1413,6 +1499,19 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
              or {}).get("all_resolved")
             and ((plan_service_line.get("plan_service") or {}).get(
                 "soak") or {}).get("statistics_identical")
+        ),
+        "fleet_takeover_sha_ok": bool(
+            ((fleet_line.get("fleet") or {}).get("takeover") or {})
+            .get("sha_identical_to_twin")
+        ),
+        "fleet_takeover_wall_s": (
+            (fleet_line.get("fleet") or {}).get("takeover") or {}
+        ).get("wall_s"),
+        "fleet_zero_double_executions": bool(
+            (fleet_line.get("fleet") or {}).get("zero_double_executions")
+        ),
+        "fleet_drained_cleanly": bool(
+            (fleet_line.get("fleet") or {}).get("drained_cleanly")
         ),
         "reports_checked": len(reports_checked),
         "cold_stages": {

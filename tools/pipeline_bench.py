@@ -585,6 +585,22 @@ _PLAN_SERVICE_CLIENTS = 6
 _PLAN_SERVICE_PLANS_PER_CLIENT = 3
 _PLAN_SERVICE_SOAK_ATTEMPTS = 8
 
+#: fleet shape (gateway_fleet): real replica processes over ONE shared
+#: journal; quick plans spread over the survivors plus ONE heavy plan
+#: on the victim. The heavy iteration count sizes a multi-second train
+#: (the compiled SGD loop costs ~1.4s/1M iterations on this box's CPU
+#: class) so the SIGKILL provably lands mid-execution, and the lease
+#: timeout is cranked down so takeover latency — not the 30s
+#: production default — dominates the measured failover wall.
+_FLEET_REPLICAS = 3
+_FLEET_QUICK_PLANS = 3
+# sized for a reliable mid-run SIGKILL window (~seconds) at the
+# fleet's small bench session — per-iteration cost scales with the
+# session, so at bigger shapes this count would stretch the twin and
+# the takeover re-run into minutes without sharpening any pin
+_FLEET_HEAVY_ITERATIONS = 600_000
+_FLEET_LEASE_TIMEOUT_S = "2"
+
 
 def _http_json(url: str, body: str = None, method: str = "GET",
                headers: dict = None, timeout: float = 60.0):
@@ -982,6 +998,317 @@ def run_plan_service(info: str, scratch: str) -> dict:
     }
 
 
+def _spawn_gateway_replica(replica_id: str, journal_dir: str,
+                           report_root: str, cache_dir: str):
+    """One REAL fleet replica process via the production entrypoint
+    (``python -m eeg_dataanalysispackage_tpu.gateway --fleet``) — the
+    bench kills and drains exactly what an operator runs. CPU-forced:
+    three concurrent processes must never contend for one
+    accelerator. Returns (Popen, stderr tempfile path)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["EEG_TPU_FEATURE_CACHE_DIR"] = cache_dir
+    env["EEG_TPU_LEASE_TIMEOUT_S"] = _FLEET_LEASE_TIMEOUT_S
+    env["EEG_TPU_FLEET_SCAN_INTERVAL_S"] = "0.1"
+    env.pop("EEG_TPU_FAULTS", None)
+    env.pop("EEG_TPU_RUN_REPORT_DIR", None)
+    env.pop("EEG_TPU_NO_FEATURE_CACHE", None)
+    # stderr to a file, not a pipe: replicas log freely and nobody
+    # drains the pipe while the bench orchestrates the kill
+    err = tempfile.NamedTemporaryFile(
+        mode="w+", suffix=f".{replica_id}.err", delete=False
+    )
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "eeg_dataanalysispackage_tpu.gateway",
+            "--port", "0", "--journal-dir", journal_dir,
+            "--report-root", report_root, "--max-concurrent", "2",
+            "--drain-timeout-s", "120",
+            "--fleet", "--replica-id", replica_id,
+        ],
+        env=env, stdout=subprocess.PIPE, stderr=err, text=True,
+    )
+    return proc, err.name
+
+
+def _replica_url(proc, deadline_s: float = 120.0) -> str:
+    """Parse the replica's flushed listening line off its stdout."""
+    import select as _select
+
+    buf = ""
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"replica exited rc={proc.returncode} before listening"
+            )
+        ready, _, _ = _select.select([proc.stdout], [], [], 0.5)
+        if not ready:
+            continue
+        chunk = os.read(proc.stdout.fileno(), 4096).decode()
+        if not chunk:
+            continue
+        buf += chunk
+        for line in buf.splitlines():
+            if "listening on " in line:
+                return line.split("listening on ", 1)[1].split()[0]
+    raise RuntimeError("replica never printed its listening line")
+
+
+def run_gateway_fleet(info: str, scratch: str) -> dict:
+    """The replicated-gateway measurement (gateway/fleet.py): three
+    real replica processes over one shared journal; quick plans spread
+    across two of them, one heavy plan on the third; SIGKILL the heavy
+    plan's holder MID-RUN and measure the survivors finishing it under
+    its original id — statistics sha pinned byte-identical against an
+    uninterrupted fresh-process twin. The journal audit (exactly one
+    terminal record per plan, zero corrupt quarantines, zero leftover
+    leases) plus the survivors' ``scheduler.completed`` sum against
+    the expected execution count is the zero-double-execution
+    evidence; the close-out is a real SIGTERM drain of the survivors
+    (exit 0 pinned)."""
+    import signal as _signal
+
+    def q(iterations):
+        # replace, don't append: get_raw_param takes the FIRST
+        # occurrence of a duplicated key
+        base = build_query(info, fanout=False) + "&dedup=false"
+        if iterations:
+            base = base.replace(
+                "config_num_iterations=20",
+                f"config_num_iterations={iterations}",
+            )
+        return base
+
+    # -- uninterrupted twins, each in its own fresh CPU process (the
+    # same spawn the replicas' plans run under): the shas every fleet
+    # execution — takeover included — must reproduce byte-identically.
+    # Independent of each other (cache off, read-only data), so they
+    # run concurrently
+    quick_proc = _spawn_multiproc_worker(q(0))
+    heavy_proc = _spawn_multiproc_worker(q(_FLEET_HEAVY_ITERATIONS))
+    quick_twin = _reap_worker(quick_proc)
+    heavy_twin = _reap_worker(heavy_proc)
+
+    journal_dir = os.path.join(scratch, "journal_fleet")
+    report_root = os.path.join(scratch, "reports_fleet")
+    cache_dir = os.path.join(scratch, "fc_fleet")
+    ids = [f"gw-{chr(ord('a') + i)}" for i in range(_FLEET_REPLICAS)]
+    procs, err_files, urls = [], [], []
+    start = time.perf_counter()
+    try:
+        for rid in ids:
+            proc, err = _spawn_gateway_replica(
+                rid, journal_dir, report_root, cache_dir
+            )
+            procs.append(proc)
+            err_files.append(err)
+        for proc in procs:
+            urls.append(_replica_url(proc))
+        # routable = /readyz 200 (journal writable, executor
+        # accepting) — the fleet's own routing contract, probed here
+        # exactly as a load balancer would
+        for url in urls:
+            ready_deadline = time.monotonic() + 120
+            while True:
+                try:
+                    code, _ = _http_json(f"{url}/readyz", timeout=5)
+                except OSError:
+                    code = 0
+                if code == 200:
+                    break
+                if time.monotonic() > ready_deadline:
+                    raise RuntimeError(f"{url} never became ready")
+                time.sleep(0.2)
+        startup_wall = time.perf_counter() - start
+
+        # -- submit: heavy to the victim (replica 0), quick plans
+        # round-robin over the survivors
+        code, heavy = _http_json(
+            f"{urls[0]}/plans", body=q(_FLEET_HEAVY_ITERATIONS),
+            method="POST",
+            headers={"X-Idempotency-Key": "fleet-heavy"},
+        )
+        if code != 201:
+            raise RuntimeError(f"heavy submit failed: {code} {heavy}")
+        heavy_id = heavy["plan_id"]
+        quick = []
+        for i in range(_FLEET_QUICK_PLANS):
+            url = urls[1 + i % (_FLEET_REPLICAS - 1)]
+            code, payload = _http_json(
+                f"{url}/plans", body=q(0), method="POST",
+                headers={"X-Idempotency-Key": f"fleet-q{i}"},
+            )
+            if code != 201:
+                raise RuntimeError(
+                    f"quick submit {i} failed: {code} {payload}"
+                )
+            quick.append(payload["plan_id"])
+
+        # -- the kill: wait until the heavy plan is RUNNING on the
+        # victim, then SIGKILL — no drain, no goodbye; the lease
+        # heartbeat just stops and the pid dies
+        kill_deadline = time.monotonic() + 240
+        while True:
+            _, status = _http_json(f"{urls[0]}/plans/{heavy_id}")
+            if status.get("state") == "running":
+                break
+            if status.get("state") in ("completed", "failed"):
+                raise RuntimeError(
+                    f"heavy plan finished before the kill "
+                    f"({status.get('state')}) — raise "
+                    f"_FLEET_HEAVY_ITERATIONS"
+                )
+            if time.monotonic() > kill_deadline:
+                raise RuntimeError("heavy plan never started running")
+            time.sleep(0.05)
+        kill_at = time.perf_counter()
+        procs[0].kill()
+        procs[0].wait(timeout=60)
+
+        # -- takeover: every plan reaches a terminal state, observed
+        # through a SURVIVOR (any replica answers for any plan via the
+        # shared journal)
+        base = urls[1]
+        final = {
+            pid: _await_plan(base, pid, deadline_s=600.0)
+            for pid in [heavy_id] + quick
+        }
+        takeover_wall = time.perf_counter() - kill_at
+
+        # -- keyed re-submit of the taken-over plan to a survivor
+        # that never accepted it: the fleet-wide replay contract
+        recode, repayload = _http_json(
+            f"{urls[2]}/plans", body=q(_FLEET_HEAVY_ITERATIONS),
+            method="POST",
+            headers={"X-Idempotency-Key": "fleet-heavy"},
+        )
+
+        survivor_stats = []
+        for url in urls[1:]:
+            _, stats = _http_json(f"{url}/stats")
+            survivor_stats.append(stats)
+
+        # -- graceful close-out: real SIGTERM, drain, exit 0
+        for proc in procs[1:]:
+            proc.send_signal(_signal.SIGTERM)
+        drain_rcs = [p.wait(timeout=180) for p in procs[1:]]
+        wall = time.perf_counter() - start
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+        for name in err_files:
+            try:
+                os.unlink(name)
+            except OSError:
+                pass
+
+    # -- offline journal audit (the dead fleet's records speak for
+    # themselves, exactly as plan_admin fleet reads them)
+    from eeg_dataanalysispackage_tpu.scheduler.journal import PlanJournal
+
+    entries = {
+        e["plan_id"]: e for e in PlanJournal(journal_dir).entries()
+    }
+    heavy_entry = entries.get(heavy_id, {})
+    heavy_fleet = (heavy_entry.get("meta") or {}).get("fleet") or {}
+    corrupt = [
+        n for n in os.listdir(journal_dir) if n.endswith(".corrupt")
+    ]
+    leases = [
+        n for n in os.listdir(journal_dir) if n.endswith(".lease")
+    ]
+    # exactly-once across processes: the survivors' own completion
+    # counters must sum to precisely the executions the fleet owed
+    # them — the quick plans they accepted plus the one takeover (the
+    # keyed re-submit replays, never re-runs). One more would BE a
+    # double execution.
+    completed_counts = [
+        int((s.get("scheduler") or {}).get("scheduler.completed", 0))
+        for s in survivor_stats
+    ]
+    expected_completions = _FLEET_QUICK_PLANS + 1
+
+    epochs = 0
+    for pid in entries:
+        path = os.path.join(report_root, pid, "run_report.json")
+        try:
+            with open(path) as f:
+                counters = (json.load(f).get("metrics") or {}).get(
+                    "counters"
+                ) or {}
+            epochs += int(counters.get("pipeline.epochs_loaded", 0))
+        except (OSError, ValueError):
+            pass
+
+    fleet_block = {
+        "replicas": _FLEET_REPLICAS,
+        "victim": ids[0],
+        "killed_in_state": "running",
+        "startup_to_ready_s": round(startup_wall, 3),
+        "plans": {
+            "heavy": heavy_id, "quick": quick, "states": final,
+        },
+        "all_terminal": all(
+            s in ("completed", "failed") for s in final.values()
+        ),
+        "all_completed": all(s == "completed" for s in final.values()),
+        "takeover": {
+            "plan_id": heavy_id,
+            "completed_by": heavy_fleet.get("replica"),
+            "takeover_recorded": bool(heavy_fleet.get("takeover")),
+            "not_victim": heavy_fleet.get("replica") not in
+            (None, ids[0]),
+            "wall_s": round(takeover_wall, 3),
+            "lease_timeout_s": float(_FLEET_LEASE_TIMEOUT_S),
+            "sha_identical_to_twin": (
+                heavy_entry.get("statistics_sha256") == heavy_twin["sha"]
+            ),
+        },
+        "quick_sha_identical": all(
+            entries.get(pid, {}).get("statistics_sha256")
+            == quick_twin["sha"]
+            for pid in quick
+        ),
+        "resubmit_after_takeover": {
+            "http": recode,
+            "same_plan_id": repayload.get("plan_id") == heavy_id,
+            "replayed": bool(repayload.get("idempotent_replay")),
+        },
+        "journal_audit": {
+            "terminal_records": sum(
+                1 for e in entries.values()
+                if e.get("state") in ("completed", "failed")
+            ),
+            "expected_records": 1 + _FLEET_QUICK_PLANS,
+            "corrupt_quarantined": len(corrupt),
+            "leftover_leases": len(leases),
+        },
+        "survivor_completed_counts": completed_counts,
+        "zero_double_executions": (
+            sum(completed_counts) == expected_completions
+            and len(entries) == 1 + _FLEET_QUICK_PLANS
+        ),
+        "survivor_fleet_stats": [
+            s.get("fleet") for s in survivor_stats
+        ],
+        "drain_exit_codes": drain_rcs,
+        "drained_cleanly": all(rc == 0 for rc in drain_rcs),
+    }
+    return {
+        "fleet": fleet_block,
+        "wall_s": round(wall, 3),
+        # epochs actually loaded BY THE FLEET, summed from the
+        # per-plan run reports the replicas wrote (the victim's
+        # partial pass died with its process — unreported, honestly)
+        "epochs": epochs,
+        "report_sha256": heavy_twin["sha"],
+    }
+
+
 def run_query(query: str):
     """(statistics, wall_s, n_epochs, stage dict, extras) for one
     pipeline execution. The stage dict is the builder's StageTimer
@@ -1071,7 +1398,7 @@ def main(argv) -> dict:
         "population_vmap", "population_looped", "population_sharded",
         "population_multiproc", "multiproc_worker",
         "seizure_e2e", "scheduler_multi", "scheduler_suicide",
-        "plan_service", "populate",
+        "plan_service", "gateway_fleet", "populate",
     ):
         raise SystemExit(f"unknown variant {variant!r}")
 
@@ -1283,6 +1610,47 @@ def main(argv) -> dict:
             },
             "compile_cache": compile_cache.active_cache_dir(),
             "plan_service": result["plan_service"],
+            "report_sha256": result["report_sha256"],
+        }
+
+    if variant == "gateway_fleet":
+        scratch = _OWNED_TMP or cache_dir
+        result = run_gateway_fleet(info, scratch)
+        import jax
+
+        from eeg_dataanalysispackage_tpu.io import feature_cache
+        from eeg_dataanalysispackage_tpu.ops import plan_cache
+        from eeg_dataanalysispackage_tpu.utils import compile_cache
+
+        pstats = plan_cache.stats()
+        wall = result["wall_s"]
+        n_epochs = result["epochs"]
+        return {
+            "variant": variant,
+            # the headline rate is epochs through the WHOLE fleet per
+            # wall second — replica startup, the kill, the lease
+            # timeout and the takeover re-execution all inside the
+            # denominator, because failover latency is exactly what
+            # this line exists to measure (the takeover wall alone is
+            # in the fleet block)
+            "epochs_per_s": round(n_epochs / wall, 1) if wall else 0.0,
+            "n": n_epochs,
+            "iters": 1,
+            "wall_s": wall,
+            "elapsed_s": wall,
+            "bytes_per_epoch": _BYTES_PER_EPOCH,
+            "bytes_per_s": round(
+                (n_epochs / wall) * _BYTES_PER_EPOCH, 1
+            ) if wall else 0.0,
+            "n_markers_per_file": n_markers,
+            "n_files": n_files,
+            "platform": jax.devices()[0].platform,
+            "feature_cache": feature_cache.stats(),
+            "plan_cache": {
+                "hits": pstats["hits"], "misses": pstats["misses"],
+            },
+            "compile_cache": compile_cache.active_cache_dir(),
+            "fleet": result["fleet"],
             "report_sha256": result["report_sha256"],
         }
 

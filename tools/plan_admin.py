@@ -9,6 +9,7 @@ Usage:
     python tools/plan_admin.py stats --gateway URL [--tenant NAME]
     python tools/plan_admin.py tail --journal DIR
             [--interval S] [--count N]
+    python tools/plan_admin.py fleet --journal DIR
 
 ``list`` renders every plan record as an aligned table — id, state,
 attempts, timestamp, idempotency key, query — against either a journal
@@ -35,6 +36,14 @@ mentioning that tenant.
 or change state — the exactly-once behavior is auditable live:
 ``submitted`` appears before execution, exactly one terminal record
 replaces it, and an idempotent re-submit changes nothing.
+
+``fleet`` renders the replication view of a shared journal directory
+(gateway/fleet.py): every lease file joined against its plan record —
+holder replica, holder pid (and whether it still exists), heartbeat
+age vs the ``EEG_TPU_LEASE_TIMEOUT_S`` break threshold, and the plan
+state. A ``STALE`` row is a dead replica's claim a surviving peer will
+break and take over on its next scan; unleased ``submitted`` rows are
+up for grabs.
 
 Stdlib only, like every tool in this repo.
 """
@@ -310,6 +319,76 @@ def cmd_tail(args) -> int:
         time.sleep(args.interval)
 
 
+def cmd_fleet(args) -> int:
+    """The replication view: lease files joined against plan records.
+    Works offline against any shared journal directory — auditing a
+    fleet does not require a live replica."""
+    from eeg_dataanalysispackage_tpu.scheduler import lease as lease_mod
+    from eeg_dataanalysispackage_tpu.scheduler.journal import PlanJournal
+
+    if not os.path.isdir(args.journal):
+        raise SystemExit(f"no such journal directory: {args.journal}")
+    journal = PlanJournal(args.journal)
+    states = {
+        e.get("plan_id"): e for e in journal.entries()
+    }
+    # observer-only LeaseDir: the holder id is never written because
+    # this command never claims
+    leases = lease_mod.LeaseDir(args.journal, holder="plan-admin")
+    rows = []
+    for info in leases.scan():
+        entry = states.pop(info["plan_id"], None) or {}
+        meta = entry.get("meta") or {}
+        fleet_meta = meta.get("fleet") or {}
+        rows.append({
+            "plan_id": info["plan_id"],
+            "state": entry.get("state", "(no record)"),
+            "holder": info["holder"] or "?",
+            "pid": f"{info['pid']}"
+            + (" (dead)" if info["pid_dead"] else ""),
+            "beat_age": f"{info['age_s']:.1f}s",
+            "lease": "STALE" if info["stale"] else "held",
+            "takeover": "yes" if fleet_meta.get("takeover") else "",
+        })
+    # unleased unfinished records: claimable by any replica's next scan
+    for plan_id in sorted(states):
+        entry = states[plan_id]
+        if entry.get("state") != "submitted":
+            continue
+        rows.append({
+            "plan_id": plan_id,
+            "state": "submitted",
+            "holder": "-",
+            "pid": "-",
+            "beat_age": "-",
+            "lease": "unleased",
+            "takeover": "",
+        })
+    timeout = lease_mod.lease_timeout()
+    print(
+        f"journal {args.journal}  "
+        f"(lease break threshold {timeout:.0f}s + dead holder pid)"
+    )
+    if not rows:
+        print("(no leases and no unfinished records — fleet is idle)")
+        return 0
+    cols = ("plan_id", "state", "holder", "pid", "beat_age", "lease",
+            "takeover")
+    widths = {
+        c: max(len(c), *(len(str(r[c])) for r in rows)) for c in cols
+    }
+    print("  ".join(f"{c:<{widths[c]}}" for c in cols))
+    for r in rows:
+        print("  ".join(f"{str(r[c]):<{widths[c]}}" for c in cols))
+    stale = sum(1 for r in rows if r["lease"] == "STALE")
+    unleased = sum(1 for r in rows if r["lease"] == "unleased")
+    print(
+        f"\n{len(rows)} rows: {stale} stale (will be broken), "
+        f"{unleased} unleased submitted (claimable)"
+    )
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="plan_admin", description=__doc__.split("\n\n")[0],
@@ -328,6 +407,10 @@ def main(argv=None) -> int:
         help="print only this tenant's serve attribution",
     )
     p_tail = sub.add_parser("tail", help="follow a journal directory")
+    p_fleet = sub.add_parser(
+        "fleet", help="replication view: leases joined to plan records"
+    )
+    p_fleet.add_argument("--journal", required=True)
     for p in (p_list, p_show):
         p.add_argument("--journal", help="journal directory")
         p.add_argument("--gateway", help="running gateway URL")
@@ -355,6 +438,8 @@ def main(argv=None) -> int:
         return cmd_show(args)
     if args.command == "stats":
         return cmd_stats(args)
+    if args.command == "fleet":
+        return cmd_fleet(args)
     return cmd_tail(args)
 
 
