@@ -38,8 +38,8 @@ with ``recover=False`` and there is ONE takeover code path, not two.
 
 Split-brain non-goals (docs/architecture.md): replicas share one
 journal *directory* (one filesystem), and holder-death is checked by
-pid — this is a same-host/shared-mount fleet, not a consensus
-protocol. A partitioned filesystem is outside the contract.
+pid + start token — this is a same-host/shared-mount fleet, not a
+consensus protocol. A partitioned filesystem is outside the contract.
 """
 
 from __future__ import annotations

@@ -410,8 +410,76 @@ class PlanExecutor:
 
     # -- submission ------------------------------------------------------
 
+    #: how long a keyed submit waits (under ``_submit_lock``) for a
+    #: fleet peer holding the key's registration claim to land its
+    #: write-ahead record before degrading to a best-effort mint
+    key_claim_wait_s = 1.0
+
     def _next_id(self) -> str:
         return f"p{next(self._ids):04d}"
+
+    def _resolve_fleet_key(
+        self, idempotency_key: str,
+    ):
+        """Resolve a previously-unseen idempotency key against the
+        FLEET: re-seed the key index from the shared journal and,
+        because two replicas can receive the same new key concurrently
+        — each missing on the re-seed before either has journaled —
+        serialize registration through a key-scoped lease
+        (:func:`~.lease.key_claim_id`, the plan claim's own O_EXCL
+        primitive). Returns ``(existing_plan_id, key_claim)``:
+
+        - ``(plan_id, None)`` — the key is already bound (possibly by
+          a peer); the caller takes the replay/rejoin/readmit path;
+        - ``(None, PlanLease)`` — this replica holds the fleet-wide
+          registration right; the caller MUST release the claim once
+          its write-ahead record (which carries the binding) lands;
+        - ``(None, None)`` — claiming unavailable, or a live peer held
+          the claim past :attr:`key_claim_wait_s` without journaling
+          (died mid-registration — its claim breaks once stale — or
+          pathologically slow): degrade to a best-effort mint
+          (``scheduler.key_claim_degraded``) rather than wedge the
+          submit path.
+        """
+        claim_id = lease_mod.key_claim_id(idempotency_key)
+
+        def _reseed() -> Optional[str]:
+            # setdefault: live local mappings always win — the shared
+            # journal is authoritative only for keys this process has
+            # never seen
+            for k, v in self._seed_idempotency().items():
+                self._idempotency.setdefault(k, v)
+            return self._idempotency.get(idempotency_key)
+
+        deadline = time.monotonic() + self.key_claim_wait_s
+        while True:
+            claim = self.leases.try_claim(claim_id)
+            if isinstance(claim, lease_mod.PlanLease):
+                existing = _reseed()
+                if existing is not None:
+                    # the binding landed between our first miss and
+                    # the claim winning — the claim is moot
+                    self.leases.release(claim_id)
+                    return existing, None
+                return None, claim
+            existing = _reseed()
+            if existing is not None:
+                return existing, None
+            if claim is None:
+                # locking unavailable (degraded journal dir, chaos):
+                # fleet key dedup is best-effort this round
+                obs.metrics.count("scheduler.key_claim_degraded")
+                return None, None
+            if time.monotonic() >= deadline:
+                obs.metrics.count("scheduler.key_claim_degraded")
+                logger.warning(
+                    "idempotency key %r: registration claim held "
+                    "elsewhere past %.1fs without a journaled "
+                    "binding; proceeding best-effort",
+                    idempotency_key, self.key_claim_wait_s,
+                )
+                return None, None
+            time.sleep(0.02)
 
     def submit(
         self,
@@ -448,7 +516,11 @@ class PlanExecutor:
         With a lease directory attached (a fleet replica), admission
         claims the plan's lease BEFORE the write-ahead record lands;
         a plan whose lease a live peer holds raises
-        :class:`PlanOwnedElsewhereError` instead of double-executing."""
+        :class:`PlanOwnedElsewhereError` instead of double-executing.
+        A previously-unseen idempotency key is additionally registered
+        under a fleet-wide key-scoped lease
+        (:meth:`_resolve_fleet_key`), so two replicas racing one new
+        key mint exactly one plan."""
         from ..pipeline.plan import ExecutionPlan
 
         if self._stop.is_set():
@@ -500,20 +572,32 @@ class PlanExecutor:
                     # execution (re-admitting again would run the
                     # same plan twice into the same report_dir)
                     return PlanHandle(live, replayed=True)
+            key_claim: Optional[lease_mod.PlanLease] = None
             if idempotency_key and not _recovered:
                 # the check and the (later) registration share this
                 # lock: two concurrent submits with one key resolve to
                 # exactly one execution
                 existing = self._idempotency.get(idempotency_key)
-                if existing is None and self.leases is not None:
+                if (
+                    existing is None
+                    and self.leases is not None
+                    and self.journal is not None
+                ):
                     # fleet: peers journal keys after this replica
                     # seeded its map, so the shared journal — not the
                     # in-memory cache — is the authoritative key
-                    # index. Re-seed on miss (setdefault: live local
-                    # mappings always win) before minting a duplicate.
-                    for k, v in self._seed_idempotency().items():
-                        self._idempotency.setdefault(k, v)
-                    existing = self._idempotency.get(idempotency_key)
+                    # index, and REGISTERING a previously-unseen key
+                    # must itself be serialized across replicas: two
+                    # replicas receiving one new key concurrently
+                    # would each miss on the re-seed (neither has
+                    # journaled yet) and each mint its own plan. The
+                    # key-scoped lease closes that window; a non-None
+                    # key_claim comes back held and MUST be released
+                    # once the write-ahead record (which carries the
+                    # binding) lands.
+                    existing, key_claim = self._resolve_fleet_key(
+                        idempotency_key
+                    )
                 if existing is not None:
                     live = self._tickets.get(existing)
                     entry = (
@@ -587,17 +671,23 @@ class PlanExecutor:
                     # would erase a served result. The peer's write
                     # happened-before its release happened-before our
                     # claim, so the under-lease record check is final.
+                    # The record check runs EVEN when the claim came
+                    # back None (lease dir degraded, chaos): a failed
+                    # claim says nothing about ownership, and writing
+                    # our record over a peer's — possibly terminal —
+                    # one would erase a served result and resurface it
+                    # as 'submitted'.
                     while True:
                         claim = self.leases.try_claim(plan_id)
                         if claim is lease_mod.FOREIGN_HELD:
                             plan_id = self._next_id()
                             continue
                         if (
-                            claim is not None
-                            and self.journal is not None
+                            self.journal is not None
                             and self.journal.entry(plan_id) is not None
                         ):
-                            self.leases.release(plan_id)
+                            if claim is not None:
+                                self.leases.release(plan_id)
                             plan_id = self._next_id()
                             continue
                         break
@@ -649,6 +739,13 @@ class PlanExecutor:
                             "fleet": fleet,
                         },
                     )
+            if key_claim is not None:
+                # the write-ahead record carrying the key→plan binding
+                # has landed (or the journal write degraded, and fleet
+                # key dedup is best-effort anyway): peers re-seeding
+                # the shared journal see the binding now — the
+                # registration claim has done its job
+                self.leases.release(key_claim.plan_id)
             if _recovered:
                 # journal recovery must NEVER shed: these plans were
                 # admitted once by the dead process, and a shed here

@@ -5,7 +5,7 @@ need exactly one answer to "who executes plan p0007?" — the journal
 record itself cannot say, because any replica may scan it. The answer
 is a lease file beside the record::
 
-    <journal_dir>/plan-<id>.lease     "<holder-id>\\n<pid>\\n"
+    <journal_dir>/plan-<id>.lease     "<holder-id>\\n<pid>\\n<start-token>\\n"
 
 taken with the same cross-process ``O_CREAT|O_EXCL`` single-flight the
 feature cache's :class:`~eeg_dataanalysispackage_tpu.io.feature_cache.BuildSlot`
@@ -14,20 +14,41 @@ holder, and its **mtime is the heartbeat** — the holding replica
 touches it periodically, so a fresh mtime means a live owner even when
 the observer cannot see the owner's pid.
 
-The two rules that make this safe where the cache's lock (which only
+The rules that make this safe where the cache's lock (which only
 ever saved redundant work) did not have to be:
 
 - **Break only the provably dead.** A stale lease is broken ONLY when
   its heartbeat age exceeds ``EEG_TPU_LEASE_TIMEOUT_S`` *and* the
-  recorded holder pid no longer exists (``os.kill(pid, 0)`` →
-  ``ProcessLookupError``). A live-but-slow holder keeps its claim: a
-  double execution costs more than a late one (statistics stay
-  byte-identical either way — the pipeline is deterministic — but the
-  journal's exactly-once completion story should not depend on it).
+  recorded holder no longer exists. Holder-death is pid liveness
+  (``os.kill(pid, 0)`` → ``ProcessLookupError``) hardened against pid
+  reuse: the lease records the holder pid's *start token*
+  (``/proc/<pid>/stat`` starttime), so an unrelated live process that
+  recycled a dead holder's pid still reads as dead — without the
+  token, a recycled pid would strand the plan forever (heartbeats
+  never resume, but the pid test never fails). A live-but-slow holder
+  keeps its claim: a double execution costs more than a late one
+  (statistics stay byte-identical either way — the pipeline is
+  deterministic — but the journal's exactly-once completion story
+  should not depend on it).
+- **Break atomically.** Two replicas observing the same stale lease
+  must not interleave as A-unlink, A-create, B-unlink(-A's-fresh-
+  lease!), B-create — that is two holders and a double execution. The
+  break is therefore (1) serialized through a ``<lease>.breaking``
+  guard (the same O_EXCL single-flight), with staleness re-read UNDER
+  the guard, and (2) executed as an atomic *capture*: ``os.rename`` to
+  a breaker-unique name moves exactly one inode to exactly one
+  breaker, and the captured bytes are verified to be the observed
+  stale record before they are dropped. See
+  :meth:`LeaseDir._break_stale`.
 - **Unlink only your own lease** (the ``BuildSlot.release`` rule): a
   holder that outlived the stale age may have had its lease broken and
   re-taken by a peer whose id is now in the file — deleting that live
   lease would invite a third executor.
+
+The same file primitive also serializes idempotency-key registration
+across the fleet (``key-<hash>.lease`` via :func:`key_claim_id`): two
+replicas receiving the same previously-unseen key concurrently would
+otherwise each mint their own plan for it (scheduler/executor.py).
 
 Chaos points: ``fleet.lease`` fires inside one claim attempt and
 ``fleet.heartbeat`` inside one heartbeat touch (both injected as
@@ -42,11 +63,12 @@ and ``obs.metrics`` (``fleet.*``); per-replica attribution lands in
 
 from __future__ import annotations
 
+import hashlib
 import logging
 import os
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 logger = logging.getLogger(__name__)
 
@@ -126,8 +148,35 @@ def _count(name: str) -> None:
     obs.metrics.count(f"fleet.lease_{name}")
 
 
-def _pid_dead(pid: Optional[int]) -> bool:
-    """True only when the pid PROVABLY no longer exists. Unknown,
+def key_claim_id(idempotency_key: str) -> str:
+    """The lease name for an idempotency key's fleet-wide registration
+    claim (``key-<hash>.lease``): the executor serializes minting a
+    plan for a previously-unseen key through it, so two replicas
+    racing one new key register exactly one plan. Hashed — key
+    contents never land in a filename."""
+    digest = hashlib.sha256(idempotency_key.encode()).hexdigest()[:16]
+    return f"key:{digest}"
+
+
+def _pid_start_token(pid: int) -> Optional[str]:
+    """The pid's kernel start time (``/proc/<pid>/stat`` field 22) —
+    a (pid, token) pair survives pid reuse, which bare pid liveness
+    does not. None when unreadable (no procfs, pid gone)."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            # comm (field 2) may contain spaces and parens: the fixed
+            # fields start after the LAST ')'
+            fields = f.read().rsplit(b")", 1)[1].split()
+        return fields[19].decode()
+    except (OSError, IndexError, ValueError, UnicodeDecodeError):
+        return None
+
+
+def _holder_dead(pid: Optional[int], token: str = "") -> bool:
+    """True only when the recorded holder PROVABLY no longer exists:
+    its pid is gone, or the pid is alive but wearing a different start
+    token (an unrelated process recycled it — without this check a
+    reused pid would make the lease unbreakable forever). Unknown,
     unparseable, or permission-denied pids read as alive: breaking a
     lease on uncertainty is the one mistake this module must not
     make."""
@@ -139,6 +188,10 @@ def _pid_dead(pid: Optional[int]) -> bool:
         return True
     except OSError:
         return False
+    if token:
+        current = _pid_start_token(pid)
+        if current is not None and current != token:
+            return True
     return False
 
 
@@ -207,15 +260,21 @@ class LeaseDir:
         self._held: Dict[str, PlanLease] = {}
         self._held_lock = threading.Lock()
 
-    def _path(self, plan_id: str) -> str:
-        return os.path.join(self.directory, f"plan-{plan_id}.lease")
+    def _path(self, name: str) -> str:
+        if name.startswith("key:"):
+            # an idempotency-key registration claim (key_claim_id) —
+            # never scanned as a plan lease
+            return os.path.join(
+                self.directory, f"key-{name[len('key:'):]}.lease"
+            )
+        return os.path.join(self.directory, f"plan-{name}.lease")
 
     # -- claiming --------------------------------------------------------
 
     def _try_create(self, path: str) -> Optional[bool]:
-        """O_EXCL create with our holder id + pid: True = claimed,
-        False = a holder exists, None = locking unavailable here
-        (unwritable dir, chaos)."""
+        """O_EXCL create with our holder id + pid + start token:
+        True = claimed, False = a holder exists, None = locking
+        unavailable here (unwritable dir, chaos)."""
         from ..obs import chaos
 
         try:
@@ -226,11 +285,146 @@ class LeaseDir:
             return False
         except OSError:
             return None
+        token = _pid_start_token(os.getpid()) or ""
         try:
-            os.write(fd, f"{self.holder}\n{os.getpid()}\n".encode())
+            os.write(
+                fd, f"{self.holder}\n{os.getpid()}\n{token}\n".encode()
+            )
         finally:
             os.close(fd)
         return True
+
+    @staticmethod
+    def _read_id_file(
+        path: str,
+    ) -> Optional[Tuple[str, Optional[int], str]]:
+        """(holder, pid, start-token) from a lease/guard file; None
+        when unreadable."""
+        try:
+            with open(path) as f:
+                lines = f.read().splitlines()
+        except OSError:
+            return None
+        holder = lines[0].strip() if lines else ""
+        pid: Optional[int] = None
+        if len(lines) > 1:
+            try:
+                pid = int(lines[1].strip())
+            except ValueError:
+                pid = None
+        token = lines[2].strip() if len(lines) > 2 else ""
+        return holder, pid, token
+
+    def _break_stale(self, plan_id: str, path: str) -> Optional[bool]:
+        """Break ONE observed-stale lease ATOMICALLY. Returns True
+        when this replica won the break (the stale file is gone; the
+        caller now races for the vacant claim), False when a peer owns
+        the break or the lease turned out live under re-read (stand
+        down: FOREIGN_HELD), None when locking was unavailable.
+
+        Two replicas observing the same stale lease must not
+        interleave as A-unlink, A-create, B-unlink(-A's-fresh-lease!),
+        B-create — both would then hold "their own" lease and
+        double-execute. Two layers prevent it:
+
+        - a **break guard** (``<lease>.breaking``, the same O_EXCL
+          single-flight): one breaker works a given lease at a time,
+          and staleness is re-read UNDER the guard. A guard whose
+          creator died mid-break (or wedged past the lease timeout —
+          guards carry no heartbeat, so age is time since creation) is
+          itself captured-and-dropped atomically, then the break
+          retried;
+        - the removal is an **atomic capture**: ``os.rename`` to a
+          breaker-unique name hands exactly one inode to exactly one
+          breaker, and the captured bytes are verified to BE the
+          observed stale record before being dropped. A capture that
+          grabbed a fresh lease instead (possible only when the guard
+          itself was stale-broken concurrently) is republished with
+          ``os.link``, which cannot clobber any newer claim.
+        """
+        from ..obs import events
+
+        guard = path + ".breaking"
+        took_guard = self._try_create(guard)
+        if took_guard is False:
+            ids = self._read_id_file(guard)
+            try:
+                age = time.time() - os.path.getmtime(guard)
+            except OSError:
+                return False
+            if ids is None or not (
+                _holder_dead(ids[1], ids[2]) or age > lease_timeout()
+            ):
+                # a live breaker owns the takeover
+                return False
+            trash = f"{guard}.{self.holder}.{os.getpid()}"
+            try:
+                os.rename(guard, trash)
+                os.unlink(trash)
+            except OSError:
+                return False
+            took_guard = self._try_create(guard)
+        if took_guard is not True:
+            return None if took_guard is None else False
+        try:
+            info = self.holder_info(plan_id)
+            if info is None:
+                # released while the guard was taken: nothing to
+                # break, the claim path is already vacant
+                return True
+            if not info["stale"]:
+                # the holder resumed, or a faster breaker already
+                # re-created a fresh lease here
+                return False
+            captured = f"{path}.broken.{self.holder}.{os.getpid()}"
+            try:
+                os.rename(path, captured)
+            except OSError:
+                return False
+            got = self._read_id_file(captured)
+            if got is not None and (
+                got[0] != info["holder"] or got[1] != info["pid"]
+            ):
+                # the rename grabbed a FRESH lease (only reachable
+                # when our guard was concurrently stale-broken):
+                # republish it — os.link refuses to clobber a claim
+                # that landed at the path meanwhile
+                try:
+                    os.link(captured, path)
+                except OSError:
+                    pass
+                try:
+                    os.unlink(captured)
+                except OSError:
+                    pass
+                return False
+            try:
+                os.unlink(captured)
+            except OSError:
+                pass
+            _count("breaks")
+            events.event(
+                "fleet.lease_break", plan=plan_id,
+                holder=info["holder"], age_s=round(info["age_s"], 3),
+            )
+            logger.warning(
+                "broke stale lease for %s (holder %s pid %s dead, "
+                "heartbeat %.1fs old > %.0fs timeout)",
+                plan_id, info["holder"], info["pid"],
+                info["age_s"], lease_timeout(),
+            )
+            return True
+        finally:
+            ids = self._read_id_file(guard)
+            if (
+                ids is not None
+                and ids[0] == self.holder
+                and ids[1] == os.getpid()
+            ):
+                try:
+                    os.unlink(guard)
+                except OSError:
+                    pass
 
     def try_claim(self, plan_id: str, takeover: bool = False):
         """One non-blocking claim attempt. Returns the owned
@@ -242,7 +436,9 @@ class LeaseDir:
         ``takeover=True`` marks a claim of another replica's journal
         record (the fleet scan loop) for the counters; a stale lease is
         broken first — only past :func:`lease_timeout` AND only when
-        the recorded holder pid is provably dead."""
+        the recorded holder is provably dead, atomically
+        (:meth:`_break_stale`), so racing breakers never produce two
+        holders."""
         path = self._path(plan_id)
         with self._held_lock:
             held = self._held.get(plan_id)
@@ -263,24 +459,16 @@ class LeaseDir:
                 # released between the create and the read: one retry
                 created = self._try_create(path)
             elif info["stale"]:
-                _count("breaks")
-                from ..obs import events
-
-                events.event(
-                    "fleet.lease_break", plan=plan_id,
-                    holder=info["holder"], age_s=round(info["age_s"], 3),
-                )
-                logger.warning(
-                    "breaking stale lease for %s (holder %s pid %s "
-                    "dead, heartbeat %.1fs old > %.0fs timeout)",
-                    plan_id, info["holder"], info["pid"],
-                    info["age_s"], lease_timeout(),
-                )
-                try:
-                    os.unlink(path)
-                except OSError:
-                    pass
-                created = self._try_create(path)
+                broke = self._break_stale(plan_id, path)
+                if broke is True:
+                    created = self._try_create(path)
+                elif broke is None:
+                    _count("claim_failures")
+                    return None
+                else:
+                    # a racing breaker owns the takeover (or the
+                    # holder turned out live under the guard)
+                    return FOREIGN_HELD
             else:
                 return FOREIGN_HELD
         if created is not True:
@@ -328,23 +516,20 @@ class LeaseDir:
 
     def holder_info(self, plan_id: str) -> Optional[Dict[str, Any]]:
         """Who holds ``plan_id`` — {holder, pid, age_s, pid_dead,
-        stale}; None when unleased."""
+        stale}; None when unleased. ``pid_dead`` folds in the start
+        token: a recycled pid reads as dead (see
+        :func:`_holder_dead`)."""
         path = self._path(plan_id)
         try:
             mtime = os.path.getmtime(path)
-            with open(path) as f:
-                lines = f.read().splitlines()
         except OSError:
             return None
-        holder = lines[0].strip() if lines else ""
-        pid: Optional[int] = None
-        if len(lines) > 1:
-            try:
-                pid = int(lines[1].strip())
-            except ValueError:
-                pid = None
+        ids = self._read_id_file(path)
+        if ids is None:
+            return None
+        holder, pid, token = ids
         age_s = max(0.0, time.time() - mtime)
-        dead = _pid_dead(pid)
+        dead = _holder_dead(pid, token)
         return {
             "plan_id": plan_id,
             "holder": holder,

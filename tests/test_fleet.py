@@ -36,6 +36,7 @@ from eeg_dataanalysispackage_tpu.gateway import FleetReplica
 from eeg_dataanalysispackage_tpu.obs import chaos, domain as run_domain
 from eeg_dataanalysispackage_tpu.pipeline import builder
 from eeg_dataanalysispackage_tpu.scheduler import lease as lease_mod
+from eeg_dataanalysispackage_tpu.scheduler.executor import PlanExecutor
 from eeg_dataanalysispackage_tpu.scheduler.journal import PlanJournal
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -93,13 +94,14 @@ def _await(base, plan_id, deadline_s=300):
 
 
 def _stale_lease(journal_dir, plan_id, holder="gw-dead", pid=999999,
-                 age_s=100.0):
+                 age_s=100.0, token=""):
     """A dead replica's lease: unknown pid, heartbeat long past the
-    break threshold."""
+    break threshold. ``token`` is the holder pid's start token (empty
+    = pre-token lease; liveness is then pid-only)."""
     os.makedirs(journal_dir, exist_ok=True)
     path = os.path.join(journal_dir, f"plan-{plan_id}.lease")
     with open(path, "w") as f:
-        f.write(f"{holder}\n{pid}\n")
+        f.write(f"{holder}\n{pid}\n{token}\n")
     old = time.time() - age_s
     os.utime(path, (old, old))
     return path
@@ -196,6 +198,101 @@ def test_own_reclaim_returns_held_object(tmp_path):
     first = d.try_claim("p0001")
     second = d.try_claim("p0001")
     assert first is second
+
+
+def test_racing_breakers_break_exactly_once(tmp_path):
+    """Many threads across four replica identities race ONE stale
+    lease: the break happens exactly once (break guard + atomic
+    rename-capture), exactly one fresh claim is granted, and the lease
+    file names that winner — never the double-execution interleaving
+    A-unlink, A-create, B-unlink(-A's-fresh-lease), B-create."""
+    _stale_lease(str(tmp_path), "p0001")
+    dirs = [
+        lease_mod.LeaseDir(str(tmp_path), holder=f"gw-{i}")
+        for i in range(4)
+    ]
+    before = lease_mod.stats()
+    outcomes = []
+    lock = threading.Lock()
+    barrier = threading.Barrier(8)
+
+    def race(directory):
+        barrier.wait()
+        out = directory.try_claim("p0001", takeover=True)
+        with lock:
+            outcomes.append(out)
+
+    threads = [
+        threading.Thread(target=race, args=(d,))
+        for d in dirs for _ in range(2)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wins = [o for o in outcomes if isinstance(o, lease_mod.PlanLease)]
+    assert wins
+    assert len({id(w) for w in wins}) == 1
+    assert len({w.holder for w in wins}) == 1
+    after = lease_mod.stats()
+    assert after["breaks"] == before["breaks"] + 1
+    with open(os.path.join(str(tmp_path), "plan-p0001.lease")) as f:
+        assert f.readline().strip() == wins[0].holder
+    # no break machinery left behind (guards, captured inodes)
+    leftovers = [
+        n for n in os.listdir(str(tmp_path))
+        if ".breaking" in n or ".broken." in n
+    ]
+    assert leftovers == []
+
+
+def test_pid_reuse_detected_by_start_token(tmp_path):
+    """A recycled pid must not strand a plan: the lease records the
+    holder pid's start token, so a live unrelated process wearing a
+    dead holder's pid still reads as dead — while the genuinely live
+    holder (matching token) keeps its claim."""
+    token = lease_mod._pid_start_token(os.getpid())
+    if token is None:
+        pytest.skip("no /proc start token on this platform")
+    d = lease_mod.LeaseDir(str(tmp_path), holder="gw-b")
+    # this process's live pid but ANOTHER process's start token: the
+    # recorded holder is dead, its pid recycled — breakable
+    _stale_lease(str(tmp_path), "p0001", holder="gw-a",
+                 pid=os.getpid(), token="1")
+    assert isinstance(d.try_claim("p0001"), lease_mod.PlanLease)
+    # same pid with the MATCHING token: genuinely alive, never broken
+    _stale_lease(str(tmp_path), "p0002", holder="gw-a",
+                 pid=os.getpid(), token=token)
+    assert d.try_claim("p0002") is lease_mod.FOREIGN_HELD
+
+
+def test_stale_break_guard_from_dead_breaker_is_cleared(tmp_path):
+    """A breaker that died mid-break leaves its guard file behind; the
+    next breaker captures the dead guard atomically and completes the
+    break instead of wedging forever."""
+    d = lease_mod.LeaseDir(str(tmp_path), holder="gw-b")
+    path = _stale_lease(str(tmp_path), "p0001")
+    guard = path + ".breaking"
+    with open(guard, "w") as f:
+        f.write("gw-dead\n999999\n\n")
+    old = time.time() - 100
+    os.utime(guard, (old, old))
+    assert isinstance(d.try_claim("p0001"), lease_mod.PlanLease)
+    assert not os.path.exists(guard)
+
+
+def test_live_break_guard_defers_to_the_breaker(tmp_path):
+    """A fresh guard held by a LIVE breaker means the takeover is
+    already owned: a second breaker stands down (FOREIGN_HELD) and the
+    stale lease is left for the guard holder."""
+    d = lease_mod.LeaseDir(str(tmp_path), holder="gw-b")
+    path = _stale_lease(str(tmp_path), "p0001")
+    guard = path + ".breaking"
+    with open(guard, "w") as f:
+        f.write(f"gw-a\n{os.getpid()}\n\n")
+    assert d.try_claim("p0001") is lease_mod.FOREIGN_HELD
+    assert os.path.exists(path)
+    assert os.path.exists(guard)
 
 
 def test_heartbeat_failure_counted_not_fatal(tmp_path):
@@ -365,6 +462,116 @@ def test_fresh_ids_never_collide_across_replicas(session, tmp_path):
     finally:
         a.close()
         b.close()
+
+
+def test_fresh_id_skips_peer_record_when_claim_unavailable(
+    session, tmp_path,
+):
+    """fleet.lease chaos makes every claim return None; a peer's
+    journal record under the would-be fresh id must STILL be detected
+    and skipped — overwriting it would erase a served result and
+    resurface it as 'submitted' for the whole fleet to re-run."""
+    journal_dir = str(tmp_path / "journal")
+    ex = PlanExecutor(journal_dir=journal_dir, max_concurrent=1)
+    ex.leases = lease_mod.LeaseDir(journal_dir, holder="gw-a")
+    # a peer journals p0001 AFTER this executor seeded its id counter
+    peer = PlanJournal(journal_dir)
+    peer.record_completed("p0001", "peer-query", "peer-stats")
+    try:
+        with chaos.faults("fleet.lease:p=1.0"):
+            handle = ex.submit(_q(session))
+        assert handle.plan_id == "p0002"
+        handle.result(timeout=300)
+    finally:
+        ex.close()
+    # the peer's served result is untouched, ours landed beside it
+    assert peer.entry("p0001")["statistics"] == "peer-stats"
+    assert peer.entry("p0002")["state"] == "completed"
+
+
+def test_concurrent_new_key_registers_exactly_one_plan(
+    session, tmp_path,
+):
+    """Two replicas receive the SAME previously-unseen idempotency key
+    at the same instant: the key-scoped registration lease serializes
+    them — exactly one plan id is minted for the key and the journal
+    audit shows exactly one record."""
+    journal_dir = str(tmp_path / "journal")
+    a = FleetReplica(journal_dir=journal_dir, replica_id="gw-a",
+                     scan_interval_s=5.0)
+    b = FleetReplica(journal_dir=journal_dir, replica_id="gw-b",
+                     scan_interval_s=5.0)
+    a.start()
+    b.start()
+    query = _q(session)
+    results = {}
+    barrier = threading.Barrier(2)
+
+    def go(name, replica):
+        barrier.wait()
+        results[name] = replica.server.submit_query(
+            query, idempotency_key="race-key"
+        )
+    try:
+        threads = [
+            threading.Thread(target=go, args=(n, r))
+            for n, r in (("a", a), ("b", b))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        ids = {payload["plan_id"] for _, payload in results.values()}
+        assert len(ids) == 1, results
+        (plan_id,) = ids
+        journal = PlanJournal(journal_dir)
+        assert [e["plan_id"] for e in journal.entries()] == [plan_id]
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            entry = journal.entry(plan_id)
+            if entry["state"] != "submitted":
+                break
+            time.sleep(0.05)
+        assert entry["state"] == "completed"
+        # the registration claim never outlives the write-ahead record
+        assert not [
+            n for n in os.listdir(journal_dir) if n.startswith("key-")
+        ]
+    finally:
+        a.close()
+        b.close()
+
+
+def test_key_claim_degrades_after_wait_budget(session, tmp_path):
+    """A peer that took the key registration claim and then never
+    journaled its binding (died mid-registration, pre-timeout) must
+    not wedge submissions: past the wait budget the submit degrades to
+    a best-effort mint, counted."""
+    journal_dir = str(tmp_path / "journal")
+    ex = PlanExecutor(journal_dir=journal_dir, max_concurrent=1)
+    ex.leases = lease_mod.LeaseDir(journal_dir, holder="gw-a")
+    ex.key_claim_wait_s = 0.2
+    # a live foreign registrant that never journals its binding
+    peer = lease_mod.LeaseDir(journal_dir, holder="gw-peer")
+    assert isinstance(
+        peer.try_claim(lease_mod.key_claim_id("k-stuck")),
+        lease_mod.PlanLease,
+    )
+    before = obs.metrics.snapshot()["counters"].get(
+        "scheduler.key_claim_degraded", 0
+    )
+    try:
+        handle = ex.submit(_q(session), idempotency_key="k-stuck")
+        handle.result(timeout=300)
+    finally:
+        ex.close()
+    after = obs.metrics.snapshot()["counters"].get(
+        "scheduler.key_claim_degraded", 0
+    )
+    assert after == before + 1
+    assert PlanJournal(journal_dir).entry(
+        handle.plan_id
+    )["state"] == "completed"
 
 
 def test_keyed_resubmit_of_peer_held_plan_names_owner(session, tmp_path):
