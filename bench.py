@@ -132,6 +132,22 @@ train-step variants (tools/ingest_bench.py) with HBM-roofline context:
                   feature quantization behind the per-run gate — the
                   rung below bf16; the line's ``precision`` block
                   records the decision + gate_seconds)
+  pipeline_e2e_int4
+                  the cold query with precision=int4 (nibble-packed
+                  feature rows, two per byte, per-(channel, subband)
+                  group scales — the bottom rung of the ladder, same
+                  per-run gate machinery with the widest envelope)
+  serve_multitenant_quant
+                  the quantized tenant weight stack
+                  (weights_precision=int4 on serve/multiplex.py via
+                  tools/serve_bench.py): 16 tenants through the
+                  packed int4 stack + per-lane scales vs the same 16
+                  through the f32 multiplexed twin at concurrency 16
+                  — preds/sec pair + ratio, per-tenant margin parity
+                  within the weights gate tolerance, the
+                  resident-weight-bytes reduction (>=4x), and the
+                  0-compile add/swap/remove pin on the live
+                  quantized stack
 
 Resilience contract (round-1 BENCH artifact died rc=1 on a single
 ``Unable to initialize backend 'axon': UNAVAILABLE``): the parent
@@ -227,6 +243,10 @@ _VARIANT_TIMEOUTS = {
     # programs cold, then drives six sweeps (multiplexed + fleet at
     # three tenant levels) — same fresh-compile class
     "serve_multitenant": _SLOW_COMPILE_TIMEOUT_S,
+    # the quantized-stack child compiles the packed-weights fused AND
+    # mega lowerings cold on top of the f32 multiplexed twin — same
+    # fresh-compile class
+    "serve_multitenant_quant": _SLOW_COMPILE_TIMEOUT_S,
     # four fresh pipeline processes (2 pod workers + twin + degraded
     # run) in one child — the wall is ~4 population_vmap runs
     "population_multiproc": _SLOW_COMPILE_TIMEOUT_S,
@@ -242,7 +262,7 @@ _VARIANT_TIMEOUTS = {
 # patience — on a warm compile cache everything fits easily; on a
 # cold cache the tail variants may be budget-skipped (recorded as
 # such, artifact intact). BENCH_TOTAL_BUDGET overrides.
-_N_VARIANTS = 31  # asserted against the variant tables below
+_N_VARIANTS = 33  # asserted against the variant tables below
 _TOTAL_BUDGET_S = int(
     os.environ.get(
         "BENCH_TOTAL_BUDGET",
@@ -309,6 +329,9 @@ _VARIANTS_TPU = {
     # the int8 precision rung's cold twin (per-subband feature
     # quantization behind the per-run gate)
     "pipeline_e2e_int8": (2000, 4),
+    # the int4 rung's cold twin (nibble-packed feature rows, widest
+    # gate envelope on the ladder)
+    "pipeline_e2e_int4": (2000, 4),
     # population training engine (markers per file, file count): 16
     # SGD members as one vmapped program vs the same members looped,
     # plus the member axis sharded over the device mesh
@@ -354,6 +377,11 @@ _VARIANTS_TPU = {
     # prefix build, statistics byte-identical to solo), idempotent
     # re-submit replay, many-client chaos soak with submits/sec
     "plan_service": (2000, 4),
+    # the 16-tenant quantized (int4 packed + per-lane scales) weight
+    # stack vs the f32 multiplexed twin: preds/sec ratio, per-tenant
+    # margin parity, resident-weight-bytes reduction, and the
+    # 0-compile add/swap/remove pin on the quantized stack
+    "serve_multitenant_quant": (2000, 2),
     # the replicated gateway fleet (tools/pipeline_bench.py
     # gateway_fleet): 3 real replica processes over one shared
     # journal, SIGKILL the in-flight holder, takeover sha pinned
@@ -385,6 +413,7 @@ _VARIANTS_CPU = {
     "pipeline_e2e_overlap": (2000, 4),
     "pipeline_e2e_bf16": (2000, 4),
     "pipeline_e2e_int8": (2000, 4),
+    "pipeline_e2e_int4": (2000, 4),
     "population_vmap": (800, 2),
     "population_looped": (800, 2),
     "population_sharded": (800, 2),
@@ -395,6 +424,7 @@ _VARIANTS_CPU = {
     "serve_mega": (400, 2),
     "serve_lifecycle": (400, 2),
     "serve_multitenant": (400, 2),
+    "serve_multitenant_quant": (400, 2),
     "scheduler_multi": (2000, 4),
     "plan_service": (2000, 4),
     "gateway_fleet": (400, 2),

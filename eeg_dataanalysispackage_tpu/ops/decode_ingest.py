@@ -90,10 +90,12 @@ DECODE_FORMULATIONS = ("slice", "bank128")
 #: the feature precision ladder, loosest last (single source for the
 #: builder, the IR, the serving engine, and this module's validation):
 #: f32 is the ~1e-7 ladder-rung contract; bf16 computes the cascade
-#: contraction on bfloat16 operands; int8 quantizes the finished f32
-#: feature rows per subband. Every non-f32 rung runs behind a per-run
-#: measured-deviation gate with per-run auto-disable.
-PRECISIONS = ("f32", "bf16", "int8")
+#: contraction on bfloat16 operands; int8 and int4 quantize the
+#: finished f32 feature rows per subband (int4 lives in ops/quant.py:
+#: 4-bit levels, two nibbles per byte in the shipped representation).
+#: Every non-f32 rung runs behind a per-run measured-deviation gate
+#: with per-run auto-disable.
+PRECISIONS = ("f32", "bf16", "int8", "int4")
 
 #: env override for the platform-resolved formulation.
 ENV_FORMULATION = "EEG_TPU_DECODE_FORMULATION"
@@ -447,10 +449,11 @@ def make_decode_ingest_featurizer(
     :func:`default_formulation` (never cached — the
     'auto'-resolution staleness class device_ingest documents).
     ``precision="bf16"`` computes the cascade matmul in bfloat16 with
-    f32 accumulation; ``precision="int8"`` computes f32 features and
-    quantizes the finished rows per subband
-    (:func:`quantize_dequantize_int8` — the rung below bf16). Callers
-    gate every non-f32 rung per run (:func:`feature_precision_gate` /
+    f32 accumulation; ``precision="int8"`` / ``"int4"`` compute f32
+    features and quantize the finished rows per subband
+    (:func:`quantize_dequantize_int8` and ``quant.int4_feature_path``
+    — the rungs below bf16, loosest last). Callers gate every non-f32
+    rung per run (:func:`feature_precision_gate` /
     pipeline/builder.py).
     ``donate_stream`` donates the staged int16 stream buffer to the
     program (the overlap path's ping/pong staging — the stream is
@@ -476,12 +479,16 @@ def make_decode_ingest_featurizer(
             out = _bank_featurize(
                 raw_i16, resolutions, positions, mask,
                 wavelet_index, epoch_size, skip_samples, feature_size,
-                # int8 quantizes FINISHED f32 rows; the kernel itself
-                # runs the f32 formulation (bf16 keeps its twin)
+                # int8/int4 quantize FINISHED f32 rows; the kernel
+                # itself runs the f32 formulation (bf16 keeps its twin)
                 pre, "bf16" if precision == "bf16" else "f32",
             )
             if precision == "int8":
                 out = int8_feature_path(out, feature_size)
+            elif precision == "int4":
+                from . import quant
+
+                out = quant.int4_feature_path(out, feature_size)
             return out
         donate = donate_stream and jax.default_backend() != "cpu"
         run = _slice_program(
@@ -523,6 +530,10 @@ def make_decode_ingest_featurizer(
             # quantize the finished rows (padded/masked rows are zero
             # and stay zero — abs-max scales never see them as peaks)
             out = int8_feature_path(out, feature_size)
+        elif precision == "int4":
+            from . import quant
+
+            out = quant.int4_feature_path(out, feature_size)
         return out
 
     featurize.tile = tile
@@ -675,6 +686,10 @@ def precision_gate_tolerance(precision: str) -> float:
         return bf16_gate_tolerance()
     if precision == "int8":
         return int8_gate_tolerance()
+    if precision == "int4":
+        from . import quant
+
+        return quant.int4_gate_tolerance()
     raise ValueError(
         f"precision {precision!r} has no accuracy gate (f32 IS the "
         f"reference)"

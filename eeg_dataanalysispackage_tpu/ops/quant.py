@@ -1,0 +1,487 @@
+"""The 4-bit tier (ISSUE 18): the int4 feature rung below int8, and
+int8/int4 quantization of the multiplexed tenant weight stack.
+
+Two halves, one gate discipline:
+
+**int4 features** — ``precision=int4`` quantizes FINISHED f32 feature
+rows with the same per-(row, channel, subband)-group symmetric scales
+the int8 rung uses (``decode_ingest.quantize_dequantize_int8``), one
+rung looser: 4-bit symmetric levels (qmax = 7), two nibbles packed per
+byte in the shipped representation. The in-graph round trip IS the
+rung (downstream keeps its f32 contract while every value has passed
+through 4 bits); :func:`pack_int4_rows` / :func:`unpack_int4_rows`
+pin that the packed wire format reconstructs the round trip exactly.
+Gated per run by :data:`INT4_GATE_TOL` (override
+``EEG_TPU_INT4_GATE_TOL``) with per-run auto-disable
+(``pipeline.int4_gate_disabled``) — the bf16/int8 policy verbatim.
+
+**quantized weight stack** — ``weights_precision=int8|int4`` on the
+multiplexed engine keeps the (d, 128) f32 host mirror as master (so
+tenant add/swap/remove stays zero-recompile device_put) but makes the
+RESIDENT matrix the packed int8/int4 payload plus per-lane scales,
+dequantized inside the program (:func:`dequantize_weight_stack` — VPU
+elementwise, feeding the existing single MXU dot). Per-lane scales
+deliberately: a lane is one tenant's model, and a cross-tenant max
+would couple one tenant's quantization grid to its neighbors' weight
+magnitudes (a swap_model on lane 3 would move lane 7's margins).
+Promotion rides the established warmup margin-parity gate
+(:func:`weights_gate_tolerance`), 2 consecutive failures degrade back
+to the f32 stack, and the resident-bytes win (4x/8x) is accounted on
+serve stats and bench lines — never assumed.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import numpy as np
+
+from . import decode_ingest
+
+#: int4 feature gate: max abs deviation of the int4-quantized feature
+#: rows vs the f32 reference on the SAME rows before the rung
+#: auto-disables. The arithmetic envelope follows the quantizer:
+#: symmetric per-(channel, subband) scales put the worst rounding
+#: error at scale/2 = group_max/14, and L2-normalized rows keep
+#: group_max <= 1, so the envelope is ~7.2e-2 — eighteen times the
+#: int8 rung's (~4e-3), which is what dropping 4 bits costs. 1.5e-1
+#: is ~2x that envelope (the bf16 gate's headroom factor, tighter
+#: than int8's 5x: at 4 bits the gate is the load-bearing safety and
+#: should trip on anything beyond plain rounding). Override for
+#: experiments via EEG_TPU_INT4_GATE_TOL.
+INT4_GATE_TOL = 1.5e-1
+
+#: symmetric 4-bit quantization levels: q in [-7, 7], stored +8 as a
+#: nibble in [1, 15] (0 never occurs — a cheap corruption tripwire).
+INT4_QMAX = 7.0
+
+#: the weight-stack precision grammar (single source for the
+#: multiplexed engine, the bench, and tests). f32 is the PR 16
+#: baseline: the host mirror device_put verbatim.
+WEIGHTS_PRECISIONS = ("f32", "int8", "int4")
+
+#: headroom factor on the weight-stack gate's arithmetic envelope
+#: (|delta margin| <= ||f||_2 * ||delta w||_2 <= sqrt(d) * s_max / 2
+#: for L2-normalized feature rows): the same order the feature gates
+#: carry over their own envelopes.
+WEIGHTS_GATE_HEADROOM = 4.0
+
+#: pre-registered accelerator flip (docs/chip_playbook.md): the
+#: quantized stack's conc-16 predictions/sec must hold >= this ratio
+#: of the f32 multiplexed engine's on chip before weights_precision
+#: defaults quantized on that platform. Below 1.0 deliberately: the
+#: quantized stack's win is resident VMEM bytes (4x/8x — N tenants'
+#: weights next to the megakernel instead of paged from HBM), so a
+#: small throughput toll is a fair trade, but >5% is not.
+WEIGHTS_QUANT_FLIP_RATIO = 0.95
+
+#: sweep-artifact filename stems carrying a serve_multitenant_quant
+#: chip run (staged by tools/collect_chip_runs.sh).
+_QUANT_ARTIFACTS = ("serve_multitenant_quant*.json",)
+
+
+def int4_gate_tolerance() -> float:
+    """The documented int4 feature gate (:data:`INT4_GATE_TOL`), with
+    the experiment override ``EEG_TPU_INT4_GATE_TOL`` — same
+    logged-never-silent fallback policy as the bf16/int8 gates."""
+    import logging
+    import os
+
+    raw = os.environ.get("EEG_TPU_INT4_GATE_TOL")
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            logging.getLogger(__name__).warning(
+                "EEG_TPU_INT4_GATE_TOL=%r is not a float; using the "
+                "default gate %g", raw, INT4_GATE_TOL,
+            )
+    return INT4_GATE_TOL
+
+
+def quantize_dequantize_int4(rows, feature_size: int):
+    """The int4 feature rung's core (traceable): symmetric
+    per-(row, channel, subband) scales, round-to-nearest into 4-bit
+    levels, immediate dequantization back to f32 — the int8 core
+    (``decode_ingest.quantize_dequantize_int8``) with qmax = 7.
+
+    Returns ``(dequantized rows (n, C*K) f32, scales
+    (n_groups, n, C) f32)``. Scales are per ROW (batch-invariance:
+    bit-identical whatever micro-batch a window rides in),
+    deterministic rounding (cache contract), zero rows stay exactly
+    zero. See the int8 docstring for why each invariant is
+    load-bearing; all three transfer verbatim.
+    """
+    import jax.numpy as jnp
+
+    n = rows.shape[0]
+    K = int(feature_size)
+    C = rows.shape[1] // K
+    x = rows.reshape(n, C, K)
+    outs = []
+    scales = []
+    for lo, hi in decode_ingest.subband_group_bounds(K):
+        g = x[:, :, lo:hi]
+        s = jnp.max(jnp.abs(g), axis=2) / INT4_QMAX  # (n, C)
+        s = jnp.maximum(s, 1e-30)  # all-zero group: 0/s stays 0
+        q = jnp.clip(
+            jnp.round(g / s[..., None]), -INT4_QMAX, INT4_QMAX
+        )
+        outs.append(q.astype(jnp.int8).astype(jnp.float32)
+                    * s[..., None])
+        scales.append(s)
+    return (
+        jnp.concatenate(outs, axis=2).reshape(n, C * K),
+        jnp.stack(scales),
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _int4_path_program(feature_size: int):
+    import jax
+
+    @jax.jit
+    def run(rows):
+        dq, _ = quantize_dequantize_int4(rows, feature_size)
+        return dq
+
+    return run
+
+
+def int4_feature_path(rows, feature_size: int):
+    """Jitted quantize→dequantize pass over finished feature rows —
+    the int4 rung the decode featurizer (and the serving engine's
+    int4 program) applies after the f32 math."""
+    return _int4_path_program(int(feature_size))(rows)
+
+
+def pack_int4_rows(q) -> np.ndarray:
+    """Pack integer 4-bit levels ``q (n, d) in [-7, 7]`` two nibbles
+    per byte along the column axis (d even): byte j of a row carries
+    column 2j in its low nibble and 2j+1 in its high nibble, each
+    stored +8 (so the wire value is in [1, 15] and a zero byte is
+    provably corruption, never data)."""
+    q = np.asarray(q)
+    if q.ndim != 2 or q.shape[1] % 2:
+        raise ValueError(
+            f"int4 packing needs an (n, even) matrix, got {q.shape}"
+        )
+    shifted = q.astype(np.int32) + 8
+    if shifted.size and (shifted.min() < 1 or shifted.max() > 15):
+        raise ValueError(
+            f"int4 levels out of [-7, 7]: [{q.min()}, {q.max()}]"
+        )
+    return (shifted[:, 0::2] | (shifted[:, 1::2] << 4)).astype(
+        np.uint8
+    )
+
+
+def unpack_int4_rows(packed) -> np.ndarray:
+    """Inverse of :func:`pack_int4_rows`: ``(n, d//2) uint8`` back to
+    ``(n, d) int32`` levels in [-7, 7]."""
+    p = np.asarray(packed, np.uint8).astype(np.int32)
+    lo = (p & 0xF) - 8
+    hi = (p >> 4) - 8
+    return np.stack([lo, hi], axis=2).reshape(p.shape[0], -1)
+
+
+def quantize_int4_packed(rows, feature_size: int):
+    """The shipped int4 representation of finished feature rows:
+    ``(packed (n, C*K//2) uint8, scales (n_groups, n, C) f32)`` —
+    host-side, numpy. :func:`dequantize_int4_packed` reconstructs the
+    in-graph round trip (:func:`quantize_dequantize_int4`) exactly;
+    tests pin the equivalence, so the traceable round trip and the
+    wire format can never drift apart."""
+    rows = np.asarray(rows, np.float32)
+    n = rows.shape[0]
+    K = int(feature_size)
+    C = rows.shape[1] // K
+    x = rows.reshape(n, C, K)
+    qs = []
+    scales = []
+    for lo, hi in decode_ingest.subband_group_bounds(K):
+        g = x[:, :, lo:hi]
+        s = np.max(np.abs(g), axis=2) / INT4_QMAX
+        s = np.maximum(s, 1e-30)
+        qs.append(
+            np.clip(np.round(g / s[..., None]), -INT4_QMAX, INT4_QMAX)
+        )
+        scales.append(s)
+    q = np.concatenate(qs, axis=2).reshape(n, C * K).astype(np.int8)
+    return pack_int4_rows(q), np.stack(scales).astype(np.float32)
+
+
+def dequantize_int4_packed(
+    packed, scales, feature_size: int
+) -> np.ndarray:
+    """Reconstruct f32 rows from the packed int4 representation —
+    bitwise the in-graph round trip's output."""
+    q = unpack_int4_rows(packed).astype(np.float32)
+    n = q.shape[0]
+    K = int(feature_size)
+    C = q.shape[1] // K
+    x = q.reshape(n, C, K)
+    outs = []
+    for i, (lo, hi) in enumerate(
+        decode_ingest.subband_group_bounds(K)
+    ):
+        outs.append(
+            x[:, :, lo:hi]
+            * np.asarray(scales[i], np.float32)[..., None]
+        )
+    return np.concatenate(outs, axis=2).reshape(n, C * K)
+
+
+def subband_lane_masks(
+    n_channels: int, feature_size: int
+) -> tuple:
+    """The (channel, subband) groups of the channel-major ``(C*K,)``
+    feature layout as disjoint 0/1 float32 lane masks — the
+    full-lane-ops spelling of ``subband_group_bounds`` for code that
+    cannot reshape or lane-slice (Mosaic kernels: lane-split reshapes
+    and dynamic lane slices are the documented remote-compile crasher
+    class)."""
+    bounds = decode_ingest.subband_group_bounds(int(feature_size))
+    d = int(n_channels) * int(feature_size)
+    masks = []
+    for c in range(int(n_channels)):
+        base = c * int(feature_size)
+        for lo, hi in bounds:
+            m = np.zeros((d,), np.float32)
+            m[base + lo:base + hi] = 1.0
+            masks.append(m)
+    return tuple(masks)
+
+
+def masked_quantize_dequantize(feats, masks, qmax: float):
+    """Grouped symmetric quantize→dequantize via disjoint lane masks —
+    numerically identical to the reshape-based cores
+    (``quantize_dequantize_int8`` / :func:`quantize_dequantize_int4`)
+    but built from full-lane VPU ops only (abs, row-max, divide,
+    round, clip, multiply, add): safe inside the mega Pallas kernel.
+
+    Identity argument, group by group: ``max(|feats| * m, axis=1)``
+    is the group's abs-max (masked-off lanes contribute 0, and an
+    abs-max is >= 0), the scalar scale math is the same f32 ops in
+    the same order, and each lane receives exactly one group's
+    ``m * (q * s)`` plus zeros.
+    """
+    import jax.numpy as jnp
+
+    out = jnp.zeros_like(feats)
+    a = jnp.abs(feats)
+    for m in masks:
+        mv = jnp.asarray(m, feats.dtype)
+        s = jnp.max(a * mv, axis=1, keepdims=True) / qmax
+        s = jnp.maximum(s, 1e-30)
+        q = jnp.clip(jnp.round(feats / s), -qmax, qmax)
+        out = out + mv * (q * s)
+    return out
+
+
+def _weights_qmax(precision: str) -> float:
+    if precision == "int8":
+        return 127.0
+    if precision == "int4":
+        return INT4_QMAX
+    raise ValueError(
+        f"weights_precision {precision!r} has no quantized form; use "
+        f"one of {WEIGHTS_PRECISIONS[1:]}"
+    )
+
+
+def quantize_weight_stack(w_host, precision: str):
+    """Quantize the multiplexed engine's (d, 128) f32 host mirror into
+    the resident payload: ``(packed, scales (128,) f32)`` — packed is
+    ``(d, 128) int8`` for int8 or ``(d//2, 128) uint8`` for int4 (row
+    2i in the low nibble, 2i+1 in the high, each stored +8).
+
+    Scales are per LANE (symmetric, ``max|w[:, lane]| / qmax``): one
+    lane is one tenant's model, and a cross-lane max would couple a
+    tenant's quantization grid to its neighbors' magnitudes — a
+    ``swap_model`` on one lane would move every other tenant's
+    margins, breaking the snapshot-isolation contract. Host-side
+    numpy: this runs inside ``_publish`` on the admin path, never in
+    the program."""
+    w = np.asarray(w_host, np.float32)
+    qmax = _weights_qmax(precision)
+    s = np.max(np.abs(w), axis=0) / qmax  # (lanes,)
+    s = np.maximum(s, 1e-30).astype(np.float32)
+    q = np.clip(np.rint(w / s[None, :]), -qmax, qmax)
+    if precision == "int8":
+        return q.astype(np.int8), s
+    if w.shape[0] % 2:
+        raise ValueError(
+            f"int4 weight packing needs an even row count, got "
+            f"{w.shape[0]}"
+        )
+    shifted = q.astype(np.int32) + 8
+    packed = (shifted[0::2, :] | (shifted[1::2, :] << 4)).astype(
+        np.uint8
+    )
+    return packed, s
+
+
+def dequantize_weight_stack(packed, scales, precision: str, n_rows: int):
+    """Traceable inverse of :func:`quantize_weight_stack` — the VPU
+    dequant that runs INSIDE the serving program (elementwise ops on
+    the resident payload, feeding the existing single MXU dot). For
+    int4 the nibble split is uint8 bitwise + an interleaving stack,
+    kept OUTSIDE any Pallas kernel body: sub-byte unpacking in Mosaic
+    would need int8 blocks below the (32, 128) minimum tile or
+    lane-split reshapes — the documented remote-compile crasher class
+    — so the packed->f32 expansion is plain XLA and the kernel keeps
+    its f32 contract."""
+    import jax.numpy as jnp
+
+    scales = jnp.asarray(scales, jnp.float32)
+    if precision == "int8":
+        return packed.astype(jnp.float32) * scales[None, :]
+    if precision == "int4":
+        lo = (packed & np.uint8(0xF)).astype(jnp.float32) - 8.0
+        hi = (packed >> np.uint8(4)).astype(jnp.float32) - 8.0
+        vals = jnp.stack([lo, hi], axis=1).reshape(
+            int(n_rows), packed.shape[1]
+        )
+        return vals * scales[None, :]
+    raise ValueError(
+        f"weights_precision {precision!r} has no quantized form; use "
+        f"one of {WEIGHTS_PRECISIONS[1:]}"
+    )
+
+
+def weights_gate_tolerance(precision: str, w_host) -> float:
+    """The quantized weight stack's warmup margin-parity gate: the
+    arithmetic envelope of the margin perturbation, with headroom.
+    ``|delta margin| = |f . delta_w| <= ||f||_2 * ||delta_w||_2``,
+    feature rows are L2-normalized (``||f||_2 <= 1``), and symmetric
+    rounding bounds each weight's error by ``s_max / 2``, so
+    ``||delta_w||_2 <= sqrt(d) * s_max / 2`` with ``s_max`` the
+    largest per-lane scale in the CURRENT stack — the gate tightens
+    automatically for small-magnitude models instead of waving a
+    fixed constant at everything. ``EEG_TPU_WEIGHTS_GATE_TOL``
+    overrides with an ABSOLUTE tolerance (0 forces the gate shut:
+    the auto-disable drill)."""
+    import logging
+    import os
+
+    raw = os.environ.get("EEG_TPU_WEIGHTS_GATE_TOL")
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            logging.getLogger(__name__).warning(
+                "EEG_TPU_WEIGHTS_GATE_TOL=%r is not a float; using "
+                "the derived envelope gate", raw,
+            )
+    w = np.asarray(w_host, np.float32)
+    qmax = _weights_qmax(precision)
+    s_max = (float(np.max(np.abs(w))) if w.size else 0.0) / qmax
+    s_max = max(s_max, 1e-30)
+    return WEIGHTS_GATE_HEADROOM * math.sqrt(w.shape[0]) * s_max / 2.0
+
+
+def resident_weight_bytes(packed, scales) -> int:
+    """What the quantized stack actually keeps resident: the packed
+    matrix plus its per-lane scales (the f32 twin's number is the
+    mirror's nbytes; both land on stats and bench lines so the 4x/8x
+    claim is accounted, never assumed)."""
+    return int(
+        np.asarray(packed).nbytes + np.asarray(scales).nbytes
+    )
+
+
+def accelerator_decision(root: str | None = None) -> dict:
+    """The quantized weight stack's accelerator decision, as DATA:
+    harvest the best on-chip ``serve_multitenant_quant`` sweep (staged
+    by tools/collect_chip_runs.sh) and judge its 16-tenant
+    quantized-vs-f32-multiplexed throughput ratio against the
+    pre-registered :data:`WEIGHTS_QUANT_FLIP_RATIO`. Returns
+    ``{"quantize_stack", "quant_preds_per_s", "f32_preds_per_s",
+    "ratio", "weights_precision", "source", "threshold_ratio",
+    "reason"}`` — artifact lands, the residency default flips, zero
+    code change."""
+    import glob
+    import json
+    import os
+
+    from . import serve_mega
+
+    base = root or serve_mega._sweep_results_root()
+    best = None
+    best_src = None
+    for pattern in _QUANT_ARTIFACTS:
+        for path in glob.glob(os.path.join(base, "*", pattern)):
+            try:
+                if os.path.getsize(path) == 0:
+                    continue
+                with open(path) as f:
+                    rec = json.loads(f.read().strip().splitlines()[-1])
+            except (OSError, ValueError, IndexError):
+                continue
+            if rec.get("platform") not in ("tpu", "axon"):
+                continue
+            block = (
+                (rec.get("serve") or {}).get("multitenant_quant") or {}
+            )
+            if block.get("tenants") != 16:
+                continue
+            qps = (block.get("quant") or {}).get("preds_per_s")
+            fps = (block.get("f32") or {}).get("preds_per_s")
+            wp = block.get("weights_precision")
+            if not (
+                isinstance(qps, (int, float))
+                and isinstance(fps, (int, float))
+                and qps > 0 and fps > 0
+            ):
+                continue
+            if best is None or qps / fps > best[0]:
+                best, best_src = (qps / fps, qps, fps, wp), path
+    decision = {
+        "threshold_ratio": WEIGHTS_QUANT_FLIP_RATIO,
+        "source": (
+            os.path.relpath(best_src, os.path.dirname(base))
+            if best_src
+            else None
+        ),
+    }
+    if best is None:
+        decision.update(
+            quantize_stack=False,
+            reason=(
+                "no on-chip serve_multitenant_quant artifact staged; "
+                "the f32 stack stands until one lands"
+            ),
+        )
+        return decision
+    ratio, qps, fps, wp = best
+    decision.update(
+        quant_preds_per_s=qps,
+        f32_preds_per_s=fps,
+        weights_precision=wp,
+        ratio=round(ratio, 4),
+    )
+    if ratio >= WEIGHTS_QUANT_FLIP_RATIO:
+        decision.update(
+            quantize_stack=True,
+            reason=(
+                f"serve_multitenant_quant measured {qps:.0f} preds/s "
+                f"on chip at 16 tenants >= "
+                f"{WEIGHTS_QUANT_FLIP_RATIO:g}x the f32 multiplexed "
+                f"engine ({fps:.0f}); the quantized stack's "
+                f"resident-bytes win is free — quantize"
+            ),
+        )
+    else:
+        decision.update(
+            quantize_stack=False,
+            reason=(
+                f"serve_multitenant_quant measured {qps:.0f} preds/s "
+                f"on chip at 16 tenants < "
+                f"{WEIGHTS_QUANT_FLIP_RATIO:g}x the f32 multiplexed "
+                f"engine ({fps:.0f}); the throughput toll outweighs "
+                f"residency — the f32 stack stands"
+            ),
+        )
+    return decision

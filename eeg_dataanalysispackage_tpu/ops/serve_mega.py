@@ -257,7 +257,8 @@ def default_engine_rung() -> str:
 
 
 def _make_mega_kernel(n_channels: int, tile_b: int, stride: int,
-                      pre: int, feature_size: int):
+                      pre: int, feature_size: int,
+                      precision: str = "f32"):
     """The Pallas kernel body: one grid step = ``tile_b`` windows.
 
     ``a_ref`` is the step's stream block in the rows-of-128 layout
@@ -265,9 +266,29 @@ def _make_mega_kernel(n_channels: int, tile_b: int, stride: int,
     construct here is from the bank128 kernel's chip-proven set —
     lane-contiguous reshapes, STATIC lane slices (offsets are
     ``e * stride`` with ``stride % 128 == 0``), MXU dots with f32
-    accumulation, VPU reductions."""
+    accumulation, VPU reductions.
+
+    ``precision="int8"|"int4"`` quantizes the finished feature rows
+    before the margin dot via the MASKED grouped quantizer
+    (ops/quant.masked_quantize_dequantize): full-lane VPU ops only —
+    the reshape-based cores' ``(n, C, K)`` regrouping is a lane-split
+    reshape, the documented remote-compile crasher class — and
+    numerically identical to them, so the kernel's margins parity-gate
+    against the fused quantized program like the f32 kernel does
+    against the fused f32 program."""
+    from . import quant
+
     C = n_channels
     K = feature_size
+    if precision in ("int8", "int4"):
+        masks = quant.subband_lane_masks(C, K)
+        qmax = 127.0 if precision == "int8" else quant.INT4_QMAX
+    elif precision != "f32":
+        raise ValueError(
+            f"mega kernel precision {precision!r}; use f32, int8, or "
+            f"int4 (bf16 has no mega twin — its cascade runs bf16 "
+            f"operands, not quantized f32 rows)"
+        )
 
     def kernel(a_ref, res_ref, e_ref, wm_ref, o_ref, xa_ref):
         # decode: int16 (or staged f32) block -> scaled f32, once
@@ -288,6 +309,10 @@ def _make_mega_kernel(n_channels: int, tile_b: int, stride: int,
             preferred_element_type=jnp.float32,
         )  # (tile_b*C, K)
         feats = dwt_xla.safe_l2_normalize(y.reshape(tile_b, C * K))
+        if precision in ("int8", "int4"):
+            feats = quant.masked_quantize_dequantize(
+                feats, masks, qmax
+            )
         # margin: one more MXU dot against the weights padded to a
         # 128-lane matrix (column 0 carries the model; features never
         # leave VMEM)
@@ -314,6 +339,7 @@ def _mega_program(
     interpret: bool,
     donate: bool,
     tile_b: int = MEGA_TILE,
+    precision: str = "f32",
 ):
     """The jitted megakernel program, cached per geometry/capacity:
     ``(stream (C, capacity*Wp), resolutions (C,), weights (C*K,)) ->
@@ -321,7 +347,20 @@ def _mega_program(
     program's fused matvec). One compiled program serves every batch
     size 1..capacity — padded windows are zero, each window's compute
     is row-independent, so a window's margin is BIT-IDENTICAL whatever
-    batch it rides in (pinned in tests/test_serve_mega.py)."""
+    batch it rides in (pinned in tests/test_serve_mega.py).
+
+    ``precision="int8"|"int4"`` quantizes the finished feature rows
+    before the margin (the quantized-feature engines' mega twin —
+    ISSUE 18 closes the PR 12 leftover that hard-pinned them to
+    fused): the XLA twin applies the SAME canonical quantize cores the
+    fused program uses, the pallas kernel the masked spelling of
+    them."""
+    if precision not in ("f32", "int8", "int4"):
+        raise ValueError(
+            f"mega precision {precision!r}; use f32, int8, or int4 "
+            f"(bf16 has no mega twin — its cascade runs bf16 "
+            f"operands, not quantized f32 rows)"
+        )
     if capacity % tile_b:
         raise ValueError(
             f"mega capacity {capacity} must be a multiple of the "
@@ -375,6 +414,20 @@ def _mega_program(
                 y.reshape(C, capacity, K), (1, 0, 2)
             ).reshape(capacity, C * K)
             feats = dwt_xla.safe_l2_normalize(feats)
+            # the quantized-feature rungs: the CANONICAL cores — the
+            # exact traceables the fused serving program runs, so
+            # feature rows (and thus margins, modulo the dot
+            # formulations' documented drift) parity-gate cleanly
+            if precision == "int8":
+                from . import decode_ingest
+
+                feats, _ = decode_ingest.quantize_dequantize_int8(
+                    feats, K
+                )
+            elif precision == "int4":
+                from . import quant
+
+                feats, _ = quant.quantize_dequantize_int4(feats, K)
             return jnp.dot(
                 feats, weights.astype(jnp.float32),
                 precision=lax.Precision.HIGHEST,
@@ -388,7 +441,7 @@ def _mega_program(
         )
 
     rpw = Wp // 128
-    kernel = _make_mega_kernel(C, tile_b, Wp, pre, K)
+    kernel = _make_mega_kernel(C, tile_b, Wp, pre, K, precision)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=0,
         grid=(capacity // tile_b,),
@@ -441,11 +494,23 @@ def _mega_multi_program(
     interpret: bool,
     donate: bool,
     tile_b: int = MEGA_TILE,
+    weights_precision: str = "f32",
 ):
     """The tenant-stacked megakernel: ``(stream, resolutions,
     weight_matrix (C*K, 128), tenant_lanes (capacity,) int32) ->
     margins (capacity,)``, one compiled program for every tenant mix
     (serve/multiplex.py).
+
+    ``weights_precision="int8"|"int4"`` swaps the weight-matrix
+    argument for ``(packed, scales)`` — the quantized stack's
+    RESIDENT payload (ops/quant.py) — and reconstructs the (C*K, 128)
+    f32 matrix inside the program with elementwise VPU ops feeding
+    the SAME single MXU dot (pallas) / HIGHEST matmul (xla twin). The
+    dequant stays OUTSIDE the kernel body deliberately: sub-byte
+    nibble unpacking in Mosaic needs int8 blocks under the (32, 128)
+    minimum tile or lane-split reshapes — the remote-compile crasher
+    class — while as plain XLA it fuses into the program for free and
+    the kernel keeps its chip-proven f32 contract.
 
     The solo kernel ALREADY computes the full ``(tile_b, 128)`` margin
     matrix against a 128-lane weight matrix and discards 127 columns;
@@ -488,11 +553,34 @@ def _mega_multi_program(
     )
     donate_args = (0,) if donate else ()
 
+    if weights_precision not in ("f32", "int8", "int4"):
+        raise ValueError(
+            f"mega weights_precision {weights_precision!r}; use one "
+            f"of ('f32', 'int8', 'int4')"
+        )
+
+    def wrap_quantized(inner):
+        """Adapt a ``(stream, res, weight_matrix, lanes)`` body to the
+        quantized-stack signature ``(stream, res, packed, scales,
+        lanes)``: the resident payload expands to f32 inside the
+        program (ops/quant.dequantize_weight_stack — elementwise, VPU
+        on Mosaic platforms) and the margin math is untouched."""
+        if weights_precision == "f32":
+            return inner
+        from . import quant
+
+        def run(stream, resolutions, packed, scales, tenant_lanes):
+            wm = quant.dequantize_weight_stack(
+                packed, scales, weights_precision, C * K
+            )
+            return inner(stream, resolutions, wm, tenant_lanes)
+
+        return run
+
     if lowering == "xla":
         W_np = E_np[pre + skip_samples: pre + skip_samples + epoch_size]
 
-        @functools.partial(jax.jit, donate_argnums=donate_args)
-        def run(stream, resolutions, weight_matrix, tenant_lanes):
+        def body(stream, resolutions, weight_matrix, tenant_lanes):
             W = jnp.asarray(W_np)
             rows = stream.reshape(C, capacity, Wp)
             scale = resolutions[:, None, None]
@@ -525,7 +613,9 @@ def _mega_multi_program(
                 columns, tenant_lanes[:, None], axis=1
             )[:, 0]
 
-        return run
+        return jax.jit(
+            wrap_quantized(body), donate_argnums=donate_args
+        )
 
     if lowering != "pallas":
         raise ValueError(
@@ -551,8 +641,7 @@ def _mega_multi_program(
         ],
     )
 
-    @functools.partial(jax.jit, donate_argnums=donate_args)
-    def run(stream, resolutions, weight_matrix, tenant_lanes):
+    def body(stream, resolutions, weight_matrix, tenant_lanes):
         out = pl.pallas_call(
             kernel,
             grid_spec=grid_spec,
@@ -568,7 +657,7 @@ def _mega_multi_program(
             out, tenant_lanes[:, None], axis=1
         )[:, 0]
 
-    return run
+    return jax.jit(wrap_quantized(body), donate_argnums=donate_args)
 
 
 def make_serve_mega_multi_program(
@@ -583,10 +672,14 @@ def make_serve_mega_multi_program(
     lowering: str | None = None,
     interpret: bool | None = None,
     donate: bool | None = None,
+    weights_precision: str = "f32",
 ):
     """Build (or fetch cached) the tenant-stacked megakernel program
     for one serving geometry — the multi-tenant twin of
-    :func:`make_serve_mega_program`, same resolution rules."""
+    :func:`make_serve_mega_program`, same resolution rules.
+    ``weights_precision="int8"|"int4"`` builds the packed-stack
+    lowering: ``(stream, resolutions, packed, scales, tenant_lanes)``
+    with the dequant inside the program."""
     from . import pallas_support
 
     if lowering is None:
@@ -599,6 +692,7 @@ def make_serve_mega_multi_program(
         int(wavelet_index), int(epoch_size), int(skip_samples),
         int(feature_size), int(n_channels), int(pre), int(post),
         int(capacity), str(lowering), bool(interpret), bool(donate),
+        weights_precision=str(weights_precision),
     )
 
 
@@ -614,6 +708,7 @@ def make_serve_mega_program(
     lowering: str | None = None,
     interpret: bool | None = None,
     donate: bool | None = None,
+    precision: str = "f32",
 ):
     """Build (or fetch cached) the megakernel program for one serving
     geometry. ``lowering`` None resolves per platform
@@ -622,7 +717,9 @@ def make_serve_mega_program(
     ``lowering="pallas", interpret=True`` for hermetic kernel parity);
     ``donate`` None donates the staged stream on accelerator backends
     only (the engine's established donation policy — XLA:CPU cannot
-    alias it and would warn per call)."""
+    alias it and would warn per call). ``precision="int8"|"int4"``
+    builds the quantized-feature twin (the finished rows pass through
+    that rung's quantizer before the margin)."""
     from . import pallas_support
 
     if lowering is None:
@@ -635,6 +732,7 @@ def make_serve_mega_program(
         int(wavelet_index), int(epoch_size), int(skip_samples),
         int(feature_size), int(n_channels), int(pre), int(post),
         int(capacity), str(lowering), bool(interpret), bool(donate),
+        precision=str(precision),
     )
 
 

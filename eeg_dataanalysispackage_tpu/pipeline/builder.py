@@ -335,7 +335,7 @@ class PipelineBuilder:
         )
         if precision not in _decode_ingest.PRECISIONS:
             raise ValueError(
-                f"precision= must be f32, bf16, or int8, got "
+                f"precision= must be f32, bf16, int8, or int4, got "
                 f"{precision!r}"
             )
         if precision != "f32" and not fused:

@@ -416,11 +416,11 @@ class ExecutionPlan:
                     f"got {overlap_value!r}"
                 )
             if precision is not None and precision not in (
-                "f32", "bf16", "int8"
+                "f32", "bf16", "int8", "int4"
             ):
                 _raise(
-                    f"precision= must be f32, bf16, or int8, got "
-                    f"{precision!r}"
+                    f"precision= must be f32, bf16, int8, or int4, "
+                    f"got {precision!r}"
                 )
             import re
 
@@ -434,7 +434,7 @@ class ExecutionPlan:
                 suffix = fused_match.group(2)
                 if suffix is not None:
                     fused_backend = suffix[1:]
-            if precision in ("bf16", "int8"):
+            if precision in ("bf16", "int8", "int4"):
                 if not fused:
                     _raise(
                         f"precision={precision} applies to the fused "
@@ -688,7 +688,7 @@ class ExecutionPlan:
                 "— run it single-host (devices= still shards the "
                 "member axis)"
             )
-        if query_map.get("precision") in ("bf16", "int8"):
+        if query_map.get("precision") in ("bf16", "int8", "int4"):
             # statically decidable half of the builder's runtime
             # check (an env-resolved EEG_TPU_PRECISION still lands on
             # the execution-time guard): the reduced-precision gate

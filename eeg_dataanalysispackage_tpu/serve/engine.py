@@ -83,13 +83,14 @@ def _serving_program(
     the featurizer — features never round-trip to the host before the
     decision. Weights ride as a traced argument, so swapping a model
     recompiles nothing. ``precision="bf16"`` runs the featurizer's
-    cascade contraction on bfloat16 epochs; ``precision="int8"``
-    computes f32 features and quantizes the finished rows per subband
-    (ops/decode_ingest.quantize_dequantize_int8) before the margin —
-    both gate at warmup and fall back to the f32 program above their
-    documented tolerance.
+    cascade contraction on bfloat16 epochs; ``precision="int8"`` /
+    ``"int4"`` compute f32 features and quantize the finished rows per
+    subband (ops/decode_ingest.quantize_dequantize_int8 /
+    ops/quant.quantize_dequantize_int4) before the margin — every
+    non-f32 rung gates at warmup and falls back to the f32 program
+    above its documented tolerance.
     """
-    from ..ops import decode_ingest
+    from ..ops import decode_ingest, quant
 
     featurizer = device_ingest.make_device_ingest_featurizer(
         wavelet_index=wavelet_index,
@@ -106,6 +107,10 @@ def _serving_program(
         feats = featurizer(raw, resolutions, positions, mask)
         if precision == "int8":
             feats, _ = decode_ingest.quantize_dequantize_int8(
+                feats, feature_size
+            )
+        elif precision == "int4":
+            feats, _ = quant.quantize_dequantize_int4(
                 feats, feature_size
             )
         return feats
@@ -142,6 +147,7 @@ def _multi_serving_program(
     pre: int,
     post: int,
     precision: str = "f32",
+    weights_precision: str = "f32",
 ):
     """The tenant-stacked twin of :func:`_serving_program`: one jitted
     program ``(raw, resolutions, positions, mask, weight_matrix
@@ -160,8 +166,20 @@ def _multi_serving_program(
     (the gather is free), one compile, still zero-recompile on swap:
     the weight matrix rides as a traced argument exactly like the solo
     weights vector.
+
+    ``weights_precision="int8"|"int4"`` (ops/quant.py) changes WHAT is
+    resident, not the math's shape: the program takes the packed
+    int8/int4 matrix plus per-lane scales, dequantizes INSIDE
+    (elementwise — the packed payload is what lives on device), and
+    runs the same 128 unrolled matvecs on the reconstruction. Swap
+    stays zero-recompile (packed + scales are traced arguments), and
+    per-tenant margin parity vs the f32 stack is gated at warmup by
+    the multiplexed engine (quant.weights_gate_tolerance), never
+    assumed.
     """
     import jax.numpy as jnp
+
+    from ..ops import quant
 
     featurizer = device_ingest.make_device_ingest_featurizer(
         wavelet_index=wavelet_index,
@@ -173,20 +191,35 @@ def _multi_serving_program(
         post=post,
         precision="bf16" if precision == "bf16" else "f32",
     )
+    d = n_channels * feature_size
 
-    def run(raw, resolutions, positions, mask, weight_matrix,
-            tenant_lanes):
-        feats = featurizer(raw, resolutions, positions, mask)
+    def margins_of(feats, weight_matrix, tenant_lanes):
         # 128 unrolled matvecs — each bitwise the solo program's
         # ``feats @ weights`` — then a per-row column pick
         columns = jnp.stack(
             [feats @ weight_matrix[:, t] for t in range(MAX_TENANTS)],
             axis=1,
         )
-        margins = jnp.take_along_axis(
+        return jnp.take_along_axis(
             columns, tenant_lanes[:, None], axis=1
         )[:, 0]
-        return feats, margins
+
+    if weights_precision == "f32":
+
+        def run(raw, resolutions, positions, mask, weight_matrix,
+                tenant_lanes):
+            feats = featurizer(raw, resolutions, positions, mask)
+            return feats, margins_of(feats, weight_matrix, tenant_lanes)
+
+    else:
+
+        def run(raw, resolutions, positions, mask, packed, scales,
+                tenant_lanes):
+            feats = featurizer(raw, resolutions, positions, mask)
+            weight_matrix = quant.dequantize_weight_stack(
+                packed, scales, weights_precision, d
+            )
+            return feats, margins_of(feats, weight_matrix, tenant_lanes)
 
     return jax.jit(run, donate_argnums=_donate_argnums())
 
@@ -767,13 +800,31 @@ class ServingEngine:
         documented tolerance, and only then make it the serving rung.
         A build/compile failure or a gate miss leaves the engine on
         the fused program with the evidence recorded — the ladder's
-        contract: stepping down is survival, never silence."""
-        from ..ops import serve_mega
+        contract: stepping down is survival, never silence.
 
+        Quantized-feature engines (int8/int4) attempt the rung too
+        (ISSUE 18 closed the PR 12 leftover that hard-pinned them to
+        fused): the mega program is built at the engine's EFFECTIVE
+        precision — what the precision gate left it serving, so a
+        gated-off engine pins mega against f32 like any f32 engine —
+        and judged at that rung's own documented tolerance (a single
+        quantization-boundary flip between the fused and mega
+        formulations moves a margin by up to one quantization step,
+        orders beyond the f32 rungs' 5e-5 parity, and is exactly the
+        deviation class the rung's tolerance already licenses). bf16
+        stays pinned to fused: its cascade runs bfloat16 OPERANDS —
+        there is no bf16 mega twin to gate."""
+        from ..ops import decode_ingest, serve_mega
+
+        effective = (
+            (self.precision_record or {}).get("used", self._precision)
+            if self._precision != "f32"
+            else "f32"
+        )
         if (
             self._host_fe is not None
             or not self._fused_linear
-            or self._precision != "f32"
+            or effective == "bf16"
             or self.pre < 1
         ):
             return
@@ -791,6 +842,7 @@ class ServingEngine:
             "used": "fused",
             "lowering": None,
             "gate": None,
+            "precision": effective,
         }
         self.mega_record = record
         if resolved != "mega":
@@ -815,6 +867,7 @@ class ServingEngine:
                 post=self.post,
                 capacity=self.capacity,
                 lowering=lowering,
+                precision=effective,
             )
             stride = serve_mega.padded_stride(self.pre, self.post)
             windows, res = self._gate_windows()
@@ -829,7 +882,17 @@ class ServingEngine:
             _, fused_margins = self._fused_gate_margins(
                 self._program, windows, res
             )
-            tol = serve_mega.mega_gate_tolerance()
+            # f32 engines pin at the mega parity bound; quantized-
+            # feature engines at their rung's own tolerance (see the
+            # docstring — boundary flips dwarf 5e-5 by construction)
+            tol = (
+                serve_mega.mega_gate_tolerance()
+                if effective == "f32"
+                else max(
+                    serve_mega.mega_gate_tolerance(),
+                    decode_ingest.precision_gate_tolerance(effective),
+                )
+            )
             dev = float(
                 np.max(np.abs(mega_margins - fused_margins))
                 if len(windows)

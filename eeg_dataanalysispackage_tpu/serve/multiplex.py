@@ -191,6 +191,15 @@ class TenantStack(NamedTuple):
     thresholds: tuple          # 128 python floats
     classifiers: tuple         # 128 entries: classifier or None
     generations: tuple         # 128 ints
+    #: the quantized-stack residency fields (ISSUE 18): when
+    #: ``weights_precision`` is int8/int4 the published snapshot
+    #: carries the PACKED device payload + per-lane scales and
+    #: ``weights`` is None — what is resident is the quantized stack;
+    #: the f32 host mirror stays master on the host, so the
+    #: zero-recompile admin path is untouched.
+    packed: object = None      # jax.Array int8 (d,128) / uint8 (d/2,128)
+    scales: object = None      # jax.Array (128,) float32
+    weights_precision: str = "f32"
 
 
 class MultiplexedEngine(engine_mod.ServingEngine):
@@ -217,7 +226,15 @@ class MultiplexedEngine(engine_mod.ServingEngine):
         feature_size: int = 16,
         capacity: int = 64,
         engine_rung: str = "auto",
+        weights_precision: str = "f32",
     ):
+        from ..ops import quant
+
+        if weights_precision not in quant.WEIGHTS_PRECISIONS:
+            raise ValueError(
+                f"weights_precision= must be one of "
+                f"{quant.WEIGHTS_PRECISIONS}, got {weights_precision!r}"
+            )
         items = list(
             tenants.items() if isinstance(tenants, dict) else tenants
         )
@@ -250,6 +267,18 @@ class MultiplexedEngine(engine_mod.ServingEngine):
         self._multi_program = engine_mod._multi_serving_program(
             *self._geometry, precision="f32",
         )
+        #: quantized-stack state: ``_weights_precision_requested`` is
+        #: the knob; the ACTIVE precision starts (and on a failed gate
+        #: or runtime degradation, stays/returns to) f32 — promotion
+        #: happens only in :meth:`_weights_quant_warmup`, behind the
+        #: margin-parity gate against the f32 stack.
+        self._weights_precision_requested = weights_precision
+        self._weights_precision = "f32"
+        self._multi_program_quant = None
+        self._consecutive_quant_failures = 0
+        self._quant_degrade_after = 2
+        self.weights_record = None
+        self._resident_bytes = 0
         # tenant registry: name -> lane (a column of the stack). All
         # mutation happens under the lock and ends in _publish(); the
         # hot path never takes it — execute() reads the published
@@ -328,14 +357,41 @@ class MultiplexedEngine(engine_mod.ServingEngine):
         ``device_put`` (NOT a jitted scatter) keeps the add/swap path
         off the compiler entirely — the 0-recompile pin is structural.
         Publication is one attribute assignment: an in-flight batch
-        holds the previous snapshot and is served wholly by it."""
-        self._stack = TenantStack(
-            weights=jax.device_put(self._w_host),
+        holds the previous snapshot and is served wholly by it.
+
+        With a promoted quantized stack the f32 mirror is still what
+        the admin path mutates (master copy), but what ships to the
+        device is its packed int8/int4 payload + per-lane scales
+        (numpy quantize — ops/quant.py — then device_put: still zero
+        compiles); ``weights`` is None on those snapshots, so the
+        resident footprint really IS the quantized one."""
+        common = dict(
             intercepts=tuple(self._intercepts),
             thresholds=tuple(self._thresholds),
             classifiers=tuple(self._classifiers),
             generations=tuple(self._generations),
         )
+        if self._weights_precision != "f32":
+            from ..ops import quant
+
+            packed_np, scales_np = quant.quantize_weight_stack(
+                self._w_host, self._weights_precision
+            )
+            self._resident_bytes = quant.resident_weight_bytes(
+                packed_np, scales_np
+            )
+            self._stack = TenantStack(
+                weights=None,
+                packed=jax.device_put(packed_np),
+                scales=jax.device_put(scales_np),
+                weights_precision=self._weights_precision,
+                **common,
+            )
+        else:
+            self._resident_bytes = int(self._w_host.nbytes)
+            self._stack = TenantStack(
+                weights=jax.device_put(self._w_host), **common,
+            )
 
     @property
     def tenants(self) -> Tuple[str, ...]:
@@ -358,10 +414,19 @@ class MultiplexedEngine(engine_mod.ServingEngine):
 
     @property
     def resident_weight_bytes(self) -> int:
-        """Bytes of the device-resident stacked weight matrix — the
+        """Bytes of the device-resident stacked weight payload — the
         whole per-tenant model footprint of the multiplexed engine
-        (one matrix serves all 128 lanes)."""
-        return int(self._w_host.nbytes)
+        (one matrix serves all 128 lanes). With a promoted quantized
+        stack this is the packed matrix + per-lane scales (the 4x/8x
+        reduction the bench line accounts), not the f32 mirror."""
+        return int(self._resident_bytes)
+
+    @property
+    def weights_precision(self) -> str:
+        """The ACTIVE weight-stack precision (what is resident now —
+        f32 until the warmup gate promotes the requested rung, and
+        again after a runtime degradation)."""
+        return self._weights_precision
 
     def add_tenant(self, name: str, classifier) -> int:
         """Register a new tenant at runtime; returns its lane. One
@@ -511,9 +576,38 @@ class MultiplexedEngine(engine_mod.ServingEngine):
         res = np.asarray(resolutions, dtype=np.float32)
         tids = np.zeros(self.capacity, np.int32)
         tids[:n] = lanes
-        _feats, margins = self._multi_program(
-            staged, res, self._positions, mask, stack.weights, tids,
-        )
+        if stack.weights_precision != "f32":
+            try:
+                _feats, margins = self._multi_program_quant(
+                    staged, res, self._positions, mask,
+                    stack.packed, stack.scales, tids,
+                )
+                self._consecutive_quant_failures = 0
+            except Exception as e:
+                # the quantized stack's runtime degradation seam: the
+                # batch is served by the f32 MASTER mirror (device_put
+                # on the fly — exact weights, zero compiles), and two
+                # consecutive failures retire the quantized stack for
+                # the engine's lifetime (crash-only: stepping down is
+                # survival, never silence)
+                self._consecutive_quant_failures += 1
+                err = f"{type(e).__name__}: {e}"
+                events.event("serve.weights_quant_error", error=err)
+                if (
+                    self._consecutive_quant_failures
+                    >= self._quant_degrade_after
+                ):
+                    self._disable_weights_quant(err)
+                staged = jax.device_put(stream)
+                _feats, margins = self._multi_program(
+                    staged, res, self._positions, mask,
+                    jax.device_put(self._w_host), tids,
+                )
+        else:
+            _feats, margins = self._multi_program(
+                staged, res, self._positions, mask, stack.weights,
+                tids,
+            )
         return self._postprocess(
             np.asarray(margins[:n]), np.asarray(lanes), stack
         )
@@ -532,10 +626,46 @@ class MultiplexedEngine(engine_mod.ServingEngine):
         res = np.asarray(resolutions, dtype=np.float32)
         tids = np.zeros(self.capacity, np.int32)
         tids[:n] = lanes
-        margins = np.asarray(
-            self._mega_program(staged, res, stack.weights, tids)
-        )[:n]
+        if stack.weights_precision != "f32":
+            # the packed-stack mega lowering (a mega-rung failure here
+            # rides the inherited mega->fused degradation, where the
+            # fused path above owns the quant bookkeeping)
+            margins = np.asarray(
+                self._mega_program(
+                    staged, res, stack.packed, stack.scales, tids
+                )
+            )[:n]
+        else:
+            margins = np.asarray(
+                self._mega_program(staged, res, stack.weights, tids)
+            )[:n]
         return self._postprocess(margins, np.asarray(lanes), stack)
+
+    def _disable_weights_quant(self, error: str) -> None:
+        """Retire the quantized stack: republish the f32 mirror (all
+        later snapshots are f32) and, if the promoted mega program was
+        built for the packed signature, step the ladder down to the
+        always-alive f32 fused multi program."""
+        from .. import obs
+
+        with self._tenant_lock:
+            if self._weights_precision == "f32":
+                return
+            self._weights_precision = "f32"
+            if self.weights_record is not None:
+                self.weights_record["used"] = "f32"
+                self.weights_record["degraded"] = True
+                self.weights_record["error"] = error
+            if self._rung == "mega":
+                self._rung = "fused"
+            self._publish()
+        obs.metrics.count("serve.weights_quant_degraded")
+        events.event("serve.weights_quant_degraded", error=error)
+        logger.warning(
+            "serve.weights_quant degraded to the f32 stack after %d "
+            "consecutive failures (%s)",
+            self._consecutive_quant_failures, error,
+        )
 
     def _execute_host(self, windows, resolutions):
         """The host floor, per tenant group: one shared featurization
@@ -566,9 +696,16 @@ class MultiplexedEngine(engine_mod.ServingEngine):
         engine uses (multi-mega vs multi-fused on the shared gate
         windows, tenant lanes cycling over the registered tenants so
         the gather path itself is what's judged), then trace both
-        request dtypes. Idempotent."""
+        request dtypes. Idempotent.
+
+        Order matters: the quantized-stack gate runs FIRST (it judges
+        the quant fused program against the f32 fused program and, on
+        a pass, republishes the packed stack), so the mega gate then
+        pins mega-vs-fused at whatever weight residency actually
+        serves."""
         if self._warmed:
             return
+        self._weights_quant_warmup()
         self._mega_multi_warmup()
         names = self.tenants
         for dtype in (np.int16, np.float32):
@@ -581,16 +718,115 @@ class MultiplexedEngine(engine_mod.ServingEngine):
 
     def _multi_gate_margins(self, windows, res, tids):
         """The fused multi program on the gate windows (pre-intercept
-        margins for the live rows) — the parity gate's reference."""
+        margins for the live rows) — the parity gate's reference,
+        served by whatever stack is currently published (f32, or the
+        packed payload once the quant gate promoted it)."""
         n = len(windows)
         stream, mask = self._stage_fused_stream(windows)
         padded_tids = np.zeros(self.capacity, np.int32)
         padded_tids[:n] = tids
-        _feats, margins = self._multi_program(
-            jax.device_put(stream), res, self._positions, mask,
-            self._stack.weights, padded_tids,
-        )
+        stack = self._stack
+        if stack.weights_precision != "f32":
+            _feats, margins = self._multi_program_quant(
+                jax.device_put(stream), res, self._positions, mask,
+                stack.packed, stack.scales, padded_tids,
+            )
+        else:
+            _feats, margins = self._multi_program(
+                jax.device_put(stream), res, self._positions, mask,
+                stack.weights, padded_tids,
+            )
         return np.asarray(margins)[:n]
+
+    def _gate_tids(self, n: int) -> np.ndarray:
+        """Gate-window tenant lanes cycling over the REGISTERED
+        tenants: the gather (and with a quantized stack, every lane's
+        own scale) — not just lane 0 — is what the pins judge."""
+        with self._tenant_lock:
+            lanes = sorted(self._lanes.values())
+        return np.asarray(
+            [lanes[i % len(lanes)] for i in range(n)], np.int32
+        )
+
+    def _weights_quant_warmup(self) -> None:
+        """Resolve and (when earned) promote the quantized weight
+        stack: build the packed-stack fused program, quantize the
+        CURRENT host mirror, and pin its per-tenant margins against
+        the f32 stack's on the shared gate windows at the derived
+        envelope tolerance (ops/quant.weights_gate_tolerance;
+        EEG_TPU_WEIGHTS_GATE_TOL=0 is the forced-off drill). Above
+        tolerance — or on any build/compile failure — the f32 stack
+        stands, recorded, never silent."""
+        from .. import obs
+        from ..ops import quant
+
+        wp = self._weights_precision_requested
+        if wp == "f32":
+            return
+        record = {"requested": wp, "used": "f32", "gate": None}
+        self.weights_record = record
+        try:
+            program = engine_mod._multi_serving_program(
+                *self._geometry, precision="f32",
+                weights_precision=wp,
+            )
+            windows, res = self._gate_windows()
+            n = len(windows)
+            tids = self._gate_tids(n)
+            f32_margins = self._multi_gate_margins(windows, res, tids)
+            packed_np, scales_np = quant.quantize_weight_stack(
+                self._w_host, wp
+            )
+            stream, mask = self._stage_fused_stream(windows)
+            padded_tids = np.zeros(self.capacity, np.int32)
+            padded_tids[:n] = tids
+            _feats, q_margins = program(
+                jax.device_put(stream), res, self._positions, mask,
+                jax.device_put(packed_np), jax.device_put(scales_np),
+                padded_tids,
+            )
+            q_margins = np.asarray(q_margins)[:n]
+            tol = quant.weights_gate_tolerance(wp, self._w_host)
+            dev = float(
+                np.max(np.abs(q_margins - f32_margins)) if n else 0.0
+            )
+            gate = {
+                "max_abs_dev": dev,
+                "tolerance": tol,
+                "ok": bool(dev <= tol),
+                "rows_checked": n,
+            }
+        except Exception as e:
+            record["error"] = f"{type(e).__name__}: {e}"
+            obs.metrics.count("serve.weights_quant_unavailable")
+            events.event(
+                "serve.weights_quant_unavailable",
+                error=record["error"],
+            )
+            logger.warning(
+                "serve.weights_quant (%s) unavailable (%s); serving "
+                "the f32 stack", wp, record["error"],
+            )
+            return
+        record["gate"] = gate
+        if not gate["ok"]:
+            obs.metrics.count("serve.weights_quant_gate_disabled")
+            events.event("serve.weights_quant_gate", **gate)
+            logger.warning(
+                "serve.weights_quant_gate refused the %s stack: max "
+                "abs margin dev %.3e > gate %.3e; serving the f32 "
+                "stack", wp, gate["max_abs_dev"], gate["tolerance"],
+            )
+            return
+        self._multi_program_quant = program
+        with self._tenant_lock:
+            self._weights_precision = wp
+            self._publish()
+        record["used"] = wp
+        events.event(
+            "serve.weights_quant_promoted", weights_precision=wp,
+            resident_bytes=self._resident_bytes,
+        )
 
     def _mega_multi_warmup(self) -> None:
         from ..ops import serve_mega
@@ -616,6 +852,11 @@ class MultiplexedEngine(engine_mod.ServingEngine):
         self.mega_record = record
         if resolved != "mega":
             return
+        # the mega program is built for whatever stack the quant gate
+        # left published — packed signature when promoted, f32 weights
+        # otherwise — so the rung it earns is the rung it serves
+        wp = self._weights_precision
+        record["weights_precision"] = wp
         try:
             lowering = serve_mega.default_lowering()
             record["lowering"] = lowering
@@ -629,27 +870,29 @@ class MultiplexedEngine(engine_mod.ServingEngine):
                 post=self.post,
                 capacity=self.capacity,
                 lowering=lowering,
+                weights_precision=wp,
             )
             stride = serve_mega.padded_stride(self.pre, self.post)
             windows, res = self._gate_windows()
             # gate lanes cycle over the REGISTERED tenants: the gather
             # itself — not just lane 0 — is what the pin judges
-            with self._tenant_lock:
-                lanes = sorted(self._lanes.values())
-            tids = np.asarray(
-                [lanes[i % len(lanes)] for i in range(len(windows))],
-                np.int32,
-            )
+            tids = self._gate_tids(len(windows))
             padded_tids = np.zeros(self.capacity, np.int32)
             padded_tids[: len(windows)] = tids
             mega_stream = serve_mega.stage_mega_stream(
                 windows, self.n_channels, self.window_len, stride,
                 self.capacity,
             )
-            mega_margins = np.asarray(program(
-                jax.device_put(mega_stream), res,
-                self._stack.weights, padded_tids,
-            ))[: len(windows)]
+            staged = jax.device_put(mega_stream)
+            if wp != "f32":
+                mega_margins = np.asarray(program(
+                    staged, res, self._stack.packed,
+                    self._stack.scales, padded_tids,
+                ))[: len(windows)]
+            else:
+                mega_margins = np.asarray(program(
+                    staged, res, self._stack.weights, padded_tids,
+                ))[: len(windows)]
             fused_margins = self._multi_gate_margins(windows, res, tids)
             tol = serve_mega.mega_gate_tolerance()
             dev = float(
@@ -714,6 +957,7 @@ class MultiplexedService(service_mod.InferenceService):
         post: int = constants.POSTSTIMULUS_SAMPLES,
         config: Optional[service_mod.ServeConfig] = None,
         engine_rung: str = "auto",
+        weights_precision: str = "f32",
     ):
         self.config = config or service_mod.ServeConfig()
         self.engine = MultiplexedEngine(
@@ -724,6 +968,7 @@ class MultiplexedService(service_mod.InferenceService):
             post=post,
             capacity=self.config.max_batch,
             engine_rung=engine_rung,
+            weights_precision=weights_precision,
         )
         #: multiplexed services have no (single) lifecycle manager;
         #: per-tenant model state is the stack's swap generations
@@ -987,4 +1232,7 @@ class MultiplexedService(service_mod.InferenceService):
         block["resident_weight_bytes"] = (
             self.engine.resident_weight_bytes
         )
+        block["weights_precision"] = self.engine.weights_precision
+        if self.engine.weights_record is not None:
+            block["weights"] = dict(self.engine.weights_record)
         return block

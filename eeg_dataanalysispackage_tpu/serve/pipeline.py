@@ -221,9 +221,10 @@ def run_serve(query_map, provider_factory, stage):
     from ..ops import decode_ingest
 
     wavelet_index = int(fused_match.group(1))
-    # precision=bf16/int8 serve through the reduced-precision feature
-    # path behind the engine's warmup accuracy gate (serve/engine.py);
-    # the decision is recorded in the serve block's ``precision`` entry
+    # precision=bf16/int8/int4 serve through the reduced-precision
+    # feature path behind the engine's warmup accuracy gate
+    # (serve/engine.py); the decision is recorded in the serve block's
+    # ``precision`` entry
     precision = (
         query_map.get("precision")
         or os.environ.get("EEG_TPU_PRECISION")
@@ -231,7 +232,8 @@ def run_serve(query_map, provider_factory, stage):
     )
     if precision not in decode_ingest.PRECISIONS:
         raise ValueError(
-            f"precision= must be f32, bf16, or int8, got {precision!r}"
+            f"precision= must be f32, bf16, int8, or int4, got "
+            f"{precision!r}"
         )
 
     classifier = clf_registry.create(query_map["load_clf"])

@@ -695,6 +695,71 @@ def test_collect_propagates_serve_multitenant_field(monkeypatch):
     assert v["serve"] == serve_block
 
 
+def test_int4_and_quant_stack_variants_in_both_tables_and_routing():
+    """The int4 rung + quantized weight stack (ISSUE 18) ride every
+    bench artifact: the pipeline_e2e_int4 cold twin sized like the
+    other precision rungs through the pipeline child, the
+    serve_multitenant_quant quant-vs-f32 twin through the serve child
+    in the slow-compile class (it warms FOUR programs cold: the quant
+    and f32 engines' fused and packed/mega twins)."""
+    import inspect
+
+    for table in (bench._VARIANTS_TPU, bench._VARIANTS_CPU):
+        assert "pipeline_e2e_int4" in table
+        assert "serve_multitenant_quant" in table
+        # every precision rung's cold twin is sized identically —
+        # the ladder is directly comparable from one artifact
+        assert table["pipeline_e2e_int4"] == table["pipeline_e2e_bf16"]
+        assert table["pipeline_e2e_int4"] == table["pipeline_e2e_int8"]
+    src = inspect.getsource(bench._run_variant)
+    assert "pipeline_e2e" in src and "serve_" in src
+    assert "serve_multitenant_quant" in bench._VARIANT_TIMEOUTS
+
+
+def test_collect_propagates_serve_multitenant_quant_field(monkeypatch):
+    """The serve_multitenant_quant line's quant-vs-f32 twin + parity +
+    residency block must survive the parent's field whitelist into the
+    published artifact — the exact block quant.accelerator_decision
+    harvests from staged chip runs."""
+    serve_block = {
+        "multitenant_quant": {
+            "tenants": 16,
+            "weights_precision": "int4",
+            "quant": {"preds_per_s": 5100.0, "p99_ms": 4.2},
+            "f32": {"preds_per_s": 5000.0, "p99_ms": 4.0},
+            "ratio": 1.02,
+            "parity": {"within_tolerance": True,
+                       "max_abs_margin_dev": 0.01},
+            "resident": {"f32_bytes": 24576, "quant_bytes": 3584,
+                         "reduction": 6.857},
+            "admin": {"compiles": 0, "compiles_zero_ok": True,
+                      "still_quantized": True},
+        },
+    }
+    monkeypatch.setattr(
+        bench, "_VARIANTS_CPU",
+        {"einsum": (8, 2), "serve_multitenant_quant": (400, 2)},
+    )
+    monkeypatch.setattr(
+        bench,
+        "_run_variant",
+        lambda name, platform, n, iters: {
+            "epochs_per_s": 1.0,
+            "bytes_per_epoch": 5100,
+            "n": n,
+            "wall_s": 1.0,
+            **(
+                {"serve": serve_block}
+                if name == "serve_multitenant_quant" else {}
+            ),
+        },
+    )
+    v = bench._collect("cpu_fallback")["variants"][
+        "serve_multitenant_quant"
+    ]
+    assert v["serve"] == serve_block
+
+
 def test_plan_service_variant_in_both_tables_and_routing():
     """The networked plan service (ISSUE 11) rides every bench
     artifact, sized identically on TPU and the CPU fallback, through

@@ -131,12 +131,26 @@ def test_degradation_ladder_starts_at_decode():
 
 def test_fused_extractor_id_precision_class():
     """The f32 key tuple is byte-unchanged from PR 3 (warm caches
-    survive); bf16 keys its own entries — the precision-class rule."""
+    survive); every non-f32 rung keys its own entries — the
+    precision-class rule, now a 4-way ladder."""
     f32 = provider.fused_extractor_id(8)
     assert f32 == ("dwt-fused", 8, 512, 175, 16)
     assert provider.fused_extractor_id(8, "f32") == f32
     bf16 = provider.fused_extractor_id(8, "bf16")
     assert bf16 == f32 + ("bf16",)
+    ids = {
+        p: provider.fused_extractor_id(8, p)
+        for p in ("f32", "bf16", "int8", "int4")
+    }
+    assert ids["int4"] == f32 + ("int4",)
+    # 4 distinct classes: no rung's entry can ever serve another's
+    assert len(set(ids.values())) == 4
+
+
+def test_precisions_ladder_registry():
+    """The grammar is the registry: decode_ingest.PRECISIONS is what
+    plan validation, the builder, and the serve engine all accept."""
+    assert decode_ingest.PRECISIONS == ("f32", "bf16", "int8", "int4")
 
 
 def test_bf16_within_gate_on_dc_offset_signal():
@@ -211,7 +225,7 @@ def test_bf16_with_explicit_other_backend_is_an_error(session):
         builder.PipelineBuilder(
             _query(session, "&fe=dwt-8-fused-block&precision=bf16")
         ).execute()
-    with pytest.raises(ValueError, match="f32, bf16, or int8"):
+    with pytest.raises(ValueError, match="f32, bf16, int8, or int4"):
         builder.PipelineBuilder(
             _query(session, "&fe=dwt-8-fused&precision=f16")
         ).execute()

@@ -156,6 +156,24 @@ def test_guessed_number_is_part_of_the_key(tmp_path, cache_env):
     assert st["misses"] == 2 and st["hits"] == 0
 
 
+def test_precision_class_is_part_of_the_key(tmp_path, cache_env):
+    """Same bytes, different precision rung -> its OWN cache class:
+    four cold runs across the f32/bf16/int8/int4 ladder store four
+    entries (no cross-class hit), and each rung's warm re-run hits
+    only its own entry — the 4-way miss matrix at the builder level."""
+    info = _session(tmp_path, n_files=1)
+    ladder = ("f32", "bf16", "int8", "int4")
+    for p in ladder:
+        builder.PipelineBuilder(_query(info, precision=p)).execute()
+    st = feature_cache.stats()
+    assert st["misses"] == len(ladder) and st["hits"] == 0
+    assert len(glob.glob(str(cache_env / "*.npz"))) == len(ladder)
+    for p in ladder:
+        builder.PipelineBuilder(_query(info, precision=p)).execute()
+    st = feature_cache.stats()
+    assert st["misses"] == len(ladder) and st["hits"] == len(ladder)
+
+
 def test_disabled_globally_without_dir(tmp_path, monkeypatch):
     monkeypatch.setenv(feature_cache.ENV_DISABLE, "1")
     assert feature_cache.open_cache() is None

@@ -20,6 +20,7 @@ from eeg_dataanalysispackage_tpu.gateway.server import GatewayServer
 from eeg_dataanalysispackage_tpu.io import provider
 from eeg_dataanalysispackage_tpu.models import registry as clf_registry
 from eeg_dataanalysispackage_tpu.obs import chaos
+from eeg_dataanalysispackage_tpu.ops import quant
 from eeg_dataanalysispackage_tpu.obs.report import CompilationMonitor
 from eeg_dataanalysispackage_tpu.pipeline import builder
 from eeg_dataanalysispackage_tpu.serve import (
@@ -703,3 +704,169 @@ def test_multiplex_accelerator_decision_harvest(tmp_path):
     ] = 3000.0
     (run / "serve_multitenant.json").write_text(json.dumps(record))
     assert multiplex.accelerator_decision(str(root))["consolidate"] is False
+
+
+# -- the quantized weight stack (ISSUE 18) -------------------------------
+
+
+def test_quantized_stack_gate_promotes_with_margin_parity(
+    session, tenants
+):
+    """The warmup gate promotes int4 residency and every tenant's
+    margins out of the quantized stack sit within the documented
+    weights tolerance of the f32 engine's — with predictions equal
+    wherever the f32 margin clears the tolerance band."""
+    multi = MultiplexedEngine(
+        tenants, capacity=64, weights_precision="int4"
+    )
+    multi.warmup()
+    assert multi.weights_precision == "int4"
+    rec = multi.weights_record
+    assert rec["requested"] == "int4" and rec["used"] == "int4"
+    gate = rec["gate"]
+    assert gate["ok"] and gate["max_abs_dev"] <= gate["tolerance"]
+    # 48/2 packed uint8 rows + 128 f32 per-lane scales: 3584 B, the
+    # >= 4x VMEM-residency reduction the bench line records
+    assert multi.resident_weight_bytes == 48 // 2 * 128 + 128 * 4
+    f32 = MultiplexedEngine(tenants, capacity=64)
+    f32.warmup()
+    assert f32.resident_weight_bytes == 48 * 128 * 4
+    windows = session["windows"][:12]
+    res = session["resolutions"]
+    mix = [_NAMES[i % 3] for i in range(12)]
+    qp, qm = multi.execute(windows, res, mix)
+    fp, fm = f32.execute(windows, res, mix)
+    tol = quant.weights_gate_tolerance("int4", multi._w_host)
+    assert float(np.max(np.abs(qm - fm))) <= tol
+    clear = np.abs(fm) > tol
+    np.testing.assert_array_equal(qp[clear], fp[clear])
+
+
+def test_quantized_stack_forced_off_is_identical_to_f32(
+    session, tenants, monkeypatch
+):
+    """The forced-off drill: EEG_TPU_WEIGHTS_GATE_TOL=0 shuts the
+    gate, the engine publishes the f32 mirror (record says so — never
+    silence), and served margins are BYTE-identical to a plain f32
+    engine's."""
+    monkeypatch.setenv("EEG_TPU_WEIGHTS_GATE_TOL", "0")
+    multi = MultiplexedEngine(
+        tenants, capacity=64, weights_precision="int4"
+    )
+    multi.warmup()
+    assert multi.weights_precision == "f32"
+    rec = multi.weights_record
+    assert rec["requested"] == "int4" and rec["used"] == "f32"
+    assert rec["gate"] is not None and rec["gate"]["ok"] is False
+    assert multi.resident_weight_bytes == 48 * 128 * 4
+    f32 = MultiplexedEngine(tenants, capacity=64)
+    f32.warmup()
+    windows = session["windows"][:12]
+    res = session["resolutions"]
+    mix = [_NAMES[i % 3] for i in range(12)]
+    qp, qm = multi.execute(windows, res, mix)
+    fp, fm = f32.execute(windows, res, mix)
+    np.testing.assert_array_equal(qm, fm)
+    np.testing.assert_array_equal(qp, fp)
+
+
+def test_quantized_stack_zero_compile_admin_stays_quantized(
+    session, tenants
+):
+    """The tentpole's economic pin survives quantization: add, swap,
+    remove, and serve on the int4 stack are 0 XLA compiles (the
+    re-pack is host-side numpy; the resident program's signature
+    never changes), and the stack is STILL quantized afterwards."""
+    multi = MultiplexedEngine(
+        tenants, capacity=64, weights_precision="int4"
+    )
+    multi.warmup()
+    assert multi.weights_precision == "int4"
+    windows = session["windows"][:9]
+    res = session["resolutions"]
+    multi.execute(windows, res, [_NAMES[i % 3] for i in range(9)])
+    newcomer = _tenant_clf(session, 81)
+    replacement = _tenant_clf(session, 82)
+    with CompilationMonitor() as monitor:
+        multi.add_tenant("dave", newcomer)
+        multi.swap_model(replacement, tenant="bob")
+        multi.remove_tenant("dave")
+        multi.execute(windows, res, ["bob", "alice", "carol"] * 3)
+    snap = monitor.snapshot()
+    if snap["available"]:
+        assert snap["compilations"] == 0
+    assert multi.weights_precision == "int4"
+    assert multi.resident_weight_bytes == 48 // 2 * 128 + 128 * 4
+    # the swap landed THROUGH the quantized stack: bob now tracks the
+    # replacement's weights within the weights tolerance
+    solo = ServingEngine(replacement, capacity=64)
+    solo.warmup()
+    sp, sm = solo.execute(windows, res)
+    mp, mm = multi.execute(windows, res, ["bob"] * 9)
+    tol = quant.weights_gate_tolerance("int4", multi._w_host)
+    assert float(np.max(np.abs(mm - sm))) <= tol
+
+
+def test_quantized_stack_runtime_degradation_to_f32_master(
+    session, tenants
+):
+    """The crash-only seam: a faulting quant program serves its batch
+    via the f32 MASTER mirror (byte-identical to a plain f32 engine,
+    zero drops), and two consecutive failures retire the quantized
+    stack for the engine's lifetime with the evidence recorded."""
+    multi = MultiplexedEngine(
+        tenants, capacity=64, engine_rung="fused",
+        weights_precision="int4",
+    )
+    multi.warmup()
+    assert multi.weights_precision == "int4"
+
+    def boom(*a, **k):
+        raise RuntimeError("injected quant fault")
+
+    multi._multi_program_quant = boom
+    f32 = MultiplexedEngine(tenants, capacity=64, engine_rung="fused")
+    f32.warmup()
+    windows = session["windows"][:6]
+    res = session["resolutions"]
+    mix = [_NAMES[i % 3] for i in range(6)]
+    fp, fm = f32.execute(windows, res, mix)
+    # first failure: served by the master mirror, not yet retired
+    p1, m1 = multi.execute(windows, res, mix)
+    np.testing.assert_array_equal(m1, fm)
+    np.testing.assert_array_equal(p1, fp)
+    assert multi.weights_precision == "int4"
+    # second consecutive failure: the stack is retired
+    p2, m2 = multi.execute(windows, res, mix)
+    np.testing.assert_array_equal(m2, fm)
+    assert multi.weights_precision == "f32"
+    rec = multi.weights_record
+    assert rec["used"] == "f32" and rec["degraded"] is True
+    assert "injected quant fault" in rec["error"]
+    # and the next batch runs the published f32 snapshot cleanly
+    p3, m3 = multi.execute(windows, res, mix)
+    np.testing.assert_array_equal(m3, fm)
+
+
+def test_quantized_stack_service_stats_and_validation(session, tenants):
+    """The service surface: stats_block carries the ACTIVE stack
+    precision + the full weights record, and a junk weights_precision=
+    is refused at construction."""
+    svc = MultiplexedService(
+        tenants, config=ServeConfig(max_batch=16),
+        weights_precision="int4",
+    )
+    svc.engine.warmup()
+    with svc:
+        svc.predict_all(
+            session["windows"][:3], session["resolutions"],
+            list(_NAMES),
+        )
+        block = svc.stats_block()
+    assert block["weights_precision"] == "int4"
+    assert block["weights"]["requested"] == "int4"
+    assert block["weights"]["used"] == "int4"
+    assert block["weights"]["gate"]["ok"] is True
+    assert block["resident_weight_bytes"] == 48 // 2 * 128 + 128 * 4
+    with pytest.raises(ValueError, match="weights_precision="):
+        MultiplexedEngine(tenants, capacity=64, weights_precision="fp8")

@@ -1,0 +1,302 @@
+"""The int4 precision rung + quantized weight stack units
+(ops/quant.py, ISSUE 18).
+
+The acceptance bar: int4 feature quantization carries the int8 core's
+invariants verbatim (per-row scales, exact-zero rows, determinism) at
+qmax 7; the nibble wire format round-trips exactly and equals the
+in-graph quantize→dequantize; the masked full-lane quantizer (the
+mega kernel's spelling) is numerically identical to the reshape core;
+the weight stack packs per-lane and dequantizes bit-exactly back to
+its grid; and every gate tolerance honours its env override with the
+logged-never-silent fallback.
+"""
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+from eeg_dataanalysispackage_tpu.ops import decode_ingest, quant
+
+
+# -- the int4 feature rung -----------------------------------------------
+
+
+def test_int4_quantize_roundtrip_properties():
+    """Per-(row, channel, subband) scales at qmax 7, the arithmetic
+    error bound, exact zero preservation, and determinism — the int8
+    core's invariants transferred to the bottom rung."""
+    rng = np.random.RandomState(0)
+    rows = rng.randn(32, 48).astype(np.float32)
+    rows /= np.linalg.norm(rows, axis=1, keepdims=True)
+    rows[5] = 0.0
+    dq, scales = quant.quantize_dequantize_int4(rows, 16)
+    dq = np.asarray(dq)
+    scales = np.asarray(scales)
+    n_groups = len(decode_ingest.subband_group_bounds(16))
+    assert scales.shape == (n_groups, 32, 3)
+    x = rows.reshape(32, 3, 16)
+    d = np.abs(dq.reshape(32, 3, 16) - x)
+    for gi, (lo, hi) in enumerate(
+        decode_ingest.subband_group_bounds(16)
+    ):
+        bound = scales[gi][:, :, None] / 2 + 1e-7
+        assert np.all(d[:, :, lo:hi] <= bound)
+    assert np.all(dq[5] == 0.0)
+    dq2, _ = quant.quantize_dequantize_int4(rows, 16)
+    np.testing.assert_array_equal(dq, np.asarray(dq2))
+
+
+def test_int4_quantize_is_row_independent():
+    """Per-ROW scales: a loud neighbour never stretches another row's
+    quantization grid — the batch-invariance contract the cache and
+    the serve bucket pins rely on."""
+    rng = np.random.RandomState(1)
+    rows = rng.randn(8, 48).astype(np.float32)
+    rows[3] *= 100.0
+    dq_batch, _ = quant.quantize_dequantize_int4(rows, 16)
+    dq_batch = np.asarray(dq_batch)
+    for i in range(8):
+        dq_solo, _ = quant.quantize_dequantize_int4(rows[i:i + 1], 16)
+        np.testing.assert_array_equal(
+            np.asarray(dq_solo)[0], dq_batch[i]
+        )
+
+
+def test_int4_pack_unpack_roundtrip_exact():
+    rng = np.random.RandomState(2)
+    q = rng.randint(-7, 8, size=(5, 48)).astype(np.int32)
+    packed = quant.pack_int4_rows(q)
+    assert packed.dtype == np.uint8 and packed.shape == (5, 24)
+    # +8 storage: every wire byte's nibbles sit in [1, 15] — a zero
+    # byte is provably corruption, never data
+    assert (packed & 0xF).min() >= 1 and (packed >> 4).min() >= 1
+    np.testing.assert_array_equal(quant.unpack_int4_rows(packed), q)
+
+
+def test_int4_pack_rejects_bad_input():
+    with pytest.raises(ValueError, match="even"):
+        quant.pack_int4_rows(np.zeros((2, 3), np.int32))
+    with pytest.raises(ValueError, match="out of"):
+        quant.pack_int4_rows(np.full((1, 2), 8, np.int32))
+    with pytest.raises(ValueError, match="out of"):
+        quant.pack_int4_rows(np.full((1, 2), -8, np.int32))
+
+
+def test_int4_packed_wire_equals_in_graph():
+    """The host wire format (quantize_int4_packed →
+    dequantize_int4_packed) reproduces the in-graph round trip
+    byte-for-byte — what a cache stores is exactly what the program
+    computes."""
+    rng = np.random.RandomState(3)
+    rows = rng.randn(16, 48).astype(np.float32)
+    rows /= np.linalg.norm(rows, axis=1, keepdims=True)
+    in_graph, _ = quant.quantize_dequantize_int4(rows, 16)
+    packed, scales = quant.quantize_int4_packed(rows, 16)
+    wire = quant.dequantize_int4_packed(packed, scales, 16)
+    np.testing.assert_array_equal(np.asarray(in_graph), wire)
+
+
+def test_masked_quantizer_matches_reshape_core():
+    """The mega kernel's full-lane masked spelling
+    (subband_lane_masks + masked_quantize_dequantize) is numerically
+    identical to the grouped-reshape cores at both qmax values — the
+    lane-layout twin the in-kernel rung relies on."""
+    rng = np.random.RandomState(4)
+    rows = rng.randn(12, 48).astype(np.float32)
+    rows /= np.linalg.norm(rows, axis=1, keepdims=True)
+    masks = quant.subband_lane_masks(3, 16)
+    # disjoint and complete over the (C, K) lane layout
+    assert np.array_equal(
+        sum(np.asarray(m) for m in masks), np.ones(48, np.float32)
+    )
+    masked_i8 = np.asarray(
+        quant.masked_quantize_dequantize(rows, masks, 127.0)
+    )
+    core_i8 = np.asarray(
+        decode_ingest.quantize_dequantize_int8(rows, 16)[0]
+    )
+    np.testing.assert_array_equal(masked_i8, core_i8)
+    masked_i4 = np.asarray(
+        quant.masked_quantize_dequantize(rows, masks, quant.INT4_QMAX)
+    )
+    core_i4 = np.asarray(quant.quantize_dequantize_int4(rows, 16)[0])
+    np.testing.assert_array_equal(masked_i4, core_i4)
+
+
+def test_int4_gate_tolerance_env(monkeypatch):
+    monkeypatch.setenv("EEG_TPU_INT4_GATE_TOL", "0.5")
+    assert quant.int4_gate_tolerance() == 0.5
+    monkeypatch.setenv("EEG_TPU_INT4_GATE_TOL", "zero")
+    assert quant.int4_gate_tolerance() == quant.INT4_GATE_TOL
+    monkeypatch.delenv("EEG_TPU_INT4_GATE_TOL", raising=False)
+    assert quant.int4_gate_tolerance() == quant.INT4_GATE_TOL
+    assert (
+        decode_ingest.precision_gate_tolerance("int4")
+        == quant.INT4_GATE_TOL
+    )
+
+
+# -- the quantized weight stack ------------------------------------------
+
+
+def _stack(d=48, lanes=128, seed=7):
+    rng = np.random.RandomState(seed)
+    w = (rng.randn(d, lanes) * 0.3).astype(np.float32)
+    w[:, 5] = 0.0  # an empty lane (a freed tenant slot)
+    return w
+
+
+@pytest.mark.parametrize("precision,qmax", [("int8", 127.0),
+                                            ("int4", 7.0)])
+def test_weight_stack_quantize_roundtrip(precision, qmax):
+    """Per-lane symmetric scales; the dequantized stack sits within
+    scale/2 of the master per weight; an empty lane dequantizes to
+    exactly zero."""
+    w = _stack()
+    packed, scales = quant.quantize_weight_stack(w, precision)
+    assert scales.shape == (128,) and scales.dtype == np.float32
+    np.testing.assert_allclose(
+        np.maximum(np.max(np.abs(w), axis=0) / qmax, 1e-30), scales,
+        rtol=1e-6,
+    )
+    dq = np.asarray(
+        quant.dequantize_weight_stack(packed, scales, precision, 48)
+    )
+    assert dq.shape == w.shape
+    assert np.all(np.abs(dq - w) <= scales[None, :] / 2 + 1e-7)
+    assert np.all(dq[:, 5] == 0.0)
+
+
+def test_weight_stack_int4_interleave_exact():
+    """int4 packing is row-pairwise (2i low nibble, 2i+1 high): the
+    dequantized stack lands every weight back on its OWN grid point —
+    bit-exact against an independent per-element requantization."""
+    w = _stack(seed=8)
+    packed, scales = quant.quantize_weight_stack(w, "int4")
+    assert packed.shape == (24, 128) and packed.dtype == np.uint8
+    q = np.clip(np.rint(w / scales[None, :]), -7, 7)
+    dq = np.asarray(
+        quant.dequantize_weight_stack(packed, scales, "int4", 48)
+    )
+    np.testing.assert_array_equal(dq, (q * scales[None, :]).astype(
+        np.float32
+    ))
+
+
+def test_weight_stack_int4_rejects_odd_rows():
+    with pytest.raises(ValueError, match="even row count"):
+        quant.quantize_weight_stack(np.zeros((7, 128), np.float32),
+                                    "int4")
+
+
+def test_weight_stack_scales_are_per_lane():
+    """Cross-lane isolation: scaling ONE lane's weights 100x moves
+    only that lane's scale and dequantized column — a swap_model on
+    tenant A can never move tenant B's margins."""
+    w = _stack(seed=9)
+    loud = w.copy()
+    loud[:, 3] *= 100.0
+    _, s_base = quant.quantize_weight_stack(w, "int4")
+    p_loud, s_loud = quant.quantize_weight_stack(loud, "int4")
+    changed = s_base != s_loud
+    assert changed[3] and changed.sum() == 1
+    dq_base = np.asarray(
+        quant.dequantize_weight_stack(
+            *quant.quantize_weight_stack(w, "int4")[:2], "int4", 48
+        )
+    )
+    dq_loud = np.asarray(
+        quant.dequantize_weight_stack(p_loud, s_loud, "int4", 48)
+    )
+    other = np.arange(128) != 3
+    np.testing.assert_array_equal(
+        dq_base[:, other], dq_loud[:, other]
+    )
+
+
+def test_resident_weight_bytes_reduction():
+    """The VMEM-residency arithmetic on the real (48, 128) geometry:
+    f32 24576 B; int8 6656 B (3.69x); int4 3584 B (6.86x) — only int4
+    clears the 4x bar, which is why the quant bench serves it."""
+    w = _stack()
+    f32_bytes = w.nbytes
+    assert f32_bytes == 24576
+    i8 = quant.resident_weight_bytes(
+        *quant.quantize_weight_stack(w, "int8")
+    )
+    i4 = quant.resident_weight_bytes(
+        *quant.quantize_weight_stack(w, "int4")
+    )
+    assert i8 == 48 * 128 + 128 * 4 == 6656
+    assert i4 == 24 * 128 + 128 * 4 == 3584
+    assert f32_bytes / i8 < 4.0 < f32_bytes / i4
+
+
+def test_weights_gate_tolerance_envelope_and_env(monkeypatch):
+    """The derived envelope (headroom * sqrt(d) * s_max / 2) tracks
+    the stack's own magnitude; the env override is ABSOLUTE and 0
+    forces the gate shut."""
+    w = _stack()
+    tol = quant.weights_gate_tolerance("int4", w)
+    s_max = np.max(np.abs(w)) / 7.0
+    expected = (
+        quant.WEIGHTS_GATE_HEADROOM * math.sqrt(48) * s_max / 2.0
+    )
+    assert tol == pytest.approx(expected, rel=1e-6)
+    # smaller weights -> tighter gate, automatically
+    assert quant.weights_gate_tolerance("int4", w * 0.01) < tol
+    monkeypatch.setenv("EEG_TPU_WEIGHTS_GATE_TOL", "0.25")
+    assert quant.weights_gate_tolerance("int4", w) == 0.25
+    monkeypatch.setenv("EEG_TPU_WEIGHTS_GATE_TOL", "0")
+    assert quant.weights_gate_tolerance("int4", w) == 0.0
+    monkeypatch.setenv("EEG_TPU_WEIGHTS_GATE_TOL", "junk")
+    assert quant.weights_gate_tolerance("int4", w) == pytest.approx(
+        expected, rel=1e-6
+    )
+
+
+# -- the accelerator decision path ---------------------------------------
+
+
+def _stage_quant_artifact(root, name, platform, qps, fps, tenants=16):
+    d = root / name
+    d.mkdir(parents=True, exist_ok=True)
+    (d / "serve_multitenant_quant.json").write_text(json.dumps({
+        "variant": "serve_multitenant_quant",
+        "platform": platform,
+        "serve": {"multitenant_quant": {
+            "tenants": tenants,
+            "weights_precision": "int4",
+            "quant": {"preds_per_s": qps},
+            "f32": {"preds_per_s": fps},
+        }},
+    }) + "\n")
+
+
+def test_accelerator_decision_flips_on_chip_evidence(tmp_path):
+    _stage_quant_artifact(tmp_path, "r1", "tpu", 980.0, 1000.0)
+    d = quant.accelerator_decision(root=str(tmp_path))
+    assert d["quantize_stack"] is True
+    assert d["ratio"] == pytest.approx(0.98)
+    assert d["weights_precision"] == "int4"
+    assert d["threshold_ratio"] == quant.WEIGHTS_QUANT_FLIP_RATIO
+    assert "r1" in d["source"]
+
+
+def test_accelerator_decision_holds_below_threshold(tmp_path):
+    _stage_quant_artifact(tmp_path, "r1", "tpu", 500.0, 1000.0)
+    d = quant.accelerator_decision(root=str(tmp_path))
+    assert d["quantize_stack"] is False and d["ratio"] == 0.5
+
+
+def test_accelerator_decision_ignores_cpu_and_absent(tmp_path):
+    # no artifact at all
+    d = quant.accelerator_decision(root=str(tmp_path / "empty"))
+    assert d["quantize_stack"] is False and d["source"] is None
+    # a CPU-fallback artifact is not chip evidence
+    _stage_quant_artifact(tmp_path, "r1", "cpu_fallback", 2000.0,
+                          1000.0)
+    d = quant.accelerator_decision(root=str(tmp_path))
+    assert d["quantize_stack"] is False and d["source"] is None

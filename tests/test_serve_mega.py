@@ -20,6 +20,7 @@ from eeg_dataanalysispackage_tpu.obs import chaos
 from eeg_dataanalysispackage_tpu.ops import (
     decode_ingest,
     device_ingest,
+    quant,
     serve_mega,
 )
 from eeg_dataanalysispackage_tpu.pipeline import builder
@@ -431,9 +432,9 @@ def test_int8_extractor_id_and_cache_class_separation(
     )
     ids = {
         p: provider.fused_extractor_id(8, p)
-        for p in ("f32", "bf16", "int8")
+        for p in ("f32", "bf16", "int8", "int4")
     }
-    assert len(set(ids.values())) == 3
+    assert len(set(ids.values())) == 4
 
     monkeypatch.delenv("EEG_TPU_NO_FEATURE_CACHE", raising=False)
     monkeypatch.setenv(
@@ -442,9 +443,9 @@ def test_int8_extractor_id_and_cache_class_separation(
     odp = provider.OfflineDataProvider([session["info"]])
     keys = {
         p: odp.prepare_fused_run(ids[p]).key
-        for p in ("f32", "bf16", "int8")
+        for p in ("f32", "bf16", "int8", "int4")
     }
-    assert len(set(keys.values())) == 3
+    assert len(set(keys.values())) == 4
     cache = feature_cache.open_cache()
     cache.store(
         keys["f32"], np.ones((4, 48), np.float32), np.zeros(4)
@@ -453,12 +454,19 @@ def test_int8_extractor_id_and_cache_class_separation(
     assert cache.lookup(keys["f32"]) is not None
     assert cache.lookup(keys["int8"]) is None
     assert cache.lookup(keys["bf16"]) is None
+    assert cache.lookup(keys["int4"]) is None
     cache.store(
         keys["int8"], np.full((4, 48), 2.0, np.float32), np.zeros(4)
     )
+    cache.store(
+        keys["int4"], np.full((4, 48), 3.0, np.float32), np.zeros(4)
+    )
     hit = cache.lookup(keys["int8"])
     assert hit is not None and float(hit[0][0, 0]) == 2.0
-    # and the int8 entry never leaks into the f32 class
+    # the two quantized classes never serve each other either
+    i4_hit = cache.lookup(keys["int4"])
+    assert i4_hit is not None and float(i4_hit[0][0, 0]) == 3.0
+    # and the quantized entries never leak into the f32 class
     f32_hit = cache.lookup(keys["f32"])
     assert f32_hit is not None and float(f32_hit[0][0, 0]) == 1.0
 
@@ -552,12 +560,166 @@ def test_engine_int8_gate_auto_disables(session, monkeypatch):
     try:
         rec = svc.engine.precision_record
         assert rec["used"] == "f32" and not rec["gate"]["ok"]
-        # a gated-off int8 engine never takes the mega rung either
-        # (mega is f32-only by request, not by resolution)
-        assert svc.engine.rung == "fused"
+        # a gated-off int8 engine is an EFFECTIVE-f32 engine: since
+        # ISSUE 18 un-pinned quantized engines from fused, it attempts
+        # (and on CPU earns) the mega rung at the f32 parity bound
+        assert svc.engine.rung == "mega"
+        assert svc.engine.mega_record["precision"] == "f32"
     finally:
         svc.stop(drain=True)
     assert svc.stats_block()["precision"]["used"] == "f32"
+
+
+# -- the int4 precision rung (ISSUE 18) ----------------------------------
+
+
+def test_int4_decode_featurizer_within_gate():
+    """The bottom rung's rows deviate from f32 by less than the int4
+    gate on realistic DC-offset signal — and coarser than int8's on
+    the SAME signal, pinning the ladder's ordering."""
+    rng = np.random.RandomState(3)
+    S = 16384
+    raw = (
+        rng.randint(-3000, 3000, size=(3, S))
+        + np.asarray([15000, -12000, 9000])[:, None]
+    ).astype(np.int16)
+    res = np.full(3, 0.1, np.float32)
+    positions = (np.arange(24, dtype=np.int64) * 600 + _PRE)
+    cap = 64
+    pos = np.zeros(cap, np.int32)
+    pos[:24] = positions
+    mask = np.zeros(cap, bool)
+    mask[:24] = True
+    f32 = decode_ingest.make_decode_ingest_featurizer(precision="f32")(
+        raw, res, pos, mask
+    )
+    i4 = decode_ingest.make_decode_ingest_featurizer(precision="int4")(
+        raw, res, pos, mask
+    )
+    gate = decode_ingest.feature_precision_gate(
+        np.asarray(i4)[mask], np.asarray(f32)[mask], precision="int4"
+    )
+    assert gate["ok"], gate
+    assert 0.0 < gate["max_abs_dev"] <= quant.INT4_GATE_TOL
+    assert gate["precision"] == "int4"
+    i8 = decode_ingest.make_decode_ingest_featurizer(precision="int8")(
+        raw, res, pos, mask
+    )
+    dev_i8 = float(np.max(np.abs(np.asarray(i8) - np.asarray(f32))))
+    assert gate["max_abs_dev"] > dev_i8
+
+
+def test_int4_pipeline_auto_disable_pins_f32_statistics(
+    session, monkeypatch
+):
+    """The ISSUE 18 acceptance pin, int4 edition: a forced-zero-
+    tolerance run auto-disables and produces statistics byte-identical
+    to the f32 run; an un-forced run records its gate decision."""
+    q = (
+        f"info_file={session['info']}&train_clf=logreg&cache=false"
+        f"{_CONFIG}"
+    )
+    pb_f32 = builder.PipelineBuilder(q + "&fe=dwt-8-fused-decode")
+    s_f32 = pb_f32.execute()
+
+    provider.reset_gate_memo()
+    pb_i4 = builder.PipelineBuilder(
+        q + "&fe=dwt-8-fused&precision=int4"
+    )
+    s_i4 = pb_i4.execute()
+    rec = pb_i4.precision_resolved
+    assert rec["requested"] == "int4" and rec["used"] == "int4"
+    assert rec["gate"]["ok"] and rec["gate"]["gate_seconds"] > 0.0
+
+    monkeypatch.setenv("EEG_TPU_INT4_GATE_TOL", "0")
+    pb_off = builder.PipelineBuilder(
+        q + "&fe=dwt-8-fused&precision=int4"
+    )
+    s_off = pb_off.execute()
+    assert pb_off.precision_resolved["used"] == "f32"
+    assert not pb_off.precision_resolved["gate"]["ok"]
+    assert str(s_off) == str(s_f32)
+    del s_i4  # gate-passing statistics live in their own class
+
+
+def _mega_int4_margins(windows, weights, capacity):
+    import jax
+
+    prog = serve_mega.make_serve_mega_program(
+        n_channels=_C, pre=_PRE, post=_POST, capacity=capacity,
+        lowering="xla", interpret=True, donate=False,
+        precision="int4",
+    )
+    stride = serve_mega.padded_stride(_PRE, _POST)
+    stream = serve_mega.stage_mega_stream(
+        windows, _C, _WIN, stride, capacity
+    )
+    return np.asarray(prog(
+        jax.device_put(stream), _RES,
+        np.asarray(weights, np.float32),
+    ))
+
+
+def test_mega_int4_bit_identical_within_bucket():
+    """Per-ROW quantization keeps the mega contract on the int4 rung:
+    a window's int4 margin is byte-equal whatever batch it rides in —
+    a loud neighbour cannot stretch its quantization grid."""
+    rng = np.random.RandomState(2)
+    weights = rng.randn(_C * 16).astype(np.float32)
+    windows = _windows(7, seed=7)
+    windows[3] = (windows[3].astype(np.int32) * 10).clip(
+        -32768, 32767
+    ).astype(np.int16)  # the loud neighbour
+    batch = _mega_int4_margins(windows, weights, 64)
+    for i, w in enumerate(windows):
+        solo = _mega_int4_margins([w], weights, 64)
+        assert solo[0] == batch[i]
+    # padded rows stay exactly zero on the quantized rung too
+    assert np.all(batch[len(windows):] == 0.0)
+
+
+def test_engine_int4_attempts_mega_and_matches_fused_twin(session):
+    """ISSUE 18's satellite: quantized-feature engines attempt the
+    mega rung (built at the EFFECTIVE precision, judged at the rung's
+    own tolerance) instead of the PR 12 hard-pin to fused — and the
+    promoted engine's predictions match a fused-pinned int4 twin's."""
+    windows = _windows(12, seed=5)
+    with InferenceService(
+        session["classifier"], precision="int4", engine_rung="auto",
+    ) as mega_svc:
+        assert mega_svc.engine.precision_record["used"] == "int4"
+        record = mega_svc.engine.mega_record
+        assert record is not None and record["precision"] == "int4"
+        assert record["used"] == "mega" and record["gate"]["ok"]
+        # judged at the rung's own tolerance, not the f32 parity bound
+        assert record["gate"]["tolerance"] == max(
+            serve_mega.mega_gate_tolerance(),
+            quant.int4_gate_tolerance(),
+        )
+        mega = [
+            r.prediction
+            for r in mega_svc.predict_all(windows, _RES)
+        ]
+    with InferenceService(
+        session["classifier"], precision="int4", engine_rung="fused",
+    ) as fused_svc:
+        assert fused_svc.engine.rung == "fused"
+        fused = [
+            r.prediction
+            for r in fused_svc.predict_all(windows, _RES)
+        ]
+    assert mega == fused
+
+
+def test_engine_bf16_stays_pinned_to_fused(session):
+    """The un-pin stops at bf16: its cascade runs bfloat16 OPERANDS,
+    so there is no bf16 mega twin to gate — the engine records no
+    mega candidacy at all."""
+    with InferenceService(
+        session["classifier"], precision="bf16", engine_rung="auto",
+    ) as svc:
+        assert svc.engine.rung == "fused"
+        assert svc.engine.mega_record is None
 
 
 # -- the serve_flush_us coalescing window --------------------------------

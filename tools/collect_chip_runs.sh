@@ -49,6 +49,9 @@ run population_multiproc 1800 python tools/pipeline_bench.py population_multipro
 # the int8 precision rung's gate decision on chip (the precision
 # block + gate_seconds ride the line)
 run pipeline_int8 900 python tools/pipeline_bench.py pipeline_e2e_int8 2000 4
+# the int4 rung's gate decision on chip (bottom of the ladder — the
+# widest envelope; same precision-block attribution)
+run pipeline_int4 900 python tools/pipeline_bench.py pipeline_e2e_int4 2000 4
 # outer timeout must exceed bench.py's worst case (probe 420 +
 # variant budget 1800 + one variant overrun 420 = 2640 < 3600) so the
 # caller never SIGTERMs bench mid-variant; 1800 gives all 8 variants
@@ -84,5 +87,13 @@ run serve_mega 1200 python tools/serve_bench.py serve_mega 2000 2
 # MULTIPLEX_FLIP_RATIO, flips the consolidation call, zero code
 # change). Same mega program family as serve_mega, so it sits here.
 run serve_multitenant 1200 python tools/serve_bench.py serve_multitenant 2000 2
+# the quantized (int4 packed + per-lane scales) tenant weight stack
+# vs the f32 multiplexed twin on chip: this artifact IS the
+# weight-residency decision path's input
+# (ops/quant.accelerator_decision — a 16-tenant conc-16 quant/f32
+# preds/sec ratio >= 0.95, pre-registered as
+# WEIGHTS_QUANT_FLIP_RATIO, flips the default stack residency to
+# int4, zero code change). Same program family, so it sits here.
+run serve_multitenant_quant 1200 python tools/serve_bench.py serve_multitenant_quant 2000 2
 run pallas_bisect 900 python tools/pallas_compile_bisect.py
 run sublane_probe 900 python tools/pallas_sublane_probe.py

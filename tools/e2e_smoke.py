@@ -147,6 +147,23 @@ performance contract holds:
   worse than the 16-engine solo fleet it replaces (0.9x noise
   floor, back-to-back on a shared box).
 
+- the int4 precision rung (pipeline_e2e_int4 — the ISSUE 18
+  tentpole's feature half): the bottom of the ladder rides the SAME
+  gate contract as bf16/int8 — a decision recorded on every run,
+  measured deviation inside the int4 envelope when it served, and
+  the forced-gate-off twin (EEG_TPU_INT4_GATE_TOL=0) auto-disabled
+  AND byte-identical to the f32 cold run.
+
+- the quantized tenant weight stack (serve_multitenant_quant,
+  tools/serve_bench.py — the ISSUE 18 tentpole's serving half): the
+  warmup gate decision recorded, 16-tenant margins within the
+  derived weights tolerance of the f32 multiplexed twin, >=4x
+  resident-weight-bytes reduction, tenant add/swap/remove at 0 XLA
+  compiles on the LIVE quantized stack, quant throughput >=0.95x
+  the f32 twin (noise floor applied — shared box), and the
+  forced-gate-off twin (EEG_TPU_WEIGHTS_GATE_TOL=0) serving the f32
+  stack with margins bit-identical to the twin.
+
 Usage: python tools/e2e_smoke.py [n_markers_per_file] [n_files]
 
 Prints a JSON summary line; exit 0 iff every gate passed. Wired into
@@ -164,10 +181,23 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _PIPELINE_BENCH = os.path.join(_REPO, "tools", "pipeline_bench.py")
 _SERVE_BENCH = os.path.join(_REPO, "tools", "serve_bench.py")
 
+#: the run-report gates :func:`run` drives through ``_check_report``,
+#: in call order. The summary's ``reports_checked`` count and the
+#: suite's pin (tests/test_e2e_smoke.py) are BOTH derived from this
+#: registry, so growing the checked set is one edit here — never a
+#: hand-maintained integer chase across files.
+REPORT_CHECKS = (
+    "cold", "warm", "fanout", "pop_vmap", "pop_looped", "pop_sharded",
+)
+
 
 def _run_serve_bench(n_markers: int, n_files: int,
                      report_dir: str = None,
-                     variant: str = "serve_bench") -> dict:
+                     variant: str = "serve_bench",
+                     env_extra: dict = None) -> dict:
+    env = dict(os.environ)
+    if env_extra:
+        env.update(env_extra)
     proc = subprocess.run(
         [
             sys.executable, _SERVE_BENCH, variant,
@@ -176,6 +206,7 @@ def _run_serve_bench(n_markers: int, n_files: int,
         ],
         capture_output=True,
         text=True,
+        env=env,
     )
     if proc.returncode != 0:
         raise RuntimeError(
@@ -438,6 +469,108 @@ def _check_multitenant(line: dict, failures: list) -> None:
                 f"serve_multitenant: unresolved requests at the "
                 f"16-tenant level: {level16.get('multiplexed')}"
             )
+
+
+def _check_multitenant_quant(line: dict, off_line: dict,
+                             failures: list) -> None:
+    """The quantized weight stack gate (the ISSUE 18 serving-half
+    acceptance): warmup gate decision recorded; when the int4 stack
+    served, its measured deviation inside the derived tolerance and
+    every 16-tenant margin within that tolerance of the f32
+    multiplexed twin; >=4x resident-weight-bytes reduction; tenant
+    add/swap/remove at 0 XLA compiles on the live quantized stack;
+    quant conc-16 throughput >=0.95x the f32 twin (with the same
+    shared-box noise allowance the serve_multitenant gate applies);
+    and the forced-gate-off twin (EEG_TPU_WEIGHTS_GATE_TOL=0) serving
+    the f32 stack with margins bit-identical to the twin's."""
+    mq = (line.get("serve") or {}).get("multitenant_quant") or {}
+    if not mq:
+        failures.append(
+            "serve_multitenant_quant: no multitenant_quant block on "
+            "the line"
+        )
+        return
+    weights = mq.get("weights") or {}
+    gate = weights.get("gate") or {}
+    if weights.get("requested") != "int4" or "used" not in weights:
+        failures.append(
+            f"serve_multitenant_quant: no weights gate decision "
+            f"recorded: {weights}"
+        )
+    elif weights["used"] == "int4":
+        if not (
+            gate.get("ok")
+            and gate.get("max_abs_dev", 1.0)
+            <= gate.get("tolerance", 0.0)
+        ):
+            failures.append(
+                f"serve_multitenant_quant: int4 stack served outside "
+                f"its gate: {gate}"
+            )
+        admin = mq.get("admin") or {}
+        if not admin.get("compiles_zero_ok"):
+            failures.append(
+                f"serve_multitenant_quant: tenant admin on the "
+                f"quantized stack recompiled: {admin}"
+            )
+        if not admin.get("still_quantized"):
+            failures.append(
+                f"serve_multitenant_quant: tenant admin degraded the "
+                f"stack to f32: {admin}"
+            )
+        parity = mq.get("parity") or {}
+        if not parity.get("within_tolerance"):
+            failures.append(
+                f"serve_multitenant_quant: 16-tenant margins drifted "
+                f"past the weights tolerance of the f32 twin: {parity}"
+            )
+        resident = mq.get("resident") or {}
+        if not resident.get("reduction", 0.0) >= 4.0:
+            failures.append(
+                f"serve_multitenant_quant: resident-weight-bytes "
+                f"reduction below the 4x bar: {resident}"
+            )
+        qps = (mq.get("quant") or {}).get("preds_per_s", 0.0)
+        fps = (mq.get("f32") or {}).get("preds_per_s", 0.0)
+        # nominal pin 0.95x (the dequant toll must stay in the noise);
+        # measured with the same 0.9x-style shared-box allowance the
+        # serve_multitenant fleet gate applies, so 0.9 * 0.95
+        if not qps >= 0.9 * 0.95 * fps:
+            failures.append(
+                f"serve_multitenant_quant: quantized stack slower "
+                f"than 0.95x the f32 twin at conc 16 (noise floor "
+                f"applied): {qps} vs {fps} preds/s"
+            )
+        if (mq.get("quant") or {}).get("unresolved"):
+            failures.append(
+                f"serve_multitenant_quant: unresolved requests on "
+                f"the quantized stack: {mq.get('quant')}"
+            )
+    if not mq.get("drained_cleanly"):
+        failures.append(
+            "serve_multitenant_quant: a service did not drain cleanly"
+        )
+    # the forced-gate-off drill: the gate must refuse (recorded), the
+    # run serves the f32 stack, and — both sides then running the SAME
+    # f32 program over the SAME host mirror — margins are bit-identical
+    off = (off_line.get("serve") or {}).get("multitenant_quant") or {}
+    off_weights = off.get("weights") or {}
+    if off_weights.get("used") != "f32" or (
+        off_weights.get("gate") or {}
+    ).get("ok") is not False:
+        failures.append(
+            f"serve_multitenant_quant: forced gate-off did not refuse "
+            f"the quantized stack: {off_weights}"
+        )
+    off_parity = off.get("parity") or {}
+    if not (
+        off_parity.get("max_abs_margin_dev") == 0.0
+        and off_parity.get("prediction_mismatches") == 0
+    ):
+        failures.append(
+            f"serve_multitenant_quant: gated-off stack's margins not "
+            f"bit-identical to the f32 twin: {off_parity}"
+        )
 
 
 def _run_variant(variant: str, n_markers: int, n_files: int,
@@ -1012,6 +1145,20 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
             os.path.join(tmp, "report_int8_off"),
             env_extra={"EEG_TPU_INT8_GATE_TOL": "0"},
         )
+        # the int4 rung (ISSUE 18): same contract, bottom of the
+        # ladder — gate decision recorded, and the forced-gate-off
+        # twin pinned byte-identical to f32
+        int4_line = _run_variant(
+            "pipeline_e2e_int4", n_markers, n_files,
+            data_dir, os.path.join(tmp, "cache_int4"),
+            os.path.join(tmp, "report_int4"),
+        )
+        int4_off_line = _run_variant(
+            "pipeline_e2e_int4", n_markers, n_files,
+            data_dir, os.path.join(tmp, "cache_int4_off"),
+            os.path.join(tmp, "report_int4_off"),
+            env_extra={"EEG_TPU_INT4_GATE_TOL": "0"},
+        )
         # the other four legs as their OWN single-classifier cold
         # runs (fresh process, fresh cache): their reports' compile
         # counters are the honest "5x single" side of the fan-out
@@ -1097,6 +1244,21 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
             min(n_markers, 400), n_files, variant="serve_multitenant"
         )
         _check_multitenant(multitenant_line, failures)
+        # the quantized tenant weight stack (ISSUE 18 tentpole): the
+        # int4 run plus its forced-gate-off drill, gated together
+        multitenant_quant_line = _run_serve_bench(
+            min(n_markers, 400), n_files,
+            variant="serve_multitenant_quant",
+        )
+        multitenant_quant_off_line = _run_serve_bench(
+            min(n_markers, 400), n_files,
+            variant="serve_multitenant_quant",
+            env_extra={"EEG_TPU_WEIGHTS_GATE_TOL": "0"},
+        )
+        _check_multitenant_quant(
+            multitenant_quant_line, multitenant_quant_off_line,
+            failures,
+        )
         # the seizure workload: one cost-swept population run over a
         # continuous annotated session (its own data dir — the
         # manifest points at continuous recordings); the swept member
@@ -1169,6 +1331,15 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
             report_dirs["pop_sharded"], report_dirs["pop_vmap"],
             failures,
         )
+        # the checked set IS the registry: a report gate added (or
+        # dropped) without updating REPORT_CHECKS fails here, and the
+        # suite's reports_checked pin derives from the same tuple
+        if tuple(reports_checked) != REPORT_CHECKS:
+            failures.append(
+                f"report checks drifted from the REPORT_CHECKS "
+                f"registry: ran {tuple(reports_checked)}, registered "
+                f"{REPORT_CHECKS}"
+            )
 
     if not warm["wall_s"] < cold["wall_s"]:
         failures.append(
@@ -1266,6 +1437,30 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
         failures.append(
             "gated-off int8 run drifted from the f32 cold run: "
             f"{int8_off_line['report_sha256']} vs "
+            f"{cold['report_sha256']}"
+        )
+    # the int4 rung: the same contract at the bottom of the ladder
+    prec_i4 = int4_line.get("precision") or {}
+    gate_i4 = prec_i4.get("gate") or {}
+    if prec_i4.get("requested") != "int4" or "used" not in prec_i4:
+        failures.append(
+            f"int4 line recorded no gate decision: {prec_i4}"
+        )
+    elif prec_i4["used"] == "int4" and not (
+        gate_i4.get("ok")
+        and gate_i4.get("max_abs_dev", 1.0)
+        <= gate_i4.get("tolerance", 0.0)
+    ):
+        failures.append(f"int4 ran outside its gate: {gate_i4}")
+    prec_i4_off = int4_off_line.get("precision") or {}
+    if prec_i4_off.get("used") != "f32":
+        failures.append(
+            f"forced int4 gate-off did not auto-disable: {prec_i4_off}"
+        )
+    if int4_off_line["report_sha256"] != cold["report_sha256"]:
+        failures.append(
+            "gated-off int4 run drifted from the f32 cold run: "
+            f"{int4_off_line['report_sha256']} vs "
             f"{cold['report_sha256']}"
         )
     plateau_summary = _check_plateau(cold, failures)
@@ -1410,6 +1605,10 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
         "int8_gate_off_identical_to_f32": (
             int8_off_line["report_sha256"] == cold["report_sha256"]
         ),
+        "int4_precision": int4_line.get("precision"),
+        "int4_gate_off_identical_to_f32": (
+            int4_off_line["report_sha256"] == cold["report_sha256"]
+        ),
         "serve_lifecycle": {
             "no_swap_parity": (
                 (lifecycle_line.get("serve") or {})
@@ -1450,6 +1649,32 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
                 (multitenant_line.get("serve") or {})
                 .get("multitenant") or {}
             ).get("levels"),
+        },
+        "serve_multitenant_quant": {
+            "weights": (
+                (multitenant_quant_line.get("serve") or {})
+                .get("multitenant_quant") or {}
+            ).get("weights"),
+            "parity": (
+                (multitenant_quant_line.get("serve") or {})
+                .get("multitenant_quant") or {}
+            ).get("parity"),
+            "ratio": (
+                (multitenant_quant_line.get("serve") or {})
+                .get("multitenant_quant") or {}
+            ).get("ratio"),
+            "resident": (
+                (multitenant_quant_line.get("serve") or {})
+                .get("multitenant_quant") or {}
+            ).get("resident"),
+            "admin": (
+                (multitenant_quant_line.get("serve") or {})
+                .get("multitenant_quant") or {}
+            ).get("admin"),
+            "gate_off_used": (
+                ((multitenant_quant_off_line.get("serve") or {})
+                 .get("multitenant_quant") or {}).get("weights") or {}
+            ).get("used"),
         },
         "serve_mega": {
             "mega_rung": (

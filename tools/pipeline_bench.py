@@ -37,6 +37,14 @@ Variants:
                         behind the same per-run gate machinery — the
                         rung below bf16, same ``precision`` block
                         attribution
+  pipeline_e2e_int4     the cold query with precision=int4: finished
+                        f32 feature rows quantized per (channel,
+                        subband) group, two nibbles per byte
+                        (ops/quant.quantize_dequantize_int4) behind
+                        the same per-run gate machinery — the bottom
+                        rung, widest envelope, same ``precision``
+                        block attribution and its own int4 feature
+                        cache class
   population_vmap       a 16-member population (cv=4 folds x a 2x2
                         lr/reg grid, models/population.py) trained
                         as ONE vmapped program — the compile- and
@@ -1394,7 +1402,7 @@ def main(argv) -> dict:
     if variant not in (
         "pipeline_e2e_cold", "pipeline_e2e_warm", "pipeline_e2e_fanout5",
         "pipeline_e2e_overlap", "pipeline_e2e_bf16",
-        "pipeline_e2e_int8",
+        "pipeline_e2e_int8", "pipeline_e2e_int4",
         "population_vmap", "population_looped", "population_sharded",
         "population_multiproc", "multiproc_worker",
         "seizure_e2e", "scheduler_multi", "scheduler_suicide",
@@ -1684,6 +1692,7 @@ def main(argv) -> dict:
             "pipeline_e2e_overlap": "&overlap=true",
             "pipeline_e2e_bf16": "&precision=bf16",
             "pipeline_e2e_int8": "&precision=int8",
+            "pipeline_e2e_int4": "&precision=int4",
         }.get(variant, "")
         query = build_query(
             info, fanout=variant == "pipeline_e2e_fanout5",

@@ -5,6 +5,8 @@ Usage: python tools/serve_bench.py serve_bench <n_markers> <n_files>
        python tools/serve_bench.py serve_mega <n_markers> <n_files>
        python tools/serve_bench.py serve_multitenant <n_markers>
            <n_files>
+       python tools/serve_bench.py serve_multitenant_quant
+           <n_markers> <n_files>
 
 One hermetic run proves the serving layer's whole contract and prints
 one JSON line in the driver-facing schema (bench.py whitelists the
@@ -55,6 +57,23 @@ counts for scaling 1→16 tenants and for a hot swap (both pinned at 0
 (one stacked matrix vs N engines). The accelerator decision path
 (multiplex.accelerator_decision) harvests the 16-tenant level from
 staged chip runs of this variant.
+
+The ``serve_multitenant_quant`` variant is the quantized tenant
+weight stack (``weights_precision=int4`` on the same multiplexed
+engine): 16 tenants through the VMEM-resident packed int4 matrix +
+per-lane scales (dequantized inside the program) driven at
+concurrency 16 back-to-back against the SAME 16 tenants through the
+f32 multiplexed twin. The line records the preds/sec pair + ratio,
+the per-tenant margin-parity pin against the f32 twin (within the
+derived weights gate tolerance), the engine's weights-quant warmup
+gate record, the resident-weight-bytes reduction (f32 stack /
+packed stack — >=4x at int4), and the XLA compile counts for tenant
+add/swap/remove on the LIVE quantized stack (pinned 0 — the f32
+host mirror stays master, requantized and republished without a
+recompile). The weight-residency decision path
+(ops/quant.accelerator_decision) harvests the 16-tenant block from
+staged chip runs of this variant against the pre-registered
+WEIGHTS_QUANT_FLIP_RATIO.
 
 Everything is fabricated by tests/_synthetic.py; the model is trained
 and saved by the real pipeline in-process before the service loads it.
@@ -1120,11 +1139,178 @@ def run_multitenant(n_markers: int, n_files: int) -> dict:
     }
 
 
+def run_multitenant_quant(n_markers: int, n_files: int) -> dict:
+    """The serve_multitenant_quant measurement: 16 tenants through
+    the packed int4 weight stack vs the same 16 through the f32
+    multiplexed twin, back-to-back at concurrency 16 (see the module
+    docstring)."""
+    import numpy as np
+
+    from eeg_dataanalysispackage_tpu.obs.report import (
+        CompilationMonitor,
+    )
+    from eeg_dataanalysispackage_tpu.ops import quant
+    from eeg_dataanalysispackage_tpu.serve import (
+        MultiplexedService, ServeConfig,
+    )
+
+    t0 = time.perf_counter()
+    tmp = tempfile.mkdtemp(prefix="eeg_tpu_serve_mt_quant_")
+    (
+        _info, model, windows, _targets, resolutions, _classifier,
+        _batch_features, _batch_predictions,
+    ) = _prepare(tmp, n_markers, n_files)
+
+    n_tenants = max(_TENANT_LEVELS)
+    tenant_models = _clone_tenants(model, n_tenants)
+    names = list(tenant_models)
+
+    with CompilationMonitor() as warm_mon:
+        service = MultiplexedService(
+            tenant_models, config=ServeConfig(),
+            weights_precision="int4",
+        )
+        service.engine.warmup()
+    warmup = warm_mon.snapshot()
+    counters_available = bool(warmup.get("available"))
+    weights_record = service.engine.weights_record
+
+    twin = MultiplexedService(tenant_models, config=ServeConfig())
+    twin.engine.warmup()
+
+    service.start()
+    twin.start()
+    try:
+        quant_level = _drive_level(
+            service, windows, resolutions, 16, _REQUESTS_PER_LEVEL,
+            deadline_s=5.0, tenants=names,
+        )
+        # the f32 multiplexed twin over the SAME models, seconds
+        # later (temporal adjacency — this box's load swings 2-4x
+        # between runs)
+        f32_level = _drive_level(
+            twin, windows, resolutions, 16, _REQUESTS_PER_LEVEL,
+            deadline_s=5.0, tenants=names,
+        )
+
+        # per-tenant margin parity out of a 16-way mixed stream: the
+        # quantized stack's margins vs the f32 twin's, element-wise,
+        # pinned within the derived weights gate tolerance (the same
+        # envelope the warmup gate enforced)
+        mix = [names[i % n_tenants] for i in range(len(windows))]
+        q_served = service.predict_all(windows, resolutions, mix)
+        f_served = twin.predict_all(windows, resolutions, mix)
+        q_margins = np.array([r.margin for r in q_served])
+        f_margins = np.array([r.margin for r in f_served])
+        tol = quant.weights_gate_tolerance(
+            "int4", service.engine._w_host
+        )
+        margin_dev = float(np.max(np.abs(q_margins - f_margins)))
+        pred_mismatches = int(sum(
+            a.prediction != b.prediction
+            for a, b in zip(q_served, f_served)
+        ))
+        parity = {
+            "n": len(windows),
+            "tenants": n_tenants,
+            "max_abs_margin_dev": margin_dev,
+            "tolerance": tol,
+            "within_tolerance": margin_dev <= tol,
+            "prediction_mismatches": pred_mismatches,
+        }
+
+        # the 0-compile admin pin ON THE LIVE QUANTIZED STACK: add,
+        # swap, remove — the f32 host mirror stays master, the packed
+        # matrix + scales are requantized and republished, and the
+        # resident program never recompiles
+        replacement = _clone_tenants(model, 2)[names[1]]
+        with CompilationMonitor() as admin_mon:
+            service.add_tenant("t_extra", replacement)
+            service.swap_tenant(names[0], replacement)
+            service.remove_tenant("t_extra")
+            admin_result = service.predict_window(
+                windows[0], resolutions, tenant=names[0],
+            )
+        admined = admin_mon.snapshot()
+        admin_compiles = (
+            admined["compilations"] if admined.get("available") else 0
+        )
+        admin_block = {
+            "compiles": admin_compiles,
+            "compiles_zero_ok": (
+                not counters_available or admin_compiles == 0
+            ),
+            "served_after_admin": admin_result.prediction in (
+                0.0, 1.0
+            ),
+            "still_quantized": (
+                service.engine.weights_precision == "int4"
+            ),
+        }
+        stats = service.stats_block()
+    finally:
+        drained = service.stop(drain=True)
+        twin_drained = twin.stop(drain=True)
+
+    import jax
+
+    f32_bytes = twin.engine.resident_weight_bytes
+    quant_bytes = service.engine.resident_weight_bytes
+    return {
+        "variant": "serve_multitenant_quant",
+        "epochs_per_s": quant_level["preds_per_s"],
+        "n": len(windows),
+        "iters": _REQUESTS_PER_LEVEL,
+        "bytes_per_epoch": _BYTES_PER_EPOCH,
+        "wall_s": round(time.perf_counter() - t0, 3),
+        "n_markers_per_file": n_markers,
+        "n_files": n_files,
+        "platform": jax.devices()[0].platform,
+        "serve": {
+            "multitenant_quant": {
+                "tenants": n_tenants,
+                "weights_precision": (
+                    service.engine.weights_precision
+                ),
+                "weights": weights_record,
+                "quant": quant_level,
+                "f32": f32_level,
+                "ratio": round(
+                    quant_level["preds_per_s"]
+                    / max(1e-9, f32_level["preds_per_s"]), 3
+                ),
+                "parity": parity,
+                "compiles": {
+                    "available": counters_available,
+                    "warmup": warmup.get("compilations"),
+                },
+                "admin": admin_block,
+                "resident": {
+                    "f32_bytes": f32_bytes,
+                    "quant_bytes": quant_bytes,
+                    # the VMEM-residency win the packed stack buys:
+                    # >=4x is the acceptance bar (int4 measures
+                    # ~6.9x — packed nibbles + per-lane f32 scales)
+                    "reduction": round(
+                        f32_bytes / max(1, quant_bytes), 3
+                    ),
+                },
+                "rung": service.engine.rung,
+                "drained_cleanly": drained and twin_drained,
+                "service": stats,
+                "accelerator_decision": (
+                    quant.accelerator_decision()
+                ),
+            },
+        },
+    }
+
+
 def main(argv) -> dict:
     variant = argv[0] if argv else "serve_bench"
     if variant not in (
         "serve_bench", "serve_mega", "serve_lifecycle",
-        "serve_multitenant",
+        "serve_multitenant", "serve_multitenant_quant",
     ):
         raise SystemExit(f"unknown variant {variant!r}")
     n_markers = int(argv[1]) if len(argv) > 1 else 400
@@ -1141,6 +1327,8 @@ def main(argv) -> dict:
         return run_lifecycle(n_markers, n_files, report_dir=report_dir)
     if variant == "serve_multitenant":
         return run_multitenant(n_markers, n_files)
+    if variant == "serve_multitenant_quant":
+        return run_multitenant_quant(n_markers, n_files)
     return run(n_markers, n_files, report_dir=report_dir)
 
 
