@@ -116,6 +116,9 @@ class FleetReplica:
             self.executor.journal.directory, holder=self.replica_id
         )
         self.executor.leases = self.leases
+        # the crash flight recorder (obs/report.py) reads the held
+        # leases off this registration when a fleet plan dies
+        lease_mod.set_active(self.leases)
         self._scan_interval_s = (
             scan_interval_s if scan_interval_s is not None
             else scan_interval()

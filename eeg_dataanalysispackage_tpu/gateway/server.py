@@ -56,6 +56,16 @@ GET    /stats                     200 {dedup, queue_depth, scheduler
                                   sub-block (serve/multiplex.py); in a
                                   replica fleet also ``fleet`` — replica id
                                   + lease claim/takeover/break counters
+GET    /metrics                   200 Prometheus text exposition
+                                  (obs/metrics_export.py): every
+                                  ``obs.metrics`` counter as a
+                                  ``*_total`` series, serve latency
+                                  histograms (global + per-tenant
+                                  labels), lease counters, queue depth,
+                                  and the ``eeg_tpu_build_info`` series
+                                  naming the replica — the ONLY
+                                  non-JSON endpoint; deterministic
+                                  ordering, fleet_top's scrape surface
 GET    /healthz                   200 {ok: true, ...} — pure LIVENESS: the
                                   process answers; never checks disk
 GET    /readyz                    READINESS: 200 {ready: true} only when
@@ -68,7 +78,11 @@ GET    /readyz                    READINESS: 200 {ready: true} only when
 
 Headers on POST /plans: ``X-Idempotency-Key`` (client retry token,
 journaled with the plan record), ``X-Plan-Deadline-S`` (float; the
-executor's per-plan deadline budget).
+executor's per-plan deadline budget), ``X-Trace-Id`` (caller-supplied
+distributed-trace id; minted when absent, echoed as ``trace_id`` in
+the response, and journaled with the plan record so a fleet takeover
+CONTINUES the same trace on the surviving replica). ``X-Trace-Id`` is
+honored on POST /predict too (echoed in the prediction payload).
 """
 
 from __future__ import annotations
@@ -78,6 +92,7 @@ import logging
 import os
 import re
 import threading
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 
@@ -106,6 +121,19 @@ _PREDICT_CACHE_LIMIT = 4096
 ENV_PORT = "EEG_TPU_GATEWAY_PORT"
 
 _PLAN_PATH = re.compile(r"^/plans/([A-Za-z0-9_.-]+)(/report)?$")
+
+#: an acceptable inbound X-Trace-Id (filesystem-safe — trace segment
+#: files embed it in attrs); anything else is ignored and a fresh id
+#: is minted instead of 400ing the plan over a malformed ornament
+_TRACE_ID = re.compile(r"^[A-Za-z0-9_.-]{1,128}$")
+
+
+def mint_trace_id(inbound: Optional[str] = None) -> str:
+    """The request's distributed-trace id: the caller's ``X-Trace-Id``
+    when it is well-formed, a fresh uuid4 hex otherwise."""
+    if inbound and _TRACE_ID.match(inbound):
+        return inbound
+    return uuid.uuid4().hex
 
 
 class GatewayServer:
@@ -248,9 +276,11 @@ class GatewayServer:
         deadline_s: Optional[float] = None,
         idempotency_key: Optional[str] = None,
         client: Optional[str] = None,
+        trace_id: Optional[str] = None,
     ) -> Tuple[int, Dict[str, Any]]:
         from ..pipeline.builder import decode_percent_query
 
+        trace_id = mint_trace_id(trace_id)
         if self.draining:
             return 503, {
                 "error": f"replica {self.replica_id} is draining; "
@@ -264,7 +294,9 @@ class GatewayServer:
             return 400, {"error": str(e)}
         if not query:
             return 400, {"error": "empty request body; POST the query string"}
-        gateway_block = {"via": "http"}
+        # replica names the trace segment file (obs/events.py) even
+        # for a solo (lease-less) gateway
+        gateway_block = {"via": "http", "replica": self.replica_id}
         if idempotency_key:
             gateway_block["idempotency_key"] = idempotency_key
         if client:
@@ -275,6 +307,7 @@ class GatewayServer:
                 deadline_s=deadline_s,
                 idempotency_key=idempotency_key,
                 gateway=gateway_block,
+                trace_id=trace_id,
             )
         except PlanShedError as e:
             # backpressure, with the evidence and the journaled id —
@@ -301,6 +334,9 @@ class GatewayServer:
                 "idempotent_replay": True,
                 "owner": e.holder,
                 "replica": self.replica_id,
+                "trace_id": (
+                    self._journaled_trace_id(e.plan_id) or trace_id
+                ),
             }
         except ValueError as e:
             # PlanValidationError included: the query is the bug
@@ -309,16 +345,31 @@ class GatewayServer:
         if not replayed and self.executor.journal is None:
             with self._lock:
                 self._handles[handle.plan_id] = handle
+        if replayed:
+            # a keyed replay continues the ORIGINAL submission's trace
+            # — the journaled id, not the one this retry minted
+            trace_id = self._journaled_trace_id(handle.plan_id) or trace_id
         return (200 if replayed else 201), {
             "plan_id": handle.plan_id,
             "state": handle.state,
             "idempotent_replay": replayed,
+            "trace_id": trace_id,
         }
+
+    def _journaled_trace_id(self, plan_id: str) -> Optional[str]:
+        journal = self.executor.journal
+        if journal is None:
+            return None
+        entry = journal.entry(plan_id)
+        if entry is None:
+            return None
+        return (entry.get("meta") or {}).get("trace_id")
 
     def predict_payload(
         self,
         raw_body: str,
         idempotency_key: Optional[str] = None,
+        trace_id: Optional[str] = None,
     ) -> Tuple[int, Dict[str, Any]]:
         """The serving hot path: one tenant-keyed prediction request
         against the attached multiplexed service.
@@ -333,6 +384,7 @@ class GatewayServer:
 
         import numpy as np
 
+        trace_id = mint_trace_id(trace_id)
         if self.predict_service is None:
             return 503, {
                 "error": "no prediction service attached to this "
@@ -420,6 +472,9 @@ class GatewayServer:
             "batch_size": result.batch_size,
             "attempts": result.attempts,
             "idempotent_replay": False,
+            # cached with the payload, so a keyed replay echoes the
+            # ORIGINAL request's trace id (byte-identical answer)
+            "trace_id": trace_id,
         }
         if idempotency_key:
             with self._predict_cache_lock:
@@ -595,6 +650,48 @@ class GatewayServer:
             }
         return 200, payload
 
+    def metrics_payload(self) -> Tuple[int, str]:
+        """The Prometheus text exposition for this replica
+        (obs/metrics_export.py): every ``obs.metrics`` counter,
+        the serve latency histograms (global + per-tenant labels),
+        lease counters, queue depth, and the build-info series naming
+        the replica. Deterministic ordering — the fleet aggregator
+        (tools/fleet_top.py) merges N replicas' histograms exactly."""
+        from ..obs import metrics_export
+
+        snap = obs.metrics.snapshot()
+        counters = dict(snap["counters"])
+        gauges = dict(snap["gauges"])
+        gauges["gateway.queue_depth"] = len(self.executor.queue)
+        histograms = []
+        if self.predict_service is not None:
+            batcher = self.predict_service.batcher
+            histograms.append(
+                ("serve_request_latency_ms", {}, batcher.histogram_snapshot())
+            )
+            for tenant, hist in sorted(
+                batcher.tenant_histogram_snapshot().items()
+            ):
+                histograms.append(
+                    ("serve_request_latency_ms", {"tenant": tenant}, hist)
+                )
+        if self.executor.leases is not None:
+            from ..scheduler import lease as lease_mod
+
+            for key, value in lease_mod.stats().items():
+                counters[f"lease.{key}"] = value
+            gauges["fleet.held_leases"] = len(
+                self.executor.leases.held_leases()
+            )
+            gauges["fleet.draining"] = int(self.draining)
+        text = metrics_export.render(
+            counters=counters,
+            histograms=histograms,
+            gauges=gauges,
+            info={"replica": self.replica_id},
+        )
+        return 200, text
+
     def health_payload(self) -> Tuple[int, Dict[str, Any]]:
         """LIVENESS only — the process answers. Deliberately touches
         no disk: a replica with a read-only journal is alive (don't
@@ -671,6 +768,16 @@ class _GatewayHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _send_text(
+        self, code: int, text: str, content_type: str,
+    ) -> None:
+        body = text.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def _body(self) -> str:
         length = int(self.headers.get("Content-Length", "0") or 0)
         return self.rfile.read(length).decode("utf-8", "replace")
@@ -682,6 +789,7 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             code, payload = self.gateway.predict_payload(
                 self._body(),
                 idempotency_key=self.headers.get("X-Idempotency-Key"),
+                trace_id=self.headers.get("X-Trace-Id"),
             )
             self._send(code, payload)
             return
@@ -704,6 +812,7 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             deadline_s=deadline_s,
             idempotency_key=self.headers.get("X-Idempotency-Key"),
             client=self.client_address[0],
+            trace_id=self.headers.get("X-Trace-Id"),
         )
         self._send(code, payload)
 
@@ -717,6 +826,12 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             return
         if path == "/stats":
             self._send(*self.gateway.stats_payload())
+            return
+        if path == "/metrics":
+            from ..obs import metrics_export
+
+            code, text = self.gateway.metrics_payload()
+            self._send_text(code, text, metrics_export.CONTENT_TYPE)
             return
         if path.rstrip("/") == "/plans":
             self._send(*self.gateway.list_payload())

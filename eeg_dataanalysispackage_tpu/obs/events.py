@@ -42,6 +42,8 @@ import collections
 import contextlib
 import itertools
 import json
+import os
+import re
 import threading
 import time
 from typing import Any, Dict, Iterator, List, Optional
@@ -55,6 +57,14 @@ DEFAULT_MAX_SPANS = 10_000
 DEFAULT_RING_CAPACITY = 512
 #: events attached per span before the span only counts them
 _MAX_EVENTS_PER_SPAN = 64
+
+#: per-replica trace sink directory: when set (and a plan carries a
+#: trace id) every finished span also appends to
+#: ``<dir>/trace-<segment>.jsonl`` — the durable cross-replica trace a
+#: lease takeover CONTINUES under the original trace id
+ENV_TRACE_DIR = "EEG_TPU_TRACE_DIR"
+
+_SEGMENT_BAD = re.compile(r"[^a-zA-Z0-9._-]")
 
 
 class SpanRecorder:
@@ -88,6 +98,14 @@ class SpanRecorder:
         self._jsonl_file = None
         self._jsonl_failed = False
         self._jsonl_closed = False
+        # cross-replica trace context (set_trace); spans carry
+        # trace_id/span_id/parent_id in the trace sink, ids made
+        # globally unique by the segment prefix (the replica id)
+        self.trace_id: Optional[str] = None
+        self.trace_segment: Optional[str] = None
+        self._trace_path: Optional[str] = None
+        self._trace_file = None
+        self._trace_failed = False
         # the root span is open for the recorder's whole life and
         # closed by finish(); orphan threads parent onto it
         self.root: Dict[str, Any] = {
@@ -105,6 +123,86 @@ class SpanRecorder:
 
     def _now(self) -> float:
         return time.perf_counter() - self._t0
+
+    # -- cross-replica trace context -----------------------------------
+
+    def set_trace(
+        self,
+        trace_id: str,
+        trace_dir: Optional[str] = None,
+        segment: Optional[str] = None,
+        **attrs: Any,
+    ) -> None:
+        """Join this recorder to a distributed trace: all spans carry
+        ``trace_id`` and segment-prefixed globally-unique span ids,
+        and (with ``trace_dir``) append to ``trace-<segment>.jsonl``
+        in it. The file is opened in APPEND mode — a replica's
+        successive plans share one segment file, and a surviving
+        replica's takeover segment lands next to the dead holder's
+        (``plan_admin trace`` stitches them back into one tree).
+
+        ``attrs`` (plan_id, takeover, ...) land on the root span and
+        on a ``segment`` header line, so a stitcher knows the takeover
+        boundary even when the dead holder never closed its root.
+        """
+        self.trace_id = str(trace_id)
+        segment = segment or f"pid{os.getpid()}"
+        self.trace_segment = _SEGMENT_BAD.sub("_", str(segment))
+        self.root["attrs"].update(attrs)
+        if trace_dir:
+            self._trace_path = os.path.join(
+                trace_dir, f"trace-{self.trace_segment}.jsonl"
+            )
+            self._trace_sink({
+                "kind": "segment",
+                "trace_id": self.trace_id,
+                "segment": self.trace_segment,
+                "root_span_id": self._span_id(self.root["id"]),
+                "wall_start": self.wall_start,
+                "attrs": dict(self.root["attrs"]),
+            })
+
+    def _span_id(self, local_id: Optional[int]) -> Optional[str]:
+        if local_id is None:
+            return None
+        return f"{self.trace_segment}:{local_id}"
+
+    def _trace_line(self, rec: Dict[str, Any]) -> Dict[str, Any]:
+        return {
+            "kind": "span",
+            "trace_id": self.trace_id,
+            "segment": self.trace_segment,
+            "span_id": self._span_id(rec["id"]),
+            "parent_id": self._span_id(rec["parent"]),
+            "name": rec["name"],
+            "wall_start": self.wall_start + rec["start"],
+            "wall_end": (
+                None if rec["end"] is None
+                else self.wall_start + rec["end"]
+            ),
+            "thread": rec["thread"],
+            "attrs": rec["attrs"],
+        }
+
+    def _trace_sink(self, line: Dict[str, Any]) -> None:
+        if self._trace_path is None or self._trace_failed:
+            return
+        with self._lock:
+            try:
+                if self._trace_file is None:
+                    os.makedirs(
+                        os.path.dirname(self._trace_path) or ".",
+                        exist_ok=True,
+                    )
+                    self._trace_file = open(self._trace_path, "a")
+                self._trace_file.write(
+                    json.dumps(line, sort_keys=True, default=str) + "\n"
+                )
+                self._trace_file.flush()
+            except OSError:
+                # a broken trace sink never kills the run it observes
+                self._trace_failed = True
+                self._trace_file = None
 
     # -- thread-local span stack ---------------------------------------
 
@@ -156,6 +254,8 @@ class SpanRecorder:
             else:
                 self._dropped_spans += 1
         self._sink({"kind": "span", **_span_line(rec)})
+        if self.trace_id is not None:
+            self._trace_sink(self._trace_line(rec))
 
     def event(self, name: str, **attrs: Any) -> None:
         """Point-in-time mark on the current span; retained in the
@@ -185,6 +285,8 @@ class SpanRecorder:
         if self.root["end"] is None:
             self.root["end"] = self._now()
             self._sink({"kind": "span", **_span_line(self.root)})
+            if self.trace_id is not None:
+                self._trace_sink(self._trace_line(self.root))
         with self._lock:
             self._jsonl_closed = True
             if self._jsonl_file is not None:
@@ -193,6 +295,12 @@ class SpanRecorder:
                 except OSError:
                     pass
                 self._jsonl_file = None
+            if self._trace_file is not None:
+                try:
+                    self._trace_file.close()
+                except OSError:
+                    pass
+                self._trace_file = None
 
     # -- introspection -------------------------------------------------
 

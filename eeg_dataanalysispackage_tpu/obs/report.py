@@ -56,6 +56,7 @@ _ENV_KNOBS = (
     "EEG_TPU_CIRCUIT_COOLDOWN",
     "EEG_TPU_FAULTS",
     "EEG_TPU_RUN_REPORT_DIR",
+    "EEG_TPU_TRACE_DIR",
     "EEG_TPU_OVERLAP",
     "EEG_TPU_PRECISION",
     "EEG_TPU_BF16_GATE_TOL",
@@ -286,6 +287,10 @@ class RunTelemetry:
         #: executed the plan lives HERE, never only in a log line;
         #: None outside a replica fleet (the default, schema-stable)
         self.fleet: Optional[Dict[str, Any]] = None
+        #: distributed trace id (gateway-minted, journaled with the
+        #: plan so a lease takeover CONTINUES the trace on the
+        #: surviving replica); None for untraced runs (schema-stable)
+        self.trace_id: Optional[str] = None
 
     @property
     def report_path(self) -> str:
@@ -340,6 +345,10 @@ class RunTelemetry:
             "dedup": self.dedup,
             "gateway": self.gateway,
             "fleet": self.fleet,
+            "trace": None if self.trace_id is None else {
+                "trace_id": self.trace_id,
+                "segment": self.recorder.trace_segment,
+            },
             "degradation": list(self.degradation),
             "stages": timers.as_dict() if timers is not None else {},
             "metrics": metrics.snapshot() if metrics is not None else {},
@@ -392,6 +401,23 @@ class RunTelemetry:
         logger.info("run report written: %s", self.report_path)
         return self.report_path
 
+    def _fleet_context(self) -> Optional[Dict[str, Any]]:
+        """Replica id + live lease state for a fleet plan's crash
+        artifact; None outside a fleet (schema-stable)."""
+        if not self.fleet:
+            return None
+        try:
+            from ..scheduler import lease as lease_mod
+
+            return {
+                "replica": self.fleet.get("replica"),
+                "takeover": bool(self.fleet.get("takeover")),
+                "held_leases": lease_mod.active_held(),
+                "lease_counters": lease_mod.stats(),
+            }
+        except Exception:  # the dump must never mask the real error
+            return {"replica": self.fleet.get("replica")}
+
     def dump_crash(self, error: BaseException, timers, metrics) -> str:
         """The failure artifact: flight-recorder ring + run state."""
         self.recorder.finish()
@@ -408,6 +434,11 @@ class RunTelemetry:
             **self._common(timers, metrics),
             "spans": self.recorder.summary(),
             "events": self.recorder.recent_events(),
+            # fleet context: when the plan died on a fleet replica the
+            # crash artifact names the replica, the leases it held at
+            # death, and the process's lease counters — next to the
+            # chaos/degradation evidence already here
+            "fleet_context": self._fleet_context(),
         }
         try:
             _atomic_json(self.crash_path, payload)

@@ -145,11 +145,11 @@ class _PlanTicket:
     __slots__ = ("plan", "plan_id", "deadline", "future",
                  "submitted_at", "attempts", "history", "fault_plan",
                  "report_dir", "recovered", "state",
-                 "idempotency_key", "gateway", "fleet")
+                 "idempotency_key", "gateway", "fleet", "trace_id")
 
     def __init__(self, plan, plan_id, deadline, fault_plan, report_dir,
                  recovered=False, idempotency_key=None, gateway=None,
-                 fleet=None):
+                 fleet=None, trace_id=None):
         self.plan = plan
         self.plan_id = plan_id
         self.deadline: Optional[deadline_mod.Deadline] = deadline
@@ -171,6 +171,10 @@ class _PlanTicket:
         #: fleet attribution ({"replica", "takeover"}), echoed into
         #: the plan's run report; None outside a replica fleet
         self.fleet = fleet
+        #: distributed trace id (gateway-minted, journaled with the
+        #: plan meta so a takeover CONTINUES the trace); None for
+        #: untraced submissions
+        self.trace_id = trace_id
 
     def batch_key(self):
         # plans never coalesce: every ticket is its own micro-batch
@@ -490,6 +494,7 @@ class PlanExecutor:
         idempotency_key: Optional[str] = None,
         gateway: Optional[Dict[str, Any]] = None,
         fleet: Optional[Dict[str, Any]] = None,
+        trace_id: Optional[str] = None,
     ) -> PlanHandle:
         """Validate, journal, and enqueue one plan; returns its
         handle. Sheds with :class:`PlanShedError` (evidence included)
@@ -718,7 +723,7 @@ class PlanExecutor:
             ticket = _PlanTicket(
                 plan, plan_id, deadline, fault_plan, report_dir,
                 recovered=_recovered, idempotency_key=idempotency_key,
-                gateway=gateway, fleet=fleet,
+                gateway=gateway, fleet=fleet, trace_id=trace_id,
             )
             if self.journal is not None:
                 # journal writes belong to the plan's fault domain:
@@ -737,6 +742,7 @@ class PlanExecutor:
                             "idempotency_key": idempotency_key,
                             "gateway": gateway,
                             "fleet": fleet,
+                            "trace_id": trace_id,
                         },
                     )
             if key_claim is not None:
@@ -986,6 +992,7 @@ class PlanExecutor:
                         _recovered=True,
                         idempotency_key=meta.get("idempotency_key"),
                         gateway=meta.get("gateway"),
+                        trace_id=meta.get("trace_id"),
                     ))
                 except PlanOwnedElsewhereError:
                     # a fleet peer lease-holds this record: recovery
@@ -1065,6 +1072,9 @@ class PlanExecutor:
                 idempotency_key=meta.get("idempotency_key"),
                 gateway=meta.get("gateway"),
                 fleet=fleet,
+                # the journaled trace id: the takeover segment joins
+                # the SAME distributed trace the dead holder started
+                trace_id=meta.get("trace_id"),
             )
         except Exception:
             # a claim this call took must not outlive its failure —
@@ -1164,6 +1174,8 @@ class PlanExecutor:
             # executors keep the pre-fleet call signature, which test
             # doubles for execute_plan rely on
             extra = {"fleet": ticket.fleet} if ticket.fleet else {}
+            if ticket.trace_id:
+                extra["trace_id"] = ticket.trace_id
             try:
                 with deadline_mod.deadline_scope(ticket.deadline):
                     statistics = runtime.execute_plan(
@@ -1250,6 +1262,9 @@ class PlanExecutor:
                             "gateway": ticket.gateway,
                             "fleet": ticket.fleet,
                             "report_dir": ticket.report_dir,
+                            # survives into the terminal record so
+                            # plan_admin trace resolves finished plans
+                            "trace_id": ticket.trace_id,
                         },
                     )
             # terminal record landed (or degraded): either way this
@@ -1297,6 +1312,7 @@ class PlanExecutor:
                         "gateway": ticket.gateway,
                         "fleet": ticket.fleet,
                         "report_dir": ticket.report_dir,
+                        "trace_id": ticket.trace_id,
                     },
                 )
             if journaled:

@@ -127,6 +127,28 @@ def reset_stats() -> None:
         _heartbeats = _heartbeat_failures = _claim_failures = 0
 
 
+#: the replica's live LeaseDir, registered by gateway/fleet.py so the
+#: crash flight recorder (obs/report.py) can name the leases the
+#: process held when a plan died — observation only, weakly referenced
+_active_dir = None
+
+
+def set_active(lease_dir: "LeaseDir") -> None:
+    import weakref
+
+    global _active_dir
+    _active_dir = weakref.ref(lease_dir)
+
+
+def active_held() -> List[str]:
+    """Plan ids of the leases the process's registered LeaseDir holds
+    right now; [] when no fleet replica runs in this process."""
+    ld = _active_dir() if _active_dir is not None else None
+    if ld is None:
+        return []
+    return sorted(l.plan_id for l in ld.held_leases())
+
+
 def _count(name: str) -> None:
     from .. import obs
 

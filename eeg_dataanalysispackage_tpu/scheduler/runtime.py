@@ -48,6 +48,7 @@ def execute_plan(
     default_report_dir: Optional[str] = None,
     gateway: Optional[dict] = None,
     fleet: Optional[dict] = None,
+    trace_id: Optional[str] = None,
 ):
     """Run ``plan`` through ``builder`` inside a fresh fault domain;
     returns the statistics (and leaves the builder's per-run
@@ -75,6 +76,13 @@ def execute_plan(
     {"replica", "takeover"} block, plus the process's lease counters
     at execution time) echoed into run_report.json, so an artifact
     names WHICH replica executed its plan and whether by takeover.
+
+    ``trace_id`` — the distributed trace this execution belongs to
+    (gateway-minted, journaled in the plan meta so a takeover on a
+    surviving replica CONTINUES the original trace). With
+    ``EEG_TPU_TRACE_DIR`` set, spans additionally append to the
+    per-replica trace sink — even when run reports are off, so a
+    fleet's trace plane works without the per-plan report tree.
     """
     query_map = plan.query_map
     logger.info("query: %s", query_map)
@@ -140,6 +148,7 @@ def execute_plan(
             builder.telemetry.plan_id = plan_id
             builder.telemetry.gateway = gateway
             builder.telemetry.fleet = fleet
+            builder.telemetry.trace_id = trace_id
             # the builder appends rung drops as they happen; the
             # report reads this shared list
             builder.telemetry.degradation = builder.degradation_history
@@ -155,6 +164,32 @@ def execute_plan(
         else contextlib.nullcontext()
     )
 
+    # the distributed-trace sink is independent of run reports: a
+    # gateway-minted trace id plus EEG_TPU_TRACE_DIR turns on span
+    # recording even for an unreported plan (bounded standalone
+    # recorder), so the fleet's trace plane works without the
+    # per-plan report tree. A takeover re-submits with the journaled
+    # trace id, so the surviving replica's segment CONTINUES the
+    # original trace.
+    recorder = None if telemetry is None else telemetry.recorder
+    standalone_recorder = None
+    if trace_id:
+        from ..obs import events
+        trace_dir = os.environ.get(events.ENV_TRACE_DIR)
+        if trace_dir:
+            if recorder is None:
+                recorder = standalone_recorder = events.SpanRecorder(
+                    name="plan", max_spans=512
+                )
+            recorder.set_trace(
+                trace_id,
+                trace_dir=trace_dir,
+                segment=(fleet or {}).get("replica")
+                or (gateway or {}).get("replica"),
+                plan_id=plan_id,
+                takeover=bool((fleet or {}).get("takeover")),
+            )
+
     # the plan's fault domain: chaos spec, span recorder, and metrics
     # child all scoped to THIS plan's threads (worker threads adopt it
     # — io/staging, io/provider, serve/batcher)
@@ -162,12 +197,28 @@ def execute_plan(
     domain = run_domain.RunDomain(
         plan_id=plan_id,
         chaos=fault_plan,
-        recorder=None if telemetry is None else telemetry.recorder,
+        recorder=recorder,
         metrics=run_metrics,
     )
     builder.run_metrics = run_metrics
 
     start = time.perf_counter()
+    try:
+        return _run_in_domain(
+            plan, builder, domain, comp_scope, telemetry,
+            run_metrics, start,
+        )
+    finally:
+        if standalone_recorder is not None:
+            # close the report-less trace segment (flushes the root
+            # span to the trace sink); telemetry-backed recorders are
+            # finished by the report writer as before
+            standalone_recorder.finish()
+
+
+def _run_in_domain(
+    plan, builder, domain, comp_scope, telemetry, run_metrics, start,
+):
     with run_domain.activate(domain), comp_scope:
         try:
             # the scheduler's own injection point: one execution

@@ -43,6 +43,7 @@ from typing import Any, Callable, List, Optional
 from .. import obs
 from ..io import deadline as deadline_mod
 from ..obs import chaos, domain as run_domain, events
+from ..obs import metrics_export
 
 
 class ServeError(RuntimeError):
@@ -480,6 +481,12 @@ class MicroBatcher:
         #: per-tenant latency reservoirs (tenant-aware batchers only;
         #: bounded like the global one, guarded by the counters lock)
         self.tenant_latencies: dict = {}
+        #: fixed-bucket latency histograms (obs/metrics_export.py):
+        #: unlike the reservoirs these never evict, so two replicas'
+        #: histograms merge by exact integer addition — the /metrics
+        #: exposition and the per-tenant SLO math read these
+        self.latency_hist = metrics_export.LatencyHistogram()
+        self.tenant_latency_hists: dict = {}
         self.counters = collections.Counter()
         self._counters_lock = threading.Lock()
 
@@ -552,6 +559,11 @@ class MicroBatcher:
                 reservoir = collections.deque(maxlen=8192)
                 self.tenant_latencies[tenant] = reservoir
             reservoir.append(latency)
+            hist = self.tenant_latency_hists.get(tenant)
+            if hist is None:
+                hist = metrics_export.LatencyHistogram()
+                self.tenant_latency_hists[tenant] = hist
+            hist.observe(latency * 1e3)
 
     def snapshot(self):
         """(counters copy, latency list) under the lock — the safe
@@ -568,6 +580,42 @@ class MicroBatcher:
                 tenant: list(reservoir)
                 for tenant, reservoir in self.tenant_latencies.items()
             }
+
+    def histogram_snapshot(self) -> metrics_export.LatencyHistogram:
+        """A point-in-time copy of the global latency histogram,
+        taken under the counters lock (the /metrics scrape surface)."""
+        with self._counters_lock:
+            return metrics_export.LatencyHistogram.from_snapshot(
+                self.latency_hist.snapshot()
+            )
+
+    def tenant_histogram_snapshot(self) -> dict:
+        """Per-tenant latency histogram copies under the lock (empty
+        for tenant-unaware batchers)."""
+        with self._counters_lock:
+            return {
+                tenant: metrics_export.LatencyHistogram.from_snapshot(
+                    hist.snapshot()
+                )
+                for tenant, hist in self.tenant_latency_hists.items()
+            }
+
+    def evict_tenant(self, tenant: str) -> None:
+        """Drop every per-tenant accounting structure for ``tenant``
+        — the latency reservoir, the latency histogram, and the
+        ``tenant.<name>.*`` counters. Called by the multiplexed
+        service's ``remove_tenant`` so a departed tenant's state does
+        not accumulate for the service's lifetime (add/remove churn
+        across many tenants would otherwise grow these dicts without
+        bound)."""
+        prefix = f"tenant.{tenant}."
+        with self._counters_lock:
+            self.tenant_latencies.pop(tenant, None)
+            self.tenant_latency_hists.pop(tenant, None)
+            for key in [
+                k for k in self.counters if k.startswith(prefix)
+            ]:
+                del self.counters[key]
 
     # -- the batcher loop ----------------------------------------------
 
@@ -732,6 +780,7 @@ class MicroBatcher:
                     # can snapshot the reservoir without racing the
                     # deque's iteration
                     self.latencies.append(latency)
+                    self.latency_hist.observe(latency * 1e3)
             if delivered:
                 self._count("completed", delivered)
             # per-request spans: one retroactive span per served

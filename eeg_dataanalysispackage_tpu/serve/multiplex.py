@@ -53,6 +53,7 @@ from . import service as service_mod
 from ..io import deadline as deadline_mod
 from ..models import linear
 from ..obs import events
+from ..obs import metrics_export
 from ..utils import constants
 
 logger = logging.getLogger(__name__)
@@ -1039,8 +1040,13 @@ class MultiplexedService(service_mod.InferenceService):
 
     def remove_tenant(self, name: str):
         """Unregister a tenant; in-flight requests ride the
-        pre-removal snapshot, new submissions for it are refused."""
+        pre-removal snapshot, new submissions for it are refused.
+        The tenant's batcher-side accounting (latency reservoir,
+        histogram, per-tenant counters) is evicted with it — a
+        long-lived service with add/remove churn must not accumulate
+        departed tenants' state."""
         displaced = self.engine.remove_tenant(name)
+        self.batcher.evict_tenant(name)
         self.batcher._count("tenant_removes")
         return displaced
 
@@ -1198,6 +1204,7 @@ class MultiplexedService(service_mod.InferenceService):
         block = super().stats_block()
         counters, _ = self.batcher.snapshot()
         tenant_lat = self.batcher.tenant_latency_snapshot()
+        tenant_hists = self.batcher.tenant_histogram_snapshot()
         tenants_block = {}
         for name in self.engine.tenants:
             lat = sorted(tenant_lat.get(name, []))
@@ -1222,6 +1229,25 @@ class MultiplexedService(service_mod.InferenceService):
                     ),
                     "n": len(lat),
                 },
+                # the tenant's SLO scorecard (obs/metrics_export.py):
+                # availability, latency-objective attainment off the
+                # tenant's fixed-bucket histogram, error-budget burn
+                "slo": metrics_export.slo_block(
+                    tenant_hists.get(
+                        name, metrics_export.LatencyHistogram()
+                    ),
+                    {
+                        key: counters.get(f"tenant.{name}.{key}", 0)
+                        for key in (
+                            "completed", "shed", "failed",
+                            "deadline_exceeded",
+                        )
+                    },
+                    objective_ms=self.config.slo_latency_ms,
+                    availability_target=(
+                        self.config.slo_availability_target
+                    ),
+                ),
                 # per-tenant model-lifecycle attribution: None —
                 # schema-stable with the solo block; the stack's swap
                 # generation above is the multiplexed model state

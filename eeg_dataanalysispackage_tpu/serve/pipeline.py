@@ -66,6 +66,18 @@ def _int_knob(query_map, name: str, default: int) -> int:
         )
 
 
+def _float_knob(query_map, name: str, default: float) -> float:
+    value = query_map.get(name, "")
+    if not value:
+        return default
+    try:
+        return float(value)
+    except ValueError:
+        raise ValueError(
+            f"query parameter {name}= must be a number, got {value!r}"
+        )
+
+
 #: process default for the bounded batch-fill window (microseconds);
 #: a per-run ``serve_flush_us=`` query value wins.
 ENV_SERVE_FLUSH_US = "EEG_TPU_SERVE_FLUSH_US"
@@ -99,6 +111,12 @@ def serve_config_from_query(query_map) -> service_mod.ServeConfig:
         default_deadline_s=_int_knob(
             query_map, "serve_deadline_ms", 2000
         ) / 1000.0,
+        # the per-tenant SLO objectives the stats/metrics SLO block
+        # scores against (obs/metrics_export.py)
+        slo_latency_ms=_float_knob(query_map, "serve_slo_ms", 50.0),
+        slo_availability_target=_float_knob(
+            query_map, "serve_slo_availability", 0.999
+        ),
     )
 
 

@@ -37,6 +37,7 @@ from . import lifecycle as lifecycle_mod
 from ..io import deadline as deadline_mod
 from ..models import registry as clf_registry
 from ..obs import events
+from ..obs import metrics_export
 from ..utils import constants
 
 logger = logging.getLogger(__name__)
@@ -76,6 +77,17 @@ class ServeConfig:
     retry_backoff_s: float = 0.05
     watchdog_s: float = 5.0
     drain_timeout_s: float = 10.0
+    #: the latency objective (milliseconds) the SLO block scores
+    #: attainment against — the fraction of completed requests whose
+    #: latency landed at or under this bound (serve_slo_ms= /
+    #: computed from the fixed-bucket histogram, so two replicas'
+    #: attainment merges exactly)
+    slo_latency_ms: float = 50.0
+    #: the availability objective: completed / admitted (sheds,
+    #: failures, and deadline misses all count against it). The error
+    #: budget is 1 - this target; burn rate 1.0 means spending the
+    #: budget exactly as fast as the objective allows.
+    slo_availability_target: float = 0.999
 
 
 def _percentile(sorted_values: List[float], q: float) -> float:
@@ -443,6 +455,24 @@ class InferenceService:
             "watchdog_trips": counters.get("watchdog_trips", 0),
             "wedged": self.batcher.wedged.is_set(),
             "drained_cleanly": self._drained_cleanly,
+            # the service-wide SLO block (obs/metrics_export.py):
+            # availability vs admitted traffic, latency-objective
+            # attainment off the fixed-bucket histogram, and the
+            # error-budget burn rate — per-tenant variants live in the
+            # multiplexed service's tenants sub-block
+            "slo": metrics_export.slo_block(
+                self.batcher.histogram_snapshot(),
+                {
+                    "completed": counters.get("completed", 0),
+                    "shed": counters.get("shed", 0),
+                    "failed": counters.get("failed", 0),
+                    "deadline_exceeded": counters.get(
+                        "deadline_exceeded", 0
+                    ),
+                },
+                objective_ms=self.config.slo_latency_ms,
+                availability_target=self.config.slo_availability_target,
+            ),
             # model lifecycle attribution (serve/lifecycle.py):
             # feedback/partial-fit counters, the candidate's shadow
             # window, gate decisions, swaps/rollbacks/drift — None for

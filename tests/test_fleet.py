@@ -789,7 +789,7 @@ def _spawn_replica(replica_id, journal_dir, env):
     return proc, line.split("listening on ", 1)[1].split()[0]
 
 
-def _fleet_env():
+def _fleet_env(trace_dir=None):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
@@ -797,25 +797,42 @@ def _fleet_env():
     env["EEG_TPU_FLEET_SCAN_INTERVAL_S"] = "0.1"
     env.pop("EEG_TPU_FAULTS", None)
     env.pop("EEG_TPU_RUN_REPORT_DIR", None)
+    if trace_dir is not None:
+        env["EEG_TPU_TRACE_DIR"] = trace_dir
+    else:
+        env.pop("EEG_TPU_TRACE_DIR", None)
     return env
 
 
+def _get_text(url, timeout=30):
+    """GET a non-JSON endpoint (/metrics is Prometheus text)."""
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read().decode()
+
+
 @pytest.mark.chaos
-def test_kill_one_of_three_replicas_peer_completes(session, tmp_path):
+def test_kill_one_of_three_replicas_peer_completes(session, tmp_path,
+                                                   capsys):
     """THE fleet acceptance pin: 3 real replica processes over one
     journal; SIGKILL the one executing a plan; a survivor breaks the
     dead lease, completes the plan under its original id with
     statistics byte-identical to an uninterrupted twin, exactly once;
     a keyed re-submit to the third replica replays it; the survivors
-    then drain cleanly on real SIGTERM."""
+    then drain cleanly on real SIGTERM. The observability half
+    (ISSUE 19): the trace minted at submit SURVIVES the kill — the
+    takeover segment continues the same trace id, and ``plan_admin
+    trace`` stitches the dead holder's and the survivor's segments
+    into ONE tree with the takeover boundary visible; the survivors'
+    /metrics exposition carries the takeover."""
     journal_dir = str(tmp_path / "journal")
+    trace_dir = str(tmp_path / "traces")
     heavy = (
         f"info_file={session}&fe=dwt-8&train_clf=logreg"
         "&config_step_size=0.5&config_num_iterations=1500000"
         "&config_mini_batch_fraction=1.0"
     )
     twin = str(builder.PipelineBuilder(heavy).execute())
-    env = _fleet_env()
+    env = _fleet_env(trace_dir=trace_dir)
 
     procs, urls = [], []
     try:
@@ -834,10 +851,13 @@ def test_kill_one_of_three_replicas_peer_completes(session, tmp_path):
 
         code, payload = _request(
             f"{urls[0]}/plans", body=heavy, method="POST",
-            headers={"X-Idempotency-Key": "fleet-pin"},
+            headers={"X-Idempotency-Key": "fleet-pin",
+                     "X-Trace-Id": "fleet-pin-trace"},
         )
         assert code == 201, payload
         plan_id = payload["plan_id"]
+        # the inbound trace id is honored, not re-minted
+        assert payload["trace_id"] == "fleet-pin-trace"
 
         # kill the holder provably mid-execution
         deadline = time.monotonic() + 240
@@ -876,6 +896,22 @@ def test_kill_one_of_three_replicas_peer_completes(session, tmp_path):
             assert stats["fleet"]["replica"] in ("gw-b", "gw-c")
         assert completed == 1
 
+        # the survivors' /metrics exposition (ISSUE 19): build_info
+        # names the replica, the completion and takeover counters sum
+        # across the fleet to exactly this one taken-over execution
+        scraped_completed = scraped_takeovers = 0
+        for rid, url in zip(("gw-b", "gw-c"), urls[1:]):
+            code, text = _get_text(f"{url}/metrics")
+            assert code == 200
+            assert f'eeg_tpu_build_info{{replica="{rid}"}} 1' in text
+            for line in text.splitlines():
+                if line.startswith("eeg_tpu_scheduler_completed_total "):
+                    scraped_completed += int(float(line.split()[1]))
+                if line.startswith("eeg_tpu_lease_takeovers_total "):
+                    scraped_takeovers += int(float(line.split()[1]))
+        assert scraped_completed == 1
+        assert scraped_takeovers == 1
+
         # keyed re-submit to the OTHER survivor: replayed, original id
         code, payload = _request(
             f"{urls[2]}/plans", body=heavy, method="POST",
@@ -894,6 +930,32 @@ def test_kill_one_of_three_replicas_peer_completes(session, tmp_path):
             n for n in os.listdir(journal_dir)
             if n.endswith(".lease")
         ]
+
+        # THE trace pin (ISSUE 19): plan_admin stitches the dead
+        # holder's segment and the survivor's takeover segment into
+        # ONE tree under the submit-time trace id, takeover boundary
+        # annotated — the kill shows up as a seam in one trace, not
+        # as two unrelated traces
+        sys.path.insert(0, os.path.join(_REPO, "tools"))
+        try:
+            import plan_admin
+        finally:
+            sys.path.pop(0)
+        rc = plan_admin.main([
+            "trace", plan_id, "--journal", journal_dir,
+            "--trace-dir", trace_dir,
+        ])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "trace fleet-pin-trace" in out
+        assert "2 segment(s)" in out
+        assert "segment gw-a" in out
+        takeover_replica = fleet_meta["replica"]
+        assert f"segment {takeover_replica}" in out
+        assert "TAKEOVER boundary" in out
+        # the victim's segment died mid-span — the stitcher must say
+        # so rather than invent an end
+        assert "UNFINISHED" in out
     finally:
         for proc in procs:
             if proc.poll() is None:

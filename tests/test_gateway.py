@@ -205,7 +205,7 @@ def blocked_runtime(monkeypatch):
     started = threading.Event()
 
     def blocked_execute(plan, builder_, plan_id=None, fault_plan=None,
-                        default_report_dir=None, gateway=None):
+                        default_report_dir=None, gateway=None, **kw):
         started.set()
         assert release.wait(60), "test never released the worker"
         return f"done-{plan_id}"
@@ -596,7 +596,7 @@ def test_keyed_resubmit_racing_recover_runs_once(
     release = threading.Event()
 
     def counting_execute(plan, builder_, plan_id=None, fault_plan=None,
-                         default_report_dir=None, gateway=None):
+                         default_report_dir=None, gateway=None, **kw):
         runs.append(plan_id)
         assert release.wait(60)
         return f"done-{plan_id}"

@@ -1,0 +1,498 @@
+"""Fleet observability plane suite (ISSUE 19): metrics exposition
+(obs/metrics_export.py), the gateway /metrics endpoint, trace
+propagation plumbing, per-tenant SLOs, and the trace stitcher.
+
+The pins:
+
+- **exposition determinism** — render() over controlled inputs is
+  byte-identical to a golden text (ordering, escaping, histogram
+  series), and parse()/histogram_from_series() round-trip it exactly;
+- **exact merge** — two replicas' histograms merged by integer
+  addition equal the histogram of the union of observations, bit for
+  bit, in either merge order;
+- **SLO math** — availability/attainment/error-budget burn from the
+  outcome counters plus the histogram, including the empty-service
+  and burning-budget edges;
+- **trace plumbing** — mint_trace_id honors a well-formed inbound id
+  and re-mints hostile ones; a gateway journals the trace id through
+  to the terminal record and echoes it on the response;
+- **tenant eviction** — remove-side accounting: evict_tenant drops
+  the reservoir, the histogram, and the per-tenant counters;
+- **trace stitching** — plan_admin trace reassembles synthesized
+  dead-holder + takeover segment files into one tree with the
+  takeover boundary and the unfinished root visible.
+"""
+
+import json
+import os
+import sys
+import urllib.request
+
+import pytest
+
+import _synthetic
+from eeg_dataanalysispackage_tpu.gateway import GatewayServer
+from eeg_dataanalysispackage_tpu.gateway.server import mint_trace_id
+from eeg_dataanalysispackage_tpu.obs import metrics_export
+from eeg_dataanalysispackage_tpu.scheduler.journal import PlanJournal
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def session(tmp_path):
+    return _synthetic.write_session(str(tmp_path), n_markers=60)
+
+
+def _q(info):
+    return (
+        f"info_file={info}&fe=dwt-8&train_clf=logreg"
+        "&config_step_size=1.0&config_num_iterations=20"
+        "&config_mini_batch_fraction=1.0"
+    )
+
+
+# -- LatencyHistogram ---------------------------------------------------
+
+
+def test_histogram_buckets_and_quantiles():
+    h = metrics_export.LatencyHistogram()
+    for ms in (0.3, 0.5, 3.0, 40.0, 9000.0):
+        h.observe(ms)
+    assert h.count == 5
+    # le-buckets: 0.5 lands IN the 0.5 bucket, 9000 in +Inf
+    assert h.counts[0] == 2          # <= 0.5
+    assert h.counts[-1] == 1         # +Inf
+    assert h.quantile(50.0) == 5.0   # 3rd of 5 → the le=5ms bucket
+    assert h.quantile(99.0) == metrics_export.BUCKET_BOUNDS_MS[-1]
+    assert h.attainment(50.0) == pytest.approx(4 / 5)
+    # sum is integer microseconds — exact accumulation
+    assert h.sum_us == int(round((0.3 + 0.5 + 3.0 + 40.0 + 9000.0) * 1000))
+
+
+def test_empty_histogram_edges():
+    h = metrics_export.LatencyHistogram()
+    assert h.quantile(99.0) is None
+    assert h.attainment(50.0) == 1.0
+    assert h.snapshot()["count"] == 0
+
+
+def test_bounds_must_increase():
+    with pytest.raises(ValueError):
+        metrics_export.LatencyHistogram((1.0, 1.0, 2.0))
+
+
+def test_two_replica_merge_is_exact():
+    """The fleet aggregation contract: merging replica histograms is
+    element-wise integer addition, so the merged histogram IS the
+    histogram of the union of observations — same counts, same sum,
+    any merge order."""
+    obs_a = [0.2, 1.7, 30.0, 400.0]
+    obs_b = [0.9, 2.5, 2.5, 8000.0, 12.0]
+    a = metrics_export.LatencyHistogram()
+    b = metrics_export.LatencyHistogram()
+    union = metrics_export.LatencyHistogram()
+    for ms in obs_a:
+        a.observe(ms)
+        union.observe(ms)
+    for ms in obs_b:
+        b.observe(ms)
+        union.observe(ms)
+    # merge through the snapshot round trip, exactly the path
+    # fleet_top takes (scrape → snapshot → from_snapshot → merge)
+    ab = metrics_export.merge_all(
+        metrics_export.LatencyHistogram.from_snapshot(h.snapshot())
+        for h in (a, b)
+    )
+    ba = metrics_export.merge_all(
+        metrics_export.LatencyHistogram.from_snapshot(h.snapshot())
+        for h in (b, a)
+    )
+    for merged in (ab, ba):
+        assert merged.counts == union.counts
+        assert merged.count == union.count
+        assert merged.sum_us == union.sum_us
+        assert merged.quantile(99.0) == union.quantile(99.0)
+
+
+def test_merge_refuses_mismatched_bounds():
+    a = metrics_export.LatencyHistogram()
+    b = metrics_export.LatencyHistogram((1.0, 2.0))
+    with pytest.raises(ValueError):
+        a.merge(b)
+
+
+# -- exposition text ----------------------------------------------------
+
+
+def test_render_golden_text():
+    """The golden pin: one controlled state renders to exactly this
+    document — sorted series, deterministic floats, escaped labels.
+    A renderer change that moves a byte shows up here first."""
+    h = metrics_export.LatencyHistogram((1.0, 10.0))
+    h.observe(0.5)
+    h.observe(7.0)
+    h.observe(99.0)
+    text = metrics_export.render(
+        counters={"scheduler.completed": 3, "serve.shed": 1},
+        gauges={"gateway.queue_depth": 2},
+        histograms=[
+            ("serve_request_latency_ms", {}, h),
+            ("serve_request_latency_ms", {"tenant": 'ten"a\n'}, h),
+        ],
+        info={"replica": "gw-a"},
+    )
+    assert text == (
+        '# TYPE eeg_tpu_build_info gauge\n'
+        'eeg_tpu_build_info{replica="gw-a"} 1\n'
+        '# TYPE eeg_tpu_scheduler_completed_total counter\n'
+        'eeg_tpu_scheduler_completed_total 3\n'
+        '# TYPE eeg_tpu_serve_shed_total counter\n'
+        'eeg_tpu_serve_shed_total 1\n'
+        '# TYPE eeg_tpu_gateway_queue_depth gauge\n'
+        'eeg_tpu_gateway_queue_depth 2\n'
+        '# TYPE eeg_tpu_serve_request_latency_ms histogram\n'
+        'eeg_tpu_serve_request_latency_ms_bucket{le="1"} 1\n'
+        'eeg_tpu_serve_request_latency_ms_bucket{le="10"} 2\n'
+        'eeg_tpu_serve_request_latency_ms_bucket{le="+Inf"} 3\n'
+        'eeg_tpu_serve_request_latency_ms_sum 106.5\n'
+        'eeg_tpu_serve_request_latency_ms_count 3\n'
+        'eeg_tpu_serve_request_latency_ms_bucket'
+        '{le="1",tenant="ten\\"a\\n"} 1\n'
+        'eeg_tpu_serve_request_latency_ms_bucket'
+        '{le="10",tenant="ten\\"a\\n"} 2\n'
+        'eeg_tpu_serve_request_latency_ms_bucket'
+        '{le="+Inf",tenant="ten\\"a\\n"} 3\n'
+        'eeg_tpu_serve_request_latency_ms_sum{tenant="ten\\"a\\n"} 106.5\n'
+        'eeg_tpu_serve_request_latency_ms_count{tenant="ten\\"a\\n"} 3\n'
+    )
+
+
+def test_render_is_deterministic_across_input_order():
+    h = metrics_export.LatencyHistogram()
+    h.observe(1.0)
+    kw = dict(
+        histograms=[("lat_ms", {}, h)], info={"replica": "r"},
+    )
+    a = metrics_export.render(
+        counters={"b": 2, "a": 1}, gauges={"y": 0, "x": 9}, **kw
+    )
+    b = metrics_export.render(
+        counters={"a": 1, "b": 2}, gauges={"x": 9, "y": 0}, **kw
+    )
+    assert a == b
+
+
+def test_parse_histogram_round_trip():
+    """Scrape-side exactness: parse() + histogram_from_series()
+    rebuilds the rendered histogram bit for bit, and the tenant label
+    selects the right series (match={'tenant': None} keeps only the
+    unlabeled service-wide one)."""
+    service = metrics_export.LatencyHistogram()
+    tenant = metrics_export.LatencyHistogram()
+    for ms in (0.4, 3.0, 77.0):
+        service.observe(ms)
+    tenant.observe(600.0)
+    text = metrics_export.render(
+        counters={"scheduler.completed": 41},
+        histograms=[
+            ("serve_request_latency_ms", {}, service),
+            ("serve_request_latency_ms", {"tenant": "t0"}, tenant),
+        ],
+    )
+    series = metrics_export.parse(text)
+    assert series["eeg_tpu_scheduler_completed_total"] == [({}, 41.0)]
+    got = metrics_export.histogram_from_series(
+        series, "eeg_tpu_serve_request_latency_ms",
+        match={"tenant": None},
+    )
+    assert got.counts == service.counts
+    assert got.count == service.count
+    assert got.sum_us == service.sum_us
+    got_t = metrics_export.histogram_from_series(
+        series, "eeg_tpu_serve_request_latency_ms",
+        match={"tenant": "t0"},
+    )
+    assert got_t.counts == tenant.counts
+    assert metrics_export.histogram_from_series(
+        series, "eeg_tpu_nope"
+    ) is None
+
+
+# -- SLO math -----------------------------------------------------------
+
+
+def test_slo_block_healthy_and_burning():
+    h = metrics_export.LatencyHistogram()
+    for _ in range(99):
+        h.observe(5.0)
+    h.observe(2000.0)
+    ok = metrics_export.slo_block(
+        h, {"completed": 100, "shed": 0, "failed": 0},
+        objective_ms=50.0, availability_target=0.98,
+    )
+    assert ok["availability"] == 1.0
+    assert ok["latency_attainment"] == pytest.approx(0.99)
+    assert ok["ok"] is True
+    # the same latencies against a 99.9% target: 1% bad burns 10x
+    burn = metrics_export.slo_block(
+        h, {"completed": 100, "shed": 0, "failed": 0},
+        objective_ms=50.0, availability_target=0.999,
+    )
+    assert burn["error_budget_burn"] == pytest.approx(10.0)
+    assert burn["ok"] is False
+    # availability is the binding objective when sheds dominate
+    shed = metrics_export.slo_block(
+        metrics_export.LatencyHistogram(),
+        {"completed": 50, "shed": 50, "failed": 0},
+        objective_ms=50.0, availability_target=0.999,
+    )
+    assert shed["availability"] == pytest.approx(0.5)
+    assert shed["ok"] is False
+
+
+def test_slo_block_empty_service_is_healthy():
+    block = metrics_export.slo_block(
+        metrics_export.LatencyHistogram(), {},
+        objective_ms=50.0, availability_target=0.999,
+    )
+    assert block["availability"] == 1.0
+    assert block["latency_attainment"] == 1.0
+    assert block["ok"] is True
+    assert block["requests_observed"] == 0
+
+
+# -- trace-id minting ---------------------------------------------------
+
+
+def test_mint_trace_id_honors_wellformed_inbound():
+    assert mint_trace_id("req-2026.08_07-a") == "req-2026.08_07-a"
+
+
+@pytest.mark.parametrize("bad", [
+    None, "", "has space", "semi;colon", "x" * 129, 'quo"te',
+])
+def test_mint_trace_id_remints_hostile_inbound(bad):
+    minted = mint_trace_id(bad)
+    assert minted != bad
+    assert len(minted) == 32
+    int(minted, 16)  # hex-shaped
+
+
+def test_mint_trace_id_unique_per_mint():
+    assert mint_trace_id(None) != mint_trace_id(None)
+
+
+# -- the gateway surface ------------------------------------------------
+
+
+def test_gateway_journals_and_echoes_trace_id(session, tmp_path):
+    """The propagation root: an inbound X-Trace-Id rides the submit
+    response, the journal's submit meta, AND the terminal record
+    (re-journaled at completion — plan_admin trace resolves finished
+    plans from exactly that field)."""
+    journal_dir = str(tmp_path / "journal")
+    with GatewayServer(journal_dir=journal_dir) as gw:
+        req = urllib.request.Request(
+            f"{gw.url}/plans", data=_q(session).encode(),
+            method="POST", headers={"X-Trace-Id": "trace-pin-1"},
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            payload = json.loads(r.read())
+        assert payload["trace_id"] == "trace-pin-1"
+        plan_id = payload["plan_id"]
+        gw.executor.handle(plan_id).result(120)
+    entry = PlanJournal(journal_dir).entry(plan_id)
+    assert entry["state"] == "completed"
+    assert entry["meta"]["trace_id"] == "trace-pin-1"
+
+
+def test_gateway_metrics_endpoint(session, tmp_path):
+    """GET /metrics: Prometheus content type, the build-info series
+    naming the replica, scheduler counters present after a completed
+    plan. Structural, not golden — obs.metrics counters are process-
+    global and accumulate across the suite."""
+    with GatewayServer(journal_dir=str(tmp_path / "journal")) as gw:
+        req = urllib.request.Request(
+            f"{gw.url}/plans", data=_q(session).encode(), method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            plan_id = json.loads(r.read())["plan_id"]
+        gw.executor.handle(plan_id).result(120)
+        with urllib.request.urlopen(
+            f"{gw.url}/metrics", timeout=30
+        ) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"] == \
+                metrics_export.CONTENT_TYPE
+            text = r.read().decode()
+    series = metrics_export.parse(text)
+    info = series["eeg_tpu_build_info"]
+    assert info[0][0]["replica"] == gw.replica_id
+    assert series["eeg_tpu_scheduler_completed_total"][0][1] >= 1
+    assert "eeg_tpu_gateway_queue_depth" in series
+
+
+def test_fleet_top_over_live_and_down_replicas(session, tmp_path,
+                                               capsys):
+    """tools/fleet_top.py against one live gateway plus one dead URL:
+    the live row carries the scraped counters, the dead URL renders
+    DOWN without failing the table, and --snapshot-style output stays
+    strict-JSON-safe."""
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    try:
+        import fleet_top
+    finally:
+        sys.path.pop(0)
+    from eeg_dataanalysispackage_tpu.utils import strict_json
+
+    with GatewayServer(journal_dir=str(tmp_path / "journal")) as gw:
+        req = urllib.request.Request(
+            f"{gw.url}/plans", data=_q(session).encode(), method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            plan_id = json.loads(r.read())["plan_id"]
+        gw.executor.handle(plan_id).result(120)
+        snap = fleet_top.snapshot(
+            [gw.url, "http://127.0.0.1:9"], timeout_s=5.0
+        )
+    up, down = snap["replicas"]
+    assert up["replica"] == gw.replica_id
+    assert up["plans_completed"] >= 1
+    assert "error" in down
+    assert snap["fleet"]["replicas_up"] == 1
+    assert snap["fleet"]["replicas_total"] == 2
+    strict_json.dumps(snap)  # JSON-safe end to end
+    fleet_top.render(snap)
+    out = capsys.readouterr().out
+    assert gw.replica_id in out and "DOWN" in out
+    assert "fleet: 1/2 up" in out
+
+
+# -- tenant eviction ----------------------------------------------------
+
+
+def test_evict_tenant_drops_all_accounting():
+    """The remove_tenant leak fix: after eviction the reservoir, the
+    histogram, and every ``tenant.<name>.*`` counter are gone, while
+    other tenants' state is untouched."""
+    from eeg_dataanalysispackage_tpu.serve import batcher as batcher_mod
+
+    mb = batcher_mod.MicroBatcher(
+        lambda windows, resolutions: (None, None),
+        max_batch=4, queue_depth=8, tenant_aware=True,
+    )
+    for tenant in ("t0", "t1"):
+        mb._count_tenant(tenant, "completed", 3)
+        mb._tenant_latency(tenant, 0.004)
+    assert set(mb.tenant_latency_snapshot()) == {"t0", "t1"}
+    assert set(mb.tenant_histogram_snapshot()) == {"t0", "t1"}
+
+    mb.evict_tenant("t0")
+    counters, _ = mb.snapshot()
+    assert not [k for k in counters if k.startswith("tenant.t0.")]
+    assert counters["tenant.t1.completed"] == 3
+    assert set(mb.tenant_latency_snapshot()) == {"t1"}
+    assert set(mb.tenant_histogram_snapshot()) == {"t1"}
+    # idempotent — a double remove must not raise
+    mb.evict_tenant("t0")
+
+
+# -- the trace stitcher -------------------------------------------------
+
+
+def _segment_line(**kw):
+    return json.dumps(kw, sort_keys=True)
+
+
+def test_plan_admin_trace_stitches_takeover(tmp_path, capsys):
+    """Synthesized two-segment trace: the dead holder's segment (a
+    header whose root span never closed, plus one finished child) and
+    the survivor's takeover segment. The stitcher must render ONE
+    tree — both segments under one trace id — with the TAKEOVER
+    boundary named and the dead root UNFINISHED."""
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    try:
+        import plan_admin
+    finally:
+        sys.path.pop(0)
+    journal_dir = str(tmp_path / "journal")
+    journal = PlanJournal(journal_dir)
+    journal.record_submitted(
+        "p0001", "q", meta={"trace_id": "trace-x"}
+    )
+    trace_dir = tmp_path / "traces"
+    trace_dir.mkdir()
+    victim = [
+        _segment_line(
+            kind="segment", trace_id="trace-x", segment="gw-a",
+            root_span_id="gw-a:1", wall_start=100.0,
+            attrs={"plan_id": "p0001"},
+        ),
+        _segment_line(
+            kind="span", trace_id="trace-x", segment="gw-a",
+            span_id="gw-a:2", parent_id="gw-a:1", name="stage.ingest",
+            wall_start=100.1, wall_end=100.4, thread="w0", attrs={},
+        ),
+        # the SIGKILL tore the final line mid-write — skipped, never
+        # fatal
+        '{"kind": "span", "trace_id": "trace-x", "seg',
+    ]
+    survivor = [
+        _segment_line(
+            kind="segment", trace_id="trace-x", segment="gw-b",
+            root_span_id="gw-b:1", wall_start=103.0,
+            attrs={"plan_id": "p0001", "takeover": True},
+        ),
+        _segment_line(
+            kind="span", trace_id="trace-x", segment="gw-b",
+            span_id="gw-b:2", parent_id="gw-b:1", name="stage.train",
+            wall_start=103.1, wall_end=104.0, thread="w0", attrs={},
+        ),
+        _segment_line(
+            kind="span", trace_id="trace-x", segment="gw-b",
+            span_id="gw-b:1", parent_id=None, name="plan",
+            wall_start=103.0, wall_end=104.2, thread="w0",
+            attrs={"plan_id": "p0001", "takeover": True},
+        ),
+    ]
+    (trace_dir / "trace-gw-a.jsonl").write_text(
+        "\n".join(victim) + "\n"
+    )
+    (trace_dir / "trace-gw-b.jsonl").write_text(
+        "\n".join(survivor) + "\n"
+    )
+
+    rc = plan_admin.main([
+        "trace", "p0001", "--journal", journal_dir,
+        "--trace-dir", str(trace_dir),
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "trace trace-x" in out and "2 segment(s)" in out
+    # segment order is wall-start order: the victim first
+    assert out.index("segment gw-a") < out.index("segment gw-b")
+    assert "TAKEOVER boundary: continued after gw-a died" in out
+    # the dead holder's root was synthesized from the header and
+    # rendered unfinished, with its completed child nested under it
+    assert "UNFINISHED (holder died mid-span)" in out
+    assert "stage.ingest" in out and "stage.train" in out
+
+
+def test_plan_admin_trace_without_trace_id(session, tmp_path, capsys):
+    """A record journaled without a trace id (pre-observability
+    submit) is reported, not crashed on."""
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    try:
+        import plan_admin
+    finally:
+        sys.path.pop(0)
+    journal_dir = str(tmp_path / "journal")
+    PlanJournal(journal_dir).record_submitted("p0009", "q", meta={})
+    rc = plan_admin.main([
+        "trace", "p0009", "--journal", journal_dir,
+        "--trace-dir", str(tmp_path / "traces"),
+    ])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "no journaled trace id" in out

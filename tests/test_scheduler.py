@@ -117,7 +117,7 @@ def test_shed_with_evidence(monkeypatch, session, tmp_path):
     release = threading.Event()
 
     def blocked_execute(plan, builder_, plan_id=None, fault_plan=None,
-                        default_report_dir=None, gateway=None):
+                        default_report_dir=None, gateway=None, **kw):
         assert release.wait(30), "test never released the worker"
         return f"done-{plan_id}"
 
@@ -154,7 +154,7 @@ def test_queued_deadline_fails_fast(monkeypatch, session):
     release = threading.Event()
 
     def blocked_execute(plan, builder_, plan_id=None, fault_plan=None,
-                        default_report_dir=None, gateway=None):
+                        default_report_dir=None, gateway=None, **kw):
         assert release.wait(30)
         return f"done-{plan_id}"
 
@@ -609,7 +609,7 @@ def test_close_fails_abandoned_queued_handles(monkeypatch, session):
     release = threading.Event()
 
     def blocked_execute(plan, builder_, plan_id=None, fault_plan=None,
-                        default_report_dir=None, gateway=None):
+                        default_report_dir=None, gateway=None, **kw):
         assert release.wait(30)
         return f"done-{plan_id}"
 

@@ -93,8 +93,16 @@ performance contract holds:
   fresh-process twin, exactly once (one terminal record per plan,
   zero corrupt quarantines, zero leftover leases, and the survivors'
   ``scheduler.completed`` sum equals the expected execution count);
-  a keyed re-submit after the takeover replays the original id; and
+  a keyed re-submit after the takeover replays the original id; a
+  live ``fleet_top`` /metrics sweep taken after the takeover sees
+  exactly the survivors up (the victim a DOWN row) with scraped
+  completion/takeover counters agreeing with the journal audit; and
   the surviving replicas drain to exit 0 on a real SIGTERM;
+
+- the observability plane (ISSUE 19): a telemetry-off cold twin (no
+  report dir) produces statistics byte-identical to the instrumented
+  cold run (observation never steers) and the instrumented wall stays
+  inside the shared-box noise floor of the unobserved twin's;
 
 - the PR 8 ingest gates: the overlap=true cold twin produces
   byte-identical statistics to the serial cold run (double-buffered
@@ -1027,6 +1035,34 @@ def _check_fleet(line: dict, failures: list) -> None:
             f"fleet: SIGTERM drain exit codes "
             f"{fleet.get('drain_exit_codes')} (expected all 0)"
         )
+    # the scraped fleet view (ISSUE 19): fleet_top's /metrics sweep,
+    # taken live after the takeover — the dead victim a DOWN row, the
+    # survivors' own exposition counters agreeing with the journal
+    # about completions and the takeover
+    metrics = fleet.get("metrics") or {}
+    m_fleet = metrics.get("fleet") or {}
+    down = [
+        r for r in metrics.get("replicas") or [] if "error" in r
+    ]
+    if m_fleet.get("replicas_up") != fleet.get("replicas", 0) - 1 or (
+        len(down) != 1
+    ):
+        failures.append(
+            f"fleet: /metrics scrape did not see exactly the "
+            f"survivors up and the victim DOWN: {m_fleet} "
+            f"(down rows: {down})"
+        )
+    if m_fleet.get("plans_completed") != audit.get("expected_records"):
+        failures.append(
+            f"fleet: scraped completion counters disagree with the "
+            f"journal: {m_fleet.get('plans_completed')} vs "
+            f"{audit.get('expected_records')}"
+        )
+    if not m_fleet.get("takeovers", 0) >= 1:
+        failures.append(
+            f"fleet: the takeover never reached the survivors' "
+            f"/metrics exposition: {m_fleet}"
+        )
 
 
 def _check_report(tag: str, bench_line: dict, report_dir: str,
@@ -1109,6 +1145,17 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
             "pipeline_e2e_fanout5", n_markers, n_files,
             data_dir, os.path.join(tmp, "cache_fanout"),
             report_dirs["fanout"],
+        )
+        # the observability-plane twin (ISSUE 19): the same cold query
+        # with telemetry fully OFF (no report dir, env override
+        # cleared) — the plane observes, never steers, so its
+        # statistics must be byte-identical to the instrumented cold
+        # run's, and instrumenting must cost no more than the
+        # shared-box noise floor
+        obs_off = _run_variant(
+            "pipeline_e2e_cold", n_markers, n_files,
+            data_dir, os.path.join(tmp, "cache_obs_off"), None,
+            env_extra={"EEG_TPU_RUN_REPORT_DIR": ""},
         )
         # PR 8 gates: the overlap twin (bit-identical statistics), the
         # bf16 twin (gate decision recorded, statistics within the
@@ -1361,6 +1408,21 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
         failures.append(
             "cached vs uncached statistics drifted: "
             f"{cold['report_sha256']} vs {warm['report_sha256']}"
+        )
+    # the observability plane observes, never steers (ISSUE 19): the
+    # telemetry-off twin is byte-identical to the instrumented cold
+    # run, and instrumentation stays inside the noise floor (1.5x —
+    # the pair runs minutes apart on a shared box)
+    if obs_off["report_sha256"] != cold["report_sha256"]:
+        failures.append(
+            "obs: instrumented statistics drifted from the "
+            f"telemetry-off twin: {cold['report_sha256']} vs "
+            f"{obs_off['report_sha256']}"
+        )
+    if not cold["wall_s"] <= 1.5 * obs_off["wall_s"]:
+        failures.append(
+            f"obs: telemetry overhead left the noise floor: "
+            f"{cold['wall_s']}s instrumented vs {obs_off['wall_s']}s off"
         )
     # overlap-on vs overlap-off: scheduling only, never results
     if overlap_line.get("overlap") is not True:
@@ -1738,6 +1800,21 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
         "fleet_drained_cleanly": bool(
             (fleet_line.get("fleet") or {}).get("drained_cleanly")
         ),
+        "fleet_metrics_scrape": (
+            ((fleet_line.get("fleet") or {}).get("metrics") or {})
+            .get("fleet")
+        ),
+        "obs_overhead": {
+            "obs_on_wall_s": cold["wall_s"],
+            "obs_off_wall_s": obs_off["wall_s"],
+            "ratio": (
+                round(cold["wall_s"] / obs_off["wall_s"], 2)
+                if obs_off["wall_s"] > 0 else None
+            ),
+            "statistics_identical": (
+                obs_off["report_sha256"] == cold["report_sha256"]
+            ),
+        },
         "reports_checked": len(reports_checked),
         "cold_stages": {
             k: v["seconds"] for k, v in cold.get("stages", {}).items()

@@ -1,0 +1,283 @@
+"""One fleet view: scrape every replica's /metrics + /stats and the
+shared lease directory, and render the whole fleet as one table.
+
+Usage:
+    python tools/fleet_top.py http://h:p1 http://h:p2 [--journal DIR]
+    python tools/fleet_top.py ... --snapshot        # strict JSON out
+
+Per replica: throughput counters (plans completed, serve requests),
+held leases, takeover count, the latency histogram's p50/p99, and the
+per-tenant SLO verdicts off the replica's own /stats block. Fleet-
+wide: the replicas' fixed-bucket histograms merged by exact integer
+addition (obs/metrics_export.py — the merged p99 IS the histogram-p99
+of the union of observations, not an approximation), summed counters,
+and, with ``--journal``, the lease table joined straight off the
+shared directory (who holds what, what is stale, what is claimable).
+
+A replica that cannot be scraped renders as DOWN with the error —
+the fleet view must degrade per-replica, never refuse the whole
+table because one member is mid-restart.
+
+``--snapshot`` emits the same data as one strict-JSON object
+(non-finite floats -> null) for CI and the gateway_fleet bench line
+(tools/pipeline_bench.py embeds it in the bench artifact).
+
+Stdlib only, like every tool in this repo.
+"""
+
+import argparse
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+
+def _get_text(url: str, timeout_s: float = 10.0) -> str:
+    with urllib.request.urlopen(url, timeout=timeout_s) as r:
+        return r.read().decode("utf-8", "replace")
+
+
+def _get_json(url: str, timeout_s: float = 10.0):
+    return json.loads(_get_text(url, timeout_s=timeout_s))
+
+
+def replica_snapshot(url: str, timeout_s: float = 10.0) -> dict:
+    """Scrape one replica: parsed /metrics series + the /stats
+    payload, reduced to the fleet table's row (raising on any scrape
+    failure — the caller degrades the row, not this function)."""
+    from eeg_dataanalysispackage_tpu.obs import metrics_export
+
+    base = url.rstrip("/")
+    series = metrics_export.parse(_get_text(base + "/metrics", timeout_s))
+    stats = _get_json(base + "/stats", timeout_s)
+
+    def counter(name: str) -> int:
+        rows = series.get(f"eeg_tpu_{name}_total", [])
+        return int(rows[0][1]) if rows else 0
+
+    def gauge(name: str) -> int:
+        rows = series.get(f"eeg_tpu_{name}", [])
+        return int(rows[0][1]) if rows else 0
+
+    info = series.get("eeg_tpu_build_info", [])
+    replica = info[0][0].get("replica", "?") if info else "?"
+    # the service-wide histogram is the tenant-unlabeled series
+    # (matching tenant=None keeps only rows WITHOUT the label);
+    # per-tenant series carry tenant= labels
+    hist = metrics_export.histogram_from_series(
+        series, "eeg_tpu_serve_request_latency_ms",
+        match={"tenant": None},
+    )
+    serve = stats.get("serve") or {}
+    tenants = serve.get("tenants") or {}
+    slo = {
+        name: block.get("slo")
+        for name, block in sorted(tenants.items())
+        if block.get("slo") is not None
+    }
+    if not slo and serve.get("slo") is not None:
+        slo = {"(service)": serve["slo"]}
+    fleet_block = stats.get("fleet") or {}
+    return {
+        "url": base,
+        "replica": replica,
+        "draining": bool(fleet_block.get("draining")),
+        "plans_completed": counter("scheduler_completed"),
+        "serve_completed": counter("serve_completed"),
+        "serve_shed": counter("serve_shed"),
+        "held_leases": gauge("fleet_held_leases"),
+        "takeovers": counter("lease_takeovers"),
+        "latency_hist": None if hist is None else hist.snapshot(),
+        "slo": slo,
+    }
+
+
+def _lease_table(journal_dir: str) -> list:
+    """The shared lease directory's rows (offline — same join as
+    plan_admin's ``fleet`` view, reduced to what the top table
+    needs)."""
+    from eeg_dataanalysispackage_tpu.scheduler import lease as lease_mod
+
+    leases = lease_mod.LeaseDir(journal_dir, holder="fleet-top")
+    return [
+        {
+            "plan_id": info["plan_id"],
+            "holder": info["holder"],
+            "age_s": round(info["age_s"], 2),
+            "stale": bool(info["stale"]),
+        }
+        for info in leases.scan()
+    ]
+
+
+def snapshot(urls, journal_dir=None, timeout_s: float = 10.0) -> dict:
+    """The whole fleet as one JSON-safe dict: per-replica rows
+    (DOWN rows carry ``error``), the exactly-merged fleet histogram,
+    summed counters, the worst per-tenant SLO across replicas, and
+    (with ``journal_dir``) the lease table."""
+    from eeg_dataanalysispackage_tpu.obs import metrics_export
+
+    replicas = []
+    for url in urls:
+        try:
+            replicas.append(replica_snapshot(url, timeout_s=timeout_s))
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            replicas.append({
+                "url": url.rstrip("/"),
+                "replica": None,
+                "error": f"{type(e).__name__}: {e}",
+            })
+    up = [r for r in replicas if "error" not in r]
+    merged = metrics_export.merge_all(
+        metrics_export.LatencyHistogram.from_snapshot(r["latency_hist"])
+        for r in up
+        if r.get("latency_hist")
+    )
+    # per-tenant worst-case across replicas: a tenant is only as
+    # healthy as its worst replica says it is
+    tenant_slo = {}
+    for r in up:
+        for tenant, block in (r.get("slo") or {}).items():
+            prior = tenant_slo.get(tenant)
+            if prior is None or (
+                block.get("error_budget_burn", 0)
+                > prior.get("error_budget_burn", 0)
+            ):
+                tenant_slo[tenant] = block
+    fleet = {
+        "replicas_total": len(replicas),
+        "replicas_up": len(up),
+        "plans_completed": sum(r["plans_completed"] for r in up),
+        "serve_completed": sum(r["serve_completed"] for r in up),
+        "serve_shed": sum(r["serve_shed"] for r in up),
+        "held_leases": sum(r["held_leases"] for r in up),
+        "takeovers": sum(r["takeovers"] for r in up),
+        "latency_hist": None if merged is None else merged.snapshot(),
+        "latency_p50_ms": None if merged is None else merged.quantile(50.0),
+        "latency_p99_ms": None if merged is None else merged.quantile(99.0),
+        "tenant_slo": tenant_slo,
+    }
+    snap = {"replicas": replicas, "fleet": fleet}
+    if journal_dir:
+        try:
+            snap["leases"] = _lease_table(journal_dir)
+        except OSError as e:
+            snap["leases_error"] = f"{type(e).__name__}: {e}"
+    return snap
+
+
+def render(snap: dict) -> None:
+    """The human table over one :func:`snapshot`."""
+    from eeg_dataanalysispackage_tpu.obs import metrics_export
+
+    cols = ("replica", "state", "plans", "serve", "shed", "leases",
+            "takeovers", "p50ms", "p99ms")
+    rows = []
+    for r in snap["replicas"]:
+        if "error" in r:
+            rows.append({
+                "replica": r["url"], "state": "DOWN",
+                "plans": "-", "serve": "-", "shed": "-", "leases": "-",
+                "takeovers": "-", "p50ms": "-", "p99ms": "-",
+                "_error": r["error"],
+            })
+            continue
+        hist = (
+            metrics_export.LatencyHistogram.from_snapshot(
+                r["latency_hist"]
+            )
+            if r.get("latency_hist") else None
+        )
+        p50 = hist.quantile(50.0) if hist else None
+        p99 = hist.quantile(99.0) if hist else None
+        rows.append({
+            "replica": r["replica"],
+            "state": "draining" if r["draining"] else "up",
+            "plans": r["plans_completed"],
+            "serve": r["serve_completed"],
+            "shed": r["serve_shed"],
+            "leases": r["held_leases"],
+            "takeovers": r["takeovers"],
+            "p50ms": "-" if p50 is None else f"{p50:g}",
+            "p99ms": "-" if p99 is None else f"{p99:g}",
+        })
+    widths = {
+        c: max(len(c), *(len(str(row[c])) for row in rows))
+        for c in cols
+    } if rows else {c: len(c) for c in cols}
+    print("  ".join(f"{c:<{widths[c]}}" for c in cols))
+    for row in rows:
+        print("  ".join(f"{str(row[c]):<{widths[c]}}" for c in cols))
+        if row.get("_error"):
+            print(f"    ({row['_error']})")
+    fleet = snap["fleet"]
+    p99 = fleet.get("latency_p99_ms")
+    print(
+        f"\nfleet: {fleet['replicas_up']}/{fleet['replicas_total']} up, "
+        f"{fleet['plans_completed']} plans, "
+        f"{fleet['serve_completed']} serve requests "
+        f"({fleet['serve_shed']} shed), "
+        f"{fleet['held_leases']} leases held, "
+        f"{fleet['takeovers']} takeovers"
+        + (f", merged p99 {p99:g}ms" if p99 is not None else "")
+    )
+    for tenant, slo in sorted((fleet.get("tenant_slo") or {}).items()):
+        verdict = "OK" if slo.get("ok") else "BURNING"
+        print(
+            f"  slo {tenant}: {verdict}  "
+            f"avail={slo.get('availability')} "
+            f"attain={slo.get('latency_attainment')} "
+            f"burn={slo.get('error_budget_burn')} "
+            f"(objective {slo.get('objective_ms')}ms, "
+            f"target {slo.get('availability_target')})"
+        )
+    leases = snap.get("leases")
+    if leases is not None:
+        stale = sum(1 for row in leases if row["stale"])
+        print(f"\nleases on disk: {len(leases)} ({stale} stale)")
+        for row in leases:
+            mark = "STALE" if row["stale"] else "held"
+            print(
+                f"  {row['plan_id']:<12} {row['holder'] or '?':<16} "
+                f"{row['age_s']:>7.2f}s  {mark}"
+            )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="fleet_top", description=__doc__.split("\n\n")[0],
+    )
+    parser.add_argument(
+        "urls", nargs="+", help="replica base URLs (http://host:port)",
+    )
+    parser.add_argument(
+        "--journal", help="shared journal dir (adds the lease table)",
+    )
+    parser.add_argument(
+        "--snapshot", action="store_true",
+        help="emit one strict-JSON object instead of the table",
+    )
+    parser.add_argument("--timeout", type=float, default=10.0)
+    args = parser.parse_args(argv)
+    snap = snapshot(
+        args.urls, journal_dir=args.journal, timeout_s=args.timeout
+    )
+    if args.snapshot:
+        from eeg_dataanalysispackage_tpu.utils import strict_json
+
+        print(strict_json.dumps(snap, sort_keys=True))
+    else:
+        render(snap)
+    return 0 if snap["fleet"]["replicas_up"] == len(args.urls) else 1
+
+
+if __name__ == "__main__":
+    # the repo root, so the package imports without installation
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    try:
+        sys.exit(main())
+    except KeyboardInterrupt:
+        sys.exit(130)

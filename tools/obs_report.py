@@ -160,6 +160,13 @@ def show(path: str) -> None:
             f"idempotency_key={gateway.get('idempotency_key')} "
             f"client={gateway.get('client')}"
         )
+    trace = data.get("trace")
+    if trace:
+        print(
+            f"  trace    id={trace.get('trace_id')} "
+            f"segment={trace.get('segment')}"
+            "  (stitch: plan_admin trace <plan-id>)"
+        )
     mesh = data.get("mesh")
     if mesh:
         req = mesh.get("requested") or {}
@@ -263,6 +270,16 @@ def show(path: str) -> None:
             f"{serve.get('drained_cleanly')}  wedged="
             f"{serve.get('wedged')}"
         )
+        slo = serve.get("slo")
+        if slo:
+            print(
+                f"  slo {'OK' if slo.get('ok') else 'BURNING'}  "
+                f"avail={slo.get('availability')} "
+                f"attain={slo.get('latency_attainment')} "
+                f"burn={slo.get('error_budget_burn')}  "
+                f"(objective {slo.get('objective_ms')}ms, target "
+                f"{slo.get('availability_target')})"
+            )
         tenants = serve.get("tenants") or {}
         if tenants:
             print(
@@ -275,6 +292,12 @@ def show(path: str) -> None:
                 t = tenants[name]
                 treq = t.get("requests", {})
                 tlat = t.get("latency_ms", {})
+                tslo = t.get("slo") or {}
+                slo_tail = (
+                    f"  slo={'OK' if tslo.get('ok') else 'BURN'}"
+                    f"(burn={tslo.get('error_budget_burn')})"
+                    if tslo else ""
+                )
                 print(
                     f"    {name:<{width}}  lane={t.get('lane')} "
                     f"gen={t.get('generation')}  completed="
@@ -282,6 +305,7 @@ def show(path: str) -> None:
                     f"deadline={treq.get('deadline_exceeded')} "
                     f"failed={treq.get('failed')}  p50="
                     f"{tlat.get('p50')}ms p99={tlat.get('p99')}ms"
+                    f"{slo_tail}"
                 )
     lifecycle = data.get("lifecycle")
     if lifecycle:
@@ -383,6 +407,21 @@ def show(path: str) -> None:
                 f"  t={ev['t']:9.4f}  {ev['name']:<28} "
                 f"span={ev.get('span_name')}  {ev.get('attrs') or ''}"
             )
+        fleet_ctx = data.get("fleet_context")
+        if fleet_ctx:
+            counters = fleet_ctx.get("lease_counters") or {}
+            print(
+                f"\nfleet context: replica={fleet_ctx.get('replica')} "
+                f"takeover={fleet_ctx.get('takeover')} "
+                f"held_leases={fleet_ctx.get('held_leases')}"
+            )
+            if counters:
+                print(
+                    "  lease counters: "
+                    + "  ".join(
+                        f"{k}={v}" for k, v in sorted(counters.items())
+                    )
+                )
 
 
 def diff(path_a: str, path_b: str) -> None:
@@ -469,6 +508,7 @@ def diff(path_a: str, path_b: str) -> None:
                 t.get("lane"), t.get("generation"),
                 (t.get("requests") or {}).get("completed"),
                 (t.get("requests") or {}).get("shed"),
+                (t.get("slo") or {}).get("ok"),
             )
             for name, t in tenants.items()
         }
@@ -476,9 +516,28 @@ def diff(path_a: str, path_b: str) -> None:
     ta, tb = _tenant_digest(a), _tenant_digest(b)
     if (ta or tb) and ta != tb:
         print(
-            f"serve tenants (lane, gen, completed, shed): "
+            f"serve tenants (lane, gen, completed, shed, slo_ok): "
             f"A {ta}  B {tb}"
         )
+
+    def _slo_digest(report):
+        slo = (report.get("serve") or {}).get("slo")
+        if not slo:
+            return None
+        return {
+            "ok": slo.get("ok"),
+            "availability": slo.get("availability"),
+            "attainment": slo.get("latency_attainment"),
+            "burn": slo.get("error_budget_burn"),
+        }
+
+    slo_a, slo_b = _slo_digest(a), _slo_digest(b)
+    if (slo_a or slo_b) and slo_a != slo_b:
+        print(f"serve slo (ok, avail, attain, burn): A {slo_a}  B {slo_b}")
+    tr_a = (a.get("trace") or {}).get("trace_id")
+    tr_b = (b.get("trace") or {}).get("trace_id")
+    if (tr_a or tr_b) and tr_a != tr_b:
+        print(f"trace: A {tr_a}  B {tr_b}")
 
     def _pop_digest(report):
         pop = report.get("population")

@@ -1199,6 +1199,19 @@ def run_gateway_fleet(info: str, scratch: str) -> dict:
             _, stats = _http_json(f"{url}/stats")
             survivor_stats.append(stats)
 
+        # -- one fleet view off the live fleet (tools/fleet_top.py):
+        # scrape every replica's /metrics — the SIGKILLed victim must
+        # render as a DOWN row, the table degrades per-replica — and
+        # join the shared lease directory. The scraped counters are
+        # independent evidence for the journal audit below: the
+        # survivors' own exposition must agree about completions and
+        # the takeover.
+        sys.path.insert(
+            0, os.path.dirname(os.path.abspath(__file__))
+        )
+        import fleet_top
+        metrics_snap = fleet_top.snapshot(urls, journal_dir=journal_dir)
+
         # -- graceful close-out: real SIGTERM, drain, exit 0
         for proc in procs[1:]:
             proc.send_signal(_signal.SIGTERM)
@@ -1303,6 +1316,10 @@ def run_gateway_fleet(info: str, scratch: str) -> dict:
         "survivor_fleet_stats": [
             s.get("fleet") for s in survivor_stats
         ],
+        # the live /metrics scrape (fleet_top), taken after the
+        # takeover and before the drain: the victim DOWN, the
+        # survivors' summed counters agreeing with the journal
+        "metrics": metrics_snap,
         "drain_exit_codes": drain_rcs,
         "drained_cleanly": all(rc == 0 for rc in drain_rcs),
     }

@@ -10,6 +10,8 @@ Usage:
     python tools/plan_admin.py tail --journal DIR
             [--interval S] [--count N]
     python tools/plan_admin.py fleet --journal DIR
+    python tools/plan_admin.py trace <plan_id> --journal DIR
+            [--trace-dir DIR]
 
 ``list`` renders every plan record as an aligned table — id, state,
 attempts, timestamp, idempotency key, query — against either a journal
@@ -36,6 +38,16 @@ mentioning that tenant.
 or change state — the exactly-once behavior is auditable live:
 ``submitted`` appears before execution, exactly one terminal record
 replaces it, and an idempotent re-submit changes nothing.
+
+``trace`` stitches one plan's distributed trace back together: the
+plan's journaled trace id (``meta.trace_id``) selects the matching
+spans out of every replica's ``trace-<replica>.jsonl`` segment file
+(``EEG_TPU_TRACE_DIR``, or ``--trace-dir``), and the segments render
+as ONE tree ordered by wall time — a plan whose holder was SIGKILLed
+mid-run shows the dead replica's truncated segment followed by the
+surviving replica's takeover segment, with the boundary annotated.
+Works offline, like ``fleet``: the trace files and the journal are
+all it reads.
 
 ``fleet`` renders the replication view of a shared journal directory
 (gateway/fleet.py): every lease file joined against its plan record —
@@ -389,6 +401,160 @@ def cmd_fleet(args) -> int:
     return 0
 
 
+def _load_trace_segments(trace_dir: str, trace_id: str):
+    """Read every ``trace-*.jsonl`` segment file under ``trace_dir``
+    and return the segments carrying ``trace_id``, ordered by wall
+    start: ``[{segment, wall_start, takeover, attrs, spans}, ...]``.
+    Unparseable lines are skipped (a SIGKILLed writer may leave a
+    torn final line — that is exactly the scenario this audits)."""
+    import glob
+
+    segments = {}
+    for path in sorted(glob.glob(os.path.join(trace_dir, "trace-*.jsonl"))):
+        try:
+            with open(path) as f:
+                lines = f.read().splitlines()
+        except OSError:
+            continue
+        for raw in lines:
+            try:
+                rec = json.loads(raw)
+            except ValueError:
+                continue
+            if rec.get("trace_id") != trace_id:
+                continue
+            name = rec.get("segment") or os.path.basename(path)
+            seg = segments.setdefault(name, {
+                "segment": name,
+                "wall_start": None,
+                "takeover": False,
+                "attrs": {},
+                "root_span_id": None,
+                "spans": [],
+            })
+            if rec.get("kind") == "segment":
+                seg["wall_start"] = rec.get("wall_start")
+                attrs = rec.get("attrs") or {}
+                seg["attrs"] = attrs
+                seg["takeover"] = bool(attrs.get("takeover"))
+                seg["root_span_id"] = rec.get("root_span_id")
+            elif rec.get("kind") == "span":
+                seg["spans"].append(rec)
+                if seg["wall_start"] is None:
+                    seg["wall_start"] = rec.get("wall_start")
+    # a recorder only sinks spans as they FINISH: a segment whose
+    # header promised a root span that never arrived belongs to a
+    # writer that died with the span open (SIGKILL). Synthesize the
+    # unfinished root so the dead holder's completed children hang
+    # off a visible seam instead of floating parentless.
+    for seg in segments.values():
+        root_id = seg.get("root_span_id")
+        if root_id and not any(
+            s.get("span_id") == root_id for s in seg["spans"]
+        ):
+            seg["spans"].insert(0, {
+                "kind": "span",
+                "span_id": root_id,
+                "parent_id": None,
+                "name": "(segment root)",
+                "wall_start": seg["wall_start"],
+                "wall_end": None,
+                "attrs": dict(seg["attrs"]),
+            })
+    return sorted(
+        segments.values(),
+        key=lambda s: (s["wall_start"] or 0.0, s["segment"]),
+    )
+
+
+def _render_segment_spans(spans) -> int:
+    """Print one segment's spans as an indented tree (wall order
+    within each level); returns the span count. A span without an end
+    is rendered as UNFINISHED — the dead holder's in-flight work."""
+    by_id = {s["span_id"]: s for s in spans if s.get("span_id")}
+    children = {}
+    roots = []
+    for s in spans:
+        parent = s.get("parent_id")
+        if parent and parent in by_id:
+            children.setdefault(parent, []).append(s)
+        else:
+            roots.append(s)
+
+    def walk(span, depth):
+        start = span.get("wall_start") or 0.0
+        end = span.get("wall_end")
+        if end is None:
+            timing = "UNFINISHED (holder died mid-span)"
+        else:
+            timing = f"{(end - start) * 1e3:.1f}ms"
+        attrs = span.get("attrs") or {}
+        extra = "".join(
+            f" {k}={attrs[k]}" for k in sorted(attrs)
+            if k not in ("plan_id", "takeover")
+        )
+        print(f"  {'  ' * depth}{span.get('name', '?')}  {timing}{extra}")
+        for child in sorted(
+            children.get(span.get("span_id"), []),
+            key=lambda s: s.get("wall_start") or 0.0,
+        ):
+            walk(child, depth + 1)
+
+    for root in sorted(roots, key=lambda s: s.get("wall_start") or 0.0):
+        walk(root, 0)
+    return len(spans)
+
+
+def cmd_trace(args) -> int:
+    """One plan's cross-replica trace, stitched from the per-replica
+    segment files into a single tree with the takeover boundary
+    annotated."""
+    from eeg_dataanalysispackage_tpu.scheduler.journal import PlanJournal
+
+    trace_dir = args.trace_dir or os.environ.get("EEG_TPU_TRACE_DIR")
+    if not trace_dir:
+        raise SystemExit(
+            "no trace directory: pass --trace-dir or set "
+            "EEG_TPU_TRACE_DIR"
+        )
+    if not os.path.isdir(args.journal):
+        raise SystemExit(f"no such journal directory: {args.journal}")
+    entry = PlanJournal(args.journal).entry(args.plan_id)
+    if entry is None:
+        print(f"no journal record for {args.plan_id} in {args.journal}")
+        return 1
+    trace_id = (entry.get("meta") or {}).get("trace_id")
+    if not trace_id:
+        print(
+            f"plan {args.plan_id} has no journaled trace id (submitted "
+            f"before tracing was enabled, or not via a gateway)"
+        )
+        return 1
+    segments = _load_trace_segments(trace_dir, trace_id)
+    if not segments:
+        print(
+            f"trace {trace_id} (plan {args.plan_id}): no segments under "
+            f"{trace_dir} — was EEG_TPU_TRACE_DIR set on the replicas?"
+        )
+        return 1
+    total = sum(len(s["spans"]) for s in segments)
+    print(
+        f"trace {trace_id}  plan {args.plan_id}  state "
+        f"{entry.get('state', '?')}  — {len(segments)} segment(s), "
+        f"{total} span(s)"
+    )
+    prev = None
+    for seg in segments:
+        marker = ""
+        if seg["takeover"]:
+            died = f" after {prev} died" if prev else ""
+            marker = f"  <-- TAKEOVER boundary: continued{died}"
+        print(f"\nsegment {seg['segment']}{marker}")
+        _render_segment_spans(seg["spans"])
+        prev = seg["segment"]
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="plan_admin", description=__doc__.split("\n\n")[0],
@@ -411,6 +577,16 @@ def main(argv=None) -> int:
         "fleet", help="replication view: leases joined to plan records"
     )
     p_fleet.add_argument("--journal", required=True)
+    p_trace = sub.add_parser(
+        "trace",
+        help="one plan's cross-replica trace tree (takeover-aware)",
+    )
+    p_trace.add_argument("plan_id")
+    p_trace.add_argument("--journal", required=True)
+    p_trace.add_argument(
+        "--trace-dir", dest="trace_dir",
+        help="trace segment directory (default: EEG_TPU_TRACE_DIR)",
+    )
     for p in (p_list, p_show):
         p.add_argument("--journal", help="journal directory")
         p.add_argument("--gateway", help="running gateway URL")
@@ -440,6 +616,8 @@ def main(argv=None) -> int:
         return cmd_stats(args)
     if args.command == "fleet":
         return cmd_fleet(args)
+    if args.command == "trace":
+        return cmd_trace(args)
     return cmd_tail(args)
 
 
