@@ -254,6 +254,10 @@ _VARIANT_TIMEOUTS = {
     # compiling cold, plus the lease-timeout failover wait — same
     # fresh-compile class
     "gateway_fleet": _SLOW_COMPILE_TIMEOUT_S,
+    # eight fresh processes (3 replicas x 2 phases + 2 twins), each
+    # compiling cold, plus the gang's placement wait — same
+    # fresh-compile class
+    "fleet_placement": _SLOW_COMPILE_TIMEOUT_S,
 }
 # Total wall budget for the variant loop: the headline always runs;
 # a further variant starts only if it could finish inside the budget
@@ -262,7 +266,7 @@ _VARIANT_TIMEOUTS = {
 # patience — on a warm compile cache everything fits easily; on a
 # cold cache the tail variants may be budget-skipped (recorded as
 # such, artifact intact). BENCH_TOTAL_BUDGET overrides.
-_N_VARIANTS = 33  # asserted against the variant tables below
+_N_VARIANTS = 34  # asserted against the variant tables below
 _TOTAL_BUDGET_S = int(
     os.environ.get(
         "BENCH_TOTAL_BUDGET",
@@ -393,6 +397,14 @@ _VARIANTS_TPU = {
     # iterations — a big session turns the twin + takeover re-run
     # into minutes without sharpening any failover pin
     "gateway_fleet": (400, 2),
+    # device-aware fleet placement (tools/pipeline_bench.py
+    # fleet_placement): the same 3-replica fleet run twice over a
+    # forced-8-virtual-device host — device pool on vs off — with one
+    # whole-pool gang plan + 4 single-device plans. The line carries
+    # the makespan ratio, per-plan sha parity against fresh-process
+    # twins, and the live zero-double-held device-lease audit. Same
+    # small session reasoning as gateway_fleet.
+    "fleet_placement": (400, 2),
 }
 _VARIANTS_CPU = {
     "einsum": (8192, 5),
@@ -428,6 +440,7 @@ _VARIANTS_CPU = {
     "scheduler_multi": (2000, 4),
     "plan_service": (2000, 4),
     "gateway_fleet": (400, 2),
+    "fleet_placement": (400, 2),
 }
 assert len(_VARIANTS_TPU) == len(_VARIANTS_CPU) == _N_VARIANTS
 
@@ -573,7 +586,7 @@ def _run_variant(variant: str, platform: str, n: int, iters: int) -> dict:
     # is a kernel variant through tools/ingest_bench.py
     if variant.startswith(
         ("pipeline_e2e", "population_", "seizure_", "scheduler_",
-         "plan_service", "gateway_")
+         "plan_service", "gateway_", "fleet_")
     ):
         script = "pipeline_bench.py"
     elif variant.startswith("serve_"):
@@ -789,6 +802,10 @@ def _collect(platform: str) -> dict:
                 # sha parity vs the uninterrupted twin, the journal
                 # exactly-once audit, and the survivors' drain codes
                 "fleet",
+                # the device-aware placement line: makespan ratio vs
+                # the pool-disabled twin, per-plan sha parity, and
+                # the zero-double-held device-lease audit
+                "placement",
             ):
                 if extra_field in r:
                     variants[name][extra_field] = r[extra_field]

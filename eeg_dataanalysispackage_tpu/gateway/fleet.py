@@ -44,6 +44,8 @@ consensus protocol. A partitioned filesystem is outside the contract.
 
 from __future__ import annotations
 
+import hashlib
+import json
 import logging
 import os
 import threading
@@ -51,6 +53,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from ..scheduler import lease as lease_mod
+from ..scheduler import placement as placement_mod
 from .server import GatewayServer
 
 logger = logging.getLogger(__name__)
@@ -58,6 +61,15 @@ logger = logging.getLogger(__name__)
 #: how often a replica scans the shared journal for claimable records
 ENV_SCAN_INTERVAL = "EEG_TPU_FLEET_SCAN_INTERVAL_S"
 _DEFAULT_SCAN_INTERVAL_S = 0.25
+
+#: set to "0" to disable the per-replica scan jitter (lockstep scans,
+#: the pre-jitter behavior — useful when a test wants deterministic
+#: scan timing)
+ENV_SCAN_JITTER = "EEG_TPU_FLEET_SCAN_JITTER"
+
+#: jitter amplitude as a fraction of the scan interval: the offset is
+#: a deterministic per-replica value in [-25%, +25%]
+_SCAN_JITTER_AMPLITUDE = 0.25
 
 
 def scan_interval() -> float:
@@ -72,6 +84,32 @@ def scan_interval() -> float:
             ENV_SCAN_INTERVAL, value, _DEFAULT_SCAN_INTERVAL_S,
         )
         return _DEFAULT_SCAN_INTERVAL_S
+
+
+def jittered_scan_interval(replica_id: str, base: Optional[float] = None) -> float:
+    """The replica's effective scan interval: the configured base ±25%,
+    offset by a DETERMINISTIC function of the replica id.
+
+    N replicas configured with one interval otherwise scan the shared
+    journal in lockstep — every pass, every replica O_EXCL-races every
+    claimable record and N-1 of them lose (``lease.stats()``'s
+    ``claim_losses`` counts exactly these). A per-replica offset
+    de-phases the scans so most passes see a record either already
+    claimed (no race: the read path, not the create path) or not yet
+    scanned by peers. Deterministic — blake2b of the replica id, not
+    ``random`` — so a replica's cadence is stable across restarts and
+    reproducible in tests. ``EEG_TPU_FLEET_SCAN_JITTER=0`` disables.
+    """
+    if base is None:
+        base = scan_interval()
+    if os.environ.get(ENV_SCAN_JITTER, "").strip() == "0":
+        return base
+    digest = hashlib.blake2b(
+        replica_id.encode(), digest_size=8
+    ).digest()
+    unit = int.from_bytes(digest, "big") / float(2 ** 64)  # [0, 1)
+    factor = 1.0 + _SCAN_JITTER_AMPLITUDE * (2.0 * unit - 1.0)
+    return max(0.001, base * factor)
 
 
 class FleetReplica:
@@ -119,10 +157,22 @@ class FleetReplica:
         # the crash flight recorder (obs/report.py) reads the held
         # leases off this registration when a fleet plan dies
         lease_mod.set_active(self.leases)
-        self._scan_interval_s = (
-            scan_interval_s if scan_interval_s is not None
-            else scan_interval()
+        self._scan_interval_s = jittered_scan_interval(
+            self.replica_id,
+            base=scan_interval_s,
         )
+        # the shared device pool (scheduler/placement.py): None unless
+        # EEG_TPU_DEVICE_POOL opts in — placement default-off keeps
+        # the PR 17 fleet behavior byte-identical (a 1-CPU-device pool
+        # would serialize every plan behind one ordinal)
+        self.pool = placement_mod.DevicePool.from_env(self.leases)
+        self.executor.placement = self.pool
+        # pod routing: a won processes=N plan runs through the
+        # pod-assist coordinator (fresh subprocess per member — a
+        # live gateway's jax backend cannot re-bootstrap), peers
+        # enlist via the journaled assist records the scan loop reads
+        self.pod_assist = PodAssist(self)
+        self.executor.pod_assist = self.pod_assist
         self._heartbeat_interval_s = (
             heartbeat_interval_s if heartbeat_interval_s is not None
             else min(2.0, max(0.05, lease_mod.lease_timeout() / 4.0))
@@ -171,6 +221,9 @@ class FleetReplica:
             t.join(timeout=join_timeout_s)
         self._threads = []
         self.server.close(join_timeout_s=join_timeout_s)
+        self.pod_assist.close()
+        if self.pool is not None:
+            self.pool.release_all()
         self.leases.release_all()
 
     def drain(
@@ -284,6 +337,13 @@ class FleetReplica:
                     "fleet scan pass failed (%s: %s); continuing",
                     type(e).__name__, e,
                 )
+            try:
+                self.pod_assist.scan_assists()
+            except Exception as e:  # pragma: no cover - defensive
+                logger.error(
+                    "pod-assist scan pass failed (%s: %s); continuing",
+                    type(e).__name__, e,
+                )
 
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(self._heartbeat_interval_s):
@@ -303,14 +363,214 @@ class FleetReplica:
         directory for out-of-process observers)."""
         with self._claimed_lock:
             claimed = list(self.claimed)
-        return {
+        view = {
             "replica": self.replica_id,
             "draining": self.server.draining,
             "journal_dir": self.executor.journal.directory,
             "held": [
-                lease.plan_id for lease in self.leases.held_leases()
+                lease.plan_id
+                for lease in self.leases.held_plan_leases()
             ],
             "claimed": claimed,
             "leases": self.leases.scan(),
             "counters": lease_mod.stats(),
+            "devices_held": self.leases.held_device_ordinals(),
+            "scan_interval_s": round(self._scan_interval_s, 4),
         }
+        if self.pool is not None:
+            view["device_pool"] = self.pool.health()
+        return view
+
+
+class PodAssist:
+    """The fleet's pod routing, both halves.
+
+    **Coordinator half** (:meth:`run`, called from the executor's
+    worker thread for a won ``processes=N`` plan): publish a
+    ``podassist-<plan>.json`` record in the shared journal dir, spawn
+    our OWN process-0 member as a fresh ``parallel.pod_worker``
+    subprocess (a live gateway's jax backend cannot re-bootstrap;
+    this is why no member runs in-process), reap it, return its
+    statistics text. The record carries our pid + start token, so
+    peers can tell a live request from a SIGKILLed coordinator's
+    leftovers and clear the latter. Every failure path returns None —
+    the executor then runs the plan inline, where the builder's
+    existing preflight-timeout ladder degrades pod -> single-host:
+    degrade, never wedge.
+
+    **Peer half** (:meth:`scan_assists`, called from the fleet scan
+    loop): for each live assist record from ANOTHER replica, claim
+    per-rank ``assist:<plan>:<k>`` leases (the same O_EXCL protocol
+    as plans — each worker rank gets exactly one parent fleet-wide)
+    and spawn worker members. Children self-exit when this replica
+    dies (the pod_worker parent watchdog) and are killed past
+    ``EEG_TPU_ASSIST_MAX_S`` — a coordinator that vanished mid-pod
+    strands no rank forever.
+    """
+
+    def __init__(self, replica: "FleetReplica"):
+        self.replica = replica
+        self.journal = replica.executor.journal
+        self.leases = replica.leases
+        self._lock = threading.Lock()
+        #: lease-name -> (Popen, lease, spawn-monotonic)
+        self._children: Dict[str, Any] = {}
+        self.max_child_age_s = float(
+            os.environ.get("EEG_TPU_ASSIST_MAX_S") or 600.0
+        )
+        #: worker ranks this replica will parent at once — an idle
+        #: replica lends compute, a busy one stays a front door
+        self.worker_cap = int(
+            os.environ.get("EEG_TPU_ASSIST_WORKERS") or 2
+        )
+
+    # -- coordinator half ------------------------------------------------
+
+    def run(self, ticket) -> Optional[str]:
+        from .. import obs
+
+        plan = ticket.plan
+        processes = int(plan.pod.processes)
+        coordinator = plan.pod.coordinator
+        if coordinator is None:
+            from ..parallel import distributed
+
+            coordinator = f"127.0.0.1:{distributed.free_port_pair()}"
+        obs.metrics.count("fleet.pod_assist_requests")
+        token = lease_mod._pid_start_token(os.getpid()) or ""
+        self.journal.record_assist(
+            ticket.plan_id, coordinator, processes,
+            holder=self.replica.replica_id,
+            pid=os.getpid(), start_token=token,
+            query=plan.query,
+        )
+        from ..parallel import pod as pod_mod
+
+        child = None
+        try:
+            child = pod_mod.spawn_pod_member(
+                plan.query, coordinator, processes, process_id=0,
+            )
+            out, err = child.communicate(
+                timeout=self.max_child_age_s
+            )
+        except Exception as e:
+            logger.warning(
+                "pod-assist coordinator member for %s failed "
+                "(%s: %s); degrading to the inline ladder",
+                ticket.plan_id, type(e).__name__, e,
+            )
+            if child is not None and child.poll() is None:
+                child.kill()
+                child.communicate()
+            obs.metrics.count("fleet.pod_assist_degraded")
+            return None
+        finally:
+            self.journal.clear_assist(ticket.plan_id)
+        if child.returncode != 0:
+            logger.warning(
+                "pod-assist coordinator member for %s exited rc %d; "
+                "degrading to the inline ladder: %s",
+                ticket.plan_id, child.returncode, err[-1500:],
+            )
+            obs.metrics.count("fleet.pod_assist_degraded")
+            return None
+        try:
+            result = json.loads(out.strip().splitlines()[-1])
+            statistics = result["statistics"]
+        except Exception:
+            obs.metrics.count("fleet.pod_assist_degraded")
+            return None
+        obs.metrics.count("fleet.pod_assist_completed")
+        return statistics
+
+    # -- peer half -------------------------------------------------------
+
+    def scan_assists(self) -> List[str]:
+        """One pass: reap finished worker children, clear dead
+        coordinators' records, claim + spawn ranks for live ones.
+        Returns the lease names newly spawned this pass."""
+        from .. import obs
+
+        self._reap()
+        spawned: List[str] = []
+        for rec in self.journal.assist_entries():
+            plan_id = rec.get("plan_id")
+            if not plan_id:
+                continue
+            if rec.get("holder") == self.replica.replica_id:
+                continue  # our own request; rank 0 is our child
+            if lease_mod._holder_dead(
+                rec.get("pid"), rec.get("start_token") or ""
+            ):
+                # the SIGKILLed-coordinator path: the record must not
+                # outlive its writer, or every scan forever would try
+                # to staff a pod nobody coordinates
+                self.journal.clear_assist(plan_id)
+                obs.metrics.count("fleet.pod_assist_cleared")
+                continue
+            try:
+                processes = int(rec["processes"])
+                coordinator = rec["coordinator"]
+                query = rec["query"]
+            except (KeyError, TypeError, ValueError):
+                continue
+            for rank in range(1, processes):
+                name = f"assist:{plan_id}:{rank}"
+                with self._lock:
+                    if len(self._children) >= self.worker_cap:
+                        return spawned
+                    if name in self._children:
+                        continue
+                lease = self.leases.try_claim(name)
+                if lease is None or lease is lease_mod.FOREIGN_HELD:
+                    continue
+                try:
+                    from ..parallel import pod as pod_mod
+
+                    child = pod_mod.spawn_pod_member(
+                        query, coordinator, processes,
+                        process_id=rank,
+                    )
+                except Exception as e:
+                    logger.warning(
+                        "pod-assist worker spawn for %s failed "
+                        "(%s: %s)", name, type(e).__name__, e,
+                    )
+                    self.leases.release(name)
+                    continue
+                with self._lock:
+                    self._children[name] = (
+                        child, lease, time.monotonic()
+                    )
+                obs.metrics.count("fleet.pod_assist_workers")
+                spawned.append(name)
+        return spawned
+
+    def _reap(self) -> None:
+        with self._lock:
+            items = list(self._children.items())
+        for name, (child, lease, since) in items:
+            if child.poll() is None:
+                if time.monotonic() - since > self.max_child_age_s:
+                    # a rank stuck past the budget (its pod died
+                    # under it mid-collective): kill, don't strand
+                    child.kill()
+                    child.communicate()
+                else:
+                    continue
+            else:
+                child.communicate()  # drain pipes; output discarded
+            lease.release()
+            with self._lock:
+                self._children.pop(name, None)
+
+    def close(self) -> None:
+        with self._lock:
+            items = list(self._children.items())
+            self._children = {}
+        for name, (child, lease, _since) in items:
+            if child.poll() is None:
+                child.kill()
+            child.communicate()
+            lease.release()

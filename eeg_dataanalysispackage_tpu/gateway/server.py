@@ -645,9 +645,17 @@ class GatewayServer:
             payload["fleet"] = {
                 "replica": self.replica_id,
                 "draining": self.draining,
-                "held_leases": len(self.executor.leases.held_leases()),
+                "held_leases": len(
+                    self.executor.leases.held_plan_leases()
+                ),
+                "devices_held": (
+                    self.executor.leases.held_device_ordinals()
+                ),
                 **lease_mod.stats(),
             }
+            placement = getattr(self.executor, "placement", None)
+            if placement is not None:
+                payload["fleet"]["device_pool"] = placement.health()
         return 200, payload
 
     def metrics_payload(self) -> Tuple[int, str]:
@@ -681,9 +689,19 @@ class GatewayServer:
             for key, value in lease_mod.stats().items():
                 counters[f"lease.{key}"] = value
             gauges["fleet.held_leases"] = len(
-                self.executor.leases.held_leases()
+                self.executor.leases.held_plan_leases()
+            )
+            gauges["fleet.devices_held"] = len(
+                self.executor.leases.held_device_ordinals()
             )
             gauges["fleet.draining"] = int(self.draining)
+            placement = getattr(self.executor, "placement", None)
+            if placement is not None:
+                health = placement.health()
+                gauges["fleet.devices_free"] = health["free"]
+                gauges["fleet.plans_waiting_placement"] = (
+                    health["waiting"]
+                )
         text = metrics_export.render(
             counters=counters,
             histograms=histograms,
@@ -735,6 +753,29 @@ class GatewayServer:
             reasons.append("executor is closed")
         elif not self.executor._started:
             reasons.append("executor workers not started")
+        placement = getattr(self.executor, "placement", None)
+        if placement is not None:
+            # device-pool health: plans are waiting on devices, the
+            # fleet has zero claimable ordinals, and THIS replica
+            # holds none of the held ones — new plans routed here
+            # would only deepen the wait; a load balancer should
+            # prefer the replicas actually holding devices
+            try:
+                health = placement.health()
+            except Exception:  # pragma: no cover - observer only
+                health = None
+            if (
+                health is not None
+                and health["waiting"] > 0
+                and health["free"] == 0
+                and not health["held"]
+            ):
+                reasons.append(
+                    f"device pool exhausted: 0 of {health['size']} "
+                    f"ordinals claimable, none held here, "
+                    f"{health['waiting']} plan(s) waiting (oldest: "
+                    f"{health['oldest_waiting']})"
+                )
         payload = {
             "ready": not reasons,
             "replica": self.replica_id,

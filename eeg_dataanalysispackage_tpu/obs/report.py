@@ -412,6 +412,10 @@ class RunTelemetry:
             return {
                 "replica": self.fleet.get("replica"),
                 "takeover": bool(self.fleet.get("takeover")),
+                # leased device ordinals, when the fleet's device
+                # pool placed this plan (scheduler/placement.py):
+                # a crash artifact names WHICH chips the mesh held
+                "devices": self.fleet.get("devices"),
                 "held_leases": lease_mod.active_held(),
                 "lease_counters": lease_mod.stats(),
             }

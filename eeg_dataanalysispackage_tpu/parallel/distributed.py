@@ -236,6 +236,30 @@ def _preflight_rendezvous(
     )
 
 
+def free_port_pair(attempts: int = 16) -> int:
+    """A loopback port whose NEIGHBOR is also bindable — the preflight
+    rendezvous listens on coordinator port + 1, so a coordinator
+    address is only usable when both are free. (Still a close-then-use
+    window, but probing the pair removes the common collision: an
+    ephemeral port whose neighbor is a listening service.) The fleet's
+    pod-assist coordinator picks its ``coordinator=`` address here."""
+    for _ in range(attempts):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        try:
+            s2 = socket.socket()
+            try:
+                s2.bind(("", port + 1))
+            except OSError:
+                continue
+            s2.close()
+            return port
+        finally:
+            s.close()
+    raise RuntimeError("no free coordinator port pair found")
+
+
 def initialize(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,

@@ -32,16 +32,32 @@ def make_mesh(
     n_devices: Optional[int] = None,
     axes: Tuple[str, ...] = (DATA_AXIS,),
     shape: Optional[Sequence[int]] = None,
+    devices: Optional[Sequence] = None,
 ) -> Mesh:
     """Build a mesh over the first ``n_devices`` available devices.
 
     1-D data mesh by default; pass ``axes``/``shape`` for 2-D layouts
     (e.g. ``axes=('data','time'), shape=(2,4)``).
+
+    ``devices`` — an explicit device subset (e.g. the ordinals a
+    fleet device lease granted) instead of the ``[:n]`` prefix slice;
+    ``n_devices`` must match its length when both are given.
     """
-    devices = jax.devices()
-    n = n_devices or len(devices)
-    if n > len(devices):
-        raise ValueError(f"requested {n} devices, only {len(devices)} present")
+    if devices is None:
+        devices = jax.devices()
+        n = n_devices or len(devices)
+        if n > len(devices):
+            raise ValueError(
+                f"requested {n} devices, only {len(devices)} present"
+            )
+    else:
+        devices = list(devices)
+        n = n_devices or len(devices)
+        if n != len(devices):
+            raise ValueError(
+                f"requested {n} devices but an explicit subset of "
+                f"{len(devices)} was given; they must agree"
+            )
     devs = np.array(devices[:n])
     if shape is None:
         shape = (n,) if len(axes) == 1 else None

@@ -50,6 +50,71 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 
+def spawn_pod_member(
+    query: str,
+    coordinator: str,
+    num_processes: int,
+    process_id: int,
+    parent_pid: Optional[int] = None,
+    timeout_s: Optional[str] = None,
+):
+    """One fresh ``parallel.pod_worker`` subprocess for rank
+    ``process_id`` of a ``num_processes`` pod at ``coordinator`` —
+    the fleet's pod-assist spawn point (both the coordinator's own
+    process 0 and every enlisted peer's worker ranks go through
+    here, so their environments cannot diverge).
+
+    The pod knobs ride the QUERY, not env twins — the spawner's own
+    ``JAX_*`` pod env (if any) is popped so the child's membership is
+    exactly what the query says. ``parent_pid`` defaults to the
+    calling process: the child self-exits when its spawner dies,
+    which bounds a SIGKILLed coordinator to a degraded pod instead
+    of orphaned ranks. Returns the ``subprocess.Popen`` (stdout
+    piped; the last line is the worker's JSON result).
+    """
+    import os
+    import subprocess
+    import sys as _sys
+
+    base = query
+    if "process_id=" in base:
+        raise ValueError(
+            "query already carries process_id; pod-assist must not "
+            "re-route an explicitly placed member"
+        )
+    member_query = base
+    if "coordinator=" not in base:
+        member_query += f"&coordinator={coordinator}"
+    if "processes=" not in base:
+        member_query += f"&processes={num_processes}"
+    member_query += f"&process_id={process_id}"
+    env = dict(os.environ)
+    for var in (
+        "JAX_NUM_PROCESSES", "JAX_COORDINATOR",
+        "JAX_COORDINATOR_ADDRESS", "JAX_PROCESS_ID",
+    ):
+        env.pop(var, None)
+    if timeout_s is not None:
+        # distributed.ENV_BOOTSTRAP_TIMEOUT, spelled out: importing
+        # parallel.distributed pulls jax into the spawner, and this
+        # helper must stay importable from jax-free tooling
+        env["EEG_TPU_POD_TIMEOUT_S"] = str(timeout_s)
+    if parent_pid is None:
+        parent_pid = os.getpid()
+    return subprocess.Popen(
+        [
+            _sys.executable, "-m",
+            "eeg_dataanalysispackage_tpu.parallel.pod_worker",
+            f"--query={member_query}",
+            f"--parent-pid={parent_pid}",
+        ],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+
+
 def partition(n_items: int, num_processes: int) -> List[Tuple[int, int]]:
     """Deterministic contiguous partition of ``range(n_items)`` into
     ``num_processes`` blocks: ``[start, stop)`` per process.

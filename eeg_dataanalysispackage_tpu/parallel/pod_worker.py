@@ -1,0 +1,86 @@
+"""One pod-member process for fleet pod-assist (``python -m
+eeg_dataanalysispackage_tpu.parallel.pod_worker --query=...``).
+
+The fleet's pod routing (gateway/fleet.py) cannot run
+``jax.distributed.initialize`` inside a gateway replica — the
+replica's JAX backend initialized long ago, and jax forbids a
+bootstrap after that point — so every pod member, INCLUDING the
+coordinator's own process 0, is a fresh subprocess running this
+module. The query alone decides pod membership (``processes=``/
+``coordinator=``/``process_id=`` ride in it); the builder's existing
+``_resolve_pod`` ladder does the bootstrap, which is what makes the
+degradation story free: a member whose preflight cannot assemble the
+pod drops to the single-host rung and still produces the
+byte-identical statistics (the PR 14 parity pin).
+
+The last stdout line is one JSON object ``{"sha", "statistics"}`` —
+the coordinator reaps its process-0 child for the statistics it
+journals; worker ranks' outputs are discarded.
+
+``--parent-pid=N`` arms a watchdog: when the spawning process dies
+(SIGKILL included — this process is reparented and ``os.getppid()``
+changes), the member self-exits instead of orphan-running a
+multi-minute plan nobody will read. This is what bounds the blast
+radius of a SIGKILLed coordinator to "the pod degrades", never "CPUs
+burn on abandoned ranks".
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+import threading
+import time
+
+#: exit code for a watchdog self-exit, distinct from plan failures so
+#: a reaper can tell "orphaned" from "broken"
+ORPHANED_EXIT = 70
+
+
+def _watch_parent(parent_pid: int, poll_s: float = 0.5) -> None:
+    def _loop():
+        while True:
+            if os.getppid() != parent_pid:
+                os._exit(ORPHANED_EXIT)
+            time.sleep(poll_s)
+
+    threading.Thread(
+        target=_loop, name="pod-worker-parent-watchdog", daemon=True
+    ).start()
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    query = None
+    parent_pid = None
+    for arg in argv:
+        if arg.startswith("--query="):
+            query = arg.split("=", 1)[1]
+        elif arg.startswith("--parent-pid="):
+            parent_pid = int(arg.split("=", 1)[1])
+        else:
+            raise SystemExit(f"unknown argument {arg!r}")
+    if not query:
+        raise SystemExit("--query= is required")
+    if parent_pid is not None:
+        _watch_parent(parent_pid)
+
+    from ..pipeline.builder import PipelineBuilder
+    from ..pipeline.plan import ExecutionPlan
+    from ..scheduler import runtime
+
+    plan = ExecutionPlan.parse(query)
+    builder = PipelineBuilder(plan.query)
+    statistics = runtime.execute_plan(plan, builder)
+    text = str(statistics)
+    print(json.dumps({
+        "sha": hashlib.sha256(text.encode()).hexdigest(),
+        "statistics": text,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1624,15 +1624,34 @@ class PipelineBuilder:
         }
         if self.telemetry is not None:
             self.telemetry.mesh = self.mesh_resolved
+        leased = getattr(self, "placement_devices", None)
+        if leased:
+            self.mesh_resolved["leased"] = list(leased)
         try:
             import jax
 
-            n = requested["devices"] or len(jax.devices())
-            mesh = pmesh.make_mesh(
-                n,
-                axes=tuple(axes),
-                shape=tuple(sizes) if sizes else None,
-            )
+            if leased:
+                # the fleet's device pool granted these ordinals: the
+                # mesh is built from exactly them, not a [:n] prefix
+                # slice — this is what keeps concurrent plans on one
+                # host on DISJOINT chips. An out-of-range ordinal or
+                # an unbuildable subset degrades below, identically
+                # to any other availability failure.
+                host = jax.devices()
+                subset = [host[i] for i in leased]
+                mesh = pmesh.make_mesh(
+                    len(subset),
+                    axes=tuple(axes),
+                    shape=tuple(sizes) if sizes else None,
+                    devices=subset,
+                )
+            else:
+                n = requested["devices"] or len(jax.devices())
+                mesh = pmesh.make_mesh(
+                    n,
+                    axes=tuple(axes),
+                    shape=tuple(sizes) if sizes else None,
+                )
         except Exception as e:
             # the ladder's top rung: mesh unavailable -> single-device
             evidence = f"{type(e).__name__}: {e}"

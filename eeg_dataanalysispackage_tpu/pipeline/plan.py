@@ -292,6 +292,78 @@ class ExecutionPlan:
             h.update(repr((name, fields[name])).encode())
         return h.hexdigest()
 
+    # -- placement -------------------------------------------------------
+
+    def device_footprint(self) -> Dict[str, Any]:
+        """The plan's static resource footprint for the fleet device
+        pool (scheduler/placement.py): ``{"devices", "hosts",
+        "memory_class"}``, derived purely from the already-parsed
+        ``devices=``/``mesh_axes=``/``processes=``/population knobs.
+
+        - ``devices`` — exclusive device ordinals the plan wants on
+          its host. ``0`` means "every device present" (an axes-only
+          mesh request sizes itself to the host at execution time);
+          any positive count is the gang size the scheduler must
+          satisfy all-or-nothing. A plan with no mesh request is one
+          ordinal: a capacity token, since the single-device path runs
+          on the default device.
+        - ``hosts`` — ``processes=`` for pod plans, else 1. The fleet
+          treats hosts > 1 as pod-assist work (peer replicas enlist as
+          worker processes), not as extra local ordinals.
+        - ``memory_class`` — ``"serve" | "light" | "standard" |
+          "heavy"``: a coarse working-set class for operators and the
+          backfill view. Heavy = a multi-device gang (4+, or
+          whole-host), a pod, or a 32+-member population stack;
+          standard = any smaller population/sweep; light = a plain
+          single-model batch run; serve plans are their own class
+          (resident service, admission-controlled elsewhere).
+
+        Pure and side-effect-free: no environment, no backend, no
+        ``jax`` import — and derived, so it is canonical-key-neutral
+        by construction (two queries with one canonical key have one
+        footprint).
+        """
+        devices = 1
+        if self.mesh is not None:
+            if self.mesh.shape:
+                product = 1
+                for extent in self.mesh.shape:
+                    product *= int(extent)
+                devices = product
+            elif self.mesh.devices:
+                devices = int(self.mesh.devices)
+            else:
+                devices = 0  # axes-only: the whole host, sized later
+        if devices < 0:
+            raise PlanValidationError(
+                f"mesh request resolves to a negative device count "
+                f"({devices}); the parse grammar should have refused it"
+            )
+        hosts = 1
+        if self.pod is not None and self.pod.processes:
+            hosts = max(1, int(self.pod.processes))
+        members = 1
+        if self.population_active:
+            members = (
+                self.population.cv
+                * self.population.seeds
+                * self.population.grid_points()
+                * max(1, len(self.population.fe_configs))
+            )
+        if self.serve:
+            memory_class = "serve"
+        elif devices == 0 or devices >= 4 or hosts > 1 or members >= 32:
+            memory_class = "heavy"
+        elif members > 1:
+            memory_class = "standard"
+        else:
+            memory_class = "light"
+        return {
+            "devices": devices,
+            "hosts": hosts,
+            "memory_class": memory_class,
+        }
+
     @classmethod
     def parse(cls, query: str) -> "ExecutionPlan":
         """Query string -> validated plan; raises

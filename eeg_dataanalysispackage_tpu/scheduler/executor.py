@@ -62,6 +62,13 @@ from . import runtime
 
 logger = logging.getLogger(__name__)
 
+#: sentinel returned by :meth:`PlanExecutor._try_place` when a plan's
+#: footprint cannot currently be satisfied: the worker readmits the
+#: ticket to the queue TAIL (smaller plans backfill past it) and the
+#: journal record stays untouched.  Distinct from ``None``, which
+#: means "run unplaced" (exempt, unsatisfiable, or pool degraded).
+_PLACEMENT_WAIT = object()
+
 
 class PlanShedError(ShedError):
     """Admission control refused the plan (queue full); the message
@@ -145,7 +152,8 @@ class _PlanTicket:
     __slots__ = ("plan", "plan_id", "deadline", "future",
                  "submitted_at", "attempts", "history", "fault_plan",
                  "report_dir", "recovered", "state",
-                 "idempotency_key", "gateway", "fleet", "trace_id")
+                 "idempotency_key", "gateway", "fleet", "trace_id",
+                 "footprint")
 
     def __init__(self, plan, plan_id, deadline, fault_plan, report_dir,
                  recovered=False, idempotency_key=None, gateway=None,
@@ -175,6 +183,9 @@ class _PlanTicket:
         #: plan meta so a takeover CONTINUES the trace); None for
         #: untraced submissions
         self.trace_id = trace_id
+        #: cached ExecutionPlan.device_footprint() — computed once by
+        #: the first placement attempt, reused every backfill retry
+        self.footprint = None
 
     def batch_key(self):
         # plans never coalesce: every ticket is its own micro-batch
@@ -304,6 +315,29 @@ class PlanExecutor:
         #: replica is executing) and released when the plan's terminal
         #: record lands. None (the default) = no fleet, no leases.
         self.leases: Optional[lease_mod.LeaseDir] = None
+        #: the fleet's shared device pool (scheduler/placement.py
+        #: DevicePool), attached by gateway/fleet.py when
+        #: EEG_TPU_DEVICE_POOL enables placement. With it set, a
+        #: popped plan's footprint is lease-claimed all-or-nothing
+        #: before execution; an unsatisfiable footprint goes back to
+        #: the queue's TAIL (journal state unchanged) so smaller plans
+        #: backfill past it, bounded by the pool's age-based
+        #: no-starvation promotion. None = unplaced execution, the
+        #: pre-placement behavior byte-unchanged.
+        self.placement = None
+        #: pod-assist runner (gateway/fleet.py PodAssist): executes a
+        #: ``processes>1`` plan by driving the pod bootstrap as
+        #: coordinator with peer replicas enlisted as workers. None =
+        #: pod plans run in-process (the builder's own pod ladder).
+        self.pod_assist = None
+        #: seconds a worker pauses after parking an unplaceable plan
+        #: back on the queue — bounds the claim-file churn of a lone
+        #: waiting gang without delaying backfill noticeably
+        self.placement_backoff_s = 0.02
+        #: set by drain_queued(): a worker holding a placement-WAITING
+        #: ticket (popped, so queue.remove missed it) hands it back
+        #: instead of re-queueing into a draining executor
+        self._drain_requested = False
         self.report_root = report_root
         self.max_attempts = int(max_attempts)
         self.retry_backoff_s = float(retry_backoff_s)
@@ -1097,6 +1131,10 @@ class PlanExecutor:
         local handle fails with :class:`ServiceClosedError`. Running
         plans are untouched — the drain finishes them. Returns the
         released plan ids."""
+        # placement-waiting tickets cycle between the queue and a
+        # worker's hands; the flag catches the in-hand ones the
+        # queue.remove pass below cannot see
+        self._drain_requested = True
         with self._submit_lock:
             queued = [
                 t for t in self._tickets.values()
@@ -1132,12 +1170,99 @@ class PlanExecutor:
             )
             if not batch:
                 continue
-            self._execute_ticket(batch[0])
+            ticket = batch[0]
+            grant = None
+            if (
+                self.placement is not None
+                and isinstance(ticket, _PlanTicket)
+                and ticket.state == "queued"
+            ):
+                placed = self._try_place(ticket)
+                if placed is _PLACEMENT_WAIT:
+                    if self._drain_requested:
+                        # popped tickets are invisible to
+                        # drain_queued's queue.remove pass — hand
+                        # this one back here, identically
+                        self._drain_waiting_ticket(ticket)
+                        continue
+                    if (
+                        ticket.deadline is not None
+                        and ticket.deadline.expired
+                    ):
+                        # die on time, with the deadline's own
+                        # evidence path — never wait past the budget
+                        self._execute_ticket(ticket)
+                        continue
+                    # back to the TAIL: smaller plans backfill past
+                    # this footprint while it waits (journal state
+                    # unchanged — the record stays 'submitted' and
+                    # the plan lease stays held)
+                    self.queue.readmit(ticket)
+                    self._stop.wait(self.placement_backoff_s)
+                    continue
+                grant = placed
+            try:
+                self._execute_ticket(ticket, grant=grant)
+            finally:
+                if grant is not None:
+                    grant.release()
 
-    def _execute_ticket(self, ticket: _PlanTicket) -> None:
+    def _try_place(self, ticket: "_PlanTicket"):
+        """One placement attempt: a DeviceGrant (run on these leased
+        ordinals), None (run unplaced — exempt/unsatisfiable/pool
+        degraded: the builder's availability ladder governs), or
+        :data:`_PLACEMENT_WAIT` (requeue; backfill may pass)."""
+        from . import placement as placement_mod
+
+        try:
+            if ticket.footprint is None:
+                ticket.footprint = ticket.plan.device_footprint()
+            placed = self.placement.admit(
+                ticket.plan_id, ticket.footprint
+            )
+        except Exception as e:
+            # placement must never kill a plan it exists to schedule:
+            # degrade to unplaced execution, with the evidence
+            obs.metrics.count("placement.errors")
+            logger.warning(
+                "placement degraded for %s (%s: %s); running unplaced",
+                ticket.plan_id, type(e).__name__, e,
+            )
+            return None
+        if placed is placement_mod.UNPLACED:
+            return None
+        if placed is None:
+            return _PLACEMENT_WAIT
+        return placed
+
+    def _drain_waiting_ticket(self, ticket: "_PlanTicket") -> None:
+        """drain_queued's hand-back, for a placement-waiting ticket a
+        worker had already popped: journal record stays 'submitted',
+        the plan lease is released for an immediate peer claim, the
+        local handle fails."""
+        ticket.state = "failed"
+        with self._submit_lock:
+            self._tickets.pop(ticket.plan_id, None)
+        if self.placement is not None:
+            self.placement.clear_waiting(ticket.plan_id)
+        self._release_lease(ticket.plan_id)
+        obs.metrics.count("scheduler.drain_released")
+        events.event("scheduler.drain_released", plan=ticket.plan_id)
+        ticket.future.fail(ServiceClosedError(
+            f"plan {ticket.plan_id} released for peer takeover "
+            f"during drain; its journal record stays 'submitted'"
+        ))
+
+    def _execute_ticket(self, ticket: _PlanTicket, grant=None) -> None:
         from ..pipeline.builder import PipelineBuilder
 
         ticket.state = "running"
+        if grant is not None:
+            # the granted ordinals ride the fleet attribution into
+            # run_report.json and the journal meta: an artifact names
+            # WHICH leased devices built its mesh
+            ticket.fleet = dict(ticket.fleet or {})
+            ticket.fleet["devices"] = list(grant.ordinals)
         while True:
             if ticket.deadline is not None and ticket.deadline.expired:
                 # attempts == 0: the budget died in the admission
@@ -1176,17 +1301,37 @@ class PlanExecutor:
             extra = {"fleet": ticket.fleet} if ticket.fleet else {}
             if ticket.trace_id:
                 extra["trace_id"] = ticket.trace_id
+            if grant is not None:
+                extra["placement"] = grant.ordinals
+            # a fleet-won `processes=N` plan (no explicit process_id:
+            # the client asked for a pod, not a pod MEMBER) routes
+            # through the pod-assist coordinator when one is attached;
+            # None from it means "could not assemble a pod" and the
+            # plan falls through to the inline ladder, which is
+            # exactly the degrade-don't-wedge path
+            assist = None
+            if (
+                self.pod_assist is not None
+                and ticket.plan.pod is not None
+                and (ticket.plan.pod.processes or 0) > 1
+                and ticket.plan.pod.process_id is None
+            ):
+                assist = self.pod_assist
             try:
                 with deadline_mod.deadline_scope(ticket.deadline):
-                    statistics = runtime.execute_plan(
-                        ticket.plan,
-                        builder,
-                        plan_id=ticket.plan_id,
-                        fault_plan=ticket.fault_plan,
-                        default_report_dir=ticket.report_dir,
-                        gateway=ticket.gateway,
-                        **extra,
-                    )
+                    statistics = None
+                    if assist is not None:
+                        statistics = assist.run(ticket)
+                    if statistics is None:
+                        statistics = runtime.execute_plan(
+                            ticket.plan,
+                            builder,
+                            plan_id=ticket.plan_id,
+                            fault_plan=ticket.fault_plan,
+                            default_report_dir=ticket.report_dir,
+                            gateway=ticket.gateway,
+                            **extra,
+                        )
             except Exception as e:
                 ticket.attempts += 1
                 ticket.history.append(

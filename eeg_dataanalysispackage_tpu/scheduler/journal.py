@@ -179,6 +179,90 @@ class PlanJournal:
             "meta": meta or {},
         })
 
+    # -- pod-assist records ----------------------------------------------
+
+    ASSIST_SCHEMA = "eeg-tpu-pod-assist/v1"
+
+    def _assist_path(self, plan_id: str) -> str:
+        return os.path.join(self.directory, f"podassist-{plan_id}.json")
+
+    def record_assist(
+        self, plan_id: str,
+        coordinator: str,
+        processes: int,
+        holder: str,
+        pid: int,
+        start_token: str,
+        query: str,
+    ) -> bool:
+        """Publish a pod-assist request: the coordinator replica has
+        won a ``processes=N`` plan and needs N-1 worker processes at
+        ``coordinator``. Lives beside the plan records in the shared
+        journal dir (the ``podassist-`` prefix keeps it invisible to
+        :meth:`entries`' ``plan-*.json`` scan); peers claim per-slot
+        ``assist:`` leases before spawning so each worker rank has
+        exactly one parent. The holder's pid+start_token ride along so
+        a peer can tell a live request from one whose coordinator was
+        SIGKILLed (and clear the latter)."""
+        from ..checkpoint.manager import atomic_write_text
+
+        payload = {
+            "schema": self.ASSIST_SCHEMA,
+            "plan_id": plan_id,
+            "coordinator": coordinator,
+            "processes": int(processes),
+            "holder": holder,
+            "pid": int(pid),
+            "start_token": start_token,
+            "query": query,
+            "since": time.time(),
+        }
+        try:
+            atomic_write_text(
+                self._assist_path(plan_id),
+                json.dumps(payload, indent=2, sort_keys=True) + "\n",
+            )
+            return True
+        except Exception as e:
+            logger.warning(
+                "pod-assist record write failed for %s (%s: %s); "
+                "the pod degrades to the inline ladder",
+                plan_id, type(e).__name__, e,
+            )
+            return False
+
+    def assist_entries(self) -> List[Dict[str, Any]]:
+        """All live pod-assist requests, oldest first. Unparseable
+        records are skipped (not quarantined — an assist record is
+        advisory: worst case the pod degrades, never a lost plan)."""
+        try:
+            names = sorted(os.listdir(self.directory))
+        except FileNotFoundError:
+            return []
+        out: List[Dict[str, Any]] = []
+        for name in names:
+            if not (
+                name.startswith("podassist-") and name.endswith(".json")
+            ):
+                continue
+            try:
+                with open(os.path.join(self.directory, name)) as f:
+                    rec = json.load(f)
+                rec["plan_id"]  # noqa: B018 — shape check
+            except Exception:
+                continue
+            out.append(rec)
+        out.sort(key=lambda r: r.get("since", 0.0))
+        return out
+
+    def clear_assist(self, plan_id: str) -> None:
+        """Withdraw a pod-assist request (pod assembled, degraded, or
+        its coordinator is provably dead)."""
+        try:
+            os.unlink(self._assist_path(plan_id))
+        except OSError:
+            pass
+
     # -- reads -----------------------------------------------------------
 
     def _quarantine(self, path: str, error: Exception) -> None:

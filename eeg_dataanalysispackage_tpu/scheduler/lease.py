@@ -49,6 +49,14 @@ The same file primitive also serializes idempotency-key registration
 across the fleet (``key-<hash>.lease`` via :func:`key_claim_id`): two
 replicas receiving the same previously-unseen key concurrently would
 otherwise each mint their own plan for it (scheduler/executor.py).
+Two more claim families ride the identical protocol (same break-only-
+provably-dead and atomic-break-guard discipline, same heartbeat
+thread): **device leases** (``device-<ordinal>.lease`` via the
+``device:<ordinal>`` claim name — scheduler/placement.py's shared
+device pool, one file per claimable ordinal) and **pod-assist worker
+slots** (``assist-<plan>-<k>.lease`` via ``assist:<plan>:<k>`` — a
+peer replica's claim on worker slot ``k`` of a coordinator's pod,
+gateway/fleet.py).
 
 Chaos points: ``fleet.lease`` fires inside one claim attempt and
 ``fleet.heartbeat`` inside one heartbeat touch (both injected as
@@ -88,6 +96,13 @@ _breaks = 0
 _heartbeats = 0
 _heartbeat_failures = 0
 _claim_failures = 0
+#: O_EXCL claim attempts LOST to a live foreign holder (every
+#: FOREIGN_HELD return) — the lockstep-scan contention signal the
+#: per-replica scan jitter (gateway/fleet.py) exists to reduce
+_claim_losses = 0
+_device_claims = 0
+_device_claim_losses = 0
+_device_releases = 0
 
 
 def lease_timeout() -> float:
@@ -115,6 +130,10 @@ def stats() -> Dict[str, int]:
             "heartbeats": _heartbeats,
             "heartbeat_failures": _heartbeat_failures,
             "claim_failures": _claim_failures,
+            "claim_losses": _claim_losses,
+            "device_claims": _device_claims,
+            "device_claim_losses": _device_claim_losses,
+            "device_releases": _device_releases,
         }
 
 
@@ -122,9 +141,13 @@ def reset_stats() -> None:
     """Zero the counters (test/bench isolation)."""
     global _claims, _takeovers, _breaks
     global _heartbeats, _heartbeat_failures, _claim_failures
+    global _claim_losses, _device_claims, _device_claim_losses
+    global _device_releases
     with _lock:
         _claims = _takeovers = _breaks = 0
         _heartbeats = _heartbeat_failures = _claim_failures = 0
+        _claim_losses = _device_claims = 0
+        _device_claim_losses = _device_releases = 0
 
 
 #: the replica's live LeaseDir, registered by gateway/fleet.py so the
@@ -154,6 +177,8 @@ def _count(name: str) -> None:
 
     global _claims, _takeovers, _breaks
     global _heartbeats, _heartbeat_failures, _claim_failures
+    global _claim_losses, _device_claims, _device_claim_losses
+    global _device_releases
     with _lock:
         if name == "claims":
             _claims += 1
@@ -167,6 +192,14 @@ def _count(name: str) -> None:
             _heartbeat_failures += 1
         elif name == "claim_failures":
             _claim_failures += 1
+        elif name == "claim_losses":
+            _claim_losses += 1
+        elif name == "device_claims":
+            _device_claims += 1
+        elif name == "device_claim_losses":
+            _device_claim_losses += 1
+        elif name == "device_releases":
+            _device_releases += 1
     obs.metrics.count(f"fleet.lease_{name}")
 
 
@@ -289,6 +322,16 @@ class LeaseDir:
             return os.path.join(
                 self.directory, f"key-{name[len('key:'):]}.lease"
             )
+        if name.startswith("device:"):
+            # a device-pool ordinal claim (scheduler/placement.py)
+            return os.path.join(
+                self.directory, f"device-{name[len('device:'):]}.lease"
+            )
+        if name.startswith("assist:"):
+            # a pod-assist worker-slot claim (gateway/fleet.py):
+            # assist:<plan_id>:<slot> -> assist-<plan_id>-<slot>.lease
+            stem = name[len("assist:"):].replace(":", "-")
+            return os.path.join(self.directory, f"assist-{stem}.lease")
         return os.path.join(self.directory, f"plan-{name}.lease")
 
     # -- claiming --------------------------------------------------------
@@ -460,7 +503,16 @@ class LeaseDir:
         broken first — only past :func:`lease_timeout` AND only when
         the recorded holder is provably dead, atomically
         (:meth:`_break_stale`), so racing breakers never produce two
-        holders."""
+        holders.
+
+        Every FOREIGN_HELD return is additionally counted as a
+        **claim loss** (``claim_losses``, or ``device_claim_losses``
+        for ``device:`` claims): an O_EXCL attempt a peer won. The
+        per-replica scan jitter (gateway/fleet.py) exists to shrink
+        this number — N replicas scanning in lockstep all race the
+        same fresh record and N-1 lose every round."""
+        device = plan_id.startswith("device:")
+        loss = "device_claim_losses" if device else "claim_losses"
         path = self._path(plan_id)
         with self._held_lock:
             held = self._held.get(plan_id)
@@ -490,19 +542,22 @@ class LeaseDir:
                 else:
                     # a racing breaker owns the takeover (or the
                     # holder turned out live under the guard)
+                    _count(loss)
                     return FOREIGN_HELD
             else:
+                _count(loss)
                 return FOREIGN_HELD
         if created is not True:
             if created is False:
+                _count(loss)
                 return FOREIGN_HELD
             _count("claim_failures")
             return None
         lease = PlanLease(plan_id, path, self.holder)
         with self._held_lock:
             self._held[plan_id] = lease
-        _count("claims")
-        if takeover:
+        _count("device_claims" if device else "claims")
+        if takeover and not device:
             _count("takeovers")
         return lease
 
@@ -516,6 +571,26 @@ class LeaseDir:
     def held_leases(self) -> List[PlanLease]:
         with self._held_lock:
             return [l for l in self._held.values() if not l.released]
+
+    def held_plan_leases(self) -> List[PlanLease]:
+        """Held PLAN leases only — the gateway's ``fleet.held_leases``
+        gauge keeps its pre-placement meaning (plans this replica is
+        executing), with device/assist/key claims filtered out."""
+        return [
+            l for l in self.held_leases() if ":" not in l.plan_id
+        ]
+
+    def held_device_ordinals(self) -> List[int]:
+        """Device-pool ordinals this replica holds right now (the
+        ``fleet.devices_held`` gauge)."""
+        out = []
+        for l in self.held_leases():
+            if l.plan_id.startswith("device:"):
+                try:
+                    out.append(int(l.plan_id[len("device:"):]))
+                except ValueError:
+                    continue
+        return sorted(out)
 
     def heartbeat_all(self) -> int:
         """One beat across every held lease; returns beats landed."""

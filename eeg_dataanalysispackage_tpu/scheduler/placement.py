@@ -1,0 +1,451 @@
+"""Device-aware fleet placement: the shared device pool.
+
+PR 17's replica fleet made N gateways share one journal, but every
+replica still executed on whatever devices its process happened to
+see — two replicas running two 4-device plans on one 8-device host
+silently time-share the same chips. This module turns the host's
+ordinals into a claimable pool using the exact lease protocol plans
+already ride (scheduler/lease.py): one ``device-<ordinal>.lease``
+file per ordinal beside the journal, O_CREAT|O_EXCL creation as the
+claim, mtime heartbeats from the holder's beat thread, break only the
+provably dead, break atomically. A replica that wants to run a plan
+claims the plan's whole footprint (ExecutionPlan.device_footprint())
+**all-or-nothing** — partial holds are released immediately, so two
+replicas' gangs can never deadlock each other holding half a pool
+each.
+
+Gang scheduling with backfill lives in the executor's worker loop
+(scheduler/executor.py): a plan whose footprint cannot be satisfied
+right now goes back to the queue's tail — its journal record stays
+``submitted``, its plan lease stays held — while smaller plans
+backfill past it on the ordinals that ARE free. Starvation is bounded
+by an age-based promotion: every unsatisfied footprint is advertised
+as a ``waiting-<plan_id>.json`` record in the lease directory, and
+once the oldest waiting plan (fleet-wide — every replica reads the
+same directory) has waited past ``EEG_TPU_GANG_PROMOTION_S``, no
+replica grants ANY other plan new ordinals until the promoted gang
+fits. Freed devices then drain toward the gang instead of leaking to
+a stream of small jobs.
+
+Exemptions, deliberately: serve plans (resident services — an
+exclusive ordinal held forever would starve the pool; admission
+control bounds them elsewhere) and pod plans with ``processes>1``
+(they are routed through pod-assist — gateway/fleet.py — and their
+worker processes manage their own devices). Both run unplaced, which
+is also the global degradation path: a pool that cannot claim
+(unwritable directory, chaos) or a footprint larger than the pool
+degrades to today's unplaced execution, where the builder's existing
+mesh -> single-device -> host ladder applies unchanged.
+
+Counters ride :func:`lease.stats` (``device_claims`` /
+``device_claim_losses`` / ``device_releases``) and ``obs.metrics``
+(``placement.*``); the waiting records are the operator surface
+``fleet_top`` and ``plan_admin fleet`` render.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import lease as lease_mod
+
+logger = logging.getLogger(__name__)
+
+#: pool size: unset/""/"0" = placement off; "auto" = len(jax.devices())
+#: at replica start; an integer = exactly that many ordinals
+ENV_DEVICE_POOL = "EEG_TPU_DEVICE_POOL"
+#: seconds the fleet's oldest waiting footprint may starve before it
+#: is promoted (no replica grants any OTHER plan new ordinals)
+ENV_GANG_PROMOTION = "EEG_TPU_GANG_PROMOTION_S"
+_DEFAULT_PROMOTION_S = 5.0
+
+#: sentinel from :meth:`DevicePool.admit`: run WITHOUT a grant — the
+#: plan is exempt (serve/pod), its footprint exceeds the pool, or the
+#: pool itself is degraded. The builder's existing availability
+#: ladder governs from there.
+UNPLACED = object()
+
+_POOL_MARKER = "device-pool.json"
+_MARKER_SCHEMA = "eeg-tpu-device-pool/v1"
+_WAIT_SCHEMA = "eeg-tpu-placement-wait/v1"
+
+
+def promotion_age() -> float:
+    value = os.environ.get(ENV_GANG_PROMOTION)
+    if not value:
+        return _DEFAULT_PROMOTION_S
+    try:
+        return float(value)
+    except ValueError:
+        logger.warning(
+            "unparseable %s=%r; using the default %.1fs",
+            ENV_GANG_PROMOTION, value, _DEFAULT_PROMOTION_S,
+        )
+        return _DEFAULT_PROMOTION_S
+
+
+def _wait_path(directory: str, plan_id: str) -> str:
+    return os.path.join(directory, f"waiting-{plan_id}.json")
+
+
+def waiting_entries(
+    directory: str, clear_dead: bool = False,
+) -> List[Dict[str, Any]]:
+    """Every valid waiting record in ``directory``, oldest first.
+    A record whose advertising process is provably dead (pid + start
+    token, the lease module's liveness test) is skipped — and unlinked
+    when ``clear_dead`` (a SIGKILLed replica's waiting gang must not
+    promote forever and block the whole fleet; the plan itself is
+    re-run via its stale plan lease and re-advertises under the
+    survivor's identity)."""
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError:
+        return []
+    out = []
+    for name in names:
+        if not (name.startswith("waiting-") and name.endswith(".json")):
+            continue
+        path = os.path.join(directory, name)
+        try:
+            with open(path) as f:
+                entry = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(entry, dict) or "plan_id" not in entry:
+            continue
+        pid = entry.get("pid")
+        if pid is not None and lease_mod._holder_dead(
+            pid, entry.get("start_token", "")
+        ):
+            if clear_dead:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+            continue
+        out.append(entry)
+    out.sort(key=lambda e: (e.get("since", 0.0), e.get("plan_id", "")))
+    return out
+
+
+def device_table(directory: str) -> List[Dict[str, Any]]:
+    """Observer view of the device leases in ``directory`` — one row
+    per held ordinal ({ordinal, holder, pid, age_s, pid_dead, stale}),
+    read exactly as ``plan_admin``/``fleet_top`` read plan leases."""
+    observer = lease_mod.LeaseDir(directory, holder="placement-observer")
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError:
+        return []
+    out = []
+    for name in names:
+        if not (name.startswith("device-") and name.endswith(".lease")):
+            continue
+        stem = name[len("device-"):-len(".lease")]
+        if not stem.isdigit():
+            continue
+        info = observer.holder_info(f"device:{stem}")
+        if info is not None:
+            info["ordinal"] = int(stem)
+            out.append(info)
+    out.sort(key=lambda r: r["ordinal"])
+    return out
+
+
+def pool_size_marker(directory: str) -> Optional[int]:
+    """The advertised pool size, or None when no pool ever ran here."""
+    try:
+        with open(os.path.join(directory, _POOL_MARKER)) as f:
+            marker = json.load(f)
+        return int(marker["size"])
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
+class DeviceGrant:
+    """One plan's granted device set: the leased ordinals its mesh is
+    built from. Released exactly once, when the plan's execution ends
+    (terminal record or attempt ladder exit)."""
+
+    __slots__ = ("plan_id", "ordinals", "_pool", "_released")
+
+    def __init__(self, plan_id: str, ordinals: Tuple[int, ...], pool):
+        self.plan_id = plan_id
+        self.ordinals = tuple(ordinals)
+        self._pool = pool
+        self._released = False
+
+    def release(self) -> None:
+        if self._released:
+            return
+        self._released = True
+        self._pool._release_ordinals(self.ordinals)
+
+    def __repr__(self) -> str:
+        return (
+            f"DeviceGrant(plan={self.plan_id}, "
+            f"ordinals={list(self.ordinals)})"
+        )
+
+
+class DevicePool:
+    """One replica's handle on the shared device pool.
+
+    Cross-process exclusivity is the lease file (O_EXCL create wins);
+    in-process exclusivity is ``_lock`` + the granted set — required
+    because ``LeaseDir.try_claim`` deliberately hands a lease this
+    process already holds back to a second caller (the plan-lease
+    re-claim path), which for device ordinals would be a double
+    grant."""
+
+    def __init__(self, leases: lease_mod.LeaseDir, size: int):
+        if size < 1:
+            raise ValueError(f"device pool size must be >= 1, got {size}")
+        self.leases = leases
+        self.size = int(size)
+        self._lock = threading.Lock()
+        #: ordinals granted to plans in THIS process right now
+        self._granted: set = set()
+        self._write_marker()
+
+    @classmethod
+    def from_env(
+        cls, leases: lease_mod.LeaseDir,
+    ) -> Optional["DevicePool"]:
+        """Build the pool from ``EEG_TPU_DEVICE_POOL`` — None when
+        placement is off (unset/empty/0, the default: PR 17 fleet
+        behavior byte-unchanged)."""
+        value = (os.environ.get(ENV_DEVICE_POOL) or "").strip()
+        if not value or value == "0":
+            return None
+        if value.lower() == "auto":
+            try:
+                import jax
+
+                size = len(jax.devices())
+            except Exception as e:
+                logger.warning(
+                    "EEG_TPU_DEVICE_POOL=auto but jax.devices() failed "
+                    "(%s: %s); placement disabled",
+                    type(e).__name__, e,
+                )
+                return None
+        else:
+            try:
+                size = int(value)
+            except ValueError:
+                logger.warning(
+                    "unparseable %s=%r; placement disabled",
+                    ENV_DEVICE_POOL, value,
+                )
+                return None
+            if size < 1:
+                return None
+        return cls(leases, size)
+
+    def _write_marker(self) -> None:
+        """Advertise the pool size beside the lease files so offline
+        observers (fleet_top, plan_admin) can compute the free count.
+        Best-effort: a marker that cannot land degrades the view, not
+        the pool."""
+        from ..checkpoint.manager import atomic_write_text
+
+        try:
+            atomic_write_text(
+                os.path.join(self.leases.directory, _POOL_MARKER),
+                json.dumps({
+                    "schema": _MARKER_SCHEMA,
+                    "size": self.size,
+                    "holder": self.leases.holder,
+                    "pid": os.getpid(),
+                }, sort_keys=True) + "\n",
+            )
+        except OSError as e:
+            logger.warning(
+                "device-pool marker write failed (%s: %s)",
+                type(e).__name__, e,
+            )
+
+    # -- the scheduling surface ------------------------------------------
+
+    def admit(self, plan_id: str, footprint: Dict[str, Any]):
+        """One placement attempt for ``plan_id``. Returns a
+        :class:`DeviceGrant` (run on these ordinals), ``None`` (wait:
+        the footprint cannot be satisfied now — the caller requeues
+        the plan and smaller plans backfill past it), or
+        :data:`UNPLACED` (run without a grant: exempt class,
+        footprint larger than the pool, or pool degraded)."""
+        from .. import obs
+
+        if footprint.get("memory_class") == "serve":
+            obs.metrics.count("placement.exempt")
+            return UNPLACED
+        if footprint.get("hosts", 1) > 1:
+            # pod plans route through pod-assist; their processes own
+            # their devices
+            obs.metrics.count("placement.exempt")
+            return UNPLACED
+        need = int(footprint.get("devices", 1))
+        if need == 0:
+            need = self.size
+        if need > self.size:
+            obs.metrics.count("placement.unsatisfiable")
+            logger.warning(
+                "plan %s wants %d devices but the pool holds %d; "
+                "running unplaced (the mesh ladder degrades it)",
+                plan_id, need, self.size,
+            )
+            self.clear_waiting(plan_id)
+            return UNPLACED
+        with self._lock:
+            promoted = self.promoted()
+            if promoted is not None and promoted["plan_id"] != plan_id:
+                # a starved gang owns every ordinal that frees up
+                # until it fits — do not even try to claim
+                self._note_waiting(plan_id, footprint)
+                obs.metrics.count("placement.promotion_blocked")
+                return None
+            claimed: List[int] = []
+            for ordinal in range(self.size):
+                if len(claimed) == need:
+                    break
+                if ordinal in self._granted:
+                    continue
+                got = self.leases.try_claim(f"device:{ordinal}")
+                if isinstance(got, lease_mod.PlanLease):
+                    claimed.append(ordinal)
+            if len(claimed) < need:
+                # all-or-nothing: holding a partial gang would
+                # deadlock against a peer holding the complement
+                for ordinal in claimed:
+                    self.leases.release(f"device:{ordinal}")
+                    lease_mod._count("device_releases")
+                self._note_waiting(plan_id, footprint)
+                obs.metrics.count("placement.waits")
+                return None
+            self._granted.update(claimed)
+        self.clear_waiting(plan_id)
+        obs.metrics.count("placement.grants")
+        if promoted is not None and promoted["plan_id"] == plan_id:
+            obs.metrics.count("placement.promotions")
+        elif self.waiting_others(plan_id):
+            # a smaller plan just ran past a footprint that is still
+            # waiting: the backfill evidence
+            obs.metrics.count("placement.backfills")
+        return DeviceGrant(plan_id, tuple(claimed), self)
+
+    def _release_ordinals(self, ordinals: Tuple[int, ...]) -> None:
+        with self._lock:
+            for ordinal in ordinals:
+                self.leases.release(f"device:{ordinal}")
+                lease_mod._count("device_releases")
+                self._granted.discard(ordinal)
+
+    def release_all(self) -> None:
+        """Free every ordinal this process granted (replica close)."""
+        with self._lock:
+            for ordinal in sorted(self._granted):
+                self.leases.release(f"device:{ordinal}")
+                lease_mod._count("device_releases")
+            self._granted.clear()
+
+    # -- waiting records (the no-starvation + operator surface) ----------
+
+    def _note_waiting(self, plan_id: str, footprint: Dict[str, Any]):
+        """Advertise an unsatisfied footprint (idempotent: the FIRST
+        wait's timestamp is the promotion clock — rewriting it every
+        retry would reset the starvation bound). A dead peer's record
+        for the same plan is overwritten: after a takeover the
+        survivor's identity owns the wait."""
+        from ..checkpoint.manager import atomic_write_text
+
+        path = _wait_path(self.leases.directory, plan_id)
+        try:
+            with open(path) as f:
+                existing = json.load(f)
+            if existing.get("holder") == self.leases.holder:
+                return  # our record, original clock preserved
+            pid = existing.get("pid")
+            if pid is not None and not lease_mod._holder_dead(
+                pid, existing.get("start_token", "")
+            ):
+                return  # a live peer's record (its plan lease rules)
+        except (OSError, ValueError):
+            pass
+        try:
+            atomic_write_text(path, json.dumps({
+                "schema": _WAIT_SCHEMA,
+                "plan_id": plan_id,
+                "footprint": dict(footprint),
+                "since": time.time(),
+                "holder": self.leases.holder,
+                "pid": os.getpid(),
+                "start_token": lease_mod._pid_start_token(os.getpid())
+                or "",
+            }, sort_keys=True) + "\n")
+        except OSError as e:
+            logger.warning(
+                "placement waiting record write failed for %s "
+                "(%s: %s)", plan_id, type(e).__name__, e,
+            )
+
+    def clear_waiting(self, plan_id: str) -> None:
+        try:
+            os.unlink(_wait_path(self.leases.directory, plan_id))
+        except OSError:
+            pass
+
+    def waiting_entries(self) -> List[Dict[str, Any]]:
+        return waiting_entries(self.leases.directory, clear_dead=True)
+
+    def waiting_others(self, plan_id: str) -> List[Dict[str, Any]]:
+        return [
+            e for e in self.waiting_entries()
+            if e.get("plan_id") != plan_id
+        ]
+
+    def promoted(self) -> Optional[Dict[str, Any]]:
+        """The fleet's oldest waiting record once it has starved past
+        :func:`promotion_age`; None otherwise. Every replica computes
+        this from the same directory, so promotion is fleet-wide."""
+        entries = self.waiting_entries()
+        if not entries:
+            return None
+        oldest = entries[0]
+        if time.time() - float(oldest.get("since", 0.0)) \
+                > promotion_age():
+            return oldest
+        return None
+
+    # -- observation ------------------------------------------------------
+
+    def free_ordinals(self) -> List[int]:
+        """Ordinals claimable RIGHT NOW: no lease file, or a stale
+        (breakable) one."""
+        out = []
+        for ordinal in range(self.size):
+            info = self.leases.holder_info(f"device:{ordinal}")
+            if info is None or info["stale"]:
+                out.append(ordinal)
+        return out
+
+    def health(self) -> Dict[str, Any]:
+        """The /readyz evidence block: pool size, this replica's held
+        ordinals, the fleet's claimable count, and the waiting plans
+        blocked on them."""
+        waiting = self.waiting_entries()
+        return {
+            "size": self.size,
+            "held": self.leases.held_device_ordinals(),
+            "free": len(self.free_ordinals()),
+            "waiting": len(waiting),
+            "oldest_waiting": (
+                waiting[0]["plan_id"] if waiting else None
+            ),
+        }

@@ -49,6 +49,7 @@ def execute_plan(
     gateway: Optional[dict] = None,
     fleet: Optional[dict] = None,
     trace_id: Optional[str] = None,
+    placement=None,
 ):
     """Run ``plan`` through ``builder`` inside a fresh fault domain;
     returns the statistics (and leaves the builder's per-run
@@ -83,6 +84,14 @@ def execute_plan(
     ``EEG_TPU_TRACE_DIR`` set, spans additionally append to the
     per-replica trace sink — even when run reports are off, so a
     fleet's trace plane works without the per-plan report tree.
+
+    ``placement`` — leased device ordinals granted by the fleet's
+    device pool (scheduler/placement.py). When set, the builder's
+    mesh is built from exactly these ``jax.devices()`` ordinals
+    instead of a ``[:n]`` prefix slice, so concurrent plans on one
+    host run on DISJOINT chips. Degradation unchanged: if the leased
+    subset cannot build a mesh, the existing
+    mesh→single-device→host ladder applies.
     """
     query_map = plan.query_map
     logger.info("query: %s", query_map)
@@ -117,6 +126,9 @@ def execute_plan(
     builder.overlap_resolved = None
     builder.mesh_resolved = None
     builder.dedup_resolved = None
+    builder.placement_devices = (
+        tuple(placement) if placement else None
+    )
     # fresh per run, like the metrics scope below: a reused builder
     # must not report run 1's stage seconds under run 2
     builder.timers = obs.StageTimer()

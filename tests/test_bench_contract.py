@@ -920,3 +920,115 @@ def test_collect_propagates_fleet_field(monkeypatch):
     v = bench._collect("cpu_fallback")["variants"]["gateway_fleet"]
     assert v["fleet"] == block
     assert v["report_sha256"] == "abc"
+
+
+def test_fleet_placement_in_both_tables_and_routing():
+    """The device-aware placement benchmark (ISSUE 20) rides every
+    bench artifact, on TPU and the CPU fallback — both phases force a
+    virtual CPU host either way — through the pipeline child."""
+    import inspect
+
+    for table in (bench._VARIANTS_TPU, bench._VARIANTS_CPU):
+        assert "fleet_placement" in table
+        # same small-session reasoning as gateway_fleet: the line
+        # pins scheduling (makespan ratio, sha parity, the lease
+        # audit), which a bigger session stretches without sharpening
+        assert table["fleet_placement"] == (400, 2)
+    src = inspect.getsource(bench._run_variant)
+    assert '"fleet_"' in src and "pipeline_bench.py" in src
+
+
+def test_collect_propagates_placement_field(monkeypatch):
+    """The fleet_placement line's block (makespan ratio vs the
+    disabled twin, sha parity, zero-double-held audit) must survive
+    the parent's field whitelist into the published artifact — the
+    placement claim is audited from it."""
+    block = {
+        "replicas": 3,
+        "makespan_ratio": 0.9,
+        "placement_no_slower": True,
+        "sha_parity": True,
+        "zero_double_held": True,
+        "gang_fully_leased": True,
+        "placed": {"makespan_s": 9.0, "drain_exit_codes": [0, 0, 0]},
+        "disabled": {"makespan_s": 10.0},
+    }
+    monkeypatch.setattr(
+        bench, "_VARIANTS_CPU",
+        {"einsum": (8, 2), "fleet_placement": (400, 2)},
+    )
+    monkeypatch.setattr(
+        bench,
+        "_run_variant",
+        lambda name, platform, n, iters: {
+            "epochs_per_s": 1.0,
+            "bytes_per_epoch": 6000,
+            "n": n,
+            "wall_s": 1.0,
+            "report_sha256": "abc",
+            **(
+                {"placement": block}
+                if name == "fleet_placement" else {}
+            ),
+        },
+    )
+    v = bench._collect("cpu_fallback")["variants"]["fleet_placement"]
+    assert v["placement"] == block
+    assert v["report_sha256"] == "abc"
+
+
+def test_smoke_gates_fleet_placement():
+    """The e2e smoke suite runs the fleet_placement child and gates
+    on its placement block (ISSUE 20): the check exists, is wired
+    into run(), and refuses a line with no block, a slower placed
+    makespan, a sha drift, or a failed lease audit."""
+    import importlib.util as iu
+
+    spec = iu.spec_from_file_location(
+        "e2e_smoke",
+        os.path.join(
+            os.path.dirname(os.path.dirname(__file__)),
+            "tools", "e2e_smoke.py",
+        ),
+    )
+    smoke = iu.module_from_spec(spec)
+    spec.loader.exec_module(smoke)
+
+    failures = []
+    smoke._check_placement({}, failures)
+    assert failures and "no placement block" in failures[0]
+
+    good = {
+        "placement": {
+            "makespan_ratio": 0.9,
+            "placement_no_slower": True,
+            "sha_parity": True,
+            "zero_double_held": True,
+            "gang_fully_leased": True,
+            "placed": {
+                "all_completed": True, "drained_cleanly": True,
+                "makespan_s": 9.0,
+                "sha_identical": {"gang": True, "small": True},
+                "device_audit": {"gang_leased_ordinals": list(range(8))},
+            },
+            "disabled": {
+                "all_completed": True, "drained_cleanly": True,
+                "makespan_s": 10.0,
+                "sha_identical": {"gang": True, "small": True},
+            },
+        },
+    }
+    failures = []
+    smoke._check_placement(good, failures)
+    assert failures == []
+
+    bad = json.loads(json.dumps(good))
+    bad["placement"]["placement_no_slower"] = False
+    bad["placement"]["zero_double_held"] = False
+    failures = []
+    smoke._check_placement(bad, failures)
+    assert len(failures) == 2
+    import inspect
+
+    src = inspect.getsource(smoke.run)
+    assert "fleet_placement" in src and "_check_placement" in src

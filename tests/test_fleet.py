@@ -1009,3 +1009,201 @@ def test_sigterm_drain_real_process(session, tmp_path):
     assert not os.path.exists(
         os.path.join(journal_dir, f"plan-{queued['plan_id']}.lease")
     )
+
+
+# -- device-aware placement + pod routing ------------------------------
+
+
+def test_readyz_flags_exhausted_device_pool(tmp_path, monkeypatch):
+    """/readyz turns 503 with evidence when every pool ordinal is
+    held elsewhere AND plans are waiting on them — a replica that can
+    accept but never place is unroutable; /metrics and /stats carry
+    the device-pool gauges either way."""
+    from eeg_dataanalysispackage_tpu.scheduler import placement
+
+    monkeypatch.setenv(placement.ENV_DEVICE_POOL, "1")
+    journal_dir = str(tmp_path / "journal")
+    os.makedirs(journal_dir)
+    replica = FleetReplica(
+        journal_dir=journal_dir, replica_id="gw-a",
+        scan_interval_s=5.0,
+    )
+    host, port = replica.start()
+    base = f"http://{host}:{port}"
+    peer_leases = lease_mod.LeaseDir(journal_dir, holder="gw-peer")
+    peer_pool = placement.DevicePool(peer_leases, size=1)
+    try:
+        code, payload = _request(f"{base}/readyz")
+        assert code == 200 and payload["ready"] is True
+
+        # the peer holds the only ordinal and a plan waits on it
+        blocker = peer_pool.admit(
+            "blocker",
+            {"devices": 1, "hosts": 1, "memory_class": "light"},
+        )
+        assert isinstance(blocker, placement.DeviceGrant)
+        assert peer_pool.admit(
+            "waiter",
+            {"devices": 1, "hosts": 1, "memory_class": "light"},
+        ) is None
+
+        code, payload = _request(f"{base}/readyz")
+        assert code == 503 and payload["ready"] is False
+        reason = " ".join(payload["reasons"])
+        assert "device pool exhausted" in reason
+        assert "waiter" in reason  # names the starving plan
+
+        # still ALIVE, and the exposition carries the pool state
+        code, _ = _request(f"{base}/healthz")
+        assert code == 200
+        code, text = _get_text(f"{base}/metrics")
+        assert code == 200
+        assert "eeg_tpu_fleet_devices_held" in text
+        assert "eeg_tpu_fleet_devices_free 0" in text
+        assert "eeg_tpu_fleet_plans_waiting_placement 1" in text
+        code, stats = _request(f"{base}/stats")
+        pool_block = stats["fleet"]["device_pool"]
+        assert pool_block["size"] == 1
+        assert pool_block["free"] == 0
+        assert pool_block["oldest_waiting"] == "waiter"
+
+        # freeing the ordinal restores readiness
+        blocker.release()
+        peer_pool.clear_waiting("waiter")
+        code, _ = _request(f"{base}/readyz")
+        assert code == 200
+    finally:
+        replica.close()
+
+
+def test_pod_assist_enlists_peer_byte_identical(session, tmp_path,
+                                                monkeypatch):
+    """The pod routing acceptance: a ``processes=2`` plan submitted
+    through the fleet completes via pod-assist — the winning replica
+    drives its own process-0 member, a peer replica claims the
+    ``assist:`` rank lease and contributes the rank-1 worker — with
+    statistics byte-identical to the solo single-process run."""
+    journal_dir = str(tmp_path / "journal")
+    extra = "&cv=2&sweep=lr:1.0,0.5&cache=false"
+    twin = str(builder.PipelineBuilder(_q(session, extra)).execute())
+    # the members bootstrap their own fresh processes; give each the
+    # pinned 2-virtual-device host the pod parity suite runs on
+    monkeypatch.setenv(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=2"
+    )
+    before = obs.metrics.snapshot()["counters"]
+
+    a = FleetReplica(journal_dir=journal_dir, replica_id="gw-a",
+                     scan_interval_s=0.05)
+    b = FleetReplica(journal_dir=journal_dir, replica_id="gw-b",
+                     scan_interval_s=0.05)
+    a.start()
+    b.start()
+    journal = PlanJournal(journal_dir)
+    try:
+        code, payload = a.server.submit_query(
+            _q(session, extra + "&processes=2")
+        )
+        assert code == 201, payload
+        plan_id = payload["plan_id"]
+        deadline = time.monotonic() + 600
+        entry = None
+        while time.monotonic() < deadline:
+            entry = journal.entry(plan_id)
+            if entry and entry["state"] in ("completed", "failed"):
+                break
+            time.sleep(0.1)
+        assert entry is not None and entry["state"] == "completed", (
+            entry
+        )
+        assert entry["statistics"] == twin
+        after = obs.metrics.snapshot()["counters"]
+        delta = lambda k: after.get(k, 0) - before.get(k, 0)  # noqa: E731
+        assert delta("fleet.pod_assist_requests") >= 1
+        assert delta("fleet.pod_assist_completed") >= 1
+        # the peer really contributed a rank, and nothing degraded
+        assert delta("fleet.pod_assist_workers") >= 1
+        assert delta("fleet.pod_assist_degraded") == 0
+        # the assist record never outlives its run
+        assert journal.assist_entries() == []
+    finally:
+        a.close()
+        b.close()
+    leftover = [
+        n for n in os.listdir(journal_dir)
+        if n.startswith("assist-") and n.endswith(".lease")
+    ]
+    assert leftover == []
+
+
+def test_sigkilled_pod_coordinator_degrades_not_wedges(
+        session, tmp_path, monkeypatch):
+    """A coordinator pod process that dies (SIGKILL, no goodbye) must
+    degrade the plan down the existing pod ladder — inline execution,
+    single-host rung, byte-identical statistics — never wedge the
+    fleet or leave the assist record behind."""
+    from eeg_dataanalysispackage_tpu.parallel import pod as pod_mod
+
+    journal_dir = str(tmp_path / "journal")
+    twin = str(builder.PipelineBuilder(_q(session)).execute())
+    real_spawn = pod_mod.spawn_pod_member
+    killed = []
+
+    def spawn_then_sigkill(*args, **kwargs):
+        child = real_spawn(*args, **kwargs)
+        child.kill()
+        killed.append(child)
+        return child
+
+    monkeypatch.setattr(pod_mod, "spawn_pod_member", spawn_then_sigkill)
+    before = obs.metrics.snapshot()["counters"]
+    replica = FleetReplica(journal_dir=journal_dir, replica_id="gw-a",
+                           scan_interval_s=0.05)
+    replica.start()
+    journal = PlanJournal(journal_dir)
+    try:
+        code, payload = replica.server.submit_query(
+            _q(session, "&processes=2")
+        )
+        assert code == 201, payload
+        plan_id = payload["plan_id"]
+        deadline = time.monotonic() + 300
+        entry = None
+        while time.monotonic() < deadline:
+            entry = journal.entry(plan_id)
+            if entry and entry["state"] in ("completed", "failed"):
+                break
+            time.sleep(0.1)
+        assert killed, "the coordinator member was never spawned"
+        assert entry is not None and entry["state"] == "completed", (
+            entry
+        )
+        # the ladder's parity pin: degraded == solo, byte-identical
+        assert entry["statistics"] == twin
+        after = obs.metrics.snapshot()["counters"]
+        assert after.get("fleet.pod_assist_degraded", 0) \
+            > before.get("fleet.pod_assist_degraded", 0)
+        assert journal.assist_entries() == []
+    finally:
+        replica.close()
+
+
+def test_dead_coordinators_assist_record_cleared_by_peer(tmp_path):
+    """A SIGKILLed coordinator's podassist record must not make every
+    peer scan try to staff a pod nobody coordinates: a provably dead
+    writer (pid + start token) is cleared on the next scan pass."""
+    journal_dir = str(tmp_path / "journal")
+    replica = FleetReplica(journal_dir=journal_dir, replica_id="gw-b",
+                           scan_interval_s=5.0)
+    journal = PlanJournal(journal_dir)
+    try:
+        journal.record_assist(
+            "p0001", "127.0.0.1:45555", 2, holder="gw-dead",
+            pid=999999, start_token="", query="info_file=/x",
+        )
+        assert len(journal.assist_entries()) == 1
+        spawned = replica.pod_assist.scan_assists()
+        assert spawned == []
+        assert journal.assist_entries() == []
+    finally:
+        replica.close()

@@ -1,0 +1,332 @@
+"""Device-aware fleet placement suite (scheduler/placement.py +
+ExecutionPlan.device_footprint + the executor's gang/backfill loop).
+
+The acceptance pins:
+
+- **footprint matrix** — the IR derives ``{devices, hosts,
+  memory_class}`` purely from the parsed knobs (devices/mesh/pod/
+  population/serve rows), no environment and no backend;
+- **device-lease race** — two replica identities race the same
+  ordinals through the shared lease directory and every ordinal lands
+  with exactly one holder; the losses are counted;
+- **backfill** — a gang whose footprint cannot be satisfied waits
+  (journal record stays ``submitted``) while a smaller plan backfills
+  past it and completes first; the freed pool then grants the gang,
+  with the leased ordinals attributed in its journal meta;
+- **no-starvation promotion** — once the oldest waiting footprint has
+  starved past ``EEG_TPU_GANG_PROMOTION_S``, no other plan is granted
+  new ordinals until the promoted gang fits.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+import _synthetic
+from eeg_dataanalysispackage_tpu import obs
+from eeg_dataanalysispackage_tpu.pipeline.plan import ExecutionPlan
+from eeg_dataanalysispackage_tpu.scheduler import lease as lease_mod
+from eeg_dataanalysispackage_tpu.scheduler import placement
+from eeg_dataanalysispackage_tpu.scheduler.executor import PlanExecutor
+from eeg_dataanalysispackage_tpu.scheduler.journal import PlanJournal
+
+
+@pytest.fixture(autouse=True)
+def _fast_lease(monkeypatch):
+    monkeypatch.setenv(lease_mod.ENV_LEASE_TIMEOUT, "1")
+
+
+@pytest.fixture()
+def session(tmp_path):
+    return _synthetic.write_session(str(tmp_path), n_markers=60)
+
+
+def _q(info, extra=""):
+    return (
+        f"info_file={info}&fe=dwt-8&train_clf=logreg"
+        "&config_step_size=1.0&config_num_iterations=20"
+        "&config_mini_batch_fraction=1.0&dedup=false" + extra
+    )
+
+
+def _counters():
+    return obs.metrics.snapshot()["counters"]
+
+
+# -- footprint matrix --------------------------------------------------
+
+
+_BASE = "info_file=/tmp/x/info.txt&fe=dwt-8&train_clf=logreg"
+
+
+@pytest.mark.parametrize("extra,expected", [
+    # a plain single-model run is one capacity token
+    ("", {"devices": 1, "hosts": 1, "memory_class": "light"}),
+    # explicit mesh size = the gang size, all-or-nothing
+    ("&devices=4", {"devices": 4, "hosts": 1, "memory_class": "heavy"}),
+    # multi-axis extents multiply out to the gang size
+    ("&mesh_axes=data:2,time:2",
+     {"devices": 4, "hosts": 1, "memory_class": "heavy"}),
+    # axes-only mesh sizes itself to the host at execution: devices=0
+    # means "every ordinal present"
+    ("&mesh_axes=data",
+     {"devices": 0, "hosts": 1, "memory_class": "heavy"}),
+    # pod plans: hosts = processes, one local ordinal — the fleet
+    # routes them through pod-assist, not the local pool
+    ("&processes=2", {"devices": 1, "hosts": 2, "memory_class": "heavy"}),
+    # population stacks classify by member count: < 32 standard,
+    # >= 32 heavy
+    ("&cv=4&seeds=2",
+     {"devices": 1, "hosts": 1, "memory_class": "standard"}),
+    ("&cv=8&seeds=4",
+     {"devices": 1, "hosts": 1, "memory_class": "heavy"}),
+    # serve plans are their own class (resident; exempt from the pool)
+    ("&serve=true", {"devices": 1, "hosts": 1, "memory_class": "serve"}),
+])
+def test_footprint_matrix(extra, expected):
+    assert ExecutionPlan.parse(_BASE + extra).device_footprint() \
+        == expected
+
+
+def test_footprint_is_pure_and_repeatable():
+    plan = ExecutionPlan.parse(_BASE + "&devices=4")
+    assert plan.device_footprint() == plan.device_footprint()
+
+
+# -- two-replica device-lease race -------------------------------------
+
+
+def test_two_replicas_race_ordinals_exactly_one_holder(tmp_path):
+    """Two replica identities hammer a 4-ordinal pool with competing
+    2-device gangs from 8 threads: whatever lands, every ordinal has
+    exactly ONE holder (the O_EXCL claim is the arbiter), the two
+    pools' granted sets never overlap, and the losers' contended
+    claims are counted in lease.stats()."""
+    a = lease_mod.LeaseDir(str(tmp_path), holder="gw-a")
+    b = lease_mod.LeaseDir(str(tmp_path), holder="gw-b")
+    pool_a = placement.DevicePool(a, size=4)
+    pool_b = placement.DevicePool(b, size=4)
+    before = lease_mod.stats()
+    footprint = {"devices": 2, "hosts": 1, "memory_class": "heavy"}
+    grants, lock = [], threading.Lock()
+    barrier = threading.Barrier(8)
+
+    def race(pool, plan_id):
+        barrier.wait()
+        got = pool.admit(plan_id, footprint)
+        if isinstance(got, placement.DeviceGrant):
+            with lock:
+                grants.append((pool, got))
+
+    threads = [
+        threading.Thread(
+            target=race,
+            args=(pool_a if i % 2 == 0 else pool_b, f"p{i:04d}"),
+        )
+        for i in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    # a 4-ordinal pool fits exactly two 2-device gangs
+    assert len(grants) == 2
+    held = [tuple(g.ordinals) for _, g in grants]
+    flat = [o for ordinals in held for o in ordinals]
+    assert sorted(flat) == sorted(set(flat)), (
+        f"ordinal granted twice: {held}"
+    )
+    # the on-disk view agrees: each held ordinal names one holder
+    table = placement.device_table(str(tmp_path))
+    assert sorted(r["ordinal"] for r in table) == sorted(flat)
+    assert all(r["holder"] in ("gw-a", "gw-b") for r in table)
+    after = lease_mod.stats()
+    assert after["device_claims"] - before["device_claims"] >= 4
+    # 8 threads x 2-ordinal wants over 4 ordinals: somebody lost a
+    # contended O_EXCL create and the loss was counted
+    assert after["device_claim_losses"] > before["device_claim_losses"]
+
+    for _, g in grants:
+        g.release()
+    assert placement.device_table(str(tmp_path)) == []
+    assert lease_mod.stats()["device_releases"] \
+        > before["device_releases"]
+
+
+def test_all_or_nothing_no_partial_gang_held(tmp_path):
+    """A gang that cannot fully fit releases every partial claim
+    immediately — two half-holding replicas must never deadlock."""
+    a = lease_mod.LeaseDir(str(tmp_path), holder="gw-a")
+    b = lease_mod.LeaseDir(str(tmp_path), holder="gw-b")
+    pool_a = placement.DevicePool(a, size=4)
+    # gw-b pins ordinals 2 and 3 out from under the gang
+    assert isinstance(b.try_claim("device:2"), lease_mod.PlanLease)
+    assert isinstance(b.try_claim("device:3"), lease_mod.PlanLease)
+    got = pool_a.admit(
+        "gang", {"devices": 3, "hosts": 1, "memory_class": "heavy"}
+    )
+    assert got is None  # wait — and crucially, hold NOTHING
+    table = placement.device_table(str(tmp_path))
+    assert sorted(r["ordinal"] for r in table) == [2, 3]
+    assert all(r["holder"] == "gw-b" for r in table)
+    # the unsatisfied footprint is advertised for the operator surface
+    waiting = placement.waiting_entries(str(tmp_path))
+    assert [e["plan_id"] for e in waiting] == ["gang"]
+    assert waiting[0]["footprint"]["devices"] == 3
+
+
+def test_exempt_and_oversize_run_unplaced(tmp_path):
+    """Serve plans, pod plans, and footprints larger than the pool
+    return UNPLACED — the builder's availability ladder governs, the
+    pool holds nothing, and nobody waits forever on the impossible."""
+    pool = placement.DevicePool(
+        lease_mod.LeaseDir(str(tmp_path), holder="gw-a"), size=2,
+    )
+    for footprint in (
+        {"devices": 1, "hosts": 1, "memory_class": "serve"},
+        {"devices": 1, "hosts": 2, "memory_class": "heavy"},
+        {"devices": 3, "hosts": 1, "memory_class": "heavy"},
+    ):
+        assert pool.admit("px", footprint) is placement.UNPLACED
+    assert placement.device_table(str(tmp_path)) == []
+    assert placement.waiting_entries(str(tmp_path)) == []
+
+
+# -- gang scheduling with backfill (the executor loop) -----------------
+
+
+def test_small_plan_backfills_past_blocked_gang(session, tmp_path,
+                                                monkeypatch):
+    """A 2-device gang blocked on a peer-held ordinal waits with its
+    journal record still ``submitted`` while a 1-device plan submitted
+    AFTER it backfills past and completes first. Freeing the ordinal
+    then grants the gang, and the leased ordinals land in its journal
+    meta."""
+    monkeypatch.setenv(placement.ENV_GANG_PROMOTION, "600")
+    journal_dir = str(tmp_path / "journal")
+    os.makedirs(journal_dir)
+    peer = lease_mod.LeaseDir(journal_dir, holder="gw-peer")
+    assert isinstance(peer.try_claim("device:1"), lease_mod.PlanLease)
+
+    before = _counters()
+    ex = PlanExecutor(journal_dir=journal_dir, max_concurrent=1)
+    ex.placement = placement.DevicePool(
+        lease_mod.LeaseDir(journal_dir, holder="gw-a"), size=2,
+    )
+    journal = PlanJournal(journal_dir)
+    try:
+        gang = ex.submit(_q(session, "&devices=2"))
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if placement.waiting_entries(journal_dir):
+                break
+            time.sleep(0.02)
+        waiting = placement.waiting_entries(journal_dir)
+        assert [e["plan_id"] for e in waiting] == [gang.plan_id]
+
+        small = ex.submit(_q(session))
+        small.result(timeout=300)
+        # the backfill evidence: the small plan is terminal while the
+        # gang is still write-ahead-only, and the pass was counted
+        assert journal.entry(small.plan_id)["state"] == "completed"
+        assert journal.entry(gang.plan_id)["state"] == "submitted"
+        assert _counters().get("placement.backfills", 0) \
+            > before.get("placement.backfills", 0)
+
+        peer.release("device:1")
+        gang.result(timeout=300)
+        entry = journal.entry(gang.plan_id)
+        assert entry["state"] == "completed"
+        # the granted ordinals are the mesh the builder was handed
+        assert entry["meta"]["fleet"]["devices"] == [0, 1]
+        assert placement.waiting_entries(journal_dir) == []
+    finally:
+        ex.close()
+    # nothing left held: grants released on the execution path
+    assert placement.device_table(journal_dir) == []
+
+
+def test_promotion_blocks_other_grants_until_gang_fits(tmp_path,
+                                                       monkeypatch):
+    """The no-starvation bound: once the oldest waiting footprint has
+    starved past EEG_TPU_GANG_PROMOTION_S, a freed ordinal goes to the
+    promoted gang — a smaller plan that would previously have
+    backfilled is refused until the gang runs."""
+    monkeypatch.setenv(placement.ENV_GANG_PROMOTION, "0.2")
+    peer = lease_mod.LeaseDir(str(tmp_path), holder="gw-peer")
+    assert isinstance(peer.try_claim("device:0"), lease_mod.PlanLease)
+    pool = placement.DevicePool(
+        lease_mod.LeaseDir(str(tmp_path), holder="gw-a"), size=1,
+    )
+    one = {"devices": 1, "hosts": 1, "memory_class": "light"}
+
+    before = _counters()
+    assert pool.admit("gang", one) is None  # waits, clock starts
+    time.sleep(0.3)  # starve past the promotion age
+    peer.release("device:0")
+
+    # the ordinal is free, but the promoted gang owns everything that
+    # frees up: the backfill candidate is refused
+    assert pool.admit("small", one) is None
+    after = _counters()
+    assert after.get("placement.promotion_blocked", 0) \
+        > before.get("placement.promotion_blocked", 0)
+
+    granted = pool.admit("gang", one)
+    assert isinstance(granted, placement.DeviceGrant)
+    assert granted.ordinals == (0,)
+    assert _counters().get("placement.promotions", 0) \
+        > before.get("placement.promotions", 0)
+    # the gang's record is gone; the refused backfiller still waits
+    assert [
+        e["plan_id"]
+        for e in placement.waiting_entries(str(tmp_path))
+    ] == ["small"]
+
+    granted.release()
+    small = pool.admit("small", one)
+    assert isinstance(small, placement.DeviceGrant)
+    small.release()
+    assert placement.waiting_entries(str(tmp_path)) == []
+
+
+def test_dead_holders_waiting_record_cleared(tmp_path):
+    """A SIGKILLed replica's waiting record must not promote forever
+    and wedge the whole fleet: a provably dead advertiser (pid + start
+    token) is skipped and unlinked on the next read."""
+    path = os.path.join(str(tmp_path), "waiting-p0001.json")
+    with open(path, "w") as f:
+        json.dump({
+            "schema": "eeg-tpu-placement-wait/v1",
+            "plan_id": "p0001",
+            "footprint": {"devices": 2, "hosts": 1,
+                          "memory_class": "heavy"},
+            "since": time.time() - 100.0,
+            "holder": "gw-dead",
+            "pid": 999999,
+            "start_token": "",
+        }, f)
+    assert placement.waiting_entries(str(tmp_path)) == []
+    assert placement.waiting_entries(
+        str(tmp_path), clear_dead=True
+    ) == []
+    assert not os.path.exists(path)
+
+
+def test_pool_disabled_by_default(tmp_path, monkeypatch):
+    """EEG_TPU_DEVICE_POOL unset/0 = placement off: from_env returns
+    None and the executor path stays byte-identical to PR 17."""
+    leases = lease_mod.LeaseDir(str(tmp_path), holder="gw-a")
+    monkeypatch.delenv(placement.ENV_DEVICE_POOL, raising=False)
+    assert placement.DevicePool.from_env(leases) is None
+    monkeypatch.setenv(placement.ENV_DEVICE_POOL, "0")
+    assert placement.DevicePool.from_env(leases) is None
+    monkeypatch.setenv(placement.ENV_DEVICE_POOL, "3")
+    pool = placement.DevicePool.from_env(leases)
+    assert pool is not None and pool.size == 3
+    # the marker advertises the size for offline observers
+    assert placement.pool_size_marker(str(tmp_path)) == 3

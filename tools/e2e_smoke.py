@@ -99,6 +99,17 @@ performance contract holds:
   completion/takeover counters agreeing with the journal audit; and
   the surviving replicas drain to exit 0 on a real SIGTERM;
 
+- device-aware fleet placement (fleet_placement,
+  tools/pipeline_bench.py — ISSUE 20): the same 3-replica fleet run
+  twice over a forced-8-virtual-device host — shared device pool on
+  vs off — driving one whole-pool gang plan plus four single-device
+  plans; the placed fleet must finish at a makespan no worse than the
+  placement-disabled twin, every plan byte-identical to its
+  fresh-process twin, the gang granted all 8 leased ordinals (named
+  in its journal meta), the live lease audit observing zero
+  double-held ordinals and nothing beyond the pool, zero device
+  leases after the drain;
+
 - the observability plane (ISSUE 19): a telemetry-off cold twin (no
   report dir) produces statistics byte-identical to the instrumented
   cold run (observation never steers) and the instrumented wall stays
@@ -1065,6 +1076,57 @@ def _check_fleet(line: dict, failures: list) -> None:
         )
 
 
+def _check_placement(line: dict, failures: list) -> None:
+    """The device-aware placement gate (ISSUE 20): the same 3-replica
+    fleet workload — one whole-pool gang plan plus 4 single-device
+    plans over a forced-8-virtual-device host — run with the shared
+    device pool on and off. The placed fleet must complete every plan
+    byte-identically to fresh-process twins, at a makespan no worse
+    than the placement-disabled twin, with the gang granted all 8
+    leased ordinals, no ordinal ever held twice, never more held than
+    the pool, zero device leases left after the drain, and both
+    phases draining to exit 0 on a real SIGTERM."""
+    block = line.get("placement") or {}
+    if not block:
+        failures.append("placement: no placement block on the line")
+        return
+    for tag in ("placed", "disabled"):
+        phase = block.get(tag) or {}
+        if not phase.get("all_completed"):
+            failures.append(
+                f"placement: {tag} phase left plans unfinished: "
+                f"{phase.get('states')}"
+            )
+        if not phase.get("drained_cleanly"):
+            failures.append(
+                f"placement: {tag} phase drain exit codes "
+                f"{phase.get('drain_exit_codes')} (expected all 0)"
+            )
+    if not block.get("sha_parity"):
+        failures.append(
+            "placement: statistics drifted from the fresh-process "
+            f"twins: placed {block.get('placed', {}).get('sha_identical')} "
+            f"disabled {block.get('disabled', {}).get('sha_identical')}"
+        )
+    if not block.get("placement_no_slower"):
+        failures.append(
+            f"placement: placed makespan slower than the disabled "
+            f"twin (ratio {block.get('makespan_ratio')}): "
+            f"{(block.get('placed') or {}).get('makespan_s')}s vs "
+            f"{(block.get('disabled') or {}).get('makespan_s')}s"
+        )
+    if not block.get("zero_double_held"):
+        failures.append(
+            f"placement: device-lease audit failed: "
+            f"{(block.get('placed') or {}).get('device_audit')}"
+        )
+    if not block.get("gang_fully_leased"):
+        failures.append(
+            f"placement: the gang never held its full footprint: "
+            f"leased {(block.get('placed') or {}).get('device_audit', {}).get('gang_leased_ordinals')}"
+        )
+
+
 def _check_report(tag: str, bench_line: dict, report_dir: str,
                   failures: list, checked: list) -> dict:
     """The run-report half of the gate: the artifact exists, parses,
@@ -1351,6 +1413,17 @@ def run(n_markers: int = 2000, n_files: int = 4) -> dict:
             os.path.join(tmp, "cache_fleet"), None,
         )
         _check_fleet(fleet_line, failures)
+        # device-aware placement (ISSUE 20): the same fleet run with
+        # the shared device pool on vs off — makespan no worse, shas
+        # byte-identical, the gang fully leased, zero double-held
+        # ordinals, zero leftover device leases. Same small-session
+        # reasoning as gateway_fleet: the pins are scheduling pins
+        placement_line = _run_variant(
+            "fleet_placement", 400, 2,
+            os.path.join(tmp, "data_placement"),
+            os.path.join(tmp, "cache_placement"), None,
+        )
+        _check_placement(placement_line, failures)
         cold_report = _check_report(
             "cold", cold, report_dirs["cold"], failures, reports_checked
         )

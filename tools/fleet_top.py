@@ -88,9 +88,52 @@ def replica_snapshot(url: str, timeout_s: float = 10.0) -> dict:
         "serve_shed": counter("serve_shed"),
         "held_leases": gauge("fleet_held_leases"),
         "takeovers": counter("lease_takeovers"),
+        # device-pool columns (ISSUE 20): the ordinals this replica's
+        # plans hold right now, straight off the replica's own stats
+        # block (the gauge carries the count; the block, the list)
+        "devices_held": fleet_block.get("devices_held") or [],
+        "device_pool": fleet_block.get("device_pool"),
         "latency_hist": None if hist is None else hist.snapshot(),
         "slo": slo,
     }
+
+
+def _device_pool_table(journal_dir: str):
+    """The shared device pool, observed straight off the lease dir:
+    per-ordinal holder rows, the claimable count, and the waiting
+    plans with the footprint that blocks them (oldest first). None
+    when no replica has ever run with a pool here (no marker)."""
+    from eeg_dataanalysispackage_tpu.scheduler import (
+        placement as placement_mod,
+    )
+
+    size = placement_mod.pool_size_marker(journal_dir)
+    if size is None:
+        return None
+    devices = placement_mod.device_table(journal_dir)
+    held = {row["ordinal"] for row in devices if not row["stale"]}
+    waiting = placement_mod.waiting_entries(journal_dir)
+    return {
+        "size": size,
+        "devices": devices,
+        "free": max(0, size - len(held)),
+        "waiting": [
+            {
+                "plan_id": w.get("plan_id"),
+                "footprint": w.get("footprint"),
+                "age_s": round(
+                    max(0.0, _now() - float(w.get("since", 0.0))), 2
+                ),
+            }
+            for w in waiting
+        ],
+    }
+
+
+def _now() -> float:
+    import time
+
+    return time.time()
 
 
 def _lease_table(journal_dir: str) -> list:
@@ -158,12 +201,21 @@ def snapshot(urls, journal_dir=None, timeout_s: float = 10.0) -> dict:
         "latency_p99_ms": None if merged is None else merged.quantile(99.0),
         "tenant_slo": tenant_slo,
     }
+    fleet["devices_held"] = sum(
+        len(r.get("devices_held") or []) for r in up
+    )
     snap = {"replicas": replicas, "fleet": fleet}
     if journal_dir:
         try:
             snap["leases"] = _lease_table(journal_dir)
         except OSError as e:
             snap["leases_error"] = f"{type(e).__name__}: {e}"
+        try:
+            pool = _device_pool_table(journal_dir)
+            if pool is not None:
+                snap["device_pool"] = pool
+        except OSError as e:
+            snap["device_pool_error"] = f"{type(e).__name__}: {e}"
     return snap
 
 
@@ -172,14 +224,15 @@ def render(snap: dict) -> None:
     from eeg_dataanalysispackage_tpu.obs import metrics_export
 
     cols = ("replica", "state", "plans", "serve", "shed", "leases",
-            "takeovers", "p50ms", "p99ms")
+            "devices", "takeovers", "p50ms", "p99ms")
     rows = []
     for r in snap["replicas"]:
         if "error" in r:
             rows.append({
                 "replica": r["url"], "state": "DOWN",
                 "plans": "-", "serve": "-", "shed": "-", "leases": "-",
-                "takeovers": "-", "p50ms": "-", "p99ms": "-",
+                "devices": "-", "takeovers": "-",
+                "p50ms": "-", "p99ms": "-",
                 "_error": r["error"],
             })
             continue
@@ -198,6 +251,10 @@ def render(snap: dict) -> None:
             "serve": r["serve_completed"],
             "shed": r["serve_shed"],
             "leases": r["held_leases"],
+            "devices": (
+                ",".join(str(o) for o in r.get("devices_held") or [])
+                or "-"
+            ),
             "takeovers": r["takeovers"],
             "p50ms": "-" if p50 is None else f"{p50:g}",
             "p99ms": "-" if p99 is None else f"{p99:g}",
@@ -241,6 +298,29 @@ def render(snap: dict) -> None:
             print(
                 f"  {row['plan_id']:<12} {row['holder'] or '?':<16} "
                 f"{row['age_s']:>7.2f}s  {mark}"
+            )
+    pool = snap.get("device_pool")
+    if pool is not None:
+        print(
+            f"\ndevice pool: {pool['size']} ordinals, "
+            f"{pool['free']} free, "
+            f"{len(pool['waiting'])} plan(s) waiting"
+        )
+        for row in pool["devices"]:
+            mark = "STALE" if row["stale"] else "held"
+            print(
+                f"  device {row['ordinal']:<3} "
+                f"{row['holder'] or '?':<16} "
+                f"{row['age_s']:>7.2f}s  {mark}"
+            )
+        for w in pool["waiting"]:
+            fp = w.get("footprint") or {}
+            print(
+                f"  waiting {w['plan_id'] or '?':<10} "
+                f"needs devices={fp.get('devices')} "
+                f"hosts={fp.get('hosts')} "
+                f"class={fp.get('memory_class')} "
+                f"({w['age_s']:.2f}s)"
             )
 
 

@@ -609,6 +609,17 @@ _FLEET_QUICK_PLANS = 3
 _FLEET_HEAVY_ITERATIONS = 600_000
 _FLEET_LEASE_TIMEOUT_S = "2"
 
+#: fleet_placement shape: the same 3-replica fleet over a forced
+#: 8-virtual-device host, run twice — device pool on vs off — driving
+#: one whole-pool gang plan plus 4 single-device plans. The small
+#: iteration count keeps real overlap on the pool (smalls granted,
+#: the gang waiting, backfill past it) without stretching either
+#: phase's makespan past the failover-class budget.
+_PLACEMENT_POOL = 8
+_PLACEMENT_SMALL_PLANS = 4
+_PLACEMENT_SMALL_ITERATIONS = 100_000
+_PLACEMENT_PROMOTION_S = "2"
+
 
 def _http_json(url: str, body: str = None, method: str = "GET",
                headers: dict = None, timeout: float = 60.0):
@@ -640,14 +651,18 @@ def _await_plan(base: str, plan_id: str, deadline_s: float = 600.0):
         time.sleep(0.05)
 
 
-def _spawn_multiproc_worker(query: str, timeout_s: str = "60"):
+def _spawn_multiproc_worker(query: str, timeout_s: str = "60",
+                            xla_devices: str = "2"):
     """One fresh pipeline process for the population_multiproc family:
-    2 virtual CPU devices, gloo collectives (set by the worker branch
-    before the backend initializes), feature cache off (the pod path
-    bypasses it anyway — the twin must match)."""
+    ``xla_devices`` virtual CPU devices (2 for the pod twins, the pool
+    size for fleet_placement's gang twin), gloo collectives (set by
+    the worker branch before the backend initializes), feature cache
+    off (the pod path bypasses it anyway — the twin must match)."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={xla_devices}"
+    )
     env["EEG_TPU_NO_FEATURE_CACHE"] = "1"
     env["EEG_TPU_POD_TIMEOUT_S"] = timeout_s
     env.pop("EEG_TPU_FAULTS", None)
@@ -1007,12 +1022,15 @@ def run_plan_service(info: str, scratch: str) -> dict:
 
 
 def _spawn_gateway_replica(replica_id: str, journal_dir: str,
-                           report_root: str, cache_dir: str):
+                           report_root: str, cache_dir: str,
+                           extra_env: dict = None):
     """One REAL fleet replica process via the production entrypoint
     (``python -m eeg_dataanalysispackage_tpu.gateway --fleet``) — the
     bench kills and drains exactly what an operator runs. CPU-forced:
     three concurrent processes must never contend for one
-    accelerator. Returns (Popen, stderr tempfile path)."""
+    accelerator. ``extra_env`` overlays the defaults (fleet_placement
+    turns the device pool on and forces the virtual host size).
+    Returns (Popen, stderr tempfile path)."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ)
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
@@ -1023,6 +1041,7 @@ def _spawn_gateway_replica(replica_id: str, journal_dir: str,
     env.pop("EEG_TPU_FAULTS", None)
     env.pop("EEG_TPU_RUN_REPORT_DIR", None)
     env.pop("EEG_TPU_NO_FEATURE_CACHE", None)
+    env.update(extra_env or {})
     # stderr to a file, not a pipe: replicas log freely and nobody
     # drains the pipe while the bench orchestrates the kill
     err = tempfile.NamedTemporaryFile(
@@ -1334,6 +1353,271 @@ def run_gateway_fleet(info: str, scratch: str) -> dict:
     }
 
 
+def run_fleet_placement(info: str, scratch: str) -> dict:
+    """The device-aware placement measurement (scheduler/placement.py
+    over the gateway fleet): the SAME 3-replica fleet workload run
+    twice on a forced-8-virtual-device host — once with the shared
+    device pool on (``EEG_TPU_DEVICE_POOL=8``) and once with placement
+    disabled — driving one 8-device gang plan plus 4 single-device
+    plans. The line carries the makespan ratio (placement vs the
+    disabled twin), byte-identical sha parity for every plan against
+    uninterrupted fresh-process twins, and the device-lease audit:
+    held ordinals sampled live while the fleet runs (never more than
+    the pool, never an ordinal twice), the gang's journal meta naming
+    all 8 leased ordinals, zero device leases left after the SIGTERM
+    drain."""
+    import signal as _signal
+
+    from eeg_dataanalysispackage_tpu.scheduler import (
+        placement as placement_mod,
+    )
+    from eeg_dataanalysispackage_tpu.scheduler.journal import PlanJournal
+
+    def q(extra="", iterations=0):
+        base = build_query(info, fanout=False) + "&dedup=false" + extra
+        if iterations:
+            base = base.replace(
+                "config_num_iterations=20",
+                f"config_num_iterations={iterations}",
+            )
+        return base
+
+    heavy_q = q(f"&devices={_PLACEMENT_POOL}", _FLEET_HEAVY_ITERATIONS)
+    small_q = q("", _PLACEMENT_SMALL_ITERATIONS)
+
+    # -- fresh-process twins: the shas every fleet execution — placed,
+    # backfilled, or unplaced — must reproduce byte-identically. The
+    # gang twin runs on the same 8-virtual-device host shape.
+    small_twin_proc = _spawn_multiproc_worker(small_q)
+    heavy_twin_proc = _spawn_multiproc_worker(
+        heavy_q, xla_devices=str(_PLACEMENT_POOL)
+    )
+    small_twin = _reap_worker(small_twin_proc)
+    heavy_twin = _reap_worker(heavy_twin_proc)
+
+    def phase(tag: str, pool: str) -> dict:
+        journal_dir = os.path.join(scratch, f"journal_pl_{tag}")
+        report_root = os.path.join(scratch, f"reports_pl_{tag}")
+        # per-phase feature cache: both phases pay the same cold
+        # ingest, so the makespan ratio compares placement, not cache
+        # warmth
+        cache_dir = os.path.join(scratch, f"fc_pl_{tag}")
+        extra_env = {
+            "EEG_TPU_DEVICE_POOL": pool,
+            "EEG_TPU_GANG_PROMOTION_S": _PLACEMENT_PROMOTION_S,
+            "XLA_FLAGS": (
+                "--xla_force_host_platform_device_count="
+                f"{_PLACEMENT_POOL}"
+            ),
+        }
+        ids = [
+            f"gw-{tag}-{chr(ord('a') + i)}"
+            for i in range(_FLEET_REPLICAS)
+        ]
+        procs, err_files, urls = [], [], []
+        max_held = 0
+        double_held = 0
+        waiting_seen = 0
+        try:
+            for rid in ids:
+                proc, err = _spawn_gateway_replica(
+                    rid, journal_dir, report_root, cache_dir,
+                    extra_env=extra_env,
+                )
+                procs.append(proc)
+                err_files.append(err)
+            for proc in procs:
+                urls.append(_replica_url(proc))
+            for url in urls:
+                ready_deadline = time.monotonic() + 120
+                while True:
+                    try:
+                        code, _ = _http_json(f"{url}/readyz", timeout=5)
+                    except OSError:
+                        code = 0
+                    if code == 200:
+                        break
+                    if time.monotonic() > ready_deadline:
+                        raise RuntimeError(f"{url} never became ready")
+                    time.sleep(0.2)
+
+            # -- submit: smalls first (they grant and the gang must
+            # wait behind them — the backfill/promotion window the
+            # pool exists to manage), then the whole-pool gang
+            start = time.perf_counter()
+            small_ids = []
+            for i in range(_PLACEMENT_SMALL_PLANS):
+                code, payload = _http_json(
+                    f"{urls[i % _FLEET_REPLICAS]}/plans",
+                    body=small_q, method="POST",
+                    headers={"X-Idempotency-Key": f"pl-{tag}-s{i}"},
+                )
+                if code != 201:
+                    raise RuntimeError(
+                        f"small submit {i} failed: {code} {payload}"
+                    )
+                small_ids.append(payload["plan_id"])
+            code, payload = _http_json(
+                f"{urls[0]}/plans", body=heavy_q, method="POST",
+                headers={"X-Idempotency-Key": f"pl-{tag}-heavy"},
+            )
+            if code != 201:
+                raise RuntimeError(
+                    f"gang submit failed: {code} {payload}"
+                )
+            heavy_id = payload["plan_id"]
+
+            # -- await all terminal, auditing the shared lease
+            # directory live: the union of held ordinals must never
+            # exceed the pool and no ordinal may ever be held twice
+            pending = set(small_ids + [heavy_id])
+            states = {}
+            deadline = time.monotonic() + 600
+            while pending:
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"plans never finished: {sorted(pending)}"
+                    )
+                rows = placement_mod.device_table(journal_dir)
+                ordinals = [r["ordinal"] for r in rows]
+                max_held = max(max_held, len(ordinals))
+                if len(ordinals) != len(set(ordinals)):
+                    double_held += 1
+                waiting_seen = max(
+                    waiting_seen,
+                    len(placement_mod.waiting_entries(journal_dir)),
+                )
+                for pid in list(pending):
+                    _, status = _http_json(f"{urls[1]}/plans/{pid}")
+                    if status.get("state") in (
+                        "completed", "failed", "cancelled",
+                    ):
+                        states[pid] = status["state"]
+                        pending.discard(pid)
+                time.sleep(0.05)
+            makespan = time.perf_counter() - start
+
+            for proc in procs:
+                proc.send_signal(_signal.SIGTERM)
+            drain_rcs = [p.wait(timeout=180) for p in procs]
+        finally:
+            for proc in procs:
+                if proc.poll() is None:
+                    proc.kill()
+            for name in err_files:
+                try:
+                    os.unlink(name)
+                except OSError:
+                    pass
+
+        entries = {
+            e["plan_id"]: e for e in PlanJournal(journal_dir).entries()
+        }
+        heavy_meta = (
+            entries.get(heavy_id, {}).get("meta") or {}
+        ).get("fleet") or {}
+        leftover_devices = [
+            n for n in os.listdir(journal_dir)
+            if n.startswith("device-") and n.endswith(".lease")
+        ]
+        return {
+            "pool": pool,
+            "makespan_s": round(makespan, 3),
+            "states": states,
+            "all_completed": all(
+                s == "completed" for s in states.values()
+            ),
+            "sha_identical": {
+                "gang": entries.get(heavy_id, {}).get(
+                    "statistics_sha256"
+                ) == heavy_twin["sha"],
+                "small": all(
+                    entries.get(pid, {}).get("statistics_sha256")
+                    == small_twin["sha"]
+                    for pid in small_ids
+                ),
+            },
+            "device_audit": {
+                "pool_size": _PLACEMENT_POOL,
+                "max_held": max_held,
+                "double_held_samples": double_held,
+                "waiting_seen": waiting_seen,
+                "leftover_device_leases": len(leftover_devices),
+                "gang_leased_ordinals": heavy_meta.get("devices"),
+            },
+            "drain_exit_codes": drain_rcs,
+            "drained_cleanly": all(rc == 0 for rc in drain_rcs),
+        }
+
+    start = time.perf_counter()
+    placed = phase("on", str(_PLACEMENT_POOL))
+    disabled = phase("off", "0")
+    wall = time.perf_counter() - start
+
+    gang_ordinals = placed["device_audit"]["gang_leased_ordinals"]
+    placement_block = {
+        "replicas": _FLEET_REPLICAS,
+        "plans": {
+            "gang_devices": _PLACEMENT_POOL,
+            "small": _PLACEMENT_SMALL_PLANS,
+        },
+        "placed": placed,
+        "disabled": disabled,
+        # the headline comparison: the placed fleet must not be slower
+        # than the free-for-all twin — exclusive ordinals instead of
+        # time-sharing the same host. 10% noise allowance, same
+        # precedent as the other wall-clock gates (makespans here are
+        # ~20s and scheduler jitter on a shared host exceeds a strict
+        # <=); the exact ratio stays in the line for trend tracking.
+        "makespan_ratio": round(
+            placed["makespan_s"] / disabled["makespan_s"], 3
+        ) if disabled["makespan_s"] else 0.0,
+        "placement_no_slower": (
+            placed["makespan_s"] <= disabled["makespan_s"] * 1.10
+        ),
+        "sha_parity": (
+            placed["sha_identical"]["gang"]
+            and placed["sha_identical"]["small"]
+            and disabled["sha_identical"]["gang"]
+            and disabled["sha_identical"]["small"]
+        ),
+        "zero_double_held": (
+            placed["device_audit"]["double_held_samples"] == 0
+            and placed["device_audit"]["max_held"] <= _PLACEMENT_POOL
+            and placed["device_audit"]["leftover_device_leases"] == 0
+        ),
+        "gang_fully_leased": (
+            sorted(gang_ordinals or [])
+            == list(range(_PLACEMENT_POOL))
+        ),
+    }
+    # epochs actually pushed through both fleets, from the per-plan
+    # run reports the replicas wrote
+    epochs = 0
+    for tag in ("on", "off"):
+        root = os.path.join(scratch, f"reports_pl_{tag}")
+        try:
+            plan_dirs = os.listdir(root)
+        except OSError:
+            plan_dirs = []
+        for pid in plan_dirs:
+            path = os.path.join(root, pid, "run_report.json")
+            try:
+                with open(path) as f:
+                    counters = (
+                        json.load(f).get("metrics") or {}
+                    ).get("counters") or {}
+                epochs += int(counters.get("pipeline.epochs_loaded", 0))
+            except (OSError, ValueError):
+                pass
+    return {
+        "placement": placement_block,
+        "wall_s": round(wall, 3),
+        "epochs": epochs,
+        "report_sha256": heavy_twin["sha"],
+    }
+
+
 def run_query(query: str):
     """(statistics, wall_s, n_epochs, stage dict, extras) for one
     pipeline execution. The stage dict is the builder's StageTimer
@@ -1423,7 +1707,7 @@ def main(argv) -> dict:
         "population_vmap", "population_looped", "population_sharded",
         "population_multiproc", "multiproc_worker",
         "seizure_e2e", "scheduler_multi", "scheduler_suicide",
-        "plan_service", "gateway_fleet", "populate",
+        "plan_service", "gateway_fleet", "fleet_placement", "populate",
     ):
         raise SystemExit(f"unknown variant {variant!r}")
 
@@ -1676,6 +1960,44 @@ def main(argv) -> dict:
             },
             "compile_cache": compile_cache.active_cache_dir(),
             "fleet": result["fleet"],
+            "report_sha256": result["report_sha256"],
+        }
+
+    if variant == "fleet_placement":
+        scratch = _OWNED_TMP or cache_dir
+        result = run_fleet_placement(info, scratch)
+        import jax
+
+        from eeg_dataanalysispackage_tpu.io import feature_cache
+        from eeg_dataanalysispackage_tpu.ops import plan_cache
+        from eeg_dataanalysispackage_tpu.utils import compile_cache
+
+        pstats = plan_cache.stats()
+        wall = result["wall_s"]
+        n_epochs = result["epochs"]
+        return {
+            "variant": variant,
+            # the headline rate spans BOTH phases (placed + disabled
+            # twin): the line exists for the makespan ratio and the
+            # audit in the placement block, not for raw throughput
+            "epochs_per_s": round(n_epochs / wall, 1) if wall else 0.0,
+            "n": n_epochs,
+            "iters": 1,
+            "wall_s": wall,
+            "elapsed_s": wall,
+            "bytes_per_epoch": _BYTES_PER_EPOCH,
+            "bytes_per_s": round(
+                (n_epochs / wall) * _BYTES_PER_EPOCH, 1
+            ) if wall else 0.0,
+            "n_markers_per_file": n_markers,
+            "n_files": n_files,
+            "platform": jax.devices()[0].platform,
+            "feature_cache": feature_cache.stats(),
+            "plan_cache": {
+                "hits": pstats["hits"], "misses": pstats["misses"],
+            },
+            "compile_cache": compile_cache.active_cache_dir(),
+            "placement": result["placement"],
             "report_sha256": result["report_sha256"],
         }
 

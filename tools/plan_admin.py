@@ -398,7 +398,45 @@ def cmd_fleet(args) -> int:
         f"\n{len(rows)} rows: {stale} stale (will be broken), "
         f"{unleased} unleased submitted (claimable)"
     )
+    _print_device_pool(args.journal)
     return 0
+
+
+def _print_device_pool(journal_dir: str) -> None:
+    """The device-pool section of the fleet view: per-ordinal holders
+    plus every WAITING plan with the footprint that blocks it —
+    rendered only when a pool has ever run over this journal (the
+    device-pool.json marker)."""
+    from eeg_dataanalysispackage_tpu.scheduler import (
+        placement as placement_mod,
+    )
+
+    size = placement_mod.pool_size_marker(journal_dir)
+    if size is None:
+        return
+    devices = placement_mod.device_table(journal_dir)
+    held = sum(1 for d in devices if not d["stale"])
+    print(
+        f"\ndevice pool: {size} ordinals, {held} held, "
+        f"{size - held} claimable"
+    )
+    for d in devices:
+        mark = "STALE" if d["stale"] else "held"
+        print(
+            f"  device {d['ordinal']:<3} {d['holder'] or '?':<16} "
+            f"{d['age_s']:>7.1f}s  {mark}"
+        )
+    waiting = placement_mod.waiting_entries(journal_dir)
+    for w in waiting:
+        fp = w.get("footprint") or {}
+        age = max(0.0, time.time() - float(w.get("since", 0.0)))
+        print(
+            f"  WAITING {w.get('plan_id') or '?':<10} "
+            f"blocked on devices={fp.get('devices')} "
+            f"hosts={fp.get('hosts')} "
+            f"class={fp.get('memory_class')}  ({age:.1f}s, "
+            f"promotes at {placement_mod.promotion_age():.1f}s)"
+        )
 
 
 def _load_trace_segments(trace_dir: str, trace_id: str):
